@@ -1,0 +1,21 @@
+"""dlrover_tpu: a TPU-native elastic, fault-tolerant distributed training framework.
+
+Re-imagines the capabilities of DLRover (elastic control plane + Flash
+Checkpoint + ATorch acceleration + TFPlus sparse embeddings) idiomatically for
+JAX/XLA/Pallas on TPU:
+
+- control plane: per-job master + per-host agent bringing up
+  ``jax.distributed`` process groups with master-mediated rendezvous
+  (reference: dlrover/python/master/**, dlrover/python/elastic_agent/**)
+- flash checkpoint: async device->host-shm snapshot of JAX pytrees with
+  restore-from-memory after restart (reference:
+  dlrover/python/elastic_agent/torch/ckpt_saver.py,
+  dlrover/trainer/torch/flash_checkpoint/**)
+- acceleration: named-axis device meshes + sharding-rule strategy layer
+  replacing ATorch's ``auto_accelerate`` (reference:
+  atorch/atorch/auto/accelerate.py)
+- sparse embeddings: native C++ hash-table embedding runtime (reference:
+  tfplus/tfplus/kv_variable/**)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,228 @@
+"""Agent-side async checkpoint persister (flash checkpoint back half).
+
+Reference analog: AsyncCheckpointSaver in
+dlrover/python/elastic_agent/torch/ckpt_saver.py (:344; _sync_shm_to_storage
+:515; save_shm_to_storage :631; commit protocol :745,856). The training
+process snapshots pytrees into shared memory (checkpoint/shm_handler.py) and
+enqueues a save event; this saver — living in the *agent* process so it
+survives trainer crashes — drains events and persists shm -> storage with a
+done-file + tracker commit protocol. On SIGTERM or before a restart the
+agent calls ``save_shm_to_storage`` so no snapshot is ever lost.
+
+Storage layout (one directory per step)::
+
+    <ckpt_dir>/step-<N>/node_<id>.bin        raw arena bytes
+    <ckpt_dir>/step-<N>/node_<id>.meta.json  leaf metas + save config
+    <ckpt_dir>/step-<N>/done_<id>            per-writer commit marker
+    <ckpt_dir>/latest                        tracker: committed step number
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import signal
+import threading
+import time
+from typing import Optional
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.multi_process import SharedQueue
+from dlrover_tpu.common.storage import (
+    CheckpointStorage,
+    ClassMeta,
+    PosixDiskStorage,
+    build_storage,
+)
+from dlrover_tpu.checkpoint.shm_handler import SharedMemoryHandler
+
+logger = get_logger(__name__)
+
+EVENT_SAVE = "save"
+EVENT_STOP = "stop"
+
+
+def step_dir(ckpt_dir: str, step: int) -> str:
+    return os.path.join(ckpt_dir, f"step-{step}")
+
+
+def tracker_path(ckpt_dir: str) -> str:
+    return os.path.join(ckpt_dir, "latest")
+
+
+class AsyncCheckpointSaver:
+    """Singleton-per-agent async persister."""
+
+    _instance: Optional["AsyncCheckpointSaver"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, node_id: int):
+        self.node_id = node_id
+        self.shm_handler = SharedMemoryHandler(node_id, owner=True)
+        self.event_queue = SharedQueue(f"ckpt_event_{node_id}", create=True)
+        self._last_persisted_step = -1
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(
+            target=self._sync_loop, name="ckpt-saver", daemon=True
+        )
+        self._persist_lock = threading.Lock()
+
+    @classmethod
+    def start(cls, node_id: int) -> "AsyncCheckpointSaver":
+        with cls._lock:
+            if cls._instance is None:
+                saver = cls(node_id)
+                saver._thread.start()
+                saver._register_signal_handlers()
+                cls._instance = saver
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            if cls._instance is not None:
+                cls._instance.stop()
+            cls._instance = None
+
+    def _register_signal_handlers(self) -> None:
+        # persist the latest snapshot on graceful termination
+        # (reference: ckpt_saver.py:470 register_signal_handler)
+        if threading.current_thread() is not threading.main_thread():
+            return
+        orig_term = signal.getsignal(signal.SIGTERM)
+
+        def on_term(signum, frame):
+            try:
+                self.save_shm_to_storage(reason="SIGTERM")
+            finally:
+                if callable(orig_term):
+                    orig_term(signum, frame)
+                else:
+                    raise SystemExit(143)
+
+        try:
+            signal.signal(signal.SIGTERM, on_term)
+        except ValueError:
+            pass
+
+    # ------------------------------------------------------------- main loop
+
+    def _sync_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                event = self.event_queue.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            if event.get("kind") == EVENT_STOP:
+                break
+            if event.get("kind") == EVENT_SAVE:
+                try:
+                    self._persist_step(int(event["step"]))
+                except Exception:  # noqa: BLE001
+                    logger.exception(
+                        "persist of step %s failed", event.get("step")
+                    )
+
+    def _persist_step(self, step: int) -> None:
+        with self._persist_lock:
+            raw = self.shm_handler.read_raw()
+            if raw is None:
+                logger.warning("no snapshot in shm; nothing to persist")
+                return
+            header, buf = raw
+            if int(header["step"]) != step:
+                logger.warning(
+                    "shm snapshot step %s != requested %s; persisting shm",
+                    header["step"], step,
+                )
+                step = int(header["step"])
+            if step <= self._last_persisted_step:
+                return
+            self._write_files(header, buf, step)
+            self._last_persisted_step = step
+
+    def _write_files(self, header: dict, buf, step: int) -> None:
+        ckpt_dir = header.get("ckpt_dir", "")
+        if not ckpt_dir:
+            logger.warning("snapshot has no ckpt_dir; skipping persist")
+            return
+        storage = self._build_storage(header)
+        start = time.monotonic()
+        # hold the writer lock so the trainer can't overwrite mid-copy
+        self.shm_handler.lock.acquire()
+        try:
+            content = bytes(buf[: int(header["total_size"])])
+        finally:
+            self.shm_handler.lock.release()
+        sdir = step_dir(ckpt_dir, step)
+        storage.makedirs(sdir)
+        storage.write(content, os.path.join(sdir, f"node_{self.node_id}.bin"))
+        storage.write(
+            json.dumps(header),
+            os.path.join(sdir, f"node_{self.node_id}.meta.json"),
+        )
+        storage.write(b"", os.path.join(sdir, f"done_{self.node_id}"))
+        self._maybe_commit(storage, header, step)
+        logger.info(
+            "persisted step %d (%d bytes) in %.2fs",
+            step, len(content), time.monotonic() - start,
+        )
+
+    def _maybe_commit(self, storage: CheckpointStorage, header: dict,
+                      step: int) -> None:
+        """Rank-0's agent updates the tracker once all shards are durable."""
+        if int(header.get("node_rank", 0)) != 0:
+            return
+        ckpt_dir = header["ckpt_dir"]
+        num_shards = int(header.get("num_shards", 1))
+        sdir = step_dir(ckpt_dir, step)
+        deadline = time.time() + 300.0
+        while time.time() < deadline:
+            done = [
+                f for f in storage.listdir(sdir) if f.startswith("done_")
+            ]
+            if len(done) >= num_shards:
+                storage.write(str(step), tracker_path(ckpt_dir))
+                logger.info("committed checkpoint step %d", step)
+                return
+            time.sleep(0.2)
+        logger.error(
+            "commit of step %d timed out (%d/%d shards done)", step,
+            len(done), num_shards,
+        )
+
+    def _build_storage(self, header: dict) -> CheckpointStorage:
+        meta = header.get("storage")
+        if meta:
+            try:
+                return build_storage(ClassMeta.from_dict(meta))
+            except Exception:  # noqa: BLE001
+                logger.exception("bad storage meta; using posix disk")
+        return PosixDiskStorage()
+
+    # -------------------------------------------------------- breakpoint save
+
+    def save_shm_to_storage(self, reason: str = "") -> None:
+        """Persist whatever is in shm right now (pre-restart / SIGTERM).
+
+        Reference analog: ckpt_saver.py:631 save_shm_to_storage.
+        """
+        raw = self.shm_handler.read_raw()
+        if raw is None:
+            return
+        header, _ = raw
+        step = int(header["step"])
+        if step <= self._last_persisted_step:
+            return
+        logger.info("breakpoint save of step %d (%s)", step, reason)
+        self._persist_step(step)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self.shm_handler.close()
+        self.event_queue.close()
+
+    @property
+    def last_persisted_step(self) -> int:
+        return self._last_persisted_step

@@ -1,0 +1,325 @@
+"""Per-host elastic agent: rendezvous, spawn, monitor, restart.
+
+Reference analog: dlrover/python/elastic_agent/torch/training.py
+(ElasticTrainingAgent:349, _invoke_run:547, _membership_changed:676,
+launch_agent:695). TPU-native differences:
+
+- one training *process per host* owning all local TPU chips (torch runs one
+  per GPU); the agent spawns exactly one child and the JAX runtime fans out
+  over local devices.
+- a completed rendezvous yields the JAX coordinator address; the child calls
+  ``jax.distributed.initialize`` from env instead of joining a TCPStore.
+- restart-in-place: on child failure or membership change the agent asks the
+  flash-checkpoint saver to persist the latest shm snapshot, then respawns
+  the child, which restores from shm in seconds (SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from enum import Enum
+
+from dlrover_tpu.common.constants import (
+    Defaults,
+    EnvKey,
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+    TrainingExceptionLevel,
+)
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.rpc import find_free_port
+from dlrover_tpu.agent.master_client import MasterClient
+
+logger = get_logger(__name__)
+
+
+class RunResult(str, Enum):
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+
+
+@dataclasses.dataclass
+class AgentConfig:
+    job_name: str = "local"
+    master_addr: str = ""
+    node_id: int = 0
+    entrypoint: list[str] = dataclasses.field(default_factory=list)
+    max_restarts: int = Defaults.MAX_RESTARTS
+    monitor_interval_s: float = Defaults.MONITOR_INTERVAL_S
+    heartbeat_interval_s: float = Defaults.HEARTBEAT_INTERVAL_S
+    rdzv_timeout_s: float = Defaults.RDZV_WAIT_TIMEOUT_S
+    network_check: bool = False
+    exclude_straggler: bool = False
+    local_devices: int = 0  # 0 -> autodetect
+    host_ip: str = "127.0.0.1"
+    topology_key: str = ""
+    save_on_failure: bool = True
+    comm_port_base: int = 0  # 0 -> pick free ports
+
+
+def _detect_local_devices() -> int:
+    override = os.environ.get(EnvKey.DEVICE_COUNT_OVERRIDE)
+    if override:
+        return int(override)
+    try:
+        import jax
+
+        return jax.local_device_count()
+    except Exception:  # noqa: BLE001 - no jax / no devices in agent is fine
+        return 1
+
+
+class ElasticAgent:
+    """Runs one elastic training lifecycle on this host."""
+
+    def __init__(self, config: AgentConfig, client: MasterClient | None = None):
+        self._config = config
+        self._client = client or MasterClient(
+            config.master_addr, config.node_id
+        )
+        self._proc: subprocess.Popen | None = None
+        self._restart_count = 0
+        self._stopped = threading.Event()
+        self._local_devices = config.local_devices or _detect_local_devices()
+        self._ckpt_saver = None  # wired by agent/ckpt_saver.py start()
+        self._world: dict[int, int] = {}
+        self._node_rank = -1
+        self._pending_action = ""
+
+    # ------------------------------------------------------------ rendezvous
+
+    def _rendezvous(self) -> tuple[int, int, str]:
+        """Join the training rendezvous; return (rank, num_nodes, coordinator).
+
+        The advertised address carries a freshly picked port the JAX
+        coordination service will bind if this node becomes rank 0.
+        """
+        port = self._config.comm_port_base or find_free_port(
+            self._config.host_ip
+        )
+        addr = f"{self._config.host_ip}:{port}"
+        self._client.join_rendezvous(
+            addr=addr,
+            local_devices=self._local_devices,
+            topology_key=self._config.topology_key,
+        )
+        world = self._client.wait_comm_world(
+            timeout=self._config.rdzv_timeout_s
+        )
+        self._world = world.world
+        self._node_rank = world.world[self._config.node_id]
+        logger.info(
+            "rendezvous round %d: rank %d of %d nodes, coordinator %s",
+            world.round, self._node_rank, len(world.world), world.coordinator,
+        )
+        return self._node_rank, len(world.world), world.coordinator
+
+    # ----------------------------------------------------------- child mgmt
+
+    def _spawn(self, rank: int, num_nodes: int, coordinator: str
+               ) -> subprocess.Popen:
+        env = dict(os.environ)
+        env.update(
+            {
+                EnvKey.JOB_NAME: self._config.job_name,
+                EnvKey.MASTER_ADDR: self._client._client.addr,
+                EnvKey.NODE_ID: str(self._config.node_id),
+                EnvKey.NODE_RANK: str(rank),
+                EnvKey.NODE_NUM: str(num_nodes),
+                EnvKey.COORDINATOR: coordinator,
+                EnvKey.RESTART_COUNT: str(self._restart_count),
+            }
+        )
+        logger.info(
+            "spawning training process (restart %d): %s",
+            self._restart_count, " ".join(self._config.entrypoint),
+        )
+        return subprocess.Popen(
+            self._config.entrypoint, env=env, start_new_session=True
+        )
+
+    def _kill_child(self) -> None:
+        if self._proc is None or self._proc.poll() is not None:
+            return
+        try:
+            os.killpg(self._proc.pid, signal.SIGTERM)
+            try:
+                self._proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                os.killpg(self._proc.pid, signal.SIGKILL)
+                self._proc.wait(timeout=10)
+        except ProcessLookupError:
+            pass
+
+    # ------------------------------------------------------------ main loop
+
+    def run(self) -> RunResult:
+        self._start_heartbeat()
+        self._start_ckpt_saver()
+        try:
+            if self._config.network_check:
+                self._run_network_check()
+            return self._invoke_run()
+        finally:
+            self._stopped.set()
+            self._kill_child()
+
+    def _invoke_run(self) -> RunResult:
+        rank, num_nodes, coordinator = self._rendezvous()
+        self._proc = self._spawn(rank, num_nodes, coordinator)
+        while True:
+            time.sleep(self._config.monitor_interval_s)
+            code = self._proc.poll()
+            if code == 0:
+                logger.info("training process succeeded")
+                self._client.report_node_event(
+                    NodeEventType.MODIFIED, NodeStatus.SUCCEEDED.value,
+                    NodeExitReason.SUCCEEDED,
+                )
+                self._client.report_job_exit(success=True)
+                return RunResult.SUCCEEDED
+            if code is not None:
+                if not self._handle_failure(code):
+                    return RunResult.FAILED
+                continue
+            # healthy: check for membership changes / master actions
+            if self._membership_changed() or self._master_action() == "restart":
+                self._restart_workers(reason="membership change")
+
+    def _handle_failure(self, exit_code: int) -> bool:
+        """Report and decide restart; returns False when giving up."""
+        logger.warning("training process exited with code %d", exit_code)
+        self._client.report_failure(
+            error_data=f"exit code {exit_code}",
+            restart_count=self._restart_count,
+            level=TrainingExceptionLevel.PROCESS_ERROR,
+        )
+        if self._restart_count >= self._config.max_restarts:
+            logger.error(
+                "no failovers remain (%d used); job failed",
+                self._restart_count,
+            )
+            self._client.report_node_event(
+                NodeEventType.MODIFIED, NodeStatus.FAILED.value,
+                NodeExitReason.FATAL_ERROR, f"exit code {exit_code}",
+            )
+            self._client.report_job_exit(
+                success=False, reason=f"exit code {exit_code}"
+            )
+            return False
+        self._persist_checkpoint(reason="process failure")
+        self._restart_count += 1
+        rank, num_nodes, coordinator = self._rendezvous()
+        self._proc = self._spawn(rank, num_nodes, coordinator)
+        return True
+
+    def _restart_workers(self, reason: str) -> None:
+        logger.info("restarting workers: %s", reason)
+        self._persist_checkpoint(reason=reason)
+        self._kill_child()
+        self._restart_count += 1
+        rank, num_nodes, coordinator = self._rendezvous()
+        self._proc = self._spawn(rank, num_nodes, coordinator)
+
+    def _membership_changed(self) -> bool:
+        try:
+            return self._client.num_nodes_waiting() > 0
+        except ConnectionError:
+            return False
+
+    def _master_action(self) -> str:
+        action, self._pending_action = self._pending_action, ""
+        return action
+
+    # ------------------------------------------------------------- services
+
+    def _start_heartbeat(self) -> None:
+        def loop():
+            while not self._stopped.is_set():
+                try:
+                    action = self._client.report_heartbeat(
+                        self._restart_count
+                    )
+                    if action:
+                        self._pending_action = action
+                except ConnectionError:
+                    logger.warning("heartbeat failed: master unreachable")
+                self._stopped.wait(self._config.heartbeat_interval_s)
+
+        threading.Thread(target=loop, name="agent-heartbeat",
+                         daemon=True).start()
+
+    def _start_ckpt_saver(self) -> None:
+        from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+
+        self._ckpt_saver = AsyncCheckpointSaver.start(
+            node_id=self._config.node_id
+        )
+
+    def _persist_checkpoint(self, reason: str) -> None:
+        """Flush the latest in-memory snapshot to storage before a restart.
+
+        Reference analog: the breakpoint save (ckpt_saver.py:631
+        save_shm_to_storage) triggered from training.py:590-610.
+        """
+        if not self._config.save_on_failure or self._ckpt_saver is None:
+            return
+        try:
+            self._ckpt_saver.save_shm_to_storage(reason=reason)
+        except Exception:  # noqa: BLE001 - never let persist break restart
+            logger.exception("breakpoint checkpoint persist failed")
+
+    # -------------------------------------------------------- network check
+
+    def _run_network_check(self) -> None:
+        """Pre-training collective probe; excludes bad nodes.
+
+        Reference analog: NodeCheckElasticAgent.run (training.py:805,956).
+        Joins the dedicated network-check rendezvous, runs the probe payload
+        in a subprocess, and reports timing to the master diagnosis manager.
+        """
+        from dlrover_tpu.agent.node_check import run_node_check
+
+        port = find_free_port(self._config.host_ip)
+        self._client.join_rendezvous(
+            addr=f"{self._config.host_ip}:{port}",
+            local_devices=self._local_devices,
+            rdzv_name="network-check",
+            topology_key=self._config.topology_key,
+        )
+        world = self._client.wait_comm_world(
+            rdzv_name="network-check", timeout=self._config.rdzv_timeout_s
+        )
+        elapsed, ok = run_node_check(
+            node_rank=world.world[self._config.node_id],
+            num_nodes=len(world.world),
+            coordinator=world.coordinator,
+        )
+        self._client.report_network_check(world.round, ok, elapsed)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            status = self._client.get_network_check_status()
+            if status.completed:
+                bad = set(status.abnormal_nodes)
+                if self._config.exclude_straggler:
+                    bad |= set(status.straggler_nodes)
+                if self._config.node_id in bad:
+                    raise RuntimeError(
+                        "this node failed the network check; excluding"
+                    )
+                return
+            time.sleep(0.5)
+        logger.warning("network check status never completed; proceeding")
+
+
+def launch_agent(config: AgentConfig) -> RunResult:
+    agent = ElasticAgent(config)
+    return agent.run()

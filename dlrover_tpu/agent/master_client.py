@@ -1,0 +1,240 @@
+"""Typed client for all agent/trainer -> master calls.
+
+Reference analog: dlrover/python/elastic_agent/master_client.py (:49
+MasterClient, API surface :122-404). One singleton per process, address from
+``EnvKey.MASTER_ADDR``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.constants import (
+    EnvKey,
+    NodeEventType,
+    NodeExitReason,
+    TrainingExceptionLevel,
+)
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.rpc import RpcClient
+
+logger = get_logger(__name__)
+
+
+class MasterClient:
+    _instance: Optional["MasterClient"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self, master_addr: str, node_id: int):
+        self._client = RpcClient(master_addr)
+        self.node_id = node_id
+
+    # ------------------------------------------------------------- singleton
+
+    @classmethod
+    def singleton(cls) -> "MasterClient":
+        with cls._instance_lock:
+            if cls._instance is None:
+                addr = os.environ.get(EnvKey.MASTER_ADDR, "")
+                if not addr:
+                    raise RuntimeError(
+                        f"{EnvKey.MASTER_ADDR} is not set; is this process "
+                        "running under the dlrover-tpu agent?"
+                    )
+                node_id = int(os.environ.get(EnvKey.NODE_ID, "0"))
+                cls._instance = cls(addr, node_id)
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._instance_lock:
+            if cls._instance is not None:
+                cls._instance.close()
+            cls._instance = None
+
+    def close(self) -> None:
+        self._client.close()
+
+    # ------------------------------------------------------------ rendezvous
+
+    def join_rendezvous(self, addr: str, local_devices: int,
+                        rdzv_name: str = "training",
+                        topology_key: str = "") -> int:
+        resp = self._client.call(
+            m.JoinRendezvousRequest(
+                node_id=self.node_id, rdzv_name=rdzv_name, addr=addr,
+                local_devices=local_devices, topology_key=topology_key,
+            )
+        )
+        return resp.round
+
+    def get_comm_world(self, rdzv_name: str = "training"
+                       ) -> m.CommWorldResponse:
+        return self._client.call(
+            m.CommWorldRequest(node_id=self.node_id, rdzv_name=rdzv_name)
+        )
+
+    def wait_comm_world(self, rdzv_name: str = "training",
+                        timeout: float = 600.0,
+                        poll_interval: float = 0.2) -> m.CommWorldResponse:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            resp = self.get_comm_world(rdzv_name)
+            if resp.completed:
+                return resp
+            time.sleep(poll_interval)
+        raise TimeoutError(
+            f"rendezvous {rdzv_name!r} did not complete in {timeout}s"
+        )
+
+    def num_nodes_waiting(self, rdzv_name: str = "training") -> int:
+        return self._client.call(
+            m.NumNodesWaitingRequest(rdzv_name=rdzv_name)
+        ).waiting_num
+
+    # -------------------------------------------------------------- kv store
+
+    def kv_set(self, key: str, value: bytes) -> None:
+        self._client.call(m.KVStoreSetRequest(key=key, value=value))
+
+    def kv_get(self, key: str) -> bytes | None:
+        resp = self._client.call(m.KVStoreGetRequest(key=key))
+        return resp.value if resp.found else None
+
+    def kv_wait(self, key: str, timeout: float = 60.0) -> bytes | None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            v = self.kv_get(key)
+            if v is not None:
+                return v
+            time.sleep(0.1)
+        return None
+
+    def kv_add(self, key: str, amount: int = 1) -> int:
+        return self._client.call(
+            m.KVStoreAddRequest(key=key, amount=amount)
+        ).number
+
+    def barrier(self, name: str, world_size: int, timeout: float = 60.0
+                ) -> bool:
+        """All-node barrier over the master counter."""
+        self.kv_add(f"barrier/{name}", 1)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.kv_add(f"barrier/{name}", 0) >= world_size:
+                return True
+            time.sleep(0.1)
+        return False
+
+    # ------------------------------------------------------- health / status
+
+    def report_heartbeat(self, restart_count: int = 0) -> str:
+        resp = self._client.call(
+            m.NodeHeartbeat(node_id=self.node_id,
+                            restart_count=restart_count)
+        )
+        return resp.action
+
+    def report_node_event(
+        self,
+        event_type: NodeEventType,
+        status: str = "",
+        exit_reason: NodeExitReason = NodeExitReason.UNKNOWN,
+        message: str = "",
+    ) -> None:
+        self._client.call(
+            m.NodeEventReport(
+                node_id=self.node_id, event_type=event_type, status=status,
+                exit_reason=exit_reason, message=message,
+            )
+        )
+
+    def report_failure(self, error_data: str, restart_count: int = 0,
+                       level: TrainingExceptionLevel =
+                       TrainingExceptionLevel.PROCESS_ERROR) -> None:
+        self._client.call(
+            m.FailureReport(
+                node_id=self.node_id, restart_count=restart_count,
+                level=level, error_data=error_data,
+            )
+        )
+
+    def report_resource(self, cpu_percent: float, used_memory_mb: int,
+                        tpu_chips: int = 0, used_hbm_mb: int = 0) -> None:
+        self._client.call(
+            m.ResourceStats(
+                node_id=self.node_id, cpu_percent=cpu_percent,
+                used_memory_mb=used_memory_mb, tpu_chips=tpu_chips,
+                used_hbm_mb=used_hbm_mb,
+            )
+        )
+
+    def report_step(self, step: int) -> None:
+        self._client.call(m.GlobalStepReport(node_id=self.node_id, step=step))
+
+    def get_running_nodes(self) -> list[m.NodeMeta]:
+        return self._client.call(m.RunningNodesRequest()).nodes
+
+    # --------------------------------------------------------- data sharding
+
+    def report_dataset_params(self, params: m.DatasetShardParams) -> None:
+        self._client.call(params)
+
+    def get_task(self, dataset_name: str) -> m.ShardTask:
+        return self._client.call(
+            m.TaskRequest(node_id=self.node_id, dataset_name=dataset_name)
+        )
+
+    def report_task_result(self, task_id: int, dataset_name: str,
+                           success: bool = True, error: str = "") -> None:
+        self._client.call(
+            m.TaskResult(
+                task_id=task_id, dataset_name=dataset_name,
+                node_id=self.node_id, success=success, error=error,
+            )
+        )
+
+    def get_shard_checkpoint(self, dataset_name: str) -> str:
+        return self._client.call(
+            m.ShardCheckpointRequest(dataset_name=dataset_name)
+        ).content
+
+    def restore_shard_checkpoint(self, dataset_name: str, content: str
+                                 ) -> None:
+        self._client.call(
+            m.ShardCheckpoint(dataset_name=dataset_name, content=content)
+        )
+
+    # -------------------------------------------------------- network check
+
+    def report_network_check(self, round_idx: int, succeeded: bool,
+                             elapsed_time: float) -> None:
+        self._client.call(
+            m.NetworkCheckResult(
+                node_id=self.node_id, round=round_idx, succeeded=succeeded,
+                elapsed_time=elapsed_time,
+            )
+        )
+
+    def get_network_check_status(self) -> m.NetworkCheckStatusResponse:
+        return self._client.call(
+            m.NetworkCheckStatusRequest(node_id=self.node_id)
+        )
+
+    # -------------------------------------------------------------- config
+
+    def get_paral_config(self) -> m.ParalConfig:
+        return self._client.call(m.ParalConfigRequest(node_id=self.node_id))
+
+    def report_paral_config(self, config: m.ParalConfig) -> None:
+        self._client.call(config)
+
+    def report_job_exit(self, success: bool, reason: str = "") -> None:
+        self._client.call(
+            m.JobExitRequest(node_id=self.node_id, success=success,
+                             reason=reason)
+        )

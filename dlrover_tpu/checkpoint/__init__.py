@@ -1,0 +1,2 @@
+from dlrover_tpu.checkpoint.checkpointer import Checkpointer  # noqa: F401
+from dlrover_tpu.checkpoint.engine import CheckpointEngine  # noqa: F401

@@ -1,0 +1,217 @@
+"""Trainer-side flash-checkpoint engine.
+
+Reference analog: dlrover/trainer/torch/flash_checkpoint/engine.py (:134
+CheckpointEngine, :287 save_state_dict_to_memory) + full_ckpt_engine.py.
+
+Save path: snapshot the pytree into this node's shm arena (sub-second), then
+— for DISK saves — enqueue an event so the *agent's* AsyncCheckpointSaver
+persists shm -> storage off the training path. Load path: shm fast-path if a
+snapshot exists (restart-in-place), else read the committed step from
+storage.
+
+Runs in two modes:
+- agent mode: the agent owns the shm primitives; this engine connects as a
+  client (detected by the agent's IPC sockets existing).
+- solo mode (no agent — notebooks, bench scripts): the engine owns the
+  primitives and runs an in-process AsyncCheckpointSaver thread, keeping the
+  same async behavior.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from dlrover_tpu.common.constants import CheckpointStorageType, EnvKey
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.multi_process import SharedQueue, client_socket_ready
+from dlrover_tpu.common.storage import CheckpointStorage, PosixDiskStorage
+from dlrover_tpu.checkpoint.shm_handler import (
+    SharedMemoryHandler,
+    restore_pytree,
+)
+
+logger = get_logger(__name__)
+
+
+class CheckpointEngine:
+    def __init__(
+        self,
+        ckpt_dir: str,
+        storage: CheckpointStorage | None = None,
+        node_id: int | None = None,
+        node_rank: int | None = None,
+        world_size: int | None = None,
+        replicated: bool = True,
+    ):
+        self.ckpt_dir = ckpt_dir
+        self.storage = storage or PosixDiskStorage()
+        self.node_id = (
+            node_id if node_id is not None
+            else int(os.environ.get(EnvKey.NODE_ID, "0"))
+        )
+        self.node_rank = (
+            node_rank if node_rank is not None
+            else int(os.environ.get(EnvKey.NODE_RANK, "0"))
+        )
+        self.world_size = (
+            world_size if world_size is not None
+            else int(os.environ.get(EnvKey.NODE_NUM, "1"))
+        )
+        # replicated: every node holds the full state (DP); only rank 0
+        # persists to storage. Sharded engines set replicated=False and every
+        # node persists its own shard.
+        self.replicated = replicated
+        self._solo_saver = None
+        agent_present = client_socket_ready(f"dict_ckpt_node{self.node_id}")
+        if not agent_present:
+            from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+
+            self._solo_saver = AsyncCheckpointSaver.start(self.node_id)
+            self.shm_handler = self._solo_saver.shm_handler
+            self.event_queue = self._solo_saver.event_queue
+        else:
+            self.shm_handler = SharedMemoryHandler(self.node_id, owner=False)
+            self.event_queue = SharedQueue(
+                f"ckpt_event_{self.node_id}", create=False
+            )
+
+    # ------------------------------------------------------------------ save
+
+    def _extra_meta(self) -> dict:
+        return {
+            "ckpt_dir": self.ckpt_dir,
+            "storage": self.storage.class_meta().to_dict(),
+            "node_rank": self.node_rank,
+            "node_id": self.node_id,
+            "world_size": self.world_size,
+            "num_shards": 1 if self.replicated else self.world_size,
+            "replicated": self.replicated,
+        }
+
+    def save_to_memory(self, step: int, state: Any) -> bool:
+        """Sub-second snapshot into shm. Returns False if the saver is mid-
+        persist (skip rather than block the training step)."""
+        if not self.shm_handler.lock.acquire(blocking=False):
+            logger.warning(
+                "skipping in-memory save at step %d: persister busy", step
+            )
+            return False
+        try:
+            start = time.monotonic()
+            self.shm_handler.save_state_dict(
+                step, state, extra_meta=self._extra_meta()
+            )
+            logger.info(
+                "step %d snapshotted to shm in %.3fs",
+                step, time.monotonic() - start,
+            )
+            return True
+        finally:
+            self.shm_handler.lock.release()
+
+    def save_to_storage(self, step: int, state: Any) -> bool:
+        if not self.save_to_memory(step, state):
+            return False
+        if self._should_write_storage():
+            self.event_queue.put({"kind": "save", "step": step})
+        return True
+
+    def _should_write_storage(self) -> bool:
+        return (not self.replicated) or self.node_rank == 0
+
+    def save(self, step: int, state: Any,
+             storage_type: CheckpointStorageType =
+             CheckpointStorageType.MEMORY) -> bool:
+        if storage_type == CheckpointStorageType.MEMORY:
+            return self.save_to_memory(step, state)
+        return self.save_to_storage(step, state)
+
+    # ------------------------------------------------------------------ load
+
+    def load(self, template: Any,
+             put: Callable[[str, np.ndarray], Any] | None = None
+             ) -> tuple[int, Any] | None:
+        """Restore the newest checkpoint: shm first, then storage."""
+        loaded = self._load_from_memory()
+        if loaded is None:
+            loaded = self._load_from_storage()
+        if loaded is None:
+            return None
+        step, arrays = loaded
+        return step, restore_pytree(template, arrays, put=put)
+
+    def _load_from_memory(self) -> tuple[int, dict[str, np.ndarray]] | None:
+        try:
+            snap = self.shm_handler.load_arrays()
+        except Exception:  # noqa: BLE001 - fall back to storage on any damage
+            logger.exception("shm restore failed; falling back to storage")
+            return None
+        if snap is not None:
+            logger.info("restoring step %d from shared memory", snap[0])
+        return snap
+
+    def _load_from_storage(self) -> tuple[int, dict[str, np.ndarray]] | None:
+        from dlrover_tpu.agent.ckpt_saver import step_dir, tracker_path
+
+        tracker = tracker_path(self.ckpt_dir)
+        if not self.storage.exists(tracker):
+            return None
+        step = int(self.storage.read_text(tracker).strip())
+        sdir = step_dir(self.ckpt_dir, step)
+        # replicated ckpt: one node file holds everything; prefer our own,
+        # else the smallest node id present.
+        metas = [
+            f for f in self.storage.listdir(sdir) if f.endswith(".meta.json")
+        ]
+        if not metas:
+            return None
+        own = f"node_{self.node_id}.meta.json"
+        meta_file = own if own in metas else sorted(metas)[0]
+        header = json.loads(
+            self.storage.read_text(os.path.join(sdir, meta_file))
+        )
+        bin_file = meta_file.replace(".meta.json", ".bin")
+        blob = self.storage.read(os.path.join(sdir, bin_file))
+        arrays: dict[str, np.ndarray] = {}
+        for name, info in header["metas"].items():
+            arr = np.frombuffer(
+                blob, dtype=np.dtype(info["dtype"]),
+                count=max(1, int(np.prod(info["shape"] or [1]))),
+                offset=info["offset"],
+            ).reshape(info["shape"])
+            arrays[name] = arr
+        logger.info("restored step %d from storage %s", step, sdir)
+        return step, arrays
+
+    def latest_persisted_step(self) -> int:
+        from dlrover_tpu.agent.ckpt_saver import tracker_path
+
+        tracker = tracker_path(self.ckpt_dir)
+        if not self.storage.exists(tracker):
+            return -1
+        return int(self.storage.read_text(tracker).strip())
+
+    def wait_for_persist(self, step: int, timeout: float = 120.0) -> bool:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.latest_persisted_step() >= step:
+                return True
+            time.sleep(0.1)
+        return False
+
+    def close(self) -> None:
+        if self._solo_saver is not None:
+            from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+
+            AsyncCheckpointSaver.reset()
+        else:
+            self.shm_handler.close()
+            self.event_queue.close()
+
+
+Optional  # re-export appeasement

@@ -1,0 +1,132 @@
+"""Framework-wide constants.
+
+Mirrors the capability surface of the reference constants module
+(dlrover/python/common/constants.py) with TPU-native vocabulary: node types
+are TPU hosts rather than PS/worker pods, accelerators are TPU chips, and the
+distribution strategies are mesh-axis based rather than PS/AllReduce based.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class PlatformType(str, enum.Enum):
+    LOCAL = "local"
+    KUBERNETES = "k8s"
+    RAY = "ray"
+
+
+class NodeType(str, enum.Enum):
+    MASTER = "master"
+    HOST = "host"  # a TPU host VM (runs one agent + one training process)
+    CPU_WORKER = "cpu_worker"  # auxiliary CPU pod (data preprocessing)
+
+
+class NodeStatus(str, enum.Enum):
+    INITIAL = "initial"
+    PENDING = "pending"
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    DELETED = "deleted"
+    UNKNOWN = "unknown"
+
+    @classmethod
+    def terminal(cls) -> set["NodeStatus"]:
+        return {cls.SUCCEEDED, cls.FAILED, cls.DELETED}
+
+
+class NodeEventType(str, enum.Enum):
+    ADDED = "added"
+    MODIFIED = "modified"
+    DELETED = "deleted"
+
+
+class NodeExitReason(str, enum.Enum):
+    SUCCEEDED = "succeeded"
+    KILLED = "killed"
+    OOM = "oom"
+    FATAL_ERROR = "fatal_error"
+    HARDWARE_ERROR = "hardware_error"
+    PREEMPTED = "preempted"
+    UNKNOWN = "unknown"
+
+
+class JobExitReason(str, enum.Enum):
+    SUCCEEDED = "succeeded"
+    NODE_OOM = "node_oom"
+    NODE_ERROR = "node_error"
+    RDZV_TIMEOUT = "rdzv_timeout"
+    HANG_ERROR = "hang_error"
+    UNCOMPLETED_TIMEOUT = "uncompleted_timeout"
+    EARLY_STOP = "early_stop"
+    UNKNOWN = "unknown"
+
+
+class RendezvousName(str, enum.Enum):
+    TRAINING = "training"
+    NETWORK_CHECK = "network-check"
+
+
+class TaskType(str, enum.Enum):
+    TRAINING = "training"
+    EVALUATION = "evaluation"
+    PREDICTION = "prediction"
+
+
+class CheckpointStorageType(str, enum.Enum):
+    MEMORY = "memory"
+    DISK = "disk"
+
+
+class ParallelAxis(str, enum.Enum):
+    """Named mesh axes for the parallel layer.
+
+    The reference builds torch process groups per named dim
+    (atorch/atorch/distributed/distributed.py:321 create_parallel_group);
+    here axes are dims of one ``jax.sharding.Mesh``.
+    """
+
+    DATA = "data"
+    FSDP = "fsdp"
+    TENSOR = "tensor"
+    SEQUENCE = "sequence"
+    EXPERT = "expert"
+    PIPELINE = "pipeline"
+
+
+class TrainingExceptionLevel(str, enum.Enum):
+    PROCESS_ERROR = "process_error"
+    NODE_ERROR = "node_error"
+    RDZV_ERROR = "rdzv_error"
+    WARNING = "warning"
+    INFO = "info"
+
+
+# Agent <-> training-process environment variable contract.
+class EnvKey:
+    JOB_NAME = "DLROVER_TPU_JOB_NAME"
+    MASTER_ADDR = "DLROVER_TPU_MASTER_ADDR"
+    NODE_ID = "DLROVER_TPU_NODE_ID"
+    NODE_RANK = "DLROVER_TPU_NODE_RANK"
+    NODE_NUM = "DLROVER_TPU_NODE_NUM"
+    COORDINATOR = "DLROVER_TPU_COORDINATOR"
+    RESTART_COUNT = "DLROVER_TPU_RESTART_COUNT"
+    PARAL_CONFIG_PATH = "DLROVER_TPU_PARAL_CONFIG"
+    CKPT_META_DIR = "DLROVER_TPU_CKPT_META_DIR"
+    MOCK_ERR_RANK = "DLROVER_TPU_MOCK_ERR_RANK"
+    DEVICE_COUNT_OVERRIDE = "DLROVER_TPU_DEVICE_COUNT"
+
+
+class Defaults:
+    MASTER_PORT = 0  # 0 -> pick a free port
+    HEARTBEAT_INTERVAL_S = 15.0
+    HEARTBEAT_DEAD_WINDOW_S = 300.0
+    RDZV_WAIT_TIMEOUT_S = 600.0
+    RDZV_POLL_INTERVAL_S = 0.2
+    MONITOR_INTERVAL_S = 1.0
+    MAX_RESTARTS = 3
+    SPEED_WINDOW_S = 6.0
+    RPC_TIMEOUT_S = 30.0
+    SHM_PREFIX = "dlrover_tpu"

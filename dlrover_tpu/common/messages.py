@@ -1,0 +1,338 @@
+"""Typed control-plane messages between agent and master.
+
+Reference analog: the pickled dataclasses in dlrover/python/common/grpc.py
+carried by the generic get/report RPCs (master/servicer.py:88-283). Here each
+message is a registered serde dataclass; the servicer dispatches on type.
+
+TPU-native differences: rendezvous hands back a *coordinator address* for
+``jax.distributed.initialize`` instead of a torch TCPStore world, and a node
+is one TPU host VM (one JAX process owning all local chips).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+from dlrover_tpu.common.constants import (
+    NodeEventType,
+    NodeExitReason,
+    TrainingExceptionLevel,
+)
+from dlrover_tpu.common.serde import register_message
+
+
+@register_message
+@dataclasses.dataclass
+class OkResponse:
+    success: bool = True
+    reason: str = ""
+
+
+# ---------------------------------------------------------------- rendezvous
+
+
+@register_message
+@dataclasses.dataclass
+class JoinRendezvousRequest:
+    node_id: int = 0
+    rdzv_name: str = "training"
+    addr: str = ""  # host:port the node would expose as JAX coordinator
+    local_devices: int = 0
+    topology_key: str = ""  # e.g. TPU slice/host position for rank sorting
+
+
+@register_message
+@dataclasses.dataclass
+class JoinRendezvousResponse:
+    round: int = 0
+
+
+@register_message
+@dataclasses.dataclass
+class CommWorldRequest:
+    node_id: int = 0
+    rdzv_name: str = "training"
+
+
+@register_message
+@dataclasses.dataclass
+class CommWorldResponse:
+    """The completed rendezvous round, or ``completed=False`` while waiting.
+
+    ``world`` maps node_id -> node_rank; ``coordinator`` is the address of
+    rank 0 (used as ``jax.distributed.initialize`` coordinator).
+    """
+
+    completed: bool = False
+    round: int = 0
+    world: dict[int, int] = dataclasses.field(default_factory=dict)
+    coordinator: str = ""
+    total_devices: int = 0
+
+
+@register_message
+@dataclasses.dataclass
+class NumNodesWaitingRequest:
+    rdzv_name: str = "training"
+
+
+@register_message
+@dataclasses.dataclass
+class NumNodesWaitingResponse:
+    waiting_num: int = 0
+
+
+# ------------------------------------------------------------------ kv store
+
+
+@register_message
+@dataclasses.dataclass
+class KVStoreSetRequest:
+    key: str = ""
+    value: bytes = b""
+
+
+@register_message
+@dataclasses.dataclass
+class KVStoreGetRequest:
+    key: str = ""
+
+
+@register_message
+@dataclasses.dataclass
+class KVStoreAddRequest:
+    key: str = ""
+    amount: int = 1
+
+
+@register_message
+@dataclasses.dataclass
+class KVStoreResponse:
+    found: bool = False
+    value: bytes = b""
+    number: int = 0
+
+
+# -------------------------------------------------------- node state / health
+
+
+@register_message
+@dataclasses.dataclass
+class NodeHeartbeat:
+    node_id: int = 0
+    timestamp: float = dataclasses.field(default_factory=time.time)
+    restart_count: int = 0
+
+
+@register_message
+@dataclasses.dataclass
+class HeartbeatResponse:
+    # master-initiated actions delivered on the heartbeat channel
+    action: str = ""  # "", "restart", "stop"
+
+
+@register_message
+@dataclasses.dataclass
+class NodeEventReport:
+    node_id: int = 0
+    event_type: NodeEventType = NodeEventType.MODIFIED
+    status: str = ""
+    exit_reason: NodeExitReason = NodeExitReason.UNKNOWN
+    message: str = ""
+
+
+@register_message
+@dataclasses.dataclass
+class FailureReport:
+    node_id: int = 0
+    restart_count: int = 0
+    level: TrainingExceptionLevel = TrainingExceptionLevel.PROCESS_ERROR
+    error_data: str = ""
+
+
+@register_message
+@dataclasses.dataclass
+class ResourceStats:
+    node_id: int = 0
+    cpu_percent: float = 0.0
+    used_memory_mb: int = 0
+    tpu_chips: int = 0
+    used_hbm_mb: int = 0
+
+
+@register_message
+@dataclasses.dataclass
+class GlobalStepReport:
+    node_id: int = 0
+    step: int = 0
+    timestamp: float = dataclasses.field(default_factory=time.time)
+
+
+@register_message
+@dataclasses.dataclass
+class RunningNodesRequest:
+    pass
+
+
+@register_message
+@dataclasses.dataclass
+class NodeMeta:
+    node_id: int = 0
+    rank: int = -1
+    status: str = ""
+    addr: str = ""
+
+
+@register_message
+@dataclasses.dataclass
+class RunningNodesResponse:
+    nodes: list[NodeMeta] = dataclasses.field(default_factory=list)
+
+
+# ----------------------------------------------------------- data sharding
+
+
+@register_message
+@dataclasses.dataclass
+class DatasetShardParams:
+    """Registers a dataset with the master task manager.
+
+    Reference analog: ReportDatasetShardParams
+    (dlrover/python/master/servicer.py report path + shard/dataset_splitter.py).
+    """
+
+    dataset_name: str = ""
+    dataset_size: int = 0
+    shard_size: int = 0  # records per shard (== per-round global batch slice)
+    num_epochs: int = 1
+    shuffle: bool = False
+    storage_type: str = "table"  # "table" (index ranges) or "text" (files)
+    task_type: str = "training"
+
+
+@register_message
+@dataclasses.dataclass
+class TaskRequest:
+    node_id: int = 0
+    dataset_name: str = ""
+
+
+@register_message
+@dataclasses.dataclass
+class ShardTask:
+    task_id: int = -1
+    dataset_name: str = ""
+    start: int = 0
+    end: int = 0
+    epoch: int = 0
+    task_type: str = "training"
+
+    @property
+    def valid(self) -> bool:
+        return self.task_id >= 0
+
+
+@register_message
+@dataclasses.dataclass
+class TaskResult:
+    task_id: int = -1
+    dataset_name: str = ""
+    node_id: int = 0
+    success: bool = True
+    error: str = ""
+
+
+@register_message
+@dataclasses.dataclass
+class ShardCheckpointRequest:
+    dataset_name: str = ""
+
+
+@register_message
+@dataclasses.dataclass
+class ShardCheckpoint:
+    dataset_name: str = ""
+    content: str = ""  # JSON blob of undone shards + epoch position
+
+
+# --------------------------------------------------------------- network check
+
+
+@register_message
+@dataclasses.dataclass
+class NetworkCheckResult:
+    node_id: int = 0
+    round: int = 0
+    succeeded: bool = True
+    elapsed_time: float = 0.0
+
+
+@register_message
+@dataclasses.dataclass
+class NetworkCheckStatusRequest:
+    node_id: int = 0
+
+
+@register_message
+@dataclasses.dataclass
+class NetworkCheckStatusResponse:
+    completed: bool = False
+    node_ok: bool = True
+    abnormal_nodes: list[int] = dataclasses.field(default_factory=list)
+    straggler_nodes: list[int] = dataclasses.field(default_factory=list)
+
+
+# ------------------------------------------------------------------- sync/ckpt
+
+
+@register_message
+@dataclasses.dataclass
+class SyncJoin:
+    sync_name: str = ""
+    node_id: int = 0
+
+
+@register_message
+@dataclasses.dataclass
+class SyncFinishedRequest:
+    sync_name: str = ""
+
+
+@register_message
+@dataclasses.dataclass
+class CheckpointSyncRequest:
+    """Master-coordinated 'everyone persists shm now' barrier before restart."""
+
+    node_id: int = 0
+    step: int = 0
+
+
+@register_message
+@dataclasses.dataclass
+class ParalConfigRequest:
+    node_id: int = 0
+
+
+@register_message
+@dataclasses.dataclass
+class ParalConfig:
+    """Master-suggested runtime-tunable knobs, hot-reloaded by the trainer.
+
+    Reference analog: ParallelConfig JSON handled by ParalConfigTuner
+    (dlrover/python/elastic_agent/config/paral_config_tuner.py:31).
+    """
+
+    dataloader_batch_size: int = 0
+    dataloader_version: int = 0
+    grad_accum_steps: int = 0
+    version: int = 0
+
+
+@register_message
+@dataclasses.dataclass
+class JobExitRequest:
+    node_id: int = 0
+    success: bool = True
+    reason: str = ""

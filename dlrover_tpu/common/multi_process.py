@@ -1,0 +1,393 @@
+"""Cross-process primitives: SharedLock / SharedQueue / SharedDict / SharedMemory.
+
+Reference analog: dlrover/python/common/multi_process.py (:225 SharedLock,
+:346 SharedQueue, :453 SharedDict, :537 SharedMemory). Same architecture:
+the *owner* process (the agent) hosts each primitive behind a unix-domain
+socket; *client* processes (training workers) connect by name. Payloads are
+typed JSON frames, never pickle.
+
+SharedMemory differs from the stdlib in one crucial way (as in the
+reference's ``_make_filename`` patch): segments are detached from the
+resource tracker so they survive the death of whichever process touched them
+— the point of flash checkpoint is that the agent can persist a worker's
+snapshot after the worker crashed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue as _queue
+import socket
+import socketserver
+import threading
+import time
+from multiprocessing import resource_tracker, shared_memory
+from typing import Any, Optional
+
+from dlrover_tpu.common.constants import Defaults
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.rpc import recv_frame, send_frame
+
+logger = get_logger(__name__)
+
+
+def _socket_dir() -> str:
+    d = os.environ.get(
+        "DLROVER_TPU_IPC_DIR", os.path.join("/tmp", Defaults.SHM_PREFIX + "_ipc")
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _socket_path(name: str) -> str:
+    return os.path.join(_socket_dir(), f"{name}.sock")
+
+
+class _LocalServer:
+    """Unix-socket server hosting one shared primitive in the owner process."""
+
+    def __init__(self, name: str, handler):
+        path = _socket_path(name)
+        if os.path.exists(path):
+            os.unlink(path)
+        outer_handler = handler
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                try:
+                    while True:
+                        raw = recv_frame(self.request)
+                        req = json.loads(raw.decode("utf-8"))
+                        resp = outer_handler(req)
+                        send_frame(
+                            self.request, json.dumps(resp).encode("utf-8")
+                        )
+                except (ConnectionError, OSError):
+                    pass
+
+        class _Server(socketserver.ThreadingUnixStreamServer):
+            daemon_threads = True
+
+        self._server = _Server(path, _Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name=f"ipc-{name}", daemon=True
+        )
+        self._thread.start()
+        self._path = path
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if os.path.exists(self._path):
+            os.unlink(self._path)
+
+
+class _LocalClient:
+    def __init__(self, name: str, timeout: float = 60.0):
+        self._path = _socket_path(name)
+        self._timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.settimeout(self._timeout)
+            sock.connect(self._path)
+            self._sock = sock
+        return self._sock
+
+    def request(self, req: dict) -> dict:
+        with self._lock:
+            try:
+                sock = self._connect()
+                send_frame(sock, json.dumps(req).encode("utf-8"))
+                return json.loads(recv_frame(sock).decode("utf-8"))
+            except (ConnectionError, OSError):
+                if self._sock is not None:
+                    self._sock.close()
+                    self._sock = None
+                raise
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None
+
+
+class SharedLock:
+    """A lock shared between the owner process and client processes."""
+
+    def __init__(self, name: str, create: bool = False):
+        self._name = f"lock_{name}"
+        self._create = create
+        if create:
+            self._local = threading.Lock()
+            self._server = _LocalServer(self._name, self._handle)
+        else:
+            self._client = _LocalClient(self._name)
+
+    def _handle(self, req: dict) -> dict:
+        op = req["op"]
+        if op == "acquire":
+            ok = self._local.acquire(blocking=req.get("blocking", True),
+                                     timeout=req.get("timeout", -1))
+            return {"ok": ok}
+        if op == "release":
+            try:
+                self._local.release()
+                return {"ok": True}
+            except RuntimeError:
+                return {"ok": False}
+        if op == "locked":
+            return {"ok": self._local.locked()}
+        return {"ok": False, "error": f"bad op {op}"}
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if self._create:
+            return self._local.acquire(blocking=blocking, timeout=timeout)
+        return self._client.request(
+            {"op": "acquire", "blocking": blocking, "timeout": timeout}
+        )["ok"]
+
+    def release(self) -> bool:
+        if self._create:
+            try:
+                self._local.release()
+                return True
+            except RuntimeError:
+                return False
+        return self._client.request({"op": "release"})["ok"]
+
+    def locked(self) -> bool:
+        if self._create:
+            return self._local.locked()
+        return self._client.request({"op": "locked"})["ok"]
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def close(self) -> None:
+        if self._create:
+            self._server.stop()
+        else:
+            self._client.close()
+
+
+class SharedQueue:
+    """A FIFO queue shared between processes (JSON-serializable items)."""
+
+    def __init__(self, name: str, create: bool = False, maxsize: int = 0):
+        self._name = f"queue_{name}"
+        self._create = create
+        if create:
+            self._local: _queue.Queue = _queue.Queue(maxsize)
+            self._server = _LocalServer(self._name, self._handle)
+        else:
+            self._client = _LocalClient(self._name)
+
+    def _handle(self, req: dict) -> dict:
+        op = req["op"]
+        try:
+            if op == "put":
+                self._local.put(
+                    req["item"], timeout=req.get("timeout") or None
+                )
+                return {"ok": True}
+            if op == "get":
+                item = self._local.get(
+                    block=req.get("block", True),
+                    timeout=req.get("timeout") or None,
+                )
+                return {"ok": True, "item": item}
+            if op == "qsize":
+                return {"ok": True, "size": self._local.qsize()}
+        except (_queue.Empty, _queue.Full) as e:
+            return {"ok": False, "error": type(e).__name__}
+        return {"ok": False, "error": f"bad op {op}"}
+
+    def put(self, item: Any, timeout: float | None = None) -> None:
+        if self._create:
+            self._local.put(item, timeout=timeout)
+        else:
+            resp = self._client.request(
+                {"op": "put", "item": item, "timeout": timeout}
+            )
+            if not resp["ok"]:
+                raise _queue.Full()
+
+    def get(self, block: bool = True, timeout: float | None = None) -> Any:
+        if self._create:
+            return self._local.get(block=block, timeout=timeout)
+        resp = self._client.request(
+            {"op": "get", "block": block, "timeout": timeout}
+        )
+        if not resp["ok"]:
+            raise _queue.Empty()
+        return resp["item"]
+
+    def qsize(self) -> int:
+        if self._create:
+            return self._local.qsize()
+        return self._client.request({"op": "qsize"})["size"]
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def close(self) -> None:
+        if self._create:
+            self._server.stop()
+        else:
+            self._client.close()
+
+
+class SharedDict:
+    """A dict shared between processes (JSON-serializable values).
+
+    Clients write with ``set``/``update`` and read a full snapshot with
+    ``get`` — matching how the reference shares checkpoint tensor metas
+    between trainer and agent (common/multi_process.py:453).
+    """
+
+    def __init__(self, name: str, create: bool = False):
+        self._name = f"dict_{name}"
+        self._create = create
+        if create:
+            self._store: dict = {}
+            self._mutex = threading.Lock()
+            self._server = _LocalServer(self._name, self._handle)
+        else:
+            self._client = _LocalClient(self._name)
+
+    def _handle(self, req: dict) -> dict:
+        op = req["op"]
+        with self._mutex:
+            if op == "set":
+                self._store[req["key"]] = req["value"]
+                return {"ok": True}
+            if op == "update":
+                self._store.update(req["items"])
+                return {"ok": True}
+            if op == "get":
+                return {"ok": True, "value": dict(self._store)}
+            if op == "pop":
+                return {"ok": True, "value": self._store.pop(req["key"], None)}
+        return {"ok": False, "error": f"bad op {op}"}
+
+    def set(self, key: str, value: Any) -> None:
+        if self._create:
+            with self._mutex:
+                self._store[key] = value
+        else:
+            self._client.request({"op": "set", "key": key, "value": value})
+
+    def update(self, items: dict) -> None:
+        if self._create:
+            with self._mutex:
+                self._store.update(items)
+        else:
+            self._client.request({"op": "update", "items": items})
+
+    def get(self) -> dict:
+        if self._create:
+            with self._mutex:
+                return dict(self._store)
+        return self._client.request({"op": "get"})["value"]
+
+    def pop(self, key: str) -> Any:
+        if self._create:
+            with self._mutex:
+                return self._store.pop(key, None)
+        return self._client.request({"op": "pop", "key": key})["value"]
+
+    def close(self) -> None:
+        if self._create:
+            self._server.stop()
+        else:
+            self._client.close()
+
+
+def _untrack(shm: shared_memory.SharedMemory) -> None:
+    """Detach a segment from the resource tracker.
+
+    Without this, whichever process merely *opened* the segment unlinks it at
+    exit, destroying the snapshot the agent still needs (the problem the
+    reference solves by patching ``_make_filename``).
+    """
+    try:
+        resource_tracker.unregister("/" + shm.name, "shared_memory")
+    except Exception:  # noqa: BLE001 - tracker internals vary by version
+        pass
+
+
+class SharedMemoryArena:
+    """Named POSIX shared memory that survives process death.
+
+    ``open_or_create`` grows the segment if an existing one is too small.
+    """
+
+    def __init__(self, name: str, shm: shared_memory.SharedMemory):
+        self.name = name
+        self._shm = shm
+
+    @property
+    def buf(self) -> memoryview:
+        return self._shm.buf
+
+    @property
+    def size(self) -> int:
+        return self._shm.size
+
+    @classmethod
+    def open_or_create(cls, name: str, size: int) -> "SharedMemoryArena":
+        full = f"{Defaults.SHM_PREFIX}_{name}"
+        try:
+            shm = shared_memory.SharedMemory(name=full, create=False)
+            if shm.size < size:
+                shm.unlink()
+                shm.close()
+                shm = shared_memory.SharedMemory(
+                    name=full, create=True, size=size
+                )
+        except FileNotFoundError:
+            shm = shared_memory.SharedMemory(name=full, create=True, size=size)
+        _untrack(shm)
+        return cls(full, shm)
+
+    @classmethod
+    def open(cls, name: str) -> Optional["SharedMemoryArena"]:
+        full = f"{Defaults.SHM_PREFIX}_{name}"
+        try:
+            shm = shared_memory.SharedMemory(name=full, create=False)
+        except FileNotFoundError:
+            return None
+        _untrack(shm)
+        return cls(full, shm)
+
+    def close(self) -> None:
+        self._shm.close()
+
+    def unlink(self) -> None:
+        try:
+            self._shm.unlink()
+        except FileNotFoundError:
+            pass
+
+
+def wait_for_path(path: str, timeout: float = 30.0) -> bool:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if os.path.exists(path):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def client_socket_ready(name: str) -> bool:
+    return os.path.exists(_socket_path(f"{name}"))

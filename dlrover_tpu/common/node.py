@@ -1,0 +1,72 @@
+"""Node model: what the master knows about each TPU host.
+
+Reference analog: ``Node``/``NodeResource`` in dlrover/python/common/node.py
+(:149, :37). TPU-native differences: resources track TPU chips/topology
+instead of GPU count, and one node == one host VM running a single JAX
+process that owns all local chips (the torch reference runs one process per
+GPU; see SURVEY.md §7 "Process model").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from dlrover_tpu.common.constants import NodeExitReason, NodeStatus, NodeType
+
+
+@dataclasses.dataclass
+class NodeResource:
+    cpu: float = 0.0
+    memory_mb: int = 0
+    tpu_chips: int = 0
+    tpu_topology: str = ""  # e.g. "2x2x1"
+    # runtime usage stats (reported by the agent resource monitor)
+    used_cpu: float = 0.0
+    used_memory_mb: int = 0
+    used_hbm_mb: int = 0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "NodeResource":
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class Node:
+    node_type: NodeType
+    node_id: int
+    rank: int = -1
+    name: str = ""
+    status: NodeStatus = NodeStatus.INITIAL
+    addr: str = ""
+    resource: NodeResource = dataclasses.field(default_factory=NodeResource)
+    exit_reason: NodeExitReason = NodeExitReason.UNKNOWN
+    relaunch_count: int = 0
+    max_relaunch_count: int = 3
+    create_time: float = dataclasses.field(default_factory=time.time)
+    heartbeat_time: float = 0.0
+    # topology hints for rank sorting (reference:
+    # dlrover/python/master/elastic_training/net_topology.py:61)
+    topology_key: str = ""
+
+    def update_status(self, status: NodeStatus) -> None:
+        self.status = status
+
+    def is_alive(self, dead_window_s: float, now: float | None = None) -> bool:
+        if self.heartbeat_time <= 0:
+            return True  # never reported yet; grace period handled by caller
+        now = time.time() if now is None else now
+        return (now - self.heartbeat_time) < dead_window_s
+
+    def should_relaunch(self, exit_reason: NodeExitReason) -> bool:
+        """Relaunch policy (reference: dist_job_manager.py:561 _should_relaunch).
+
+        Fatal (software) errors do not relaunch; everything else —
+        kill/preemption/OOM/hardware — does, bounded by max_relaunch_count.
+        """
+        if exit_reason == NodeExitReason.FATAL_ERROR:
+            return False
+        return self.relaunch_count < self.max_relaunch_count

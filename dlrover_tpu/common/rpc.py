@@ -1,0 +1,177 @@
+"""Length-prefixed TCP RPC for the control plane.
+
+Reference analog: the master gRPC service with generic ``get``/``report``
+methods (dlrover/proto/elastic_training.proto:28, master/servicer.py:62).
+Here a request is one typed message (common/serde.py) and the response is
+another; dispatch happens on the message type. The control plane is cold-path
+(heartbeats, rendezvous, shard requests), so a simple threaded TCP server is
+plenty and keeps the framework dependency-free.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from dlrover_tpu.common import serde
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 256 * 1024 * 1024
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def recv_frame(sock: socket.socket) -> bytes:
+    (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if length > MAX_FRAME:
+        raise ValueError(f"frame too large: {length}")
+    return _recv_exact(sock, length)
+
+
+@serde.register_message
+class RpcError:
+    error: str = ""
+
+
+class RpcServer:
+    """Threaded TCP server dispatching typed messages to a handler.
+
+    ``handler(msg) -> response message or None``.
+    """
+
+    def __init__(self, handler: Callable[[Any], Any], host: str = "0.0.0.0",
+                 port: int = 0):
+        self._handler = handler
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                sock = self.request
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                try:
+                    while True:
+                        raw = recv_frame(sock)
+                        resp = outer._dispatch(raw)
+                        send_frame(sock, resp)
+                except (ConnectionError, OSError):
+                    pass
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def _dispatch(self, raw: bytes) -> bytes:
+        try:
+            msg = serde.decode(raw)
+            resp = self._handler(msg)
+            if resp is None:
+                resp = RpcError()
+            return serde.encode(resp)
+        except Exception as e:  # noqa: BLE001 - report errors to the caller
+            logger.exception("rpc dispatch failed")
+            return serde.encode(RpcError(error=f"{type(e).__name__}: {e}"))
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="rpc-server", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class RpcClient:
+    """Persistent-connection client with reconnect + retry."""
+
+    def __init__(self, addr: str, timeout: float = 30.0, retries: int = 5,
+                 retry_interval: float = 1.0):
+        host, _, port = addr.rpartition(":")
+        self._host = host or "127.0.0.1"
+        self._port = int(port)
+        self._timeout = timeout
+        self._retries = retries
+        self._retry_interval = retry_interval
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    @property
+    def addr(self) -> str:
+        return f"{self._host}:{self._port}"
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            sock = socket.create_connection(
+                (self._host, self._port), timeout=self._timeout
+            )
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = sock
+        return self._sock
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+
+    def call(self, msg: Any) -> Any:
+        """Send one message, wait for the typed response.
+
+        Raises RuntimeError if the server reported an error, ConnectionError
+        if the master is unreachable after retries.
+        """
+        payload = serde.encode(msg)
+        last_err: Exception | None = None
+        for attempt in range(self._retries):
+            try:
+                with self._lock:
+                    sock = self._connect()
+                    send_frame(sock, payload)
+                    raw = recv_frame(sock)
+                resp = serde.decode(raw)
+                if isinstance(resp, RpcError) and resp.error:
+                    raise RuntimeError(f"rpc error: {resp.error}")
+                return resp
+            except (ConnectionError, OSError, TimeoutError) as e:
+                last_err = e
+                self.close()
+                if attempt < self._retries - 1:
+                    time.sleep(self._retry_interval)
+        raise ConnectionError(
+            f"rpc to {self.addr} failed after {self._retries} tries: {last_err}"
+        )
+
+
+def find_free_port(host: str = "127.0.0.1") -> int:
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]

@@ -1,0 +1,111 @@
+"""Typed, pickle-free message serialization.
+
+The reference ships ~40 pickled Python dataclasses over two generic gRPC
+methods (dlrover/python/common/grpc.py, master/servicer.py:88-130). Pickle
+over the wire is unsafe and version-brittle (SURVEY.md §7 "Master protocol"),
+so here every message is a registered dataclass encoded as JSON with a type
+tag. Only registered types can be decoded, and field reconstruction goes
+through the dataclass constructor with type-directed coercion (enums, nested
+dataclasses, tuples) — never arbitrary object construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import typing
+from typing import Any, Type, TypeVar
+
+T = TypeVar("T")
+
+_REGISTRY: dict[str, type] = {}
+
+
+def register_message(cls: Type[T]) -> Type[T]:
+    """Class decorator: make a dataclass wire-encodable."""
+    if not dataclasses.is_dataclass(cls):
+        cls = dataclasses.dataclass(cls)
+    _REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def _to_jsonable(value: Any) -> Any:
+    if isinstance(value, enum.Enum):
+        return value.value
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        out = {}
+        for f in dataclasses.fields(value):
+            out[f.name] = _to_jsonable(getattr(value, f.name))
+        if type(value).__name__ in _REGISTRY:
+            out["__type__"] = type(value).__name__
+        return out
+    if isinstance(value, dict):
+        return {str(k): _to_jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_to_jsonable(v) for v in value]
+    if isinstance(value, bytes):
+        return {"__bytes__": value.hex()}
+    return value
+
+
+def _coerce(hint: Any, value: Any) -> Any:
+    """Coerce a decoded JSON value to the annotated type."""
+    if value is None:
+        return None
+    origin = typing.get_origin(hint)
+    if origin is typing.Union or str(origin) == "types.UnionType":
+        for arg in typing.get_args(hint):
+            if arg is type(None):
+                continue
+            try:
+                return _coerce(arg, value)
+            except (TypeError, ValueError, KeyError):
+                continue
+        return value
+    if isinstance(hint, type) and issubclass(hint, enum.Enum):
+        return hint(value)
+    if dataclasses.is_dataclass(hint) and isinstance(value, dict):
+        return _from_fields(hint, value)
+    if origin in (list, tuple):
+        args = typing.get_args(hint)
+        elem = args[0] if args else Any
+        seq = [_coerce(elem, v) for v in value]
+        return tuple(seq) if origin is tuple else seq
+    if origin is dict:
+        args = typing.get_args(hint)
+        vt = args[1] if len(args) == 2 else Any
+        kt = args[0] if len(args) == 2 else str
+        def _key(k: str) -> Any:
+            return int(k) if kt is int else k
+        return {_key(k): _coerce(vt, v) for k, v in value.items()}
+    if isinstance(value, dict) and "__bytes__" in value:
+        return bytes.fromhex(value["__bytes__"])
+    return value
+
+
+def _from_fields(cls: type, data: dict) -> Any:
+    hints = typing.get_type_hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name in data:
+            kwargs[f.name] = _coerce(hints.get(f.name, Any), data[f.name])
+    return cls(**kwargs)
+
+
+def encode(msg: Any) -> bytes:
+    name = type(msg).__name__
+    if name not in _REGISTRY:
+        raise TypeError(f"message type {name} is not registered")
+    payload = _to_jsonable(msg)
+    payload.pop("__type__", None)
+    return json.dumps({"type": name, "data": payload}).encode("utf-8")
+
+
+def decode(raw: bytes) -> Any:
+    obj = json.loads(raw.decode("utf-8"))
+    name = obj.get("type")
+    cls = _REGISTRY.get(name)
+    if cls is None:
+        raise TypeError(f"unknown message type {name!r}")
+    return _from_fields(cls, obj.get("data", {}))

@@ -1,0 +1,104 @@
+"""Pluggable checkpoint storage.
+
+Reference analog: dlrover/python/common/storage.py (:23 CheckpointStorage,
+:127 PosixDiskStorage). ``ClassMeta`` survives a process boundary so the
+agent-side persister can reconstruct the trainer-configured storage backend
+(the reference ships it through shared memory; we ship it as JSON).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import os
+import shutil
+from abc import ABC, abstractmethod
+from typing import Any
+
+
+@dataclasses.dataclass
+class ClassMeta:
+    module_path: str = ""
+    class_name: str = ""
+    kwargs: dict = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ClassMeta":
+        return cls(**d)
+
+
+def build_storage(meta: ClassMeta) -> "CheckpointStorage":
+    module = importlib.import_module(meta.module_path)
+    klass = getattr(module, meta.class_name)
+    if not (isinstance(klass, type) and issubclass(klass, CheckpointStorage)):
+        raise TypeError(f"{meta.class_name} is not a CheckpointStorage")
+    return klass(**meta.kwargs)
+
+
+class CheckpointStorage(ABC):
+    @abstractmethod
+    def write(self, content: bytes | str, path: str) -> None: ...
+
+    @abstractmethod
+    def read(self, path: str) -> bytes: ...
+
+    @abstractmethod
+    def exists(self, path: str) -> bool: ...
+
+    @abstractmethod
+    def listdir(self, path: str) -> list[str]: ...
+
+    @abstractmethod
+    def makedirs(self, path: str) -> None: ...
+
+    @abstractmethod
+    def delete(self, path: str) -> None: ...
+
+    def read_text(self, path: str) -> str:
+        return self.read(path).decode("utf-8")
+
+    def class_meta(self) -> ClassMeta:
+        return ClassMeta(
+            module_path=type(self).__module__,
+            class_name=type(self).__name__,
+            kwargs=self._init_kwargs(),
+        )
+
+    def _init_kwargs(self) -> dict[str, Any]:
+        return {}
+
+
+class PosixDiskStorage(CheckpointStorage):
+    """Local/NFS filesystem storage with atomic writes."""
+
+    def write(self, content: bytes | str, path: str) -> None:
+        mode = "wb" if isinstance(content, bytes) else "w"
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, mode) as f:
+            f.write(content)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def read(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def listdir(self, path: str) -> list[str]:
+        return sorted(os.listdir(path)) if os.path.isdir(path) else []
+
+    def makedirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path: str) -> None:
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.unlink(path)

@@ -1,0 +1,108 @@
+"""Dataset splitting into shards for dynamic data sharding.
+
+Reference analog: dlrover/python/master/shard/dataset_splitter.py
+(DatasetSplitter:90, TableDatasetSplitter:144, TextDatasetSplitter:257).
+A shard is a [start, end) record-index range; workers fetch shards from the
+master so data assignment follows the *live* membership instead of a static
+rank-based partition — the mechanism that lets training continue when nodes
+come and go.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from abc import ABC, abstractmethod
+
+
+@dataclasses.dataclass
+class Shard:
+    start: int
+    end: int
+    record_indices: list[int] | None = None
+
+
+class DatasetSplitter(ABC):
+    def __init__(self, dataset_name: str, dataset_size: int, shard_size: int,
+                 num_epochs: int = 1):
+        if shard_size <= 0:
+            raise ValueError("shard_size must be positive")
+        self.dataset_name = dataset_name
+        self.dataset_size = dataset_size
+        self.shard_size = shard_size
+        self.num_epochs = num_epochs
+        self.epoch = 0
+
+    @abstractmethod
+    def create_shards(self) -> list[Shard]:
+        """Produce the shard list for the current epoch."""
+
+    def epoch_finished(self) -> bool:
+        return self.epoch >= self.num_epochs
+
+
+class TableDatasetSplitter(DatasetSplitter):
+    """Splits a record-indexed dataset into contiguous ranges.
+
+    With ``shuffle`` the *shard order* is permuted per epoch (deterministic
+    in epoch number, so recovery reproduces the same order); intra-shard
+    shuffling belongs to the data loader.
+    """
+
+    def __init__(self, *args, shuffle: bool = False, seed: int = 0, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.shuffle = shuffle
+        self.seed = seed
+
+    def create_shards(self) -> list[Shard]:
+        shards = [
+            Shard(start=i, end=min(i + self.shard_size, self.dataset_size))
+            for i in range(0, self.dataset_size, self.shard_size)
+        ]
+        if self.shuffle:
+            random.Random(self.seed + self.epoch).shuffle(shards)
+        self.epoch += 1
+        return shards
+
+
+class TextDatasetSplitter(DatasetSplitter):
+    """Splits line-indexed text data; shards carry explicit record indices
+    so shuffling can permute records globally (reference:
+    dataset_splitter.py:257)."""
+
+    def __init__(self, *args, shuffle: bool = False, seed: int = 0, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.shuffle = shuffle
+        self.seed = seed
+
+    def create_shards(self) -> list[Shard]:
+        indices = list(range(self.dataset_size))
+        if self.shuffle:
+            random.Random(self.seed + self.epoch).shuffle(indices)
+        shards = []
+        for i in range(0, self.dataset_size, self.shard_size):
+            chunk = indices[i:i + self.shard_size]
+            shards.append(
+                Shard(start=i, end=i + len(chunk), record_indices=chunk)
+            )
+        self.epoch += 1
+        return shards
+
+
+def new_dataset_splitter(
+    storage_type: str,
+    dataset_name: str,
+    dataset_size: int,
+    shard_size: int,
+    num_epochs: int = 1,
+    shuffle: bool = False,
+) -> DatasetSplitter:
+    cls = {
+        "table": TableDatasetSplitter,
+        "text": TextDatasetSplitter,
+    }.get(storage_type)
+    if cls is None:
+        raise ValueError(f"unknown dataset storage type {storage_type!r}")
+    return cls(
+        dataset_name, dataset_size, shard_size, num_epochs, shuffle=shuffle
+    )

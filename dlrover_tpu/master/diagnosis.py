@@ -1,0 +1,78 @@
+"""Node-health diagnosis from network-check probe results.
+
+Reference analog: the result side of NetworkCheckRendezvousManager +
+``_check_straggler`` (dlrover/python/master/servicer.py:226). Nodes run a
+matmul + collective probe (agent/node_check.py); the master aggregates
+per-round results, marks failing nodes abnormal and slow nodes stragglers
+(elapsed > ``straggler_ratio`` x median).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+import threading
+
+
+@dataclasses.dataclass
+class _ProbeResult:
+    succeeded: bool
+    elapsed_time: float
+
+
+class DiagnosisManager:
+    def __init__(self, straggler_ratio: float = 3.0):
+        self._straggler_ratio = straggler_ratio
+        self._lock = threading.Lock()
+        # round -> node_id -> result
+        self._results: dict[int, dict[int, _ProbeResult]] = {}
+        self._expected_nodes: set[int] = set()
+
+    def set_expected_nodes(self, node_ids: set[int]) -> None:
+        with self._lock:
+            self._expected_nodes = set(node_ids)
+
+    def report(self, node_id: int, round_idx: int, succeeded: bool,
+               elapsed_time: float) -> None:
+        with self._lock:
+            self._results.setdefault(round_idx, {})[node_id] = _ProbeResult(
+                succeeded, elapsed_time
+            )
+
+    def round_results(self, round_idx: int) -> dict[int, bool]:
+        with self._lock:
+            return {
+                nid: r.succeeded
+                for nid, r in self._results.get(round_idx, {}).items()
+            }
+
+    def status(self, latest_round: int) -> tuple[bool, list[int], list[int]]:
+        """(completed, abnormal_nodes, straggler_nodes) for a probe round."""
+        with self._lock:
+            results = self._results.get(latest_round, {})
+            expected = self._expected_nodes or set(results)
+            if not expected or not expected.issubset(results):
+                return False, [], []
+            abnormal = sorted(
+                nid for nid in expected if not results[nid].succeeded
+            )
+            ok_times = [
+                r.elapsed_time
+                for nid, r in results.items()
+                if r.succeeded and r.elapsed_time > 0
+            ]
+            stragglers: list[int] = []
+            if len(ok_times) >= 2:
+                med = statistics.median(ok_times)
+                if med > 0:
+                    stragglers = sorted(
+                        nid
+                        for nid, r in results.items()
+                        if r.succeeded
+                        and r.elapsed_time > self._straggler_ratio * med
+                    )
+            return True, abnormal, stragglers
+
+    def clear(self) -> None:
+        with self._lock:
+            self._results.clear()

@@ -1,0 +1,149 @@
+"""The per-job master: assembles managers + RPC server and runs the job loop.
+
+Reference analog: dlrover/python/master/local_master.py (:38 LocalJobMaster)
+and dist_master.py (:86 DistributedJobMaster, run loop :211-269). One master
+serves one elastic job. ``JobMaster`` here plays both roles: in standalone
+mode the CLI spawns it as a subprocess on localhost; on a cluster it runs in
+its own pod and agents connect over the network.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+from dlrover_tpu.common.constants import Defaults
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.rpc import RpcServer
+from dlrover_tpu.master.diagnosis import DiagnosisManager
+from dlrover_tpu.master.kv_store import KVStoreService
+from dlrover_tpu.master.node_manager import NodeManager
+from dlrover_tpu.master.rdzv_manager import (
+    NetworkCheckRendezvousManager,
+    RendezvousManager,
+)
+from dlrover_tpu.master.servicer import MasterServicer
+from dlrover_tpu.master.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.task_manager import TaskManager
+
+logger = get_logger(__name__)
+
+
+class JobMaster:
+    def __init__(
+        self,
+        job_name: str = "local",
+        port: int = 0,
+        min_nodes: int = 1,
+        max_nodes: int = 1,
+        rdzv_timeout: float = Defaults.RDZV_WAIT_TIMEOUT_S,
+        node_unit: int = 1,
+        hang_timeout_s: float = 1800.0,
+        heartbeat_dead_window_s: float = Defaults.HEARTBEAT_DEAD_WINDOW_S,
+    ):
+        self.job_name = job_name
+        self.task_manager = TaskManager()
+        self.speed_monitor = SpeedMonitor(hang_timeout_s=hang_timeout_s)
+        self.kv_store = KVStoreService()
+        self.diagnosis = DiagnosisManager()
+        self.node_manager = NodeManager(
+            dead_window_s=heartbeat_dead_window_s,
+            on_node_dead=self._on_node_dead,
+        )
+        self.rdzv_managers: dict[str, RendezvousManager] = {
+            "training": RendezvousManager(
+                name="training",
+                min_nodes=min_nodes,
+                max_nodes=max_nodes,
+                waiting_timeout=rdzv_timeout,
+                node_unit=node_unit,
+            ),
+            "network-check": NetworkCheckRendezvousManager(
+                min_nodes=min_nodes,
+                max_nodes=max_nodes,
+                waiting_timeout=rdzv_timeout,
+            ),
+        }
+        self.servicer = MasterServicer(
+            node_manager=self.node_manager,
+            task_manager=self.task_manager,
+            rdzv_managers=self.rdzv_managers,
+            speed_monitor=self.speed_monitor,
+            kv_store=self.kv_store,
+            diagnosis=self.diagnosis,
+        )
+        self._server = RpcServer(self.servicer.handle, port=port)
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def _on_node_dead(self, node_id: int) -> None:
+        self.task_manager.recover_tasks_of_node(node_id)
+        for mgr in self.rdzv_managers.values():
+            mgr.remove_node(node_id)
+
+    def prepare(self) -> None:
+        self._server.start()
+        self.node_manager.start()
+        logger.info("job master %s serving on port %d", self.job_name,
+                    self.port)
+
+    def run(self, poll_interval_s: float = 2.0) -> bool:
+        """Block until the job finishes; returns success."""
+        while True:
+            if self.servicer.job_exit_event.wait(poll_interval_s):
+                break
+            if self.speed_monitor.hanged():
+                logger.error("job hang detected; stopping")
+                self.servicer.job_success = False
+                break
+        success = bool(self.servicer.job_success)
+        logger.info("job %s finished, success=%s", self.job_name, success)
+        return success
+
+    def stop(self) -> None:
+        self.node_manager.stop()
+        self._server.stop()
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser("dlrover-tpu master")
+    parser.add_argument("--job-name", default="local")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--min-nodes", type=int, default=1)
+    parser.add_argument("--max-nodes", type=int, default=1)
+    parser.add_argument("--rdzv-timeout", type=float,
+                        default=Defaults.RDZV_WAIT_TIMEOUT_S)
+    parser.add_argument("--node-unit", type=int, default=1)
+    parser.add_argument("--hang-timeout", type=float, default=1800.0)
+    parser.add_argument(
+        "--port-file", default="",
+        help="write the bound port to this file once serving (for the CLI "
+             "to discover a dynamically chosen port)",
+    )
+    args = parser.parse_args(argv)
+    master = JobMaster(
+        job_name=args.job_name,
+        port=args.port,
+        min_nodes=args.min_nodes,
+        max_nodes=args.max_nodes,
+        rdzv_timeout=args.rdzv_timeout,
+        node_unit=args.node_unit,
+        hang_timeout_s=args.hang_timeout,
+    )
+    master.prepare()
+    if args.port_file:
+        with open(args.port_file, "w") as f:
+            f.write(str(master.port))
+    ok = master.run()
+    master.stop()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

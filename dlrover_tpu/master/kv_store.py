@@ -1,0 +1,48 @@
+"""Master-hosted KV store for inter-node barrier/address exchange.
+
+Reference analog: dlrover/python/master/elastic_training/kv_store_service.py
+and the agent-side MasterKVStore (elastic_agent/torch/master_kv_store.py:1),
+which replace torch's TCPStore. On TPU the heavy lifting is done by the JAX
+coordination service; this store covers pre-init exchange (coordinator
+address publication, barriers, checkpoint sync counts).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class KVStoreService:
+    def __init__(self):
+        self._store: dict[str, bytes] = {}
+        self._counters: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def set(self, key: str, value: bytes) -> None:
+        with self._lock:
+            self._store[key] = value
+
+    def get(self, key: str) -> bytes | None:
+        with self._lock:
+            return self._store.get(key)
+
+    def add(self, key: str, amount: int) -> int:
+        """Atomic counter add; used for barrier arrivals."""
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + amount
+            return self._counters[key]
+
+    def wait(self, key: str, timeout: float = 30.0) -> bytes | None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            v = self.get(key)
+            if v is not None:
+                return v
+            time.sleep(0.05)
+        return None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._store.clear()
+            self._counters.clear()

@@ -1,0 +1,171 @@
+"""Master-side node lifecycle: registration, heartbeats, failure handling.
+
+Reference analog: dlrover/python/master/node/dist_job_manager.py (:88
+DistributedJobManager, :355 _monitor_node_heart_beat, :561 _should_relaunch)
+collapsed to what the TPU control plane needs without a k8s scaler in the
+loop: track per-host liveness, emit dead-node events that (a) recover the
+node's in-flight data shards and (b) tell surviving agents to restart into a
+new rendezvous round via the heartbeat action channel.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+from dlrover_tpu.common.constants import (
+    Defaults,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.node import Node
+
+logger = get_logger(__name__)
+
+
+class NodeManager:
+    def __init__(
+        self,
+        dead_window_s: float = Defaults.HEARTBEAT_DEAD_WINDOW_S,
+        on_node_dead: Callable[[int], None] | None = None,
+    ):
+        self._dead_window_s = dead_window_s
+        self._on_node_dead = on_node_dead
+        self._lock = threading.Lock()
+        self._nodes: dict[int, Node] = {}
+        self._pending_actions: dict[int, str] = {}
+        self._stopped = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._failure_counts: dict[int, int] = {}
+
+    # ----------------------------------------------------------- registration
+
+    def ensure_node(self, node_id: int, addr: str = "") -> Node:
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                node = Node(
+                    node_type=NodeType.HOST, node_id=node_id, addr=addr,
+                    status=NodeStatus.RUNNING,
+                )
+                self._nodes[node_id] = node
+                logger.info("node %d registered (%s)", node_id, addr)
+            elif addr:
+                node.addr = addr
+            if node.status in NodeStatus.terminal():
+                # node came back (relaunch); resurrect
+                node.status = NodeStatus.RUNNING
+                node.heartbeat_time = time.time()
+            return node
+
+    def report_heartbeat(self, node_id: int, restart_count: int = 0) -> str:
+        """Record liveness; return any pending master action for the node."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                node = Node(node_type=NodeType.HOST, node_id=node_id,
+                            status=NodeStatus.RUNNING)
+                self._nodes[node_id] = node
+            node.heartbeat_time = time.time()
+            node.relaunch_count = restart_count
+            return self._pending_actions.pop(node_id, "")
+
+    def update_status(self, node_id: int, status: NodeStatus,
+                      exit_reason: NodeExitReason = NodeExitReason.UNKNOWN
+                      ) -> None:
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                return
+            node.status = status
+            node.exit_reason = exit_reason
+
+    def report_failure(self, node_id: int) -> int:
+        with self._lock:
+            self._failure_counts[node_id] = (
+                self._failure_counts.get(node_id, 0) + 1
+            )
+            return self._failure_counts[node_id]
+
+    # ------------------------------------------------------------- monitoring
+
+    def start(self, interval_s: float = 5.0) -> None:
+        self._thread = threading.Thread(
+            target=self._monitor_loop, args=(interval_s,),
+            name="node-heartbeat-monitor", daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def _monitor_loop(self, interval_s: float) -> None:
+        while not self._stopped.is_set():
+            try:
+                self._check_dead_nodes()
+            except Exception:  # noqa: BLE001
+                logger.exception("heartbeat monitor error")
+            self._stopped.wait(interval_s)
+
+    def _check_dead_nodes(self) -> None:
+        now = time.time()
+        dead: list[int] = []
+        with self._lock:
+            for node in self._nodes.values():
+                if node.status != NodeStatus.RUNNING:
+                    continue
+                if node.heartbeat_time <= 0:
+                    # never reported: give it a full window from creation
+                    if now - node.create_time > self._dead_window_s:
+                        dead.append(node.node_id)
+                elif not node.is_alive(self._dead_window_s, now):
+                    dead.append(node.node_id)
+            for nid in dead:
+                self._nodes[nid].status = NodeStatus.FAILED
+                self._nodes[nid].exit_reason = NodeExitReason.KILLED
+        for nid in dead:
+            logger.warning("node %d declared dead (no heartbeat)", nid)
+            self.broadcast_action("restart", exclude={nid})
+            if self._on_node_dead:
+                self._on_node_dead(nid)
+
+    def broadcast_action(self, action: str, exclude: set[int] | None = None
+                         ) -> None:
+        exclude = exclude or set()
+        with self._lock:
+            for nid, node in self._nodes.items():
+                if nid not in exclude and node.status == NodeStatus.RUNNING:
+                    self._pending_actions[nid] = action
+
+    # ---------------------------------------------------------------- queries
+
+    def running_nodes(self) -> list[Node]:
+        with self._lock:
+            return [
+                n for n in self._nodes.values()
+                if n.status == NodeStatus.RUNNING
+            ]
+
+    def all_nodes(self) -> list[Node]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    def all_exited(self) -> bool:
+        with self._lock:
+            if not self._nodes:
+                return False
+            return all(
+                n.status in NodeStatus.terminal()
+                for n in self._nodes.values()
+            )
+
+    def any_failed_fatally(self) -> bool:
+        with self._lock:
+            return any(
+                n.status == NodeStatus.FAILED
+                and n.exit_reason == NodeExitReason.FATAL_ERROR
+                for n in self._nodes.values()
+            )

@@ -1,0 +1,212 @@
+"""Master-mediated rendezvous.
+
+Reference analog: dlrover/python/master/elastic_training/rdzv_manager.py
+(RendezvousManager:58, _check_rdzv_completed:129, join_rendezvous:198,
+ElasticTrainingRendezvousManager:291, NetworkCheckRendezvousManager:349).
+
+TPU-native behavior: a completed round yields node ranks plus the JAX
+*coordinator address* (rank 0's advertised addr) so every agent can call
+``jax.distributed.initialize(coordinator, num_processes, process_id)``.
+Rank order is topology-aware: nodes sort by ``topology_key`` (TPU slice /
+host position) so data-parallel neighbors land on adjacent ICI links —
+the analog of the reference's access-switch sort (net_topology.py:61).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class _WaitingNode:
+    node_id: int
+    addr: str
+    local_devices: int
+    topology_key: str
+    join_time: float
+
+
+@dataclasses.dataclass
+class CommWorld:
+    round: int = 0
+    world: dict[int, int] = dataclasses.field(default_factory=dict)  # id->rank
+    coordinator: str = ""
+    total_devices: int = 0
+    node_addrs: dict[int, str] = dataclasses.field(default_factory=dict)
+
+
+class RendezvousManager:
+    """One named rendezvous (training or network-check)."""
+
+    def __init__(
+        self,
+        name: str = "training",
+        min_nodes: int = 1,
+        max_nodes: int = 1,
+        waiting_timeout: float = 60.0,
+        node_unit: int = 1,
+    ):
+        self.name = name
+        self._min_nodes = min_nodes
+        self._max_nodes = max_nodes
+        self._waiting_timeout = waiting_timeout
+        # world sizes must be a multiple of node_unit (e.g. hosts per TPU
+        # slice), mirroring the reference's node_unit rounding.
+        self._node_unit = max(1, node_unit)
+        self._lock = threading.Lock()
+        self._waiting: dict[int, _WaitingNode] = {}
+        self._latest: CommWorld | None = None
+        self._round = 0
+        self._first_join_time = 0.0
+
+    def update_node_bounds(self, min_nodes: int, max_nodes: int) -> None:
+        with self._lock:
+            self._min_nodes = min_nodes
+            self._max_nodes = max_nodes
+
+    def join(self, node_id: int, addr: str, local_devices: int,
+             topology_key: str = "") -> int:
+        """A node (re-)joins; returns the round it will participate in."""
+        with self._lock:
+            if not self._waiting:
+                self._first_join_time = time.time()
+            self._waiting[node_id] = _WaitingNode(
+                node_id=node_id,
+                addr=addr,
+                local_devices=local_devices,
+                topology_key=topology_key,
+                join_time=time.time(),
+            )
+            # a node rejoining invalidates the completed round it was part of
+            if self._latest and node_id in self._latest.world:
+                logger.info(
+                    "rdzv %s: node %s rejoined; invalidating round %s",
+                    self.name, node_id, self._latest.round,
+                )
+                self._latest = None
+            logger.info(
+                "rdzv %s: node %s joined (%d waiting, need %d-%d)",
+                self.name, node_id, len(self._waiting),
+                self._min_nodes, self._max_nodes,
+            )
+            return self._round
+
+    def remove_node(self, node_id: int) -> None:
+        with self._lock:
+            self._waiting.pop(node_id, None)
+            if self._latest and node_id in self._latest.world:
+                logger.info(
+                    "rdzv %s: node %s removed from completed round", self.name,
+                    node_id,
+                )
+                self._latest = None
+
+    def num_nodes_waiting(self) -> int:
+        """Nodes waiting for a round beyond the current completed world.
+
+        Agents poll this to detect membership changes
+        (reference: training.py:676 _membership_changed).
+        """
+        with self._lock:
+            if self._latest is None:
+                return 0 if not self._waiting else len(self._waiting)
+            return len(
+                [n for n in self._waiting if n not in self._latest.world]
+            )
+
+    def _try_complete(self) -> None:
+        # caller holds the lock
+        n = len(self._waiting)
+        if n < max(self._min_nodes, 1):
+            return
+        timed_out = (
+            time.time() - self._first_join_time >= self._waiting_timeout
+        )
+        if n < self._max_nodes and not timed_out:
+            return
+        usable = min(n, self._max_nodes)
+        usable -= usable % self._node_unit
+        if usable < self._min_nodes or usable <= 0:
+            return
+        nodes = sorted(
+            self._waiting.values(),
+            key=lambda w: (w.topology_key, w.node_id),
+        )[:usable]
+        world = {w.node_id: rank for rank, w in enumerate(nodes)}
+        coordinator = nodes[0].addr
+        self._round += 1
+        self._latest = CommWorld(
+            round=self._round,
+            world=world,
+            coordinator=coordinator,
+            total_devices=sum(w.local_devices for w in nodes),
+            node_addrs={w.node_id: w.addr for w in nodes},
+        )
+        for w in nodes:
+            self._waiting.pop(w.node_id, None)
+        logger.info(
+            "rdzv %s: round %d completed with %d nodes, coordinator %s",
+            self.name, self._round, len(world), coordinator,
+        )
+
+    def get_comm_world(self, node_id: int) -> CommWorld | None:
+        """The completed world containing ``node_id``, if any (non-blocking)."""
+        with self._lock:
+            self._try_complete()
+            if self._latest and node_id in self._latest.world:
+                return self._latest
+            return None
+
+    def clear_waiting(self) -> None:
+        with self._lock:
+            self._waiting.clear()
+
+
+class NetworkCheckRendezvousManager(RendezvousManager):
+    """Pairwise-group rendezvous for fault-node bisection.
+
+    The reference diagnoses a bad node in ≤2 rounds by grouping nodes in
+    pairs for an allgather probe, then re-pairing suspect nodes with known
+    good ones (rdzv_manager.py:349). The same logic applies on TPU with an
+    ICI/DCN collective probe; group assignment happens here, result
+    bookkeeping in the diagnosis manager.
+    """
+
+    def __init__(self, **kwargs):
+        kwargs.setdefault("name", "network-check")
+        super().__init__(**kwargs)
+
+    def group_nodes(self, round_idx: int, node_results: dict[int, bool]) -> list[list[int]]:
+        """Pair nodes for the probe round.
+
+        Round 0: adjacent pairs. Round 1: each node that failed round 0 is
+        paired with a node that passed, so a healthy node stuck with a bad
+        partner gets a second chance to prove itself.
+        """
+        with self._lock:
+            if self._latest is None:
+                return []
+            ids = sorted(self._latest.world, key=self._latest.world.get)
+        if round_idx == 0 or not node_results:
+            return [ids[i:i + 2] for i in range(0, len(ids), 2)]
+        good = [n for n in ids if node_results.get(n, False)]
+        bad = [n for n in ids if not node_results.get(n, False)]
+        groups: list[list[int]] = []
+        gi = 0
+        for b in bad:
+            if gi < len(good):
+                groups.append([b, good[gi]])
+                gi += 1
+            else:
+                groups.append([b])
+        remaining = good[gi:]
+        groups.extend(
+            [remaining[i:i + 2] for i in range(0, len(remaining), 2)]
+        )
+        return groups

@@ -1,0 +1,58 @@
+"""Training speed monitoring + hang detection on the master.
+
+Reference analog: dlrover/python/master/monitor/speed_monitor.py (:43) —
+workers report their global step; the master computes steps/s over a sliding
+window and flags a hang when no progress arrives within a timeout.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+
+class SpeedMonitor:
+    def __init__(self, window_s: float = 6.0, hang_timeout_s: float = 1800.0):
+        self._window_s = window_s
+        self._hang_timeout_s = hang_timeout_s
+        self._lock = threading.Lock()
+        self._samples: deque[tuple[float, int]] = deque(maxlen=4096)
+        self._global_step = 0
+        self._last_report_time = 0.0
+        self._start_time = time.time()
+
+    def report_step(self, step: int, timestamp: float | None = None) -> None:
+        ts = timestamp or time.time()
+        with self._lock:
+            if step > self._global_step:
+                self._global_step = step
+                self._samples.append((ts, step))
+            self._last_report_time = ts
+
+    @property
+    def global_step(self) -> int:
+        with self._lock:
+            return self._global_step
+
+    def running_speed(self) -> float:
+        """Steps per second over at least ``window_s`` of history."""
+        with self._lock:
+            if len(self._samples) < 2:
+                return 0.0
+            newest_t, newest_s = self._samples[-1]
+            for t, s in self._samples:
+                if newest_t - t >= self._window_s:
+                    oldest_t, oldest_s = t, s
+                    break
+            else:
+                oldest_t, oldest_s = self._samples[0]
+            if newest_t <= oldest_t:
+                return 0.0
+            return (newest_s - oldest_s) / (newest_t - oldest_t)
+
+    def hanged(self) -> bool:
+        with self._lock:
+            last = self._last_report_time or self._start_time
+            started = self._last_report_time > 0
+        return started and (time.time() - last) > self._hang_timeout_s

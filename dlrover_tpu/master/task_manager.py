@@ -1,0 +1,199 @@
+"""Master-side dynamic data sharding: the task queue per dataset.
+
+Reference analog: dlrover/python/master/shard/task_manager.py (:37) plus the
+batch dataset manager. Shards are dispatched to whichever node asks, tracked
+as *doing* until the node reports completion (at-least-once semantics); when
+a node dies its in-flight shards go back on the queue; the undone-shard state
+serializes to a checkpoint so a restarted job resumes mid-epoch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from collections import deque
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.messages import DatasetShardParams, ShardTask
+from dlrover_tpu.master.dataset_splitter import (
+    DatasetSplitter,
+    Shard,
+    new_dataset_splitter,
+)
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class _DoingTask:
+    task: ShardTask
+    node_id: int
+    start_time: float
+
+
+class _DatasetManager:
+    def __init__(self, splitter: DatasetSplitter, task_type: str):
+        self.splitter = splitter
+        self.task_type = task_type
+        self.todo: deque[ShardTask] = deque()
+        self.doing: dict[int, _DoingTask] = {}
+        self._next_task_id = 0
+        self._epoch_of_queue = -1
+        self.completed_count = 0
+
+    def _refill(self) -> None:
+        if self.todo or self.doing:
+            return
+        if self.splitter.epoch_finished():
+            return
+        epoch = self.splitter.epoch
+        for shard in self.splitter.create_shards():
+            self._append_shard(shard, epoch)
+        self._epoch_of_queue = epoch
+
+    def _append_shard(self, shard: Shard, epoch: int) -> None:
+        self.todo.append(
+            ShardTask(
+                task_id=self._next_task_id,
+                dataset_name=self.splitter.dataset_name,
+                start=shard.start,
+                end=shard.end,
+                epoch=epoch,
+                task_type=self.task_type,
+            )
+        )
+        self._next_task_id += 1
+
+    def get_task(self, node_id: int) -> ShardTask:
+        self._refill()
+        if not self.todo:
+            return ShardTask()  # invalid: no more work (epoch drained or done)
+        task = self.todo.popleft()
+        self.doing[task.task_id] = _DoingTask(task, node_id, time.time())
+        return task
+
+    def report_task(self, task_id: int, success: bool) -> None:
+        doing = self.doing.pop(task_id, None)
+        if doing is None:
+            return
+        if success:
+            self.completed_count += 1
+        else:
+            self.todo.appendleft(doing.task)
+
+    def recover_tasks_of_node(self, node_id: int) -> int:
+        ids = [
+            tid for tid, d in self.doing.items() if d.node_id == node_id
+        ]
+        for tid in ids:
+            self.todo.appendleft(self.doing.pop(tid).task)
+        return len(ids)
+
+    def finished(self) -> bool:
+        self._refill()
+        return (
+            not self.todo and not self.doing and self.splitter.epoch_finished()
+        )
+
+    def checkpoint(self) -> str:
+        """Undone shards (todo + doing) as JSON; doing counts as undone."""
+        undone = [dataclasses.asdict(t.task) for t in self.doing.values()]
+        undone += [dataclasses.asdict(t) for t in self.todo]
+        return json.dumps(
+            {
+                "dataset_name": self.splitter.dataset_name,
+                "epoch": self.splitter.epoch,
+                "next_task_id": self._next_task_id,
+                "undone": undone,
+            }
+        )
+
+    def restore_checkpoint(self, content: str) -> None:
+        state = json.loads(content)
+        self.todo.clear()
+        self.doing.clear()
+        self.splitter.epoch = state["epoch"]
+        self._next_task_id = state["next_task_id"]
+        for t in state["undone"]:
+            self.todo.append(ShardTask(**t))
+        self._epoch_of_queue = state["epoch"] - 1
+
+
+class TaskManager:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._datasets: dict[str, _DatasetManager] = {}
+
+    def maybe_create_dataset(self, params: DatasetShardParams) -> None:
+        with self._lock:
+            if params.dataset_name in self._datasets:
+                return
+            splitter = new_dataset_splitter(
+                params.storage_type,
+                params.dataset_name,
+                params.dataset_size,
+                params.shard_size,
+                params.num_epochs,
+                params.shuffle,
+            )
+            self._datasets[params.dataset_name] = _DatasetManager(
+                splitter, params.task_type
+            )
+            logger.info(
+                "dataset %s registered: size=%d shard=%d epochs=%d",
+                params.dataset_name, params.dataset_size, params.shard_size,
+                params.num_epochs,
+            )
+
+    def get_task(self, node_id: int, dataset_name: str) -> ShardTask:
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is None:
+                return ShardTask()
+            return ds.get_task(node_id)
+
+    def report_task(self, task_id: int, dataset_name: str,
+                    success: bool) -> None:
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is not None:
+                ds.report_task(task_id, success)
+
+    def recover_tasks_of_node(self, node_id: int) -> None:
+        with self._lock:
+            for name, ds in self._datasets.items():
+                n = ds.recover_tasks_of_node(node_id)
+                if n:
+                    logger.info(
+                        "recovered %d in-flight shards of node %d in %s",
+                        n, node_id, name,
+                    )
+
+    def finished(self) -> bool:
+        with self._lock:
+            if not self._datasets:
+                return False
+            return all(
+                ds.finished() for ds in self._datasets.values()
+                if ds.task_type == "training"
+            )
+
+    def checkpoint(self, dataset_name: str) -> str:
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            return ds.checkpoint() if ds else ""
+
+    def restore_checkpoint(self, dataset_name: str, content: str) -> None:
+        with self._lock:
+            ds = self._datasets.get(dataset_name)
+            if ds is not None and content:
+                ds.restore_checkpoint(content)
+
+    def completed_counts(self) -> dict[str, int]:
+        with self._lock:
+            return {
+                name: ds.completed_count
+                for name, ds in self._datasets.items()
+            }

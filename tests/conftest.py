@@ -1,0 +1,23 @@
+"""Test configuration: hermetic 8-device CPU mesh.
+
+Mirrors the reference's gloo-spawn multi-device testing pattern
+(SURVEY.md §4): JAX on CPU with ``--xla_force_host_platform_device_count=8``
+gives multi-device semantics without TPU hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_ipc_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("DLROVER_TPU_IPC_DIR", str(tmp_path / "ipc"))
+    return tmp_path

@@ -1,0 +1,204 @@
+"""Tests for the common substrate: serde, rpc, shared-memory IPC, storage."""
+
+import queue
+import threading
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.common import serde
+from dlrover_tpu.common.constants import NodeEventType, NodeExitReason
+from dlrover_tpu.common.messages import (
+    CommWorldResponse,
+    JoinRendezvousRequest,
+    KVStoreSetRequest,
+    NodeEventReport,
+    NodeMeta,
+    RunningNodesResponse,
+)
+from dlrover_tpu.common.multi_process import (
+    SharedDict,
+    SharedLock,
+    SharedMemoryArena,
+    SharedQueue,
+)
+from dlrover_tpu.common.rpc import RpcClient, RpcServer
+from dlrover_tpu.common.storage import ClassMeta, PosixDiskStorage, build_storage
+
+
+class TestSerde:
+    def test_roundtrip_simple(self):
+        msg = JoinRendezvousRequest(node_id=3, addr="h1:1234", local_devices=4)
+        out = serde.decode(serde.encode(msg))
+        assert out == msg
+
+    def test_roundtrip_enum_and_bytes(self):
+        msg = NodeEventReport(
+            node_id=1,
+            event_type=NodeEventType.DELETED,
+            exit_reason=NodeExitReason.OOM,
+        )
+        out = serde.decode(serde.encode(msg))
+        assert out.event_type is NodeEventType.DELETED
+        assert out.exit_reason is NodeExitReason.OOM
+
+        kv = KVStoreSetRequest(key="k", value=b"\x00\xffbin")
+        assert serde.decode(serde.encode(kv)).value == b"\x00\xffbin"
+
+    def test_roundtrip_int_keyed_dict(self):
+        msg = CommWorldResponse(
+            completed=True, world={0: 0, 3: 1}, coordinator="h:1"
+        )
+        out = serde.decode(serde.encode(msg))
+        assert out.world == {0: 0, 3: 1}
+        assert all(isinstance(k, int) for k in out.world)
+
+    def test_roundtrip_nested_list(self):
+        msg = RunningNodesResponse(
+            nodes=[NodeMeta(node_id=1, rank=0), NodeMeta(node_id=2, rank=1)]
+        )
+        out = serde.decode(serde.encode(msg))
+        assert out.nodes[1].node_id == 2
+        assert isinstance(out.nodes[0], NodeMeta)
+
+    def test_unknown_type_rejected(self):
+        with pytest.raises(TypeError):
+            serde.decode(b'{"type": "os.system", "data": {}}')
+
+
+class TestRpc:
+    def test_request_response(self):
+        def handler(msg):
+            if isinstance(msg, JoinRendezvousRequest):
+                return CommWorldResponse(completed=True, world={msg.node_id: 0})
+            return None
+
+        server = RpcServer(handler, host="127.0.0.1")
+        server.start()
+        try:
+            client = RpcClient(f"127.0.0.1:{server.port}")
+            resp = client.call(JoinRendezvousRequest(node_id=7))
+            assert resp.completed and resp.world == {7: 0}
+            # many sequential calls over one connection
+            for _ in range(50):
+                assert client.call(JoinRendezvousRequest(node_id=1)).completed
+            client.close()
+        finally:
+            server.stop()
+
+    def test_handler_error_propagates(self):
+        def handler(msg):
+            raise ValueError("boom")
+
+        server = RpcServer(handler, host="127.0.0.1")
+        server.start()
+        try:
+            client = RpcClient(f"127.0.0.1:{server.port}")
+            with pytest.raises(RuntimeError, match="boom"):
+                client.call(JoinRendezvousRequest())
+            client.close()
+        finally:
+            server.stop()
+
+    def test_concurrent_clients(self):
+        def handler(msg):
+            return CommWorldResponse(completed=True, round=msg.node_id)
+
+        server = RpcServer(handler, host="127.0.0.1")
+        server.start()
+        results = {}
+
+        def worker(i):
+            c = RpcClient(f"127.0.0.1:{server.port}")
+            results[i] = c.call(JoinRendezvousRequest(node_id=i)).round
+            c.close()
+
+        try:
+            threads = [
+                threading.Thread(target=worker, args=(i,)) for i in range(8)
+            ]
+            [t.start() for t in threads]
+            [t.join() for t in threads]
+            assert results == {i: i for i in range(8)}
+        finally:
+            server.stop()
+
+
+class TestSharedPrimitives:
+    def test_shared_lock(self, tmp_ipc_dir):
+        owner = SharedLock("l1", create=True)
+        client = SharedLock("l1", create=False)
+        try:
+            assert client.acquire()
+            assert not owner.acquire(blocking=False)
+            assert client.release()
+            assert owner.acquire(blocking=False)
+            owner.release()
+        finally:
+            client.close()
+            owner.close()
+
+    def test_shared_queue(self, tmp_ipc_dir):
+        owner = SharedQueue("q1", create=True)
+        client = SharedQueue("q1", create=False)
+        try:
+            client.put({"step": 5, "kind": "save"})
+            assert owner.qsize() == 1
+            item = owner.get(timeout=1)
+            assert item == {"step": 5, "kind": "save"}
+            with pytest.raises(queue.Empty):
+                client.get(block=False)
+        finally:
+            client.close()
+            owner.close()
+
+    def test_shared_dict(self, tmp_ipc_dir):
+        owner = SharedDict("d1", create=True)
+        client = SharedDict("d1", create=False)
+        try:
+            client.set("meta", {"offset": 128, "dtype": "float32"})
+            client.update({"step": 9})
+            snap = owner.get()
+            assert snap["meta"]["offset"] == 128
+            assert snap["step"] == 9
+            assert client.get()["step"] == 9
+        finally:
+            client.close()
+            owner.close()
+
+    def test_shared_memory_survives_reopen(self):
+        arena = SharedMemoryArena.open_or_create("t_arena", 1024)
+        np.frombuffer(arena.buf, dtype=np.uint8)[:4] = [1, 2, 3, 4]
+        arena.close()
+
+        again = SharedMemoryArena.open("t_arena")
+        assert again is not None
+        assert list(np.frombuffer(again.buf, dtype=np.uint8)[:4]) == [1, 2, 3, 4]
+        # growing reallocates
+        bigger = SharedMemoryArena.open_or_create("t_arena", 4096)
+        assert bigger.size >= 4096
+        bigger.unlink()
+        bigger.close()
+        again.close()
+
+
+class TestStorage:
+    def test_posix_roundtrip(self, tmp_path):
+        s = PosixDiskStorage()
+        p = str(tmp_path / "a" / "b.bin")
+        s.write(b"hello", p)
+        assert s.read(p) == b"hello"
+        assert s.exists(p)
+        assert s.listdir(str(tmp_path / "a")) == ["b.bin"]
+        s.delete(p)
+        assert not s.exists(p)
+
+    def test_class_meta_rebuild(self):
+        meta = PosixDiskStorage().class_meta()
+        rebuilt = build_storage(ClassMeta.from_dict(meta.to_dict()))
+        assert isinstance(rebuilt, PosixDiskStorage)
+
+    def test_build_storage_rejects_non_storage(self):
+        meta = ClassMeta(module_path="os", class_name="system")
+        with pytest.raises(TypeError):
+            build_storage(meta)
