@@ -124,37 +124,54 @@ class AsyncCheckpointSaver:
                         "persist of step %s failed", event.get("step")
                     )
 
-    def _persist_step(self, step: int) -> None:
+    def _persist_step(self, step: int, lock_timeout: float = 60.0) -> bool:
+        """Copy shm -> storage. Header and bytes are read under one hold of
+        the writer lock (bounded acquire) so a concurrent trainer save can't
+        leave us with a header/bytes mismatch, and a crashed lock holder
+        can't deadlock the failover path (reference: ckpt_saver.py:556-565
+        skips when any rank's lock is held)."""
         with self._persist_lock:
-            raw = self.shm_handler.read_raw()
-            if raw is None:
-                logger.warning("no snapshot in shm; nothing to persist")
-                return
-            header, buf = raw
-            if int(header["step"]) != step:
+            if not self.shm_handler.lock.acquire(timeout=lock_timeout):
                 logger.warning(
-                    "shm snapshot step %s != requested %s; persisting shm",
-                    header["step"], step,
+                    "shm writer lock busy for %.0fs; skipping persist of "
+                    "step %s (dirty shm)", lock_timeout, step,
                 )
-                step = int(header["step"])
-            if step <= self._last_persisted_step:
-                return
-            self._write_files(header, buf, step)
+                return False
+            try:
+                raw = self.shm_handler.read_raw()
+                if raw is None:
+                    logger.warning("no snapshot in shm; nothing to persist")
+                    return False
+                header, buf = raw
+                if int(header["step"]) != step:
+                    logger.warning(
+                        "shm snapshot step %s != requested %s; persisting shm",
+                        header["step"], step,
+                    )
+                    step = int(header["step"])
+                if step <= self._last_persisted_step:
+                    return True
+                total = int(header["total_size"])
+                content = bytes(buf[:total])
+            finally:
+                self.shm_handler.lock.release()
+            if len(content) != total:
+                logger.error(
+                    "shm arena truncated: %d bytes < header total %d; "
+                    "refusing to persist step %d", len(content), total, step,
+                )
+                return False
+            self._write_files(header, content, step)
             self._last_persisted_step = step
+            return True
 
-    def _write_files(self, header: dict, buf, step: int) -> None:
+    def _write_files(self, header: dict, content: bytes, step: int) -> None:
         ckpt_dir = header.get("ckpt_dir", "")
         if not ckpt_dir:
             logger.warning("snapshot has no ckpt_dir; skipping persist")
             return
         storage = self._build_storage(header)
         start = time.monotonic()
-        # hold the writer lock so the trainer can't overwrite mid-copy
-        self.shm_handler.lock.acquire()
-        try:
-            content = bytes(buf[: int(header["total_size"])])
-        finally:
-            self.shm_handler.lock.release()
         sdir = step_dir(ckpt_dir, step)
         storage.makedirs(sdir)
         storage.write(content, os.path.join(sdir, f"node_{self.node_id}.bin"))
@@ -203,20 +220,29 @@ class AsyncCheckpointSaver:
 
     # -------------------------------------------------------- breakpoint save
 
+    def reset_writer_lock(self) -> None:
+        """Release a lock orphaned by a crashed trainer (call pre-respawn)."""
+        try:
+            self.shm_handler.lock.reset()
+        except Exception:  # noqa: BLE001 - never block a restart on this
+            logger.exception("writer lock reset failed")
+
     def save_shm_to_storage(self, reason: str = "") -> None:
         """Persist whatever is in shm right now (pre-restart / SIGTERM).
 
+        Uses a short bounded lock acquire: if the trainer crashed while
+        holding the writer lock mid-save the shm is dirty anyway, and
+        blocking here would deadlock the agent's restart path.
         Reference analog: ckpt_saver.py:631 save_shm_to_storage.
         """
-        raw = self.shm_handler.read_raw()
-        if raw is None:
+        header = self.shm_handler.header()
+        if not header:
             return
-        header, _ = raw
         step = int(header["step"])
         if step <= self._last_persisted_step:
             return
         logger.info("breakpoint save of step %d (%s)", step, reason)
-        self._persist_step(step)
+        self._persist_step(step, lock_timeout=5.0)
 
     def stop(self) -> None:
         self._stopped.set()

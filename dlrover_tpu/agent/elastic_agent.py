@@ -93,6 +93,7 @@ class ElasticAgent:
         self._world: dict[int, int] = {}
         self._node_rank = -1
         self._pending_action = ""
+        self._action_lock = threading.Lock()
 
     # ------------------------------------------------------------ rendezvous
 
@@ -236,7 +237,8 @@ class ElasticAgent:
             return False
 
     def _master_action(self) -> str:
-        action, self._pending_action = self._pending_action, ""
+        with self._action_lock:
+            action, self._pending_action = self._pending_action, ""
         return action
 
     # ------------------------------------------------------------- services
@@ -249,7 +251,8 @@ class ElasticAgent:
                         self._restart_count
                     )
                     if action:
-                        self._pending_action = action
+                        with self._action_lock:
+                            self._pending_action = action
                 except ConnectionError:
                     logger.warning("heartbeat failed: master unreachable")
                 self._stopped.wait(self._config.heartbeat_interval_s)
@@ -270,12 +273,17 @@ class ElasticAgent:
         Reference analog: the breakpoint save (ckpt_saver.py:631
         save_shm_to_storage) triggered from training.py:590-610.
         """
-        if not self._config.save_on_failure or self._ckpt_saver is None:
+        if self._ckpt_saver is None:
             return
         try:
-            self._ckpt_saver.save_shm_to_storage(reason=reason)
+            if self._config.save_on_failure:
+                self._ckpt_saver.save_shm_to_storage(reason=reason)
         except Exception:  # noqa: BLE001 - never let persist break restart
             logger.exception("breakpoint checkpoint persist failed")
+        finally:
+            # a trainer that died holding the shm writer lock must not
+            # disable checkpointing for the rest of the job
+            self._ckpt_saver.reset_writer_lock()
 
     # -------------------------------------------------------- network check
 

@@ -1,2 +1,5 @@
-from dlrover_tpu.checkpoint.checkpointer import Checkpointer  # noqa: F401
 from dlrover_tpu.checkpoint.engine import CheckpointEngine  # noqa: F401
+from dlrover_tpu.checkpoint.shm_handler import (  # noqa: F401
+    SharedMemoryHandler,
+    restore_pytree,
+)

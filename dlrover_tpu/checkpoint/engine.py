@@ -22,7 +22,7 @@ from __future__ import annotations
 import json
 import os
 import time
-from typing import Any, Callable, Optional
+from typing import Any, Callable
 
 import numpy as np
 
@@ -175,6 +175,13 @@ class CheckpointEngine:
         header = json.loads(
             self.storage.read_text(os.path.join(sdir, meta_file))
         )
+        if meta_file != own and not header.get("replicated", True):
+            # Sharded checkpoint: another node's file holds a different
+            # shard — loading it would silently install wrong weights.
+            raise FileNotFoundError(
+                f"sharded checkpoint at {sdir} is missing this node's "
+                f"shard {own}; refusing to load another node's shard"
+            )
         bin_file = meta_file.replace(".meta.json", ".bin")
         blob = self.storage.read(os.path.join(sdir, bin_file))
         arrays: dict[str, np.ndarray] = {}
@@ -212,6 +219,3 @@ class CheckpointEngine:
         else:
             self.shm_handler.close()
             self.event_queue.close()
-
-
-Optional  # re-export appeasement

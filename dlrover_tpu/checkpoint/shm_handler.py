@@ -7,11 +7,11 @@ offsets. The arena outlives the training process, so the agent can persist
 the last snapshot even after a crash, and a restarted process restores from
 memory without touching storage.
 
-JAX specifics: leaves are host numpy views; ``device_get`` lands device
-arrays straight into the pinned views (one D2H copy, no intermediate
-allocation). Restore hands back numpy arrays; the caller ``device_put``s
-them with target shardings (which may differ from the saving mesh —
-reshard-on-load).
+JAX specifics: all D2H transfers are kicked off with
+``copy_to_host_async`` before the first blocking ``device_get`` so they
+overlap, then each host buffer is copied into its arena view. Restore hands
+back numpy arrays; the caller ``device_put``s them with target shardings
+(which may differ from the saving mesh — reshard-on-load).
 """
 
 from __future__ import annotations
@@ -156,7 +156,7 @@ class SharedMemoryHandler:
         header = self.header()
         if not header:
             return None
-        arena = self._open_arena()
+        arena = self._open_arena(min_size=int(header["total_size"]))
         if arena is None:
             return None
         out: dict[str, np.ndarray] = {}
@@ -175,13 +175,22 @@ class SharedMemoryHandler:
         header = self.header()
         if not header:
             return None
-        arena = self._open_arena()
+        arena = self._open_arena(min_size=int(header["total_size"]))
         if arena is None:
             return None
         return header, arena.buf
 
-    def _open_arena(self) -> SharedMemoryArena | None:
+    def _open_arena(self, min_size: int = 0) -> SharedMemoryArena | None:
+        """Open (or re-open) the arena mapping.
+
+        The trainer unlinks and recreates the segment under the same name
+        when a snapshot grows, so a cached mapping smaller than the header's
+        ``total_size`` is stale — close it and map the new segment.
+        """
         with self._local_lock:
+            if self._arena is not None and self._arena.size < min_size:
+                self._arena.close()
+                self._arena = None
             if self._arena is None:
                 self._arena = SharedMemoryArena.open(self._arena_name)
             return self._arena

@@ -228,6 +228,12 @@ class ShardTask:
     end: int = 0
     epoch: int = 0
     task_type: str = "training"
+    # Explicit record indices for globally-shuffled text datasets
+    # (TextDatasetSplitter); empty means "use range(start, end)".
+    record_indices: list[int] = dataclasses.field(default_factory=list)
+
+    def indices(self) -> list[int]:
+        return self.record_indices or list(range(self.start, self.end))
 
     @property
     def valid(self) -> bool:

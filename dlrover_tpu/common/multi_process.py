@@ -166,6 +166,20 @@ class SharedLock:
             return self._local.locked()
         return self._client.request({"op": "locked"})["ok"]
 
+    def reset(self) -> None:
+        """Force-release an orphaned hold (owner side only).
+
+        A client that dies between acquire and release would otherwise pin
+        the lock forever; the agent calls this when it restarts the worker.
+        """
+        if not self._create:
+            raise RuntimeError("only the lock owner can reset it")
+        if self._local.locked():
+            try:
+                self._local.release()
+            except RuntimeError:
+                pass
+
     def __enter__(self):
         self.acquire()
         return self

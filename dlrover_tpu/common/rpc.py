@@ -10,11 +10,14 @@ plenty and keeps the framework dependency-free.
 
 from __future__ import annotations
 
+import json
 import socket
 import socketserver
 import struct
 import threading
 import time
+import uuid
+from collections import OrderedDict
 from typing import Any, Callable, Optional
 
 from dlrover_tpu.common import serde
@@ -61,6 +64,14 @@ class RpcServer:
     def __init__(self, handler: Callable[[Any], Any], host: str = "0.0.0.0",
                  port: int = 0):
         self._handler = handler
+        # Replay cache: request-id -> encoded response. A client retry after
+        # a lost *response* must not re-apply non-idempotent messages
+        # (TaskResult completions, KV barrier increments). Large responses
+        # (shard tasks with record indices) are not cached — re-fetching a
+        # read is safe; only small non-idempotent acks need replay cover.
+        self._replay: OrderedDict[str, bytes] = OrderedDict()
+        self._replay_bytes = 0
+        self._replay_lock = threading.Lock()
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
@@ -88,11 +99,29 @@ class RpcServer:
 
     def _dispatch(self, raw: bytes) -> bytes:
         try:
-            msg = serde.decode(raw)
+            obj = json.loads(raw.decode("utf-8"))
+            rid = obj.pop("rid", None)
+            if rid is not None:
+                with self._replay_lock:
+                    cached = self._replay.get(rid)
+                if cached is not None:
+                    return cached
+            msg = serde.decode_obj(obj)
             resp = self._handler(msg)
             if resp is None:
                 resp = RpcError()
-            return serde.encode(resp)
+            encoded = serde.encode(resp)
+            if rid is not None and len(encoded) <= 64 * 1024:
+                with self._replay_lock:
+                    self._replay[rid] = encoded
+                    self._replay_bytes += len(encoded)
+                    while (
+                        len(self._replay) > 4096
+                        or self._replay_bytes > 64 * 1024 * 1024
+                    ):
+                        _, old = self._replay.popitem(last=False)
+                        self._replay_bytes -= len(old)
+            return encoded
         except Exception as e:  # noqa: BLE001 - report errors to the caller
             logger.exception("rpc dispatch failed")
             return serde.encode(RpcError(error=f"{type(e).__name__}: {e}"))
@@ -149,7 +178,9 @@ class RpcClient:
         Raises RuntimeError if the server reported an error, ConnectionError
         if the master is unreachable after retries.
         """
-        payload = serde.encode(msg)
+        env = serde.encode_obj(msg)
+        env["rid"] = uuid.uuid4().hex
+        payload = json.dumps(env).encode("utf-8")
         last_err: Exception | None = None
         for attempt in range(self._retries):
             try:

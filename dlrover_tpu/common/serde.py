@@ -93,17 +93,24 @@ def _from_fields(cls: type, data: dict) -> Any:
     return cls(**kwargs)
 
 
-def encode(msg: Any) -> bytes:
+def encode_obj(msg: Any) -> dict:
     name = type(msg).__name__
     if name not in _REGISTRY:
         raise TypeError(f"message type {name} is not registered")
     payload = _to_jsonable(msg)
     payload.pop("__type__", None)
-    return json.dumps({"type": name, "data": payload}).encode("utf-8")
+    return {"type": name, "data": payload}
+
+
+def encode(msg: Any) -> bytes:
+    return json.dumps(encode_obj(msg)).encode("utf-8")
 
 
 def decode(raw: bytes) -> Any:
-    obj = json.loads(raw.decode("utf-8"))
+    return decode_obj(json.loads(raw.decode("utf-8")))
+
+
+def decode_obj(obj: dict) -> Any:
     name = obj.get("type")
     cls = _REGISTRY.get(name)
     if cls is None:

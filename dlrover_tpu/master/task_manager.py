@@ -62,6 +62,7 @@ class _DatasetManager:
                 end=shard.end,
                 epoch=epoch,
                 task_type=self.task_type,
+                record_indices=list(shard.record_indices or []),
             )
         )
         self._next_task_id += 1
