@@ -1,0 +1,5 @@
+from dlrover_tpu.models import mlp, transformer  # noqa: F401
+from dlrover_tpu.models.transformer import (  # noqa: F401
+    CONFIGS,
+    TransformerConfig,
+)

@@ -1,0 +1,49 @@
+"""MNIST-scale MLP: the smallest end-to-end workload.
+
+Reference analog: examples/pytorch/mnist (BASELINE.md config 1) — the elastic
+DP smoke-test model. Same pytree/logical-axes conventions as the
+transformer so the strategy layer treats both uniformly.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def init_params(key: jax.Array, sizes=(784, 512, 256, 10)):
+    params = []
+    for i, (n_in, n_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        k_w, key = jax.random.split(key)
+        params.append(
+            {
+                "w": jax.random.normal(k_w, (n_in, n_out), jnp.float32)
+                / math.sqrt(n_in),
+                "b": jnp.zeros((n_out,), jnp.float32),
+            }
+        )
+    return params
+
+
+def logical_axes(sizes=(784, 512, 256, 10)):
+    return [
+        {"w": ("embed", "mlp"), "b": ("mlp",)}
+        for _ in range(len(sizes) - 1)
+    ]
+
+
+def forward(params, x: jax.Array) -> jax.Array:
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def loss_fn(params, batch) -> jax.Array:
+    logits = forward(params, batch["x"])
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, batch["y"][:, None], axis=-1)[:, 0]
+    return nll.mean()

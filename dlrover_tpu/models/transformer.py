@@ -1,0 +1,309 @@
+"""Decoder-only transformer, TPU-first.
+
+Covers the model families the reference accelerates (ATorch's model-zoo TP
+ports and HF integrations, atorch/atorch/modules/distributed_modules/
+transformer.py:45-1742) as one configurable implementation:
+
+- ``variant="llama"``: RMSNorm, RoPE, SwiGLU, no biases (Llama/GLM class)
+- ``variant="gpt2"``: LayerNorm, learned positions, GELU (GPT-2 class)
+
+Design choices for the MXU/XLA:
+- per-layer weights are stacked along a leading ``layers`` dim and the block
+  runs under ``lax.scan`` — one compiled layer body regardless of depth
+- params live in fp32; compute casts to bf16 so matmuls hit the MXU at full
+  rate while the loss/softmax reductions stay fp32
+- every weight carries *logical* axis names (see parallel/partition.py);
+  DP/FSDP/TP/SP are rule-table choices, not model edits
+- attention is a pluggable callable so the ring/flash implementations
+  (ops/ring_attention.py) drop in for long-context strategies
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 8         # < n_heads -> grouped-query attention
+    d_ff: int = 1408
+    max_seq_len: int = 2048
+    variant: str = "llama"      # "llama" | "gpt2"
+    rope_theta: float = 10000.0
+    dtype: str = "bfloat16"     # compute dtype
+    remat_scan: bool = False    # checkpoint each scanned layer
+    attention: str = "dense"    # "dense" | "ring" (ops/ring_attention.py)
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def param_count(self) -> int:
+        c = self
+        embed = c.vocab_size * c.d_model
+        attn = c.d_model * c.head_dim * (c.n_heads * 2 + c.n_kv_heads * 2)
+        if c.variant == "llama":
+            ffn = 3 * c.d_model * c.d_ff
+            norms = 2 * c.d_model
+        else:
+            ffn = 2 * c.d_model * c.d_ff + c.d_ff + c.d_model
+            norms = 4 * c.d_model
+        per_layer = attn + ffn + norms
+        pos = 0 if c.variant == "llama" else c.max_seq_len * c.d_model
+        lm_head = c.d_model * c.vocab_size  # untied
+        final_norm = c.d_model * (1 if c.variant == "llama" else 2)
+        return embed + pos + c.n_layers * per_layer + final_norm + lm_head
+
+
+# Named configs, smallest to flagship. Sizes follow public model families
+# (the reference's benchmark models: GPT-2 1.5B, Llama-2 7B — BASELINE.md).
+CONFIGS = {
+    "tiny": TransformerConfig(
+        vocab_size=512, d_model=64, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=176, max_seq_len=128),
+    "gpt2-small": TransformerConfig(
+        vocab_size=50257, d_model=768, n_layers=12, n_heads=12, n_kv_heads=12,
+        d_ff=3072, max_seq_len=1024, variant="gpt2"),
+    "gpt2-xl": TransformerConfig(
+        vocab_size=50257, d_model=1600, n_layers=48, n_heads=25, n_kv_heads=25,
+        d_ff=6400, max_seq_len=1024, variant="gpt2"),
+    "llama2-7b": TransformerConfig(
+        vocab_size=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=32,
+        d_ff=11008, max_seq_len=4096, variant="llama"),
+    "llama3-8b": TransformerConfig(
+        vocab_size=128256, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        d_ff=14336, max_seq_len=8192, variant="llama", rope_theta=500000.0),
+}
+
+
+# ------------------------------------------------------------------- init
+
+
+def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
+    """Initialize an fp32 parameter pytree (layer-stacked)."""
+    c = cfg
+    k_embed, k_layers, k_out, k_pos = jax.random.split(key, 4)
+    hd = c.head_dim
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                / math.sqrt(fan_in))
+
+    ks = jax.random.split(k_layers, 8)
+
+    def stack(key, shape, fan_in):
+        return dense(key, (c.n_layers, *shape), fan_in)
+
+    layers = {
+        "wq": stack(ks[0], (c.d_model, c.n_heads, hd), c.d_model),
+        "wk": stack(ks[1], (c.d_model, c.n_kv_heads, hd), c.d_model),
+        "wv": stack(ks[2], (c.d_model, c.n_kv_heads, hd), c.d_model),
+        "wo": stack(ks[3], (c.n_heads, hd, c.d_model), c.d_model),
+        "w_gate": stack(ks[4], (c.d_model, c.d_ff), c.d_model),
+        "w_down": stack(ks[5], (c.d_ff, c.d_model), c.d_ff),
+        "ln1": jnp.ones((c.n_layers, c.d_model), jnp.float32),
+        "ln2": jnp.ones((c.n_layers, c.d_model), jnp.float32),
+    }
+    if c.variant == "llama":
+        layers["w_up"] = stack(ks[6], (c.d_model, c.d_ff), c.d_model)
+    else:
+        layers["b_ff"] = jnp.zeros((c.n_layers, c.d_ff), jnp.float32)
+        layers["b_out"] = jnp.zeros((c.n_layers, c.d_model), jnp.float32)
+        layers["ln1_b"] = jnp.zeros((c.n_layers, c.d_model), jnp.float32)
+        layers["ln2_b"] = jnp.zeros((c.n_layers, c.d_model), jnp.float32)
+    params = {
+        "embed": dense(k_embed, (c.vocab_size, c.d_model), c.d_model),
+        "layers": layers,
+        "ln_f": jnp.ones((c.d_model,), jnp.float32),
+        "lm_head": dense(k_out, (c.d_model, c.vocab_size), c.d_model),
+    }
+    if c.variant == "gpt2":
+        params["pos_embed"] = 0.01 * jax.random.normal(
+            k_pos, (c.max_seq_len, c.d_model), jnp.float32
+        )
+        params["ln_f_b"] = jnp.zeros((c.d_model,), jnp.float32)
+    return params
+
+
+def logical_axes(cfg: TransformerConfig) -> Params:
+    """Same-structure tree of logical axis names for every weight.
+
+    Vocabulary: layers (scan dim, never sharded), vocab, embed (the big
+    model dim — FSDP shards it), heads/kv_heads (TP), mlp (TP).
+    """
+    c = cfg
+    layers = {
+        "wq": ("layers", "embed", "heads", None),
+        "wk": ("layers", "embed", "kv_heads", None),
+        "wv": ("layers", "embed", "kv_heads", None),
+        "wo": ("layers", "heads", None, "embed"),
+        "w_gate": ("layers", "embed", "mlp"),
+        "w_down": ("layers", "mlp", "embed"),
+        "ln1": ("layers", None),
+        "ln2": ("layers", None),
+    }
+    if c.variant == "llama":
+        layers["w_up"] = ("layers", "embed", "mlp")
+    else:
+        layers["b_ff"] = ("layers", "mlp")
+        layers["b_out"] = ("layers", None)
+        layers["ln1_b"] = ("layers", None)
+        layers["ln2_b"] = ("layers", None)
+    tree = {
+        "embed": ("vocab", "embed"),
+        "layers": layers,
+        "ln_f": (None,),
+        "lm_head": ("embed", "vocab"),
+    }
+    if c.variant == "gpt2":
+        tree["pos_embed"] = (None, "embed")
+        tree["ln_f_b"] = (None,)
+    return tree
+
+
+# ---------------------------------------------------------------- forward
+
+
+def _norm(x, scale, bias, variant: str):
+    if variant == "llama":  # RMSNorm
+        x32 = x.astype(jnp.float32)
+        inv = lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6)
+        return (x32 * inv).astype(x.dtype) * scale.astype(x.dtype)
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    out = (x32 - mean) * lax.rsqrt(var + 1e-5)
+    out = out.astype(x.dtype) * scale.astype(x.dtype)
+    return out + bias.astype(x.dtype) if bias is not None else out
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding over the last dim. x: [B, S, H, D]."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[:, :, None, None].astype(jnp.float32) * freqs  # B,S,1,d/2
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    cos = cos.astype(x.dtype)
+    sin = sin.astype(x.dtype)
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(x.shape)
+
+
+def dense_attention(q, k, v, *, causal: bool = True) -> jax.Array:
+    """Reference attention: [B,S,H,D] einsum softmax. fp32 softmax."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        s_q, s_k = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), bool))
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+AttentionFn = Callable[..., jax.Array]
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    attention_fn: AttentionFn | None = None,
+    constrain: Callable[[jax.Array, tuple], jax.Array] | None = None,
+) -> jax.Array:
+    """Token ids [B, S] -> logits [B, S, vocab].
+
+    ``constrain(x, logical_axes)`` optionally pins activation shardings
+    (supplied by the strategy layer); identity when absent.
+    """
+    c = cfg
+    dt = jnp.dtype(c.dtype)
+    pin = constrain or (lambda x, a: x)
+    attn = attention_fn or dense_attention
+
+    B, S = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    x = params["embed"].astype(dt)[tokens]
+    if c.variant == "gpt2":
+        x = x + params["pos_embed"].astype(dt)[:S][None]
+    x = pin(x, ("batch", "sequence", "embed"))
+
+    n_rep = c.n_heads // c.n_kv_heads
+
+    def layer(x, w):
+        h = _norm(x, w["ln1"], w.get("ln1_b"), c.variant)
+        q = jnp.einsum("bse,ehd->bshd", h, w["wq"].astype(dt))
+        k = jnp.einsum("bse,ehd->bshd", h, w["wk"].astype(dt))
+        v = jnp.einsum("bse,ehd->bshd", h, w["wv"].astype(dt))
+        if c.variant == "llama":
+            q = _rope(q, positions, c.rope_theta)
+            k = _rope(k, positions, c.rope_theta)
+        if n_rep > 1:
+            k = jnp.repeat(k, n_rep, axis=2)
+            v = jnp.repeat(v, n_rep, axis=2)
+        o = attn(q, k, v, causal=True)
+        o = jnp.einsum("bshd,hde->bse", o, w["wo"].astype(dt))
+        x = pin(x + o, ("batch", "sequence", "embed"))
+
+        h = _norm(x, w["ln2"], w.get("ln2_b"), c.variant)
+        if c.variant == "llama":
+            gate = jax.nn.silu(jnp.einsum("bse,ef->bsf", h,
+                                          w["w_gate"].astype(dt)))
+            up = jnp.einsum("bse,ef->bsf", h, w["w_up"].astype(dt))
+            ff = jnp.einsum("bsf,fe->bse", gate * up, w["w_down"].astype(dt))
+        else:
+            hidden = jax.nn.gelu(
+                jnp.einsum("bse,ef->bsf", h, w["w_gate"].astype(dt))
+                + w["b_ff"].astype(dt)
+            )
+            ff = (jnp.einsum("bsf,fe->bse", hidden, w["w_down"].astype(dt))
+                  + w["b_out"].astype(dt))
+        x = pin(x + ff, ("batch", "sequence", "embed"))
+        return x, None
+
+    body = layer
+    if c.remat_scan:
+        body = jax.checkpoint(
+            layer, policy=jax.checkpoint_policies.nothing_saveable
+        )
+    x, _ = lax.scan(lambda carry, w: body(carry, w), x, params["layers"])
+
+    x = _norm(x, params["ln_f"], params.get("ln_f_b"), c.variant)
+    logits = jnp.einsum("bse,ev->bsv", x, params["lm_head"].astype(dt))
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(
+    params: Params,
+    batch: dict[str, jax.Array],
+    cfg: TransformerConfig,
+    attention_fn: AttentionFn | None = None,
+    constrain=None,
+) -> jax.Array:
+    """Next-token cross entropy. batch: tokens [B, S] (shift-in-loss)."""
+    tokens = batch["tokens"]
+    logits = forward(params, tokens[:, :-1], cfg,
+                     attention_fn=attention_fn, constrain=constrain)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("mask")
+    if mask is not None:
+        m = mask[:, 1:].astype(nll.dtype)
+        return (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
+    return nll.mean()

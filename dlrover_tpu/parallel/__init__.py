@@ -1,0 +1,14 @@
+from dlrover_tpu.parallel.mesh import (  # noqa: F401
+    MeshSpec,
+    build_mesh,
+    batch_axes,
+    data_parallel_size,
+)
+from dlrover_tpu.parallel.partition import (  # noqa: F401
+    constrain,
+    spec_for,
+    tree_shardings,
+    tree_specs,
+)
+from dlrover_tpu.parallel.strategy import PRESETS, Strategy  # noqa: F401
+from dlrover_tpu.parallel.dry_run import dry_run, pick_strategy  # noqa: F401

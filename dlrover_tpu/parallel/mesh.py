@@ -1,0 +1,132 @@
+"""Named-axis device mesh construction over ICI and DCN.
+
+Reference analog: ATorch's named-dim process-group fabric
+(atorch/atorch/distributed/distributed.py:321 create_parallel_group) builds
+one torch process group per parallel dim. The TPU-native equivalent is a
+single ``jax.sharding.Mesh`` whose named axes play the role of those groups:
+collectives are inserted by XLA from sharding annotations instead of being
+issued imperatively, and axis order is chosen so the fastest-varying axes
+(tensor/sequence) ride ICI while the slowest (data across slices) rides DCN.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+# Canonical axis order: slow (DCN-friendly) -> fast (ICI-friendly). Data
+# parallelism tolerates the highest latency (one gradient reduce per step),
+# tensor/sequence need the tightest coupling (collectives inside every layer).
+AXIS_ORDER = ("data", "fsdp", "expert", "pipeline", "sequence", "tensor")
+
+
+@dataclasses.dataclass
+class MeshSpec:
+    """Sizes for each named axis; at most one axis may be -1 (fill).
+
+    ``dcn_axes`` names the axes that span slices (multi-host groups connected
+    by data-center network rather than ICI); used to build a hybrid mesh.
+    """
+
+    axes: dict[str, int] = dataclasses.field(default_factory=dict)
+    dcn_axes: dict[str, int] = dataclasses.field(default_factory=dict)
+
+    def resolved(self, num_devices: int) -> dict[str, int]:
+        sizes = {a: int(s) for a, s in self.axes.items() if int(s) != 1}
+        for a in sizes:
+            if a not in AXIS_ORDER:
+                raise ValueError(
+                    f"unknown mesh axis {a!r}; known: {AXIS_ORDER}"
+                )
+        fill = [a for a, s in sizes.items() if s == -1]
+        if len(fill) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if fill:
+            if num_devices % fixed:
+                raise ValueError(
+                    f"{num_devices} devices not divisible by fixed axes "
+                    f"product {fixed}"
+                )
+            sizes[fill[0]] = num_devices // fixed
+        total = math.prod(sizes.values())
+        if total != num_devices:
+            raise ValueError(
+                f"mesh axes {sizes} use {total} devices, have {num_devices}"
+            )
+        # keep canonical order, drop size-1 axes that were explicit
+        return {a: sizes[a] for a in AXIS_ORDER if a in sizes and sizes[a] > 1} or {
+            "data": num_devices
+        }
+
+
+def build_mesh(
+    spec: MeshSpec | dict[str, int],
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a Mesh whose axis layout maps well onto the TPU topology.
+
+    Uses ``mesh_utils.create_device_mesh`` so physical ICI neighbors land in
+    the same tensor/sequence axis rows; falls back to a reshape for device
+    sets the util can't map (CPU test meshes).
+    """
+    if isinstance(spec, dict):
+        spec = MeshSpec(axes=spec)
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = spec.resolved(len(devices))
+    names = tuple(sizes)
+    shape = tuple(sizes.values())
+    if spec.dcn_axes:
+        dcn = {a: int(s) for a, s in spec.dcn_axes.items()}
+        for a, s in dcn.items():
+            if a not in sizes:
+                raise ValueError(
+                    f"dcn axis {a!r} not among resolved mesh axes "
+                    f"{list(sizes)} (size-1 axes are dropped)"
+                )
+            if sizes[a] % s:
+                raise ValueError(
+                    f"dcn size {s} does not divide axis {a!r}={sizes[a]}"
+                )
+        ici_shape = tuple(
+            sizes[a] // dcn.get(a, 1) for a in names
+        )
+        dcn_shape = tuple(dcn.get(a, 1) for a in names)
+        arr = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices,
+            allow_split_physical_axes=True,
+        )
+    else:
+        try:
+            arr = mesh_utils.create_device_mesh(
+                shape, devices=devices, allow_split_physical_axes=True
+            )
+        except (ValueError, NotImplementedError, AssertionError):
+            arr = np.asarray(devices).reshape(shape)
+    mesh = Mesh(arr, names)
+    logger.info("built mesh %s over %d devices", dict(sizes), len(devices))
+    return mesh
+
+
+def data_parallel_size(mesh: Mesh) -> int:
+    """Number of independent data-parallel replicas (data × fsdp axes)."""
+    size = 1
+    for a in ("data", "fsdp"):
+        if a in mesh.axis_names:
+            size *= mesh.shape[a]
+    return size
+
+
+def batch_axes(mesh: Mesh) -> tuple[str, ...]:
+    """Mesh axes the global batch dimension is sharded over."""
+    return tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)

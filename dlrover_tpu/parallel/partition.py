@@ -1,0 +1,84 @@
+"""Logical-axis sharding rules: param trees annotated with *logical* axis
+names, mapped to mesh axes by a rule table.
+
+Reference analog: ATorch decides placement imperatively per module (TP layer
+classes in atorch/atorch/modules/distributed_modules/layers.py:239,392,549;
+FSDP auto-wrap policies in auto/opt_lib/zero_optimization.py:240). The
+TPU-native design is declarative: models label every weight dim with a
+logical name ("embed", "heads", "mlp", "vocab"), a Strategy supplies
+logical->mesh rules, and XLA derives the collectives. Changing DP->FSDP->TP
+is a rule-table edit, not a model rewrite.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+Rules = Sequence[tuple[str, Any]]  # logical name -> mesh axis | tuple | None
+
+
+def spec_for(
+    logical: Sequence[str | None], rules: Rules, mesh: Mesh
+) -> PartitionSpec:
+    """Map one array's logical axes to a PartitionSpec on ``mesh``.
+
+    A rule whose mesh axis is absent from the mesh (or size 1) resolves to
+    replication for that dim, so the same rule table works on any mesh shape
+    — the elasticity property: shrink the mesh and specs degrade gracefully.
+    Mesh axes already used by an earlier dim of the same array are skipped
+    (an axis can shard at most one dim).
+    """
+    table = dict(rules)
+    used: set[str] = set()
+    parts: list[Any] = []
+    for name in logical:
+        axis = table.get(name) if name is not None else None
+        if axis is None:
+            parts.append(None)
+            continue
+        axes = tuple(axis) if isinstance(axis, (tuple, list)) else (axis,)
+        ok = tuple(
+            a for a in axes
+            if a in mesh.axis_names and mesh.shape[a] > 1 and a not in used
+        )
+        used.update(ok)
+        parts.append(ok if len(ok) > 1 else (ok[0] if ok else None))
+    while parts and parts[-1] is None:
+        parts.pop()
+    return PartitionSpec(*parts)
+
+
+def tree_specs(logical_tree: Any, rules: Rules, mesh: Mesh) -> Any:
+    """Map a pytree of logical-axis tuples to a pytree of PartitionSpecs."""
+    return jax.tree.map(
+        lambda ax: spec_for(ax, rules, mesh),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple)
+        and all(isinstance(e, (str, type(None))) for e in x),
+    )
+
+
+def tree_shardings(logical_tree: Any, rules: Rules, mesh: Mesh) -> Any:
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        tree_specs(logical_tree, rules, mesh),
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )
+
+
+def constrain(
+    x: jax.Array, logical: Sequence[str | None], rules: Rules, mesh: Mesh
+) -> jax.Array:
+    """``with_sharding_constraint`` through the logical-axis table.
+
+    Used inside model code to pin activation layouts (e.g. keep the batch
+    dim on data axes, the sequence dim on the sequence axis).
+    """
+    if mesh.empty:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, spec_for(logical, rules, mesh))
+    )

@@ -1,0 +1,200 @@
+"""Acceleration strategies: the ``auto_accelerate`` analog.
+
+Reference analog: atorch/atorch/auto/accelerate.py:406 (auto_accelerate),
+auto/strategy.py (strategy serialization), auto/opt_lib/** (the optimization
+library: FSDP/TP/AMP/checkpoint wrappers). In torch each optimization is an
+imperative model transform; on TPU the whole bundle reduces to declarative
+inputs of one ``jax.jit``:
+
+- parallel "groups"      -> mesh axis sizes (MeshSpec)
+- FSDP/TP/SP wrappers    -> logical->mesh sharding rules
+- AMP                    -> compute dtype (bf16 matmuls, f32 reductions)
+- activation checkpoint  -> jax.checkpoint policy applied to the step fn
+- ZeRO optimizer states  -> optimizer-state sharding rules (same table)
+
+A Strategy is a plain serializable record, so it can be saved next to a
+checkpoint and reloaded (reference: load_strategy, accelerate.py:467).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+import jax
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.parallel.mesh import MeshSpec, build_mesh
+from dlrover_tpu.parallel.partition import (
+    Rules,
+    tree_shardings,
+    tree_specs,
+)
+
+logger = get_logger(__name__)
+
+# jax.checkpoint policies by name (serialization-friendly).
+REMAT_POLICIES = {
+    "none": None,
+    "full": jax.checkpoint_policies.nothing_saveable,
+    "dots": jax.checkpoint_policies.dots_saveable,
+    "dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "everything": jax.checkpoint_policies.everything_saveable,
+}
+
+
+@dataclasses.dataclass
+class Strategy:
+    """One complete acceleration plan for a model."""
+
+    name: str = "dp"
+    mesh_axes: dict[str, int] = dataclasses.field(
+        default_factory=lambda: {"data": -1}
+    )
+    dcn_axes: dict[str, int] = dataclasses.field(default_factory=dict)
+    # logical axis name -> mesh axis (str), tuple of axes, or None
+    rules: list[list] = dataclasses.field(default_factory=list)
+    compute_dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+    remat: str = "none"  # key into REMAT_POLICIES
+    grad_accum: int = 1
+    extra: dict = dataclasses.field(default_factory=dict)
+
+    # ------------------------------------------------------------- building
+
+    def mesh_spec(self) -> MeshSpec:
+        return MeshSpec(axes=dict(self.mesh_axes), dcn_axes=dict(self.dcn_axes))
+
+    def build_mesh(self, devices=None) -> jax.sharding.Mesh:
+        return build_mesh(self.mesh_spec(), devices=devices)
+
+    def rule_table(self) -> Rules:
+        return [
+            (name, tuple(ax) if isinstance(ax, list) else ax)
+            for name, ax in self.rules
+        ]
+
+    def shardings(self, logical_tree: Any, mesh) -> Any:
+        return tree_shardings(logical_tree, self.rule_table(), mesh)
+
+    def specs(self, logical_tree: Any, mesh) -> Any:
+        return tree_specs(logical_tree, self.rule_table(), mesh)
+
+    def remat_policy(self):
+        if self.remat not in REMAT_POLICIES:
+            raise ValueError(
+                f"unknown remat policy {self.remat!r}; "
+                f"known: {sorted(REMAT_POLICIES)}"
+            )
+        return REMAT_POLICIES[self.remat]
+
+    # --------------------------------------------------------- serialization
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "Strategy":
+        return cls(**json.loads(text))
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json())
+
+    @classmethod
+    def load(cls, path: str) -> "Strategy":
+        with open(path) as f:
+            return cls.from_json(f.read())
+
+
+# Rule fragments shared by the presets. Logical names are the vocabulary the
+# bundled models use (models/transformer.py); user models may extend freely.
+_FSDP_RULES = [
+    ["embed", "fsdp"],          # shard the big embed dim of every weight
+    ["vocab", "fsdp"],
+    ["batch", ["data", "fsdp"]],
+]
+_TP_RULES = [
+    ["heads", "tensor"],        # attention heads across tensor axis
+    ["mlp", "tensor"],          # ffn hidden dim across tensor axis
+    ["vocab", "tensor"],        # vocab-parallel embedding / lm head
+    ["kv_heads", "tensor"],
+]
+_SP_RULES = [
+    ["sequence", "sequence"],   # activation sequence dim across seq axis
+]
+_EP_RULES = [
+    ["expert", "expert"],
+]
+
+
+def dp(num_devices: int = -1) -> Strategy:
+    """Pure data parallel: params replicated, batch split."""
+    return Strategy(
+        name="dp",
+        mesh_axes={"data": num_devices},
+        rules=[["batch", ["data", "fsdp"]]],
+    )
+
+
+def fsdp(fsdp_size: int = -1, remat: str = "dots") -> Strategy:
+    """ZeRO-3-style fully sharded data parallel (param gather per layer)."""
+    return Strategy(
+        name="fsdp",
+        mesh_axes={"fsdp": fsdp_size},
+        rules=list(_FSDP_RULES),
+        remat=remat,
+    )
+
+
+def tp(tensor_size: int, data_size: int = -1, remat: str = "none") -> Strategy:
+    """Megatron-style tensor parallel × data parallel."""
+    return Strategy(
+        name="tp",
+        mesh_axes={"data": data_size, "tensor": tensor_size},
+        rules=[["batch", ["data", "fsdp"]]] + [list(r) for r in _TP_RULES],
+        remat=remat,
+    )
+
+
+def fsdp_tp(tensor_size: int, fsdp_size: int = -1,
+            remat: str = "dots") -> Strategy:
+    """2D: FSDP across hosts × TP inside the fast ICI neighborhood."""
+    return Strategy(
+        name="fsdp_tp",
+        mesh_axes={"fsdp": fsdp_size, "tensor": tensor_size},
+        rules=list(_FSDP_RULES) + [list(r) for r in _TP_RULES],
+        remat=remat,
+    )
+
+
+def long_context(sequence_size: int, data_size: int = -1,
+                 remat: str = "dots") -> Strategy:
+    """Sequence/context parallel for long sequences (ring attention)."""
+    return Strategy(
+        name="long_context",
+        mesh_axes={"data": data_size, "sequence": sequence_size},
+        rules=[["batch", ["data", "fsdp"]]] + [list(r) for r in _SP_RULES],
+        remat=remat,
+        extra={"attention": "ring"},
+    )
+
+
+def moe(expert_size: int, data_size: int = -1) -> Strategy:
+    """Expert parallel: experts split over the expert axis."""
+    return Strategy(
+        name="moe",
+        mesh_axes={"data": data_size, "expert": expert_size},
+        rules=[["batch", ["data", "fsdp"]]] + [list(r) for r in _EP_RULES],
+    )
+
+
+PRESETS = {
+    "dp": dp,
+    "fsdp": fsdp,
+    "tp": tp,
+    "fsdp_tp": fsdp_tp,
+    "long_context": long_context,
+    "moe": moe,
+}

@@ -1,0 +1,127 @@
+"""ElasticTrainer: fixed global batch under changing world size.
+
+Reference analog: dlrover/trainer/torch/elastic/trainer.py:181
+(ElasticTrainer with GradientState and _ElasticOptimizer: gradient
+accumulation steps are recomputed from the live world size so the effective
+global batch — and therefore the loss trajectory — is invariant to
+elasticity). TPU-native difference: a membership change restarts the process
+and recompiles the step anyway (XLA bakes the mesh into the program), so the
+accumulation factor is resolved once per incarnation, not per optimizer call.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Iterator
+
+import jax
+import numpy as np
+
+from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.parallel.mesh import data_parallel_size
+from dlrover_tpu.trainer.train_step import CompiledTrain, TrainState
+
+logger = get_logger(__name__)
+
+
+class BatchAssembler:
+    """Shape sample streams into [accum, batch, ...] step batches."""
+
+    def __init__(self, accum: int, batch_size: int):
+        self.accum = accum
+        self.batch_size = batch_size
+
+    def batches(
+        self, samples: Iterator[Any],
+        collate: Callable[[list], dict[str, np.ndarray]],
+    ) -> Iterator[dict[str, np.ndarray]]:
+        need = self.accum * self.batch_size
+        buf: list = []
+        for s in samples:
+            buf.append(s)
+            if len(buf) == need:
+                flat = collate(buf)
+                yield {
+                    k: v.reshape((self.accum, self.batch_size) + v.shape[1:])
+                    for k, v in flat.items()
+                }
+                buf = []
+
+
+class ElasticTrainer:
+    def __init__(
+        self,
+        compiled: CompiledTrain,
+        global_batch_size: int,
+        micro_batch_size: int,
+        report_step_interval: int = 1,
+        master_client=None,
+    ):
+        self.compiled = compiled
+        dp = data_parallel_size(compiled.mesh)
+        if global_batch_size % (micro_batch_size * dp):
+            raise ValueError(
+                f"global batch {global_batch_size} not divisible by "
+                f"micro_batch {micro_batch_size} × dp {dp}"
+            )
+        self.accum = global_batch_size // (micro_batch_size * dp)
+        self.global_batch_size = global_batch_size
+        # per-step batch dim fed to the compiled step (sharded over dp)
+        self.step_batch_size = micro_batch_size * dp
+        self.assembler = BatchAssembler(self.accum, self.step_batch_size)
+        self._report_interval = report_step_interval
+        self._host_step = 0  # avoids blocking on the device step counter
+        self._client = master_client
+        if self._client is None and os.environ.get(EnvKey.MASTER_ADDR):
+            from dlrover_tpu.agent.master_client import MasterClient
+
+            self._client = MasterClient.singleton()
+        logger.info(
+            "elastic trainer: dp=%d accum=%d global_batch=%d (fixed)",
+            dp, self.accum, global_batch_size,
+        )
+
+    def train_step(self, state: TrainState, batch: dict
+                   ) -> tuple[TrainState, dict]:
+        batch = jax.device_put(batch, self.compiled.batch_sharding)
+        state, metrics = self.compiled.step(state, batch)
+        # host-side counter: reading state.step would block async dispatch
+        self._host_step += 1
+        step = self._host_step
+        if self._client is not None and step % self._report_interval == 0:
+            try:
+                self._client.report_step(step)
+            except ConnectionError:
+                logger.warning("step report failed: master unreachable")
+        return state, metrics
+
+    def run(
+        self,
+        state: TrainState,
+        samples: Iterator[Any],
+        collate: Callable[[list], dict[str, np.ndarray]],
+        max_steps: int | None = None,
+        on_step: Callable[[int, dict], None] | None = None,
+        checkpointer: Callable[[int, TrainState], None] | None = None,
+        checkpoint_interval: int = 0,
+    ) -> TrainState:
+        start = time.monotonic()
+        # one sync at entry so a restored state's step carries forward
+        self._host_step = int(state.step)
+        for batch in self.assembler.batches(samples, collate):
+            state, metrics = self.train_step(state, batch)
+            step = self._host_step
+            if on_step is not None:
+                on_step(step, jax.device_get(metrics))
+            if (checkpointer is not None and checkpoint_interval
+                    and step % checkpoint_interval == 0):
+                checkpointer(step, state)
+            if max_steps is not None and step >= max_steps:
+                break
+        logger.info(
+            "training loop exited at step %d after %.1fs",
+            self._host_step, time.monotonic() - start,
+        )
+        return state

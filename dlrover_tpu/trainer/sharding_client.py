@@ -1,0 +1,171 @@
+"""Worker-side dynamic data sharding clients.
+
+Reference analog: dlrover/python/elastic_agent/sharding/client.py
+(ShardingClient:29 with at-least-once reporting and shard checkpointing;
+IndexShardingClient:231 dispensing per-sample indices). Workers pull
+[start, end) shards from the master's TaskManager so data assignment follows
+live membership — the mechanism that keeps epochs exact across elasticity.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Iterator
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.messages import DatasetShardParams, ShardTask
+from dlrover_tpu.agent.master_client import MasterClient
+
+logger = get_logger(__name__)
+
+
+class ShardingClient:
+    """Fetch shards, report completion, checkpoint shard progress."""
+
+    def __init__(
+        self,
+        dataset_name: str,
+        dataset_size: int,
+        shard_size: int,
+        num_epochs: int = 1,
+        shuffle: bool = False,
+        storage_type: str = "table",
+        master_client: MasterClient | None = None,
+        fetch_timeout: float = 60.0,
+    ):
+        self._client = master_client or MasterClient.singleton()
+        self.dataset_name = dataset_name
+        self._fetch_timeout = fetch_timeout
+        self._current: ShardTask | None = None
+        self._client.report_dataset_params(
+            DatasetShardParams(
+                dataset_name=dataset_name,
+                dataset_size=dataset_size,
+                shard_size=shard_size,
+                num_epochs=num_epochs,
+                shuffle=shuffle,
+                storage_type=storage_type,
+            )
+        )
+
+    def fetch_shard(self) -> ShardTask | None:
+        """Next shard, or None when the dataset is exhausted.
+
+        An invalid task can mean either "all epochs done" or "queue briefly
+        empty while peers' in-flight shards may still fail back onto it", so
+        poll until the timeout before concluding exhaustion.
+        """
+        deadline = time.time() + self._fetch_timeout
+        while True:
+            task = self._client.get_task(self.dataset_name)
+            if task.valid:
+                self._current = task
+                return task
+            if time.time() >= deadline:
+                return None
+            time.sleep(0.5)
+
+    def report_done(self, task: ShardTask | None = None,
+                    success: bool = True, error: str = "") -> None:
+        task = task or self._current
+        if task is None:
+            return
+        self._client.report_task_result(
+            task.task_id, self.dataset_name, success=success, error=error
+        )
+        if task is self._current:
+            self._current = None
+
+    def shard_checkpoint(self) -> str:
+        return self._client.get_shard_checkpoint(self.dataset_name)
+
+    def restore_shard_checkpoint(self, content: str) -> None:
+        self._client.restore_shard_checkpoint(self.dataset_name, content)
+
+    def iter_shards(self) -> Iterator[ShardTask]:
+        """At-least-once shard stream: completion reported when the caller
+        advances to the next shard."""
+        while True:
+            task = self.fetch_shard()
+            if task is None:
+                return
+            yield task
+            self.report_done(task)
+
+
+class IndexShardingClient(ShardingClient):
+    """Per-sample index stream over the shard protocol.
+
+    A background thread keeps the index queue fed so sample consumption
+    never stalls on an RPC (reference: IndexShardingClient:231).
+    """
+
+    def __init__(self, *args, prefetch_shards: int = 2, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._indices: queue.Queue = queue.Queue(
+            maxsize=max(1, prefetch_shards) * 4096
+        )
+        self._done = threading.Event()
+        self._fill_error: BaseException | None = None
+        self._thread = threading.Thread(
+            target=self._fill, name="index-sharding", daemon=True
+        )
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        """Bounded put that gives up when the client is closed."""
+        while not self._done.is_set():
+            try:
+                self._indices.put(item, timeout=0.5)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _fill(self) -> None:
+        try:
+            while not self._done.is_set():
+                task = self.fetch_shard()
+                if task is None:
+                    break
+                for idx in task.indices():
+                    if not self._put((idx, None)):
+                        return
+                # sentinel marks shard boundary for completion reporting
+                if not self._put((None, task)):
+                    return
+        except BaseException as e:  # noqa: BLE001 - surfaced to the consumer
+            self._fill_error = e
+            logger.exception("index prefetch thread failed")
+        finally:
+            self._put((None, None))
+
+    def next_index(self, timeout: float = 120.0) -> int | None:
+        """Next sample index, or None at end of data.
+
+        Raises if the prefetch thread died (e.g. master unreachable) so an
+        RPC failure is never mistaken for end-of-epoch.
+        """
+        deadline = time.time() + timeout
+        while True:
+            remain = deadline - time.time()
+            if remain <= 0:
+                return None
+            try:
+                idx, boundary = self._indices.get(timeout=min(remain, 1.0))
+            except queue.Empty:
+                continue
+            if idx is not None:
+                return idx
+            if boundary is None:
+                if self._fill_error is not None:
+                    raise RuntimeError(
+                        "index prefetch failed"
+                    ) from self._fill_error
+                return None
+            self.report_done(boundary)
+
+    def close(self) -> None:
+        self._done.set()

@@ -7,12 +7,21 @@ gives multi-device semantics without TPU hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the outer environment points at real hardware
+# (JAX_PLATFORMS=axon/tpu): tests must be hermetic and multi-device. A
+# sitecustomize may already have imported jax to register a TPU plugin, so
+# updating the env alone is not enough — update the live config too (safe:
+# backends initialize lazily on first device query).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
