@@ -134,10 +134,20 @@ class CheckpointEngine:
     # ------------------------------------------------------------------ load
 
     def load(self, template: Any,
-             put: Callable[[str, np.ndarray], Any] | None = None
+             put: Callable[[str, np.ndarray], Any] | None = None,
+             zero_copy: bool = False,
              ) -> tuple[int, Any] | None:
-        """Restore the newest checkpoint: shm first, then storage."""
-        loaded = self._load_from_memory()
+        """Restore the newest checkpoint: shm first, then storage.
+
+        ``zero_copy=True`` hands shm arena views straight to ``put``, which
+        must consume them immediately (device transfer, file write) and
+        return something that does NOT alias the input — retained views are
+        overwritten by the next snapshot and block arena growth. Requires
+        ``put``; explicit opt-in because safety depends on the callback.
+        """
+        if zero_copy and put is None:
+            raise ValueError("zero_copy=True requires a consuming `put`")
+        loaded = self._load_from_memory(copy=not zero_copy)
         if loaded is None:
             loaded = self._load_from_storage()
         if loaded is None:
@@ -145,9 +155,10 @@ class CheckpointEngine:
         step, arrays = loaded
         return step, restore_pytree(template, arrays, put=put)
 
-    def _load_from_memory(self) -> tuple[int, dict[str, np.ndarray]] | None:
+    def _load_from_memory(self, copy: bool = True
+                          ) -> tuple[int, dict[str, np.ndarray]] | None:
         try:
-            snap = self.shm_handler.load_arrays()
+            snap = self.shm_handler.load_arrays(copy=copy)
         except Exception:  # noqa: BLE001 - fall back to storage on any damage
             logger.exception("shm restore failed; falling back to storage")
             return None

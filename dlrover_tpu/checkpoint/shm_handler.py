@@ -151,8 +151,15 @@ class SharedMemoryHandler:
     def header(self) -> dict | None:
         return self.meta_dict.get().get(_HEADER_KEY)
 
-    def load_arrays(self) -> tuple[int, dict[str, np.ndarray]] | None:
-        """Read the snapshot: (step, {path: array}). None if empty."""
+    def load_arrays(self, copy: bool = True
+                    ) -> tuple[int, dict[str, np.ndarray]] | None:
+        """Read the snapshot: (step, {path: array}). None if empty.
+
+        ``copy=False`` returns zero-copy views into the arena — valid only
+        until the next snapshot overwrites it. Use when a consumer reads the
+        arrays immediately (``jax.device_put`` on restore) and skip the
+        host-memory materialization cost.
+        """
         header = self.header()
         if not header:
             return None
@@ -167,7 +174,7 @@ class SharedMemoryHandler:
                 buffer=arena.buf,
                 offset=info["offset"],
             )
-            out[name] = np.array(view)  # copy out of the shared buffer
+            out[name] = np.array(view) if copy else view
         return int(header["step"]), out
 
     def read_raw(self) -> tuple[dict, memoryview] | None:

@@ -71,10 +71,14 @@ def dry_run(
     try:
         mem = compiled.memory_analysis()
         if mem is not None:
+            # donated args alias outputs (the train state is donated), so
+            # argument + output double-counts it; peak live set is temps
+            # plus the larger of the two plus any non-aliased remainder
+            arg = int(getattr(mem, "argument_size_in_bytes", 0))
+            out = int(getattr(mem, "output_size_in_bytes", 0))
+            alias = int(getattr(mem, "alias_size_in_bytes", 0)) or min(arg, out)
             report.hbm_bytes = int(
-                getattr(mem, "temp_size_in_bytes", 0)
-                + getattr(mem, "output_size_in_bytes", 0)
-                + getattr(mem, "argument_size_in_bytes", 0)
+                getattr(mem, "temp_size_in_bytes", 0) + arg + out - alias
             )
             report.argument_bytes = int(
                 getattr(mem, "argument_size_in_bytes", 0)

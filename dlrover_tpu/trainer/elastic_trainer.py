@@ -93,8 +93,11 @@ class ElasticTrainer:
         if self._client is not None and step % self._report_interval == 0:
             try:
                 self._client.report_step(step)
-            except ConnectionError:
-                logger.warning("step report failed: master unreachable")
+            except (ConnectionError, RuntimeError, OSError) as e:
+                # telemetry is best-effort: a master mid-failover answers
+                # with RpcError (surfaced as RuntimeError) — don't kill
+                # the training loop over it
+                logger.warning("step report failed: %s", e)
         return state, metrics
 
     def run(
@@ -111,10 +114,15 @@ class ElasticTrainer:
         # one sync at entry so a restored state's step carries forward
         self._host_step = int(state.step)
         for batch in self.assembler.batches(samples, collate):
+            if max_steps is not None and self._host_step >= max_steps:
+                break  # a restored finished job must not run extra steps
             state, metrics = self.train_step(state, batch)
             step = self._host_step
             if on_step is not None:
-                on_step(step, jax.device_get(metrics))
+                # metrics stay on device: fetching here would serialize
+                # host and device every step; callbacks device_get at
+                # their own cadence
+                on_step(step, metrics)
             if (checkpointer is not None and checkpoint_interval
                     and step % checkpoint_interval == 0):
                 checkpointer(step, state)
