@@ -217,6 +217,7 @@ class ElasticAgent:
             )
             return False
         self._persist_checkpoint(reason="process failure")
+        self._recover_shards()
         self._restart_count += 1
         rank, num_nodes, coordinator = self._rendezvous()
         self._proc = self._spawn(rank, num_nodes, coordinator)
@@ -226,9 +227,22 @@ class ElasticAgent:
         logger.info("restarting workers: %s", reason)
         self._persist_checkpoint(reason=reason)
         self._kill_child()
+        self._recover_shards()
         self._restart_count += 1
         rank, num_nodes, coordinator = self._rendezvous()
         self._proc = self._spawn(rank, num_nodes, coordinator)
+
+    def _recover_shards(self) -> None:
+        """Give the dead trainer's in-flight data shards back to the queue.
+
+        Restart-in-place keeps this node alive, so the master's
+        heartbeat-dead recovery never fires for it (reference analog:
+        dist_job_manager relaunch path re-queuing worker shards).
+        """
+        try:
+            self._client.recover_shards()
+        except (ConnectionError, RuntimeError, OSError) as e:
+            logger.warning("shard recovery request failed: %s", e)
 
     def _membership_changed(self) -> bool:
         try:

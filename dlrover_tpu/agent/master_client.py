@@ -198,6 +198,13 @@ class MasterClient:
             )
         )
 
+    def recover_shards(self, node_id: int | None = None) -> None:
+        self._client.call(
+            m.RecoverShardsRequest(
+                node_id=self.node_id if node_id is None else node_id
+            )
+        )
+
     def get_shard_checkpoint(self, dataset_name: str) -> str:
         return self._client.call(
             m.ShardCheckpointRequest(dataset_name=dataset_name)

@@ -252,6 +252,19 @@ class TaskResult:
 
 @register_message
 @dataclasses.dataclass
+class RecoverShardsRequest:
+    """Return a node's in-flight shards to the queue.
+
+    Sent by the agent before a restart-in-place: the dying trainer held
+    shards the heartbeat-dead path would only recover after the dead window
+    (the node itself stays alive, so it never trips).
+    """
+
+    node_id: int = 0
+
+
+@register_message
+@dataclasses.dataclass
 class ShardCheckpointRequest:
     dataset_name: str = ""
 

@@ -112,6 +112,9 @@ class MasterServicer:
                 msg.task_id, msg.dataset_name, msg.success
             )
             return m.OkResponse()
+        if isinstance(msg, m.RecoverShardsRequest):
+            self._task_manager.recover_tasks_of_node(msg.node_id)
+            return m.OkResponse()
         if isinstance(msg, m.ShardCheckpointRequest):
             return m.ShardCheckpoint(
                 dataset_name=msg.dataset_name,

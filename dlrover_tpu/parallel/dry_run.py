@@ -76,14 +76,16 @@ def dry_run(
             # plus the larger of the two plus any non-aliased remainder
             arg = int(getattr(mem, "argument_size_in_bytes", 0))
             out = int(getattr(mem, "output_size_in_bytes", 0))
-            alias = int(getattr(mem, "alias_size_in_bytes", 0)) or min(arg, out)
+            alias = getattr(mem, "alias_size_in_bytes", None)
+            if alias is None:
+                # backend doesn't report aliasing: assume donation (the
+                # train-step convention here) aliases the smaller side
+                alias = min(arg, out)
             report.hbm_bytes = int(
-                getattr(mem, "temp_size_in_bytes", 0) + arg + out - alias
+                getattr(mem, "temp_size_in_bytes", 0) + arg + out - int(alias)
             )
-            report.argument_bytes = int(
-                getattr(mem, "argument_size_in_bytes", 0)
-            )
-            report.output_bytes = int(getattr(mem, "output_size_in_bytes", 0))
+            report.argument_bytes = arg
+            report.output_bytes = out
     except Exception:  # noqa: BLE001
         pass
     return report

@@ -1,0 +1,181 @@
+"""``python -m dlrover_tpu.run`` — the elastic launch CLI.
+
+Reference analog: the ``dlrover-run`` console script
+(dlrover/trainer/torch/elastic_run.py:124 parse_args, :230
+_launch_dlrover_local_master, :322 run): a torchrun-superset launcher that
+optionally spawns a local master (``--standalone``), then runs the elastic
+agent supervising the training script. TPU differences: one training process
+per host (JAX owns all local chips), and the rendezvous yields a JAX
+coordination-service address instead of a TCPStore.
+
+Usage:
+    python -m dlrover_tpu.run --standalone --max-restarts 3 \
+        train.py --my-flag ...
+    python -m dlrover_tpu.run --master-addr 10.0.0.2:5001 --node-id 3 \
+        --nnodes 4:8 train.py ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+from dlrover_tpu.agent.elastic_agent import AgentConfig, RunResult, launch_agent
+from dlrover_tpu.common.constants import Defaults, EnvKey
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        "dlrover-tpu run", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument(
+        "--standalone", action="store_true",
+        help="spawn a local job master (single-host dev mode)",
+    )
+    p.add_argument("--master-addr", default="",
+                   help="job master host:port (cluster mode)")
+    p.add_argument("--job-name", default="local")
+    p.add_argument("--node-id", type=int,
+                   default=int(os.environ.get(EnvKey.NODE_ID, "0")))
+    p.add_argument(
+        "--nnodes", default="1",
+        help="N or MIN:MAX node range for the elastic rendezvous",
+    )
+    p.add_argument("--node-unit", type=int, default=1,
+                   help="world size must be a multiple of this")
+    p.add_argument("--max-restarts", type=int, default=Defaults.MAX_RESTARTS)
+    p.add_argument("--rdzv-timeout", type=float,
+                   default=Defaults.RDZV_WAIT_TIMEOUT_S)
+    p.add_argument("--monitor-interval", type=float,
+                   default=Defaults.MONITOR_INTERVAL_S)
+    p.add_argument("--network-check", action="store_true",
+                   help="run a collective probe before training")
+    p.add_argument("--exclude-straggler", action="store_true",
+                   help="with --network-check: also exclude slow nodes")
+    p.add_argument("--no-save-on-failure", action="store_true",
+                   help="skip the breakpoint checkpoint persist on restart")
+    p.add_argument("--host-ip", default="127.0.0.1")
+    p.add_argument("--topology-key", default="",
+                   help="rank-sorting key (TPU slice/host position)")
+    p.add_argument("training_script", help="script (or module via -m inside)")
+    p.add_argument("training_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def parse_nnodes(spec: str) -> tuple[int, int]:
+    if ":" in spec:
+        lo, hi = spec.split(":", 1)
+        return int(lo), int(hi)
+    n = int(spec)
+    return n, n
+
+
+def launch_local_master(args, min_nodes: int, max_nodes: int
+                        ) -> tuple[subprocess.Popen, str]:
+    """Spawn the standalone master; return (proc, addr)."""
+    port_file = os.path.join(
+        tempfile.mkdtemp(prefix="dlrover_tpu_master_"), "port"
+    )
+    cmd = [
+        sys.executable, "-m", "dlrover_tpu.master.job_master",
+        "--job-name", args.job_name,
+        "--min-nodes", str(min_nodes),
+        "--max-nodes", str(max_nodes),
+        "--node-unit", str(args.node_unit),
+        "--rdzv-timeout", str(args.rdzv_timeout),
+        "--port-file", port_file,
+    ]
+    proc = subprocess.Popen(cmd, start_new_session=True)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"standalone master exited early with {proc.returncode}"
+            )
+        if os.path.exists(port_file):
+            with open(port_file) as f:
+                text = f.read().strip()
+            if text:
+                return proc, f"127.0.0.1:{text}"
+        time.sleep(0.05)
+    proc.kill()
+    raise TimeoutError("standalone master did not report its port in 30s")
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = parse_args(argv)
+    min_nodes, max_nodes = parse_nnodes(args.nnodes)
+
+    master_proc = None
+    if args.standalone:
+        master_proc, master_addr = launch_local_master(
+            args, min_nodes, max_nodes
+        )
+        logger.info("standalone master at %s", master_addr)
+    else:
+        master_addr = args.master_addr or os.environ.get(
+            EnvKey.MASTER_ADDR, ""
+        )
+        if not master_addr:
+            print(
+                "error: provide --master-addr (or --standalone)",
+                file=sys.stderr,
+            )
+            return 2
+
+    script = args.training_script
+    train_args = list(args.training_args)
+    if train_args and train_args[0] == "--":
+        train_args = train_args[1:]
+    entrypoint = [sys.executable, script, *train_args]
+
+    # children must resolve dlrover_tpu from this checkout even when the
+    # package is not pip-installed
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    existing = os.environ.get("PYTHONPATH", "")
+    if pkg_root not in existing.split(os.pathsep):
+        os.environ["PYTHONPATH"] = (
+            f"{pkg_root}{os.pathsep}{existing}" if existing else pkg_root
+        )
+
+    config = AgentConfig(
+        job_name=args.job_name,
+        master_addr=master_addr,
+        node_id=args.node_id,
+        entrypoint=entrypoint,
+        max_restarts=args.max_restarts,
+        monitor_interval_s=args.monitor_interval,
+        rdzv_timeout_s=args.rdzv_timeout,
+        network_check=args.network_check,
+        exclude_straggler=args.exclude_straggler,
+        host_ip=args.host_ip,
+        topology_key=args.topology_key,
+        save_on_failure=not args.no_save_on_failure,
+    )
+    try:
+        result = launch_agent(config)
+    finally:
+        if master_proc is not None:
+            try:
+                deadline = time.time() + 10
+                while time.time() < deadline and master_proc.poll() is None:
+                    time.sleep(0.1)
+                if master_proc.poll() is None:
+                    os.killpg(master_proc.pid, signal.SIGTERM)
+                    master_proc.wait(timeout=10)
+            except (ProcessLookupError, subprocess.TimeoutExpired):
+                pass
+    return 0 if result == RunResult.SUCCEEDED else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

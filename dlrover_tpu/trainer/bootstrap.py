@@ -1,0 +1,75 @@
+"""Trainer-process bring-up from the agent-provided environment.
+
+Reference analog: torchelastic workers read RANK/WORLD_SIZE/MASTER_ADDR set
+by the agent (dlrover/python/elastic_agent/torch/training.py worker env
+assembly). TPU-natively the agent hands the JAX coordination service address
+from the completed rendezvous and the trainer calls
+``jax.distributed.initialize`` — after that every process sees the global
+device set and a single ``Mesh`` spans hosts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class RunContext:
+    job_name: str = "local"
+    node_id: int = 0
+    node_rank: int = 0
+    num_nodes: int = 1
+    restart_count: int = 0
+    coordinator: str = ""
+    master_addr: str = ""
+    under_agent: bool = False
+
+
+def init_from_env(initialize_distributed: bool = True) -> RunContext:
+    """Read the agent contract from env; multi-node: join the JAX cluster.
+
+    Safe to call without an agent (standalone notebooks/benchmarks): returns
+    a single-node context and skips ``jax.distributed.initialize``.
+
+    ``DLROVER_TPU_PLATFORM`` forces the JAX platform (tests set ``cpu`` for
+    hermetic multi-device runs) — a plain ``JAX_PLATFORMS`` env var loses to
+    an eagerly registered TPU plugin, the live config does not.
+    """
+    platform = os.environ.get("DLROVER_TPU_PLATFORM")
+    if platform:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", platform)
+        except RuntimeError:
+            logger.warning("backend already initialized; cannot force %s",
+                           platform)
+    ctx = RunContext(
+        job_name=os.environ.get(EnvKey.JOB_NAME, "local"),
+        node_id=int(os.environ.get(EnvKey.NODE_ID, "0")),
+        node_rank=int(os.environ.get(EnvKey.NODE_RANK, "0")),
+        num_nodes=int(os.environ.get(EnvKey.NODE_NUM, "1")),
+        restart_count=int(os.environ.get(EnvKey.RESTART_COUNT, "0")),
+        coordinator=os.environ.get(EnvKey.COORDINATOR, ""),
+        master_addr=os.environ.get(EnvKey.MASTER_ADDR, ""),
+        under_agent=bool(os.environ.get(EnvKey.MASTER_ADDR)),
+    )
+    if initialize_distributed and ctx.num_nodes > 1 and ctx.coordinator:
+        import jax
+
+        logger.info(
+            "joining jax cluster: rank %d/%d coordinator %s (restart %d)",
+            ctx.node_rank, ctx.num_nodes, ctx.coordinator, ctx.restart_count,
+        )
+        jax.distributed.initialize(
+            coordinator_address=ctx.coordinator,
+            num_processes=ctx.num_nodes,
+            process_id=ctx.node_rank,
+        )
+    return ctx

@@ -113,9 +113,13 @@ class ElasticTrainer:
         start = time.monotonic()
         # one sync at entry so a restored state's step carries forward
         self._host_step = int(state.step)
+        if max_steps is not None and self._host_step >= max_steps:
+            # a restored finished job must not assemble (and discard) a
+            # batch, let alone run extra steps
+            logger.info("restored at step %d >= max_steps %d; nothing to do",
+                        self._host_step, max_steps)
+            return state
         for batch in self.assembler.batches(samples, collate):
-            if max_steps is not None and self._host_step >= max_steps:
-                break  # a restored finished job must not run extra steps
             state, metrics = self.train_step(state, batch)
             step = self._host_step
             if on_step is not None:

@@ -1,0 +1,201 @@
+"""Elastic transformer training example.
+
+The TPU-native analog of the reference's GPT-2 + Flash Checkpoint example
+(BASELINE.md config 2; reference flow dlrover/trainer/torch/elastic_run.py
+-> user script with Checkpointer). Run it under the agent:
+
+    python -m dlrover_tpu.run --standalone examples/train_transformer.py \
+        -- --model tiny --max-steps 50
+
+Kill the training process mid-run: the agent persists the shm snapshot,
+re-rendezvouses, respawns this script, and it resumes from the in-memory
+checkpoint — the wow-path this example exists to demonstrate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+# runnable from a checkout without installing the package
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("train_transformer")
+    p.add_argument("--model", default="tiny")
+    p.add_argument("--strategy", default="dp",
+                   help="strategy preset name (parallel/strategy.py)")
+    p.add_argument("--max-steps", type=int, default=50)
+    p.add_argument("--global-batch", type=int, default=8)
+    p.add_argument("--micro-batch", type=int, default=0,
+                   help="0 -> global_batch / dp (no accumulation)")
+    p.add_argument("--seq", type=int, default=0, help="0 -> model max")
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--ckpt-dir", default="/tmp/dlrover_tpu_ckpt")
+    p.add_argument("--ckpt-interval", type=int, default=10,
+                   help="persist to storage every N steps")
+    p.add_argument("--mem-ckpt-interval", type=int, default=1,
+                   help="shm snapshot every N steps")
+    p.add_argument("--dataset-size", type=int, default=100000)
+    p.add_argument("--epochs", type=int, default=10)
+    p.add_argument("--shard-size", type=int, default=256)
+    p.add_argument("--result-file", default="")
+    p.add_argument("--log-interval", type=int, default=10)
+    p.add_argument("--crash-at-step", type=int, default=0,
+                   help="fault injection: hard-exit at this step "
+                        "(first incarnation only unless --crash-always)")
+    p.add_argument("--crash-always", action="store_true",
+                   help="crash at --crash-at-step in every incarnation")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+
+    import jax
+    import optax
+
+    from dlrover_tpu.checkpoint.engine import CheckpointEngine
+    from dlrover_tpu.checkpoint.shm_handler import _leaf_paths
+    from dlrover_tpu.models import transformer as tfm
+    from dlrover_tpu.parallel.mesh import data_parallel_size
+    from dlrover_tpu.parallel.strategy import PRESETS
+    from dlrover_tpu.trainer import bootstrap
+    from dlrover_tpu.trainer.elastic_trainer import ElasticTrainer
+    from dlrover_tpu.trainer.train_step import compile_train
+
+    ctx = bootstrap.init_from_env()
+    cfg = tfm.CONFIGS[args.model]
+    seq = args.seq or cfg.max_seq_len
+
+    strategy = PRESETS[args.strategy]()
+    mesh = strategy.build_mesh()
+    compiled = compile_train(
+        strategy=strategy,
+        mesh=mesh,
+        loss_fn=partial(tfm.loss_fn, cfg=cfg),
+        init_params_fn=lambda rng: tfm.init_params(cfg, rng),
+        logical_params=tfm.logical_axes(cfg),
+        optimizer=optax.adamw(args.lr),
+    )
+    state = compiled.init(jax.random.PRNGKey(0))
+
+    engine = CheckpointEngine(args.ckpt_dir, node_id=ctx.node_id,
+                              node_rank=ctx.node_rank,
+                              world_size=ctx.num_nodes)
+    shard_of = dict(_leaf_paths(compiled.state_shardings))
+    resumed_from = 0
+    loaded = engine.load(
+        state,
+        put=lambda name, arr: jax.device_put(arr, shard_of[name]),
+        zero_copy=True,
+    )
+    if loaded is not None:
+        resumed_from, state = loaded
+        print(f"[trainer] resumed from step {resumed_from}", flush=True)
+
+    dp = data_parallel_size(mesh)
+    trainer = ElasticTrainer(
+        compiled,
+        global_batch_size=args.global_batch,
+        micro_batch_size=args.micro_batch or max(1, args.global_batch // dp),
+    )
+
+    # ---- data: master-fed dynamic shards under the agent, local otherwise
+    vocab = cfg.vocab_size
+    rng_seed = 1234
+
+    def tokens_for(idx: int) -> np.ndarray:
+        g = np.random.Generator(np.random.Philox(key=rng_seed + idx))
+        return g.integers(0, vocab, seq + 1, dtype=np.int32)
+
+    if ctx.under_agent:
+        from dlrover_tpu.trainer.sharding_client import IndexShardingClient
+
+        shard_client = IndexShardingClient(
+            dataset_name="synthetic",
+            dataset_size=args.dataset_size,
+            shard_size=args.shard_size,
+            num_epochs=args.epochs,
+            shuffle=True,
+        )
+
+        def samples():
+            while True:
+                idx = shard_client.next_index()
+                if idx is None:
+                    return
+                yield idx
+    else:
+        def samples():
+            i = 0
+            while True:
+                yield i % args.dataset_size
+                i += 1
+
+    def collate(batch_indices: list[int]) -> dict[str, np.ndarray]:
+        return {"tokens": np.stack([tokens_for(i) for i in batch_indices])}
+
+    def checkpointer(step: int, st) -> None:
+        if step % args.mem_ckpt_interval == 0:
+            if step % args.ckpt_interval == 0:
+                engine.save_to_storage(step, st)
+            else:
+                engine.save_to_memory(step, st)
+
+    losses: list[float] = []
+
+    def on_step(step: int, metrics: dict) -> None:
+        if args.crash_at_step and step == args.crash_at_step \
+                and (args.crash_always or ctx.restart_count == 0):
+            print(f"[trainer] injected crash at step {step}", flush=True)
+            sys.stdout.flush()
+            os._exit(17)
+        if step % args.log_interval == 0:
+            loss = float(jax.device_get(metrics["loss"]))
+            losses.append(loss)
+            print(f"[trainer] step {step} loss {loss:.4f}", flush=True)
+
+    start = time.monotonic()
+    state = trainer.run(
+        state,
+        samples(),
+        collate,
+        max_steps=args.max_steps,
+        on_step=on_step,
+        checkpointer=checkpointer,
+        checkpoint_interval=1,
+    )
+    final_step = int(state.step)
+    engine.save_to_storage(final_step, state)
+    engine.wait_for_persist(final_step, timeout=120)
+    engine.close()
+
+    if args.result_file and ctx.node_rank == 0:
+        with open(args.result_file, "w") as f:
+            json.dump(
+                {
+                    "final_step": final_step,
+                    "resumed_from": resumed_from,
+                    "restart_count": ctx.restart_count,
+                    "num_nodes": ctx.num_nodes,
+                    "last_loss": losses[-1] if losses else None,
+                    "wall_s": round(time.monotonic() - start, 2),
+                },
+                f,
+            )
+    print(f"[trainer] done at step {final_step}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
