@@ -51,10 +51,37 @@ def tracker_path(ckpt_dir: str) -> str:
     return os.path.join(ckpt_dir, "latest")
 
 
-class AsyncCheckpointSaver:
-    """Singleton-per-agent async persister."""
+def done_marker(node_id: int, num_shards: int) -> str:
+    """Commit markers carry the writer world size: a re-save of the same
+    step after the job reshaped must not count a previous incarnation's
+    markers (stale ``done_3`` from a 4-node save would otherwise commit a
+    2-node save early and blend divergent shard files into restores)."""
+    return f"done_{node_id}_w{num_shards}"
 
-    _instance: Optional["AsyncCheckpointSaver"] = None
+
+def read_tracker(storage, ckpt_dir: str) -> tuple[int, int] | None:
+    """(committed step, num_shards committed) or None. Accepts the legacy
+    plain-int tracker (num_shards defaults to 1)."""
+    path = tracker_path(ckpt_dir)
+    if not storage.exists(path):
+        return None
+    text = storage.read_text(path).strip()
+    if not text:
+        return None
+    try:
+        data = json.loads(text)
+        if isinstance(data, dict):
+            return int(data["step"]), int(data.get("num_shards", 1))
+    except (ValueError, KeyError):
+        pass
+    return int(text), 1
+
+
+class AsyncCheckpointSaver:
+    """One async persister per node id (an agent hosts exactly one; tests
+    and multi-node-per-host simulations may hold several)."""
+
+    _instances: dict[int, "AsyncCheckpointSaver"] = {}
     _lock = threading.Lock()
 
     def __init__(self, node_id: int):
@@ -68,33 +95,48 @@ class AsyncCheckpointSaver:
         )
         self._persist_lock = threading.Lock()
 
+    _signals_registered = False
+
     @classmethod
     def start(cls, node_id: int) -> "AsyncCheckpointSaver":
         with cls._lock:
-            if cls._instance is None:
+            saver = cls._instances.get(node_id)
+            if saver is None:
                 saver = cls(node_id)
                 saver._thread.start()
-                saver._register_signal_handlers()
-                cls._instance = saver
-            return cls._instance
+                cls._register_signal_handlers()
+                cls._instances[node_id] = saver
+            return saver
 
     @classmethod
-    def reset(cls) -> None:
+    def reset(cls, node_id: int | None = None) -> None:
         with cls._lock:
-            if cls._instance is not None:
-                cls._instance.stop()
-            cls._instance = None
+            targets = (
+                list(cls._instances) if node_id is None else
+                [node_id] if node_id in cls._instances else []
+            )
+            for nid in targets:
+                cls._instances.pop(nid).stop()
 
-    def _register_signal_handlers(self) -> None:
-        # persist the latest snapshot on graceful termination
-        # (reference: ckpt_saver.py:470 register_signal_handler)
+    @classmethod
+    def _register_signal_handlers(cls) -> None:
+        # persist the latest snapshots on graceful termination
+        # (reference: ckpt_saver.py:470 register_signal_handler). One
+        # handler for the process; it walks the live saver registry at fire
+        # time, so savers added/reset later are handled correctly.
+        if cls._signals_registered:
+            return
         if threading.current_thread() is not threading.main_thread():
             return
         orig_term = signal.getsignal(signal.SIGTERM)
 
         def on_term(signum, frame):
             try:
-                self.save_shm_to_storage(reason="SIGTERM")
+                for saver in list(cls._instances.values()):
+                    try:
+                        saver.save_shm_to_storage(reason="SIGTERM")
+                    except Exception:  # noqa: BLE001 - keep terminating
+                        logger.exception("SIGTERM persist failed")
             finally:
                 if callable(orig_term):
                     orig_term(signum, frame)
@@ -103,6 +145,7 @@ class AsyncCheckpointSaver:
 
         try:
             signal.signal(signal.SIGTERM, on_term)
+            cls._signals_registered = True
         except ValueError:
             pass
 
@@ -174,12 +217,15 @@ class AsyncCheckpointSaver:
         start = time.monotonic()
         sdir = step_dir(ckpt_dir, step)
         storage.makedirs(sdir)
+        num_shards = int(header.get("num_shards", 1))
         storage.write(content, os.path.join(sdir, f"node_{self.node_id}.bin"))
         storage.write(
             json.dumps(header),
             os.path.join(sdir, f"node_{self.node_id}.meta.json"),
         )
-        storage.write(b"", os.path.join(sdir, f"done_{self.node_id}"))
+        storage.write(
+            b"", os.path.join(sdir, done_marker(self.node_id, num_shards))
+        )
         self._maybe_commit(storage, header, step)
         logger.info(
             "persisted step %d (%d bytes) in %.2fs",
@@ -194,14 +240,20 @@ class AsyncCheckpointSaver:
         ckpt_dir = header["ckpt_dir"]
         num_shards = int(header.get("num_shards", 1))
         sdir = step_dir(ckpt_dir, step)
+        suffix = f"_w{num_shards}"
         deadline = time.time() + 300.0
         while time.time() < deadline:
             done = [
-                f for f in storage.listdir(sdir) if f.startswith("done_")
+                f for f in storage.listdir(sdir)
+                if f.startswith("done_") and f.endswith(suffix)
             ]
             if len(done) >= num_shards:
-                storage.write(str(step), tracker_path(ckpt_dir))
-                logger.info("committed checkpoint step %d", step)
+                storage.write(
+                    json.dumps({"step": step, "num_shards": num_shards}),
+                    tracker_path(ckpt_dir),
+                )
+                logger.info("committed checkpoint step %d (%d shards)",
+                            step, num_shards)
                 return
             time.sleep(0.2)
         logger.error(

@@ -93,6 +93,12 @@ class CheckpointEngine:
             "replicated": self.replicated,
         }
 
+    def _prepare_state(self, state: Any) -> tuple[Any, dict]:
+        """Hook: transform the pytree before snapshotting (sharded engines
+        split leaves into addressable pieces here). Returns (tree, extra
+        header metadata)."""
+        return state, {}
+
     def save_to_memory(self, step: int, state: Any) -> bool:
         """Sub-second snapshot into shm. Returns False if the saver is mid-
         persist (skip rather than block the training step)."""
@@ -103,8 +109,9 @@ class CheckpointEngine:
             return False
         try:
             start = time.monotonic()
+            tree, extra = self._prepare_state(state)
             self.shm_handler.save_state_dict(
-                step, state, extra_meta=self._extra_meta()
+                step, tree, extra_meta={**self._extra_meta(), **extra}
             )
             logger.info(
                 "step %d snapshotted to shm in %.3fs",
@@ -167,12 +174,12 @@ class CheckpointEngine:
         return snap
 
     def _load_from_storage(self) -> tuple[int, dict[str, np.ndarray]] | None:
-        from dlrover_tpu.agent.ckpt_saver import step_dir, tracker_path
+        from dlrover_tpu.agent.ckpt_saver import read_tracker, step_dir
 
-        tracker = tracker_path(self.ckpt_dir)
-        if not self.storage.exists(tracker):
+        committed = read_tracker(self.storage, self.ckpt_dir)
+        if committed is None:
             return None
-        step = int(self.storage.read_text(tracker).strip())
+        step, _ = committed
         sdir = step_dir(self.ckpt_dir, step)
         # replicated ckpt: one node file holds everything; prefer our own,
         # else the smallest node id present.
@@ -207,12 +214,10 @@ class CheckpointEngine:
         return step, arrays
 
     def latest_persisted_step(self) -> int:
-        from dlrover_tpu.agent.ckpt_saver import tracker_path
+        from dlrover_tpu.agent.ckpt_saver import read_tracker
 
-        tracker = tracker_path(self.ckpt_dir)
-        if not self.storage.exists(tracker):
-            return -1
-        return int(self.storage.read_text(tracker).strip())
+        committed = read_tracker(self.storage, self.ckpt_dir)
+        return -1 if committed is None else committed[0]
 
     def wait_for_persist(self, step: int, timeout: float = 120.0) -> bool:
         deadline = time.time() + timeout
@@ -226,7 +231,7 @@ class CheckpointEngine:
         if self._solo_saver is not None:
             from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
 
-            AsyncCheckpointSaver.reset()
+            AsyncCheckpointSaver.reset(self.node_id)
         else:
             self.shm_handler.close()
             self.event_queue.close()

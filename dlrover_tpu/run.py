@@ -171,7 +171,13 @@ def main(argv: list[str] | None = None) -> int:
                     time.sleep(0.1)
                 if master_proc.poll() is None:
                     os.killpg(master_proc.pid, signal.SIGTERM)
-                    master_proc.wait(timeout=10)
+                    try:
+                        master_proc.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        # a wedged master must not outlive the run: its
+                        # port/IPC names would break later standalone runs
+                        os.killpg(master_proc.pid, signal.SIGKILL)
+                        master_proc.wait(timeout=10)
             except (ProcessLookupError, subprocess.TimeoutExpired):
                 pass
     return 0 if result == RunResult.SUCCEEDED else 1

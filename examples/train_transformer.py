@@ -48,6 +48,8 @@ def parse_args(argv=None):
     p.add_argument("--dataset-size", type=int, default=100000)
     p.add_argument("--epochs", type=int, default=10)
     p.add_argument("--shard-size", type=int, default=256)
+    p.add_argument("--sharded-ckpt", action="store_true",
+                   help="per-shard snapshots + reshard-on-load (FSDP-style)")
     p.add_argument("--result-file", default="")
     p.add_argument("--log-interval", type=int, default=10)
     p.add_argument("--crash-at-step", type=int, default=0,
@@ -89,16 +91,25 @@ def main(argv=None) -> int:
     )
     state = compiled.init(jax.random.PRNGKey(0))
 
-    engine = CheckpointEngine(args.ckpt_dir, node_id=ctx.node_id,
-                              node_rank=ctx.node_rank,
-                              world_size=ctx.num_nodes)
-    shard_of = dict(_leaf_paths(compiled.state_shardings))
+    if args.sharded_ckpt:
+        from dlrover_tpu.checkpoint.sharded import ShardedCheckpointEngine
+
+        engine = ShardedCheckpointEngine(
+            args.ckpt_dir, node_id=ctx.node_id, node_rank=ctx.node_rank,
+            world_size=ctx.num_nodes,
+        )
+        loaded = engine.load_sharded(state, compiled.state_shardings)
+    else:
+        engine = CheckpointEngine(args.ckpt_dir, node_id=ctx.node_id,
+                                  node_rank=ctx.node_rank,
+                                  world_size=ctx.num_nodes)
+        shard_of = dict(_leaf_paths(compiled.state_shardings))
+        loaded = engine.load(
+            state,
+            put=lambda name, arr: jax.device_put(arr, shard_of[name]),
+            zero_copy=True,
+        )
     resumed_from = 0
-    loaded = engine.load(
-        state,
-        put=lambda name, arr: jax.device_put(arr, shard_of[name]),
-        zero_copy=True,
-    )
     if loaded is not None:
         resumed_from, state = loaded
         print(f"[trainer] resumed from step {resumed_from}", flush=True)

@@ -135,6 +135,25 @@ def test_sigkill_recovers(tmp_path):
 
 
 @pytest.mark.timeout(300)
+def test_fsdp_sharded_ckpt_crash_recovers(tmp_path):
+    """FSDP strategy + per-shard snapshots: crash -> reshard-on-load."""
+    cmd, result_file = _cli_cmd(
+        tmp_path, ["--max-restarts", "2"],
+        ["--max-steps", "20", "--crash-at-step", "8",
+         "--strategy", "fsdp", "--sharded-ckpt"],
+    )
+    proc = subprocess.run(
+        cmd, env=_env(tmp_path), cwd=REPO, timeout=280,
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    result = json.load(open(result_file))
+    assert result["final_step"] == 20
+    assert result["resumed_from"] >= 6
+    assert result["restart_count"] == 1
+
+
+@pytest.mark.timeout(300)
 def test_restarts_exhausted_fails_job(tmp_path):
     cmd, result_file = _cli_cmd(
         tmp_path, ["--max-restarts", "1"],
