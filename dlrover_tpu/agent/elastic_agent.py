@@ -44,6 +44,9 @@ logger = get_logger(__name__)
 class RunResult(str, Enum):
     SUCCEEDED = "succeeded"
     FAILED = "failed"
+    # this host should be replaced, not restarted-in-place: the launcher
+    # exits with a distinct code the operator/scaler keys on
+    NODE_RELAUNCH = "node_relaunch"
 
 
 @dataclasses.dataclass
@@ -188,22 +191,51 @@ class ElasticAgent:
                 self._client.report_job_exit(success=True)
                 return RunResult.SUCCEEDED
             if code is not None:
-                if not self._handle_failure(code):
-                    return RunResult.FAILED
+                outcome = self._handle_failure(code)
+                if outcome is not None:
+                    return outcome
                 continue
             # healthy: check for membership changes / master actions
             if self._membership_changed() or self._master_action() == "restart":
                 self._restart_workers(reason="membership change")
 
-    def _handle_failure(self, exit_code: int) -> bool:
-        """Report and decide restart; returns False when giving up."""
-        logger.warning("training process exited with code %d", exit_code)
-        self._client.report_failure(
-            error_data=f"exit code {exit_code}",
-            restart_count=self._restart_count,
-            level=TrainingExceptionLevel.PROCESS_ERROR,
+    def _handle_failure(self, exit_code: int) -> RunResult | None:
+        """Classify the exit and act on it; None means restarted, keep
+        monitoring. Reference: training.py:356-360 exit-code semantics +
+        dist_job_manager.py:561 _should_relaunch."""
+        from dlrover_tpu.agent.failure_policy import (
+            FailureAction,
+            classify_exit,
+            decide,
         )
-        if self._restart_count >= self._config.max_restarts:
+
+        reason = classify_exit(exit_code)
+        action = decide(reason, self._restart_count,
+                        self._config.max_restarts)
+        logger.warning(
+            "training process exited with code %d (%s) -> %s",
+            exit_code, reason.value, action.value,
+        )
+        self._client.report_failure(
+            error_data=f"exit code {exit_code} ({reason.value})",
+            restart_count=self._restart_count,
+            level=(
+                TrainingExceptionLevel.NODE_ERROR
+                if reason in (NodeExitReason.HARDWARE_ERROR,
+                              NodeExitReason.OOM)
+                else TrainingExceptionLevel.PROCESS_ERROR
+            ),
+        )
+        if action == FailureAction.RELAUNCH_NODE:
+            # persist the snapshot first: the replacement host restores
+            # from storage, not from this host's shm
+            self._persist_checkpoint(reason="node relaunch")
+            self._client.report_node_event(
+                NodeEventType.MODIFIED, NodeStatus.FAILED.value,
+                reason, f"exit code {exit_code}",
+            )
+            return RunResult.NODE_RELAUNCH
+        if action == FailureAction.GIVE_UP:
             logger.error(
                 "no failovers remain (%d used); job failed",
                 self._restart_count,
@@ -215,13 +247,13 @@ class ElasticAgent:
             self._client.report_job_exit(
                 success=False, reason=f"exit code {exit_code}"
             )
-            return False
+            return RunResult.FAILED
         self._persist_checkpoint(reason="process failure")
         self._recover_shards()
         self._restart_count += 1
         rank, num_nodes, coordinator = self._rendezvous()
         self._proc = self._spawn(rank, num_nodes, coordinator)
-        return True
+        return None
 
     def _restart_workers(self, reason: str) -> None:
         logger.info("restarting workers: %s", reason)
@@ -302,11 +334,13 @@ class ElasticAgent:
     # -------------------------------------------------------- network check
 
     def _run_network_check(self) -> None:
-        """Pre-training collective probe; excludes bad nodes.
+        """Pre-training collective probe with ≤2-round fault bisection.
 
-        Reference analog: NodeCheckElasticAgent.run (training.py:805,956).
-        Joins the dedicated network-check rendezvous, runs the probe payload
-        in a subprocess, and reports timing to the master diagnosis manager.
+        Reference analog: NodeCheckElasticAgent.run (training.py:805,956) +
+        NetworkCheckRendezvousManager (reference rdzv_manager.py:349).
+        Probe round 0 runs in master-assigned pairs; nodes whose pair failed
+        are re-paired with known-good partners in round 1, so one bad node
+        cannot condemn its healthy neighbor.
         """
         from dlrover_tpu.agent.node_check import run_node_check
 
@@ -320,12 +354,19 @@ class ElasticAgent:
         world = self._client.wait_comm_world(
             rdzv_name="network-check", timeout=self._config.rdzv_timeout_s
         )
-        elapsed, ok = run_node_check(
-            node_rank=world.world[self._config.node_id],
-            num_nodes=len(world.world),
-            coordinator=world.coordinator,
-        )
-        self._client.report_network_check(world.round, ok, elapsed)
+        global_rank = world.world[self._config.node_id]
+        for probe_round in (0, 1):
+            group = self._wait_probe_group(probe_round)
+            if group is None or not group.needed:
+                break
+            elapsed, ok, local = run_node_check(
+                node_rank=group.world[self._config.node_id],
+                num_nodes=len(group.world),
+                coordinator=group.coordinator,
+                global_rank=global_rank,
+            )
+            self._client.report_network_check(probe_round, ok, elapsed,
+                                              local_time=local)
         deadline = time.time() + 120
         while time.time() < deadline:
             status = self._client.get_network_check_status()
@@ -340,6 +381,16 @@ class ElasticAgent:
                 return
             time.sleep(0.5)
         logger.warning("network check status never completed; proceeding")
+
+    def _wait_probe_group(self, probe_round: int, timeout: float = 300.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            group = self._client.get_network_check_group(probe_round)
+            if group.ready:
+                return group
+            time.sleep(0.5)
+        logger.warning("probe round %d group never became ready", probe_round)
+        return None
 
 
 def launch_agent(config: AgentConfig) -> RunResult:

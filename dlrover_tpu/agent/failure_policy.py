@@ -1,0 +1,72 @@
+"""Exit-code classification and failover decisions.
+
+Reference analog: the agent/master failure split in
+dlrover/python/elastic_agent/torch/training.py:356-360 (exit-code semantics)
+and dlrover/python/master/node/dist_job_manager.py:561 (_should_relaunch:
+hardware -> relaunch the node, OOM -> bigger pod, software -> restart the
+process). TPU specifics: a trainer cannot fix a bad chip by restarting on
+the same host, so hardware faults escalate to node relaunch (the
+operator/scaler replaces the host); HBM is fixed per chip, so OOM restarts
+in place after reporting — the resource optimizer's job is to shrink the
+per-step footprint (grad accumulation) or grow the slice.
+
+Exit-code contract (trainer side helpers in trainer/bootstrap.py):
+    0    success
+    210  out of memory (HBM/host)           -> restart + report OOM
+    211  hardware/chip fault                -> relaunch node
+    <0   killed by signal -abs(code)        -> restart (KILLED/PREEMPTED)
+    else software error                     -> restart, bounded
+"""
+
+from __future__ import annotations
+
+import enum
+import signal
+
+from dlrover_tpu.common.constants import NodeExitReason
+
+EXIT_CODE_OOM = 210
+EXIT_CODE_HARDWARE = 211
+# 128+signal exit codes some runtimes report instead of negative returncodes
+_SIGNAL_BASE = 128
+
+
+class FailureAction(str, enum.Enum):
+    RESTART_PROCESS = "restart_process"
+    RELAUNCH_NODE = "relaunch_node"
+    GIVE_UP = "give_up"
+
+
+def classify_exit(exit_code: int) -> NodeExitReason:
+    if exit_code == 0:
+        return NodeExitReason.SUCCEEDED
+    if exit_code == EXIT_CODE_OOM:
+        return NodeExitReason.OOM
+    if exit_code == EXIT_CODE_HARDWARE:
+        return NodeExitReason.HARDWARE_ERROR
+    sig = None
+    if exit_code < 0:
+        sig = -exit_code
+    elif exit_code > _SIGNAL_BASE:
+        sig = exit_code - _SIGNAL_BASE
+    if sig == signal.SIGKILL:
+        # the OOM killer and hard preemption both SIGKILL; without more
+        # signal treat it as an external kill (restartable)
+        return NodeExitReason.KILLED
+    if sig == signal.SIGTERM:
+        return NodeExitReason.PREEMPTED
+    if sig is not None:
+        return NodeExitReason.KILLED
+    return NodeExitReason.UNKNOWN
+
+
+def decide(reason: NodeExitReason, restart_count: int,
+           max_restarts: int) -> FailureAction:
+    """What the agent does about a dead training process."""
+    if reason == NodeExitReason.HARDWARE_ERROR:
+        return FailureAction.RELAUNCH_NODE
+    if reason == NodeExitReason.FATAL_ERROR:
+        return FailureAction.GIVE_UP
+    if restart_count >= max_restarts:
+        return FailureAction.GIVE_UP
+    return FailureAction.RESTART_PROCESS

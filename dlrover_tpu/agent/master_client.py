@@ -218,12 +218,21 @@ class MasterClient:
 
     # -------------------------------------------------------- network check
 
-    def report_network_check(self, round_idx: int, succeeded: bool,
-                             elapsed_time: float) -> None:
+    def report_network_check(self, probe_round: int, succeeded: bool,
+                             elapsed_time: float,
+                             local_time: float = 0.0) -> None:
         self._client.call(
             m.NetworkCheckResult(
-                node_id=self.node_id, round=round_idx, succeeded=succeeded,
-                elapsed_time=elapsed_time,
+                node_id=self.node_id, round=probe_round, succeeded=succeeded,
+                elapsed_time=elapsed_time, local_time=local_time,
+            )
+        )
+
+    def get_network_check_group(self, probe_round: int
+                                ) -> m.NetworkCheckGroupResponse:
+        return self._client.call(
+            m.NetworkCheckGroupRequest(
+                node_id=self.node_id, probe_round=probe_round
             )
         )
 

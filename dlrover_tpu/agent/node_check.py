@@ -20,18 +20,30 @@ from dlrover_tpu.common.log import get_logger
 
 logger = get_logger(__name__)
 
-PROBE_TIMEOUT_S = 300.0
+PROBE_TIMEOUT_S = float(os.environ.get("DLROVER_TPU_PROBE_TIMEOUT", "300"))
+GLOBAL_RANK_ENV = "DLROVER_TPU_GLOBAL_RANK"
 
 
 def _probe_payload() -> float:
     """The in-process probe; returns elapsed seconds. Exits nonzero on fault."""
     mock_rank = os.environ.get(EnvKey.MOCK_ERR_RANK)
+    # fault injection keys on the node's GLOBAL rendezvous rank — probe
+    # groups renumber ranks within each pair, and the mock must follow the
+    # node, not its position in a pair
     node_rank = int(os.environ.get(EnvKey.NODE_RANK, "0"))
-    if mock_rank is not None and int(mock_rank) == node_rank:
+    global_rank = int(os.environ.get(GLOBAL_RANK_ENV, str(node_rank)))
+    if mock_rank is not None and int(mock_rank) == global_rank:
         raise RuntimeError("mock error injected by MOCK_ERR_RANK")
 
     import jax
     import jax.numpy as jnp
+
+    platform = os.environ.get("DLROVER_TPU_PLATFORM")
+    if platform:  # hermetic tests force the CPU backend
+        try:
+            jax.config.update("jax_platforms", platform)
+        except RuntimeError:
+            pass
 
     num_nodes = int(os.environ.get(EnvKey.NODE_NUM, "1"))
     coordinator = os.environ.get(EnvKey.COORDINATOR, "")
@@ -54,6 +66,10 @@ def _probe_payload() -> float:
 
     y = matmul_chain(x)
     y.block_until_ready()
+    # compute-only time: this is the straggler signal — the collective
+    # below gates on the slowest group member, so its wall clock cannot
+    # distinguish a slow chip from a slow partner
+    local_elapsed = time.monotonic() - start
 
     if num_nodes > 1:
         # 16M-element allreduce across every device in the probe group
@@ -63,16 +79,24 @@ def _probe_payload() -> float:
         reduced = jax.pmap(lambda v: jax.lax.psum(v, "probe"),
                            axis_name="probe")(data)
         reduced.block_until_ready()
-    return time.monotonic() - start
+    return time.monotonic() - start, local_elapsed
 
 
-def run_node_check(node_rank: int, num_nodes: int, coordinator: str
-                   ) -> tuple[float, bool]:
-    """Run the probe in a subprocess. Returns (elapsed_s, succeeded)."""
+def run_node_check(node_rank: int, num_nodes: int, coordinator: str,
+                   global_rank: int | None = None
+                   ) -> tuple[float, bool, float]:
+    """Run the probe in a subprocess.
+
+    Returns (elapsed_s, succeeded, local_elapsed_s) — the last being the
+    compute-only portion used for straggler detection.
+    """
     env = dict(os.environ)
     env[EnvKey.NODE_RANK] = str(node_rank)
     env[EnvKey.NODE_NUM] = str(num_nodes)
     env[EnvKey.COORDINATOR] = coordinator
+    env[GLOBAL_RANK_ENV] = str(
+        global_rank if global_rank is not None else node_rank
+    )
     start = time.monotonic()
     try:
         out = subprocess.run(
@@ -81,20 +105,22 @@ def run_node_check(node_rank: int, num_nodes: int, coordinator: str
         )
     except subprocess.TimeoutExpired:
         logger.error("node check timed out after %ss", PROBE_TIMEOUT_S)
-        return PROBE_TIMEOUT_S, False
+        return PROBE_TIMEOUT_S, False, 0.0
     if out.returncode != 0:
         logger.error("node check failed: %s", out.stderr[-2000:])
-        return time.monotonic() - start, False
+        return time.monotonic() - start, False, 0.0
     try:
-        elapsed = json.loads(out.stdout.strip().splitlines()[-1])["elapsed"]
+        result = json.loads(out.stdout.strip().splitlines()[-1])
+        elapsed = result["elapsed"]
+        local = result.get("local", 0.0)
     except (json.JSONDecodeError, IndexError, KeyError):
-        elapsed = time.monotonic() - start
-    return elapsed, True
+        elapsed, local = time.monotonic() - start, 0.0
+    return elapsed, True, local
 
 
 def main() -> int:
-    elapsed = _probe_payload()
-    print(json.dumps({"elapsed": elapsed}))
+    elapsed, local = _probe_payload()
+    print(json.dumps({"elapsed": elapsed, "local": local}))
     return 0
 
 

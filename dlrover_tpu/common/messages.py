@@ -282,10 +282,33 @@ class ShardCheckpoint:
 @register_message
 @dataclasses.dataclass
 class NetworkCheckResult:
+    """Result of one probe round. ``round`` is the PROBE round (0 = paired
+    sweep, 1 = bisection re-pair), not the rendezvous round."""
+
     node_id: int = 0
     round: int = 0
     succeeded: bool = True
     elapsed_time: float = 0.0
+    local_time: float = 0.0  # compute-only time: straggler detection keys
+    #                          on this, not the collective-gated wall clock
+
+
+@register_message
+@dataclasses.dataclass
+class NetworkCheckGroupRequest:
+    """Which probe group should I run ``probe_round`` with?"""
+
+    node_id: int = 0
+    probe_round: int = 0
+
+
+@register_message
+@dataclasses.dataclass
+class NetworkCheckGroupResponse:
+    ready: bool = False     # False: poll again (peers still joining/reporting)
+    needed: bool = True     # False: this probe round is unnecessary
+    world: dict[int, int] = dataclasses.field(default_factory=dict)
+    coordinator: str = ""
 
 
 @register_message

@@ -44,8 +44,11 @@ class Node:
     addr: str = ""
     resource: NodeResource = dataclasses.field(default_factory=NodeResource)
     exit_reason: NodeExitReason = NodeExitReason.UNKNOWN
+    # node-level relaunches (host replaced) — distinct from the agent's
+    # in-place process restarts, which the agent reports via heartbeat
     relaunch_count: int = 0
     max_relaunch_count: int = 3
+    process_restarts: int = 0
     create_time: float = dataclasses.field(default_factory=time.time)
     heartbeat_time: float = 0.0
     # topology hints for rank sorting (reference:

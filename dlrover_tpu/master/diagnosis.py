@@ -18,6 +18,7 @@ import threading
 class _ProbeResult:
     succeeded: bool
     elapsed_time: float
+    local_time: float = 0.0  # compute-only portion (chip speed, no peers)
 
 
 class DiagnosisManager:
@@ -27,16 +28,30 @@ class DiagnosisManager:
         # round -> node_id -> result
         self._results: dict[int, dict[int, _ProbeResult]] = {}
         self._expected_nodes: set[int] = set()
+        self._generation = -1
 
-    def set_expected_nodes(self, node_ids: set[int]) -> None:
+    def set_expected_nodes(self, node_ids: set[int],
+                           generation: int = 0) -> None:
+        """Begin check ``generation`` (the network-check rendezvous round)
+        over ``node_ids``. A new generation discards previous probe
+        results — node ids are stable across launcher restarts, so the set
+        alone cannot distinguish a re-check from the old one."""
         with self._lock:
-            self._expected_nodes = set(node_ids)
+            ids = set(node_ids)
+            if generation != self._generation or ids != self._expected_nodes:
+                self._results.clear()
+            self._generation = generation
+            self._expected_nodes = ids
+
+    def expected_nodes(self) -> set[int]:
+        with self._lock:
+            return set(self._expected_nodes)
 
     def report(self, node_id: int, round_idx: int, succeeded: bool,
-               elapsed_time: float) -> None:
+               elapsed_time: float, local_time: float = 0.0) -> None:
         with self._lock:
             self._results.setdefault(round_idx, {})[node_id] = _ProbeResult(
-                succeeded, elapsed_time
+                succeeded, elapsed_time, local_time
             )
 
     def round_results(self, round_idx: int) -> dict[int, bool]:
@@ -46,31 +61,49 @@ class DiagnosisManager:
                 for nid, r in self._results.get(round_idx, {}).items()
             }
 
-    def status(self, latest_round: int) -> tuple[bool, list[int], list[int]]:
-        """(completed, abnormal_nodes, straggler_nodes) for a probe round."""
+    def _stragglers(self, results: dict[int, _ProbeResult]) -> list[int]:
+        # caller holds the lock. Keyed on the LOCAL compute time when
+        # reported: the collective portion gates on the slowest group
+        # member, so pair wall-clock would condemn a slow node's healthy
+        # partner along with it.
+        def time_of(r: _ProbeResult) -> float:
+            return r.local_time if r.local_time > 0 else r.elapsed_time
+
+        ok_times = [
+            time_of(r) for r in results.values()
+            if r.succeeded and time_of(r) > 0
+        ]
+        if len(ok_times) < 2:
+            return []
+        med = statistics.median(ok_times)
+        if med <= 0:
+            return []
+        return sorted(
+            nid for nid, r in results.items()
+            if r.succeeded and time_of(r) > self._straggler_ratio * med
+        )
+
+    def bisect_status(self) -> tuple[bool, list[int], list[int]]:
+        """(completed, abnormal_nodes, straggler_nodes) over the ≤2-round
+        bisection: a node is abnormal only if its probe failed in BOTH
+        rounds — a healthy node dragged down by a bad round-0 partner
+        passes once re-paired with a good one (reference:
+        NetworkCheckRendezvousManager, rdzv_manager.py:349)."""
         with self._lock:
-            results = self._results.get(latest_round, {})
-            expected = self._expected_nodes or set(results)
-            if not expected or not expected.issubset(results):
+            expected = self._expected_nodes
+            r0 = self._results.get(0, {})
+            if not expected or not expected.issubset(r0):
+                return False, [], []
+            stragglers = self._stragglers(r0)
+            fail0 = {nid for nid in expected if not r0[nid].succeeded}
+            if not fail0:
+                return True, [], stragglers
+            r1 = self._results.get(1, {})
+            if not expected.issubset(r1):
                 return False, [], []
             abnormal = sorted(
-                nid for nid in expected if not results[nid].succeeded
+                nid for nid in fail0 if not r1[nid].succeeded
             )
-            ok_times = [
-                r.elapsed_time
-                for nid, r in results.items()
-                if r.succeeded and r.elapsed_time > 0
-            ]
-            stragglers: list[int] = []
-            if len(ok_times) >= 2:
-                med = statistics.median(ok_times)
-                if med > 0:
-                    stragglers = sorted(
-                        nid
-                        for nid, r in results.items()
-                        if r.succeeded
-                        and r.elapsed_time > self._straggler_ratio * med
-                    )
             return True, abnormal, stragglers
 
     def clear(self) -> None:

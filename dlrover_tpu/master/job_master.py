@@ -12,7 +12,7 @@ from __future__ import annotations
 import argparse
 import time
 
-from dlrover_tpu.common.constants import Defaults
+from dlrover_tpu.common.constants import Defaults, NodeStatus
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.common.rpc import RpcServer
 from dlrover_tpu.master.diagnosis import DiagnosisManager
@@ -93,8 +93,10 @@ class JobMaster:
         logger.info("job master %s serving on port %d", self.job_name,
                     self.port)
 
-    def run(self, poll_interval_s: float = 2.0) -> bool:
+    def run(self, poll_interval_s: float = 2.0,
+            all_exited_grace_s: float = 30.0) -> bool:
         """Block until the job finishes; returns success."""
+        all_exited_since = 0.0
         while True:
             if self.servicer.job_exit_event.wait(poll_interval_s):
                 break
@@ -102,6 +104,25 @@ class JobMaster:
                 logger.error("job hang detected; stopping")
                 self.servicer.job_success = False
                 break
+            # every node reached a terminal state without an explicit job
+            # exit (e.g. the last host left for relaunch and no scaler will
+            # replace it): don't hang forever (reference: the all-exited
+            # composite check, dist_master.py:211-269). The grace window
+            # lets heartbeat-dead nodes that are merely partitioned revive
+            # before the job is declared over.
+            if self.node_manager.all_exited():
+                now = time.time()
+                if not all_exited_since:
+                    all_exited_since = now
+                elif now - all_exited_since >= all_exited_grace_s:
+                    logger.info("all nodes exited; finishing job")
+                    self.servicer.job_success = all(
+                        n.status == NodeStatus.SUCCEEDED
+                        for n in self.node_manager.all_nodes()
+                    )
+                    break
+            else:
+                all_exited_since = 0.0
         success = bool(self.servicer.job_success)
         logger.info("job %s finished, success=%s", self.job_name, success)
         return success

@@ -31,15 +31,25 @@ class NodeManager:
         self,
         dead_window_s: float = Defaults.HEARTBEAT_DEAD_WINDOW_S,
         on_node_dead: Callable[[int], None] | None = None,
+        relaunch_hook: Callable[[Node], None] | None = None,
     ):
         self._dead_window_s = dead_window_s
         self._on_node_dead = on_node_dead
+        # the scaler's entry point: replace the host a failed node ran on
+        # (reference: _relaunch_node dist_job_manager.py:605 -> PodScaler).
+        # None on platforms with no scaler (standalone): relaunch then
+        # relies on an external supervisor restarting the launcher, which
+        # exits with the node-relaunch code.
+        self._relaunch_hook = relaunch_hook
         self._lock = threading.Lock()
         self._nodes: dict[int, Node] = {}
         self._pending_actions: dict[int, str] = {}
         self._stopped = threading.Event()
         self._thread: threading.Thread | None = None
         self._failure_counts: dict[int, int] = {}
+        # nodes whose replacement host has not registered yet: the job is
+        # not "all exited" while one of these is outstanding
+        self._pending_relaunches: set[int] = set()
 
     # ----------------------------------------------------------- registration
 
@@ -59,6 +69,7 @@ class NodeManager:
                 # node came back (relaunch); resurrect
                 node.status = NodeStatus.RUNNING
                 node.heartbeat_time = time.time()
+            self._pending_relaunches.discard(node_id)
             return node
 
     def report_heartbeat(self, node_id: int, restart_count: int = 0) -> str:
@@ -70,18 +81,41 @@ class NodeManager:
                             status=NodeStatus.RUNNING)
                 self._nodes[node_id] = node
             node.heartbeat_time = time.time()
-            node.relaunch_count = restart_count
+            node.process_restarts = restart_count
+            if (node.status == NodeStatus.FAILED
+                    and node.exit_reason == NodeExitReason.KILLED):
+                # the heartbeat monitor declared it dead, but it's clearly
+                # alive (transient partition) — resurrect
+                logger.info("node %d heartbeat after dead-window; reviving",
+                            node_id)
+                node.status = NodeStatus.RUNNING
             return self._pending_actions.pop(node_id, "")
 
     def update_status(self, node_id: int, status: NodeStatus,
                       exit_reason: NodeExitReason = NodeExitReason.UNKNOWN
                       ) -> None:
+        relaunch = None
         with self._lock:
             node = self._nodes.get(node_id)
             if node is None:
                 return
             node.status = status
             node.exit_reason = exit_reason
+            if (status == NodeStatus.FAILED
+                    and node.should_relaunch(exit_reason)
+                    and self._relaunch_hook is not None):
+                node.relaunch_count += 1
+                self._pending_relaunches.add(node_id)
+                relaunch = node
+        if relaunch is not None:
+            logger.info(
+                "relaunching node %d (%s, attempt %d)", node_id,
+                exit_reason.value, relaunch.relaunch_count,
+            )
+            try:
+                self._relaunch_hook(relaunch)
+            except Exception:  # noqa: BLE001 - a failed relaunch is an event,
+                logger.exception("relaunch hook failed")  # not a crash
 
     def report_failure(self, node_id: int) -> int:
         with self._lock:
@@ -155,7 +189,7 @@ class NodeManager:
 
     def all_exited(self) -> bool:
         with self._lock:
-            if not self._nodes:
+            if not self._nodes or self._pending_relaunches:
                 return False
             return all(
                 n.status in NodeStatus.terminal()

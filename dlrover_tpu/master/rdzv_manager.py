@@ -187,7 +187,11 @@ class NetworkCheckRendezvousManager(RendezvousManager):
 
         Round 0: adjacent pairs. Round 1: each node that failed round 0 is
         paired with a node that passed, so a healthy node stuck with a bad
-        partner gets a second chance to prove itself.
+        partner gets a second chance to prove itself. Failed nodes beyond
+        the supply of good partners pair with each other — a solo probe
+        has no collective and would trivially "pass", wrongly clearing a
+        bad node (the servicer records an automatic round-1 failure for an
+        unpairable singleton instead).
         """
         with self._lock:
             if self._latest is None:
@@ -199,12 +203,19 @@ class NetworkCheckRendezvousManager(RendezvousManager):
         bad = [n for n in ids if not node_results.get(n, False)]
         groups: list[list[int]] = []
         gi = 0
+        unpaired_bad: list[int] = []
         for b in bad:
             if gi < len(good):
                 groups.append([b, good[gi]])
                 gi += 1
             else:
-                groups.append([b])
+                unpaired_bad.append(b)
+        # leftover bad nodes probe each other: neither can be exonerated,
+        # and a genuine pair failure marks both abnormal (correct — there
+        # is no good partner to bisect with)
+        groups.extend(
+            [unpaired_bad[i:i + 2] for i in range(0, len(unpaired_bad), 2)]
+        )
         remaining = good[gi:]
         groups.extend(
             [remaining[i:i + 2] for i in range(0, len(remaining), 2)]

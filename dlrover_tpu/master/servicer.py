@@ -125,9 +125,12 @@ class MasterServicer:
             return m.OkResponse()
         if isinstance(msg, m.NetworkCheckResult):
             self._diagnosis.report(
-                msg.node_id, msg.round, msg.succeeded, msg.elapsed_time
+                msg.node_id, msg.round, msg.succeeded, msg.elapsed_time,
+                msg.local_time,
             )
             return m.OkResponse()
+        if isinstance(msg, m.NetworkCheckGroupRequest):
+            return self._network_check_group(msg)
         if isinstance(msg, m.NetworkCheckStatusRequest):
             return self._network_check_status()
         if isinstance(msg, m.ParalConfigRequest):
@@ -167,7 +170,8 @@ class MasterServicer:
         if world is None:
             return m.CommWorldResponse(completed=False)
         if msg.rdzv_name == "network-check":
-            self._diagnosis.set_expected_nodes(set(world.world))
+            self._diagnosis.set_expected_nodes(set(world.world),
+                                               generation=world.round)
         return m.CommWorldResponse(
             completed=True,
             round=world.round,
@@ -176,13 +180,52 @@ class MasterServicer:
             total_devices=world.total_devices,
         )
 
-    def _network_check_status(self) -> m.NetworkCheckStatusResponse:
+    def _network_check_group(self, msg: m.NetworkCheckGroupRequest
+                             ) -> m.NetworkCheckGroupResponse:
+        """Probe-group assignment for the ≤2-round bisection.
+
+        Round 0 pairs adjacent nodes; round 1 re-pairs each round-0 failure
+        with a known-good partner (rdzv_manager.group_nodes). Reference:
+        NetworkCheckRendezvousManager (reference rdzv_manager.py:349).
+        """
         mgr = self._rdzv_managers.get("network-check")
-        latest_round = 0
-        if mgr is not None:
-            # peek at the latest completed probe round
-            latest_round = getattr(mgr, "_round", 0)
-        done, abnormal, stragglers = self._diagnosis.status(latest_round)
+        if mgr is None:
+            return m.NetworkCheckGroupResponse(ready=False)
+        world = mgr.get_comm_world(msg.node_id)
+        if world is None:
+            return m.NetworkCheckGroupResponse(ready=False)
+        self._diagnosis.set_expected_nodes(set(world.world),
+                                           generation=world.round)
+        if msg.probe_round == 0:
+            groups = mgr.group_nodes(0, {})
+        else:
+            r0 = self._diagnosis.round_results(0)
+            if not set(world.world).issubset(r0):
+                return m.NetworkCheckGroupResponse(ready=False)
+            if all(r0.values()):
+                return m.NetworkCheckGroupResponse(ready=True, needed=False)
+            groups = mgr.group_nodes(1, r0)
+        for group in groups:
+            if msg.node_id not in group:
+                continue
+            if msg.probe_round == 1 and len(group) == 1 \
+                    and not self._diagnosis.round_results(0).get(
+                        msg.node_id, True):
+                # a failed node with no partner cannot be exonerated by a
+                # collective-free solo probe: record the round-1 failure
+                # on its behalf and skip the probe
+                self._diagnosis.report(msg.node_id, 1, False, 0.0)
+                return m.NetworkCheckGroupResponse(ready=True, needed=False)
+            return m.NetworkCheckGroupResponse(
+                ready=True,
+                needed=True,
+                world={nid: i for i, nid in enumerate(group)},
+                coordinator=world.node_addrs.get(group[0], ""),
+            )
+        return m.NetworkCheckGroupResponse(ready=False)
+
+    def _network_check_status(self) -> m.NetworkCheckStatusResponse:
+        done, abnormal, stragglers = self._diagnosis.bisect_status()
         return m.NetworkCheckStatusResponse(
             completed=done,
             abnormal_nodes=abnormal,

@@ -180,7 +180,11 @@ def main(argv: list[str] | None = None) -> int:
                         master_proc.wait(timeout=10)
             except (ProcessLookupError, subprocess.TimeoutExpired):
                 pass
-    return 0 if result == RunResult.SUCCEEDED else 1
+    if result == RunResult.SUCCEEDED:
+        return 0
+    if result == RunResult.NODE_RELAUNCH:
+        return 3  # operator/scaler contract: replace this host, same job
+    return 1
 
 
 if __name__ == "__main__":

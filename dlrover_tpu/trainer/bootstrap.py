@@ -19,6 +19,47 @@ from dlrover_tpu.common.log import get_logger
 logger = get_logger(__name__)
 
 
+def exit_oom() -> None:
+    """Report out-of-memory to the agent via the exit-code contract."""
+    from dlrover_tpu.agent.failure_policy import EXIT_CODE_OOM
+
+    os._exit(EXIT_CODE_OOM)
+
+
+def exit_hardware_fault() -> None:
+    """Report an unrecoverable chip/host fault: the agent escalates to node
+    relaunch instead of restarting in place."""
+    from dlrover_tpu.agent.failure_policy import EXIT_CODE_HARDWARE
+
+    os._exit(EXIT_CODE_HARDWARE)
+
+
+class failure_contract:
+    """Context manager translating runtime faults to the exit-code contract.
+
+    Wrap the training loop::
+
+        with bootstrap.failure_contract():
+            trainer.run(...)
+
+    XLA RESOURCE_EXHAUSTED (HBM/host OOM) exits 210 so the agent reports
+    OOM to the master's resource optimizer; everything else propagates and
+    becomes a software error.
+    """
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc is None:
+            return False
+        text = f"{exc_type.__name__}: {exc}"
+        if "RESOURCE_EXHAUSTED" in text or isinstance(exc, MemoryError):
+            logger.error("out of memory: %s", text[:2000])
+            exit_oom()
+        return False
+
+
 @dataclasses.dataclass
 class RunContext:
     job_name: str = "local"

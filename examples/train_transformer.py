@@ -57,6 +57,9 @@ def parse_args(argv=None):
                         "(first incarnation only unless --crash-always)")
     p.add_argument("--crash-always", action="store_true",
                    help="crash at --crash-at-step in every incarnation")
+    p.add_argument("--crash-exit", type=int, default=17,
+                   help="exit code for the injected crash (210=OOM, "
+                        "211=hardware per the failure contract)")
     return p.parse_args(argv)
 
 
@@ -168,9 +171,10 @@ def main(argv=None) -> int:
     def on_step(step: int, metrics: dict) -> None:
         if args.crash_at_step and step == args.crash_at_step \
                 and (args.crash_always or ctx.restart_count == 0):
-            print(f"[trainer] injected crash at step {step}", flush=True)
+            print(f"[trainer] injected crash at step {step} "
+                  f"(exit {args.crash_exit})", flush=True)
             sys.stdout.flush()
-            os._exit(17)
+            os._exit(args.crash_exit)
         if step % args.log_interval == 0:
             loss = float(jax.device_get(metrics["loss"]))
             losses.append(loss)

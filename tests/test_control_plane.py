@@ -1,0 +1,343 @@
+"""Control-plane integration tests: real JobMaster + in-process clients.
+
+Reference analog: the ``start_local_master`` fixture pattern
+(dlrover/python/tests/test_utils.py:268) — boot a real master + servicer,
+then drive it through real MasterClients. Covers rendezvous rounds,
+membership change, dead-node shard recovery, heartbeat action delivery, and
+the network-check bisection.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.messages import DatasetShardParams
+from dlrover_tpu.master.job_master import JobMaster
+
+
+@pytest.fixture
+def master_factory():
+    masters = []
+
+    def make(**kwargs) -> JobMaster:
+        kwargs.setdefault("rdzv_timeout", 2.0)
+        m = JobMaster(port=0, **kwargs)
+        m.prepare()
+        masters.append(m)
+        return m
+
+    yield make
+    for m in masters:
+        m.stop()
+
+
+def client(master: JobMaster, node_id: int) -> MasterClient:
+    return MasterClient(master.addr, node_id)
+
+
+class TestRendezvous:
+    def test_round_completes_with_topology_sort(self, master_factory):
+        master = master_factory(min_nodes=3, max_nodes=3)
+        clients = [client(master, i) for i in range(3)]
+        # join out of order with topology keys that reverse node order
+        keys = {0: "c", 1: "a", 2: "b"}
+        for i, c in enumerate(clients):
+            c.join_rendezvous(addr=f"127.0.0.1:{9000 + i}",
+                              local_devices=4, topology_key=keys[i])
+        world = clients[0].wait_comm_world(timeout=10)
+        assert world.completed
+        # rank order follows topology_key: node1(a)=0, node2(b)=1, node0(c)=2
+        assert world.world == {1: 0, 2: 1, 0: 2}
+        assert world.coordinator == "127.0.0.1:9001"
+        assert world.total_devices == 12
+
+    def test_node_unit_rounding_and_timeout(self, master_factory):
+        master = master_factory(min_nodes=2, max_nodes=4, node_unit=2,
+                                rdzv_timeout=1.0)
+        clients = [client(master, i) for i in range(3)]
+        for i, c in enumerate(clients):
+            c.join_rendezvous(addr=f"127.0.0.1:{9100 + i}", local_devices=1)
+        # 3 joined < max 4: completes after the waiting timeout, rounded
+        # down to node_unit -> 2 nodes
+        world = clients[0].wait_comm_world(timeout=10)
+        assert len(world.world) == 2
+        assert set(world.world.values()) == {0, 1}
+
+    def test_rejoin_invalidates_round_and_waiting_count(self, master_factory):
+        master = master_factory(min_nodes=2, max_nodes=2)
+        c0, c1 = client(master, 0), client(master, 1)
+        for i, c in enumerate((c0, c1)):
+            c.join_rendezvous(addr=f"127.0.0.1:{9200 + i}", local_devices=1)
+        assert c0.wait_comm_world(timeout=10).completed
+        assert c0.num_nodes_waiting() == 0
+        # node 1 restarts and rejoins: old round invalid, 1 waiting
+        c1.join_rendezvous(addr="127.0.0.1:9301", local_devices=1)
+        assert c0.num_nodes_waiting() >= 1
+        assert not c0.get_comm_world().completed
+        c0.join_rendezvous(addr="127.0.0.1:9300", local_devices=1)
+        world = c1.wait_comm_world(timeout=10)
+        assert world.completed and len(world.world) == 2
+        assert world.round == 2
+
+
+class TestDeadNodeRecovery:
+    def test_dead_node_shards_recovered_and_survivors_restarted(
+        self, master_factory
+    ):
+        master = master_factory(
+            min_nodes=2, max_nodes=2, heartbeat_dead_window_s=1.0,
+        )
+        master.node_manager.stop()  # restart monitor with a fast interval
+        master.node_manager._stopped = threading.Event()
+        master.node_manager.start(interval_s=0.2)
+
+        c0, c1 = client(master, 0), client(master, 1)
+        for i, c in enumerate((c0, c1)):
+            c.join_rendezvous(addr=f"127.0.0.1:{9400 + i}", local_devices=1)
+            c.report_heartbeat()
+        assert c0.wait_comm_world(timeout=10).completed
+
+        c0.report_dataset_params(DatasetShardParams(
+            dataset_name="d", dataset_size=100, shard_size=10, num_epochs=1,
+        ))
+        # node 1 takes two shards and dies silently
+        t1 = c1.get_task("d")
+        t2 = c1.get_task("d")
+        assert t1.valid and t2.valid
+        taken = {t1.task_id, t2.task_id}
+
+        deadline = time.time() + 15
+        got_restart = False
+        recovered: set[int] = set()
+        while time.time() < deadline:
+            # node 0 keeps heartbeating; node 1 stays silent
+            action = c0.report_heartbeat()
+            if action == "restart":
+                got_restart = True
+            task = c0.get_task("d")
+            if task.valid:
+                if task.task_id in taken:
+                    recovered.add(task.task_id)
+                c0.report_task_result(task.task_id, "d")
+            if got_restart and recovered == taken:
+                break
+            time.sleep(0.1)
+        assert got_restart, "survivor never got the restart action"
+        assert recovered == taken, "dead node's shards were not recovered"
+        # the dead node's rendezvous membership is gone
+        assert not c0.get_comm_world().completed
+
+    def test_explicit_failure_report_recovers_shards(self, master_factory):
+        master = master_factory(min_nodes=1, max_nodes=1)
+        c0 = client(master, 0)
+        c0.report_dataset_params(DatasetShardParams(
+            dataset_name="d", dataset_size=20, shard_size=10, num_epochs=1,
+        ))
+        t1 = c0.get_task("d")
+        assert t1.valid
+        c0.recover_shards()
+        t1b = c0.get_task("d")
+        assert t1b.valid and t1b.task_id == t1.task_id
+
+
+class TestNetworkCheckBisection:
+    def _join_all(self, master, n):
+        clients = [client(master, i) for i in range(n)]
+        for i, c in enumerate(clients):
+            c.join_rendezvous(
+                addr=f"127.0.0.1:{9500 + i}", local_devices=1,
+                rdzv_name="network-check",
+            )
+        for c in clients:
+            assert c.wait_comm_world(
+                rdzv_name="network-check", timeout=10
+            ).completed
+        return clients
+
+    def test_round0_pairs_and_bad_node_isolated(self, master_factory):
+        master = master_factory(min_nodes=4, max_nodes=4)
+        clients = self._join_all(master, 4)
+
+        groups0 = {}
+        for i, c in enumerate(clients):
+            g = c.get_network_check_group(0)
+            assert g.ready and g.needed
+            groups0[i] = g
+        # adjacent pairs with in-group ranks and the partner's coordinator
+        assert set(groups0[0].world) == {0, 1}
+        assert set(groups0[2].world) == {2, 3}
+        assert groups0[2].coordinator == "127.0.0.1:9502"
+
+        # node 2 is faulty: its pair (2, 3) both fail round 0
+        for i, c in enumerate(clients):
+            c.report_network_check(0, succeeded=i not in (2, 3),
+                                   elapsed_time=1.0)
+        assert not clients[0].get_network_check_status().completed
+
+        # round 1 re-pairs each failure with a good node
+        groups1 = {}
+        for i, c in enumerate(clients):
+            g = c.get_network_check_group(1)
+            assert g.ready and g.needed
+            groups1[i] = g
+        assert set(groups1[2].world) & {0, 1}, "bad node not re-paired"
+        assert set(groups1[3].world) & {0, 1}, "bad node not re-paired"
+
+        # node 3 passes with its good partner; node 2 fails again
+        for i, c in enumerate(clients):
+            c.report_network_check(1, succeeded=i != 2, elapsed_time=1.0)
+        status = clients[0].get_network_check_status()
+        assert status.completed
+        assert status.abnormal_nodes == [2]
+
+    def test_no_good_partner_cannot_exonerate(self, master_factory):
+        """Both nodes of a broken pair fail round 1 too (no good partner to
+        bisect with) -> both abnormal; none escape via a solo probe."""
+        master = master_factory(min_nodes=2, max_nodes=2)
+        clients = self._join_all(master, 2)
+        for c in clients:
+            assert c.get_network_check_group(0).ready
+            c.report_network_check(0, succeeded=False, elapsed_time=1.0)
+        # round 1 re-pairs the two failures with each other
+        for i, c in enumerate(clients):
+            g = c.get_network_check_group(1)
+            assert g.ready and g.needed
+            assert set(g.world) == {0, 1}
+            c.report_network_check(1, succeeded=False, elapsed_time=1.0)
+        status = clients[0].get_network_check_status()
+        assert status.completed
+        assert status.abnormal_nodes == [0, 1]
+
+    def test_unpaired_bad_singleton_autofails(self, master_factory):
+        """3 bad nodes, 0 good: the leftover singleton is auto-failed by
+        the master instead of passing a collective-free solo probe."""
+        master = master_factory(min_nodes=3, max_nodes=3)
+        clients = self._join_all(master, 3)
+        for c in clients:
+            assert c.get_network_check_group(0).ready
+            c.report_network_check(0, succeeded=False, elapsed_time=1.0)
+        solo = 0
+        for i, c in enumerate(clients):
+            g = c.get_network_check_group(1)
+            assert g.ready
+            if not g.needed:
+                solo += 1
+                continue
+            c.report_network_check(1, succeeded=False, elapsed_time=1.0)
+        assert solo == 1
+        status = clients[0].get_network_check_status()
+        assert status.completed
+        assert status.abnormal_nodes == [0, 1, 2]
+
+    def test_recheck_generation_clears_stale_results(self, master_factory):
+        """A new network-check rendezvous round discards the previous
+        round's probe results even with identical node ids."""
+        master = master_factory(min_nodes=2, max_nodes=2)
+        clients = self._join_all(master, 2)
+        for c in clients:
+            c.get_network_check_group(0)
+            c.report_network_check(0, succeeded=True, elapsed_time=1.0)
+        assert clients[0].get_network_check_status().completed
+        # same nodes re-join (launcher restart): a fresh check must probe
+        for i, c in enumerate(clients):
+            c.join_rendezvous(
+                addr=f"127.0.0.1:{9700 + i}", local_devices=1,
+                rdzv_name="network-check",
+            )
+        for c in clients:
+            assert c.wait_comm_world(
+                rdzv_name="network-check", timeout=10
+            ).completed
+        assert clients[0].get_network_check_group(0).ready
+        assert not clients[0].get_network_check_status().completed
+
+    def test_straggler_uses_local_time_not_pair_wallclock(
+        self, master_factory
+    ):
+        """A slow node's healthy partner shares the pair's collective wall
+        clock but not its local compute time — only the slow node flags."""
+        master = master_factory(min_nodes=4, max_nodes=4)
+        clients = self._join_all(master, 4)
+        # pair (2,3): node 3's chip is slow, so BOTH report 10x wall clock,
+        # but only node 3's local time is slow
+        local = {0: 1.0, 1: 1.0, 2: 1.0, 3: 10.0}
+        wall = {0: 1.2, 1: 1.2, 2: 10.2, 3: 10.2}
+        for i, c in enumerate(clients):
+            c.get_network_check_group(0)
+            c.report_network_check(0, succeeded=True,
+                                   elapsed_time=wall[i],
+                                   local_time=local[i])
+        status = clients[0].get_network_check_status()
+        assert status.completed
+        assert status.straggler_nodes == [3]
+
+    def test_all_pass_single_round(self, master_factory):
+        master = master_factory(min_nodes=2, max_nodes=2)
+        clients = self._join_all(master, 2)
+        for c in clients:
+            assert c.get_network_check_group(0).ready
+            c.report_network_check(0, succeeded=True, elapsed_time=1.0)
+        g = clients[0].get_network_check_group(1)
+        assert g.ready and not g.needed
+        status = clients[0].get_network_check_status()
+        assert status.completed and status.abnormal_nodes == []
+
+    def test_straggler_detection(self, master_factory):
+        master = master_factory(min_nodes=4, max_nodes=4)
+        clients = self._join_all(master, 4)
+        for i, c in enumerate(clients):
+            c.get_network_check_group(0)
+            c.report_network_check(
+                0, succeeded=True, elapsed_time=100.0 if i == 1 else 1.0
+            )
+        status = clients[0].get_network_check_status()
+        assert status.completed
+        assert status.straggler_nodes == [1]
+
+
+class TestRelaunchHook:
+    def test_hardware_failure_triggers_relaunch_hook(self, master_factory):
+        from dlrover_tpu.common.constants import NodeEventType, NodeExitReason
+
+        master = master_factory(min_nodes=1, max_nodes=1)
+        relaunched = []
+        master.node_manager._relaunch_hook = relaunched.append
+        c0 = client(master, 0)
+        c0.join_rendezvous(addr="127.0.0.1:9600", local_devices=1)
+        c0.report_node_event(
+            NodeEventType.MODIFIED, "failed",
+            NodeExitReason.HARDWARE_ERROR, "exit code 211",
+        )
+        assert len(relaunched) == 1
+        assert relaunched[0].node_id == 0
+        assert relaunched[0].relaunch_count == 1
+        # fatal software errors never relaunch
+        c0.join_rendezvous(addr="127.0.0.1:9600", local_devices=1)  # revive
+        c0.report_node_event(
+            NodeEventType.MODIFIED, "failed",
+            NodeExitReason.FATAL_ERROR, "exit code 1",
+        )
+        assert len(relaunched) == 1
+
+
+class TestKvAndBarrier:
+    def test_kv_and_barrier(self, master_factory):
+        master = master_factory(min_nodes=1, max_nodes=1)
+        c0, c1 = client(master, 0), client(master, 1)
+        c0.kv_set("k", b"v")
+        assert c1.kv_get("k") == b"v"
+        done = []
+
+        def waiter():
+            done.append(c1.barrier("b", world_size=2, timeout=10))
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.2)
+        assert c0.barrier("b", world_size=2, timeout=10)
+        t.join(timeout=10)
+        assert done == [True]

@@ -154,6 +154,21 @@ def test_fsdp_sharded_ckpt_crash_recovers(tmp_path):
 
 
 @pytest.mark.timeout(300)
+def test_network_check_then_train(tmp_path):
+    """--network-check runs the probe rendezvous + payload before training."""
+    cmd, result_file = _cli_cmd(
+        tmp_path, ["--network-check"], ["--max-steps", "5"],
+    )
+    proc = subprocess.run(
+        cmd, env=_env(tmp_path), cwd=REPO, timeout=280,
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    result = json.load(open(result_file))
+    assert result["final_step"] == 5
+
+
+@pytest.mark.timeout(300)
 def test_restarts_exhausted_fails_job(tmp_path):
     cmd, result_file = _cli_cmd(
         tmp_path, ["--max-restarts", "1"],
@@ -165,3 +180,38 @@ def test_restarts_exhausted_fails_job(tmp_path):
     )
     assert proc.returncode == 1, proc.stdout[-2000:]
     assert not os.path.exists(result_file)
+
+
+@pytest.mark.timeout(300)
+def test_oom_exit_restarts_in_place(tmp_path):
+    """Exit code 210 (OOM contract) restarts and recovers like software."""
+    cmd, result_file = _cli_cmd(
+        tmp_path, ["--max-restarts", "2"],
+        ["--max-steps", "16", "--crash-at-step", "6", "--crash-exit", "210"],
+    )
+    proc = subprocess.run(
+        cmd, env=_env(tmp_path), cwd=REPO, timeout=280,
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    result = json.load(open(result_file))
+    assert result["final_step"] == 16
+    assert result["restart_count"] == 1
+
+
+@pytest.mark.timeout(300)
+def test_hardware_exit_escalates_to_node_relaunch(tmp_path):
+    """Exit code 211 -> agent exits with the node-relaunch code (3) after
+    persisting the snapshot, instead of restarting on the bad host."""
+    cmd, result_file = _cli_cmd(
+        tmp_path, ["--max-restarts", "3"],
+        ["--max-steps", "30", "--crash-at-step", "6", "--crash-exit", "211"],
+    )
+    proc = subprocess.run(
+        cmd, env=_env(tmp_path), cwd=REPO, timeout=280,
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 3, (proc.returncode, proc.stdout[-2000:])
+    assert not os.path.exists(result_file)
+    # the breakpoint snapshot was persisted for the replacement host
+    assert (tmp_path / "ckpt" / "latest").exists()

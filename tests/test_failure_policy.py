@@ -1,0 +1,56 @@
+"""Exit-code classification and failover decision tables.
+
+Reference analog: training.py:356-360 + dist_job_manager.py:561.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from dlrover_tpu.agent.failure_policy import (
+    EXIT_CODE_HARDWARE,
+    EXIT_CODE_OOM,
+    FailureAction,
+    classify_exit,
+    decide,
+)
+from dlrover_tpu.common.constants import NodeExitReason
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.common.constants import NodeType
+
+
+@pytest.mark.parametrize("code,reason", [
+    (0, NodeExitReason.SUCCEEDED),
+    (EXIT_CODE_OOM, NodeExitReason.OOM),
+    (EXIT_CODE_HARDWARE, NodeExitReason.HARDWARE_ERROR),
+    (-9, NodeExitReason.KILLED),
+    (137, NodeExitReason.KILLED),       # 128+9
+    (-15, NodeExitReason.PREEMPTED),
+    (143, NodeExitReason.PREEMPTED),    # 128+15
+    (1, NodeExitReason.UNKNOWN),
+    (17, NodeExitReason.UNKNOWN),
+])
+def test_classify(code, reason):
+    assert classify_exit(code) == reason
+
+
+@pytest.mark.parametrize("reason,restarts,max_r,action", [
+    (NodeExitReason.UNKNOWN, 0, 3, FailureAction.RESTART_PROCESS),
+    (NodeExitReason.OOM, 0, 3, FailureAction.RESTART_PROCESS),
+    (NodeExitReason.KILLED, 2, 3, FailureAction.RESTART_PROCESS),
+    (NodeExitReason.KILLED, 3, 3, FailureAction.GIVE_UP),
+    (NodeExitReason.HARDWARE_ERROR, 0, 3, FailureAction.RELAUNCH_NODE),
+    (NodeExitReason.HARDWARE_ERROR, 9, 3, FailureAction.RELAUNCH_NODE),
+    (NodeExitReason.FATAL_ERROR, 0, 3, FailureAction.GIVE_UP),
+])
+def test_decide(reason, restarts, max_r, action):
+    assert decide(reason, restarts, max_r) == action
+
+
+def test_node_should_relaunch_policy():
+    node = Node(node_type=NodeType.HOST, node_id=0, max_relaunch_count=2)
+    assert node.should_relaunch(NodeExitReason.HARDWARE_ERROR)
+    assert node.should_relaunch(NodeExitReason.OOM)
+    assert not node.should_relaunch(NodeExitReason.FATAL_ERROR)
+    node.relaunch_count = 2
+    assert not node.should_relaunch(NodeExitReason.HARDWARE_ERROR)
