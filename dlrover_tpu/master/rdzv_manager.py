@@ -198,7 +198,12 @@ class NetworkCheckRendezvousManager(RendezvousManager):
                 return []
             ids = sorted(self._latest.world, key=self._latest.world.get)
         if round_idx == 0 or not node_results:
-            return [ids[i:i + 2] for i in range(0, len(ids), 2)]
+            groups = [ids[i:i + 2] for i in range(0, len(ids), 2)]
+            if len(groups) >= 2 and len(groups[-1]) == 1:
+                # an odd node out must not probe solo — a solo probe has no
+                # collective and trivially passes; fold it into a triple
+                groups[-2].extend(groups.pop())
+            return groups
         good = [n for n in ids if node_results.get(n, False)]
         bad = [n for n in ids if not node_results.get(n, False)]
         groups: list[list[int]] = []

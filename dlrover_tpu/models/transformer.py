@@ -288,6 +288,29 @@ def forward(
     return logits.astype(jnp.float32)
 
 
+def make_loss_fn(cfg: TransformerConfig, strategy, mesh) -> Callable:
+    """Bind loss_fn to a strategy: activation constraints + attention impl.
+
+    Consumes ``strategy.extra["attention"] == "ring"`` (the long_context
+    preset) or ``cfg.attention == "ring"``: attention runs as ring
+    attention over the mesh's "sequence" axis (ops/ring_attention.py),
+    degrading to dense when the mesh has no sequence axis.
+    """
+    from dlrover_tpu.parallel.partition import constrain as _constrain
+
+    pin = partial(_constrain, rules=strategy.rule_table(), mesh=mesh)
+    attn: AttentionFn | None = None
+    wants_ring = (
+        getattr(strategy, "extra", {}).get("attention") == "ring"
+        or cfg.attention == "ring"
+    )
+    if wants_ring:
+        from dlrover_tpu.ops.ring_attention import make_ring_attention
+
+        attn = make_ring_attention(mesh)
+    return partial(loss_fn, cfg=cfg, attention_fn=attn, constrain=pin)
+
+
 def loss_fn(
     params: Params,
     batch: dict[str, jax.Array],

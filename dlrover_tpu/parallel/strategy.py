@@ -148,7 +148,8 @@ def fsdp(fsdp_size: int = -1, remat: str = "dots") -> Strategy:
     )
 
 
-def tp(tensor_size: int, data_size: int = -1, remat: str = "none") -> Strategy:
+def tp(tensor_size: int = 2, data_size: int = -1,
+       remat: str = "none") -> Strategy:
     """Megatron-style tensor parallel × data parallel."""
     return Strategy(
         name="tp",
@@ -158,7 +159,7 @@ def tp(tensor_size: int, data_size: int = -1, remat: str = "none") -> Strategy:
     )
 
 
-def fsdp_tp(tensor_size: int, fsdp_size: int = -1,
+def fsdp_tp(tensor_size: int = 2, fsdp_size: int = -1,
             remat: str = "dots") -> Strategy:
     """2D: FSDP across hosts × TP inside the fast ICI neighborhood."""
     return Strategy(
@@ -169,7 +170,7 @@ def fsdp_tp(tensor_size: int, fsdp_size: int = -1,
     )
 
 
-def long_context(sequence_size: int, data_size: int = -1,
+def long_context(sequence_size: int = 2, data_size: int = -1,
                  remat: str = "dots") -> Strategy:
     """Sequence/context parallel for long sequences (ring attention)."""
     return Strategy(
@@ -181,7 +182,7 @@ def long_context(sequence_size: int, data_size: int = -1,
     )
 
 
-def moe(expert_size: int, data_size: int = -1) -> Strategy:
+def moe(expert_size: int = 2, data_size: int = -1) -> Strategy:
     """Expert parallel: experts split over the expert axis."""
     return Strategy(
         name="moe",

@@ -19,7 +19,6 @@ import json
 import os
 import sys
 import time
-from functools import partial
 
 import numpy as np
 
@@ -87,7 +86,7 @@ def main(argv=None) -> int:
     compiled = compile_train(
         strategy=strategy,
         mesh=mesh,
-        loss_fn=partial(tfm.loss_fn, cfg=cfg),
+        loss_fn=tfm.make_loss_fn(cfg, strategy, mesh),
         init_params_fn=lambda rng: tfm.init_params(cfg, rng),
         logical_params=tfm.logical_axes(cfg),
         optimizer=optax.adamw(args.lr),

@@ -286,6 +286,20 @@ class TestNetworkCheckBisection:
         status = clients[0].get_network_check_status()
         assert status.completed and status.abnormal_nodes == []
 
+    def test_odd_world_round0_folds_singleton_into_triple(
+        self, master_factory
+    ):
+        """5 nodes: round-0 groups are [0,1],[2,3,4] — nobody probes solo
+        (a collective-free solo probe would trivially pass)."""
+        master = master_factory(min_nodes=5, max_nodes=5)
+        clients = self._join_all(master, 5)
+        sizes = []
+        for c in clients:
+            g = c.get_network_check_group(0)
+            assert g.ready and g.needed
+            sizes.append(len(g.world))
+        assert sorted(sizes) == [2, 2, 3, 3, 3]
+
     def test_straggler_detection(self, master_factory):
         master = master_factory(min_nodes=4, max_nodes=4)
         clients = self._join_all(master, 4)
