@@ -93,6 +93,7 @@ class ElasticAgent:
         self._stopped = threading.Event()
         self._local_devices = config.local_devices or _detect_local_devices()
         self._ckpt_saver = None  # wired by agent/ckpt_saver.py start()
+        self._resource_monitor = None
         self._world: dict[int, int] = {}
         self._node_rank = -1
         self._pending_action = ""
@@ -168,12 +169,15 @@ class ElasticAgent:
     def run(self) -> RunResult:
         self._start_heartbeat()
         self._start_ckpt_saver()
+        self._start_resource_monitor()
         try:
             if self._config.network_check:
                 self._run_network_check()
             return self._invoke_run()
         finally:
             self._stopped.set()
+            if self._resource_monitor is not None:
+                self._resource_monitor.stop()
             self._kill_child()
 
     def _invoke_run(self) -> RunResult:
@@ -312,6 +316,16 @@ class ElasticAgent:
         self._ckpt_saver = AsyncCheckpointSaver.start(
             node_id=self._config.node_id
         )
+
+    def _start_resource_monitor(self) -> None:
+        from dlrover_tpu.agent.resource_monitor import ResourceMonitor
+
+        self._resource_monitor = ResourceMonitor(
+            self._client,
+            interval_s=self._config.heartbeat_interval_s,
+            tpu_chips=self._local_devices,
+        )
+        self._resource_monitor.start()
 
     def _persist_checkpoint(self, reason: str) -> None:
         """Flush the latest in-memory snapshot to storage before a restart.

@@ -176,6 +176,9 @@ class MasterClient:
     def report_step(self, step: int) -> None:
         self._client.call(m.GlobalStepReport(node_id=self.node_id, step=step))
 
+    def get_job_stats(self) -> m.JobStatsResponse:
+        return self._client.call(m.JobStatsRequest(node_id=self.node_id))
+
     def get_running_nodes(self) -> list[m.NodeMeta]:
         return self._client.call(m.RunningNodesRequest()).nodes
 

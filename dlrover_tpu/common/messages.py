@@ -313,6 +313,31 @@ class NetworkCheckGroupResponse:
 
 @register_message
 @dataclasses.dataclass
+class JobStatsRequest:
+    node_id: int = 0
+
+
+@register_message
+@dataclasses.dataclass
+class NodeStatSample:
+    node_id: int = 0
+    cpu_percent: float = 0.0
+    used_memory_mb: int = 0
+    used_hbm_mb: int = 0
+    tpu_chips: int = 0
+
+
+@register_message
+@dataclasses.dataclass
+class JobStatsResponse:
+    uptime_s: float = 0.0
+    global_step: int = 0
+    steps_per_s: float = 0.0
+    nodes: list[NodeStatSample] = dataclasses.field(default_factory=list)
+
+
+@register_message
+@dataclasses.dataclass
 class NetworkCheckStatusRequest:
     node_id: int = 0
 

@@ -41,11 +41,14 @@ class JobMaster:
         hang_timeout_s: float = 1800.0,
         heartbeat_dead_window_s: float = Defaults.HEARTBEAT_DEAD_WINDOW_S,
     ):
+        from dlrover_tpu.master.stats import LocalStatsReporter
+
         self.job_name = job_name
         self.task_manager = TaskManager()
         self.speed_monitor = SpeedMonitor(hang_timeout_s=hang_timeout_s)
         self.kv_store = KVStoreService()
         self.diagnosis = DiagnosisManager()
+        self.stats_reporter = LocalStatsReporter()
         self.node_manager = NodeManager(
             dead_window_s=heartbeat_dead_window_s,
             on_node_dead=self._on_node_dead,
@@ -71,6 +74,7 @@ class JobMaster:
             speed_monitor=self.speed_monitor,
             kv_store=self.kv_store,
             diagnosis=self.diagnosis,
+            stats_reporter=self.stats_reporter,
         )
         self._server = RpcServer(self.servicer.handle, port=port)
 
@@ -86,6 +90,7 @@ class JobMaster:
         self.task_manager.recover_tasks_of_node(node_id)
         for mgr in self.rdzv_managers.values():
             mgr.remove_node(node_id)
+        self.stats_reporter.remove(node_id)
 
     def prepare(self) -> None:
         self._server.start()

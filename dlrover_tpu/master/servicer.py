@@ -32,13 +32,24 @@ class MasterServicer:
         speed_monitor: SpeedMonitor,
         kv_store: KVStoreService,
         diagnosis: DiagnosisManager,
+        stats_reporter=None,
+        metric_collector=None,
     ):
+        from dlrover_tpu.master.stats import (
+            JobMetricCollector,
+            LocalStatsReporter,
+        )
+
         self._node_manager = node_manager
         self._task_manager = task_manager
         self._rdzv_managers = rdzv_managers
         self._speed_monitor = speed_monitor
         self._kv_store = kv_store
         self._diagnosis = diagnosis
+        self._stats = stats_reporter or LocalStatsReporter()
+        self._metrics = metric_collector or JobMetricCollector(
+            self._stats, speed_monitor
+        )
         self._paral_config = m.ParalConfig()
         self._paral_lock = threading.Lock()
         self.job_exit_event = threading.Event()
@@ -83,12 +94,38 @@ class MasterServicer:
             )
             return m.OkResponse()
         if isinstance(msg, m.ResourceStats):
+            # partial-update semantics: the agent reports host cpu/mem, the
+            # trainer reports HBM; <= 0 means "not measured in this report"
             node = self._node_manager.ensure_node(msg.node_id)
-            node.resource.used_cpu = msg.cpu_percent
-            node.resource.used_memory_mb = msg.used_memory_mb
-            node.resource.tpu_chips = msg.tpu_chips
-            node.resource.used_hbm_mb = msg.used_hbm_mb
+            if msg.cpu_percent > 0:
+                node.resource.used_cpu = msg.cpu_percent
+            if msg.used_memory_mb > 0:
+                node.resource.used_memory_mb = msg.used_memory_mb
+            if msg.tpu_chips > 0:
+                node.resource.tpu_chips = msg.tpu_chips
+            if msg.used_hbm_mb > 0:
+                node.resource.used_hbm_mb = msg.used_hbm_mb
+            self._stats.record(
+                msg.node_id, cpu_percent=msg.cpu_percent,
+                used_memory_mb=msg.used_memory_mb,
+                used_hbm_mb=msg.used_hbm_mb, tpu_chips=msg.tpu_chips,
+            )
             return m.OkResponse()
+        if isinstance(msg, m.JobStatsRequest):
+            summary = self._metrics.summary()
+            return m.JobStatsResponse(
+                uptime_s=summary["uptime_s"],
+                global_step=summary["global_step"],
+                steps_per_s=summary["steps_per_s"],
+                nodes=[
+                    m.NodeStatSample(
+                        node_id=nid, cpu_percent=s.cpu_percent,
+                        used_memory_mb=s.used_memory_mb,
+                        used_hbm_mb=s.used_hbm_mb, tpu_chips=s.tpu_chips,
+                    )
+                    for nid, s in sorted(self._stats.latest().items())
+                ],
+            )
         if isinstance(msg, m.GlobalStepReport):
             self._speed_monitor.report_step(msg.step, msg.timestamp)
             return m.OkResponse()

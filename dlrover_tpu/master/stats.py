@@ -1,0 +1,127 @@
+"""Master-side runtime stats: per-node time series + job summary.
+
+Reference analog: dlrover/python/master/stats/reporter.py:99
+(LocalStatsReporter) and stats/job_collector.py:76 (JobMetricCollector).
+The Brain-backed reporter (MySQL, cross-job learning) maps to a pluggable
+reporter interface here; the local one keeps a bounded in-memory window,
+which is what the diagnosis/auto-scaler consumers need.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+
+
+@dataclasses.dataclass
+class ResourceSample:
+    timestamp: float
+    cpu_percent: float = 0.0
+    used_memory_mb: int = 0
+    used_hbm_mb: int = 0
+    tpu_chips: int = 0
+
+
+class LocalStatsReporter:
+    """Bounded per-node resource time series."""
+
+    def __init__(self, window: int = 240):
+        self._window = window
+        self._lock = threading.Lock()
+        self._series: dict[int, deque[ResourceSample]] = {}
+
+    def record(self, node_id: int, cpu_percent: float = 0.0,
+               used_memory_mb: int = 0, used_hbm_mb: int = 0,
+               tpu_chips: int = 0) -> None:
+        """Merge a partial report (fields <= 0 mean "not measured" — the
+        agent reports host stats, the trainer reports HBM)."""
+        with self._lock:
+            series = self._series.setdefault(
+                node_id, deque(maxlen=self._window)
+            )
+            prev = series[-1] if series else None
+            sample = ResourceSample(
+                timestamp=time.time(),
+                cpu_percent=(
+                    cpu_percent if cpu_percent > 0
+                    else (prev.cpu_percent if prev else 0.0)
+                ),
+                used_memory_mb=(
+                    used_memory_mb if used_memory_mb > 0
+                    else (prev.used_memory_mb if prev else 0)
+                ),
+                used_hbm_mb=(
+                    used_hbm_mb if used_hbm_mb > 0
+                    else (prev.used_hbm_mb if prev else 0)
+                ),
+                tpu_chips=(
+                    tpu_chips if tpu_chips > 0
+                    else (prev.tpu_chips if prev else 0)
+                ),
+            )
+            series.append(sample)
+
+    def remove(self, node_id: int) -> None:
+        """Evict a departed node so job totals and slow-node detection
+        never act on ghosts."""
+        with self._lock:
+            self._series.pop(node_id, None)
+
+    def latest(self) -> dict[int, ResourceSample]:
+        with self._lock:
+            return {
+                nid: s[-1] for nid, s in self._series.items() if s
+            }
+
+    def series(self, node_id: int) -> list[ResourceSample]:
+        with self._lock:
+            return list(self._series.get(node_id, ()))
+
+    def slow_nodes(self, ratio: float = 0.5, window: int = 8) -> list[int]:
+        """Nodes whose CPU usage over the last ``window`` samples is
+        anomalously low relative to the fleet (often a wedged/straggling
+        host): mean below ``ratio`` x median-of-means. Averaging filters
+        single idle samples (a node caught between steps)."""
+        import statistics
+
+        with self._lock:
+            means: dict[int, float] = {}
+            for nid, series in self._series.items():
+                vals = [
+                    s.cpu_percent for s in list(series)[-window:]
+                    if s.cpu_percent > 0
+                ]
+                if vals:
+                    means[nid] = statistics.fmean(vals)
+        if len(means) < 3:
+            return []
+        med = statistics.median(means.values())
+        if med <= 0:
+            return []
+        return sorted(
+            nid for nid, v in means.items() if v < ratio * med
+        )
+
+
+class JobMetricCollector:
+    """Job-level summary the operator/CLI can poll."""
+
+    def __init__(self, reporter: LocalStatsReporter, speed_monitor):
+        self._reporter = reporter
+        self._speed = speed_monitor
+        self._start = time.time()
+
+    def summary(self) -> dict:
+        latest = self._reporter.latest()
+        return {
+            "uptime_s": round(time.time() - self._start, 1),
+            "nodes": len(latest),
+            "steps_per_s": round(self._speed.running_speed(), 3),
+            "global_step": self._speed.global_step,
+            "used_hbm_mb": sum(s.used_hbm_mb for s in latest.values()),
+            "used_memory_mb": sum(
+                s.used_memory_mb for s in latest.values()
+            ),
+        }

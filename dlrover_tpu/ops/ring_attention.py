@@ -62,8 +62,11 @@ def _accumulate(carry, logits, v):
     corr = jnp.exp(m - m_new)
     p = jnp.exp(logits - m_new[..., None])
     l = l * corr + p.sum(axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
-    o = o * corr[..., None] + pv
+    # the flop-dominant PV matmul runs in the compute dtype (bf16 MXU
+    # rate); only the accumulators stay f32 — same split as
+    # dense_attention's fp32-softmax/bf16-matmul
+    pv = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v.dtype), v)
+    o = o * corr[..., None] + pv.astype(jnp.float32)
     return o, l, m_new
 
 

@@ -93,6 +93,18 @@ class ElasticTrainer:
         if self._client is not None and step % self._report_interval == 0:
             try:
                 self._client.report_step(step)
+                # HBM is only observable from the process that owns the
+                # chips: report it alongside the step (the agent's monitor
+                # covers host cpu/mem; the master merges partial reports)
+                from dlrover_tpu.agent.resource_monitor import (
+                    local_hbm_used_mb,
+                )
+
+                hbm = local_hbm_used_mb()
+                if hbm > 0:
+                    self._client.report_resource(
+                        cpu_percent=0.0, used_memory_mb=0, used_hbm_mb=hbm
+                    )
             except (ConnectionError, RuntimeError, OSError) as e:
                 # telemetry is best-effort: a master mid-failover answers
                 # with RpcError (surfaced as RuntimeError) — don't kill

@@ -338,6 +338,70 @@ class TestRelaunchHook:
         assert len(relaunched) == 1
 
 
+class TestStats:
+    def test_partial_reports_merge_and_job_stats(self, master_factory):
+        master = master_factory(min_nodes=1, max_nodes=1)
+        c0, c1 = client(master, 0), client(master, 1)
+        # agent-style host report, then trainer-style HBM report
+        c0.report_resource(cpu_percent=55.0, used_memory_mb=2048,
+                           tpu_chips=4)
+        c0.report_resource(cpu_percent=0.0, used_memory_mb=0,
+                           used_hbm_mb=9000)
+        c1.report_resource(cpu_percent=70.0, used_memory_mb=4096)
+        c0.report_step(42)
+
+        stats = c0.get_job_stats()
+        assert stats.global_step == 42
+        by_id = {s.node_id: s for s in stats.nodes}
+        assert by_id[0].cpu_percent == 55.0       # host report survived
+        assert by_id[0].used_memory_mb == 2048
+        assert by_id[0].used_hbm_mb == 9000       # merged from trainer
+        assert by_id[0].tpu_chips == 4
+        assert by_id[1].used_memory_mb == 4096
+        # node model merged too
+        nodes = {n.node_id: n for n in master.node_manager.all_nodes()}
+        assert nodes[0].resource.used_hbm_mb == 9000
+        assert nodes[0].resource.used_cpu == 55.0
+
+    def test_resource_monitor_reports(self, master_factory):
+        from dlrover_tpu.agent.resource_monitor import ResourceMonitor
+
+        master = master_factory(min_nodes=1, max_nodes=1)
+        c0 = client(master, 0)
+        mon = ResourceMonitor(c0, interval_s=0.2, tpu_chips=8)
+        mon.start()
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                latest = master.servicer._stats.latest()
+                if 0 in latest and latest[0].used_memory_mb > 0:
+                    break
+                time.sleep(0.1)
+            sample = master.servicer._stats.latest()[0]
+            assert sample.used_memory_mb > 0
+            assert sample.tpu_chips == 8
+        finally:
+            mon.stop()
+
+    def test_slow_node_detection(self, master_factory):
+        master = master_factory(min_nodes=1, max_nodes=1)
+        for _ in range(3):  # averaged over a window, not one sample
+            for nid, cpu in [(0, 90.0), (1, 85.0), (2, 88.0), (3, 10.0)]:
+                client(master, nid).report_resource(
+                    cpu_percent=cpu, used_memory_mb=100
+                )
+        assert master.servicer._stats.slow_nodes() == [3]
+
+    def test_dead_node_evicted_from_stats(self, master_factory):
+        master = master_factory(min_nodes=1, max_nodes=1)
+        client(master, 0).report_resource(cpu_percent=50.0,
+                                          used_memory_mb=100)
+        client(master, 1).report_resource(cpu_percent=50.0,
+                                          used_memory_mb=100)
+        master._on_node_dead(1)
+        assert set(master.servicer._stats.latest()) == {0}
+
+
 class TestKvAndBarrier:
     def test_kv_and_barrier(self, master_factory):
         master = master_factory(min_nodes=1, max_nodes=1)
