@@ -1,0 +1,1 @@
+from dlrover_tpu.embedding.kv_table import KvEmbeddingTable  # noqa: F401

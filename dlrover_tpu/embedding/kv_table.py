@@ -1,0 +1,225 @@
+"""Python binding for the native KvVariable embedding runtime.
+
+Reference analog: the KvVariable python layer
+(tfplus/tfplus/kv_variable/python/ops/kv_variable_ops.py + embedding_ops.py)
+over the C++ kernels (kv_variable/kernels/kv_variable.h:89,
+kernels/training_ops.cc). TPU-native shape: the unbounded id->row table
+lives host-side (XLA needs static shapes); ``lookup`` gathers the batch's
+rows into a dense [n, dim] block that ships to the device, and
+``apply_adam`` applies the sparse optimizer update host-side to exactly the
+touched rows (GroupAdam family: Adam + optional L2 + group-lasso row
+shrinkage, reference group_adam.py:272).
+
+The binding is ctypes over ``native/libdlrover_tpu_native.so`` (built by
+``make -C native``; auto-built on first import when the toolchain is
+available).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libdlrover_tpu_native.so")
+_lib = None
+_lib_lock = threading.Lock()
+
+_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+_u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+
+
+def _load_lib():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            logger.info("building native runtime in %s", _NATIVE_DIR)
+            proc = subprocess.run(
+                ["make", "-C", _NATIVE_DIR], capture_output=True, text=True
+            )
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"native build failed:\n{proc.stderr[-4000:]}"
+                )
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.kv_create.restype = ctypes.c_void_p
+        lib.kv_create.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_float,
+        ]
+        lib.kv_free.argtypes = [ctypes.c_void_p]
+        lib.kv_size.restype = ctypes.c_int64
+        lib.kv_size.argtypes = [ctypes.c_void_p]
+        lib.kv_lookup.argtypes = [
+            ctypes.c_void_p, _i64p, ctypes.c_int64, _f32p, ctypes.c_int,
+        ]
+        lib.kv_apply_adam.argtypes = [
+            ctypes.c_void_p, _i64p, _f32p, ctypes.c_int64,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_int64, ctypes.c_float, ctypes.c_float,
+        ]
+        lib.kv_export.restype = ctypes.c_int64
+        lib.kv_export.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int64,
+        ]
+        lib.kv_import.argtypes = [
+            ctypes.c_void_p, _i64p, _f32p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ]
+        lib.kv_remove.restype = ctypes.c_int64
+        lib.kv_remove.argtypes = [ctypes.c_void_p, _i64p, ctypes.c_int64]
+        _lib = lib
+        return lib
+
+
+class KvEmbeddingTable:
+    """Unbounded sparse-id embedding table with a sparse Adam optimizer.
+
+    ``num_slots=2`` reserves Adam's (m, v) per row; set 0 for a frozen /
+    SGD-updated table.
+    """
+
+    def __init__(self, dim: int, num_slots: int = 2, seed: int = 0,
+                 init_scale: float = 0.05):
+        self._lib = _load_lib()
+        self.dim = dim
+        self.num_slots = num_slots
+        self._handle = self._lib.kv_create(
+            dim, num_slots, seed, init_scale
+        )
+        self._step = 0
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.kv_free(handle)
+            self._handle = None
+
+    def __len__(self) -> int:
+        return int(self._lib.kv_size(self._handle))
+
+    # ------------------------------------------------------------------- ops
+
+    def lookup(self, ids: np.ndarray, init_missing: bool = True
+               ) -> np.ndarray:
+        """Gather rows for ``ids`` (any shape) -> [*ids.shape, dim] f32."""
+        flat = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        out = np.empty((flat.size, self.dim), np.float32)
+        self._lib.kv_lookup(
+            self._handle, flat, flat.size, out, int(init_missing)
+        )
+        return out.reshape(*np.shape(ids), self.dim)
+
+    def apply_adam(self, ids: np.ndarray, grads: np.ndarray,
+                   lr: float = 1e-3, beta1: float = 0.9,
+                   beta2: float = 0.999, eps: float = 1e-8,
+                   l2: float = 0.0, group_lasso: float = 0.0,
+                   step: int | None = None) -> None:
+        """Sparse (Group)Adam on the rows of ``ids`` with ``grads``.
+
+        Duplicate ids apply sequentially. ``group_lasso`` adds the
+        proximal row-shrinkage step of the reference's GroupAdam.
+        """
+        flat = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        g = np.ascontiguousarray(grads, np.float32).reshape(-1, self.dim)
+        if g.shape[0] != flat.size:
+            raise ValueError(
+                f"{flat.size} ids but {g.shape[0]} gradient rows"
+            )
+        if self.num_slots < 2:
+            raise ValueError("apply_adam needs num_slots >= 2 (m, v)")
+        if step is None:
+            self._step += 1
+            step = self._step
+        self._lib.kv_apply_adam(
+            self._handle, flat, g, flat.size,
+            lr, beta1, beta2, eps, step, l2, group_lasso,
+        )
+
+    def remove(self, ids: np.ndarray) -> int:
+        flat = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        return int(self._lib.kv_remove(self._handle, flat, flat.size))
+
+    # ------------------------------------------------------------ checkpoint
+
+    def export(self, min_freq: int = 0, with_slots: bool = True
+               ) -> dict[str, np.ndarray]:
+        """Snapshot rows with frequency >= ``min_freq`` (the reference's
+        under-threshold feature filtering)."""
+        n = int(self._lib.kv_export(self._handle, min_freq, None, None,
+                                    None, None, 0))
+        keys = np.empty(n, np.int64)
+        values = np.empty((n, self.dim), np.float32)
+        slots = np.empty((n, self.num_slots * self.dim), np.float32)
+        freq = np.empty(n, np.uint32)
+        written = 0
+        if n:
+            # the fill pass is capacity-bounded: the table may mutate
+            # between the count and fill calls (shard-level locking only)
+            written = int(self._lib.kv_export(
+                self._handle, min_freq,
+                keys.ctypes.data_as(ctypes.c_void_p),
+                values.ctypes.data_as(ctypes.c_void_p),
+                slots.ctypes.data_as(ctypes.c_void_p)
+                if with_slots and self.num_slots else None,
+                freq.ctypes.data_as(ctypes.c_void_p),
+                n,
+            ))
+        if written < n:
+            keys, values = keys[:written], values[:written]
+            slots, freq = slots[:written], freq[:written]
+        out = {
+            "keys": keys, "values": values, "freq": freq,
+            "step": np.asarray(self._step, np.int64),
+        }
+        if with_slots and self.num_slots:
+            out["slots"] = slots
+        return out
+
+    def import_(self, snapshot: dict[str, np.ndarray]) -> None:
+        keys = np.ascontiguousarray(snapshot["keys"], np.int64)
+        values = np.ascontiguousarray(snapshot["values"], np.float32)
+        slots = snapshot.get("slots")
+        freq = snapshot.get("freq")
+        if values.shape != (keys.size, self.dim):
+            raise ValueError(
+                f"snapshot values shape {values.shape} != "
+                f"({keys.size}, {self.dim}) — saved with a different dim?"
+            )
+        if slots is not None and np.shape(slots) != (
+            keys.size, self.num_slots * self.dim
+        ):
+            raise ValueError(
+                f"snapshot slots shape {np.shape(slots)} != "
+                f"({keys.size}, {self.num_slots * self.dim}) — saved with "
+                "different num_slots?"
+            )
+        if freq is not None and np.shape(freq) != (keys.size,):
+            raise ValueError(f"snapshot freq shape {np.shape(freq)}")
+        self._lib.kv_import(
+            self._handle, keys, values,
+            np.ascontiguousarray(slots, np.float32).ctypes.data_as(
+                ctypes.c_void_p
+            ) if slots is not None else None,
+            np.ascontiguousarray(freq, np.uint32).ctypes.data_as(
+                ctypes.c_void_p
+            ) if freq is not None else None,
+            keys.size,
+        )
+        if "step" in snapshot:
+            self._step = int(snapshot["step"])

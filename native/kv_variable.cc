@@ -1,0 +1,251 @@
+// Host-side hash-table embedding runtime (KvVariable analog).
+//
+// Reference analog: tfplus/tfplus/kv_variable/kernels/kv_variable.h:89
+// (concurrent hash-table embedding variable for unbounded sparse ids:
+// per-key rows + optimizer slots, frequency tracking, under-threshold
+// filtering on export, import/export for checkpoints) and the sparse
+// optimizer kernels in kernels/training_ops.cc (Adam/GroupAdam family).
+//
+// TPU-native role: XLA programs need static shapes, so the unbounded table
+// lives host-side in C++; the trainer gathers the batch's rows into a dense
+// [n, dim] buffer that goes to the device, and sparse optimizer updates
+// apply host-side to exactly the touched rows. Sharded locking gives
+// concurrent lookups from data-loading threads.
+//
+// Exposed as a C API consumed via ctypes (no pybind11 in the image).
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kNumShards = 16;  // power of two
+
+struct Row {
+  uint32_t chunk;
+  uint32_t offset;  // row index within the chunk
+  uint32_t freq;
+};
+
+struct Shard {
+  std::mutex mu;
+  std::unordered_map<int64_t, Row> index;
+  // chunked arena: each chunk holds kChunkRows rows of width row_width
+  std::vector<std::unique_ptr<float[]>> chunks;
+  uint32_t next_offset = 0;  // next free row in the last chunk
+};
+
+struct KvTable {
+  int dim = 0;        // embedding width
+  int num_slots = 0;  // optimizer slot vectors per row (Adam: 2)
+  int row_width = 0;  // dim * (1 + num_slots)
+  uint64_t seed = 0;
+  float init_scale = 0.05f;
+  Shard shards[kNumShards];
+  std::atomic<int64_t> size{0};
+
+  static constexpr uint32_t kChunkRows = 4096;
+
+  Shard& shard_for(int64_t key) {
+    // splitmix64 finalizer: avoids shard hotspots for sequential ids
+    uint64_t x = static_cast<uint64_t>(key) + 0x9e3779b97f4a7c15ULL;
+    x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+    x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+    x ^= x >> 31;
+    return shards[x & (kNumShards - 1)];
+  }
+
+  // caller holds the shard lock
+  float* row_ptr(Shard& s, const Row& r) {
+    return s.chunks[r.chunk].get() + static_cast<size_t>(r.offset) * row_width;
+  }
+
+  // caller holds the shard lock; initializes embedding part, zeroes slots
+  Row& insert(Shard& s, int64_t key) {
+    if (s.chunks.empty() || s.next_offset == kChunkRows) {
+      s.chunks.emplace_back(new float[static_cast<size_t>(kChunkRows) * row_width]);
+      s.next_offset = 0;
+    }
+    Row r{static_cast<uint32_t>(s.chunks.size() - 1), s.next_offset++, 0};
+    float* p = row_ptr(s, r);
+    // deterministic per-key init: uniform(-scale, scale) from key+seed
+    std::mt19937_64 gen(seed ^ static_cast<uint64_t>(key));
+    std::uniform_real_distribution<float> dist(-init_scale, init_scale);
+    for (int i = 0; i < dim; ++i) p[i] = dist(gen);
+    std::memset(p + dim, 0, sizeof(float) * dim * num_slots);
+    auto it = s.index.emplace(key, r).first;
+    size.fetch_add(1, std::memory_order_relaxed);
+    return it->second;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kv_create(int dim, int num_slots, uint64_t seed, float init_scale) {
+  auto* t = new KvTable();
+  t->dim = dim;
+  t->num_slots = num_slots;
+  t->row_width = dim * (1 + num_slots);
+  t->seed = seed;
+  t->init_scale = init_scale;
+  return t;
+}
+
+void kv_free(void* handle) { delete static_cast<KvTable*>(handle); }
+
+int64_t kv_size(void* handle) {
+  return static_cast<KvTable*>(handle)->size.load(std::memory_order_relaxed);
+}
+
+// Gather rows for keys[n] into out[n*dim]. Missing keys are inserted
+// (init_missing=1) or zero-filled (0). Bumps frequency on hit/insert.
+void kv_lookup(void* handle, const int64_t* keys, int64_t n, float* out,
+               int init_missing) {
+  auto* t = static_cast<KvTable*>(handle);
+  for (int64_t i = 0; i < n; ++i) {
+    Shard& s = t->shard_for(keys[i]);
+    std::lock_guard<std::mutex> lock(s.mu);
+    auto it = s.index.find(keys[i]);
+    if (it == s.index.end()) {
+      if (!init_missing) {
+        std::memset(out + i * t->dim, 0, sizeof(float) * t->dim);
+        continue;
+      }
+      Row& r = t->insert(s, keys[i]);
+      r.freq = 1;
+      std::memcpy(out + i * t->dim, t->row_ptr(s, r), sizeof(float) * t->dim);
+      continue;
+    }
+    it->second.freq++;
+    std::memcpy(out + i * t->dim, t->row_ptr(s, it->second),
+                sizeof(float) * t->dim);
+  }
+}
+
+// Sparse Adam with optional group-lasso shrinkage (GroupAdam,
+// reference: kv_variable/python/training/group_adam.py:272).
+// Duplicate keys in one batch are applied sequentially (gradient order).
+// Requires num_slots >= 2 (m, v). step is the 1-based global step for
+// bias correction.
+void kv_apply_adam(void* handle, const int64_t* keys, const float* grads,
+                   int64_t n, float lr, float beta1, float beta2, float eps,
+                   int64_t step, float l2, float group_lasso) {
+  auto* t = static_cast<KvTable*>(handle);
+  const int dim = t->dim;
+  const float bc1 = 1.0f - std::pow(beta1, static_cast<float>(step));
+  const float bc2 = 1.0f - std::pow(beta2, static_cast<float>(step));
+  for (int64_t i = 0; i < n; ++i) {
+    Shard& s = t->shard_for(keys[i]);
+    std::lock_guard<std::mutex> lock(s.mu);
+    auto it = s.index.find(keys[i]);
+    Row* r = it != s.index.end() ? &it->second : &t->insert(s, keys[i]);
+    float* w = t->row_ptr(s, *r);
+    float* m = w + dim;
+    float* v = w + 2 * dim;
+    const float* g = grads + i * dim;
+    for (int d = 0; d < dim; ++d) {
+      float gd = g[d] + l2 * w[d];
+      m[d] = beta1 * m[d] + (1.0f - beta1) * gd;
+      v[d] = beta2 * v[d] + (1.0f - beta2) * gd * gd;
+      float mhat = m[d] / bc1;
+      float vhat = v[d] / bc2;
+      w[d] -= lr * mhat / (std::sqrt(vhat) + eps);
+    }
+    if (group_lasso > 0.0f) {
+      // proximal group-lasso step on the whole row: shrink its norm,
+      // zeroing rows whose norm falls below lr*lambda (feature pruning)
+      float norm = 0.0f;
+      for (int d = 0; d < dim; ++d) norm += w[d] * w[d];
+      norm = std::sqrt(norm);
+      float thresh = lr * group_lasso;
+      if (norm <= thresh) {
+        std::memset(w, 0, sizeof(float) * dim);
+      } else {
+        float scale = 1.0f - thresh / norm;
+        for (int d = 0; d < dim; ++d) w[d] *= scale;
+      }
+    }
+  }
+}
+
+// Export keys with freq >= min_freq. Two-phase: call with keys_out=null to
+// get the count, then with buffers sized [capacity] / [capacity*dim] /
+// [capacity*dim*num_slots] (slots_out may be null) / [capacity]. The fill
+// pass never writes more than ``capacity`` rows and returns the number
+// actually written — the table may have grown between the two calls
+// (concurrent lookups hold only shard locks).
+int64_t kv_export(void* handle, uint32_t min_freq, int64_t* keys_out,
+                  float* values_out, float* slots_out, uint32_t* freq_out,
+                  int64_t capacity) {
+  auto* t = static_cast<KvTable*>(handle);
+  const int dim = t->dim;
+  const int slot_width = dim * t->num_slots;
+  int64_t count = 0;
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> lock(s.mu);
+    for (auto& [key, row] : s.index) {
+      if (row.freq < min_freq) continue;
+      if (keys_out != nullptr) {
+        if (count >= capacity) return count;
+        float* p = t->row_ptr(s, row);
+        keys_out[count] = key;
+        std::memcpy(values_out + count * dim, p, sizeof(float) * dim);
+        if (slots_out != nullptr && slot_width > 0) {
+          std::memcpy(slots_out + count * slot_width, p + dim,
+                      sizeof(float) * slot_width);
+        }
+        if (freq_out != nullptr) freq_out[count] = row.freq;
+      }
+      ++count;
+    }
+  }
+  return count;
+}
+
+// Import n rows (checkpoint restore). slots/freq may be null (zeroed).
+void kv_import(void* handle, const int64_t* keys, const float* values,
+               const float* slots, const uint32_t* freq, int64_t n) {
+  auto* t = static_cast<KvTable*>(handle);
+  const int dim = t->dim;
+  const int slot_width = dim * t->num_slots;
+  for (int64_t i = 0; i < n; ++i) {
+    Shard& s = t->shard_for(keys[i]);
+    std::lock_guard<std::mutex> lock(s.mu);
+    auto it = s.index.find(keys[i]);
+    Row* r = it != s.index.end() ? &it->second : &t->insert(s, keys[i]);
+    float* p = t->row_ptr(s, *r);
+    std::memcpy(p, values + i * dim, sizeof(float) * dim);
+    if (slots != nullptr && slot_width > 0) {
+      std::memcpy(p + dim, slots + i * slot_width, sizeof(float) * slot_width);
+    } else {
+      std::memset(p + dim, 0, sizeof(float) * slot_width);
+    }
+    r->freq = freq != nullptr ? freq[i] : 1;
+  }
+}
+
+// Remove keys[n]; rows are tombstoned (arena space not reclaimed — the
+// reference behaves the same until a full export/import compaction).
+int64_t kv_remove(void* handle, const int64_t* keys, int64_t n) {
+  auto* t = static_cast<KvTable*>(handle);
+  int64_t removed = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    Shard& s = t->shard_for(keys[i]);
+    std::lock_guard<std::mutex> lock(s.mu);
+    removed += static_cast<int64_t>(s.index.erase(keys[i]));
+  }
+  t->size.fetch_sub(removed, std::memory_order_relaxed);
+  return removed;
+}
+
+}  // extern "C"
