@@ -242,19 +242,39 @@ class ShardedCheckpointEngine(CheckpointEngine):
         ``template`` supplies structure/shape/dtype (concrete arrays or
         ``jax.eval_shape`` structs); ``shardings`` is a matching tree of
         target ``Sharding``s. shm fast path first (restart-in-place, same
-        mesh); falls back to storage — which has every node's pieces — when
-        the local snapshot can't cover the new layout.
+        mesh) — only when every process's snapshot is at the SAME step
+        (nodes killed mid-step may be one snapshot apart; mixing steps
+        would silently blend divergent shards) — else the committed
+        storage step, which the tracker guarantees is shard-complete.
         """
         snap = self._shm_pieces()
-        if snap is not None:
+        # every process joins the step-agreement collective (a process
+        # with nothing local reports -1), or the others deadlock in it
+        use_shm = self._shm_step_consistent(snap[0] if snap else -1)
+        built = None
+        if use_shm:
             step, registry = snap
             try:
-                return step, self._build(template, shardings, registry)
+                built = self._build(template, shardings, registry)
             except CoverageError:
                 logger.info(
                     "local shm pieces don't cover the target shardings "
                     "(mesh changed); assembling from storage"
                 )
+            # the shm-vs-storage choice must be collective: if ANY
+            # process's local pieces can't cover its new shards, all
+            # processes fall back to the committed storage step together
+            # — half restoring step N from shm and half step M from
+            # storage is silent divergence
+            if not self._all_processes_agree(built is not None):
+                built = None
+            if built is not None:
+                return step, built
+        elif snap is not None:
+            logger.info(
+                "shm snapshot steps disagree across nodes; restoring the "
+                "committed storage step instead"
+            )
         from dlrover_tpu.agent.ckpt_saver import read_tracker
 
         committed = read_tracker(self.storage, self.ckpt_dir)
@@ -265,6 +285,33 @@ class ShardedCheckpointEngine(CheckpointEngine):
         if registry is None:
             return None
         return step, self._build(template, shardings, registry)
+
+    @staticmethod
+    def _shm_step_consistent(step: int) -> bool:
+        """All processes hold a snapshot of the same step (>= 0)."""
+        import jax
+
+        if jax.process_count() == 1:
+            return step >= 0
+        from jax.experimental import multihost_utils
+
+        steps = multihost_utils.process_allgather(
+            np.asarray(step, np.int64)
+        )
+        return bool((steps >= 0).all() and (steps == steps[0]).all())
+
+    @staticmethod
+    def _all_processes_agree(ok: bool) -> bool:
+        import jax
+
+        if jax.process_count() == 1:
+            return ok
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray(1 if ok else 0, np.int64)
+        )
+        return bool(flags.all())
 
     def _build(self, template: Any, shardings: Any,
                registry: dict[str, list[PieceSource]]) -> Any:

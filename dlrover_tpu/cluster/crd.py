@@ -1,0 +1,178 @@
+"""ElasticJob / ScalePlan custom-resource types.
+
+Reference analog: the Go CRD types
+(dlrover/go/operator/api/v1alpha1/elasticjob_types.go:29-86 ElasticJobSpec —
+distributionStrategy, optimizeMode, replicaSpecs with autoScale/priority/
+restartCount — and scaleplan_types.go:129 ScalePlanSpec). TPU differences:
+a replica is a HOST of a TPU slice (one agent + one JAX process owning all
+local chips), and resources name chip type/topology (v5p-8 etc.) instead of
+GPU counts. The types serialize to/from k8s-style manifests so a controller
+(cluster/operator.py) can reconcile them with any client.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+GROUP = "elastic.dlrover-tpu.org"
+VERSION = "v1alpha1"
+
+
+class OptimizeMode(str, enum.Enum):
+    MANUAL = "manual"
+    SINGLE_JOB = "single-job"
+    CLUSTER = "cluster"
+
+
+@dataclasses.dataclass
+class ReplicaSpec:
+    """One replica group (TPU hosts of a slice)."""
+
+    replicas: int = 1
+    min_replicas: int = 0       # 0 -> replicas (fixed size)
+    max_replicas: int = 0
+    auto_scale: bool = False
+    priority: str = ""
+    restart_count: int = 3
+    tpu_type: str = ""          # e.g. "v5p"
+    tpu_topology: str = ""      # e.g. "2x2x1"
+    tpu_chips_per_host: int = 4
+    cpu: float = 0.0
+    memory_mb: int = 0
+    image: str = ""
+    command: list[str] = dataclasses.field(default_factory=list)
+
+    def bounds(self) -> tuple[int, int]:
+        lo = self.min_replicas or self.replicas
+        hi = self.max_replicas or self.replicas
+        return lo, hi
+
+
+@dataclasses.dataclass
+class ElasticJobSpec:
+    distribution_strategy: str = "allreduce"
+    optimize_mode: OptimizeMode = OptimizeMode.SINGLE_JOB
+    enable_dynamic_sharding: bool = True
+    enable_elastic_scheduling: bool = True
+    master_cpu: float = 2.0
+    master_memory_mb: int = 4096
+    master_image: str = ""
+    replica_specs: dict[str, ReplicaSpec] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+@dataclasses.dataclass
+class ElasticJob:
+    name: str
+    namespace: str = "default"
+    spec: ElasticJobSpec = dataclasses.field(default_factory=ElasticJobSpec)
+    phase: str = "Pending"   # Pending/Running/Succeeded/Failed (status)
+
+    def to_manifest(self) -> dict:
+        return {
+            "apiVersion": f"{GROUP}/{VERSION}",
+            "kind": "ElasticJob",
+            "metadata": {"name": self.name, "namespace": self.namespace},
+            "spec": {
+                "distributionStrategy": self.spec.distribution_strategy,
+                "optimizeMode": self.spec.optimize_mode.value,
+                "enableDynamicSharding": self.spec.enable_dynamic_sharding,
+                "enableElasticScheduling":
+                    self.spec.enable_elastic_scheduling,
+                "masterResource": {
+                    "cpu": self.spec.master_cpu,
+                    "memoryMb": self.spec.master_memory_mb,
+                    "image": self.spec.master_image,
+                },
+                "replicaSpecs": {
+                    name: {
+                        "replicas": r.replicas,
+                        "minReplicas": r.min_replicas,
+                        "maxReplicas": r.max_replicas,
+                        "autoScale": r.auto_scale,
+                        "priority": r.priority,
+                        "restartCount": r.restart_count,
+                        "tpuType": r.tpu_type,
+                        "tpuTopology": r.tpu_topology,
+                        "tpuChipsPerHost": r.tpu_chips_per_host,
+                        "cpu": r.cpu,
+                        "memoryMb": r.memory_mb,
+                        "image": r.image,
+                        "command": list(r.command),
+                    }
+                    for name, r in self.spec.replica_specs.items()
+                },
+            },
+            "status": {"phase": self.phase},
+        }
+
+    @classmethod
+    def from_manifest(cls, manifest: dict) -> "ElasticJob":
+        meta = manifest.get("metadata", {})
+        spec = manifest.get("spec", {})
+        master = spec.get("masterResource", {})
+        replicas = {
+            name: ReplicaSpec(
+                replicas=r.get("replicas", 1),
+                min_replicas=r.get("minReplicas", 0),
+                max_replicas=r.get("maxReplicas", 0),
+                auto_scale=r.get("autoScale", False),
+                priority=r.get("priority", ""),
+                restart_count=r.get("restartCount", 3),
+                tpu_type=r.get("tpuType", ""),
+                tpu_topology=r.get("tpuTopology", ""),
+                tpu_chips_per_host=r.get("tpuChipsPerHost", 4),
+                cpu=r.get("cpu", 0.0),
+                memory_mb=r.get("memoryMb", 0),
+                image=r.get("image", ""),
+                command=list(r.get("command", [])),
+            )
+            for name, r in spec.get("replicaSpecs", {}).items()
+        }
+        return cls(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            spec=ElasticJobSpec(
+                distribution_strategy=spec.get(
+                    "distributionStrategy", "allreduce"
+                ),
+                optimize_mode=OptimizeMode(
+                    spec.get("optimizeMode", "single-job")
+                ),
+                enable_dynamic_sharding=spec.get(
+                    "enableDynamicSharding", True
+                ),
+                enable_elastic_scheduling=spec.get(
+                    "enableElasticScheduling", True
+                ),
+                master_cpu=master.get("cpu", 2.0),
+                master_memory_mb=master.get("memoryMb", 4096),
+                master_image=master.get("image", ""),
+                replica_specs=replicas,
+            ),
+            phase=manifest.get("status", {}).get("phase", "Pending"),
+        )
+
+
+@dataclasses.dataclass
+class ScalePlan:
+    """A desired-state delta the scaler executes.
+
+    Reference: ScalePlanSpec (scaleplan_types.go:129) — replica resizes
+    plus individual node migrations/removals.
+    """
+
+    job_name: str = ""
+    replica_resources: dict[str, int] = dataclasses.field(
+        default_factory=dict
+    )  # replica group -> target count
+    memory_mb: dict[str, int] = dataclasses.field(default_factory=dict)
+    remove_nodes: list[int] = dataclasses.field(default_factory=list)
+    relaunch_nodes: list[int] = dataclasses.field(default_factory=list)
+    reason: str = ""
+
+    def is_empty(self) -> bool:
+        return not (self.replica_resources or self.memory_mb
+                    or self.remove_nodes or self.relaunch_nodes)

@@ -1,0 +1,152 @@
+"""ElasticJob operator: reconcile jobs -> master pods -> worker scaling.
+
+Reference analog: the Go controller
+(dlrover/go/operator/pkg/controllers/elasticjob_controller.go:85
+ElasticJobReconciler.Reconcile — create the job-master pod, track phase —
+and scaleplan_controller.go:79 applying ScalePlans). Implemented over the
+same injected KubeClient interface the scalers use, so the control loop is
+testable with a fake client and portable to any k8s SDK.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from dlrover_tpu.cluster.crd import ElasticJob, ScalePlan
+from dlrover_tpu.cluster.scaler import (
+    KubeClient,
+    PodScaler,
+    master_pod_manifest,
+    master_service_manifest,
+)
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+MASTER_PORT = 5001
+
+
+class ElasticJobOperator:
+    """One reconciler instance per cluster (or namespace)."""
+
+    def __init__(self, client: KubeClient, interval_s: float = 5.0):
+        self._client = client
+        self._interval_s = interval_s
+        self._jobs: dict[str, ElasticJob] = {}
+        # one scaler per (job, replica group)
+        self._scalers: dict[tuple[str, str], PodScaler] = {}
+        self._lock = threading.Lock()
+        self._stopped = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ job intake
+
+    def apply_job(self, job: ElasticJob) -> None:
+        """Submit/update an ElasticJob (the CR-watch analog)."""
+        with self._lock:
+            self._jobs[job.name] = job
+        self.reconcile(job.name)
+
+    def delete_job(self, name: str) -> None:
+        with self._lock:
+            job = self._jobs.pop(name, None)
+            for key in [k for k in self._scalers if k[0] == name]:
+                self._scalers.pop(key)
+        if job is None:
+            return
+        for pod in self._client.list_pods(job.namespace, f"job={name}"):
+            self._client.delete_pod(
+                job.namespace, pod["metadata"]["name"]
+            )
+        self._client.delete_service(job.namespace, f"{name}-master")
+
+    def apply_scale_plan(self, plan: ScalePlan) -> None:
+        """The ScalePlan-CR reconcile path."""
+        with self._lock:
+            scalers = {
+                group: s for (jname, group), s in self._scalers.items()
+                if jname == plan.job_name
+            }
+        if not scalers:
+            logger.warning("scale plan for unknown job %s", plan.job_name)
+            return
+        for group, scaler in scalers.items():
+            sub = ScalePlan(
+                job_name=plan.job_name,
+                replica_resources=(
+                    {group: plan.replica_resources[group]}
+                    if group in plan.replica_resources else {}
+                ),
+                memory_mb=dict(plan.memory_mb),
+                remove_nodes=list(plan.remove_nodes),
+                relaunch_nodes=list(plan.relaunch_nodes),
+                reason=plan.reason,
+            )
+            if not sub.is_empty():
+                scaler.scale(sub)
+
+    # ------------------------------------------------------------- reconcile
+
+    def reconcile(self, name: str) -> None:
+        with self._lock:
+            job = self._jobs.get(name)
+        if job is None:
+            return
+        master_name = f"{name}-master"
+        pods = {
+            p["metadata"]["name"]: p
+            for p in self._client.list_pods(job.namespace, f"job={name}")
+        }
+        if master_name not in pods:
+            logger.info("creating master pod + service for job %s", name)
+            self._client.create_service(
+                job.namespace, master_service_manifest(job, MASTER_PORT)
+            )
+            self._client.create_pod(
+                job.namespace, master_pod_manifest(job, MASTER_PORT)
+            )
+            job.phase = "Pending"
+        # the headless Service's DNS name (pod names are not resolvable)
+        master_addr = f"{master_name}.{job.namespace}.svc:{MASTER_PORT}"
+        for group, spec in job.spec.replica_specs.items():
+            with self._lock:
+                scaler = self._scalers.get((name, group))
+                if scaler is None:
+                    scaler = PodScaler(
+                        job, self._client, master_addr, group=group
+                    )
+                    self._scalers[(name, group)] = scaler
+                else:
+                    # a resubmitted spec must reach the scaler, or new and
+                    # relaunched pods keep the old image/resources
+                    scaler.update_job(job)
+            scaler.scale(ScalePlan(
+                job_name=name,
+                replica_resources={group: spec.replicas},
+                reason="reconcile",
+            ))
+        if master_name in pods:
+            phase = pods[master_name].get("status", {}).get("phase")
+            if phase in ("Succeeded", "Failed"):
+                job.phase = phase
+            elif phase == "Running":
+                job.phase = "Running"
+
+    def _loop(self) -> None:
+        while not self._stopped.wait(self._interval_s):
+            with self._lock:
+                names = list(self._jobs)
+            for name in names:
+                try:
+                    self.reconcile(name)
+                except Exception:  # noqa: BLE001 - reconcile must not die
+                    logger.exception("reconcile of %s failed", name)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="elasticjob-operator", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()

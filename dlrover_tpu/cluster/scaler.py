@@ -1,0 +1,325 @@
+"""Scalers: execute ScalePlans against a platform.
+
+Reference analog: dlrover/python/master/scaler/pod_scaler.py:77 (PodScaler:
+scale :174, _create_pod :410 builds V1Pod + env contract) and
+elasticjob_scaler.py (emit ScalePlan CRs for the operator). The k8s client
+is an injected interface (the reference's tests mock the same singleton,
+SURVEY.md §4 mock_k8s_client) so everything here is testable without a
+cluster; LocalProcessScaler scales real agent subprocesses on this host and
+doubles as the master's node-relaunch hook in standalone runs.
+"""
+
+from __future__ import annotations
+
+import abc
+import subprocess
+import sys
+import threading
+
+from dlrover_tpu.cluster.crd import ElasticJob, ScalePlan
+from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+class Scaler(abc.ABC):
+    @abc.abstractmethod
+    def scale(self, plan: ScalePlan) -> None:
+        """Drive the platform toward the plan's desired state."""
+
+
+class KubeClient(abc.ABC):
+    """The few verbs the operator/scalers need; implement over any SDK."""
+
+    @abc.abstractmethod
+    def create_pod(self, namespace: str, manifest: dict) -> None: ...
+
+    @abc.abstractmethod
+    def delete_pod(self, namespace: str, name: str) -> None: ...
+
+    @abc.abstractmethod
+    def list_pods(self, namespace: str, label_selector: str) -> list[dict]:
+        ...
+
+    def create_service(self, namespace: str, manifest: dict) -> None:
+        """Optional: masters are exposed via a Service (pod names alone
+        have no DNS entry)."""
+
+    def delete_service(self, namespace: str, name: str) -> None:
+        """Optional counterpart of create_service."""
+
+
+def worker_pod_manifest(job: ElasticJob, group: str, node_id: int,
+                        master_addr: str,
+                        memory_mb_override: int = 0) -> dict:
+    """One TPU-host worker pod with the agent env contract.
+
+    Reference: _create_pod pod_scaler.py:410 (+ TF_CONFIG injection :520 —
+    here the contract is the EnvKey set the agent/trainer read).
+    ``memory_mb_override`` carries the resource optimizer's OOM->2x bump
+    for this specific node.
+    """
+    spec = job.spec.replica_specs[group]
+    env = [
+        {"name": EnvKey.JOB_NAME, "value": job.name},
+        {"name": EnvKey.MASTER_ADDR, "value": master_addr},
+        {"name": EnvKey.NODE_ID, "value": str(node_id)},
+    ]
+    resources: dict = {}
+    if spec.cpu:
+        resources.setdefault("requests", {})["cpu"] = str(spec.cpu)
+    memory_mb = memory_mb_override or spec.memory_mb
+    if memory_mb:
+        resources.setdefault("requests", {})["memory"] = f"{memory_mb}Mi"
+    if spec.tpu_type:
+        # TPU slices schedule via google.com/tpu + topology selectors
+        resources.setdefault("limits", {})["google.com/tpu"] = str(
+            spec.tpu_chips_per_host
+        )
+    manifest = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": f"{job.name}-{group}-{node_id}",
+            "namespace": job.namespace,
+            "labels": {
+                "app": "dlrover-tpu",
+                "job": job.name,
+                "group": group,
+                "node-id": str(node_id),
+            },
+        },
+        "spec": {
+            "restartPolicy": "Never",
+            "containers": [
+                {
+                    "name": "agent",
+                    "image": spec.image or "dlrover-tpu:latest",
+                    "command": list(spec.command)
+                    or [sys.executable, "-m", "dlrover_tpu.run"],
+                    "env": env,
+                    "resources": resources,
+                }
+            ],
+        },
+    }
+    if spec.tpu_type:
+        manifest["spec"]["nodeSelector"] = {
+            "cloud.google.com/gke-tpu-accelerator": spec.tpu_type,
+            "cloud.google.com/gke-tpu-topology": spec.tpu_topology,
+        }
+    if spec.priority:
+        manifest["spec"]["priorityClassName"] = spec.priority
+    return manifest
+
+
+def master_service_manifest(job: ElasticJob, port: int = 5001) -> dict:
+    """Headless Service giving the master pod a resolvable DNS name
+    (``<job>-master.<ns>.svc``); bare pod names have no DNS entry.
+    Reference: the operator creates a master Service the same way
+    (dist_master.py:55)."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": f"{job.name}-master",
+            "namespace": job.namespace,
+            "labels": {"app": "dlrover-tpu", "job": job.name},
+        },
+        "spec": {
+            "clusterIP": "None",
+            "selector": {"job": job.name, "role": "master"},
+            "ports": [{"port": port, "targetPort": port}],
+        },
+    }
+
+
+def master_pod_manifest(job: ElasticJob, port: int = 5001) -> dict:
+    """The job-master pod the operator creates per ElasticJob.
+
+    Reference: master pod factory go/operator/pkg/controllers/master/
+    master.go.
+    """
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": f"{job.name}-master",
+            "namespace": job.namespace,
+            "labels": {"app": "dlrover-tpu", "job": job.name,
+                       "role": "master"},
+        },
+        "spec": {
+            "restartPolicy": "OnFailure",
+            "containers": [
+                {
+                    "name": "master",
+                    "image": job.spec.master_image or "dlrover-tpu:latest",
+                    "command": [
+                        sys.executable, "-m",
+                        "dlrover_tpu.master.job_master",
+                        "--job-name", job.name, "--port", str(port),
+                    ],
+                    "resources": {
+                        "requests": {
+                            "cpu": str(job.spec.master_cpu),
+                            "memory": f"{job.spec.master_memory_mb}Mi",
+                        }
+                    },
+                }
+            ],
+        },
+    }
+
+
+class PodScaler(Scaler):
+    """Reconcile worker pods toward a ScalePlan via the KubeClient."""
+
+    def __init__(self, job: ElasticJob, client: KubeClient,
+                 master_addr: str, group: str = "worker"):
+        self._job = job
+        self._client = client
+        self._master_addr = master_addr
+        self._group = group
+        self._lock = threading.Lock()
+        self._next_node_id = 0
+        # per-node memory bumps from OOM-recovery plans; survive relaunches
+        self._memory_mb: dict[int, int] = {}
+
+    def update_job(self, job: ElasticJob) -> None:
+        """Adopt a resubmitted job spec (new image/resources/command)."""
+        with self._lock:
+            self._job = job
+
+    def _manifest(self, node_id: int) -> dict:
+        return worker_pod_manifest(
+            self._job, self._group, node_id, self._master_addr,
+            memory_mb_override=self._memory_mb.get(node_id, 0),
+        )
+
+    def _live_pods(self) -> dict[int, dict]:
+        pods = self._client.list_pods(
+            self._job.namespace,
+            f"job={self._job.name},group={self._group}",
+        )
+        out = {}
+        for p in pods:
+            labels = p.get("metadata", {}).get("labels", {})
+            if "node-id" in labels:
+                out[int(labels["node-id"])] = p
+        return out
+
+    def scale(self, plan: ScalePlan) -> None:
+        with self._lock:
+            for nid_str, mb in plan.memory_mb.items():
+                self._memory_mb[int(nid_str)] = int(mb)
+            live = self._live_pods()
+            if live:
+                self._next_node_id = max(
+                    self._next_node_id, max(live) + 1
+                )
+            for nid in plan.remove_nodes:
+                if nid in live:
+                    self._client.delete_pod(
+                        self._job.namespace,
+                        live[nid]["metadata"]["name"],
+                    )
+                    live.pop(nid)
+            for nid in plan.relaunch_nodes:
+                if nid in live:
+                    self._client.delete_pod(
+                        self._job.namespace,
+                        live[nid]["metadata"]["name"],
+                    )
+                manifest = self._manifest(nid)
+                self._client.create_pod(self._job.namespace, manifest)
+                live[nid] = manifest
+            target = plan.replica_resources.get(self._group)
+            if target is None:
+                return
+            while len(live) > target:
+                nid = max(live)
+                self._client.delete_pod(
+                    self._job.namespace, live.pop(nid)["metadata"]["name"]
+                )
+            while len(live) < target:
+                nid = self._next_node_id
+                self._next_node_id += 1
+                manifest = self._manifest(nid)
+                self._client.create_pod(self._job.namespace, manifest)
+                live[nid] = manifest
+            logger.info(
+                "scaled %s/%s to %d workers (%s)", self._job.name,
+                self._group, len(live), plan.reason or "plan",
+            )
+
+
+class LocalProcessScaler(Scaler):
+    """Scale agent subprocesses on this host (standalone / tests).
+
+    Doubles as the master's node-relaunch hook: the relaunched "pod" is a
+    fresh launcher process for the same node id.
+    """
+
+    def __init__(self, master_addr: str, entrypoint: list[str],
+                 extra_cli: list[str] | None = None):
+        self._master_addr = master_addr
+        self._entrypoint = entrypoint
+        self._extra_cli = list(extra_cli or [])
+        self._lock = threading.Lock()
+        self._procs: dict[int, subprocess.Popen] = {}
+        self._next_node_id = 0
+
+    def _spawn(self, node_id: int) -> subprocess.Popen:
+        cmd = [
+            sys.executable, "-m", "dlrover_tpu.run",
+            "--master-addr", self._master_addr,
+            "--node-id", str(node_id),
+            *self._extra_cli,
+            *self._entrypoint,
+        ]
+        logger.info("spawning local worker %d", node_id)
+        return subprocess.Popen(cmd, start_new_session=True)
+
+    def scale(self, plan: ScalePlan) -> None:
+        with self._lock:
+            self._reap()
+            for nid in plan.remove_nodes:
+                self._kill(nid)
+            for nid in plan.relaunch_nodes:
+                self._kill(nid)
+                self._procs[nid] = self._spawn(nid)
+            target = plan.replica_resources.get("worker")
+            if target is None:
+                return
+            while len(self._procs) > target:
+                self._kill(max(self._procs))
+            while len(self._procs) < target:
+                nid = self._next_node_id
+                self._next_node_id += 1
+                self._procs[nid] = self._spawn(nid)
+
+    def relaunch_node(self, node) -> None:
+        """Master relaunch-hook adapter (node_manager.relaunch_hook)."""
+        self.scale(ScalePlan(relaunch_nodes=[node.node_id],
+                             reason="node relaunch"))
+
+    def _reap(self) -> None:
+        for nid in [n for n, p in self._procs.items()
+                    if p.poll() is not None]:
+            self._procs.pop(nid)
+
+    def _kill(self, node_id: int) -> None:
+        proc = self._procs.pop(node_id, None)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    def stop_all(self) -> None:
+        with self._lock:
+            for nid in list(self._procs):
+                self._kill(nid)

@@ -46,15 +46,16 @@ def _load_lib():
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH):
-            logger.info("building native runtime in %s", _NATIVE_DIR)
-            proc = subprocess.run(
-                ["make", "-C", _NATIVE_DIR], capture_output=True, text=True
+        # run make unconditionally: it's a no-op when the .so is current,
+        # and an edited kv_variable.cc must never load stale. Tolerate a
+        # missing toolchain when a prebuilt .so exists.
+        proc = subprocess.run(
+            ["make", "-C", _NATIVE_DIR], capture_output=True, text=True
+        )
+        if proc.returncode != 0 and not os.path.exists(_LIB_PATH):
+            raise RuntimeError(
+                f"native build failed:\n{proc.stderr[-4000:]}"
             )
-            if proc.returncode != 0:
-                raise RuntimeError(
-                    f"native build failed:\n{proc.stderr[-4000:]}"
-                )
         lib = ctypes.CDLL(_LIB_PATH)
         lib.kv_create.restype = ctypes.c_void_p
         lib.kv_create.argtypes = [

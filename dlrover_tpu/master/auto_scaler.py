@@ -1,0 +1,59 @@
+"""Job auto-scaler: periodic re-planning + plan execution.
+
+Reference analog: dlrover/python/master/node/job_auto_scaler.py:73
+(JobAutoScaler / AllreduceTrainingAutoScaler:254 — a timer loop asking the
+resource optimizer for a plan and handing it to the scaler; failure events
+trigger immediate replanning).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from dlrover_tpu.cluster.crd import ScalePlan
+from dlrover_tpu.cluster.scaler import Scaler
+from dlrover_tpu.common.constants import NodeExitReason
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.master.resource_optimizer import LocalResourceOptimizer
+
+logger = get_logger(__name__)
+
+
+class JobAutoScaler:
+    def __init__(self, optimizer: LocalResourceOptimizer, scaler: Scaler,
+                 node_manager, interval_s: float = 30.0):
+        self._optimizer = optimizer
+        self._scaler = scaler
+        self._node_manager = node_manager
+        self._interval_s = interval_s
+        self._stopped = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self, initial_scale: bool = True) -> None:
+        if initial_scale:
+            self.execute(self._optimizer.initial_plan())
+        self._thread = threading.Thread(
+            target=self._loop, name="auto-scaler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def _loop(self) -> None:
+        while not self._stopped.wait(self._interval_s):
+            try:
+                current = len(self._node_manager.running_nodes())
+                self.execute(self._optimizer.speed_plan(current))
+            except Exception:  # noqa: BLE001 - planning must not die
+                logger.exception("auto-scale tick failed")
+
+    def on_node_failure(self, node_id: int, reason: NodeExitReason) -> None:
+        """Immediate replan on a failure event (OOM -> 2x, etc.)."""
+        self.execute(self._optimizer.plan_for_failure(node_id, reason))
+
+    def execute(self, plan: ScalePlan) -> None:
+        if plan.is_empty():
+            return
+        logger.info("executing scale plan: %s", plan)
+        self._scaler.scale(plan)

@@ -1,0 +1,101 @@
+"""Local resource optimizer: heuristic ScalePlans from runtime stats.
+
+Reference analog: dlrover/python/master/resource/local_optimizer.py:66
+(PSLocalOptimizer: per-JobOptStage plans; generate_oom_recovery_plan :99 is
+the famous OOM -> 2x memory rule) and the Brain's optalgorithm family.
+TPU-specific reality: HBM per chip is fixed, so the OOM response for
+*device* memory is a bigger slice or a smaller per-step footprint (the
+paral-config channel suggests higher grad accumulation); host-memory OOM
+keeps the reference's 2x rule.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from dlrover_tpu.cluster.crd import ScalePlan
+from dlrover_tpu.common.constants import NodeExitReason
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class OptimizerConfig:
+    min_workers: int = 1
+    max_workers: int = 1
+    target_steps_per_s: float = 0.0   # 0 -> no speed-based scaling
+    scale_up_factor: float = 1.5
+    host_memory_mb: int = 0           # configured request per host
+
+
+class LocalResourceOptimizer:
+    """Produces ScalePlans; the auto-scaler executes them."""
+
+    def __init__(self, config: OptimizerConfig, stats_reporter,
+                 speed_monitor):
+        self._config = config
+        self._stats = stats_reporter
+        self._speed = speed_monitor
+        self._memory_mb: dict[int, int] = {}
+
+    def initial_plan(self) -> ScalePlan:
+        return ScalePlan(
+            replica_resources={"worker": self._config.max_workers},
+            reason="initial",
+        )
+
+    def oom_recovery_plan(self, node_id: int) -> ScalePlan:
+        """Host OOM -> 2x the node's memory request (reference
+        local_optimizer.py:99). Device (HBM) OOM is handled by the
+        paral-config tuner instead — HBM per chip is fixed."""
+        current = self._memory_mb.get(
+            node_id, self._config.host_memory_mb or 0
+        )
+        latest = self._stats.latest().get(node_id)
+        if latest is not None:
+            current = max(current, latest.used_memory_mb)
+        doubled = max(2 * current, 1024)
+        self._memory_mb[node_id] = doubled
+        logger.info("OOM on node %d: memory -> %dMB", node_id, doubled)
+        return ScalePlan(
+            memory_mb={str(node_id): doubled},
+            relaunch_nodes=[node_id],
+            reason="oom-recovery",
+        )
+
+    def speed_plan(self, current_workers: int) -> ScalePlan:
+        """Scale workers toward the target throughput, within bounds."""
+        target = self._config.target_steps_per_s
+        if target <= 0 or current_workers <= 0:
+            return ScalePlan()
+        speed = self._speed.running_speed()
+        if speed <= 0:
+            return ScalePlan()
+        if speed < target:
+            desired = min(
+                self._config.max_workers,
+                max(
+                    current_workers + 1,
+                    int(current_workers * self._config.scale_up_factor),
+                ),
+            )
+        else:
+            desired = current_workers
+        if desired == current_workers:
+            return ScalePlan()
+        return ScalePlan(
+            replica_resources={"worker": desired},
+            reason=f"speed {speed:.2f}/s < target {target:.2f}/s",
+        )
+
+    def plan_for_failure(self, node_id: int,
+                         reason: NodeExitReason) -> ScalePlan:
+        if reason == NodeExitReason.OOM:
+            return self.oom_recovery_plan(node_id)
+        if reason in (NodeExitReason.HARDWARE_ERROR,
+                      NodeExitReason.PREEMPTED,
+                      NodeExitReason.KILLED):
+            return ScalePlan(relaunch_nodes=[node_id],
+                             reason=reason.value)
+        return ScalePlan()

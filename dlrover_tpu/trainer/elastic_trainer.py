@@ -68,9 +68,20 @@ class ElasticTrainer:
             )
         self.accum = global_batch_size // (micro_batch_size * dp)
         self.global_batch_size = global_batch_size
-        # per-step batch dim fed to the compiled step (sharded over dp)
+        # per-step GLOBAL batch dim of the compiled step (sharded over dp)
         self.step_batch_size = micro_batch_size * dp
-        self.assembler = BatchAssembler(self.accum, self.step_batch_size)
+        # multi-process SPMD: each node assembles only the rows its own
+        # devices consume; jax assembles the global array from per-process
+        # shards (make_array_from_process_local_data). The master's data
+        # sharding already hands each node distinct samples.
+        self.num_processes = jax.process_count()
+        if self.step_batch_size % self.num_processes:
+            raise ValueError(
+                f"per-step batch {self.step_batch_size} not divisible by "
+                f"{self.num_processes} processes"
+            )
+        self.local_step_batch = self.step_batch_size // self.num_processes
+        self.assembler = BatchAssembler(self.accum, self.local_step_batch)
         self._report_interval = report_step_interval
         self._host_step = 0  # avoids blocking on the device step counter
         self._client = master_client
@@ -85,7 +96,18 @@ class ElasticTrainer:
 
     def train_step(self, state: TrainState, batch: dict
                    ) -> tuple[TrainState, dict]:
-        batch = jax.device_put(batch, self.compiled.batch_sharding)
+        if self.num_processes > 1:
+            sharding = self.compiled.batch_sharding
+            batch = jax.tree.map(
+                lambda x: jax.make_array_from_process_local_data(
+                    sharding, np.ascontiguousarray(x),
+                    (x.shape[0], x.shape[1] * self.num_processes)
+                    + x.shape[2:],
+                ),
+                batch,
+            )
+        else:
+            batch = jax.device_put(batch, self.compiled.batch_sharding)
         state, metrics = self.compiled.step(state, batch)
         # host-side counter: reading state.step would block async dispatch
         self._host_step += 1

@@ -93,7 +93,9 @@ def main(argv=None) -> int:
     )
     state = compiled.init(jax.random.PRNGKey(0))
 
-    if args.sharded_ckpt:
+    # multi-node state is sharded across processes: only the sharded
+    # engine can snapshot it (each node persists its addressable pieces)
+    if args.sharded_ckpt or ctx.num_nodes > 1:
         from dlrover_tpu.checkpoint.sharded import ShardedCheckpointEngine
 
         engine = ShardedCheckpointEngine(

@@ -149,6 +149,9 @@ void kv_apply_adam(void* handle, const int64_t* keys, const float* grads,
     std::lock_guard<std::mutex> lock(s.mu);
     auto it = s.index.find(keys[i]);
     Row* r = it != s.index.end() ? &it->second : &t->insert(s, keys[i]);
+    // a row that receives updates is live: export's frequency filtering
+    // must never drop trained weights just because no lookup preceded
+    if (r->freq == 0) r->freq = 1;
     float* w = t->row_ptr(s, *r);
     float* m = w + dim;
     float* v = w + 2 * dim;

@@ -1,0 +1,153 @@
+"""Multi-node elastic training on localhost: 2 masters-worth of reality.
+
+Two launcher processes (agents), one master, one jax.distributed world over
+CPU+Gloo — training genuinely sharded across processes. The kill test is
+the reference's headline scenario (SURVEY.md §5.3 elastic recovery): kill
+one node's trainer mid-run, both agents re-rendezvous, training resumes
+from a consistent checkpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLE = os.path.join(REPO, "examples", "train_transformer.py")
+
+
+def _env(tmp_path) -> dict:
+    env = dict(os.environ)
+    env.update(
+        {
+            "DLROVER_TPU_PLATFORM": "cpu",
+            "DLROVER_TPU_DEVICE_COUNT": "4",
+            "DLROVER_TPU_IPC_DIR": str(tmp_path / "ipc"),
+            "PYTHONPATH": REPO,
+            # 4 virtual devices per process -> 8 global over 2 nodes
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        }
+    )
+    return env
+
+
+def _start_master(tmp_path, env) -> tuple[subprocess.Popen, str]:
+    port_file = str(tmp_path / "master_port")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dlrover_tpu.master.job_master",
+         "--min-nodes", "2", "--max-nodes", "2",
+         "--port-file", port_file],
+        env=env, cwd=REPO, start_new_session=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if os.path.exists(port_file) and open(port_file).read().strip():
+            return proc, f"127.0.0.1:{open(port_file).read().strip()}"
+        time.sleep(0.1)
+    proc.kill()
+    raise TimeoutError("master did not start")
+
+
+def _launcher(tmp_path, env, node_id: int, train_args: list[str]
+              ) -> subprocess.Popen:
+    cmd = [
+        sys.executable, "-m", "dlrover_tpu.run",
+        "--master-addr", open(str(tmp_path / "master_addr")).read(),
+        "--node-id", str(node_id), "--nnodes", "2",
+        "--monitor-interval", "0.3", "--max-restarts", "2",
+        EXAMPLE, "--",
+        "--model", "tiny", "--seq", "128",
+        "--global-batch", "8",
+        "--ckpt-dir", str(tmp_path / "ckpt"),
+        "--result-file", str(tmp_path / f"result_{node_id}.json"),
+        "--log-interval", "5",
+        *train_args,
+    ]
+    return subprocess.Popen(
+        cmd, env=env, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def _run_two_nodes(tmp_path, train_args, kill_after_ckpt=False,
+                   timeout=420):
+    env = _env(tmp_path)
+    master, addr = _start_master(tmp_path, env)
+    (tmp_path / "master_addr").write_text(addr)
+    launchers = [
+        _launcher(tmp_path, env, nid, train_args) for nid in (0, 1)
+    ]
+    killed = False
+    try:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if all(p.poll() is not None for p in launchers):
+                break
+            if kill_after_ckpt and not killed \
+                    and (tmp_path / "ckpt" / "latest").exists():
+                out = subprocess.run(
+                    ["pgrep", "-f", f"^{sys.executable} {EXAMPLE}"],
+                    capture_output=True, text=True,
+                )
+                pids = [int(p) for p in out.stdout.split()]
+                if pids:
+                    os.kill(pids[-1], signal.SIGKILL)
+                    killed = True
+            time.sleep(0.5)
+        outs = []
+        for p in launchers:
+            try:
+                out, _ = p.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+            outs.append(out)
+        return launchers, outs, killed
+    finally:
+        for p in launchers:
+            if p.poll() is None:
+                p.kill()
+        if master.poll() is None:
+            try:
+                os.killpg(master.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        subprocess.run(["pkill", "-9", "-f", EXAMPLE],
+                       capture_output=True)
+
+
+@pytest.mark.timeout(500)
+def test_two_node_training_completes(tmp_path):
+    launchers, outs, _ = _run_two_nodes(
+        tmp_path, ["--max-steps", "12"],
+    )
+    for p, out in zip(launchers, outs):
+        assert p.returncode == 0, out[-3000:]
+    result = json.load(open(tmp_path / "result_0.json"))
+    assert result["final_step"] == 12
+    assert result["num_nodes"] == 2
+    assert not os.path.exists(tmp_path / "result_1.json")  # rank 1 silent
+
+
+@pytest.mark.timeout(500)
+def test_two_node_kill_one_trainer_recovers(tmp_path):
+    launchers, outs, killed = _run_two_nodes(
+        tmp_path, ["--max-steps", "30", "--ckpt-interval", "5"],
+        kill_after_ckpt=True,
+    )
+    assert killed, "never saw a checkpoint to kill after"
+    for p, out in zip(launchers, outs):
+        assert p.returncode == 0, out[-4000:]
+    result = json.load(open(tmp_path / "result_0.json"))
+    assert result["final_step"] == 30
+    assert result["num_nodes"] == 2
+    assert result["resumed_from"] > 0
+    joint = "\n".join(outs)
+    assert "resumed from step" in joint
