@@ -27,16 +27,7 @@ from functools import partial
 
 import numpy as np
 
-# bf16 peak FLOP/s per chip by device kind (public spec sheets).
-PEAK_FLOPS = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
+from dlrover_tpu.utils.profiler import PEAK_FLOPS, compiled_flops
 
 CKPT_SAVE_BASELINE_S = 0.5  # reference FCP blocking bar (BASELINE.md)
 
@@ -95,9 +86,13 @@ def bench_train_step(extra: dict) -> None:
 
     n_params = cfg.param_count
     tokens_per_step = batch * seq
-    # PaLM-style accounting: 6N per token + attention 12*L*S*d per token
+    # PaLM-style accounting: 6N per token + attention 12*L*S*d per token.
+    # MFU uses this model-FLOPs number (excludes remat recompute); the
+    # compiled count from XLA's cost analysis rides along for hardware
+    # utilization.
     flops_per_token = 6 * n_params + 12 * cfg.n_layers * seq * cfg.d_model
     flops_per_step = flops_per_token * tokens_per_step
+    xla_flops = compiled_flops(compiled.step, state, step_batch)
     peak = PEAK_FLOPS.get(dev.device_kind)
     extra.update(
         model=model,
@@ -110,6 +105,9 @@ def bench_train_step(extra: dict) -> None:
         tokens_per_s=round(tokens_per_step / step_s),
         tflops_per_s=round(flops_per_step / step_s / 1e12, 1),
         mfu=round(flops_per_step / step_s / peak, 4) if peak else None,
+        xla_flops_per_step=xla_flops,
+        hw_util=round(xla_flops / step_s / peak, 4)
+        if peak and xla_flops else None,
         loss=round(loss, 4),
     )
 

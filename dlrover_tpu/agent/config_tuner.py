@@ -1,0 +1,113 @@
+"""Agent-side paral-config tuner: master suggestions -> JSON file.
+
+Reference analog: dlrover/python/elastic_agent/config/paral_config_tuner.py
+(:31 ParalConfigTuner — a thread syncing the master's ParallelConfig to a
+JSON file named by an env var; the trainer's ElasticDataLoader hot-reloads
+it). TPU nuance: batch-geometry knobs (grad accumulation, micro batch)
+bake into the compiled program — those are applied at the next trainer
+incarnation — while dataloader knobs (prefetch depth) hot-apply.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+
+from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+def default_config_path(node_id: int) -> str:
+    base = os.environ.get("DLROVER_TPU_IPC_DIR") or "/tmp"
+    job = os.environ.get(EnvKey.JOB_NAME, "local")
+    return os.path.join(base, f"paral_config_{job}_{node_id}.json")
+
+
+class ParalConfigTuner:
+    """Polls the master for config suggestions; mirrors them to a file."""
+
+    def __init__(self, client, path: str = "", interval_s: float = 10.0,
+                 on_update=None):
+        self._client = client
+        self.path = path or default_config_path(client.node_id)
+        self._interval_s = interval_s
+        self._on_update = on_update  # called with the config dict
+        self._stopped = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._version = -1
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="paral-config-tuner", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def poll_once(self) -> bool:
+        """Fetch and mirror; True when a new version was written."""
+        config = self._client.get_paral_config()
+        if config.version == self._version:
+            return False
+        first_sync = self._version == -1
+        self._version = config.version
+        data = dataclasses.asdict(config)
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, self.path)
+        logger.info("paral config v%d written to %s", config.version,
+                    self.path)
+        # the startup sync mirrors whatever the master already has; only
+        # CHANGES observed while running fire the callback — a freshly
+        # spawned worker reads the file anyway, and restarting it for a
+        # config it already applied would loop forever (restart_required
+        # stays set on the master's latest version)
+        if self._on_update is not None and not first_sync:
+            self._on_update(data)
+        return True
+
+    def _loop(self) -> None:
+        while not self._stopped.wait(self._interval_s):
+            try:
+                self.poll_once()
+            except (ConnectionError, RuntimeError, OSError) as e:
+                logger.warning("paral config poll failed: %s", e)
+
+
+class ParalConfigReader:
+    """Trainer-side hot reload of the tuner's file (mtime-based)."""
+
+    def __init__(self, path: str = ""):
+        # no explicit path and no agent-provided env: stay inert — reading
+        # another job's leftover file would apply the wrong batch geometry
+        self.path = path or os.environ.get(EnvKey.PARAL_CONFIG_PATH, "")
+        self._mtime = 0.0
+        self._config: dict = {}
+
+    def current(self) -> dict:
+        """Latest config dict ({} before any suggestion arrives)."""
+        if not self.path:
+            return self._config
+        try:
+            mtime = os.path.getmtime(self.path)
+        except OSError:
+            return self._config
+        if mtime != self._mtime:
+            try:
+                with open(self.path) as f:
+                    self._config = json.load(f)
+                self._mtime = mtime
+                logger.info("reloaded paral config v%s",
+                            self._config.get("version"))
+            except (OSError, json.JSONDecodeError):
+                logger.warning("paral config reload failed")
+        return self._config
+
+    def get(self, key: str, default=None):
+        return self.current().get(key, default)

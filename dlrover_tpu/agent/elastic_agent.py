@@ -89,11 +89,15 @@ class ElasticAgent:
             config.master_addr, config.node_id
         )
         self._proc: subprocess.Popen | None = None
+        # failure restarts (consume the failover budget) vs the incarnation
+        # counter (any respawn — failures, membership changes, config)
         self._restart_count = 0
+        self._incarnation = 0
         self._stopped = threading.Event()
         self._local_devices = config.local_devices or _detect_local_devices()
         self._ckpt_saver = None  # wired by agent/ckpt_saver.py start()
         self._resource_monitor = None
+        self._config_tuner = None
         self._world: dict[int, int] = {}
         self._node_rank = -1
         self._pending_action = ""
@@ -140,12 +144,15 @@ class ElasticAgent:
                 EnvKey.NODE_RANK: str(rank),
                 EnvKey.NODE_NUM: str(num_nodes),
                 EnvKey.COORDINATOR: coordinator,
-                EnvKey.RESTART_COUNT: str(self._restart_count),
+                EnvKey.RESTART_COUNT: str(self._incarnation),
             }
         )
+        if self._config_tuner is not None:
+            env[EnvKey.PARAL_CONFIG_PATH] = self._config_tuner.path
         logger.info(
-            "spawning training process (restart %d): %s",
-            self._restart_count, " ".join(self._config.entrypoint),
+            "spawning training process (incarnation %d, failures %d): %s",
+            self._incarnation, self._restart_count,
+            " ".join(self._config.entrypoint),
         )
         return subprocess.Popen(
             self._config.entrypoint, env=env, start_new_session=True
@@ -170,6 +177,7 @@ class ElasticAgent:
         self._start_heartbeat()
         self._start_ckpt_saver()
         self._start_resource_monitor()
+        self._start_config_tuner()
         try:
             if self._config.network_check:
                 self._run_network_check()
@@ -178,6 +186,8 @@ class ElasticAgent:
             self._stopped.set()
             if self._resource_monitor is not None:
                 self._resource_monitor.stop()
+            if self._config_tuner is not None:
+                self._config_tuner.stop()
             self._kill_child()
 
     def _invoke_run(self) -> RunResult:
@@ -255,16 +265,21 @@ class ElasticAgent:
         self._persist_checkpoint(reason="process failure")
         self._recover_shards()
         self._restart_count += 1
+        self._incarnation += 1
         rank, num_nodes, coordinator = self._rendezvous()
         self._proc = self._spawn(rank, num_nodes, coordinator)
         return None
 
     def _restart_workers(self, reason: str) -> None:
+        """Planned restart (membership change / config update): bumps the
+        incarnation but does NOT consume the failover budget — only
+        failures do (reference: _remaining_failovers decrements on failure
+        only, training.py:594)."""
         logger.info("restarting workers: %s", reason)
         self._persist_checkpoint(reason=reason)
         self._kill_child()
         self._recover_shards()
-        self._restart_count += 1
+        self._incarnation += 1
         rank, num_nodes, coordinator = self._rendezvous()
         self._proc = self._spawn(rank, num_nodes, coordinator)
 
@@ -326,6 +341,21 @@ class ElasticAgent:
             tpu_chips=self._local_devices,
         )
         self._resource_monitor.start()
+
+    def _start_config_tuner(self) -> None:
+        from dlrover_tpu.agent.config_tuner import ParalConfigTuner
+
+        def on_update(config: dict) -> None:
+            if config.get("restart_required") and self._proc is not None \
+                    and self._proc.poll() is None:
+                # recompile-class knobs apply at the next incarnation
+                with self._action_lock:
+                    self._pending_action = "restart"
+
+        self._config_tuner = ParalConfigTuner(
+            self._client, on_update=on_update
+        )
+        self._config_tuner.start()
 
     def _persist_checkpoint(self, reason: str) -> None:
         """Flush the latest in-memory snapshot to storage before a restart.

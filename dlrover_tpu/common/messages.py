@@ -231,6 +231,9 @@ class ShardTask:
     # Explicit record indices for globally-shuffled text datasets
     # (TextDatasetSplitter); empty means "use range(start, end)".
     record_indices: list[int] = dataclasses.field(default_factory=list)
+    # invalid task + finished=True: the dataset is exhausted for good —
+    # clients stop polling instead of waiting out the fail-back window
+    finished: bool = False
 
     def indices(self) -> list[int]:
         return self.record_indices or list(range(self.start, self.end))
@@ -394,6 +397,10 @@ class ParalConfig:
     dataloader_batch_size: int = 0
     dataloader_version: int = 0
     grad_accum_steps: int = 0
+    prefetch_batches: int = 0
+    # knobs that require a recompile take effect at the next incarnation;
+    # this flag asks the agent to restart workers to apply them
+    restart_required: bool = False
     version: int = 0
 
 

@@ -10,6 +10,7 @@ its own pod and agents connect over the network.
 from __future__ import annotations
 
 import argparse
+import os
 import time
 
 from dlrover_tpu.common.constants import Defaults, NodeStatus
@@ -40,6 +41,7 @@ class JobMaster:
         node_unit: int = 1,
         hang_timeout_s: float = 1800.0,
         heartbeat_dead_window_s: float = Defaults.HEARTBEAT_DEAD_WINDOW_S,
+        state_dir: str = "",
     ):
         from dlrover_tpu.master.stats import LocalStatsReporter
 
@@ -77,6 +79,19 @@ class JobMaster:
             stats_reporter=self.stats_reporter,
         )
         self._server = RpcServer(self.servicer.handle, port=port)
+        self.state_manager = None
+        if state_dir:
+            from dlrover_tpu.master.state_store import (
+                FileStateBackend,
+                MasterStateManager,
+            )
+
+            self.state_manager = MasterStateManager(
+                self,
+                FileStateBackend(
+                    os.path.join(state_dir, f"{job_name}.state.json")
+                ),
+            )
 
     @property
     def port(self) -> int:
@@ -93,6 +108,9 @@ class JobMaster:
         self.stats_reporter.remove(node_id)
 
     def prepare(self) -> None:
+        if self.state_manager is not None:
+            self.state_manager.restore()
+            self.state_manager.start()
         self._server.start()
         self.node_manager.start()
         logger.info("job master %s serving on port %d", self.job_name,
@@ -133,6 +151,8 @@ class JobMaster:
         return success
 
     def stop(self) -> None:
+        if self.state_manager is not None:
+            self.state_manager.stop()
         self.node_manager.stop()
         self._server.stop()
 
@@ -148,6 +168,10 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--node-unit", type=int, default=1)
     parser.add_argument("--hang-timeout", type=float, default=1800.0)
     parser.add_argument(
+        "--state-dir", default="",
+        help="persist recoverable master state here (HA restart)",
+    )
+    parser.add_argument(
         "--port-file", default="",
         help="write the bound port to this file once serving (for the CLI "
              "to discover a dynamically chosen port)",
@@ -161,6 +185,7 @@ def main(argv: list[str] | None = None) -> int:
         rdzv_timeout=args.rdzv_timeout,
         node_unit=args.node_unit,
         hang_timeout_s=args.hang_timeout,
+        state_dir=args.state_dir,
     )
     master.prepare()
     if args.port_file:

@@ -52,6 +52,7 @@ class MasterServicer:
         )
         self._paral_config = m.ParalConfig()
         self._paral_lock = threading.Lock()
+        self._oom_bump_threshold = 0
         self.job_exit_event = threading.Event()
         self.job_success: bool | None = None
 
@@ -92,6 +93,8 @@ class MasterServicer:
                 msg.node_id, msg.restart_count, msg.level.value,
                 msg.error_data,
             )
+            if "(oom)" in msg.error_data:
+                self._suggest_higher_accum(msg.restart_count)
             return m.OkResponse()
         if isinstance(msg, m.ResourceStats):
             # partial-update semantics: the agent reports host cpu/mem, the
@@ -187,6 +190,34 @@ class MasterServicer:
             n = self._kv_store.add(f"sync/{msg.sync_name}", 0)
             return m.KVStoreResponse(found=True, number=n)
         raise TypeError(f"unhandled message type {type(msg).__name__}")
+
+    def _suggest_higher_accum(self, restart_count: int) -> None:
+        """Device-OOM mitigation: double gradient accumulation (smaller
+        per-step activation footprint at a fixed global batch). HBM per
+        chip is fixed — the host-memory analog is the resource optimizer's
+        2x rule. Applied at the trainer's next incarnation
+        (restart_required). Debounced on the reporter's restart count: N
+        nodes OOMing in the same incarnation must double ONCE, and a
+        doubling is only compounded after an incarnation that actually ran
+        with it OOMed again. Reference analog: paral_config_tuner.py:31 +
+        local_optimizer.py:99."""
+        import dataclasses as _dc
+
+        with self._paral_lock:
+            if restart_count < self._oom_bump_threshold:
+                return
+            self._oom_bump_threshold = restart_count + 1
+            current = self._paral_config.grad_accum_steps or 1
+            self._paral_config = _dc.replace(
+                self._paral_config,
+                grad_accum_steps=current * 2,
+                restart_required=True,
+                version=self._paral_config.version + 1,
+            )
+            logger.info(
+                "OOM: suggesting grad_accum_steps=%d (paral config v%d)",
+                current * 2, self._paral_config.version,
+            )
 
     def _join_rendezvous(self, msg: m.JoinRendezvousRequest
                          ) -> m.JoinRendezvousResponse:

@@ -1,0 +1,120 @@
+"""Master HA: control-plane state snapshot + restore.
+
+Reference analog: dlrover/python/util/state/store_mananger.py +
+memory_store.py (pluggable state backends for master recovery). What must
+survive a master restart is the DATA-PLANE bookkeeping: dataset shard
+progress (epoch, undone shards, task ids) — without it, a restarted
+master answers ``get_task`` with "no dataset" and every trainer concludes
+its epoch ended. Node registry and rendezvous state rebuild organically
+(heartbeats re-register nodes within one interval; agents re-join
+rendezvous on the next membership change), and in-flight shards are
+checkpointed as undone, preserving at-least-once semantics.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+class StateBackend:
+    def save(self, state: dict) -> None:
+        raise NotImplementedError
+
+    def load(self) -> dict | None:
+        raise NotImplementedError
+
+
+class MemoryStateBackend(StateBackend):
+    def __init__(self):
+        self._state: dict | None = None
+
+    def save(self, state: dict) -> None:
+        self._state = json.loads(json.dumps(state))
+
+    def load(self) -> dict | None:
+        return self._state
+
+
+class FileStateBackend(StateBackend):
+    """Atomic JSON file (k8s analog: a ConfigMap or PVC file)."""
+
+    def __init__(self, path: str):
+        self._path = path
+
+    def save(self, state: dict) -> None:
+        os.makedirs(os.path.dirname(self._path) or ".", exist_ok=True)
+        tmp = f"{self._path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, self._path)
+
+    def load(self) -> dict | None:
+        if not os.path.exists(self._path):
+            return None
+        try:
+            with open(self._path) as f:
+                return json.load(f)
+        except (json.JSONDecodeError, OSError):
+            logger.exception("state restore failed; starting fresh")
+            return None
+
+
+class MasterStateManager:
+    """Periodic snapshots of a JobMaster's recoverable state."""
+
+    def __init__(self, master: Any, backend: StateBackend,
+                 interval_s: float = 5.0):
+        self._master = master
+        self._backend = backend
+        self._interval_s = interval_s
+        self._stopped = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def snapshot(self) -> None:
+        state = {
+            "version": 1,
+            "timestamp": time.time(),
+            "job_name": self._master.job_name,
+            "datasets": self._master.task_manager.export_state(),
+        }
+        self._backend.save(state)
+
+    def restore(self) -> bool:
+        state = self._backend.load()
+        if not state:
+            return False
+        self._master.task_manager.restore_state(state.get("datasets", {}))
+        logger.info(
+            "restored master state from %s (age %.1fs)",
+            type(self._backend).__name__,
+            time.time() - state.get("timestamp", time.time()),
+        )
+        return True
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="master-state", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self.snapshot()
+        except Exception:  # noqa: BLE001 - shutdown must proceed
+            logger.exception("final state snapshot failed")
+
+    def _loop(self) -> None:
+        while not self._stopped.wait(self._interval_s):
+            try:
+                self.snapshot()
+            except Exception:  # noqa: BLE001
+                logger.exception("state snapshot failed")

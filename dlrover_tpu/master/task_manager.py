@@ -70,7 +70,10 @@ class _DatasetManager:
     def get_task(self, node_id: int) -> ShardTask:
         self._refill()
         if not self.todo:
-            return ShardTask()  # invalid: no more work (epoch drained or done)
+            # invalid: either done for good (finished flag stops client
+            # polling) or temporarily drained while peers' in-flight
+            # shards may still fail back onto the queue
+            return ShardTask(finished=self.finished())
         task = self.todo.popleft()
         self.doing[task.task_id] = _DoingTask(task, node_id, time.time())
         return task
@@ -126,6 +129,7 @@ class TaskManager:
     def __init__(self):
         self._lock = threading.Lock()
         self._datasets: dict[str, _DatasetManager] = {}
+        self._params: dict[str, DatasetShardParams] = {}
 
     def maybe_create_dataset(self, params: DatasetShardParams) -> None:
         with self._lock:
@@ -142,6 +146,7 @@ class TaskManager:
             self._datasets[params.dataset_name] = _DatasetManager(
                 splitter, params.task_type
             )
+            self._params[params.dataset_name] = params
             logger.info(
                 "dataset %s registered: size=%d shard=%d epochs=%d",
                 params.dataset_name, params.dataset_size, params.shard_size,
@@ -198,3 +203,33 @@ class TaskManager:
                 name: ds.completed_count
                 for name, ds in self._datasets.items()
             }
+
+    # ------------------------------------------------------------ master HA
+
+    def export_state(self) -> dict:
+        """Everything needed to rebuild the shard queues in a new master
+        (params to re-create splitters; checkpoints hold undone shards,
+        with in-flight ones counted undone — at-least-once)."""
+        with self._lock:
+            return {
+                name: {
+                    "params": dataclasses.asdict(self._params[name]),
+                    "checkpoint": ds.checkpoint(),
+                    "completed": ds.completed_count,
+                }
+                for name, ds in self._datasets.items()
+            }
+
+    def restore_state(self, state: dict) -> None:
+        for name, entry in state.items():
+            self.maybe_create_dataset(
+                DatasetShardParams(**entry["params"])
+            )
+            self.restore_checkpoint(name, entry["checkpoint"])
+            with self._lock:
+                self._datasets[name].completed_count = entry.get(
+                    "completed", 0
+                )
+        if state:
+            logger.info("restored %d dataset(s): %s", len(state),
+                        list(state))

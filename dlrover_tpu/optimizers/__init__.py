@@ -1,0 +1,2 @@
+from dlrover_tpu.optimizers.agd import agd  # noqa: F401
+from dlrover_tpu.optimizers.wsam import wsam  # noqa: F401

@@ -1,0 +1,84 @@
+"""AGD: Auto-switchable optimizer with Gradient-Difference preconditioning.
+
+Reference analog: atorch/atorch/optimizers/agd.py:155 (AGD, NeurIPS '23,
+"AGD: an Auto-switchable Optimizer using Stepwise Gradient Difference as
+Preconditioning Matrix"). The preconditioner's second-moment accumulates
+the stepwise gradient DIFFERENCE (g_t - g_{t-1}) instead of the gradient,
+and the update auto-switches between SGD-like and Adam-like behavior via
+``delta``: where the preconditioned curvature estimate is small the step
+degrades toward plain momentum.
+
+Implemented as an optax ``GradientTransformation``; compose with
+``optax.chain`` / weight decay the usual way.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import chex
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class AGDState(NamedTuple):
+    count: chex.Array
+    mu: optax.Updates        # first moment of gradients
+    bu: optax.Updates        # second moment of gradient differences
+    prev_grad: optax.Updates
+
+
+def agd(
+    learning_rate: float | optax.Schedule = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    delta: float = 1e-5,
+) -> optax.GradientTransformation:
+    """AGD gradient transformation.
+
+    ``delta`` is the switching threshold: dimensions whose preconditioner
+    sqrt falls below ``delta`` take momentum-SGD-style steps (divide by
+    ``delta``), others take Adam-style preconditioned steps.
+    """
+
+    def init_fn(params):
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        return AGDState(
+            count=jnp.zeros((), jnp.int32),
+            mu=jax.tree.map(jnp.zeros_like, params),
+            bu=jax.tree.map(jnp.zeros_like, params),
+            prev_grad=zeros,
+        )
+
+    def update_fn(updates, state, params=None):
+        del params
+        count = state.count + 1
+        # gradient difference; first step uses the gradient itself
+        # (reference: diff = grad on step 1)
+        is_first = count == 1
+        diff = jax.tree.map(
+            lambda g, pg: jnp.where(is_first, g, g - pg),
+            updates, state.prev_grad,
+        )
+        mu = optax.tree.update_moment(updates, state.mu, b1, 1)
+        bu = optax.tree.update_moment_per_elem_norm(diff, state.bu, b2, 2)
+        mu_hat = optax.tree.bias_correction(mu, b1, count)
+        bu_hat = optax.tree.bias_correction(bu, b2, count)
+        # auto-switch: max(sqrt(bu_hat), delta) — small curvature
+        # estimates degrade to momentum / delta (SGD regime)
+        scaled = jax.tree.map(
+            lambda m, b: m / jnp.maximum(jnp.sqrt(b) + eps, delta),
+            mu_hat, bu_hat,
+        )
+        lr = (
+            learning_rate(count)
+            if callable(learning_rate) else learning_rate
+        )
+        new_updates = jax.tree.map(lambda u: -lr * u, scaled)
+        return new_updates, AGDState(
+            count=count, mu=mu, bu=bu, prev_grad=updates
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)

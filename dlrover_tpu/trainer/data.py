@@ -1,0 +1,156 @@
+"""Elastic data pipeline: master-fed samples with background prefetch.
+
+Reference analog: ATorch's data layer (atorch/atorch/data/ —
+ElasticDataset:19 backed by the shard client, elastic_dataloader.py built
+from the paral-config file, preloader.py GPU prefetch) and the trainer's
+ElasticDataLoader (dlrover/trainer/torch/elastic/dataloader.py:26). TPU
+shape: a background thread pulls sample indices from the master's dynamic
+sharding, materializes + collates them into step batches, and keeps a
+bounded queue full so the train loop never stalls on data; the queue depth
+hot-reloads from the paral-config file.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+from dlrover_tpu.agent.config_tuner import ParalConfigReader
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+class ElasticDataset:
+    """Sample-index stream: master-fed under the agent, local otherwise."""
+
+    def __init__(self, dataset_size: int, *, name: str = "train",
+                 shard_size: int = 256, num_epochs: int = 1,
+                 shuffle: bool = True, under_agent: bool | None = None):
+        self.dataset_size = dataset_size
+        if under_agent is None:
+            import os
+
+            from dlrover_tpu.common.constants import EnvKey
+
+            under_agent = bool(os.environ.get(EnvKey.MASTER_ADDR))
+        self._client = None
+        if under_agent:
+            from dlrover_tpu.trainer.sharding_client import (
+                IndexShardingClient,
+            )
+
+            self._client = IndexShardingClient(
+                dataset_name=name,
+                dataset_size=dataset_size,
+                shard_size=shard_size,
+                num_epochs=num_epochs,
+                shuffle=shuffle,
+            )
+        self._num_epochs = num_epochs
+
+    def indices(self) -> Iterator[int]:
+        if self._client is not None:
+            while True:
+                idx = self._client.next_index()
+                if idx is None:
+                    return
+                yield idx
+        else:
+            for _ in range(self._num_epochs):
+                yield from range(self.dataset_size)
+
+    def close(self) -> None:
+        if self._client is not None:
+            self._client.close()
+
+
+class PrefetchLoader:
+    """Background batch assembly with a bounded, hot-tunable queue.
+
+    ``sample_fn(index) -> sample``; ``collate(list) -> dict of arrays``;
+    batches come out shaped [accum, batch, ...] ready for the compiled
+    step. Queue depth follows the paral-config ``prefetch_batches`` knob.
+    """
+
+    def __init__(
+        self,
+        dataset: ElasticDataset,
+        sample_fn: Callable[[int], Any],
+        collate: Callable[[list], dict[str, np.ndarray]],
+        accum: int,
+        batch_size: int,
+        prefetch_batches: int = 2,
+        config_reader: ParalConfigReader | None = None,
+    ):
+        self._dataset = dataset
+        self._sample_fn = sample_fn
+        self._collate = collate
+        self._accum = accum
+        self._batch_size = batch_size
+        self._config = config_reader
+        self._depth = max(1, prefetch_batches)
+        # unbounded queue; depth is enforced by the producer's wait loop so
+        # a hot-tuned larger target can actually take effect
+        self._queue: queue.Queue = queue.Queue()
+        self._stopped = threading.Event()
+        self._error: BaseException | None = None
+        self._thread = threading.Thread(
+            target=self._fill, name="prefetch-loader", daemon=True
+        )
+        self._thread.start()
+
+    def _target_depth(self) -> int:
+        if self._config is not None:
+            suggested = int(self._config.get("prefetch_batches", 0) or 0)
+            if suggested > 0:
+                return suggested
+        return self._depth
+
+    def _samples(self):
+        for idx in self._dataset.indices():
+            if self._stopped.is_set():
+                return
+            yield self._sample_fn(idx)
+
+    def _fill(self) -> None:
+        from dlrover_tpu.trainer.elastic_trainer import BatchAssembler
+
+        assembler = BatchAssembler(self._accum, self._batch_size)
+        try:
+            for batch in assembler.batches(self._samples(), self._collate):
+                while not self._stopped.is_set():
+                    if self._queue.qsize() < self._target_depth():
+                        self._queue.put(batch)
+                        break
+                    self._stopped.wait(0.05)
+                if self._stopped.is_set():
+                    return
+        except BaseException as e:  # noqa: BLE001 - surfaced to consumer
+            self._error = e
+            logger.exception("prefetch thread failed")
+        finally:
+            self._queue.put(None)
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        while True:
+            batch = self._queue.get()
+            if batch is None:
+                if self._error is not None:
+                    raise RuntimeError(
+                        "prefetch failed"
+                    ) from self._error
+                return
+            yield batch
+
+    def close(self) -> None:
+        self._stopped.set()
+        self._dataset.close()
+        # unblock a waiting producer
+        try:
+            self._queue.get_nowait()
+        except queue.Empty:
+            pass

@@ -144,6 +144,24 @@ class ElasticTrainer:
         checkpointer: Callable[[int, TrainState], None] | None = None,
         checkpoint_interval: int = 0,
     ) -> TrainState:
+        return self.run_batches(
+            state, self.assembler.batches(samples, collate),
+            max_steps=max_steps, on_step=on_step,
+            checkpointer=checkpointer,
+            checkpoint_interval=checkpoint_interval,
+        )
+
+    def run_batches(
+        self,
+        state: TrainState,
+        batches: Iterator[dict],
+        max_steps: int | None = None,
+        on_step: Callable[[int, dict], None] | None = None,
+        checkpointer: Callable[[int, TrainState], None] | None = None,
+        checkpoint_interval: int = 0,
+    ) -> TrainState:
+        """Train over pre-assembled [accum, local_batch, ...] batches
+        (e.g. a PrefetchLoader)."""
         start = time.monotonic()
         # one sync at entry so a restored state's step carries forward
         self._host_step = int(state.step)
@@ -153,7 +171,7 @@ class ElasticTrainer:
             logger.info("restored at step %d >= max_steps %d; nothing to do",
                         self._host_step, max_steps)
             return state
-        for batch in self.assembler.batches(samples, collate):
+        for batch in batches:
             state, metrics = self.train_step(state, batch)
             step = self._host_step
             if on_step is not None:

@@ -63,7 +63,7 @@ class ShardingClient:
             if task.valid:
                 self._current = task
                 return task
-            if time.time() >= deadline:
+            if task.finished or time.time() >= deadline:
                 return None
             time.sleep(0.5)
 

@@ -119,10 +119,24 @@ def main(argv=None) -> int:
         print(f"[trainer] resumed from step {resumed_from}", flush=True)
 
     dp = data_parallel_size(mesh)
+    # honor the master's paral-config suggestion (e.g. OOM -> higher grad
+    # accumulation at a fixed global batch) unless the user pinned one
+    from dlrover_tpu.agent.config_tuner import ParalConfigReader
+
+    paral = ParalConfigReader()
+    micro = args.micro_batch
+    if not micro:
+        suggested_accum = int(paral.get("grad_accum_steps", 0) or 0)
+        if suggested_accum > 0:
+            micro = max(1, args.global_batch // (dp * suggested_accum))
+            print(f"[trainer] paral-config: accum={suggested_accum} -> "
+                  f"micro_batch={micro}", flush=True)
+        else:
+            micro = max(1, args.global_batch // dp)
     trainer = ElasticTrainer(
         compiled,
         global_batch_size=args.global_batch,
-        micro_batch_size=args.micro_batch or max(1, args.global_batch // dp),
+        micro_batch_size=micro,
     )
 
     # ---- data: master-fed dynamic shards under the agent, local otherwise
@@ -133,32 +147,20 @@ def main(argv=None) -> int:
         g = np.random.Generator(np.random.Philox(key=rng_seed + idx))
         return g.integers(0, vocab, seq + 1, dtype=np.int32)
 
-    if ctx.under_agent:
-        from dlrover_tpu.trainer.sharding_client import IndexShardingClient
+    from dlrover_tpu.trainer.data import ElasticDataset, PrefetchLoader
 
-        shard_client = IndexShardingClient(
-            dataset_name="synthetic",
-            dataset_size=args.dataset_size,
-            shard_size=args.shard_size,
-            num_epochs=args.epochs,
-            shuffle=True,
-        )
-
-        def samples():
-            while True:
-                idx = shard_client.next_index()
-                if idx is None:
-                    return
-                yield idx
-    else:
-        def samples():
-            i = 0
-            while True:
-                yield i % args.dataset_size
-                i += 1
-
-    def collate(batch_indices: list[int]) -> dict[str, np.ndarray]:
-        return {"tokens": np.stack([tokens_for(i) for i in batch_indices])}
+    dataset = ElasticDataset(
+        args.dataset_size, name="synthetic", shard_size=args.shard_size,
+        num_epochs=args.epochs, shuffle=True, under_agent=ctx.under_agent,
+    )
+    loader = PrefetchLoader(
+        dataset,
+        sample_fn=tokens_for,
+        collate=lambda samples: {"tokens": np.stack(samples)},
+        accum=trainer.accum,
+        batch_size=trainer.local_step_batch,
+        config_reader=paral,
+    )
 
     def checkpointer(step: int, st) -> None:
         if step % args.mem_ckpt_interval == 0:
@@ -182,15 +184,15 @@ def main(argv=None) -> int:
             print(f"[trainer] step {step} loss {loss:.4f}", flush=True)
 
     start = time.monotonic()
-    state = trainer.run(
+    state = trainer.run_batches(
         state,
-        samples(),
-        collate,
+        iter(loader),
         max_steps=args.max_steps,
         on_step=on_step,
         checkpointer=checkpointer,
         checkpoint_interval=1,
     )
+    loader.close()
     final_step = int(state.step)
     engine.save_to_storage(final_step, state)
     engine.wait_for_persist(final_step, timeout=120)

@@ -338,6 +338,63 @@ class TestRelaunchHook:
         assert len(relaunched) == 1
 
 
+class TestMasterHA:
+    def test_state_survives_master_restart(self, master_factory, tmp_path):
+        """A new master incarnation resumes the shard queues: undone and
+        in-flight shards survive; no duplicate completions."""
+        state_dir = str(tmp_path / "state")
+        m1 = master_factory(min_nodes=1, max_nodes=1)
+        from dlrover_tpu.master.state_store import (
+            FileStateBackend,
+            MasterStateManager,
+        )
+
+        sm1 = MasterStateManager(
+            m1, FileStateBackend(state_dir + "/job.state.json"),
+        )
+        c = client(m1, 0)
+        c.report_dataset_params(DatasetShardParams(
+            dataset_name="d", dataset_size=40, shard_size=10, num_epochs=1,
+        ))
+        t1 = c.get_task("d")       # completed before the crash
+        c.report_task_result(t1.task_id, "d")
+        t2 = c.get_task("d")       # in flight at the crash
+        assert t1.valid and t2.valid
+        sm1.snapshot()
+        m1.stop()
+
+        m2 = master_factory(min_nodes=1, max_nodes=1)
+        sm2 = MasterStateManager(
+            m2, FileStateBackend(state_dir + "/job.state.json"),
+        )
+        assert sm2.restore()
+        c2 = client(m2, 0)
+        got = []
+        while True:
+            task = c2.get_task("d")
+            if not task.valid:
+                break
+            got.append((task.start, task.end))
+            c2.report_task_result(task.task_id, "d")
+        # 3 remaining shards: the in-flight one (recovered) + 2 untouched
+        assert len(got) == 3
+        assert (t2.start, t2.end) in got
+        assert (t1.start, t1.end) not in got
+        assert m2.task_manager.completed_counts()["d"] == 4
+
+    def test_restore_from_empty_backend_is_noop(self, tmp_path):
+        from dlrover_tpu.master.state_store import (
+            FileStateBackend,
+            MasterStateManager,
+        )
+
+        m = JobMaster(port=0)
+        sm = MasterStateManager(
+            m, FileStateBackend(str(tmp_path / "nope.json")),
+        )
+        assert not sm.restore()
+
+
 class TestStats:
     def test_partial_reports_merge_and_job_stats(self, master_factory):
         master = master_factory(min_nodes=1, max_nodes=1)

@@ -1,0 +1,151 @@
+"""Elastic data pipeline + paral-config tuner.
+
+Reference analog: ElasticDataLoader config hot-reload
+(dlrover/trainer/torch/elastic/dataloader.py:26) and ParalConfigTuner
+(elastic_agent/config/paral_config_tuner.py:31).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.agent.config_tuner import (
+    ParalConfigReader,
+    ParalConfigTuner,
+)
+from dlrover_tpu.common.messages import DatasetShardParams, ParalConfig
+from dlrover_tpu.master.job_master import JobMaster
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.trainer.data import ElasticDataset, PrefetchLoader
+
+
+@pytest.fixture
+def master():
+    m = JobMaster(port=0, min_nodes=1, max_nodes=1)
+    m.prepare()
+    yield m
+    m.stop()
+
+
+def _collate(samples):
+    return {"x": np.stack(samples)}
+
+
+class TestPrefetchLoader:
+    def test_batches_and_order_local(self):
+        ds = ElasticDataset(32, under_agent=False, num_epochs=1)
+        loader = PrefetchLoader(
+            ds, sample_fn=lambda i: np.full((4,), i, np.float32),
+            collate=_collate, accum=2, batch_size=4,
+        )
+        batches = list(loader)
+        assert len(batches) == 4  # 32 / (2*4)
+        assert batches[0]["x"].shape == (2, 4, 4)
+        np.testing.assert_array_equal(
+            batches[0]["x"][0, :, 0], [0, 1, 2, 3]
+        )
+        loader.close()
+
+    def test_prefetch_overlaps_slow_consumer(self):
+        ds = ElasticDataset(64, under_agent=False)
+        produced = []
+
+        def sample(i):
+            produced.append(i)
+            return np.zeros((1,), np.float32)
+
+        loader = PrefetchLoader(
+            ds, sample_fn=sample, collate=_collate,
+            accum=1, batch_size=8, prefetch_batches=3,
+        )
+        time.sleep(0.5)
+        # producer ran ahead without any consumption: ~3 batches deep
+        assert len(produced) >= 24
+        it = iter(loader)
+        next(it)
+        loader.close()
+
+    def test_master_fed_dataset(self, master, tmp_ipc_dir):
+        import os
+
+        from dlrover_tpu.common.constants import EnvKey
+
+        os.environ[EnvKey.MASTER_ADDR] = master.addr
+        os.environ[EnvKey.NODE_ID] = "0"
+        MasterClient.reset()
+        try:
+            ds = ElasticDataset(
+                20, name="pf", shard_size=5, under_agent=True
+            )
+            loader = PrefetchLoader(
+                ds, sample_fn=lambda i: np.asarray([i], np.float32),
+                collate=_collate, accum=1, batch_size=5,
+            )
+            batches = list(loader)
+            seen = sorted(
+                int(v) for b in batches for v in b["x"].reshape(-1)
+            )
+            assert seen == list(range(20))
+            loader.close()
+        finally:
+            os.environ.pop(EnvKey.MASTER_ADDR)
+            MasterClient.reset()
+
+
+class TestParalConfigTuner:
+    def test_tuner_writes_file_and_reader_reloads(self, master, tmp_path):
+        client = MasterClient(master.addr, 0)
+        path = str(tmp_path / "paral.json")
+        tuner = ParalConfigTuner(client, path=path, interval_s=3600)
+        assert tuner.poll_once()  # version 0 -> file written
+        reader = ParalConfigReader(path)
+        assert reader.get("version") == 0
+
+        client._client.call(ParalConfig(prefetch_batches=8))
+        assert tuner.poll_once()
+        time.sleep(0.01)
+        assert reader.get("prefetch_batches") == 8
+        assert reader.get("version") == 1
+        # no new version -> no rewrite
+        assert not tuner.poll_once()
+
+    def test_oom_failure_bumps_grad_accum_debounced(self, master):
+        client = MasterClient(master.addr, 0)
+        client.report_failure("exit code 210 (oom)", restart_count=0)
+        cfg = client.get_paral_config()
+        assert cfg.grad_accum_steps == 2
+        assert cfg.restart_required
+        # peer nodes OOMing in the same incarnation must not compound
+        MasterClient(master.addr, 1).report_failure(
+            "exit code 210 (oom)", restart_count=0
+        )
+        assert client.get_paral_config().grad_accum_steps == 2
+        # the NEXT incarnation OOMing again does compound
+        client.report_failure("exit code 210 (oom)", restart_count=1)
+        assert client.get_paral_config().grad_accum_steps == 4
+
+    def test_update_callback_skips_startup_sync(self, master, tmp_path):
+        client = MasterClient(master.addr, 0)
+        seen = []
+        tuner = ParalConfigTuner(
+            client, path=str(tmp_path / "p.json"), on_update=seen.append
+        )
+        client._client.call(ParalConfig(restart_required=True))
+        tuner.poll_once()
+        # the startup sync mirrors but must not fire the restart callback
+        assert seen == []
+        client._client.call(ParalConfig(restart_required=True))
+        tuner.poll_once()
+        assert seen and seen[-1]["restart_required"]
+
+    def test_reader_inert_without_agent_env(self, monkeypatch):
+        from dlrover_tpu.common.constants import EnvKey
+
+        monkeypatch.delenv(EnvKey.PARAL_CONFIG_PATH, raising=False)
+        reader = ParalConfigReader()
+        assert reader.current() == {}
+        assert reader.get("grad_accum_steps") is None

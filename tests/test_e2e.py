@@ -196,7 +196,9 @@ def test_oom_exit_restarts_in_place(tmp_path):
     assert proc.returncode == 0, proc.stderr[-3000:]
     result = json.load(open(result_file))
     assert result["final_step"] == 16
-    assert result["restart_count"] == 1
+    # >= 1: the OOM restart, plus possibly a paral-config restart when the
+    # master's grad-accum suggestion lands before the run finishes
+    assert result["restart_count"] >= 1
 
 
 @pytest.mark.timeout(300)
