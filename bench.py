@@ -105,10 +105,64 @@ def bench_train_step(extra: dict) -> None:
         tokens_per_s=round(tokens_per_step / step_s),
         tflops_per_s=round(flops_per_step / step_s / 1e12, 1),
         mfu=round(flops_per_step / step_s / peak, 4) if peak else None,
-        xla_flops_per_step=xla_flops,
-        hw_util=round(xla_flops / step_s / peak, 4)
-        if peak and xla_flops else None,
+        # raw XLA cost analysis; undercounts lax.scan/while bodies, so it
+        # is NOT a utilization figure — recorded for cross-round tracking
+        xla_cost_analysis_flops=xla_flops,
         loss=round(loss, 4),
+    )
+
+
+def bench_long_context(extra: dict) -> None:
+    """gpt2-small @ 4k tokens: Pallas flash attention without remat vs the
+    best dense config (dense needs per-layer remat to fit at all)."""
+    import jax
+    import optax
+
+    from dlrover_tpu.models import transformer as tfm
+    from dlrover_tpu.parallel import strategy as strat_lib
+    from dlrover_tpu.trainer.train_step import compile_train
+
+    if jax.devices()[0].platform != "tpu":
+        return
+    seq = int(os.environ.get("BENCH_LC_SEQ", "4096"))
+    batch = int(os.environ.get("BENCH_LC_BATCH", "2"))
+    steps = int(os.environ.get("BENCH_LC_STEPS", "10"))
+
+    def run(attention: str, remat: bool) -> float:
+        cfg = dataclasses.replace(
+            tfm.CONFIGS["gpt2-small"], remat_scan=remat,
+            attention=attention, max_seq_len=seq,
+        )
+        strat = strat_lib.dp()
+        mesh = strat.build_mesh(jax.devices()[:1])
+        compiled = compile_train(
+            strategy=strat, mesh=mesh,
+            loss_fn=tfm.make_loss_fn(cfg, strat, mesh),
+            init_params_fn=lambda rng: tfm.init_params(cfg, rng),
+            logical_params=tfm.logical_axes(cfg),
+            optimizer=optax.adamw(1e-4),
+        )
+        state = compiled.init(jax.random.PRNGKey(0))
+        tokens = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (1, batch, seq + 1), dtype=np.int32
+        )
+        b = jax.device_put({"tokens": tokens}, compiled.batch_sharding)
+        state, m = compiled.step(state, b)
+        float(jax.device_get(m["loss"]))
+        t0 = time.monotonic()
+        for _ in range(steps):
+            state, m = compiled.step(state, b)
+        float(jax.device_get(m["loss"]))
+        return (time.monotonic() - t0) / steps
+
+    dense_s = run("dense", True)
+    flash_s = run("flash", False)
+    extra.update(
+        lc_seq=seq,
+        lc_dense_remat_step_s=round(dense_s, 4),
+        lc_flash_step_s=round(flash_s, 4),
+        lc_flash_speedup=round(dense_s / flash_s, 2),
+        lc_flash_tokens_per_s=round(batch * seq / flash_s),
     )
 
 
@@ -185,6 +239,10 @@ def main() -> None:
         bench_train_step(extra)
     except Exception as e:  # noqa: BLE001
         errors.append(f"train: {type(e).__name__}: {e}")
+    try:
+        bench_long_context(extra)
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"long_context: {type(e).__name__}: {e}")
     if errors:
         extra["errors"] = errors
 

@@ -41,6 +41,14 @@ class ParalConfigTuner:
         self._version = -1
 
     def start(self) -> None:
+        # synchronous first sync: the config file must exist before the
+        # first worker spawn (a restarted agent would otherwise start its
+        # worker on an empty config and — with the first-sync callback
+        # suppression — never apply a pre-existing suggestion)
+        try:
+            self.poll_once()
+        except (ConnectionError, RuntimeError, OSError) as e:
+            logger.warning("initial paral config sync failed: %s", e)
         self._thread = threading.Thread(
             target=self._loop, name="paral-config-tuner", daemon=True
         )
@@ -51,16 +59,17 @@ class ParalConfigTuner:
 
     def poll_once(self) -> bool:
         """Fetch and mirror; True when a new version was written."""
+        from dlrover_tpu.common.storage import atomic_write_file
+
         config = self._client.get_paral_config()
         if config.version == self._version:
             return False
         first_sync = self._version == -1
-        self._version = config.version
         data = dataclasses.asdict(config)
-        tmp = f"{self.path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(data, f)
-        os.replace(tmp, self.path)
+        atomic_write_file(json.dumps(data), self.path)
+        # only record the sync AFTER the file is durably published — a
+        # failed write must not mark the version as delivered
+        self._version = config.version
         logger.info("paral config v%d written to %s", config.version,
                     self.path)
         # the startup sync mirrors whatever the master already has; only

@@ -71,18 +71,24 @@ class CheckpointStorage(ABC):
         return {}
 
 
+def atomic_write_file(content: bytes | str, path: str) -> None:
+    """Durable atomic file publish: tmp + fsync + rename. Without the
+    fsync a crash right after the rename can publish a truncated file."""
+    mode = "wb" if isinstance(content, bytes) else "w"
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, mode) as f:
+        f.write(content)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 class PosixDiskStorage(CheckpointStorage):
     """Local/NFS filesystem storage with atomic writes."""
 
     def write(self, content: bytes | str, path: str) -> None:
-        mode = "wb" if isinstance(content, bytes) else "w"
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, mode) as f:
-            f.write(content)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        atomic_write_file(content, path)
 
     def read(self, path: str) -> bytes:
         with open(path, "rb") as f:

@@ -53,6 +53,8 @@ class MasterServicer:
         self._paral_config = m.ParalConfig()
         self._paral_lock = threading.Lock()
         self._oom_bump_threshold = 0
+        self._last_oom_bump = 0.0
+        self.oom_bump_cooldown_s = 30.0
         self.job_exit_event = threading.Event()
         self.job_success: bool | None = None
 
@@ -203,9 +205,17 @@ class MasterServicer:
         local_optimizer.py:99."""
         import dataclasses as _dc
 
+        import time as _time
+
         with self._paral_lock:
             if restart_count < self._oom_bump_threshold:
                 return
+            # cooldown: a crash loop faster than the tuner's poll would
+            # otherwise compound doublings that never actually ran
+            now = _time.time()
+            if now - self._last_oom_bump < self.oom_bump_cooldown_s:
+                return
+            self._last_oom_bump = now
             self._oom_bump_threshold = restart_count + 1
             current = self._paral_config.grad_accum_steps or 1
             self._paral_config = _dc.replace(

@@ -50,11 +50,9 @@ class FileStateBackend(StateBackend):
         self._path = path
 
     def save(self, state: dict) -> None:
-        os.makedirs(os.path.dirname(self._path) or ".", exist_ok=True)
-        tmp = f"{self._path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(state, f)
-        os.replace(tmp, self._path)
+        from dlrover_tpu.common.storage import atomic_write_file
+
+        atomic_write_file(json.dumps(state), self._path)
 
     def load(self) -> dict | None:
         if not os.path.exists(self._path):

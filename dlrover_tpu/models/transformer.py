@@ -300,14 +300,17 @@ def make_loss_fn(cfg: TransformerConfig, strategy, mesh) -> Callable:
 
     pin = partial(_constrain, rules=strategy.rule_table(), mesh=mesh)
     attn: AttentionFn | None = None
-    wants_ring = (
-        getattr(strategy, "extra", {}).get("attention") == "ring"
-        or cfg.attention == "ring"
+    choice = (
+        getattr(strategy, "extra", {}).get("attention") or cfg.attention
     )
-    if wants_ring:
+    if choice == "ring":
         from dlrover_tpu.ops.ring_attention import make_ring_attention
 
         attn = make_ring_attention(mesh)
+    elif choice == "flash":
+        from dlrover_tpu.ops.flash_attention import flash_attention
+
+        attn = flash_attention
     return partial(loss_fn, cfg=cfg, attention_fn=attn, constrain=pin)
 
 

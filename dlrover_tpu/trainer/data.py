@@ -147,10 +147,8 @@ class PrefetchLoader:
             yield batch
 
     def close(self) -> None:
+        # the producer only ever waits on _stopped (the queue is
+        # unbounded), so set() fully unblocks it; draining the queue here
+        # could steal the end-of-stream sentinel from a live consumer
         self._stopped.set()
         self._dataset.close()
-        # unblock a waiting producer
-        try:
-            self._queue.get_nowait()
-        except queue.Empty:
-            pass

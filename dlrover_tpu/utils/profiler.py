@@ -167,12 +167,11 @@ def profile_train_step(step_fn: Callable, state: Any, batch: Any,
     per = (time.monotonic() - t0) / steps
     flops_per_s = flops / per if per > 0 else 0.0
     peak = device_peak_flops()
+    # one timed interval over N chained steps: only the mean is real —
+    # percentile fields stay 0 (use StepProfiler for order statistics)
     stats = StepStats(
         steps=steps,
         mean_s=round(per, 5),
-        p50_s=round(per, 5),
-        p90_s=round(per, 5),
-        min_s=round(per, 5),
         flops_per_step=flops,
         tflops_per_s=round(flops_per_s / 1e12, 2),
         mfu=round(flops_per_s / (peak * jax.device_count()), 4)

@@ -31,6 +31,9 @@ if _REPO not in sys.path:
 def parse_args(argv=None):
     p = argparse.ArgumentParser("train_transformer")
     p.add_argument("--model", default="tiny")
+    p.add_argument("--attention", default="",
+                   help="override the model's attention impl "
+                        "(dense|flash|ring)")
     p.add_argument("--strategy", default="dp",
                    help="strategy preset name (parallel/strategy.py)")
     p.add_argument("--max-steps", type=int, default=50)
@@ -77,8 +80,12 @@ def main(argv=None) -> int:
     from dlrover_tpu.trainer.elastic_trainer import ElasticTrainer
     from dlrover_tpu.trainer.train_step import compile_train
 
+    import dataclasses
+
     ctx = bootstrap.init_from_env()
     cfg = tfm.CONFIGS[args.model]
+    if args.attention:
+        cfg = dataclasses.replace(cfg, attention=args.attention)
     seq = args.seq or cfg.max_seq_len
 
     strategy = PRESETS[args.strategy]()

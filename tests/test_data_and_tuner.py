@@ -114,6 +114,7 @@ class TestParalConfigTuner:
         assert not tuner.poll_once()
 
     def test_oom_failure_bumps_grad_accum_debounced(self, master):
+        master.servicer.oom_bump_cooldown_s = 0.0  # not under test here
         client = MasterClient(master.addr, 0)
         client.report_failure("exit code 210 (oom)", restart_count=0)
         cfg = client.get_paral_config()
