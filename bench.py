@@ -155,15 +155,22 @@ def bench_long_context(extra: dict) -> None:
         float(jax.device_get(m["loss"]))
         return (time.monotonic() - t0) / steps
 
-    dense_s = run("dense", True)
+    # flash first: it's the headline number and must survive a dense-side
+    # failure (the dense config barely fits at this seq)
     flash_s = run("flash", False)
     extra.update(
         lc_seq=seq,
-        lc_dense_remat_step_s=round(dense_s, 4),
         lc_flash_step_s=round(flash_s, 4),
-        lc_flash_speedup=round(dense_s / flash_s, 2),
         lc_flash_tokens_per_s=round(batch * seq / flash_s),
     )
+    try:
+        dense_s = run("dense", True)
+        extra.update(
+            lc_dense_remat_step_s=round(dense_s, 4),
+            lc_flash_speedup=round(dense_s / flash_s, 2),
+        )
+    except Exception as e:  # noqa: BLE001 - baseline is optional
+        extra["lc_dense_error"] = f"{type(e).__name__}"
 
 
 def bench_checkpoint(extra: dict) -> dict:

@@ -40,15 +40,28 @@ class ParalConfigTuner:
         self._thread: threading.Thread | None = None
         self._version = -1
 
-    def start(self) -> None:
-        # synchronous first sync: the config file must exist before the
+    def start(self, first_sync_deadline_s: float = 5.0) -> None:
+        # bounded first sync: the config file should exist before the
         # first worker spawn (a restarted agent would otherwise start its
         # worker on an empty config and — with the first-sync callback
-        # suppression — never apply a pre-existing suggestion)
-        try:
-            self.poll_once()
-        except (ConnectionError, RuntimeError, OSError) as e:
-            logger.warning("initial paral config sync failed: %s", e)
+        # suppression — never apply a pre-existing suggestion). Bounded
+        # because an unreachable master must not stall agent startup for
+        # the RPC client's full retry budget; the poll thread finishes
+        # the sync in the background.
+        def first_sync():
+            try:
+                self.poll_once()
+            except (ConnectionError, RuntimeError, OSError) as e:
+                logger.warning("initial paral config sync failed: %s", e)
+
+        t = threading.Thread(target=first_sync, daemon=True)
+        t.start()
+        t.join(first_sync_deadline_s)
+        if t.is_alive():
+            logger.warning(
+                "initial paral config sync still pending after %.0fs; "
+                "proceeding", first_sync_deadline_s,
+            )
         self._thread = threading.Thread(
             target=self._loop, name="paral-config-tuner", daemon=True
         )

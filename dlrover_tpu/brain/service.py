@@ -1,0 +1,222 @@
+"""Brain: cluster-level metrics store + resource optimizer service.
+
+Reference analog: the Go brain service (dlrover/go/brain — MySQL datastore
+in pkg/datastore, optimize algorithms in
+pkg/optimizer/implementation/optalgorithm/*: OptimizeJobPSCreateResource,
+OptimizeJobPSOomResource, OptimizeJobWorkerResource, ...; served over
+brain.proto). This build keeps the capability — persist job runtime
+metrics across jobs, answer resource-plan queries from history — over the
+repo's typed RPC stack with a sqlite datastore (stdlib; the storage
+interface is one class to swap for MySQL).
+
+One Brain serves many job masters; a master in ``optimize_mode=cluster``
+reports metrics through BrainClient and consults it for initial and
+OOM-recovery plans, falling back to the local heuristics when the Brain
+has no history.
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import statistics
+import threading
+import time
+from typing import Any
+
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.rpc import RpcServer
+
+logger = get_logger(__name__)
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS job_metrics (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    job_name TEXT NOT NULL,
+    signature TEXT NOT NULL,
+    workers INTEGER,
+    used_memory_mb INTEGER,
+    used_hbm_mb INTEGER,
+    steps_per_s REAL,
+    status TEXT,
+    timestamp REAL
+);
+CREATE INDEX IF NOT EXISTS idx_signature ON job_metrics (signature);
+"""
+
+
+class BrainDataStore:
+    """sqlite-backed metrics history (MySQL analog)."""
+
+    def __init__(self, path: str = ":memory:"):
+        if path != ":memory:":
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+
+    def record(self, metrics: m.BrainJobMetrics) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO job_metrics (job_name, signature, workers,"
+                " used_memory_mb, used_hbm_mb, steps_per_s, status,"
+                " timestamp) VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
+                (
+                    metrics.job_name, metrics.signature, metrics.workers,
+                    metrics.used_memory_mb, metrics.used_hbm_mb,
+                    metrics.steps_per_s, metrics.status,
+                    metrics.timestamp or time.time(),
+                ),
+            )
+            self._conn.commit()
+
+    def history(self, signature: str, limit: int = 50) -> list[tuple]:
+        """Latest record per job for a workload signature.
+
+        Standard-SQL latest-row-per-group (a join on MAX(timestamp)) so
+        the store ports to MySQL's ONLY_FULL_GROUP_BY unchanged.
+        """
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT jm.job_name, jm.workers, jm.used_memory_mb,"
+                " jm.used_hbm_mb, jm.steps_per_s, jm.status, jm.timestamp"
+                " FROM job_metrics jm JOIN ("
+                "   SELECT job_name, MAX(timestamp) AS ts FROM job_metrics"
+                "   WHERE signature = ? GROUP BY job_name"
+                " ) latest ON jm.job_name = latest.job_name"
+                "   AND jm.timestamp = latest.ts"
+                " WHERE jm.signature = ?"
+                " ORDER BY jm.timestamp DESC LIMIT ?",
+                (signature, signature, limit),
+            ).fetchall()
+        return rows
+
+    def peak_memory_mb(self, signature: str) -> int:
+        """Max memory EVER observed for a signature — across every report,
+        not just each job's final one (a job's last record often carries
+        post-peak usage)."""
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT MAX(used_memory_mb) FROM job_metrics"
+                " WHERE signature = ?",
+                (signature,),
+            ).fetchone()
+        return int(row[0] or 0)
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+class BrainService:
+    """The optimize algorithms over the datastore, served via RPC."""
+
+    def __init__(self, store: BrainDataStore | None = None, port: int = 0):
+        self.store = store or BrainDataStore()
+        self._server = RpcServer(self.handle, port=port)
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self._server.port}"
+
+    def start(self) -> None:
+        self._server.start()
+        logger.info("brain serving on %s", self.addr)
+
+    def stop(self) -> None:
+        self._server.stop()
+        self.store.close()
+
+    def handle(self, msg: Any) -> Any:
+        if isinstance(msg, m.BrainJobMetrics):
+            self.store.record(msg)
+            return m.OkResponse()
+        if isinstance(msg, m.BrainOptimizeRequest):
+            return self.optimize(msg)
+        raise TypeError(f"unhandled message type {type(msg).__name__}")
+
+    # ------------------------------------------------------------ algorithms
+
+    def optimize(self, req: m.BrainOptimizeRequest) -> m.BrainOptimizePlan:
+        """Plan from same-signature history (the optalgorithm family):
+
+        - create: memory = 1.5x median successful usage; workers = the
+          worker count of the fastest successful run (per-worker speed)
+        - oom: memory = 2x the max usage ever observed for the signature
+        """
+        rows = self.store.history(req.signature)
+        ok_rows = [r for r in rows if r[5] == "succeeded"]
+        if not rows or (req.stage == "create" and not ok_rows):
+            return m.BrainOptimizePlan(found=False)
+        if req.stage == "oom":
+            peak = self.store.peak_memory_mb(req.signature)
+            return m.BrainOptimizePlan(
+                found=True, memory_mb=2 * peak, based_on_jobs=len(rows),
+            )
+        mem = int(1.5 * statistics.median(r[2] for r in ok_rows))
+        # fastest per-worker throughput wins the worker-count vote
+        best = max(
+            ok_rows,
+            key=lambda r: (r[4] / r[1]) if r[1] else 0.0,
+        )
+        return m.BrainOptimizePlan(
+            found=True, workers=best[1] or 0, memory_mb=mem,
+            based_on_jobs=len(ok_rows),
+        )
+
+
+class BrainClient:
+    """Master-side client (reference: dlrover/python/brain/client.py).
+
+    Short deadline by default: every Brain consultation is advisory with
+    a working local fallback — an unreachable Brain must cost seconds,
+    not the default client's minutes of retries (OOM recovery calls this
+    synchronously).
+    """
+
+    def __init__(self, addr: str, timeout: float = 3.0, retries: int = 1):
+        from dlrover_tpu.common.rpc import RpcClient
+
+        self._client = RpcClient(addr, timeout=timeout, retries=retries)
+
+    def report(self, metrics: m.BrainJobMetrics) -> None:
+        self._client.call(metrics)
+
+    def optimize(self, job_name: str, signature: str,
+                 stage: str = "create") -> m.BrainOptimizePlan:
+        return self._client.call(
+            m.BrainOptimizeRequest(
+                job_name=job_name, signature=signature, stage=stage
+            )
+        )
+
+    def close(self) -> None:
+        self._client.close()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser("dlrover-tpu brain")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--db", default="/tmp/dlrover_tpu_brain.sqlite")
+    parser.add_argument("--port-file", default="")
+    args = parser.parse_args(argv)
+    service = BrainService(BrainDataStore(args.db), port=args.port)
+    service.start()
+    if args.port_file:
+        with open(args.port_file, "w") as f:
+            f.write(str(service._server.port))
+    try:
+        while True:
+            time.sleep(60)
+    except KeyboardInterrupt:
+        service.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -354,6 +354,42 @@ class NetworkCheckStatusResponse:
     straggler_nodes: list[int] = dataclasses.field(default_factory=list)
 
 
+# ----------------------------------------------------------------- brain
+
+
+@register_message
+@dataclasses.dataclass
+class BrainJobMetrics:
+    """One job's runtime record, persisted by the Brain for cross-job
+    learning (reference: the MySQL rows the Go brain's datastore keeps)."""
+
+    job_name: str = ""
+    signature: str = ""   # workload identity: model/config hash
+    workers: int = 0
+    used_memory_mb: int = 0
+    used_hbm_mb: int = 0
+    steps_per_s: float = 0.0
+    status: str = "running"  # running | succeeded | failed | oom
+    timestamp: float = 0.0
+
+
+@register_message
+@dataclasses.dataclass
+class BrainOptimizeRequest:
+    job_name: str = ""
+    signature: str = ""
+    stage: str = "create"   # create | oom | running
+
+
+@register_message
+@dataclasses.dataclass
+class BrainOptimizePlan:
+    found: bool = False
+    workers: int = 0
+    memory_mb: int = 0
+    based_on_jobs: int = 0
+
+
 # ------------------------------------------------------------------- sync/ckpt
 
 

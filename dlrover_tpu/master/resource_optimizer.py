@@ -30,16 +30,47 @@ class OptimizerConfig:
 
 
 class LocalResourceOptimizer:
-    """Produces ScalePlans; the auto-scaler executes them."""
+    """Produces ScalePlans; the auto-scaler executes them.
+
+    With a BrainClient (optimize_mode=cluster), plans consult cross-job
+    history first (reference: brain_optimizer.py routing to the Brain's
+    Optimize RPC) and fall back to the local heuristics.
+    """
 
     def __init__(self, config: OptimizerConfig, stats_reporter,
-                 speed_monitor):
+                 speed_monitor, brain=None, signature: str = ""):
         self._config = config
         self._stats = stats_reporter
         self._speed = speed_monitor
         self._memory_mb: dict[int, int] = {}
+        self._brain = brain
+        self._signature = signature
+
+    def _brain_plan(self, stage: str):
+        if self._brain is None or not self._signature:
+            return None
+        try:
+            plan = self._brain.optimize("", self._signature, stage=stage)
+            return plan if plan.found else None
+        except (ConnectionError, RuntimeError, OSError) as e:
+            logger.warning("brain optimize failed: %s", e)
+            return None
 
     def initial_plan(self) -> ScalePlan:
+        brain = self._brain_plan("create")
+        if brain is not None and brain.workers:
+            workers = min(
+                max(brain.workers, self._config.min_workers),
+                self._config.max_workers,
+            )
+            logger.info(
+                "brain initial plan: %d workers (from %d jobs)",
+                workers, brain.based_on_jobs,
+            )
+            return ScalePlan(
+                replica_resources={"worker": workers},
+                reason=f"brain history ({brain.based_on_jobs} jobs)",
+            )
         return ScalePlan(
             replica_resources={"worker": self._config.max_workers},
             reason="initial",
@@ -56,6 +87,9 @@ class LocalResourceOptimizer:
         if latest is not None:
             current = max(current, latest.used_memory_mb)
         doubled = max(2 * current, 1024)
+        brain = self._brain_plan("oom")
+        if brain is not None and brain.memory_mb:
+            doubled = max(doubled, brain.memory_mb)
         self._memory_mb[node_id] = doubled
         logger.info("OOM on node %d: memory -> %dMB", node_id, doubled)
         return ScalePlan(

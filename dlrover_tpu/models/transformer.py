@@ -45,7 +45,11 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     dtype: str = "bfloat16"     # compute dtype
     remat_scan: bool = False    # checkpoint each scanned layer
-    attention: str = "dense"    # "dense" | "ring" (ops/ring_attention.py)
+    attention: str = "dense"    # "dense" | "flash" | "ring"
+    # muP (parallel/mup.py): base d_model tuned on; 0 disables. Applies
+    # the readout multiplier and 1/d_head attention scaling here; pair
+    # with mup_optimizer for the per-leaf LR table.
+    mup_base_width: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -245,9 +249,17 @@ def forward(
 
     n_rep = c.n_heads // c.n_kv_heads
 
+    # muP: attention logits scale 1/d_head instead of 1/sqrt(d_head) —
+    # pre-scaling q composes with the attention impl's 1/sqrt(d)
+    mup_q_scale = (
+        1.0 / math.sqrt(c.head_dim) if c.mup_base_width else 1.0
+    )
+
     def layer(x, w):
         h = _norm(x, w["ln1"], w.get("ln1_b"), c.variant)
         q = jnp.einsum("bse,ehd->bshd", h, w["wq"].astype(dt))
+        if c.mup_base_width:
+            q = q * mup_q_scale
         k = jnp.einsum("bse,ehd->bshd", h, w["wk"].astype(dt))
         v = jnp.einsum("bse,ehd->bshd", h, w["wv"].astype(dt))
         if c.variant == "llama":
@@ -285,6 +297,9 @@ def forward(
 
     x = _norm(x, params["ln_f"], params.get("ln_f_b"), c.variant)
     logits = jnp.einsum("bse,ev->bsv", x, params["lm_head"].astype(dt))
+    if c.mup_base_width:
+        # muP readout multiplier keeps logit scale width-invariant
+        logits = logits * (c.mup_base_width / c.d_model)
     return logits.astype(jnp.float32)
 
 

@@ -1,0 +1,95 @@
+"""muP: maximal-update parametrization for width-transferable HPs.
+
+Reference analog: atorch/atorch/mup/ (infshape/init/optim/module — the
+torch port of Yang & Hu's muP). What muP buys: tune learning rate etc. on
+a small-width proxy model, transfer to the full width unchanged.
+
+The standard muP-Adam recipe, expressed the JAX way (pure functions, no
+module surgery):
+
+- hidden "matrix-like" weights (both dims scale with width): LR scaled by
+  ``base_width / width``
+- "vector-like" params (embeddings, norms, biases — one or zero dims
+  scale): LR unscaled
+- readout (lm_head): forward output multiplied by ``base_width / width``
+- attention logits scaled ``1/d_head`` instead of ``1/sqrt(d_head)``
+
+Model integration: set ``TransformerConfig.mup_base_width``; the forward
+pass applies the readout/attention scalings, and ``mup_optimizer`` wraps
+any optax optimizer with the per-leaf LR table derived from the logical
+axes (the same annotations the sharding rules use — "matrix-like" is
+exactly "has an 'embed'/'mlp'/'heads' input dim").
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import optax
+
+# logical axis names that scale with model width
+_WIDTH_AXES = {"embed", "mlp", "heads", "kv_heads"}
+
+
+def lr_scale_tree(logical_axes: Any, base_width: int, width: int) -> Any:
+    """Per-leaf LR multipliers from the logical-axis annotations.
+
+    A leaf is matrix-like (scaled ``base/width``) when at least TWO of its
+    dims scale with width — e.g. wq [embed, heads, d], w_down [mlp,
+    embed], lm_head [embed, vocab]... lm_head is handled by the forward
+    readout multiplier instead, but its fan-in still scales, so muP-Adam
+    scales its LR too (both-dims rule with vocab treated as non-width).
+    """
+    ratio = base_width / width
+
+    def leaf_scale(axes: tuple) -> float:
+        width_dims = sum(1 for a in axes if a in _WIDTH_AXES)
+        return ratio if width_dims >= 2 else (
+            ratio if width_dims == 1 and "vocab" in axes
+            and axes[0] == "embed" else 1.0
+        )
+
+    return jax.tree.map(
+        leaf_scale,
+        logical_axes,
+        is_leaf=lambda x: isinstance(x, tuple)
+        and all(isinstance(e, (str, type(None))) for e in x),
+    )
+
+
+class _ScaleByTreeState(NamedTuple):
+    pass
+
+
+def scale_by_tree(scales: Any) -> optax.GradientTransformation:
+    """Multiply each update leaf by its entry in ``scales``."""
+
+    def init_fn(params):
+        del params
+        return _ScaleByTreeState()
+
+    def update_fn(updates, state, params=None):
+        del params
+        return jax.tree.map(
+            lambda u, s: u * s, updates, scales
+        ), state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def mup_optimizer(
+    base_optimizer: optax.GradientTransformation,
+    logical_axes: Any,
+    base_width: int,
+    width: int,
+) -> optax.GradientTransformation:
+    """Wrap an optimizer with muP per-leaf LR scaling.
+
+    ``logical_axes`` is the model's axis-annotation tree
+    (models.transformer.logical_axes); widths are d_model values.
+    """
+    return optax.chain(
+        base_optimizer,
+        scale_by_tree(lr_scale_tree(logical_axes, base_width, width)),
+    )

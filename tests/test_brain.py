@@ -1,0 +1,126 @@
+"""Brain service: datastore persistence + optimize algorithms + client.
+
+Reference analog: the Go brain's optalgorithm table tests
+(dlrover/go/brain/pkg/optimizer/implementation/optalgorithm/*_test.go).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from dlrover_tpu.brain.service import (
+    BrainClient,
+    BrainDataStore,
+    BrainService,
+)
+from dlrover_tpu.common.messages import BrainJobMetrics
+from dlrover_tpu.master.resource_optimizer import (
+    LocalResourceOptimizer,
+    OptimizerConfig,
+)
+from dlrover_tpu.master.stats import LocalStatsReporter
+
+
+@pytest.fixture
+def brain():
+    service = BrainService()
+    service.start()
+    client = BrainClient(service.addr)
+    yield service, client
+    client.close()
+    service.stop()
+
+
+def _job(name, workers, mem, speed, status="succeeded", sig="llama-7b"):
+    return BrainJobMetrics(
+        job_name=name, signature=sig, workers=workers,
+        used_memory_mb=mem, steps_per_s=speed, status=status,
+    )
+
+
+class TestBrainService:
+    def test_no_history_not_found(self, brain):
+        _, client = brain
+        assert not client.optimize("j", "unknown-sig").found
+
+    def test_create_plan_from_history(self, brain):
+        _, client = brain
+        client.report(_job("a", workers=4, mem=8000, speed=2.0))
+        client.report(_job("b", workers=8, mem=10000, speed=6.0))
+        client.report(_job("c", workers=8, mem=12000, speed=1.0,
+                           status="failed"))
+        plan = client.optimize("new", "llama-7b")
+        assert plan.found
+        # fastest per-worker successful run had 8 workers (6/8 > 2/4)
+        assert plan.workers == 8
+        # 1.5x median successful memory (median of 8000, 10000)
+        assert plan.memory_mb == int(1.5 * 9000)
+        assert plan.based_on_jobs == 2
+
+    def test_oom_plan_doubles_peak(self, brain):
+        _, client = brain
+        client.report(_job("a", workers=4, mem=8000, speed=2.0,
+                           status="oom"))
+        plan = client.optimize("a", "llama-7b", stage="oom")
+        assert plan.found and plan.memory_mb == 16000
+
+    def test_latest_record_per_job_wins(self, brain):
+        _, client = brain
+        client.report(_job("a", workers=2, mem=4000, speed=1.0,
+                           status="running"))
+        client.report(_job("a", workers=2, mem=6000, speed=1.5))
+        plan = client.optimize("new", "llama-7b")
+        assert plan.based_on_jobs == 1
+        assert plan.memory_mb == int(1.5 * 6000)
+
+    def test_sqlite_persistence_across_restart(self, tmp_path):
+        db = str(tmp_path / "brain.sqlite")
+        s1 = BrainService(BrainDataStore(db))
+        s1.start()
+        BrainClient(s1.addr).report(
+            _job("a", workers=4, mem=8000, speed=2.0)
+        )
+        s1.stop()
+        s2 = BrainService(BrainDataStore(db))
+        s2.start()
+        try:
+            plan = BrainClient(s2.addr).optimize("new", "llama-7b")
+            assert plan.found and plan.workers == 4
+        finally:
+            s2.stop()
+
+
+class TestOptimizerBrainIntegration:
+    def test_initial_plan_uses_history_clamped(self, brain):
+        _, client = brain
+        client.report(_job("a", workers=16, mem=8000, speed=10.0))
+
+        class Speed:
+            def running_speed(self):
+                return 0.0
+
+        opt = LocalResourceOptimizer(
+            OptimizerConfig(min_workers=1, max_workers=8),
+            LocalStatsReporter(), Speed(),
+            brain=client, signature="llama-7b",
+        )
+        plan = opt.initial_plan()
+        assert plan.replica_resources == {"worker": 8}  # clamped
+        assert "brain" in plan.reason
+
+    def test_oom_plan_takes_brain_max(self, brain):
+        _, client = brain
+        client.report(_job("a", workers=4, mem=50000, speed=2.0,
+                           status="oom"))
+
+        class Speed:
+            def running_speed(self):
+                return 0.0
+
+        opt = LocalResourceOptimizer(
+            OptimizerConfig(host_memory_mb=4096, max_workers=4),
+            LocalStatsReporter(), Speed(),
+            brain=client, signature="llama-7b",
+        )
+        plan = opt.oom_recovery_plan(0)
+        assert plan.memory_mb["0"] == 100000  # brain's 2x peak wins
