@@ -50,6 +50,12 @@ class TransformerConfig:
     # the readout multiplier and 1/d_head attention scaling here; pair
     # with mup_optimizer for the per-leaf LR table.
     mup_base_width: int = 0
+    # MoE (ops/moe.py): experts replace the FFN when > 0; shard them over
+    # the "expert" mesh axis via the moe strategy preset
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_aux_weight: float = 1e-2
+    moe_capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -60,7 +66,11 @@ class TransformerConfig:
         c = self
         embed = c.vocab_size * c.d_model
         attn = c.d_model * c.head_dim * (c.n_heads * 2 + c.n_kv_heads * 2)
-        if c.variant == "llama":
+        if c.moe_experts:
+            ffn = (c.d_model * c.moe_experts
+                   + 2 * c.moe_experts * c.d_model * c.d_ff)
+            norms = 2 * c.d_model
+        elif c.variant == "llama":
             ffn = 3 * c.d_model * c.d_ff
             norms = 2 * c.d_model
         else:
@@ -79,6 +89,9 @@ CONFIGS = {
     "tiny": TransformerConfig(
         vocab_size=512, d_model=64, n_layers=2, n_heads=4, n_kv_heads=4,
         d_ff=176, max_seq_len=128),
+    "tiny-moe": TransformerConfig(
+        vocab_size=512, d_model=64, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=128, max_seq_len=128, moe_experts=4),
     "gpt2-small": TransformerConfig(
         vocab_size=50257, d_model=768, n_layers=12, n_heads=12, n_kv_heads=12,
         d_ff=3072, max_seq_len=1024, variant="gpt2"),
@@ -117,14 +130,28 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
         "wk": stack(ks[1], (c.d_model, c.n_kv_heads, hd), c.d_model),
         "wv": stack(ks[2], (c.d_model, c.n_kv_heads, hd), c.d_model),
         "wo": stack(ks[3], (c.n_heads, hd, c.d_model), c.d_model),
-        "w_gate": stack(ks[4], (c.d_model, c.d_ff), c.d_model),
-        "w_down": stack(ks[5], (c.d_ff, c.d_model), c.d_ff),
         "ln1": jnp.ones((c.n_layers, c.d_model), jnp.float32),
         "ln2": jnp.ones((c.n_layers, c.d_model), jnp.float32),
     }
-    if c.variant == "llama":
+    if c.moe_experts:
+        # one source of truth for expert init: ops/moe.py, stacked per
+        # layer via vmap
+        from dlrover_tpu.ops.moe import MoeConfig, init_moe_params
+
+        moe = jax.vmap(
+            lambda k: init_moe_params(
+                k, c.d_model, c.d_ff,
+                MoeConfig(n_experts=c.moe_experts),
+            )
+        )(jax.random.split(ks[4], c.n_layers))
+        layers.update(moe)
+    elif c.variant == "llama":
+        layers["w_gate"] = stack(ks[4], (c.d_model, c.d_ff), c.d_model)
+        layers["w_down"] = stack(ks[5], (c.d_ff, c.d_model), c.d_ff)
         layers["w_up"] = stack(ks[6], (c.d_model, c.d_ff), c.d_model)
     else:
+        layers["w_gate"] = stack(ks[4], (c.d_model, c.d_ff), c.d_model)
+        layers["w_down"] = stack(ks[5], (c.d_ff, c.d_model), c.d_ff)
         layers["b_ff"] = jnp.zeros((c.n_layers, c.d_ff), jnp.float32)
         layers["b_out"] = jnp.zeros((c.n_layers, c.d_model), jnp.float32)
         layers["ln1_b"] = jnp.zeros((c.n_layers, c.d_model), jnp.float32)
@@ -155,14 +182,23 @@ def logical_axes(cfg: TransformerConfig) -> Params:
         "wk": ("layers", "embed", "kv_heads", None),
         "wv": ("layers", "embed", "kv_heads", None),
         "wo": ("layers", "heads", None, "embed"),
-        "w_gate": ("layers", "embed", "mlp"),
-        "w_down": ("layers", "mlp", "embed"),
         "ln1": ("layers", None),
         "ln2": ("layers", None),
     }
-    if c.variant == "llama":
+    if c.moe_experts:
+        from dlrover_tpu.ops.moe import moe_logical_axes
+
+        layers.update({
+            name: ("layers", *axes)
+            for name, axes in moe_logical_axes().items()
+        })
+    elif c.variant == "llama":
+        layers["w_gate"] = ("layers", "embed", "mlp")
+        layers["w_down"] = ("layers", "mlp", "embed")
         layers["w_up"] = ("layers", "embed", "mlp")
     else:
+        layers["w_gate"] = ("layers", "embed", "mlp")
+        layers["w_down"] = ("layers", "mlp", "embed")
         layers["b_ff"] = ("layers", "mlp")
         layers["b_out"] = ("layers", None)
         layers["ln1_b"] = ("layers", None)
@@ -230,7 +266,23 @@ def forward(
     attention_fn: AttentionFn | None = None,
     constrain: Callable[[jax.Array, tuple], jax.Array] | None = None,
 ) -> jax.Array:
-    """Token ids [B, S] -> logits [B, S, vocab].
+    """Token ids [B, S] -> logits [B, S, vocab]."""
+    return forward_with_aux(
+        params, tokens, cfg, attention_fn=attention_fn,
+        constrain=constrain,
+    )[0]
+
+
+def forward_with_aux(
+    params: Params,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    attention_fn: AttentionFn | None = None,
+    constrain: Callable[[jax.Array, tuple], jax.Array] | None = None,
+    mask: jax.Array | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """(logits, aux_loss). aux is the MoE load-balancing term (0 when
+    the model has no experts).
 
     ``constrain(x, logical_axes)`` optionally pins activation shardings
     (supplied by the strategy layer); identity when absent.
@@ -255,7 +307,16 @@ def forward(
         1.0 / math.sqrt(c.head_dim) if c.mup_base_width else 1.0
     )
 
-    def layer(x, w):
+    if c.moe_experts:
+        from dlrover_tpu.ops.moe import MoeConfig, moe_ffn
+
+        moe_cfg = MoeConfig(
+            n_experts=c.moe_experts, top_k=c.moe_top_k,
+            capacity_factor=c.moe_capacity_factor,
+        )
+
+    def layer(carry, w):
+        x, aux = carry
         h = _norm(x, w["ln1"], w.get("ln1_b"), c.variant)
         q = jnp.einsum("bse,ehd->bshd", h, w["wq"].astype(dt))
         if c.mup_base_width:
@@ -273,7 +334,14 @@ def forward(
         x = pin(x + o, ("batch", "sequence", "embed"))
 
         h = _norm(x, w["ln2"], w.get("ln2_b"), c.variant)
-        if c.variant == "llama":
+        if c.moe_experts:
+            ff, aux_l = moe_ffn(
+                {"w_router": w["w_router"], "w_in": w["w_in"],
+                 "w_out": w["w_out"]},
+                h, moe_cfg, constrain=pin, token_mask=mask,
+            )
+            aux = aux + aux_l
+        elif c.variant == "llama":
             gate = jax.nn.silu(jnp.einsum("bse,ef->bsf", h,
                                           w["w_gate"].astype(dt)))
             up = jnp.einsum("bse,ef->bsf", h, w["w_up"].astype(dt))
@@ -286,21 +354,24 @@ def forward(
             ff = (jnp.einsum("bsf,fe->bse", hidden, w["w_down"].astype(dt))
                   + w["b_out"].astype(dt))
         x = pin(x + ff, ("batch", "sequence", "embed"))
-        return x, None
+        return (x, aux), None
 
     body = layer
     if c.remat_scan:
         body = jax.checkpoint(
             layer, policy=jax.checkpoint_policies.nothing_saveable
         )
-    x, _ = lax.scan(lambda carry, w: body(carry, w), x, params["layers"])
+    (x, aux), _ = lax.scan(
+        lambda carry, w: body(carry, w),
+        (x, jnp.zeros((), jnp.float32)), params["layers"],
+    )
 
     x = _norm(x, params["ln_f"], params.get("ln_f_b"), c.variant)
     logits = jnp.einsum("bse,ev->bsv", x, params["lm_head"].astype(dt))
     if c.mup_base_width:
         # muP readout multiplier keeps logit scale width-invariant
         logits = logits * (c.mup_base_width / c.d_model)
-    return logits.astype(jnp.float32)
+    return logits.astype(jnp.float32), aux
 
 
 def make_loss_fn(cfg: TransformerConfig, strategy, mesh) -> Callable:
@@ -336,15 +407,23 @@ def loss_fn(
     attention_fn: AttentionFn | None = None,
     constrain=None,
 ) -> jax.Array:
-    """Next-token cross entropy. batch: tokens [B, S] (shift-in-loss)."""
+    """Next-token cross entropy (+ MoE aux). batch: tokens [B, S]."""
     tokens = batch["tokens"]
-    logits = forward(params, tokens[:, :-1], cfg,
-                     attention_fn=attention_fn, constrain=constrain)
+    in_mask = batch.get("mask")
+    logits, aux = forward_with_aux(
+        params, tokens[:, :-1], cfg,
+        attention_fn=attention_fn, constrain=constrain,
+        mask=in_mask[:, :-1] if in_mask is not None else None,
+    )
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     mask = batch.get("mask")
     if mask is not None:
         m = mask[:, 1:].astype(nll.dtype)
-        return (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
-    return nll.mean()
+        ce = (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
+    else:
+        ce = nll.mean()
+    if cfg.moe_experts:
+        ce = ce + cfg.moe_aux_weight * aux
+    return ce

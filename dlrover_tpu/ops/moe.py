@@ -1,0 +1,158 @@
+"""Mixture-of-Experts: top-k gating + expert-parallel dispatch.
+
+Reference analog: atorch/atorch/modules/moe/ (moe_layer.py all_to_all
+dispatch, topk_gating.py, switch_gating.py, ddp.py expert-aware grad
+groups). TPU-native design: experts carry an "expert" logical axis that
+the strategy maps onto the expert mesh axis; dispatch/combine are einsums
+against a capacity-limited one-hot dispatch tensor, and XLA lowers the
+resharding between token-sharded and expert-sharded layouts to all_to_all
+collectives — no imperative dispatch code, and expert-parallel gradients
+need no special DDP handling (they're just sharded arrays).
+
+Gating follows the Switch/GShard recipe: softmax router, top-k experts
+per token, per-expert capacity ``ceil(T/E * capacity_factor)`` with
+overflow tokens dropped (their residual path passes through), and the
+load-balancing auxiliary loss ``E * sum_e f_e * p_e``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeConfig:
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+
+
+def init_moe_params(key: jax.Array, d_model: int, d_ff: int,
+                    cfg: MoeConfig) -> dict:
+    import math
+
+    k_r, k_in, k_out = jax.random.split(key, 3)
+    return {
+        "w_router": jax.random.normal(
+            k_r, (d_model, cfg.n_experts), jnp.float32
+        ) / math.sqrt(d_model),
+        "w_in": jax.random.normal(
+            k_in, (cfg.n_experts, d_model, d_ff), jnp.float32
+        ) / math.sqrt(d_model),
+        "w_out": jax.random.normal(
+            k_out, (cfg.n_experts, d_ff, d_model), jnp.float32
+        ) / math.sqrt(d_ff),
+    }
+
+
+def moe_logical_axes(cfg: MoeConfig | None = None) -> dict:
+    """Logical axes: experts shard over the "expert" mesh axis."""
+    return {
+        "w_router": ("embed", None),
+        "w_in": ("expert", "embed", "mlp"),
+        "w_out": ("expert", "mlp", "embed"),
+    }
+
+
+def _dispatch_tensors(gates: jax.Array, cfg: MoeConfig, capacity: int
+                      ) -> tuple[jax.Array, jax.Array]:
+    """(combine [T,E,C], dispatch mask [T,E,C]) for top-k routed tokens.
+
+    GShard-style position assignment: tokens claim expert slots in order;
+    tokens past an expert's capacity are dropped for that expert.
+    """
+    T, E = gates.shape
+    combine = jnp.zeros((T, E, capacity), gates.dtype)
+    remaining = gates
+    # slots already used per expert by earlier k-iterations
+    used = jnp.zeros((E,), jnp.int32)
+    for _ in range(cfg.top_k):
+        idx = jnp.argmax(remaining, axis=-1)                 # [T]
+        onehot = jax.nn.one_hot(idx, E, dtype=gates.dtype)   # [T, E]
+        gate_k = (remaining * onehot).sum(-1)                # [T]
+        # position of each token within its chosen expert's buffer —
+        # cumsum MUST run in int32: a bf16 cumsum cannot represent
+        # integers past 256, so long sequences would collide tokens into
+        # the same capacity slot (blended expert inputs)
+        onehot_i = jax.nn.one_hot(idx, E, dtype=jnp.int32)
+        # a zero-gate token (e.g. masked out) claims no slot at all
+        routed = (gate_k > 0)
+        onehot_i = onehot_i * routed[:, None].astype(jnp.int32)
+        pos = (jnp.cumsum(onehot_i, axis=0) - onehot_i
+               ) + used[None, :]                             # [T, E] i32
+        pos_tok = (pos * onehot_i).sum(-1)                   # [T] i32
+        keep = routed & (pos_tok < capacity)
+        slot = jax.nn.one_hot(
+            jnp.where(keep, pos_tok, capacity), capacity + 1,
+            dtype=gates.dtype,
+        )[:, :capacity]                                      # [T, C]
+        combine = combine + (
+            gate_k[:, None, None] * onehot[:, :, None] * slot[:, None, :]
+        )
+        used = used + (onehot_i * keep[:, None].astype(jnp.int32)).sum(0)
+        remaining = remaining * (1.0 - onehot)
+    dispatch = (combine > 0).astype(gates.dtype)
+    return combine, dispatch
+
+
+def moe_ffn(params: dict, x: jax.Array, cfg: MoeConfig,
+            constrain=None, token_mask: jax.Array | None = None
+            ) -> tuple[jax.Array, jax.Array]:
+    """MoE feed-forward. x: [B, S, M] -> ([B, S, M], aux_loss scalar).
+
+    ``constrain`` (strategy layer) pins the expert-sharded intermediates
+    so XLA keeps expert compute on the expert mesh axis. ``token_mask``
+    [B, S] excludes padding from routing, capacity, and the aux loss —
+    pad tokens would otherwise evict real tokens from expert buffers.
+    """
+    import math
+
+    B, S, M = x.shape
+    T = B * S
+    E = cfg.n_experts
+    pin = constrain or (lambda v, a: v)
+    xt = x.reshape(T, M)
+
+    logits = (xt.astype(jnp.float32) @ params["w_router"]).astype(
+        jnp.float32
+    )
+    gates = jax.nn.softmax(logits, axis=-1)                   # [T, E]
+    if token_mask is not None:
+        mask_t = token_mask.reshape(T).astype(jnp.float32)
+        gates = gates * mask_t[:, None]
+        n_real = jnp.maximum(mask_t.sum(), 1.0)
+    else:
+        mask_t = None
+        n_real = float(T)
+
+    # load-balancing aux loss over REAL tokens: fraction routed to e
+    # (top-1) times mean router prob for e, scaled by E (Switch eq. 4)
+    top1 = jax.nn.one_hot(jnp.argmax(gates, -1), E, dtype=jnp.float32)
+    if mask_t is not None:
+        top1 = top1 * mask_t[:, None]
+    aux = E * jnp.sum(
+        (top1.sum(0) / n_real) * (gates.sum(0) / n_real)
+    )
+
+    capacity = max(
+        cfg.top_k, math.ceil(T / E * cfg.capacity_factor)
+    )
+    combine, dispatch = _dispatch_tensors(
+        gates.astype(x.dtype), cfg, capacity
+    )
+
+    # [T,E,C] x [T,M] -> [E,C,M]: becomes an all_to_all when tokens are
+    # batch-sharded and experts expert-sharded
+    x_e = jnp.einsum("tec,tm->ecm", dispatch, xt)
+    x_e = pin(x_e, ("expert", None, "embed"))
+    h = jax.nn.relu(jnp.einsum("ecm,emf->ecf", x_e, params["w_in"].astype(
+        x.dtype
+    )))
+    h = pin(h, ("expert", None, "mlp"))
+    y_e = jnp.einsum("ecf,efm->ecm", h, params["w_out"].astype(x.dtype))
+    y = jnp.einsum("tec,ecm->tm", combine, y_e)
+    return y.reshape(B, S, M), aux.astype(jnp.float32)

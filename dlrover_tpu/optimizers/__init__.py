@@ -1,2 +1,3 @@
 from dlrover_tpu.optimizers.agd import agd  # noqa: F401
+from dlrover_tpu.optimizers.low_bit import adam_8bit  # noqa: F401
 from dlrover_tpu.optimizers.wsam import wsam  # noqa: F401

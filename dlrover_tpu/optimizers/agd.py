@@ -72,8 +72,9 @@ def agd(
             lambda m, b: m / jnp.maximum(jnp.sqrt(b) + eps, delta),
             mu_hat, bu_hat,
         )
+        # schedules evaluate at the PRE-increment step (optax convention)
         lr = (
-            learning_rate(count)
+            learning_rate(count - 1)
             if callable(learning_rate) else learning_rate
         )
         new_updates = jax.tree.map(lambda u: -lr * u, scaled)

@@ -1,0 +1,141 @@
+"""8-bit Adam: blockwise-quantized optimizer states, pure JAX.
+
+Reference analog: atorch/atorch/optimizers/low_bit/ (4/8-bit optimizer
+states backed by CUDA quantization kernels, ops/csrc/quantization). On TPU
+the same memory win — optimizer moments stored at 1 byte/element — needs
+no custom kernel: blockwise absmax quantization is a handful of vector
+ops XLA fuses into the update, trading a little ALU for a 4x cut in
+optimizer-state HBM (8 bytes -> 2 bytes per param for Adam's m+v).
+
+Quantization scheme (matching the 8-bit Adam literature): states are
+flattened and split into fixed-size blocks; each block stores int8 codes
+plus one f32 absmax scale. m is signed-linear, v (non-negative) is
+unsigned-linear in the int8 range.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import chex
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def _pad_len(n: int, block: int) -> int:
+    return (n + block - 1) // block * block
+
+
+def _quantize(x: jax.Array, block: int, signed: bool
+              ) -> tuple[jax.Array, jax.Array]:
+    """Flatten -> [n_blocks, block] int8 codes + per-block f32 scales."""
+    flat = x.reshape(-1)
+    padded = jnp.zeros((_pad_len(flat.size, block),), x.dtype)
+    padded = padded.at[: flat.size].set(flat)
+    blocks = padded.reshape(-1, block)
+    absmax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax, 1.0)
+    limit = 127.0 if signed else 255.0
+    codes = jnp.round(blocks / scale * limit)
+    if signed:
+        codes = jnp.clip(codes, -127, 127).astype(jnp.int8)
+    else:
+        # store unsigned range in int8 by offsetting to [-128, 127]
+        codes = (jnp.clip(codes, 0, 255) - 128).astype(jnp.int8)
+    return codes, scale[:, 0].astype(jnp.float32)
+
+
+def _dequantize(codes: jax.Array, scales: jax.Array, shape, block: int,
+                signed: bool) -> jax.Array:
+    limit = 127.0 if signed else 255.0
+    vals = codes.astype(jnp.float32)
+    if not signed:
+        vals = vals + 128.0
+    blocks = vals / limit * scales[:, None]
+    n = 1
+    for d in shape:
+        n *= d
+    return blocks.reshape(-1)[:n].reshape(shape)
+
+
+class _Quantized(NamedTuple):
+    codes: jax.Array   # int8 [n_blocks, block]
+    scales: jax.Array  # f32 [n_blocks]
+
+
+class Adam8bitState(NamedTuple):
+    count: chex.Array
+    mu: optax.Updates   # tree of _Quantized
+    nu: optax.Updates   # tree of _Quantized
+
+
+def adam_8bit(
+    learning_rate: float | optax.Schedule = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    block_size: int = 256,
+) -> optax.GradientTransformation:
+    """Adam whose m/v live as int8 blockwise-quantized tensors."""
+
+    def q_zero(p):
+        n_blocks = _pad_len(p.size, block_size) // block_size
+        return _Quantized(
+            codes=jnp.zeros((n_blocks, block_size), jnp.int8),
+            scales=jnp.zeros((n_blocks,), jnp.float32),
+        )
+
+    def init_fn(params):
+        return Adam8bitState(
+            count=jnp.zeros((), jnp.int32),
+            mu=jax.tree.map(q_zero, params),
+            nu=jax.tree.map(q_zero, params),
+        )
+
+    def update_fn(updates, state, params=None):
+        del params
+        count = state.count + 1
+
+        def leaf_update(g, mu_q, nu_q):
+            m = _dequantize(mu_q.codes, mu_q.scales, g.shape,
+                            block_size, signed=True)
+            # v is stored in the sqrt domain: its raw dynamic range spans
+            # many orders of magnitude within a block, and linear int8
+            # would crush small entries to 0 (vhat ~ 0 -> exploding
+            # steps); sqrt halves the log-range, bounding the relative
+            # error of the Adam denominator
+            r = _dequantize(nu_q.codes, nu_q.scales, g.shape,
+                            block_size, signed=False)
+            v = r * r
+            g32 = g.astype(jnp.float32)
+            m = b1 * m + (1.0 - b1) * g32
+            v = b2 * v + (1.0 - b2) * g32 * g32
+            mhat = m / (1.0 - b1 ** count.astype(jnp.float32))
+            vhat = v / (1.0 - b2 ** count.astype(jnp.float32))
+            # schedules evaluate at the PRE-increment step, matching
+            # optax.adam (step 0 first)
+            lr = (
+                learning_rate(count - 1)
+                if callable(learning_rate) else learning_rate
+            )
+            step = (-lr * mhat / (jnp.sqrt(vhat) + eps)).astype(g.dtype)
+            m_q = _Quantized(*_quantize(m, block_size, signed=True))
+            v_q = _Quantized(
+                *_quantize(jnp.sqrt(v), block_size, signed=False)
+            )
+            return step, m_q, v_q
+
+        flat_g, treedef = jax.tree_util.tree_flatten(updates)
+        flat_mu = treedef.flatten_up_to(state.mu)
+        flat_nu = treedef.flatten_up_to(state.nu)
+        out = [leaf_update(g, mq, nq)
+               for g, mq, nq in zip(flat_g, flat_mu, flat_nu)]
+        steps = jax.tree_util.tree_unflatten(
+            treedef, [o[0] for o in out]
+        )
+        mu = jax.tree_util.tree_unflatten(treedef, [o[1] for o in out])
+        nu = jax.tree_util.tree_unflatten(treedef, [o[2] for o in out])
+        return steps, Adam8bitState(count=count, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)

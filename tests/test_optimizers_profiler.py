@@ -104,6 +104,79 @@ class TestWSAM:
         )
 
 
+class TestAdam8bit:
+    def test_states_are_int8(self):
+        from dlrover_tpu.optimizers import adam_8bit
+
+        params = {"w": jnp.zeros((1000,)), "b": jnp.zeros((3,))}
+        opt = adam_8bit(1e-3)
+        state = opt.init(params)
+        assert state.mu["w"].codes.dtype == jnp.int8
+        assert state.nu["w"].codes.dtype == jnp.int8
+        # 4 blocks of 256 cover 1000 elements
+        assert state.mu["w"].codes.shape == (4, 256)
+
+    def test_tracks_fp32_adam(self):
+        """A few steps of 8-bit Adam stay close to exact Adam."""
+        from dlrover_tpu.optimizers import adam_8bit
+
+        params_a = {"x": jnp.asarray([0.0, 0.0])}
+        params_b = {"x": jnp.asarray([0.0, 0.0])}
+        opt_a = adam_8bit(0.05, block_size=256)
+        opt_b = optax.adam(0.05)
+        sa, sb = opt_a.init(params_a), opt_b.init(params_b)
+
+        def grad(p):
+            return {"x": 2 * (p["x"] - jnp.asarray([3.0, -1.0]))}
+
+        step_a = jax.jit(
+            lambda p, s: (lambda u, s2: (optax.apply_updates(p, u), s2))(
+                *opt_a.update(grad(p), s)
+            )
+        )
+        step_b = jax.jit(
+            lambda p, s: (lambda u, s2: (optax.apply_updates(p, u), s2))(
+                *opt_b.update(grad(p), s)
+            )
+        )
+        for _ in range(100):
+            params_a, sa = step_a(params_a, sa)
+            params_b, sb = step_b(params_b, sb)
+        np.testing.assert_allclose(
+            np.asarray(params_a["x"]), np.asarray(params_b["x"]),
+            atol=0.05,
+        )
+
+    def test_converges_on_tiny_transformer(self):
+        from functools import partial
+
+        from dlrover_tpu.models import transformer as tfm
+        from dlrover_tpu.optimizers import adam_8bit
+
+        cfg = tfm.CONFIGS["tiny"]
+        params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (2, 65), 0, cfg.vocab_size
+        )
+        opt = adam_8bit(1e-2)
+        state = opt.init(params)
+        loss_fn = partial(tfm.loss_fn, cfg=cfg)
+
+        @jax.jit
+        def step(params, state):
+            loss, g = jax.value_and_grad(loss_fn)(
+                params, {"tokens": tokens}
+            )
+            updates, state = opt.update(g, state)
+            return optax.apply_updates(params, updates), state, loss
+
+        losses = []
+        for _ in range(10):
+            params, state, loss = step(params, state)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+
 class TestProfiler:
     def test_compiled_flops_matmul(self):
         a = jnp.ones((128, 128), jnp.float32)
