@@ -1,0 +1,87 @@
+"""Orbax interop: flash checkpoints <-> the JAX ecosystem's format.
+
+Reference analog: the reference writes Megatron/DeepSpeed-compatible
+tracker files so its flash checkpoints interoperate with those stacks
+(ckpt_saver.py:1119-1157 MegatronCheckpointSaver/DeepSpeedCheckpointSaver).
+The JAX ecosystem's lingua franca is Orbax: these converters let a flash
+checkpoint (fast elastic save/restore path) be exported for consumers
+expecting Orbax (eval harnesses, serving, other trainers), and let an
+Orbax checkpoint seed a flash-checkpointed elastic run.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+def save_orbax(path: str, state: Any) -> None:
+    """Write a pytree as an Orbax checkpoint (blocking)."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.abspath(path), state, force=True)
+    ckptr.wait_until_finished()
+
+
+def load_orbax(path: str, template: Any | None = None,
+               shardings: Any | None = None) -> Any:
+    """Restore an Orbax checkpoint, optionally onto target shardings."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    if template is None:
+        return ckptr.restore(os.path.abspath(path))
+    abstract = jax.tree.map(
+        lambda leaf, s=None: jax.ShapeDtypeStruct(
+            leaf.shape, leaf.dtype
+        ),
+        template,
+    )
+    if shardings is not None:
+        abstract = jax.tree.map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                              sharding=s),
+            abstract, shardings,
+        )
+    return ckptr.restore(os.path.abspath(path), abstract)
+
+
+def export_flash_to_orbax(engine, template: Any, out_path: str,
+                          shardings: Any | None = None) -> int:
+    """Materialize the engine's newest checkpoint as Orbax.
+
+    Works for both the replicated engine (``load``) and the sharded one
+    (``load_sharded``). Returns the exported step.
+    """
+    if hasattr(engine, "load_sharded") and shardings is not None:
+        loaded = engine.load_sharded(template, shardings)
+    else:
+        loaded = engine.load(template)
+    if loaded is None:
+        raise FileNotFoundError("engine has no checkpoint to export")
+    step, state = loaded
+    save_orbax(out_path, state)
+    logger.info("exported flash checkpoint step %d to orbax %s",
+                step, out_path)
+    return step
+
+
+def import_orbax_to_flash(engine, orbax_path: str, step: int,
+                          template: Any | None = None,
+                          persist: bool = True) -> None:
+    """Seed the flash-checkpoint pipeline from an Orbax checkpoint: the
+    elastic run then restores it via the normal shm/storage paths."""
+    state = load_orbax(orbax_path, template)
+    if persist:
+        engine.save_to_storage(step, state)
+        engine.wait_for_persist(step, timeout=300)
+    else:
+        engine.save_to_memory(step, state)
+    logger.info("imported orbax %s as flash checkpoint step %d",
+                orbax_path, step)

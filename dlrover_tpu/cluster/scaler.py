@@ -186,6 +186,10 @@ class PodScaler(Scaler):
         self._next_node_id = 0
         # per-node memory bumps from OOM-recovery plans; survive relaunches
         self._memory_mb: dict[int, int] = {}
+        # nodes this scaler deleted ON PURPOSE (scale-down / remove):
+        # the pod watcher consults this so an intentional removal is not
+        # mistaken for a failure and relaunched
+        self._intentional_removals: set[int] = set()
 
     def update_job(self, job: ElasticJob) -> None:
         """Adopt a resubmitted job spec (new image/resources/command)."""
@@ -193,10 +197,20 @@ class PodScaler(Scaler):
             self._job = job
 
     def _manifest(self, node_id: int) -> dict:
+        self._intentional_removals.discard(node_id)  # it's coming back
         return worker_pod_manifest(
             self._job, self._group, node_id, self._master_addr,
             memory_mb_override=self._memory_mb.get(node_id, 0),
         )
+
+    def consume_intentional_removal(self, node_id: int) -> bool:
+        """True when this scaler deliberately deleted the node's pod
+        (consumed once — a later unexpected vanish counts as failure)."""
+        with self._lock:
+            if node_id in self._intentional_removals:
+                self._intentional_removals.discard(node_id)
+                return True
+            return False
 
     def _live_pods(self) -> dict[int, dict]:
         pods = self._client.list_pods(
@@ -221,6 +235,7 @@ class PodScaler(Scaler):
                 )
             for nid in plan.remove_nodes:
                 if nid in live:
+                    self._intentional_removals.add(nid)
                     self._client.delete_pod(
                         self._job.namespace,
                         live[nid]["metadata"]["name"],
@@ -240,6 +255,7 @@ class PodScaler(Scaler):
                 return
             while len(live) > target:
                 nid = max(live)
+                self._intentional_removals.add(nid)
                 self._client.delete_pod(
                     self._job.namespace, live.pop(nid)["metadata"]["name"]
                 )

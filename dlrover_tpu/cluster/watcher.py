@@ -1,0 +1,130 @@
+"""Pod watcher: platform events -> master node events.
+
+Reference analog: dlrover/python/master/watcher/k8s_watcher.py
+(PodWatcher:155 — a k8s watch stream translated into NodeEvents the job
+manager's state machine consumes). Without assuming a streaming watch API
+on every client, this watcher polls ``list_pods`` and diffs: a pod that
+vanishes out-of-band (preemption, eviction) raises a deleted event the
+master uses to fail the node immediately instead of waiting out the
+heartbeat dead-window.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from dlrover_tpu.cluster.scaler import KubeClient
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+class PodEvent:
+    ADDED = "added"
+    DELETED = "deleted"
+
+    def __init__(self, kind: str, node_id: int, pod_name: str):
+        self.kind = kind
+        self.node_id = node_id
+        self.pod_name = pod_name
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"PodEvent({self.kind}, node={self.node_id})"
+
+
+class PodWatcher:
+    """Polling diff watcher over a job's worker pods."""
+
+    def __init__(
+        self,
+        client: KubeClient,
+        namespace: str,
+        job_name: str,
+        on_event: Callable[[PodEvent], None],
+        interval_s: float = 5.0,
+    ):
+        self._client = client
+        self._namespace = namespace
+        self._selector = f"job={job_name}"
+        self._on_event = on_event
+        self._interval_s = interval_s
+        self._known: dict[int, str] = {}
+        self._stopped = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def poll_once(self) -> list[PodEvent]:
+        pods = self._client.list_pods(self._namespace, self._selector)
+        current: dict[int, str] = {}
+        for p in pods:
+            labels = p.get("metadata", {}).get("labels", {})
+            if "node-id" in labels:
+                current[int(labels["node-id"])] = p["metadata"]["name"]
+        events: list[PodEvent] = []
+        for nid, name in current.items():
+            if nid not in self._known:
+                events.append(PodEvent(PodEvent.ADDED, nid, name))
+        for nid, name in self._known.items():
+            if nid not in current:
+                events.append(PodEvent(PodEvent.DELETED, nid, name))
+        self._known = current
+        for e in events:
+            try:
+                self._on_event(e)
+            except Exception:  # noqa: BLE001 - one handler error must not
+                logger.exception("pod event handler failed")  # stop the diff
+        return events
+
+    def start(self) -> None:
+        def loop():
+            while not self._stopped.wait(self._interval_s):
+                try:
+                    self.poll_once()
+                except Exception:  # noqa: BLE001
+                    logger.exception("pod watch poll failed")
+
+        self._thread = threading.Thread(
+            target=loop, name="pod-watcher", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+
+def wire_to_node_manager(
+    node_manager,
+    was_intentional: Callable[[int], bool] | None = None,
+) -> Callable[[PodEvent], None]:
+    """Event handler marking vanished pods' nodes failed immediately
+    (instead of waiting out the heartbeat dead-window).
+
+    ``was_intentional`` (typically ``scaler.consume_intentional_removal``)
+    distinguishes scale-down deletions from failures — without it a
+    deliberate removal would be "failed" and the relaunch hook would
+    recreate the pod the scaler just deleted.
+    """
+    from dlrover_tpu.common.constants import NodeExitReason, NodeStatus
+
+    def on_event(event: PodEvent) -> None:
+        if event.kind != PodEvent.DELETED:
+            return
+        if was_intentional is not None and was_intentional(event.node_id):
+            logger.info(
+                "pod %s (node %d) removed by the scaler; marking deleted",
+                event.pod_name, event.node_id,
+            )
+            node_manager.update_status(
+                event.node_id, NodeStatus.DELETED,
+                NodeExitReason.SUCCEEDED,
+            )
+            return
+        logger.warning(
+            "pod %s (node %d) deleted out-of-band", event.pod_name,
+            event.node_id,
+        )
+        node_manager.update_status(
+            event.node_id, NodeStatus.FAILED, NodeExitReason.KILLED
+        )
+
+    return on_event

@@ -1,0 +1,82 @@
+"""Initial hyperparameter suggestion from job/hardware shape.
+
+Reference analog: dlrover/python/master/hyperparams/
+simple_strategy_generator.py (SimpleStrategyGenerator — initial DDP batch
+size / LR suggestions from resource shape). TPU version: suggest the
+micro batch from HBM headroom, global batch from the data-parallel world,
+and LR by square-root batch scaling from a reference point — published as
+the initial ParalConfig so trainers read it the same way as runtime
+retunes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+# rule-of-thumb bytes per parameter during training: params + grads +
+# Adam moments in f32 plus bf16 compute copies
+TRAIN_BYTES_PER_PARAM = 18.0
+# activation bytes per token per layer-width unit at bf16 with remat
+ACT_BYTES_PER_TOKEN_WIDTH = 4.0
+
+
+@dataclasses.dataclass
+class SuggestedConfig:
+    micro_batch_size: int
+    global_batch_size: int
+    grad_accum_steps: int
+    learning_rate: float
+
+
+def suggest_initial(
+    *,
+    n_params: int,
+    d_model: int,
+    n_layers: int,
+    seq_len: int,
+    num_devices: int,
+    hbm_bytes_per_device: int = 16 * (1 << 30),
+    base_lr: float = 3e-4,
+    base_global_batch: int = 256,
+    target_global_batch: int | None = None,
+) -> SuggestedConfig:
+    """Initial batch geometry + LR for a dense transformer job.
+
+    ``base_lr`` is assumed tuned at ``base_global_batch``; LR transfers by
+    square-root batch scaling. The micro batch fills the per-device HBM
+    headroom left after model state.
+    """
+    state_bytes = n_params * TRAIN_BYTES_PER_PARAM / num_devices
+    headroom = max(
+        hbm_bytes_per_device * 0.9 - state_bytes,
+        hbm_bytes_per_device * 0.05,
+    )
+    act_per_sample = (
+        seq_len * d_model * n_layers * ACT_BYTES_PER_TOKEN_WIDTH
+    )
+    micro = max(1, int(headroom // max(act_per_sample, 1)))
+    micro = 1 << (micro.bit_length() - 1)  # round down to a power of two
+    micro = min(micro, 64)
+
+    if target_global_batch is None:
+        target_global_batch = max(
+            base_global_batch, micro * num_devices
+        )
+    accum = max(
+        1, math.ceil(target_global_batch / (micro * num_devices))
+    )
+    global_batch = micro * num_devices * accum
+    lr = base_lr * math.sqrt(global_batch / base_global_batch)
+    suggestion = SuggestedConfig(
+        micro_batch_size=micro,
+        global_batch_size=global_batch,
+        grad_accum_steps=accum,
+        learning_rate=round(lr, 6),
+    )
+    logger.info("initial HP suggestion: %s", suggestion)
+    return suggestion

@@ -145,17 +145,22 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
             )
         )(jax.random.split(ks[4], c.n_layers))
         layers.update(moe)
-    elif c.variant == "llama":
-        layers["w_gate"] = stack(ks[4], (c.d_model, c.d_ff), c.d_model)
-        layers["w_down"] = stack(ks[5], (c.d_ff, c.d_model), c.d_ff)
-        layers["w_up"] = stack(ks[6], (c.d_model, c.d_ff), c.d_model)
     else:
         layers["w_gate"] = stack(ks[4], (c.d_model, c.d_ff), c.d_model)
         layers["w_down"] = stack(ks[5], (c.d_ff, c.d_model), c.d_ff)
-        layers["b_ff"] = jnp.zeros((c.n_layers, c.d_ff), jnp.float32)
-        layers["b_out"] = jnp.zeros((c.n_layers, c.d_model), jnp.float32)
-        layers["ln1_b"] = jnp.zeros((c.n_layers, c.d_model), jnp.float32)
-        layers["ln2_b"] = jnp.zeros((c.n_layers, c.d_model), jnp.float32)
+        if c.variant == "llama":
+            layers["w_up"] = stack(ks[6], (c.d_model, c.d_ff), c.d_model)
+        else:
+            layers["b_ff"] = jnp.zeros((c.n_layers, c.d_ff), jnp.float32)
+            layers["b_out"] = jnp.zeros(
+                (c.n_layers, c.d_model), jnp.float32
+            )
+            layers["ln1_b"] = jnp.zeros(
+                (c.n_layers, c.d_model), jnp.float32
+            )
+            layers["ln2_b"] = jnp.zeros(
+                (c.n_layers, c.d_model), jnp.float32
+            )
     params = {
         "embed": dense(k_embed, (c.vocab_size, c.d_model), c.d_model),
         "layers": layers,
@@ -192,17 +197,16 @@ def logical_axes(cfg: TransformerConfig) -> Params:
             name: ("layers", *axes)
             for name, axes in moe_logical_axes().items()
         })
-    elif c.variant == "llama":
-        layers["w_gate"] = ("layers", "embed", "mlp")
-        layers["w_down"] = ("layers", "mlp", "embed")
-        layers["w_up"] = ("layers", "embed", "mlp")
     else:
         layers["w_gate"] = ("layers", "embed", "mlp")
         layers["w_down"] = ("layers", "mlp", "embed")
-        layers["b_ff"] = ("layers", "mlp")
-        layers["b_out"] = ("layers", None)
-        layers["ln1_b"] = ("layers", None)
-        layers["ln2_b"] = ("layers", None)
+        if c.variant == "llama":
+            layers["w_up"] = ("layers", "embed", "mlp")
+        else:
+            layers["b_ff"] = ("layers", "mlp")
+            layers["b_out"] = ("layers", None)
+            layers["ln1_b"] = ("layers", None)
+            layers["ln2_b"] = ("layers", None)
     tree = {
         "embed": ("vocab", "embed"),
         "layers": layers,
@@ -418,9 +422,8 @@ def loss_fn(
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    mask = batch.get("mask")
-    if mask is not None:
-        m = mask[:, 1:].astype(nll.dtype)
+    if in_mask is not None:
+        m = in_mask[:, 1:].astype(nll.dtype)
         ce = (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
     else:
         ce = nll.mean()

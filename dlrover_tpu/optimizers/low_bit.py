@@ -76,10 +76,22 @@ def adam_8bit(
     b2: float = 0.999,
     eps: float = 1e-8,
     block_size: int = 256,
+    min_8bit_size: int = 4096,
 ) -> optax.GradientTransformation:
-    """Adam whose m/v live as int8 blockwise-quantized tensors."""
+    """Adam whose m/v live as int8 blockwise-quantized tensors.
+
+    Leaves smaller than ``min_8bit_size`` keep fp32 moments (bitsandbytes
+    convention): padding a (3,) bias to a 256-wide int8 block would COST
+    memory, and norm/bias leaves are precisely where moment precision
+    matters most.
+    """
+
+    def small(p) -> bool:
+        return p.size < min_8bit_size
 
     def q_zero(p):
+        if small(p):
+            return jnp.zeros(p.shape, jnp.float32)
         n_blocks = _pad_len(p.size, block_size) // block_size
         return _Quantized(
             codes=jnp.zeros((n_blocks, block_size), jnp.int8),
@@ -98,16 +110,19 @@ def adam_8bit(
         count = state.count + 1
 
         def leaf_update(g, mu_q, nu_q):
-            m = _dequantize(mu_q.codes, mu_q.scales, g.shape,
-                            block_size, signed=True)
-            # v is stored in the sqrt domain: its raw dynamic range spans
-            # many orders of magnitude within a block, and linear int8
-            # would crush small entries to 0 (vhat ~ 0 -> exploding
-            # steps); sqrt halves the log-range, bounding the relative
-            # error of the Adam denominator
-            r = _dequantize(nu_q.codes, nu_q.scales, g.shape,
-                            block_size, signed=False)
-            v = r * r
+            if not isinstance(mu_q, _Quantized):
+                m, v = mu_q, nu_q  # small leaf: fp32 moments
+            else:
+                m = _dequantize(mu_q.codes, mu_q.scales, g.shape,
+                                block_size, signed=True)
+                # v is stored in the sqrt domain: its raw dynamic range
+                # spans many orders of magnitude within a block, and
+                # linear int8 would crush small entries to 0 (vhat ~ 0 ->
+                # exploding steps); sqrt halves the log-range, bounding
+                # the relative error of the Adam denominator
+                r = _dequantize(nu_q.codes, nu_q.scales, g.shape,
+                                block_size, signed=False)
+                v = r * r
             g32 = g.astype(jnp.float32)
             m = b1 * m + (1.0 - b1) * g32
             v = b2 * v + (1.0 - b2) * g32 * g32
@@ -120,6 +135,8 @@ def adam_8bit(
                 if callable(learning_rate) else learning_rate
             )
             step = (-lr * mhat / (jnp.sqrt(vhat) + eps)).astype(g.dtype)
+            if not isinstance(mu_q, _Quantized):
+                return step, m, v
             m_q = _Quantized(*_quantize(m, block_size, signed=True))
             v_q = _Quantized(
                 *_quantize(jnp.sqrt(v), block_size, signed=False)

@@ -243,6 +243,120 @@ class TestOptimizer:
         ).memory_mb
 
 
+class TestPodWatcher:
+    def test_diff_events_and_node_failure_wiring(self):
+        from dlrover_tpu.cluster.watcher import (
+            PodEvent,
+            PodWatcher,
+            wire_to_node_manager,
+        )
+        from dlrover_tpu.common.constants import NodeStatus
+        from dlrover_tpu.master.node_manager import NodeManager
+
+        kube = FakeKube()
+        op = ElasticJobOperator(kube)
+        op.apply_job(_job(workers=2))
+        nm = NodeManager()
+        nm.ensure_node(0)
+        nm.ensure_node(1)
+        events: list = []
+
+        handler = wire_to_node_manager(nm)
+        watcher = PodWatcher(
+            kube, "default", "train1",
+            on_event=lambda e: (events.append(e), handler(e)),
+        )
+        added = watcher.poll_once()
+        assert {e.kind for e in added} == {PodEvent.ADDED}
+        # a worker pod vanishes out-of-band (preemption)
+        kube.delete_pod("default", "train1-worker-1")
+        deleted = watcher.poll_once()
+        assert [e.kind for e in deleted] == [PodEvent.DELETED]
+        assert deleted[0].node_id == 1
+        # the node failed immediately — no dead-window wait
+        nodes = {n.node_id: n for n in nm.all_nodes()}
+        assert nodes[1].status == NodeStatus.FAILED
+        assert nodes[0].status == NodeStatus.RUNNING
+
+
+class TestWatcherScalerCoordination:
+    def test_intentional_scale_down_is_not_a_failure(self):
+        from dlrover_tpu.cluster.watcher import (
+            PodWatcher,
+            wire_to_node_manager,
+        )
+        from dlrover_tpu.common.constants import NodeStatus
+        from dlrover_tpu.master.node_manager import NodeManager
+
+        kube = FakeKube()
+        job = _job(workers=2)
+        scaler = PodScaler(job, kube, "m:5001")
+        scaler.scale(ScalePlan(replica_resources={"worker": 2}))
+        nm = NodeManager()
+        relaunched = []
+        nm._relaunch_hook = relaunched.append
+        nm.ensure_node(0)
+        nm.ensure_node(1)
+        watcher = PodWatcher(
+            kube, "default", "train1",
+            on_event=wire_to_node_manager(
+                nm, was_intentional=scaler.consume_intentional_removal
+            ),
+        )
+        watcher.poll_once()  # learn the 2 pods
+        # deliberate scale-down to 1
+        scaler.scale(ScalePlan(replica_resources={"worker": 1}))
+        watcher.poll_once()
+        nodes = {n.node_id: n for n in nm.all_nodes()}
+        assert nodes[1].status == NodeStatus.DELETED
+        assert relaunched == [], "scaler and watcher fought"
+        # a LATER out-of-band vanish of the surviving pod is a failure
+        kube.delete_pod("default", "train1-worker-0")
+        watcher.poll_once()
+        nodes = {n.node_id: n for n in nm.all_nodes()}
+        assert nodes[0].status == NodeStatus.FAILED
+
+
+class TestHyperparams:
+    def test_suggestion_shape(self):
+        from dlrover_tpu.master.hyperparams import suggest_initial
+
+        s = suggest_initial(
+            n_params=7_000_000_000, d_model=4096, n_layers=32,
+            seq_len=4096, num_devices=128,
+        )
+        assert s.micro_batch_size >= 1
+        assert s.global_batch_size == (
+            s.micro_batch_size * 128 * s.grad_accum_steps
+        )
+        assert s.learning_rate > 0
+
+    def test_lr_sqrt_scaling(self):
+        from dlrover_tpu.master.hyperparams import suggest_initial
+
+        small = suggest_initial(
+            n_params=100e6, d_model=768, n_layers=12, seq_len=1024,
+            num_devices=8, target_global_batch=256,
+        )
+        big = suggest_initial(
+            n_params=100e6, d_model=768, n_layers=12, seq_len=1024,
+            num_devices=8, target_global_batch=1024,
+        )
+        ratio = big.learning_rate / small.learning_rate
+        expected = (big.global_batch_size / small.global_batch_size) ** 0.5
+        assert ratio == pytest.approx(expected, rel=0.05)
+
+    def test_tiny_hbm_still_trains(self):
+        from dlrover_tpu.master.hyperparams import suggest_initial
+
+        s = suggest_initial(
+            n_params=1_000_000_000, d_model=2048, n_layers=24,
+            seq_len=8192, num_devices=1,
+            hbm_bytes_per_device=16 * (1 << 30),
+        )
+        assert s.micro_batch_size >= 1
+
+
 class TestAutoScaler:
     def test_initial_scale_and_failure_replan(self):
         from dlrover_tpu.master.auto_scaler import JobAutoScaler
