@@ -37,17 +37,17 @@ def load_orbax(path: str, template: Any | None = None,
     ckptr = ocp.StandardCheckpointer()
     if template is None:
         return ckptr.restore(os.path.abspath(path))
-    abstract = jax.tree.map(
-        lambda leaf, s=None: jax.ShapeDtypeStruct(
-            leaf.shape, leaf.dtype
-        ),
-        template,
-    )
     if shardings is not None:
         abstract = jax.tree.map(
-            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype,
-                                              sharding=s),
-            abstract, shardings,
+            lambda leaf, s: jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype, sharding=s
+            ),
+            template, shardings,
+        )
+    else:
+        abstract = jax.tree.map(
+            lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype),
+            template,
         )
     return ckptr.restore(os.path.abspath(path), abstract)
 
@@ -80,7 +80,12 @@ def import_orbax_to_flash(engine, orbax_path: str, step: int,
     state = load_orbax(orbax_path, template)
     if persist:
         engine.save_to_storage(step, state)
-        engine.wait_for_persist(step, timeout=300)
+        if not engine.wait_for_persist(step, timeout=300):
+            raise TimeoutError(
+                f"imported checkpoint (step {step}) was not committed to "
+                "storage within 300s — the elastic run would silently "
+                "start from scratch on a restart"
+            )
     else:
         engine.save_to_memory(step, state)
     logger.info("imported orbax %s as flash checkpoint step %d",

@@ -186,10 +186,13 @@ class PodScaler(Scaler):
         self._next_node_id = 0
         # per-node memory bumps from OOM-recovery plans; survive relaunches
         self._memory_mb: dict[int, int] = {}
-        # nodes this scaler deleted ON PURPOSE (scale-down / remove):
-        # the pod watcher consults this so an intentional removal is not
-        # mistaken for a failure and relaunched
-        self._intentional_removals: set[int] = set()
+        # nodes whose pod this scaler deleted ON PURPOSE (scale-down /
+        # remove / the delete half of a relaunch), with mark times: the
+        # pod watcher consults this so an intentional deletion is not
+        # mistaken for a failure and double-relaunched. Marks expire so a
+        # stale one can't mask a later genuine failure.
+        self._intentional_removals: dict[int, float] = {}
+        self._intentional_ttl_s = 60.0
 
     def update_job(self, job: ElasticJob) -> None:
         """Adopt a resubmitted job spec (new image/resources/command)."""
@@ -197,20 +200,21 @@ class PodScaler(Scaler):
             self._job = job
 
     def _manifest(self, node_id: int) -> dict:
-        self._intentional_removals.discard(node_id)  # it's coming back
         return worker_pod_manifest(
             self._job, self._group, node_id, self._master_addr,
             memory_mb_override=self._memory_mb.get(node_id, 0),
         )
 
     def consume_intentional_removal(self, node_id: int) -> bool:
-        """True when this scaler deliberately deleted the node's pod
-        (consumed once — a later unexpected vanish counts as failure)."""
+        """True when this scaler recently and deliberately deleted the
+        node's pod (consumed once; marks expire after a TTL so a stale
+        one can't mask a later genuine failure)."""
+        import time as _time
+
         with self._lock:
-            if node_id in self._intentional_removals:
-                self._intentional_removals.discard(node_id)
-                return True
-            return False
+            marked = self._intentional_removals.pop(node_id, None)
+            return (marked is not None
+                    and _time.time() - marked < self._intentional_ttl_s)
 
     def _live_pods(self) -> dict[int, dict]:
         pods = self._client.list_pods(
@@ -233,9 +237,12 @@ class PodScaler(Scaler):
                 self._next_node_id = max(
                     self._next_node_id, max(live) + 1
                 )
+            import time as _time
+
+            now = _time.time()
             for nid in plan.remove_nodes:
                 if nid in live:
-                    self._intentional_removals.add(nid)
+                    self._intentional_removals[nid] = now
                     self._client.delete_pod(
                         self._job.namespace,
                         live[nid]["metadata"]["name"],
@@ -243,6 +250,10 @@ class PodScaler(Scaler):
                     live.pop(nid)
             for nid in plan.relaunch_nodes:
                 if nid in live:
+                    # the delete half of a relaunch is also intentional:
+                    # a watcher poll landing between delete and the
+                    # replacement appearing must not double-relaunch
+                    self._intentional_removals[nid] = now
                     self._client.delete_pod(
                         self._job.namespace,
                         live[nid]["metadata"]["name"],
@@ -255,7 +266,7 @@ class PodScaler(Scaler):
                 return
             while len(live) > target:
                 nid = max(live)
-                self._intentional_removals.add(nid)
+                self._intentional_removals[nid] = now
                 self._client.delete_pod(
                     self._job.namespace, live.pop(nid)["metadata"]["name"]
                 )

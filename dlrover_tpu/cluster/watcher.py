@@ -43,10 +43,14 @@ class PodWatcher:
         job_name: str,
         on_event: Callable[[PodEvent], None],
         interval_s: float = 5.0,
+        group: str = "worker",
     ):
+        # scoped to one replica group: node ids restart at 0 per group,
+        # so a job-wide diff keyed by node id would collide groups (run
+        # one watcher per group, like one scaler per group)
         self._client = client
         self._namespace = namespace
-        self._selector = f"job={job_name}"
+        self._selector = f"job={job_name},group={group}"
         self._on_event = on_event
         self._interval_s = interval_s
         self._known: dict[int, str] = {}

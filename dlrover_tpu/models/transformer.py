@@ -284,9 +284,11 @@ def forward_with_aux(
     attention_fn: AttentionFn | None = None,
     constrain: Callable[[jax.Array, tuple], jax.Array] | None = None,
     mask: jax.Array | None = None,
+    return_hidden: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """(logits, aux_loss). aux is the MoE load-balancing term (0 when
-    the model has no experts).
+    the model has no experts). ``return_hidden`` yields the final normed
+    hidden states instead of logits (value heads, probes).
 
     ``constrain(x, logical_axes)`` optionally pins activation shardings
     (supplied by the strategy layer); identity when absent.
@@ -371,6 +373,8 @@ def forward_with_aux(
     )
 
     x = _norm(x, params["ln_f"], params.get("ln_f_b"), c.variant)
+    if return_hidden:
+        return x, aux
     logits = jnp.einsum("bse,ev->bsv", x, params["lm_head"].astype(dt))
     if c.mup_base_width:
         # muP readout multiplier keeps logit scale width-invariant

@@ -1,0 +1,269 @@
+"""RLHF: PPO fine-tuning of the bundled transformer, pure JAX.
+
+Reference analog: ATorch's RL framework (atorch/atorch/rl/ — PPO trainer
+rl/trainer/ppo_trainer.py, model_engine with per-model strategies, replay
+buffer). TPU-native shape: the four-model setup (actor, critic, reference,
+reward) is three parameter trees over ONE transformer implementation (the
+critic is a value head on actor hiddens; the reward model is a caller
+callable — often a learned model, here any scorer), sampling runs as a
+``lax.scan`` over decode steps under jit, and the whole PPO update is a
+single jitted function, shardable by the same strategy layer as
+pretraining. The reference's vLLM inference backend maps to future work
+(a KV-cached decode path); this sampler recomputes the prefix per step,
+which is fine at RLHF's short generation lengths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.models import transformer as tfm
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class PPOConfig:
+    gen_len: int = 16
+    temperature: float = 1.0
+    gamma: float = 1.0
+    lam: float = 0.95
+    clip_eps: float = 0.2
+    value_coef: float = 0.5
+    entropy_coef: float = 0.0
+    kl_coef: float = 0.1         # per-token KL penalty vs the reference
+    ppo_epochs: int = 2
+    learning_rate: float = 1e-4
+
+
+def init_actor_critic(cfg: tfm.TransformerConfig, key: jax.Array) -> dict:
+    """Actor params + a value head over the actor's final hiddens."""
+    k_model, k_head = jax.random.split(key)
+    return {
+        "model": tfm.init_params(cfg, k_model),
+        "value_head": jax.random.normal(
+            k_head, (cfg.d_model,), jnp.float32
+        ) / np.sqrt(cfg.d_model),
+    }
+
+
+# ----------------------------------------------------------------- rollout
+
+
+def sample(params: dict, prompts: jax.Array, cfg: tfm.TransformerConfig,
+           ppo: PPOConfig, key: jax.Array) -> jax.Array:
+    """Autoregressive sampling: [B, P] prompts -> [B, P+gen_len] tokens."""
+    B, P = prompts.shape
+    total = P + ppo.gen_len
+    tokens = jnp.zeros((B, total), jnp.int32).at[:, :P].set(prompts)
+
+    def step(carry, key):
+        tokens, pos = carry
+        logits, _ = tfm.forward_with_aux(params["model"], tokens, cfg)
+        next_logits = jnp.take_along_axis(
+            logits, (pos - 1)[None, None, None].repeat(B, 0), axis=1
+        )[:, 0] / max(ppo.temperature, 1e-6)
+        nxt = jax.random.categorical(key, next_logits, axis=-1)
+        tokens = jax.lax.dynamic_update_slice(
+            tokens, nxt[:, None].astype(jnp.int32), (0, pos)
+        )
+        return (tokens, pos + 1), None
+
+    keys = jax.random.split(key, ppo.gen_len)
+    (tokens, _), _ = jax.lax.scan(step, (tokens, jnp.asarray(P)), keys)
+    return tokens
+
+
+def sequence_logprobs_and_values(
+    params: dict, tokens: jax.Array, cfg: tfm.TransformerConfig
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """(logprobs [B, S-1], values [B, S-1], entropy [B, S-1])."""
+    logits, _ = tfm.forward_with_aux(params["model"], tokens[:, :-1], cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    taken = jnp.take_along_axis(
+        logp, tokens[:, 1:][..., None], axis=-1
+    )[..., 0]
+    hidden, _ = tfm.forward_with_aux(
+        params["model"], tokens[:, :-1], cfg, return_hidden=True
+    )
+    values = jnp.einsum(
+        "bsd,d->bs", hidden.astype(jnp.float32), params["value_head"]
+    )
+    probs = jnp.exp(logp)
+    entropy = -(probs * logp).sum(-1)
+    return taken, values, entropy
+
+
+def gae_advantages(rewards: jax.Array, values: jax.Array, gamma: float,
+                   lam: float) -> tuple[jax.Array, jax.Array]:
+    """Generalized advantage estimation over [B, T] (terminal at T-1).
+
+    Returns (advantages, returns)."""
+    B, T = rewards.shape
+    next_values = jnp.concatenate(
+        [values[:, 1:], jnp.zeros((B, 1), values.dtype)], axis=1
+    )
+    deltas = rewards + gamma * next_values - values
+
+    def back(carry, x):
+        delta = x
+        adv = delta + gamma * lam * carry
+        return adv, adv
+
+    _, adv_rev = jax.lax.scan(
+        back, jnp.zeros((B,), values.dtype),
+        jnp.moveaxis(deltas, 1, 0)[::-1],
+    )
+    advantages = jnp.moveaxis(adv_rev[::-1], 0, 1)
+    return advantages, advantages + values
+
+
+# ------------------------------------------------------------------ update
+
+
+def ppo_loss(params: dict, batch: dict, cfg: tfm.TransformerConfig,
+             ppo: PPOConfig) -> tuple[jax.Array, dict]:
+    """Clipped-surrogate PPO over the generated region."""
+    logp, values, entropy = sequence_logprobs_and_values(
+        params, batch["tokens"], cfg
+    )
+    mask = batch["gen_mask"]          # [B, S-1]: 1 on generated positions
+    ratio = jnp.exp(logp - batch["old_logp"])
+    adv = batch["advantages"]
+    surr = jnp.minimum(
+        ratio * adv,
+        jnp.clip(ratio, 1 - ppo.clip_eps, 1 + ppo.clip_eps) * adv,
+    )
+    denom = jnp.maximum(mask.sum(), 1.0)
+    policy_loss = -(surr * mask).sum() / denom
+    value_loss = (((values - batch["returns"]) ** 2) * mask).sum() / denom
+    ent = (entropy * mask).sum() / denom
+    loss = (policy_loss + ppo.value_coef * value_loss
+            - ppo.entropy_coef * ent)
+    return loss, {
+        "policy_loss": policy_loss,
+        "value_loss": value_loss,
+        "entropy": ent,
+    }
+
+
+class ReplayBuffer:
+    """Host-side rollout store (reference: rl/replay_buffer)."""
+
+    def __init__(self, capacity: int = 64):
+        self._capacity = capacity
+        self._items: list[dict] = []
+
+    def add(self, batch: dict) -> None:
+        self._items.append(jax.device_get(batch))
+        if len(self._items) > self._capacity:
+            self._items.pop(0)
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def sample(self, rng: np.random.Generator, n: int = 1) -> list[dict]:
+        idx = rng.choice(len(self._items), size=min(n, len(self._items)),
+                         replace=False)
+        return [self._items[i] for i in idx]
+
+
+class PPOTrainer:
+    """Generate -> score -> advantage -> clipped updates.
+
+    ``reward_fn(tokens [B, S] np) -> [B] np`` scores full sequences (the
+    reward-model slot). The reference model for the KL penalty is the
+    frozen initial actor.
+    """
+
+    def __init__(self, cfg: tfm.TransformerConfig, ppo: PPOConfig,
+                 reward_fn: Callable[[np.ndarray], np.ndarray],
+                 key: jax.Array, optimizer=None):
+        import optax
+
+        self.cfg = cfg
+        self.ppo = ppo
+        self.reward_fn = reward_fn
+        self.params = init_actor_critic(cfg, key)
+        self.ref_params = jax.tree.map(lambda x: x, self.params)
+        self.opt = optimizer or optax.adam(ppo.learning_rate)
+        self.opt_state = self.opt.init(self.params)
+        self.buffer = ReplayBuffer()
+        self._sample = jax.jit(
+            partial(sample, cfg=cfg, ppo=ppo), static_argnames=()
+        )
+        self._logp_values = jax.jit(
+            partial(sequence_logprobs_and_values, cfg=cfg)
+        )
+
+        def update(params, opt_state, batch):
+            (loss, metrics), grads = jax.value_and_grad(
+                ppo_loss, has_aux=True
+            )(params, batch, cfg, ppo)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            import optax as _optax
+
+            params = _optax.apply_updates(params, updates)
+            metrics["loss"] = loss
+            return params, opt_state, metrics
+
+        self._update = jax.jit(update)
+
+    def rollout(self, prompts: np.ndarray, key: jax.Array) -> dict:
+        """One PPO batch from prompts [B, P]."""
+        P = prompts.shape[1]
+        tokens = self._sample(self.params, jnp.asarray(prompts), key=key)
+        logp, values, _ = self._logp_values(self.params, tokens)
+        ref_logp, _, _ = self._logp_values(self.ref_params, tokens)
+
+        S1 = tokens.shape[1] - 1
+        gen_mask = (jnp.arange(S1) >= P - 1).astype(jnp.float32)[None, :]
+        gen_mask = jnp.broadcast_to(gen_mask, logp.shape)
+
+        # per-token reward: -kl penalty, plus the sequence score on the
+        # final generated token (standard RLHF shaping)
+        kl = logp - ref_logp
+        scores = jnp.asarray(
+            self.reward_fn(np.asarray(jax.device_get(tokens))),
+            jnp.float32,
+        )
+        rewards = -self.ppo.kl_coef * kl * gen_mask
+        rewards = rewards.at[:, -1].add(scores)
+
+        adv, returns = gae_advantages(
+            rewards, values * gen_mask, self.ppo.gamma, self.ppo.lam
+        )
+        adv_mean = (adv * gen_mask).sum() / jnp.maximum(gen_mask.sum(), 1)
+        adv_std = jnp.sqrt(
+            (((adv - adv_mean) ** 2) * gen_mask).sum()
+            / jnp.maximum(gen_mask.sum(), 1)
+        )
+        adv = (adv - adv_mean) / (adv_std + 1e-8)
+        batch = {
+            "tokens": tokens,
+            "old_logp": logp,
+            "advantages": adv,
+            "returns": returns,
+            "gen_mask": gen_mask,
+            "score_mean": scores.mean(),
+        }
+        self.buffer.add(batch)
+        return batch
+
+    def train_step(self, prompts: np.ndarray, key: jax.Array) -> dict:
+        batch = self.rollout(prompts, key)
+        metrics = {}
+        for _ in range(self.ppo.ppo_epochs):
+            self.params, self.opt_state, metrics = self._update(
+                self.params, self.opt_state, batch
+            )
+        metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
+        metrics["score_mean"] = float(batch["score_mean"])
+        return metrics

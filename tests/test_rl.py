@@ -1,0 +1,111 @@
+"""PPO/RLHF: GAE math, loss behavior, and a toy end-to-end improvement.
+
+Reference analog: atorch/atorch/rl tests (trainer-level behavior).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.models import transformer as tfm
+from dlrover_tpu.rl.ppo import (
+    PPOConfig,
+    PPOTrainer,
+    gae_advantages,
+    init_actor_critic,
+    ppo_loss,
+    sample,
+)
+
+
+def _np_gae(rewards, values, gamma, lam):
+    B, T = rewards.shape
+    next_v = np.concatenate([values[:, 1:], np.zeros((B, 1))], axis=1)
+    deltas = rewards + gamma * next_v - values
+    adv = np.zeros_like(deltas)
+    run = np.zeros(B)
+    for t in reversed(range(T)):
+        run = deltas[:, t] + gamma * lam * run
+        adv[:, t] = run
+    return adv, adv + values
+
+
+class TestGae:
+    def test_matches_numpy_reference(self):
+        rng = np.random.default_rng(0)
+        r = rng.standard_normal((3, 7)).astype(np.float32)
+        v = rng.standard_normal((3, 7)).astype(np.float32)
+        adv, ret = gae_advantages(jnp.asarray(r), jnp.asarray(v),
+                                  gamma=0.9, lam=0.8)
+        adv_np, ret_np = _np_gae(r, v, 0.9, 0.8)
+        np.testing.assert_allclose(np.asarray(adv), adv_np, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(ret), ret_np, atol=1e-5)
+
+
+class TestSampleAndLoss:
+    def setup_method(self):
+        self.cfg = tfm.CONFIGS["tiny"]
+        self.ppo = PPOConfig(gen_len=4)
+        self.params = init_actor_critic(self.cfg, jax.random.PRNGKey(0))
+
+    def test_sample_extends_prompts(self):
+        prompts = jnp.asarray([[1, 2, 3], [4, 5, 6]], jnp.int32)
+        out = sample(self.params, prompts, self.cfg, self.ppo,
+                     jax.random.PRNGKey(1))
+        assert out.shape == (2, 7)
+        np.testing.assert_array_equal(np.asarray(out[:, :3]),
+                                      np.asarray(prompts))
+        assert (np.asarray(out[:, 3:]) < self.cfg.vocab_size).all()
+
+    def test_loss_zero_advantage_policy_term(self):
+        tokens = jnp.ones((2, 8), jnp.int32)
+        from dlrover_tpu.rl.ppo import sequence_logprobs_and_values
+
+        logp, values, _ = sequence_logprobs_and_values(
+            self.params, tokens, self.cfg
+        )
+        batch = {
+            "tokens": tokens,
+            "old_logp": logp,
+            "advantages": jnp.zeros_like(logp),
+            "returns": values,
+            "gen_mask": jnp.ones_like(logp),
+        }
+        loss, metrics = ppo_loss(batch=batch, params=self.params,
+                                 cfg=self.cfg, ppo=self.ppo)
+        # same params, zero advantage, returns==values -> ~zero loss
+        assert abs(float(metrics["policy_loss"])) < 1e-5
+        assert abs(float(metrics["value_loss"])) < 1e-5
+
+
+class TestToyRlhf:
+    def test_reward_improves(self):
+        """Dense reward: fraction of generated tokens with low ids; PPO
+        should push the policy toward them within a few iterations."""
+        cfg = tfm.CONFIGS["tiny"]
+        ppo = PPOConfig(gen_len=8, ppo_epochs=4, learning_rate=2e-2,
+                        kl_coef=0.0)
+
+        def reward_fn(tokens: np.ndarray) -> np.ndarray:
+            gen = tokens[:, -ppo.gen_len:]
+            return (gen < cfg.vocab_size // 8).mean(axis=1).astype(
+                np.float32
+            )
+
+        trainer = PPOTrainer(cfg, ppo, reward_fn, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        scores = []
+        for i in range(12):
+            prompts = rng.integers(0, cfg.vocab_size, (16, 4)).astype(
+                np.int32
+            )
+            m = trainer.train_step(prompts, jax.random.PRNGKey(100 + i))
+            scores.append(m["score_mean"])
+        early = np.mean(scores[:2])
+        late = np.mean(scores[-2:])
+        assert late > early + 0.2, scores
+        assert len(trainer.buffer) == 12
