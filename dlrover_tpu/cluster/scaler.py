@@ -250,8 +250,8 @@ class PodScaler(Scaler):
                     live.pop(nid)
             for nid in plan.relaunch_nodes:
                 if nid in live:
-                    # the delete half of a relaunch is also intentional:
-                    # a watcher poll landing between delete and the
+                    # the delete half of a relaunch is intentional: a
+                    # watcher poll landing between delete and the
                     # replacement appearing must not double-relaunch
                     self._intentional_removals[nid] = now
                     self._client.delete_pod(
@@ -261,6 +261,12 @@ class PodScaler(Scaler):
                 manifest = self._manifest(nid)
                 self._client.create_pod(self._job.namespace, manifest)
                 live[nid] = manifest
+                # replacement exists: clear the mark, or a genuine
+                # failure of the NEW pod within the TTL would read as
+                # intentional and the node would be silently lost (a
+                # watcher that polls faster than delete+create never
+                # emits an event to consume it)
+                self._intentional_removals.pop(nid, None)
             target = plan.replica_resources.get(self._group)
             if target is None:
                 return

@@ -84,15 +84,25 @@ def sample(params: dict, prompts: jax.Array, cfg: tfm.TransformerConfig,
 def sequence_logprobs_and_values(
     params: dict, tokens: jax.Array, cfg: tfm.TransformerConfig
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """(logprobs [B, S-1], values [B, S-1], entropy [B, S-1])."""
-    logits, _ = tfm.forward_with_aux(params["model"], tokens[:, :-1], cfg)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    taken = jnp.take_along_axis(
-        logp, tokens[:, 1:][..., None], axis=-1
-    )[..., 0]
+    """(logprobs [B, S-1], values [B, S-1], entropy [B, S-1]).
+
+    One forward: logits and the value head both read the same hidden
+    states (running the transformer twice would double the RLHF loop's
+    FLOPs and activation memory).
+    """
     hidden, _ = tfm.forward_with_aux(
         params["model"], tokens[:, :-1], cfg, return_hidden=True
     )
+    logits = jnp.einsum(
+        "bse,ev->bsv", hidden,
+        params["model"]["lm_head"].astype(hidden.dtype),
+    )
+    if cfg.mup_base_width:
+        logits = logits * (cfg.mup_base_width / cfg.d_model)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    taken = jnp.take_along_axis(
+        logp, tokens[:, 1:][..., None], axis=-1
+    )[..., 0]
     values = jnp.einsum(
         "bsd,d->bs", hidden.astype(jnp.float32), params["value_head"]
     )
@@ -185,7 +195,8 @@ class PPOTrainer:
 
     def __init__(self, cfg: tfm.TransformerConfig, ppo: PPOConfig,
                  reward_fn: Callable[[np.ndarray], np.ndarray],
-                 key: jax.Array, optimizer=None):
+                 key: jax.Array, optimizer=None,
+                 store_rollouts: bool = False):
         import optax
 
         self.cfg = cfg
@@ -195,7 +206,9 @@ class PPOTrainer:
         self.ref_params = jax.tree.map(lambda x: x, self.params)
         self.opt = optimizer or optax.adam(ppo.learning_rate)
         self.opt_state = self.opt.init(self.params)
-        self.buffer = ReplayBuffer()
+        # opt-in: archiving rollouts costs a blocking device_get of the
+        # full batch per step plus host memory for the window
+        self.buffer = ReplayBuffer() if store_rollouts else None
         self._sample = jax.jit(
             partial(sample, cfg=cfg, ppo=ppo), static_argnames=()
         )
@@ -254,7 +267,8 @@ class PPOTrainer:
             "gen_mask": gen_mask,
             "score_mean": scores.mean(),
         }
-        self.buffer.add(batch)
+        if self.buffer is not None:
+            self.buffer.add(batch)
         return batch
 
     def train_step(self, prompts: np.ndarray, key: jax.Array) -> dict:

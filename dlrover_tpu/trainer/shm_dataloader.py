@@ -1,0 +1,203 @@
+"""Shared-memory batch exchange: data-worker processes feed the trainer.
+
+Reference analog: ATorch's ShmDataContext / ShmDataloader
+(atorch/atorch/data/shm_context.py:139 — CPU "coworker" pods prepare
+samples and hand them to the GPU trainer over shared memory). TPU-host
+shape: data preparation (tokenization, decoding, augmentation) runs in
+separate PROCESSES on the host VM — the trainer process must spend its
+Python time driving the chips, not collating — and ready batches cross
+process boundaries as raw bytes in a slotted shared-memory ring, no
+pickling on the hot path.
+
+Layout: ``capacity`` fixed-size slots in one SharedMemoryArena. Two
+SharedQueues carry slot indices: ``free`` (consumer -> producers) and
+``ready`` (producers -> consumer). A slot holds a 4-byte header length,
+a JSON header (array names/shapes/dtypes/offsets), then the raw bytes.
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing
+import queue as queue_mod
+import struct
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.multi_process import (
+    SharedMemoryArena,
+    SharedQueue,
+)
+
+logger = get_logger(__name__)
+
+_LEN = struct.Struct("<I")
+
+
+def _write_batch(buf: memoryview, offset: int, slot_size: int,
+                 batch: dict[str, np.ndarray]) -> None:
+    metas = {}
+    data_off = 0
+    arrays = {}
+    for name, arr in batch.items():
+        arr = np.ascontiguousarray(arr)
+        metas[name] = {
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+            "offset": data_off,
+        }
+        arrays[name] = arr
+        data_off += arr.nbytes
+    header = json.dumps(metas).encode()
+    total = _LEN.size + len(header) + data_off
+    if total > slot_size:
+        raise ValueError(
+            f"batch of {total} bytes exceeds slot size {slot_size}"
+        )
+    buf[offset:offset + _LEN.size] = _LEN.pack(len(header))
+    start = offset + _LEN.size
+    buf[start:start + len(header)] = header
+    base = start + len(header)
+    for name, arr in arrays.items():
+        o = base + metas[name]["offset"]
+        buf[o:o + arr.nbytes] = arr.tobytes()
+
+
+def _read_batch(buf: memoryview, offset: int) -> dict[str, np.ndarray]:
+    (hlen,) = _LEN.unpack(bytes(buf[offset:offset + _LEN.size]))
+    start = offset + _LEN.size
+    metas = json.loads(bytes(buf[start:start + hlen]))
+    base = start + hlen
+    out = {}
+    for name, info in metas.items():
+        dtype = np.dtype(info["dtype"])
+        count = int(np.prod(info["shape"]) or 1)
+        o = base + info["offset"]
+        out[name] = np.frombuffer(
+            buf, dtype=dtype, count=count, offset=o
+        ).reshape(info["shape"]).copy()  # own the data before slot reuse
+    return out
+
+
+class ShmBatchQueue:
+    """The slotted ring. One consumer (owner) + N producer processes."""
+
+    def __init__(self, name: str, slot_size: int = 16 << 20,
+                 capacity: int = 8, create: bool = False):
+        self.name = name
+        self.slot_size = slot_size
+        self.capacity = capacity
+        self._arena = SharedMemoryArena.open_or_create(
+            f"shmdl_{name}", slot_size * capacity
+        ) if create else SharedMemoryArena.open(f"shmdl_{name}")
+        self._free = SharedQueue(f"shmdl_free_{name}", create=create)
+        self._ready = SharedQueue(f"shmdl_ready_{name}", create=create)
+        if create:
+            for i in range(capacity):
+                self._free.put({"slot": i})
+
+    # ------------------------------------------------------------- producer
+
+    def put(self, batch: dict[str, np.ndarray],
+            timeout: float | None = None) -> None:
+        item = self._free.get(timeout=timeout)
+        slot = int(item["slot"])
+        _write_batch(self._arena.buf, slot * self.slot_size,
+                     self.slot_size, batch)
+        self._ready.put({"slot": slot})
+
+    def put_end(self) -> None:
+        self._ready.put({"end": True})
+
+    # ------------------------------------------------------------- consumer
+
+    def get(self, timeout: float | None = None
+            ) -> dict[str, np.ndarray] | None:
+        """Next batch, or None at end-of-stream."""
+        item = self._ready.get(timeout=timeout)
+        if item.get("end"):
+            return None
+        slot = int(item["slot"])
+        batch = _read_batch(self._arena.buf, slot * self.slot_size)
+        self._free.put({"slot": slot})
+        return batch
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        while True:
+            batch = self.get()
+            if batch is None:
+                return
+            yield batch
+
+    def close(self, unlink: bool = False) -> None:
+        if unlink:
+            self._arena.unlink()
+        self._arena.close()
+        self._free.close()
+        self._ready.close()
+
+
+def _worker_main(queue_name: str, slot_size: int,
+                 produce: Callable[[int], Iterator[dict]],
+                 worker_id: int) -> None:
+    q = ShmBatchQueue(queue_name, slot_size=slot_size, create=False)
+    try:
+        for batch in produce(worker_id):
+            q.put(batch)
+        q.put_end()
+    except Exception:  # noqa: BLE001 - end the stream, don't hang the consumer
+        logger.exception("shm data worker %d failed", worker_id)
+        q.put_end()
+    finally:
+        q.close()
+
+
+class ShmDataWorkers:
+    """Spawn N producer processes feeding one ShmBatchQueue.
+
+    ``produce(worker_id) -> iterator of batch dicts``; must be picklable
+    (top-level function / functools.partial). The consumer iterates the
+    returned queue; the stream ends after every worker sent its end
+    marker.
+    """
+
+    def __init__(self, name: str, produce: Callable[[int], Iterator[dict]],
+                 num_workers: int = 1, slot_size: int = 16 << 20,
+                 capacity: int = 8):
+        self.queue = ShmBatchQueue(
+            name, slot_size=slot_size, capacity=capacity, create=True
+        )
+        ctx = multiprocessing.get_context("spawn")
+        self._procs = [
+            ctx.Process(
+                target=_worker_main,
+                args=(name, slot_size, produce, i),
+                daemon=True,
+            )
+            for i in range(num_workers)
+        ]
+        for p in self._procs:
+            p.start()
+        self._ends_pending = num_workers
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        while self._ends_pending > 0:
+            try:
+                batch = self.queue.get(timeout=120)
+            except queue_mod.Empty:
+                logger.error("shm data workers stalled; ending stream")
+                return
+            if batch is None:
+                self._ends_pending -= 1
+                continue
+            yield batch
+
+    def close(self) -> None:
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+        for p in self._procs:
+            p.join(timeout=10)
+        self.queue.close(unlink=True)

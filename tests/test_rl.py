@@ -96,7 +96,8 @@ class TestToyRlhf:
                 np.float32
             )
 
-        trainer = PPOTrainer(cfg, ppo, reward_fn, jax.random.PRNGKey(0))
+        trainer = PPOTrainer(cfg, ppo, reward_fn, jax.random.PRNGKey(0),
+                             store_rollouts=True)
         rng = np.random.default_rng(0)
         scores = []
         for i in range(12):
