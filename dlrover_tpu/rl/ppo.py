@@ -8,9 +8,10 @@ critic is a value head on actor hiddens; the reward model is a caller
 callable — often a learned model, here any scorer), sampling runs as a
 ``lax.scan`` over decode steps under jit, and the whole PPO update is a
 single jitted function, shardable by the same strategy layer as
-pretraining. The reference's vLLM inference backend maps to future work
-(a KV-cached decode path); this sampler recomputes the prefix per step,
-which is fine at RLHF's short generation lengths.
+pretraining. The reference's vLLM inference backend maps to the KV-cached
+decode path (models/decode.py) PPOTrainer uses for dense models; the
+recompute-per-step ``sample`` below remains for MoE models and as the
+equivalence reference.
 """
 
 from __future__ import annotations
@@ -59,7 +60,13 @@ def init_actor_critic(cfg: tfm.TransformerConfig, key: jax.Array) -> dict:
 
 def sample(params: dict, prompts: jax.Array, cfg: tfm.TransformerConfig,
            ppo: PPOConfig, key: jax.Array) -> jax.Array:
-    """Autoregressive sampling: [B, P] prompts -> [B, P+gen_len] tokens."""
+    """Autoregressive sampling: [B, P] prompts -> [B, P+gen_len] tokens.
+
+    Recomputes the full prefix per step (O(S^2) per token). PPOTrainer
+    uses the KV-cached ``models.decode.generate`` when the model supports
+    it; this path remains for MoE models and as the equivalence
+    reference.
+    """
     B, P = prompts.shape
     total = P + ppo.gen_len
     tokens = jnp.zeros((B, total), jnp.int32).at[:, :P].set(prompts)
@@ -209,9 +216,19 @@ class PPOTrainer:
         # opt-in: archiving rollouts costs a blocking device_get of the
         # full batch per step plus host memory for the window
         self.buffer = ReplayBuffer() if store_rollouts else None
-        self._sample = jax.jit(
-            partial(sample, cfg=cfg, ppo=ppo), static_argnames=()
-        )
+        if cfg.moe_experts:
+            self._sample = jax.jit(partial(sample, cfg=cfg, ppo=ppo))
+        else:
+            from dlrover_tpu.models.decode import generate
+
+            # KV-cached decode: O(S) per generated token vs the
+            # full-forward recompute's O(S^2)
+            self._sample = jax.jit(
+                lambda params, prompts, key: generate(
+                    params["model"], prompts, cfg, ppo.gen_len, key,
+                    temperature=ppo.temperature,
+                )
+            )
         self._logp_values = jax.jit(
             partial(sequence_logprobs_and_values, cfg=cfg)
         )
