@@ -168,6 +168,12 @@ def generate(
             f"max_seq_len {cfg.max_seq_len}"
         )
     max_len = max_len or total
+    if max_len < total:
+        # an undersized cache would clamp dynamic_update_slice and
+        # silently decode against overwritten rows
+        raise ValueError(
+            f"max_len {max_len} < prompt {P} + gen_len {gen_len}"
+        )
     cache = init_cache(cfg, B, max_len)
     logits, cache = forward_cached(params, prompts, cache, cfg)
     last = logits[:, -1]

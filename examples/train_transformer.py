@@ -88,7 +88,24 @@ def main(argv=None) -> int:
         cfg = dataclasses.replace(cfg, attention=args.attention)
     seq = args.seq or cfg.max_seq_len
 
-    strategy = PRESETS[args.strategy]()
+    if args.strategy == "auto":
+        from dlrover_tpu.parallel.auto import auto_strategy
+
+        example_batch = {
+            "tokens": np.zeros(
+                (1, max(1, args.global_batch), seq + 1), np.int32
+            )
+        }
+        strategy, _ = auto_strategy(
+            loss_fn_for=lambda s, m: tfm.make_loss_fn(cfg, s, m),
+            init_params_fn=lambda rng: tfm.init_params(cfg, rng),
+            logical_params=tfm.logical_axes(cfg),
+            optimizer=optax.adamw(args.lr),
+            example_batch=example_batch,
+        )
+        print(f"[trainer] auto strategy: {strategy.name}", flush=True)
+    else:
+        strategy = PRESETS[args.strategy]()
     mesh = strategy.build_mesh()
     compiled = compile_train(
         strategy=strategy,

@@ -227,3 +227,46 @@ class TestTransformerVariants:
         params = T.init_params(CFG, jax.random.PRNGKey(0))
         actual = sum(x.size for x in jax.tree.leaves(params))
         assert actual == CFG.param_count
+
+
+class TestAutoStrategy:
+    def _pick(self, hbm_bytes, cfg=None, batch=8):
+        import optax
+
+        from dlrover_tpu.parallel.auto import auto_strategy
+
+        cfg = cfg or T.CONFIGS["tiny"]
+        example_batch = {
+            "tokens": np.zeros((1, batch, cfg.max_seq_len + 1), np.int32)
+        }
+        return auto_strategy(
+            loss_fn_for=lambda s, m: T.make_loss_fn(cfg, s, m),
+            init_params_fn=lambda rng: T.init_params(cfg, rng),
+            logical_params=T.logical_axes(cfg),
+            optimizer=optax.adamw(1e-3),
+            example_batch=example_batch,
+            hbm_capacity_bytes=hbm_bytes,
+        )
+
+    def test_ample_memory_prefers_dp(self):
+        strategy, reports = self._pick(hbm_bytes=0)  # 0 = unlimited
+        assert strategy.name == "dp"
+        assert reports[0].ok
+
+    def test_tight_memory_falls_to_sharded(self):
+        """With a param-dominated model, a budget between FSDP's sharded
+        footprint and DP's replicated one forces the sharded pick."""
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            T.CONFIGS["tiny"], d_model=512, n_layers=4, d_ff=1024,
+            vocab_size=8192, n_heads=8, n_kv_heads=8,
+        )
+        _, reports = self._pick(hbm_bytes=0, cfg=cfg, batch=8)
+        by_name = {r.strategy_name: r for r in reports}
+        dp_need = by_name["dp"].hbm_bytes
+        fsdp_need = by_name["fsdp"].hbm_bytes
+        assert fsdp_need < dp_need, (dp_need, fsdp_need)
+        budget = (dp_need + fsdp_need) // 2
+        strategy, _ = self._pick(hbm_bytes=budget, cfg=cfg, batch=8)
+        assert strategy.name in ("fsdp", "fsdp_tp")
