@@ -270,3 +270,39 @@ class TestAutoStrategy:
         budget = (dp_need + fsdp_need) // 2
         strategy, _ = self._pick(hbm_bytes=budget, cfg=cfg, batch=8)
         assert strategy.name in ("fsdp", "fsdp_tp")
+
+
+class TestStrategyNumericEquivalence:
+    def test_same_loss_across_strategies(self):
+        """DP/FSDP/TP/FSDP+TP are layout choices, not math choices: the
+        same params and batch produce the same loss on every mesh."""
+        import optax
+        from functools import partial
+
+        from dlrover_tpu.parallel import strategy as S
+        from dlrover_tpu.trainer.train_step import compile_train
+
+        cfg = T.CONFIGS["tiny"]
+        tokens = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (1, 8, cfg.max_seq_len + 1), np.int32
+        )
+        losses = {}
+        for strat in (S.dp(), S.fsdp(remat="none"), S.tp(tensor_size=2),
+                      S.fsdp_tp(tensor_size=2, remat="none")):
+            mesh = strat.build_mesh()
+            compiled = compile_train(
+                strategy=strat, mesh=mesh,
+                loss_fn=T.make_loss_fn(cfg, strat, mesh),
+                init_params_fn=lambda rng: T.init_params(cfg, rng),
+                logical_params=T.logical_axes(cfg),
+                optimizer=optax.adamw(1e-3),
+            )
+            state = compiled.init(jax.random.PRNGKey(0))
+            batch = jax.device_put(
+                {"tokens": tokens}, compiled.batch_sharding
+            )
+            _, metrics = compiled.step(state, batch)
+            losses[strat.name] = float(jax.device_get(metrics["loss"]))
+        ref = losses["dp"]
+        for name, loss in losses.items():
+            assert loss == pytest.approx(ref, rel=2e-4), losses
