@@ -168,6 +168,11 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--node-unit", type=int, default=1)
     parser.add_argument("--hang-timeout", type=float, default=1800.0)
     parser.add_argument(
+        "--dead-window", type=float,
+        default=Defaults.HEARTBEAT_DEAD_WINDOW_S,
+        help="seconds without a heartbeat before a node is declared dead",
+    )
+    parser.add_argument(
         "--state-dir", default="",
         help="persist recoverable master state here (HA restart)",
     )
@@ -185,6 +190,7 @@ def main(argv: list[str] | None = None) -> int:
         rdzv_timeout=args.rdzv_timeout,
         node_unit=args.node_unit,
         hang_timeout_s=args.hang_timeout,
+        heartbeat_dead_window_s=args.dead_window,
         state_dir=args.state_dir,
     )
     master.prepare()

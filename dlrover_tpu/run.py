@@ -57,6 +57,10 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
                    default=Defaults.RDZV_WAIT_TIMEOUT_S)
     p.add_argument("--monitor-interval", type=float,
                    default=Defaults.MONITOR_INTERVAL_S)
+    p.add_argument("--heartbeat-interval", type=float,
+                   default=Defaults.HEARTBEAT_INTERVAL_S,
+                   help="agent->master heartbeat (and master-action "
+                        "delivery) cadence")
     p.add_argument("--network-check", action="store_true",
                    help="run a collective probe before training")
     p.add_argument("--exclude-straggler", action="store_true",
@@ -154,6 +158,7 @@ def main(argv: list[str] | None = None) -> int:
         entrypoint=entrypoint,
         max_restarts=args.max_restarts,
         monitor_interval_s=args.monitor_interval,
+        heartbeat_interval_s=args.heartbeat_interval,
         rdzv_timeout_s=args.rdzv_timeout,
         network_check=args.network_check,
         exclude_straggler=args.exclude_straggler,

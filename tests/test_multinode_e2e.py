@@ -37,12 +37,13 @@ def _env(tmp_path) -> dict:
     return env
 
 
-def _start_master(tmp_path, env) -> tuple[subprocess.Popen, str]:
+def _start_master(tmp_path, env, min_nodes=2, max_nodes=2,
+                  extra=()) -> tuple[subprocess.Popen, str]:
     port_file = str(tmp_path / "master_port")
     proc = subprocess.Popen(
         [sys.executable, "-m", "dlrover_tpu.master.job_master",
-         "--min-nodes", "2", "--max-nodes", "2",
-         "--port-file", port_file],
+         "--min-nodes", str(min_nodes), "--max-nodes", str(max_nodes),
+         "--port-file", port_file, *extra],
         env=env, cwd=REPO, start_new_session=True,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
@@ -134,6 +135,89 @@ def test_two_node_training_completes(tmp_path):
     assert result["final_step"] == 12
     assert result["num_nodes"] == 2
     assert not os.path.exists(tmp_path / "result_1.json")  # rank 1 silent
+
+
+@pytest.mark.timeout(500)
+def test_three_nodes_shrink_to_two_on_node_loss(tmp_path):
+    """THE elastic headline: a 3-node world permanently loses a node
+    (launcher+trainer killed); the master declares it dead, survivors
+    re-rendezvous as a 2-node world, and training resumes from the
+    sharded checkpoint RESHARDED from 12 devices onto 8."""
+    env = _env(tmp_path)
+    master, addr = _start_master(
+        tmp_path, env, min_nodes=2, max_nodes=3,
+        extra=["--rdzv-timeout", "8", "--dead-window", "6"],
+    )
+    (tmp_path / "master_addr").write_text(addr)
+
+    def launcher(nid):
+        cmd = [
+            sys.executable, "-m", "dlrover_tpu.run",
+            "--master-addr", addr,
+            "--node-id", str(nid), "--nnodes", "2:3",
+            "--monitor-interval", "0.3", "--max-restarts", "2",
+            # NB: the agent's --rdzv-timeout is how long it WAITS for a
+            # round; the master's --rdzv-timeout is when a round
+            # COMPLETES with fewer than max nodes. Setting them equal
+            # makes the client deadline race the completion.
+            "--heartbeat-interval", "2", "--rdzv-timeout", "90",
+            EXAMPLE, "--",
+            "--model", "tiny", "--seq", "128",
+            "--global-batch", "24",
+            "--ckpt-dir", str(tmp_path / "ckpt"),
+            "--ckpt-interval", "5",
+            "--result-file", str(tmp_path / f"result_{nid}.json"),
+            "--log-interval", "5",
+            "--max-steps", "30", "--epochs", "50",
+        ]
+        return subprocess.Popen(
+            cmd, env=env, cwd=REPO, start_new_session=True,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+
+    launchers = {nid: launcher(nid) for nid in (0, 1, 2)}
+    killed = False
+    try:
+        deadline = time.time() + 420
+        while time.time() < deadline:
+            if all(p.poll() is not None
+                   for nid, p in launchers.items() if nid != 2):
+                break
+            if not killed and (tmp_path / "ckpt" / "latest").exists():
+                # permanently remove node 2: launcher AND its trainer
+                os.killpg(launchers[2].pid, signal.SIGKILL)
+                killed = True
+            time.sleep(0.5)
+        assert killed, "checkpoint never appeared"
+        outs = {}
+        for nid in (0, 1):
+            p = launchers[nid]
+            try:
+                out, _ = p.communicate(timeout=60)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+            outs[nid] = out
+        for nid in (0, 1):
+            assert launchers[nid].returncode == 0, outs[nid][-4000:]
+        result = json.load(open(tmp_path / "result_0.json"))
+        assert result["final_step"] == 30
+        assert result["num_nodes"] == 2       # the world actually shrank
+        assert result["resumed_from"] > 0     # resharded restore
+    finally:
+        for p in launchers.values():
+            if p.poll() is None:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+        if master.poll() is None:
+            try:
+                os.killpg(master.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        subprocess.run(["pkill", "-9", "-f", EXAMPLE],
+                       capture_output=True)
 
 
 @pytest.mark.timeout(500)
