@@ -45,6 +45,10 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     dtype: str = "bfloat16"     # compute dtype
     remat_scan: bool = False    # checkpoint each scanned layer
+    # per-layer remat policy: "nothing" recomputes the whole layer in
+    # backward; "save_attn" keeps the (cheap, bf16) attention outputs so
+    # the backward skips re-running attention to rebuild FFN inputs
+    remat_policy: str = "nothing"
     attention: str = "dense"    # "dense" | "flash" | "ring"
     # muP (parallel/mup.py): base d_model tuned on; 0 disables. Applies
     # the readout multiplier and 1/d_head attention scaling here; pair
@@ -337,6 +341,9 @@ def forward_with_aux(
             v = jnp.repeat(v, n_rep, axis=2)
         o = attn(q, k, v, causal=True)
         o = jnp.einsum("bshd,hde->bse", o, w["wo"].astype(dt))
+        from jax.ad_checkpoint import checkpoint_name
+
+        o = checkpoint_name(o, "attn_out")  # inert without a names policy
         x = pin(x + o, ("batch", "sequence", "embed"))
 
         h = _norm(x, w["ln2"], w.get("ln2_b"), c.variant)
@@ -364,9 +371,12 @@ def forward_with_aux(
 
     body = layer
     if c.remat_scan:
-        body = jax.checkpoint(
-            layer, policy=jax.checkpoint_policies.nothing_saveable
+        policy = (
+            jax.checkpoint_policies.save_only_these_names("attn_out")
+            if c.remat_policy == "save_attn"
+            else jax.checkpoint_policies.nothing_saveable
         )
+        body = jax.checkpoint(layer, policy=policy)
     (x, aux), _ = lax.scan(
         lambda carry, w: body(carry, w),
         (x, jnp.zeros((), jnp.float32)), params["layers"],
