@@ -18,4 +18,37 @@ JAX/XLA/Pallas on TPU:
   tfplus/tfplus/kv_variable/**)
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
+
+
+# PEP 562 lazy top-level API: heavy submodules import on first touch and
+# cache into module globals.
+_LAZY_API = {
+    "Strategy": ("dlrover_tpu.parallel.strategy", "Strategy"),
+    "PRESETS": ("dlrover_tpu.parallel.strategy", "PRESETS"),
+    "build_mesh": ("dlrover_tpu.parallel.mesh", "build_mesh"),
+    "auto_strategy": ("dlrover_tpu.parallel.auto", "auto_strategy"),
+    "compile_train": ("dlrover_tpu.trainer.train_step", "compile_train"),
+    "ElasticTrainer": ("dlrover_tpu.trainer.elastic_trainer",
+                       "ElasticTrainer"),
+    "ElasticDataset": ("dlrover_tpu.trainer.data", "ElasticDataset"),
+    "PrefetchLoader": ("dlrover_tpu.trainer.data", "PrefetchLoader"),
+    "CheckpointEngine": ("dlrover_tpu.checkpoint.engine",
+                         "CheckpointEngine"),
+    "ShardedCheckpointEngine": ("dlrover_tpu.checkpoint.sharded",
+                                "ShardedCheckpointEngine"),
+    "KvEmbeddingTable": ("dlrover_tpu.embedding.kv_table",
+                         "KvEmbeddingTable"),
+    "init_from_env": ("dlrover_tpu.trainer.bootstrap", "init_from_env"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY_API:
+        import importlib
+
+        module, attr = _LAZY_API[name]
+        value = getattr(importlib.import_module(module), attr)
+        globals()[name] = value  # cache: later accesses skip __getattr__
+        return value
+    raise AttributeError(f"module 'dlrover_tpu' has no attribute {name!r}")

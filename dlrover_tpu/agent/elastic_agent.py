@@ -210,7 +210,9 @@ class ElasticAgent:
                     return outcome
                 continue
             # healthy: check for membership changes / master actions
-            if self._membership_changed() or self._master_action() == "restart":
+            if self._master_action() == "restart":
+                self._restart_workers(reason="master restart action")
+            elif self._membership_changed():
                 self._restart_workers(reason="membership change")
 
     def _handle_failure(self, exit_code: int) -> RunResult | None:

@@ -120,11 +120,32 @@ class JobMaster:
             all_exited_grace_s: float = 30.0) -> bool:
         """Block until the job finishes; returns success."""
         all_exited_since = 0.0
+        hang_restarts = 0
+        step_at_last_hang = -1
         while True:
             if self.servicer.job_exit_event.wait(poll_interval_s):
                 break
+            if (hang_restarts
+                    and self.speed_monitor.global_step > step_at_last_hang):
+                # the restart recovered real progress: replenish the
+                # budget so a later, unrelated hang gets its own attempt
+                hang_restarts = 0
             if self.speed_monitor.hanged():
-                logger.error("job hang detected; stopping")
+                # try one restart before failing the job (reference: the
+                # hang path relaunches workers, training.py/
+                # HangingDetector; failing outright wastes a recoverable
+                # wedge — a stuck collective, a dead data source)
+                if hang_restarts < 1:
+                    hang_restarts += 1
+                    step_at_last_hang = self.speed_monitor.global_step
+                    logger.error(
+                        "job hang detected at step %d; asking all agents "
+                        "to restart workers", step_at_last_hang,
+                    )
+                    self.node_manager.broadcast_action("restart")
+                    self.speed_monitor.reset_hang_clock()
+                    continue
+                logger.error("job still hung after a restart; stopping")
                 self.servicer.job_success = False
                 break
             # every node reached a terminal state without an explicit job

@@ -56,3 +56,8 @@ class SpeedMonitor:
             last = self._last_report_time or self._start_time
             started = self._last_report_time > 0
         return started and (time.time() - last) > self._hang_timeout_s
+
+    def reset_hang_clock(self) -> None:
+        """Give the job a fresh hang window (after a recovery action)."""
+        with self._lock:
+            self._last_report_time = time.time()

@@ -28,6 +28,7 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 Params = Any
 
@@ -341,8 +342,6 @@ def forward_with_aux(
             v = jnp.repeat(v, n_rep, axis=2)
         o = attn(q, k, v, causal=True)
         o = jnp.einsum("bshd,hde->bse", o, w["wo"].astype(dt))
-        from jax.ad_checkpoint import checkpoint_name
-
         o = checkpoint_name(o, "attn_out")  # inert without a names policy
         x = pin(x + o, ("batch", "sequence", "embed"))
 
@@ -371,12 +370,17 @@ def forward_with_aux(
 
     body = layer
     if c.remat_scan:
-        policy = (
-            jax.checkpoint_policies.save_only_these_names("attn_out")
-            if c.remat_policy == "save_attn"
-            else jax.checkpoint_policies.nothing_saveable
-        )
-        body = jax.checkpoint(layer, policy=policy)
+        policies = {
+            "nothing": jax.checkpoint_policies.nothing_saveable,
+            "save_attn":
+                jax.checkpoint_policies.save_only_these_names("attn_out"),
+        }
+        if c.remat_policy not in policies:
+            raise ValueError(
+                f"unknown remat_policy {c.remat_policy!r}; "
+                f"known: {sorted(policies)}"
+            )
+        body = jax.checkpoint(layer, policy=policies[c.remat_policy])
     (x, aux), _ = lax.scan(
         lambda carry, w: body(carry, w),
         (x, jnp.zeros((), jnp.float32)), params["layers"],

@@ -338,6 +338,46 @@ class TestRelaunchHook:
         assert len(relaunched) == 1
 
 
+class TestHangRecovery:
+    def test_hang_restarts_once_then_fails(self, master_factory):
+        import dlrover_tpu.master.job_master  # noqa: F401
+
+        master = master_factory(
+            min_nodes=1, max_nodes=1, hang_timeout_s=0.5,
+        )
+        c0 = client(master, 0)
+        c0.report_heartbeat()
+        c0.report_step(5)  # training started, then goes silent
+        t = threading.Thread(
+            target=lambda: setattr(
+                master, "_run_ok", master.run(poll_interval_s=0.1)
+            )
+        )
+        t.start()
+        # first hang window: the master asks for a restart, not a failure
+        deadline = time.time() + 10
+        got_restart = False
+        while time.time() < deadline and not got_restart:
+            if c0.report_heartbeat() == "restart":
+                got_restart = True
+            time.sleep(0.05)
+        assert got_restart, "hang did not trigger a restart action"
+        # still silent: the second window fails the job
+        t.join(timeout=15)
+        assert not t.is_alive()
+        assert master._run_ok is False
+
+    def test_import_api_surface(self):
+        import dlrover_tpu
+
+        assert callable(dlrover_tpu.compile_train)
+        assert callable(dlrover_tpu.ElasticTrainer)
+        assert callable(dlrover_tpu.CheckpointEngine)
+        assert dlrover_tpu.PRESETS["fsdp"]().name == "fsdp"
+        with pytest.raises(AttributeError):
+            dlrover_tpu.no_such_thing  # noqa: B018
+
+
 class TestMasterHA:
     def test_state_survives_master_restart(self, master_factory, tmp_path):
         """A new master incarnation resumes the shard queues: undone and
