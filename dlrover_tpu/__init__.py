@@ -52,3 +52,7 @@ def __getattr__(name):
         globals()[name] = value  # cache: later accesses skip __getattr__
         return value
     raise AttributeError(f"module 'dlrover_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_API))

@@ -117,18 +117,28 @@ class JobMaster:
                     self.port)
 
     def run(self, poll_interval_s: float = 2.0,
-            all_exited_grace_s: float = 30.0) -> bool:
+            all_exited_grace_s: float = 30.0,
+            recovery_grace_s: float | None = None) -> bool:
         """Block until the job finishes; returns success."""
         all_exited_since = 0.0
         hang_restarts = 0
-        step_at_last_hang = -1
+        restart_broadcast_time = 0.0
+        if recovery_grace_s is None:
+            # recovery may legitimately exceed the hang window with no
+            # step reports (rendezvous wait + recompile + restore):
+            # before failing a restarted-but-silent job, allow this extra
+            recovery_grace_s = max(
+                2 * self.speed_monitor._hang_timeout_s, 900.0
+            )
         while True:
             if self.servicer.job_exit_event.wait(poll_interval_s):
                 break
-            if (hang_restarts
-                    and self.speed_monitor.global_step > step_at_last_hang):
-                # the restart recovered real progress: replenish the
-                # budget so a later, unrelated hang gets its own attempt
+            if (hang_restarts and self.speed_monitor.last_report_time
+                    > restart_broadcast_time):
+                # a post-restart report means the recovery worked:
+                # replenish the budget so a later, unrelated hang gets
+                # its own attempt (NOT keyed on global_step — a restore
+                # from an older checkpoint retrains below the old max)
                 hang_restarts = 0
             if self.speed_monitor.hanged():
                 # try one restart before failing the job (reference: the
@@ -137,13 +147,25 @@ class JobMaster:
                 # wedge — a stuck collective, a dead data source)
                 if hang_restarts < 1:
                     hang_restarts += 1
-                    step_at_last_hang = self.speed_monitor.global_step
                     logger.error(
                         "job hang detected at step %d; asking all agents "
-                        "to restart workers", step_at_last_hang,
+                        "to restart workers",
+                        self.speed_monitor.global_step,
                     )
                     self.node_manager.broadcast_action("restart")
+                    # reset BEFORE stamping the broadcast time: the reset
+                    # touches last_report_time, which must not itself
+                    # count as post-restart progress
                     self.speed_monitor.reset_hang_clock()
+                    restart_broadcast_time = time.time()
+                    continue
+                still_recovering = (
+                    self.speed_monitor.last_report_time
+                    <= restart_broadcast_time
+                    and time.time() - restart_broadcast_time
+                    < recovery_grace_s
+                )
+                if still_recovering:
                     continue
                 logger.error("job still hung after a restart; stopping")
                 self.servicer.job_success = False

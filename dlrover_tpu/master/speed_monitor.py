@@ -35,6 +35,11 @@ class SpeedMonitor:
         with self._lock:
             return self._global_step
 
+    @property
+    def last_report_time(self) -> float:
+        with self._lock:
+            return self._last_report_time
+
     def running_speed(self) -> float:
         """Steps per second over at least ``window_s`` of history."""
         with self._lock:

@@ -88,6 +88,17 @@ class TransformerConfig:
         return embed + pos + c.n_layers * per_layer + final_norm + lm_head
 
 
+# Per-layer remat policies for remat_scan (distinct from the step-level
+# Strategy.remat table in parallel/strategy.py): "full" is an alias of
+# "nothing" to match that table's vocabulary for full recompute.
+LAYER_REMAT_POLICIES = {
+    "nothing": jax.checkpoint_policies.nothing_saveable,
+    "full": jax.checkpoint_policies.nothing_saveable,
+    "save_attn":
+        jax.checkpoint_policies.save_only_these_names("attn_out"),
+}
+
+
 # Named configs, smallest to flagship. Sizes follow public model families
 # (the reference's benchmark models: GPT-2 1.5B, Llama-2 7B — BASELINE.md).
 CONFIGS = {
@@ -370,17 +381,14 @@ def forward_with_aux(
 
     body = layer
     if c.remat_scan:
-        policies = {
-            "nothing": jax.checkpoint_policies.nothing_saveable,
-            "save_attn":
-                jax.checkpoint_policies.save_only_these_names("attn_out"),
-        }
-        if c.remat_policy not in policies:
+        if c.remat_policy not in LAYER_REMAT_POLICIES:
             raise ValueError(
                 f"unknown remat_policy {c.remat_policy!r}; "
-                f"known: {sorted(policies)}"
+                f"known: {sorted(LAYER_REMAT_POLICIES)}"
             )
-        body = jax.checkpoint(layer, policy=policies[c.remat_policy])
+        body = jax.checkpoint(
+            layer, policy=LAYER_REMAT_POLICIES[c.remat_policy]
+        )
     (x, aux), _ = lax.scan(
         lambda carry, w: body(carry, w),
         (x, jnp.zeros((), jnp.float32)), params["layers"],

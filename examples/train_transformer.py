@@ -62,6 +62,10 @@ def parse_args(argv=None):
     p.add_argument("--crash-exit", type=int, default=17,
                    help="exit code for the injected crash (210=OOM, "
                         "211=hardware per the failure contract)")
+    p.add_argument("--crash-once-file", default="",
+                   help="crash only if this marker file is absent "
+                        "(created before crashing) — survives node "
+                        "relaunches, unlike the restart-count gate")
     return p.parse_args(argv)
 
 
@@ -195,9 +199,21 @@ def main(argv=None) -> int:
 
     losses: list[float] = []
 
+    def _should_crash() -> bool:
+        if args.crash_once_file:
+            try:
+                # O_EXCL create makes the once-claim atomic even when
+                # several nodes share the marker path
+                with open(args.crash_once_file, "x") as f:
+                    f.write("crashed")
+                return True
+            except FileExistsError:
+                return False
+        return args.crash_always or ctx.restart_count == 0
+
     def on_step(step: int, metrics: dict) -> None:
         if args.crash_at_step and step == args.crash_at_step \
-                and (args.crash_always or ctx.restart_count == 0):
+                and _should_crash():
             print(f"[trainer] injected crash at step {step} "
                   f"(exit {args.crash_exit})", flush=True)
             sys.stdout.flush()
