@@ -118,10 +118,18 @@ class JobMaster:
 
     def run(self, poll_interval_s: float = 2.0,
             all_exited_grace_s: float = 30.0,
-            recovery_grace_s: float | None = None) -> bool:
-        """Block until the job finishes; returns success."""
+            recovery_grace_s: float | None = None,
+            max_hang_restarts: int = 3) -> bool:
+        """Block until the job finishes; returns success.
+
+        ``max_hang_restarts`` bounds hang-triggered restarts over the whole
+        job lifetime: the per-incident budget below replenishes on
+        post-restart progress, so without a lifetime cap a worker that
+        reports once and wedges again would be restarted forever.
+        """
         all_exited_since = 0.0
         hang_restarts = 0
+        total_hang_restarts = 0
         restart_broadcast_time = 0.0
         if recovery_grace_s is None:
             # recovery may legitimately exceed the hang window with no
@@ -145,8 +153,10 @@ class JobMaster:
                 # hang path relaunches workers, training.py/
                 # HangingDetector; failing outright wastes a recoverable
                 # wedge — a stuck collective, a dead data source)
-                if hang_restarts < 1:
+                if (hang_restarts < 1
+                        and total_hang_restarts < max_hang_restarts):
                     hang_restarts += 1
+                    total_hang_restarts += 1
                     logger.error(
                         "job hang detected at step %d; asking all agents "
                         "to restart workers",

@@ -61,6 +61,12 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_aux_weight: float = 1e-2
     moe_capacity_factor: float = 1.25
+    # pipeline parallelism (parallel/pipeline.py): >1 splits the layer
+    # stack into that many GPipe stages over the "pipeline" mesh axis.
+    # Microbatches default to the stage count. Set via the "pipeline"
+    # strategy preset rather than by hand.
+    pipeline_stages: int = 0
+    pipeline_microbatches: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -315,7 +321,6 @@ def forward_with_aux(
     attn = attention_fn or dense_attention
 
     B, S = tokens.shape
-    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
     x = params["embed"].astype(dt)[tokens]
     if c.variant == "gpt2":
         x = x + params["pos_embed"].astype(dt)[:S][None]
@@ -337,8 +342,14 @@ def forward_with_aux(
             capacity_factor=c.moe_capacity_factor,
         )
 
-    def layer(carry, w):
-        x, aux = carry
+    def layer(x, w):
+        """One block: activations [B', S, E] -> ([B', S, E], aux_inc).
+
+        B' is the full batch under scan, a microbatch under the pipeline —
+        positions derive from the input shape so both work.
+        """
+        aux = jnp.zeros((), jnp.float32)
+        positions = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
         h = _norm(x, w["ln1"], w.get("ln1_b"), c.variant)
         q = jnp.einsum("bse,ehd->bshd", h, w["wq"].astype(dt))
         if c.mup_base_width:
@@ -363,7 +374,7 @@ def forward_with_aux(
                  "w_out": w["w_out"]},
                 h, moe_cfg, constrain=pin, token_mask=mask,
             )
-            aux = aux + aux_l
+            aux = aux_l
         elif c.variant == "llama":
             gate = jax.nn.silu(jnp.einsum("bse,ef->bsf", h,
                                           w["w_gate"].astype(dt)))
@@ -377,7 +388,7 @@ def forward_with_aux(
             ff = (jnp.einsum("bsf,fe->bse", hidden, w["w_down"].astype(dt))
                   + w["b_out"].astype(dt))
         x = pin(x + ff, ("batch", "sequence", "embed"))
-        return (x, aux), None
+        return x, aux
 
     body = layer
     if c.remat_scan:
@@ -389,10 +400,33 @@ def forward_with_aux(
         body = jax.checkpoint(
             layer, policy=LAYER_REMAT_POLICIES[c.remat_policy]
         )
-    (x, aux), _ = lax.scan(
-        lambda carry, w: body(carry, w),
-        (x, jnp.zeros((), jnp.float32)), params["layers"],
-    )
+
+    if c.pipeline_stages > 1:
+        if c.moe_experts:
+            raise NotImplementedError(
+                "pipeline + MoE: the GPipe drain steps would pollute the "
+                "load-balancing aux loss; use the moe/expert strategies"
+            )
+        from dlrover_tpu.parallel.pipeline import pipeline_apply
+
+        x = pipeline_apply(
+            lambda h, w: body(h, w)[0],
+            params["layers"],
+            x,
+            num_stages=c.pipeline_stages,
+            num_microbatches=c.pipeline_microbatches,
+            constrain=pin,
+        )
+        aux = jnp.zeros((), jnp.float32)
+    else:
+        def scan_body(carry, w):
+            x, aux = carry
+            x, inc = body(x, w)
+            return (x, aux + inc), None
+
+        (x, aux), _ = lax.scan(
+            scan_body, (x, jnp.zeros((), jnp.float32)), params["layers"]
+        )
 
     x = _norm(x, params["ln_f"], params.get("ln_f_b"), c.variant)
     if return_hidden:
@@ -414,11 +448,21 @@ def make_loss_fn(cfg: TransformerConfig, strategy, mesh) -> Callable:
     """
     from dlrover_tpu.parallel.partition import constrain as _constrain
 
+    extra = getattr(strategy, "extra", {}) or {}
+    pp = int(extra.get("pipeline_stages", 0))
+    if pp > 1:
+        # the strategy wins when it pipelines; its microbatch count only
+        # overrides the config when actually set (0 = "stage count")
+        mb = int(extra.get("pipeline_microbatches", 0))
+        cfg = dataclasses.replace(
+            cfg,
+            pipeline_stages=pp,
+            pipeline_microbatches=mb or cfg.pipeline_microbatches,
+        )
+
     pin = partial(_constrain, rules=strategy.rule_table(), mesh=mesh)
     attn: AttentionFn | None = None
-    choice = (
-        getattr(strategy, "extra", {}).get("attention") or cfg.attention
-    )
+    choice = extra.get("attention") or cfg.attention
     if choice == "ring":
         from dlrover_tpu.ops.ring_attention import make_ring_attention
 

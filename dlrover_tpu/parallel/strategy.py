@@ -182,6 +182,30 @@ def long_context(sequence_size: int = 2, data_size: int = -1,
     )
 
 
+def pipeline(pipeline_size: int = 2, data_size: int = -1,
+             microbatches: int = 0, remat: str = "none") -> Strategy:
+    """GPipe pipeline over the "pipeline" axis × data parallel.
+
+    The layer-stack dim shards over the pipeline axis so each stage's
+    weights (and their optimizer states — ZeRO for free) live only on that
+    stage's devices; parallel/pipeline.py supplies the schedule.
+    """
+    return Strategy(
+        name="pipeline",
+        mesh_axes={"data": data_size, "pipeline": pipeline_size},
+        rules=[
+            ["batch", ["data", "fsdp"]],
+            ["layers", "pipeline"],
+            ["stages", "pipeline"],
+        ],
+        remat=remat,
+        extra={
+            "pipeline_stages": pipeline_size,
+            "pipeline_microbatches": microbatches,
+        },
+    )
+
+
 def moe(expert_size: int = 2, data_size: int = -1) -> Strategy:
     """Expert parallel: experts split over the expert axis."""
     return Strategy(
@@ -197,5 +221,6 @@ PRESETS = {
     "tp": tp,
     "fsdp_tp": fsdp_tp,
     "long_context": long_context,
+    "pipeline": pipeline,
     "moe": moe,
 }

@@ -371,6 +371,43 @@ class TestHangRecovery:
         assert not t.is_alive()
         assert outcome == [False], outcome
 
+    def test_report_once_then_wedge_hits_lifetime_cap(self, master_factory):
+        """A worker that reports one step after each restart (replenishing
+        the per-incident budget) and wedges again must not be restarted
+        forever: the lifetime cap fails the job."""
+        master = master_factory(
+            min_nodes=1, max_nodes=1, hang_timeout_s=0.4,
+        )
+        c0 = client(master, 0)
+        c0.report_heartbeat()
+        c0.report_step(5)
+        outcome: list = []
+
+        def run_master():
+            try:
+                outcome.append(master.run(
+                    poll_interval_s=0.05, recovery_grace_s=1.0,
+                    max_hang_restarts=2,
+                ))
+            except BaseException as e:  # noqa: BLE001 - surface in asserts
+                outcome.append(e)
+
+        t = threading.Thread(target=run_master)
+        t.start()
+        restarts = 0
+        step = 5
+        deadline = time.time() + 30
+        while t.is_alive() and time.time() < deadline:
+            if c0.report_heartbeat() == "restart":
+                restarts += 1
+                step += 1
+                c0.report_step(step)  # one report, then silent again
+            time.sleep(0.05)
+        t.join(timeout=5)
+        assert not t.is_alive(), "master livelocked on a wedged worker"
+        assert outcome == [False], outcome
+        assert restarts == 2, restarts
+
     def test_import_api_surface(self):
         import dlrover_tpu
 
