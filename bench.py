@@ -46,12 +46,19 @@ def bench_train_step(extra: dict) -> None:
     model = os.environ.get("BENCH_MODEL", "gpt2-small" if on_tpu else "tiny")
     # per-layer remat bounds residuals to one layer of the scanned stack —
     # without it the 12-layer attention-logit residuals alone (~9 GB f32
-    # at the default batch 16 / seq 1024) exceed a v5e's 16 GB HBM.
-    # save_attn keeps the cheap bf16 attention outputs so backward skips
-    # re-running attention to rebuild FFN inputs (~2% step-time win)
-    cfg = dataclasses.replace(tfm.CONFIGS[model], remat_scan=True,
-                              remat_policy="save_attn")
-    batch = int(os.environ.get("BENCH_BATCH", "16" if on_tpu else "2"))
+    # at batch 16 / seq 1024) exceed a v5e's 16 GB HBM. Policy choice is
+    # measured on v5e (gpt2-small): dots_no_batch + Pallas flash attention
+    # + 16-chunk blockwise CE beat save_attn + dense + full-logits CE by
+    # ~2% step time.
+    if on_tpu:
+        cfg = dataclasses.replace(
+            tfm.CONFIGS[model], remat_scan=True,
+            remat_policy="dots_no_batch", attention="flash", ce_chunks=16,
+        )
+    else:
+        cfg = dataclasses.replace(tfm.CONFIGS[model], remat_scan=True,
+                                  remat_policy="save_attn")
+    batch = int(os.environ.get("BENCH_BATCH", "32" if on_tpu else "2"))
     seq = min(cfg.max_seq_len, int(os.environ.get("BENCH_SEQ", "1024")))
     steps = int(os.environ.get("BENCH_STEPS", "30"))
 

@@ -84,6 +84,17 @@ def _load_lib():
         ]
         lib.kv_remove.restype = ctypes.c_int64
         lib.kv_remove.argtypes = [ctypes.c_void_p, _i64p, ctypes.c_int64]
+        lib.kv_delta_export.restype = ctypes.c_int64
+        lib.kv_delta_export.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64, _i64p, ctypes.c_int,
+        ]
+        lib.kv_delta_overflowed.restype = ctypes.c_int
+        lib.kv_delta_overflowed.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.kv_clear_deltas.argtypes = [ctypes.c_void_p]
+        lib.kv_mark_dirty.argtypes = [ctypes.c_void_p, _i64p, ctypes.c_int64]
         _lib = lib
         return lib
 
@@ -224,3 +235,228 @@ class KvEmbeddingTable:
         )
         if "step" in snapshot:
             self._step = int(snapshot["step"])
+
+    # ----------------------------------------------------- incremental ckpt
+
+    def _delta_drain_once(self, with_slots: bool, clear: bool
+                          ) -> tuple[dict[str, np.ndarray], bool]:
+        """One native drain pass; returns (chunk, complete)."""
+        counts = np.zeros(2, np.int64)
+        self._lib.kv_delta_export(
+            self._handle, None, None, None, None, 0, None, 0, counts, 0
+        )
+        # slack: the table may grow between count and fill; an early-stop
+        # just means the remainder drains on the next pass
+        n = int(counts[0]) + 256
+        m = int(counts[1]) + 256
+        keys = np.empty(n, np.int64)
+        values = np.empty((n, self.dim), np.float32)
+        slots = np.empty((n, self.num_slots * self.dim), np.float32)
+        freq = np.empty(n, np.uint32)
+        removed = np.empty(m, np.int64)
+        complete = int(self._lib.kv_delta_export(
+            self._handle,
+            keys.ctypes.data_as(ctypes.c_void_p),
+            values.ctypes.data_as(ctypes.c_void_p),
+            slots.ctypes.data_as(ctypes.c_void_p)
+            if with_slots and self.num_slots else None,
+            freq.ctypes.data_as(ctypes.c_void_p),
+            n,
+            removed.ctypes.data_as(ctypes.c_void_p),
+            m, counts, int(clear),
+        ))
+        r, d = int(counts[0]), int(counts[1])
+        chunk = {
+            "keys": keys[:r], "values": values[:r], "freq": freq[:r],
+            "removed": removed[:d],
+            "step": np.asarray(self._step, np.int64),
+        }
+        if with_slots and self.num_slots:
+            chunk["slots"] = slots[:r]
+        return chunk, bool(complete)
+
+    def delta_export(self, with_slots: bool = True, clear: bool = True
+                     ) -> dict[str, np.ndarray]:
+        """Rows whose values changed since the last clearing delta export
+        (the reference's delta export for incremental checkpoints /
+        serving sync). Includes ``removed``: keys deleted since then —
+        restore replays removals before upserts. ``clear=True`` resets the
+        tracking so the next delta is relative to this one.
+
+        Each native pass drains whole shards atomically (a key's value
+        export and its removal never interleave within a pass); passes
+        are folded with ``merge_deltas`` so later events win. Lookup-only
+        frequency bumps do not mark rows dirty, so restored frequencies
+        can lag the live table's — value data is exact.
+        """
+        out, complete = self._delta_drain_once(with_slots, clear)
+        tries = 0
+        while not complete and clear and tries < 8:
+            chunk, complete = self._delta_drain_once(with_slots, clear)
+            out = merge_deltas(out, chunk)
+            tries += 1
+        # an early stop is safe: undrained shards keep their marks/logs
+        # and surface in the next delta
+        return out
+
+    def delta_overflowed(self, reset: bool = False) -> bool:
+        """True when removals were dropped (bounded removed-log overflow):
+        the delta chain is broken and the next save must be a full
+        export. ``reset`` clears the flag once that export is durable."""
+        return bool(self._lib.kv_delta_overflowed(self._handle, int(reset)))
+
+    def clear_deltas(self) -> None:
+        """Reset delta tracking (call after a full/base export)."""
+        self._lib.kv_clear_deltas(self._handle)
+
+    def mark_dirty(self, ids: np.ndarray) -> None:
+        """Re-mark rows dirty (failed-checkpoint recovery: the export
+        cleared their marks but the file never became durable)."""
+        flat = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        if flat.size:
+            self._lib.kv_mark_dirty(self._handle, flat, flat.size)
+
+    def apply_delta(self, delta: dict[str, np.ndarray]) -> None:
+        """Replay one delta: removals first, then row upserts."""
+        removed = delta.get("removed")
+        if removed is not None and np.size(removed):
+            self.remove(np.asarray(removed))
+        if np.size(delta["keys"]):
+            self.import_(
+                {k: v for k, v in delta.items() if k != "removed"}
+            )
+
+
+def merge_deltas(older: dict | None, newer: dict) -> dict:
+    """Fold an older delta under a newer one (one replayable delta out).
+
+    Replay applies removals before upserts, so an older row whose key was
+    since removed must be dropped — keeping it would resurrect the stale
+    value. For duplicate keys the newer row wins (import applies rows
+    sequentially; newer rows are concatenated after older ones).
+    """
+    if older is None:
+        return newer
+    keep = ~np.isin(older["keys"], newer["removed"])
+    out = dict(newer)
+    out["keys"] = np.concatenate([older["keys"][keep], newer["keys"]])
+    out["values"] = np.concatenate(
+        [older["values"][keep], newer["values"]]
+    )
+    out["freq"] = np.concatenate([older["freq"][keep], newer["freq"]])
+    if "slots" in newer and "slots" in older:
+        out["slots"] = np.concatenate(
+            [older["slots"][keep], newer["slots"]]
+        )
+    out["removed"] = np.concatenate([older["removed"], newer["removed"]])
+    return out
+
+
+class IncrementalCheckpointManager:
+    """Base + delta checkpoints for a KvEmbeddingTable.
+
+    Reference analog: the incremental checkpoint manager
+    (tfplus/tfplus/kv_variable/python/training/checkpoint_manager.py) —
+    periodic full saves with cheap deltas between them, so a 100M-row
+    table checkpoints at the cost of the rows that actually changed.
+
+    Layout under ``directory``: ``base-N.npz`` (full export at version N)
+    and ``delta-N.npz`` (changes from version N-1 to N); ``restore()``
+    loads the newest base then replays every later delta in order.
+    """
+
+    def __init__(self, table: KvEmbeddingTable, directory: str,
+                 base_interval: int = 10):
+        self.table = table
+        self.directory = directory
+        self.base_interval = base_interval
+        self._version = 0
+        # changes drained from the table's delta tracking but not yet
+        # durably written (carried across a failed save so nothing is
+        # ever lost from the chain)
+        self._pending: dict[str, np.ndarray] | None = None
+        os.makedirs(directory, exist_ok=True)
+
+    def _write(self, path: str, snap: dict) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **snap)
+        os.replace(tmp, path)
+
+    def save(self) -> str:
+        """Write the next checkpoint (base every ``base_interval``-th
+        save, delta otherwise); returns the path written.
+
+        Tracking state only advances when the file is durable: a failed
+        write parks the drained changes in ``_pending`` (folded into the
+        next attempt) and does not consume the version, so the chain
+        stays gapless and lossless. A removed-log overflow (bounded
+        native log) forces a base — the delta chain is broken there.
+        """
+        v = self._version + 1
+        force_base = self.table.delta_overflowed()
+        if force_base or (v - 1) % self.base_interval == 0:
+            # drain tracking FIRST, then snapshot: the full export is a
+            # superset of the drained delta, so a durable base supersedes
+            # it (and any older pending) — rows dirtied between drain and
+            # export keep their marks and land in the next delta
+            pend = self.table.delta_export()
+            path = os.path.join(self.directory, f"base-{v}.npz")
+            try:
+                self._write(path, self.table.export())
+            except BaseException:
+                self._pending = merge_deltas(self._pending, pend)
+                raise
+            self._pending = None
+            self.table.delta_overflowed(reset=True)
+        else:
+            path = os.path.join(self.directory, f"delta-{v}.npz")
+            snap = merge_deltas(self._pending, self.table.delta_export())
+            try:
+                self._write(path, snap)
+            except BaseException:
+                self._pending = snap
+                raise
+            self._pending = None
+        self._version = v
+        return path
+
+    def restore(self) -> int:
+        """Load newest base + later deltas; returns the version restored
+        (0 when the directory holds no base). Raises when delta files
+        exist beyond a gap in the chain (a replay would silently skip
+        them — the directory is corrupt or from a foreign run)."""
+        names = os.listdir(self.directory)
+        bases = sorted(
+            int(f[len("base-"):-len(".npz")])
+            for f in names
+            if f.startswith("base-") and f.endswith(".npz")
+        )
+        if not bases:
+            return 0
+        base_v = bases[-1]
+        with np.load(os.path.join(self.directory, f"base-{base_v}.npz")) as z:
+            self.table.import_(dict(z))
+        v = base_v
+        while True:
+            path = os.path.join(self.directory, f"delta-{v + 1}.npz")
+            if not os.path.exists(path):
+                break
+            v += 1
+            with np.load(path) as z:
+                self.table.apply_delta(dict(z))
+        orphans = sorted(
+            f for f in names
+            if f.startswith("delta-") and f.endswith(".npz")
+            and int(f[len("delta-"):-len(".npz")]) > v
+        )
+        if orphans:
+            raise ValueError(
+                f"delta chain ends at version {v} but later files exist "
+                f"({orphans}): refusing a restore that would drop them"
+            )
+        # restore itself dirties every imported row; the next delta
+        # should be relative to this restored state
+        self.table.clear_deltas()
+        self._version = v
+        return v

@@ -89,6 +89,19 @@ def forward_cached(
         )
         x = x + pe[None]
 
+    if c.moe_experts:
+        from dlrover_tpu.ops.moe import MoeConfig, moe_ffn
+
+        # Same router/experts as training. Capacity is per forward_cached
+        # call (B*S_new tokens), not per training sequence: a decode step
+        # routes B tokens against a fresh capacity pool, so drop patterns
+        # can differ from the training forward when experts overflow —
+        # exact train/decode equivalence holds in the no-drop regime.
+        moe_cfg = MoeConfig(
+            n_experts=c.moe_experts, top_k=c.moe_top_k,
+            capacity_factor=c.moe_capacity_factor,
+        )
+
     # NOTE: this layer body mirrors transformer.forward_with_aux (the
     # cache update and absolute-position math are what differ). The
     # equivalence tests in tests/test_decode.py pin the two together —
@@ -117,7 +130,13 @@ def forward_cached(
         o = jnp.einsum("bshd,hde->bse", o, w["wo"].astype(dt))
         x = x + o
         h = _norm(x, w["ln2"], w.get("ln2_b"), c.variant)
-        if c.variant == "llama":
+        if c.moe_experts:
+            ff, _ = moe_ffn(
+                {"w_router": w["w_router"], "w_in": w["w_in"],
+                 "w_out": w["w_out"]},
+                h, moe_cfg,
+            )
+        elif c.variant == "llama":
             gate = jax.nn.silu(
                 jnp.einsum("bse,ef->bsf", h, w["w_gate"].astype(dt))
             )
@@ -156,8 +175,6 @@ def generate(
     O(P + gen_len) attention reads per generated token instead of the
     O((P+gen_len)^2) full-forward recompute.
     """
-    if cfg.moe_experts:
-        raise NotImplementedError("cached decode for MoE models")
     B, P = prompts.shape
     total = P + gen_len
     if cfg.variant == "gpt2" and total > cfg.max_seq_len:

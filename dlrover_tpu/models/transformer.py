@@ -67,6 +67,12 @@ class TransformerConfig:
     # strategy preset rather than by hand.
     pipeline_stages: int = 0
     pipeline_microbatches: int = 0
+    # blockwise cross-entropy: compute the vocab logits in this many
+    # token chunks under remat instead of materializing the full
+    # [B, S, vocab] f32 logits (+ gradient) in HBM — the reference's
+    # fused cross-entropy (atorch modules/transformer/cross_entropy.py)
+    # done the XLA way. 0 = single full-logits pass.
+    ce_chunks: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -102,6 +108,10 @@ LAYER_REMAT_POLICIES = {
     "full": jax.checkpoint_policies.nothing_saveable,
     "save_attn":
         jax.checkpoint_policies.save_only_these_names("attn_out"),
+    # save matmul outputs whose shape has no batch dim (weight-gradient
+    # inputs); measured slightly ahead of save_attn on gpt2-small
+    "dots_no_batch":
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
 }
 
 
@@ -474,6 +484,46 @@ def make_loss_fn(cfg: TransformerConfig, strategy, mesh) -> Callable:
     return partial(loss_fn, cfg=cfg, attention_fn=attn, constrain=pin)
 
 
+def _blockwise_ce(
+    hidden: jax.Array, params: Params, targets: jax.Array,
+    mask: jax.Array | None, cfg: TransformerConfig,
+) -> jax.Array:
+    """Cross entropy over token chunks: logits for one chunk at a time,
+    rematerialized in backward, so the [B, S, vocab] f32 logits tensor
+    (3.3 GB for gpt2-small at batch 16 / seq 1024 — plus its gradient)
+    never lands in HBM. ``hidden`` is the final normed states [B, S, E].
+    """
+    B, S, D = hidden.shape
+    T = B * S
+    n = max(1, min(cfg.ce_chunks, T))
+    while T % n:  # largest divisor of T not above the requested count
+        n -= 1
+    xt = hidden.reshape(n, T // n, D)
+    tt = targets.reshape(n, T // n)
+    mt = (
+        jnp.ones((n, T // n), jnp.float32) if mask is None
+        else mask.reshape(n, T // n).astype(jnp.float32)
+    )
+    lm = params["lm_head"]
+    mup_scale = (
+        cfg.mup_base_width / cfg.d_model if cfg.mup_base_width else 1.0
+    )
+
+    def chunk(carry, inp):
+        xc, tc, mc = inp
+        logits = jnp.einsum(
+            "td,dv->tv", xc, lm.astype(xc.dtype)
+        ).astype(jnp.float32) * mup_scale
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, tc[:, None], axis=-1)[:, 0]
+        return carry + ((lse - gold) * mc).sum(), None
+
+    nll_sum, _ = lax.scan(
+        jax.checkpoint(chunk), jnp.zeros((), jnp.float32), (xt, tt, mt)
+    )
+    return nll_sum / jnp.maximum(mt.sum(), 1.0)
+
+
 def loss_fn(
     params: Params,
     batch: dict[str, jax.Array],
@@ -484,19 +534,33 @@ def loss_fn(
     """Next-token cross entropy (+ MoE aux). batch: tokens [B, S]."""
     tokens = batch["tokens"]
     in_mask = batch.get("mask")
-    logits, aux = forward_with_aux(
-        params, tokens[:, :-1], cfg,
-        attention_fn=attention_fn, constrain=constrain,
-        mask=in_mask[:, :-1] if in_mask is not None else None,
-    )
+    mask_in = in_mask[:, :-1] if in_mask is not None else None
     targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    if in_mask is not None:
-        m = in_mask[:, 1:].astype(nll.dtype)
-        ce = (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
+    if cfg.ce_chunks:
+        hidden, aux = forward_with_aux(
+            params, tokens[:, :-1], cfg,
+            attention_fn=attention_fn, constrain=constrain,
+            mask=mask_in, return_hidden=True,
+        )
+        ce = _blockwise_ce(
+            hidden, params, targets,
+            in_mask[:, 1:] if in_mask is not None else None, cfg,
+        )
     else:
-        ce = nll.mean()
+        logits, aux = forward_with_aux(
+            params, tokens[:, :-1], cfg,
+            attention_fn=attention_fn, constrain=constrain,
+            mask=mask_in,
+        )
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, targets[..., None], axis=-1
+        )[..., 0]
+        if in_mask is not None:
+            m = in_mask[:, 1:].astype(nll.dtype)
+            ce = (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
+        else:
+            ce = nll.mean()
     if cfg.moe_experts:
         ce = ce + cfg.moe_aux_weight * aux
     return ce

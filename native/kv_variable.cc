@@ -33,6 +33,10 @@ struct Row {
   uint32_t chunk;
   uint32_t offset;  // row index within the chunk
   uint32_t freq;
+  // weight values changed since the last clearing delta export (set on
+  // insert / optimizer update / import, NOT on lookup frequency bumps —
+  // marking reads would make every delta a full export)
+  uint8_t dirty;
 };
 
 struct Shard {
@@ -41,7 +45,16 @@ struct Shard {
   // chunked arena: each chunk holds kChunkRows rows of width row_width
   std::vector<std::unique_ptr<float[]>> chunks;
   uint32_t next_offset = 0;  // next free row in the last chunk
+  // keys removed since the last clearing removed-log drain (delta
+  // restore must replay deletions before upserts)
+  std::vector<int64_t> removed_log;
 };
+
+// Per-shard bound on the removed log: a table that removes keys but never
+// drains deltas (plain full-export checkpointing) must not leak memory.
+// On overflow the shard's log is dropped and the table-wide overflow flag
+// set — the delta chain is broken and the next checkpoint must be a base.
+constexpr size_t kRemovedLogShardCap = 1 << 16;
 
 struct KvTable {
   int dim = 0;        // embedding width
@@ -51,6 +64,7 @@ struct KvTable {
   float init_scale = 0.05f;
   Shard shards[kNumShards];
   std::atomic<int64_t> size{0};
+  std::atomic<int> removed_overflow{0};
 
   static constexpr uint32_t kChunkRows = 4096;
 
@@ -74,7 +88,7 @@ struct KvTable {
       s.chunks.emplace_back(new float[static_cast<size_t>(kChunkRows) * row_width]);
       s.next_offset = 0;
     }
-    Row r{static_cast<uint32_t>(s.chunks.size() - 1), s.next_offset++, 0};
+    Row r{static_cast<uint32_t>(s.chunks.size() - 1), s.next_offset++, 0, 1};
     float* p = row_ptr(s, r);
     // deterministic per-key init: uniform(-scale, scale) from key+seed
     std::mt19937_64 gen(seed ^ static_cast<uint64_t>(key));
@@ -152,6 +166,7 @@ void kv_apply_adam(void* handle, const int64_t* keys, const float* grads,
     // a row that receives updates is live: export's frequency filtering
     // must never drop trained weights just because no lookup preceded
     if (r->freq == 0) r->freq = 1;
+    r->dirty = 1;
     float* w = t->row_ptr(s, *r);
     float* m = w + dim;
     float* v = w + 2 * dim;
@@ -234,6 +249,100 @@ void kv_import(void* handle, const int64_t* keys, const float* values,
       std::memset(p + dim, 0, sizeof(float) * slot_width);
     }
     r->freq = freq != nullptr ? freq[i] : 1;
+    r->dirty = 1;
+  }
+}
+
+// Delta export: dirty rows AND the removed-keys log in ONE pass, each
+// shard drained atomically under its lock — a key's value export and its
+// removal can never interleave within one drain, which is what makes the
+// delta replayable (removals before upserts) without resurrecting keys.
+//
+// Count mode (keys_out == null): counts_out[0] = dirty rows,
+// counts_out[1] = logged removals; nothing cleared; returns 1.
+// Fill mode: emits per shard only when BOTH remaining capacities fit the
+// whole shard (a partially-drained shard would split one key's events
+// across drains); stops early otherwise. ``clear`` resets marks/logs of
+// the emitted shards. counts_out gets the written counts; returns 1 when
+// every shard was processed, 0 on an early stop (call again to drain the
+// rest — leftover changes simply surface in the next drain).
+int64_t kv_delta_export(void* handle, int64_t* keys_out, float* values_out,
+                        float* slots_out, uint32_t* freq_out,
+                        int64_t capacity, int64_t* removed_out,
+                        int64_t removed_capacity, int64_t* counts_out,
+                        int clear) {
+  auto* t = static_cast<KvTable*>(handle);
+  const int dim = t->dim;
+  const int slot_width = dim * t->num_slots;
+  int64_t rows = 0, removed = 0;
+  int64_t complete = 1;
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> lock(s.mu);
+    if (keys_out == nullptr) {
+      for (auto& [key, row] : s.index) rows += row.dirty ? 1 : 0;
+      removed += static_cast<int64_t>(s.removed_log.size());
+      continue;
+    }
+    int64_t shard_rows = 0;
+    for (auto& [key, row] : s.index) shard_rows += row.dirty ? 1 : 0;
+    int64_t shard_removed = static_cast<int64_t>(s.removed_log.size());
+    if (rows + shard_rows > capacity ||
+        removed + shard_removed > removed_capacity) {
+      complete = 0;
+      break;
+    }
+    for (auto& [key, row] : s.index) {
+      if (!row.dirty) continue;
+      float* p = t->row_ptr(s, row);
+      keys_out[rows] = key;
+      std::memcpy(values_out + rows * dim, p, sizeof(float) * dim);
+      if (slots_out != nullptr && slot_width > 0) {
+        std::memcpy(slots_out + rows * slot_width, p + dim,
+                    sizeof(float) * slot_width);
+      }
+      if (freq_out != nullptr) freq_out[rows] = row.freq;
+      if (clear) row.dirty = 0;
+      ++rows;
+    }
+    for (int64_t key : s.removed_log) removed_out[removed++] = key;
+    if (clear) s.removed_log.clear();
+  }
+  counts_out[0] = rows;
+  counts_out[1] = removed;
+  return complete;
+}
+
+// Nonzero when a removed log overflowed (deletions were dropped): the
+// delta chain is broken and the next checkpoint must be a full export.
+// ``reset`` clears the flag (call once the full export is durable).
+int kv_delta_overflowed(void* handle, int reset) {
+  auto* t = static_cast<KvTable*>(handle);
+  return reset ? t->removed_overflow.exchange(0)
+               : t->removed_overflow.load();
+}
+
+// Reset delta tracking (after a full/base export: the base already
+// captures every row, so pending dirty marks and removal logs are moot).
+void kv_clear_deltas(void* handle) {
+  auto* t = static_cast<KvTable*>(handle);
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> lock(s.mu);
+    for (auto& [key, row] : s.index) row.dirty = 0;
+    s.removed_log.clear();
+  }
+  t->removed_overflow.store(0);
+}
+
+// Re-mark keys dirty (checkpoint-write failure recovery: the rows were
+// exported with their marks cleared but never durably saved). Keys no
+// longer present are skipped — their removal sits in the removed log.
+void kv_mark_dirty(void* handle, const int64_t* keys, int64_t n) {
+  auto* t = static_cast<KvTable*>(handle);
+  for (int64_t i = 0; i < n; ++i) {
+    Shard& s = t->shard_for(keys[i]);
+    std::lock_guard<std::mutex> lock(s.mu);
+    auto it = s.index.find(keys[i]);
+    if (it != s.index.end()) it->second.dirty = 1;
   }
 }
 
@@ -245,7 +354,14 @@ int64_t kv_remove(void* handle, const int64_t* keys, int64_t n) {
   for (int64_t i = 0; i < n; ++i) {
     Shard& s = t->shard_for(keys[i]);
     std::lock_guard<std::mutex> lock(s.mu);
-    removed += static_cast<int64_t>(s.index.erase(keys[i]));
+    if (s.index.erase(keys[i])) {
+      ++removed;
+      if (s.removed_log.size() >= kRemovedLogShardCap) {
+        s.removed_log.clear();
+        t->removed_overflow.store(1);
+      }
+      s.removed_log.push_back(keys[i]);
+    }
   }
   t->size.fetch_sub(removed, std::memory_order_relaxed);
   return removed;

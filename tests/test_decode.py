@@ -70,6 +70,47 @@ class TestCachedForwardEquivalence:
         )
 
 
+class TestMoeDecode:
+    def _cfg(self):
+        # generous capacity: drop patterns differ between full-sequence
+        # routing (training) and per-step routing (decode), so exact
+        # equivalence is only defined in the no-drop regime
+        return _f32(
+            dataclasses.replace(
+                tfm.CONFIGS["tiny-moe"], max_seq_len=64,
+                moe_capacity_factor=float(tfm.CONFIGS["tiny-moe"].moe_experts),
+            )
+        )
+
+    def test_incremental_matches_forward(self):
+        cfg = self._cfg()
+        params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (2, 12), 0, cfg.vocab_size
+        )
+        ref = tfm.forward(params, tokens, cfg)
+        cache = init_cache(cfg, 2, 16)
+        out_p, cache = forward_cached(params, tokens[:, :4], cache, cfg)
+        outs = [out_p]
+        step = jax.jit(lambda t, c: forward_cached(params, t, c, cfg))
+        for i in range(4, 12):
+            out_i, cache = step(tokens[:, i:i + 1], cache)
+            outs.append(out_i)
+        got = jnp.concatenate(outs, axis=1)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), atol=3e-4, rtol=3e-4
+        )
+
+    def test_generate_runs(self):
+        cfg = tfm.CONFIGS["tiny-moe"]
+        params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+        prompts = jnp.asarray([[1, 2, 3], [4, 5, 6]], jnp.int32)
+        out = generate(params, prompts, cfg, gen_len=4,
+                       key=jax.random.PRNGKey(7))
+        assert out.shape == (2, 7)
+        assert (np.asarray(out[:, :3]) == np.asarray(prompts)).all()
+
+
 class TestGenerate:
     def test_shapes_and_determinism(self):
         cfg = tfm.CONFIGS["tiny"]

@@ -151,6 +151,192 @@ class TestCheckpoint:
         np.testing.assert_array_equal(out, np.zeros((1, 8), np.float32))
 
 
+class TestIncrementalCheckpoint:
+    def test_delta_tracks_only_changes(self, table):
+        table.lookup(np.arange(10))
+        table.clear_deltas()
+        # update 3 rows, read 2 others: only updates are dirty
+        table.apply_adam(np.array([1, 2, 3]), np.ones((3, 8), np.float32))
+        table.lookup(np.array([7, 8]))
+        delta = table.delta_export()
+        assert sorted(delta["keys"].tolist()) == [1, 2, 3]
+        assert delta["removed"].size == 0
+        # clearing: the next delta is empty
+        assert table.delta_export()["keys"].size == 0
+
+    def test_delta_includes_removals(self, table):
+        table.lookup(np.arange(5))
+        table.clear_deltas()
+        table.remove(np.array([0, 3]))
+        delta = table.delta_export()
+        assert sorted(delta["removed"].tolist()) == [0, 3]
+
+    def test_base_plus_deltas_restores_exactly(self, tmp_path):
+        from dlrover_tpu.embedding.kv_table import (
+            IncrementalCheckpointManager,
+        )
+
+        src = KvEmbeddingTable(dim=8, num_slots=2, seed=7)
+        mgr = IncrementalCheckpointManager(
+            src, str(tmp_path / "ckpt"), base_interval=100
+        )
+        rng = np.random.default_rng(0)
+        src.lookup(np.arange(50))
+        mgr.save()  # base-1
+        for i in range(3):
+            ids = rng.integers(0, 80, 20)  # some new, some existing
+            src.apply_adam(ids, rng.normal(size=(20, 8)).astype(np.float32))
+            src.remove(np.array([i]))
+            mgr.save()  # delta-2..4
+        dst = KvEmbeddingTable(dim=8, num_slots=2, seed=7)
+        mgr2 = IncrementalCheckpointManager(dst, str(tmp_path / "ckpt"))
+        assert mgr2.restore() == 4
+        ref = src.export()
+        got = dst.export()
+        order_r = np.argsort(ref["keys"])
+        order_g = np.argsort(got["keys"])
+        np.testing.assert_array_equal(
+            ref["keys"][order_r], got["keys"][order_g]
+        )
+        np.testing.assert_array_equal(
+            ref["values"][order_r], got["values"][order_g]
+        )
+        np.testing.assert_array_equal(
+            ref["slots"][order_r], got["slots"][order_g]
+        )
+
+    def test_failed_write_loses_nothing(self, tmp_path, monkeypatch):
+        """A delta write that dies must not drop changes from the chain
+        or leave a version gap."""
+        from dlrover_tpu.embedding.kv_table import (
+            IncrementalCheckpointManager,
+        )
+
+        src = KvEmbeddingTable(dim=8, num_slots=2, seed=3)
+        mgr = IncrementalCheckpointManager(src, str(tmp_path / "c"))
+        src.lookup(np.arange(20))
+        mgr.save()  # base-1
+        src.apply_adam(np.array([4, 5]), np.ones((2, 8), np.float32))
+        src.remove(np.array([9]))
+
+        real_write = mgr._write
+        calls = {"n": 0}
+
+        def flaky(path, snap):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("disk full")
+            real_write(path, snap)
+
+        monkeypatch.setattr(mgr, "_write", flaky)
+        with pytest.raises(OSError):
+            mgr.save()
+        # more changes after the failure, then a successful save
+        src.apply_adam(np.array([5, 6]), np.ones((2, 8), np.float32))
+        path = mgr.save()
+        assert path.endswith("delta-2.npz")  # no version gap
+
+        dst = KvEmbeddingTable(dim=8, num_slots=2, seed=3)
+        mgr2 = IncrementalCheckpointManager(dst, str(tmp_path / "c"))
+        assert mgr2.restore() == 2
+        ref, got = src.export(), dst.export()
+        o_r, o_g = np.argsort(ref["keys"]), np.argsort(got["keys"])
+        np.testing.assert_array_equal(ref["keys"][o_r], got["keys"][o_g])
+        np.testing.assert_array_equal(
+            ref["values"][o_r], got["values"][o_g]
+        )
+
+    def test_merge_drops_rows_removed_later(self):
+        from dlrover_tpu.embedding.kv_table import merge_deltas
+
+        pending = {
+            "keys": np.array([1, 2], np.int64),
+            "values": np.ones((2, 4), np.float32),
+            "slots": np.zeros((2, 8), np.float32),
+            "freq": np.ones(2, np.uint32),
+            "removed": np.empty(0, np.int64),
+        }
+        fresh = {
+            "keys": np.empty(0, np.int64),
+            "values": np.empty((0, 4), np.float32),
+            "slots": np.empty((0, 8), np.float32),
+            "freq": np.empty(0, np.uint32),
+            "removed": np.array([2], np.int64),
+        }
+        out = merge_deltas(pending, fresh)
+        # key 2 was removed after its pending export: replaying its stale
+        # row would resurrect it
+        assert out["keys"].tolist() == [1]
+        assert out["removed"].tolist() == [2]
+
+    def test_restore_refuses_orphan_deltas(self, tmp_path):
+        from dlrover_tpu.embedding.kv_table import (
+            IncrementalCheckpointManager,
+        )
+
+        t = KvEmbeddingTable(dim=8, num_slots=2)
+        mgr = IncrementalCheckpointManager(t, str(tmp_path / "c"))
+        t.lookup(np.arange(4))
+        mgr.save()
+        t.apply_adam(np.array([1]), np.ones((1, 8), np.float32))
+        p = mgr.save()
+        # fabricate a gap: delta-2 exists, delta-3 missing, delta-4 orphan
+        os.rename(p, p.replace("delta-2", "delta-4"))
+        dst = KvEmbeddingTable(dim=8, num_slots=2)
+        mgr2 = IncrementalCheckpointManager(dst, str(tmp_path / "c"))
+        with pytest.raises(ValueError, match="later files exist"):
+            mgr2.restore()
+
+    def test_removed_log_overflow_forces_base(self, tmp_path):
+        """Overflowing the bounded removed log (deletions dropped) must
+        break the delta chain loudly: the next save becomes a base and
+        restore still matches the live table."""
+        from dlrover_tpu.embedding.kv_table import (
+            IncrementalCheckpointManager,
+        )
+
+        t = KvEmbeddingTable(dim=4, num_slots=0)
+        mgr = IncrementalCheckpointManager(
+            t, str(tmp_path / "c"), base_interval=1000
+        )
+        t.lookup(np.arange(10))
+        mgr.save()  # base-1
+        # the per-shard cap is 2^16; one shard overflows well before
+        # 17 * 2^16 total removals
+        n = 17 * (1 << 16)
+        ids = np.arange(n) + 1000
+        t.lookup(ids, init_missing=True)
+        t.remove(ids)
+        assert t.delta_overflowed()
+        path = mgr.save()
+        assert "base-" in os.path.basename(path)
+        assert not t.delta_overflowed()
+        dst = KvEmbeddingTable(dim=4, num_slots=0)
+        mgr2 = IncrementalCheckpointManager(dst, str(tmp_path / "c"))
+        mgr2.restore()
+        assert sorted(dst.export()["keys"]) == sorted(t.export()["keys"])
+
+    def test_mark_dirty_reexports(self, table):
+        table.lookup(np.arange(4))
+        table.clear_deltas()
+        table.mark_dirty(np.array([2, 99]))  # 99 absent: skipped
+        delta = table.delta_export()
+        assert delta["keys"].tolist() == [2]
+
+    def test_deltas_are_smaller_than_base(self, tmp_path):
+        from dlrover_tpu.embedding.kv_table import (
+            IncrementalCheckpointManager,
+        )
+
+        t = KvEmbeddingTable(dim=8, num_slots=2)
+        mgr = IncrementalCheckpointManager(t, str(tmp_path / "c"))
+        t.lookup(np.arange(1000))
+        base = mgr.save()
+        t.apply_adam(np.array([5]), np.ones((1, 8), np.float32))
+        delta = mgr.save()
+        assert os.path.getsize(delta) < os.path.getsize(base) / 10
+
+
 class TestRecsysExample:
     def test_example_learns(self, tmp_path):
         """examples/train_recsys.py: sparse embedding + dense tower learns

@@ -309,6 +309,52 @@ class TestStrategyNumericEquivalence:
 
 
 class TestRematPolicies:
+    def test_blockwise_ce_matches_full(self):
+        """ce_chunks must not change the loss or its gradients — it only
+        changes what lands in HBM."""
+        import dataclasses
+
+        cfg = dataclasses.replace(CFG, dtype="float32")
+        params = T.init_params(cfg, jax.random.PRNGKey(0))
+        tok = jax.random.randint(
+            jax.random.PRNGKey(1), (4, 33), 0, cfg.vocab_size
+        )
+        mask = (jax.random.uniform(jax.random.PRNGKey(2), (4, 33)) > 0.2)
+        for batch in [{"tokens": tok}, {"tokens": tok, "mask": mask}]:
+            ref, ref_g = jax.value_and_grad(
+                lambda p: T.loss_fn(p, batch, cfg)
+            )(params)
+            for chunks in [4, 7, 128]:  # 7 -> falls back to a divisor
+                cfg_c = dataclasses.replace(cfg, ce_chunks=chunks)
+                got, got_g = jax.value_and_grad(
+                    lambda p: T.loss_fn(p, batch, cfg_c)
+                )(params)
+                np.testing.assert_allclose(
+                    float(got), float(ref), rtol=1e-5,
+                    err_msg=f"chunks={chunks}",
+                )
+                jax.tree.map(
+                    lambda a, b: np.testing.assert_allclose(
+                        np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
+                    ),
+                    got_g, ref_g,
+                )
+
+    def test_blockwise_ce_mup_scale(self):
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            CFG, dtype="float32", mup_base_width=32
+        )
+        params = T.init_params(cfg, jax.random.PRNGKey(0))
+        batch = {"tokens": jax.random.randint(
+            jax.random.PRNGKey(1), (2, 17), 0, cfg.vocab_size
+        )}
+        ref = T.loss_fn(params, batch, cfg)
+        cfg_c = dataclasses.replace(cfg, ce_chunks=8)
+        got = T.loss_fn(params, batch, cfg_c)
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+
     def test_save_attn_same_loss_as_nothing(self):
         import dataclasses
         import optax
