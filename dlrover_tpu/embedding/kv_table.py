@@ -92,9 +92,22 @@ def _load_lib():
             ctypes.c_void_p, ctypes.c_int64, _i64p, ctypes.c_int,
         ]
         lib.kv_delta_overflowed.restype = ctypes.c_int
-        lib.kv_delta_overflowed.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.kv_delta_overflowed.argtypes = [ctypes.c_void_p]
+        lib.kv_overflow_gen.restype = ctypes.c_int64
+        lib.kv_overflow_gen.argtypes = [ctypes.c_void_p]
+        lib.kv_ack_overflow.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.kv_io_errors.restype = ctypes.c_int64
+        lib.kv_io_errors.argtypes = [ctypes.c_void_p]
         lib.kv_clear_deltas.argtypes = [ctypes.c_void_p]
         lib.kv_mark_dirty.argtypes = [ctypes.c_void_p, _i64p, ctypes.c_int64]
+        lib.kv_enable_spill.restype = ctypes.c_int
+        lib.kv_enable_spill.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.kv_evict.restype = ctypes.c_int64
+        lib.kv_evict.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int64,
+        ]
+        lib.kv_disk_rows.restype = ctypes.c_int64
+        lib.kv_disk_rows.argtypes = [ctypes.c_void_p]
         _lib = lib
         return lib
 
@@ -167,12 +180,40 @@ class KvEmbeddingTable:
         flat = np.ascontiguousarray(ids, np.int64).reshape(-1)
         return int(self._lib.kv_remove(self._handle, flat, flat.size))
 
+    # ---------------------------------------------- hybrid (tiered) storage
+
+    def enable_spill(self, path: str) -> None:
+        """Attach a disk spill tier (reference: hybrid_embedding's
+        mem + storage tables). Cold rows move there via ``evict`` and
+        fault back in on access; export/checkpoint sees both tiers."""
+        rc = int(self._lib.kv_enable_spill(
+            self._handle, os.fsencode(path)
+        ))
+        if rc == -2:
+            raise RuntimeError(
+                "spill tier already enabled; re-pointing it would orphan "
+                "the spilled rows"
+            )
+        if rc != 0:
+            raise OSError(f"cannot open spill file {path!r}")
+
+    def evict(self, max_freq: int = 1, max_rows: int = 0) -> int:
+        """Spill rows with frequency <= ``max_freq`` to disk (at most
+        ``max_rows``; 0 = unlimited), freeing their host memory. Returns
+        the number spilled."""
+        return int(self._lib.kv_evict(self._handle, max_freq, max_rows))
+
+    @property
+    def disk_rows(self) -> int:
+        return int(self._lib.kv_disk_rows(self._handle))
+
     # ------------------------------------------------------------ checkpoint
 
     def export(self, min_freq: int = 0, with_slots: bool = True
                ) -> dict[str, np.ndarray]:
         """Snapshot rows with frequency >= ``min_freq`` (the reference's
         under-threshold feature filtering)."""
+        errs0 = self.io_errors
         n = int(self._lib.kv_export(self._handle, min_freq, None, None,
                                     None, None, 0))
         keys = np.empty(n, np.int64)
@@ -195,6 +236,11 @@ class KvEmbeddingTable:
         if written < n:
             keys, values = keys[:written], values[:written]
             slots, freq = slots[:written], freq[:written]
+        if self.io_errors != errs0:
+            raise OSError(
+                "spill-tier read failures during export: the snapshot "
+                "would silently omit rows"
+            )
         out = {
             "keys": keys, "values": values, "freq": freq,
             "step": np.asarray(self._step, np.int64),
@@ -289,21 +335,54 @@ class KvEmbeddingTable:
         frequency bumps do not mark rows dirty, so restored frequencies
         can lag the live table's — value data is exact.
         """
-        out, complete = self._delta_drain_once(with_slots, clear)
-        tries = 0
-        while not complete and clear and tries < 8:
-            chunk, complete = self._delta_drain_once(with_slots, clear)
-            out = merge_deltas(out, chunk)
-            tries += 1
-        # an early stop is safe: undrained shards keep their marks/logs
-        # and surface in the next delta
+        errs0 = self.io_errors
+        if clear:
+            out, complete = self._delta_drain_once(with_slots, True)
+            tries = 0
+            while not complete and tries < 8:
+                chunk, complete = self._delta_drain_once(with_slots, True)
+                out = merge_deltas(out, chunk)
+                tries += 1
+            # an early stop here is safe: undrained shards keep their
+            # marks/logs and surface in the next delta
+        else:
+            # clear=False passes drain nothing, so chunks can't be
+            # merged (they'd duplicate); retry whole passes with freshly
+            # counted buffers until one completes
+            for _ in range(8):
+                out, complete = self._delta_drain_once(with_slots, False)
+                if complete:
+                    break
+            else:
+                raise RuntimeError(
+                    "delta_export(clear=False) could not complete: the "
+                    "table is mutating faster than the drain"
+                )
+        if self.io_errors != errs0:
+            raise OSError(
+                "spill-tier read failures during delta export: the "
+                "delta would silently omit rows"
+            )
         return out
 
-    def delta_overflowed(self, reset: bool = False) -> bool:
-        """True when removals were dropped (bounded removed-log overflow):
-        the delta chain is broken and the next save must be a full
-        export. ``reset`` clears the flag once that export is durable."""
-        return bool(self._lib.kv_delta_overflowed(self._handle, int(reset)))
+    def delta_overflowed(self) -> bool:
+        """True when removals were dropped (bounded removed-log overflow)
+        and no covering base has been acked: the delta chain is broken
+        and the next save must be a full export."""
+        return bool(self._lib.kv_delta_overflowed(self._handle))
+
+    def overflow_gen(self) -> int:
+        """Monotonic overflow generation (see the manager's ack cycle)."""
+        return int(self._lib.kv_overflow_gen(self._handle))
+
+    def ack_overflow(self, gen: int) -> None:
+        """Mark overflows up to ``gen`` as covered by a durable base."""
+        self._lib.kv_ack_overflow(self._handle, gen)
+
+    @property
+    def io_errors(self) -> int:
+        """Cumulative spill-tier read failures."""
+        return int(self._lib.kv_io_errors(self._handle))
 
     def clear_deltas(self) -> None:
         """Reset delta tracking (call after a full/base export)."""
@@ -378,10 +457,19 @@ class IncrementalCheckpointManager:
         os.makedirs(directory, exist_ok=True)
 
     def _write(self, path: str, snap: dict) -> None:
+        # save()'s contract is "tracking only advances once the file is
+        # durable" — so durable must mean fsynced, not just in page cache
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             np.savez(f, **snap)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
+        dfd = os.open(self.directory, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
 
     def save(self) -> str:
         """Write the next checkpoint (base every ``base_interval``-th
@@ -394,6 +482,10 @@ class IncrementalCheckpointManager:
         native log) forces a base — the delta chain is broken there.
         """
         v = self._version + 1
+        # the overflow generation observed BEFORE draining is what a
+        # durable base can ack; an overflow racing the save keeps the
+        # flag up and forces the next save to be a base as well
+        overflow_gen = self.table.overflow_gen()
         force_base = self.table.delta_overflowed()
         if force_base or (v - 1) % self.base_interval == 0:
             # drain tracking FIRST, then snapshot: the full export is a
@@ -408,7 +500,7 @@ class IncrementalCheckpointManager:
                 self._pending = merge_deltas(self._pending, pend)
                 raise
             self._pending = None
-            self.table.delta_overflowed(reset=True)
+            self.table.ack_overflow(overflow_gen)
         else:
             path = os.path.join(self.directory, f"delta-{v}.npz")
             snap = merge_deltas(self._pending, self.table.delta_export())
@@ -435,26 +527,28 @@ class IncrementalCheckpointManager:
         if not bases:
             return 0
         base_v = bases[-1]
-        with np.load(os.path.join(self.directory, f"base-{base_v}.npz")) as z:
-            self.table.import_(dict(z))
-        v = base_v
-        while True:
-            path = os.path.join(self.directory, f"delta-{v + 1}.npz")
-            if not os.path.exists(path):
-                break
-            v += 1
-            with np.load(path) as z:
-                self.table.apply_delta(dict(z))
-        orphans = sorted(
-            f for f in names
+        deltas = {
+            int(f[len("delta-"):-len(".npz")])
+            for f in names
             if f.startswith("delta-") and f.endswith(".npz")
-            and int(f[len("delta-"):-len(".npz")]) > v
-        )
+        }
+        # validate the chain BEFORE touching the table: raising after a
+        # partial replay would leave the caller's table half-mutated
+        v = base_v
+        while (v + 1) in deltas:
+            v += 1
+        orphans = sorted(d for d in deltas if d > v)
         if orphans:
             raise ValueError(
                 f"delta chain ends at version {v} but later files exist "
-                f"({orphans}): refusing a restore that would drop them"
+                f"(delta-{orphans}): refusing a restore that would drop "
+                "them"
             )
+        with np.load(os.path.join(self.directory, f"base-{base_v}.npz")) as z:
+            self.table.import_(dict(z))
+        for d in range(base_v + 1, v + 1):
+            with np.load(os.path.join(self.directory, f"delta-{d}.npz")) as z:
+                self.table.apply_delta(dict(z))
         # restore itself dirties every imported row; the next delta
         # should be relative to this restored state
         self.table.clear_deltas()

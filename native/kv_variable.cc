@@ -14,6 +14,9 @@
 //
 // Exposed as a C API consumed via ctypes (no pybind11 in the image).
 
+#include <fcntl.h>
+#include <unistd.h>
+
 #include <algorithm>
 #include <atomic>
 #include <cstdint>
@@ -22,6 +25,7 @@
 #include <memory>
 #include <mutex>
 #include <random>
+#include <string>
 #include <unordered_map>
 #include <vector>
 
@@ -29,14 +33,19 @@ namespace {
 
 constexpr int kNumShards = 16;  // power of two
 
+// chunk sentinel: the row lives in the spill file; offset is its disk slot
+constexpr uint32_t kDiskChunk = 0xFFFFFFFFu;
+
 struct Row {
   uint32_t chunk;
-  uint32_t offset;  // row index within the chunk
+  uint32_t offset;  // row index within the chunk (or disk slot)
   uint32_t freq;
   // weight values changed since the last clearing delta export (set on
   // insert / optimizer update / import, NOT on lookup frequency bumps —
   // marking reads would make every delta a full export)
   uint8_t dirty;
+
+  bool on_disk() const { return chunk == kDiskChunk; }
 };
 
 struct Shard {
@@ -45,6 +54,9 @@ struct Shard {
   // chunked arena: each chunk holds kChunkRows rows of width row_width
   std::vector<std::unique_ptr<float[]>> chunks;
   uint32_t next_offset = 0;  // next free row in the last chunk
+  // arena slots released by eviction/removal, reused by insert (bounds
+  // host memory under spill — the whole point of the hybrid tier)
+  std::vector<std::pair<uint32_t, uint32_t>> free_slots;
   // keys removed since the last clearing removed-log drain (delta
   // restore must replay deletions before upserts)
   std::vector<int64_t> removed_log;
@@ -64,7 +76,24 @@ struct KvTable {
   float init_scale = 0.05f;
   Shard shards[kNumShards];
   std::atomic<int64_t> size{0};
-  std::atomic<int> removed_overflow{0};
+  // removed-log overflow is a monotonic generation + an acked watermark:
+  // "overflowed" means gen > ack. The saver acks the generation it
+  // observed BEFORE draining, so an overflow racing the save stays
+  // pending and forces the next save to be a base too.
+  std::atomic<int64_t> overflow_gen{0};
+  std::atomic<int64_t> overflow_ack{0};
+  // spill-tier read failures (checkpoint correctness depends on them
+  // being surfaced, not papered over)
+  std::atomic<int64_t> io_errors{0};
+
+  // hybrid (multi-tier) storage: cold rows spill to a fixed-width-record
+  // file and fault back in on access (reference: the hybrid_embedding
+  // MemStorageTable + secondary storage tables, table_manager.h)
+  int spill_fd = -1;
+  std::mutex disk_mu;              // guards the two members below
+  std::vector<uint32_t> disk_free; // reusable disk slots
+  uint32_t disk_next = 0;          // next fresh disk slot
+  std::atomic<int64_t> disk_rows{0};
 
   static constexpr uint32_t kChunkRows = 4096;
 
@@ -77,18 +106,29 @@ struct KvTable {
     return shards[x & (kNumShards - 1)];
   }
 
-  // caller holds the shard lock
+  // caller holds the shard lock; r must be in memory
   float* row_ptr(Shard& s, const Row& r) {
     return s.chunks[r.chunk].get() + static_cast<size_t>(r.offset) * row_width;
   }
 
-  // caller holds the shard lock; initializes embedding part, zeroes slots
-  Row& insert(Shard& s, int64_t key) {
+  // caller holds the shard lock: grab a free arena slot or grow
+  std::pair<uint32_t, uint32_t> alloc_slot(Shard& s) {
+    if (!s.free_slots.empty()) {
+      auto slot = s.free_slots.back();
+      s.free_slots.pop_back();
+      return slot;
+    }
     if (s.chunks.empty() || s.next_offset == kChunkRows) {
       s.chunks.emplace_back(new float[static_cast<size_t>(kChunkRows) * row_width]);
       s.next_offset = 0;
     }
-    Row r{static_cast<uint32_t>(s.chunks.size() - 1), s.next_offset++, 0, 1};
+    return {static_cast<uint32_t>(s.chunks.size() - 1), s.next_offset++};
+  }
+
+  // caller holds the shard lock; initializes embedding part, zeroes slots
+  Row& insert(Shard& s, int64_t key) {
+    auto [chunk, off] = alloc_slot(s);
+    Row r{chunk, off, 0, 1};
     float* p = row_ptr(s, r);
     // deterministic per-key init: uniform(-scale, scale) from key+seed
     std::mt19937_64 gen(seed ^ static_cast<uint64_t>(key));
@@ -98,6 +138,65 @@ struct KvTable {
     auto it = s.index.emplace(key, r).first;
     size.fetch_add(1, std::memory_order_relaxed);
     return it->second;
+  }
+
+  size_t row_bytes() const { return sizeof(float) * row_width; }
+
+  // caller holds the shard lock; lock order is shard.mu -> disk_mu
+  bool spill_row(Shard& s, Row& r) {
+    if (spill_fd < 0 || r.on_disk()) return false;
+    uint32_t slot;
+    {
+      std::lock_guard<std::mutex> dlock(disk_mu);
+      if (!disk_free.empty()) {
+        slot = disk_free.back();
+        disk_free.pop_back();
+      } else {
+        slot = disk_next++;
+      }
+    }
+    const float* p = row_ptr(s, r);
+    ssize_t want = static_cast<ssize_t>(row_bytes());
+    if (pwrite(spill_fd, p, want,
+               static_cast<off_t>(slot) * want) != want) {
+      std::lock_guard<std::mutex> dlock(disk_mu);
+      disk_free.push_back(slot);  // write failed: keep the row in memory
+      return false;
+    }
+    s.free_slots.emplace_back(r.chunk, r.offset);
+    r.chunk = kDiskChunk;
+    r.offset = slot;
+    disk_rows.fetch_add(1, std::memory_order_relaxed);
+    return true;
+  }
+
+  // caller holds the shard lock; reads a spilled row without faulting it in
+  bool read_spilled(const Row& r, float* out) {
+    ssize_t want = static_cast<ssize_t>(row_bytes());
+    if (pread(spill_fd, out, want,
+              static_cast<off_t>(r.offset) * want) != want) {
+      io_errors.fetch_add(1, std::memory_order_relaxed);
+      return false;
+    }
+    return true;
+  }
+
+  // caller holds the shard lock: bring a spilled row back to the arena
+  bool fault_in(Shard& s, Row& r) {
+    if (!r.on_disk()) return true;
+    auto [chunk, off] = alloc_slot(s);
+    Row mem{chunk, off, r.freq, r.dirty};
+    if (!read_spilled(r, row_ptr(s, mem))) {
+      s.free_slots.emplace_back(chunk, off);
+      return false;
+    }
+    {
+      std::lock_guard<std::mutex> dlock(disk_mu);
+      disk_free.push_back(r.offset);
+    }
+    r = mem;
+    disk_rows.fetch_sub(1, std::memory_order_relaxed);
+    return true;
   }
 };
 
@@ -115,7 +214,55 @@ void* kv_create(int dim, int num_slots, uint64_t seed, float init_scale) {
   return t;
 }
 
-void kv_free(void* handle) { delete static_cast<KvTable*>(handle); }
+void kv_free(void* handle) {
+  auto* t = static_cast<KvTable*>(handle);
+  if (t->spill_fd >= 0) close(t->spill_fd);
+  delete t;
+}
+
+// Enable the disk spill tier backed by ``path`` (created/truncated).
+// Returns 0 on success, -1 when the file cannot be opened, -2 when a
+// spill tier is already active (re-pointing it would orphan every
+// spilled row's disk slot — rows would silently read as garbage).
+int kv_enable_spill(void* handle, const char* path) {
+  auto* t = static_cast<KvTable*>(handle);
+  if (t->spill_fd >= 0) return -2;
+  int fd = open(path, O_RDWR | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return -1;
+  t->spill_fd = fd;
+  return 0;
+}
+
+// Cumulative spill-tier read failures. Checkpoint/export callers compare
+// before/after: a change means the snapshot silently omitted rows.
+int64_t kv_io_errors(void* handle) {
+  return static_cast<KvTable*>(handle)
+      ->io_errors.load(std::memory_order_relaxed);
+}
+
+// Evict rows with freq <= max_freq to the spill file, at most max_rows
+// (<=0: unlimited). Returns the number spilled. Eviction frees the rows'
+// arena slots, bounding host memory; spilled rows fault back in on
+// lookup/update and are still seen by export/delta export.
+int64_t kv_evict(void* handle, uint32_t max_freq, int64_t max_rows) {
+  auto* t = static_cast<KvTable*>(handle);
+  if (t->spill_fd < 0) return 0;
+  int64_t evicted = 0;
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> lock(s.mu);
+    for (auto& [key, row] : s.index) {
+      if (max_rows > 0 && evicted >= max_rows) return evicted;
+      if (row.on_disk() || row.freq > max_freq) continue;
+      if (t->spill_row(s, row)) ++evicted;
+    }
+  }
+  return evicted;
+}
+
+int64_t kv_disk_rows(void* handle) {
+  return static_cast<KvTable*>(handle)
+      ->disk_rows.load(std::memory_order_relaxed);
+}
 
 int64_t kv_size(void* handle) {
   return static_cast<KvTable*>(handle)->size.load(std::memory_order_relaxed);
@@ -141,6 +288,10 @@ void kv_lookup(void* handle, const int64_t* keys, int64_t n, float* out,
       continue;
     }
     it->second.freq++;
+    if (it->second.on_disk() && !t->fault_in(s, it->second)) {
+      std::memset(out + i * t->dim, 0, sizeof(float) * t->dim);
+      continue;
+    }
     std::memcpy(out + i * t->dim, t->row_ptr(s, it->second),
                 sizeof(float) * t->dim);
   }
@@ -163,6 +314,7 @@ void kv_apply_adam(void* handle, const int64_t* keys, const float* grads,
     std::lock_guard<std::mutex> lock(s.mu);
     auto it = s.index.find(keys[i]);
     Row* r = it != s.index.end() ? &it->second : &t->insert(s, keys[i]);
+    if (r->on_disk() && !t->fault_in(s, *r)) continue;  // I/O error: skip
     // a row that receives updates is live: export's frequency filtering
     // must never drop trained weights just because no lookup preceded
     if (r->freq == 0) r->freq = 1;
@@ -208,6 +360,7 @@ int64_t kv_export(void* handle, uint32_t min_freq, int64_t* keys_out,
   auto* t = static_cast<KvTable*>(handle);
   const int dim = t->dim;
   const int slot_width = dim * t->num_slots;
+  std::vector<float> scratch(t->row_width);
   int64_t count = 0;
   for (auto& s : t->shards) {
     std::lock_guard<std::mutex> lock(s.mu);
@@ -215,7 +368,13 @@ int64_t kv_export(void* handle, uint32_t min_freq, int64_t* keys_out,
       if (row.freq < min_freq) continue;
       if (keys_out != nullptr) {
         if (count >= capacity) return count;
-        float* p = t->row_ptr(s, row);
+        const float* p;
+        if (row.on_disk()) {  // snapshot spilled rows without faulting in
+          if (!t->read_spilled(row, scratch.data())) continue;
+          p = scratch.data();
+        } else {
+          p = t->row_ptr(s, row);
+        }
         keys_out[count] = key;
         std::memcpy(values_out + count * dim, p, sizeof(float) * dim);
         if (slots_out != nullptr && slot_width > 0) {
@@ -241,6 +400,18 @@ void kv_import(void* handle, const int64_t* keys, const float* values,
     std::lock_guard<std::mutex> lock(s.mu);
     auto it = s.index.find(keys[i]);
     Row* r = it != s.index.end() ? &it->second : &t->insert(s, keys[i]);
+    if (r->on_disk()) {
+      // import overwrites the whole row — no need to read the spilled
+      // copy, just move the row back to a fresh arena slot
+      {
+        std::lock_guard<std::mutex> dlock(t->disk_mu);
+        t->disk_free.push_back(r->offset);
+      }
+      t->disk_rows.fetch_sub(1, std::memory_order_relaxed);
+      auto [chunk, off] = t->alloc_slot(s);
+      r->chunk = chunk;
+      r->offset = off;
+    }
     float* p = t->row_ptr(s, *r);
     std::memcpy(p, values + i * dim, sizeof(float) * dim);
     if (slots != nullptr && slot_width > 0) {
@@ -274,6 +445,7 @@ int64_t kv_delta_export(void* handle, int64_t* keys_out, float* values_out,
   auto* t = static_cast<KvTable*>(handle);
   const int dim = t->dim;
   const int slot_width = dim * t->num_slots;
+  std::vector<float> scratch(t->row_width);
   int64_t rows = 0, removed = 0;
   int64_t complete = 1;
   for (auto& s : t->shards) {
@@ -293,7 +465,13 @@ int64_t kv_delta_export(void* handle, int64_t* keys_out, float* values_out,
     }
     for (auto& [key, row] : s.index) {
       if (!row.dirty) continue;
-      float* p = t->row_ptr(s, row);
+      const float* p;
+      if (row.on_disk()) {
+        if (!t->read_spilled(row, scratch.data())) continue;
+        p = scratch.data();
+      } else {
+        p = t->row_ptr(s, row);
+      }
       keys_out[rows] = key;
       std::memcpy(values_out + rows * dim, p, sizeof(float) * dim);
       if (slots_out != nullptr && slot_width > 0) {
@@ -312,13 +490,26 @@ int64_t kv_delta_export(void* handle, int64_t* keys_out, float* values_out,
   return complete;
 }
 
-// Nonzero when a removed log overflowed (deletions were dropped): the
-// delta chain is broken and the next checkpoint must be a full export.
-// ``reset`` clears the flag (call once the full export is durable).
-int kv_delta_overflowed(void* handle, int reset) {
+// Nonzero when an unacked removed-log overflow exists (deletions were
+// dropped): the delta chain is broken and the next checkpoint must be a
+// full export.
+int kv_delta_overflowed(void* handle) {
   auto* t = static_cast<KvTable*>(handle);
-  return reset ? t->removed_overflow.exchange(0)
-               : t->removed_overflow.load();
+  return t->overflow_gen.load() > t->overflow_ack.load() ? 1 : 0;
+}
+
+// Current overflow generation. The saver reads it BEFORE draining, and
+// acks that value once the covering full export is durable — an overflow
+// racing the save keeps gen > ack and forces another base.
+int64_t kv_overflow_gen(void* handle) {
+  return static_cast<KvTable*>(handle)->overflow_gen.load();
+}
+
+void kv_ack_overflow(void* handle, int64_t gen) {
+  auto* t = static_cast<KvTable*>(handle);
+  int64_t cur = t->overflow_ack.load();
+  while (gen > cur && !t->overflow_ack.compare_exchange_weak(cur, gen)) {
+  }
 }
 
 // Reset delta tracking (after a full/base export: the base already
@@ -330,7 +521,7 @@ void kv_clear_deltas(void* handle) {
     for (auto& [key, row] : s.index) row.dirty = 0;
     s.removed_log.clear();
   }
-  t->removed_overflow.store(0);
+  t->overflow_ack.store(t->overflow_gen.load());
 }
 
 // Re-mark keys dirty (checkpoint-write failure recovery: the rows were
@@ -354,11 +545,21 @@ int64_t kv_remove(void* handle, const int64_t* keys, int64_t n) {
   for (int64_t i = 0; i < n; ++i) {
     Shard& s = t->shard_for(keys[i]);
     std::lock_guard<std::mutex> lock(s.mu);
-    if (s.index.erase(keys[i])) {
+    auto it = s.index.find(keys[i]);
+    if (it != s.index.end()) {
+      // reclaim the row's storage (arena slot or disk slot)
+      if (it->second.on_disk()) {
+        std::lock_guard<std::mutex> dlock(t->disk_mu);
+        t->disk_free.push_back(it->second.offset);
+        t->disk_rows.fetch_sub(1, std::memory_order_relaxed);
+      } else {
+        s.free_slots.emplace_back(it->second.chunk, it->second.offset);
+      }
+      s.index.erase(it);
       ++removed;
       if (s.removed_log.size() >= kRemovedLogShardCap) {
         s.removed_log.clear();
-        t->removed_overflow.store(1);
+        t->overflow_gen.fetch_add(1, std::memory_order_relaxed);
       }
       s.removed_log.push_back(keys[i]);
     }

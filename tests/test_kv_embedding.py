@@ -286,6 +286,13 @@ class TestIncrementalCheckpoint:
         mgr2 = IncrementalCheckpointManager(dst, str(tmp_path / "c"))
         with pytest.raises(ValueError, match="later files exist"):
             mgr2.restore()
+        # the chain was validated before any import: dst is untouched
+        assert len(dst) == 0
+
+    def test_enable_spill_twice_rejected(self, table, tmp_path):
+        table.enable_spill(str(tmp_path / "a.bin"))
+        with pytest.raises(RuntimeError, match="already enabled"):
+            table.enable_spill(str(tmp_path / "b.bin"))
 
     def test_removed_log_overflow_forces_base(self, tmp_path):
         """Overflowing the bounded removed log (deletions dropped) must
@@ -335,6 +342,85 @@ class TestIncrementalCheckpoint:
         t.apply_adam(np.array([5]), np.ones((1, 8), np.float32))
         delta = mgr.save()
         assert os.path.getsize(delta) < os.path.getsize(base) / 10
+
+
+class TestHybridStorage:
+    def test_evict_and_fault_in_roundtrip(self, table, tmp_path):
+        table.enable_spill(str(tmp_path / "spill.bin"))
+        vals = table.lookup(np.arange(100))  # freq 1 each
+        hot = table.lookup(np.arange(10))  # freq 2 for [0, 10)
+        spilled = table.evict(max_freq=1)
+        assert spilled == 90
+        assert table.disk_rows == 90
+        assert len(table) == 100  # logical size unchanged
+        # faulting in returns the exact spilled values
+        back = table.lookup(np.arange(100))
+        np.testing.assert_array_equal(back, vals)
+        assert table.disk_rows == 0
+        np.testing.assert_array_equal(hot, vals[:10])
+
+    def test_update_faults_in(self, table, tmp_path):
+        table.enable_spill(str(tmp_path / "s.bin"))
+        before = table.lookup(np.array([5]))
+        table.evict(max_freq=10)
+        assert table.disk_rows == 1
+        table.apply_adam(np.array([5]), np.ones((1, 8), np.float32))
+        assert table.disk_rows == 0
+        after = table.lookup(np.array([5]))
+        assert not np.array_equal(before, after)
+
+    def test_export_sees_spilled_rows(self, table, tmp_path):
+        table.enable_spill(str(tmp_path / "s.bin"))
+        vals = table.lookup(np.arange(20))
+        table.evict(max_freq=10)
+        assert table.disk_rows == 20
+        snap = table.export()
+        assert snap["keys"].size == 20
+        order = np.argsort(snap["keys"])
+        np.testing.assert_array_equal(snap["values"][order], vals)
+        # export must not disturb the tiers
+        assert table.disk_rows == 20
+
+    def test_delta_export_sees_spilled_dirty_rows(self, table, tmp_path):
+        table.enable_spill(str(tmp_path / "s.bin"))
+        table.lookup(np.arange(8))  # inserts are dirty
+        table.evict(max_freq=10)
+        delta = table.delta_export()
+        assert sorted(delta["keys"].tolist()) == list(range(8))
+
+    def test_remove_spilled_and_reuse(self, table, tmp_path):
+        table.enable_spill(str(tmp_path / "s.bin"))
+        table.lookup(np.arange(10))
+        table.evict(max_freq=10)
+        assert table.remove(np.arange(5)) == 5
+        assert table.disk_rows == 5
+        assert len(table) == 5
+        # new inserts reuse freed slots; values still correct
+        v = table.lookup(np.arange(100, 110))
+        np.testing.assert_array_equal(v, table.lookup(np.arange(100, 110)))
+
+    def test_incremental_ckpt_with_spill(self, tmp_path):
+        """The spill tier composes with base+delta checkpoints."""
+        from dlrover_tpu.embedding.kv_table import (
+            IncrementalCheckpointManager,
+        )
+
+        src = KvEmbeddingTable(dim=8, num_slots=2, seed=11)
+        src.enable_spill(str(tmp_path / "spill.bin"))
+        mgr = IncrementalCheckpointManager(src, str(tmp_path / "ckpt"))
+        src.lookup(np.arange(30))
+        mgr.save()
+        src.evict(max_freq=10)
+        src.apply_adam(np.array([3]), np.ones((1, 8), np.float32))
+        mgr.save()
+        dst = KvEmbeddingTable(dim=8, num_slots=2, seed=11)
+        mgr2 = IncrementalCheckpointManager(dst, str(tmp_path / "ckpt"))
+        assert mgr2.restore() == 2
+        ref, got = src.export(), dst.export()
+        o_r, o_g = np.argsort(ref["keys"]), np.argsort(got["keys"])
+        np.testing.assert_array_equal(
+            ref["values"][o_r], got["values"][o_g]
+        )
 
 
 class TestRecsysExample:
