@@ -76,7 +76,7 @@ def _load_lib():
         lib.kv_export.argtypes = [
             ctypes.c_void_p, ctypes.c_uint32,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
         ]
         lib.kv_import.argtypes = [
             ctypes.c_void_p, _i64p, _f32p,
@@ -213,13 +213,13 @@ class KvEmbeddingTable:
                ) -> dict[str, np.ndarray]:
         """Snapshot rows with frequency >= ``min_freq`` (the reference's
         under-threshold feature filtering)."""
-        errs0 = self.io_errors
         n = int(self._lib.kv_export(self._handle, min_freq, None, None,
-                                    None, None, 0))
+                                    None, None, 0, None))
         keys = np.empty(n, np.int64)
         values = np.empty((n, self.dim), np.float32)
         slots = np.empty((n, self.num_slots * self.dim), np.float32)
         freq = np.empty(n, np.uint32)
+        errs = np.zeros(1, np.int64)
         written = 0
         if n:
             # the fill pass is capacity-bounded: the table may mutate
@@ -232,14 +232,17 @@ class KvEmbeddingTable:
                 if with_slots and self.num_slots else None,
                 freq.ctypes.data_as(ctypes.c_void_p),
                 n,
+                errs.ctypes.data_as(ctypes.c_void_p),
             ))
         if written < n:
             keys, values = keys[:written], values[:written]
             slots, freq = slots[:written], freq[:written]
-        if self.io_errors != errs0:
+        if int(errs[0]):
+            # scoped to THIS call (the global io_errors counter also
+            # counts unrelated lookup-path failures)
             raise OSError(
-                "spill-tier read failures during export: the snapshot "
-                "would silently omit rows"
+                f"{int(errs[0])} spill-tier read failures during "
+                "export: the snapshot would silently omit rows"
             )
         out = {
             "keys": keys, "values": values, "freq": freq,
@@ -335,7 +338,6 @@ class KvEmbeddingTable:
         frequency bumps do not mark rows dirty, so restored frequencies
         can lag the live table's — value data is exact.
         """
-        errs0 = self.io_errors
         if clear:
             out, complete = self._delta_drain_once(with_slots, True)
             tries = 0
@@ -343,8 +345,10 @@ class KvEmbeddingTable:
                 chunk, complete = self._delta_drain_once(with_slots, True)
                 out = merge_deltas(out, chunk)
                 tries += 1
-            # an early stop here is safe: undrained shards keep their
-            # marks/logs and surface in the next delta
+            # early stops and spill-read failures are both SAFE here: an
+            # undrained shard keeps its marks/logs, and a row whose disk
+            # read failed keeps its dirty mark — either way the change
+            # surfaces in the next delta instead of being lost
         else:
             # clear=False passes drain nothing, so chunks can't be
             # merged (they'd duplicate); retry whole passes with freshly
@@ -358,11 +362,6 @@ class KvEmbeddingTable:
                     "delta_export(clear=False) could not complete: the "
                     "table is mutating faster than the drain"
                 )
-        if self.io_errors != errs0:
-            raise OSError(
-                "spill-tier read failures during delta export: the "
-                "delta would silently omit rows"
-            )
         return out
 
     def delta_overflowed(self) -> bool:

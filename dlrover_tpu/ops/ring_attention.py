@@ -135,7 +135,7 @@ def make_ring_attention(
     try:
         from jax import shard_map
     except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
 
     if axis_name not in mesh.axis_names or mesh.shape[axis_name] <= 1:
         # no sequence axis on this mesh: degrade to dense attention (the

@@ -129,12 +129,18 @@ _EP_RULES = [
 ]
 
 
-def dp(num_devices: int = -1) -> Strategy:
-    """Pure data parallel: params replicated, batch split."""
+def dp(num_devices: int = -1, grad_compression: bool = False) -> Strategy:
+    """Pure data parallel: params replicated, batch split.
+
+    ``grad_compression`` ships the gradient reduce as int8 (reference:
+    ATorch's quant-reduce comm compression) — worthwhile when the data
+    axis spans DCN, where that reduce is the slowest hop of the step.
+    """
     return Strategy(
         name="dp",
         mesh_axes={"data": num_devices},
         rules=[["batch", ["data", "fsdp"]]],
+        extra={"grad_compression": "int8"} if grad_compression else {},
     )
 
 
@@ -206,6 +212,36 @@ def pipeline(pipeline_size: int = 2, data_size: int = -1,
     )
 
 
+def mixed(pipeline_size: int = 2, tensor_size: int = 2,
+          data_size: int = -1, microbatches: int = 0,
+          remat: str = "none") -> Strategy:
+    """3D: GPipe pipeline × Megatron-style tensor × data parallel.
+
+    Reference analog: MixedParallelOptimization's TP+PP+DP combination
+    (atorch/atorch/auto/opt_lib/mixed_parallel_optimization.py:32) — here
+    it is just the union of the pipeline and tensor rule tables over one
+    mesh; XLA derives the collectives for both axes from the shardings.
+    """
+    return Strategy(
+        name="mixed",
+        mesh_axes={
+            "data": data_size,
+            "pipeline": pipeline_size,
+            "tensor": tensor_size,
+        },
+        rules=[
+            ["batch", ["data", "fsdp"]],
+            ["layers", "pipeline"],
+            ["stages", "pipeline"],
+        ] + [list(r) for r in _TP_RULES],
+        remat=remat,
+        extra={
+            "pipeline_stages": pipeline_size,
+            "pipeline_microbatches": microbatches,
+        },
+    )
+
+
 def moe(expert_size: int = 2, data_size: int = -1) -> Strategy:
     """Expert parallel: experts split over the expert axis."""
     return Strategy(
@@ -222,5 +258,6 @@ PRESETS = {
     "fsdp_tp": fsdp_tp,
     "long_context": long_context,
     "pipeline": pipeline,
+    "mixed": mixed,
     "moe": moe,
 }

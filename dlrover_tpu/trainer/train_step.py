@@ -159,33 +159,75 @@ def compile_train(
         grad_loss = jax.checkpoint(loss_fn, policy=policy)
     value_and_grad = jax.value_and_grad(grad_loss)
 
-    def _step(state: TrainState, batch: Any) -> tuple[TrainState, dict]:
+    def _loss_and_grads(params: Any, batch: Any) -> tuple[jax.Array, Any]:
         # batch leaves: [accum, per_step_batch, ...]
         accum = jax.tree_util.tree_leaves(batch)[0].shape[0]
 
         if accum == 1:
-            loss, grads = value_and_grad(
-                state.params, jax.tree.map(lambda x: x[0], batch)
+            return value_and_grad(
+                params, jax.tree.map(lambda x: x[0], batch)
             )
-        else:
-            def micro(carry, mb):
-                loss_acc, grads_acc = carry
-                loss, grads = value_and_grad(state.params, mb)
-                return (
-                    loss_acc + loss,
-                    jax.tree.map(jnp.add, grads_acc, grads),
-                ), None
 
-            zero = (
-                jnp.zeros((), jnp.float32),
-                jax.tree.map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), state.params
-                ),
+        def micro(carry, mb):
+            loss_acc, grads_acc = carry
+            loss, grads = value_and_grad(params, mb)
+            return (
+                loss_acc + loss,
+                jax.tree.map(jnp.add, grads_acc, grads),
+            ), None
+
+        zero = (
+            jnp.zeros((), jnp.float32),
+            jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params
+            ),
+        )
+        (loss, grads), _ = jax.lax.scan(micro, zero, batch)
+        return loss / accum, jax.tree.map(lambda g: g / accum, grads)
+
+    compute = _loss_and_grads
+    extra = getattr(strategy, "extra", {}) or {}
+    if extra.get("grad_compression"):
+        # int8-quantized gradient reduce across the data axes (reference:
+        # ATorch's quant-reduce comm compression). The grad psum XLA would
+        # insert implicitly is replaced by an explicit shard_map region:
+        # local grads -> quantized all-gather -> local dequant mean.
+        # Scope matches the reference's DDP compression: params must be
+        # replicated (the data axes are the only reduction).
+        from jax import shard_map
+
+        from dlrover_tpu.ops.collectives import quantized_tree_mean
+
+        sharded = [
+            s for s in jax.tree_util.tree_leaves(
+                param_specs,
+                is_leaf=lambda x: isinstance(x, PartitionSpec),
+            ) if s != PartitionSpec()
+        ]
+        if sharded:
+            raise ValueError(
+                "grad_compression requires replicated parameters (pure "
+                f"data parallelism); found sharded specs {sharded[:3]}"
             )
-            (loss, grads), _ = jax.lax.scan(micro, zero, batch)
-            loss = loss / accum
-            grads = jax.tree.map(lambda g: g / accum, grads)
+        axes = batch_axes(mesh)
 
+        axis_sizes = dict(mesh.shape)
+
+        def _local(params, batch):
+            loss, grads = _loss_and_grads(params, batch)
+            grads = quantized_tree_mean(grads, axes, axis_sizes)
+            return jax.lax.pmean(loss, axes), grads
+
+        compute = shard_map(
+            _local,
+            mesh=mesh,
+            in_specs=(PartitionSpec(), batch_spec),
+            out_specs=(PartitionSpec(), PartitionSpec()),
+            check_vma=False,
+        )
+
+    def _step(state: TrainState, batch: Any) -> tuple[TrainState, dict]:
+        loss, grads = compute(state.params, batch)
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params
         )

@@ -142,34 +142,6 @@ struct KvTable {
 
   size_t row_bytes() const { return sizeof(float) * row_width; }
 
-  // caller holds the shard lock; lock order is shard.mu -> disk_mu
-  bool spill_row(Shard& s, Row& r) {
-    if (spill_fd < 0 || r.on_disk()) return false;
-    uint32_t slot;
-    {
-      std::lock_guard<std::mutex> dlock(disk_mu);
-      if (!disk_free.empty()) {
-        slot = disk_free.back();
-        disk_free.pop_back();
-      } else {
-        slot = disk_next++;
-      }
-    }
-    const float* p = row_ptr(s, r);
-    ssize_t want = static_cast<ssize_t>(row_bytes());
-    if (pwrite(spill_fd, p, want,
-               static_cast<off_t>(slot) * want) != want) {
-      std::lock_guard<std::mutex> dlock(disk_mu);
-      disk_free.push_back(slot);  // write failed: keep the row in memory
-      return false;
-    }
-    s.free_slots.emplace_back(r.chunk, r.offset);
-    r.chunk = kDiskChunk;
-    r.offset = slot;
-    disk_rows.fetch_add(1, std::memory_order_relaxed);
-    return true;
-  }
-
   // caller holds the shard lock; reads a spilled row without faulting it in
   bool read_spilled(const Row& r, float* out) {
     ssize_t want = static_cast<ssize_t>(row_bytes());
@@ -244,16 +216,93 @@ int64_t kv_io_errors(void* handle) {
 // (<=0: unlimited). Returns the number spilled. Eviction frees the rows'
 // arena slots, bounding host memory; spilled rows fault back in on
 // lookup/update and are still seen by export/delta export.
+//
+// Disk writes happen OUTSIDE the shard lock (a lock held across a long
+// pwrite sweep would stall every lookup/update hashing to the shard):
+// candidates are staged in batches under the lock, written unlocked,
+// then re-verified under the lock (bytes unchanged, same arena slot)
+// before flipping to disk — a row updated during the window is skipped.
 int64_t kv_evict(void* handle, uint32_t max_freq, int64_t max_rows) {
   auto* t = static_cast<KvTable*>(handle);
   if (t->spill_fd < 0) return 0;
+  constexpr size_t kBatch = 512;
+  const size_t rb = t->row_bytes();
+  const ssize_t want = static_cast<ssize_t>(rb);
   int64_t evicted = 0;
+  std::vector<int64_t> keys;
+  std::vector<Row> staged;
+  std::vector<float> buf;
+  std::vector<uint32_t> slots;
+  std::vector<uint8_t> ok;
   for (auto& s : t->shards) {
-    std::lock_guard<std::mutex> lock(s.mu);
-    for (auto& [key, row] : s.index) {
-      if (max_rows > 0 && evicted >= max_rows) return evicted;
-      if (row.on_disk() || row.freq > max_freq) continue;
-      if (t->spill_row(s, row)) ++evicted;
+    bool more = true;
+    while (more) {
+      keys.clear();
+      staged.clear();
+      buf.clear();
+      {
+        std::lock_guard<std::mutex> lock(s.mu);
+        for (auto& [key, row] : s.index) {
+          if (max_rows > 0 &&
+              evicted + static_cast<int64_t>(keys.size()) >= max_rows) {
+            break;
+          }
+          if (row.on_disk() || row.freq > max_freq) continue;
+          keys.push_back(key);
+          staged.push_back(row);
+          const float* p = t->row_ptr(s, row);
+          buf.insert(buf.end(), p, p + t->row_width);
+          if (keys.size() == kBatch) break;
+        }
+        more = keys.size() == kBatch;
+      }
+      if (keys.empty()) break;
+      // allocate disk slots + write, unlocked
+      slots.assign(keys.size(), 0);
+      ok.assign(keys.size(), 0);
+      for (size_t i = 0; i < keys.size(); ++i) {
+        uint32_t slot;
+        {
+          std::lock_guard<std::mutex> dlock(t->disk_mu);
+          if (!t->disk_free.empty()) {
+            slot = t->disk_free.back();
+            t->disk_free.pop_back();
+          } else {
+            slot = t->disk_next++;
+          }
+        }
+        slots[i] = slot;
+        ok[i] = pwrite(t->spill_fd, buf.data() + i * t->row_width, want,
+                       static_cast<off_t>(slot) * want) == want;
+        if (!ok[i]) {
+          std::lock_guard<std::mutex> dlock(t->disk_mu);
+          t->disk_free.push_back(slot);
+        }
+      }
+      // re-verify + flip under the lock
+      {
+        std::lock_guard<std::mutex> lock(s.mu);
+        for (size_t i = 0; i < keys.size(); ++i) {
+          if (!ok[i]) continue;
+          auto it = s.index.find(keys[i]);
+          bool valid = it != s.index.end() && !it->second.on_disk() &&
+                       it->second.chunk == staged[i].chunk &&
+                       it->second.offset == staged[i].offset &&
+                       std::memcmp(t->row_ptr(s, it->second),
+                                   buf.data() + i * t->row_width, rb) == 0;
+          if (!valid) {
+            std::lock_guard<std::mutex> dlock(t->disk_mu);
+            t->disk_free.push_back(slots[i]);
+            continue;
+          }
+          s.free_slots.emplace_back(it->second.chunk, it->second.offset);
+          it->second.chunk = kDiskChunk;
+          it->second.offset = slots[i];
+          t->disk_rows.fetch_add(1, std::memory_order_relaxed);
+          ++evicted;
+        }
+      }
+      if (max_rows > 0 && evicted >= max_rows) break;
     }
   }
   return evicted;
@@ -356,21 +405,30 @@ void kv_apply_adam(void* handle, const int64_t* keys, const float* grads,
 // (concurrent lookups hold only shard locks).
 int64_t kv_export(void* handle, uint32_t min_freq, int64_t* keys_out,
                   float* values_out, float* slots_out, uint32_t* freq_out,
-                  int64_t capacity) {
+                  int64_t capacity, int64_t* err_out) {
   auto* t = static_cast<KvTable*>(handle);
   const int dim = t->dim;
   const int slot_width = dim * t->num_slots;
   std::vector<float> scratch(t->row_width);
-  int64_t count = 0;
+  int64_t count = 0, errs = 0;
   for (auto& s : t->shards) {
     std::lock_guard<std::mutex> lock(s.mu);
     for (auto& [key, row] : s.index) {
       if (row.freq < min_freq) continue;
       if (keys_out != nullptr) {
-        if (count >= capacity) return count;
+        if (count >= capacity) {
+          if (err_out != nullptr) *err_out = errs;
+          return count;
+        }
         const float* p;
         if (row.on_disk()) {  // snapshot spilled rows without faulting in
-          if (!t->read_spilled(row, scratch.data())) continue;
+          if (!t->read_spilled(row, scratch.data())) {
+            // this call's snapshot is missing a row — report it scoped
+            // to the call (the global io_errors counter also counts
+            // unrelated lookup-path failures)
+            ++errs;
+            continue;
+          }
           p = scratch.data();
         } else {
           p = t->row_ptr(s, row);
@@ -386,6 +444,7 @@ int64_t kv_export(void* handle, uint32_t min_freq, int64_t* keys_out,
       ++count;
     }
   }
+  if (err_out != nullptr) *err_out = errs;
   return count;
 }
 

@@ -108,6 +108,43 @@ class TestPipelineStrategy:
             losses.append(float(metrics["loss"]))
         assert losses[-1] < losses[0]
 
+    def test_mixed_3d_trains_and_matches_dp(self):
+        """pipeline × tensor × data on all 8 devices: stage weights shard
+        on both the pipeline and tensor axes, loss matches pure dp."""
+        strat = S.mixed(pipeline_size=2, tensor_size=2, data_size=2)
+        mesh = strat.build_mesh()
+        specs = strat.specs(T.logical_axes(CFG), mesh)
+        assert specs["layers"]["wq"] == P("pipeline", None, "tensor")
+        ct = compile_train(
+            strategy=strat,
+            mesh=mesh,
+            loss_fn=T.make_loss_fn(CFG, strat, mesh),
+            init_params_fn=lambda rng: T.init_params(CFG, rng),
+            logical_params=T.logical_axes(CFG),
+            optimizer=optax.sgd(1e-2),
+        )
+        state = ct.init(jax.random.PRNGKey(0))
+        batch = jax.tree.map(
+            lambda x: x[None], _batch(jax.random.PRNGKey(42))
+        )
+        _, metrics = ct.step(state, batch)
+
+        strat_dp = S.dp()
+        mesh_dp = strat_dp.build_mesh()
+        ct_dp = compile_train(
+            strategy=strat_dp,
+            mesh=mesh_dp,
+            loss_fn=T.make_loss_fn(CFG, strat_dp, mesh_dp),
+            init_params_fn=lambda rng: T.init_params(CFG, rng),
+            logical_params=T.logical_axes(CFG),
+            optimizer=optax.sgd(1e-2),
+        )
+        state_dp = ct_dp.init(jax.random.PRNGKey(0))
+        _, metrics_dp = ct_dp.step(state_dp, batch)
+        assert float(metrics["loss"]) == pytest.approx(
+            float(metrics_dp["loss"]), rel=2e-5
+        )
+
     def test_matches_dp_loss(self):
         """Same params + batch: pipeline×data loss == dp loss."""
         strat_pp = S.pipeline(pipeline_size=2, data_size=4)
