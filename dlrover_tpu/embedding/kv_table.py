@@ -289,8 +289,10 @@ class KvEmbeddingTable:
 
     def _delta_drain_once(self, with_slots: bool, clear: bool
                           ) -> tuple[dict[str, np.ndarray], bool]:
-        """One native drain pass; returns (chunk, complete)."""
-        counts = np.zeros(2, np.int64)
+        """One native drain pass; returns (chunk, complete). The chunk's
+        ``read_errors`` counts spilled rows whose disk read failed — they
+        keep their dirty marks and surface in the next drain."""
+        counts = np.zeros(3, np.int64)
         self._lib.kv_delta_export(
             self._handle, None, None, None, None, 0, None, 0, counts, 0
         )
@@ -319,6 +321,7 @@ class KvEmbeddingTable:
             "keys": keys[:r], "values": values[:r], "freq": freq[:r],
             "removed": removed[:d],
             "step": np.asarray(self._step, np.int64),
+            "read_errors": np.asarray(int(counts[2]), np.int64),
         }
         if with_slots and self.num_slots:
             chunk["slots"] = slots[:r]
@@ -345,10 +348,11 @@ class KvEmbeddingTable:
                 chunk, complete = self._delta_drain_once(with_slots, True)
                 out = merge_deltas(out, chunk)
                 tries += 1
-            # early stops and spill-read failures are both SAFE here: an
-            # undrained shard keeps its marks/logs, and a row whose disk
-            # read failed keeps its dirty mark — either way the change
-            # surfaces in the next delta instead of being lost
+            # early stops and spill-read failures are both LOSSLESS here:
+            # an undrained shard keeps its marks/logs, and a failed-read
+            # row keeps its dirty mark — the change surfaces in the next
+            # delta. ``read_errors`` in the result tells checkpointing
+            # callers this delta is not yet a complete cut.
         else:
             # clear=False passes drain nothing, so chunks can't be
             # merged (they'd duplicate); retry whole passes with freshly
@@ -361,6 +365,13 @@ class KvEmbeddingTable:
                 raise RuntimeError(
                     "delta_export(clear=False) could not complete: the "
                     "table is mutating faster than the drain"
+                )
+            if int(out["read_errors"]):
+                # nothing was drained/cleared, so raising loses nothing —
+                # and a peek consumer must not mistake this for complete
+                raise OSError(
+                    f"{int(out['read_errors'])} spill-tier read failures "
+                    "during delta export"
                 )
         return out
 
@@ -400,9 +411,10 @@ class KvEmbeddingTable:
         if removed is not None and np.size(removed):
             self.remove(np.asarray(removed))
         if np.size(delta["keys"]):
-            self.import_(
-                {k: v for k, v in delta.items() if k != "removed"}
-            )
+            self.import_({
+                k: v for k, v in delta.items()
+                if k not in ("removed", "read_errors")
+            })
 
 
 def merge_deltas(older: dict | None, newer: dict) -> dict:
@@ -427,6 +439,10 @@ def merge_deltas(older: dict | None, newer: dict) -> dict:
             [older["slots"][keep], newer["slots"]]
         )
     out["removed"] = np.concatenate([older["removed"], newer["removed"]])
+    out["read_errors"] = np.asarray(
+        int(older.get("read_errors", 0)) + int(newer.get("read_errors", 0)),
+        np.int64,
+    )
     return out
 
 
@@ -503,6 +519,18 @@ class IncrementalCheckpointManager:
         else:
             path = os.path.join(self.directory, f"delta-{v}.npz")
             snap = merge_deltas(self._pending, self.table.delta_export())
+            if int(snap.get("read_errors", 0)):
+                # some spilled rows could not be read: a delta written now
+                # would be a valid-but-stale cut (those rows revert on a
+                # restore taken before the next delta). Park everything
+                # drained and surface the failure; the next save retries.
+                self._pending = snap
+                raise OSError(
+                    f"{int(snap['read_errors'])} spill-tier read "
+                    "failures while draining the delta; checkpoint "
+                    "postponed (no data lost)"
+                )
+            snap = {k: v_ for k, v_ in snap.items() if k != "read_errors"}
             try:
                 self._write(path, snap)
             except BaseException:

@@ -1,4 +1,4 @@
-from dlrover_tpu.models import mlp, transformer  # noqa: F401
+from dlrover_tpu.models import encoder, mlp, transformer  # noqa: F401
 from dlrover_tpu.models.transformer import (  # noqa: F401
     CONFIGS,
     TransformerConfig,

@@ -1,0 +1,88 @@
+"""BERT-class bidirectional encoder on the shared transformer block.
+
+Reference analog: ATorch's model-zoo encoder ports (Bert/CLIP attention,
+MLP and block parallel implementations in atorch/atorch/modules/
+distributed_modules/transformer.py:45 and the HF module mapping in
+modules_registry.py). There each architecture needs its own TP port; here
+the encoder IS the decoder block with ``causal=False`` — every weight
+already carries logical axis names, so all strategy presets (dp/fsdp/tp/
+mixed/...) apply unchanged.
+
+Training objective: masked-language modeling. The data side picks the
+masked positions (replacing inputs with ``mask_token_id``); the loss
+scores only those positions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.models.transformer import (
+    CONFIGS,
+    TransformerConfig,
+    forward_with_aux,
+)
+
+
+def encoder_config(base: str | TransformerConfig = "tiny",
+                   **overrides) -> TransformerConfig:
+    """An encoder is a decoder config with bidirectional attention."""
+    cfg = CONFIGS[base] if isinstance(base, str) else base
+    return dataclasses.replace(cfg, causal=False, **overrides)
+
+
+def encode(params, tokens: jax.Array, cfg: TransformerConfig,
+           constrain=None) -> jax.Array:
+    """Token ids [B, S] -> contextual embeddings [B, S, d_model]."""
+    hidden, _ = forward_with_aux(
+        params, tokens, cfg, constrain=constrain, return_hidden=True
+    )
+    return hidden
+
+
+def mask_tokens(
+    tokens: jax.Array, key: jax.Array, mask_token_id: int,
+    mask_rate: float = 0.15,
+) -> tuple[jax.Array, jax.Array]:
+    """BERT-style corruption: (masked_tokens, mlm_mask [B, S] bool)."""
+    mlm_mask = jax.random.uniform(key, tokens.shape) < mask_rate
+    masked = jnp.where(mlm_mask, mask_token_id, tokens)
+    return masked, mlm_mask
+
+
+def mlm_loss_fn(
+    params, batch: dict, cfg: TransformerConfig, constrain=None,
+) -> jax.Array:
+    """Masked-LM cross entropy.
+
+    batch: ``tokens`` [B, S] (already corrupted), ``targets`` [B, S]
+    (originals), ``mlm_mask`` [B, S] (True at scored positions).
+    """
+    if cfg.causal:
+        raise ValueError("mlm_loss_fn needs an encoder config "
+                         "(causal=False); see encoder_config()")
+    logits, aux = forward_with_aux(
+        params, batch["tokens"], cfg, constrain=constrain
+    )
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(
+        logp, batch["targets"][..., None], axis=-1
+    )[..., 0]
+    m = batch["mlm_mask"].astype(nll.dtype)
+    loss = (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
+    if cfg.moe_experts:
+        loss = loss + cfg.moe_aux_weight * aux
+    return loss
+
+
+def make_mlm_loss_fn(cfg: TransformerConfig, strategy, mesh) -> Callable:
+    """Strategy-bound MLM loss (activation constraints from the rules)."""
+    from dlrover_tpu.parallel.partition import constrain as _constrain
+
+    pin = partial(_constrain, rules=strategy.rule_table(), mesh=mesh)
+    return partial(mlm_loss_fn, cfg=cfg, constrain=pin)

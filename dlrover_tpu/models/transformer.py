@@ -67,6 +67,9 @@ class TransformerConfig:
     # strategy preset rather than by hand.
     pipeline_stages: int = 0
     pipeline_microbatches: int = 0
+    # False -> bidirectional attention (BERT-class encoders); the rest of
+    # the block (norms, FFN, sharding rules) is shared with decoders
+    causal: bool = True
     # blockwise cross-entropy: compute the vocab logits in this many
     # token chunks under remat instead of materializing the full
     # [B, S, vocab] f32 logits (+ gradient) in HBM — the reference's
@@ -372,7 +375,7 @@ def forward_with_aux(
         if n_rep > 1:
             k = jnp.repeat(k, n_rep, axis=2)
             v = jnp.repeat(v, n_rep, axis=2)
-        o = attn(q, k, v, causal=True)
+        o = attn(q, k, v, causal=c.causal)
         o = jnp.einsum("bshd,hde->bse", o, w["wo"].astype(dt))
         o = checkpoint_name(o, "attn_out")  # inert without a names policy
         x = pin(x + o, ("batch", "sequence", "embed"))

@@ -132,10 +132,7 @@ def make_ring_attention(
     attention is independent per head, and replicating them here would
     all-gather q/k/v and duplicate the ring FLOPs across the tensor axis.
     """
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax import shard_map
+    from dlrover_tpu.ops.collectives import shard_map_nocheck
 
     if axis_name not in mesh.axis_names or mesh.shape[axis_name] <= 1:
         # no sequence axis on this mesh: degrade to dense attention (the
@@ -156,19 +153,11 @@ def make_ring_attention(
 
     # replication/varying-axis checking is disabled: the lax.cond causal
     # skip's branches intentionally differ in which inputs they touch
-    try:
-        _probe = shard_map(lambda: None, mesh=mesh, in_specs=(),
-                           out_specs=PartitionSpec(), check_vma=False)
-        check_kwargs = {"check_vma": False}
-    except TypeError:
-        check_kwargs = {"check_rep": False}
-
     def attn(q, k, v, *, causal: bool = True):
         body = partial(ring_attention, axis_name=axis_name, causal=causal)
-        return shard_map(
+        return shard_map_nocheck(
             body, mesh=mesh,
             in_specs=(spec, spec, spec), out_specs=spec,
-            **check_kwargs,
         )(q, k, v)
 
     return attn

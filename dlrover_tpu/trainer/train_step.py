@@ -194,9 +194,10 @@ def compile_train(
         # local grads -> quantized all-gather -> local dequant mean.
         # Scope matches the reference's DDP compression: params must be
         # replicated (the data axes are the only reduction).
-        from jax import shard_map
-
-        from dlrover_tpu.ops.collectives import quantized_tree_mean
+        from dlrover_tpu.ops.collectives import (
+            quantized_tree_mean,
+            shard_map_nocheck,
+        )
 
         sharded = [
             s for s in jax.tree_util.tree_leaves(
@@ -218,12 +219,11 @@ def compile_train(
             grads = quantized_tree_mean(grads, axes, axis_sizes)
             return jax.lax.pmean(loss, axes), grads
 
-        compute = shard_map(
+        compute = shard_map_nocheck(
             _local,
             mesh=mesh,
             in_specs=(PartitionSpec(), batch_spec),
             out_specs=(PartitionSpec(), PartitionSpec()),
-            check_vma=False,
         )
 
     def _step(state: TrainState, batch: Any) -> tuple[TrainState, dict]:

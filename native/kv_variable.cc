@@ -280,6 +280,7 @@ int64_t kv_evict(void* handle, uint32_t max_freq, int64_t max_rows) {
         }
       }
       // re-verify + flip under the lock
+      int64_t batch_evicted = 0;
       {
         std::lock_guard<std::mutex> lock(s.mu);
         for (size_t i = 0; i < keys.size(); ++i) {
@@ -300,8 +301,13 @@ int64_t kv_evict(void* handle, uint32_t max_freq, int64_t max_rows) {
           it->second.offset = slots[i];
           t->disk_rows.fetch_add(1, std::memory_order_relaxed);
           ++evicted;
+          ++batch_evicted;
         }
       }
+      // a full batch that evicted nothing (disk full, or every staged
+      // row was concurrently updated) would re-stage the same rows
+      // forever — stop; a later evict() call retries
+      if (batch_evicted == 0) break;
       if (max_rows > 0 && evicted >= max_rows) break;
     }
   }
@@ -495,7 +501,9 @@ void kv_import(void* handle, const int64_t* keys, const float* values,
 // across drains); stops early otherwise. ``clear`` resets marks/logs of
 // the emitted shards. counts_out gets the written counts; returns 1 when
 // every shard was processed, 0 on an early stop (call again to drain the
-// rest — leftover changes simply surface in the next drain).
+// rest — leftover changes simply surface in the next drain). counts_out
+// is [rows_written, removals_written, spill_read_errors]; error rows
+// keep their dirty marks.
 int64_t kv_delta_export(void* handle, int64_t* keys_out, float* values_out,
                         float* slots_out, uint32_t* freq_out,
                         int64_t capacity, int64_t* removed_out,
@@ -505,7 +513,7 @@ int64_t kv_delta_export(void* handle, int64_t* keys_out, float* values_out,
   const int dim = t->dim;
   const int slot_width = dim * t->num_slots;
   std::vector<float> scratch(t->row_width);
-  int64_t rows = 0, removed = 0;
+  int64_t rows = 0, removed = 0, errs = 0;
   int64_t complete = 1;
   for (auto& s : t->shards) {
     std::lock_guard<std::mutex> lock(s.mu);
@@ -526,7 +534,14 @@ int64_t kv_delta_export(void* handle, int64_t* keys_out, float* values_out,
       if (!row.dirty) continue;
       const float* p;
       if (row.on_disk()) {
-        if (!t->read_spilled(row, scratch.data())) continue;
+        if (!t->read_spilled(row, scratch.data())) {
+          // the row stays dirty (clear is skipped) so the change
+          // surfaces in the next drain; report it so callers that need
+          // a COMPLETE snapshot now (peek consumers, the checkpoint
+          // manager's durability accounting) can react
+          ++errs;
+          continue;
+        }
         p = scratch.data();
       } else {
         p = t->row_ptr(s, row);
@@ -546,6 +561,7 @@ int64_t kv_delta_export(void* handle, int64_t* keys_out, float* values_out,
   }
   counts_out[0] = rows;
   counts_out[1] = removed;
+  counts_out[2] = errs;
   return complete;
 }
 

@@ -1,0 +1,101 @@
+"""BERT-class encoder (models/encoder.py) on the 8-device CPU mesh."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.models import transformer as T
+from dlrover_tpu.models.encoder import (
+    encode,
+    encoder_config,
+    make_mlm_loss_fn,
+    mask_tokens,
+    mlm_loss_fn,
+)
+from dlrover_tpu.parallel import strategy as S
+from dlrover_tpu.trainer import compile_train
+
+CFG = encoder_config("tiny", dtype="float32")
+
+
+class TestBidirectional:
+    def test_early_positions_see_late_tokens(self):
+        """Flipping the LAST token changes position-0 embeddings in the
+        encoder but not in the causal decoder — the defining property."""
+        params = T.init_params(CFG, jax.random.PRNGKey(0))
+        tok = jax.random.randint(
+            jax.random.PRNGKey(1), (1, 16), 0, CFG.vocab_size
+        )
+        tok2 = tok.at[0, -1].set((tok[0, -1] + 1) % CFG.vocab_size)
+
+        h1 = encode(params, tok, CFG)
+        h2 = encode(params, tok2, CFG)
+        assert not np.allclose(np.asarray(h1[0, 0]), np.asarray(h2[0, 0]))
+
+        causal = dataclasses.replace(CFG, causal=True)
+        c1 = encode(params, tok, causal)
+        c2 = encode(params, tok2, causal)
+        np.testing.assert_allclose(
+            np.asarray(c1[0, 0]), np.asarray(c2[0, 0]), rtol=1e-6
+        )
+
+    def test_mlm_rejects_causal_config(self):
+        causal = dataclasses.replace(CFG, causal=True)
+        params = T.init_params(causal, jax.random.PRNGKey(0))
+        batch = {
+            "tokens": jnp.zeros((2, 8), jnp.int32),
+            "targets": jnp.zeros((2, 8), jnp.int32),
+            "mlm_mask": jnp.ones((2, 8), bool),
+        }
+        with pytest.raises(ValueError, match="encoder config"):
+            mlm_loss_fn(params, batch, causal)
+
+
+class TestMaskTokens:
+    def test_rate_and_targets(self):
+        tok = jax.random.randint(
+            jax.random.PRNGKey(0), (64, 64), 0, 100
+        )
+        masked, mask = mask_tokens(
+            tok, jax.random.PRNGKey(1), mask_token_id=101, mask_rate=0.15
+        )
+        rate = float(mask.mean())
+        assert 0.10 < rate < 0.20
+        assert (np.asarray(masked)[np.asarray(mask)] == 101).all()
+        # unmasked positions pass through
+        inv = ~np.asarray(mask)
+        assert (np.asarray(masked)[inv] == np.asarray(tok)[inv]).all()
+
+
+class TestMlmTraining:
+    def test_loss_decreases_under_fsdp(self):
+        strat = S.fsdp()
+        mesh = strat.build_mesh()
+        ct = compile_train(
+            strategy=strat,
+            mesh=mesh,
+            loss_fn=make_mlm_loss_fn(CFG, strat, mesh),
+            init_params_fn=lambda rng: T.init_params(CFG, rng),
+            logical_params=T.logical_axes(CFG),
+            optimizer=optax.adamw(1e-2),
+        )
+        state = ct.init(jax.random.PRNGKey(0))
+        tok = jax.random.randint(
+            jax.random.PRNGKey(1), (8, 32), 0, CFG.vocab_size - 1
+        )
+        masked, mask = mask_tokens(
+            tok, jax.random.PRNGKey(2), mask_token_id=CFG.vocab_size - 1
+        )
+        batch = jax.tree.map(
+            lambda x: x[None],
+            {"tokens": masked, "targets": tok, "mlm_mask": mask},
+        )
+        losses = []
+        for _ in range(8):
+            state, metrics = ct.step(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0]
