@@ -36,6 +36,9 @@ def parse_args(argv=None):
                         "(dense|flash|ring)")
     p.add_argument("--strategy", default="dp",
                    help="strategy preset name (parallel/strategy.py)")
+    p.add_argument("--objective", default="clm", choices=["clm", "mlm"],
+                   help="clm: causal next-token; mlm: BERT-class "
+                        "bidirectional masked-LM (models/encoder.py)")
     p.add_argument("--max-steps", type=int, default=50)
     p.add_argument("--global-batch", type=int, default=8)
     p.add_argument("--micro-batch", type=int, default=0,
@@ -92,16 +95,36 @@ def main(argv=None) -> int:
         cfg = dataclasses.replace(cfg, attention=args.attention)
     seq = args.seq or cfg.max_seq_len
 
+    if args.objective == "mlm":
+        from dlrover_tpu.models.encoder import (
+            encoder_config,
+            make_mlm_loss_fn,
+        )
+
+        cfg = encoder_config(cfg)
+
+        def loss_for(s, m):
+            return make_mlm_loss_fn(cfg, s, m)
+    else:
+        def loss_for(s, m):
+            return tfm.make_loss_fn(cfg, s, m)
+
     if args.strategy == "auto":
         from dlrover_tpu.parallel.auto import auto_strategy
 
-        example_batch = {
-            "tokens": np.zeros(
-                (1, max(1, args.global_batch), seq + 1), np.int32
-            )
-        }
+        bsz = max(1, args.global_batch)
+        if args.objective == "mlm":
+            example_batch = {
+                "tokens": np.zeros((1, bsz, seq), np.int32),
+                "targets": np.zeros((1, bsz, seq), np.int32),
+                "mlm_mask": np.ones((1, bsz, seq), bool),
+            }
+        else:
+            example_batch = {
+                "tokens": np.zeros((1, bsz, seq + 1), np.int32)
+            }
         strategy, _ = auto_strategy(
-            loss_fn_for=lambda s, m: tfm.make_loss_fn(cfg, s, m),
+            loss_fn_for=loss_for,
             init_params_fn=lambda rng: tfm.init_params(cfg, rng),
             logical_params=tfm.logical_axes(cfg),
             optimizer=optax.adamw(args.lr),
@@ -114,7 +137,7 @@ def main(argv=None) -> int:
     compiled = compile_train(
         strategy=strategy,
         mesh=mesh,
-        loss_fn=tfm.make_loss_fn(cfg, strategy, mesh),
+        loss_fn=loss_for(strategy, mesh),
         init_params_fn=lambda rng: tfm.init_params(cfg, rng),
         logical_params=tfm.logical_axes(cfg),
         optimizer=optax.adamw(args.lr),
@@ -181,10 +204,37 @@ def main(argv=None) -> int:
         args.dataset_size, name="synthetic", shard_size=args.shard_size,
         num_epochs=args.epochs, shuffle=True, under_agent=ctx.under_agent,
     )
+    if args.objective == "mlm":
+        mask_id = vocab - 1
+
+        def sample_fn(idx: int):
+            # mask keyed per sample index (an independent Philox stream
+            # from tokens_for): a resumed run reproduces the exact same
+            # corruption, like the token stream itself
+            t = tokens_for(idx)[:seq]
+            g = np.random.Generator(
+                np.random.Philox(key=(rng_seed << 32) ^ idx)
+            )
+            return t, g.random(t.shape) < 0.15
+
+        def collate(samples):
+            t = np.stack([s[0] for s in samples])
+            m = np.stack([s[1] for s in samples])
+            return {
+                "tokens": np.where(m, mask_id, t).astype(np.int32),
+                "targets": t,
+                "mlm_mask": m,
+            }
+    else:
+        sample_fn = tokens_for
+
+        def collate(samples):
+            return {"tokens": np.stack(samples)}
+
     loader = PrefetchLoader(
         dataset,
-        sample_fn=tokens_for,
-        collate=lambda samples: {"tokens": np.stack(samples)},
+        sample_fn=sample_fn,
+        collate=collate,
         accum=trainer.accum,
         batch_size=trainer.local_step_batch,
         config_reader=paral,

@@ -58,6 +58,40 @@ class TestMesh:
 
 
 class TestPartition:
+    def test_hybrid_dcn_mesh(self):
+        """dcn_axes build a hybrid (multi-slice) mesh; on CPU test
+        devices the slice topology is emulated by layout."""
+        mesh = build_mesh(
+            MeshSpec(axes={"data": 4, "tensor": 2}, dcn_axes={"data": 2})
+        )
+        assert mesh.shape == {"data": 4, "tensor": 2}
+
+    def test_hybrid_dcn_strategy_trains(self):
+        """A strategy whose data axis spans DCN compiles and steps."""
+        strat = S.Strategy(
+            name="dcn_dp",
+            mesh_axes={"data": 4, "tensor": 2},
+            dcn_axes={"data": 2},
+            rules=[["batch", ["data", "fsdp"]],
+                   ["heads", "tensor"], ["mlp", "tensor"],
+                   ["kv_heads", "tensor"], ["vocab", "tensor"]],
+        )
+        assert S.Strategy.from_json(strat.to_json()).dcn_axes == {"data": 2}
+        mesh = strat.build_mesh()
+        ct = _compile(strat, mesh)
+        state = ct.init(jax.random.PRNGKey(0))
+        tok = jax.random.randint(
+            jax.random.PRNGKey(1), (1, 4, 33), 0, CFG.vocab_size
+        )
+        _, metrics = ct.step(state, {"tokens": tok})
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_dcn_errors(self):
+        with pytest.raises(ValueError, match="not among resolved"):
+            build_mesh(MeshSpec(axes={"data": 8}, dcn_axes={"tensor": 2}))
+        with pytest.raises(ValueError, match="does not divide"):
+            build_mesh(MeshSpec(axes={"data": 8}, dcn_axes={"data": 3}))
+
     def test_missing_axis_replicates(self):
         mesh = build_mesh({"data": 8})
         spec = spec_for(("embed", "heads"), [("heads", "tensor")], mesh)
