@@ -153,6 +153,27 @@ def test_fsdp_sharded_ckpt_crash_recovers(tmp_path):
     assert result["restart_count"] == 1
 
 
+@pytest.mark.timeout(480)
+def test_pipeline_strategy_crash_recovers(tmp_path):
+    """GPipe pipeline strategy: crash mid-run -> restore + completion
+    (recovery must hold for pipeline-sharded state, not just dp/fsdp).
+    Generous budget: the pipeline program compiles once per incarnation."""
+    cmd, result_file = _cli_cmd(
+        tmp_path, ["--max-restarts", "2"],
+        ["--max-steps", "12", "--crash-at-step", "5",
+         "--strategy", "pipeline"],
+    )
+    proc = subprocess.run(
+        cmd, env=_env(tmp_path), cwd=REPO, timeout=460,
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    result = json.load(open(result_file))
+    assert result["final_step"] == 12
+    assert result["resumed_from"] >= 3
+    assert result["restart_count"] == 1
+
+
 @pytest.mark.timeout(300)
 def test_network_check_then_train(tmp_path):
     """--network-check runs the probe rendezvous + payload before training."""
