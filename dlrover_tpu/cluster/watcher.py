@@ -2,11 +2,19 @@
 
 Reference analog: dlrover/python/master/watcher/k8s_watcher.py
 (PodWatcher:155 — a k8s watch stream translated into NodeEvents the job
-manager's state machine consumes). Without assuming a streaming watch API
-on every client, this watcher polls ``list_pods`` and diffs: a pod that
-vanishes out-of-band (preemption, eviction) raises a deleted event the
-master uses to fail the node immediately instead of waiting out the
-heartbeat dead-window.
+manager's state machine consumes). Two modes:
+
+- **streaming** (the reference's shape): when the client exposes
+  ``watch_pods(namespace, selector)`` — a blocking iterator of
+  ``{"type": ADDED|DELETED|..., "object": pod}`` events like the k8s
+  watch API — events are delivered immediately; a broken stream
+  re-lists (poll diff) to resync, then re-subscribes, matching k8s
+  watch-expiry semantics.
+- **polling diff** fallback for clients without a watch API.
+
+Either way, a pod that vanishes out-of-band (preemption, eviction)
+raises a deleted event the master uses to fail the node immediately
+instead of waiting out the heartbeat dead-window.
 """
 
 from __future__ import annotations
@@ -54,46 +62,133 @@ class PodWatcher:
         self._on_event = on_event
         self._interval_s = interval_s
         self._known: dict[int, str] = {}
+        self._mu = threading.Lock()  # _known: stream + resync threads
         self._stopped = threading.Event()
-        self._thread: threading.Thread | None = None
+        self._threads: list[threading.Thread] = []
+        self._warned_labels: set[str] = set()
 
-    def poll_once(self) -> list[PodEvent]:
-        pods = self._client.list_pods(self._namespace, self._selector)
-        current: dict[int, str] = {}
-        for p in pods:
-            labels = p.get("metadata", {}).get("labels", {})
-            if "node-id" in labels:
-                current[int(labels["node-id"])] = p["metadata"]["name"]
-        events: list[PodEvent] = []
-        for nid, name in current.items():
-            if nid not in self._known:
-                events.append(PodEvent(PodEvent.ADDED, nid, name))
-        for nid, name in self._known.items():
-            if nid not in current:
-                events.append(PodEvent(PodEvent.DELETED, nid, name))
-        self._known = current
+    def _emit(self, events: list[PodEvent]) -> None:
         for e in events:
             try:
                 self._on_event(e)
             except Exception:  # noqa: BLE001 - one handler error must not
                 logger.exception("pod event handler failed")  # stop the diff
+
+    def _node_of(self, pod: dict) -> tuple[int, str] | None:
+        labels = pod.get("metadata", {}).get("labels", {})
+        raw = labels.get("node-id")
+        if raw is None:
+            return None
+        try:
+            return int(raw), pod["metadata"]["name"]
+        except (ValueError, TypeError):
+            # one mislabeled pod must not tear down the whole watch
+            if raw not in self._warned_labels:
+                self._warned_labels.add(raw)
+                logger.warning("ignoring pod with bad node-id label %r",
+                               raw)
+            return None
+
+    def poll_once(self) -> list[PodEvent]:
+        pods = self._client.list_pods(self._namespace, self._selector)
+        current: dict[int, str] = {}
+        for p in pods:
+            ids = self._node_of(p)
+            if ids is not None:
+                current[ids[0]] = ids[1]
+        with self._mu:
+            events: list[PodEvent] = []
+            for nid, name in current.items():
+                if nid not in self._known:
+                    events.append(PodEvent(PodEvent.ADDED, nid, name))
+            for nid, name in self._known.items():
+                if nid not in current:
+                    events.append(PodEvent(PodEvent.DELETED, nid, name))
+            self._known = current
+        self._emit(events)
         return events
 
-    def start(self) -> None:
-        def loop():
-            while not self._stopped.wait(self._interval_s):
-                try:
-                    self.poll_once()
-                except Exception:  # noqa: BLE001
-                    logger.exception("pod watch poll failed")
+    def _handle_stream_event(self, raw: dict) -> None:
+        ids = self._node_of(raw.get("object", {}))
+        if ids is None:
+            return
+        nid, name = ids
+        kind = str(raw.get("type", "")).upper()
+        events: list[PodEvent] = []
+        with self._mu:
+            if kind == "ADDED":
+                if nid not in self._known:
+                    events.append(PodEvent(PodEvent.ADDED, nid, name))
+                # known node, new pod name: a relaunch replaced the pod —
+                # track the replacement so the OLD pod's DELETED (which
+                # may arrive after) doesn't falsely fail the live node
+                self._known[nid] = name
+            elif kind == "DELETED" and self._known.get(nid) == name:
+                del self._known[nid]
+                events.append(PodEvent(PodEvent.DELETED, nid, name))
+        self._emit(events)
 
-        self._thread = threading.Thread(
-            target=loop, name="pod-watcher", daemon=True
-        )
-        self._thread.start()
+    def _stream_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                # resync by diff before every (re)subscribe: events that
+                # fired while the stream was down surface here (the k8s
+                # re-list-then-re-watch pattern)
+                self.poll_once()
+                for raw in self._client.watch_pods(
+                    self._namespace, self._selector
+                ):
+                    if self._stopped.is_set():
+                        return
+                    self._handle_stream_event(raw)
+                # iterator ended: watch expired, loop to resync
+            except Exception:  # noqa: BLE001
+                logger.exception("pod watch stream failed; resyncing")
+            self._stopped.wait(min(self._interval_s, 1.0))
+
+    def _poll_loop(self, interval_s: float) -> None:
+        while not self._stopped.wait(interval_s):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001
+                logger.exception("pod watch poll failed")
+
+    def start(self) -> None:
+        if callable(getattr(self._client, "watch_pods", None)):
+            self._threads = [
+                threading.Thread(target=self._stream_loop,
+                                 name="pod-watch-stream", daemon=True),
+                # periodic re-list alongside the stream (the informer
+                # resync pattern): events lost in the list→watch gap —
+                # a watch has no resourceVersion handoff here — surface
+                # within one resync period instead of never
+                threading.Thread(
+                    target=self._poll_loop,
+                    args=(max(self._interval_s, 30.0),),
+                    name="pod-watch-resync", daemon=True,
+                ),
+            ]
+        else:
+            self._threads = [
+                threading.Thread(target=self._poll_loop,
+                                 args=(self._interval_s,),
+                                 name="pod-watcher", daemon=True),
+            ]
+        for t in self._threads:
+            t.start()
 
     def stop(self) -> None:
         self._stopped.set()
+        # a thread blocked inside the client's watch iterator can't see
+        # the event — give the client a chance to break the stream
+        close = getattr(self._client, "close_watch", None)
+        if callable(close):
+            try:
+                close()
+            except Exception:  # noqa: BLE001
+                logger.exception("close_watch failed")
+        for t in self._threads:
+            t.join(timeout=2.0)
 
 
 def wire_to_node_manager(

@@ -9,6 +9,7 @@ control loop runs hermetically.
 from __future__ import annotations
 
 import threading
+import time
 
 import pytest
 
@@ -277,6 +278,122 @@ class TestPodWatcher:
         nodes = {n.node_id: n for n in nm.all_nodes()}
         assert nodes[1].status == NodeStatus.FAILED
         assert nodes[0].status == NodeStatus.RUNNING
+
+
+class TestStreamingWatcher:
+    def _streaming_kube(self):
+        import queue
+
+        class StreamingKube(FakeKube):
+            """FakeKube + the k8s-style blocking watch iterator."""
+
+            def __init__(self):
+                super().__init__()
+                self.stream: queue.Queue = queue.Queue()
+
+            def watch_pods(self, namespace, label_selector):
+                while True:
+                    ev = self.stream.get()
+                    if ev is None:  # stream expiry
+                        return
+                    yield ev
+
+            def close_watch(self):
+                self.stream.put(None)
+
+        return StreamingKube()
+
+    def test_stream_events_delivered_without_polling(self):
+        from dlrover_tpu.cluster.watcher import PodEvent, PodWatcher
+
+        kube = self._streaming_kube()
+        events: list = []
+        got = threading.Event()
+        watcher = PodWatcher(
+            kube, "default", "train1",
+            on_event=lambda e: (events.append(e), got.set()),
+            interval_s=3600.0,  # polling would never fire in this test
+        )
+        watcher.start()
+        try:
+            pod = {"metadata": {"name": "train1-worker-0",
+                                "labels": {"node-id": "0"}}}
+            kube.stream.put({"type": "ADDED", "object": pod})
+            assert got.wait(5.0)
+            assert events[0].kind == PodEvent.ADDED
+            got.clear()
+            kube.stream.put({"type": "DELETED", "object": pod})
+            assert got.wait(5.0)
+            assert events[1].kind == PodEvent.DELETED
+            assert events[1].node_id == 0
+        finally:
+            watcher.stop()
+
+    def test_replacement_pod_same_node_id(self):
+        """ADDED(new pod) then DELETED(old pod) for one node-id — the
+        relaunch ordering — must not fail the live replacement node."""
+        from dlrover_tpu.cluster.watcher import PodEvent, PodWatcher
+
+        kube = self._streaming_kube()
+        events: list = []
+        watcher = PodWatcher(
+            kube, "default", "train1",
+            on_event=events.append, interval_s=3600.0,
+        )
+        old = {"metadata": {"name": "w0-old",
+                            "labels": {"node-id": "0"}}}
+        new = {"metadata": {"name": "w0-new",
+                            "labels": {"node-id": "0"}}}
+        bad = {"metadata": {"name": "weird",
+                            "labels": {"node-id": "nope"}}}
+        watcher._handle_stream_event({"type": "ADDED", "object": old})
+        watcher._handle_stream_event({"type": "ADDED", "object": new})
+        watcher._handle_stream_event({"type": "ADDED", "object": bad})
+        watcher._handle_stream_event({"type": "DELETED", "object": old})
+        assert [e.kind for e in events] == [PodEvent.ADDED]
+        # deleting the replacement itself IS a failure
+        watcher._handle_stream_event({"type": "DELETED", "object": new})
+        assert [e.kind for e in events] == [
+            PodEvent.ADDED, PodEvent.DELETED,
+        ]
+
+    def test_stream_break_resyncs_by_list(self):
+        """A deletion missed while the stream was down surfaces via the
+        re-list diff on re-subscribe."""
+        from dlrover_tpu.cluster.watcher import PodEvent, PodWatcher
+
+        kube = self._streaming_kube()
+        op = ElasticJobOperator(kube)
+        op.apply_job(_job(workers=2))
+        events: list = []
+        two = threading.Event()
+
+        def on_event(e):
+            events.append(e)
+            if len([x for x in events
+                    if x.kind == PodEvent.DELETED]) >= 1:
+                two.set()
+
+        watcher = PodWatcher(
+            kube, "default", "train1", on_event=on_event,
+            interval_s=0.1,
+        )
+        watcher.start()
+        try:
+            # initial resync list sees both workers
+            deadline = time.time() + 5
+            while time.time() < deadline and len(events) < 2:
+                time.sleep(0.02)
+            assert {e.kind for e in events} == {PodEvent.ADDED}
+            # pod vanishes while no stream event is sent; then the
+            # stream expires -> watcher re-lists and catches it
+            kube.delete_pod("default", "train1-worker-1")
+            kube.stream.put(None)
+            assert two.wait(5.0)
+            deleted = [e for e in events if e.kind == PodEvent.DELETED]
+            assert deleted[0].node_id == 1
+        finally:
+            watcher.stop()
 
 
 class TestWatcherScalerCoordination:
