@@ -62,7 +62,12 @@ class PodWatcher:
         self._on_event = on_event
         self._interval_s = interval_s
         self._known: dict[int, str] = {}
-        self._mu = threading.Lock()  # _known: stream + resync threads
+        self._mu = threading.Lock()  # _known/_epoch/_touched
+        # stream-event epoch: the resync diff must not override nodes the
+        # stream touched while its list RPC was in flight (a stale
+        # snapshot would emit false ADDED/DELETED for them)
+        self._epoch = 0
+        self._touched: dict[int, int] = {}  # node id -> epoch of last event
         self._stopped = threading.Event()
         self._threads: list[threading.Thread] = []
         self._warned_labels: set[str] = set()
@@ -90,6 +95,8 @@ class PodWatcher:
             return None
 
     def poll_once(self) -> list[PodEvent]:
+        with self._mu:
+            start_epoch = self._epoch
         pods = self._client.list_pods(self._namespace, self._selector)
         current: dict[int, str] = {}
         for p in pods:
@@ -97,14 +104,31 @@ class PodWatcher:
             if ids is not None:
                 current[ids[0]] = ids[1]
         with self._mu:
+            # nodes the stream touched while the list was in flight: the
+            # snapshot is stale for them — the stream's view wins
+            fresh = {
+                nid for nid, e in self._touched.items()
+                if e > start_epoch
+            }
             events: list[PodEvent] = []
             for nid, name in current.items():
-                if nid not in self._known:
+                if nid not in fresh and nid not in self._known:
                     events.append(PodEvent(PodEvent.ADDED, nid, name))
             for nid, name in self._known.items():
-                if nid not in current:
+                if nid not in fresh and nid not in current:
                     events.append(PodEvent(PodEvent.DELETED, nid, name))
-            self._known = current
+            new_known = {
+                nid: name for nid, name in current.items()
+                if nid not in fresh
+            }
+            for nid in fresh:
+                if nid in self._known:  # stream says alive
+                    new_known[nid] = self._known[nid]
+            self._known = new_known
+            self._touched = {
+                nid: e for nid, e in self._touched.items()
+                if e > start_epoch
+            }
         self._emit(events)
         return events
 
@@ -116,6 +140,7 @@ class PodWatcher:
         kind = str(raw.get("type", "")).upper()
         events: list[PodEvent] = []
         with self._mu:
+            self._epoch += 1
             if kind == "ADDED":
                 if nid not in self._known:
                     events.append(PodEvent(PodEvent.ADDED, nid, name))
@@ -123,8 +148,10 @@ class PodWatcher:
                 # track the replacement so the OLD pod's DELETED (which
                 # may arrive after) doesn't falsely fail the live node
                 self._known[nid] = name
+                self._touched[nid] = self._epoch
             elif kind == "DELETED" and self._known.get(nid) == name:
                 del self._known[nid]
+                self._touched[nid] = self._epoch
                 events.append(PodEvent(PodEvent.DELETED, nid, name))
         self._emit(events)
 

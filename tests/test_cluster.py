@@ -357,6 +357,55 @@ class TestStreamingWatcher:
             PodEvent.ADDED, PodEvent.DELETED,
         ]
 
+    def test_resync_does_not_override_in_flight_stream_events(self):
+        """A stream ADDED landing while the resync's list RPC is in
+        flight must not be reverted into a false DELETED by the stale
+        snapshot (and vice versa for a streamed DELETED)."""
+        from dlrover_tpu.cluster.watcher import PodEvent, PodWatcher
+
+        kube = self._streaming_kube()
+        events: list = []
+        watcher = PodWatcher(
+            kube, "default", "train1",
+            on_event=events.append, interval_s=3600.0,
+        )
+        new_pod = {"metadata": {"name": "w3",
+                                "labels": {"node-id": "3"}}}
+
+        real_list = kube.list_pods
+
+        def racing_list(namespace, selector):
+            pods = real_list(namespace, selector)  # stale: no w3 yet
+            # the stream delivers ADDED(w3) before the diff runs
+            watcher._handle_stream_event(
+                {"type": "ADDED", "object": new_pod}
+            )
+            return pods
+
+        kube.list_pods = racing_list
+        polled = watcher.poll_once()
+        # no false DELETED for node 3; the stream's view survives
+        assert polled == []
+        assert [e.kind for e in events] == [PodEvent.ADDED]
+        assert watcher._known.get(3) == "w3"
+
+        # mirror race: streamed DELETED during a list that still has w3
+        kube.list_pods = real_list
+        kube.pods["w3"] = {"metadata": {"name": "w3", "labels": {
+            "node-id": "3", "job": "train1", "group": "worker"}}}
+
+        def racing_list2(namespace, selector):
+            pods = real_list(namespace, selector)  # stale: w3 present
+            watcher._handle_stream_event(
+                {"type": "DELETED", "object": new_pod}
+            )
+            return pods
+
+        kube.list_pods = racing_list2
+        watcher.poll_once()
+        # the dead pod is not resurrected into _known
+        assert 3 not in watcher._known
+
     def test_stream_break_resyncs_by_list(self):
         """A deletion missed while the stream was down surfaces via the
         re-list diff on re-subscribe."""
