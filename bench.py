@@ -208,21 +208,25 @@ def bench_checkpoint(extra: dict) -> dict:
         save_s = time.monotonic() - t0
         assert ok
 
+        # the production restore path (what examples/train_transformer.py
+        # runs): zero-copy arena views handed straight to the consumer
+        # (device_put with target shardings in the real flow; a full
+        # read stands in for it here)
+        t0 = time.monotonic()
+        loaded = engine.load(state, put=lambda _n, a: a.sum(),
+                             zero_copy=True)
+        restore_s = time.monotonic() - t0
+        assert loaded is not None and loaded[0] == 2
+
+        # full host-side materialization (np consumers); rides along —
+        # dominated by destination page faults, not the snapshot read
         t0 = time.monotonic()
         loaded = engine.load(state)
-        restore_s = time.monotonic() - t0
+        restore_copy_s = time.monotonic() - t0
         assert loaded is not None and loaded[0] == 2
         np.testing.assert_array_equal(
             loaded[1]["params"]["w"], state["params"]["w"]
         )
-
-        # consumer fast path: zero-copy views handed straight to the
-        # restore consumer (device_put in the real flow; a full read here)
-        t0 = time.monotonic()
-        loaded = engine.load(state, put=lambda _n, a: a.sum(),
-                             zero_copy=True)
-        restore_view_s = time.monotonic() - t0
-        assert loaded is not None and loaded[0] == 2
 
         t0 = time.monotonic()
         engine.save_to_storage(3, state)
@@ -235,7 +239,7 @@ def bench_checkpoint(extra: dict) -> dict:
         ckpt_state_gb=round(state_gb, 2),
         ckpt_save_block_s=round(save_s, 3),
         ckpt_restore_s=round(restore_s, 3),
-        ckpt_restore_view_s=round(restore_view_s, 3),
+        ckpt_restore_copy_s=round(restore_copy_s, 3),
         ckpt_persist_async_s=round(persist_s, 2) if persisted else None,
         ckpt_note="host-side snapshot path; D2H excluded (axon tunnel "
                   "runs ~0.02 GB/s, unrepresentative of a TPU host)",

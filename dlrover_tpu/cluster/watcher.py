@@ -63,6 +63,10 @@ class PodWatcher:
         self._interval_s = interval_s
         self._known: dict[int, str] = {}
         self._mu = threading.Lock()  # _known/_epoch/_touched
+        # serializes poll_once across the resync + stream threads: a
+        # concurrent poll would prune _touched records the other's
+        # in-flight list still needs, reopening the stale-snapshot race
+        self._poll_mu = threading.Lock()
         # stream-event epoch: the resync diff must not override nodes the
         # stream touched while its list RPC was in flight (a stale
         # snapshot would emit false ADDED/DELETED for them)
@@ -95,6 +99,10 @@ class PodWatcher:
             return None
 
     def poll_once(self) -> list[PodEvent]:
+        with self._poll_mu:
+            return self._poll_locked()
+
+    def _poll_locked(self) -> list[PodEvent]:
         with self._mu:
             start_epoch = self._epoch
         pods = self._client.list_pods(self._namespace, self._selector)
