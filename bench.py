@@ -242,7 +242,10 @@ def bench_checkpoint(extra: dict) -> dict:
         ckpt_restore_copy_s=round(restore_copy_s, 3),
         ckpt_persist_async_s=round(persist_s, 2) if persisted else None,
         ckpt_note="host-side snapshot path; D2H excluded (axon tunnel "
-                  "runs ~0.02 GB/s, unrepresentative of a TPU host)",
+                  "runs ~0.02 GB/s, unrepresentative of a TPU host). "
+                  "Rebaselined in r02: ckpt_restore_s now times the "
+                  "production zero-copy view path (the old full-copy "
+                  "number moved to ckpt_restore_copy_s)",
     )
     return {"save_s": save_s}
 

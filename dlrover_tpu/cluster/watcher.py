@@ -99,8 +99,15 @@ class PodWatcher:
             return None
 
     def poll_once(self) -> list[PodEvent]:
-        with self._poll_mu:
+        # non-blocking: a poll already in flight is doing this work, and
+        # list_pods/_emit can block on RPCs — waiting here would couple
+        # the resync and stream threads to each other's hangs
+        if not self._poll_mu.acquire(blocking=False):
+            return []
+        try:
             return self._poll_locked()
+        finally:
+            self._poll_mu.release()
 
     def _poll_locked(self) -> list[PodEvent]:
         with self._mu:
