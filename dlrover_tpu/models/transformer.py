@@ -50,7 +50,10 @@ class TransformerConfig:
     # backward; "save_attn" keeps the (cheap, bf16) attention outputs so
     # the backward skips re-running attention to rebuild FFN inputs
     remat_policy: str = "nothing"
-    attention: str = "dense"    # "dense" | "flash" | "ring"
+    attention: str = "dense"    # "dense" | "flash" | "splash" | "ring"
+    # splash only: sliding-window size (0 = full causal); the sparse
+    # kernel skips fully-masked blocks, so long seqs pay O(S * window)
+    attention_window: int = 0
     # muP (parallel/mup.py): base d_model tuned on; 0 disables. Applies
     # the readout multiplier and 1/d_head attention scaling here; pair
     # with mup_optimizer for the per-leaf LR table.
@@ -484,6 +487,12 @@ def make_loss_fn(cfg: TransformerConfig, strategy, mesh) -> Callable:
         from dlrover_tpu.ops.flash_attention import flash_attention
 
         attn = flash_attention
+    elif choice == "splash":
+        from dlrover_tpu.ops.splash_attention import make_splash_attention
+
+        attn = make_splash_attention(
+            int(extra.get("attention_window", cfg.attention_window))
+        )
     return partial(loss_fn, cfg=cfg, attention_fn=attn, constrain=pin)
 
 

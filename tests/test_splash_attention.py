@@ -1,0 +1,84 @@
+"""Splash attention (ops/splash_attention.py).
+
+On the CPU test mesh the TPU kernel is unavailable, so these pin the
+dense fallback's mask semantics (which the on-TPU kernel is validated
+against by the same module's _dense_window) and the strategy wiring.
+"""
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.models import transformer as T
+from dlrover_tpu.ops.splash_attention import (
+    _dense_window,
+    splash_attention,
+)
+
+
+def _qkv(key, b=2, s=64, h=4, d=16):
+    ks = jax.random.split(key, 3)
+    shape = (b, s, h, d)
+    return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
+
+
+class TestWindowMask:
+    def test_no_window_matches_dense_causal(self):
+        q, k, v = _qkv(jax.random.PRNGKey(0))
+        a = splash_attention(q, k, v, causal=True)
+        b = T.dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+
+    def test_window_limits_reach(self):
+        """With window W, changing a key more than W positions back must
+        not change the query's output; within W it must."""
+        q, k, v = _qkv(jax.random.PRNGKey(1), s=32)
+        W = 8
+        out = splash_attention(q, k, v, causal=True, window=W)
+        # perturb key at position 0; query at position 20 (> W away)
+        k2 = k.at[:, 0].add(10.0)
+        v2 = v.at[:, 0].add(10.0)
+        out2 = splash_attention(q, k2, v2, causal=True, window=W)
+        np.testing.assert_allclose(
+            np.asarray(out[:, 20]), np.asarray(out2[:, 20]), rtol=1e-5
+        )
+        # query at position 5 (within W of key 0) must see the change
+        assert not np.allclose(
+            np.asarray(out[:, 5]), np.asarray(out2[:, 5])
+        )
+
+    def test_window_1_is_self_attention_only(self):
+        q, k, v = _qkv(jax.random.PRNGKey(2), s=16)
+        out = splash_attention(q, k, v, causal=True, window=1)
+        # each query attends only itself -> output == its own value row
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(v), rtol=1e-5, atol=1e-6
+        )
+
+
+class TestStrategyWiring:
+    def test_cfg_attention_splash(self):
+        cfg = dataclasses.replace(
+            T.CONFIGS["tiny"], dtype="float32",
+            attention="splash", attention_window=8,
+        )
+        from dlrover_tpu.parallel import strategy as S
+
+        strat = S.dp()
+        mesh = strat.build_mesh()
+        loss = T.make_loss_fn(cfg, strat, mesh)
+        params = T.init_params(cfg, jax.random.PRNGKey(0))
+        batch = {"tokens": jax.random.randint(
+            jax.random.PRNGKey(1), (4, 33), 0, cfg.vocab_size
+        )}
+        val = float(jax.jit(loss)(params, batch))
+        assert math.isfinite(val)
+        # window changes the loss vs full causal
+        cfg_full = dataclasses.replace(cfg, attention_window=0)
+        loss_full = T.make_loss_fn(cfg_full, strat, mesh)
+        assert float(jax.jit(loss_full)(params, batch)) != val
