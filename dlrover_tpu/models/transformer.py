@@ -375,7 +375,9 @@ def forward_with_aux(
         if c.variant == "llama":
             q = _rope(q, positions, c.rope_theta)
             k = _rope(k, positions, c.rope_theta)
-        if n_rep > 1:
+        if n_rep > 1 and not getattr(attn, "supports_gqa", False):
+            # GQA-native impls (splash) read the shared KV directly —
+            # repeating here would multiply KV memory traffic by n_rep
             k = jnp.repeat(k, n_rep, axis=2)
             v = jnp.repeat(v, n_rep, axis=2)
         o = attn(q, k, v, causal=c.causal)
@@ -491,7 +493,8 @@ def make_loss_fn(cfg: TransformerConfig, strategy, mesh) -> Callable:
         from dlrover_tpu.ops.splash_attention import make_splash_attention
 
         attn = make_splash_attention(
-            int(extra.get("attention_window", cfg.attention_window))
+            int(extra.get("attention_window", cfg.attention_window)),
+            native_gqa=bool(extra.get("native_gqa", False)),
         )
     return partial(loss_fn, cfg=cfg, attention_fn=attn, constrain=pin)
 
