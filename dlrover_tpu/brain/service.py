@@ -146,6 +146,10 @@ class BrainService:
         - create: memory = 1.5x median successful usage; workers = the
           worker count of the fastest successful run (per-worker speed)
         - oom: memory = 2x the max usage ever observed for the signature
+        - running: scaling-knee worker count (the worker-resource/util
+          algorithms) — the smallest count whose median throughput is
+          within 90% of the best, plus right-sized memory (1.2x peak):
+          workers past the knee add cost without speed
         """
         rows = self.store.history(req.signature)
         ok_rows = [r for r in rows if r[5] == "succeeded"]
@@ -155,6 +159,29 @@ class BrainService:
             peak = self.store.peak_memory_mb(req.signature)
             return m.BrainOptimizePlan(
                 found=True, memory_mb=2 * peak, based_on_jobs=len(rows),
+            )
+        if req.stage == "running":
+            by_count: dict[int, list[float]] = {}
+            for r in rows:
+                # doomed configurations (failed/oom) may report great
+                # throughput right up to the crash — never learn the
+                # knee from them
+                if r[1] and r[4] and r[5] in ("running", "succeeded"):
+                    by_count.setdefault(r[1], []).append(r[4])
+            if not by_count:
+                return m.BrainOptimizePlan(found=False)
+            med = {
+                c: statistics.median(v) for c, v in by_count.items()
+            }
+            best_tp = max(med.values())
+            knee = min(
+                c for c, tp in med.items() if tp >= 0.9 * best_tp
+            )
+            peak = self.store.peak_memory_mb(req.signature)
+            return m.BrainOptimizePlan(
+                found=True, workers=knee,
+                memory_mb=int(1.2 * peak) if peak else 0,
+                based_on_jobs=sum(len(v) for v in by_count.values()),
             )
         mem = int(1.5 * statistics.median(r[2] for r in ok_rows))
         # fastest per-worker throughput wins the worker-count vote

@@ -99,13 +99,34 @@ class LocalResourceOptimizer:
         )
 
     def speed_plan(self, current_workers: int) -> ScalePlan:
-        """Scale workers toward the target throughput, within bounds."""
+        """Scale workers toward the target throughput, within bounds.
+
+        Cross-job history first: the Brain's running-stage scaling knee
+        (the smallest worker count near peak throughput) caps how far
+        the local heuristic scales — counts past the knee historically
+        added cost without speed.
+        """
         target = self._config.target_steps_per_s
         if target <= 0 or current_workers <= 0:
             return ScalePlan()
         speed = self._speed.running_speed()
         if speed <= 0:
             return ScalePlan()
+        brain = self._brain_plan("running")
+        if (brain is not None and brain.workers
+                and brain.workers != current_workers):
+            desired = max(
+                self._config.min_workers,
+                min(self._config.max_workers, brain.workers),
+            )
+            if desired != current_workers:
+                return ScalePlan(
+                    replica_resources={"worker": desired},
+                    reason=(
+                        f"brain scaling knee: {desired} workers "
+                        f"(from {brain.based_on_jobs} jobs)"
+                    ),
+                )
         if speed < target:
             desired = min(
                 self._config.max_workers,

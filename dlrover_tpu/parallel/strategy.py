@@ -188,6 +188,23 @@ def long_context(sequence_size: int = 2, data_size: int = -1,
     )
 
 
+def sliding_window(window: int = 1024, data_size: int = -1,
+                   remat: str = "dots") -> Strategy:
+    """Local (sliding-window) attention via the splash kernel.
+
+    Single-device long-context alternative to ring attention: each query
+    sees the last ``window`` keys and the sparse kernel skips masked
+    blocks, so step cost is O(S * window) instead of O(S^2).
+    """
+    return Strategy(
+        name="sliding_window",
+        mesh_axes={"data": data_size},
+        rules=[["batch", ["data", "fsdp"]]],
+        remat=remat,
+        extra={"attention": "splash", "attention_window": int(window)},
+    )
+
+
 def pipeline(pipeline_size: int = 2, data_size: int = -1,
              microbatches: int = 0, remat: str = "none") -> Strategy:
     """GPipe pipeline over the "pipeline" axis × data parallel.
@@ -257,6 +274,7 @@ PRESETS = {
     "tp": tp,
     "fsdp_tp": fsdp_tp,
     "long_context": long_context,
+    "sliding_window": sliding_window,
     "pipeline": pipeline,
     "mixed": mixed,
     "moe": moe,

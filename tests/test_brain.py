@@ -64,6 +64,30 @@ class TestBrainService:
         plan = client.optimize("a", "llama-7b", stage="oom")
         assert plan.found and plan.memory_mb == 16000
 
+    def test_running_plan_picks_scaling_knee(self, brain):
+        """Worker counts past the throughput knee add cost, not speed:
+        the running-stage plan picks the smallest count within 90% of
+        the best median throughput."""
+        _, client = brain
+        # 4 workers: 10 steps/s; 8 workers: 19; 16 workers: 19.5
+        # (scaling flattens past 8)
+        client.report(_job("a", workers=4, mem=8000, speed=10.0))
+        client.report(_job("b", workers=8, mem=8000, speed=19.0))
+        client.report(_job("c", workers=16, mem=9000, speed=19.5))
+        plan = client.optimize("j", "llama-7b", stage="running")
+        assert plan.found
+        assert plan.workers == 8
+        # right-sized memory: 1.2x the peak ever observed
+        assert plan.memory_mb == int(1.2 * 9000)
+        assert plan.based_on_jobs == 3
+
+    def test_running_plan_without_throughput_not_found(self, brain):
+        _, client = brain
+        client.report(_job("a", workers=4, mem=8000, speed=0.0))
+        assert not client.optimize(
+            "j", "llama-7b", stage="running"
+        ).found
+
     def test_latest_record_per_job_wins(self, brain):
         _, client = brain
         client.report(_job("a", workers=2, mem=4000, speed=1.0,
@@ -72,6 +96,17 @@ class TestBrainService:
         plan = client.optimize("new", "llama-7b")
         assert plan.based_on_jobs == 1
         assert plan.memory_mb == int(1.5 * 6000)
+
+    def test_running_knee_ignores_doomed_configs(self, brain):
+        """A worker count that only ever reported throughput before
+        crashing must not win the knee."""
+        _, client = brain
+        client.report(_job("a", workers=4, mem=8000, speed=10.0))
+        client.report(_job("b", workers=16, mem=8000, speed=20.0,
+                           status="oom"))
+        plan = client.optimize("j", "llama-7b", stage="running")
+        assert plan.found
+        assert plan.workers == 4
 
     def test_sqlite_persistence_across_restart(self, tmp_path):
         db = str(tmp_path / "brain.sqlite")
@@ -91,6 +126,28 @@ class TestBrainService:
 
 
 class TestOptimizerBrainIntegration:
+    def test_speed_plan_capped_by_brain_knee(self, brain):
+        """The local scale-up heuristic defers to the cross-job scaling
+        knee: history says 8 workers is where throughput flattens."""
+        _, client = brain
+        client.report(_job("a", workers=4, mem=8000, speed=10.0))
+        client.report(_job("b", workers=8, mem=8000, speed=19.0))
+        client.report(_job("c", workers=16, mem=9000, speed=19.5))
+
+        class Speed:
+            def running_speed(self):
+                return 5.0  # below target: heuristic alone would grow
+
+        opt = LocalResourceOptimizer(
+            OptimizerConfig(min_workers=1, max_workers=32,
+                            target_steps_per_s=50.0),
+            LocalStatsReporter(), Speed(),
+            brain=client, signature="llama-7b",
+        )
+        plan = opt.speed_plan(current_workers=16)
+        assert plan.replica_resources == {"worker": 8}
+        assert "knee" in plan.reason
+
     def test_initial_plan_uses_history_clamped(self, brain):
         _, client = brain
         client.report(_job("a", workers=16, mem=8000, speed=10.0))

@@ -103,6 +103,33 @@ class TestGqa:
 
 
 class TestStrategyWiring:
+    def test_sliding_window_preset_trains(self):
+        import optax
+
+        from dlrover_tpu.parallel import strategy as S
+        from dlrover_tpu.trainer import compile_train
+
+        cfg = dataclasses.replace(T.CONFIGS["tiny"], dtype="float32")
+        strat = S.sliding_window(window=16)
+        mesh = strat.build_mesh()
+        ct = compile_train(
+            strategy=strat,
+            mesh=mesh,
+            loss_fn=T.make_loss_fn(cfg, strat, mesh),
+            init_params_fn=lambda rng: T.init_params(cfg, rng),
+            logical_params=T.logical_axes(cfg),
+            optimizer=optax.adamw(1e-2),
+        )
+        state = ct.init(jax.random.PRNGKey(0))
+        batch = {"tokens": jax.random.randint(
+            jax.random.PRNGKey(1), (1, 8, 65), 0, cfg.vocab_size
+        )}
+        losses = []
+        for _ in range(6):
+            state, m = ct.step(state, batch)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0]
+
     def test_cfg_attention_splash(self):
         cfg = dataclasses.replace(
             T.CONFIGS["tiny"], dtype="float32",
