@@ -12,6 +12,7 @@ keeps the reference's 2x rule.
 from __future__ import annotations
 
 import dataclasses
+import time
 
 from dlrover_tpu.cluster.crd import ScalePlan
 from dlrover_tpu.common.constants import NodeExitReason
@@ -45,16 +46,28 @@ class LocalResourceOptimizer:
         self._memory_mb: dict[int, int] = {}
         self._brain = brain
         self._signature = signature
+        self._brain_cache: dict[str, tuple[float, object]] = {}
+
+    _BRAIN_CACHE_TTL_S = 30.0
 
     def _brain_plan(self, stage: str):
         if self._brain is None or not self._signature:
             return None
+        # TTL cache: the auto-scaler may ask every tick; history moves
+        # slowly and an unreachable Brain must not block every plan for
+        # the full RPC timeout (negative results are cached too)
+        now = time.time()
+        cached = self._brain_cache.get(stage)
+        if cached is not None and now - cached[0] < self._BRAIN_CACHE_TTL_S:
+            return cached[1]
         try:
             plan = self._brain.optimize("", self._signature, stage=stage)
-            return plan if plan.found else None
+            result = plan if plan.found else None
         except (ConnectionError, RuntimeError, OSError) as e:
             logger.warning("brain optimize failed: %s", e)
-            return None
+            result = None
+        self._brain_cache[stage] = (now, result)
+        return result
 
     def initial_plan(self) -> ScalePlan:
         brain = self._brain_plan("create")
@@ -112,36 +125,33 @@ class LocalResourceOptimizer:
         speed = self._speed.running_speed()
         if speed <= 0:
             return ScalePlan()
+        if speed >= target:
+            return ScalePlan()
+        desired = min(
+            self._config.max_workers,
+            max(
+                current_workers + 1,
+                int(current_workers * self._config.scale_up_factor),
+            ),
+        )
+        reason = f"speed {speed:.2f}/s < target {target:.2f}/s"
+        # the knee CAPS growth (never forces scale-ups, never retargets
+        # on its own — that would oscillate against this heuristic), and
+        # the Brain is only consulted when a scale-up is actually pending
         brain = self._brain_plan("running")
-        if (brain is not None and brain.workers
-                and brain.workers != current_workers):
-            desired = max(
-                self._config.min_workers,
-                min(self._config.max_workers, brain.workers),
-            )
-            if desired != current_workers:
-                return ScalePlan(
-                    replica_resources={"worker": desired},
-                    reason=(
-                        f"brain scaling knee: {desired} workers "
-                        f"(from {brain.based_on_jobs} jobs)"
-                    ),
+        if brain is not None and brain.workers:
+            knee = max(self._config.min_workers, brain.workers)
+            if desired > knee:
+                desired = max(min(desired, knee), 1)
+                reason += (
+                    f"; capped at the brain scaling knee {knee} "
+                    f"(from {brain.based_on_jobs} jobs)"
                 )
-        if speed < target:
-            desired = min(
-                self._config.max_workers,
-                max(
-                    current_workers + 1,
-                    int(current_workers * self._config.scale_up_factor),
-                ),
-            )
-        else:
-            desired = current_workers
         if desired == current_workers:
             return ScalePlan()
         return ScalePlan(
             replica_resources={"worker": desired},
-            reason=f"speed {speed:.2f}/s < target {target:.2f}/s",
+            reason=reason,
         )
 
     def plan_for_failure(self, node_id: int,

@@ -41,6 +41,16 @@ def parse_args(argv=None):
     p.add_argument("--ckpt-dir", default="")
     p.add_argument("--result-file", default="")
     p.add_argument("--log-interval", type=int, default=50)
+    p.add_argument("--spill-dir", default="",
+                   help="hybrid storage: spill cold rows (freq <= "
+                        "--spill-max-freq) to a file in this dir every "
+                        "--spill-interval steps, bounding host memory")
+    p.add_argument("--spill-interval", type=int, default=100)
+    p.add_argument("--spill-max-freq", type=int, default=1)
+    p.add_argument("--incremental-ckpt", action="store_true",
+                   help="with --ckpt-dir: base+delta embedding "
+                        "checkpoints (only changed rows per save) every "
+                        "--log-interval steps")
     return p.parse_args(argv)
 
 
@@ -56,6 +66,28 @@ def main(argv=None) -> int:
 
     ctx = bootstrap.init_from_env()
     table = KvEmbeddingTable(dim=args.dim, num_slots=2, seed=1234)
+    if args.spill_dir:
+        os.makedirs(args.spill_dir, exist_ok=True)
+        table.enable_spill(os.path.join(
+            args.spill_dir, f"recsys-{ctx.node_id}.spill"
+        ))
+
+    inc_mgr = None
+    if args.incremental_ckpt and args.ckpt_dir:
+        from dlrover_tpu.embedding.kv_table import (
+            IncrementalCheckpointManager,
+        )
+
+        # node-scoped like the spill file and the CheckpointEngine:
+        # each node's table has its own base/delta chain
+        inc_mgr = IncrementalCheckpointManager(
+            table,
+            os.path.join(args.ckpt_dir, f"embedding-inc-{ctx.node_id}"),
+        )
+        restored = inc_mgr.restore()
+        if restored:
+            print(f"[recsys] embedding table restored at version "
+                  f"{restored} ({len(table)} rows)", flush=True)
 
     # dense tower: concat field embeddings -> MLP -> logit
     d_in = args.fields * args.dim
@@ -114,6 +146,21 @@ def main(argv=None) -> int:
             losses.append(float(loss))
             print(f"[recsys] step {step} loss {losses[-1]:.4f} "
                   f"table={len(table)}", flush=True)
+            if inc_mgr is not None:
+                try:
+                    path = inc_mgr.save()
+                    print(f"[recsys] incremental ckpt: "
+                          f"{os.path.basename(path)}", flush=True)
+                except OSError as e:
+                    # the manager parks the drained changes; the next
+                    # interval's save retries them — keep training
+                    print(f"[recsys] incremental ckpt postponed: {e}",
+                          flush=True)
+        if args.spill_dir and step % args.spill_interval == 0:
+            spilled = table.evict(max_freq=args.spill_max_freq)
+            if spilled:
+                print(f"[recsys] spilled {spilled} cold rows "
+                      f"(disk={table.disk_rows})", flush=True)
     wall = time.monotonic() - start
 
     if args.ckpt_dir:
