@@ -214,34 +214,43 @@ def bench_checkpoint(extra: dict) -> dict:
     engine = CheckpointEngine(ckpt_dir, node_id=int(os.getpid()) % 100000)
     try:
         engine.save_to_memory(1, state)  # warmup: arena creation
-        t0 = time.monotonic()
-        ok = engine.save_to_memory(2, state)
-        save_s = time.monotonic() - t0
-        assert ok
+        # median of 3: these are sub-second host-side numbers, easily
+        # skewed by transient host load during the round's bench run
+        save_times = []
+        for i in range(3):
+            t0 = time.monotonic()
+            ok = engine.save_to_memory(2 + i, state)
+            save_times.append(time.monotonic() - t0)
+            assert ok
+        save_s = sorted(save_times)[1]
+        last_step = 2 + len(save_times) - 1
 
         # the production restore path (what examples/train_transformer.py
         # runs): zero-copy arena views handed straight to the consumer
         # (device_put with target shardings in the real flow; a full
         # read stands in for it here)
-        t0 = time.monotonic()
-        loaded = engine.load(state, put=lambda _n, a: a.sum(),
-                             zero_copy=True)
-        restore_s = time.monotonic() - t0
-        assert loaded is not None and loaded[0] == 2
+        restore_times = []
+        for _ in range(3):
+            t0 = time.monotonic()
+            loaded = engine.load(state, put=lambda _n, a: a.sum(),
+                                 zero_copy=True)
+            restore_times.append(time.monotonic() - t0)
+            assert loaded is not None and loaded[0] == last_step
+        restore_s = sorted(restore_times)[1]
 
         # full host-side materialization (np consumers); rides along —
         # dominated by destination page faults, not the snapshot read
         t0 = time.monotonic()
         loaded = engine.load(state)
         restore_copy_s = time.monotonic() - t0
-        assert loaded is not None and loaded[0] == 2
+        assert loaded is not None and loaded[0] == last_step
         np.testing.assert_array_equal(
             loaded[1]["params"]["w"], state["params"]["w"]
         )
 
         t0 = time.monotonic()
-        engine.save_to_storage(3, state)
-        persisted = engine.wait_for_persist(3, timeout=300)
+        engine.save_to_storage(last_step + 1, state)
+        persisted = engine.wait_for_persist(last_step + 1, timeout=300)
         persist_s = time.monotonic() - t0
     finally:
         engine.close()

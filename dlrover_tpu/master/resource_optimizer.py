@@ -56,7 +56,7 @@ class LocalResourceOptimizer:
         # TTL cache: the auto-scaler may ask every tick; history moves
         # slowly and an unreachable Brain must not block every plan for
         # the full RPC timeout (negative results are cached too)
-        now = time.time()
+        now = time.monotonic()
         cached = self._brain_cache.get(stage)
         if cached is not None and now - cached[0] < self._BRAIN_CACHE_TTL_S:
             return cached[1]
@@ -142,7 +142,7 @@ class LocalResourceOptimizer:
         if brain is not None and brain.workers:
             knee = max(self._config.min_workers, brain.workers)
             if desired > knee:
-                desired = max(min(desired, knee), 1)
+                desired = knee
                 reason += (
                     f"; capped at the brain scaling knee {knee} "
                     f"(from {brain.based_on_jobs} jobs)"
