@@ -40,12 +40,13 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> dict:
     }
 
 
-def _layer_attend(q, k_cache, v_cache, pos, n_rep, dt):
+def _layer_attend(q, k_cache, v_cache, pos, n_rep, dt, window=0):
     """q: [B, S_new, H, D] against cache [B, max_len, H_kv, D].
 
     GQA reads the cache UNEXPANDED via a grouped-head einsum — repeating
     it to H heads would multiply per-token decode memory traffic by
-    ``n_rep`` on the hot path.
+    ``n_rep`` on the hot path. ``window > 0`` applies the sliding-window
+    mask so decode matches a model trained with local attention.
     """
     B, S_new, H, D = q.shape
     scale = 1.0 / math.sqrt(D)
@@ -59,6 +60,8 @@ def _layer_attend(q, k_cache, v_cache, pos, n_rep, dt):
     q_pos = pos + jnp.arange(S_new)
     k_pos = jnp.arange(max_len)
     mask = q_pos[:, None] >= k_pos[None, :]
+    if window > 0:
+        mask &= q_pos[:, None] - k_pos[None, :] < window
     logits = jnp.where(mask[None, None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(dt)
     o = jnp.einsum("bgrqk,bkgd->bqgrd", probs, v_cache)
@@ -126,7 +129,13 @@ def forward_cached(
         v_cache_l = lax.dynamic_update_slice_in_dim(
             v_cache_l, v.astype(dt), pos, axis=1
         )
-        o = _layer_attend(q, k_cache_l, v_cache_l, pos, n_rep, dt)
+        # the window only binds when training actually used it (the
+        # splash kind) — other attention kinds ignore attention_window
+        # in training, so decode must too or the masks diverge
+        o = _layer_attend(
+            q, k_cache_l, v_cache_l, pos, n_rep, dt,
+            window=c.attention_window if c.attention == "splash" else 0,
+        )
         o = jnp.einsum("bshd,hde->bse", o, w["wo"].astype(dt))
         x = x + o
         h = _norm(x, w["ln2"], w.get("ln2_b"), c.variant)

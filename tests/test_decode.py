@@ -70,6 +70,48 @@ class TestCachedForwardEquivalence:
         )
 
 
+class TestSlidingWindowDecode:
+    def test_windowed_decode_matches_windowed_forward(self):
+        """A model trained with sliding-window attention must decode
+        with the same mask — prefill+steps reproduce the windowed
+        training forward, not the full-causal one."""
+        cfg = _f32(
+            dataclasses.replace(
+                tfm.CONFIGS["tiny"], n_layers=2, max_seq_len=64,
+                attention="splash", attention_window=4,
+            )
+        )
+        params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (2, 12), 0, cfg.vocab_size
+        )
+        from dlrover_tpu.ops.splash_attention import make_splash_attention
+
+        ref = tfm.forward(
+            params, tokens, cfg,
+            attention_fn=make_splash_attention(cfg.attention_window),
+        )
+        cache = init_cache(cfg, 2, 16)
+        out_p, cache = forward_cached(params, tokens[:, :4], cache, cfg)
+        outs = [out_p]
+        for i in range(4, 12):
+            out_i, cache = forward_cached(
+                params, tokens[:, i:i + 1], cache, cfg
+            )
+            outs.append(out_i)
+        got = jnp.concatenate(outs, axis=1)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), atol=3e-4, rtol=3e-4
+        )
+        # and it differs from the full-causal forward (the mask matters)
+        full = tfm.forward(
+            params, tokens, dataclasses.replace(cfg, attention="dense",
+                                                attention_window=0)
+        )
+        assert not np.allclose(np.asarray(got), np.asarray(full),
+                               atol=1e-3)
+
+
 class TestMoeDecode:
     def _cfg(self):
         # generous capacity: drop patterns differ between full-sequence
