@@ -140,10 +140,11 @@ def bench_long_context(extra: dict) -> None:
     batch = int(os.environ.get("BENCH_LC_BATCH", "2"))
     steps = int(os.environ.get("BENCH_LC_STEPS", "10"))
 
-    def run(attention: str, remat: bool) -> float:
+    def run(attention: str, remat: bool, window: int = 0) -> float:
         cfg = dataclasses.replace(
             tfm.CONFIGS["gpt2-small"], remat_scan=remat,
             attention=attention, max_seq_len=seq,
+            attention_window=window,
         )
         strat = strat_lib.dp()
         mesh = strat.build_mesh(jax.devices()[:1])
@@ -182,6 +183,11 @@ def bench_long_context(extra: dict) -> None:
         extra["lc_splash_step_s"] = round(splash_s, 4)
     except Exception as e:  # noqa: BLE001 - splash is optional
         extra["lc_splash_error"] = f"{type(e).__name__}"
+    try:
+        window_s = run("splash", False, window=seq // 4)
+        extra["lc_window_step_s"] = round(window_s, 4)
+    except Exception as e:  # noqa: BLE001 - window entry is optional
+        extra["lc_window_error"] = f"{type(e).__name__}"
     extra["lc_best_tokens_per_s"] = round(batch * seq / best_s)
     try:
         dense_s = run("dense", True)

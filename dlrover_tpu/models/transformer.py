@@ -456,6 +456,33 @@ def forward_with_aux(
     return logits.astype(jnp.float32), aux
 
 
+def resolve_config(cfg: TransformerConfig, strategy) -> TransformerConfig:
+    """Merge the strategy's model-affecting extras into the config.
+
+    The strategy presets carry attention kind/window and pipeline shape
+    in ``strategy.extra`` (e.g. sliding_window, long_context, pipeline);
+    training consumes them through make_loss_fn. Anything that reads the
+    config OUTSIDE that path — cached decode/serving, parameter counts —
+    must use the RESOLVED config or its masks/shapes silently diverge
+    from what was trained.
+    """
+    extra = getattr(strategy, "extra", {}) or {}
+    updates: dict = {}
+    if extra.get("attention"):
+        updates["attention"] = extra["attention"]
+    if "attention_window" in extra:
+        updates["attention_window"] = int(extra["attention_window"])
+    pp = int(extra.get("pipeline_stages", 0))
+    if pp > 1:
+        # the strategy wins when it pipelines; its microbatch count only
+        # overrides the config when actually set (0 = "stage count")
+        updates["pipeline_stages"] = pp
+        mb = int(extra.get("pipeline_microbatches", 0))
+        if mb:
+            updates["pipeline_microbatches"] = mb
+    return dataclasses.replace(cfg, **updates) if updates else cfg
+
+
 def make_loss_fn(cfg: TransformerConfig, strategy, mesh) -> Callable:
     """Bind loss_fn to a strategy: activation constraints + attention impl.
 
@@ -466,34 +493,24 @@ def make_loss_fn(cfg: TransformerConfig, strategy, mesh) -> Callable:
     """
     from dlrover_tpu.parallel.partition import constrain as _constrain
 
+    cfg = resolve_config(cfg, strategy)
     extra = getattr(strategy, "extra", {}) or {}
-    pp = int(extra.get("pipeline_stages", 0))
-    if pp > 1:
-        # the strategy wins when it pipelines; its microbatch count only
-        # overrides the config when actually set (0 = "stage count")
-        mb = int(extra.get("pipeline_microbatches", 0))
-        cfg = dataclasses.replace(
-            cfg,
-            pipeline_stages=pp,
-            pipeline_microbatches=mb or cfg.pipeline_microbatches,
-        )
 
     pin = partial(_constrain, rules=strategy.rule_table(), mesh=mesh)
     attn: AttentionFn | None = None
-    choice = extra.get("attention") or cfg.attention
-    if choice == "ring":
+    if cfg.attention == "ring":
         from dlrover_tpu.ops.ring_attention import make_ring_attention
 
         attn = make_ring_attention(mesh)
-    elif choice == "flash":
+    elif cfg.attention == "flash":
         from dlrover_tpu.ops.flash_attention import flash_attention
 
         attn = flash_attention
-    elif choice == "splash":
+    elif cfg.attention == "splash":
         from dlrover_tpu.ops.splash_attention import make_splash_attention
 
         attn = make_splash_attention(
-            int(extra.get("attention_window", cfg.attention_window)),
+            cfg.attention_window,
             native_gqa=bool(extra.get("native_gqa", False)),
         )
     return partial(loss_fn, cfg=cfg, attention_fn=attn, constrain=pin)

@@ -56,6 +56,9 @@ class Strategy:
     # logical axis name -> mesh axis (str), tuple of axes, or None
     rules: list[list] = dataclasses.field(default_factory=list)
     compute_dtype: str = "bfloat16"
+    # master weights: params (and optimizer states) stay f32; the bf16
+    # casts happen at use sites inside the model (mixed precision with
+    # master weights — the AMP shape that is safe by default on TPU)
     param_dtype: str = "float32"
     remat: str = "none"  # key into REMAT_POLICIES
     grad_accum: int = 1

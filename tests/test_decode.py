@@ -111,6 +111,20 @@ class TestSlidingWindowDecode:
         assert not np.allclose(np.asarray(got), np.asarray(full),
                                atol=1e-3)
 
+    def test_resolve_config_carries_strategy_window(self):
+        """The sliding_window preset sets the window in strategy.extra;
+        resolve_config must surface it so decode masks match training."""
+        from dlrover_tpu.parallel import strategy as S
+
+        cfg = tfm.CONFIGS["tiny"]
+        assert cfg.attention_window == 0
+        resolved = tfm.resolve_config(cfg, S.sliding_window(window=16))
+        assert resolved.attention == "splash"
+        assert resolved.attention_window == 16
+        # and pipeline extras merge the same way
+        resolved_pp = tfm.resolve_config(cfg, S.pipeline(pipeline_size=2))
+        assert resolved_pp.pipeline_stages == 2
+
 
 class TestMoeDecode:
     def _cfg(self):
