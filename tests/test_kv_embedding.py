@@ -8,6 +8,7 @@ import/export round-trip, frequency filtering.
 from __future__ import annotations
 
 import os
+import time
 
 import numpy as np
 import pytest
@@ -420,6 +421,99 @@ class TestHybridStorage:
         o_r, o_g = np.argsort(ref["keys"]), np.argsort(got["keys"])
         np.testing.assert_array_equal(
             ref["values"][o_r], got["values"][o_g]
+        )
+
+
+class TestConcurrencyStress:
+    def test_concurrent_update_evict_delta_consistency(self, tmp_path):
+        """Hammer the table from five threads (2x lookups/updates,
+        removes, eviction sweeps, delta drains) and verify the end state is
+        consistent: base + replayed deltas reconstruct exactly the live
+        table, and no operation crashed."""
+        import threading
+
+        table = KvEmbeddingTable(dim=8, num_slots=2, seed=5)
+        table.enable_spill(str(tmp_path / "spill.bin"))
+        stop = threading.Event()
+        errors: list = []
+        deltas: list = []
+        base = table.export()
+        table.clear_deltas()
+
+        def guard(fn):
+            def run():
+                try:
+                    while not stop.is_set():
+                        fn()
+                except Exception as e:  # noqa: BLE001 - surfaced below
+                    errors.append(e)
+            return run
+
+        rng_u = np.random.default_rng(1)
+        rng_r = np.random.default_rng(2)
+
+        def update():
+            ids = rng_u.integers(0, 5000, 64)
+            table.lookup(ids)
+            table.apply_adam(ids, np.ones((64, 8), np.float32))
+
+        def remove():
+            table.remove(rng_r.integers(0, 5000, 8))
+
+        def evict():
+            table.evict(max_freq=2, max_rows=256)
+
+        def drain():
+            deltas.append(table.delta_export())
+
+        threads = [threading.Thread(target=guard(f), daemon=True)
+                   for f in (update, update, remove, evict, drain)]
+        for t in threads:
+            t.start()
+        time.sleep(2.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+            assert not t.is_alive(), "worker thread wedged"
+        assert not errors, errors[:3]
+        deltas.append(table.delta_export())  # final quiescent drain
+
+        # replay base + deltas in order into a fresh table: must equal
+        # the live table exactly (values, slots, and key set)
+        from dlrover_tpu.embedding.kv_table import merge_deltas
+
+        replayed = KvEmbeddingTable(dim=8, num_slots=2, seed=5)
+        replayed.import_(base)
+        for d in deltas:
+            replayed.apply_delta(d)
+        live = table.export()
+        got = replayed.export()
+        o_l = np.argsort(live["keys"])
+        o_g = np.argsort(got["keys"])
+        np.testing.assert_array_equal(
+            live["keys"][o_l], got["keys"][o_g]
+        )
+        np.testing.assert_array_equal(
+            live["values"][o_l], got["values"][o_g]
+        )
+        np.testing.assert_array_equal(
+            live["slots"][o_l], got["slots"][o_g]
+        )
+        assert table.io_errors == 0
+        # merge_deltas over the whole chain replays identically too
+        merged = deltas[0]
+        for d in deltas[1:]:
+            merged = merge_deltas(merged, d)
+        replayed2 = KvEmbeddingTable(dim=8, num_slots=2, seed=5)
+        replayed2.import_(base)
+        replayed2.apply_delta(merged)
+        got2 = replayed2.export()
+        o2 = np.argsort(got2["keys"])
+        np.testing.assert_array_equal(
+            live["keys"][o_l], got2["keys"][o2]
+        )
+        np.testing.assert_array_equal(
+            live["values"][o_l], got2["values"][o2]
         )
 
 
