@@ -449,13 +449,17 @@ class TestConcurrencyStress:
                     errors.append(e)
             return run
 
-        rng_u = np.random.default_rng(1)
         rng_r = np.random.default_rng(2)
 
-        def update():
-            ids = rng_u.integers(0, 5000, 64)
-            table.lookup(ids)
-            table.apply_adam(ids, np.ones((64, 8), np.float32))
+        def make_update(seed):
+            # per-thread Generator: numpy Generators are not thread-safe
+            rng = np.random.default_rng(seed)
+
+            def update():
+                ids = rng.integers(0, 5000, 64)
+                table.lookup(ids)
+                table.apply_adam(ids, np.ones((64, 8), np.float32))
+            return update
 
         def remove():
             table.remove(rng_r.integers(0, 5000, 8))
@@ -467,7 +471,8 @@ class TestConcurrencyStress:
             deltas.append(table.delta_export())
 
         threads = [threading.Thread(target=guard(f), daemon=True)
-                   for f in (update, update, remove, evict, drain)]
+                   for f in (make_update(1), make_update(11),
+                             remove, evict, drain)]
         for t in threads:
             t.start()
         time.sleep(2.0)
@@ -514,6 +519,9 @@ class TestConcurrencyStress:
         )
         np.testing.assert_array_equal(
             live["values"][o_l], got2["values"][o2]
+        )
+        np.testing.assert_array_equal(
+            live["slots"][o_l], got2["slots"][o2]
         )
 
 

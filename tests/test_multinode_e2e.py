@@ -124,6 +124,59 @@ def _run_two_nodes(tmp_path, train_args, kill_after_ckpt=False,
                        capture_output=True)
 
 
+def _elastic_launcher(env, addr, tmp_path, nid: int,
+                      nnodes: str = "2:3") -> subprocess.Popen:
+    """Launcher for the elastic grow/shrink scenarios (min:max world)."""
+    cmd = [
+        sys.executable, "-m", "dlrover_tpu.run",
+        "--master-addr", addr,
+        "--node-id", str(nid), "--nnodes", nnodes,
+        "--monitor-interval", "0.3", "--max-restarts", "2",
+        # NB: the agent's --rdzv-timeout is how long it WAITS for a
+        # round; the master's --rdzv-timeout is when a round COMPLETES
+        # with fewer than max nodes. Setting them equal makes the
+        # client deadline race the completion.
+        "--heartbeat-interval", "2", "--rdzv-timeout", "90",
+        EXAMPLE, "--",
+        "--model", "tiny", "--seq", "128",
+        "--global-batch", "24",
+        "--ckpt-dir", str(tmp_path / "ckpt"),
+        "--ckpt-interval", "5",
+        "--result-file", str(tmp_path / f"result_{nid}.json"),
+        "--log-interval", "5",
+        "--max-steps", "30", "--epochs", "50",
+    ]
+    return subprocess.Popen(
+        cmd, env=env, cwd=REPO, start_new_session=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def _drain(proc: subprocess.Popen, timeout: float = 30) -> str:
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+    return out
+
+
+def _kill_all(launchers, master) -> None:
+    for p in (launchers.values() if isinstance(launchers, dict)
+              else launchers):
+        if p.poll() is None:
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+    if master.poll() is None:
+        try:
+            os.killpg(master.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+    subprocess.run(["pkill", "-9", "-f", EXAMPLE], capture_output=True)
+
+
 @pytest.mark.timeout(500)
 def test_two_node_training_completes(tmp_path):
     launchers, outs, _ = _run_two_nodes(
@@ -148,37 +201,14 @@ def test_three_nodes_shrink_to_two_on_node_loss(tmp_path):
         tmp_path, env, min_nodes=2, max_nodes=3,
         extra=["--rdzv-timeout", "8", "--dead-window", "6"],
     )
-    (tmp_path / "master_addr").write_text(addr)
 
-    def launcher(nid):
-        cmd = [
-            sys.executable, "-m", "dlrover_tpu.run",
-            "--master-addr", addr,
-            "--node-id", str(nid), "--nnodes", "2:3",
-            "--monitor-interval", "0.3", "--max-restarts", "2",
-            # NB: the agent's --rdzv-timeout is how long it WAITS for a
-            # round; the master's --rdzv-timeout is when a round
-            # COMPLETES with fewer than max nodes. Setting them equal
-            # makes the client deadline race the completion.
-            "--heartbeat-interval", "2", "--rdzv-timeout", "90",
-            EXAMPLE, "--",
-            "--model", "tiny", "--seq", "128",
-            "--global-batch", "24",
-            "--ckpt-dir", str(tmp_path / "ckpt"),
-            "--ckpt-interval", "5",
-            "--result-file", str(tmp_path / f"result_{nid}.json"),
-            "--log-interval", "5",
-            "--max-steps", "30", "--epochs", "50",
-        ]
-        return subprocess.Popen(
-            cmd, env=env, cwd=REPO, start_new_session=True,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        )
-
-    launchers = {nid: launcher(nid) for nid in (0, 1, 2)}
+    launchers = {
+        nid: _elastic_launcher(env, addr, tmp_path, nid)
+        for nid in (0, 1, 2)
+    }
     killed = False
     try:
-        deadline = time.time() + 420
+        deadline = time.time() + 360
         while time.time() < deadline:
             if all(p.poll() is not None
                    for nid, p in launchers.items() if nid != 2):
@@ -189,15 +219,7 @@ def test_three_nodes_shrink_to_two_on_node_loss(tmp_path):
                 killed = True
             time.sleep(0.5)
         assert killed, "checkpoint never appeared"
-        outs = {}
-        for nid in (0, 1):
-            p = launchers[nid]
-            try:
-                out, _ = p.communicate(timeout=60)
-            except subprocess.TimeoutExpired:
-                p.kill()
-                out, _ = p.communicate()
-            outs[nid] = out
+        outs = {nid: _drain(launchers[nid]) for nid in (0, 1)}
         for nid in (0, 1):
             assert launchers[nid].returncode == 0, outs[nid][-4000:]
         result = json.load(open(tmp_path / "result_0.json"))
@@ -205,19 +227,48 @@ def test_three_nodes_shrink_to_two_on_node_loss(tmp_path):
         assert result["num_nodes"] == 2       # the world actually shrank
         assert result["resumed_from"] > 0     # resharded restore
     finally:
-        for p in launchers.values():
-            if p.poll() is None:
-                try:
-                    os.killpg(p.pid, signal.SIGKILL)
-                except ProcessLookupError:
-                    pass
-        if master.poll() is None:
-            try:
-                os.killpg(master.pid, signal.SIGKILL)
-            except ProcessLookupError:
-                pass
-        subprocess.run(["pkill", "-9", "-f", EXAMPLE],
-                       capture_output=True)
+        _kill_all(launchers, master)
+
+
+@pytest.mark.timeout(500)
+def test_two_nodes_grow_to_three_on_join(tmp_path):
+    """The scale-UP half of elasticity: a third node joins mid-run; the
+    running agents detect the membership change, checkpoint, restart as
+    a 3-node world, and training finishes with all three."""
+    env = _env(tmp_path)
+    master, addr = _start_master(
+        tmp_path, env, min_nodes=2, max_nodes=3,
+        extra=["--rdzv-timeout", "6"],
+    )
+
+    launchers = {
+        nid: _elastic_launcher(env, addr, tmp_path, nid)
+        for nid in (0, 1)
+    }
+    joined = False
+    try:
+        deadline = time.time() + 360
+        while time.time() < deadline:
+            # break when every launcher spawned SO FAR has exited: a
+            # pre-join startup failure must fail fast, not burn the
+            # whole deadline
+            if all(p.poll() is not None for p in launchers.values()):
+                break
+            if not joined and (tmp_path / "ckpt" / "latest").exists():
+                # the 2-node world is training: bring in node 2
+                launchers[2] = _elastic_launcher(env, addr, tmp_path, 2)
+                joined = True
+            time.sleep(0.5)
+        assert joined, "checkpoint never appeared"
+        outs = {nid: _drain(p) for nid, p in launchers.items()}
+        for nid, p in launchers.items():
+            assert p.returncode == 0, (nid, outs[nid][-4000:])
+        result = json.load(open(tmp_path / "result_0.json"))
+        assert result["final_step"] == 30
+        assert result["num_nodes"] == 3       # the world actually grew
+        assert result["resumed_from"] > 0     # restored mid-run
+    finally:
+        _kill_all(launchers, master)
 
 
 @pytest.mark.timeout(500)
