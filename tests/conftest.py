@@ -23,7 +23,69 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import signal  # noqa: E402
+
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "timeout(seconds): abort the test with a TimeoutError if it runs "
+        "longer than the given number of seconds (SIGALRM-based; main "
+        "thread only, like the reference's pytest-timeout usage)",
+    )
+
+
+def _alarm_guard(item):
+    """SIGALRM guard for one test phase, honoring ``@pytest.mark.timeout``.
+
+    pytest-timeout is not vendored in this image; without this guard the
+    mark would be silently inert and one wedged e2e subprocess could hang
+    the whole suite forever. setitimer (not alarm) so fractional-second
+    timeouts work.
+    """
+    marker = item.get_closest_marker("timeout")
+    if marker is None or not hasattr(signal, "SIGALRM"):
+        yield
+        return
+    seconds = float(marker.args[0]) if marker.args else float(
+        marker.kwargs.get("seconds", 300)
+    )
+    if seconds <= 0:
+        raise ValueError(
+            f"{item.nodeid}: timeout mark must be positive, got {seconds}"
+        )
+
+    def _on_alarm(signum, frame):
+        raise TimeoutError(
+            f"{item.nodeid} exceeded its {seconds}s timeout mark"
+        )
+
+    old_handler = signal.signal(signal.SIGALRM, _on_alarm)
+    signal.setitimer(signal.ITIMER_REAL, seconds)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, old_handler)
+
+
+# Cover every phase a test can wedge in — fixture setup and teardown hang
+# just as hard as the call body (pytest-timeout covers all three too).
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_setup(item):
+    yield from _alarm_guard(item)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    yield from _alarm_guard(item)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_teardown(item):
+    yield from _alarm_guard(item)
 
 
 @pytest.fixture()
