@@ -106,6 +106,36 @@ class BrainDataStore:
             ).fetchone()
         return int(row[0] or 0)
 
+    def peak_hbm_mb(self, signature: str) -> int:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT MAX(used_hbm_mb) FROM job_metrics"
+                " WHERE signature = ?",
+                (signature,),
+            ).fetchone()
+        return int(row[0] or 0)
+
+    def cluster_defaults(self) -> tuple[int, int, int]:
+        """(median workers, p90 memory, jobs considered) over every
+        SUCCESSFUL job cluster-wide — the cold-start prior when a
+        signature has no history of its own (reference:
+        OptimizeJobPSColdCreateResource learns from cluster stats)."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT jm.workers, jm.used_memory_mb FROM job_metrics jm"
+                " JOIN (SELECT job_name, MAX(timestamp) AS ts"
+                "       FROM job_metrics WHERE status = 'succeeded'"
+                "       GROUP BY job_name) latest"
+                " ON jm.job_name = latest.job_name"
+                "  AND jm.timestamp = latest.ts",
+            ).fetchall()
+        workers = sorted(r[0] for r in rows if r[0])
+        mems = sorted(r[1] for r in rows if r[1])
+        if not workers or not mems:
+            return 0, 0, 0
+        p90_mem = mems[min(len(mems) - 1, int(0.9 * len(mems)))]
+        return workers[len(workers) // 2], int(p90_mem), len(rows)
+
     def close(self) -> None:
         with self._lock:
             self._conn.close()
@@ -150,7 +180,24 @@ class BrainService:
           algorithms) — the smallest count whose median throughput is
           within 90% of the best, plus right-sized memory (1.2x peak):
           workers past the knee add cost without speed
+        - cold_create: signature never seen -> cluster-wide prior
+          (median workers, p90 memory + 30% margin over every successful
+          job; reference OptimizeJobPSColdCreateResource)
+        - util: shrink over-provisioned jobs — when the signature's
+          all-time peak usage sits under 60% of what the job holds,
+          right-size to 1.3x peak; same for HBM on TPU hosts (reference
+          OptimizeJobPSResourceUtil)
         """
+        if req.stage == "cold_create":
+            workers, mem, jobs = self.store.cluster_defaults()
+            if not jobs:
+                return m.BrainOptimizePlan(found=False)
+            return m.BrainOptimizePlan(
+                found=True, workers=workers, memory_mb=int(1.3 * mem),
+                based_on_jobs=jobs,
+            )
+        if req.stage == "util":
+            return self._optimize_util(req)
         rows = self.store.history(req.signature)
         ok_rows = [r for r in rows if r[5] == "succeeded"]
         if not rows or (req.stage == "create" and not ok_rows):
@@ -193,6 +240,26 @@ class BrainService:
             found=True, workers=best[1] or 0, memory_mb=mem,
             based_on_jobs=len(ok_rows),
         )
+
+    def _optimize_util(self, req: m.BrainOptimizeRequest
+                       ) -> m.BrainOptimizePlan:
+        """Right-size an over-provisioned running job. Only shrinks —
+        growth is the oom/running stages' business — and never below a
+        30% headroom over the worst usage ever seen for the signature."""
+        peak_mem = self.store.peak_memory_mb(req.signature)
+        peak_hbm = self.store.peak_hbm_mb(req.signature)
+        plan = m.BrainOptimizePlan(found=False)
+        if (req.requested_memory_mb and peak_mem
+                and peak_mem < 0.6 * req.requested_memory_mb):
+            plan.found = True
+            plan.memory_mb = int(1.3 * peak_mem)
+        if (req.requested_hbm_mb and peak_hbm
+                and peak_hbm < 0.6 * req.requested_hbm_mb):
+            plan.found = True
+            plan.hbm_mb = int(1.3 * peak_hbm)
+        if plan.found:
+            plan.based_on_jobs = len(self.store.history(req.signature))
+        return plan
 
 
 class BrainClient:

@@ -336,6 +336,7 @@ class JobStatsResponse:
     uptime_s: float = 0.0
     global_step: int = 0
     steps_per_s: float = 0.0
+    goodput: float = 0.0
     nodes: list[NodeStatSample] = dataclasses.field(default_factory=list)
 
 
@@ -378,7 +379,11 @@ class BrainJobMetrics:
 class BrainOptimizeRequest:
     job_name: str = ""
     signature: str = ""
-    stage: str = "create"   # create | oom | running
+    stage: str = "create"   # create | cold_create | oom | running | util
+    # util stage: what the job currently has, so the Brain can spot
+    # over-provisioning (reference OptimizeJobPSResourceUtil)
+    requested_memory_mb: int = 0
+    requested_hbm_mb: int = 0
 
 
 @register_message
@@ -387,6 +392,7 @@ class BrainOptimizePlan:
     found: bool = False
     workers: int = 0
     memory_mb: int = 0
+    hbm_mb: int = 0         # TPU-host analog of the memory right-sizing
     based_on_jobs: int = 0
 
 

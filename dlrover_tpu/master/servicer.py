@@ -122,6 +122,7 @@ class MasterServicer:
                 uptime_s=summary["uptime_s"],
                 global_step=summary["global_step"],
                 steps_per_s=summary["steps_per_s"],
+                goodput=summary["goodput"],
                 nodes=[
                     m.NodeStatSample(
                         node_id=nid, cpu_percent=s.cpu_percent,

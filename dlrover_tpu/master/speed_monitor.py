@@ -7,6 +7,7 @@ window and flags a hang when no progress arrives within a timeout.
 
 from __future__ import annotations
 
+import statistics
 import threading
 import time
 from collections import deque
@@ -21,14 +22,42 @@ class SpeedMonitor:
         self._global_step = 0
         self._last_report_time = 0.0
         self._start_time = time.time()
+        # live goodput bookkeeping: recent intervals between ADVANCING
+        # step reports (re-reports after rollback don't advance and so
+        # earn nothing, matching utils/goodput.py's accounting)
+        self._intervals: deque[float] = deque(maxlen=512)
+        self._advanced_steps = 0
+        self._last_advance_time = 0.0
 
     def report_step(self, step: int, timestamp: float | None = None) -> None:
         ts = timestamp or time.time()
         with self._lock:
             if step > self._global_step:
+                delta = step - self._global_step
                 self._global_step = step
                 self._samples.append((ts, step))
+                self._advanced_steps += delta
+                if self._last_advance_time:
+                    self._intervals.append(
+                        (ts - self._last_advance_time) / delta
+                    )
+                self._last_advance_time = ts
             self._last_report_time = ts
+
+    def goodput(self, now: float | None = None) -> float:
+        """Live goodput estimate: median steady-state step interval ×
+        steps advanced, over the wall clock since the job started.
+        Rendezvous, restarts, rolled-back re-runs, and straggling all
+        show up as the shortfall from 1.0. Mirrors the reference's
+        headline metric (dlrover README.md:54-55) as a running value.
+        """
+        with self._lock:
+            if self._advanced_steps < 2 or not self._intervals:
+                return 0.0
+            median = statistics.median(self._intervals)
+            productive = self._advanced_steps * median
+            total = max(1e-9, (now or time.time()) - self._start_time)
+        return max(0.0, min(1.0, productive / total))
 
     @property
     def global_step(self) -> int:

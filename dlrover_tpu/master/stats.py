@@ -119,6 +119,7 @@ class JobMetricCollector:
             "uptime_s": round(time.time() - self._start, 1),
             "nodes": len(latest),
             "steps_per_s": round(self._speed.running_speed(), 3),
+            "goodput": round(self._speed.goodput(), 4),
             "global_step": self._speed.global_step,
             "used_hbm_mb": sum(s.used_hbm_mb for s in latest.values()),
             "used_memory_mb": sum(

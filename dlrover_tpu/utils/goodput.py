@@ -1,0 +1,208 @@
+"""Goodput accounting — the reference's headline metric.
+
+Reference analog: DLRover's core claim is raising large-job goodput from
+69% to >95% via elastic fault tolerance + flash checkpoints
+(dlrover README.md:54-55). Goodput here follows that definition:
+
+    goodput = productive training time / total wall-clock time
+
+where time spent in rendezvous, process respawn, recompilation,
+checkpoint restore, re-computing rolled-back steps, and straggling all
+count as lost.
+
+Two measurement paths share this module:
+
+- ``GoodputRecorder`` + ``compute_goodput``: a per-node JSONL event log
+  written by the trainer (one ``start`` per incarnation, one ``step``
+  per optimizer step) and an offline aggregator. This is what
+  ``bench.py`` and the e2e tests use — it survives process death because
+  every event is an O_APPEND line.
+- ``SpeedMonitor.goodput()`` (master/speed_monitor.py): a live estimate
+  from the steps workers already report, for JobStats observability.
+
+Accounting model: each *retained* step (one that contributed to final
+progress, i.e. was never rolled back and re-run) earns its own duration,
+capped at the p95 of steady-state step durations. Re-executed steps earn
+nothing for their discarded run; restart gaps and outlier steps (which
+hide restarts/compiles) fall out as (total - productive). The p95 cap
+keeps one-time costs that hide inside a step (first-step compile after a
+restart) out of the productive column while still counting ordinary
+step-to-step jitter as training time — the reference's definition
+charges only downtime/rollback/restart against goodput, not variance.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import statistics
+import time
+from typing import Iterable
+
+
+@dataclasses.dataclass
+class GoodputReport:
+    goodput: float          # productive / total, from first step onward
+    goodput_cold: float     # productive / total incl. first-compile window
+    total_s: float          # warm window (first step -> last event)
+    total_cold_s: float     # first start event -> last event
+    productive_s: float
+    n_steps: int            # unique steps that reached final progress
+    n_incarnations: int
+    median_step_s: float
+    cap_step_s: float       # p95 steady duration: per-step credit cap
+    redone_steps: int       # step executions discarded by rollback
+    lost_s: float           # warm-window lost time
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        for k, v in d.items():
+            if isinstance(v, float):
+                d[k] = round(v, 4)
+        return d
+
+
+class GoodputRecorder:
+    """Append-only JSONL event log; one recorder per trainer incarnation.
+
+    Events: ``{"ev": "start", "t": ..., "restart": N}`` once at
+    construction, ``{"ev": "step", "step": G, "t": ...}`` after each
+    optimizer step, ``{"ev": "done", "t": ...}`` at clean exit. Appends
+    are line-atomic (single short write with O_APPEND), so a SIGKILL
+    mid-run loses at most the final line.
+    """
+
+    def __init__(self, path: str, restart_count: int = 0):
+        self._path = path
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self._f = open(path, "a", buffering=1)
+        self._write({"ev": "start", "t": time.time(),
+                     "restart": restart_count})
+
+    def _write(self, event: dict) -> None:
+        self._f.write(json.dumps(event) + "\n")
+
+    def step(self, step: int) -> None:
+        self._write({"ev": "step", "step": int(step), "t": time.time()})
+
+    def done(self) -> None:
+        self._write({"ev": "done", "t": time.time()})
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except OSError:
+            pass
+
+
+def _parse_events(path: str) -> list[dict]:
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn final line after a SIGKILL
+            if isinstance(ev, dict) and "t" in ev:
+                events.append(ev)
+    return events
+
+
+def compute_goodput(
+    path: str | Iterable[str],
+    end_time: float | None = None,
+    start_time: float | None = None,
+) -> GoodputReport:
+    """Aggregate one node's goodput log (or pick the most complete of
+    several nodes' logs).
+
+    ``start_time``/``end_time`` widen the cold window to an external
+    observer's clock (e.g. bench.py's just-before-launch timestamp), so
+    process spawn and interpreter startup count as lost too.
+    """
+    if not isinstance(path, (str, os.PathLike)):
+        reports = [compute_goodput(p, end_time, start_time) for p in path]
+        if not reports:
+            raise ValueError("no goodput logs given")
+        return max(reports, key=lambda r: r.n_steps)
+
+    events = _parse_events(str(path))
+    if not events:
+        raise ValueError(f"no events in goodput log {path}")
+
+    # Walk incarnations in file order; O_APPEND keeps that equal to time
+    # order even across process restarts.
+    retained: dict[int, float] = {}   # step -> raw duration of kept run
+    steady: list[float] = []
+    redone = 0
+    n_incarnations = 0
+    first_start_t = None
+    first_step_t = None
+    last_t = events[0]["t"]
+    prev_t = None
+    first_of_incarnation = True
+    for ev in events:
+        last_t = ev["t"]
+        if ev["ev"] == "start":
+            n_incarnations += 1
+            if first_start_t is None:
+                first_start_t = ev["t"]
+            prev_t = ev["t"]
+            first_of_incarnation = True
+        elif ev["ev"] == "step":
+            if prev_t is None:  # torn log missing its start line
+                prev_t = ev["t"]
+            dur = max(0.0, ev["t"] - prev_t)
+            step = int(ev["step"])
+            if step in retained:
+                redone += 1
+            retained[step] = dur
+            if not first_of_incarnation:
+                steady.append(dur)
+            if first_step_t is None:
+                first_step_t = ev["t"]
+            prev_t = ev["t"]
+            first_of_incarnation = False
+
+    if not retained:
+        raise ValueError(f"no step events in goodput log {path}")
+
+    basis = steady if steady else list(retained.values())
+    median = statistics.median(basis)
+    # p95 credit cap per retained step: a genuinely-faster step earns
+    # its own (smaller) duration so productive never exceeds real
+    # compute time; ordinary jitter under the cap counts as training,
+    # while compile-bearing post-restart first steps and pathological
+    # outliers spill into the lost column.
+    cap = sorted(basis)[min(len(basis) - 1, int(0.95 * len(basis)))]
+    productive = sum(min(d, cap) for d in retained.values())
+
+    t_end = last_t if end_time is None else max(last_t, end_time)
+    t_cold = first_step_t if first_start_t is None else first_start_t
+    if start_time is not None:
+        t_cold = min(t_cold, start_time)
+    # Warm window starts one step-credit before the first step
+    # completion so the first step itself is inside the window.
+    t_warm = first_step_t - cap
+    total_cold = max(1e-9, t_end - t_cold)
+    total_warm = max(1e-9, min(total_cold, t_end - t_warm))
+    productive = min(productive, total_warm)
+
+    return GoodputReport(
+        goodput=productive / total_warm,
+        goodput_cold=productive / total_cold,
+        total_s=total_warm,
+        total_cold_s=total_cold,
+        productive_s=productive,
+        n_steps=len(retained),
+        n_incarnations=n_incarnations,
+        median_step_s=median,
+        cap_step_s=cap,
+        redone_steps=redone,
+        lost_s=total_warm - productive,
+    )

@@ -34,6 +34,12 @@ def parse_args(argv=None):
     p.add_argument("--attention", default="",
                    help="override the model's attention impl "
                         "(dense|flash|ring)")
+    p.add_argument("--remat", default="",
+                   help="per-layer remat policy (e.g. dots_no_batch, "
+                        "save_attn); empty = model default")
+    p.add_argument("--ce-chunks", type=int, default=0,
+                   help="blockwise cross-entropy chunks (0 = model "
+                        "default)")
     p.add_argument("--strategy", default="dp",
                    help="strategy preset name (parallel/strategy.py)")
     p.add_argument("--objective", default="clm", choices=["clm", "mlm"],
@@ -56,6 +62,9 @@ def parse_args(argv=None):
     p.add_argument("--sharded-ckpt", action="store_true",
                    help="per-shard snapshots + reshard-on-load (FSDP-style)")
     p.add_argument("--result-file", default="")
+    p.add_argument("--goodput-log", default="",
+                   help="append per-step goodput events (JSONL) here; "
+                        "aggregate with utils/goodput.compute_goodput")
     p.add_argument("--log-interval", type=int, default=10)
     p.add_argument("--crash-at-step", type=int, default=0,
                    help="fault injection: hard-exit at this step "
@@ -93,6 +102,11 @@ def main(argv=None) -> int:
     cfg = tfm.CONFIGS[args.model]
     if args.attention:
         cfg = dataclasses.replace(cfg, attention=args.attention)
+    if args.remat:
+        cfg = dataclasses.replace(cfg, remat_scan=True,
+                                  remat_policy=args.remat)
+    if args.ce_chunks:
+        cfg = dataclasses.replace(cfg, ce_chunks=args.ce_chunks)
     seq = args.seq or cfg.max_seq_len
 
     if args.objective == "mlm":
@@ -248,6 +262,12 @@ def main(argv=None) -> int:
                 engine.save_to_memory(step, st)
 
     losses: list[float] = []
+    goodput = None
+    if args.goodput_log and ctx.node_rank == 0:
+        from dlrover_tpu.utils.goodput import GoodputRecorder
+
+        goodput = GoodputRecorder(args.goodput_log,
+                                  restart_count=ctx.restart_count)
 
     def _should_crash() -> bool:
         if args.crash_once_file:
@@ -262,6 +282,8 @@ def main(argv=None) -> int:
         return args.crash_always or ctx.restart_count == 0
 
     def on_step(step: int, metrics: dict) -> None:
+        if goodput is not None:
+            goodput.step(step)
         if args.crash_at_step and step == args.crash_at_step \
                 and _should_crash():
             print(f"[trainer] injected crash at step {step} "
@@ -284,6 +306,9 @@ def main(argv=None) -> int:
     )
     loader.close()
     final_step = int(state.step)
+    if goodput is not None:
+        goodput.done()
+        goodput.close()
     engine.save_to_storage(final_step, state)
     engine.wait_for_persist(final_step, timeout=120)
     engine.close()

@@ -181,3 +181,101 @@ class TestOptimizerBrainIntegration:
         )
         plan = opt.oom_recovery_plan(0)
         assert plan.memory_mb["0"] == 100000  # brain's 2x peak wins
+
+
+def _hbm_job(name, hbm, mem=4000, status="running", sig="tpu-sig"):
+    return BrainJobMetrics(
+        job_name=name, signature=sig, workers=4, used_memory_mb=mem,
+        used_hbm_mb=hbm, steps_per_s=1.0, status=status,
+    )
+
+
+class TestColdCreate:
+    """Reference: OptimizeJobPSColdCreateResource — a never-seen
+    signature gets the cluster-wide prior, not a not-found."""
+
+    def test_empty_store_not_found(self, brain):
+        _, client = brain
+        assert not client.optimize("j", "new-sig",
+                                   stage="cold_create").found
+
+    def test_cluster_prior_from_other_signatures(self, brain):
+        _, client = brain
+        client.report(_job("a", workers=4, mem=8000, speed=2.0,
+                           sig="sig-a"))
+        client.report(_job("b", workers=8, mem=10000, speed=3.0,
+                           sig="sig-b"))
+        client.report(_job("c", workers=16, mem=20000, speed=3.0,
+                           sig="sig-c"))
+        plan = client.optimize("fresh", "never-seen-sig",
+                               stage="cold_create")
+        assert plan.found
+        assert plan.workers == 8                    # cluster median
+        assert plan.memory_mb == int(1.3 * 20000)   # p90 + 30% margin
+        assert plan.based_on_jobs == 3
+
+    def test_failed_jobs_do_not_shape_the_prior(self, brain):
+        _, client = brain
+        client.report(_job("a", workers=4, mem=8000, speed=2.0,
+                           sig="sig-a"))
+        client.report(_job("bad", workers=64, mem=90000, speed=0.1,
+                           status="failed", sig="sig-b"))
+        plan = client.optimize("fresh", "never-seen",
+                               stage="cold_create")
+        assert plan.found
+        assert plan.workers == 4
+        assert plan.memory_mb == int(1.3 * 8000)
+
+
+class TestResourceUtil:
+    """Reference: OptimizeJobPSResourceUtil — shrink over-provisioned
+    jobs; TPU twist: HBM right-sizing rides alongside host memory."""
+
+    CASES = [
+        # (peak_used, requested, expect_found, expect_mb)
+        pytest.param(4000, 16000, True, int(1.3 * 4000),
+                     id="heavily-overprovisioned-shrinks"),
+        pytest.param(9900, 16000, False, 0,
+                     id="above-60pct-keeps"),
+        pytest.param(0, 16000, False, 0, id="no-history-keeps"),
+        pytest.param(4000, 0, False, 0, id="no-request-info-keeps"),
+    ]
+
+    @pytest.mark.parametrize("peak,requested,found,mb", CASES)
+    def test_memory_table(self, brain, peak, requested, found, mb):
+        _, client = brain
+        if peak:
+            client.report(_hbm_job("a", hbm=0, mem=peak, sig="s"))
+        from dlrover_tpu.common.messages import BrainOptimizeRequest
+
+        plan = client._client.call(BrainOptimizeRequest(
+            job_name="a", signature="s", stage="util",
+            requested_memory_mb=requested,
+        ))
+        assert plan.found is found
+        assert plan.memory_mb == mb
+
+    def test_hbm_rightsizing(self, brain):
+        _, client = brain
+        client.report(_hbm_job("a", hbm=3000, sig="s"))
+        client.report(_hbm_job("a", hbm=5000, sig="s"))
+        from dlrover_tpu.common.messages import BrainOptimizeRequest
+
+        plan = client._client.call(BrainOptimizeRequest(
+            job_name="a", signature="s", stage="util",
+            requested_hbm_mb=16000,
+        ))
+        assert plan.found
+        assert plan.hbm_mb == int(1.3 * 5000)   # all-time peak, not last
+        assert plan.memory_mb == 0              # memory not requested
+
+    def test_util_never_grows(self, brain):
+        _, client = brain
+        client.report(_hbm_job("a", hbm=15000, mem=15000, sig="s"))
+        from dlrover_tpu.common.messages import BrainOptimizeRequest
+
+        plan = client._client.call(BrainOptimizeRequest(
+            job_name="a", signature="s", stage="util",
+            requested_memory_mb=16000, requested_hbm_mb=16000,
+        ))
+        assert not plan.found

@@ -273,8 +273,10 @@ def test_two_nodes_grow_to_three_on_join(tmp_path):
 
 @pytest.mark.timeout(500)
 def test_two_node_kill_one_trainer_recovers(tmp_path):
+    goodput_log = str(tmp_path / "goodput.jsonl")
     launchers, outs, killed = _run_two_nodes(
-        tmp_path, ["--max-steps", "30", "--ckpt-interval", "5"],
+        tmp_path, ["--max-steps", "30", "--ckpt-interval", "5",
+                   "--goodput-log", goodput_log],
         kill_after_ckpt=True,
     )
     assert killed, "never saw a checkpoint to kill after"
@@ -286,3 +288,11 @@ def test_two_node_kill_one_trainer_recovers(tmp_path):
     assert result["resumed_from"] > 0
     joint = "\n".join(outs)
     assert "resumed from step" in joint
+    # goodput accounting over the CPU-mesh multinode failure scenario
+    # (the reference's headline metric, measured for real in bench.py)
+    from dlrover_tpu.utils.goodput import compute_goodput
+
+    r = compute_goodput(goodput_log)
+    assert r.n_steps == 30
+    assert r.n_incarnations >= 2
+    assert 0.0 < r.goodput <= 1.0
