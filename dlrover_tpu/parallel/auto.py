@@ -60,11 +60,16 @@ def auto_strategy(
     devices: Sequence | None = None,
     candidates: Sequence[Strategy] | None = None,
     hbm_capacity_bytes: int | None = None,
+    objective: str = "fastest",
+    hw=None,
 ) -> tuple[Strategy, list]:
-    """Pick the first candidate that compiles and fits memory.
+    """Pick the best candidate that compiles and fits memory.
 
-    Returns (strategy, dry-run reports). ``loss_fn_for`` lets the caller
-    bind attention/constraint choices per strategy (make_loss_fn).
+    ``objective="fastest"`` (default) ranks fitting candidates by the
+    roofline step-time estimate (parallel/cost_model.py); "first_fit"
+    keeps the preference-order behavior. Returns (strategy, dry-run
+    reports). ``loss_fn_for`` lets the caller bind attention/constraint
+    choices per strategy (make_loss_fn).
     """
     from dlrover_tpu.trainer.train_step import compile_train
 
@@ -106,6 +111,7 @@ def auto_strategy(
     best, reports = pick_strategy(
         build_step, list(candidates),
         hbm_capacity_bytes=hbm_capacity_bytes,
+        objective=objective, hw=hw,
     )
     logger.info("auto strategy selected: %s", best.name)
     return best, reports

@@ -28,6 +28,9 @@ class DryRunReport:
     argument_bytes: int = 0
     output_bytes: int = 0
     compile_seconds: float = 0.0
+    bytes_accessed: float = 0.0  # cost-analysis HBM traffic (per device)
+    comm_bytes: float = 0.0      # collective wire volume (per device)
+    est_step_s: float = 0.0      # roofline estimate (parallel/cost_model)
 
     def fits(self, hbm_capacity_bytes: int) -> bool:
         return self.ok and (
@@ -38,12 +41,15 @@ class DryRunReport:
 def dry_run(
     build_step: Callable[[Any], tuple[Callable, tuple]],
     strategy: Any,
+    hw=None,
 ) -> DryRunReport:
     """Compile a strategy's train step and harvest cost/memory analyses.
 
     ``build_step(strategy) -> (jitted_fn, abstract_args)`` so the caller
     controls model/optimizer wiring; abstract args come from
     ``jax.eval_shape``-style ShapeDtypeStructs with shardings attached.
+    ``hw`` (cost_model.HardwareSpec) parameterizes the roofline step-time
+    estimate; default = live backend.
     """
     import time
 
@@ -66,7 +72,23 @@ def dry_run(
         cost = compiled.cost_analysis()
         if cost:
             report.flops = float(cost.get("flops", 0.0))
+            report.bytes_accessed = float(cost.get("bytes accessed", 0.0))
     except Exception:  # noqa: BLE001 - backends may not implement this
+        pass
+    try:
+        # throughput ranking: roofline over FLOPs + HBM traffic + the
+        # collectives the partitioner inserted (read from the HLO itself)
+        from dlrover_tpu.parallel.cost_model import estimate_step_time
+
+        est = estimate_step_time(
+            flops=report.flops,
+            bytes_accessed=report.bytes_accessed,
+            hlo_text=compiled.as_text(),
+            hw=hw,
+        )
+        report.est_step_s = est.est_step_s
+        report.comm_bytes = est.comm_bytes
+    except Exception:  # noqa: BLE001 - estimate is advisory
         pass
     try:
         mem = compiled.memory_analysis()
@@ -95,29 +117,45 @@ def pick_strategy(
     build_step: Callable[[Any], tuple[Callable, tuple]],
     candidates: Sequence[Any],
     hbm_capacity_bytes: int = 0,
+    objective: str = "fastest",
+    hw=None,
 ) -> tuple[Any, list[DryRunReport]]:
     """Evaluate candidates; return (best, all reports).
 
-    Best = the first candidate (caller's preference order) that compiles and
-    fits memory; reports let callers log the full comparison.
+    ``objective="fastest"``: among candidates that compile and fit
+    memory, pick the lowest roofline step-time estimate (ties and
+    missing estimates fall back to the caller's preference order).
+    ``objective="first_fit"``: the r02 behavior — first candidate that
+    compiles and fits. Reference analog: atorch's acceleration engine
+    scores strategies by throughput, not just feasibility
+    (atorch/auto/engine/acceleration_engine.py:13).
     """
+    if objective not in ("fastest", "first_fit"):
+        raise ValueError(f"unknown objective {objective!r}")
     reports = []
-    best = None
+    fitting: list[tuple[Any, DryRunReport]] = []
     for s in candidates:
-        r = dry_run(build_step, s)
+        r = dry_run(build_step, s, hw=hw)
         reports.append(r)
         logger.info(
-            "dry-run %s: ok=%s hbm=%.2fGB flops=%.2e (%.1fs)",
+            "dry-run %s: ok=%s hbm=%.2fGB flops=%.2e comm=%.2fMB "
+            "est=%.2fms (%.1fs)",
             r.strategy_name, r.ok, r.hbm_bytes / 2**30, r.flops,
-            r.compile_seconds,
+            r.comm_bytes / 2**20, r.est_step_s * 1e3, r.compile_seconds,
         )
-        if best is None and (
-            r.fits(hbm_capacity_bytes) if hbm_capacity_bytes else r.ok
-        ):
-            best = s
-    if best is None and candidates:
+        # every candidate is dry-run (reports must cover them all for
+        # comparison logging) — only the pick rule differs by objective
+        if r.fits(hbm_capacity_bytes) if hbm_capacity_bytes else r.ok:
+            fitting.append((s, r))
+    if not fitting:
         raise RuntimeError(
             "no candidate strategy compiled and fit memory: "
-            + "; ".join(f"{r.strategy_name}: {r.error or 'OOM'}" for r in reports)
+            + "; ".join(f"{r.strategy_name}: {r.error or 'OOM'}"
+                        for r in reports)
         )
+    if objective == "fastest" and all(r.est_step_s > 0 for _, r in fitting):
+        # stable min: preference order wins ties
+        best = min(fitting, key=lambda sr: sr[1].est_step_s)[0]
+    else:
+        best = fitting[0][0]
     return best, reports

@@ -1,0 +1,142 @@
+"""Throughput-aware strategy selection (parallel/cost_model.py).
+
+Round-2 verdict Weak #5 / Next #7: strategy auto-selection was
+first-fit-on-memory and never compared speed. These tests pin the HLO
+collective parser, the roofline math, and the headline behavior: on a
+params-dominated (heads-heavy) config, FSDPxTP moves less wire volume
+than pure FSDP and ``auto_strategy(objective="fastest")`` picks it.
+Reference analog: atorch/auto/engine/acceleration_engine.py:13 (BO over
+dry-run throughput), atorch/auto/opt_lib/shard_planners/ (MIP planner).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.models import transformer as T
+from dlrover_tpu.parallel import strategy as S
+from dlrover_tpu.parallel.cost_model import (
+    HardwareSpec,
+    collective_bytes,
+    estimate_step_time,
+)
+
+HLO = """
+ENTRY %main {
+  %ag = f32[1024,64]{1,0} all-gather(%p0), replica_groups={{0,1,2,3}}
+  %ar = bf16[512]{0} all-reduce(%g0), to_apply=%add
+  %rs = f32[256,8]{1,0} reduce-scatter(%g1), dimensions={0}
+  %cp = f32[128]{0} collective-permute(%x), source_target_pairs={{0,1}}
+  %ags = (f32[2,4]{1,0}, f32[16,4]{1,0}) all-gather-start(%p1)
+  %agd = f32[16,4]{1,0} all-gather-done(%ags)
+  %other = f32[9999]{0} add(%a, %b)
+}
+"""
+
+
+class TestCollectiveParser:
+    def test_parses_each_kind_with_wire_factors(self):
+        by = collective_bytes(HLO)
+        # all-gather: plain 1024*64*4 + async-start larger member 16*4*4
+        assert by["all-gather"] == 1024 * 64 * 4 + 16 * 4 * 4
+        assert by["all-reduce"] == 512 * 2 * 2.0      # bf16, 2x ring factor
+        assert by["reduce-scatter"] == 256 * 8 * 4
+        assert by["collective-permute"] == 128 * 4
+        # non-collective ops contribute nothing
+        assert set(by) == {"all-gather", "all-reduce", "reduce-scatter",
+                           "collective-permute"}
+
+    def test_empty_module(self):
+        assert collective_bytes("ENTRY %m { %r = f32[4]{0} add(%a,%b) }") == {}
+
+
+class TestRoofline:
+    def test_compute_bound(self):
+        hw = HardwareSpec(peak_flops=1e12, hbm_bps=1e12, ici_bps=1e12,
+                          mxu_efficiency=1.0)
+        est = estimate_step_time(flops=2e12, bytes_accessed=1e10,
+                                 hlo_text="", hw=hw)
+        assert est.est_step_s == pytest.approx(2.0)
+        assert est.compute_s == pytest.approx(2.0)
+        assert est.ici_s == 0.0
+
+    def test_memory_bound_plus_comm(self):
+        hw = HardwareSpec(peak_flops=1e15, hbm_bps=1e9, ici_bps=1e9,
+                          mxu_efficiency=1.0)
+        hlo = "%ar = f32[250000000]{0} all-reduce(%g)"  # 1 GB, 2x wire
+        est = estimate_step_time(flops=1e9, bytes_accessed=2e9,
+                                 hlo_text=hlo, hw=hw)
+        assert est.hbm_s == pytest.approx(2.0)
+        assert est.ici_s == pytest.approx(2.0)
+        assert est.est_step_s == pytest.approx(4.0)
+        assert est.comm_bytes == pytest.approx(2e9)
+
+
+def _auto(cfg, batch, candidates, objective="fastest"):
+    import optax
+
+    from dlrover_tpu.parallel.auto import auto_strategy
+
+    example_batch = {
+        "tokens": np.zeros((1, batch, cfg.max_seq_len + 1), np.int32)
+    }
+    return auto_strategy(
+        loss_fn_for=lambda s, m: T.make_loss_fn(cfg, s, m),
+        init_params_fn=lambda rng: T.init_params(cfg, rng),
+        logical_params=T.logical_axes(cfg),
+        optimizer=optax.adamw(1e-3),
+        example_batch=example_batch,
+        hbm_capacity_bytes=0,
+        candidates=candidates,
+        objective=objective,
+    )
+
+
+HEAVY = dataclasses.replace(
+    T.CONFIGS["tiny"], d_model=256, n_heads=8, n_kv_heads=8,
+    d_ff=2048, vocab_size=4096, n_layers=4, max_seq_len=32,
+)
+
+
+class TestThroughputSelection:
+    def test_fsdp_tp_beats_fsdp_on_params_dominated_config(self):
+        """Heads-heavy, params >> activations: pure FSDP all-gathers the
+        full parameter set over an 8-way axis every step; FSDPxTP keeps
+        half the params TP-sharded and gathers over a 4-way axis, so its
+        wire volume — and roofline estimate — is lower. The fastest
+        objective must therefore pick fsdp_tp even though fsdp is listed
+        first."""
+        best, reports = _auto(
+            HEAVY, batch=8, candidates=[S.fsdp(), S.fsdp_tp(2)],
+        )
+        by_name = {r.strategy_name: r for r in reports}
+        assert by_name["fsdp"].ok and by_name["fsdp_tp"].ok
+        assert by_name["fsdp"].comm_bytes > by_name["fsdp_tp"].comm_bytes
+        assert by_name["fsdp"].est_step_s > by_name["fsdp_tp"].est_step_s
+        assert best.name == "fsdp_tp"
+
+    def test_first_fit_keeps_preference_order(self):
+        best, _ = _auto(
+            HEAVY, batch=8, candidates=[S.fsdp(), S.fsdp_tp(2)],
+            objective="first_fit",
+        )
+        assert best.name == "fsdp"
+
+    def test_dry_run_populates_estimates(self):
+        _, reports = _auto(
+            T.CONFIGS["tiny"], batch=8, candidates=[S.dp()],
+        )
+        (r,) = reports
+        assert r.est_step_s > 0
+        assert r.flops > 0
+
+    def test_unknown_objective_raises(self):
+        from dlrover_tpu.parallel.dry_run import pick_strategy
+
+        with pytest.raises(ValueError, match="objective"):
+            pick_strategy(lambda s: None, [S.dp()], objective="bogus")

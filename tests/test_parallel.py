@@ -221,7 +221,8 @@ class TestDryRun:
             batch = {"tokens": jax.ShapeDtypeStruct((1, 8, 33), jnp.int32)}
             return ct.step, (state_shape, batch)
 
-        best, reports = pick_strategy(build, [S.fsdp(8), S.dp()])
+        best, reports = pick_strategy(build, [S.fsdp(8), S.dp()],
+                                      objective="first_fit")
         assert best.name == "fsdp"
         assert all(r.ok for r in reports)
 
