@@ -72,6 +72,23 @@ def _load_lib():
             ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
             ctypes.c_int64, ctypes.c_float, ctypes.c_float,
         ]
+        lib.kv_apply_adagrad.restype = ctypes.c_int
+        lib.kv_apply_adagrad.argtypes = [
+            ctypes.c_void_p, _i64p, _f32p, ctypes.c_int64,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+        ]
+        lib.kv_apply_ftrl.restype = ctypes.c_int
+        lib.kv_apply_ftrl.argtypes = [
+            ctypes.c_void_p, _i64p, _f32p, ctypes.c_int64,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float,
+        ]
+        lib.kv_apply_radam.restype = ctypes.c_int
+        lib.kv_apply_radam.argtypes = [
+            ctypes.c_void_p, _i64p, _f32p, ctypes.c_int64,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_int64, ctypes.c_float,
+        ]
         lib.kv_export.restype = ctypes.c_int64
         lib.kv_export.argtypes = [
             ctypes.c_void_p, ctypes.c_uint32,
@@ -160,14 +177,7 @@ class KvEmbeddingTable:
         Duplicate ids apply sequentially. ``group_lasso`` adds the
         proximal row-shrinkage step of the reference's GroupAdam.
         """
-        flat = np.ascontiguousarray(ids, np.int64).reshape(-1)
-        g = np.ascontiguousarray(grads, np.float32).reshape(-1, self.dim)
-        if g.shape[0] != flat.size:
-            raise ValueError(
-                f"{flat.size} ids but {g.shape[0]} gradient rows"
-            )
-        if self.num_slots < 2:
-            raise ValueError("apply_adam needs num_slots >= 2 (m, v)")
+        flat, g = self._check_grads(ids, grads, 2, "apply_adam")
         if step is None:
             self._step += 1
             step = self._step
@@ -175,6 +185,86 @@ class KvEmbeddingTable:
             self._handle, flat, g, flat.size,
             lr, beta1, beta2, eps, step, l2, group_lasso,
         )
+
+    def _check_grads(self, ids: np.ndarray, grads: np.ndarray,
+                     need_slots: int, what: str
+                     ) -> tuple[np.ndarray, np.ndarray]:
+        flat = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        g = np.ascontiguousarray(grads, np.float32).reshape(-1, self.dim)
+        if g.shape[0] != flat.size:
+            raise ValueError(
+                f"{flat.size} ids but {g.shape[0]} gradient rows"
+            )
+        if self.num_slots < need_slots:
+            raise ValueError(
+                f"{what} needs num_slots >= {need_slots}, "
+                f"table has {self.num_slots}"
+            )
+        return flat, g
+
+    def apply_adagrad(self, ids: np.ndarray, grads: np.ndarray,
+                      lr: float = 0.1, eps: float = 1e-8,
+                      l2: float = 0.0, group_lasso: float = 0.0) -> None:
+        """Sparse (Group)Adagrad: slot 0 is the squared-grad accumulator;
+        ``group_lasso`` adds the reference GroupAdagrad's proximal row
+        shrinkage (tfplus training_ops.cc Adagrad family)."""
+        flat, g = self._check_grads(ids, grads, 1, "apply_adagrad")
+        rc = self._lib.kv_apply_adagrad(
+            self._handle, flat, g, flat.size, lr, eps, l2, group_lasso,
+        )
+        if rc != 0:
+            raise RuntimeError(f"kv_apply_adagrad failed ({rc})")
+
+    def apply_ftrl(self, ids: np.ndarray, grads: np.ndarray,
+                   lr: float = 0.1, l1: float = 0.0, l2: float = 0.0,
+                   beta: float = 1.0, group_lasso: float = 0.0) -> None:
+        """Sparse (Group)FTRL-proximal: slots are (z, n). L1 drives
+        per-coordinate sparsity; ``group_lasso`` prunes whole rows
+        (reference SparseGroupFtrl)."""
+        flat, g = self._check_grads(ids, grads, 2, "apply_ftrl")
+        rc = self._lib.kv_apply_ftrl(
+            self._handle, flat, g, flat.size, lr, l1, l2, beta,
+            group_lasso,
+        )
+        if rc != 0:
+            raise RuntimeError(f"kv_apply_ftrl failed ({rc})")
+
+    def apply_radam(self, ids: np.ndarray, grads: np.ndarray,
+                    lr: float = 1e-3, beta1: float = 0.9,
+                    beta2: float = 0.999, eps: float = 1e-8,
+                    l2: float = 0.0, step: int | None = None) -> None:
+        """Sparse Rectified Adam (variance-rectified warmup-free Adam;
+        reference tfplus rectified_adam.py). Slots are (m, v)."""
+        flat, g = self._check_grads(ids, grads, 2, "apply_radam")
+        if step is None:
+            self._step += 1
+            step = self._step
+        rc = self._lib.kv_apply_radam(
+            self._handle, flat, g, flat.size, lr, beta1, beta2, eps,
+            step, l2,
+        )
+        if rc != 0:
+            raise RuntimeError(f"kv_apply_radam failed ({rc})")
+
+    def apply(self, optimizer: str, ids: np.ndarray, grads: np.ndarray,
+              **kwargs) -> None:
+        """Name-dispatched sparse update — what config-driven trainers
+        (the recsys example) call. Optimizers: adam, group_adam,
+        adagrad, group_adagrad, ftrl, group_ftrl, radam."""
+        known = {"adam", "group_adam", "adagrad", "group_adagrad",
+                 "ftrl", "group_ftrl", "radam"}
+        if optimizer not in known:
+            raise ValueError(f"unknown sparse optimizer {optimizer!r}")
+        base = optimizer.removeprefix("group_")
+        if optimizer.startswith("group_") and "group_lasso" not in kwargs:
+            kwargs["group_lasso"] = 1e-3
+        fn = {
+            "adam": self.apply_adam,
+            "adagrad": self.apply_adagrad,
+            "ftrl": self.apply_ftrl,
+            "radam": self.apply_radam,
+        }[base]
+        fn(ids, grads, **kwargs)
 
     def remove(self, ids: np.ndarray) -> int:
         flat = np.ascontiguousarray(ids, np.int64).reshape(-1)

@@ -38,6 +38,13 @@ def parse_args(argv=None):
     p.add_argument("--id-space", type=int, default=1_000_000)
     p.add_argument("--lr", type=float, default=1e-2)
     p.add_argument("--group-lasso", type=float, default=0.0)
+    p.add_argument("--sparse-optimizer", default="adam",
+                   choices=["adam", "group_adam", "adagrad",
+                            "group_adagrad", "ftrl", "group_ftrl",
+                            "radam"],
+                   help="host-side sparse optimizer for the embedding "
+                        "table (reference: tfplus training_ops.cc "
+                        "family)")
     p.add_argument("--ckpt-dir", default="")
     p.add_argument("--result-file", default="")
     p.add_argument("--log-interval", type=int, default=50)
@@ -138,10 +145,11 @@ def main(argv=None) -> int:
         params, opt_state, loss, emb_grads = train_step(
             params, opt_state, jnp.asarray(emb), jnp.asarray(labels)
         )
-        table.apply_adam(                                # host sparse update
-            ids, np.asarray(emb_grads), lr=args.lr,
-            group_lasso=args.group_lasso,
-        )
+        kwargs = {"lr": args.lr}                         # host sparse update
+        if args.group_lasso and args.sparse_optimizer != "radam":
+            kwargs["group_lasso"] = args.group_lasso
+        table.apply(args.sparse_optimizer, ids, np.asarray(emb_grads),
+                    **kwargs)
         if step % args.log_interval == 0:
             losses.append(float(loss))
             print(f"[recsys] step {step} loss {losses[-1]:.4f} "

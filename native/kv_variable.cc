@@ -172,6 +172,45 @@ struct KvTable {
   }
 };
 
+// Shared per-row scaffold for every sparse optimizer: find-or-insert,
+// fault in spilled rows, keep freq/dirty semantics identical across the
+// family (reference: the per-optimizer kernels in
+// tfplus/kv_variable/kernels/training_ops.cc repeat this dance ~7x).
+// ``update`` runs under the shard lock with (w_row, grad_row).
+template <typename F>
+void apply_sparse_update(KvTable* t, const int64_t* keys, const float* grads,
+                         int64_t n, F&& update) {
+  const int dim = t->dim;
+  for (int64_t i = 0; i < n; ++i) {
+    Shard& s = t->shard_for(keys[i]);
+    std::lock_guard<std::mutex> lock(s.mu);
+    auto it = s.index.find(keys[i]);
+    Row* r = it != s.index.end() ? &it->second : &t->insert(s, keys[i]);
+    if (r->on_disk() && !t->fault_in(s, *r)) continue;  // I/O error: skip
+    // a row that receives updates is live: export's frequency filtering
+    // must never drop trained weights just because no lookup preceded
+    if (r->freq == 0) r->freq = 1;
+    r->dirty = 1;
+    update(t->row_ptr(s, *r), grads + i * dim);
+  }
+}
+
+// Proximal group-lasso row shrinkage (the "Group" in GroupAdam /
+// GroupAdagrad, reference kv_variable/python/training/group_adam.py:272):
+// shrink the row's L2 norm by ``thresh``, zeroing rows that fall below —
+// feature pruning for stale/noisy ids.
+inline void group_lasso_prox(float* w, int dim, float thresh) {
+  float norm = 0.0f;
+  for (int d = 0; d < dim; ++d) norm += w[d] * w[d];
+  norm = std::sqrt(norm);
+  if (norm <= thresh) {
+    std::memset(w, 0, sizeof(float) * dim);
+  } else {
+    float scale = 1.0f - thresh / norm;
+    for (int d = 0; d < dim; ++d) w[d] *= scale;
+  }
+}
+
 }  // namespace
 
 extern "C" {
@@ -364,20 +403,9 @@ void kv_apply_adam(void* handle, const int64_t* keys, const float* grads,
   const int dim = t->dim;
   const float bc1 = 1.0f - std::pow(beta1, static_cast<float>(step));
   const float bc2 = 1.0f - std::pow(beta2, static_cast<float>(step));
-  for (int64_t i = 0; i < n; ++i) {
-    Shard& s = t->shard_for(keys[i]);
-    std::lock_guard<std::mutex> lock(s.mu);
-    auto it = s.index.find(keys[i]);
-    Row* r = it != s.index.end() ? &it->second : &t->insert(s, keys[i]);
-    if (r->on_disk() && !t->fault_in(s, *r)) continue;  // I/O error: skip
-    // a row that receives updates is live: export's frequency filtering
-    // must never drop trained weights just because no lookup preceded
-    if (r->freq == 0) r->freq = 1;
-    r->dirty = 1;
-    float* w = t->row_ptr(s, *r);
+  apply_sparse_update(t, keys, grads, n, [&](float* w, const float* g) {
     float* m = w + dim;
     float* v = w + 2 * dim;
-    const float* g = grads + i * dim;
     for (int d = 0; d < dim; ++d) {
       float gd = g[d] + l2 * w[d];
       m[d] = beta1 * m[d] + (1.0f - beta1) * gd;
@@ -386,21 +414,106 @@ void kv_apply_adam(void* handle, const int64_t* keys, const float* grads,
       float vhat = v[d] / bc2;
       w[d] -= lr * mhat / (std::sqrt(vhat) + eps);
     }
-    if (group_lasso > 0.0f) {
-      // proximal group-lasso step on the whole row: shrink its norm,
-      // zeroing rows whose norm falls below lr*lambda (feature pruning)
-      float norm = 0.0f;
-      for (int d = 0; d < dim; ++d) norm += w[d] * w[d];
-      norm = std::sqrt(norm);
-      float thresh = lr * group_lasso;
-      if (norm <= thresh) {
-        std::memset(w, 0, sizeof(float) * dim);
+    if (group_lasso > 0.0f) group_lasso_prox(w, dim, lr * group_lasso);
+  });
+}
+
+// Sparse (Group)Adagrad: per-coordinate accumulator in slot 0, optional
+// L2 and proximal group-lasso row shrinkage. Reference:
+// tfplus/kv_variable/kernels/training_ops.cc KvResourceSparseApplyAdagrad
+// + python/training/group_adagrad.py. Requires num_slots >= 1; returns
+// -1 otherwise, 0 on success.
+int kv_apply_adagrad(void* handle, const int64_t* keys, const float* grads,
+                     int64_t n, float lr, float eps, float l2,
+                     float group_lasso) {
+  auto* t = static_cast<KvTable*>(handle);
+  if (t->num_slots < 1) return -1;
+  const int dim = t->dim;
+  apply_sparse_update(t, keys, grads, n, [&](float* w, const float* g) {
+    float* a = w + dim;
+    for (int d = 0; d < dim; ++d) {
+      float gd = g[d] + l2 * w[d];
+      a[d] += gd * gd;
+      w[d] -= lr * gd / (std::sqrt(a[d]) + eps);
+    }
+    if (group_lasso > 0.0f) group_lasso_prox(w, dim, lr * group_lasso);
+  });
+  return 0;
+}
+
+// Sparse (Group)FTRL-proximal: slots are z (slot 0) and the squared-grad
+// accumulator nn (slot 1). Per-coordinate closed form with L1/L2, then
+// the row-level group-lasso prox — the sparse-group penalty of the
+// reference's SparseGroupFtrl (tfplus training_ops.cc
+// KvResourceSparseApplyFtrl family). Requires num_slots >= 2.
+int kv_apply_ftrl(void* handle, const int64_t* keys, const float* grads,
+                  int64_t n, float lr, float l1, float l2, float beta,
+                  float group_lasso) {
+  auto* t = static_cast<KvTable*>(handle);
+  if (t->num_slots < 2) return -1;
+  const int dim = t->dim;
+  apply_sparse_update(t, keys, grads, n, [&](float* w, const float* g) {
+    float* z = w + dim;
+    float* nn = w + 2 * dim;
+    for (int d = 0; d < dim; ++d) {
+      float gd = g[d];
+      float n_new = nn[d] + gd * gd;
+      float sigma = (std::sqrt(n_new) - std::sqrt(nn[d])) / lr;
+      z[d] += gd - sigma * w[d];
+      nn[d] = n_new;
+      if (std::fabs(z[d]) <= l1) {
+        w[d] = 0.0f;
       } else {
-        float scale = 1.0f - thresh / norm;
-        for (int d = 0; d < dim; ++d) w[d] *= scale;
+        float sgn = z[d] > 0.0f ? 1.0f : -1.0f;
+        w[d] = -(z[d] - sgn * l1) /
+               ((beta + std::sqrt(n_new)) / lr + 2.0f * l2);
       }
     }
+    if (group_lasso > 0.0f) group_lasso_prox(w, dim, lr * group_lasso);
+  });
+  return 0;
+}
+
+// Sparse Rectified Adam: Adam whose adaptive step is gated by the
+// variance-rectification term (warmup-free adaptivity; reference:
+// tfplus kv_variable/python/training/rectified_adam.py over its
+// training_ops.cc kernel). Slots: m, v. Requires num_slots >= 2.
+int kv_apply_radam(void* handle, const int64_t* keys, const float* grads,
+                   int64_t n, float lr, float beta1, float beta2, float eps,
+                   int64_t step, float l2) {
+  auto* t = static_cast<KvTable*>(handle);
+  if (t->num_slots < 2) return -1;
+  const int dim = t->dim;
+  const float st = static_cast<float>(step);
+  const float b2t = std::pow(beta2, st);
+  const float bc1 = 1.0f - std::pow(beta1, st);
+  const float bc2 = 1.0f - b2t;
+  const float rho_inf = 2.0f / (1.0f - beta2) - 1.0f;
+  const float rho_t = rho_inf - 2.0f * st * b2t / bc2;
+  float rect = 0.0f;
+  const bool rectify = rho_t > 4.0f;
+  if (rectify) {
+    rect = std::sqrt(((rho_t - 4.0f) * (rho_t - 2.0f) * rho_inf) /
+                     ((rho_inf - 4.0f) * (rho_inf - 2.0f) * rho_t));
   }
+  apply_sparse_update(t, keys, grads, n, [&](float* w, const float* g) {
+    float* m = w + dim;
+    float* v = w + 2 * dim;
+    for (int d = 0; d < dim; ++d) {
+      float gd = g[d] + l2 * w[d];
+      m[d] = beta1 * m[d] + (1.0f - beta1) * gd;
+      v[d] = beta2 * v[d] + (1.0f - beta2) * gd * gd;
+      float mhat = m[d] / bc1;
+      if (rectify) {
+        float vhat = std::sqrt(v[d] / bc2);
+        w[d] -= lr * rect * mhat / (vhat + eps);
+      } else {
+        // variance not yet tractable: un-adapted SGD-with-momentum step
+        w[d] -= lr * mhat;
+      }
+    }
+  });
+  return 0;
 }
 
 // Export keys with freq >= min_freq. Two-phase: call with keys_out=null to

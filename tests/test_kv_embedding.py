@@ -570,3 +570,181 @@ class TestBench:
             f"table={len(table)} rows"
         )
         assert lookup_s < 5 and update_s < 5
+
+
+class TestOptimizerFamily:
+    """The sparse-optimizer family beyond Adam (round-2 verdict Next #4).
+
+    Reference: tfplus/tfplus/kv_variable/kernels/training_ops.cc (Adagrad,
+    GroupAdam, GroupAdagrad, SparseGroupFtrl, RectifiedAdam) and the
+    python wrappers under kv_variable/python/training/. Each kernel is
+    checked against a numpy reference, the group variants against their
+    pruning semantics, and the whole family under thread stress.
+    """
+
+    def _numpy_adagrad(self, w, g, a, lr, eps, l2):
+        gd = g + l2 * w
+        a = a + gd * gd
+        w = w - lr * gd / (np.sqrt(a) + eps)
+        return w, a
+
+    def _numpy_ftrl(self, w, g, z, n, lr, l1, l2, beta):
+        n_new = n + g * g
+        sigma = (np.sqrt(n_new) - np.sqrt(n)) / lr
+        z = z + g - sigma * w
+        n = n_new
+        w = np.where(
+            np.abs(z) <= l1,
+            0.0,
+            -(z - np.sign(z) * l1) / ((beta + np.sqrt(n)) / lr + 2 * l2),
+        ).astype(np.float32)
+        return w, z, n
+
+    def _numpy_radam(self, w, g, m, v, lr, b1, b2, eps, step, l2):
+        gd = g + l2 * w
+        m = b1 * m + (1 - b1) * gd
+        v = b2 * v + (1 - b2) * gd * gd
+        bc1 = 1 - b1 ** step
+        bc2 = 1 - b2 ** step
+        mhat = m / bc1
+        rho_inf = 2 / (1 - b2) - 1
+        rho_t = rho_inf - 2 * step * b2 ** step / bc2
+        if rho_t > 4:
+            rect = np.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
+                           / ((rho_inf - 4) * (rho_inf - 2) * rho_t))
+            w = w - lr * rect * mhat / (np.sqrt(v / bc2) + eps)
+        else:
+            w = w - lr * mhat
+        return w, m, v
+
+    def test_adagrad_matches_numpy(self):
+        table = KvEmbeddingTable(dim=8, num_slots=1, seed=9)
+        ids = np.array([1, 2, 3])
+        w = table.lookup(ids).copy()
+        a = np.zeros_like(w)
+        rng = np.random.default_rng(0)
+        for _ in range(3):
+            g = rng.standard_normal((3, 8)).astype(np.float32)
+            table.apply_adagrad(ids, g, lr=0.1, l2=0.01)
+            w, a = self._numpy_adagrad(w, g, a, 0.1, 1e-8, 0.01)
+        np.testing.assert_allclose(table.lookup(ids), w,
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_ftrl_matches_numpy_and_l1_sparsifies(self, table):
+        ids = np.array([4, 5])
+        w = table.lookup(ids).copy()
+        z = np.zeros_like(w)
+        n = np.zeros_like(w)
+        rng = np.random.default_rng(1)
+        for _ in range(4):
+            g = rng.standard_normal((2, 8)).astype(np.float32)
+            table.apply_ftrl(ids, g, lr=0.5, l1=0.1, l2=0.01)
+            w, z, n = self._numpy_ftrl(w, g, z, n, 0.5, 0.1, 0.01, 1.0)
+        np.testing.assert_allclose(table.lookup(ids), w,
+                                   atol=1e-5, rtol=1e-5)
+        # strong L1 zeroes coordinates whose |z| stays under the threshold
+        big_l1 = KvEmbeddingTable(dim=8, num_slots=2, seed=9)
+        big_l1.lookup(ids)
+        big_l1.apply_ftrl(ids, np.full((2, 8), 1e-4, np.float32),
+                          lr=0.5, l1=10.0)
+        np.testing.assert_array_equal(
+            big_l1.lookup(ids), np.zeros((2, 8), np.float32))
+
+    def test_radam_matches_numpy_across_rectification_switch(self, table):
+        """rho_t <= 4 early (momentum-SGD branch), > 4 later (rectified
+        adaptive branch) — with beta2=0.9 the switch happens inside a
+        handful of steps, covering both paths in one run."""
+        ids = np.array([6])
+        w = table.lookup(ids).copy()
+        m = np.zeros_like(w)
+        v = np.zeros_like(w)
+        rng = np.random.default_rng(2)
+        for step in range(1, 9):
+            g = rng.standard_normal((1, 8)).astype(np.float32)
+            table.apply_radam(ids, g, lr=0.01, beta2=0.9, l2=0.02,
+                              step=step)
+            w, m, v = self._numpy_radam(
+                w, g, m, v, 0.01, 0.9, 0.9, 1e-8, step, 0.02)
+        np.testing.assert_allclose(table.lookup(ids), w,
+                                   atol=1e-5, rtol=1e-4)
+
+    def test_group_variants_prune_rows(self):
+        for opt, slots in (("group_adagrad", 1), ("group_ftrl", 2)):
+            t = KvEmbeddingTable(dim=8, num_slots=slots, seed=3)
+            ids = np.array([42])
+            t.lookup(ids)
+            t.apply(opt, ids, np.zeros((1, 8), np.float32), lr=1.0,
+                    group_lasso=1e6)
+            np.testing.assert_array_equal(
+                t.lookup(ids), np.zeros((1, 8), np.float32))
+
+    def test_slot_requirements_enforced(self):
+        t0 = KvEmbeddingTable(dim=4, num_slots=0, seed=1)
+        with pytest.raises(ValueError, match="num_slots"):
+            t0.apply_adagrad(np.array([1]), np.zeros((1, 4), np.float32))
+        t1 = KvEmbeddingTable(dim=4, num_slots=1, seed=1)
+        for fn in (t1.apply_ftrl, t1.apply_radam, t1.apply_adam):
+            with pytest.raises(ValueError, match="num_slots"):
+                fn(np.array([1]), np.zeros((1, 4), np.float32))
+
+    def test_apply_dispatch(self, table):
+        ids = np.array([77])
+        table.apply("radam", ids, np.ones((1, 8), np.float32))
+        with pytest.raises(ValueError, match="unknown sparse optimizer"):
+            table.apply("sgd", ids, np.ones((1, 8), np.float32))
+
+    def test_family_under_thread_stress(self, table):
+        """All four optimizers hammer overlapping ids concurrently with
+        lookups and removals: no crash, no wedge, table stays sane."""
+        import threading
+        import time as _time
+
+        stop = threading.Event()
+        errors = []
+
+        def guard(fn):
+            def run():
+                try:
+                    while not stop.is_set():
+                        fn()
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+            return run
+
+        shared = np.arange(64)
+
+        def make(opt, seed):
+            rng = np.random.default_rng(seed)  # Generators aren't
+            # thread-safe: one per worker or the test flakes on its
+            # own RNG instead of the locking under test
+
+            def step():
+                ids = rng.choice(shared, size=16)
+                table.apply(opt, ids,
+                            np.ones((16, 8), np.float32) * 0.01)
+            return step
+
+        reader_rng = np.random.default_rng(100)
+        remover_rng = np.random.default_rng(101)
+
+        def reader():
+            table.lookup(reader_rng.choice(shared, size=32))
+
+        def remover():
+            table.remove(remover_rng.choice(shared, size=2))
+
+        threads = [
+            threading.Thread(target=guard(f), daemon=True)
+            for f in (make("adam", 0), make("adagrad", 1),
+                      make("ftrl", 2), make("radam", 3), reader, remover)
+        ]
+        for t in threads:
+            t.start()
+        _time.sleep(1.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+            assert not t.is_alive(), "worker thread wedged"
+        assert not errors, errors[:3]
+        snap = table.export()
+        assert np.isfinite(snap["values"]).all()
