@@ -176,3 +176,41 @@ class ScalePlan:
     def is_empty(self) -> bool:
         return not (self.replica_resources or self.memory_mb
                     or self.remove_nodes or self.relaunch_nodes)
+
+    def to_manifest(self, name: str = "",
+                    namespace: str = "default") -> dict:
+        return {
+            "apiVersion": f"{GROUP}/{VERSION}",
+            "kind": "ScalePlan",
+            "metadata": {
+                "name": name or f"{self.job_name}-scaleplan",
+                "namespace": namespace,
+            },
+            "spec": {
+                "jobName": self.job_name,
+                "replicaResources": dict(self.replica_resources),
+                "memoryMb": dict(self.memory_mb),
+                "removeNodes": list(self.remove_nodes),
+                "relaunchNodes": list(self.relaunch_nodes),
+                "reason": self.reason,
+            },
+        }
+
+    @classmethod
+    def from_manifest(cls, manifest: dict) -> "ScalePlan":
+        spec = manifest.get("spec", {})
+        return cls(
+            job_name=spec.get("jobName", ""),
+            replica_resources={
+                k: int(v)
+                for k, v in spec.get("replicaResources", {}).items()
+            },
+            memory_mb={
+                str(k): int(v) for k, v in spec.get("memoryMb", {}).items()
+            },
+            remove_nodes=[int(n) for n in spec.get("removeNodes", [])],
+            relaunch_nodes=[
+                int(n) for n in spec.get("relaunchNodes", [])
+            ],
+            reason=spec.get("reason", ""),
+        )

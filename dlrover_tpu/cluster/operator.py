@@ -10,6 +10,7 @@ testable with a fake client and portable to any k8s SDK.
 
 from __future__ import annotations
 
+import os
 import threading
 
 from dlrover_tpu.cluster.crd import ElasticJob, ScalePlan
@@ -60,16 +61,26 @@ class ElasticJobOperator:
             )
         self._client.delete_service(job.namespace, f"{name}-master")
 
-    def apply_scale_plan(self, plan: ScalePlan) -> None:
-        """The ScalePlan-CR reconcile path."""
+    def apply_scale_plan(self, plan: ScalePlan) -> bool:
+        """The ScalePlan-CR reconcile path. Returns False when the job
+        is unknown (the plan stays pending and is retried — it may have
+        been submitted seconds before its ElasticJob CR)."""
         with self._lock:
             scalers = {
                 group: s for (jname, group), s in self._scalers.items()
                 if jname == plan.job_name
             }
+            job = self._jobs.get(plan.job_name)
+            if job is not None:
+                # persist the resize into the job spec, or the periodic
+                # reconcile would scale every group straight back to the
+                # old replica count within one interval
+                for group, target in plan.replica_resources.items():
+                    if group in job.spec.replica_specs:
+                        job.spec.replica_specs[group].replicas = target
         if not scalers:
             logger.warning("scale plan for unknown job %s", plan.job_name)
-            return
+            return False
         for group, scaler in scalers.items():
             sub = ScalePlan(
                 job_name=plan.job_name,
@@ -84,6 +95,7 @@ class ElasticJobOperator:
             )
             if not sub.is_empty():
                 scaler.scale(sub)
+        return True
 
     # ------------------------------------------------------------- reconcile
 
@@ -150,3 +162,119 @@ class ElasticJobOperator:
 
     def stop(self) -> None:
         self._stopped.set()
+
+    def job_phase(self, name: str) -> str | None:
+        with self._lock:
+            job = self._jobs.get(name)
+        return job.phase if job is not None else None
+
+
+ELASTICJOB_PLURAL = "elasticjobs"
+SCALEPLAN_PLURAL = "scaleplans"
+
+
+class CrSync:
+    """Feed the reconciler from the cluster's custom resources.
+
+    Reference analog: the Go controller's watch-driven Reconcile
+    (elasticjob_controller.go:85) and scaleplan_controller.go:79. Here a
+    level-triggered list loop (the informer-resync shape): new/changed
+    ElasticJob CRs -> apply_job, vanished CRs -> delete_job, pending
+    ScalePlan CRs -> apply_scale_plan once (phase-marked Applied via the
+    status subresource so a restarted operator doesn't re-apply them).
+    """
+
+    def __init__(self, client, operator: ElasticJobOperator,
+                 namespace: str = "default"):
+        self._client = client
+        self._op = operator
+        self._ns = namespace
+        self._seen_specs: dict[str, str] = {}
+
+    def sync_once(self) -> None:
+        import json as _json
+
+        names = set()
+        for mf in self._client.list_custom(self._ns, ELASTICJOB_PLURAL):
+            job = ElasticJob.from_manifest(mf)
+            if not job.name:
+                continue
+            names.add(job.name)
+            key = _json.dumps(mf.get("spec", {}), sort_keys=True)
+            if self._seen_specs.get(job.name) != key:
+                self._op.apply_job(job)
+                self._seen_specs[job.name] = key
+            phase = self._op.job_phase(job.name)
+            if phase and phase != mf.get("status", {}).get("phase"):
+                self._client.patch_custom_status(
+                    self._ns, ELASTICJOB_PLURAL, job.name,
+                    {"phase": phase},
+                )
+        for gone in set(self._seen_specs) - names:
+            logger.info("ElasticJob CR %s deleted; tearing down", gone)
+            self._op.delete_job(gone)
+            self._seen_specs.pop(gone, None)
+        for mf in self._client.list_custom(self._ns, SCALEPLAN_PLURAL):
+            if mf.get("status", {}).get("phase") == "Applied":
+                continue
+            plan = ScalePlan.from_manifest(mf)
+            # unknown job: leave the plan pending (it may predate its
+            # ElasticJob CR by a sync or two) — marking it Applied here
+            # would silently discard the scale request forever
+            if self._op.apply_scale_plan(plan):
+                self._client.patch_custom_status(
+                    self._ns, SCALEPLAN_PLURAL, mf["metadata"]["name"],
+                    {"phase": "Applied"},
+                )
+
+    def run_forever(self, interval_s: float = 5.0,
+                    stop_event: threading.Event | None = None) -> None:
+        stop = stop_event or threading.Event()
+        while not stop.wait(interval_s):
+            try:
+                self.sync_once()
+            except Exception:  # noqa: BLE001 - the control loop must live
+                logger.exception("CR sync failed; retrying")
+
+
+def main(argv=None) -> int:
+    """Deployable operator entrypoint (deploy/operator-deployment.yaml).
+
+    Auth resolution order: --api-server (dev/stub), in-cluster service
+    account, kubeconfig.
+    """
+    import argparse
+
+    from dlrover_tpu.cluster.kube_client import KubernetesClient
+
+    p = argparse.ArgumentParser("dlrover-tpu operator")
+    p.add_argument("--namespace", default="")
+    p.add_argument("--interval", type=float, default=5.0)
+    p.add_argument("--api-server", default="",
+                   help="plain API server URL (dev/stub; no auth)")
+    p.add_argument("--kubeconfig", default="")
+    args = p.parse_args(argv)
+
+    if args.api_server:
+        client = KubernetesClient(args.api_server)
+    elif os.environ.get("KUBERNETES_SERVICE_HOST"):
+        client = KubernetesClient.in_cluster()
+    else:
+        client = KubernetesClient.from_kubeconfig(args.kubeconfig or None)
+    namespace = args.namespace or client.namespace
+    operator = ElasticJobOperator(client, interval_s=args.interval)
+    operator.start()
+    logger.info("operator reconciling namespace %s via %s",
+                namespace, client.base_url)
+    try:
+        CrSync(client, operator, namespace).run_forever(args.interval)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        operator.stop()
+        client.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

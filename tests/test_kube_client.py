@@ -1,0 +1,495 @@
+"""KubernetesClient against a stubbed API-server HTTP transport.
+
+Round-2 verdict Missing #2 / Next #3: the cluster layer previously only
+ever ran against in-memory fakes. These tests drive the REAL client —
+urllib transport, JSON bodies, label-selector queries, streaming watch,
+CR status subresource — through a stdlib HTTP server that imitates the
+kube-apiserver surface the client uses, then run PodScaler, PodWatcher,
+and the operator's CR sync loop over it end-to-end.
+
+Reference analog: dlrover/python/tests exercising k8sClient against
+mocked API responses (scheduler/kubernetes.py:121), and the Go
+operator's envtest-style controller tests.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import pytest
+
+from dlrover_tpu.cluster.crd import (
+    GROUP,
+    VERSION,
+    ElasticJob,
+    ElasticJobSpec,
+    ReplicaSpec,
+    ScalePlan,
+)
+from dlrover_tpu.cluster.kube_client import ApiError, KubernetesClient
+from dlrover_tpu.cluster.operator import CrSync, ElasticJobOperator
+from dlrover_tpu.cluster.scaler import PodScaler
+from dlrover_tpu.cluster.watcher import PodEvent, PodWatcher
+
+
+def _matches(selector: str, labels: dict) -> bool:
+    for clause in filter(None, selector.split(",")):
+        k, _, v = clause.partition("=")
+        if labels.get(k) != v:
+            return False
+    return True
+
+
+class _State:
+    """In-memory cluster state behind the HTTP surface."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.pods: dict[tuple[str, str], dict] = {}
+        self.services: dict[tuple[str, str], dict] = {}
+        self.customs: dict[tuple[str, str, str], dict] = {}
+        self.watchers: list[tuple[queue.Queue, str, str]] = []
+        self.requests: list[tuple[str, str, str]] = []  # method, path, auth
+
+    def notify(self, event_type: str, pod: dict) -> None:
+        ns = pod["metadata"].get("namespace", "default")
+        labels = pod["metadata"].get("labels", {})
+        with self.lock:
+            for q, wns, selector in self.watchers:
+                if wns == ns and _matches(selector, labels):
+                    q.put({"type": event_type, "object": pod})
+
+
+def _handler(state: _State):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):  # noqa: D102 - silence
+            pass
+
+        def _json(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _body(self) -> dict:
+            n = int(self.headers.get("Content-Length", 0))
+            return json.loads(self.rfile.read(n)) if n else {}
+
+        def _record(self):
+            state.requests.append((
+                self.command, self.path,
+                self.headers.get("Authorization", ""),
+            ))
+
+        # ---- routing helpers
+        def _route(self):
+            u = urlparse(self.path)
+            parts = [p for p in u.path.split("/") if p]
+            q = {k: v[0] for k, v in parse_qs(u.query).items()}
+            return parts, q
+
+        def do_GET(self):  # noqa: N802
+            self._record()
+            parts, q = self._route()
+            if parts[:2] == ["api", "v1"] and parts[4] == "pods":
+                ns = parts[3]
+                if len(parts) == 6:
+                    pod = state.pods.get((ns, parts[5]))
+                    if pod is None:
+                        return self._json(404, {"reason": "NotFound"})
+                    return self._json(200, pod)
+                selector = q.get("labelSelector", "")
+                if q.get("watch") == "true":
+                    return self._watch(ns, selector)
+                with state.lock:
+                    items = [
+                        p for (pns, _), p in state.pods.items()
+                        if pns == ns and _matches(
+                            selector, p["metadata"].get("labels", {}))
+                    ]
+                return self._json(200, {"items": items})
+            if parts[0] == "apis" and parts[1] == GROUP:
+                ns, plural = parts[4], parts[5]
+                if len(parts) == 7:
+                    obj = state.customs.get((ns, plural, parts[6]))
+                    if obj is None:
+                        return self._json(404, {"reason": "NotFound"})
+                    return self._json(200, obj)
+                with state.lock:
+                    items = [
+                        o for (ons, op, _), o in state.customs.items()
+                        if ons == ns and op == plural
+                    ]
+                return self._json(200, {"items": items})
+            return self._json(404, {"reason": "NotFound"})
+
+        def _watch(self, ns: str, selector: str) -> None:
+            events: queue.Queue = queue.Queue()
+            with state.lock:
+                state.watchers.append((events, ns, selector))
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            try:
+                while True:
+                    try:
+                        ev = events.get(timeout=0.2)
+                    except queue.Empty:
+                        continue
+                    line = (json.dumps(ev) + "\n").encode()
+                    self.wfile.write(
+                        f"{len(line):x}\r\n".encode() + line + b"\r\n"
+                    )
+                    self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass
+            finally:
+                with state.lock:
+                    state.watchers[:] = [
+                        w for w in state.watchers if w[0] is not events
+                    ]
+
+        def do_POST(self):  # noqa: N802
+            self._record()
+            parts, _ = self._route()
+            manifest = self._body()
+            name = manifest["metadata"]["name"]
+            if parts[:2] == ["api", "v1"]:
+                ns, kind = parts[3], parts[4]
+                if kind == "pods":
+                    manifest["metadata"].setdefault("namespace", ns)
+                    manifest.setdefault("status", {"phase": "Pending"})
+                    with state.lock:
+                        state.pods[(ns, name)] = manifest
+                    state.notify("ADDED", manifest)
+                else:
+                    with state.lock:
+                        state.services[(ns, name)] = manifest
+                return self._json(201, manifest)
+            if parts[0] == "apis":
+                ns, plural = parts[4], parts[5]
+                with state.lock:
+                    state.customs[(ns, plural, name)] = manifest
+                return self._json(201, manifest)
+            return self._json(404, {})
+
+        def do_DELETE(self):  # noqa: N802
+            self._record()
+            parts, _ = self._route()
+            if parts[:2] == ["api", "v1"]:
+                ns, kind, name = parts[3], parts[4], parts[5]
+                store = state.pods if kind == "pods" else state.services
+                with state.lock:
+                    obj = store.pop((ns, name), None)
+                if obj is None:
+                    return self._json(404, {"reason": "NotFound"})
+                if kind == "pods":
+                    state.notify("DELETED", obj)
+                return self._json(200, {})
+            ns, plural, name = parts[4], parts[5], parts[6]
+            with state.lock:
+                gone = state.customs.pop((ns, plural, name), None)
+            return self._json(200 if gone else 404, {})
+
+        def do_PATCH(self):  # noqa: N802
+            self._record()
+            parts, _ = self._route()
+            assert parts[-1] == "status"
+            ns, plural, name = parts[4], parts[5], parts[6]
+            patch = self._body()
+            with state.lock:
+                obj = state.customs.get((ns, plural, name))
+                if obj is None:
+                    return self._json(404, {"reason": "NotFound"})
+                obj.setdefault("status", {}).update(
+                    patch.get("status", {})
+                )
+            return self._json(200, obj)
+
+    return Handler
+
+
+@pytest.fixture
+def api():
+    state = _State()
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _handler(state))
+    server.daemon_threads = True
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    client = KubernetesClient(
+        f"http://127.0.0.1:{server.server_port}", token="stub-token",
+    )
+    yield state, client
+    client.close()
+    server.shutdown()
+    server.server_close()
+
+
+def _job(workers=2) -> ElasticJob:
+    return ElasticJob(
+        name="jobx",
+        spec=ElasticJobSpec(replica_specs={
+            "worker": ReplicaSpec(replicas=workers, image="img:1"),
+        }),
+    )
+
+
+@pytest.mark.timeout(120)
+class TestVerbs:
+    def test_pod_crud_and_selector_listing(self, api):
+        state, client = api
+        client.create_pod("default", {
+            "metadata": {"name": "p1", "labels": {"job": "a"}}})
+        client.create_pod("default", {
+            "metadata": {"name": "p2", "labels": {"job": "b"}}})
+        assert [p["metadata"]["name"]
+                for p in client.list_pods("default", "job=a")] == ["p1"]
+        client.delete_pod("default", "p1")
+        assert client.list_pods("default", "job=a") == []
+        client.delete_pod("default", "p1")  # 404 tolerated
+        assert client.get_pod("default", "nope") is None
+
+    def test_bearer_token_sent(self, api):
+        state, client = api
+        client.list_pods("default", "")
+        assert state.requests[-1][2] == "Bearer stub-token"
+
+    def test_api_error_carries_status(self, api):
+        _, client = api
+        with pytest.raises(ApiError) as ei:
+            client._request("GET", "/api/v1/namespaces/x/unknown")
+        assert ei.value.status == 404
+
+    def test_custom_resource_crud_and_status_patch(self, api):
+        state, client = api
+        mf = _job().to_manifest()
+        client.create_custom("default", "elasticjobs", mf)
+        got = client.get_custom("default", "elasticjobs", "jobx")
+        assert got["spec"]["replicaSpecs"]["worker"]["replicas"] == 2
+        client.patch_custom_status(
+            "default", "elasticjobs", "jobx", {"phase": "Running"})
+        got = client.get_custom("default", "elasticjobs", "jobx")
+        assert got["status"]["phase"] == "Running"
+        client.delete_custom("default", "elasticjobs", "jobx")
+        assert client.get_custom("default", "elasticjobs", "jobx") is None
+
+
+@pytest.mark.timeout(120)
+class TestScalerOverRealTransport:
+    def test_scale_up_and_down(self, api):
+        state, client = api
+        scaler = PodScaler(_job(), client, "master:5001")
+        scaler.scale(ScalePlan(replica_resources={"worker": 3}))
+        with state.lock:
+            names = sorted(n for (_, n) in state.pods)
+        assert names == ["jobx-worker-0", "jobx-worker-1", "jobx-worker-2"]
+        pod = state.pods[("default", "jobx-worker-0")]
+        env = {e["name"]: e["value"]
+               for e in pod["spec"]["containers"][0]["env"]}
+        assert env["DLROVER_TPU_MASTER_ADDR"] == "master:5001"
+        scaler.scale(ScalePlan(replica_resources={"worker": 1}))
+        with state.lock:
+            assert len(state.pods) == 1
+
+
+@pytest.mark.timeout(120)
+class TestWatchStream:
+    def test_events_flow_and_stop_does_not_wedge(self, api):
+        state, client = api
+        events: list[PodEvent] = []
+        seen = threading.Event()
+
+        def on_event(e: PodEvent):
+            events.append(e)
+            seen.set()
+
+        watcher = PodWatcher(client, "default", "jobx", on_event,
+                             interval_s=30.0)
+        watcher.start()
+        time.sleep(0.3)  # let the stream subscribe
+        client.create_pod("default", {"metadata": {
+            "name": "jobx-worker-0", "namespace": "default",
+            "labels": {"job": "jobx", "group": "worker", "node-id": "0"},
+        }})
+        assert seen.wait(10), "watch event never arrived"
+        assert events[0].kind == PodEvent.ADDED
+        assert events[0].node_id == 0
+        seen.clear()
+        client.delete_pod("default", "jobx-worker-0")
+        assert seen.wait(10), "delete event never arrived"
+        assert events[-1].kind == PodEvent.DELETED
+        t0 = time.monotonic()
+        watcher.stop()
+        assert time.monotonic() - t0 < 5, "stop wedged on the stream"
+
+
+@pytest.mark.timeout(120)
+class TestOperatorCrSync:
+    def test_job_cr_drives_pods_and_status(self, api):
+        state, client = api
+        client.create_custom("default", "elasticjobs",
+                             _job(workers=2).to_manifest())
+        op = ElasticJobOperator(client, interval_s=600)
+        sync = CrSync(client, op, "default")
+        sync.sync_once()
+        with state.lock:
+            names = sorted(n for (_, n) in state.pods)
+        assert names == ["jobx-master", "jobx-worker-0", "jobx-worker-1"]
+        assert ("default", "jobx-master") in state.services
+        got = client.get_custom("default", "elasticjobs", "jobx")
+        assert got["status"]["phase"] == "Pending"
+
+        # a ScalePlan CR resizes the workers exactly once
+        client.create_custom(
+            "default", "scaleplans",
+            ScalePlan(job_name="jobx",
+                      replica_resources={"worker": 3}).to_manifest())
+        sync.sync_once()
+        with state.lock:
+            workers = [n for (_, n) in state.pods if "worker" in n]
+        assert len(workers) == 3
+        plan = client.get_custom("default", "scaleplans",
+                                 "jobx-scaleplan")
+        assert plan["status"]["phase"] == "Applied"
+
+        # deleting the job CR tears everything down
+        client.delete_custom("default", "elasticjobs", "jobx")
+        sync.sync_once()
+        with state.lock:
+            assert not state.pods
+        op.stop()
+
+
+class TestKubeconfig:
+    def test_token_and_namespace_resolution(self, tmp_path, api):
+        state, client = api
+        cfg = {
+            "current-context": "dev",
+            "contexts": [{"name": "dev", "context": {
+                "cluster": "c1", "user": "u1", "namespace": "ns9"}}],
+            "clusters": [{"name": "c1", "cluster": {
+                "server": client.base_url}}],
+            "users": [{"name": "u1", "user": {"token": "cfg-token"}}],
+        }
+        import yaml
+
+        path = tmp_path / "kubeconfig"
+        path.write_text(yaml.safe_dump(cfg))
+        kc = KubernetesClient.from_kubeconfig(str(path))
+        assert kc.base_url == client.base_url
+        assert kc.namespace == "ns9"
+        kc.list_pods("ns9", "")
+        assert state.requests[-1][2] == "Bearer cfg-token"
+        kc.close()
+
+    def test_base64_data_materialized_and_cleaned(self, tmp_path):
+        ca_pem = b"-----BEGIN CERTIFICATE-----\nAA==\n-----END CERTIFICATE-----\n"
+        cfg = {
+            "current-context": "dev",
+            "contexts": [{"name": "dev", "context": {
+                "cluster": "c1", "user": "u1"}}],
+            "clusters": [{"name": "c1", "cluster": {
+                "server": "https://1.2.3.4:6443",
+                "insecure-skip-tls-verify": True,
+                "certificate-authority-data":
+                    base64.b64encode(ca_pem).decode()}}],
+            "users": [{"name": "u1", "user": {"token": "t"}}],
+        }
+        import os
+
+        import yaml
+
+        path = tmp_path / "kubeconfig"
+        path.write_text(yaml.safe_dump(cfg))
+        kc = KubernetesClient.from_kubeconfig(str(path))
+        assert kc._tmp_files
+        assert all(os.path.exists(p) for p in kc._tmp_files)
+        files = list(kc._tmp_files)
+        kc.close()
+        assert all(not os.path.exists(p) for p in files)
+
+    def test_unknown_context_rejected(self, tmp_path):
+        import yaml
+
+        path = tmp_path / "kubeconfig"
+        path.write_text(yaml.safe_dump({
+            "current-context": "missing", "contexts": [],
+            "clusters": [], "users": [],
+        }))
+        with pytest.raises(ValueError, match="context"):
+            KubernetesClient.from_kubeconfig(str(path))
+
+
+@pytest.mark.timeout(120)
+class TestScalePlanDurability:
+    def test_resize_survives_subsequent_reconcile(self, api):
+        """A CR-driven resize must stick: the periodic reconcile used to
+        scale every group straight back to the original spec within one
+        interval (review finding)."""
+        state, client = api
+        client.create_custom("default", "elasticjobs",
+                             _job(workers=2).to_manifest())
+        op = ElasticJobOperator(client, interval_s=600)
+        sync = CrSync(client, op, "default")
+        sync.sync_once()
+        client.create_custom(
+            "default", "scaleplans",
+            ScalePlan(job_name="jobx",
+                      replica_resources={"worker": 4}).to_manifest())
+        sync.sync_once()
+        op.reconcile("jobx")  # the periodic loop's pass
+        with state.lock:
+            workers = [n for (_, n) in state.pods if "worker" in n]
+        assert len(workers) == 4, "reconcile reverted the CR resize"
+        op.stop()
+
+    def test_plan_before_job_stays_pending_then_applies(self, api):
+        state, client = api
+        client.create_custom(
+            "default", "scaleplans",
+            ScalePlan(job_name="jobx",
+                      replica_resources={"worker": 3}).to_manifest())
+        op = ElasticJobOperator(client, interval_s=600)
+        sync = CrSync(client, op, "default")
+        sync.sync_once()  # job CR not there yet
+        plan = client.get_custom("default", "scaleplans",
+                                 "jobx-scaleplan")
+        assert plan.get("status", {}).get("phase") != "Applied"
+        client.create_custom("default", "elasticjobs",
+                             _job(workers=2).to_manifest())
+        sync.sync_once()
+        with state.lock:
+            workers = [n for (_, n) in state.pods if "worker" in n]
+        assert len(workers) == 3
+        plan = client.get_custom("default", "scaleplans",
+                                 "jobx-scaleplan")
+        assert plan["status"]["phase"] == "Applied"
+        op.stop()
+
+
+class TestTokenRefresh:
+    def test_rotated_token_file_is_reread(self, tmp_path, api):
+        state, client = api
+        tok = tmp_path / "token"
+        tok.write_text("tok-1")
+        kc = KubernetesClient(client.base_url, token_file=str(tok))
+        kc.list_pods("default", "")
+        assert state.requests[-1][2] == "Bearer tok-1"
+        tok.write_text("tok-2")
+        import os
+
+        os.utime(tok, (time.time() + 5, time.time() + 5))
+        kc.list_pods("default", "")
+        assert state.requests[-1][2] == "Bearer tok-2"
+        kc.close()
