@@ -94,6 +94,8 @@ class AsyncCheckpointSaver:
             target=self._sync_loop, name="ckpt-saver", daemon=True
         )
         self._persist_lock = threading.Lock()
+        self._commit_lock = threading.Lock()
+        self._commit_waiters: dict[int, threading.Thread] = {}
 
     _signals_registered = False
 
@@ -167,7 +169,8 @@ class AsyncCheckpointSaver:
                         "persist of step %s failed", event.get("step")
                     )
 
-    def _persist_step(self, step: int, lock_timeout: float = 60.0) -> bool:
+    def _persist_step(self, step: int, lock_timeout: float = 60.0,
+                      commit_block_s: float = 0.0) -> bool:
         """Copy shm -> storage. Header and bytes are read under one hold of
         the writer lock (bounded acquire) so a concurrent trainer save can't
         leave us with a header/bytes mismatch, and a crashed lock holder
@@ -204,11 +207,13 @@ class AsyncCheckpointSaver:
                     "refusing to persist step %d", len(content), total, step,
                 )
                 return False
-            self._write_files(header, content, step)
+            self._write_files(header, content, step,
+                              commit_block_s=commit_block_s)
             self._last_persisted_step = step
             return True
 
-    def _write_files(self, header: dict, content: bytes, step: int) -> None:
+    def _write_files(self, header: dict, content: bytes, step: int,
+                     commit_block_s: float = 0.0) -> None:
         ckpt_dir = header.get("ckpt_dir", "")
         if not ckpt_dir:
             logger.warning("snapshot has no ckpt_dir; skipping persist")
@@ -226,40 +231,79 @@ class AsyncCheckpointSaver:
         storage.write(
             b"", os.path.join(sdir, done_marker(self.node_id, num_shards))
         )
-        self._maybe_commit(storage, header, step)
+        self._maybe_commit(storage, header, step,
+                           block_s=commit_block_s)
         logger.info(
             "persisted step %d (%d bytes) in %.2fs",
             step, len(content), time.monotonic() - start,
         )
 
     def _maybe_commit(self, storage: CheckpointStorage, header: dict,
-                      step: int) -> None:
-        """Rank-0's agent updates the tracker once all shards are durable."""
+                      step: int, block_s: float = 0.0) -> None:
+        """Rank-0's agent updates the tracker once all shards are durable.
+
+        The marker wait runs in a background thread: other shards may be
+        minutes away (or never arrive, when a peer died mid-save), and
+        blocking here would stall the agent's restart path — the exact
+        path breakpoint saves run on (seen as a 5-minute rendezvous
+        stall in the buddy e2e). ``block_s > 0`` additionally joins the
+        waiter for that long — the pre-exit paths (SIGTERM, node
+        relaunch) use it so a fast commit lands before the process dies,
+        without re-introducing the unbounded stall. One waiter per step;
+        a newer step's commit superseding an older one is fine (tracker
+        is monotonic).
+        """
         if int(header.get("node_rank", 0)) != 0:
             return
         ckpt_dir = header["ckpt_dir"]
         num_shards = int(header.get("num_shards", 1))
+        with self._commit_lock:
+            waiter = self._commit_waiters.get(step)
+            if waiter is None:
+                waiter = threading.Thread(
+                    target=self._commit_wait,
+                    name=f"ckpt-commit-{step}",
+                    args=(storage, ckpt_dir, step, num_shards),
+                    daemon=True,
+                )
+                self._commit_waiters[step] = waiter
+                waiter.start()
+        if block_s > 0:
+            waiter.join(timeout=block_s)
+
+    def _commit_wait(self, storage: CheckpointStorage, ckpt_dir: str,
+                     step: int, num_shards: int,
+                     timeout_s: float = 300.0) -> None:
         sdir = step_dir(ckpt_dir, step)
         suffix = f"_w{num_shards}"
-        deadline = time.time() + 300.0
-        while time.time() < deadline:
-            done = [
-                f for f in storage.listdir(sdir)
-                if f.startswith("done_") and f.endswith(suffix)
-            ]
-            if len(done) >= num_shards:
-                storage.write(
-                    json.dumps({"step": step, "num_shards": num_shards}),
-                    tracker_path(ckpt_dir),
-                )
-                logger.info("committed checkpoint step %d (%d shards)",
-                            step, num_shards)
-                return
-            time.sleep(0.2)
-        logger.error(
-            "commit of step %d timed out (%d/%d shards done)", step,
-            len(done), num_shards,
-        )
+        deadline = time.time() + timeout_s
+        done: list = []
+        try:
+            while time.time() < deadline and not self._stopped.is_set():
+                done = [
+                    f for f in storage.listdir(sdir)
+                    if f.startswith("done_") and f.endswith(suffix)
+                ]
+                if len(done) >= num_shards:
+                    storage.write(
+                        json.dumps(
+                            {"step": step, "num_shards": num_shards}
+                        ),
+                        tracker_path(ckpt_dir),
+                    )
+                    logger.info(
+                        "committed checkpoint step %d (%d shards)",
+                        step, num_shards,
+                    )
+                    return
+                time.sleep(0.2)
+            logger.error(
+                "commit of step %d timed out (%d/%d shards done)", step,
+                len(done), num_shards,
+            )
+        finally:
+            with self._commit_lock:
+                self._commit_waiters.pop(step, None)
 
     def _build_storage(self, header: dict) -> CheckpointStorage:
         meta = header.get("storage")
@@ -294,7 +338,9 @@ class AsyncCheckpointSaver:
         if step <= self._last_persisted_step:
             return
         logger.info("breakpoint save of step %d (%s)", step, reason)
-        self._persist_step(step, lock_timeout=5.0)
+        # short commit join: this path often precedes process exit, and
+        # a durable-but-uncommitted checkpoint is invisible to restore
+        self._persist_step(step, lock_timeout=5.0, commit_block_s=15.0)
 
     def stop(self) -> None:
         self._stopped.set()

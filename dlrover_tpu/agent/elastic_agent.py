@@ -98,6 +98,8 @@ class ElasticAgent:
         self._ckpt_saver = None  # wired by agent/ckpt_saver.py start()
         self._resource_monitor = None
         self._config_tuner = None
+        self._buddy_server = None
+        self._buddy_replicator = None
         self._world: dict[int, int] = {}
         self._node_rank = -1
         self._pending_action = ""
@@ -178,6 +180,7 @@ class ElasticAgent:
         self._start_ckpt_saver()
         self._start_resource_monitor()
         self._start_config_tuner()
+        self._start_buddy_replication()
         try:
             if self._config.network_check:
                 self._run_network_check()
@@ -188,10 +191,15 @@ class ElasticAgent:
                 self._resource_monitor.stop()
             if self._config_tuner is not None:
                 self._config_tuner.stop()
+            if self._buddy_replicator is not None:
+                self._buddy_replicator.stop()
+            if self._buddy_server is not None:
+                self._buddy_server.stop()
             self._kill_child()
 
     def _invoke_run(self) -> RunResult:
         rank, num_nodes, coordinator = self._rendezvous()
+        self._restore_from_buddy()
         self._proc = self._spawn(rank, num_nodes, coordinator)
         while True:
             time.sleep(self._config.monitor_interval_s)
@@ -358,6 +366,74 @@ class ElasticAgent:
             self._client, on_update=on_update
         )
         self._config_tuner.start()
+
+    def _start_buddy_replication(self) -> None:
+        """Peer-redundant shm snapshots over DCN (checkpoint/buddy.py):
+        this agent serves its peers' pushes and streams its own node's
+        new snapshots to the master-assigned ring buddy. Disable with
+        DLROVER_TPU_BUDDY=0."""
+        if os.environ.get("DLROVER_TPU_BUDDY", "1") == "0":
+            return
+        from dlrover_tpu.checkpoint.buddy import (
+            BuddyReplicator,
+            BuddyServer,
+        )
+
+        try:
+            self._buddy_server = BuddyServer(
+                host=self._config.host_ip
+            ).start()
+            self._client.report_buddy_endpoint(self._buddy_server.addr)
+        except (OSError, ConnectionError, RuntimeError) as e:
+            logger.warning("buddy server unavailable: %s", e)
+            self._buddy_server = None
+            return
+        interval = float(os.environ.get(
+            "DLROVER_TPU_BUDDY_INTERVAL", "2.0"
+        ))
+        self._buddy_replicator = BuddyReplicator(
+            self._ckpt_saver.shm_handler, self._client,
+            interval_s=interval,
+        )
+        self._buddy_replicator.start()
+
+    def _restore_from_buddy(self) -> None:
+        """Pre-spawn: if this host's shm snapshot is gone (node relaunch
+        on a fresh VM — TPU preemption), pull it back from the buddy so
+        the trainer's restore-from-shm path works unchanged and storage
+        stays the last resort (<10s budget, SURVEY §7 hard-parts).
+
+        Independent of the local BuddyServer: fetching OUR snapshot only
+        needs the buddy's server — a recycled VM whose own server failed
+        to bind must still restore."""
+        if os.environ.get("DLROVER_TPU_BUDDY", "1") == "0" \
+                or self._ckpt_saver is None:
+            return
+        handler = self._ckpt_saver.shm_handler
+        if handler.header() is not None:
+            return  # local snapshot alive; nothing to do
+        from dlrover_tpu.checkpoint.buddy import fetch_snapshot
+
+        try:
+            buddy = self._client.query_buddy()
+        except (ConnectionError, RuntimeError, OSError) as e:
+            logger.warning("buddy query failed: %s", e)
+            return
+        if not buddy.found:
+            return
+        start = time.monotonic()
+        got = fetch_snapshot(buddy.addr, self._config.node_id)
+        if got is None:
+            logger.info("buddy node %d holds no snapshot for us",
+                        buddy.buddy_node_id)
+            return
+        header, payload = got
+        handler.write_raw(header, payload)
+        logger.info(
+            "restored snapshot step %s (%d bytes) from buddy node %d "
+            "in %.2fs", header.get("step"), len(payload),
+            buddy.buddy_node_id, time.monotonic() - start,
+        )
 
     def _persist_checkpoint(self, reason: str) -> None:
         """Flush the latest in-memory snapshot to storage before a restart.

@@ -130,6 +130,18 @@ class MasterClient:
             time.sleep(0.1)
         return False
 
+    # ---------------------------------------------------- buddy replication
+
+    def report_buddy_endpoint(self, addr: str) -> None:
+        self._client.call(
+            m.ReportBuddyEndpoint(node_id=self.node_id, addr=addr)
+        )
+
+    def query_buddy(self) -> m.BuddyQueryResponse:
+        return self._client.call(
+            m.BuddyQueryRequest(node_id=self.node_id)
+        )
+
     # ------------------------------------------------------- health / status
 
     def report_heartbeat(self, restart_count: int = 0) -> str:

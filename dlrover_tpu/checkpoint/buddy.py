@@ -1,0 +1,277 @@
+"""Buddy-host replication of shm checkpoint snapshots.
+
+Round-2 verdict Missing #4 / SURVEY §7 hard-parts: the shm snapshot
+(shm_handler.py) survives *process* death, but TPU preemption takes the
+whole host VM — and with it the arena. The reference's restart-in-place
+(dlrover/python/elastic_agent/torch/ckpt_saver.py:313) has the same
+blind spot; its answer is the storage fallback, which blows the <10s
+restore budget. Here every node's agent streams each new snapshot to a
+buddy node over DCN; a relaunched node whose shm is gone pulls its
+snapshot back from the buddy BEFORE spawning the trainer, so the
+trainer's normal restore-from-shm path works unchanged and storage is
+only the last resort.
+
+Pairing is a ring over the alive nodes (assigned by the master,
+master/servicer.py BuddyQueryRequest): node i pushes to — and after a
+relaunch fetches from — the next alive node after i.
+
+Wire protocol (length-delimited, binary-clean — snapshots are hundreds
+of MB, so no JSON-wrapped payloads):
+
+    request:  <json line: {"op": "push"|"get", "source": id,
+               ["header": {...}, "nbytes": N]}>\\n [N raw bytes]
+    response: <json line: {"ok": bool, ["header": ..., "nbytes": N]}>\\n
+              [N raw bytes]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import socketserver
+import threading
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+_MAX_HEADER = 64 * 1024 * 1024  # json lines; snapshots metas can be large
+
+
+def _max_snapshot_bytes() -> int:
+    """Upper bound on one pushed snapshot (refuses runaway/malicious
+    nbytes before buffering; a TPU host's training state tops out near
+    its host RAM)."""
+    return int(os.environ.get(
+        "DLROVER_TPU_BUDDY_MAX_BYTES", str(64 << 30)
+    ))
+
+
+def _read_line(rfile) -> bytes:
+    line = rfile.readline(_MAX_HEADER)
+    if not line.endswith(b"\n"):
+        raise ConnectionError("truncated control line")
+    return line
+
+
+def _read_exact(rfile, n: int) -> bytes:
+    chunks = []
+    remaining = n
+    while remaining:
+        chunk = rfile.read(min(remaining, 1 << 20))
+        if not chunk:
+            raise ConnectionError(
+                f"peer closed mid-payload ({remaining} bytes short)"
+            )
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)
+
+
+class BuddyServer:
+    """Agent-side receiver holding peers' snapshots in host memory.
+
+    One slot per source node (latest wins) and at most ``max_sources``
+    peers (oldest-pushed evicted): a node is ring-buddy for one peer at
+    a time, so anything beyond the reassignment-overlap allowance is a
+    stale copy no relaunch can legitimately fetch — it must not pin
+    host RAM the trainer needs.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 max_sources: int = 2):
+        self._store: dict[int, tuple[dict, bytes]] = {}
+        self._max_sources = max_sources
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                try:
+                    req = json.loads(_read_line(self.rfile))
+                    if req["op"] == "push":
+                        nbytes = int(req["nbytes"])
+                        if nbytes < 0 or nbytes > _max_snapshot_bytes():
+                            self.wfile.write(b'{"ok": false}\n')
+                            return
+                        payload = _read_exact(self.rfile, nbytes)
+                        with outer._lock:
+                            # dict preserves insertion order: re-insert
+                            # so eviction drops the least-recent pusher
+                            outer._store.pop(int(req["source"]), None)
+                            outer._store[int(req["source"])] = (
+                                req["header"], payload
+                            )
+                            while len(outer._store) > outer._max_sources:
+                                evicted = next(iter(outer._store))
+                                outer._store.pop(evicted)
+                                logger.info(
+                                    "evicted stale snapshot of node %d",
+                                    evicted,
+                                )
+                        self.wfile.write(b'{"ok": true}\n')
+                    elif req["op"] == "get":
+                        with outer._lock:
+                            entry = outer._store.get(int(req["source"]))
+                        if entry is None:
+                            self.wfile.write(b'{"ok": false}\n')
+                            return
+                        header, payload = entry
+                        self.wfile.write(json.dumps({
+                            "ok": True, "header": header,
+                            "nbytes": len(payload),
+                        }).encode() + b"\n")
+                        self.wfile.write(payload)
+                    else:
+                        self.wfile.write(b'{"ok": false}\n')
+                except (ConnectionError, json.JSONDecodeError,
+                        KeyError, ValueError) as e:
+                    logger.warning("buddy request failed: %s", e)
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
+        self.addr = (
+            f"{self._server.server_address[0]}:"
+            f"{self._server.server_address[1]}"
+        )
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="buddy-server",
+            daemon=True,
+        )
+
+    def start(self) -> "BuddyServer":
+        self._thread.start()
+        logger.info("buddy server on %s", self.addr)
+        return self
+
+    def holds(self, source: int) -> int | None:
+        """Step of the held snapshot for ``source`` (None when absent)."""
+        with self._lock:
+            entry = self._store.get(source)
+        return int(entry[0].get("step", -1)) if entry else None
+
+    def drop(self, source: int) -> None:
+        with self._lock:
+            self._store.pop(source, None)
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def _connect(addr: str, timeout_s: float) -> socket.socket:
+    host, _, port = addr.rpartition(":")
+    return socket.create_connection((host, int(port)), timeout=timeout_s)
+
+
+def push_snapshot(addr: str, source: int, header: dict, payload: bytes,
+                  timeout_s: float = 60.0) -> bool:
+    """Stream one snapshot to the buddy at ``addr``. False on any error
+    (replication is best-effort; the next snapshot retries)."""
+    try:
+        with _connect(addr, timeout_s) as sock:
+            wfile = sock.makefile("wb")
+            rfile = sock.makefile("rb")
+            wfile.write(json.dumps({
+                "op": "push", "source": source, "header": header,
+                "nbytes": len(payload),
+            }).encode() + b"\n")
+            wfile.write(payload)
+            wfile.flush()
+            resp = json.loads(_read_line(rfile))
+            return bool(resp.get("ok"))
+    except (OSError, json.JSONDecodeError, ConnectionError) as e:
+        logger.warning("snapshot push to %s failed: %s", addr, e)
+        return False
+
+
+def fetch_snapshot(addr: str, source: int, timeout_s: float = 60.0
+                   ) -> tuple[dict, bytes] | None:
+    """Pull ``source``'s snapshot back from the buddy at ``addr``."""
+    try:
+        with _connect(addr, timeout_s) as sock:
+            wfile = sock.makefile("wb")
+            rfile = sock.makefile("rb")
+            wfile.write(json.dumps(
+                {"op": "get", "source": source}
+            ).encode() + b"\n")
+            wfile.flush()
+            resp = json.loads(_read_line(rfile))
+            if not resp.get("ok"):
+                return None
+            payload = _read_exact(rfile, int(resp["nbytes"]))
+            return resp["header"], payload
+    except (OSError, json.JSONDecodeError, ConnectionError) as e:
+        logger.warning("snapshot fetch from %s failed: %s", addr, e)
+        return None
+
+
+class BuddyReplicator:
+    """Agent thread: pushes every new shm snapshot to the master-assigned
+    buddy. Polls the shm header (cheap meta-dict read) instead of hooking
+    the trainer, so replication needs zero trainer changes."""
+
+    def __init__(self, shm_handler, master_client,
+                 interval_s: float = 2.0):
+        self._shm = shm_handler
+        self._client = master_client
+        self._interval_s = interval_s
+        # (step, buddy) of the last successful push: a ring reassignment
+        # must re-push the CURRENT snapshot to the new buddy, or the
+        # node is unprotected until the next snapshot
+        self._last_pushed: tuple[int, int] = (-1, -1)
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="buddy-replicator", daemon=True
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def replicate_once(self) -> bool:
+        """One replication attempt; True when a push happened and
+        succeeded."""
+        header = self._shm.header()
+        if not header:
+            return False
+        step = int(header.get("step", -1))
+        buddy = self._client.query_buddy()
+        if not buddy.found:
+            return False
+        last_step, last_buddy = self._last_pushed
+        if buddy.buddy_node_id == last_buddy and step <= last_step:
+            return False  # same buddy already holds this (or a newer) step
+        # bounded lock hold: read header+bytes consistently, then push
+        # OUTSIDE the lock (a slow DCN push must not block the trainer's
+        # next snapshot)
+        if not self._shm.lock.acquire(timeout=10.0):
+            return False
+        try:
+            raw = self._shm.read_raw()
+            if raw is None:
+                return False
+            header, buf = raw
+            payload = bytes(buf[: int(header["total_size"])])
+        finally:
+            self._shm.lock.release()
+        step = int(header["step"])
+        if push_snapshot(buddy.addr, self._shm.node_id, header, payload):
+            self._last_pushed = (step, buddy.buddy_node_id)
+            logger.info("replicated snapshot step %d to buddy node %d "
+                        "(%s)", step, buddy.buddy_node_id, buddy.addr)
+            return True
+        return False
+
+    def _loop(self) -> None:
+        while not self._stopped.wait(self._interval_s):
+            try:
+                self.replicate_once()
+            except Exception:  # noqa: BLE001 - replication is best-effort
+                logger.exception("buddy replication failed")

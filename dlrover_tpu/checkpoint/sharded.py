@@ -98,17 +98,37 @@ def assemble(target_index: list[list[int]], dtype: np.dtype,
 class ShardedCheckpointEngine(CheckpointEngine):
     """Per-node shard snapshots + any-mesh restore.
 
-    ``owned`` decides which addressable shards this node snapshots; the
-    default (replica_id == 0) gives exactly-once coverage across a
-    multi-host job, since every element of a sharded array has its
-    replica-0 copy on exactly one device.
+    ``owned`` decides which addressable shards this node snapshots. The
+    default keeps, for every distinct shard index, this NODE's
+    lowest-replica copy — i.e. replicas are deduplicated within a node
+    but every node retains full coverage of the data its own devices
+    hold. A global replica_id==0 policy would be smaller (exactly-once
+    across the job) but leaves rank>0 nodes unable to restore
+    REPLICATED leaves (the step counter, norms — everything, under pure
+    dp) from their local shm: their restore would always fall through
+    to storage, defeating restart-in-place AND buddy replication. The
+    reference's per-rank shm snapshots make the same size-for-locality
+    trade (ckpt_saver.py: each rank snapshots its own state view).
     """
 
     def __init__(self, *args,
                  owned: Callable[[Any], bool] | None = None, **kwargs):
         kwargs.setdefault("replicated", False)
         super().__init__(*args, **kwargs)
-        self._owned = owned or (lambda shard: shard.replica_id == 0)
+        self._owned = owned  # None -> per-node replica dedup (default)
+
+    @staticmethod
+    def _node_owned_shards(leaf) -> list:
+        """This node's lowest-replica copy of each distinct shard index."""
+        best: dict = {}
+        for s in leaf.addressable_shards:
+            key = tuple(
+                tuple(pair) for pair in _norm_index(s.index, leaf.shape)
+            )
+            cur = best.get(key)
+            if cur is None or s.replica_id < cur.replica_id:
+                best[key] = s
+        return list(best.values())
 
     # ------------------------------------------------------------------ save
 
@@ -119,9 +139,13 @@ class ShardedCheckpointEngine(CheckpointEngine):
         index_map: dict[str, dict] = {}
         for name, leaf in _leaf_paths(state):
             if isinstance(leaf, jax.Array):
-                shards = [
-                    s for s in leaf.addressable_shards if self._owned(s)
-                ]
+                if self._owned is not None:
+                    shards = [
+                        s for s in leaf.addressable_shards
+                        if self._owned(s)
+                    ]
+                else:
+                    shards = self._node_owned_shards(leaf)
                 for i, s in enumerate(shards):
                     key = f"{name}{PIECE_SEP}{i}"
                     pieces[key] = s.data

@@ -177,6 +177,21 @@ class SharedMemoryHandler:
             out[name] = np.array(view) if copy else view
         return int(header["step"]), out
 
+    def write_raw(self, header: dict, payload: bytes) -> None:
+        """Install a snapshot received as raw bytes (buddy restore path:
+        checkpoint/buddy.py fetch_snapshot -> this node's arena). The
+        header becomes visible only after the bytes are in place, same
+        ordering as save_state_dict."""
+        total = int(header["total_size"])
+        if len(payload) < total:
+            raise ValueError(
+                f"payload {len(payload)} bytes < header total {total}"
+            )
+        with self._local_lock:
+            arena = self._ensure_arena(total)
+            arena.buf[:total] = payload[:total]
+        self.meta_dict.set(_HEADER_KEY, header)
+
     def read_raw(self) -> tuple[dict, memoryview] | None:
         """Agent-side zero-copy access: (header, raw buffer)."""
         header = self.header()

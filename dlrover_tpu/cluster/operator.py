@@ -214,6 +214,28 @@ class CrSync:
             logger.info("ElasticJob CR %s deleted; tearing down", gone)
             self._op.delete_job(gone)
             self._seen_specs.pop(gone, None)
+        # orphan sweep: pods whose job label matches NO live CR — e.g.
+        # the CR was deleted while the operator was down, so the
+        # _seen_specs diff above never saw it. Without this the master
+        # pod + workers + Service leak forever, holding TPU quota.
+        try:
+            orphan_jobs = {
+                p["metadata"].get("labels", {}).get("job")
+                for p in self._client.list_pods(
+                    self._ns, "app=dlrover-tpu")
+            } - names - {None}
+            for job in orphan_jobs:
+                logger.warning(
+                    "pods of job %s have no ElasticJob CR; cleaning up",
+                    job,
+                )
+                for pod in self._client.list_pods(self._ns,
+                                                  f"job={job}"):
+                    self._client.delete_pod(
+                        self._ns, pod["metadata"]["name"])
+                self._client.delete_service(self._ns, f"{job}-master")
+        except Exception:  # noqa: BLE001 - sweep is best-effort
+            logger.exception("orphan sweep failed")
         for mf in self._client.list_custom(self._ns, SCALEPLAN_PLURAL):
             if mf.get("status", {}).get("phase") == "Applied":
                 continue

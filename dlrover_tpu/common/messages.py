@@ -396,6 +396,33 @@ class BrainOptimizePlan:
     based_on_jobs: int = 0
 
 
+@register_message
+@dataclasses.dataclass
+class ReportBuddyEndpoint:
+    """Agent -> master: where this node's BuddyServer listens
+    (checkpoint/buddy.py peer-replication of shm snapshots)."""
+
+    node_id: int = 0
+    addr: str = ""
+
+
+@register_message
+@dataclasses.dataclass
+class BuddyQueryRequest:
+    node_id: int = 0
+
+
+@register_message
+@dataclasses.dataclass
+class BuddyQueryResponse:
+    """The ring buddy this node pushes to — and, after a relaunch,
+    fetches its own snapshot back from."""
+
+    found: bool = False
+    buddy_node_id: int = -1
+    addr: str = ""
+
+
 # ------------------------------------------------------------------- sync/ckpt
 
 

@@ -157,11 +157,15 @@ class NodeManager:
                         dead.append(node.node_id)
                 elif not node.is_alive(self._dead_window_s, now):
                     dead.append(node.node_id)
-            for nid in dead:
-                self._nodes[nid].status = NodeStatus.FAILED
-                self._nodes[nid].exit_reason = NodeExitReason.KILLED
         for nid in dead:
             logger.warning("node %d declared dead (no heartbeat)", nid)
+            # through update_status so the relaunch decision applies: a
+            # SIGKILLed/preempted host has no agent left to report its
+            # own failure, yet it must be replaced exactly like an
+            # agent-reported node failure (when a relaunch hook exists;
+            # without one the world shrinks, the elastic path)
+            self.update_status(nid, NodeStatus.FAILED,
+                               NodeExitReason.KILLED)
             self.broadcast_action("restart", exclude={nid})
             if self._on_node_dead:
                 self._on_node_dead(nid)

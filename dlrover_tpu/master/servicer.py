@@ -57,6 +57,8 @@ class MasterServicer:
         self.oom_bump_cooldown_s = 30.0
         self.job_exit_event = threading.Event()
         self.job_success: bool | None = None
+        # node_id -> BuddyServer addr (checkpoint/buddy.py replication)
+        self._buddy_endpoints: dict[int, str] = {}
 
     # The single entry point handed to RpcServer.
     def handle(self, msg: Any) -> Any:  # noqa: C901 - dispatch table
@@ -81,6 +83,11 @@ class MasterServicer:
             return m.KVStoreResponse(
                 found=True, number=self._kv_store.add(msg.key, msg.amount)
             )
+        if isinstance(msg, m.ReportBuddyEndpoint):
+            self._buddy_endpoints[msg.node_id] = msg.addr
+            return m.OkResponse()
+        if isinstance(msg, m.BuddyQueryRequest):
+            return self._buddy_query(msg)
         if isinstance(msg, m.NodeHeartbeat):
             action = self._node_manager.report_heartbeat(
                 msg.node_id, msg.restart_count
@@ -193,6 +200,29 @@ class MasterServicer:
             n = self._kv_store.add(f"sync/{msg.sync_name}", 0)
             return m.KVStoreResponse(found=True, number=n)
         raise TypeError(f"unhandled message type {type(msg).__name__}")
+
+    def _buddy_query(self, msg: m.BuddyQueryRequest
+                     ) -> m.BuddyQueryResponse:
+        """Ring buddy assignment over the alive nodes with registered
+        buddy endpoints: node i's buddy is the next such node after i
+        (wrapping), so pushes spread evenly and a relaunched node knows
+        exactly where its own snapshot lives. Reference analog: SURVEY §7
+        hard-parts (peer-redundant host-memory checkpoints)."""
+        alive = {
+            n.node_id for n in self._node_manager.running_nodes()
+        }
+        candidates = sorted(
+            nid for nid in self._buddy_endpoints
+            if nid != msg.node_id and (not alive or nid in alive)
+        )
+        if not candidates:
+            return m.BuddyQueryResponse(found=False)
+        nxt = next((nid for nid in candidates if nid > msg.node_id),
+                   candidates[0])
+        return m.BuddyQueryResponse(
+            found=True, buddy_node_id=nxt,
+            addr=self._buddy_endpoints[nxt],
+        )
 
     def _suggest_higher_accum(self, restart_count: int) -> None:
         """Device-OOM mitigation: double gradient accumulation (smaller

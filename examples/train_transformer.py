@@ -74,6 +74,10 @@ def parse_args(argv=None):
     p.add_argument("--crash-exit", type=int, default=17,
                    help="exit code for the injected crash (210=OOM, "
                         "211=hardware per the failure contract)")
+    p.add_argument("--step-delay", type=float, default=0.0,
+                   help="sleep this long after each step (fault-injection "
+                        "tests pace the run so kills land at a known "
+                        "training position)")
     p.add_argument("--crash-once-file", default="",
                    help="crash only if this marker file is absent "
                         "(created before crashing) — survives node "
@@ -294,6 +298,10 @@ def main(argv=None) -> int:
             loss = float(jax.device_get(metrics["loss"]))
             losses.append(loss)
             print(f"[trainer] step {step} loss {loss:.4f}", flush=True)
+        if args.step_delay > 0:
+            # sync first so the delay paces the DEVICE, not just dispatch
+            jax.device_get(metrics["loss"])
+            time.sleep(args.step_delay)
 
     start = time.monotonic()
     state = trainer.run_batches(

@@ -1,0 +1,378 @@
+"""Buddy-host shm checkpoint replication (checkpoint/buddy.py).
+
+Round-2 verdict Missing #4 / Next #5: shm snapshots only survived
+*process* death; TPU preemption kills the host VM. Every agent now
+streams new snapshots to a master-assigned ring buddy and a relaunched
+node pulls its snapshot back BEFORE spawning the trainer. The e2e here
+SIGKILLs an entire node (launcher + agent + trainer), lets the master
+relaunch it, and asserts the job resumed from the replicated in-memory
+snapshot with no committed storage checkpoint to fall back on.
+
+Reference analog: extends dlrover/python/elastic_agent/torch/
+ckpt_saver.py:313 restart-in-place beyond single-host survival
+(SURVEY §7 hard-parts).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.checkpoint.buddy import (
+    BuddyReplicator,
+    BuddyServer,
+    fetch_snapshot,
+    push_snapshot,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLE = os.path.join(REPO, "examples", "train_transformer.py")
+
+
+def _trainer_pids(node_id: int) -> list[int]:
+    """Find trainer processes of one node by their agent-set env (the
+    trainer runs in its own session, so killing the launcher's process
+    group alone leaves it computing as an orphan)."""
+    needle = f"DLROVER_TPU_NODE_ID={node_id}".encode()
+    pids = []
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read()
+            if b"train_transformer" not in cmd:
+                continue
+            with open(f"/proc/{pid}/environ", "rb") as f:
+                env = f.read()
+        except OSError:
+            continue
+        if needle + b"\x00" in env:
+            pids.append(int(pid))
+    return pids
+
+
+@pytest.fixture
+def server():
+    s = BuddyServer().start()
+    yield s
+    s.stop()
+
+
+class TestBuddyProtocol:
+    def test_push_get_roundtrip(self, server):
+        header = {"step": 7, "total_size": 1 << 20, "metas": {"w": {}}}
+        payload = os.urandom(1 << 20)
+        assert push_snapshot(server.addr, source=3, header=header,
+                             payload=payload)
+        got = fetch_snapshot(server.addr, source=3)
+        assert got is not None
+        got_header, got_payload = got
+        assert got_header["step"] == 7
+        assert got_payload == payload
+        assert server.holds(3) == 7
+
+    def test_get_missing_returns_none(self, server):
+        assert fetch_snapshot(server.addr, source=99) is None
+        assert server.holds(99) is None
+
+    def test_latest_push_wins(self, server):
+        push_snapshot(server.addr, 1, {"step": 1}, b"a")
+        push_snapshot(server.addr, 1, {"step": 2}, b"bb")
+        _, payload = fetch_snapshot(server.addr, 1)
+        assert payload == b"bb"
+        server.drop(1)
+        assert fetch_snapshot(server.addr, 1) is None
+
+    def test_push_to_dead_addr_is_false(self):
+        assert not push_snapshot("127.0.0.1:1", 0, {"step": 1}, b"x",
+                                 timeout_s=2.0)
+
+
+class TestShmRawRoundTrip:
+    def test_write_raw_restores_arrays(self, tmp_ipc_dir):
+        from dlrover_tpu.checkpoint.shm_handler import (
+            SharedMemoryHandler,
+        )
+
+        src = SharedMemoryHandler(70, owner=True)
+        try:
+            tree = {"w": np.arange(128, dtype=np.float32),
+                    "b": np.ones(3, dtype=np.int32)}
+            src.save_state_dict(11, tree)
+            header, buf = src.read_raw()
+            payload = bytes(buf[: int(header["total_size"])])
+        finally:
+            src.close(unlink=True)
+
+        dst = SharedMemoryHandler(71, owner=True)
+        try:
+            assert dst.header() is None
+            dst.write_raw(header, payload)
+            step, arrays = dst.load_arrays()
+            assert step == 11
+            np.testing.assert_array_equal(
+                arrays["w"], np.arange(128, dtype=np.float32))
+            np.testing.assert_array_equal(
+                arrays["b"], np.ones(3, dtype=np.int32))
+        finally:
+            dst.close(unlink=True)
+
+    def test_write_raw_rejects_short_payload(self, tmp_ipc_dir):
+        from dlrover_tpu.checkpoint.shm_handler import (
+            SharedMemoryHandler,
+        )
+
+        h = SharedMemoryHandler(72, owner=True)
+        try:
+            with pytest.raises(ValueError, match="payload"):
+                h.write_raw({"total_size": 128, "step": 1, "metas": {}},
+                            b"short")
+        finally:
+            h.close(unlink=True)
+
+
+class _FakeBuddyClient:
+    def __init__(self, addr):
+        self._addr = addr
+
+    def query_buddy(self):
+        from dlrover_tpu.common.messages import BuddyQueryResponse
+
+        return BuddyQueryResponse(found=True, buddy_node_id=9,
+                                  addr=self._addr)
+
+
+class TestReplicator:
+    def test_replicates_new_snapshots_once(self, tmp_ipc_dir, server):
+        from dlrover_tpu.checkpoint.shm_handler import (
+            SharedMemoryHandler,
+        )
+
+        h = SharedMemoryHandler(73, owner=True)
+        try:
+            rep = BuddyReplicator(h, _FakeBuddyClient(server.addr))
+            assert not rep.replicate_once()  # nothing snapshotted yet
+            h.save_state_dict(5, {"w": np.zeros(16, np.float32)})
+            assert rep.replicate_once()
+            assert server.holds(73) == 5
+            assert not rep.replicate_once()  # same step: no re-push
+            h.save_state_dict(6, {"w": np.ones(16, np.float32)})
+            assert rep.replicate_once()
+            header, payload = fetch_snapshot(server.addr, 73)
+            assert header["step"] == 6
+            view = np.frombuffer(
+                payload[: 16 * 4], dtype=np.float32)
+            np.testing.assert_array_equal(view, np.ones(16, np.float32))
+        finally:
+            h.close(unlink=True)
+
+
+class TestMasterRingAssignment:
+    def test_ring_over_registered_endpoints(self, tmp_ipc_dir):
+        from dlrover_tpu.agent.master_client import MasterClient
+        from dlrover_tpu.master.job_master import JobMaster
+
+        master = JobMaster(min_nodes=1, max_nodes=3)
+        master.prepare()
+        try:
+            clients = {
+                nid: MasterClient(master.addr, node_id=nid)
+                for nid in (0, 1, 2)
+            }
+            assert not clients[0].query_buddy().found  # nobody registered
+            for nid, c in clients.items():
+                c.report_buddy_endpoint(f"127.0.0.1:{9000 + nid}")
+            assert clients[0].query_buddy().buddy_node_id == 1
+            assert clients[1].query_buddy().buddy_node_id == 2
+            assert clients[2].query_buddy().buddy_node_id == 0  # wrap
+            # a node alone in the ring has no buddy
+            solo = JobMaster(min_nodes=1, max_nodes=1)
+            solo.prepare()
+            try:
+                c = MasterClient(solo.addr, node_id=0)
+                c.report_buddy_endpoint("127.0.0.1:9999")
+                assert not c.query_buddy().found
+            finally:
+                solo.stop()
+        finally:
+            master.stop()
+
+
+@pytest.mark.timeout(500)
+def test_sigkilled_node_restores_from_buddy(tmp_path, monkeypatch):
+    """Kill node 1 wholesale (launcher+agent+trainer: its shm header dies
+    with the agent); the master relaunches it; the replacement restores
+    the replicated snapshot from node 0 and the 2-node job finishes.
+
+    Determinism: FSDP strategy so each node owns real shard pieces
+    (under pure dp, replica-0 dedup gives node 1 an empty shard set and
+    nothing to replicate); ONE snapshot point (step 12 of 20, ~5s of
+    0.4s steps away from the next) so survivors' local shm and the buddy
+    copy can only ever hold step 12; the kill fires once BOTH buddies
+    hold it. Storage never commits (ckpt-interval huge; the 2-shard
+    commit can't complete with one shard missing), so resumed_from==12
+    proves the restore came through the buddy path within the recovery
+    window."""
+    from dlrover_tpu.cluster.crd import ScalePlan
+    from dlrover_tpu.cluster.scaler import LocalProcessScaler
+    from dlrover_tpu.master.job_master import JobMaster
+
+    monkeypatch.setenv("DLROVER_TPU_PLATFORM", "cpu")
+    monkeypatch.setenv("DLROVER_TPU_DEVICE_COUNT", "4")
+    monkeypatch.setenv("DLROVER_TPU_IPC_DIR", str(tmp_path / "ipc"))
+    monkeypatch.setenv("PYTHONPATH", REPO)
+    monkeypatch.setenv("DLROVER_TPU_BUDDY_INTERVAL", "0.1")
+    monkeypatch.setenv(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=4"
+    )
+
+    master = JobMaster(min_nodes=2, max_nodes=2, rdzv_timeout=8.0,
+                       heartbeat_dead_window_s=4.0)
+    result_file = str(tmp_path / "result.json")
+    scaler = LocalProcessScaler(
+        master_addr="",
+        entrypoint=[
+            "--monitor-interval", "0.3", "--max-restarts", "2",
+            "--nnodes", "2", "--heartbeat-interval", "1",
+            EXAMPLE, "--",
+            "--model", "tiny", "--seq", "128", "--global-batch", "64",
+            "--strategy", "fsdp",
+            "--max-steps", "20", "--step-delay", "0.5",
+            "--mem-ckpt-interval", "12",
+            "--ckpt-interval", "1000000",
+            "--ckpt-dir", str(tmp_path / "ckpt"),
+            "--result-file", result_file,
+            # frequent loss syncs keep host dispatch from running ahead
+            # of the device past the next snapshot point
+            "--log-interval", "2",
+        ],
+    )
+    master.node_manager._relaunch_hook = scaler.relaunch_node
+    master.prepare()
+    scaler._master_addr = master.addr
+    done = {}
+
+    def run_master():
+        done["ok"] = master.run(poll_interval_s=0.2,
+                                all_exited_grace_s=5.0)
+
+    t = threading.Thread(target=run_master, daemon=True)
+    try:
+        scaler.scale(ScalePlan(replica_resources={"worker": 2}))
+        t.start()
+
+        # wait until BOTH buddies hold the step-12 snapshot
+        deadline = time.time() + 240
+        ready = False
+        while time.time() < deadline and not ready:
+            eps = dict(master.servicer._buddy_endpoints)
+            if len(eps) == 2:
+                held = {}
+                for nid, other in ((0, 1), (1, 0)):
+                    got = fetch_snapshot(eps[nid], source=other,
+                                         timeout_s=5.0)
+                    held[other] = got[0]["step"] if got else None
+                ready = held.get(0) == 12 and held.get(1) == 12
+            if not ready:
+                time.sleep(0.3)
+        assert ready, "buddies never both held the step-12 snapshot"
+        assert not os.path.exists(tmp_path / "ckpt" / "latest"), \
+            "storage committed a checkpoint; test premise broken"
+
+        kill_t = time.monotonic()
+        # the ENTIRE node dies at once: launcher+agent group AND the
+        # trainer's own session (simulates host preemption)
+        trainers = _trainer_pids(1)
+        os.killpg(scaler._procs[1].pid, signal.SIGKILL)
+        for pid in trainers:
+            try:
+                os.killpg(pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        assert trainers, "node 1 trainer not found to kill"
+
+        t.join(timeout=400)
+        assert not t.is_alive(), "job never finished after node kill"
+        assert done.get("ok"), "job did not finish successfully"
+        recover_s = time.monotonic() - kill_t
+        result = json.load(open(result_file))
+        assert result["final_step"] == 20
+        assert result["num_nodes"] == 2
+        # restored from the replicated in-memory snapshot — storage had
+        # no committed step to offer
+        assert result["resumed_from"] == 12
+        nodes = {n.node_id: n for n in master.node_manager.all_nodes()}
+        assert nodes[1].relaunch_count == 1
+        print(f"\nbuddy recovery wall time: {recover_s:.1f}s "
+              "(includes dead-window + respawn + restore)")
+    finally:
+        scaler.stop_all()
+        master.stop()
+
+
+class _SwitchableBuddyClient:
+    def __init__(self):
+        self.addr = ""
+        self.buddy_id = 0
+
+    def query_buddy(self):
+        from dlrover_tpu.common.messages import BuddyQueryResponse
+
+        return BuddyQueryResponse(found=True, buddy_node_id=self.buddy_id,
+                                  addr=self.addr)
+
+
+class TestReplicatorReassignment:
+    def test_repushes_current_snapshot_to_new_buddy(self, tmp_ipc_dir):
+        """Ring reassignment (old buddy died) must re-push the CURRENT
+        snapshot to the new buddy, or the node is unprotected until the
+        next snapshot (review finding)."""
+        from dlrover_tpu.checkpoint.shm_handler import (
+            SharedMemoryHandler,
+        )
+
+        a, b = BuddyServer().start(), BuddyServer().start()
+        h = SharedMemoryHandler(74, owner=True)
+        try:
+            client = _SwitchableBuddyClient()
+            client.addr, client.buddy_id = a.addr, 1
+            rep = BuddyReplicator(h, client)
+            h.save_state_dict(9, {"w": np.zeros(8, np.float32)})
+            assert rep.replicate_once()
+            assert a.holds(74) == 9
+            # buddy reassigned: same step must go to the NEW server
+            client.addr, client.buddy_id = b.addr, 2
+            assert rep.replicate_once()
+            assert b.holds(74) == 9
+            assert not rep.replicate_once()  # now settled
+        finally:
+            h.close(unlink=True)
+            a.stop()
+            b.stop()
+
+
+class TestServerBounds:
+    def test_oversized_push_rejected(self, server, monkeypatch):
+        monkeypatch.setenv("DLROVER_TPU_BUDDY_MAX_BYTES", "1024")
+        assert not push_snapshot(server.addr, 1, {"step": 1},
+                                 b"x" * 2048)
+        assert server.holds(1) is None
+
+    def test_store_evicts_beyond_max_sources(self):
+        s = BuddyServer(max_sources=2).start()
+        try:
+            for src in (1, 2, 3):
+                push_snapshot(s.addr, src, {"step": src}, b"p")
+            assert s.holds(1) is None      # oldest evicted
+            assert s.holds(2) == 2
+            assert s.holds(3) == 3
+        finally:
+            s.stop()
