@@ -52,10 +52,17 @@ def bench_train_step(extra: dict) -> None:
     # ~2% step time.
     if on_tpu:
         # splash (tuned 512 blocks + fused bwd) measured fastest of the
-        # attention kernels at this geometry
+        # attention kernels at this geometry; full scan unroll lets XLA
+        # schedule weight prefetch across layers (r03 sweep: 0.393 vs
+        # 0.382 MFU). Attention impl and CE chunking measured invariant
+        # at b32/s1024; no-remat configs (est. ~0.43+) fail the axon
+        # remote-compile service with HTTP 500, and the chip's matmul
+        # roofline (76% on the vocab dot, d_model=768-bound layers)
+        # caps the practical MFU near this point.
         cfg = dataclasses.replace(
             tfm.CONFIGS[model], remat_scan=True,
             remat_policy="dots_no_batch", attention="splash", ce_chunks=16,
+            scan_unroll=12,
         )
     else:
         cfg = dataclasses.replace(tfm.CONFIGS[model], remat_scan=True,
@@ -200,13 +207,17 @@ def bench_long_context(extra: dict) -> None:
         extra["lc_dense_error"] = f"{type(e).__name__}"
 
 
-def bench_checkpoint(extra: dict) -> dict:
-    """Host-side snapshot/restore path; ~1.5 GB GPT-2-small-class state."""
+def bench_checkpoint(extra: dict, gb: float | None = None,
+                     prefix: str = "ckpt_") -> dict:
+    """Host-side snapshot/restore path. Default ~1.5 GB GPT-2-small-class
+    state; called again with ``gb`` ~12 for the 1B-param config
+    (BASELINE configs 2-3; reference flash_checkpoint.md GPT-2 1.5B)."""
     os.environ.setdefault("DLROVER_TPU_IPC_DIR",
                           tempfile.mkdtemp(prefix="bench_ipc_"))
     from dlrover_tpu.checkpoint.engine import CheckpointEngine
 
-    gb = float(os.environ.get("BENCH_CKPT_GB", "1.5"))
+    if gb is None:
+        gb = float(os.environ.get("BENCH_CKPT_GB", "1.5"))
     n = int(gb * (1 << 30) / 12)  # params + adam mu/nu, fp32
     rng = np.random.default_rng(0)
     state = {
@@ -256,23 +267,39 @@ def bench_checkpoint(extra: dict) -> dict:
 
         t0 = time.monotonic()
         engine.save_to_storage(last_step + 1, state)
-        persisted = engine.wait_for_persist(last_step + 1, timeout=300)
+        persisted = engine.wait_for_persist(last_step + 1, timeout=600)
         persist_s = time.monotonic() - t0
+
+        # cold storage restore: the path a REAL preemption runs (fresh
+        # host: no shm). Drop the shm header so load() takes the storage
+        # branch (round-2 Weak #6: this leg was never measured).
+        engine.shm_handler.clear()
+        t0 = time.monotonic()
+        loaded = engine.load(state)
+        cold_restore_s = time.monotonic() - t0
+        assert loaded is not None and loaded[0] == last_step + 1
+        np.testing.assert_array_equal(
+            loaded[1]["params"]["w"][:1024], state["params"]["w"][:1024]
+        )
     finally:
         engine.close()
 
-    extra.update(
-        ckpt_state_gb=round(state_gb, 2),
-        ckpt_save_block_s=round(save_s, 3),
-        ckpt_restore_s=round(restore_s, 3),
-        ckpt_restore_copy_s=round(restore_copy_s, 3),
-        ckpt_persist_async_s=round(persist_s, 2) if persisted else None,
-        ckpt_note="host-side snapshot path; D2H excluded (axon tunnel "
-                  "runs ~0.02 GB/s, unrepresentative of a TPU host). "
-                  "Rebaselined in r02: ckpt_restore_s now times the "
-                  "production zero-copy view path (the old full-copy "
-                  "number moved to ckpt_restore_copy_s)",
-    )
+    extra.update({
+        f"{prefix}state_gb": round(state_gb, 2),
+        f"{prefix}save_block_s": round(save_s, 3),
+        f"{prefix}restore_s": round(restore_s, 3),
+        f"{prefix}restore_copy_s": round(restore_copy_s, 3),
+        f"{prefix}persist_async_s":
+            round(persist_s, 2) if persisted else None,
+        f"{prefix}cold_storage_restore_s": round(cold_restore_s, 2),
+    })
+    if prefix == "ckpt_":
+        extra["ckpt_note"] = (
+            "host-side snapshot path; D2H excluded (axon tunnel runs "
+            "~0.02 GB/s, unrepresentative of a TPU host). ckpt_restore_s "
+            "times the production zero-copy view path; "
+            "cold_storage_restore_s is the fresh-host storage read"
+        )
     return {"save_s": save_s}
 
 
@@ -287,17 +314,19 @@ def _run_elastic_job(work: str, env: dict, train_args: list[str],
 
     repo = os.path.dirname(os.path.abspath(__file__))
     log = os.path.join(work, "goodput.jsonl")
+    job_log = os.path.join(work, "job.log")
     t_launch = time.time()
-    # own session: on deadline overrun the whole tree (agent + the
-    # standalone master it spawned + trainer) dies with one killpg —
-    # a surviving master would hold the merged stdout pipe open and
-    # wedge communicate() below
+    # stdout to a FILE, not a pipe: nobody drains a pipe during the run,
+    # and a full 64KB pipe buffer blocks every child's write — the whole
+    # elastic job wedges mid-scenario (seen in verification). Own
+    # session so a deadline overrun kills the whole tree with one killpg.
+    log_f = open(job_log, "wb")
     proc = subprocess.Popen(
         [sys.executable, "-m", "dlrover_tpu.run", "--standalone",
          "--max-restarts", str(kills + 2), "--monitor-interval", "0.3",
          example, "--", *train_args, "--max-steps", str(max_steps)],
-        env=env, cwd=repo, stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT, text=True, start_new_session=True,
+        env=env, cwd=repo, stdout=log_f,
+        stderr=subprocess.STDOUT, start_new_session=True,
     )
 
     def _kill_tree() -> None:
@@ -306,8 +335,8 @@ def _run_elastic_job(work: str, env: dict, train_args: list[str],
         except (ProcessLookupError, PermissionError):
             pass
         # the standalone master detaches into its own session (run.py
-        # launch_local_master) yet inherits our stdout pipe — it must
-        # die too or communicate() blocks on the open write end
+        # launch_local_master), so killpg misses it — an orphaned master
+        # would keep holding its port and IPC names
         subprocess.run(
             ["pkill", "-9", "-f", "dlrover_tpu.master.job_master"],
             capture_output=True,
@@ -339,14 +368,21 @@ def _run_elastic_job(work: str, env: dict, train_args: list[str],
         if proc.poll() is None:
             _kill_tree()
         try:
-            out, _ = proc.communicate(timeout=60)
+            proc.wait(timeout=60)
         except subprocess.TimeoutExpired:
             _kill_tree()
-            out, _ = proc.communicate(timeout=30)
+            proc.wait(timeout=30)
     finally:
         if proc.poll() is None:
             _kill_tree()
-    return proc.returncode, out[-2000:], killed, t_launch, time.time()
+        log_f.close()
+    try:
+        with open(job_log, "rb") as f:
+            f.seek(max(0, os.path.getsize(job_log) - 2000))
+            tail = f.read().decode(errors="replace")
+    except OSError:
+        tail = ""
+    return proc.returncode, tail, killed, t_launch, time.time()
 
 
 def _snapshot_cost_s(log_path: str, mem_interval: int) -> float:
@@ -530,6 +566,60 @@ def bench_goodput(extra: dict) -> None:
         )
 
 
+def bench_checkpoint_1b(extra: dict) -> None:
+    """GPT-2-1.5B-class (~1B-param, 12 GB fp32 state) checkpoint config
+    (BASELINE configs 2-3; reference flash_checkpoint.md:317). Skipped
+    with a note when host RAM can't hold state + arena + page cache."""
+    gb = float(os.environ.get("BENCH_CKPT_1B_GB", "12"))
+    try:
+        avail_kb = int(next(
+            line.split()[1]
+            for line in open("/proc/meminfo")
+            if line.startswith("MemAvailable")
+        ))
+    except (OSError, StopIteration, ValueError):
+        avail_kb = 0
+    if avail_kb and avail_kb < gb * 3 * (1 << 20):
+        extra["ckpt1b_skipped"] = (
+            f"need ~{gb * 3:.0f}GB RAM, have {avail_kb >> 20}GB"
+        )
+        return
+    bench_checkpoint(extra, gb=gb, prefix="ckpt1b_")
+
+
+def bench_7b_aot(extra: dict) -> None:
+    """Llama-7B FSDP on a virtual v5p-128 mesh, AOT: compiles the full
+    sharded train step and reports per-device memory/FLOPs/collectives
+    without touching hardware (parallel/aot_report.py). Subprocess so
+    the 128-device CPU backend can't collide with the live TPU client."""
+    import subprocess
+
+    if os.environ.get("BENCH_7B_AOT", "1") == "0":
+        return
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.update({
+        "DLROVER_TPU_PLATFORM": "cpu",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": (env.get("XLA_FLAGS", "")
+                      + " --xla_force_host_platform_device_count=128"
+                      ).strip(),
+        "PYTHONPATH": env.get("PYTHONPATH", "") + os.pathsep + repo,
+    })
+    proc = subprocess.run(
+        [sys.executable, "-m", "dlrover_tpu.parallel.aot_report",
+         "--model", os.environ.get("BENCH_AOT_MODEL", "llama2-7b"),
+         "--strategy", "fsdp", "--batch", "128", "--seq", "4096"],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=3600,
+    )
+    line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() \
+        else ""
+    try:
+        extra["aot_7b"] = json.loads(line)
+    except json.JSONDecodeError:
+        extra["aot_7b_error"] = (proc.stderr or line)[-400:]
+
+
 def main() -> None:
     extra: dict = {}
     errors = []
@@ -539,6 +629,14 @@ def main() -> None:
         save_s = ckpt["save_s"]
     except Exception as e:  # noqa: BLE001
         errors.append(f"ckpt: {type(e).__name__}: {e}")
+    try:
+        bench_checkpoint_1b(extra)
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"ckpt1b: {type(e).__name__}: {e}")
+    try:
+        bench_7b_aot(extra)
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"aot7b: {type(e).__name__}: {e}")
     try:
         bench_train_step(extra)
     except Exception as e:  # noqa: BLE001

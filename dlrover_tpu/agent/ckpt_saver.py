@@ -336,6 +336,16 @@ class AsyncCheckpointSaver:
             return
         step = int(header["step"])
         if step <= self._last_persisted_step:
+            # already persisted — but its background COMMIT may still be
+            # polling for peer shards; exiting now would orphan a fully
+            # durable checkpoint the tracker never points at. Join it
+            # briefly (same budget as the persist path's commit join).
+            with self._commit_lock:
+                waiter = self._commit_waiters.get(
+                    self._last_persisted_step
+                )
+            if waiter is not None:
+                waiter.join(timeout=15.0)
             return
         logger.info("breakpoint save of step %d (%s)", step, reason)
         # short commit join: this path often precedes process exit, and

@@ -220,10 +220,11 @@ class BuddyReplicator:
         self._shm = shm_handler
         self._client = master_client
         self._interval_s = interval_s
-        # (step, buddy) of the last successful push: a ring reassignment
-        # must re-push the CURRENT snapshot to the new buddy, or the
-        # node is unprotected until the next snapshot
-        self._last_pushed: tuple[int, int] = (-1, -1)
+        # (step, buddy ADDR) of the last successful push: a ring
+        # reassignment — or the same buddy node relaunching with a fresh
+        # empty server (new port) — must re-push the CURRENT snapshot,
+        # or the node is unprotected until its next snapshot
+        self._last_pushed: tuple[int, str] = (-1, "")
         self._stopped = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, name="buddy-replicator", daemon=True
@@ -245,9 +246,9 @@ class BuddyReplicator:
         buddy = self._client.query_buddy()
         if not buddy.found:
             return False
-        last_step, last_buddy = self._last_pushed
-        if buddy.buddy_node_id == last_buddy and step <= last_step:
-            return False  # same buddy already holds this (or a newer) step
+        last_step, last_addr = self._last_pushed
+        if buddy.addr == last_addr and step <= last_step:
+            return False  # same server already holds this (or newer) step
         # bounded lock hold: read header+bytes consistently, then push
         # OUTSIDE the lock (a slow DCN push must not block the trainer's
         # next snapshot)
@@ -263,7 +264,7 @@ class BuddyReplicator:
             self._shm.lock.release()
         step = int(header["step"])
         if push_snapshot(buddy.addr, self._shm.node_id, header, payload):
-            self._last_pushed = (step, buddy.buddy_node_id)
+            self._last_pushed = (step, buddy.addr)
             logger.info("replicated snapshot step %d to buddy node %d "
                         "(%s)", step, buddy.buddy_node_id, buddy.addr)
             return True

@@ -50,6 +50,10 @@ class TransformerConfig:
     # backward; "save_attn" keeps the (cheap, bf16) attention outputs so
     # the backward skips re-running attention to rebuild FFN inputs
     remat_policy: str = "nothing"
+    # lax.scan unroll factor for the layer stack: >1 lets XLA overlap
+    # weight prefetch/scheduling across adjacent layers at the cost of
+    # program size (still one remat boundary per layer)
+    scan_unroll: int = 1
     attention: str = "dense"    # "dense" | "flash" | "splash" | "ring"
     # splash only: sliding-window size (0 = full causal); the sparse
     # kernel skips fully-masked blocks, so long seqs pay O(S * window)
@@ -118,6 +122,16 @@ LAYER_REMAT_POLICIES = {
     # inputs); measured slightly ahead of save_attn on gpt2-small
     "dots_no_batch":
         jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    # save EVERY matmul output: minimal recompute (the backward re-runs
+    # only elementwise ops), highest residual memory — the MFU pick when
+    # the model still fits HBM with it
+    "dots": jax.checkpoint_policies.dots_saveable,
+    # save the two most expensive recomputes (attention output and the
+    # gelu'd FFN hidden) by name: most of "dots"' recompute savings at a
+    # fraction of its residual memory
+    "save_attn_ffn": jax.checkpoint_policies.save_only_these_names(
+        "attn_out", "ffn_hidden"
+    ),
 }
 
 
@@ -337,10 +351,16 @@ def forward_with_aux(
     attn = attention_fn or dense_attention
 
     B, S = tokens.shape
-    x = params["embed"].astype(dt)[tokens]
+    # pin the gather result BEFORE the position add: with the table
+    # sharded (vocab x embed) and tokens (batch x sequence), the
+    # partitioner otherwise leaves the gather's layout ambiguous and
+    # falls back to involuntary full rematerialization of the embedding
+    # (seen in the r02 4D dryrun tail)
+    x = pin(params["embed"].astype(dt)[tokens],
+            ("batch", "sequence", "embed"))
     if c.variant == "gpt2":
         x = x + params["pos_embed"].astype(dt)[:S][None]
-    x = pin(x, ("batch", "sequence", "embed"))
+        x = pin(x, ("batch", "sequence", "embed"))
 
     n_rep = c.n_heads // c.n_kv_heads
 
@@ -403,6 +423,7 @@ def forward_with_aux(
                 jnp.einsum("bse,ef->bsf", h, w["w_gate"].astype(dt))
                 + w["b_ff"].astype(dt)
             )
+            hidden = checkpoint_name(hidden, "ffn_hidden")
             ff = (jnp.einsum("bsf,fe->bse", hidden, w["w_down"].astype(dt))
                   + w["b_out"].astype(dt))
         x = pin(x + ff, ("batch", "sequence", "embed"))
@@ -443,7 +464,8 @@ def forward_with_aux(
             return (x, aux + inc), None
 
         (x, aux), _ = lax.scan(
-            scan_body, (x, jnp.zeros((), jnp.float32)), params["layers"]
+            scan_body, (x, jnp.zeros((), jnp.float32)), params["layers"],
+            unroll=max(1, c.scan_unroll),
         )
 
     x = _norm(x, params["ln_f"], params.get("ln_f_b"), c.variant)

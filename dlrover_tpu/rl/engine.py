@@ -1,0 +1,183 @@
+"""PPO model engine: actor/critic/reference under the strategy layer.
+
+Reference analog: ATorch's RL model_engine
+(atorch/atorch/rl/model_engine/model_engine.py:1 — per-model
+parallelization strategies, a vLLM generation backend, weight sync
+between trainer and inference engines). TPU-native shape: every model
+lives on ONE jax mesh; "per-model strategy" means per-model SHARDING
+RULES compiled into the same SPMD programs — the actor/critic trains
+under its strategy's partition specs (with optimizer-state sharding
+derived ZeRO-style), the frozen reference model can use a different
+(e.g. memory-lean, tensor-only) layout, and "weight sync" between train
+and inference engines is the identity: the KV-cached decode
+(models/decode.py) jit-shares the very parameter buffers the update
+step produces, so generation is never stale.
+
+The single-host PPOTrainer (rl/ppo.py) stays as the compact reference
+implementation; ShardedPPOTrainer reuses its rollout/update logic with
+sharded jits, so the algorithm has exactly one source of truth.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.models import transformer as tfm
+from dlrover_tpu.parallel.mesh import batch_axes
+from dlrover_tpu.parallel.strategy import Strategy
+from dlrover_tpu.rl.ppo import (
+    PPOConfig,
+    PPOTrainer,
+    init_actor_critic,
+    ppo_loss,
+    sample,
+    sequence_logprobs_and_values,
+)
+from dlrover_tpu.trainer.train_step import derive_opt_specs
+
+logger = get_logger(__name__)
+
+
+def actor_critic_logical(cfg: tfm.TransformerConfig) -> dict:
+    """Logical axes for the actor+value-head tree: the transformer reuses
+    the pretraining rules; the value head (one d_model vector) replicates
+    (its name is outside every rule table)."""
+    return {
+        "model": tfm.logical_axes(cfg),
+        "value_head": ("value_dim",),
+    }
+
+
+class ShardedPPOTrainer(PPOTrainer):
+    """PPOTrainer whose models, optimizer state, rollout, and update run
+    sharded over a mesh — per-model strategies included.
+
+    ``strategy`` shards the trained actor/critic (params + Adam state +
+    batch); ``ref_strategy`` (default: same rules) lays out the frozen
+    reference model, which carries no optimizer state and may prefer a
+    different split. The KV-cached decode runs inside jit on the same
+    mesh with the actor's shardings, batch over the data axes.
+    """
+
+    def __init__(self, cfg: tfm.TransformerConfig, ppo: PPOConfig,
+                 reward_fn, key: jax.Array,
+                 strategy: Strategy | None = None,
+                 ref_strategy: Strategy | None = None,
+                 devices=None, optimizer=None,
+                 store_rollouts: bool = False):
+        import optax
+
+        from dlrover_tpu.rl.ppo import ReplayBuffer
+
+        from dlrover_tpu.parallel.strategy import dp as dp_preset
+
+        self.cfg = cfg
+        self.ppo = ppo
+        self.reward_fn = reward_fn
+        self.strategy = strategy or dp_preset()
+        self.mesh = self.strategy.build_mesh(devices)
+        mesh = self.mesh
+        self.buffer = ReplayBuffer() if store_rollouts else None
+
+        logical = actor_critic_logical(cfg)
+        param_specs = self.strategy.specs(logical, mesh)
+        param_shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), param_specs,
+            is_leaf=lambda x: isinstance(x, PartitionSpec),
+        )
+        ref_rules = (ref_strategy or self.strategy)
+        ref_shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s),
+            ref_rules.specs(logical, mesh),
+            is_leaf=lambda x: isinstance(x, PartitionSpec),
+        )
+
+        # data-parallel batch layout for [B, ...] rollout fields
+        axes = batch_axes(mesh)
+        dp_spec = PartitionSpec(
+            axes if len(axes) > 1 else (axes[0] if axes else None)
+        )
+        self._dp_sharding = NamedSharding(mesh, dp_spec)
+        replicated = NamedSharding(mesh, PartitionSpec())
+
+        self.params = jax.jit(
+            partial(init_actor_critic, cfg), out_shardings=param_shardings
+        )(key)
+        # the frozen reference starts as the actor's weights, laid out
+        # under ITS strategy (reference model_engine: one strategy per
+        # model). Identity-jit rather than device_put: leaves whose ref
+        # sharding equals the actor's would otherwise ALIAS the actor
+        # buffers, and the first donated update would delete them out
+        # from under the reference model.
+        self.ref_params = jax.jit(
+            lambda t: t, out_shardings=ref_shardings
+        )(self.params)
+
+        self.opt = optimizer or optax.adam(ppo.learning_rate)
+        opt_specs = derive_opt_specs(self.opt, self.params, param_specs)
+        opt_shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), opt_specs,
+            is_leaf=lambda x: isinstance(x, PartitionSpec),
+        )
+        self.opt_state = jax.jit(
+            self.opt.init, out_shardings=opt_shardings
+        )(self.params)
+
+        # ---- sharded jits: same algorithm objects as the base class
+        if cfg.moe_experts:
+            self._sample = jax.jit(
+                lambda params, prompts, key: sample(
+                    params, prompts, cfg, ppo, key
+                ),
+                in_shardings=(param_shardings, self._dp_sharding, None),
+            )
+        else:
+            from dlrover_tpu.models.decode import generate
+
+            self._sample = jax.jit(
+                lambda params, prompts, key: generate(
+                    params["model"], prompts, cfg, ppo.gen_len, key,
+                    temperature=ppo.temperature,
+                ),
+                in_shardings=(param_shardings, self._dp_sharding, None),
+            )
+        self._logp_values = jax.jit(
+            partial(sequence_logprobs_and_values, cfg=cfg),
+            # ref params arrive with THEIR shardings; jit resolves both
+            # layouts against the same program via the arg shardings
+            in_shardings=(None, self._dp_sharding),
+        )
+
+        def update(params, opt_state, batch):
+            (loss, metrics), grads = jax.value_and_grad(
+                ppo_loss, has_aux=True
+            )(params, batch, cfg, ppo)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            metrics["loss"] = loss
+            return params, opt_state, metrics
+
+        batch_shardings = {
+            "tokens": self._dp_sharding,
+            "old_logp": self._dp_sharding,
+            "advantages": self._dp_sharding,
+            "returns": self._dp_sharding,
+            "gen_mask": self._dp_sharding,
+            "score_mean": replicated,
+        }
+        self._update = jax.jit(
+            update,
+            in_shardings=(param_shardings, opt_shardings,
+                          batch_shardings),
+            out_shardings=(param_shardings, opt_shardings, None),
+            donate_argnums=(0, 1),
+        )
+        logger.info(
+            "sharded ppo engine: mesh %s, actor strategy %s, ref %s",
+            dict(mesh.shape), self.strategy.name, ref_rules.name,
+        )

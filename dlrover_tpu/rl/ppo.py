@@ -217,7 +217,13 @@ class PPOTrainer:
         # full batch per step plus host memory for the window
         self.buffer = ReplayBuffer() if store_rollouts else None
         if cfg.moe_experts:
-            self._sample = jax.jit(partial(sample, cfg=cfg, ppo=ppo))
+            # positional (params, prompts, key) signature: sharded jits
+            # pass in_shardings, and pjit forbids kwargs with those
+            self._sample = jax.jit(
+                lambda params, prompts, key: sample(
+                    params, prompts, cfg, ppo, key
+                )
+            )
         else:
             from dlrover_tpu.models.decode import generate
 
@@ -249,7 +255,7 @@ class PPOTrainer:
     def rollout(self, prompts: np.ndarray, key: jax.Array) -> dict:
         """One PPO batch from prompts [B, P]."""
         P = prompts.shape[1]
-        tokens = self._sample(self.params, jnp.asarray(prompts), key=key)
+        tokens = self._sample(self.params, jnp.asarray(prompts), key)
         logp, values, _ = self._logp_values(self.params, tokens)
         ref_logp, _, _ = self._logp_values(self.ref_params, tokens)
 

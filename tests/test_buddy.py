@@ -353,6 +353,16 @@ class TestReplicatorReassignment:
             assert rep.replicate_once()
             assert b.holds(74) == 9
             assert not rep.replicate_once()  # now settled
+            # SAME buddy id relaunches with a fresh empty server (new
+            # port): suppression must key on the address, not the id
+            b.drop(74)
+            c = BuddyServer().start()
+            try:
+                client.addr, client.buddy_id = c.addr, 2
+                assert rep.replicate_once()
+                assert c.holds(74) == 9
+            finally:
+                c.stop()
         finally:
             h.close(unlink=True)
             a.stop()
