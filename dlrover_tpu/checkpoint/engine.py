@@ -143,6 +143,7 @@ class CheckpointEngine:
     def load(self, template: Any,
              put: Callable[[str, np.ndarray], Any] | None = None,
              zero_copy: bool = False,
+             step: int | None = None,
              ) -> tuple[int, Any] | None:
         """Restore the newest checkpoint: shm first, then storage.
 
@@ -151,12 +152,18 @@ class CheckpointEngine:
         return something that does NOT alias the input — retained views are
         overwritten by the next snapshot and block arena growth. Requires
         ``put``; explicit opt-in because safety depends on the callback.
+
+        ``step`` pins the restore to a specific persisted step (best-model
+        reload) instead of the newest; the shm fast path only applies when
+        its snapshot is exactly that step.
         """
         if zero_copy and put is None:
             raise ValueError("zero_copy=True requires a consuming `put`")
         loaded = self._load_from_memory(copy=not zero_copy)
+        if loaded is not None and step is not None and loaded[0] != step:
+            loaded = None
         if loaded is None:
-            loaded = self._load_from_storage()
+            loaded = self._load_from_storage(step=step)
         if loaded is None:
             return None
         step, arrays = loaded
@@ -173,13 +180,15 @@ class CheckpointEngine:
             logger.info("restoring step %d from shared memory", snap[0])
         return snap
 
-    def _load_from_storage(self) -> tuple[int, dict[str, np.ndarray]] | None:
+    def _load_from_storage(self, step: int | None = None
+                           ) -> tuple[int, dict[str, np.ndarray]] | None:
         from dlrover_tpu.agent.ckpt_saver import read_tracker, step_dir
 
-        committed = read_tracker(self.storage, self.ckpt_dir)
-        if committed is None:
-            return None
-        step, _ = committed
+        if step is None:
+            committed = read_tracker(self.storage, self.ckpt_dir)
+            if committed is None:
+                return None
+            step, _ = committed
         sdir = step_dir(self.ckpt_dir, step)
         # replicated ckpt: one node file holds everything; prefer our own,
         # else the smallest node id present.

@@ -1,0 +1,309 @@
+"""Ray scheduler backend: actors as the node substrate.
+
+Reference analog: dlrover/python/scheduler/ray.py:51 (RayClient over the ray
+SDK), dlrover/python/master/scaler/ray_scaler.py:39 (ActorScaler: diff alive
+actors against the plan, create/kill named actors) and
+master/watcher/ray_watcher.py (ActorWatcher -> NodeEvents).
+
+Design: the master's platform seams are ``Scaler.scale(plan)`` plus a
+watcher feeding node events — identical for pods and actors. So this module
+mirrors ``cluster/scaler.py``'s PodScaler reconcile semantics over a small
+``RayClient`` verb interface (create/kill/list named actors), and *reuses*
+PodWatcher unchanged through an actors-as-pods adapter rather than
+duplicating its stream/resync race handling. The real binding
+(``RayClusterClient``) talks to a live Ray cluster when the ``ray`` package
+is importable; everything else runs against fakes, the same seam pattern as
+``KubeClient``/``KubernetesClient``.
+
+TPU note: on a Ray-on-TPU cluster each actor pins one TPU VM host
+(``resources={"TPU-<type>-head": ...}`` or a custom host resource); the
+actor supervises the same ``dlrover_tpu.run`` agent the pod path launches,
+so rendezvous/elasticity behave identically above this layer.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import subprocess
+import sys
+import threading
+import time
+
+from dlrover_tpu.cluster.crd import ElasticJob, ScalePlan
+from dlrover_tpu.cluster.scaler import Scaler
+from dlrover_tpu.cluster.watcher import PodWatcher
+from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class ActorSpec:
+    """What the scaler asks the Ray cluster to run for one node."""
+
+    name: str
+    command: list[str]
+    env: dict[str, str]
+    num_cpus: float = 1.0
+    memory_mb: int = 0
+    # custom resources, e.g. {"TPU": 4} or {"tpu-v5e-host": 1}
+    resources: dict[str, float] = dataclasses.field(default_factory=dict)
+
+
+class RayClient(abc.ABC):
+    """The verbs the scaler/watcher need; implement over any Ray API."""
+
+    @abc.abstractmethod
+    def create_actor(self, spec: ActorSpec) -> None: ...
+
+    @abc.abstractmethod
+    def kill_actor(self, name: str) -> None: ...
+
+    @abc.abstractmethod
+    def list_actors(self, name_prefix: str) -> list[dict]:
+        """[{"name": str, "state": "ALIVE"|"DEAD"|...}] for named actors
+        whose name starts with the prefix."""
+
+
+class RayClusterClient(RayClient):
+    """Real binding over the ``ray`` SDK (importable only where Ray is
+    installed; tests use fakes, mirroring KubernetesClient's stubbed
+    transport).
+
+    Each created actor is a detached supervisor hosting the node's agent
+    process — the reference's ``RayWorker.exec_module`` pattern
+    (scheduler/ray.py:40) with the agent as the module.
+    """
+
+    def __init__(self, namespace: str = "dlrover_tpu",
+                 address: str = "auto"):
+        try:
+            import ray  # noqa: PLC0415 - optional platform dependency
+        except ImportError as e:  # pragma: no cover - env without ray
+            raise ImportError(
+                "RayClusterClient needs the 'ray' package; on TPU/k8s "
+                "deployments use KubernetesClient + PodScaler instead"
+            ) from e
+        self._ray = ray
+        ray.init(address=address, namespace=namespace,
+                 ignore_reinit_error=True)
+        self._namespace = namespace
+
+    def _supervisor_cls(self):  # pragma: no cover - needs a live cluster
+        ray = self._ray
+
+        @ray.remote
+        class AgentSupervisor:
+            """Runs the node agent as a child process inside the actor."""
+
+            def __init__(self, command: list[str], env: dict[str, str]):
+                import os
+
+                merged = dict(os.environ)
+                merged.update(env)
+                self._proc = subprocess.Popen(command, env=merged)
+
+            def poll(self) -> int | None:
+                return self._proc.poll()
+
+            def stop(self) -> None:
+                self._proc.terminate()
+
+        return AgentSupervisor
+
+    def create_actor(self, spec: ActorSpec
+                     ) -> None:  # pragma: no cover - needs a live cluster
+        opts = {
+            "name": spec.name,
+            "lifetime": "detached",
+            "num_cpus": spec.num_cpus,
+        }
+        if spec.memory_mb:
+            opts["memory"] = spec.memory_mb * 1024 * 1024
+        if spec.resources:
+            opts["resources"] = dict(spec.resources)
+        self._supervisor_cls().options(**opts).remote(
+            spec.command, spec.env
+        )
+
+    def kill_actor(self, name: str
+                   ) -> None:  # pragma: no cover - needs a live cluster
+        try:
+            handle = self._ray.get_actor(name, namespace=self._namespace)
+        except ValueError:
+            logger.warning("actor %s already gone", name)
+            return
+        self._ray.kill(handle, no_restart=True)
+
+    def list_actors(self, name_prefix: str
+                    ) -> list[dict]:  # pragma: no cover - needs live cluster
+        from ray.util.state import list_actors  # noqa: PLC0415
+
+        out = []
+        for a in list_actors(filters=[("state", "=", "ALIVE")]):
+            name = getattr(a, "name", None) or a.get("name")
+            if name and name.startswith(name_prefix):
+                state = getattr(a, "state", None) or a.get("state")
+                out.append({"name": name, "state": state})
+        return out
+
+
+def _actor_name(job: ElasticJob, group: str, node_id: int) -> str:
+    return f"{job.name}-{group}-{node_id}"
+
+
+def actor_spec(job: ElasticJob, group: str, node_id: int,
+               master_addr: str, memory_mb_override: int = 0) -> ActorSpec:
+    """The Ray-side twin of ``worker_pod_manifest`` (same env contract)."""
+    spec = job.spec.replica_specs[group]
+    resources: dict[str, float] = {}
+    if spec.tpu_type:
+        # pin one TPU host per actor: a custom node resource the cluster
+        # operator tags TPU VMs with (ray's TPU pod-slice convention)
+        resources[f"tpu-{spec.tpu_type}-host"] = 1.0
+    return ActorSpec(
+        name=_actor_name(job, group, node_id),
+        command=list(spec.command)
+        or [sys.executable, "-m", "dlrover_tpu.run"],
+        env={
+            EnvKey.JOB_NAME: job.name,
+            EnvKey.MASTER_ADDR: master_addr,
+            EnvKey.NODE_ID: str(node_id),
+        },
+        num_cpus=float(spec.cpu or 1),
+        memory_mb=memory_mb_override or spec.memory_mb,
+        resources=resources,
+    )
+
+
+class ActorScaler(Scaler):
+    """Reconcile named Ray actors toward a ScalePlan.
+
+    Same contract as PodScaler (scaler.py:176): honors remove/relaunch
+    lists, per-node memory bumps from OOM plans, replica targets, and
+    marks intentional kills so the watcher doesn't read a scale-down as a
+    failure. Reference: ray_scaler.py:51 ``scale`` diffing
+    ``_stats_alive_actors`` against the plan.
+    """
+
+    def __init__(self, job: ElasticJob, client: RayClient,
+                 master_addr: str, group: str = "worker"):
+        self._job = job
+        self._client = client
+        self._master_addr = master_addr
+        self._group = group
+        self._lock = threading.Lock()
+        self._next_node_id = 0
+        self._memory_mb: dict[int, int] = {}
+        self._intentional_removals: dict[int, float] = {}
+        self._intentional_ttl_s = 60.0
+
+    def update_job(self, job: ElasticJob) -> None:
+        with self._lock:
+            self._job = job
+
+    def consume_intentional_removal(self, node_id: int) -> bool:
+        with self._lock:
+            marked = self._intentional_removals.pop(node_id, None)
+            return (marked is not None
+                    and time.time() - marked < self._intentional_ttl_s)
+
+    def _prefix(self) -> str:
+        return f"{self._job.name}-{self._group}-"
+
+    def _live_actors(self) -> dict[int, str]:
+        out: dict[int, str] = {}
+        for a in self._client.list_actors(self._prefix()):
+            if str(a.get("state", "ALIVE")).upper() != "ALIVE":
+                continue
+            try:
+                out[int(a["name"].rsplit("-", 1)[1])] = a["name"]
+            except (ValueError, IndexError):
+                logger.warning("ignoring unparsable actor name %r",
+                               a.get("name"))
+        return out
+
+    def _create(self, node_id: int) -> None:
+        self._client.create_actor(actor_spec(
+            self._job, self._group, node_id, self._master_addr,
+            memory_mb_override=self._memory_mb.get(node_id, 0),
+        ))
+
+    def scale(self, plan: ScalePlan) -> None:
+        with self._lock:
+            for nid_str, mb in plan.memory_mb.items():
+                self._memory_mb[int(nid_str)] = int(mb)
+            live = self._live_actors()
+            if live:
+                self._next_node_id = max(self._next_node_id, max(live) + 1)
+            now = time.time()
+            for nid in plan.remove_nodes:
+                if nid in live:
+                    self._intentional_removals[nid] = now
+                    self._client.kill_actor(live.pop(nid))
+            for nid in plan.relaunch_nodes:
+                if nid in live:
+                    self._intentional_removals[nid] = now
+                    self._client.kill_actor(live[nid])
+                self._create(nid)
+                live[nid] = _actor_name(self._job, self._group, nid)
+                # replacement exists: see PodScaler.scale on why the mark
+                # must not outlive the relaunch
+                self._intentional_removals.pop(nid, None)
+            target = plan.replica_resources.get(self._group)
+            if target is None:
+                return
+            while len(live) > target:
+                nid = max(live)
+                self._intentional_removals[nid] = now
+                self._client.kill_actor(live.pop(nid))
+            while len(live) < target:
+                nid = self._next_node_id
+                self._next_node_id += 1
+                self._create(nid)
+                live[nid] = _actor_name(self._job, self._group, nid)
+            logger.info(
+                "scaled %s/%s to %d actors (%s)", self._job.name,
+                self._group, len(live), plan.reason or "plan",
+            )
+
+
+class _ActorsAsPods:
+    """Adapter giving PodWatcher its ``list_pods`` verb over actors.
+
+    PodWatcher's diff/stream machinery is substrate-agnostic (it only reads
+    ``metadata.name`` + the ``node-id`` label); reusing it keeps one tested
+    implementation of the resync races instead of a second copy for Ray.
+    """
+
+    def __init__(self, client: RayClient, prefix: str):
+        self._client = client
+        self._prefix = prefix
+
+    def list_pods(self, namespace: str, label_selector: str) -> list[dict]:
+        pods = []
+        for a in self._client.list_actors(self._prefix):
+            if str(a.get("state", "ALIVE")).upper() != "ALIVE":
+                continue
+            name = a["name"]
+            try:
+                nid = int(name.rsplit("-", 1)[1])
+            except (ValueError, IndexError):
+                continue
+            pods.append({
+                "metadata": {"name": name, "labels": {"node-id": str(nid)}}
+            })
+        return pods
+
+
+def actor_watcher(client: RayClient, job: ElasticJob, on_event,
+                  interval_s: float = 5.0,
+                  group: str = "worker") -> PodWatcher:
+    """A polling node watcher over Ray actors (ray_watcher.py analog)."""
+    adapter = _ActorsAsPods(client, f"{job.name}-{group}-")
+    return PodWatcher(
+        adapter, job.namespace, job.name, on_event,
+        interval_s=interval_s, group=group,
+    )

@@ -9,6 +9,7 @@ distribution strategies are mesh-axis based rather than PS/AllReduce based.
 from __future__ import annotations
 
 import enum
+import os
 
 
 class PlatformType(str, enum.Enum):
@@ -129,4 +130,6 @@ class Defaults:
     MAX_RESTARTS = 3
     SPEED_WINDOW_S = 6.0
     RPC_TIMEOUT_S = 30.0
-    SHM_PREFIX = "dlrover_tpu"
+    # overridable so parallel test runs / co-hosted jobs can't collide on
+    # POSIX shm names (children inherit the env, so agent+trainer agree)
+    SHM_PREFIX = os.environ.get("DLROVER_TPU_SHM_PREFIX", "dlrover_tpu")

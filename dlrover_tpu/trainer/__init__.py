@@ -8,3 +8,11 @@ from dlrover_tpu.trainer.sharding_client import (  # noqa: F401
     IndexShardingClient,
     ShardingClient,
 )
+from dlrover_tpu.trainer.trainer import (  # noqa: F401
+    EarlyStoppingCallback,
+    Trainer,
+    TrainerCallback,
+    TrainerControl,
+    TrainerState,
+    TrainingArguments,
+)
