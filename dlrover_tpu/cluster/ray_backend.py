@@ -30,8 +30,8 @@ import sys
 import threading
 import time
 
-from dlrover_tpu.cluster.crd import ElasticJob, ScalePlan
-from dlrover_tpu.cluster.scaler import Scaler
+from dlrover_tpu.cluster.crd import ElasticJob
+from dlrover_tpu.cluster.scaler import ReconcilingScaler
 from dlrover_tpu.cluster.watcher import PodWatcher
 from dlrover_tpu.common.constants import EnvKey
 from dlrover_tpu.common.log import get_logger
@@ -124,9 +124,20 @@ class RayClusterClient(RayClient):
             opts["memory"] = spec.memory_mb * 1024 * 1024
         if spec.resources:
             opts["resources"] = dict(spec.resources)
-        self._supervisor_cls().options(**opts).remote(
-            spec.command, spec.env
-        )
+        # ray.kill is async: a relaunch's create can race the old actor's
+        # name still being registered — retry until the name frees up
+        deadline = time.monotonic() + 30.0
+        while True:
+            try:
+                self._supervisor_cls().options(**opts).remote(
+                    spec.command, spec.env
+                )
+                return
+            except ValueError as e:
+                if ("exists" not in str(e).lower()
+                        or time.monotonic() >= deadline):
+                    raise
+                time.sleep(0.5)
 
     def kill_actor(self, name: str
                    ) -> None:  # pragma: no cover - needs a live cluster
@@ -178,42 +189,27 @@ def actor_spec(job: ElasticJob, group: str, node_id: int,
     )
 
 
-class ActorScaler(Scaler):
+class ActorScaler(ReconcilingScaler):
     """Reconcile named Ray actors toward a ScalePlan.
 
-    Same contract as PodScaler (scaler.py:176): honors remove/relaunch
-    lists, per-node memory bumps from OOM plans, replica targets, and
-    marks intentional kills so the watcher doesn't read a scale-down as a
-    failure. Reference: ray_scaler.py:51 ``scale`` diffing
+    The reconcile semantics (remove/relaunch ordering, OOM memory bumps,
+    replica targets, intentional-removal marks) are the shared
+    ReconcilingScaler; this class only supplies the actor verbs.
+    Reference: ray_scaler.py:51 ``scale`` diffing
     ``_stats_alive_actors`` against the plan.
     """
 
+    _kind = "actors"
+
     def __init__(self, job: ElasticJob, client: RayClient,
                  master_addr: str, group: str = "worker"):
-        self._job = job
+        super().__init__(job, master_addr, group)
         self._client = client
-        self._master_addr = master_addr
-        self._group = group
-        self._lock = threading.Lock()
-        self._next_node_id = 0
-        self._memory_mb: dict[int, int] = {}
-        self._intentional_removals: dict[int, float] = {}
-        self._intentional_ttl_s = 60.0
-
-    def update_job(self, job: ElasticJob) -> None:
-        with self._lock:
-            self._job = job
-
-    def consume_intentional_removal(self, node_id: int) -> bool:
-        with self._lock:
-            marked = self._intentional_removals.pop(node_id, None)
-            return (marked is not None
-                    and time.time() - marked < self._intentional_ttl_s)
 
     def _prefix(self) -> str:
         return f"{self._job.name}-{self._group}-"
 
-    def _live_actors(self) -> dict[int, str]:
+    def _live(self) -> dict[int, str]:
         out: dict[int, str] = {}
         for a in self._client.list_actors(self._prefix()):
             if str(a.get("state", "ALIVE")).upper() != "ALIVE":
@@ -225,49 +221,15 @@ class ActorScaler(Scaler):
                                a.get("name"))
         return out
 
-    def _create(self, node_id: int) -> None:
+    def _create_node(self, node_id: int) -> str:
         self._client.create_actor(actor_spec(
             self._job, self._group, node_id, self._master_addr,
             memory_mb_override=self._memory_mb.get(node_id, 0),
         ))
+        return _actor_name(self._job, self._group, node_id)
 
-    def scale(self, plan: ScalePlan) -> None:
-        with self._lock:
-            for nid_str, mb in plan.memory_mb.items():
-                self._memory_mb[int(nid_str)] = int(mb)
-            live = self._live_actors()
-            if live:
-                self._next_node_id = max(self._next_node_id, max(live) + 1)
-            now = time.time()
-            for nid in plan.remove_nodes:
-                if nid in live:
-                    self._intentional_removals[nid] = now
-                    self._client.kill_actor(live.pop(nid))
-            for nid in plan.relaunch_nodes:
-                if nid in live:
-                    self._intentional_removals[nid] = now
-                    self._client.kill_actor(live[nid])
-                self._create(nid)
-                live[nid] = _actor_name(self._job, self._group, nid)
-                # replacement exists: see PodScaler.scale on why the mark
-                # must not outlive the relaunch
-                self._intentional_removals.pop(nid, None)
-            target = plan.replica_resources.get(self._group)
-            if target is None:
-                return
-            while len(live) > target:
-                nid = max(live)
-                self._intentional_removals[nid] = now
-                self._client.kill_actor(live.pop(nid))
-            while len(live) < target:
-                nid = self._next_node_id
-                self._next_node_id += 1
-                self._create(nid)
-                live[nid] = _actor_name(self._job, self._group, nid)
-            logger.info(
-                "scaled %s/%s to %d actors (%s)", self._job.name,
-                self._group, len(live), plan.reason or "plan",
-            )
+    def _delete_node(self, node_id: int, handle: str) -> None:
+        self._client.kill_actor(handle)
 
 
 class _ActorsAsPods:

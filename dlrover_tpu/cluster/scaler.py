@@ -173,24 +173,30 @@ def master_pod_manifest(job: ElasticJob, port: int = 5001) -> dict:
     }
 
 
-class PodScaler(Scaler):
-    """Reconcile worker pods toward a ScalePlan via the KubeClient."""
+class ReconcilingScaler(Scaler):
+    """Shared ScalePlan reconcile over create/delete/list node verbs.
 
-    def __init__(self, job: ElasticJob, client: KubeClient,
-                 master_addr: str, group: str = "worker"):
+    Substrate-agnostic semantics (one implementation for pods AND Ray
+    actors — cluster/ray_backend.py): per-node memory bumps from
+    OOM-recovery plans survive relaunches; remove/relaunch lists run
+    before the replica-target loops; deliberate deletions are marked so
+    the watcher doesn't read a scale-down as a failure, with a TTL so a
+    stale mark can't mask a later genuine failure.
+
+    Subclasses supply ``_live() -> {node_id: handle}``,
+    ``_create_node(node_id) -> handle``, ``_delete_node(node_id, handle)``.
+    """
+
+    _kind = "nodes"
+
+    def __init__(self, job: ElasticJob, master_addr: str,
+                 group: str = "worker"):
         self._job = job
-        self._client = client
         self._master_addr = master_addr
         self._group = group
         self._lock = threading.Lock()
         self._next_node_id = 0
-        # per-node memory bumps from OOM-recovery plans; survive relaunches
         self._memory_mb: dict[int, int] = {}
-        # nodes whose pod this scaler deleted ON PURPOSE (scale-down /
-        # remove / the delete half of a relaunch), with mark times: the
-        # pod watcher consults this so an intentional deletion is not
-        # mistaken for a failure and double-relaunched. Marks expire so a
-        # stale one can't mask a later genuine failure.
         self._intentional_removals: dict[int, float] = {}
         self._intentional_ttl_s = 60.0
 
@@ -199,16 +205,9 @@ class PodScaler(Scaler):
         with self._lock:
             self._job = job
 
-    def _manifest(self, node_id: int) -> dict:
-        return worker_pod_manifest(
-            self._job, self._group, node_id, self._master_addr,
-            memory_mb_override=self._memory_mb.get(node_id, 0),
-        )
-
     def consume_intentional_removal(self, node_id: int) -> bool:
         """True when this scaler recently and deliberately deleted the
-        node's pod (consumed once; marks expire after a TTL so a stale
-        one can't mask a later genuine failure)."""
+        node's pod/actor (consumed once)."""
         import time as _time
 
         with self._lock:
@@ -216,23 +215,20 @@ class PodScaler(Scaler):
             return (marked is not None
                     and _time.time() - marked < self._intentional_ttl_s)
 
-    def _live_pods(self) -> dict[int, dict]:
-        pods = self._client.list_pods(
-            self._job.namespace,
-            f"job={self._job.name},group={self._group}",
-        )
-        out = {}
-        for p in pods:
-            labels = p.get("metadata", {}).get("labels", {})
-            if "node-id" in labels:
-                out[int(labels["node-id"])] = p
-        return out
+    def _live(self) -> dict[int, object]:
+        raise NotImplementedError
+
+    def _create_node(self, node_id: int) -> object:
+        raise NotImplementedError
+
+    def _delete_node(self, node_id: int, handle: object) -> None:
+        raise NotImplementedError
 
     def scale(self, plan: ScalePlan) -> None:
         with self._lock:
             for nid_str, mb in plan.memory_mb.items():
                 self._memory_mb[int(nid_str)] = int(mb)
-            live = self._live_pods()
+            live = self._live()
             if live:
                 self._next_node_id = max(
                     self._next_node_id, max(live) + 1
@@ -243,24 +239,15 @@ class PodScaler(Scaler):
             for nid in plan.remove_nodes:
                 if nid in live:
                     self._intentional_removals[nid] = now
-                    self._client.delete_pod(
-                        self._job.namespace,
-                        live[nid]["metadata"]["name"],
-                    )
-                    live.pop(nid)
+                    self._delete_node(nid, live.pop(nid))
             for nid in plan.relaunch_nodes:
                 if nid in live:
                     # the delete half of a relaunch is intentional: a
                     # watcher poll landing between delete and the
                     # replacement appearing must not double-relaunch
                     self._intentional_removals[nid] = now
-                    self._client.delete_pod(
-                        self._job.namespace,
-                        live[nid]["metadata"]["name"],
-                    )
-                manifest = self._manifest(nid)
-                self._client.create_pod(self._job.namespace, manifest)
-                live[nid] = manifest
+                    self._delete_node(nid, live[nid])
+                live[nid] = self._create_node(nid)
                 # replacement exists: clear the mark, or a genuine
                 # failure of the NEW pod within the TTL would read as
                 # intentional and the node would be silently lost (a
@@ -273,19 +260,54 @@ class PodScaler(Scaler):
             while len(live) > target:
                 nid = max(live)
                 self._intentional_removals[nid] = now
-                self._client.delete_pod(
-                    self._job.namespace, live.pop(nid)["metadata"]["name"]
-                )
+                self._delete_node(nid, live.pop(nid))
             while len(live) < target:
                 nid = self._next_node_id
                 self._next_node_id += 1
-                manifest = self._manifest(nid)
-                self._client.create_pod(self._job.namespace, manifest)
-                live[nid] = manifest
+                live[nid] = self._create_node(nid)
             logger.info(
-                "scaled %s/%s to %d workers (%s)", self._job.name,
-                self._group, len(live), plan.reason or "plan",
+                "scaled %s/%s to %d %s (%s)", self._job.name,
+                self._group, len(live), self._kind, plan.reason or "plan",
             )
+
+
+class PodScaler(ReconcilingScaler):
+    """Reconcile worker pods toward a ScalePlan via the KubeClient."""
+
+    _kind = "workers"
+
+    def __init__(self, job: ElasticJob, client: KubeClient,
+                 master_addr: str, group: str = "worker"):
+        super().__init__(job, master_addr, group)
+        self._client = client
+
+    def _manifest(self, node_id: int) -> dict:
+        return worker_pod_manifest(
+            self._job, self._group, node_id, self._master_addr,
+            memory_mb_override=self._memory_mb.get(node_id, 0),
+        )
+
+    def _live(self) -> dict[int, dict]:
+        pods = self._client.list_pods(
+            self._job.namespace,
+            f"job={self._job.name},group={self._group}",
+        )
+        out = {}
+        for p in pods:
+            labels = p.get("metadata", {}).get("labels", {})
+            if "node-id" in labels:
+                out[int(labels["node-id"])] = p
+        return out
+
+    def _create_node(self, node_id: int) -> dict:
+        manifest = self._manifest(node_id)
+        self._client.create_pod(self._job.namespace, manifest)
+        return manifest
+
+    def _delete_node(self, node_id: int, handle: dict) -> None:
+        self._client.delete_pod(
+            self._job.namespace, handle["metadata"]["name"]
+        )
 
 
 class LocalProcessScaler(Scaler):

@@ -54,7 +54,8 @@ class TransformerConfig:
     # weight prefetch/scheduling across adjacent layers at the cost of
     # program size (still one remat boundary per layer)
     scan_unroll: int = 1
-    attention: str = "dense"    # "dense" | "flash" | "splash" | "ring"
+    # "dense" | "flash" | "flash_own" | "splash" | "ring"
+    attention: str = "dense"
     # splash only: sliding-window size (0 = full causal); the sparse
     # kernel skips fully-masked blocks, so long seqs pay O(S * window)
     attention_window: int = 0
@@ -337,6 +338,7 @@ def forward_with_aux(
     constrain: Callable[[jax.Array, tuple], jax.Array] | None = None,
     mask: jax.Array | None = None,
     return_hidden: bool = False,
+    inputs_embeds: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """(logits, aux_loss). aux is the MoE load-balancing term (0 when
     the model has no experts). ``return_hidden`` yields the final normed
@@ -344,23 +346,32 @@ def forward_with_aux(
 
     ``constrain(x, logical_axes)`` optionally pins activation shardings
     (supplied by the strategy layer); identity when absent.
+
+    ``inputs_embeds`` [B, S, d_model] bypasses the token embedding (and
+    the gpt2 position add) — the caller owns the front end. This is how
+    non-token modalities (ViT patches, models/vision.py) reuse the block
+    stack with every strategy unchanged.
     """
     c = cfg
     dt = jnp.dtype(c.dtype)
     pin = constrain or (lambda x, a: x)
     attn = attention_fn or dense_attention
 
-    B, S = tokens.shape
-    # pin the gather result BEFORE the position add: with the table
-    # sharded (vocab x embed) and tokens (batch x sequence), the
-    # partitioner otherwise leaves the gather's layout ambiguous and
-    # falls back to involuntary full rematerialization of the embedding
-    # (seen in the r02 4D dryrun tail)
-    x = pin(params["embed"].astype(dt)[tokens],
-            ("batch", "sequence", "embed"))
-    if c.variant == "gpt2":
-        x = x + params["pos_embed"].astype(dt)[:S][None]
-        x = pin(x, ("batch", "sequence", "embed"))
+    if inputs_embeds is not None:
+        B, S = inputs_embeds.shape[:2]
+        x = pin(inputs_embeds.astype(dt), ("batch", "sequence", "embed"))
+    else:
+        B, S = tokens.shape
+        # pin the gather result BEFORE the position add: with the table
+        # sharded (vocab x embed) and tokens (batch x sequence), the
+        # partitioner otherwise leaves the gather's layout ambiguous and
+        # falls back to involuntary full rematerialization of the embedding
+        # (seen in the r02 4D dryrun tail)
+        x = pin(params["embed"].astype(dt)[tokens],
+                ("batch", "sequence", "embed"))
+        if c.variant == "gpt2":
+            x = x + params["pos_embed"].astype(dt)[:S][None]
+            x = pin(x, ("batch", "sequence", "embed"))
 
     n_rep = c.n_heads // c.n_kv_heads
 
@@ -528,6 +539,13 @@ def make_loss_fn(cfg: TransformerConfig, strategy, mesh) -> Callable:
         from dlrover_tpu.ops.flash_attention import flash_attention
 
         attn = flash_attention
+    elif cfg.attention == "flash_own":
+        # this repo's full fwd+bwd Pallas kernel pair (no library
+        # fallback); interpret mode makes it runnable on the CPU mesh
+        from dlrover_tpu.ops.flash_attention import flash_attention_own
+
+        def attn(q, k, v, causal=True):
+            return flash_attention_own(q, k, v, causal)
     elif cfg.attention == "splash":
         from dlrover_tpu.ops.splash_attention import make_splash_attention
 

@@ -1,0 +1,307 @@
+"""Vision family: ViT encoder + CLIP-style dual-encoder, on the shared block.
+
+Reference analog: ATorch's model-zoo vision ports — the CLIP attention/MLP
+parallel implementations and HF module mapping
+(atorch/atorch/modules/distributed_modules/transformer.py:45,
+modules_registry.py). There every architecture needs its own Row/Col
+parallel port; here the ViT IS the shared transformer stack driven through
+``inputs_embeds`` (models/transformer.py forward_with_aux) with a patch
+front end — so dp/fsdp/tp/mixed strategies, remat policies, and the flash
+checkpoint engines all apply unchanged.
+
+TPU-first notes:
+- Patchify is a reshape/transpose (no conv im2col): the patch projection is
+  one big [N, P²C] x [P²C, D] matmul on the MXU.
+- The CLIP contrastive loss computes the full [B, B] similarity logits
+  under pjit; with features sharded batch-wise XLA inserts the all-gather
+  over the data axes — the torch implementation's explicit
+  ``all_gather`` + local-logits dance is just sharding propagation here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.models.transformer import (
+    TransformerConfig,
+    forward_with_aux,
+    init_params as init_text_params,
+    logical_axes as text_logical_axes,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class VisionConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    dtype: str = "bfloat16"
+    # pooling: "cls" (prepended token) or "mean" over patch tokens
+    pool: str = "cls"
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def seq_len(self) -> int:
+        return self.n_patches + (1 if self.pool == "cls" else 0)
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * self.channels
+
+    def encoder_config(self) -> TransformerConfig:
+        """The shared-block config this ViT runs on: bidirectional, gpt2
+        norms (LayerNorm with bias, ViT's convention)."""
+        return TransformerConfig(
+            vocab_size=8,  # unused: the ViT path feeds inputs_embeds
+            d_model=self.d_model, n_layers=self.n_layers,
+            n_heads=self.n_heads, n_kv_heads=self.n_heads,
+            d_ff=self.d_ff, max_seq_len=self.seq_len,
+            variant="gpt2", causal=False, dtype=self.dtype,
+        )
+
+
+VISION_CONFIGS = {
+    "vit-tiny": VisionConfig(image_size=32, patch_size=8, d_model=64,
+                             n_layers=2, n_heads=4, d_ff=176),
+    "vit-base": VisionConfig(),  # ViT-B/16
+    "vit-large": VisionConfig(patch_size=14, d_model=1024, n_layers=24,
+                              n_heads=16, d_ff=4096),
+}
+
+
+def patchify(images: jax.Array, patch: int) -> jax.Array:
+    """[B, H, W, C] -> [B, N, P*P*C] without conv/im2col."""
+    B, H, W, C = images.shape
+    gh, gw = H // patch, W // patch
+    x = images.reshape(B, gh, patch, gw, patch, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(B, gh * gw, patch * patch * C)
+
+
+def init_vit_params(cfg: VisionConfig, key: jax.Array) -> dict:
+    k_proj, k_pos, k_cls, k_enc = jax.random.split(key, 4)
+    enc = init_text_params(cfg.encoder_config(), k_enc)
+    # the block stack + final norm come from the shared init; the token
+    # front end and LM head do not apply to pixels
+    for unused in ("embed", "lm_head", "pos_embed"):
+        enc.pop(unused, None)
+    params = {
+        "patch_proj": jax.random.normal(
+            k_proj, (cfg.patch_dim, cfg.d_model), jnp.float32
+        ) / math.sqrt(cfg.patch_dim),
+        "patch_bias": jnp.zeros((cfg.d_model,), jnp.float32),
+        "pos_embed": 0.02 * jax.random.normal(
+            k_pos, (cfg.seq_len, cfg.d_model), jnp.float32
+        ),
+        **enc,
+    }
+    if cfg.pool == "cls":
+        params["cls"] = 0.02 * jax.random.normal(
+            k_cls, (cfg.d_model,), jnp.float32
+        )
+    return params
+
+
+def vit_logical_axes(cfg: VisionConfig) -> dict:
+    axes = text_logical_axes(cfg.encoder_config())
+    for unused in ("embed", "lm_head", "pos_embed"):
+        axes.pop(unused, None)
+    tree = {
+        "patch_proj": (None, "embed"),
+        "patch_bias": (None,),
+        "pos_embed": (None, "embed"),
+        **axes,
+    }
+    if cfg.pool == "cls":
+        tree["cls"] = (None,)
+    return tree
+
+
+def vit_encode(
+    params: dict, images: jax.Array, cfg: VisionConfig,
+    constrain: Callable | None = None,
+) -> jax.Array:
+    """[B, H, W, C] images -> pooled features [B, d_model]."""
+    dt = jnp.dtype(cfg.dtype)
+    pin = constrain or (lambda x, a: x)
+    x = patchify(images.astype(dt), cfg.patch_size)
+    x = x @ params["patch_proj"].astype(dt) + params["patch_bias"].astype(dt)
+    if cfg.pool == "cls":
+        cls = jnp.broadcast_to(
+            params["cls"].astype(dt), (x.shape[0], 1, cfg.d_model)
+        )
+        x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"].astype(dt)[None]
+    x = pin(x, ("batch", "sequence", "embed"))
+    hidden, _ = forward_with_aux(
+        params, None, cfg.encoder_config(),
+        constrain=constrain, return_hidden=True, inputs_embeds=x,
+    )
+    if cfg.pool == "cls":
+        return hidden[:, 0]
+    return hidden.mean(axis=1)
+
+
+def classifier_loss_fn(
+    params: dict, batch: dict, cfg: VisionConfig,
+    constrain: Callable | None = None,
+) -> jax.Array:
+    """Supervised ViT: batch = images [B,H,W,C] + labels [B].
+
+    The classifier head lives in ``params["head"]`` ([d_model, n_classes],
+    logical axes ("embed", "vocab")).
+    """
+    feats = vit_encode(params, batch["images"], cfg, constrain=constrain)
+    logits = feats.astype(jnp.float32) @ params["head"].astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.take_along_axis(
+        logp, batch["labels"][:, None], axis=-1
+    )[:, 0].mean()
+
+
+def init_classifier_params(cfg: VisionConfig, n_classes: int,
+                           key: jax.Array) -> dict:
+    k_vit, k_head = jax.random.split(key)
+    params = init_vit_params(cfg, k_vit)
+    params["head"] = jax.random.normal(
+        k_head, (cfg.d_model, n_classes), jnp.float32
+    ) / math.sqrt(cfg.d_model)
+    return params
+
+
+def classifier_logical_axes(cfg: VisionConfig) -> dict:
+    axes = vit_logical_axes(cfg)
+    axes["head"] = ("embed", "vocab")
+    return axes
+
+
+# --------------------------------------------------------------------- CLIP
+
+
+@dataclasses.dataclass(frozen=True)
+class ClipConfig:
+    vision: VisionConfig = dataclasses.field(
+        default_factory=lambda: VISION_CONFIGS["vit-base"])
+    text: TransformerConfig = dataclasses.field(
+        default_factory=lambda: TransformerConfig(
+            vocab_size=49408, d_model=512, n_layers=12, n_heads=8,
+            n_kv_heads=8, d_ff=2048, max_seq_len=77, variant="gpt2",
+            causal=True,  # CLIP's text tower is causal, pooled at EOT
+        ))
+    proj_dim: int = 512
+
+
+CLIP_CONFIGS = {
+    "clip-tiny": ClipConfig(
+        vision=VISION_CONFIGS["vit-tiny"],
+        text=TransformerConfig(
+            vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+            n_kv_heads=4, d_ff=176, max_seq_len=32, variant="gpt2",
+            causal=True),
+        proj_dim=64,
+    ),
+    "clip-vit-b16": ClipConfig(),
+}
+
+
+def init_clip_params(cfg: ClipConfig, key: jax.Array) -> dict:
+    k_v, k_t, k_pv, k_pt = jax.random.split(key, 4)
+    text = init_text_params(cfg.text, k_t)
+    text.pop("lm_head", None)  # contrastive, not generative
+    return {
+        "vision": init_vit_params(cfg.vision, k_v),
+        "text": text,
+        "image_proj": jax.random.normal(
+            k_pv, (cfg.vision.d_model, cfg.proj_dim), jnp.float32
+        ) / math.sqrt(cfg.vision.d_model),
+        "text_proj": jax.random.normal(
+            k_pt, (cfg.text.d_model, cfg.proj_dim), jnp.float32
+        ) / math.sqrt(cfg.text.d_model),
+        # CLIP's learned temperature, stored as log(1/0.07)
+        "logit_scale": jnp.asarray(math.log(1 / 0.07), jnp.float32),
+    }
+
+
+def clip_logical_axes(cfg: ClipConfig) -> dict:
+    text = text_logical_axes(cfg.text)
+    text.pop("lm_head", None)
+    return {
+        "vision": vit_logical_axes(cfg.vision),
+        "text": text,
+        "image_proj": ("embed", None),
+        "text_proj": ("embed", None),
+        "logit_scale": (),
+    }
+
+
+def clip_forward(
+    params: dict, batch: dict, cfg: ClipConfig,
+    constrain: Callable | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """batch = images [B,H,W,C] + tokens [B,S] (+ optional eot [B] index).
+
+    Returns L2-normalized (image_embeds, text_embeds) [B, proj_dim] and the
+    exp'd logit scale.
+    """
+    img = vit_encode(params["vision"], batch["images"], cfg.vision,
+                     constrain=constrain)
+    hidden, _ = forward_with_aux(
+        params["text"], batch["tokens"], cfg.text,
+        constrain=constrain, return_hidden=True,
+    )
+    # pool at the end-of-text position (CLIP's convention); default to the
+    # final position when the batch carries no eot index
+    if "eot" in batch:
+        txt = jnp.take_along_axis(
+            hidden, batch["eot"][:, None, None].astype(jnp.int32), axis=1
+        )[:, 0]
+    else:
+        txt = hidden[:, -1]
+    img = img.astype(jnp.float32) @ params["image_proj"]
+    txt = txt.astype(jnp.float32) @ params["text_proj"]
+    img = img / jnp.linalg.norm(img, axis=-1, keepdims=True).clip(1e-6)
+    txt = txt / jnp.linalg.norm(txt, axis=-1, keepdims=True).clip(1e-6)
+    # clamp like the paper: temperature never above 100
+    scale = jnp.exp(jnp.minimum(params["logit_scale"], math.log(100.0)))
+    return img, txt, scale
+
+
+def clip_loss_fn(
+    params: dict, batch: dict, cfg: ClipConfig,
+    constrain: Callable | None = None,
+) -> jax.Array:
+    """Symmetric InfoNCE over the GLOBAL batch.
+
+    The [B, B] logits are computed directly under pjit; batch-sharded
+    features make XLA all-gather one side over the data axes — matching
+    open_clip's gathered-features loss without any explicit collective.
+    """
+    img, txt, scale = clip_forward(params, batch, cfg, constrain=constrain)
+    logits = scale * (img @ txt.T)
+    labels = jnp.arange(logits.shape[0])
+    lp_i = jax.nn.log_softmax(logits, axis=-1)
+    lp_t = jax.nn.log_softmax(logits, axis=0)
+    diag_i = jnp.take_along_axis(lp_i, labels[:, None], axis=-1)[:, 0]
+    diag_t = jnp.take_along_axis(lp_t, labels[None, :], axis=0)[0]
+    return -(diag_i.mean() + diag_t.mean()) / 2
+
+
+def make_clip_loss_fn(cfg: ClipConfig, strategy, mesh) -> Callable:
+    """Strategy-bound CLIP loss (the make_loss_fn twin for dual towers)."""
+    from dlrover_tpu.parallel.partition import constrain as _constrain
+
+    pin = partial(_constrain, rules=strategy.rule_table(), mesh=mesh)
+    return partial(clip_loss_fn, cfg=cfg, constrain=pin)

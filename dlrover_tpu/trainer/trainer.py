@@ -306,32 +306,52 @@ class Trainer:
             return max(1, len(ds) // self.args.global_batch_size)
         return None
 
-    def _epoch_samples(self, epoch: int) -> Iterable:
+    def _epoch_samples(self, epoch: int, skip_steps: int = 0) -> Iterable:
         """One epoch's sample stream (seeded shuffle for Sequences).
 
         Multi-process SPMD: every process derives the same permutation,
-        then takes its strided slice — each sample lands on exactly one
-        process, and (len/np) samples at (global_batch/np) per step keeps
-        steps_per_epoch = len // global_batch on every process. Elastic
-        runs use a master-fed dataset instead, which arrives pre-sharded.
+        truncates it to a multiple of the process count (unequal
+        per-process counts would desync the collective step), then takes
+        its strided slice — each remaining sample lands on exactly one
+        process and every process yields the same number of step batches.
+        Elastic runs use a master-fed dataset instead (pre-sharded).
+
+        ``skip_steps`` drops already-consumed step batches at the SAMPLE
+        level (mid-epoch resume) — slicing here instead of draining
+        assembled batches keeps restart-in-place sub-second.
         """
         ds = self.train_dataset
+        np_ = self.elastic.num_processes
+        skip_samples = skip_steps * self.elastic.assembler.accum \
+            * self.elastic.assembler.batch_size
         if hasattr(ds, "__len__") and hasattr(ds, "__getitem__"):
             order = np.arange(len(ds))
             if self.args.shuffle:
                 order = np.random.default_rng(
                     self.args.seed + epoch).permutation(len(ds))
-            np_ = self.elastic.num_processes
             if np_ > 1:
+                order = order[:len(order) - len(order) % np_]
                 order = order[jax.process_index()::np_]
-            return (ds[int(i)] for i in order)
-        return iter(ds)
+            return (ds[int(i)] for i in order[skip_samples:])
+        import itertools
 
-    @staticmethod
-    def _sample_iter(ds: Iterable) -> Iterable:
-        """Uniform sample stream over a Sequence or plain iterable."""
+        return itertools.islice(iter(ds), skip_samples, None)
+
+    def _sample_iter(self, ds: Iterable) -> Iterable:
+        """Eval/predict sample stream, sharded across processes.
+
+        Sequences are truncated to a process-count multiple then strided
+        (equal batch counts everywhere, no duplicated work). Plain
+        iterables can't be split safely — every process reads the full
+        stream, which is numerically correct for evaluate (identical
+        global batches) at the cost of redundant passes.
+        """
+        np_ = self.elastic.num_processes
         if hasattr(ds, "__len__") and hasattr(ds, "__getitem__"):
-            return (ds[int(i)] for i in range(len(ds)))
+            n = len(ds) - (len(ds) % np_ if np_ > 1 else 0)
+            idx = range(jax.process_index(), n, np_) if np_ > 1 \
+                else range(n)
+            return (ds[int(i)] for i in idx)
         return iter(ds)
 
     @staticmethod
@@ -444,15 +464,14 @@ class Trainer:
             self.callback_handler.fire(
                 "on_epoch_begin", args, self.state, self.control
             )
-            batches = self.elastic.assembler.batches(
-                self._epoch_samples(epoch), self.collate_fn
-            )
-            # mid-epoch resume: drop the batches this incarnation already
-            # consumed (same seed -> same order, so samples line up)
+            # mid-epoch resume: same seed -> same order, so skipping the
+            # consumed steps' samples realigns the stream
             skip = (self.state.global_step % steps_per_epoch
                     if steps_per_epoch else 0)
-            for _ in range(skip):
-                next(batches, None)
+            batches = self.elastic.assembler.batches(
+                self._epoch_samples(epoch, skip_steps=skip),
+                self.collate_fn,
+            )
             made_progress = False
             for batch in batches:
                 made_progress = True
@@ -521,6 +540,10 @@ class Trainer:
             if self._last_save_step < step:
                 self._save_checkpoint(step, state)
             self.engine.wait_for_persist(step)
+            # in-loop rotations see whatever the async persister had
+            # committed at the time; with the final step durable, this
+            # pass makes the retained set deterministic
+            self._rotate_checkpoints(step)
         if args.load_best_model_at_end and self.state.best_step is not None:
             best = self.state.best_step
             if best != self.state.global_step:
@@ -559,9 +582,23 @@ class Trainer:
 
     # ------------------------------------------------------------- checkpoints
 
+    def _durable_save(self, step: int, state) -> bool:
+        """save_to_storage with a bounded retry: the snapshot skips while
+        the async persister holds the shm lock, and silently dropping a
+        scheduled save would hand a restart an older step."""
+        for _ in range(20):
+            if self.engine.save_to_storage(step, state):
+                return True
+            time.sleep(0.25)
+        logger.warning(
+            "checkpoint at step %d dropped: persister busy for >5s", step
+        )
+        return False
+
     def _save_checkpoint(self, step: int, state) -> None:
+        if not self._durable_save(step, state):
+            return
         self._last_save_step = step
-        self.engine.save_to_storage(step, state)
         with open(os.path.join(
                 self.args.output_dir, "trainer_state.json"), "w") as f:
             f.write(self.state.to_json())
@@ -692,20 +729,8 @@ class Trainer:
                 self.state.best_metric = value
                 self.state.best_step = self.state.global_step
                 if self.args.load_best_model_at_end:
-                    # the best step must be durable to be reloadable; the
-                    # snapshot skips while the persister holds the shm
-                    # lock, so retry briefly instead of dropping the save
-                    for _ in range(20):
-                        if self.engine.save_to_storage(
-                                self.state.global_step, state):
-                            break
-                        time.sleep(0.25)
-                    else:
-                        logger.warning(
-                            "best step %d never snapshotted (persister "
-                            "busy); reload at end may fall back",
-                            self.state.global_step,
-                        )
+                    # the best step must be durable to be reloadable
+                    self._durable_save(self.state.global_step, state)
         self.callback_handler.fire(
             "on_evaluate", self.args, self.state, self.control,
             metrics=metrics,

@@ -1,0 +1,157 @@
+"""Vision family: ViT encoder + CLIP dual-encoder on the shared block.
+
+Reference analog: the model-zoo port surface (ATorch's CLIP attention/MLP
+parallel modules, modules_registry.py) — here exercised as: same strategy
+presets, same compile path, pixels in.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.models import vision
+from dlrover_tpu.parallel.strategy import PRESETS
+from dlrover_tpu.trainer.train_step import compile_train
+
+TINY = vision.VISION_CONFIGS["vit-tiny"]
+
+
+class TestPatchify:
+    def test_shapes_and_content(self):
+        imgs = np.arange(2 * 32 * 32 * 3, dtype=np.float32).reshape(
+            2, 32, 32, 3)
+        patches = vision.patchify(jnp.asarray(imgs), 8)
+        assert patches.shape == (2, 16, 8 * 8 * 3)
+        # first patch = top-left 8x8 block, row-major
+        expect = imgs[0, :8, :8, :].reshape(-1)
+        np.testing.assert_array_equal(np.asarray(patches[0, 0]), expect)
+
+
+class TestViT:
+    def test_encode_shapes_and_pooling(self):
+        params = vision.init_vit_params(TINY, jax.random.PRNGKey(0))
+        imgs = jnp.ones((2, 32, 32, 3), jnp.float32)
+        feats = vision.vit_encode(params, imgs, TINY)
+        assert feats.shape == (2, TINY.d_model)
+        # mean pooling drops the cls token
+        import dataclasses
+
+        mean_cfg = dataclasses.replace(TINY, pool="mean")
+        p2 = vision.init_vit_params(mean_cfg, jax.random.PRNGKey(0))
+        assert "cls" not in p2
+        assert vision.vit_encode(p2, imgs, mean_cfg).shape == (
+            2, TINY.d_model)
+
+    def test_logical_axes_match_params(self):
+        params = vision.init_classifier_params(
+            TINY, 4, jax.random.PRNGKey(0))
+        axes = vision.classifier_logical_axes(TINY)
+        p_paths = jax.tree_util.tree_structure(params)
+        a_paths = jax.tree_util.tree_structure(
+            axes, is_leaf=lambda x: isinstance(x, tuple))
+        assert p_paths == a_paths
+
+    @pytest.mark.timeout(180)
+    def test_supervised_vit_trains_under_fsdp_tp(self):
+        # learnable rule: class = quadrant with the brightest mean
+        rng = np.random.default_rng(0)
+        n = 64
+        imgs = rng.normal(size=(n, 32, 32, 3)).astype(np.float32)
+        labels = rng.integers(0, 4, size=n).astype(np.int32)
+        for i in range(n):
+            q = labels[i]
+            r, c = divmod(int(q), 2)
+            imgs[i, r * 16:(r + 1) * 16, c * 16:(c + 1) * 16] += 2.0
+
+        strategy = PRESETS["fsdp_tp"]()
+        mesh = strategy.build_mesh()
+        compiled = compile_train(
+            strategy=strategy,
+            mesh=mesh,
+            loss_fn=lambda p, b: vision.classifier_loss_fn(p, b, TINY),
+            init_params_fn=lambda rng: vision.init_classifier_params(
+                TINY, 4, rng),
+            logical_params=vision.classifier_logical_axes(TINY),
+            optimizer=optax.adam(1e-3),
+        )
+        state = compiled.init(jax.random.PRNGKey(0))
+        losses = []
+        for step in range(10):
+            lo = step * 16 % n
+            batch = {
+                "images": jnp.asarray(imgs[lo:lo + 16])[None],
+                "labels": jnp.asarray(labels[lo:lo + 16])[None],
+            }
+            state, metrics = compiled.step(
+                state, jax.device_put(batch, compiled.batch_sharding))
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0]
+
+
+class TestClip:
+    CFG = vision.CLIP_CONFIGS["clip-tiny"]
+
+    def test_forward_shapes_and_normalization(self):
+        params = vision.init_clip_params(self.CFG, jax.random.PRNGKey(0))
+        batch = {
+            "images": jnp.ones((4, 32, 32, 3), jnp.float32),
+            "tokens": jnp.arange(4 * 16).reshape(4, 16) % 512,
+        }
+        img, txt, scale = vision.clip_forward(params, batch, self.CFG)
+        assert img.shape == (4, self.CFG.proj_dim)
+        assert txt.shape == (4, self.CFG.proj_dim)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(img), axis=-1), 1.0, rtol=1e-4)
+        assert float(scale) == pytest.approx(1 / 0.07, rel=1e-4)
+        # eot pooling picks the requested position
+        batch["eot"] = jnp.full((4,), 7)
+        img2, txt2, _ = vision.clip_forward(params, batch, self.CFG)
+        assert not np.allclose(np.asarray(txt), np.asarray(txt2))
+        np.testing.assert_allclose(
+            np.asarray(img), np.asarray(img2), rtol=1e-5)
+
+    @pytest.mark.timeout(240)
+    def test_contrastive_training_aligns_pairs(self):
+        # pair i: image brightness ramp i <-> token sequence of id i
+        n = 32
+        imgs = np.zeros((n, 32, 32, 3), np.float32)
+        toks = np.zeros((n, 16), np.int64)
+        for i in range(n):
+            imgs[i] += (i / n) * 2 - 1 + 0.05 * np.random.default_rng(
+                i).normal(size=(32, 32, 3))
+            toks[i] = i + 1
+        cfg = self.CFG
+
+        strategy = PRESETS["dp"]()
+        mesh = strategy.build_mesh()
+        compiled = compile_train(
+            strategy=strategy,
+            mesh=mesh,
+            loss_fn=lambda p, b: vision.clip_loss_fn(p, b, cfg),
+            init_params_fn=lambda rng: vision.init_clip_params(cfg, rng),
+            logical_params=vision.clip_logical_axes(cfg),
+            optimizer=optax.adam(3e-3),
+        )
+        state = compiled.init(jax.random.PRNGKey(1))
+        first = last = None
+        for step in range(12):
+            lo = (step * 16) % n
+            batch = {
+                "images": jnp.asarray(imgs[lo:lo + 16])[None],
+                "tokens": jnp.asarray(toks[lo:lo + 16])[None],
+            }
+            state, metrics = compiled.step(
+                state, jax.device_put(batch, compiled.batch_sharding))
+            loss = float(metrics["loss"])
+            first = first if first is not None else loss
+            last = loss
+        # the learned temperature starts hot (1/0.07), so the untrained
+        # loss sits well above the uniform-pairing bound log(16) = 2.77;
+        # training must recover past that bound, not just move
+        assert last < first
+        assert last < np.log(16)
