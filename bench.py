@@ -431,12 +431,18 @@ def _goodput_scenario(extra: dict, prefix: str, child_env: dict,
     env.update({
         "DLROVER_TPU_IPC_DIR": os.path.join(work, "ipc"),
         "PYTHONPATH": env.get("PYTHONPATH", "") + os.pathsep + repo,
+    })
+    if env.get("DLROVER_TPU_PLATFORM") != "cpu":
         # persistent compile cache: restarted incarnations reload the
         # executable instead of recompiling — the TPU-idiomatic way to
-        # keep restart cost out of goodput
-        "JAX_COMPILATION_CACHE_DIR": os.path.join(work, "jit_cache"),
-        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
-    })
+        # keep restart cost out of goodput. NOT for the CPU scenario:
+        # XLA:CPU's AOT cache loads misexecute (machine-feature mismatch
+        # -> wedged collectives, jax 0.9) — the trainer bootstrap skips
+        # it there for the same reason.
+        env.update({
+            "JAX_COMPILATION_CACHE_DIR": os.path.join(work, "jit_cache"),
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
+        })
 
     def train_args(mem_interval: int) -> list[str]:
         return [
@@ -487,9 +493,31 @@ def _goodput_scenario(extra: dict, prefix: str, child_env: dict,
             deadline_s=target_s * 3 + 600, example=example)
         report = compute_goodput(log, start_time=t_launch,
                                  end_time=t_exit)
+        # North-star normalization (BASELINE.md: >=95% goodput at ONE
+        # injected preemption per hour). The harness compresses time —
+        # killed/total_s is 20-30x the baseline's failure rate — so the
+        # raw window number charges 20-30 failures/hour of restart cost.
+        # Decompose the measured loss into per-failure cost + steady
+        # snapshot overhead and price it at the baseline's rate. The
+        # per-failure cost keeps rollback re-compute as measured
+        # (conservative: the snapshot cadence was tuned for the
+        # stressed rate, not the 1/hour one).
+        n_snaps = report.n_steps // max(1, interval)
+        fail_lost_s = max(0.0, report.lost_s - n_snaps * snap_s)
+        per_failure_s = fail_lost_s / killed if killed else 0.0
+        step_cost = report.median_step_s + snap_s / max(1, interval)
+        f_snap = (snap_s / max(1, interval)) / step_cost
+        goodput_hourly = max(
+            0.0, 1.0 - per_failure_s / 3600.0 - f_snap
+        )
         extra.update({
             f"{prefix}goodput": round(report.goodput, 4),
             f"{prefix}goodput_cold": round(report.goodput_cold, 4),
+            f"{prefix}per_failure_cost_s": round(per_failure_s, 2),
+            f"{prefix}snapshot_overhead_frac": round(f_snap, 5),
+            # the north-star number: measured failure cost at the
+            # baseline's 1-preemption-per-hour rate
+            f"{prefix}goodput_at_baseline_rate": round(goodput_hourly, 4),
             f"{prefix}failures_injected": killed,
             f"{prefix}incarnations": report.n_incarnations,
             f"{prefix}steps": report.n_steps,
@@ -551,7 +579,8 @@ def bench_goodput(extra: dict) -> None:
         target_s=target_s, kills=kills,
     )
     # headline aliases (the systems scenario is THE goodput number)
-    for k in ("goodput", "goodput_cold", "failures_injected",
+    for k in ("goodput", "goodput_cold", "goodput_at_baseline_rate",
+              "per_failure_cost_s", "failures_injected",
               "incarnations", "steps", "median_step_s", "total_s"):
         if f"goodput_sys_{k}" in extra:
             name = k if k.startswith("goodput") else f"goodput_{k}"

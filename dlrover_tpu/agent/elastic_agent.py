@@ -66,6 +66,11 @@ class AgentConfig:
     topology_key: str = ""
     save_on_failure: bool = True
     comm_port_base: int = 0  # 0 -> pick free ports
+    # node-local hang detection (agent/hang_detector.py): restart the
+    # trainer when its reported step stops advancing for this long.
+    # 0 disables. The grace covers (re)compilation after every spawn.
+    hang_timeout_s: float = 0.0
+    hang_startup_grace_s: float = 600.0
 
 
 def _detect_local_devices() -> int:
@@ -104,6 +109,15 @@ class ElasticAgent:
         self._node_rank = -1
         self._pending_action = ""
         self._action_lock = threading.Lock()
+        self._hang = None
+        if config.hang_timeout_s > 0:
+            from dlrover_tpu.agent.hang_detector import HangDetector
+
+            self._hang = HangDetector(
+                config.node_id,
+                timeout_s=config.hang_timeout_s,
+                startup_grace_s=config.hang_startup_grace_s,
+            )
 
     # ------------------------------------------------------------ rendezvous
 
@@ -156,6 +170,9 @@ class ElasticAgent:
             self._incarnation, self._restart_count,
             " ".join(self._config.entrypoint),
         )
+        if self._hang is not None:
+            # every incarnation recompiles: fresh grace period
+            self._hang.reset()
         return subprocess.Popen(
             self._config.entrypoint, env=env, start_new_session=True
         )
@@ -201,6 +218,7 @@ class ElasticAgent:
         rank, num_nodes, coordinator = self._rendezvous()
         self._restore_from_buddy()
         self._proc = self._spawn(rank, num_nodes, coordinator)
+        hang = self._hang
         while True:
             time.sleep(self._config.monitor_interval_s)
             code = self._proc.poll()
@@ -216,6 +234,20 @@ class ElasticAgent:
                 outcome = self._handle_failure(code)
                 if outcome is not None:
                     return outcome
+                continue
+            if hang is not None and hang.check():
+                # wedged trainer: the kill surfaces as a failure exit on
+                # the next poll and flows through the normal restart and
+                # failover budget (the reference's HangingDetector
+                # relaunch). _handle_failure owns the master report — a
+                # second report here would double-trigger master-side
+                # recovery actions.
+                logger.warning(
+                    "hang detected: no training progress past step %d "
+                    "for %.0fs; killing the wedged trainer",
+                    hang.last_step(), self._config.hang_timeout_s,
+                )
+                self._kill_child()
                 continue
             # healthy: check for membership changes / master actions
             if self._master_action() == "restart":

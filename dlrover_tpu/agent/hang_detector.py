@@ -1,0 +1,117 @@
+"""Agent-side hanging detection: progress-timeout -> worker restart.
+
+Reference analog: atorch/atorch/fault_tolerance/hanging_detector.py:86
+(HangingDetector: relaunch when training makes no progress within a
+timeout) + the TorchTrainingMonitor file channel
+(dlrover/python/elastic_agent/monitor/training.py). The master's hang
+check (speed_monitor + job_master) sees a job-wide stall through step
+reports; this detector is the NODE-local fast path — it catches a wedged
+trainer process (deadlocked collective, stuck host callback) without
+waiting for the master's global dead-window, and restarts in place.
+
+Channel: the trainer touches a tiny JSON progress file (atomic rename) in
+the job's IPC dir every few steps; the agent stats it. A file — not an RPC
+or shm — so a fully wedged process can't take the channel down with it,
+and the agent can read the last-good step after the child dies.
+
+TPU note on the startup grace: the first step compiles the whole SPMD
+program (20-40s single-chip, minutes for big meshes), and every
+incarnation recompiles after a membership change. The grace period is
+therefore per-spawn, not per-job: ``reset()`` on every (re)spawn.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.storage import atomic_write_file
+
+logger = get_logger(__name__)
+
+
+def progress_path(node_id: int | None = None) -> str:
+    from dlrover_tpu.common.multi_process import _socket_dir
+
+    if node_id is None:
+        node_id = int(os.environ.get(EnvKey.NODE_ID, "0"))
+    return os.path.join(_socket_dir(), f"progress_node{node_id}.json")
+
+
+class ProgressReporter:
+    """Trainer-side: cheap heartbeat-with-step, rate-limited writes."""
+
+    def __init__(self, node_id: int | None = None,
+                 min_interval_s: float = 1.0):
+        self._path = progress_path(node_id)
+        self._min_interval_s = min_interval_s
+        self._last_write = 0.0
+
+    def report(self, step: int) -> None:
+        now = time.monotonic()
+        if now - self._last_write < self._min_interval_s:
+            return
+        self._last_write = now
+        try:
+            atomic_write_file(
+                json.dumps({"step": int(step), "ts": time.time()}),
+                self._path,
+            )
+        except OSError as e:  # never let telemetry kill the step loop
+            logger.warning("progress report failed: %s", e)
+
+
+class HangDetector:
+    """Agent-side: hung = alive process, no NEW progress for timeout_s.
+
+    Progress = the reported step advancing. A trainer stuck inside one
+    step (wedged collective) keeps rewriting the same step number — that
+    still counts as hung once ``timeout_s`` passes without the step
+    moving. Before the first report, ``startup_grace_s`` applies
+    (compilation + data warmup).
+    """
+
+    def __init__(self, node_id: int | None = None, *,
+                 timeout_s: float = 300.0,
+                 startup_grace_s: float = 600.0):
+        self._path = progress_path(node_id)
+        self.timeout_s = timeout_s
+        self.startup_grace_s = startup_grace_s
+        self._spawned_at = time.monotonic()
+        self._last_step = -1
+        self._last_advance = self._spawned_at
+
+    def reset(self) -> None:
+        """Call on every (re)spawn: new incarnation, new grace period."""
+        self._spawned_at = time.monotonic()
+        self._last_step = -1
+        self._last_advance = self._spawned_at
+        try:
+            os.unlink(self._path)
+        except OSError:
+            pass
+
+    def last_step(self) -> int:
+        return self._last_step
+
+    def _read(self) -> int | None:
+        try:
+            with open(self._path) as f:
+                return int(json.load(f)["step"])
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def check(self, now: float | None = None) -> bool:
+        """True when the trainer should be considered hung."""
+        now = time.monotonic() if now is None else now
+        step = self._read()
+        if step is not None and step > self._last_step:
+            self._last_step = step
+            self._last_advance = now
+            return False
+        if self._last_step < 0:
+            return now - self._spawned_at > self.startup_grace_s
+        return now - self._last_advance > self.timeout_s

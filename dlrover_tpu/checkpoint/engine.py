@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Any, Callable
 
@@ -39,6 +40,11 @@ logger = get_logger(__name__)
 
 
 class CheckpointEngine:
+    # async snapshots supersede older pending ones, which is safe only
+    # when one node's snapshot is the whole checkpoint; sharded engines
+    # need cross-node step agreement and keep the sync path
+    supports_async_snapshot = True
+
     def __init__(
         self,
         ckpt_dir: str,
@@ -66,6 +72,18 @@ class CheckpointEngine:
         # persists to storage. Sharded engines set replicated=False and every
         # node persists its own shard.
         self.replicated = replicated
+        # async-snapshot pipeline state (save_to_memory_async)
+        self._pending_lock = threading.Lock()
+        self._pending: tuple[int, int, Any] | None = None  # (seq, step, snap)
+        self._async_seq = 0
+        # sequence floor: a sync save lifts it so an older async snapshot
+        # popped-but-unwritten can never overwrite the newer sync write
+        self._async_floor = 0
+        self._async_writing = False
+        self._snap_wake = threading.Event()
+        self._snap_stop = threading.Event()
+        self._snap_thread: threading.Thread | None = None
+        self._device_copy = None
         self._solo_saver = None
         agent_present = client_socket_ready(f"dict_ckpt_node{self.node_id}")
         if not agent_present:
@@ -99,15 +117,31 @@ class CheckpointEngine:
         header metadata)."""
         return state, {}
 
-    def save_to_memory(self, step: int, state: Any) -> bool:
+    def save_to_memory(self, step: int, state: Any,
+                       _async_seq: int | None = None) -> bool:
         """Sub-second snapshot into shm. Returns False if the saver is mid-
-        persist (skip rather than block the training step)."""
+        persist (skip rather than block the training step).
+
+        ``_async_seq`` is the snapshot-worker's ordering token: under the
+        shm lock, an async write whose sequence a sync save has already
+        superseded is dropped — otherwise a worker that popped step N and
+        then got descheduled could overwrite a NEWER sync snapshot the
+        persister is about to read.
+        """
         if not self.shm_handler.lock.acquire(blocking=False):
             logger.warning(
                 "skipping in-memory save at step %d: persister busy", step
             )
             return False
         try:
+            with self._pending_lock:
+                if _async_seq is not None:
+                    if _async_seq <= self._async_floor:
+                        return False  # superseded by a sync save
+                else:
+                    # sync write wins over anything async still in flight
+                    self._async_floor = self._async_seq
+                    self._pending = None
             start = time.monotonic()
             tree, extra = self._prepare_state(state)
             self.shm_handler.save_state_dict(
@@ -120,6 +154,78 @@ class CheckpointEngine:
             return True
         finally:
             self.shm_handler.lock.release()
+
+    def save_to_memory_async(self, step: int, state: Any) -> None:
+        """Zero-stall snapshot: returns before any device sync.
+
+        The synchronous path's cost is NOT the arena write — it is the
+        host blocking on ``device_get`` until every queued step finishes,
+        charged to the training loop (measured 0.15-0.35s per snapshot in
+        the goodput bench, 5-8% of steady step time at tuned cadences).
+        Here the state is first duplicated ON DEVICE (a jitted identity —
+        async dispatch, fresh buffers immune to the train step's buffer
+        donation; a post-donation host read of the original would raise
+        "Array has been deleted"), then a worker thread blocks and writes
+        the arena while the main thread keeps dispatching steps.
+
+        Costs one transient state copy in HBM; callers with states near
+        the HBM limit (the 1B ckpt bench) use the sync path. Supersede
+        semantics: only the newest pending snapshot is written.
+        """
+        import jax
+
+        if self._device_copy is None:
+            import jax.numpy as jnp
+
+            self._device_copy = jax.jit(
+                lambda t: jax.tree.map(jnp.copy, t)
+            )
+        snap = self._device_copy(state)
+        with self._pending_lock:
+            self._async_seq += 1
+            self._pending = (self._async_seq, step, snap)
+        if self._snap_thread is None:
+            self._snap_thread = threading.Thread(
+                target=self._snapshot_worker, name="snapshot-writer",
+                daemon=True,
+            )
+            self._snap_thread.start()
+        self._snap_wake.set()
+
+    def _snapshot_worker(self) -> None:
+        while not self._snap_stop.is_set():
+            self._snap_wake.wait()
+            if self._snap_stop.is_set():
+                return
+            self._snap_wake.clear()
+            with self._pending_lock:
+                pending, self._pending = self._pending, None
+                if pending is not None:
+                    self._async_writing = True
+            if pending is None:
+                continue
+            seq, step, snap = pending
+            try:
+                self.save_to_memory(step, snap, _async_seq=seq)
+            except Exception:  # noqa: BLE001 - snapshots are best-effort
+                logger.exception("async snapshot at step %d failed", step)
+            finally:
+                with self._pending_lock:
+                    self._async_writing = False
+
+    def flush_async(self, timeout: float = 60.0) -> bool:
+        """Wait until no snapshot is pending or mid-write."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._pending_lock:
+                # _async_writing covers the pop-to-write gap: pending is
+                # None the moment the worker claims it, before the shm
+                # lock is even requested
+                idle = self._pending is None and not self._async_writing
+            if idle and not self._snap_wake.is_set():
+                return True
+            time.sleep(0.02)
+        return False
 
     def save_to_storage(self, step: int, state: Any) -> bool:
         if not self.save_to_memory(step, state):
@@ -237,6 +343,11 @@ class CheckpointEngine:
         return False
 
     def close(self) -> None:
+        if self._snap_thread is not None:
+            self.flush_async(timeout=10.0)
+            self._snap_stop.set()
+            self._snap_wake.set()
+            self._snap_thread.join(timeout=5.0)
         if self._solo_saver is not None:
             from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
 

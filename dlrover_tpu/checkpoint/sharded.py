@@ -111,6 +111,9 @@ class ShardedCheckpointEngine(CheckpointEngine):
     trade (ckpt_saver.py: each rank snapshots its own state view).
     """
 
+    # async supersede semantics would break cross-node step agreement
+    supports_async_snapshot = False
+
     def __init__(self, *args,
                  owned: Callable[[Any], bool] | None = None, **kwargs):
         kwargs.setdefault("replicated", False)

@@ -27,7 +27,6 @@ import abc
 import dataclasses
 import subprocess
 import sys
-import threading
 import time
 
 from dlrover_tpu.cluster.crd import ElasticJob

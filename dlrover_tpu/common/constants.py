@@ -118,6 +118,7 @@ class EnvKey:
     CKPT_META_DIR = "DLROVER_TPU_CKPT_META_DIR"
     MOCK_ERR_RANK = "DLROVER_TPU_MOCK_ERR_RANK"
     DEVICE_COUNT_OVERRIDE = "DLROVER_TPU_DEVICE_COUNT"
+    COMPILE_CACHE_DIR = "DLROVER_TPU_COMPILE_CACHE"
 
 
 class Defaults:

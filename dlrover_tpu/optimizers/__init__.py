@@ -1,3 +1,6 @@
 from dlrover_tpu.optimizers.agd import agd  # noqa: F401
-from dlrover_tpu.optimizers.low_bit import adam_8bit  # noqa: F401
+from dlrover_tpu.optimizers.low_bit import (  # noqa: F401
+    adam_4bit,
+    adam_8bit,
+)
 from dlrover_tpu.optimizers.wsam import wsam  # noqa: F401

@@ -156,3 +156,140 @@ def adam_8bit(
         return steps, Adam8bitState(count=count, mu=mu, nu=nu)
 
     return optax.GradientTransformation(init_fn, update_fn)
+
+
+# ------------------------------------------------------------------- 4-bit
+
+
+def _codebook(signed: bool) -> jax.Array:
+    """16-level quadratic codebook on [-1, 1] (signed) or [0, 1].
+
+    Optimizer moments cluster near zero within a block; quadratic code
+    spacing spends most of the 4-bit budget there (the reference's 4-bit
+    states use a dynamic-exponent mapping for the same reason —
+    atorch/atorch/optimizers/low_bit/). Signed uses 15 symmetric levels
+    so zero is exactly representable.
+    """
+    if signed:
+        idx = jnp.arange(-7, 8, dtype=jnp.float32)
+        return jnp.sign(idx) * (jnp.abs(idx) / 7.0) ** 2
+    return (jnp.arange(16, dtype=jnp.float32) / 15.0) ** 2
+
+
+def _quantize4(x: jax.Array, block: int, signed: bool
+               ) -> tuple[jax.Array, jax.Array]:
+    """Flatten -> packed nibble codes [n_blocks, block//2] + f32 scales."""
+    flat = x.reshape(-1)
+    padded = jnp.zeros((_pad_len(flat.size, block),), x.dtype)
+    padded = padded.at[: flat.size].set(flat)
+    blocks = padded.reshape(-1, block).astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax, 1.0)
+    normed = blocks / scale
+    book = _codebook(signed)
+    codes = jnp.argmin(
+        jnp.abs(normed[..., None] - book), axis=-1
+    ).astype(jnp.int32)  # [n_blocks, block] in [0, 15]
+    hi, lo = codes[:, 0::2], codes[:, 1::2]
+    packed = ((hi << 4) | lo).astype(jnp.int8)
+    return packed, scale[:, 0].astype(jnp.float32)
+
+
+def _dequantize4(packed: jax.Array, scales: jax.Array, shape, block: int,
+                 signed: bool) -> jax.Array:
+    u = packed.astype(jnp.int32) & 0xFF
+    hi, lo = (u >> 4) & 0xF, u & 0xF
+    codes = jnp.stack([hi, lo], axis=-1).reshape(u.shape[0], -1)
+    book = _codebook(signed)
+    blocks = book[jnp.clip(codes, 0, book.size - 1)] * scales[:, None]
+    n = 1
+    for d in shape:
+        n *= d
+    return blocks.reshape(-1)[:n].reshape(shape)
+
+
+class Adam4bitState(NamedTuple):
+    count: chex.Array
+    mu: optax.Updates
+    nu: optax.Updates
+
+
+def adam_4bit(
+    learning_rate: float | optax.Schedule = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    block_size: int = 128,
+    min_quant_size: int = 4096,
+) -> optax.GradientTransformation:
+    """Adam whose m/v live as packed 4-bit codes: 0.5 byte/element state
+    (16x less optimizer HBM than fp32 Adam; 2x less than adam_8bit).
+
+    Same scaffold as adam_8bit: blockwise absmax scales, sqrt-domain v,
+    fp32 moments for small leaves. The smaller default block (128) offsets
+    the coarser codes with tighter scales.
+    """
+
+    def small(p) -> bool:
+        return p.size < min_quant_size
+
+    def q_zero(p):
+        if small(p):
+            return jnp.zeros(p.shape, jnp.float32)
+        n_blocks = _pad_len(p.size, block_size) // block_size
+        return _Quantized(
+            codes=jnp.zeros((n_blocks, block_size // 2), jnp.int8),
+            scales=jnp.zeros((n_blocks,), jnp.float32),
+        )
+
+    def init_fn(params):
+        return Adam4bitState(
+            count=jnp.zeros((), jnp.int32),
+            mu=jax.tree.map(q_zero, params),
+            nu=jax.tree.map(q_zero, params),
+        )
+
+    def update_fn(updates, state, params=None):
+        del params
+        count = state.count + 1
+
+        def leaf_update(g, mu_q, nu_q):
+            if not isinstance(mu_q, _Quantized):
+                m, v = mu_q, nu_q
+            else:
+                m = _dequantize4(mu_q.codes, mu_q.scales, g.shape,
+                                 block_size, signed=True)
+                r = _dequantize4(nu_q.codes, nu_q.scales, g.shape,
+                                 block_size, signed=False)
+                v = r * r
+            g32 = g.astype(jnp.float32)
+            m = b1 * m + (1.0 - b1) * g32
+            v = b2 * v + (1.0 - b2) * g32 * g32
+            mhat = m / (1.0 - b1 ** count.astype(jnp.float32))
+            vhat = v / (1.0 - b2 ** count.astype(jnp.float32))
+            lr = (
+                learning_rate(count - 1)
+                if callable(learning_rate) else learning_rate
+            )
+            step = (-lr * mhat / (jnp.sqrt(vhat) + eps)).astype(g.dtype)
+            if not isinstance(mu_q, _Quantized):
+                return step, m, v
+            m_q = _Quantized(*_quantize4(m, block_size, signed=True))
+            v_q = _Quantized(
+                *_quantize4(jnp.sqrt(v), block_size, signed=False)
+            )
+            return step, m_q, v_q
+
+        flat_g, treedef = jax.tree_util.tree_flatten(updates)
+        flat_mu = treedef.flatten_up_to(state.mu)
+        flat_nu = treedef.flatten_up_to(state.nu)
+        out = [leaf_update(g, mq, nq)
+               for g, mq, nq in zip(flat_g, flat_mu, flat_nu)]
+        steps = jax.tree_util.tree_unflatten(
+            treedef, [o[0] for o in out]
+        )
+        mu = jax.tree_util.tree_unflatten(treedef, [o[1] for o in out])
+        nu = jax.tree_util.tree_unflatten(treedef, [o[2] for o in out])
+        return steps, Adam4bitState(count=count, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)

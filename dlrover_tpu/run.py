@@ -67,6 +67,12 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
                    help="with --network-check: also exclude slow nodes")
     p.add_argument("--no-save-on-failure", action="store_true",
                    help="skip the breakpoint checkpoint persist on restart")
+    p.add_argument("--hang-timeout", type=float, default=0.0,
+                   help="restart the trainer when its step stops "
+                        "advancing for this many seconds (0 disables)")
+    p.add_argument("--hang-startup-grace", type=float, default=600.0,
+                   help="per-spawn grace before hang detection arms "
+                        "(covers XLA compilation)")
     p.add_argument("--host-ip", default="127.0.0.1")
     p.add_argument("--topology-key", default="",
                    help="rank-sorting key (TPU slice/host position)")
@@ -165,6 +171,8 @@ def main(argv: list[str] | None = None) -> int:
         host_ip=args.host_ip,
         topology_key=args.topology_key,
         save_on_failure=not args.no_save_on_failure,
+        hang_timeout_s=args.hang_timeout,
+        hang_startup_grace_s=args.hang_startup_grace,
     )
     try:
         result = launch_agent(config)

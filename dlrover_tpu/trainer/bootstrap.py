@@ -72,6 +72,63 @@ class RunContext:
     under_agent: bool = False
 
 
+def setup_compilation_cache(path: str | None = None) -> str | None:
+    """Point XLA's persistent compilation cache at a host-local dir.
+
+    The goodput lever for elasticity x static compilation (SURVEY §7 hard
+    parts): every restart-in-place re-traces the same program, and without
+    this cache each incarnation pays the full XLA compile (tens of seconds
+    to minutes at scale) before its first step. With it, a restarted
+    process deserializes the executable in ~1s, so the per-failure cost is
+    rendezvous + restore, not recompilation. The reference has no analog —
+    torch re-executes eagerly — this cost class only exists under XLA, and
+    this is its native fix.
+
+    Default path is host-local (/tmp): it survives process death and
+    restart-in-place. Point DLROVER_TPU_COMPILE_CACHE at job-shared
+    storage to also cover node relaunches onto fresh hosts; set it to
+    ``off`` to disable.
+    """
+    import jax
+
+    explicit = path or os.environ.get(EnvKey.COMPILE_CACHE_DIR)
+    if explicit and explicit.lower() in ("off", "none", "0"):
+        return None
+    if not explicit:
+        # already configured (JAX_COMPILATION_CACHE_DIR env or caller):
+        # don't override a deliberate per-job cache location
+        if jax.config.jax_compilation_cache_dir:
+            return jax.config.jax_compilation_cache_dir
+        # XLA:CPU's AOT cache deserialization is unreliable
+        # (machine-feature mismatch on load -> misexecuting executables
+        # that wedge cross-device collectives; observed with jax 0.9).
+        # The cache is a TPU-path feature, so the default requires a
+        # POSITIVE TPU indicator — an env sniff for "not cpu" would
+        # enable it on a bare CPU run with no platform env set at all.
+        # (The backend itself can't be queried here: that would
+        # initialize it before jax.distributed.initialize.)
+        platform = (os.environ.get("DLROVER_TPU_PLATFORM")
+                    or os.environ.get("JAX_PLATFORMS", "")).lower()
+        if "cpu" in platform:
+            return None  # explicitly CPU: never cache
+        if not any(p in platform for p in ("tpu", "axon")):
+            # TPU VMs usually leave JAX_PLATFORMS unset; libtpu being
+            # importable is the positive indicator there
+            import importlib.util
+
+            if importlib.util.find_spec("libtpu") is None:
+                return None
+    cache_dir = explicit or "/tmp/dlrover_tpu_xla_cache"
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache even fast compiles: restart storms re-pay them N times
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except (RuntimeError, AttributeError) as e:
+        logger.warning("compilation cache unavailable: %s", e)
+        return None
+    return cache_dir
+
+
 def init_from_env(initialize_distributed: bool = True) -> RunContext:
     """Read the agent contract from env; multi-node: join the JAX cluster.
 
@@ -91,6 +148,7 @@ def init_from_env(initialize_distributed: bool = True) -> RunContext:
         except RuntimeError:
             logger.warning("backend already initialized; cannot force %s",
                            platform)
+    setup_compilation_cache()
     ctx = RunContext(
         job_name=os.environ.get(EnvKey.JOB_NAME, "local"),
         node_id=int(os.environ.get(EnvKey.NODE_ID, "0")),

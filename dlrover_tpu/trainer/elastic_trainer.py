@@ -84,6 +84,12 @@ class ElasticTrainer:
         self.assembler = BatchAssembler(self.accum, self.local_step_batch)
         self._report_interval = report_step_interval
         self._host_step = 0  # avoids blocking on the device step counter
+        # node-local progress heartbeat for the agent's hang detector
+        # (agent/hang_detector.py); file writes, rate-limited, never on
+        # the device-dispatch path
+        from dlrover_tpu.agent.hang_detector import ProgressReporter
+
+        self._progress = ProgressReporter()
         self._client = master_client
         if self._client is None and os.environ.get(EnvKey.MASTER_ADDR):
             from dlrover_tpu.agent.master_client import MasterClient
@@ -112,6 +118,7 @@ class ElasticTrainer:
         # host-side counter: reading state.step would block async dispatch
         self._host_step += 1
         step = self._host_step
+        self._progress.report(step)
         if self._client is not None and step % self._report_interval == 0:
             try:
                 self._client.report_step(step)

@@ -337,20 +337,24 @@ class Trainer:
 
         return itertools.islice(iter(ds), skip_samples, None)
 
-    def _sample_iter(self, ds: Iterable) -> Iterable:
-        """Eval/predict sample stream, sharded across processes.
+    def _sample_iter(self, ds: Iterable, shard: bool = True) -> Iterable:
+        """Eval sample stream, optionally sharded across processes.
 
-        Sequences are truncated to a process-count multiple then strided
-        (equal batch counts everywhere, no duplicated work). Plain
-        iterables can't be split safely — every process reads the full
-        stream, which is numerically correct for evaluate (identical
-        global batches) at the cost of redundant passes.
+        Sharded Sequences are padded by wrap-around to a process-count
+        multiple then strided: equal batch counts on every process
+        (collective safety), every sample scored at least once
+        (drop_last=False; the <np wrapped samples weigh double in the
+        mean). ``shard=False`` (predict) and plain iterables read the
+        full stream on every process.
         """
         np_ = self.elastic.num_processes
         if hasattr(ds, "__len__") and hasattr(ds, "__getitem__"):
-            n = len(ds) - (len(ds) % np_ if np_ > 1 else 0)
-            idx = range(jax.process_index(), n, np_) if np_ > 1 \
-                else range(n)
+            if shard and np_ > 1:
+                idx = list(range(len(ds)))
+                idx += idx[:(-len(idx)) % np_]  # wrap-pad to a multiple
+                idx = idx[jax.process_index()::np_]
+            else:
+                idx = range(len(ds))
             return (ds[int(i)] for i in idx)
         return iter(ds)
 
@@ -501,7 +505,18 @@ class Trainer:
                     self._save_checkpoint(step, state)
                 elif (args.memory_save_steps
                         and step % args.memory_save_steps == 0):
-                    self.engine.save_to_memory(step, state)
+                    if (self.engine.supports_async_snapshot
+                            and self.mesh.devices.flat[0].platform
+                            != "cpu"):
+                        # zero-stall flash snapshot (device-side copy +
+                        # background arena write). Not on the CPU
+                        # backend: a second thread touching arrays
+                        # mid-collective wedges XLA:CPU's in-process
+                        # rendezvous (fatal aborts; see
+                        # examples/train_transformer.py)
+                        self.engine.save_to_memory_async(step, state)
+                    else:
+                        self.engine.save_to_memory(step, state)
                 if step >= total_steps or self.control.should_training_stop:
                     break
             if not made_progress:
@@ -740,7 +755,9 @@ class Trainer:
                 forward_fn: Callable[[Any, Any], Any],
                 params: Any | None = None) -> list:
         """Run ``forward_fn(params, batch)`` over a dataset; returns host
-        arrays per batch (the reference's Trainer.predict analog)."""
+        arrays per batch (the reference's Trainer.predict analog).
+        Every process reads the FULL dataset (complete outputs
+        everywhere; multi-process runs duplicate the forward work)."""
         if params is None:
             if self._train_state is None:
                 raise ValueError("no params: train first or pass params")
@@ -750,7 +767,8 @@ class Trainer:
         local_bsz = self._eval_local_batch()
         outs: list = []
         for buf, true in self._batched(
-                self._sample_iter(dataset), local_bsz):
+                self._sample_iter(dataset, shard=False),
+                local_bsz):
             batch = self.collate_fn(buf)
             out = jax.device_get(fn(params, self._put_eval_batch(batch)))
             if true < local_bsz:

@@ -82,6 +82,10 @@ def parse_args(argv=None):
                    help="crash only if this marker file is absent "
                         "(created before crashing) — survives node "
                         "relaunches, unlike the restart-count gate")
+    p.add_argument("--hang-at-step", type=int, default=0,
+                   help="fault injection: wedge forever at this step "
+                        "(first incarnation only) — exercises the "
+                        "agent's hang detector")
     return p.parse_args(argv)
 
 
@@ -258,10 +262,23 @@ def main(argv=None) -> int:
         config_reader=paral,
     )
 
+    # Async snapshots are the TPU path. On the virtual-multi-device CPU
+    # backend a second thread touching arrays mid-collective wedges
+    # XLA:CPU's in-process rendezvous (fatal "Expected 8 threads..."
+    # aborts, observed in the goodput bench) — same class of CPU-substrate
+    # fragility as its AOT cache (trainer/bootstrap.py).
+    on_cpu = jax.devices()[0].platform == "cpu"
+    use_async = engine.supports_async_snapshot and not on_cpu
+
     def checkpointer(step: int, st) -> None:
         if step % args.mem_ckpt_interval == 0:
             if step % args.ckpt_interval == 0:
                 engine.save_to_storage(step, st)
+            elif use_async:
+                # zero-stall: device-side copy + background arena write
+                # (sharded engine keeps the sync path: async supersede
+                # semantics would break its cross-node step agreement)
+                engine.save_to_memory_async(step, st)
             else:
                 engine.save_to_memory(step, st)
 
@@ -285,9 +302,23 @@ def main(argv=None) -> int:
                 return False
         return args.crash_always or ctx.restart_count == 0
 
+    # On CPU, pace the host to the device each step: dispatch runs ahead
+    # of execution by hundreds of steps there, so host-side step events
+    # (goodput log) and snapshot timings would charge queue-drain waits
+    # to the wrong step. In-process fetch is ~free on CPU; on TPU the
+    # tunnel RTT makes pacing expensive AND async dispatch is the point.
+    pace_host = on_cpu
+
     def on_step(step: int, metrics: dict) -> None:
+        if pace_host:
+            jax.device_get(metrics["loss"])
         if goodput is not None:
             goodput.step(step)
+        if args.hang_at_step and step == args.hang_at_step \
+                and ctx.restart_count == 0:
+            print(f"[trainer] injected hang at step {step}", flush=True)
+            while True:  # wedged: alive but no progress
+                time.sleep(3600)
         if args.crash_at_step and step == args.crash_at_step \
                 and _should_crash():
             print(f"[trainer] injected crash at step {step} "

@@ -1,0 +1,70 @@
+"""Zero-stall async shm snapshots (CheckpointEngine.save_to_memory_async).
+
+The goodput-critical path: the sync snapshot charges the training loop for
+a device sync + arena write every cadence (measured 5-8% of steady step
+time in the goodput bench); the async path must cost the loop nothing,
+survive the train step's buffer donation, and keep only the newest
+pending snapshot.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.checkpoint.engine import CheckpointEngine
+
+
+@pytest.fixture()
+def engine(tmp_ipc_dir, tmp_path):
+    eng = CheckpointEngine(str(tmp_path / "ckpt"), node_id=3)
+    yield eng
+    eng.close()
+
+
+def _state(v: float):
+    return {"w": jnp.full((64, 64), v), "step": jnp.asarray(int(v))}
+
+
+@pytest.mark.timeout(60)
+def test_async_snapshot_lands_and_matches(engine):
+    engine.save_to_memory_async(7, _state(7.0))
+    assert engine.flush_async(timeout=30)
+    loaded = engine.load(_state(0.0))
+    assert loaded is not None
+    step, state = loaded
+    assert step == 7
+    np.testing.assert_array_equal(np.asarray(state["w"]), 7.0)
+
+
+@pytest.mark.timeout(60)
+def test_supersede_keeps_newest(engine):
+    for v in (1, 2, 3):
+        engine.save_to_memory_async(v, _state(float(v)))
+    assert engine.flush_async(timeout=30)
+    step, state = engine.load(_state(0.0))
+    assert step == 3
+    np.testing.assert_array_equal(np.asarray(state["w"]), 3.0)
+
+
+@pytest.mark.timeout(120)
+def test_survives_buffer_donation(engine):
+    """The snapshot must capture the value at save time even though the
+    very next train step donates (and deletes) those buffers."""
+    step_fn = jax.jit(
+        lambda s: {"w": s["w"] * 2, "step": s["step"] + 1},
+        donate_argnums=0,
+    )
+    state = _state(5.0)
+    engine.save_to_memory_async(5, state)
+    state = step_fn(state)  # donates the snapshotted buffers
+    state = step_fn(state)
+    assert engine.flush_async(timeout=60)
+    step, snap = engine.load(_state(0.0))
+    assert step == 5
+    np.testing.assert_array_equal(np.asarray(snap["w"]), 5.0)  # not 20
+    # training state itself advanced independently
+    np.testing.assert_array_equal(np.asarray(state["w"]), 20.0)

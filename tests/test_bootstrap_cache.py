@@ -1,0 +1,70 @@
+"""Compilation-cache gating in trainer bring-up.
+
+The cache is the elasticity x static-compilation lever (restart without
+recompiling) but XLA:CPU's AOT deserialization misexecutes (jax 0.9), so
+enablement needs a positive TPU indicator — these tests pin the decision
+table without initializing any backend.
+"""
+
+from __future__ import annotations
+
+import jax
+import pytest
+
+from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.trainer import bootstrap
+
+
+@pytest.fixture()
+def clean_cache_config(monkeypatch):
+    monkeypatch.delenv(EnvKey.COMPILE_CACHE_DIR, raising=False)
+    monkeypatch.delenv("DLROVER_TPU_PLATFORM", raising=False)
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    before = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    yield
+    jax.config.update("jax_compilation_cache_dir", before)
+
+
+def test_explicit_cpu_platform_disables(clean_cache_config, monkeypatch):
+    monkeypatch.setenv("DLROVER_TPU_PLATFORM", "cpu")
+    assert bootstrap.setup_compilation_cache() is None
+    assert jax.config.jax_compilation_cache_dir is None
+
+
+def test_tpu_platform_enables_default_dir(clean_cache_config, monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    path = bootstrap.setup_compilation_cache()
+    assert path == "/tmp/dlrover_tpu_xla_cache"
+    assert jax.config.jax_compilation_cache_dir == path
+
+
+def test_off_sentinel_wins_over_platform(clean_cache_config, monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    monkeypatch.setenv(EnvKey.COMPILE_CACHE_DIR, "off")
+    assert bootstrap.setup_compilation_cache() is None
+
+
+def test_explicit_dir_enables_anywhere(clean_cache_config, monkeypatch,
+                                       tmp_path):
+    # operator override: explicit dir wins even without a TPU indicator
+    monkeypatch.setenv(EnvKey.COMPILE_CACHE_DIR, str(tmp_path / "c"))
+    assert bootstrap.setup_compilation_cache() == str(tmp_path / "c")
+
+
+def test_preconfigured_jax_dir_respected(clean_cache_config, monkeypatch,
+                                         tmp_path):
+    # e.g. the bench harness sets JAX_COMPILATION_CACHE_DIR per work dir;
+    # bootstrap must not override it with the shared default
+    jax.config.update("jax_compilation_cache_dir", str(tmp_path / "j"))
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    assert bootstrap.setup_compilation_cache() == str(tmp_path / "j")
+
+
+def test_bare_cpu_machine_stays_off(clean_cache_config):
+    # no platform envs at all: enable only if libtpu exists on this host
+    import importlib.util
+
+    expected_off = importlib.util.find_spec("libtpu") is None
+    result = bootstrap.setup_compilation_cache()
+    assert (result is None) == expected_off
