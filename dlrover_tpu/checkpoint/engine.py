@@ -84,6 +84,7 @@ class CheckpointEngine:
         self._snap_stop = threading.Event()
         self._snap_thread: threading.Thread | None = None
         self._device_copy = None
+        self._async_ok: bool | None = None
         self._solo_saver = None
         agent_present = client_socket_ready(f"dict_ckpt_node{self.node_id}")
         if not agent_present:
@@ -155,10 +156,24 @@ class CheckpointEngine:
         finally:
             self.shm_handler.lock.release()
 
+    def _async_eligible(self) -> bool:
+        """The gate lives HERE, not at call sites: sharded engines need
+        cross-node step agreement (supersede would break it), and on the
+        CPU backend a second host thread touching arrays mid-collective
+        wedges XLA:CPU's in-process rendezvous."""
+        if not self.supports_async_snapshot:
+            return False
+        if self._async_ok is None:
+            import jax
+
+            self._async_ok = jax.devices()[0].platform != "cpu"
+        return self._async_ok
+
     def save_to_memory_async(self, step: int, state: Any) -> None:
         """Zero-stall snapshot: returns before any device sync.
-
-        The synchronous path's cost is NOT the arena write — it is the
+        Falls back to the synchronous path where async is unsafe
+        (sharded engine, CPU backend) — callers never need their own
+        gate. The synchronous path's cost is NOT the arena write — it is the
         host blocking on ``device_get`` until every queued step finishes,
         charged to the training loop (measured 0.15-0.35s per snapshot in
         the goodput bench, 5-8% of steady step time at tuned cadences).
@@ -172,6 +187,9 @@ class CheckpointEngine:
         the HBM limit (the 1B ckpt bench) use the sync path. Supersede
         semantics: only the newest pending snapshot is written.
         """
+        if not self._async_eligible():
+            self.save_to_memory(step, state)
+            return
         import jax
 
         if self._device_copy is None:
@@ -228,6 +246,11 @@ class CheckpointEngine:
         return False
 
     def save_to_storage(self, step: int, state: Any) -> bool:
+        # a pending/mid-write async snapshot holds the shm lock across
+        # its device fetch; without this flush the non-blocking acquire
+        # below loses the race and the DURABLE save silently degrades
+        if self._snap_thread is not None:
+            self.flush_async()
         if not self.save_to_memory(step, state):
             return False
         if self._should_write_storage():

@@ -47,6 +47,9 @@ def _layer_attend(q, k_cache, v_cache, pos, n_rep, dt, window=0):
     it to H heads would multiply per-token decode memory traffic by
     ``n_rep`` on the hot path. ``window > 0`` applies the sliding-window
     mask so decode matches a model trained with local attention.
+    ``pos`` scalar: all rows in lockstep (one [S, K] mask). [B] vector:
+    independent per-row positions (continuous batching,
+    serving/engine.py) with a [B, S, K] mask.
     """
     B, S_new, H, D = q.shape
     scale = 1.0 / math.sqrt(D)
@@ -56,13 +59,22 @@ def _layer_attend(q, k_cache, v_cache, pos, n_rep, dt, window=0):
         jnp.float32
     ) * scale
     max_len = k_cache.shape[1]
-    # causal over absolute positions: query i sits at pos + i
-    q_pos = pos + jnp.arange(S_new)
     k_pos = jnp.arange(max_len)
-    mask = q_pos[:, None] >= k_pos[None, :]
-    if window > 0:
-        mask &= q_pos[:, None] - k_pos[None, :] < window
-    logits = jnp.where(mask[None, None, None], logits, -1e30)
+    if jnp.ndim(pos) == 0:
+        # causal over absolute positions: query i sits at pos + i
+        q_pos = pos + jnp.arange(S_new)
+        mask = q_pos[:, None] >= k_pos[None, :]            # [S, K]
+        if window > 0:
+            mask &= q_pos[:, None] - k_pos[None, :] < window
+        mask = mask[None, None, None]
+    else:
+        # row b's query i sits at pos[b] + i
+        q_pos = pos[:, None] + jnp.arange(S_new)[None]     # [B, S_new]
+        mask = q_pos[:, :, None] >= k_pos[None, None, :]   # [B, S, K]
+        if window > 0:
+            mask &= q_pos[:, :, None] - k_pos[None, None, :] < window
+        mask = mask[:, None, None]
+    logits = jnp.where(mask, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(dt)
     o = jnp.einsum("bgrqk,bkgd->bqgrd", probs, v_cache)
     return o.reshape(B, S_new, H, D)
@@ -76,21 +88,34 @@ def forward_cached(
 
     tokens: [B, S_new] -> (logits [B, S_new, vocab], updated cache).
     Used with S_new=P for prefill and S_new=1 for decode steps; both
-    compile once each (static shapes).
+    compile once each (static shapes). ``cache['pos']`` may be a scalar
+    (all rows in lockstep — generate()) or a [B] vector (independent
+    per-row positions — the continuous-batching serving engine).
     """
     c = cfg
     dt = jnp.dtype(c.dtype)
     B, S_new = tokens.shape
     pos = cache["pos"]
+    scalar_pos = jnp.ndim(pos) == 0  # static at trace time
     n_rep = c.n_heads // c.n_kv_heads
 
-    positions = pos + jnp.broadcast_to(jnp.arange(S_new), (B, S_new))
+    if scalar_pos:
+        positions = pos + jnp.broadcast_to(jnp.arange(S_new), (B, S_new))
+    else:
+        positions = pos[:, None] + jnp.arange(S_new)[None]
     x = params["embed"].astype(dt)[tokens]
     if c.variant == "gpt2":
-        pe = lax.dynamic_slice_in_dim(
-            params["pos_embed"].astype(dt), pos, S_new, axis=0
-        )
-        x = x + pe[None]
+        if scalar_pos:
+            pe = lax.dynamic_slice_in_dim(
+                params["pos_embed"].astype(dt), pos, S_new, axis=0
+            )[None]
+        else:
+            # gather (not slice): per-row positions; clamp keeps the
+            # lookup in-table for padded/inactive rows
+            pe = params["pos_embed"].astype(dt)[
+                jnp.clip(positions, 0, c.max_seq_len - 1)
+            ]
+        x = x + pe
 
     if c.moe_experts:
         from dlrover_tpu.ops.moe import MoeConfig, moe_ffn
@@ -123,12 +148,25 @@ def forward_cached(
         if c.variant == "llama":
             q = _rope(q, positions, c.rope_theta)
             k = _rope(k, positions, c.rope_theta)
-        k_cache_l = lax.dynamic_update_slice_in_dim(
-            k_cache_l, k.astype(dt), pos, axis=1
-        )
-        v_cache_l = lax.dynamic_update_slice_in_dim(
-            v_cache_l, v.astype(dt), pos, axis=1
-        )
+        if scalar_pos:
+            # one contiguous slice update for the whole batch (keeps the
+            # generate()/PPO hot path off the scatter lowering the
+            # vmapped form implies)
+            k_cache_l = lax.dynamic_update_slice_in_dim(
+                k_cache_l, k.astype(dt), pos, axis=1
+            )
+            v_cache_l = lax.dynamic_update_slice_in_dim(
+                v_cache_l, v.astype(dt), pos, axis=1
+            )
+        else:
+            # per-row write offsets: vmap a single-row dynamic update
+            row_update = jax.vmap(
+                lambda row, new, p: lax.dynamic_update_slice_in_dim(
+                    row, new, p, axis=0
+                )
+            )
+            k_cache_l = row_update(k_cache_l, k.astype(dt), pos)
+            v_cache_l = row_update(v_cache_l, v.astype(dt), pos)
         # the window only binds when training actually used it (the
         # splash kind) — other attention kinds ignore attention_window
         # in training, so decode must too or the masks diverge
@@ -174,15 +212,74 @@ def forward_cached(
     return logits.astype(jnp.float32), new_cache
 
 
+def sample_logits(
+    logits: jax.Array, key: jax.Array,
+    temperature: float | jax.Array = 1.0,
+    top_k: int | jax.Array = 0,
+    top_p: float | jax.Array = 1.0,
+) -> jax.Array:
+    """One sampling step over [B, V] logits: temperature, top-k, nucleus.
+
+    The serving-side sampler surface (reference analog: the vLLM
+    SamplingParams the RLHF backend passes through,
+    atorch/atorch/rl/inference_backend/vllm_backend.py) as pure lax ops:
+    static shapes, no data-dependent control flow, usable inside scan.
+
+    Each parameter may be a python scalar (whole batch, generate()) or a
+    [B] array (per-row, the continuous-batching engine) — one
+    implementation for both, so the nucleus/greedy semantics can't
+    drift between serving and rollout paths. Per-row temperature <= 0
+    means greedy for that row.
+    """
+    B, V = logits.shape
+    static = all(isinstance(p, (int, float))
+                 for p in (temperature, top_k, top_p))
+    if static and temperature <= 0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    temp = jnp.broadcast_to(
+        jnp.asarray(temperature, jnp.float32), (B,))
+    k_vec = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (B,))
+    p_vec = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (B,))
+
+    need_sort = (not static) or (0 < top_k < V) or top_p < 1.0
+    if need_sort:
+        sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
+        # top-k: survival threshold is the value at rank k-1; k <= 0 or
+        # k >= V disables the filter for that row
+        k_idx = jnp.clip(k_vec - 1, 0, V - 1)
+        kth = jnp.take_along_axis(sorted_l, k_idx[:, None], axis=-1)
+        k_on = ((k_vec > 0) & (k_vec < V))[:, None]
+        logits = jnp.where(k_on & (logits < kth), -jnp.inf, logits)
+        # nucleus: keep the smallest prefix of the (top-k-filtered)
+        # distribution whose mass reaches top_p; the top-1 always
+        # survives (cum - prob = 0 < top_p)
+        sorted_m = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_m, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = cum - probs < p_vec[:, None]
+        cutoff = jnp.min(
+            jnp.where(keep, sorted_m, jnp.inf), axis=-1, keepdims=True,
+        )
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+
+    scaled = logits / jnp.maximum(temp, 1e-6)[:, None]
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    greedy = jnp.argmax(logits, axis=-1)
+    return jnp.where(temp <= 0, greedy, sampled).astype(jnp.int32)
+
+
 def generate(
     params: Params, prompts: jax.Array, cfg: TransformerConfig,
     gen_len: int, key: jax.Array, temperature: float = 1.0,
-    max_len: int | None = None,
+    max_len: int | None = None, top_k: int = 0, top_p: float = 1.0,
+    eos_id: int | None = None,
 ) -> jax.Array:
     """Sample continuations with a KV cache: [B, P] -> [B, P+gen_len].
 
     O(P + gen_len) attention reads per generated token instead of the
-    O((P+gen_len)^2) full-forward recompute.
+    O((P+gen_len)^2) full-forward recompute. ``eos_id`` pads a finished
+    row with eos for the rest of the (static-shape) scan.
     """
     B, P = prompts.shape
     total = P + gen_len
@@ -203,23 +300,21 @@ def generate(
     cache = init_cache(cfg, B, max_len)
     logits, cache = forward_cached(params, prompts, cache, cfg)
     last = logits[:, -1]
+    done0 = jnp.zeros((B,), bool)
 
     def step(carry, key):
-        cache, last = carry
-        nxt = (
-            jax.random.categorical(
-                key, last / max(temperature, 1e-6), axis=-1
-            )
-            if temperature > 0
-            else jnp.argmax(last, axis=-1)
-        ).astype(jnp.int32)
+        cache, last, done = carry
+        nxt = sample_logits(last, key, temperature, top_k, top_p)
+        if eos_id is not None:
+            nxt = jnp.where(done, jnp.int32(eos_id), nxt)
+            done = done | (nxt == eos_id)
         logits, cache = forward_cached(
             params, nxt[:, None], cache, cfg
         )
-        return (cache, logits[:, -1]), nxt
+        return (cache, logits[:, -1], done), nxt
 
     keys = jax.random.split(key, gen_len)
-    (_, _), toks = lax.scan(step, (cache, last), keys)
+    (_, _, _), toks = lax.scan(step, (cache, last, done0), keys)
     return jnp.concatenate(
         [prompts, jnp.moveaxis(toks, 0, 1)], axis=1
     )

@@ -1,0 +1,6 @@
+from dlrover_tpu.serving.engine import (  # noqa: F401
+    InferenceEngine,
+    Request,
+    Result,
+    SamplingParams,
+)

@@ -1,0 +1,249 @@
+"""Continuous-batching inference engine (the vLLM-backend analog).
+
+Reference analog: the reference serves RLHF rollouts through vLLM
+(atorch/atorch/rl/inference_backend/vllm_backend.py) — its core idea is
+continuous batching: requests join and leave a fixed slot batch between
+decode iterations, so the accelerator always steps a full batch instead
+of waiting for the longest sequence. TPU-natively that becomes THREE
+compiled programs total (prefill, slot-install, decode-step) over a
+per-row-position KV cache (models/decode.py forward_cached with vector
+``pos``):
+
+- **prefill**: one [1, prefill_len] forward filling a fresh cache row
+  (prompts right-padded; pad rows beyond the true length are overwritten
+  just-in-time as decode advances, so they never leak into attention).
+- **install**: dynamic-update the prefilled row into the slot batch's
+  cache at a traced slot index.
+- **decode step**: one token for ALL slots at their own positions;
+  per-slot sampling params are vectorized (temperature/top_k/top_p as
+  [slots] arrays), finished slots are host-side bookkeeping.
+
+Static shapes everywhere: slot count, cache length and prefill length
+are engine constants, so serving never recompiles after warmup.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from collections import deque
+from typing import Any
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.models.decode import (
+    forward_cached,
+    init_cache,
+    sample_logits,
+)
+from dlrover_tpu.models.transformer import TransformerConfig
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    max_new_tokens: int = 64
+    eos_id: int | None = None
+
+
+@dataclasses.dataclass
+class Request:
+    id: int
+    prompt: list[int]
+    params: SamplingParams
+
+
+@dataclasses.dataclass
+class Result:
+    id: int
+    prompt: list[int]
+    tokens: list[int]          # generated continuation (no prompt)
+    finish_reason: str         # "eos" | "length"
+
+
+class InferenceEngine:
+    """Fixed-slot continuous batching over one model.
+
+    Usage::
+
+        eng = InferenceEngine(params, cfg, slots=8, max_len=256)
+        rid = eng.submit([1, 2, 3], SamplingParams(max_new_tokens=32))
+        results = eng.run()          # drain queue + active slots
+    """
+
+    def __init__(self, params: Any, cfg: TransformerConfig, *,
+                 slots: int = 8, max_len: int = 0,
+                 prefill_len: int = 0):
+        self.params = params
+        self.cfg = cfg
+        self.slots = slots
+        self.max_len = max_len or cfg.max_seq_len
+        self.prefill_len = prefill_len or min(64, self.max_len)
+        if self.prefill_len > self.max_len:
+            raise ValueError("prefill_len > max_len")
+
+        self._queue: deque[Request] = deque()
+        self._ids = itertools.count()
+        # host-side slot bookkeeping; None = free
+        self._active: list[Request | None] = [None] * slots
+        self._emitted: list[list[int]] = [[] for _ in range(slots)]
+        self._results: list[Result] = []
+
+        self._cache = init_cache(cfg, slots, self.max_len)
+        self._cache["pos"] = jnp.zeros((slots,), jnp.int32)
+        self._last = jnp.zeros((slots, cfg.vocab_size), jnp.float32)
+        self._key = jax.random.PRNGKey(0)
+
+        # --- compiled programs (three, total) -------------------------
+        def _prefill(params, tokens, true_len):
+            cache = init_cache(cfg, 1, self.max_len)
+            logits, cache = forward_cached(params, tokens, cache, cfg)
+            # logits at the last REAL prompt token (pads come after it)
+            last = logits[0, true_len - 1]
+            return cache["k"], cache["v"], last
+
+        self._prefill = jax.jit(_prefill)
+
+        def _install(cache_k, cache_v, pos, last_all, row_k, row_v,
+                     last_row, slot, true_len):
+            # write the prefilled row into slot `slot` of the big cache
+            cache_k = lax.dynamic_update_index_in_dim(
+                cache_k, row_k[:, 0], slot, axis=1
+            )
+            cache_v = lax.dynamic_update_index_in_dim(
+                cache_v, row_v[:, 0], slot, axis=1
+            )
+            pos = pos.at[slot].set(true_len)
+            last_all = last_all.at[slot].set(last_row)
+            return cache_k, cache_v, pos, last_all
+
+        self._install = jax.jit(_install)
+
+        def _step(params, k, v, pos, last, key, temperature, top_k,
+                  top_p, active):
+            # per-row sampling params as VECTORS: one compiled program
+            # regardless of the mix of requests in the batch
+            nxt = sample_logits(last, key, temperature, top_k, top_p)
+            cache = {"k": k, "v": v, "pos": pos}
+            logits, cache = forward_cached(
+                params, nxt[:, None], cache, cfg
+            )
+            # inactive rows must not advance (their pos would creep past
+            # max_len and clamp the next real install's attention math)
+            new_pos = jnp.where(active, cache["pos"], pos)
+            return nxt, cache["k"], cache["v"], new_pos, logits[:, 0]
+
+        self._step = jax.jit(_step)
+
+    # ----------------------------------------------------------- user API
+
+    def submit(self, prompt: list[int],
+               params: SamplingParams | None = None) -> int:
+        params = params or SamplingParams()
+        if len(prompt) > self.prefill_len:
+            raise ValueError(
+                f"prompt length {len(prompt)} > prefill_len "
+                f"{self.prefill_len}"
+            )
+        if len(prompt) + params.max_new_tokens > self.max_len:
+            raise ValueError("prompt + max_new_tokens > max_len")
+        rid = next(self._ids)
+        self._queue.append(Request(rid, list(prompt), params))
+        return rid
+
+    def _admit(self) -> None:
+        for slot in range(self.slots):
+            if self._active[slot] is not None or not self._queue:
+                continue
+            req = self._queue.popleft()
+            toks = np.zeros((1, self.prefill_len), np.int32)
+            toks[0, : len(req.prompt)] = req.prompt
+            row_k, row_v, last = self._prefill(
+                self.params, jnp.asarray(toks),
+                jnp.asarray(len(req.prompt), jnp.int32),
+            )
+            (self._cache["k"], self._cache["v"], self._cache["pos"],
+             self._last) = self._install(
+                self._cache["k"], self._cache["v"], self._cache["pos"],
+                self._last, row_k, row_v, last,
+                jnp.asarray(slot, jnp.int32),
+                jnp.asarray(len(req.prompt), jnp.int32),
+            )
+            self._active[slot] = req
+            self._emitted[slot] = []
+
+    def _sampling_tensors(self):
+        V = self.cfg.vocab_size
+        temp = np.ones((self.slots,), np.float32)
+        top_p = np.ones((self.slots,), np.float32)
+        top_k = np.zeros((self.slots,), np.int32)
+        for s, req in enumerate(self._active):
+            if req is None:
+                continue
+            temp[s] = req.params.temperature
+            top_p[s] = req.params.top_p
+            top_k[s] = req.params.top_k or 0
+        return (jnp.asarray(temp), jnp.asarray(top_k),
+                jnp.asarray(top_p))
+
+    def step(self) -> int:
+        """Admit waiting requests, decode one token for every active
+        slot, retire finished ones. Returns number of active slots."""
+        self._admit()
+        active_mask = np.array(
+            [r is not None for r in self._active], bool
+        )
+        if not active_mask.any():
+            return 0
+        temp, top_k, top_p = self._sampling_tensors()
+        self._key, sub = jax.random.split(self._key)
+        nxt, k, v, pos, last = self._step(
+            self.params, self._cache["k"], self._cache["v"],
+            self._cache["pos"], self._last, sub, temp, top_k,
+            top_p, jnp.asarray(active_mask),
+        )
+        self._cache["k"], self._cache["v"] = k, v
+        self._cache["pos"] = pos
+        self._last = last
+        toks = np.asarray(jax.device_get(nxt))
+        for s, req in enumerate(self._active):
+            if req is None:
+                continue
+            t = int(toks[s])
+            self._emitted[s].append(t)
+            p = req.params
+            if p.eos_id is not None and t == p.eos_id:
+                self._retire(s, "eos")
+            elif len(self._emitted[s]) >= p.max_new_tokens:
+                self._retire(s, "length")
+        return sum(r is not None for r in self._active)
+
+    def _retire(self, slot: int, reason: str) -> None:
+        req = self._active[slot]
+        self._results.append(Result(
+            id=req.id, prompt=req.prompt,
+            tokens=list(self._emitted[slot]), finish_reason=reason,
+        ))
+        self._active[slot] = None
+        self._emitted[slot] = []
+
+    def run(self, max_iters: int = 100000) -> list[Result]:
+        """Drain the queue and all active slots; returns results in
+        completion order."""
+        for _ in range(max_iters):
+            if not self._queue and not any(
+                r is not None for r in self._active
+            ):
+                break
+            self.step()
+        out, self._results = self._results, []
+        return out

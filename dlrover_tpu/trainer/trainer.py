@@ -505,18 +505,9 @@ class Trainer:
                     self._save_checkpoint(step, state)
                 elif (args.memory_save_steps
                         and step % args.memory_save_steps == 0):
-                    if (self.engine.supports_async_snapshot
-                            and self.mesh.devices.flat[0].platform
-                            != "cpu"):
-                        # zero-stall flash snapshot (device-side copy +
-                        # background arena write). Not on the CPU
-                        # backend: a second thread touching arrays
-                        # mid-collective wedges XLA:CPU's in-process
-                        # rendezvous (fatal aborts; see
-                        # examples/train_transformer.py)
-                        self.engine.save_to_memory_async(step, state)
-                    else:
-                        self.engine.save_to_memory(step, state)
+                    # zero-stall where safe; the engine self-gates
+                    # (sharded/CPU fall back to the sync path)
+                    self.engine.save_to_memory_async(step, state)
                 if step >= total_steps or self.control.should_training_stop:
                     break
             if not made_progress:

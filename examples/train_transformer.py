@@ -262,25 +262,16 @@ def main(argv=None) -> int:
         config_reader=paral,
     )
 
-    # Async snapshots are the TPU path. On the virtual-multi-device CPU
-    # backend a second thread touching arrays mid-collective wedges
-    # XLA:CPU's in-process rendezvous (fatal "Expected 8 threads..."
-    # aborts, observed in the goodput bench) — same class of CPU-substrate
-    # fragility as its AOT cache (trainer/bootstrap.py).
     on_cpu = jax.devices()[0].platform == "cpu"
-    use_async = engine.supports_async_snapshot and not on_cpu
 
     def checkpointer(step: int, st) -> None:
         if step % args.mem_ckpt_interval == 0:
             if step % args.ckpt_interval == 0:
                 engine.save_to_storage(step, st)
-            elif use_async:
-                # zero-stall: device-side copy + background arena write
-                # (sharded engine keeps the sync path: async supersede
-                # semantics would break its cross-node step agreement)
-                engine.save_to_memory_async(step, st)
             else:
-                engine.save_to_memory(step, st)
+                # zero-stall where safe; the engine self-gates
+                # (sharded/CPU fall back to the sync path)
+                engine.save_to_memory_async(step, st)
 
     losses: list[float] = []
     goodput = None

@@ -224,3 +224,65 @@ class TestGenerate:
         cached_s = best_of(gen)
         uncached_s = best_of(samp)
         assert cached_s < uncached_s, (cached_s, uncached_s)
+
+
+class TestSampling:
+    """Serving-side sampler surface: top-k, nucleus, eos padding."""
+
+    def test_top_k_one_equals_greedy(self):
+        from dlrover_tpu.models.decode import sample_logits
+
+        logits = jax.random.normal(jax.random.PRNGKey(0), (4, 64))
+        greedy = jnp.argmax(logits, axis=-1)
+        sampled = sample_logits(logits, jax.random.PRNGKey(1),
+                                temperature=1.0, top_k=1)
+        np.testing.assert_array_equal(np.asarray(sampled),
+                                      np.asarray(greedy))
+
+    def test_top_p_masks_tail(self):
+        from dlrover_tpu.models.decode import sample_logits
+
+        # one dominant token (p ~ 0.97): tiny nucleus keeps only it
+        logits = jnp.zeros((2, 8)).at[:, 3].set(5.0)
+        for seed in range(5):
+            out = sample_logits(logits, jax.random.PRNGKey(seed),
+                                temperature=1.0, top_p=0.5)
+            np.testing.assert_array_equal(np.asarray(out), 3)
+
+    def test_temperature_zero_is_argmax(self):
+        from dlrover_tpu.models.decode import sample_logits
+
+        logits = jax.random.normal(jax.random.PRNGKey(2), (3, 16))
+        out = sample_logits(logits, jax.random.PRNGKey(3), temperature=0)
+        np.testing.assert_array_equal(
+            np.asarray(out), np.asarray(jnp.argmax(logits, axis=-1)))
+
+    def test_generate_eos_pads_finished_rows(self):
+        from dlrover_tpu.models.decode import generate
+
+        cfg = tfm.CONFIGS["tiny"]
+        params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+        prompts = jnp.ones((2, 4), jnp.int32)
+        out = generate(params, prompts, cfg, gen_len=12,
+                       key=jax.random.PRNGKey(1), temperature=1.0,
+                       eos_id=7)
+        gen = np.asarray(out[:, 4:])
+        for row in gen:
+            hits = np.where(row == 7)[0]
+            if hits.size:  # everything after the first eos is eos
+                assert np.all(row[hits[0]:] == 7)
+
+    def test_generate_top_kp_runs_under_jit(self):
+        from functools import partial
+
+        from dlrover_tpu.models.decode import generate
+
+        cfg = tfm.CONFIGS["tiny"]
+        params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+        fn = jax.jit(partial(generate, cfg=cfg, gen_len=6,
+                             temperature=0.8, top_k=16, top_p=0.9))
+        out = fn(params, jnp.ones((2, 3), jnp.int32),
+                 key=jax.random.PRNGKey(4))
+        assert out.shape == (2, 9)
+        assert np.all(np.asarray(out) >= 0)
+        assert np.all(np.asarray(out) < cfg.vocab_size)

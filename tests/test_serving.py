@@ -1,0 +1,94 @@
+"""Continuous-batching inference engine (serving/engine.py).
+
+Reference analog: the vLLM backend the reference's RLHF stack serves
+through (atorch rl/inference_backend) — here validated for the property
+that matters: slot-batched decode with per-row positions produces exactly
+the tokens a solo greedy ``generate`` would, while requests of different
+lengths join and leave the batch mid-flight.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.models import transformer as tfm
+from dlrover_tpu.models.decode import generate
+from dlrover_tpu.serving import InferenceEngine, SamplingParams
+
+CFG = tfm.CONFIGS["tiny"]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.mark.timeout(300)
+def test_matches_solo_greedy_generate(params):
+    """Slot-batched greedy == single-request generate, per request."""
+    prompts = [[5, 9, 2], [7, 7, 7, 7, 1], [3]]
+    eng = InferenceEngine(params, CFG, slots=2, max_len=64,
+                          prefill_len=8)
+    ids = {}
+    for p in prompts:
+        ids[eng.submit(p, SamplingParams(temperature=0.0,
+                                         max_new_tokens=6))] = p
+    results = {r.id: r for r in eng.run()}
+    assert len(results) == 3
+    for rid, prompt in ids.items():
+        solo = generate(
+            params, jnp.asarray([prompt], jnp.int32), CFG,
+            gen_len=6, key=jax.random.PRNGKey(1), temperature=0.0,
+        )
+        expect = np.asarray(solo)[0, len(prompt):].tolist()
+        assert results[rid].tokens == expect, (
+            rid, results[rid].tokens, expect
+        )
+        assert results[rid].finish_reason == "length"
+
+
+@pytest.mark.timeout(300)
+def test_slot_reuse_and_mixed_lengths(params):
+    """More requests than slots with different max_new: slots recycle."""
+    eng = InferenceEngine(params, CFG, slots=2, max_len=64,
+                          prefill_len=8)
+    lens = [2, 9, 4, 6, 3]
+    ids = [
+        eng.submit([i + 1], SamplingParams(temperature=0.0,
+                                           max_new_tokens=n))
+        for i, n in enumerate(lens)
+    ]
+    results = {r.id: r for r in eng.run()}
+    assert len(results) == 5
+    for rid, n in zip(ids, lens):
+        assert len(results[rid].tokens) == n
+
+
+@pytest.mark.timeout(300)
+def test_eos_retires_early(params):
+    eng = InferenceEngine(params, CFG, slots=1, max_len=64,
+                          prefill_len=8)
+    # discover which token greedy decoding emits first, use it as eos
+    probe = generate(params, jnp.asarray([[5, 9, 2]], jnp.int32), CFG,
+                     gen_len=1, key=jax.random.PRNGKey(0),
+                     temperature=0.0)
+    eos = int(np.asarray(probe)[0, -1])
+    rid = eng.submit([5, 9, 2], SamplingParams(
+        temperature=0.0, max_new_tokens=20, eos_id=eos))
+    res = {r.id: r for r in eng.run()}[rid]
+    assert res.finish_reason == "eos"
+    assert res.tokens == [eos]
+
+
+@pytest.mark.timeout(300)
+def test_validation_errors(params):
+    eng = InferenceEngine(params, CFG, slots=1, max_len=32,
+                          prefill_len=8)
+    with pytest.raises(ValueError):
+        eng.submit(list(range(9)))  # prompt > prefill_len
+    with pytest.raises(ValueError):
+        eng.submit([1], SamplingParams(max_new_tokens=40))  # > max_len
