@@ -82,7 +82,7 @@ class InferenceEngine:
 
     def __init__(self, params: Any, cfg: TransformerConfig, *,
                  slots: int = 8, max_len: int = 0,
-                 prefill_len: int = 0):
+                 prefill_len: int = 0, decode_block: int = 1):
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -90,6 +90,14 @@ class InferenceEngine:
         self.prefill_len = prefill_len or min(64, self.max_len)
         if self.prefill_len > self.max_len:
             raise ValueError("prefill_len > max_len")
+        # decode_block > 1: run up to that many decode iterations inside
+        # ONE compiled scan before syncing tokens to the host — the
+        # per-token host round trip (sync + dispatch) otherwise bounds
+        # throughput on high-RTT hosts. Shrunk per step to the smallest
+        # remaining budget among active slots (power-of-two ladder, so
+        # compiles stay bounded) and to 1 whenever any active request
+        # uses eos (its stop must be observed token-by-token).
+        self.decode_block = max(1, decode_block)
 
         self._queue: deque[Request] = deque()
         self._ids = itertools.count()
@@ -128,21 +136,33 @@ class InferenceEngine:
 
         self._install = jax.jit(_install)
 
-        def _step(params, k, v, pos, last, key, temperature, top_k,
-                  top_p, active):
+        def _step_block(params, k, v, pos, last, key, temperature,
+                        top_k, top_p, active, n_steps):
             # per-row sampling params as VECTORS: one compiled program
             # regardless of the mix of requests in the batch
-            nxt = sample_logits(last, key, temperature, top_k, top_p)
-            cache = {"k": k, "v": v, "pos": pos}
-            logits, cache = forward_cached(
-                params, nxt[:, None], cache, cfg
-            )
-            # inactive rows must not advance (their pos would creep past
-            # max_len and clamp the next real install's attention math)
-            new_pos = jnp.where(active, cache["pos"], pos)
-            return nxt, cache["k"], cache["v"], new_pos, logits[:, 0]
+            def body(carry, sub):
+                k, v, pos, last = carry
+                nxt = sample_logits(last, sub, temperature, top_k,
+                                    top_p)
+                cache = {"k": k, "v": v, "pos": pos}
+                logits, cache = forward_cached(
+                    params, nxt[:, None], cache, cfg
+                )
+                # inactive rows must not advance (their pos would creep
+                # past max_len and clamp the next install's attention)
+                new_pos = jnp.where(active, cache["pos"], pos)
+                return (cache["k"], cache["v"], new_pos,
+                        logits[:, 0]), nxt
 
-        self._step = jax.jit(_step)
+            keys = jax.random.split(key, n_steps)
+            (k, v, pos, last), toks = lax.scan(
+                body, (k, v, pos, last), keys
+            )
+            return toks, k, v, pos, last
+
+        self._step_block = jax.jit(
+            _step_block, static_argnames=("n_steps",)
+        )
 
     # ----------------------------------------------------------- user API
 
@@ -153,6 +173,11 @@ class InferenceEngine:
             raise ValueError(
                 f"prompt length {len(prompt)} > prefill_len "
                 f"{self.prefill_len}"
+            )
+        if params.max_new_tokens < 1:
+            raise ValueError(
+                "max_new_tokens must be >= 1 (this engine decodes; "
+                "prefill-only scoring is forward_cached directly)"
             )
         if len(prompt) + params.max_new_tokens > self.max_len:
             raise ValueError("prompt + max_new_tokens > max_len")
@@ -195,9 +220,29 @@ class InferenceEngine:
         return (jnp.asarray(temp), jnp.asarray(top_k),
                 jnp.asarray(top_p))
 
+    def _block_size(self) -> int:
+        """Largest safe compiled block: never past any active slot's
+        remaining budget, 1 when any active request needs per-token eos
+        checks; power-of-two ladder keeps distinct compiles bounded."""
+        remaining = []
+        for s, req in enumerate(self._active):
+            if req is None:
+                continue
+            if req.params.eos_id is not None:
+                return 1
+            remaining.append(
+                req.params.max_new_tokens - len(self._emitted[s])
+            )
+        cap = min(self.decode_block, min(remaining))
+        block = 1
+        while block * 2 <= cap:
+            block *= 2
+        return block
+
     def step(self) -> int:
-        """Admit waiting requests, decode one token for every active
-        slot, retire finished ones. Returns number of active slots."""
+        """Admit waiting requests, decode one token (or one compiled
+        block of tokens) for every active slot, retire finished ones.
+        Returns number of active slots."""
         self._admit()
         active_mask = np.array(
             [r is not None for r in self._active], bool
@@ -206,25 +251,29 @@ class InferenceEngine:
             return 0
         temp, top_k, top_p = self._sampling_tensors()
         self._key, sub = jax.random.split(self._key)
-        nxt, k, v, pos, last = self._step(
+        block = self._block_size()
+        toks_dev, k, v, pos, last = self._step_block(
             self.params, self._cache["k"], self._cache["v"],
             self._cache["pos"], self._last, sub, temp, top_k,
-            top_p, jnp.asarray(active_mask),
+            top_p, jnp.asarray(active_mask), n_steps=block,
         )
         self._cache["k"], self._cache["v"] = k, v
         self._cache["pos"] = pos
         self._last = last
-        toks = np.asarray(jax.device_get(nxt))
+        toks = np.asarray(jax.device_get(toks_dev))  # [block, slots]
         for s, req in enumerate(self._active):
             if req is None:
                 continue
-            t = int(toks[s])
-            self._emitted[s].append(t)
             p = req.params
-            if p.eos_id is not None and t == p.eos_id:
-                self._retire(s, "eos")
-            elif len(self._emitted[s]) >= p.max_new_tokens:
-                self._retire(s, "length")
+            for j in range(block):
+                t = int(toks[j, s])
+                self._emitted[s].append(t)
+                if p.eos_id is not None and t == p.eos_id:
+                    self._retire(s, "eos")
+                    break
+                if len(self._emitted[s]) >= p.max_new_tokens:
+                    self._retire(s, "length")
+                    break
         return sum(r is not None for r in self._active)
 
     def _retire(self, slot: int, reason: str) -> None:
@@ -245,5 +294,12 @@ class InferenceEngine:
             ):
                 break
             self.step()
+        else:
+            raise RuntimeError(
+                f"run() exhausted {max_iters} iterations with "
+                f"{len(self._queue)} queued and "
+                f"{sum(r is not None for r in self._active)} active "
+                "requests still unfinished"
+            )
         out, self._results = self._results, []
         return out

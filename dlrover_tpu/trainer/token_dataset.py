@@ -1,0 +1,146 @@
+"""Packed-token datasets: real-data training input without a loader fleet.
+
+Reference analog: the reference's data layer spans the estimator file
+readers (dlrover/trainer/tensorflow/reader/file_reader.py), the master's
+TextDatasetSplitter (line-offset shards), and atorch's elastic_dataset —
+all built around "the master hands out index ranges; workers map indices
+to samples". This module supplies the sample side for LLM pretraining
+data the TPU-idiomatic way:
+
+- ``PackedTokenDataset``: a flat binary token file, memory-mapped; sample
+  i is the contiguous window ``[i*seq, i*seq + seq + 1)`` (the +1 feeds
+  the next-token target). Zero-copy reads, O(1) per sample, and the
+  index space composes directly with the master's dynamic sharding
+  (ElasticDataset hands out exactly these indices).
+- ``TextLineDataset``: newline-delimited text with a byte-offset index
+  built on first open (TextDatasetSplitter's layout, worker-side) and a
+  caller-supplied tokenizer for on-the-fly encoding.
+- ``pack_tokens``: offline packer turning a token-id iterator into the
+  flat binary file (what a preprocessing job would emit).
+
+Static shapes by construction: every sample is exactly ``seq + 1``
+tokens, so the compiled train step never re-specializes on data length —
+ragged text is absorbed at pack time, not in the jit.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Iterable, Iterator
+
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+TOKEN_DTYPE = np.uint32  # vocab < 4B; fixed so files are portable
+
+
+def pack_tokens(token_iter: Iterable[int] | Iterator[np.ndarray],
+                path: str, *, chunk: int = 1 << 20) -> int:
+    """Write a stream of token ids (ints or id arrays) to a flat binary
+    file. Returns the total token count."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    total = 0
+    buf: list[int] = []
+    with open(path, "wb") as f:
+        def flush(items):
+            nonlocal total
+            arr = np.asarray(items, TOKEN_DTYPE)
+            arr.tofile(f)
+            total += arr.size
+
+        for item in token_iter:
+            if isinstance(item, (list, np.ndarray)):
+                if buf:
+                    flush(buf)
+                    buf = []
+                flush(np.asarray(item).reshape(-1))
+            else:
+                buf.append(int(item))
+                if len(buf) >= chunk:
+                    flush(buf)
+                    buf = []
+        if buf:
+            flush(buf)
+    return total
+
+
+class PackedTokenDataset:
+    """Flat binary token file -> fixed-length training windows.
+
+    ``ds[i]`` is ``{"tokens": uint32[seq + 1]}`` — the shape the
+    transformer example's CLM loss consumes. ``stride`` defaults to
+    ``seq`` (disjoint windows); smaller strides oversample boundaries.
+    """
+
+    def __init__(self, path: str, seq: int, stride: int = 0):
+        self.path = path
+        self.seq = seq
+        self.stride = stride or seq
+        size = os.path.getsize(path)
+        if size % np.dtype(TOKEN_DTYPE).itemsize:
+            raise ValueError(
+                f"{path} is not a whole number of {TOKEN_DTYPE} tokens"
+            )
+        self._tokens = np.memmap(path, dtype=TOKEN_DTYPE, mode="r")
+        n = self._tokens.size
+        if n < seq + 1:
+            raise ValueError(
+                f"{path} holds {n} tokens < one window of {seq + 1}"
+            )
+        self._len = (n - (seq + 1)) // self.stride + 1
+
+    def __len__(self) -> int:
+        return self._len
+
+    def __getitem__(self, i: int) -> dict:
+        if not 0 <= i < self._len:
+            raise IndexError(i)
+        lo = i * self.stride
+        # np.array copies out of the mmap: samples must not pin pages
+        # once collated into a batch
+        return {"tokens": np.array(
+            self._tokens[lo: lo + self.seq + 1], np.int32
+        )}
+
+
+class TextLineDataset:
+    """Newline-delimited text + tokenizer -> fixed-length windows.
+
+    The byte-offset line index is built once per open (the worker-side
+    twin of the master's TextDatasetSplitter, dataset_splitter.py);
+    lines tokenize lazily and are truncated/padded to ``seq + 1``.
+    """
+
+    def __init__(self, path: str, seq: int,
+                 tokenize: Callable[[str], list[int]],
+                 pad_id: int = 0):
+        self.path = path
+        self.seq = seq
+        self.tokenize = tokenize
+        self.pad_id = pad_id
+        offsets = [0]
+        with open(path, "rb") as f:
+            for line in f:
+                offsets.append(offsets[-1] + len(line))
+        # drop the EOF sentinel; empty trailing line never indexes
+        self._offsets = np.asarray(offsets[:-1], np.int64)
+        self._f = open(path, "rb")
+
+    def __len__(self) -> int:
+        return len(self._offsets)
+
+    def __getitem__(self, i: int) -> dict:
+        if not 0 <= i < len(self._offsets):
+            raise IndexError(i)
+        self._f.seek(self._offsets[i])
+        text = self._f.readline().decode("utf-8").rstrip("\n")
+        ids = self.tokenize(text)[: self.seq + 1]
+        out = np.full((self.seq + 1,), self.pad_id, np.int32)
+        out[: len(ids)] = ids
+        return {"tokens": out}
+
+    def close(self) -> None:
+        self._f.close()

@@ -57,6 +57,9 @@ def parse_args(argv=None):
     p.add_argument("--mem-ckpt-interval", type=int, default=1,
                    help="shm snapshot every N steps")
     p.add_argument("--dataset-size", type=int, default=100000)
+    p.add_argument("--data-file", default="",
+                   help="flat binary token file (trainer/token_dataset "
+                        "pack_tokens format); empty = synthetic data")
     p.add_argument("--epochs", type=int, default=10)
     p.add_argument("--shard-size", type=int, default=256)
     p.add_argument("--sharded-ckpt", action="store_true",
@@ -216,9 +219,21 @@ def main(argv=None) -> int:
     vocab = cfg.vocab_size
     rng_seed = 1234
 
-    def tokens_for(idx: int) -> np.ndarray:
-        g = np.random.Generator(np.random.Philox(key=rng_seed + idx))
-        return g.integers(0, vocab, seq + 1, dtype=np.int32)
+    packed = None
+    if args.data_file:
+        # real data: flat binary token file, windowed (the master's
+        # shard indices address windows)
+        from dlrover_tpu.trainer.token_dataset import PackedTokenDataset
+
+        packed = PackedTokenDataset(args.data_file, seq=seq)
+        args.dataset_size = len(packed)
+
+        def tokens_for(idx: int) -> np.ndarray:
+            return packed[idx]["tokens"]
+    else:
+        def tokens_for(idx: int) -> np.ndarray:
+            g = np.random.Generator(np.random.Philox(key=rng_seed + idx))
+            return g.integers(0, vocab, seq + 1, dtype=np.int32)
 
     from dlrover_tpu.trainer.data import ElasticDataset, PrefetchLoader
 

@@ -92,3 +92,22 @@ def test_validation_errors(params):
         eng.submit(list(range(9)))  # prompt > prefill_len
     with pytest.raises(ValueError):
         eng.submit([1], SamplingParams(max_new_tokens=40))  # > max_len
+
+
+@pytest.mark.timeout(300)
+def test_block_decode_matches_per_token(params):
+    """decode_block > 1 produces the same greedy tokens as block=1."""
+    out = {}
+    for block in (1, 8):
+        eng = InferenceEngine(params, CFG, slots=2, max_len=64,
+                              prefill_len=8, decode_block=block)
+        rids = [
+            eng.submit([4, 2], SamplingParams(temperature=0.0,
+                                              max_new_tokens=12)),
+            eng.submit([9], SamplingParams(temperature=0.0,
+                                           max_new_tokens=7)),
+        ]
+        res = {r.id: r for r in eng.run()}
+        out[block] = [res[r].tokens for r in rids]
+    assert out[1] == out[8]
+    assert len(out[1][0]) == 12 and len(out[1][1]) == 7

@@ -1,0 +1,98 @@
+"""Packed-token / text-line datasets (trainer/token_dataset.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.trainer.token_dataset import (
+    PackedTokenDataset,
+    TextLineDataset,
+    pack_tokens,
+)
+
+
+class TestPackedTokens:
+    def test_pack_and_window_layout(self, tmp_path):
+        path = str(tmp_path / "toks.bin")
+        n = pack_tokens(iter(range(100)), path)
+        assert n == 100
+        ds = PackedTokenDataset(path, seq=9)
+        # windows stride by seq: (100 - 10) // 9 + 1 = 11
+        assert len(ds) == 11
+        s0 = ds[0]["tokens"]
+        np.testing.assert_array_equal(s0, np.arange(10))
+        s1 = ds[1]["tokens"]
+        np.testing.assert_array_equal(s1, np.arange(9, 19))
+        assert s0.dtype == np.int32
+
+    def test_pack_accepts_arrays_and_chunks(self, tmp_path):
+        path = str(tmp_path / "toks.bin")
+        n = pack_tokens(
+            [np.arange(5), np.arange(5, 12)], path
+        )
+        assert n == 12
+        ds = PackedTokenDataset(path, seq=4, stride=2)
+        np.testing.assert_array_equal(
+            ds[1]["tokens"], np.arange(2, 7)
+        )
+
+    def test_too_small_file_raises(self, tmp_path):
+        path = str(tmp_path / "toks.bin")
+        pack_tokens(iter(range(5)), path)
+        with pytest.raises(ValueError):
+            PackedTokenDataset(path, seq=9)
+        with pytest.raises(IndexError):
+            PackedTokenDataset(path, seq=3)[99]
+
+    def test_trains_with_elastic_assembler(self, tmp_path):
+        """The window index space composes with batch assembly."""
+        from dlrover_tpu.trainer.elastic_trainer import BatchAssembler
+
+        path = str(tmp_path / "toks.bin")
+        pack_tokens(iter(range(1000)), path)
+        ds = PackedTokenDataset(path, seq=15)
+
+        def collate(samples):
+            return {"tokens": np.stack([s["tokens"] for s in samples])}
+
+        asm = BatchAssembler(accum=2, batch_size=4)
+        batches = list(asm.batches(
+            (ds[i] for i in range(len(ds))), collate
+        ))
+        assert batches and batches[0]["tokens"].shape == (2, 4, 16)
+
+
+class TestTextLines:
+    def test_line_index_and_tokenize(self, tmp_path):
+        p = tmp_path / "text.txt"
+        p.write_text("hello world\nsecond line here\nx\n")
+        ds = TextLineDataset(
+            str(p), seq=5,
+            tokenize=lambda s: [len(w) for w in s.split()],
+            pad_id=-1,
+        )
+        try:
+            assert len(ds) == 3
+            np.testing.assert_array_equal(
+                ds[0]["tokens"], [5, 5, -1, -1, -1, -1])
+            np.testing.assert_array_equal(
+                ds[1]["tokens"], [6, 4, 4, -1, -1, -1])
+            # random access after sequential reads still lands right
+            np.testing.assert_array_equal(
+                ds[2]["tokens"], [1, -1, -1, -1, -1, -1])
+            np.testing.assert_array_equal(
+                ds[0]["tokens"], [5, 5, -1, -1, -1, -1])
+        finally:
+            ds.close()
+
+    def test_truncates_long_lines(self, tmp_path):
+        p = tmp_path / "t.txt"
+        p.write_text("a a a a a a a a a a\n")
+        ds = TextLineDataset(str(p), seq=3,
+                             tokenize=lambda s: [7] * len(s.split()))
+        try:
+            assert ds[0]["tokens"].shape == (4,)
+            np.testing.assert_array_equal(ds[0]["tokens"], [7, 7, 7, 7])
+        finally:
+            ds.close()
