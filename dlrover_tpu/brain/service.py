@@ -94,6 +94,20 @@ class BrainDataStore:
             ).fetchall()
         return rows
 
+    def job_usage(self, job_name: str, signature: str
+                  ) -> tuple[int, int, int]:
+        """(peak_memory_mb, peak_hbm_mb, n_samples) for this job's OWN
+        reports (init_adjust reads the job's early samples, not the
+        cross-job history)."""
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT MAX(used_memory_mb), MAX(used_hbm_mb), COUNT(*)"
+                " FROM job_metrics"
+                " WHERE job_name = ? AND signature = ?",
+                (job_name, signature),
+            ).fetchone()
+        return int(row[0] or 0), int(row[1] or 0), int(row[2] or 0)
+
     def peak_memory_mb(self, signature: str) -> int:
         """Max memory EVER observed for a signature — across every report,
         not just each job's final one (a job's last record often carries
@@ -187,7 +201,17 @@ class BrainService:
           all-time peak usage sits under 60% of what the job holds,
           right-size to 1.3x peak; same for HBM on TPU hosts (reference
           OptimizeJobPSResourceUtil)
+        - init_adjust: early self-correction from the job's OWN first
+          samples — needs requested_memory_mb/requested_hbm_mb
+          (reference OptimizeJobPSInitAdjustResource)
+        - hot: per-node memory grants for nodes whose usage exceeds
+          1.5x the job median — needs node_memory_mb, >= 3 nodes
+          (reference OptimizeJobHotPSResource)
         """
+        if req.stage == "init_adjust":
+            return self._optimize_init_adjust(req)
+        if req.stage == "hot":
+            return self._optimize_hot(req)
         if req.stage == "cold_create":
             workers, mem, jobs = self.store.cluster_defaults()
             if not jobs:
@@ -241,6 +265,63 @@ class BrainService:
             based_on_jobs=len(ok_rows),
         )
 
+    def _optimize_init_adjust(self, req: m.BrainOptimizeRequest
+                              ) -> m.BrainOptimizePlan:
+        """Early correction of the create-stage guess from the job's OWN
+        first samples (reference OptimizeJobPSInitAdjustResource).
+
+        The create/cold plans are cross-job priors; minutes in, this
+        job's real usage is a better signal than any history. Adjust
+        (host memory and HBM independently) only when 1.5x the job's
+        own peak differs from the current allocation by >20% — in
+        EITHER direction (the create guess may be oversized too; OOM
+        escalation stays the oom stage's job).
+        """
+        peak_mem, peak_hbm, n = self.store.job_usage(
+            req.job_name, req.signature
+        )
+        plan = m.BrainOptimizePlan(found=False)
+
+        def adjust(peak: int, requested: int) -> int:
+            if not (peak and requested):
+                return 0
+            target = int(1.5 * peak)
+            if abs(target - requested) <= 0.2 * requested:
+                return 0
+            return target
+
+        plan.memory_mb = adjust(peak_mem, req.requested_memory_mb)
+        plan.hbm_mb = adjust(peak_hbm, req.requested_hbm_mb)
+        if plan.memory_mb or plan.hbm_mb:
+            plan.found = True
+            plan.based_on_jobs = n
+        return plan
+
+    def _optimize_hot(self, req: m.BrainOptimizeRequest
+                      ) -> m.BrainOptimizePlan:
+        """Per-node grants for hot nodes (OptimizeJobHotPSResource).
+
+        A node whose memory usage exceeds 1.5x the job's median carries
+        a skewed share (hot input shards, a fat embedding partition);
+        grant it 1.5x its own usage instead of restarting the whole job
+        bigger. Needs >= 3 nodes — a median of fewer is noise.
+        """
+        usage = {str(k): int(v) for k, v in req.node_memory_mb.items()
+                 if int(v) > 0}
+        if len(usage) < 3:
+            return m.BrainOptimizePlan(found=False)
+        med = statistics.median(usage.values())
+        grants = {
+            node: int(1.5 * used)
+            for node, used in usage.items() if used > 1.5 * med
+        }
+        if not grants:
+            return m.BrainOptimizePlan(found=False)
+        return m.BrainOptimizePlan(
+            found=True, node_memory_mb=grants,
+            based_on_jobs=len(usage),
+        )
+
     def _optimize_util(self, req: m.BrainOptimizeRequest
                        ) -> m.BrainOptimizePlan:
         """Right-size an over-provisioned running job. Only shrinks —
@@ -280,10 +361,20 @@ class BrainClient:
         self._client.call(metrics)
 
     def optimize(self, job_name: str, signature: str,
-                 stage: str = "create") -> m.BrainOptimizePlan:
+                 stage: str = "create", *,
+                 requested_memory_mb: int = 0,
+                 requested_hbm_mb: int = 0,
+                 node_memory_mb: dict | None = None
+                 ) -> m.BrainOptimizePlan:
+        """The stage inputs ride along: util/init_adjust need the
+        current allocation, hot needs per-node usage — without them
+        those stages always answer found=False."""
         return self._client.call(
             m.BrainOptimizeRequest(
-                job_name=job_name, signature=signature, stage=stage
+                job_name=job_name, signature=signature, stage=stage,
+                requested_memory_mb=requested_memory_mb,
+                requested_hbm_mb=requested_hbm_mb,
+                node_memory_mb=dict(node_memory_mb or {}),
             )
         )
 

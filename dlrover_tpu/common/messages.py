@@ -379,11 +379,16 @@ class BrainJobMetrics:
 class BrainOptimizeRequest:
     job_name: str = ""
     signature: str = ""
-    stage: str = "create"   # create | cold_create | oom | running | util
-    # util stage: what the job currently has, so the Brain can spot
-    # over-provisioning (reference OptimizeJobPSResourceUtil)
+    # create | cold_create | init_adjust | oom | running | util | hot
+    stage: str = "create"
+    # util/init_adjust stages: what the job currently has, so the Brain
+    # can spot over/under-provisioning (OptimizeJobPSResourceUtil /
+    # OptimizeJobPSInitAdjustResource)
     requested_memory_mb: int = 0
     requested_hbm_mb: int = 0
+    # hot stage: current per-node usage, so the Brain can single out the
+    # hot node(s) (OptimizeJobHotPSResource)
+    node_memory_mb: dict = dataclasses.field(default_factory=dict)
 
 
 @register_message
@@ -394,6 +399,8 @@ class BrainOptimizePlan:
     memory_mb: int = 0
     hbm_mb: int = 0         # TPU-host analog of the memory right-sizing
     based_on_jobs: int = 0
+    # hot stage: per-node memory grants (node id -> new memory_mb)
+    node_memory_mb: dict = dataclasses.field(default_factory=dict)
 
 
 @register_message

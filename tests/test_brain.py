@@ -13,6 +13,7 @@ from dlrover_tpu.brain.service import (
     BrainDataStore,
     BrainService,
 )
+from dlrover_tpu.common import messages as m
 from dlrover_tpu.common.messages import BrainJobMetrics
 from dlrover_tpu.master.resource_optimizer import (
     LocalResourceOptimizer,
@@ -279,3 +280,115 @@ class TestResourceUtil:
             requested_memory_mb=16000, requested_hbm_mb=16000,
         ))
         assert not plan.found
+
+
+class TestInitAdjustStage:
+    """OptimizeJobPSInitAdjustResource analog: early self-correction."""
+
+    def _seed(self, svc, mems):
+        for i, mem in enumerate(mems):
+            svc.store.record(m.BrainJobMetrics(
+                job_name="j1", signature="sigA", workers=2,
+                used_memory_mb=mem, steps_per_s=1.0, status="running",
+                timestamp=100.0 + i,
+            ))
+
+    def test_undersized_guess_grows(self):
+        svc = BrainService()
+        self._seed(svc, [4000, 7000])
+        plan = svc.optimize(m.BrainOptimizeRequest(
+            job_name="j1", signature="sigA", stage="init_adjust",
+            requested_memory_mb=8000))
+        assert plan.found and plan.memory_mb == 10500  # 1.5 * own peak
+
+    def test_oversized_guess_shrinks(self):
+        svc = BrainService()
+        self._seed(svc, [1000, 1100])
+        plan = svc.optimize(m.BrainOptimizeRequest(
+            job_name="j1", signature="sigA", stage="init_adjust",
+            requested_memory_mb=16000))
+        assert plan.found and plan.memory_mb == 1650
+
+    def test_close_enough_stays(self):
+        svc = BrainService()
+        self._seed(svc, [6000])
+        plan = svc.optimize(m.BrainOptimizeRequest(
+            job_name="j1", signature="sigA", stage="init_adjust",
+            requested_memory_mb=9500))  # target 9000, within 20%
+        assert not plan.found
+
+    def test_other_jobs_history_not_used(self):
+        svc = BrainService()
+        svc.store.record(m.BrainJobMetrics(
+            job_name="OTHER", signature="sigA", workers=2,
+            used_memory_mb=50000, status="running", timestamp=1.0))
+        plan = svc.optimize(m.BrainOptimizeRequest(
+            job_name="j1", signature="sigA", stage="init_adjust",
+            requested_memory_mb=8000))
+        assert not plan.found  # j1 itself has no samples yet
+
+
+class TestHotNodeStage:
+    """OptimizeJobHotPSResource analog: per-node grants."""
+
+    def test_hot_node_gets_grant(self):
+        svc = BrainService()
+        plan = svc.optimize(m.BrainOptimizeRequest(
+            job_name="j1", signature="sigA", stage="hot",
+            node_memory_mb={"0": 4000, "1": 4100, "2": 4050,
+                            "3": 9000}))
+        assert plan.found
+        assert plan.node_memory_mb == {"3": 13500}
+
+    def test_balanced_job_no_plan(self):
+        svc = BrainService()
+        plan = svc.optimize(m.BrainOptimizeRequest(
+            job_name="j1", signature="sigA", stage="hot",
+            node_memory_mb={"0": 4000, "1": 4200, "2": 4100}))
+        assert not plan.found
+
+    def test_too_few_nodes_no_plan(self):
+        svc = BrainService()
+        plan = svc.optimize(m.BrainOptimizeRequest(
+            job_name="j1", signature="sigA", stage="hot",
+            node_memory_mb={"0": 1000, "1": 9000}))
+        assert not plan.found
+
+
+class TestNewStagesOverRpc:
+    """init_adjust/hot must be reachable through the CLIENT API (the
+    path the master actually uses), not just direct service calls."""
+
+    def test_round_trip(self):
+        from dlrover_tpu.brain.service import BrainClient
+
+        svc = BrainService()
+        svc.start()
+        try:
+            client = BrainClient(svc.addr)
+            client.report(m.BrainJobMetrics(
+                job_name="j9", signature="sigR", workers=2,
+                used_memory_mb=7000, status="running", timestamp=1.0))
+            adj = client.optimize("j9", "sigR", "init_adjust",
+                                  requested_memory_mb=4000)
+            assert adj.found and adj.memory_mb == 10500
+            hot = client.optimize("j9", "sigR", "hot", node_memory_mb={
+                "0": 4000, "1": 4100, "2": 4050, "3": 9000})
+            assert hot.found and hot.node_memory_mb == {"3": 13500}
+            client.close()
+        finally:
+            svc.stop()
+
+
+class TestInitAdjustHbm:
+    def test_hbm_adjusts_independently(self):
+        svc = BrainService()
+        svc.store.record(m.BrainJobMetrics(
+            job_name="j1", signature="sigH", workers=2,
+            used_memory_mb=0, used_hbm_mb=9000, status="running",
+            timestamp=1.0))
+        plan = svc.optimize(m.BrainOptimizeRequest(
+            job_name="j1", signature="sigH", stage="init_adjust",
+            requested_hbm_mb=8000))
+        assert plan.found and plan.hbm_mb == 13500
+        assert plan.memory_mb == 0
