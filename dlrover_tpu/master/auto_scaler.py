@@ -45,6 +45,10 @@ class JobAutoScaler:
             try:
                 current = len(self._node_manager.running_nodes())
                 self.execute(self._optimizer.speed_plan(current))
+                # Brain-driven per-node memory tuning (init_adjust/hot
+                # stages); applies at the next relaunch, so executing it
+                # every tick is non-disruptive
+                self.execute(self._optimizer.tuning_plan())
             except Exception:  # noqa: BLE001 - planning must not die
                 logger.exception("auto-scale tick failed")
 

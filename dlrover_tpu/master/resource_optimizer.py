@@ -39,18 +39,20 @@ class LocalResourceOptimizer:
     """
 
     def __init__(self, config: OptimizerConfig, stats_reporter,
-                 speed_monitor, brain=None, signature: str = ""):
+                 speed_monitor, brain=None, signature: str = "",
+                 job_name: str = ""):
         self._config = config
         self._stats = stats_reporter
         self._speed = speed_monitor
         self._memory_mb: dict[int, int] = {}
         self._brain = brain
         self._signature = signature
+        self._job_name = job_name
         self._brain_cache: dict[str, tuple[float, object]] = {}
 
     _BRAIN_CACHE_TTL_S = 30.0
 
-    def _brain_plan(self, stage: str):
+    def _brain_plan(self, stage: str, **inputs):
         if self._brain is None or not self._signature:
             return None
         # TTL cache: the auto-scaler may ask every tick; history moves
@@ -61,13 +63,44 @@ class LocalResourceOptimizer:
         if cached is not None and now - cached[0] < self._BRAIN_CACHE_TTL_S:
             return cached[1]
         try:
-            plan = self._brain.optimize("", self._signature, stage=stage)
+            plan = self._brain.optimize(
+                self._job_name, self._signature, stage=stage, **inputs
+            )
             result = plan if plan.found else None
         except (ConnectionError, RuntimeError, OSError) as e:
             logger.warning("brain optimize failed: %s", e)
             result = None
         self._brain_cache[stage] = (now, result)
         return result
+
+    def tuning_plan(self) -> ScalePlan:
+        """Brain-driven per-node resource tuning (the init_adjust and
+        hot stages): memory adjustments that apply at each node's next
+        (re)launch — no forced restarts. Empty plan when the Brain has
+        nothing (or isn't configured)."""
+        plan = ScalePlan(reason="brain-tuning")
+        latest = self._stats.latest()
+        requested = self._config.host_memory_mb
+        if requested:
+            adj = self._brain_plan(
+                "init_adjust", requested_memory_mb=requested
+            )
+            if adj is not None and adj.memory_mb:
+                for nid in latest:
+                    plan.memory_mb[str(nid)] = adj.memory_mb
+        usage = {
+            str(nid): s.used_memory_mb
+            for nid, s in latest.items() if s.used_memory_mb
+        }
+        if len(usage) >= 3:
+            hot = self._brain_plan("hot", node_memory_mb=usage)
+            if hot is not None and hot.node_memory_mb:
+                # hot grants win over the uniform init adjustment
+                plan.memory_mb.update({
+                    str(k): int(v)
+                    for k, v in hot.node_memory_mb.items()
+                })
+        return plan
 
     def initial_plan(self) -> ScalePlan:
         brain = self._brain_plan("create")

@@ -392,3 +392,62 @@ class TestInitAdjustHbm:
             requested_hbm_mb=8000))
         assert plan.found and plan.hbm_mb == 13500
         assert plan.memory_mb == 0
+
+
+class TestTuningPlanIntegration:
+    """The master path for the new stages: optimizer.tuning_plan()
+    consults the Brain with real inputs and emits per-node memory."""
+
+    class _Stats:
+        def __init__(self, usage):
+            self._usage = usage
+
+        def latest(self):
+            import types
+
+            return {
+                nid: types.SimpleNamespace(used_memory_mb=mem)
+                for nid, mem in self._usage.items()
+            }
+
+    def test_init_adjust_and_hot_reach_brain(self):
+        from dlrover_tpu.brain.service import BrainClient
+        from dlrover_tpu.master.resource_optimizer import (
+            LocalResourceOptimizer,
+            OptimizerConfig,
+        )
+
+        svc = BrainService()
+        svc.start()
+        try:
+            client = BrainClient(svc.addr)
+            client.report(m.BrainJobMetrics(
+                job_name="jT", signature="sigT", workers=4,
+                used_memory_mb=7000, status="running", timestamp=1.0))
+            opt = LocalResourceOptimizer(
+                OptimizerConfig(min_workers=1, max_workers=4,
+                                host_memory_mb=4000),
+                self._Stats({0: 4000, 1: 4100, 2: 4050, 3: 9000}),
+                speed_monitor=None, brain=client,
+                signature="sigT", job_name="jT",
+            )
+            plan = opt.tuning_plan()
+            # init_adjust: 1.5 * 7000 = 10500 for every node...
+            assert plan.memory_mb["0"] == 10500
+            # ...except the hot node, whose grant wins
+            assert plan.memory_mb["3"] == 13500
+            client.close()
+        finally:
+            svc.stop()
+
+    def test_no_brain_empty_plan(self):
+        from dlrover_tpu.master.resource_optimizer import (
+            LocalResourceOptimizer,
+            OptimizerConfig,
+        )
+
+        opt = LocalResourceOptimizer(
+            OptimizerConfig(host_memory_mb=4000),
+            self._Stats({0: 1000}), speed_monitor=None,
+        )
+        assert opt.tuning_plan().is_empty()
