@@ -288,6 +288,12 @@ def clip_loss_fn(
     The [B, B] logits are computed directly under pjit; batch-sharded
     features make XLA all-gather one side over the data axes — matching
     open_clip's gathered-features loss without any explicit collective.
+
+    Gradient accumulation caveat: InfoNCE is not linear in micro
+    batches — summing per-micro losses shrinks the negatives pool to
+    each micro batch. Train contrastively with accum = 1
+    (micro_batch_size = global/dp); the in-batch negatives then span
+    the full device batch.
     """
     img, txt, scale = clip_forward(params, batch, cfg, constrain=constrain)
     logits = scale * (img @ txt.T)

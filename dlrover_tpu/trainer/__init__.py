@@ -10,6 +10,7 @@ from dlrover_tpu.trainer.sharding_client import (  # noqa: F401
 )
 from dlrover_tpu.trainer.trainer import (  # noqa: F401
     EarlyStoppingCallback,
+    GoodputCallback,
     Trainer,
     TrainerCallback,
     TrainerControl,

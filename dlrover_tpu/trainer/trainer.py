@@ -148,6 +148,9 @@ class TrainerCallback:
     def on_save(self, args, state, control, **kw): ...
     def on_epoch_end(self, args, state, control, **kw): ...
     def on_train_end(self, args, state, control, **kw): ...
+    # fired when train() is about to re-raise an exception; release
+    # resources here (on_train_end does NOT fire on the failure path)
+    def on_train_error(self, args, state, control, **kw): ...
 
 
 class LoggingCallback(TrainerCallback):
@@ -168,6 +171,39 @@ class LoggingCallback(TrainerCallback):
             with open(self._path, "a") as f:
                 f.write(json.dumps(
                     {"step": state.global_step, **logs}) + "\n")
+
+
+class GoodputCallback(TrainerCallback):
+    """Write the per-step goodput event log (utils/goodput.py) from the
+    Trainer loop; aggregate offline with ``compute_goodput``."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._recorder = None
+
+    def on_train_begin(self, args, state, control, **kw):
+        from dlrover_tpu.common.constants import EnvKey
+        from dlrover_tpu.utils.goodput import GoodputRecorder
+
+        restart = int(os.environ.get(EnvKey.RESTART_COUNT, "0"))
+        self._recorder = GoodputRecorder(self._path, restart)
+
+    def on_step_end(self, args, state, control, **kw):
+        if self._recorder is not None:
+            self._recorder.step(state.global_step)
+
+    def on_train_end(self, args, state, control, **kw):
+        if self._recorder is not None:
+            self._recorder.done()
+            self._recorder.close()
+            self._recorder = None
+
+    def on_train_error(self, args, state, control, **kw):
+        # no "done" event: a crashed incarnation looks the same as a
+        # SIGKILLed one to the aggregator — only release the handle
+        if self._recorder is not None:
+            self._recorder.close()
+            self._recorder = None
 
 
 class EarlyStoppingCallback(TrainerCallback):
@@ -415,6 +451,17 @@ class Trainer:
     # ---------------------------------------------------------------- training
 
     def train(self) -> TrainerState:
+        try:
+            return self._train()
+        except BaseException:
+            # resource-releasing hook for callbacks holding files/threads
+            # (on_train_end only fires on the success path)
+            self.callback_handler.fire(
+                "on_train_error", self.args, self.state, self.control
+            )
+            raise
+
+    def _train(self) -> TrainerState:
         args = self.args
         state = self._init_or_resume()
         steps_per_epoch = self._steps_per_epoch()

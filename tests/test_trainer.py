@@ -197,3 +197,21 @@ def test_training_arguments_validation_and_roundtrip(tmp_path):
     assert args.metric_for_best_model == "eval_loss"
     clone = TrainingArguments.from_json(args.to_json())
     assert clone == args
+
+
+@pytest.mark.timeout(120)
+def test_goodput_callback_writes_log(tmp_ipc_dir, tmp_path):
+    from dlrover_tpu.trainer.trainer import GoodputCallback
+    from dlrover_tpu.utils.goodput import compute_goodput
+
+    log = str(tmp_path / "gp.jsonl")
+    t = _trainer(tmp_path, max_steps=12,
+                 callbacks=[GoodputCallback(log)])
+    try:
+        t.train()
+    finally:
+        t.close()
+    report = compute_goodput(log)
+    assert report.n_steps == 12
+    assert report.n_incarnations == 1
+    assert report.goodput > 0.5
