@@ -54,7 +54,7 @@ class TransformerConfig:
     # weight prefetch/scheduling across adjacent layers at the cost of
     # program size (still one remat boundary per layer)
     scan_unroll: int = 1
-    # "dense" | "flash" | "flash_own" | "splash" | "ring"
+    # "dense" | "flash" | "flash_own" | "splash" | "ring" | "ulysses"
     attention: str = "dense"
     # splash only: sliding-window size (0 = full causal); the sparse
     # kernel skips fully-masked blocks, so long seqs pay O(S * window)
@@ -519,10 +519,11 @@ def resolve_config(cfg: TransformerConfig, strategy) -> TransformerConfig:
 def make_loss_fn(cfg: TransformerConfig, strategy, mesh) -> Callable:
     """Bind loss_fn to a strategy: activation constraints + attention impl.
 
-    Consumes ``strategy.extra["attention"] == "ring"`` (the long_context
-    preset) or ``cfg.attention == "ring"``: attention runs as ring
-    attention over the mesh's "sequence" axis (ops/ring_attention.py),
-    degrading to dense when the mesh has no sequence axis.
+    Consumes ``strategy.extra["attention"]`` (or ``cfg.attention``):
+    "ring" (long_context preset) and "ulysses" run sequence-parallel
+    attention over the mesh's "sequence" axis (ops/ring_attention.py /
+    ops/ulysses.py), degrading to dense when the mesh has no sequence
+    axis; "flash"/"flash_own"/"splash" pick per-device kernels.
     """
     from dlrover_tpu.parallel.partition import constrain as _constrain
 
@@ -535,6 +536,10 @@ def make_loss_fn(cfg: TransformerConfig, strategy, mesh) -> Callable:
         from dlrover_tpu.ops.ring_attention import make_ring_attention
 
         attn = make_ring_attention(mesh)
+    elif cfg.attention == "ulysses":
+        from dlrover_tpu.ops.ulysses import make_ulysses_attention
+
+        attn = make_ulysses_attention(mesh)
     elif cfg.attention == "flash":
         from dlrover_tpu.ops.flash_attention import flash_attention
 

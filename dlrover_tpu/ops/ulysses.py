@@ -1,0 +1,121 @@
+"""Ulysses sequence parallelism: all-to-all head redistribution.
+
+The second of the two modern context-parallel schemes (SURVEY.md §2.5
+names both as capability gaps to fill natively — the reference's own SP is
+an all-reduce softmax). DeepSpeed-Ulysses (Jacobs et al., 2023):
+
+- activations arrive sequence-sharded, [B, S/n, H, D] per device;
+- one ``all_to_all`` trades the sequence split for a head split: every
+  device ends with the FULL sequence for H/n heads;
+- attention runs locally, completely standard (any per-device kernel —
+  dense, flash — since each head's attention is independent);
+- a second ``all_to_all`` restores sequence sharding.
+
+vs ring attention (ops/ring_attention.py): Ulysses moves 2x the activation
+bytes in two bursts but runs UNMODIFIED local attention (no online-softmax
+ring pipeline), and its comm volume is independent of the sequence length
+per hop count — the better fit when heads are plentiful and the per-device
+kernel is highly tuned. Ring wins when n > H or memory for the full-S
+scores per head is the binding constraint. Both ride the same "sequence"
+mesh axis, so strategies can pick per model shape.
+
+Constraint: the sequence-axis size must divide n_heads (and kv_heads for
+GQA) — heads are the resource being redistributed.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec
+
+
+def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = True,
+                      attn_impl: Callable | None = None):
+    """Per-shard body (call under shard_map): [B, S/n, H, D] in/out.
+
+    GQA-native: k/v arrive with their OWN (smaller) head count and are
+    all-to-all'd unexpanded — repeating to n_heads happens locally after
+    the gather, so the comm bursts move only kv-head bytes (the point of
+    GQA). This is why the model layer must NOT pre-repeat
+    (``supports_gqa`` on the wrapper).
+    """
+    if attn_impl is None:
+        from dlrover_tpu.models.transformer import dense_attention
+
+        attn_impl = dense_attention
+
+    def seq_to_heads(x):
+        # split heads (axis 2) across the group, gather the sequence
+        # (axis 1): [B, S/n, H, D] -> [B, S, H/n, D]
+        return lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    n_rep = qg.shape[2] // kg.shape[2]
+    if n_rep > 1:
+        import jax.numpy as jnp
+
+        kg = jnp.repeat(kg, n_rep, axis=2)
+        vg = jnp.repeat(vg, n_rep, axis=2)
+    o = attn_impl(qg, kg, vg, causal=causal)
+    # inverse: split the sequence back, gather the heads
+    return lax.all_to_all(
+        o, axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def make_ulysses_attention(
+    mesh: Mesh, axis_name: str = "sequence",
+    batch_axes: tuple[str, ...] = ("data", "fsdp"),
+    heads_axis: str = "tensor",
+    attn_impl: Callable | None = None,
+) -> Callable:
+    """Drop-in ``attention_fn`` (same signature/degradation contract as
+    make_ring_attention): global [B, S, H, D] arrays, sequence-sharded by
+    the strategy's activation constraints."""
+    from dlrover_tpu.ops.collectives import shard_map_nocheck
+
+    if axis_name not in mesh.axis_names or mesh.shape[axis_name] <= 1:
+        from dlrover_tpu.models.transformer import dense_attention
+
+        return dense_attention
+
+    n = mesh.shape[axis_name]
+    batch = tuple(a for a in batch_axes if a in mesh.axis_names
+                  and mesh.shape[a] > 1)
+    b_spec = batch if len(batch) > 1 else (batch[0] if batch else None)
+    h_spec = (
+        heads_axis
+        if heads_axis in mesh.axis_names and mesh.shape[heads_axis] > 1
+        else None
+    )
+    spec = PartitionSpec(b_spec, axis_name, h_spec, None)
+
+    def attn(q, k, v, *, causal: bool = True):
+        heads_local = q.shape[2] // (mesh.shape.get(heads_axis, 1)
+                                     if h_spec else 1)
+        kv_local = k.shape[2] // (mesh.shape.get(heads_axis, 1)
+                                  if h_spec else 1)
+        if heads_local % n or kv_local % n:
+            raise ValueError(
+                f"ulysses needs the sequence axis ({n}) to divide the "
+                f"per-shard head counts ({heads_local} q / {kv_local} "
+                f"kv); use ring attention for this shape"
+            )
+        body = partial(
+            ulysses_attention, axis_name=axis_name, causal=causal,
+            attn_impl=attn_impl,
+        )
+        return shard_map_nocheck(
+            body, mesh=mesh,
+            in_specs=(spec, spec, spec), out_specs=spec,
+        )(q, k, v)
+
+    # GQA-native: the layer body hands over UNEXPANDED kv heads and the
+    # all-to-alls move only kv bytes (repeat happens post-gather)
+    attn.supports_gqa = True
+    return attn

@@ -191,6 +191,20 @@ def long_context(sequence_size: int = 2, data_size: int = -1,
     )
 
 
+def ulysses(sequence_size: int = 2, data_size: int = -1,
+            remat: str = "dots") -> Strategy:
+    """Sequence parallel via all-to-all head redistribution
+    (ops/ulysses.py) — the alternative to ring attention when the head
+    count comfortably divides by the sequence axis."""
+    return Strategy(
+        name="ulysses",
+        mesh_axes={"data": data_size, "sequence": sequence_size},
+        rules=[["batch", ["data", "fsdp"]]] + [list(r) for r in _SP_RULES],
+        remat=remat,
+        extra={"attention": "ulysses"},
+    )
+
+
 def sliding_window(window: int = 1024, data_size: int = -1,
                    remat: str = "dots") -> Strategy:
     """Local (sliding-window) attention via the splash kernel.
@@ -277,6 +291,7 @@ PRESETS = {
     "tp": tp,
     "fsdp_tp": fsdp_tp,
     "long_context": long_context,
+    "ulysses": ulysses,
     "sliding_window": sliding_window,
     "pipeline": pipeline,
     "mixed": mixed,
