@@ -128,3 +128,24 @@ def quantized_tree_mean(
             lambda g: quantized_ring_mean(g, axes[0], n), tree
         )
     return jax.tree.map(lambda g: quantized_gather_mean(g, axes), tree)
+
+
+def seq_parallel_spec(mesh, axis_name: str,
+                      batch_axes: tuple[str, ...] = ("data", "fsdp"),
+                      heads_axis: str = "tensor"):
+    """The [B, S, H, D] PartitionSpec shared by the sequence-parallel
+    attention wrappers (ring + Ulysses), or None when the mesh has no
+    usable sequence axis (callers degrade to dense attention)."""
+    from jax.sharding import PartitionSpec
+
+    if axis_name not in mesh.axis_names or mesh.shape[axis_name] <= 1:
+        return None
+    batch = tuple(a for a in batch_axes if a in mesh.axis_names
+                  and mesh.shape[a] > 1)
+    b_spec = batch if len(batch) > 1 else (batch[0] if batch else None)
+    h_spec = (
+        heads_axis
+        if heads_axis in mesh.axis_names and mesh.shape[heads_axis] > 1
+        else None
+    )
+    return PartitionSpec(b_spec, axis_name, h_spec, None)

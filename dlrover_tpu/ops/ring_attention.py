@@ -132,24 +132,18 @@ def make_ring_attention(
     attention is independent per head, and replicating them here would
     all-gather q/k/v and duplicate the ring FLOPs across the tensor axis.
     """
-    from dlrover_tpu.ops.collectives import shard_map_nocheck
+    from dlrover_tpu.ops.collectives import (
+        seq_parallel_spec,
+        shard_map_nocheck,
+    )
 
-    if axis_name not in mesh.axis_names or mesh.shape[axis_name] <= 1:
+    spec = seq_parallel_spec(mesh, axis_name, batch_axes, heads_axis)
+    if spec is None:
         # no sequence axis on this mesh: degrade to dense attention (the
         # elasticity property — same model code on any mesh)
         from dlrover_tpu.models.transformer import dense_attention
 
         return dense_attention
-
-    batch = tuple(a for a in batch_axes if a in mesh.axis_names
-                  and mesh.shape[a] > 1)
-    b_spec = batch if len(batch) > 1 else (batch[0] if batch else None)
-    h_spec = (
-        heads_axis
-        if heads_axis in mesh.axis_names and mesh.shape[heads_axis] > 1
-        else None
-    )
-    spec = PartitionSpec(b_spec, axis_name, h_spec, None)
 
     # replication/varying-axis checking is disabled: the lax.cond causal
     # skip's branches intentionally differ in which inputs they touch

@@ -29,7 +29,7 @@ from functools import partial
 from typing import Callable
 
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec
+from jax.sharding import Mesh
 
 
 def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = True,
@@ -77,23 +77,18 @@ def make_ulysses_attention(
     """Drop-in ``attention_fn`` (same signature/degradation contract as
     make_ring_attention): global [B, S, H, D] arrays, sequence-sharded by
     the strategy's activation constraints."""
-    from dlrover_tpu.ops.collectives import shard_map_nocheck
+    from dlrover_tpu.ops.collectives import (
+        seq_parallel_spec,
+        shard_map_nocheck,
+    )
 
-    if axis_name not in mesh.axis_names or mesh.shape[axis_name] <= 1:
+    spec = seq_parallel_spec(mesh, axis_name, batch_axes, heads_axis)
+    if spec is None:
         from dlrover_tpu.models.transformer import dense_attention
 
         return dense_attention
-
     n = mesh.shape[axis_name]
-    batch = tuple(a for a in batch_axes if a in mesh.axis_names
-                  and mesh.shape[a] > 1)
-    b_spec = batch if len(batch) > 1 else (batch[0] if batch else None)
-    h_spec = (
-        heads_axis
-        if heads_axis in mesh.axis_names and mesh.shape[heads_axis] > 1
-        else None
-    )
-    spec = PartitionSpec(b_spec, axis_name, h_spec, None)
+    h_spec = spec[2]
 
     def attn(q, k, v, *, causal: bool = True):
         heads_local = q.shape[2] // (mesh.shape.get(heads_axis, 1)

@@ -9,9 +9,11 @@ compiled programs total (prefill, slot-install, decode-step) over a
 per-row-position KV cache (models/decode.py forward_cached with vector
 ``pos``):
 
-- **prefill**: one [1, prefill_len] forward filling a fresh cache row
-  (prompts right-padded; pad rows beyond the true length are overwritten
-  just-in-time as decode advances, so they never leak into attention).
+- **prefill**: [1, prefill_len] forward chunks filling a working cache
+  row — long prompts loop the SAME compiled chunk (cache position
+  carries across), so prompt length is bounded by max_len, not
+  prefill_len. Only the final chunk is pad-tailed; trailing pads are
+  overwritten just-in-time as decode advances, never attended.
 - **install**: dynamic-update the prefilled row into the slot batch's
   cache at a traced slot index.
 - **decode step**: one token for ALL slots at their own positions;
@@ -86,10 +88,23 @@ class InferenceEngine:
         self.params = params
         self.cfg = cfg
         self.slots = slots
+        import math
+
         self.max_len = max_len or cfg.max_seq_len
-        self.prefill_len = prefill_len or min(64, self.max_len)
+        # default chunk: the largest divisor of max_len <= 64. The
+        # divisibility invariant is what makes chunked prefill safe: a
+        # final pad-tailed chunk then never extends past max_len, where
+        # XLA's clamped dynamic_update_slice would silently overwrite
+        # EARLIER cache positions with misaligned data.
+        self.prefill_len = prefill_len or math.gcd(self.max_len, 64)
         if self.prefill_len > self.max_len:
             raise ValueError("prefill_len > max_len")
+        if self.max_len % self.prefill_len:
+            raise ValueError(
+                f"prefill_len {self.prefill_len} must divide max_len "
+                f"{self.max_len} (a clamped final chunk write would "
+                "corrupt earlier cache rows)"
+            )
         # decode_block > 1: run up to that many decode iterations inside
         # ONE compiled scan before syncing tokens to the host — the
         # per-token host round trip (sync + dispatch) otherwise bounds
@@ -112,14 +127,18 @@ class InferenceEngine:
         self._key = jax.random.PRNGKey(0)
 
         # --- compiled programs (three, total) -------------------------
-        def _prefill(params, tokens, true_len):
-            cache = init_cache(cfg, 1, self.max_len)
+        def _prefill_chunk(params, tokens, k, v, pos, true_len):
+            # one prefill_len chunk into a [1, max_len] working cache;
+            # long prompts loop this program (cache pos carries across
+            # chunks, so only the FINAL chunk may be pad-tailed — a
+            # mid-sequence pad would sit under later queries' causal
+            # mask). Returns the last REAL token's logits of the chunk.
+            cache = {"k": k, "v": v, "pos": pos}
             logits, cache = forward_cached(params, tokens, cache, cfg)
-            # logits at the last REAL prompt token (pads come after it)
             last = logits[0, true_len - 1]
-            return cache["k"], cache["v"], last
+            return cache["k"], cache["v"], cache["pos"], last
 
-        self._prefill = jax.jit(_prefill)
+        self._prefill_chunk = jax.jit(_prefill_chunk)
 
         def _install(cache_k, cache_v, pos, last_all, row_k, row_v,
                      last_row, slot, true_len):
@@ -169,11 +188,8 @@ class InferenceEngine:
     def submit(self, prompt: list[int],
                params: SamplingParams | None = None) -> int:
         params = params or SamplingParams()
-        if len(prompt) > self.prefill_len:
-            raise ValueError(
-                f"prompt length {len(prompt)} > prefill_len "
-                f"{self.prefill_len}"
-            )
+        if not prompt:
+            raise ValueError("empty prompt")
         if params.max_new_tokens < 1:
             raise ValueError(
                 "max_new_tokens must be >= 1 (this engine decodes; "
@@ -190,12 +206,18 @@ class InferenceEngine:
             if self._active[slot] is not None or not self._queue:
                 continue
             req = self._queue.popleft()
-            toks = np.zeros((1, self.prefill_len), np.int32)
-            toks[0, : len(req.prompt)] = req.prompt
-            row_k, row_v, last = self._prefill(
-                self.params, jnp.asarray(toks),
-                jnp.asarray(len(req.prompt), jnp.int32),
-            )
+            work = init_cache(self.cfg, 1, self.max_len)
+            row_k, row_v, pos = work["k"], work["v"], work["pos"]
+            last = None
+            P = self.prefill_len
+            for lo in range(0, len(req.prompt), P):
+                chunk = req.prompt[lo: lo + P]
+                toks = np.zeros((1, P), np.int32)
+                toks[0, : len(chunk)] = chunk
+                row_k, row_v, pos, last = self._prefill_chunk(
+                    self.params, jnp.asarray(toks), row_k, row_v, pos,
+                    jnp.asarray(len(chunk), jnp.int32),
+                )
             (self._cache["k"], self._cache["v"], self._cache["pos"],
              self._last) = self._install(
                 self._cache["k"], self._cache["v"], self._cache["pos"],

@@ -88,10 +88,13 @@ def test_eos_retires_early(params):
 def test_validation_errors(params):
     eng = InferenceEngine(params, CFG, slots=1, max_len=32,
                           prefill_len=8)
-    with pytest.raises(ValueError):
-        eng.submit(list(range(9)))  # prompt > prefill_len
+    # prompt > prefill_len is fine now (chunked prefill) as long as the
+    # budget fits max_len
+    eng.submit(list(range(9)), SamplingParams(max_new_tokens=4))
     with pytest.raises(ValueError):
         eng.submit([1], SamplingParams(max_new_tokens=40))  # > max_len
+    with pytest.raises(ValueError):
+        eng.submit(list(range(30)))  # prompt + default 64 > max_len
 
 
 @pytest.mark.timeout(300)
@@ -153,3 +156,32 @@ def test_serves_sharded_params_identically(params):
     np.testing.assert_allclose(
         logits["plain"], logits["sharded"], rtol=1e-4, atol=1e-4)
     assert outs["plain"] == outs["sharded"]
+
+
+@pytest.mark.timeout(300)
+def test_chunked_prefill_long_prompt_matches_solo(params):
+    """A prompt longer than prefill_len loops the chunk program and the
+    greedy continuation is exactly solo generate's."""
+    prompt = list((np.arange(19) * 7 + 3) % CFG.vocab_size)
+    eng = InferenceEngine(params, CFG, slots=2, max_len=64,
+                          prefill_len=8)  # 19 tokens -> 3 chunks
+    rid = eng.submit(prompt, SamplingParams(temperature=0.0,
+                                            max_new_tokens=6))
+    res = {r.id: r for r in eng.run()}
+    solo = generate(params, jnp.asarray([prompt], jnp.int32), CFG,
+                    gen_len=6, key=jax.random.PRNGKey(0),
+                    temperature=0.0)
+    assert res[rid].tokens == np.asarray(solo)[0, 19:].tolist()
+    with pytest.raises(ValueError):
+        eng.submit([])  # empty prompt
+
+
+def test_prefill_divisibility_invariant(params):
+    """max_len % prefill_len != 0 is rejected at construction — a
+    clamped final chunk write would corrupt earlier cache rows."""
+    with pytest.raises(ValueError, match="divide"):
+        InferenceEngine(params, CFG, slots=1, max_len=100,
+                        prefill_len=64)
+    # default prefill_len adapts to a divisor
+    eng = InferenceEngine(params, CFG, slots=1, max_len=100)
+    assert eng.prefill_len == 4 and 100 % eng.prefill_len == 0
