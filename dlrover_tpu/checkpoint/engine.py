@@ -298,9 +298,30 @@ class CheckpointEngine:
         step, arrays = loaded
         return step, restore_pytree(template, arrays, put=put)
 
+    def load_raw(self) -> tuple[int, dict] | None:
+        """(step, {leaf_path: array}) without a shape template — for
+        states with data-dependent shapes (embedding tables, whose row
+        count is only known from the checkpoint itself)."""
+        loaded = self._load_from_memory()
+        if loaded is None:
+            loaded = self._load_from_storage()
+        return loaded
+
     def _load_from_memory(self, copy: bool = True
                           ) -> tuple[int, dict[str, np.ndarray]] | None:
         try:
+            header = self.shm_handler.header()
+            if header and header.get("ckpt_dir") not in (
+                None, self.ckpt_dir
+            ):
+                # the shm segment is keyed by node id only: a snapshot
+                # left by ANOTHER job on this host must not shadow the
+                # requested checkpoint directory
+                logger.info(
+                    "shm snapshot belongs to %s, not %s; reading storage",
+                    header.get("ckpt_dir"), self.ckpt_dir,
+                )
+                return None
             snap = self.shm_handler.load_arrays(copy=copy)
         except Exception:  # noqa: BLE001 - fall back to storage on any damage
             logger.exception("shm restore failed; falling back to storage")

@@ -88,15 +88,20 @@ class InferenceEngine:
         self.params = params
         self.cfg = cfg
         self.slots = slots
-        import math
-
         self.max_len = max_len or cfg.max_seq_len
-        # default chunk: the largest divisor of max_len <= 64. The
+        # default chunk: the largest divisor of max_len <= 64 (a real
+        # divisor search — gcd would only extract the power-of-two
+        # factor and degrade to per-token prefill for odd max_len). The
         # divisibility invariant is what makes chunked prefill safe: a
         # final pad-tailed chunk then never extends past max_len, where
         # XLA's clamped dynamic_update_slice would silently overwrite
         # EARLIER cache positions with misaligned data.
-        self.prefill_len = prefill_len or math.gcd(self.max_len, 64)
+        if not prefill_len:
+            prefill_len = next(
+                d for d in range(min(64, self.max_len), 0, -1)
+                if self.max_len % d == 0
+            )
+        self.prefill_len = prefill_len
         if self.prefill_len > self.max_len:
             raise ValueError("prefill_len > max_len")
         if self.max_len % self.prefill_len:

@@ -182,6 +182,8 @@ def test_prefill_divisibility_invariant(params):
     with pytest.raises(ValueError, match="divide"):
         InferenceEngine(params, CFG, slots=1, max_len=100,
                         prefill_len=64)
-    # default prefill_len adapts to a divisor
+    # default prefill_len adapts to the LARGEST divisor <= 64
     eng = InferenceEngine(params, CFG, slots=1, max_len=100)
-    assert eng.prefill_len == 4 and 100 % eng.prefill_len == 0
+    assert eng.prefill_len == 50
+    eng2 = InferenceEngine(params, CFG, slots=1, max_len=96)
+    assert eng2.prefill_len == 48
