@@ -60,8 +60,10 @@ def bench_train_step(extra: dict) -> None:
         # config that would cut backward recompute — "dots", no-remat
         # (projected >=0.45 observed), and even batch 48 of THIS config —
         # fails the axon remote-compile service (HTTP 500,
-        # tpu_compile_helper exit 1), so the measurable ceiling here is
-        # compile-service-bound, not HBM- or roofline-bound. MFU counts
+        # tpu_compile_helper exit 1) — at ANY unroll, so the rejection
+        # tracks the program's live-memory analysis, not program size —
+        # making the measurable ceiling here compile-service-bound, not
+        # HBM- or roofline-bound. MFU counts
         # model FLOPs only; with near-full recompute the device executes
         # ~1.33x that, i.e. hardware utilization ~0.52 (reported as
         # mfu_hw_est).
