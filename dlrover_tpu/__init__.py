@@ -40,6 +40,17 @@ _LAZY_API = {
     "KvEmbeddingTable": ("dlrover_tpu.embedding.kv_table",
                          "KvEmbeddingTable"),
     "init_from_env": ("dlrover_tpu.trainer.bootstrap", "init_from_env"),
+    # round-3 surfaces
+    "Trainer": ("dlrover_tpu.trainer.trainer", "Trainer"),
+    "TrainingArguments": ("dlrover_tpu.trainer.trainer",
+                          "TrainingArguments"),
+    "InferenceEngine": ("dlrover_tpu.serving.engine", "InferenceEngine"),
+    "SamplingParams": ("dlrover_tpu.serving.engine", "SamplingParams"),
+    "generate": ("dlrover_tpu.models.decode", "generate"),
+    "PackedTokenDataset": ("dlrover_tpu.trainer.token_dataset",
+                           "PackedTokenDataset"),
+    "check_strategies": ("dlrover_tpu.utils.numeric_check",
+                         "check_strategies"),
 }
 
 

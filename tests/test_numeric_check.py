@@ -21,11 +21,13 @@ from dlrover_tpu.utils.numeric_check import check_strategies
 CFG = dataclasses.replace(tfm.CONFIGS["tiny"], dtype="float32")
 
 
-def _batch():
+def _batch(seed: int = 0, cfg=None):
     # micro-batch shape (no accumulation dim): the checker feeds
     # loss_fn directly, the way compile_train does per micro step
-    toks = np.random.default_rng(0).integers(
-        0, CFG.vocab_size, (8, 65), dtype=np.int32)
+    cfg = cfg or CFG
+    seq = min(cfg.max_seq_len, 64)  # short sequences keep the jit fast
+    toks = np.random.default_rng(seed).integers(
+        0, cfg.vocab_size, (8, seq + 1), dtype=np.int32)
     return {"tokens": jnp.asarray(toks)}
 
 
@@ -79,3 +81,25 @@ def test_requires_two_strategies():
             batch=_batch(),
             strategies={"dp": PRESETS["dp"]()},
         )
+
+
+@pytest.mark.timeout(300)
+def test_sequence_parallel_strategies_agree():
+    """ring and ulysses must compute the SAME gradients as dp at f32 —
+    the drift checker covering the sequence-parallel attention paths
+    through the full loss (not just the isolated ops)."""
+    cfg = dataclasses.replace(CFG, max_seq_len=64)
+    report = check_strategies(
+        loss_fn_for=lambda s, m: tfm.make_loss_fn(cfg, s, m),
+        init_params_fn=lambda rng: tfm.init_params(cfg, rng),
+        logical_params=tfm.logical_axes(cfg),
+        batch=_batch(seed=2, cfg=cfg),
+        strategies={
+            "dp": PRESETS["dp"](),
+            "ring": PRESETS["long_context"](sequence_size=4,
+                                            data_size=2),
+            "ulysses": PRESETS["ulysses"](sequence_size=4, data_size=2),
+        },
+        rtol=1e-3,
+    )
+    assert report.ok, report.summary()
