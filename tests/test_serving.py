@@ -187,3 +187,42 @@ def test_prefill_divisibility_invariant(params):
     assert eng.prefill_len == 50
     eng2 = InferenceEngine(params, CFG, slots=1, max_len=96)
     assert eng2.prefill_len == 48
+
+
+@pytest.mark.timeout(300)
+def test_randomized_workload_completes_exactly(params):
+    """Mini-fuzz (fixed seed): a mixed bag of prompt lengths, budgets
+    and sampling params on one engine must complete every request with
+    the promised token counts and finish reasons."""
+    import random
+
+    rng = random.Random(42)
+    eng = InferenceEngine(params, CFG, slots=3, max_len=64,
+                          prefill_len=8, decode_block=4)
+    expected = {}
+    for _ in range(10):
+        plen = rng.randint(1, 20)
+        max_new = rng.randint(1, 64 - plen)
+        sp = SamplingParams(
+            temperature=rng.choice([0.0, 0.7, 1.2]),
+            top_k=rng.choice([0, 3, 20]),
+            top_p=rng.choice([1.0, 0.9, 0.5]),
+            max_new_tokens=max_new,
+            eos_id=rng.choice([None, 7]),
+        )
+        prompt = [rng.randrange(CFG.vocab_size) for _ in range(plen)]
+        expected[eng.submit(prompt, sp)] = (max_new, sp.eos_id)
+    results = {r.id: r for r in eng.run()}
+    assert set(results) == set(expected)
+    for rid, (max_new, eos) in expected.items():
+        r = results[rid]
+        assert 1 <= len(r.tokens) <= max_new
+        assert all(0 <= t < CFG.vocab_size for t in r.tokens)
+        if r.finish_reason == "length":
+            assert len(r.tokens) == max_new
+        else:
+            assert eos is not None and r.tokens[-1] == eos
+        if eos is not None:
+            # the stop must have been observed AT the eos token: an eos
+            # anywhere before the end means the engine decoded past it
+            assert eos not in r.tokens[:-1], r
