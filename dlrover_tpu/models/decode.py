@@ -230,6 +230,10 @@ def sample_logits(
     implementation for both, so the nucleus/greedy semantics can't
     drift between serving and rollout paths. Per-row temperature <= 0
     means greedy for that row.
+
+    ``key`` may be one PRNG key (whole batch) or a [B, key_size] stack
+    of per-row keys — per-request determinism: a row's draw then
+    depends only on its own key, never on batch composition.
     """
     B, V = logits.shape
     static = all(isinstance(p, (int, float))
@@ -268,7 +272,12 @@ def sample_logits(
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
 
     scaled = logits / jnp.maximum(temp, 1e-6)[:, None]
-    sampled = jax.random.categorical(key, scaled, axis=-1)
+    if key.ndim == 2:  # per-row keys
+        sampled = jax.vmap(
+            lambda k, row: jax.random.categorical(k, row)
+        )(key, scaled)
+    else:
+        sampled = jax.random.categorical(key, scaled, axis=-1)
     greedy = jnp.argmax(logits, axis=-1)
     return jnp.where(temp <= 0, greedy, sampled).astype(jnp.int32)
 

@@ -55,6 +55,10 @@ class SamplingParams:
     top_p: float = 1.0
     max_new_tokens: int = 64
     eos_id: int | None = None
+    # per-request determinism: with a seed, the continuation depends
+    # only on (params, prompt, sampling params, seed) — identical
+    # whatever else shares the batch. None -> engine-generated seed.
+    seed: int | None = None
 
 
 @dataclasses.dataclass
@@ -129,7 +133,12 @@ class InferenceEngine:
         self._cache = init_cache(cfg, slots, self.max_len)
         self._cache["pos"] = jnp.zeros((slots,), jnp.int32)
         self._last = jnp.zeros((slots, cfg.vocab_size), jnp.float32)
-        self._key = jax.random.PRNGKey(0)
+        # per-slot sampling randomness: a seed per REQUEST + a count of
+        # tokens sampled so far — the per-draw key is derived from both,
+        # so a request's stream never depends on batch composition
+        self._seeds = np.zeros((slots,), np.uint32)
+        self._sampled = np.zeros((slots,), np.int64)
+        self._seed_gen = np.random.default_rng(0)
 
         # --- compiled programs (three, total) -------------------------
         def _prefill_chunk(params, tokens, k, v, pos, true_len):
@@ -160,14 +169,25 @@ class InferenceEngine:
 
         self._install = jax.jit(_install)
 
-        def _step_block(params, k, v, pos, last, key, temperature,
-                        top_k, top_p, active, n_steps):
+        def _row_keys(seeds, counts):
+            # per-row key = f(request seed, index of this draw): pure
+            # per-request randomness, batch-composition-independent
+            return jax.vmap(
+                lambda s, c: jax.random.fold_in(
+                    jax.random.fold_in(jax.random.PRNGKey(0), s), c
+                )
+            )(seeds, counts)
+
+        def _step_block(params, k, v, pos, last, seeds, counts,
+                        temperature, top_k, top_p, active, n_steps):
             # per-row sampling params as VECTORS: one compiled program
             # regardless of the mix of requests in the batch
-            def body(carry, sub):
+            def body(carry, i):
                 k, v, pos, last = carry
-                nxt = sample_logits(last, sub, temperature, top_k,
-                                    top_p)
+                nxt = sample_logits(
+                    last, _row_keys(seeds, counts + i), temperature,
+                    top_k, top_p,
+                )
                 cache = {"k": k, "v": v, "pos": pos}
                 logits, cache = forward_cached(
                     params, nxt[:, None], cache, cfg
@@ -178,9 +198,8 @@ class InferenceEngine:
                 return (cache["k"], cache["v"], new_pos,
                         logits[:, 0]), nxt
 
-            keys = jax.random.split(key, n_steps)
             (k, v, pos, last), toks = lax.scan(
-                body, (k, v, pos, last), keys
+                body, (k, v, pos, last), jnp.arange(n_steps)
             )
             return toks, k, v, pos, last
 
@@ -232,6 +251,12 @@ class InferenceEngine:
             )
             self._active[slot] = req
             self._emitted[slot] = []
+            seed = (req.params.seed if req.params.seed is not None
+                    else int(self._seed_gen.integers(0, 2**32)))
+            # normalize arbitrary ints (time_ns(), 64-bit random) into
+            # the uint32 fold_in domain instead of overflowing mid-run
+            self._seeds[slot] = np.uint32(seed % (2**32))
+            self._sampled[slot] = 0
 
     def _sampling_tensors(self):
         V = self.cfg.vocab_size
@@ -277,13 +302,15 @@ class InferenceEngine:
         if not active_mask.any():
             return 0
         temp, top_k, top_p = self._sampling_tensors()
-        self._key, sub = jax.random.split(self._key)
         block = self._block_size()
         toks_dev, k, v, pos, last = self._step_block(
             self.params, self._cache["k"], self._cache["v"],
-            self._cache["pos"], self._last, sub, temp, top_k,
-            top_p, jnp.asarray(active_mask), n_steps=block,
+            self._cache["pos"], self._last,
+            jnp.asarray(self._seeds), jnp.asarray(self._sampled),
+            temp, top_k, top_p, jnp.asarray(active_mask),
+            n_steps=block,
         )
+        self._sampled[active_mask] += block
         self._cache["k"], self._cache["v"] = k, v
         self._cache["pos"] = pos
         self._last = last

@@ -39,6 +39,10 @@ def parse_args(argv=None):
     p.add_argument("--top-k", type=int, default=0)
     p.add_argument("--top-p", type=float, default=1.0)
     p.add_argument("--eos-id", type=int, default=-1)
+    p.add_argument("--seed", type=int, default=-1,
+                   help="per-request sampling seed (same seed -> same "
+                        "continuation regardless of batching); -1 = "
+                        "engine-generated")
     p.add_argument("--slots", type=int, default=8)
     p.add_argument("--max-len", type=int, default=0)
     p.add_argument("--prefill-len", type=int, default=0)
@@ -93,6 +97,7 @@ def main(argv=None) -> int:
         temperature=args.temperature, top_k=args.top_k,
         top_p=args.top_p, max_new_tokens=args.max_new,
         eos_id=None if args.eos_id < 0 else args.eos_id,
+        seed=None if args.seed < 0 else args.seed,
     )
 
     lines = args.prompt or [ln.strip() for ln in sys.stdin

@@ -226,3 +226,42 @@ def test_randomized_workload_completes_exactly(params):
             # the stop must have been observed AT the eos token: an eos
             # anywhere before the end means the engine decoded past it
             assert eos not in r.tokens[:-1], r
+
+
+@pytest.mark.timeout(300)
+def test_seeded_requests_are_batch_independent(params):
+    """A seeded request's continuation depends only on (prompt, params,
+    seed) — identical whether it runs alone or batched with strangers.
+    f32: bf16 tiling differences across batch shapes would add ulp
+    noise unrelated to the property under test."""
+    import dataclasses
+
+    cfg32 = dataclasses.replace(CFG, dtype="float32")
+    sp = SamplingParams(temperature=0.9, top_p=0.95,
+                        max_new_tokens=10, seed=123)
+
+    def run_alone():
+        eng = InferenceEngine(params, cfg32, slots=1, max_len=64,
+                              prefill_len=8)
+        rid = eng.submit([5, 9, 2], sp)
+        return {r.id: r for r in eng.run()}[rid].tokens
+
+    def run_batched():
+        eng = InferenceEngine(params, cfg32, slots=3, max_len=64,
+                              prefill_len=8)
+        eng.submit([7, 7], SamplingParams(temperature=1.1,
+                                          max_new_tokens=14))
+        rid = eng.submit([5, 9, 2], sp)
+        eng.submit([1, 2, 3, 4], SamplingParams(temperature=0.5,
+                                                max_new_tokens=5))
+        return {r.id: r for r in eng.run()}[rid].tokens
+
+    alone = run_alone()
+    assert run_batched() == alone
+    assert run_alone() == alone            # and reproducible
+    # a different seed (almost surely) diverges
+    sp2 = dataclasses.replace(sp, seed=99)
+    eng = InferenceEngine(params, cfg32, slots=1, max_len=64,
+                          prefill_len=8)
+    rid = eng.submit([5, 9, 2], sp2)
+    assert {r.id: r for r in eng.run()}[rid].tokens != alone
