@@ -66,6 +66,11 @@ class Request:
     id: int
     prompt: list[int]
     params: SamplingParams
+    # streaming: called as on_token(request_id, token) for each ACCEPTED
+    # token, in order, from step()'s host loop. With decode_block > 1
+    # tokens arrive in bursts of up to block size — streaming-latency-
+    # sensitive callers trade throughput with decode_block=1.
+    on_token: Any = None
 
 
 @dataclasses.dataclass
@@ -210,7 +215,8 @@ class InferenceEngine:
     # ----------------------------------------------------------- user API
 
     def submit(self, prompt: list[int],
-               params: SamplingParams | None = None) -> int:
+               params: SamplingParams | None = None,
+               on_token=None) -> int:
         params = params or SamplingParams()
         if not prompt:
             raise ValueError("empty prompt")
@@ -222,7 +228,7 @@ class InferenceEngine:
         if len(prompt) + params.max_new_tokens > self.max_len:
             raise ValueError("prompt + max_new_tokens > max_len")
         rid = next(self._ids)
-        self._queue.append(Request(rid, list(prompt), params))
+        self._queue.append(Request(rid, list(prompt), params, on_token))
         return rid
 
     def _admit(self) -> None:
@@ -322,6 +328,14 @@ class InferenceEngine:
             for j in range(block):
                 t = int(toks[j, s])
                 self._emitted[s].append(t)
+                if req.on_token is not None:
+                    try:
+                        req.on_token(req.id, t)
+                    except Exception:  # noqa: BLE001 - a streaming
+                        logger.exception(  # consumer must not kill decode
+                            "on_token callback failed (request %d)",
+                            req.id,
+                        )
                 if p.eos_id is not None and t == p.eos_id:
                     self._retire(s, "eos")
                     break

@@ -265,3 +265,39 @@ def test_seeded_requests_are_batch_independent(params):
                           prefill_len=8)
     rid = eng.submit([5, 9, 2], sp2)
     assert {r.id: r for r in eng.run()}[rid].tokens != alone
+
+
+@pytest.mark.timeout(300)
+def test_streaming_callback_receives_tokens_in_order(params):
+    """on_token streams every accepted token in order; a raising
+    consumer never kills decode; nothing streams past eos."""
+    eng = InferenceEngine(params, CFG, slots=2, max_len=64,
+                          prefill_len=8, decode_block=4)
+    streamed = {}
+
+    def cb(rid, tok):
+        streamed.setdefault(rid, []).append(tok)
+
+    def bad_cb(rid, tok):
+        raise RuntimeError("consumer bug")
+
+    r1 = eng.submit([5, 9, 2], SamplingParams(temperature=0.0,
+                                              max_new_tokens=9),
+                    on_token=cb)
+    r2 = eng.submit([7, 7], SamplingParams(temperature=0.0,
+                                           max_new_tokens=6),
+                    on_token=bad_cb)
+    results = {r.id: r for r in eng.run()}
+    assert streamed[r1] == results[r1].tokens
+    assert len(results[r2].tokens) == 6  # bad consumer didn't kill it
+
+    # eos path: the eos token itself streams, nothing after it
+    probe = generate(params, jnp.asarray([[5, 9, 2]], jnp.int32), CFG,
+                     gen_len=1, key=jax.random.PRNGKey(0),
+                     temperature=0.0)
+    eos = int(np.asarray(probe)[0, -1])
+    r3 = eng.submit([5, 9, 2], SamplingParams(
+        temperature=0.0, max_new_tokens=20, eos_id=eos), on_token=cb)
+    res3 = {r.id: r for r in eng.run()}[r3]
+    assert res3.finish_reason == "eos"
+    assert streamed[r3] == res3.tokens == [eos]
