@@ -56,17 +56,22 @@ def bench_train_step(extra: dict) -> None:
         # schedule weight prefetch across layers (r03 sweep: 0.393 vs
         # 0.382 MFU). Attention impl and CE chunking measured invariant
         # at b32/s1024. Exhaustive r03 policy sweep: save_attn_ffn
-        # 0.384, save_attn 0.382, dots_no_batch 0.393 (pick); every
-        # config that would cut backward recompute — "dots", no-remat
-        # (projected >=0.45 observed), and even batch 48 of THIS config —
-        # fails the axon remote-compile service (HTTP 500,
-        # tpu_compile_helper exit 1) — at ANY unroll, so the rejection
-        # tracks the program's live-memory analysis, not program size —
-        # making the measurable ceiling here compile-service-bound, not
-        # HBM- or roofline-bound. MFU counts
-        # model FLOPs only; with near-full recompute the device executes
-        # ~1.33x that, i.e. hardware utilization ~0.52 (reported as
-        # mfu_hw_est).
+        # 0.384, save_attn 0.382, dots_no_batch 0.393 (pick).
+        # Ceiling analysis (measured with examples/mfu_probe.py, late
+        # r03): this config is HBM-BANDWIDTH-bound, not recompute-bound.
+        # Every memory<->FLOPs trade measures flat or worse: no-remat
+        # genuinely OOMs (24.7 GB vs 15.75 GB HBM — the earlier compile
+        # 500s were real OOM rejections), "dots" needs 17.2 GB and at
+        # b24 is SLOWER than full recompute (0.375 vs 0.389 MFU), and
+        # interleaved remat_interval=2 (recompute halved to 0.5 fwd)
+        # compiles at b32 but lands at 0.396 — the saved activations'
+        # HBM writes+reads cost what the skipped recompute saves. The
+        # roofline itself: back-to-back bf16 matmul chains at this
+        # d_model=768 geometry peak at 0.58-0.64 utilization on v5e
+        # (vs 0.76-0.77 at d_model>=1024), so the step's ~0.53 hardware
+        # utilization is ~85% of what pure matmuls can do at these
+        # shapes. MFU counts model FLOPs only; with near-full recompute
+        # the device executes ~1.33x that (reported as mfu_hw_est).
         cfg = dataclasses.replace(
             tfm.CONFIGS[model], remat_scan=True,
             remat_policy="dots_no_batch", attention="splash", ce_chunks=16,
@@ -135,8 +140,9 @@ def bench_train_step(extra: dict) -> None:
         # model-FLOPs MFU understates device work under activation
         # remat: the backward re-executes ~a full forward (~1.33x model
         # FLOPs total), so hardware utilization is ~mfu * 1.33 with the
-        # dots_no_batch policy. Configs avoiding the recompute are
-        # blocked by the axon remote-compile service (see comment above).
+        # dots_no_batch policy. Configs avoiding the recompute either
+        # OOM or measure flat — see the bandwidth-bound ceiling
+        # analysis in the config comment above.
         mfu_hw_est=(round(flops_per_step * 4 / 3 / step_s / peak, 4)
                     if peak and on_tpu else None),
         # raw XLA cost analysis; undercounts lax.scan/while bodies, so it

@@ -48,7 +48,9 @@ class ProgressReporter:
                  min_interval_s: float = 1.0):
         self._path = progress_path(node_id)
         self._min_interval_s = min_interval_s
-        self._last_write = 0.0
+        # -inf, not 0: monotonic() is host uptime, so 0 would silently
+        # rate-limit away the FIRST report on a freshly booted machine
+        self._last_write = float("-inf")
 
     def report(self, step: int) -> None:
         now = time.monotonic()

@@ -54,6 +54,14 @@ class TransformerConfig:
     # weight prefetch/scheduling across adjacent layers at the cost of
     # program size (still one remat boundary per layer)
     scan_unroll: int = 1
+    # interleaved remat: scan groups of k layers where only the first
+    # k-1 are rematted and the k-th keeps its activations, so the
+    # backward recomputes (k-1)/k of a forward instead of all of it.
+    # Live memory grows by one full layer's activations per group —
+    # the middle ground the reference reaches with selective
+    # activation checkpointing (atorch checkpoint_optimization.py).
+    # 1 = remat every layer (classic); requires n_layers % k == 0.
+    remat_interval: int = 1
     # "dense" | "flash" | "flash_own" | "splash" | "ring" | "ulysses"
     attention: str = "dense"
     # splash only: sliding-window size (0 = full causal); the sparse
@@ -148,6 +156,12 @@ CONFIGS = {
     "gpt2-small": TransformerConfig(
         vocab_size=50257, d_model=768, n_layers=12, n_heads=12, n_kv_heads=12,
         d_ff=3072, max_seq_len=1024, variant="gpt2"),
+    "gpt2-medium": TransformerConfig(
+        vocab_size=50257, d_model=1024, n_layers=24, n_heads=16, n_kv_heads=16,
+        d_ff=4096, max_seq_len=1024, variant="gpt2"),
+    "gpt2-large": TransformerConfig(
+        vocab_size=50257, d_model=1280, n_layers=36, n_heads=20, n_kv_heads=20,
+        d_ff=5120, max_seq_len=1024, variant="gpt2"),
     "gpt2-xl": TransformerConfig(
         vocab_size=50257, d_model=1600, n_layers=48, n_heads=25, n_kv_heads=25,
         d_ff=6400, max_seq_len=1024, variant="gpt2"),
@@ -440,6 +454,12 @@ def forward_with_aux(
         x = pin(x + ff, ("batch", "sequence", "embed"))
         return x, aux
 
+    if c.remat_interval > 1 and (not c.remat_scan or c.pipeline_stages > 1):
+        # would be silently ignored below — reject so sweeps can't
+        # attribute numbers to an interleaving that never ran
+        raise ValueError(
+            "remat_interval > 1 requires remat_scan=True and no pipeline"
+        )
     body = layer
     if c.remat_scan:
         if c.remat_policy not in LAYER_REMAT_POLICIES:
@@ -468,6 +488,33 @@ def forward_with_aux(
             constrain=pin,
         )
         aux = jnp.zeros((), jnp.float32)
+    elif c.remat_scan and c.remat_interval > 1:
+        k = c.remat_interval
+        if c.n_layers % k:
+            raise ValueError(
+                f"remat_interval {k} must divide n_layers {c.n_layers}"
+            )
+        grouped = jax.tree_util.tree_map(
+            lambda a: a.reshape(c.n_layers // k, k, *a.shape[1:]),
+            params["layers"],
+        )
+
+        def scan_group(carry, wg):
+            x, aux = carry
+            for i in range(k - 1):
+                wi = jax.tree_util.tree_map(lambda a: a[i], wg)
+                x, inc = body(x, wi)
+                aux = aux + inc
+            # last layer of the group runs unrematted: its activations
+            # become scan residuals, bought back as skipped recompute
+            wl = jax.tree_util.tree_map(lambda a: a[k - 1], wg)
+            x, inc = layer(x, wl)
+            return (x, aux + inc), None
+
+        (x, aux), _ = lax.scan(
+            scan_group, (x, jnp.zeros((), jnp.float32)), grouped,
+            unroll=max(1, min(c.scan_unroll, c.n_layers // k)),
+        )
     else:
         def scan_body(carry, w):
             x, aux = carry

@@ -1,0 +1,108 @@
+"""MFU probe: measure one training-step config on the attached device.
+
+Usage: python examples/mfu_probe.py --policy dots_no_batch --batch 32 \
+           --attention splash --steps 20 [--no-remat] [--unroll 12]
+
+Prints one JSON line with step time and model-FLOPs MFU so configs can be
+swept from the shell (used to chase the r03 MFU ceiling; see bench.py's
+bench_train_step for the production config and DESIGN.md §9 for numbers).
+
+Deliberately mirrors bench_train_step's protocol (same warmup/timing/sync
+and the same PaLM 6N + 12*L*S*d accounting) — a sweep number here must be
+directly comparable to the bench's reported MFU. If the accounting there
+changes, change it here too.
+"""
+
+import argparse
+import dataclasses
+import json
+import time
+from functools import partial
+
+import numpy as np
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="gpt2-small")
+    p.add_argument("--policy", default="dots_no_batch")
+    p.add_argument("--no-remat", action="store_true")
+    p.add_argument("--attention", default="splash")
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--seq", type=int, default=1024)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--unroll", type=int, default=12)
+    p.add_argument("--interval", type=int, default=1)
+    p.add_argument("--ce-chunks", type=int, default=16)
+    args = p.parse_args()
+
+    import jax
+    import optax
+
+    from dlrover_tpu.models import transformer as tfm
+    from dlrover_tpu.parallel import strategy as strat_lib
+    from dlrover_tpu.trainer.train_step import compile_train
+
+    dev = jax.devices()[0]
+    cfg = dataclasses.replace(
+        tfm.CONFIGS[args.model],
+        remat_scan=not args.no_remat,
+        remat_policy=args.policy,
+        attention=args.attention,
+        ce_chunks=args.ce_chunks,
+        scan_unroll=args.unroll,
+        remat_interval=1 if args.no_remat else args.interval,
+    )
+    args.seq = min(cfg.max_seq_len, args.seq)
+    strat = strat_lib.dp()
+    mesh = strat.build_mesh(jax.devices()[:1])
+    compiled = compile_train(
+        strategy=strat,
+        mesh=mesh,
+        loss_fn=partial(tfm.loss_fn, cfg=cfg),
+        init_params_fn=lambda rng: tfm.init_params(cfg, rng),
+        logical_params=tfm.logical_axes(cfg),
+        optimizer=optax.adamw(1e-4),
+    )
+    state = compiled.init(jax.random.PRNGKey(0))
+    tokens = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (1, args.batch, args.seq + 1), dtype=np.int32
+    )
+    batch = jax.device_put({"tokens": tokens}, compiled.batch_sharding)
+
+    t0 = time.monotonic()
+    state, metrics = compiled.step(state, batch)
+    loss0 = float(jax.device_get(metrics["loss"]))
+    compile_s = time.monotonic() - t0
+    for _ in range(2):
+        state, metrics = compiled.step(state, batch)
+    float(jax.device_get(metrics["loss"]))
+
+    t0 = time.monotonic()
+    for _ in range(args.steps):
+        state, metrics = compiled.step(state, batch)
+    loss = float(jax.device_get(metrics["loss"]))
+    step_s = (time.monotonic() - t0) / args.steps
+
+    from dlrover_tpu.utils.profiler import device_peak_flops
+
+    n = cfg.param_count
+    fpt = 6 * n + 12 * cfg.n_layers * args.seq * cfg.d_model
+    flops = fpt * args.batch * args.seq
+    peak = device_peak_flops(dev)
+    print(json.dumps({
+        "policy": args.policy if not args.no_remat else "none",
+        "attention": args.attention,
+        "batch": args.batch,
+        "unroll": args.unroll,
+        "interval": cfg.remat_interval,
+        "compile_s": round(compile_s, 1),
+        "step_s": round(step_s, 4),
+        "mfu": round(flops / step_s / peak, 4) if peak else None,
+        "loss0": round(loss0, 3),
+        "loss": round(loss, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

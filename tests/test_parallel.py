@@ -424,3 +424,36 @@ class TestRematPolicies:
             )
             losses.append(float(jax.device_get(m["loss"])))
         assert losses[0] == pytest.approx(losses[1], rel=2e-4), losses
+
+    def test_remat_interval_grad_parity(self):
+        """Interleaved remat (remat_interval=2: only every other layer
+        rematted, halving backward recompute) must produce the same
+        gradients as per-layer remat, within the existing bf16 remat
+        noise floor (measured: remat itself differs from no-remat by
+        ~2.6e-3 on tiny)."""
+        import dataclasses
+
+        cfg1 = dataclasses.replace(
+            T.CONFIGS["tiny"], remat_scan=True, remat_policy="nothing",
+            n_layers=4,
+        )
+        cfg2 = dataclasses.replace(cfg1, remat_interval=2)
+        cfg_bad = dataclasses.replace(cfg1, remat_interval=3)  # 4 % 3 != 0
+        cfg_off = dataclasses.replace(cfg1, remat_scan=False,
+                                      remat_interval=2)
+        params = T.init_params(cfg1, jax.random.PRNGKey(0))
+        tokens = {"tokens": jnp.asarray(np.random.RandomState(0).randint(
+            0, 512, (2, 65)), jnp.int32)}
+        g1 = jax.grad(lambda p: T.loss_fn(p, tokens, cfg=cfg1))(params)
+        g2 = jax.grad(lambda p: T.loss_fn(p, tokens, cfg=cfg2))(params)
+        diff = max(
+            float(jnp.max(jnp.abs(a - b)))
+            for a, b in zip(jax.tree_util.tree_leaves(g1),
+                            jax.tree_util.tree_leaves(g2))
+        )
+        assert diff < 5e-3, diff
+        with pytest.raises(ValueError, match="remat_interval"):
+            T.loss_fn(params, tokens, cfg=cfg_bad)
+        # interval without remat_scan must error, not silently ignore
+        with pytest.raises(ValueError, match="remat_interval"):
+            T.loss_fn(params, tokens, cfg=cfg_off)
