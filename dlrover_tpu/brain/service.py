@@ -155,6 +155,16 @@ class BrainDataStore:
             self._conn.close()
 
 
+def _best_worker_count(ok_rows: list[tuple]) -> int:
+    """Worker count of the fastest-per-worker successful run (the
+    create/create_oom worker-count vote; rows are history() tuples with
+    workers at [1] and steps/s at [4]). 0 when there is no history."""
+    if not ok_rows:
+        return 0
+    best = max(ok_rows, key=lambda r: (r[4] / r[1]) if r[1] else 0.0)
+    return best[1] or 0
+
+
 class BrainService:
     """The optimize algorithms over the datastore, served via RPC."""
 
@@ -207,6 +217,17 @@ class BrainService:
         - hot: per-node memory grants for nodes whose usage exceeds
           1.5x the job median — needs node_memory_mb, >= 3 nodes
           (reference OptimizeJobHotPSResource)
+        - create_oom: create-stage sizing for signatures whose history
+          contains OOM kills — start at 2x the all-time peak instead of
+          re-entering the OOM->relaunch loop a new job would hit with
+          median-based sizing (reference
+          OptimizeJobWorkerCreateOomResource); found=False when the
+          signature has no OOM history so callers fall back to create
+
+        The reference's PS-vs-worker split of these stages collapses
+        here: TPU jobs have one node role, so each algorithm appears
+        once (create covers PSCreateResource + WorkerCreateResource,
+        running covers WorkerResource; 8 stages ~ 9 Go optalgorithms).
         """
         if req.stage == "init_adjust":
             return self._optimize_init_adjust(req)
@@ -224,6 +245,18 @@ class BrainService:
             return self._optimize_util(req)
         rows = self.store.history(req.signature)
         ok_rows = [r for r in rows if r[5] == "succeeded"]
+        if req.stage == "create_oom":
+            peak = self.store.peak_memory_mb(req.signature)
+            # peak==0 means the OOM rows carried no usage numbers — an
+            # all-zero plan would shadow the create stage's sizing, so
+            # this algorithm declines and the caller falls through
+            if peak <= 0 or not any(r[5] == "oom" for r in rows):
+                return m.BrainOptimizePlan(found=False)
+            return m.BrainOptimizePlan(
+                found=True, memory_mb=2 * peak,
+                workers=_best_worker_count(ok_rows),
+                based_on_jobs=len(rows),
+            )
         if not rows or (req.stage == "create" and not ok_rows):
             return m.BrainOptimizePlan(found=False)
         if req.stage == "oom":
@@ -255,13 +288,8 @@ class BrainService:
                 based_on_jobs=sum(len(v) for v in by_count.values()),
             )
         mem = int(1.5 * statistics.median(r[2] for r in ok_rows))
-        # fastest per-worker throughput wins the worker-count vote
-        best = max(
-            ok_rows,
-            key=lambda r: (r[4] / r[1]) if r[1] else 0.0,
-        )
         return m.BrainOptimizePlan(
-            found=True, workers=best[1] or 0, memory_mb=mem,
+            found=True, workers=_best_worker_count(ok_rows), memory_mb=mem,
             based_on_jobs=len(ok_rows),
         )
 

@@ -103,23 +103,45 @@ class LocalResourceOptimizer:
         return plan
 
     def initial_plan(self) -> ScalePlan:
-        brain = self._brain_plan("create")
-        if brain is not None and brain.workers:
-            workers = min(
-                max(brain.workers, self._config.min_workers),
-                self._config.max_workers,
-            )
+        # OOM-scarred signatures first: the create_oom stage sizes from
+        # the all-time peak so a new job doesn't re-enter the
+        # OOM->relaunch loop median-based create sizing would hit. The
+        # plan may carry memory WITHOUT a worker vote (all history
+        # OOMed -> no successful run to vote with) — still a plan.
+        brain = self._brain_plan("create_oom")
+        if brain is None:
+            brain = self._brain_plan("create")
+        workers = self._config.max_workers
+        reason = "initial"
+        memory: dict[str, int] = {}
+        if brain is not None:
+            if brain.workers:
+                workers = min(
+                    max(brain.workers, self._config.min_workers),
+                    self._config.max_workers,
+                )
+            if brain.memory_mb:
+                # create-stage sizing is job-wide: seed the per-node
+                # override (the scaler's OOM-bump channel) for every id
+                # up to max_workers — nodes added later by speed_plan
+                # must launch with the same sizing — and record it as
+                # the oom_recovery baseline so a later OOM can only
+                # raise it, never shrink it
+                memory = {str(i): brain.memory_mb
+                          for i in range(self._config.max_workers)}
+                for i in range(self._config.max_workers):
+                    self._memory_mb[i] = max(
+                        self._memory_mb.get(i, 0), brain.memory_mb
+                    )
+            reason = f"brain history ({brain.based_on_jobs} jobs)"
             logger.info(
-                "brain initial plan: %d workers (from %d jobs)",
-                workers, brain.based_on_jobs,
-            )
-            return ScalePlan(
-                replica_resources={"worker": workers},
-                reason=f"brain history ({brain.based_on_jobs} jobs)",
+                "brain initial plan: %d workers, %sMB (from %d jobs)",
+                workers, brain.memory_mb or "default", brain.based_on_jobs,
             )
         return ScalePlan(
-            replica_resources={"worker": self._config.max_workers},
-            reason="initial",
+            replica_resources={"worker": workers},
+            memory_mb=memory,
+            reason=reason,
         )
 
     def oom_recovery_plan(self, node_id: int) -> ScalePlan:

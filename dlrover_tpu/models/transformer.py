@@ -144,6 +144,19 @@ LAYER_REMAT_POLICIES = {
     # save the two most expensive recomputes (attention output and the
     # gelu'd FFN hidden) by name: most of "dots"' recompute savings at a
     # fraction of its residual memory
+    # host-offload variant of save_attn_ffn: the two biggest per-layer
+    # activations move to pinned host memory instead of HBM, and the
+    # backward fetches them back — activation memory bought with PCIe/
+    # host bandwidth instead of recompute FLOPs. The atorch
+    # SelectiveOffloadingCheckpoint analog
+    # (atorch/auto/opt_lib/selective_offloading_checkpoint.py), native
+    # to XLA's memory-space machinery rather than CUDA streams.
+    "offload_attn_ffn": jax.checkpoint_policies.
+    save_and_offload_only_these_names(
+        names_which_can_be_saved=[],
+        names_which_can_be_offloaded=["attn_out", "ffn_hidden"],
+        offload_src="device", offload_dst="pinned_host",
+    ),
     "save_attn_ffn": jax.checkpoint_policies.save_only_these_names(
         "attn_out", "ffn_hidden"
     ),

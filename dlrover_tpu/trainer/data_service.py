@@ -1,0 +1,260 @@
+"""Remote data workers: CPU hosts stream ready batches to trainers.
+
+Reference analog: ATorch's coworker data service — dedicated CPU pods
+prepare samples and GPU trainers consume them over gRPC
+(atorch/atorch/service/coworker_data_service.py, data/shm_context.py
+``CoworkerDataset``). The same-host half of that design is
+``trainer/shm_dataloader.py`` (process-local shm ring); this module is
+the cross-host half: a TPU-VM trainer pulls ready-made batches from
+data-worker processes running on separate CPU hosts, so tokenization /
+decoding / augmentation never competes with the Python thread driving
+the chips.
+
+Design (TPU-first, matching the repo's no-pickle transport rules):
+- Pull protocol over one TCP connection per client: the trainer sends a
+  tiny JSON request frame, the worker answers with one batch frame —
+  a 1-byte tag (``B`` batch / ``E`` end), a JSON meta header (array
+  names/shapes/dtypes/offsets — the shm ring's slot layout, promoted to
+  a wire format) and the arrays' raw bytes. No pickling anywhere.
+- Each batch goes to exactly ONE client (the dynamic-sharding
+  semantic): a shared iterator behind a lock, so N trainer hosts
+  draining one worker see a partition, not copies.
+- ``RemoteBatchLoader`` fans in from many workers: one puller thread
+  per address feeding a bounded local queue (backpressure = queue depth
+  + the pull protocol itself).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import queue as queue_mod
+from typing import Callable, Iterator
+
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.rpc import recv_frame, send_frame
+
+logger = get_logger(__name__)
+
+_TAG_BATCH = b"B"
+_TAG_END = b"E"
+_LEN = struct.Struct("<I")
+
+
+def encode_batch(batch: dict[str, np.ndarray]) -> bytes:
+    """Batch -> tag + length-prefixed JSON meta + concatenated raw bytes."""
+    metas = {}
+    chunks = []
+    off = 0
+    for name, arr in batch.items():
+        arr = np.ascontiguousarray(arr)
+        metas[name] = {
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+            "offset": off,
+        }
+        chunks.append(arr.tobytes())
+        off += arr.nbytes
+    header = json.dumps(metas).encode()
+    return b"".join([_TAG_BATCH, _LEN.pack(len(header)), header] + chunks)
+
+
+def decode_batch(payload: bytes) -> dict[str, np.ndarray] | None:
+    """Inverse of :func:`encode_batch`; ``None`` for the end marker."""
+    if payload[:1] == _TAG_END:
+        return None
+    if payload[:1] != _TAG_BATCH:
+        raise ValueError(f"bad batch frame tag {payload[:1]!r}")
+    (hlen,) = _LEN.unpack(payload[1:1 + _LEN.size])
+    start = 1 + _LEN.size
+    metas = json.loads(payload[start:start + hlen])
+    base = start + hlen
+    out = {}
+    for name, info in metas.items():
+        dtype = np.dtype(info["dtype"])
+        count = int(np.prod(info["shape"]))
+        # copy: frombuffer views are read-only and pin the whole payload
+        # alive; the shm loader hands back owned arrays, so the remote
+        # path must too or portable preprocessing breaks
+        out[name] = np.frombuffer(
+            payload, dtype=dtype, count=count, offset=base + info["offset"]
+        ).reshape(info["shape"]).copy()
+    return out
+
+
+class DataServiceServer:
+    """One data worker: serves a batch iterator to pulling trainers.
+
+    ``produce`` is called once; its iterator is shared across all client
+    connections behind a lock — each batch is delivered exactly once.
+    """
+
+    def __init__(self, produce: Callable[[], Iterator[dict]],
+                 host: str = "0.0.0.0", port: int = 0):
+        self._produce = produce
+        self._sock = socket.create_server((host, port))
+        self._sock.settimeout(0.5)
+        self._iter: Iterator[dict] | None = None
+        self._iter_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True
+        )
+
+    @property
+    def port(self) -> int:
+        return self._sock.getsockname()[1]
+
+    def start(self) -> "DataServiceServer":
+        self._iter = self._produce()
+        self._accept_thread.start()
+        logger.info("data service serving on port %d", self.port)
+        return self
+
+    def _next_batch(self) -> dict | None:
+        with self._iter_lock:
+            assert self._iter is not None
+            return next(self._iter, None)
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            )
+            t.start()
+            # prune finished handlers: reconnect-per-epoch clients would
+            # otherwise grow this list for the life of the worker
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        with conn:
+            while not self._stop.is_set():
+                try:
+                    req = json.loads(recv_frame(conn))
+                except (ConnectionError, OSError, ValueError):
+                    return
+                if req.get("kind") != "next":
+                    send_frame(conn, _TAG_END)
+                    return
+                batch = self._next_batch()
+                try:
+                    if batch is None:
+                        send_frame(conn, _TAG_END)
+                        return
+                    send_frame(conn, encode_batch(batch))
+                except (ConnectionError, OSError):
+                    logger.warning(
+                        "client dropped mid-send; batch lost (at-most-once "
+                        "on the wire — wrap produce() with the sharding "
+                        "client for at-least-once recovery)"
+                    )
+                    return
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class RemoteBatchLoader:
+    """Trainer side: fan-in iterator over one or more data workers."""
+
+    def __init__(self, addrs: list[str], prefetch: int = 4,
+                 connect_timeout: float = 10.0):
+        self._addrs = list(addrs)
+        self._prefetch = prefetch
+        self._timeout = connect_timeout
+        self._stop = threading.Event()
+        # each __iter__ call is a generation with its own queue; bumping
+        # the generation retires the previous iteration's pullers so an
+        # abandoned epoch can't leak threads or bleed batches into the
+        # next one
+        self._gen = 0
+
+    def _retired(self, gen: int) -> bool:
+        return self._stop.is_set() or gen != self._gen
+
+    def _put(self, q: queue_mod.Queue, gen: int, item) -> bool:
+        """Generation-aware bounded put — a closed loader or a newer
+        iteration must not leave pullers parked on a full queue."""
+        while not self._retired(gen):
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue_mod.Full:
+                continue
+        return False
+
+    def _pull(self, addr: str, q: queue_mod.Queue, gen: int) -> None:
+        host, port = addr.rsplit(":", 1)
+        try:
+            conn = socket.create_connection(
+                (host or "127.0.0.1", int(port)), timeout=self._timeout
+            )
+            conn.settimeout(None)
+        except OSError as e:
+            logger.warning("data worker %s unreachable: %s", addr, e)
+            self._put(q, gen, None)
+            return
+        with conn:
+            while not self._retired(gen):
+                try:
+                    send_frame(conn, json.dumps({"kind": "next"}).encode())
+                    batch = decode_batch(recv_frame(conn))
+                except (ConnectionError, OSError) as e:
+                    logger.warning("data worker %s dropped: %s", addr, e)
+                    break
+                if batch is None or not self._put(q, gen, batch):
+                    break
+        self._put(q, gen, None)
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        """Each iteration reconnects to every worker and streams until
+        all are drained. Workers hand each batch to exactly one
+        connection, so a second epoch sees whatever the produce()
+        iterators still hold (restart the services for a fresh epoch);
+        starting a new iteration retires any still-running previous one.
+        """
+        if self._stop.is_set():
+            raise RuntimeError("RemoteBatchLoader is closed")
+        self._gen += 1
+        gen = self._gen
+        q: queue_mod.Queue = queue_mod.Queue(maxsize=self._prefetch)
+        threads = [
+            threading.Thread(
+                target=self._pull, args=(a, q, gen), daemon=True,
+                name=f"data-pull-g{gen}-{a}",
+            )
+            for a in self._addrs
+        ]
+        for t in threads:
+            t.start()
+        done = 0
+        while done < len(threads):
+            try:
+                item = q.get(timeout=0.2)
+            except queue_mod.Empty:
+                if self._retired(gen):
+                    return
+                continue
+            if item is None:
+                done += 1
+                continue
+            yield item
+
+    def close(self) -> None:
+        self._stop.set()

@@ -65,6 +65,26 @@ class TestBrainService:
         plan = client.optimize("a", "llama-7b", stage="oom")
         assert plan.found and plan.memory_mb == 16000
 
+    def test_create_oom_plan_starts_above_history(self, brain):
+        """create_oom (OptimizeJobWorkerCreateOomResource analog): a
+        signature with OOM kills in its history gets a create-stage plan
+        at 2x the all-time peak, with the fastest successful run's
+        worker count; no OOM history -> found=False (fall back to
+        create)."""
+        _, client = brain
+        client.report(_job("a", workers=4, mem=9000, speed=2.0,
+                           status="oom"))
+        client.report(_job("b", workers=8, mem=8000, speed=6.0))
+        plan = client.optimize("new", "llama-7b", stage="create_oom")
+        assert plan.found
+        assert plan.memory_mb == 2 * 9000
+        assert plan.workers == 8
+        # clean-history signature: not this algorithm's business
+        client.report(_job("c", workers=4, mem=800, speed=1.0,
+                           sig="clean-sig"))
+        assert not client.optimize(
+            "new2", "clean-sig", stage="create_oom").found
+
     def test_running_plan_picks_scaling_knee(self, brain):
         """Worker counts past the throughput knee add cost, not speed:
         the running-stage plan picks the smallest count within 90% of
@@ -127,6 +147,60 @@ class TestBrainService:
 
 
 class TestOptimizerBrainIntegration:
+    def test_initial_plan_applies_oom_history_memory(self, brain):
+        """A signature whose ENTIRE history OOM-killed (no successful
+        run to vote a worker count) must still launch with the 2x-peak
+        memory bump on every planned node — losing the sizing here is
+        exactly the OOM->relaunch loop create_oom exists to break."""
+        _, client = brain
+        client.report(_job("a", workers=4, mem=9000, speed=2.0,
+                           status="oom"))
+        opt = LocalResourceOptimizer(
+            OptimizerConfig(min_workers=1, max_workers=4),
+            LocalStatsReporter(), None,
+            brain=client, signature="llama-7b",
+        )
+        plan = opt.initial_plan()
+        assert plan.replica_resources == {"worker": 4}
+        # seeded up to max_workers so later scale-ups inherit the sizing
+        assert plan.memory_mb == {str(i): 18000 for i in range(4)}
+        # the grant is also the oom-recovery baseline: a later OOM with
+        # low observed usage must RAISE memory from 18000, not shrink it
+        recovery = opt.oom_recovery_plan(node_id=1)
+        assert recovery.memory_mb["1"] >= 2 * 18000
+
+    def test_create_oom_declines_without_usage_numbers(self, brain):
+        """When NO row of the signature recorded usage (all-time peak
+        0), create_oom must decline rather than emit an all-zero plan
+        that would shadow the create stage's worker vote."""
+        _, client = brain
+        client.report(_job("a", workers=4, mem=0, speed=1.0,
+                           status="oom"))
+        client.report(_job("b", workers=8, mem=0, speed=6.0))
+        assert not client.optimize(
+            "new", "llama-7b", stage="create_oom").found
+        opt = LocalResourceOptimizer(
+            OptimizerConfig(min_workers=1, max_workers=8),
+            LocalStatsReporter(), None,
+            brain=client, signature="llama-7b",
+        )
+        plan = opt.initial_plan()
+        # falls through to create: worker vote survives, no memory seed
+        assert plan.replica_resources == {"worker": 8}
+        assert plan.memory_mb == {}
+
+    def test_create_oom_uses_successful_peak_when_oom_unmetered(self,
+                                                                brain):
+        """OOM rows without usage numbers still trigger the stage as
+        long as SOME row metered usage: 2x the all-time peak beats the
+        create stage's 1.5x-median for an OOM-scarred signature."""
+        _, client = brain
+        client.report(_job("a", workers=4, mem=0, speed=1.0,
+                           status="oom"))
+        client.report(_job("b", workers=8, mem=8000, speed=6.0))
+        plan = client.optimize("new", "llama-7b", stage="create_oom")
+        assert plan.found and plan.memory_mb == 16000 and plan.workers == 8
+
     def test_speed_plan_capped_by_brain_knee(self, brain):
         """The local scale-up heuristic defers to the cross-job scaling
         knee: history says 8 workers is where throughput flattens."""

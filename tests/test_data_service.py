@@ -1,0 +1,174 @@
+"""Remote data workers (trainer/data_service.py) — the coworker analog."""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.common.rpc import recv_frame, send_frame
+from dlrover_tpu.trainer.data_service import (
+    DataServiceServer,
+    RemoteBatchLoader,
+    decode_batch,
+    encode_batch,
+)
+
+
+def _batches(n, base=0):
+    def produce():
+        for i in range(n):
+            yield {
+                "tokens": np.full((2, 8), base + i, dtype=np.int32),
+                "weight": np.asarray([base + i], dtype=np.float32),
+            }
+    return produce
+
+
+class TestWireFormat:
+    def test_roundtrip_dtypes_shapes(self):
+        batch = {
+            "a": np.arange(12, dtype=np.int32).reshape(3, 4),
+            "b": np.random.default_rng(0).normal(size=(2, 2)).astype(
+                np.float64),
+            "c": np.asarray(7, dtype=np.uint8),  # 0-d
+        }
+        out = decode_batch(encode_batch(batch))
+        assert set(out) == set(batch)
+        for k in batch:
+            assert out[k].dtype == batch[k].dtype
+            np.testing.assert_array_equal(out[k], batch[k])
+
+    def test_end_marker(self):
+        assert decode_batch(b"E") is None
+
+    def test_zero_size_array_roundtrip(self):
+        batch = {"empty": np.zeros((0, 5), np.float32),
+                 "x": np.arange(3, dtype=np.int64)}
+        out = decode_batch(encode_batch(batch))
+        assert out["empty"].shape == (0, 5)
+        np.testing.assert_array_equal(out["x"], batch["x"])
+
+    def test_bad_tag(self):
+        with pytest.raises(ValueError):
+            decode_batch(b"X123")
+
+
+class TestService:
+    def test_single_worker_all_batches(self):
+        srv = DataServiceServer(_batches(5), host="127.0.0.1").start()
+        try:
+            loader = RemoteBatchLoader([f"127.0.0.1:{srv.port}"])
+            got = sorted(int(b["weight"][0]) for b in loader)
+            assert got == [0, 1, 2, 3, 4]
+        finally:
+            srv.stop()
+
+    def test_two_clients_partition(self):
+        """Each batch goes to exactly one client (sharding semantics)."""
+        srv = DataServiceServer(_batches(20), host="127.0.0.1").start()
+        try:
+            results: list[list[int]] = [[], []]
+
+            def drain(idx):
+                loader = RemoteBatchLoader([f"127.0.0.1:{srv.port}"])
+                results[idx] = [int(b["weight"][0]) for b in loader]
+
+            ts = [threading.Thread(target=drain, args=(i,)) for i in (0, 1)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=30)
+            allv = results[0] + results[1]
+            assert sorted(allv) == list(range(20))  # no dup, no loss
+        finally:
+            srv.stop()
+
+    def test_fan_in_two_workers(self):
+        s1 = DataServiceServer(_batches(3, base=0), host="127.0.0.1").start()
+        s2 = DataServiceServer(_batches(3, base=100),
+                               host="127.0.0.1").start()
+        try:
+            loader = RemoteBatchLoader(
+                [f"127.0.0.1:{s1.port}", f"127.0.0.1:{s2.port}"]
+            )
+            got = sorted(int(b["weight"][0]) for b in loader)
+            assert got == [0, 1, 2, 100, 101, 102]
+        finally:
+            s1.stop()
+            s2.stop()
+
+    def test_unreachable_worker_does_not_hang(self):
+        # grab a port with no listener
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        srv = DataServiceServer(_batches(2), host="127.0.0.1").start()
+        try:
+            loader = RemoteBatchLoader(
+                [f"127.0.0.1:{srv.port}", f"127.0.0.1:{port}"],
+                connect_timeout=2.0,
+            )
+            got = sorted(int(b["weight"][0]) for b in loader)
+            assert got == [0, 1]  # live worker drained, dead one skipped
+        finally:
+            srv.stop()
+
+    @staticmethod
+    def _pullers():
+        return [t for t in threading.enumerate()
+                if t.name.startswith("data-pull")]
+
+    def _wait_no_pullers(self, seconds=5.0):
+        import time
+
+        deadline = time.monotonic() + seconds
+        while self._pullers() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not self._pullers(), self._pullers()
+
+    def test_early_close_unblocks_pullers(self):
+        """Abandoning iteration + close() must not leave puller threads
+        parked on the full prefetch queue forever (incl. the final None
+        sentinel put)."""
+        srv = DataServiceServer(_batches(50), host="127.0.0.1").start()
+        try:
+            loader = RemoteBatchLoader([f"127.0.0.1:{srv.port}"],
+                                       prefetch=1)
+            it = iter(loader)
+            next(it)  # threads running, queue full behind us
+            loader.close()
+            self._wait_no_pullers()
+            with pytest.raises(RuntimeError):
+                next(iter(loader))  # closed loader refuses a new epoch
+        finally:
+            srv.stop()
+
+    def test_reiteration_retires_previous_generation(self):
+        """Breaking out of epoch 1 and starting epoch 2 must retire the
+        old pullers and never replay epoch-1 queue leftovers."""
+        srv = DataServiceServer(_batches(40), host="127.0.0.1").start()
+        try:
+            loader = RemoteBatchLoader([f"127.0.0.1:{srv.port}"],
+                                       prefetch=2)
+            it = iter(loader)
+            seen1 = [int(next(it)["weight"][0]) for _ in range(3)]
+            seen2 = [int(b["weight"][0]) for b in loader]  # epoch 2
+            assert not set(seen1) & set(seen2)  # no replays
+            # nothing lost except what epoch-1 pullers had in flight:
+            # the union is a prefix-free subset of range(40) of size >= 35
+            assert len(seen1) + len(seen2) >= 35
+            self._wait_no_pullers()
+        finally:
+            srv.stop()
+
+    def test_protocol_rejects_unknown_kind(self):
+        srv = DataServiceServer(_batches(2), host="127.0.0.1").start()
+        try:
+            conn = socket.create_connection(("127.0.0.1", srv.port))
+            send_frame(conn, b'{"kind": "bogus"}')
+            assert recv_frame(conn) == b"E"
+            conn.close()
+        finally:
+            srv.stop()

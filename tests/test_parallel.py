@@ -425,6 +425,28 @@ class TestRematPolicies:
             losses.append(float(jax.device_get(m["loss"])))
         assert losses[0] == pytest.approx(losses[1], rel=2e-4), losses
 
+    def test_offload_policy_grads(self):
+        """offload_attn_ffn (activations to pinned host memory — the
+        SelectiveOffloadingCheckpoint analog) must produce finite grads
+        and the same loss as the non-offloaded policy."""
+        import dataclasses
+
+        tokens = {"tokens": jnp.asarray(np.random.RandomState(1).randint(
+            0, 512, (2, 65)), jnp.int32)}
+        losses = []
+        for policy in ("save_attn_ffn", "offload_attn_ffn"):
+            cfg = dataclasses.replace(
+                T.CONFIGS["tiny"], remat_scan=True, remat_policy=policy)
+            params = T.init_params(cfg, jax.random.PRNGKey(0))
+            loss, g = jax.jit(jax.value_and_grad(
+                lambda p: T.loss_fn(p, tokens, cfg=cfg)))(params)
+            assert all(
+                bool(jnp.all(jnp.isfinite(x.astype(jnp.float32))))
+                for x in jax.tree_util.tree_leaves(g)
+            )
+            losses.append(float(loss))
+        assert losses[0] == pytest.approx(losses[1], rel=1e-5), losses
+
     def test_remat_interval_grad_parity(self):
         """Interleaved remat (remat_interval=2: only every other layer
         rematted, halving backward recompute) must produce the same
