@@ -661,6 +661,56 @@ def bench_serving(extra: dict) -> None:
     extra["serving_config"] = "gpt2-small slots=8 prompt=64 gen=128"
 
 
+def bench_int8(extra: dict) -> None:
+    """int8 MXU path vs bf16 on a 7B-geometry slice (d_model=4096,
+    2 layers — the full model doesn't fit one chip for training): the
+    grad step that the quantized VJP accelerates end to end."""
+    import dataclasses as dc
+
+    import jax
+
+    from dlrover_tpu.models import transformer as tfm
+
+    if jax.devices()[0].platform != "tpu":
+        return
+
+    def time_grad(use_int8: bool) -> float:
+        cfg = dc.replace(
+            tfm.CONFIGS["llama2-7b"], n_layers=2, max_seq_len=1024,
+            remat_scan=True, remat_policy="dots_no_batch",
+            attention="splash", int8_matmuls=use_int8,
+        )
+        params = jax.jit(lambda r: tfm.init_params(cfg, r))(
+            jax.random.PRNGKey(0)
+        )
+        tokens = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (8, 1025), dtype=np.int32
+        )
+        batch = {"tokens": jax.device_put(tokens)}
+        f = jax.jit(jax.grad(partial(tfm.loss_fn, cfg=cfg)))
+        out = f(params, batch)
+        jax.device_get(jax.tree_util.tree_leaves(out)[0])
+        for _ in range(2):
+            out = f(params, batch)
+        jax.device_get(jax.tree_util.tree_leaves(out)[0])
+        t0 = time.monotonic()
+        n = 10
+        for _ in range(n):
+            out = f(params, batch)
+        jax.device_get(jax.tree_util.tree_leaves(out)[0])
+        return (time.monotonic() - t0) / n
+
+    bf16_s = time_grad(False)
+    int8_s = time_grad(True)
+    extra.update(
+        int8_grad_step_bf16_s=round(bf16_s, 4),
+        int8_grad_step_s=round(int8_s, 4),
+        int8_grad_speedup=round(bf16_s / int8_s, 2),
+        int8_note=("llama2-7b geometry, 2 layers, b8 s1024; quantized "
+                   "matmuls with int8 backward (ops/quantization.py)"),
+    )
+
+
 def bench_checkpoint_1b(extra: dict) -> None:
     """GPT-2-1.5B-class (~1B-param, 12 GB fp32 state) checkpoint config
     (BASELINE configs 2-3; reference flash_checkpoint.md:317). Skipped
@@ -740,6 +790,10 @@ def main() -> None:
         bench_long_context(extra)
     except Exception as e:  # noqa: BLE001
         errors.append(f"long_context: {type(e).__name__}: {e}")
+    try:
+        bench_int8(extra)
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"int8: {type(e).__name__}: {e}")
     try:
         bench_serving(extra)
     except Exception as e:  # noqa: BLE001

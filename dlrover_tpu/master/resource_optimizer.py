@@ -124,15 +124,15 @@ class LocalResourceOptimizer:
                 # create-stage sizing is job-wide: seed the per-node
                 # override (the scaler's OOM-bump channel) for every id
                 # up to max_workers — nodes added later by speed_plan
-                # must launch with the same sizing — and record it as
-                # the oom_recovery baseline so a later OOM can only
-                # raise it, never shrink it
-                memory = {str(i): brain.memory_mb
-                          for i in range(self._config.max_workers)}
+                # must launch with the same sizing. Record through
+                # self._memory_mb so the grant is also the oom_recovery
+                # baseline and never downgrades a node a previous OOM
+                # already bumped higher.
                 for i in range(self._config.max_workers):
                     self._memory_mb[i] = max(
                         self._memory_mb.get(i, 0), brain.memory_mb
                     )
+                    memory[str(i)] = self._memory_mb[i]
             reason = f"brain history ({brain.based_on_jobs} jobs)"
             logger.info(
                 "brain initial plan: %d workers, %sMB (from %d jobs)",

@@ -148,7 +148,16 @@ class DataServiceServer:
                 if req.get("kind") != "next":
                     send_frame(conn, _TAG_END)
                     return
-                batch = self._next_batch()
+                try:
+                    batch = self._next_batch()
+                except Exception:
+                    # a broken produce() iterator must not masquerade as
+                    # clean end-of-data: log loudly and drop the
+                    # connection mid-protocol so clients see a worker
+                    # FAILURE (logged + sentinel), not a short epoch
+                    logger.exception("produce() raised; failing worker")
+                    self._stop.set()
+                    return
                 try:
                     if batch is None:
                         send_frame(conn, _TAG_END)
@@ -200,13 +209,15 @@ class RemoteBatchLoader:
         return False
 
     def _pull(self, addr: str, q: queue_mod.Queue, gen: int) -> None:
-        host, port = addr.rsplit(":", 1)
         try:
+            host, port = addr.rsplit(":", 1)
             conn = socket.create_connection(
                 (host or "127.0.0.1", int(port)), timeout=self._timeout
             )
             conn.settimeout(None)
-        except OSError as e:
+        except (OSError, ValueError) as e:
+            # malformed address included: the sentinel must go out or
+            # __iter__ waits for this puller forever
             logger.warning("data worker %s unreachable: %s", addr, e)
             self._put(q, gen, None)
             return

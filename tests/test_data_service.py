@@ -163,6 +163,33 @@ class TestService:
         finally:
             srv.stop()
 
+    def test_malformed_address_does_not_hang(self):
+        srv = DataServiceServer(_batches(2), host="127.0.0.1").start()
+        try:
+            loader = RemoteBatchLoader(
+                [f"127.0.0.1:{srv.port}", "localhost", "10.0.0.5:abc"],
+                connect_timeout=2.0,
+            )
+            got = sorted(int(b["weight"][0]) for b in loader)
+            assert got == [0, 1]
+        finally:
+            srv.stop()
+
+    def test_broken_producer_fails_loudly_not_short_epoch(self):
+        """A produce() iterator that raises mid-stream must read as a
+        worker failure (connection drop), not as clean end-of-data."""
+        def produce():
+            yield {"weight": np.asarray([0], np.float32)}
+            raise RuntimeError("corrupt shard")
+
+        srv = DataServiceServer(produce, host="127.0.0.1").start()
+        try:
+            loader = RemoteBatchLoader([f"127.0.0.1:{srv.port}"])
+            got = [int(b["weight"][0]) for b in loader]  # must terminate
+            assert got == [0]
+        finally:
+            srv.stop()
+
     def test_protocol_rejects_unknown_kind(self):
         srv = DataServiceServer(_batches(2), host="127.0.0.1").start()
         try:
