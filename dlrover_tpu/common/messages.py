@@ -486,3 +486,48 @@ class JobExitRequest:
     node_id: int = 0
     success: bool = True
     reason: str = ""
+
+
+@register_message
+@dataclasses.dataclass
+class StrategyProposeRequest:
+    """Ask the strategy engine for a parallel strategy for a model/mesh.
+
+    Reference analog: atorch's acceleration-engine RPC (the strategy
+    search service in atorch/auto/engine/servicer.py + engine_client) —
+    here the search is the AOT dry-run + roofline ranking of
+    parallel/auto.py run server-side on a virtual mesh.
+    """
+
+    model: str = "tiny"          # models/transformer.py CONFIGS key
+    n_devices: int = 8
+    batch: int = 8               # per-step global batch
+    seq: int = 128
+    objective: str = "fastest"   # "fastest" | "first_fit"
+    hbm_gb: float = 0.0          # 0 = the engine host's default
+
+
+@register_message
+@dataclasses.dataclass
+class StrategyProposal:
+    found: bool = False
+    strategy_json: str = ""      # Strategy.to_json of the winner
+    source: str = ""             # "measured" | "dry_run"
+    report: dict = dataclasses.field(default_factory=dict)
+    error: str = ""
+
+
+@register_message
+@dataclasses.dataclass
+class StrategyMeasurement:
+    """Trainer-reported measured step time for a strategy — measured
+    history outranks the roofline estimate for later proposals at the
+    SAME (model, devices, batch, seq) shape; other shapes re-run the
+    dry-run fit check."""
+
+    model: str = ""
+    n_devices: int = 0
+    batch: int = 0
+    seq: int = 0
+    strategy_json: str = ""
+    step_time_s: float = 0.0

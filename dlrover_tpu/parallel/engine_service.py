@@ -1,0 +1,225 @@
+"""Strategy engine as a service: centralized parallel-strategy search.
+
+Reference analog: atorch's AccelerationEngine gRPC service
+(atorch/atorch/auto/engine/acceleration_engine.py:13 + servicer/client
+over protos/acceleration.proto) — strategy search runs as a service so
+expensive tuning is shared across jobs and the trainer only applies the
+result. TPU-first shape: the "search" is parallel/auto.py's AOT dry-run
++ roofline ranking, which needs no chips — the service compiles against
+a VIRTUAL mesh of the requested size. Because the forced host device
+count must be set before the JAX backend initializes, each proposal
+runs in a short-lived subprocess (the same trick as bench.py's 7B AOT
+report); results are cached per (model, n_devices, batch, seq).
+
+Measured history outranks the model: trainers report real step times
+via :class:`~dlrover_tpu.common.messages.StrategyMeasurement`, and the
+fastest measured strategy for a key wins later proposals outright —
+the engine learns what the roofline can only estimate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+from typing import Any
+
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.rpc import RpcClient, RpcServer
+
+logger = get_logger(__name__)
+
+_PROPOSE_TIMEOUT_S = 600.0
+
+
+def _search_subprocess(req: m.StrategyProposeRequest) -> dict:
+    """Run auto_strategy on a virtual CPU mesh in a child process."""
+    payload = {
+        "model": req.model,
+        "n_devices": req.n_devices,
+        "batch": req.batch,
+        "seq": req.seq,
+        "objective": req.objective,
+        "hbm_gb": req.hbm_gb,
+    }
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={req.n_devices}"
+    ).strip()
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in [os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))),
+            env.get("PYTHONPATH", "")] if p
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "dlrover_tpu.parallel.engine_service",
+         json.dumps(payload)],
+        capture_output=True, text=True, timeout=_PROPOSE_TIMEOUT_S,
+        env=env,
+    )
+    line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+    try:
+        return json.loads(line)
+    except json.JSONDecodeError:
+        return {"error": (proc.stderr or line)[-800:]}
+
+
+class StrategyEngineService:
+    """RPC service: propose strategies, absorb measurements."""
+
+    def __init__(self, port: int = 0):
+        self._server = RpcServer(self.handle, port=port)
+        self._lock = threading.Lock()
+        self._cache: dict[tuple, m.StrategyProposal] = {}
+        # key -> (step_time_s, strategy_json)
+        self._measured: dict[tuple, tuple[float, str]] = {}
+        # per-key in-flight search locks: N jobs asking at once must
+        # run ONE subprocess, not N (the point of a shared engine)
+        self._inflight: dict[tuple, threading.Lock] = {}
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self._server.port}"
+
+    def start(self) -> "StrategyEngineService":
+        self._server.start()
+        logger.info("strategy engine serving on %s", self.addr)
+        return self
+
+    def stop(self) -> None:
+        self._server.stop()
+
+    def handle(self, msg: Any) -> Any:
+        if isinstance(msg, m.StrategyMeasurement):
+            key = (msg.model, msg.n_devices, msg.batch, msg.seq)
+            with self._lock:
+                best = self._measured.get(key)
+                if best is None or msg.step_time_s < best[0]:
+                    self._measured[key] = (msg.step_time_s,
+                                           msg.strategy_json)
+                    logger.info(
+                        "measured best for %s: %.4fs", key, msg.step_time_s
+                    )
+            return m.OkResponse()
+        if isinstance(msg, m.StrategyProposeRequest):
+            return self.propose(msg)
+        raise TypeError(f"unhandled message type {type(msg).__name__}")
+
+    def propose(self, req: m.StrategyProposeRequest) -> m.StrategyProposal:
+        # measured history only applies at the exact shape — at any
+        # other batch/seq the strategy hasn't passed a fit check
+        measured_key = (req.model, req.n_devices, req.batch, req.seq)
+        with self._lock:
+            measured = self._measured.get(measured_key)
+        if measured is not None:
+            return m.StrategyProposal(
+                found=True, strategy_json=measured[1], source="measured",
+                report={"measured_step_time_s": measured[0]},
+            )
+        cache_key = (req.model, req.n_devices, req.batch, req.seq,
+                     req.objective, req.hbm_gb)
+        with self._lock:
+            cached = self._cache.get(cache_key)
+            if cached is not None:
+                return cached
+            gate = self._inflight.setdefault(cache_key, threading.Lock())
+        with gate:  # followers wait here while the first search runs
+            with self._lock:
+                cached = self._cache.get(cache_key)
+            if cached is not None:
+                return cached
+            result = _search_subprocess(req)
+            if "error" in result:
+                return m.StrategyProposal(
+                    found=False, error=result["error"]
+                )
+            proposal = m.StrategyProposal(
+                found=True,
+                strategy_json=result["strategy_json"],
+                source="dry_run",
+                report=result.get("report", {}),
+            )
+            with self._lock:
+                self._cache[cache_key] = proposal
+            return proposal
+
+
+class StrategyEngineClient:
+    """Trainer/master side of the engine."""
+
+    def __init__(self, addr: str, timeout: float = _PROPOSE_TIMEOUT_S):
+        self._rpc = RpcClient(addr, timeout=timeout)
+
+    def propose(self, model: str, n_devices: int, *, batch: int = 8,
+                seq: int = 128, objective: str = "fastest",
+                hbm_gb: float = 0.0) -> m.StrategyProposal:
+        return self._rpc.call(m.StrategyProposeRequest(
+            model=model, n_devices=n_devices, batch=batch, seq=seq,
+            objective=objective, hbm_gb=hbm_gb,
+        ))
+
+    def report_measurement(self, model: str, n_devices: int,
+                           strategy, step_time_s: float, *,
+                           batch: int = 8, seq: int = 128) -> None:
+        sj = strategy if isinstance(strategy, str) else strategy.to_json()
+        self._rpc.call(m.StrategyMeasurement(
+            model=model, n_devices=n_devices, batch=batch, seq=seq,
+            strategy_json=sj, step_time_s=step_time_s,
+        ))
+
+    def close(self) -> None:
+        self._rpc.close()
+
+
+def _main() -> None:
+    """Subprocess entry: run the search on the virtual mesh and print
+    one JSON line (stdout contract with :func:`_search_subprocess`)."""
+    from functools import partial
+
+    import jax
+    import numpy as np
+    import optax
+
+    from dlrover_tpu.models import transformer as tfm
+    from dlrover_tpu.parallel.auto import auto_strategy
+
+    spec = json.loads(sys.argv[1])
+    cfg = tfm.CONFIGS[spec["model"]]
+    seq = min(cfg.max_seq_len, int(spec["seq"]))
+    batch = int(spec["batch"])
+    tokens = np.zeros((1, batch, seq + 1), dtype=np.int32)
+    hbm = (int(spec["hbm_gb"] * 2**30)
+           if spec.get("hbm_gb") else None)
+    strategy, reports = auto_strategy(
+        loss_fn_for=lambda s, mesh: tfm.make_loss_fn(cfg, s, mesh),
+        init_params_fn=partial(tfm.init_params, cfg),
+        logical_params=tfm.logical_axes(cfg),
+        optimizer=optax.adamw(1e-3),
+        example_batch={"tokens": tokens},
+        devices=jax.devices()[:spec["n_devices"]],
+        objective=spec.get("objective", "fastest"),
+        hbm_capacity_bytes=hbm,
+    )
+    import dataclasses as dc
+
+    report = {}
+    for r in reports:  # DryRunReport dataclasses
+        if getattr(r, "strategy_name", None) == strategy.name:
+            report = {
+                k: v for k, v in dc.asdict(r).items()
+                if isinstance(v, (int, float, str, bool))
+            }
+            break
+    print(json.dumps({
+        "strategy_json": strategy.to_json(),
+        "report": report,
+    }))
+
+
+if __name__ == "__main__":
+    _main()

@@ -101,6 +101,7 @@ class DataServiceServer:
         self._iter: Iterator[dict] | None = None
         self._iter_lock = threading.Lock()
         self._stop = threading.Event()
+        self._failed = False
         self._threads: list[threading.Thread] = []
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True
@@ -156,7 +157,13 @@ class DataServiceServer:
                     # connection mid-protocol so clients see a worker
                     # FAILURE (logged + sentinel), not a short epoch
                     logger.exception("produce() raised; failing worker")
+                    self._failed = True
                     self._stop.set()
+                    return
+                if self._failed:
+                    # the generator died on another connection: this one
+                    # would see StopIteration->None and read as a clean
+                    # end — drop it mid-protocol instead
                     return
                 try:
                     if batch is None:
@@ -209,29 +216,38 @@ class RemoteBatchLoader:
         return False
 
     def _pull(self, addr: str, q: queue_mod.Queue, gen: int) -> None:
+        # the finally-sentinel is load-bearing: __iter__ counts one
+        # sentinel per puller, so EVERY exit path must emit it or the
+        # training loop waits forever
         try:
-            host, port = addr.rsplit(":", 1)
-            conn = socket.create_connection(
-                (host or "127.0.0.1", int(port)), timeout=self._timeout
-            )
-            conn.settimeout(None)
-        except (OSError, ValueError) as e:
-            # malformed address included: the sentinel must go out or
-            # __iter__ waits for this puller forever
-            logger.warning("data worker %s unreachable: %s", addr, e)
+            try:
+                host, port = addr.rsplit(":", 1)
+                conn = socket.create_connection(
+                    (host or "127.0.0.1", int(port)),
+                    timeout=self._timeout,
+                )
+                conn.settimeout(None)
+            except (OSError, ValueError) as e:
+                logger.warning("data worker %s unreachable: %s", addr, e)
+                return
+            with conn:
+                while not self._retired(gen):
+                    try:
+                        send_frame(
+                            conn, json.dumps({"kind": "next"}).encode()
+                        )
+                        batch = decode_batch(recv_frame(conn))
+                    except (ConnectionError, OSError, ValueError) as e:
+                        # ValueError: version-skewed peer sent a frame
+                        # that isn't the batch protocol
+                        logger.warning(
+                            "data worker %s dropped: %s", addr, e
+                        )
+                        break
+                    if batch is None or not self._put(q, gen, batch):
+                        break
+        finally:
             self._put(q, gen, None)
-            return
-        with conn:
-            while not self._retired(gen):
-                try:
-                    send_frame(conn, json.dumps({"kind": "next"}).encode())
-                    batch = decode_batch(recv_frame(conn))
-                except (ConnectionError, OSError) as e:
-                    logger.warning("data worker %s dropped: %s", addr, e)
-                    break
-                if batch is None or not self._put(q, gen, batch):
-                    break
-        self._put(q, gen, None)
 
     def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
         """Each iteration reconnects to every worker and streams until

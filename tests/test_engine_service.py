@@ -1,0 +1,58 @@
+"""Strategy engine service (parallel/engine_service.py) — the
+acceleration-engine-as-a-service analog."""
+
+import json
+
+import pytest
+
+from dlrover_tpu.parallel.engine_service import (
+    StrategyEngineClient,
+    StrategyEngineService,
+)
+from dlrover_tpu.parallel.strategy import Strategy, fsdp
+
+
+@pytest.fixture
+def engine():
+    service = StrategyEngineService().start()
+    client = StrategyEngineClient(service.addr)
+    yield service, client
+    client.close()
+    service.stop()
+
+
+@pytest.mark.timeout(570)
+class TestEngineService:
+    def test_propose_runs_search_and_caches(self, engine):
+        service, client = engine
+        prop = client.propose("tiny", 8, batch=8, seq=64)
+        assert prop.found, prop.error
+        assert prop.source == "dry_run"
+        strat = Strategy.from_json(prop.strategy_json)
+        assert strat.name
+        assert prop.report.get("strategy_name") == strat.name
+        # second call is served from cache (no subprocess): identical
+        prop2 = client.propose("tiny", 8, batch=8, seq=64)
+        assert prop2.strategy_json == prop.strategy_json
+
+    def test_measured_history_outranks_dry_run(self, engine):
+        service, client = engine
+        fast = fsdp(fsdp_size=8)
+        client.report_measurement("tiny", 8, fast, step_time_s=0.01)
+        client.report_measurement("tiny", 8, fsdp(fsdp_size=4),
+                                  step_time_s=0.5)  # slower: ignored
+        prop = client.propose("tiny", 8)
+        assert prop.found and prop.source == "measured"
+        got = Strategy.from_json(prop.strategy_json)
+        assert got.mesh_axes == fast.mesh_axes
+        assert prop.report["measured_step_time_s"] == pytest.approx(0.01)
+        # measurements are shape-scoped: another seq must NOT reuse the
+        # measured pick (it never passed a fit check at that shape)
+        other = client.propose("tiny", 8, batch=4, seq=64)
+        assert other.found and other.source == "dry_run"
+
+    def test_unknown_model_reports_error(self, engine):
+        _, client = engine
+        prop = client.propose("no-such-model", 8)
+        assert not prop.found
+        assert prop.error
