@@ -529,5 +529,9 @@ class StrategyMeasurement:
     n_devices: int = 0
     batch: int = 0
     seq: int = 0
+    # HBM budget the measurement ran under (0 = host default) — part of
+    # the shape key: a strategy fast on 16 GB hosts never proves it
+    # FITS on 8 GB ones
+    hbm_gb: float = 0.0
     strategy_json: str = ""
     step_time_s: float = 0.0

@@ -32,7 +32,11 @@ from dlrover_tpu.common.rpc import RpcClient, RpcServer
 
 logger = get_logger(__name__)
 
-_PROPOSE_TIMEOUT_S = 600.0
+_PROPOSE_TIMEOUT_S = 540.0
+# client RPC timeout must exceed the subprocess budget or the client
+# gives up (and retries, queuing behind the in-flight gate) while the
+# search is still legitimately running
+_CLIENT_TIMEOUT_S = 600.0
 
 
 def _search_subprocess(req: m.StrategyProposeRequest) -> dict:
@@ -56,12 +60,15 @@ def _search_subprocess(req: m.StrategyProposeRequest) -> dict:
             os.path.dirname(os.path.abspath(__file__)))),
             env.get("PYTHONPATH", "")] if p
     )
-    proc = subprocess.run(
-        [sys.executable, "-m", "dlrover_tpu.parallel.engine_service",
-         json.dumps(payload)],
-        capture_output=True, text=True, timeout=_PROPOSE_TIMEOUT_S,
-        env=env,
-    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "dlrover_tpu.parallel.engine_service",
+             json.dumps(payload)],
+            capture_output=True, text=True, timeout=_PROPOSE_TIMEOUT_S,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"search exceeded {_PROPOSE_TIMEOUT_S}s"}
     line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
     try:
         return json.loads(line)
@@ -96,7 +103,8 @@ class StrategyEngineService:
 
     def handle(self, msg: Any) -> Any:
         if isinstance(msg, m.StrategyMeasurement):
-            key = (msg.model, msg.n_devices, msg.batch, msg.seq)
+            key = (msg.model, msg.n_devices, msg.batch, msg.seq,
+                   msg.hbm_gb)
             with self._lock:
                 best = self._measured.get(key)
                 if best is None or msg.step_time_s < best[0]:
@@ -113,7 +121,8 @@ class StrategyEngineService:
     def propose(self, req: m.StrategyProposeRequest) -> m.StrategyProposal:
         # measured history only applies at the exact shape — at any
         # other batch/seq the strategy hasn't passed a fit check
-        measured_key = (req.model, req.n_devices, req.batch, req.seq)
+        measured_key = (req.model, req.n_devices, req.batch, req.seq,
+                        req.hbm_gb)
         with self._lock:
             measured = self._measured.get(measured_key)
         if measured is not None:
@@ -135,15 +144,18 @@ class StrategyEngineService:
                 return cached
             result = _search_subprocess(req)
             if "error" in result:
-                return m.StrategyProposal(
+                proposal = m.StrategyProposal(
                     found=False, error=result["error"]
                 )
-            proposal = m.StrategyProposal(
-                found=True,
-                strategy_json=result["strategy_json"],
-                source="dry_run",
-                report=result.get("report", {}),
-            )
+            else:
+                proposal = m.StrategyProposal(
+                    found=True,
+                    strategy_json=result["strategy_json"],
+                    source="dry_run",
+                    report=result.get("report", {}),
+                )
+            # negative results cache too: a broken model spec must not
+            # cost a fresh full-JAX-import subprocess per retry
             with self._lock:
                 self._cache[cache_key] = proposal
             return proposal
@@ -152,7 +164,7 @@ class StrategyEngineService:
 class StrategyEngineClient:
     """Trainer/master side of the engine."""
 
-    def __init__(self, addr: str, timeout: float = _PROPOSE_TIMEOUT_S):
+    def __init__(self, addr: str, timeout: float = _CLIENT_TIMEOUT_S):
         self._rpc = RpcClient(addr, timeout=timeout)
 
     def propose(self, model: str, n_devices: int, *, batch: int = 8,
@@ -165,11 +177,12 @@ class StrategyEngineClient:
 
     def report_measurement(self, model: str, n_devices: int,
                            strategy, step_time_s: float, *,
-                           batch: int = 8, seq: int = 128) -> None:
+                           batch: int = 8, seq: int = 128,
+                           hbm_gb: float = 0.0) -> None:
         sj = strategy if isinstance(strategy, str) else strategy.to_json()
         self._rpc.call(m.StrategyMeasurement(
             model=model, n_devices=n_devices, batch=batch, seq=seq,
-            strategy_json=sj, step_time_s=step_time_s,
+            hbm_gb=hbm_gb, strategy_json=sj, step_time_s=step_time_s,
         ))
 
     def close(self) -> None:

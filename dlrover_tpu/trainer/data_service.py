@@ -120,7 +120,14 @@ class DataServiceServer:
     def _next_batch(self) -> dict | None:
         with self._iter_lock:
             assert self._iter is not None
-            return next(self._iter, None)
+            try:
+                return next(self._iter, None)
+            except Exception:
+                # flag the failure while STILL holding the lock: a
+                # concurrent connection must never observe the dead
+                # generator's StopIteration before seeing _failed
+                self._failed = True
+                raise
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
