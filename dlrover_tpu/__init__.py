@@ -51,6 +51,17 @@ _LAZY_API = {
                            "PackedTokenDataset"),
     "check_strategies": ("dlrover_tpu.utils.numeric_check",
                          "check_strategies"),
+    # late round-3 surfaces
+    "int8_matmul": ("dlrover_tpu.ops.quantization", "int8_matmul"),
+    "DataServiceServer": ("dlrover_tpu.trainer.data_service",
+                          "DataServiceServer"),
+    "RemoteBatchLoader": ("dlrover_tpu.trainer.data_service",
+                          "RemoteBatchLoader"),
+    "StrategyEngineService": ("dlrover_tpu.parallel.engine_service",
+                              "StrategyEngineService"),
+    "StrategyEngineClient": ("dlrover_tpu.parallel.engine_service",
+                             "StrategyEngineClient"),
+    "flops_breakdown": ("dlrover_tpu.utils.profiler", "flops_breakdown"),
 }
 
 

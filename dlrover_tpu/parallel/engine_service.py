@@ -68,7 +68,10 @@ def _search_subprocess(req: m.StrategyProposeRequest) -> dict:
             env=env,
         )
     except subprocess.TimeoutExpired:
-        return {"error": f"search exceeded {_PROPOSE_TIMEOUT_S}s"}
+        # transient (host load, cold compile cache) — must not poison
+        # the negative cache
+        return {"error": f"search exceeded {_PROPOSE_TIMEOUT_S}s",
+                "transient": True}
     line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
     try:
         return json.loads(line)
@@ -154,10 +157,13 @@ class StrategyEngineService:
                     source="dry_run",
                     report=result.get("report", {}),
                 )
-            # negative results cache too: a broken model spec must not
-            # cost a fresh full-JAX-import subprocess per retry
-            with self._lock:
-                self._cache[cache_key] = proposal
+            # deterministic negatives cache too (a broken model spec
+            # must not re-spawn subprocesses per retry); transient
+            # failures like timeouts stay uncached so a later propose
+            # retries on a quieter host
+            if not result.get("transient"):
+                with self._lock:
+                    self._cache[cache_key] = proposal
             return proposal
 
 

@@ -289,7 +289,7 @@ def flops_breakdown(fn: Callable, *args, **kwargs) -> dict[str, float]:
     """
     import jax
 
-    jaxpr = jax.make_jaxpr(fn, **kwargs)(*args)
+    jaxpr = jax.make_jaxpr(lambda *a: fn(*a, **kwargs))(*args)
     acc: dict[str, float] = {}
     _jaxpr_flops(jaxpr.jaxpr, acc)
     acc["total"] = sum(

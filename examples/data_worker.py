@@ -1,0 +1,79 @@
+"""Standalone data worker: serve ready batches to remote trainers.
+
+The coworker-pod entrypoint (reference: atorch CPU coworker pods feeding
+GPU trainers via the data service): run one of these per CPU host —
+trainers consume with ``RemoteBatchLoader([host:port, ...])`` (or pass
+the addresses to your training script). Batches come from a packed
+binary token file (trainer/token_dataset.py format) or are synthetic.
+
+    python examples/data_worker.py --port 9300 --data-file corpus.bin \
+        --batch 8 --seq 1024
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+from dlrover_tpu.trainer.data_service import DataServiceServer
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=9300)
+    p.add_argument("--data-file", default="",
+                   help="packed token file; empty -> synthetic")
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--vocab", type=int, default=512)
+    p.add_argument("--count", type=int, default=0,
+                   help="stop after N batches (0 = until data runs out; "
+                        "synthetic data never does)")
+    # a fleet of workers must serve a PARTITION, not copies: give each
+    # worker its shard index, and all the same shard count + seed
+    p.add_argument("--num-shards", type=int, default=1)
+    p.add_argument("--shard-index", type=int, default=0)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+    if not 0 <= args.shard_index < args.num_shards:
+        raise SystemExit("--shard-index must be in [0, --num-shards)")
+
+    def produce():
+        if args.data_file:
+            from dlrover_tpu.trainer.token_dataset import PackedTokenDataset
+
+            packed = PackedTokenDataset(args.data_file, seq=args.seq)
+            # one shared permutation (same seed fleet-wide), strided by
+            # shard: disjoint per worker, jointly covering the epoch
+            order = np.random.default_rng(args.seed).permutation(
+                len(packed))[args.shard_index::args.num_shards]
+            n = 0
+            for start in range(0, len(order) - args.batch + 1, args.batch):
+                idx = order[start:start + args.batch]
+                yield {"tokens": np.stack(
+                    [packed[int(i)]["tokens"] for i in idx])}
+                n += 1
+                if args.count and n >= args.count:
+                    return
+        else:
+            g = np.random.default_rng(args.seed + args.shard_index)
+            n = 0
+            while not args.count or n < args.count:
+                yield {"tokens": g.integers(
+                    0, args.vocab, (args.batch, args.seq + 1),
+                    dtype=np.int32)}
+                n += 1
+
+    srv = DataServiceServer(produce, host=args.host, port=args.port)
+    srv.start()
+    print(f"data worker serving on {args.host}:{srv.port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    main()

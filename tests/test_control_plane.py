@@ -414,6 +414,10 @@ class TestHangRecovery:
         assert callable(dlrover_tpu.compile_train)
         assert callable(dlrover_tpu.ElasticTrainer)
         assert callable(dlrover_tpu.CheckpointEngine)
+        assert callable(dlrover_tpu.int8_matmul)
+        assert callable(dlrover_tpu.DataServiceServer)
+        assert callable(dlrover_tpu.StrategyEngineClient)
+        assert callable(dlrover_tpu.flops_breakdown)
         assert dlrover_tpu.PRESETS["fsdp"]().name == "fsdp"
         with pytest.raises(AttributeError):
             dlrover_tpu.no_such_thing  # noqa: B018

@@ -56,3 +56,46 @@ class TestEngineService:
         prop = client.propose("no-such-model", 8)
         assert not prop.found
         assert prop.error
+        # negative result is cached: the retry must not pay a second
+        # subprocess (observable as a fast response)
+        import time
+
+        t0 = time.monotonic()
+        prop2 = client.propose("no-such-model", 8)
+        assert not prop2.found
+        assert time.monotonic() - t0 < 1.0
+
+    def test_concurrent_proposals_run_one_search(self, engine, monkeypatch):
+        """The in-flight gate: N jobs asking for the same key at once
+        must trigger ONE subprocess search, with followers served the
+        cached result."""
+        import threading
+        import time
+
+        from dlrover_tpu.parallel import engine_service as es
+
+        service, client = engine
+        calls = []
+
+        def fake_search(req):
+            calls.append(req.model)
+            time.sleep(0.5)
+            return {"strategy_json": '{"name": "dp", "mesh_axes": '
+                                     '{"data": -1}, "rules": []}',
+                    "report": {}}
+
+        monkeypatch.setattr(es, "_search_subprocess", fake_search)
+        results = []
+
+        def ask():
+            c = StrategyEngineClient(service.addr)
+            results.append(c.propose("tiny", 4, batch=2, seq=32))
+            c.close()
+
+        ts = [threading.Thread(target=ask) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert len(calls) == 1, calls
+        assert all(r.found and r.source == "dry_run" for r in results)
