@@ -671,8 +671,8 @@ def bench_int8(extra: dict) -> None:
     CE/embedding path (int8 doesn't touch it, and its layouts proved
     unstable across compiles — the same config measured 1.9x and 0.82x
     on different runs). The FFN stack is what int8 claims to speed up
-    and reproduces within ~5% run to run (bf16 baseline itself runs at
-    ~0.89 utilization here, so the ratio is measured against a healthy
+    and reproduces within ~5% run to run (the bf16 baseline itself runs at ~0.84
+    utilization here, so the ratio is measured against a healthy
     denominator). Sync is a full-reduction scalar: fetching any real
     grad leaf would ship ~90MB over the tunnel, and a sliced
     fingerprint lets XLA dead-code-eliminate the backward entirely

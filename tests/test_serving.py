@@ -116,6 +116,21 @@ def test_block_decode_matches_per_token(params):
     assert len(out[1][0]) == 12 and len(out[1][1]) == 7
 
 
+def _shard_params(preset_name, params, cfg, **preset_kwargs):
+    """Place params per a strategy preset's specs on the CPU mesh."""
+    from jax.sharding import NamedSharding
+    from dlrover_tpu.parallel.strategy import PRESETS
+
+    strategy = PRESETS[preset_name](**preset_kwargs)
+    mesh = strategy.build_mesh()
+    specs = strategy.specs(tfm.logical_axes(cfg), mesh)
+    return jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+        params, specs,
+        is_leaf=lambda x: hasattr(x, "shape") and not isinstance(x, tuple),
+    )
+
+
 @pytest.mark.timeout(300)
 def test_serves_sharded_params_identically(params):
     """Multi-chip serving: FSDP-sharded params on the 8-device mesh
@@ -123,23 +138,12 @@ def test_serves_sharded_params_identically(params):
     inserts the gathers; the engine code is sharding-agnostic)."""
     import dataclasses
 
-    from jax.sharding import NamedSharding
-    from dlrover_tpu.parallel.strategy import PRESETS
-
     # f32 compute for the comparison: at bf16, resharding reorders
     # reductions enough (~0.3 logit drift over 2 layers) that numeric
     # equality claims are meaningless — the property under test is the
     # engine's sharding-agnosticism, not bf16 determinism
     cfg32 = dataclasses.replace(CFG, dtype="float32")
-    strategy = PRESETS["fsdp"]()
-    mesh = strategy.build_mesh()
-    specs = strategy.specs(tfm.logical_axes(cfg32), mesh)
-    sharded_params = jax.tree.map(
-        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
-        params, specs,
-        is_leaf=lambda x: hasattr(x, "shape") and not isinstance(
-            x, tuple),
-    )
+    sharded_params = _shard_params("fsdp", params, cfg32)
 
     outs = {}
     logits = {}
@@ -156,6 +160,27 @@ def test_serves_sharded_params_identically(params):
     np.testing.assert_allclose(
         logits["plain"], logits["sharded"], rtol=1e-4, atol=1e-4)
     assert outs["plain"] == outs["sharded"]
+
+
+@pytest.mark.timeout(300)
+def test_serves_tensor_parallel_params_identically(params):
+    """TP serving (the vLLM-backend multi-GPU layout): heads/mlp/vocab
+    sharded over the tensor axis; decode output must match unsharded.
+    Unlike the FSDP case (gather-then-compute), TP keeps the compute
+    sharded, so this exercises partitioned attention + KV cache."""
+    import dataclasses
+
+    cfg32 = dataclasses.replace(CFG, dtype="float32")
+    tp_params = _shard_params("tp", params, cfg32, tensor_size=2)
+    outs = {}
+    for name, ps in (("plain", params), ("tp", tp_params)):
+        eng = InferenceEngine(ps, cfg32, slots=2, max_len=64,
+                              prefill_len=8, decode_block=4)
+        rid = eng.submit([3, 1, 4], SamplingParams(
+            temperature=0.0, max_new_tokens=8))
+        res = {r.id: r for r in eng.run()}
+        outs[name] = res[rid].tokens
+    assert outs["plain"] == outs["tp"]
 
 
 @pytest.mark.timeout(300)
