@@ -80,7 +80,22 @@ class RpcServer:
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 try:
                     while True:
-                        raw = recv_frame(sock)
+                        try:
+                            raw = recv_frame(sock)
+                        except ValueError as e:
+                            # framing violation (oversized declared
+                            # length): answer with a structured error
+                            # and drop — without this catch the trace
+                            # lands in socketserver's handle_error and
+                            # a hostile peer can spam the master's
+                            # stderr with raw tracebacks
+                            try:
+                                send_frame(sock, serde.encode(
+                                    RpcError(error=f"bad frame: {e}")
+                                ))
+                            except (ConnectionError, OSError):
+                                pass
+                            return
                         resp = outer._dispatch(raw)
                         send_frame(sock, resp)
                 except (ConnectionError, OSError):

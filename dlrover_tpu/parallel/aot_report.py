@@ -129,6 +129,26 @@ def main(argv=None) -> int:
         "comm_bytes": report.comm_bytes,
         "compile_s": round(time.monotonic() - t0, 1),
     }
+    # analytic per-op-class FLOPs from the jaxpr (scan-aware, unlike
+    # XLA's cost analysis above) — the Analyser's params/flops/memory
+    # triple, completing the per-device sizing with true model FLOPs
+    try:
+        from dlrover_tpu.utils.profiler import flops_breakdown
+
+        # reuse the already-traced state shapes (one build feeds all
+        # numbers, per the design note above) rather than re-tracing init
+        params_abs = state_abs.params
+        tokens = jax.ShapeDtypeStruct(
+            (args.batch, args.seq + 1), np.int32
+        )
+        bd = flops_breakdown(
+            lambda p, b: tfm.loss_fn(p, b, cfg=cfg),
+            params_abs, {"tokens": tokens},
+        )
+        line["analytic_fwd_flops"] = bd.get("total", 0.0)
+        line["analytic_fwd_matmul_flops"] = bd.get("dot_general", 0.0)
+    except Exception as e:  # noqa: BLE001 - sizing must still print
+        line["analytic_fwd_flops_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(line))
     return 0 if report.ok else 1
 

@@ -124,6 +124,91 @@ class TestRpc:
             server.stop()
 
 
+class TestRpcRobustness:
+    """Hostile/corrupt peers must never take the server down — the
+    master serves every node's control plane over this socket."""
+
+    @staticmethod
+    def _alive(server):
+        client = RpcClient(f"127.0.0.1:{server.port}")
+        try:
+            return client.call(JoinRendezvousRequest(node_id=1)).completed
+        finally:
+            client.close()
+
+    def test_garbage_bytes_do_not_kill_server(self):
+        import socket as socket_mod
+
+        server = RpcServer(
+            lambda m: CommWorldResponse(completed=True), host="127.0.0.1"
+        )
+        server.start()
+        try:
+            for payload in (
+                b"\x00" * 3,                    # truncated length prefix
+                b"\xff\xff\xff\x7f",            # huge declared frame
+                b"\x00\x00\x00\x05ab",          # declares 5 bytes, EOF at 2
+            ):
+                s = socket_mod.create_connection(
+                    ("127.0.0.1", server.port), timeout=5
+                )
+                s.sendall(payload)
+                s.close()
+            assert self._alive(server)
+        finally:
+            server.stop()
+
+    def test_malformed_json_and_unknown_type_return_errors(self):
+        import socket as socket_mod
+
+        from dlrover_tpu.common import serde
+        from dlrover_tpu.common.rpc import RpcError, recv_frame, send_frame
+
+        server = RpcServer(
+            lambda m: CommWorldResponse(completed=True), host="127.0.0.1"
+        )
+        server.start()
+        try:
+            for bad in (b"not json at all",
+                        b'{"type": "NoSuchMessageType"}',  # unknown type
+                        b'{"kind": "x"}'):                 # no type key
+                s = socket_mod.create_connection(
+                    ("127.0.0.1", server.port), timeout=5
+                )
+                send_frame(s, bad)
+                resp = serde.decode(recv_frame(s))
+                assert isinstance(resp, RpcError) and resp.error, (
+                    bad, resp
+                )
+                s.close()
+            assert self._alive(server)
+        finally:
+            server.stop()
+
+    def test_oversized_frame_gets_structured_error(self):
+        import socket as socket_mod
+
+        from dlrover_tpu.common import serde
+        from dlrover_tpu.common.rpc import RpcError, recv_frame
+
+        server = RpcServer(
+            lambda m: CommWorldResponse(completed=True), host="127.0.0.1"
+        )
+        server.start()
+        try:
+            s = socket_mod.create_connection(
+                ("127.0.0.1", server.port), timeout=5
+            )
+            s.sendall(b"\xff\xff\xff\x7f")  # 4.29 GB declared length
+            resp = serde.decode(recv_frame(s))
+            assert isinstance(resp, RpcError)
+            assert "frame" in resp.error
+            s.close()
+            assert self._alive(server)
+        finally:
+            server.stop()
+
+
 class TestSharedPrimitives:
     def test_shared_lock(self, tmp_ipc_dir):
         owner = SharedLock("l1", create=True)
