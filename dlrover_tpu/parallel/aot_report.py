@@ -136,13 +136,17 @@ def main(argv=None) -> int:
         from dlrover_tpu.utils.profiler import flops_breakdown
 
         # reuse the already-traced state shapes (one build feeds all
-        # numbers, per the design note above) rather than re-tracing init
+        # numbers, per the design note above) rather than re-tracing
+        # init, and RESOLVE the config so strategy extras that change
+        # the model (attention kind/window, int8, pipeline shape) are
+        # the ones counted — resolve_config's documented contract
         params_abs = state_abs.params
+        rcfg = tfm.resolve_config(cfg, strategy)
         tokens = jax.ShapeDtypeStruct(
             (args.batch, args.seq + 1), np.int32
         )
         bd = flops_breakdown(
-            lambda p, b: tfm.loss_fn(p, b, cfg=cfg),
+            lambda p, b: tfm.loss_fn(p, b, cfg=rcfg),
             params_abs, {"tokens": tokens},
         )
         line["analytic_fwd_flops"] = bd.get("total", 0.0)

@@ -19,7 +19,7 @@ import numpy as np
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.parallel.dry_run import pick_strategy
 from dlrover_tpu.parallel.mesh import data_parallel_size
-from dlrover_tpu.parallel.strategy import Strategy, dp, fsdp, fsdp_tp
+from dlrover_tpu.parallel.strategy import Strategy, dp, fsdp, fsdp_tp, zero1
 
 logger = get_logger(__name__)
 
@@ -40,10 +40,13 @@ def device_hbm_bytes(device=None) -> int:
 
 
 def default_candidates(num_devices: int) -> list[Strategy]:
-    """Preference order: replicated DP (no param collectives), then FSDP
-    (param gathers), then FSDP x TP (per-layer collectives)."""
+    """Preference order: replicated DP (no param collectives), ZeRO-1
+    (dp + sharded optimizer state — fits when params do but params+Adam
+    don't), then FSDP (param gathers), then FSDP x TP (per-layer
+    collectives)."""
     candidates = [dp()]
     if num_devices > 1:
+        candidates.append(zero1())
         candidates.append(fsdp())
     if num_devices >= 4:
         candidates.append(fsdp_tp(tensor_size=2))

@@ -147,6 +147,25 @@ def dp(num_devices: int = -1, grad_compression: bool = False) -> Strategy:
     )
 
 
+def zero1(data_size: int = -1) -> Strategy:
+    """ZeRO-1: pure data parallelism with SHARDED optimizer state.
+
+    Params and grads stay replicated (one psum, like dp); the Adam
+    moments shard over the data axis, cutting optimizer memory by the
+    axis size — the middle ground when params fit HBM but params+Adam
+    don't, without fsdp's per-layer param gathers. XLA inserts the
+    update all-gather from the output shardings; the math is bit-for-dp
+    (it is a layout choice, not an algorithm change). Reference:
+    atorch Zero1Optimization (auto/opt_lib/zero_optimization.py:115).
+    """
+    return Strategy(
+        name="zero1",
+        mesh_axes={"data": data_size},
+        rules=[["batch", "data"]],
+        extra={"zero1": True},
+    )
+
+
 def fsdp(fsdp_size: int = -1, remat: str = "dots",
          int8: bool = False) -> Strategy:
     """ZeRO-3-style fully sharded data parallel (param gather per layer).
@@ -301,6 +320,7 @@ def moe(expert_size: int = 2, data_size: int = -1) -> Strategy:
 
 PRESETS = {
     "dp": dp,
+    "zero1": zero1,
     "fsdp": fsdp,
     "tp": tp,
     "fsdp_tp": fsdp_tp,

@@ -142,6 +142,30 @@ def compile_train(
     # shardings for the full state
     example = jax.eval_shape(_init, jax.random.PRNGKey(0), *init_args)
     opt_specs = derive_opt_specs(optimizer, example.params, param_specs)
+    if (getattr(strategy, "extra", {}) or {}).get("zero1"):
+        # ZeRO-1: optimizer state shards over the data axes even though
+        # params stay replicated — each leaf's first divisible dim gets
+        # the axis; the update all-gather comes from out_shardings. The
+        # math is identical to dp (layout, not algorithm).
+        z_axes = batch_axes(mesh)
+        z_n = 1
+        for a in z_axes:
+            z_n *= mesh.shape[a]
+        z_axis = z_axes if len(z_axes) > 1 else (
+            z_axes[0] if z_axes else None)
+
+        def _zero1_spec(spec, leaf):
+            if spec != PartitionSpec() or leaf.ndim == 0 or z_axis is None:
+                return spec
+            for d, size in enumerate(leaf.shape):
+                if size % z_n == 0 and size >= z_n:
+                    return PartitionSpec(*([None] * d), z_axis)
+            return spec
+
+        opt_specs = jax.tree.map(
+            _zero1_spec, opt_specs, example.opt_state,
+            is_leaf=lambda x: isinstance(x, PartitionSpec),
+        )
     state_shardings = TrainState(
         step=NamedSharding(mesh, PartitionSpec()),
         params=param_shardings,
