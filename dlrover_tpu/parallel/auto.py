@@ -19,7 +19,14 @@ import numpy as np
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.parallel.dry_run import pick_strategy
 from dlrover_tpu.parallel.mesh import data_parallel_size
-from dlrover_tpu.parallel.strategy import Strategy, dp, fsdp, fsdp_tp, zero1
+from dlrover_tpu.parallel.strategy import (
+    Strategy,
+    dp,
+    fsdp,
+    fsdp_tp,
+    zero1,
+    zero2,
+)
 
 logger = get_logger(__name__)
 
@@ -47,6 +54,7 @@ def default_candidates(num_devices: int) -> list[Strategy]:
     candidates = [dp()]
     if num_devices > 1:
         candidates.append(zero1())
+        candidates.append(zero2())
         candidates.append(fsdp())
     if num_devices >= 4:
         candidates.append(fsdp_tp(tensor_size=2))

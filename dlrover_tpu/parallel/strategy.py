@@ -166,6 +166,25 @@ def zero1(data_size: int = -1) -> Strategy:
     )
 
 
+def zero2(data_size: int = -1) -> Strategy:
+    """ZeRO-2: ZeRO-1 plus reduce-scattered gradients.
+
+    Gradients are constrained to the optimizer state's sharding before
+    the update, so XLA lowers the cross-data-axis gradient sum to a
+    reduce_scatter (half the wire bytes of an all-reduce) and each
+    device holds only its gradient shard while updating its moment
+    shard; the update all-gather restores replicated params. Same math
+    as dp/zero1. Reference: atorch Zero2Optimization
+    (auto/opt_lib/zero_optimization.py:158).
+    """
+    return Strategy(
+        name="zero2",
+        mesh_axes={"data": data_size},
+        rules=[["batch", "data"]],
+        extra={"zero1": True, "zero2": True},
+    )
+
+
 def fsdp(fsdp_size: int = -1, remat: str = "dots",
          int8: bool = False) -> Strategy:
     """ZeRO-3-style fully sharded data parallel (param gather per layer).
@@ -321,6 +340,7 @@ def moe(expert_size: int = 2, data_size: int = -1) -> Strategy:
 PRESETS = {
     "dp": dp,
     "zero1": zero1,
+    "zero2": zero2,
     "fsdp": fsdp,
     "tp": tp,
     "fsdp_tp": fsdp_tp,

@@ -142,7 +142,8 @@ def compile_train(
     # shardings for the full state
     example = jax.eval_shape(_init, jax.random.PRNGKey(0), *init_args)
     opt_specs = derive_opt_specs(optimizer, example.params, param_specs)
-    if (getattr(strategy, "extra", {}) or {}).get("zero1"):
+    extra = getattr(strategy, "extra", {}) or {}
+    if extra.get("zero1") or extra.get("zero2"):
         # ZeRO-1: optimizer state shards over the data axes even though
         # params stay replicated — each leaf's first divisible dim gets
         # the axis; the update all-gather comes from out_shardings. The
@@ -210,7 +211,6 @@ def compile_train(
         return loss / accum, jax.tree.map(lambda g: g / accum, grads)
 
     compute = _loss_and_grads
-    extra = getattr(strategy, "extra", {}) or {}
     if extra.get("grad_compression"):
         # int8-quantized gradient reduce across the data axes (reference:
         # ATorch's quant-reduce comm compression). The grad psum XLA would
@@ -250,8 +250,33 @@ def compile_train(
             out_specs=(PartitionSpec(), PartitionSpec()),
         )
 
+    # ZeRO-2: constrain gradients to the moment shards' layout so the
+    # cross-data-axis gradient sum lowers to a reduce_scatter and each
+    # device updates only its shard (the all-gather moves to the
+    # parameter update, where ZeRO-1 already pays it)
+    grad_constraint = None
+    if extra.get("zero2"):
+        # the param-shaped moment layout: run the PARAM specs through
+        # the same first-divisible-dim rule the moments used, so a
+        # zero2 strategy with sharded params keeps grads and moments on
+        # one layout instead of resharding between them
+        mu_specs = jax.tree.map(
+            _zero1_spec, param_specs, example.params,
+            is_leaf=lambda x: isinstance(x, PartitionSpec),
+        )
+
+        def grad_constraint(grads):
+            return jax.tree.map(
+                lambda g, s: jax.lax.with_sharding_constraint(
+                    g, NamedSharding(mesh, s)
+                ),
+                grads, mu_specs,
+            )
+
     def _step(state: TrainState, batch: Any) -> tuple[TrainState, dict]:
         loss, grads = compute(state.params, batch)
+        if grad_constraint is not None:
+            grads = grad_constraint(grads)
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params
         )
