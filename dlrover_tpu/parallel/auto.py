@@ -126,3 +126,79 @@ def auto_strategy(
     )
     logger.info("auto strategy selected: %s", best.name)
     return best, reports
+
+
+def _workload_fingerprint(kwargs: dict, n_devices: int) -> str:
+    """Hash of everything that determines auto_strategy's answer: the
+    abstract parameter tree, batch shapes, objective, HBM budget, and
+    device count — a cache hit for a DIFFERENT model/batch would hand
+    back a strategy that never passed this workload's fit check."""
+    import hashlib
+
+    def sig(tree):
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        return sorted(
+            (jax.tree_util.keystr(p), v) for p, v in flat
+        )
+
+    shapes = jax.tree_util.tree_map(
+        lambda l: (tuple(l.shape), str(l.dtype)),
+        jax.eval_shape(kwargs["init_params_fn"], jax.random.PRNGKey(0)),
+    )
+    batch_shapes = jax.tree_util.tree_map(
+        lambda a: (tuple(np.shape(a)), str(np.asarray(a).dtype)),
+        kwargs["example_batch"],
+    )
+    blob = repr((
+        sig(shapes),
+        sig(batch_shapes),
+        kwargs.get("objective", "fastest"),
+        kwargs.get("hbm_capacity_bytes"),
+        n_devices,
+    ))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def cached_auto_strategy(cache_path: str, **kwargs) -> tuple[Strategy, list]:
+    """auto_strategy with a persisted result: the load_strategy analog.
+
+    Reference: auto_accelerate's ``load_strategy`` (accelerate.py:467)
+    — tune once, then every later run (and every elastic RESTART, where
+    re-searching would burn the recovery window with N candidate
+    compiles) reloads the picked strategy. The cache is keyed by a
+    workload fingerprint (param/batch shapes, objective, HBM budget,
+    device count): any change re-runs the search.
+    """
+    import dataclasses as _dc
+    import json as _json
+    import os as _os
+
+    devices = kwargs.get("devices")
+    n = len(devices) if devices is not None else len(jax.devices())
+    fp = _workload_fingerprint(kwargs, n)
+    try:
+        with open(cache_path) as f:
+            data = _json.load(f)
+        if data.get("fingerprint") == fp:
+            strategy = Strategy(**data["strategy"])
+            logger.info(
+                "reusing tuned strategy %r from %s (%d devices)",
+                strategy.name, cache_path, n,
+            )
+            return strategy, []
+    except (OSError, ValueError, KeyError, TypeError):
+        pass
+    strategy, reports = auto_strategy(**kwargs)
+    try:
+        _os.makedirs(_os.path.dirname(cache_path) or ".", exist_ok=True)
+        tmp = cache_path + ".tmp"
+        with open(tmp, "w") as f:
+            _json.dump({
+                "fingerprint": fp,
+                "devices": n,
+                "strategy": _dc.asdict(strategy),
+            }, f, indent=2)
+        _os.replace(tmp, cache_path)
+    except OSError as e:  # cache is best-effort
+        logger.warning("could not persist strategy cache: %s", e)
+    return strategy, reports

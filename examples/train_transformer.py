@@ -135,7 +135,7 @@ def main(argv=None) -> int:
             return tfm.make_loss_fn(cfg, s, m)
 
     if args.strategy == "auto":
-        from dlrover_tpu.parallel.auto import auto_strategy
+        from dlrover_tpu.parallel.auto import cached_auto_strategy
 
         bsz = max(1, args.global_batch)
         if args.objective == "mlm":
@@ -148,7 +148,11 @@ def main(argv=None) -> int:
             example_batch = {
                 "tokens": np.zeros((1, bsz, seq + 1), np.int32)
             }
-        strategy, _ = auto_strategy(
+        # cached next to the checkpoints: an elastic restart reuses the
+        # tuned pick instead of burning the recovery window on N
+        # candidate compiles (re-searched when the world size changes)
+        strategy, _ = cached_auto_strategy(
+            os.path.join(args.ckpt_dir, "strategy.json"),
             loss_fn_for=loss_for,
             init_params_fn=lambda rng: tfm.init_params(cfg, rng),
             logical_params=tfm.logical_axes(cfg),

@@ -284,6 +284,48 @@ class TestAutoStrategy:
             **kwargs,
         )
 
+    def test_cached_auto_strategy_reuses_and_rekeys(self, tmp_path):
+        """The load_strategy analog: the second call reloads the tuned
+        pick (no search — instant, no reports), and a cache written for
+        a different device count is ignored."""
+        import json
+        import time
+
+        from dlrover_tpu.parallel.auto import cached_auto_strategy
+
+        cache = str(tmp_path / "strategy.json")
+        cfg = T.CONFIGS["tiny"]
+        kwargs = dict(
+            loss_fn_for=lambda s, m: T.make_loss_fn(cfg, s, m),
+            init_params_fn=lambda rng: T.init_params(cfg, rng),
+            logical_params=T.logical_axes(cfg),
+            optimizer=optax.adamw(1e-3),
+            example_batch={"tokens": np.zeros((1, 8, 33), np.int32)},
+            hbm_capacity_bytes=0,
+        )
+        s1, reports = cached_auto_strategy(cache, **kwargs)
+        assert reports  # a real search ran
+        t0 = time.monotonic()
+        s2, reports2 = cached_auto_strategy(cache, **kwargs)
+        assert time.monotonic() - t0 < 1.0  # reload, not re-search
+        assert reports2 == []
+        assert s2 == s1
+        # a cache for a different workload fingerprint (other model,
+        # batch, budget, or world size) must not be reused
+        data = json.load(open(cache))
+        data["fingerprint"] = "someone-elses-workload"
+        json.dump(data, open(cache, "w"))
+        s3, reports3 = cached_auto_strategy(cache, **kwargs)
+        assert reports3  # searched again
+        assert json.load(open(cache))["devices"] == 8  # rewritten
+        # changed batch shape -> different fingerprint -> fresh search
+        kwargs2 = dict(kwargs)
+        kwargs2["example_batch"] = {
+            "tokens": np.zeros((1, 16, 33), np.int32)
+        }
+        _, reports4 = cached_auto_strategy(cache, **kwargs2)
+        assert reports4
+
     def test_ample_memory_prefers_dp(self):
         # fastest objective: either replicated-param strategy may win
         # (zero1 distributes the optimizer's elementwise work, so its
