@@ -191,7 +191,10 @@ def cached_auto_strategy(cache_path: str, **kwargs) -> tuple[Strategy, list]:
     strategy, reports = auto_strategy(**kwargs)
     try:
         _os.makedirs(_os.path.dirname(cache_path) or ".", exist_ok=True)
-        tmp = cache_path + ".tmp"
+        # pid-suffixed temp + atomic replace: concurrent cold-starting
+        # processes on a shared output_dir each write their own file
+        # (identical content) — last writer wins, never interleaved
+        tmp = f"{cache_path}.{_os.getpid()}.tmp"
         with open(tmp, "w") as f:
             _json.dump({
                 "fingerprint": fp,

@@ -286,6 +286,7 @@ class Trainer:
         callbacks: Sequence[TrainerCallback] | None = None,
         lr_schedule: Callable[[int], float] | None = None,
         engine: CheckpointEngine | None = None,
+        example_batch: Any | None = None,
     ):
         self.args = args
         self.train_dataset = train_dataset
@@ -294,7 +295,44 @@ class Trainer:
         self.compute_metrics = compute_metrics
         self.lr_schedule = lr_schedule
 
-        if isinstance(strategy, str):
+        if strategy == "auto":
+            # auto_accelerate-style search, cached in output_dir (the
+            # load_strategy analog): restarts reuse the tuned pick.
+            # ``example_batch`` carries ONE SAMPLE's shapes; the real
+            # [accum=1, global_batch, ...] layout is derived from args
+            # so the fit check sizes the workload actually trained
+            # (full global batch in one step — the conservative bound).
+            if example_batch is None:
+                raise ValueError(
+                    "strategy='auto' requires example_batch (per-sample "
+                    "shapes; the Trainer adds the batch dims)"
+                )
+            lf_for = loss_fn_for
+            if lf_for is None:
+                if loss_fn is None:
+                    raise ValueError(
+                        "strategy='auto' requires loss_fn or loss_fn_for"
+                    )
+                lf_for = lambda s, m: loss_fn  # noqa: E731
+
+            from dlrover_tpu.parallel.auto import cached_auto_strategy
+
+            gb = args.global_batch_size
+            sized_batch = jax.tree_util.tree_map(
+                lambda a: np.zeros(
+                    (1, gb, *np.shape(a)), np.asarray(a).dtype
+                ),
+                example_batch,
+            )
+            strategy, _ = cached_auto_strategy(
+                os.path.join(args.output_dir, "strategy.json"),
+                loss_fn_for=lf_for,
+                init_params_fn=init_params_fn,
+                logical_params=logical_params,
+                optimizer=optimizer,
+                example_batch=sized_batch,
+            )
+        elif isinstance(strategy, str):
             strategy = PRESETS[strategy]()
         elif strategy is None:
             strategy = PRESETS["dp"]()

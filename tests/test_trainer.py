@@ -215,3 +215,54 @@ def test_goodput_callback_writes_log(tmp_ipc_dir, tmp_path):
     assert report.n_steps == 12
     assert report.n_incarnations == 1
     assert report.goodput > 0.5
+
+
+@pytest.mark.timeout(570)
+def test_strategy_auto_with_cache(tmp_ipc_dir, tmp_path):
+    """strategy='auto': the Trainer runs the cached search (the
+    load_strategy analog) and trains; a second Trainer on the same
+    output_dir reuses the pick without re-searching."""
+    import json
+    import time
+
+    def make(out):
+        args = TrainingArguments(
+            output_dir=str(out), global_batch_size=16,
+            micro_batch_size=2, max_steps=3,
+        )
+        return Trainer(
+            args=args,
+            optimizer=optax.adam(1e-2),
+            init_params_fn=lambda rng: mlp.init_params(rng, SIZES),
+            logical_params=mlp.logical_axes(SIZES),
+            loss_fn=mlp.loss_fn,  # plain form: auto wraps it itself
+            train_dataset=_dataset(48),
+            strategy="auto",
+            # per-sample shapes; the Trainer derives [1, global, ...]
+            example_batch={
+                "x": np.zeros((SIZES[0],), np.float32),
+                "y": np.zeros((), np.int32),
+            },
+        )
+
+    out = tmp_path / "auto_out"
+    t1 = make(out)
+    t1.train()
+    cache = json.load(open(out / "strategy.json"))
+    assert cache["strategy"]["name"]
+    t0 = time.monotonic()
+    t2 = make(out)  # second construction must reload, not re-search
+    assert time.monotonic() - t0 < 30, "auto search re-ran despite cache"
+    assert t2.strategy.name == t1.strategy.name
+
+    # missing example_batch is an error, not a silent dp fallback
+    with pytest.raises(ValueError, match="example_batch"):
+        Trainer(
+            args=TrainingArguments(output_dir=str(tmp_path / "x"),
+                                   global_batch_size=16, max_steps=1),
+            optimizer=optax.adam(1e-2),
+            init_params_fn=lambda rng: mlp.init_params(rng, SIZES),
+            logical_params=mlp.logical_axes(SIZES),
+            loss_fn=mlp.loss_fn,
+            strategy="auto",
+        )
