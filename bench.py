@@ -1,12 +1,20 @@
 #!/usr/bin/env python
-"""Round benchmark: training-step MFU on the live chip + flash-checkpoint
-snapshot/restore blocking times. Prints ONE JSON line.
+"""Round benchmark, staged: each stage runs under its own deadline and the
+cumulative result JSON line is re-printed (flushed) after EVERY stage, so a
+driver timeout can never zero out the round's evidence — the last complete
+line on stdout is always a valid result (round-3 lesson: one overrunning
+stage + single end-of-run print produced rc=124 / parsed=null and lost all
+validated numbers).
+
+Budget model: BENCH_BUDGET_S (default 2400 s) is the envelope for the whole
+run. Stages execute headline-first (ckpt, goodput, MFU, serving, int8,
+soak) and each is skipped when the remaining envelope is smaller than its
+cost estimate; a SIGALRM per-stage deadline stops a wedged stage without
+killing the run.
 
 Headline metric: checkpoint save blocking time for a GPT-2-small-class
 (~1.5 GB) train state, against the reference Flash Checkpoint bar of 0.5 s
-(BASELINE.md: Megatron GPT-1.5B save 151 s -> 0.5 s on an A100 node; the
-reference's blocking path is D2H + shm memcpy per GPU shard). Training MFU,
-step time and restore time ride along in "extra".
+(BASELINE.md: Megatron GPT-1.5B save 151 s -> 0.5 s on an A100 node).
 
 Note on fidelity: under the axon tunnel the device<->host link runs at
 ~0.02 GB/s (measured), which no real TPU host sees, so the checkpoint
@@ -20,6 +28,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import signal
 import sys
 import tempfile
 import time
@@ -32,9 +41,18 @@ from dlrover_tpu.utils.profiler import PEAK_FLOPS, compiled_flops
 CKPT_SAVE_BASELINE_S = 0.5  # reference FCP blocking bar (BASELINE.md)
 
 
-def bench_train_step(extra: dict) -> None:
+class StageTimeout(Exception):
+    pass
+
+
+def _train_one(extra: dict, prefix: str, model: str, batch: int, seq: int,
+               steps: int, cfg_overrides: dict,
+               optimizer: str = "adamw") -> None:
+    """Measure one training-step geometry on the live chip and record
+    MFU/step-time under ``prefix``-ed keys. ``optimizer``: "adamw" or
+    "adam8bit" (optimizers/low_bit.py — frees ~2/3 of the moment memory,
+    which is what lets the medium geometry keep its dot activations)."""
     import jax
-    import jax.numpy as jnp
     import optax
 
     from dlrover_tpu.models import transformer as tfm
@@ -42,57 +60,29 @@ def bench_train_step(extra: dict) -> None:
     from dlrover_tpu.trainer.train_step import compile_train
 
     dev = jax.devices()[0]
-    on_tpu = dev.platform == "tpu"
-    model = os.environ.get("BENCH_MODEL", "gpt2-small" if on_tpu else "tiny")
-    # per-layer remat bounds residuals to one layer of the scanned stack —
-    # without it the 12-layer attention-logit residuals alone (~9 GB f32
-    # at batch 16 / seq 1024) exceed a v5e's 16 GB HBM. Policy choice is
-    # measured on v5e (gpt2-small): dots_no_batch + Pallas flash attention
-    # + 16-chunk blockwise CE beat save_attn + dense + full-logits CE by
-    # ~2% step time.
-    if on_tpu:
-        # splash (tuned 512 blocks + fused bwd) measured fastest of the
-        # attention kernels at this geometry; full scan unroll lets XLA
-        # schedule weight prefetch across layers (r03 sweep: 0.393 vs
-        # 0.382 MFU). Attention impl and CE chunking measured invariant
-        # at b32/s1024. Exhaustive r03 policy sweep: save_attn_ffn
-        # 0.384, save_attn 0.382, dots_no_batch 0.393 (pick).
-        # Ceiling analysis (measured with examples/mfu_probe.py, late
-        # r03): this config is HBM-BANDWIDTH-bound, not recompute-bound.
-        # Every memory<->FLOPs trade measures flat or worse: no-remat
-        # genuinely OOMs (24.7 GB vs 15.75 GB HBM — the earlier compile
-        # 500s were real OOM rejections), "dots" needs 17.2 GB and at
-        # b24 is SLOWER than full recompute (0.375 vs 0.389 MFU), and
-        # interleaved remat_interval=2 (recompute halved to 0.5 fwd)
-        # compiles at b32 but lands at 0.396 — the saved activations'
-        # HBM writes+reads cost what the skipped recompute saves. The
-        # roofline itself: back-to-back bf16 matmul chains at this
-        # d_model=768 geometry peak at 0.58-0.64 utilization on v5e
-        # (vs 0.76-0.77 at d_model>=1024), so the step's ~0.53 hardware
-        # utilization is ~85% of what pure matmuls can do at these
-        # shapes. MFU counts model FLOPs only; with near-full recompute
-        # the device executes ~1.33x that (reported as mfu_hw_est).
-        cfg = dataclasses.replace(
-            tfm.CONFIGS[model], remat_scan=True,
-            remat_policy="dots_no_batch", attention="splash", ce_chunks=16,
-            scan_unroll=12,
-        )
-    else:
-        cfg = dataclasses.replace(tfm.CONFIGS[model], remat_scan=True,
-                                  remat_policy="save_attn")
-    batch = int(os.environ.get("BENCH_BATCH", "32" if on_tpu else "2"))
-    seq = min(cfg.max_seq_len, int(os.environ.get("BENCH_SEQ", "1024")))
-    steps = int(os.environ.get("BENCH_STEPS", "30"))
+    cfg = dataclasses.replace(tfm.CONFIGS[model], **cfg_overrides)
+    seq = min(cfg.max_seq_len, seq)
 
+    if optimizer == "adam8bit":
+        from dlrover_tpu.optimizers import adam_8bit
+
+        opt = adam_8bit(1e-4)
+    else:
+        opt = optax.adamw(1e-4)
     strat = strat_lib.dp()
     mesh = strat.build_mesh(jax.devices()[:1])
+    # make_loss_fn, NOT a bare partial(loss_fn, cfg=...): the bare form
+    # leaves attention_fn=None which silently falls back to dense — the
+    # r01-r03 MFU numbers were all dense-attention numbers and
+    # gpt2-medium at b32 OOMs outright on the materialized [B,H,S,S]
+    # logits (23.2 GB vs 15.75 GB HBM, measured r04)
     compiled = compile_train(
         strategy=strat,
         mesh=mesh,
-        loss_fn=partial(tfm.loss_fn, cfg=cfg),
+        loss_fn=tfm.make_loss_fn(cfg, strat, mesh),
         init_params_fn=lambda rng: tfm.init_params(cfg, rng),
         logical_params=tfm.logical_axes(cfg),
-        optimizer=optax.adamw(1e-4),
+        optimizer=opt,
     )
     state = compiled.init(jax.random.PRNGKey(0))
     tokens = np.random.default_rng(0).integers(
@@ -126,30 +116,94 @@ def bench_train_step(extra: dict) -> None:
     flops_per_step = flops_per_token * tokens_per_step
     xla_flops = compiled_flops(compiled.step, state, step_batch)
     peak = PEAK_FLOPS.get(dev.device_kind)
-    extra.update(
-        model=model,
-        device=dev.device_kind,
-        n_params=n_params,
-        batch=batch,
-        seq=seq,
-        compile_s=round(compile_s, 2),
-        step_time_s=round(step_s, 4),
-        tokens_per_s=round(tokens_per_step / step_s),
-        tflops_per_s=round(flops_per_step / step_s / 1e12, 1),
-        mfu=round(flops_per_step / step_s / peak, 4) if peak else None,
+    on_tpu = dev.platform == "tpu"
+    extra.update({
+        f"{prefix}model": model,
+        f"{prefix}n_params": n_params,
+        f"{prefix}batch": batch,
+        f"{prefix}seq": seq,
+        f"{prefix}compile_s": round(compile_s, 2),
+        f"{prefix}step_time_s": round(step_s, 4),
+        f"{prefix}tokens_per_s": round(tokens_per_step / step_s),
+        f"{prefix}tflops_per_s": round(flops_per_step / step_s / 1e12, 1),
+        f"{prefix}mfu":
+            round(flops_per_step / step_s / peak, 4) if peak else None,
         # model-FLOPs MFU understates device work under activation
         # remat: the backward re-executes ~a full forward (~1.33x model
-        # FLOPs total), so hardware utilization is ~mfu * 1.33 with the
-        # dots_no_batch policy. Configs avoiding the recompute either
-        # OOM or measure flat — see the bandwidth-bound ceiling
-        # analysis in the config comment above.
-        mfu_hw_est=(round(flops_per_step * 4 / 3 / step_s / peak, 4)
-                    if peak and on_tpu else None),
+        # FLOPs total), so hardware utilization is ~mfu * 4/3 with the
+        # dots_no_batch policy.
+        f"{prefix}mfu_hw_est": (
+            round(flops_per_step * 4 / 3 / step_s / peak, 4)
+            if peak and on_tpu else None),
         # raw XLA cost analysis; undercounts lax.scan/while bodies, so it
         # is NOT a utilization figure — recorded for cross-round tracking
-        xla_cost_analysis_flops=xla_flops,
-        loss=round(loss, 4),
+        f"{prefix}xla_cost_analysis_flops": xla_flops,
+        f"{prefix}loss": round(loss, 4),
+    })
+    extra["device"] = dev.device_kind
+
+
+def bench_train_step(extra: dict) -> None:
+    """Training MFU. Headline geometry is gpt2-medium (d_model=1024 —
+    compute-bound on the MXU: bf16 matmul chains reach 0.76+ utilization
+    there vs 0.58-0.64 at gpt2-small's d_model=768, examples/mfu_probe.py);
+    gpt2-small rides along as the bandwidth-bound secondary for
+    cross-round comparability (r02 0.382, r03 0.393)."""
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not on_tpu:
+        _train_one(extra, "", os.environ.get("BENCH_MODEL", "tiny"),
+                   batch=int(os.environ.get("BENCH_BATCH", "2")),
+                   seq=int(os.environ.get("BENCH_SEQ", "128")),
+                   steps=int(os.environ.get("BENCH_STEPS", "5")),
+                   cfg_overrides=dict(remat_scan=True,
+                                      remat_policy="save_attn"))
+        return
+
+    # Headline FIRST so a stage deadline can only cost the secondary.
+    # Policy notes (carried from the r03 sweep on gpt2-small, re-checked
+    # on medium in r04): dots_no_batch remat + splash attention + 16-chunk
+    # blockwise CE; scan unroll lets XLA prefetch weights across layers.
+    medium_err = None
+    try:
+        _train_one(
+            extra, "medium_", "gpt2-medium",
+            batch=int(os.environ.get("BENCH_MEDIUM_BATCH", "32")),
+            seq=int(os.environ.get("BENCH_SEQ", "1024")),
+            steps=int(os.environ.get("BENCH_MEDIUM_STEPS", "20")),
+            cfg_overrides=dict(
+                remat_scan=True, remat_policy="dots_no_batch",
+                attention="splash", ce_chunks=16,
+                scan_unroll=int(os.environ.get("BENCH_MEDIUM_UNROLL",
+                                               "24")),
+            ),
+        )
+        extra["mfu_medium"] = extra.get("medium_mfu")
+    except Exception as e:  # noqa: BLE001 - keep the secondary alive
+        medium_err = f"{type(e).__name__}: {e}"
+        extra["mfu_medium_error"] = medium_err[:300]
+
+    # gpt2-small secondary: per-layer remat bounds residuals to one layer
+    # of the scanned stack — without it the 12-layer attention-logit
+    # residuals alone (~9 GB f32 at batch 16 / seq 1024) exceed a v5e's
+    # 16 GB HBM. This config is HBM-BANDWIDTH-bound (r03 ceiling
+    # analysis): every memory<->FLOPs trade measures flat or worse, and
+    # the step's ~0.53 hardware utilization is ~85% of what pure matmul
+    # chains can do at d_model=768. Exhaustive r03 policy sweep:
+    # save_attn_ffn 0.384, save_attn 0.382, dots_no_batch 0.393 (pick).
+    _train_one(
+        extra, "", os.environ.get("BENCH_MODEL", "gpt2-small"),
+        batch=int(os.environ.get("BENCH_BATCH", "32")),
+        seq=int(os.environ.get("BENCH_SEQ", "1024")),
+        steps=int(os.environ.get("BENCH_STEPS", "30")),
+        cfg_overrides=dict(
+            remat_scan=True, remat_policy="dots_no_batch",
+            attention="splash", ce_chunks=16, scan_unroll=12,
+        ),
     )
+    if medium_err:
+        raise RuntimeError(f"medium geometry failed: {medium_err}")
 
 
 def bench_long_context(extra: dict) -> None:
@@ -229,7 +283,7 @@ def bench_long_context(extra: dict) -> None:
 
 
 def bench_checkpoint(extra: dict, gb: float | None = None,
-                     prefix: str = "ckpt_") -> dict:
+                     prefix: str = "ckpt_") -> None:
     """Host-side snapshot/restore path. Default ~1.5 GB GPT-2-small-class
     state; called again with ``gb`` ~12 for the 1B-param config
     (BASELINE configs 2-3; reference flash_checkpoint.md GPT-2 1.5B)."""
@@ -321,7 +375,6 @@ def bench_checkpoint(extra: dict, gb: float | None = None,
             "times the production zero-copy view path; "
             "cold_storage_restore_s is the fresh-host storage read"
         )
-    return {"save_s": save_s}
 
 
 def _run_elastic_job(work: str, env: dict, train_args: list[str],
@@ -435,8 +488,10 @@ def _snapshot_cost_s(log_path: str, mem_interval: int) -> float:
 
 
 def _goodput_scenario(extra: dict, prefix: str, child_env: dict,
-                      target_s: float, kills: int) -> None:
-    """One full goodput measurement (calibrate -> inject-and-measure)."""
+                      target_s: float, kills: int,
+                      stage_budget_s: float = 1800.0) -> None:
+    """One full goodput measurement (calibrate -> inject-and-measure).
+    ``stage_budget_s`` bounds calibration + measured run together."""
     import math
     import shutil
 
@@ -477,6 +532,7 @@ def _goodput_scenario(extra: dict, prefix: str, child_env: dict,
             "--log-interval", "500",
         ]
 
+    t_stage0 = time.monotonic()
     try:
         # ---- calibration: steady step time + per-snapshot cost (also
         # warms the compile cache so measured-run restarts don't compile)
@@ -484,13 +540,16 @@ def _goodput_scenario(extra: dict, prefix: str, child_env: dict,
         rc, tail, _, _, _ = _run_elastic_job(
             work, env,
             train_args(cal_interval) + ["--dataset-size", "100000"],
-            max_steps=60, kills=0, deadline_s=900, example=example)
+            max_steps=60, kills=0,
+            deadline_s=min(900, stage_budget_s * 0.45), example=example)
         if rc != 0:
             extra[f"{prefix}error"] = f"calibration rc={rc}: {tail}"
             return
         cal = compute_goodput(log)
         step_s = max(1e-4, cal.median_step_s)
         snap_s = _snapshot_cost_s(log, cal_interval)
+        remaining = stage_budget_s - (time.monotonic() - t_stage0) - 60
+        target_s = max(60.0, min(target_s, remaining / 1.5))
         total_steps = max(120, min(200000, int(target_s / step_s)))
         # snapshot cadence that balances snapshot overhead against
         # rollback re-compute: minimize steps/interval*snap +
@@ -511,7 +570,7 @@ def _goodput_scenario(extra: dict, prefix: str, child_env: dict,
             train_args(interval) + ["--dataset-size",
                                     str(total_steps * 40)],
             max_steps=total_steps, kills=kills,
-            deadline_s=target_s * 3 + 600, example=example)
+            deadline_s=max(120, remaining), example=example)
         report = compute_goodput(log, start_time=t_launch,
                                  end_time=t_exit)
         # North-star normalization (BASELINE.md: >=95% goodput at ONE
@@ -563,7 +622,15 @@ def _goodput_scenario(extra: dict, prefix: str, child_env: dict,
         shutil.rmtree(work, ignore_errors=True)
 
 
-def bench_goodput(extra: dict) -> None:
+def _cpu_child_env() -> dict:
+    return {"DLROVER_TPU_PLATFORM": "cpu",
+            "DLROVER_TPU_DEVICE_COUNT": "8",
+            "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_"
+                            "count=8").strip()}
+
+
+def bench_goodput(extra: dict, stage_budget_s: float = 900.0) -> None:
     """The reference's headline metric: goodput under injected failures.
 
     Runs the elastic example under ``dlrover_tpu.run --standalone``,
@@ -574,30 +641,21 @@ def bench_goodput(extra: dict) -> None:
     count as lost). Bar: >=0.95 with >=2 failures (reference
     README.md:54-55, BASELINE.md north star).
 
-    Two scenarios:
-    - ``goodput`` (headline): trainer children on the CPU backend —
-      goodput is a *systems* metric (restart/rendezvous/restore/snapshot
-      fraction) and the axon tunnel's ~0.02 GB/s D2H + per-dispatch RTT
-      would charge the machinery for link artifacts no real TPU host
-      has (same caveat as bench_checkpoint's D2H exclusion).
-    - ``goodput_tpu_*``: identical harness with the chip in the loop,
-      reported for completeness under that caveat.
+    Trainer children run on the CPU backend — goodput is a *systems*
+    metric (restart/rendezvous/restore/snapshot fraction) and the axon
+    tunnel's ~0.02 GB/s D2H + per-dispatch RTT would charge the
+    machinery for link artifacts no real TPU host has (same caveat as
+    bench_checkpoint's D2H exclusion). ``goodput_tpu`` runs the same
+    harness with the chip in the loop as a separate stage.
     """
     if os.environ.get("BENCH_GOODPUT", "1") == "0":
         return
-    import jax
-
-    target_s = float(os.environ.get("BENCH_GOODPUT_S", "300"))
+    target_s = float(os.environ.get("BENCH_GOODPUT_S", "240"))
     kills = int(os.environ.get("BENCH_GOODPUT_KILLS", "2"))
 
     _goodput_scenario(
-        extra, "goodput_sys_",
-        child_env={"DLROVER_TPU_PLATFORM": "cpu",
-                   "DLROVER_TPU_DEVICE_COUNT": "8",
-                   "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
-                                 + " --xla_force_host_platform_device_"
-                                   "count=8").strip()},
-        target_s=target_s, kills=kills,
+        extra, "goodput_sys_", child_env=_cpu_child_env(),
+        target_s=target_s, kills=kills, stage_budget_s=stage_budget_s,
     )
     # headline aliases (the systems scenario is THE goodput number)
     for k in ("goodput", "goodput_cold", "goodput_at_baseline_rate",
@@ -607,13 +665,94 @@ def bench_goodput(extra: dict) -> None:
             name = k if k.startswith("goodput") else f"goodput_{k}"
             extra[name] = extra[f"goodput_sys_{k}"]
 
-    if (jax.devices()[0].platform == "tpu"
-            and os.environ.get("BENCH_GOODPUT_TPU", "1") != "0"):
-        _goodput_scenario(
-            extra, "goodput_tpu_", child_env={},
-            target_s=float(os.environ.get("BENCH_GOODPUT_TPU_S", "180")),
-            kills=kills,
+
+def bench_goodput_tpu(extra: dict, stage_budget_s: float = 700.0) -> None:
+    """Goodput with the real chip in the loop (tunnel caveat applies)."""
+    import jax
+
+    if (jax.devices()[0].platform != "tpu"
+            or os.environ.get("BENCH_GOODPUT_TPU", "1") == "0"):
+        return
+    _goodput_scenario(
+        extra, "goodput_tpu_", child_env={},
+        target_s=float(os.environ.get("BENCH_GOODPUT_TPU_S", "180")),
+        kills=int(os.environ.get("BENCH_GOODPUT_KILLS", "2")),
+        stage_budget_s=stage_budget_s,
+    )
+
+
+def bench_soak(extra: dict, stage_budget_s: float = 300.0) -> None:
+    """Bounded many-kill soak (round-3 Weak #7: the production-shaped
+    scenario must run in the default bench, not only behind an opt-in
+    env). CPU backend, one elastic job, BENCH_SOAK_KILLS (>=3) SIGKILLs
+    at step thresholds; reports kills delivered, steps completed and
+    whether the job still exited clean."""
+    if os.environ.get("BENCH_SOAK", "1") == "0":
+        return
+    import shutil
+
+    kills = int(os.environ.get("BENCH_SOAK_KILLS", "4"))
+    max_steps = int(os.environ.get("BENCH_SOAK_STEPS", "120"))
+    repo = os.path.dirname(os.path.abspath(__file__))
+    example = os.path.join(repo, "examples", "train_transformer.py")
+    work = tempfile.mkdtemp(prefix="bench_soak_")
+    env = dict(os.environ)
+    env.update(_cpu_child_env())
+    env.update({
+        "DLROVER_TPU_IPC_DIR": os.path.join(work, "ipc"),
+        "PYTHONPATH": env.get("PYTHONPATH", "") + os.pathsep + repo,
+    })
+    log = os.path.join(work, "goodput.jsonl")
+    try:
+        rc, tail, killed, t_launch, t_exit = _run_elastic_job(
+            work, env,
+            ["--model", "tiny", "--global-batch", "8",
+             "--ckpt-dir", os.path.join(work, "ckpt"),
+             "--mem-ckpt-interval", "5",
+             "--ckpt-interval", "1000000",
+             "--epochs", "1000000",
+             "--dataset-size", str(max_steps * 40),
+             "--goodput-log", log,
+             "--result-file", os.path.join(work, "result.json"),
+             "--log-interval", "500"],
+            max_steps=max_steps, kills=kills,
+            deadline_s=stage_budget_s - 30, example=example)
+        steps_done = 0
+        try:
+            steps = []
+            with open(log) as f:
+                for line in f:
+                    if '"step"' not in line:
+                        continue
+                    # a SIGKILL landing mid-write leaves a truncated
+                    # line; it must not void the whole stage
+                    try:
+                        steps.append(json.loads(line).get("step", -1))
+                    except json.JSONDecodeError:
+                        continue
+            steps_done = max(steps, default=-1) + 1
+        except OSError:
+            pass
+        extra.update(
+            soak_kills=killed,
+            soak_steps_completed=steps_done,
+            soak_target_steps=max_steps,
+            soak_exit_code=rc,
+            soak_wall_s=round(t_exit - t_launch, 1),
+            soak_completed=bool(rc == 0 and steps_done >= max_steps),
         )
+        if rc != 0:
+            extra["soak_tail"] = tail[-500:]
+    finally:
+        import subprocess
+
+        subprocess.run(["pkill", "-9", "-f", example],
+                       capture_output=True)
+        subprocess.run(
+            ["pkill", "-9", "-f", "dlrover_tpu.master.job_master"],
+            capture_output=True,
+        )
+        shutil.rmtree(work, ignore_errors=True)
 
 
 def bench_serving(extra: dict) -> None:
@@ -631,7 +770,6 @@ def bench_serving(extra: dict) -> None:
 
     if jax.devices()[0].platform != "tpu":
         return
-    import jax.numpy as jnp
 
     from dlrover_tpu.models import transformer as tfm
     from dlrover_tpu.serving import InferenceEngine, SamplingParams
@@ -656,9 +794,11 @@ def bench_serving(extra: dict) -> None:
         toks = sum(len(r.tokens) for r in results)
         return toks / wall
 
-    extra["serving_toks_per_s_block1"] = round(run(1), 1)
+    # block=32 (the headline) first so a stage deadline costs the
+    # tunnel-dominated block=1 number, not the real one
     extra["serving_toks_per_s"] = round(run(32), 1)
     extra["serving_config"] = "gpt2-small slots=8 prompt=64 gen=128"
+    extra["serving_toks_per_s_block1"] = round(run(1), 1)
 
 
 def bench_int8(extra: dict) -> None:
@@ -670,13 +810,18 @@ def bench_int8(extra: dict) -> None:
     model-level grad measured 4.5-5.7s of which ~3.9s was the 32k-vocab
     CE/embedding path (int8 doesn't touch it, and its layouts proved
     unstable across compiles — the same config measured 1.9x and 0.82x
-    on different runs). The FFN stack is what int8 claims to speed up
-    and reproduces within ~5% run to run (the bf16 baseline itself runs at ~0.84
-    utilization here, so the ratio is measured against a healthy
-    denominator). Sync is a full-reduction scalar: fetching any real
-    grad leaf would ship ~90MB over the tunnel, and a sliced
-    fingerprint lets XLA dead-code-eliminate the backward entirely
-    (both measured failure modes of earlier versions of this stage)."""
+    on different runs). The FFN stack is what int8 claims to speed up.
+    Sync is a full-reduction scalar: fetching any real grad leaf would
+    ship ~90MB over the tunnel, and a sliced fingerprint lets XLA
+    dead-code-eliminate the backward entirely (both measured failure
+    modes of earlier versions of this stage).
+
+    Baseline pinning (round-3 Weak #6: bf16 layouts vary compile to
+    compile, 128-173 TF/s): each impl is compiled in BENCH_INT8_COMPILES
+    fresh jit instances and the fastest compilation's steady-state time
+    is the quoted number, so the ratio compares best-layout to
+    best-layout instead of whatever layout one compile happened to pick.
+    """
     import jax
     import jax.numpy as jnp
 
@@ -713,19 +858,28 @@ def bench_int8(extra: dict) -> None:
 
         return step
 
-    def run(mm) -> float:
-        f = jax.jit(make_step(mm))
-        float(jax.device_get(f(params)))
-        float(jax.device_get(f(params)))
-        t0 = time.monotonic()
-        n = 10
-        for _ in range(n):
-            out = f(params)
-        float(jax.device_get(out))
-        return (time.monotonic() - t0) / n
+    n_compiles = int(os.environ.get("BENCH_INT8_COMPILES", "2"))
 
-    bf16_s = run(lambda a, b: a @ b)
-    int8_s = run(int8_matmul)
+    def run(mm) -> tuple[float, list[float]]:
+        times = []
+        for c in range(n_compiles):
+            # a fresh jit of a fresh function object defeats jax's
+            # C++-level executable cache, forcing an independent
+            # compilation whose layout assignment can differ
+            step = make_step(mm)
+            f = jax.jit(lambda p, _c=c: step(p))
+            float(jax.device_get(f(params)))
+            float(jax.device_get(f(params)))
+            t0 = time.monotonic()
+            n = 10
+            for _ in range(n):
+                out = f(params)
+            float(jax.device_get(out))
+            times.append((time.monotonic() - t0) / n)
+        return min(times), times
+
+    bf16_s, bf16_all = run(lambda a, b: a @ b)
+    int8_s, int8_all = run(int8_matmul)
     # contractions: 3 matmuls x (fwd + dx + dw) x L, minus layer 0's
     # g/u dx dots (their input is the closure constant x, so JAX emits
     # no transpose for them); each is 2*T*d*d_ff FLOPs
@@ -735,8 +889,11 @@ def bench_int8(extra: dict) -> None:
         int8_ffn_s=round(int8_s, 4),
         int8_ffn_speedup=round(bf16_s / int8_s, 2),
         int8_ffn_bf16_tflops=round(flops / bf16_s / 1e12, 1),
+        int8_ffn_bf16_compiles=[round(t, 4) for t in bf16_all],
+        int8_ffn_compiles=[round(t, 4) for t in int8_all],
         int8_note=("llama-7B FFN stack (d=4096, ff=11008, L=4, 8k "
-                   "tokens), fwd+bwd matmuls via ops/quantization.py"),
+                   "tokens), fwd+bwd matmuls via ops/quantization.py; "
+                   "best-of-N fresh compiles per impl"),
     )
 
 
@@ -761,7 +918,7 @@ def bench_checkpoint_1b(extra: dict) -> None:
     bench_checkpoint(extra, gb=gb, prefix="ckpt1b_")
 
 
-def bench_7b_aot(extra: dict) -> None:
+def bench_7b_aot(extra: dict, stage_budget_s: float = 600.0) -> None:
     """Llama-7B FSDP on a virtual v5p-128 mesh, AOT: compiles the full
     sharded train step and reports per-device memory/FLOPs/collectives
     without touching hardware (parallel/aot_report.py). Subprocess so
@@ -784,7 +941,8 @@ def bench_7b_aot(extra: dict) -> None:
         [sys.executable, "-m", "dlrover_tpu.parallel.aot_report",
          "--model", os.environ.get("BENCH_AOT_MODEL", "llama2-7b"),
          "--strategy", "fsdp", "--batch", "128", "--seq", "4096"],
-        env=env, cwd=repo, capture_output=True, text=True, timeout=3600,
+        env=env, cwd=repo, capture_output=True, text=True,
+        timeout=max(60, stage_budget_s - 15),
     )
     line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() \
         else ""
@@ -794,63 +952,105 @@ def bench_7b_aot(extra: dict) -> None:
         extra["aot_7b_error"] = (proc.stderr or line)[-400:]
 
 
+# ---------------------------------------------------------------------------
+# Stage harness
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Stage:
+    name: str
+    fn: object          # callable(extra) or callable(extra, stage_budget_s)
+    est_s: float        # expected cost: stage is skipped if the remaining
+                        # envelope is below this
+    deadline_s: float   # SIGALRM ceiling for the stage
+    pass_budget: bool = False  # fn accepts stage_budget_s kwarg
+
+
+STAGES = [
+    # headline stages first: by minute ~20 every number the round is
+    # judged on has been emitted at least once
+    Stage("ckpt", bench_checkpoint, est_s=90, deadline_s=240),
+    Stage("goodput", bench_goodput, est_s=420, deadline_s=900,
+          pass_budget=True),
+    Stage("mfu", bench_train_step, est_s=300, deadline_s=700),
+    Stage("serving", bench_serving, est_s=180, deadline_s=480),
+    Stage("int8", bench_int8, est_s=300, deadline_s=700),
+    Stage("soak", bench_soak, est_s=240, deadline_s=360,
+          pass_budget=True),
+    # extras, cheapest-information-per-second last
+    Stage("ckpt1b", bench_checkpoint_1b, est_s=180, deadline_s=480),
+    Stage("long_context", bench_long_context, est_s=240, deadline_s=480),
+    Stage("aot7b", bench_7b_aot, est_s=180, deadline_s=600,
+          pass_budget=True),
+    Stage("goodput_tpu", bench_goodput_tpu, est_s=420, deadline_s=700,
+          pass_budget=True),
+]
+
+
+def _result_line(extra: dict) -> str:
+    save_s = extra.get("ckpt_save_block_s")
+    return json.dumps({
+        "metric": "ckpt_save_block_s",
+        "value": save_s,
+        "unit": "s",
+        "vs_baseline":
+            round(CKPT_SAVE_BASELINE_S / save_s, 2) if save_s else None,
+        "extra": extra,
+    })
+
+
 def main() -> None:
     extra: dict = {}
-    errors = []
-    save_s = None
-    try:
-        ckpt = bench_checkpoint(extra)
-        save_s = ckpt["save_s"]
-    except Exception as e:  # noqa: BLE001
-        errors.append(f"ckpt: {type(e).__name__}: {e}")
-    try:
-        bench_checkpoint_1b(extra)
-    except Exception as e:  # noqa: BLE001
-        errors.append(f"ckpt1b: {type(e).__name__}: {e}")
-    try:
-        bench_7b_aot(extra)
-    except Exception as e:  # noqa: BLE001
-        errors.append(f"aot7b: {type(e).__name__}: {e}")
-    try:
-        bench_train_step(extra)
-    except Exception as e:  # noqa: BLE001
-        errors.append(f"train: {type(e).__name__}: {e}")
-    try:
-        bench_long_context(extra)
-    except Exception as e:  # noqa: BLE001
-        errors.append(f"long_context: {type(e).__name__}: {e}")
-    try:
-        bench_int8(extra)
-    except Exception as e:  # noqa: BLE001
-        errors.append(f"int8: {type(e).__name__}: {e}")
-    try:
-        bench_serving(extra)
-    except Exception as e:  # noqa: BLE001
-        errors.append(f"serving: {type(e).__name__}: {e}")
-    try:
-        bench_goodput(extra)
-    except Exception as e:  # noqa: BLE001
-        errors.append(f"goodput: {type(e).__name__}: {e}")
-    if errors:
-        extra["errors"] = errors
+    errors: list[str] = []
+    budget = float(os.environ.get("BENCH_BUDGET_S", "2400"))
+    t_start = time.monotonic()
+    extra["bench_budget_s"] = budget
+    stage_times: dict = {}
+    extra["stage_times"] = stage_times
 
-    if save_s is not None:
-        line = {
-            "metric": "ckpt_save_block_s",
-            "value": round(save_s, 3),
-            "unit": "s",
-            "vs_baseline": round(CKPT_SAVE_BASELINE_S / save_s, 2),
-            "extra": extra,
-        }
-    else:
-        line = {
-            "metric": "ckpt_save_block_s",
-            "value": None,
-            "unit": "s",
-            "vs_baseline": None,
-            "extra": extra,
-        }
-    print(json.dumps(line))
+    def emit() -> None:
+        if errors:
+            extra["errors"] = errors
+        print(_result_line(extra), flush=True)
+
+    def on_alarm(signum, frame):  # noqa: ARG001
+        raise StageTimeout()
+
+    def on_term(signum, frame):  # noqa: ARG001
+        errors.append("SIGTERM: flushed partial results")
+        emit()
+        # re-raise default so the driver still sees the termination
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    signal.signal(signal.SIGALRM, on_alarm)
+    signal.signal(signal.SIGTERM, on_term)
+
+    for st in STAGES:
+        left = budget - (time.monotonic() - t_start)
+        if left < st.est_s:
+            stage_times[st.name] = f"skipped ({left:.0f}s left < " \
+                                   f"est {st.est_s:.0f}s)"
+            continue
+        alarm_s = int(min(st.deadline_s, left))
+        t0 = time.monotonic()
+        signal.alarm(alarm_s)
+        try:
+            if st.pass_budget:
+                st.fn(extra, stage_budget_s=alarm_s)
+            else:
+                st.fn(extra)
+        except StageTimeout:
+            errors.append(f"{st.name}: stage deadline ({alarm_s}s) hit")
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"{st.name}: {type(e).__name__}: {e}")
+        finally:
+            signal.alarm(0)
+        stage_times[st.name] = round(time.monotonic() - t0, 1)
+        emit()
+
+    extra["bench_total_s"] = round(time.monotonic() - t_start, 1)
+    emit()
 
 
 if __name__ == "__main__":

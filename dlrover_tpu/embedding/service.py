@@ -1,0 +1,761 @@
+"""Multi-host sharded embedding service: the elastic-PS analog.
+
+Reference analog: DLRover's elastic parameter servers for sparse models —
+tables sharded across PS processes with runtime scaling
+(dlrover/python/master/elastic_training/elastic_ps.py:82 version-bumped
+PS cluster, master/node/job_auto_scaler.py:98 PSTrainingAutoScaler) over
+tfplus's hybrid embedding storage
+(tfplus/kv_variable/kernels/hybrid_embedding/table_manager.h:1). That is
+the one reference capability a single-process KvEmbeddingTable cannot
+represent: a table bigger than one host's RAM, or a scale event that
+re-partitions rows.
+
+TPU-native shape: the dense tower trains under jit on the chips; the
+unbounded sparse rows live in N *embedding shard servers* (each wrapping
+the native C++ table, embedding/kv_table.py). The trainer's
+``ShardedKvClient`` routes each batch's ids by a stable key hash,
+gathers/updates over the repo's no-pickle length-prefixed TCP framing
+(common/rpc.py), and presents the same lookup/apply surface as the local
+table so the recsys training loop is unchanged.
+
+Elasticity follows the reference's *versioned cluster* design
+(elastic_ps.py: workers watch a version and rebuild): every request
+carries the routing version; a scale event migrates rows server→server
+(each old owner pushes the rows whose new owner differs), then bumps the
+version. A client holding stale routing gets a structured version error,
+refetches the route from the coordinator, and retries — training blocks
+briefly instead of losing updates.
+
+Wire protocol (hot path, so raw arrays rather than JSON floats): one
+frame = JSON header (op, meta, array manifest) + concatenated raw array
+bytes, inside the common/rpc length-prefixed frame.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import struct
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterable
+
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.rpc import recv_frame, send_frame
+from dlrover_tpu.embedding.kv_table import (
+    IncrementalCheckpointManager,
+    KvEmbeddingTable,
+)
+
+logger = get_logger(__name__)
+
+_HLEN = struct.Struct("<I")
+# rows per migration push: bounded so one frame stays well under
+# rpc.MAX_FRAME even for wide tables with optimizer slots
+_MIGRATE_CHUNK_BYTES = 8 << 20
+
+
+def shard_owner(ids: np.ndarray, num_shards: int) -> np.ndarray:
+    """Stable owner shard per id: splitmix64 finalizer then mod — raw
+    ``id % n`` would put every hot contiguous id range on one server."""
+    x = np.asarray(ids, dtype=np.uint64)
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    x = x ^ (x >> np.uint64(31))
+    return (x % np.uint64(num_shards)).astype(np.int64)
+
+
+def encode_msg(op: str, meta: dict | None = None,
+               arrays: dict[str, np.ndarray] | None = None) -> bytes:
+    manifest = {}
+    chunks = []
+    off = 0
+    for name, arr in (arrays or {}).items():
+        arr = np.ascontiguousarray(arr)
+        manifest[name] = {
+            "shape": list(arr.shape), "dtype": str(arr.dtype), "offset": off,
+        }
+        chunks.append(arr.tobytes())
+        off += arr.nbytes
+    header = json.dumps(
+        {"op": op, "meta": meta or {}, "arrays": manifest}
+    ).encode()
+    return b"".join([_HLEN.pack(len(header)), header] + chunks)
+
+
+def decode_msg(payload: bytes) -> tuple[str, dict, dict[str, np.ndarray]]:
+    (hlen,) = _HLEN.unpack(payload[:_HLEN.size])
+    header = json.loads(payload[_HLEN.size:_HLEN.size + hlen])
+    base = _HLEN.size + hlen
+    arrays = {}
+    for name, info in header["arrays"].items():
+        dtype = np.dtype(info["dtype"])
+        count = int(np.prod(info["shape"]))
+        arrays[name] = np.frombuffer(
+            payload, dtype=dtype, count=count, offset=base + info["offset"]
+        ).reshape(info["shape"]).copy()
+    return header["op"], header["meta"], arrays
+
+
+class ShardError(RuntimeError):
+    def __init__(self, code: str, message: str, meta: dict | None = None):
+        super().__init__(f"{code}: {message}")
+        self.code = code
+        self.meta = meta or {}
+
+
+def _call(sock: socket.socket, op: str, meta: dict | None = None,
+          arrays: dict | None = None) -> tuple[dict, dict]:
+    send_frame(sock, encode_msg(op, meta, arrays))
+    rop, rmeta, rarrays = decode_msg(recv_frame(sock))
+    if rop == "err":
+        raise ShardError(rmeta.get("code", "error"),
+                         rmeta.get("message", ""), rmeta)
+    return rmeta, rarrays
+
+
+class EmbeddingShardServer:
+    """One embedding PS shard: a native KvEmbeddingTable behind TCP.
+
+    Owns rows with ``shard_owner(id, num_shards) == index`` at the
+    current routing version. ``migrate_to`` re-partitions under a new
+    epoch, pushing rows to their new owners (the PS migration analog).
+    """
+
+    def __init__(self, dim: int, num_slots: int = 2, *, seed: int = 0,
+                 host: str = "0.0.0.0", port: int = 0,
+                 version: int = 0, num_shards: int = 1, index: int = 0,
+                 ckpt_dir: str = "", base_interval: int = 10):
+        self.table = KvEmbeddingTable(dim=dim, num_slots=num_slots,
+                                      seed=seed + 7919 * index)
+        self.dim = dim
+        self.num_slots = num_slots
+        self.version = version
+        self.num_shards = num_shards
+        self.index = index
+        self._ckpt_dir = ckpt_dir
+        self._base_interval = base_interval
+        self._ckpt: IncrementalCheckpointManager | None = None
+        # one lock serializes table mutations against migration: the
+        # native table is internally thread-safe, but a migrate must see
+        # a frozen row set while it repartitions
+        self._lock = threading.Lock()
+        self._migrating = False
+        self._stop = threading.Event()
+        self._sock = socket.create_server((host, port))
+        self._sock.settimeout(0.5)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"emb-shard-{index}",
+        )
+
+    # ------------------------------------------------------------ lifecycle
+
+    @property
+    def port(self) -> int:
+        return self._sock.getsockname()[1]
+
+    def start(self) -> "EmbeddingShardServer":
+        self._accept_thread.start()
+        logger.info(
+            "embedding shard %d/%d v%d serving on port %d",
+            self.index, self.num_shards, self.version, self.port,
+        )
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    # ------------------------------------------------------------- dispatch
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with conn:
+            while not self._stop.is_set():
+                try:
+                    op, meta, arrays = decode_msg(recv_frame(conn))
+                except (ConnectionError, OSError, ValueError):
+                    return
+                try:
+                    resp = self._handle(op, meta, arrays)
+                except ShardError as e:
+                    resp = encode_msg("err", {
+                        "code": e.code, "message": str(e), **e.meta,
+                    })
+                except Exception as e:  # noqa: BLE001 - report to caller
+                    logger.exception("shard op %s failed", op)
+                    resp = encode_msg("err", {
+                        "code": "internal",
+                        "message": f"{type(e).__name__}: {e}",
+                    })
+                try:
+                    send_frame(conn, resp)
+                except (ConnectionError, OSError):
+                    return
+
+    def _check_epoch(self, meta: dict) -> None:
+        if self._migrating:
+            raise ShardError("migrating", "shard is re-partitioning",
+                             {"retry_ms": 100})
+        v = meta.get("v")
+        if v is not None and v != self.version:
+            raise ShardError(
+                "version",
+                f"client routing v{v} != shard v{self.version}",
+                {"current": self.version},
+            )
+
+    def _handle(self, op: str, meta: dict, arrays: dict) -> bytes:
+        if op == "ping":
+            return encode_msg("ok", {
+                "version": self.version, "num_shards": self.num_shards,
+                "index": self.index, "rows": len(self.table),
+            })
+        if op == "lookup":
+            self._check_epoch(meta)
+            with self._lock:
+                values = self.table.lookup(
+                    arrays["ids"], init_missing=meta.get("init", True)
+                )
+            return encode_msg("ok", arrays={"values": values})
+        if op == "apply":
+            self._check_epoch(meta)
+            with self._lock:
+                self.table.apply(
+                    meta["optimizer"], arrays["ids"], arrays["grads"],
+                    **meta.get("kwargs", {}),
+                )
+            return encode_msg("ok", {"rows": len(self.table)})
+        if op == "import_rows":
+            # migration push from a peer (or a bulk load): no epoch check
+            # — the pusher is mid-migration ahead of the version bump
+            with self._lock:
+                self.table.import_(dict(arrays))
+            return encode_msg("ok", {"rows": len(self.table)})
+        if op == "export":
+            with self._lock:
+                snap = self.table.export(
+                    min_freq=meta.get("min_freq", 0)
+                )
+            return encode_msg("ok", {"rows": int(snap["keys"].size)},
+                              arrays=snap)
+        if op == "rows":
+            return encode_msg("ok", {"rows": len(self.table)})
+        if op == "migrate":
+            moved = self.migrate_to(
+                meta["addrs"], meta["version"],
+                self_index=meta.get("self_index", -1),
+            )
+            return encode_msg("ok", {
+                "moved": moved, "rows": len(self.table),
+            })
+        if op == "set_epoch":
+            with self._lock:
+                self.version = meta["version"]
+                self.num_shards = meta["num_shards"]
+                self.index = meta["index"]
+            return encode_msg("ok", {"version": self.version})
+        if op == "ckpt_save":
+            return encode_msg("ok", {"path": self.ckpt_save()})
+        if op == "ckpt_restore":
+            return encode_msg("ok", {"version": self.ckpt_restore()})
+        raise ShardError("bad_op", f"unknown op {op!r}")
+
+    # ------------------------------------------------------------ migration
+
+    def migrate_to(self, addrs: list[str], new_version: int,
+                   self_index: int = -1) -> int:
+        """Re-partition this shard's rows for the routing ``addrs`` and
+        push every row whose new owner isn't this server. ``self_index``
+        is this server's position in the NEW ring, computed by the
+        coordinator from the address it knows this server by (a
+        port-based self-guess would misfire when multiple hosts use the
+        same port); -1 = scale-down, everything moves. Rows transfer
+        WITH optimizer slots and frequency, chunked to bound frame
+        sizes. Returns rows moved."""
+        self._migrating = True
+        try:
+            with self._lock:
+                new_n = len(addrs)
+                my_index = self_index if 0 <= self_index < new_n else -1
+                snap = self.table.export()
+                keys = snap["keys"]
+                owners = (shard_owner(keys, new_n) if keys.size
+                          else np.zeros(0, np.int64))
+                moved = 0
+                for dest in range(new_n):
+                    if dest == my_index:
+                        continue
+                    sel = owners == dest
+                    if not np.any(sel):
+                        continue
+                    moved += int(sel.sum())
+                    self._push_rows(addrs[dest], {
+                        "keys": keys[sel],
+                        "values": snap["values"][sel],
+                        "slots": snap["slots"][sel]
+                        if "slots" in snap else None,
+                        "freq": snap["freq"][sel],
+                    })
+                    self.table.remove(keys[sel])
+                self.version = new_version
+                self.num_shards = new_n
+                self.index = my_index if my_index >= 0 else 0
+                return moved
+        finally:
+            self._migrating = False
+
+    def _push_rows(self, addr: str, rows: dict) -> None:
+        host, _, port = addr.rpartition(":")
+        row_bytes = self.dim * 4 * (1 + self.num_slots) + 8 + 4
+        chunk = max(1, _MIGRATE_CHUNK_BYTES // row_bytes)
+        with socket.create_connection(
+            (host or "127.0.0.1", int(port)), timeout=30.0
+        ) as conn:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            n = rows["keys"].size
+            for i in range(0, n, chunk):
+                sl = slice(i, i + chunk)
+                payload = {
+                    "keys": rows["keys"][sl],
+                    "values": rows["values"][sl],
+                    "freq": rows["freq"][sl],
+                }
+                if rows.get("slots") is not None:
+                    payload["slots"] = rows["slots"][sl]
+                _call(conn, "import_rows", arrays=payload)
+
+    # ----------------------------------------------------------- checkpoint
+
+    def ckpt_save(self) -> str:
+        if not self._ckpt_dir:
+            raise ShardError("no_ckpt_dir", "server started without one")
+        with self._lock:
+            mgr = self._ckpt_manager()
+            return mgr.save()
+
+    def ckpt_restore(self) -> int:
+        if not self._ckpt_dir:
+            raise ShardError("no_ckpt_dir", "server started without one")
+        with self._lock:
+            mgr = self._ckpt_manager()
+            return mgr.restore()
+
+    def _ckpt_manager(self) -> IncrementalCheckpointManager:
+        # per-(shard-count, index) directory: after a reshard the row
+        # ownership changed, so the old chain must not be appended to —
+        # a fresh manager in a fresh dir starts with a base
+        d = os.path.join(self._ckpt_dir,
+                         f"n{self.num_shards}-s{self.index}")
+        if self._ckpt is None or self._ckpt.directory != d:
+            self._ckpt = IncrementalCheckpointManager(
+                self.table, d, base_interval=self._base_interval
+            )
+        return self._ckpt
+
+
+class EmbeddingCoordinator:
+    """Routing authority: (version, shard addrs) + the scale operation.
+
+    Reference analog: ElasticPsService's version-bumped PS cluster
+    (elastic_ps.py:82) driven by the PS auto-scaler. ``scale()`` runs the
+    migration: every CURRENT server re-partitions against the new address
+    ring (pushing moved rows directly peer-to-peer), then every server in
+    the new ring adopts the bumped epoch. Clients that raced the scale
+    get a version error from a shard and re-fetch the route here."""
+
+    def __init__(self, addrs: Iterable[str], host: str = "0.0.0.0",
+                 port: int = 0):
+        self.version = 0
+        self.addrs = list(addrs)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._sock = socket.create_server((host, port))
+        self._sock.settimeout(0.5)
+        self._thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="emb-coord"
+        )
+
+    @property
+    def port(self) -> int:
+        return self._sock.getsockname()[1]
+
+    def start(self) -> "EmbeddingCoordinator":
+        self._push_epochs()
+        self._thread.start()
+        logger.info("embedding coordinator on port %d (%d shards)",
+                    self.port, len(self.addrs))
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        with conn:
+            while not self._stop.is_set():
+                try:
+                    op, meta, _ = decode_msg(recv_frame(conn))
+                except (ConnectionError, OSError, ValueError):
+                    return
+                if op == "route":
+                    with self._lock:
+                        resp = encode_msg("ok", {
+                            "version": self.version, "addrs": self.addrs,
+                        })
+                elif op == "scale":
+                    try:
+                        self.scale(meta["addrs"])
+                        with self._lock:
+                            resp = encode_msg("ok", {
+                                "version": self.version,
+                                "addrs": self.addrs,
+                            })
+                    except Exception as e:  # noqa: BLE001
+                        resp = encode_msg("err", {
+                            "code": "scale_failed",
+                            "message": f"{type(e).__name__}: {e}",
+                        })
+                else:
+                    resp = encode_msg("err", {"code": "bad_op",
+                                              "message": op})
+                try:
+                    send_frame(conn, resp)
+                except (ConnectionError, OSError):
+                    return
+
+    def _shard_call(self, addr: str, op: str, meta: dict | None = None,
+                    timeout: float = 60.0):
+        host, _, port = addr.rpartition(":")
+        with socket.create_connection(
+            (host or "127.0.0.1", int(port)), timeout=timeout
+        ) as conn:
+            return _call(conn, op, meta)
+
+    def _push_epochs(self) -> None:
+        for i, addr in enumerate(self.addrs):
+            self._shard_call(addr, "set_epoch", {
+                "version": self.version, "num_shards": len(self.addrs),
+                "index": i,
+            })
+
+    def scale(self, new_addrs: list[str]) -> None:
+        """Re-partition the table onto ``new_addrs`` (grow or shrink).
+
+        Order matters: old servers migrate FIRST (each holds rows only it
+        can push; during this window they answer ``migrating`` and
+        clients back off), then the new ring's epochs are set, then the
+        route flips. A scale-down's departing servers are drained by
+        their own migrate (not in the new ring => everything moves)."""
+        with self._lock:
+            old_addrs = list(self.addrs)
+            new_version = self.version + 1
+            for addr in old_addrs:
+                # the coordinator knows each server by address, so IT
+                # computes the server's position in the new ring; no
+                # timeout cap — a migrate streams the shard's whole row
+                # set and may legitimately run for minutes on big tables
+                try:
+                    self_index = new_addrs.index(addr)
+                except ValueError:
+                    self_index = -1
+                meta, _ = self._shard_call(addr, "migrate", {
+                    "addrs": new_addrs, "version": new_version,
+                    "self_index": self_index,
+                }, timeout=None)
+                logger.info("shard %s migrated %d rows", addr,
+                            meta["moved"])
+            for i, addr in enumerate(new_addrs):
+                self._shard_call(addr, "set_epoch", {
+                    "version": new_version, "num_shards": len(new_addrs),
+                    "index": i,
+                })
+            self.version = new_version
+            self.addrs = list(new_addrs)
+
+    def total_rows(self) -> int:
+        with self._lock:
+            addrs = list(self.addrs)
+        return sum(
+            self._shard_call(a, "rows")[0]["rows"] for a in addrs
+        )
+
+
+class ShardedKvClient:
+    """Trainer-side sharded table: the KvEmbeddingTable surface over N
+    shard servers. ``lookup``/``apply`` split each batch by owner shard,
+    fan out in parallel, and reassemble — so the recsys training loop is
+    identical whether the table is local or sharded."""
+
+    def __init__(self, coordinator_addr: str | None = None,
+                 addrs: list[str] | None = None, dim: int = 0,
+                 timeout: float = 30.0):
+        if not coordinator_addr and not addrs:
+            raise ValueError("need coordinator_addr or addrs")
+        self.dim = dim
+        self._timeout = timeout
+        self._coord_addr = coordinator_addr
+        self.version = 0
+        self._addrs: list[str] = list(addrs or [])
+        self._socks: dict[str, socket.socket] = {}
+        self._lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="emb-client"
+        )
+        if coordinator_addr:
+            self.refresh_route()
+        self._step = 0
+
+    # ------------------------------------------------------------- plumbing
+
+    def refresh_route(self) -> None:
+        host, _, port = self._coord_addr.rpartition(":")
+        with socket.create_connection(
+            (host or "127.0.0.1", int(port)), timeout=self._timeout
+        ) as conn:
+            meta, _ = _call(conn, "route")
+        with self._lock:
+            self.version = meta["version"]
+            self._addrs = list(meta["addrs"])
+            # stale sockets may point at drained servers
+            for s in self._socks.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._socks.clear()
+
+    def _sock_for(self, addr: str) -> socket.socket:
+        s = self._socks.get(addr)
+        if s is None:
+            host, _, port = addr.rpartition(":")
+            s = socket.create_connection(
+                (host or "127.0.0.1", int(port)), timeout=self._timeout
+            )
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks[addr] = s
+        return s
+
+    def _shard_call(self, idx: int, op: str, meta: dict,
+                    arrays: dict) -> tuple[dict, dict]:
+        addr = self._addrs[idx]
+        try:
+            return _call(self._sock_for(addr), op, meta, arrays)
+        except (ConnectionError, OSError):
+            # one reconnect: the server may have restarted between ops
+            self._socks.pop(addr, None)
+            return _call(self._sock_for(addr), op, meta, arrays)
+
+    def _fanout(self, op: str, ids: np.ndarray,
+                per_shard_arrays, meta_extra: dict | None = None,
+                retries: int = 60):
+        """Split by owner, call each touched shard, return per-shard
+        (selector, response-arrays) pairs.
+
+        Retry semantics: completion is tracked PER ID — a retry after a
+        route-level failure (version bump, migration in progress, or a
+        dead/drained server) re-sends only the ids whose shard call
+        failed, re-routed under the refreshed route. Shards that already
+        answered are never re-sent, so a scale event racing an ``apply``
+        cannot double-apply gradients to the shards that succeeded.
+        (The residual at-least-once window — a shard that applied but
+        whose *response* was lost — is inherent to retrying writes and
+        matches the sharding-client's at-least-once contract.)"""
+        flat = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        pending = np.ones(flat.size, dtype=bool)
+        results: list[tuple[np.ndarray, dict]] = []
+        last: Exception | None = None
+        for _ in range(retries):
+            if not pending.any():
+                return results, flat
+            n = max(1, len(self._addrs))
+            idxs = np.nonzero(pending)[0]
+            owners = shard_owner(flat[idxs], n)
+            futures = []
+            for s in range(n):
+                sel = idxs[owners == s]
+                if sel.size == 0:
+                    continue
+                meta = {"v": self.version, **(meta_extra or {})}
+                arrays = per_shard_arrays(flat[sel], sel)
+                futures.append((sel, self._pool.submit(
+                    self._shard_call, s, op, meta, arrays
+                )))
+            retriable = False
+            for sel, fut in futures:
+                try:
+                    _, rarrays = fut.result()
+                    results.append((sel, rarrays))
+                    pending[sel] = False
+                except ShardError as e:
+                    last = e
+                    if e.code not in ("version", "migrating"):
+                        raise
+                    retriable = True
+                except (ConnectionError, OSError) as e:
+                    # a drained server may already be gone after a
+                    # scale-down: re-route instead of crashing training
+                    last = e
+                    retriable = True
+            if retriable:
+                time.sleep(0.25)
+                if self._coord_addr:
+                    self.refresh_route()
+        raise RuntimeError(
+            f"embedding fanout kept failing after {retries} tries: {last}"
+        )
+
+    # ------------------------------------------------------------- user ops
+
+    def lookup(self, ids: np.ndarray, init_missing: bool = True
+               ) -> np.ndarray:
+        flat_shape = np.shape(ids)
+        parts, flat = self._fanout(
+            "lookup", ids,
+            lambda shard_ids, sel: {"ids": shard_ids},
+            meta_extra={"init": init_missing},
+        )
+        out = np.empty((flat.size, self.dim), np.float32)
+        for sel, rarrays in parts:
+            out[sel] = rarrays["values"]
+        return out.reshape(*flat_shape, self.dim)
+
+    def apply(self, optimizer: str, ids: np.ndarray, grads: np.ndarray,
+              **kwargs) -> None:
+        g = np.ascontiguousarray(grads, np.float32).reshape(-1, self.dim)
+        self._step += 1
+        if optimizer in ("adam", "group_adam", "radam"):
+            kwargs.setdefault("step", self._step)
+        self._fanout(
+            "apply", ids,
+            lambda shard_ids, sel: {"ids": shard_ids, "grads": g[sel]},
+            meta_extra={"optimizer": optimizer, "kwargs": kwargs},
+        )
+
+    def apply_adam(self, ids: np.ndarray, grads: np.ndarray,
+                   **kwargs) -> None:
+        self.apply("adam", ids, grads, **kwargs)
+
+    def row_count(self) -> int:
+        total = 0
+        for i in range(len(self._addrs)):
+            meta, _ = self._shard_call(i, "rows", {}, {})
+            total += meta["rows"]
+        return total
+
+    def __len__(self) -> int:
+        return self.row_count()
+
+    def export(self, min_freq: int = 0, with_slots: bool = True
+               ) -> dict[str, np.ndarray]:
+        """KvEmbeddingTable-compatible snapshot alias (full table)."""
+        snap = self.export_all()
+        if not with_slots:
+            snap.pop("slots", None)
+        return snap
+
+    def export_all(self) -> dict[str, np.ndarray]:
+        """Full-table snapshot across shards (tests/verification)."""
+        snaps = []
+        for i in range(len(self._addrs)):
+            _, arrays = self._shard_call(i, "export", {}, {})
+            snaps.append(arrays)
+        out: dict[str, np.ndarray] = {}
+        for k in ("keys", "values", "slots", "freq"):
+            if all(k in s for s in snaps):
+                out[k] = np.concatenate([s[k] for s in snaps])
+        return out
+
+    def ckpt_save(self) -> list[str]:
+        return [self._shard_call(i, "ckpt_save", {}, {})[0]["path"]
+                for i in range(len(self._addrs))]
+
+    def ckpt_restore(self) -> list[int]:
+        return [self._shard_call(i, "ckpt_restore", {}, {})[0]["version"]
+                for i in range(len(self._addrs))]
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
+        for s in self._socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._socks.clear()
+
+
+def main(argv=None) -> int:
+    """CLI shard-server entry: prints ``PORT <n>`` once listening (the
+    spawner's readiness/port-discovery contract, like data_worker.py)."""
+    p = argparse.ArgumentParser("embedding shard server")
+    p.add_argument("--dim", type=int, required=True)
+    p.add_argument("--num-slots", type=int, default=2)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--index", type=int, default=0)
+    p.add_argument("--num-shards", type=int, default=1)
+    p.add_argument("--ckpt-dir", default="")
+    p.add_argument("--spill-dir", default="",
+                   help="hybrid tier: spill file for cold rows")
+    args = p.parse_args(argv)
+    server = EmbeddingShardServer(
+        dim=args.dim, num_slots=args.num_slots, seed=args.seed,
+        host=args.host, port=args.port, index=args.index,
+        num_shards=args.num_shards, ckpt_dir=args.ckpt_dir,
+    )
+    if args.spill_dir:
+        os.makedirs(args.spill_dir, exist_ok=True)
+        server.table.enable_spill(os.path.join(
+            args.spill_dir, f"shard-{args.index}.spill"
+        ))
+    server.start()
+    print(f"PORT {server.port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -128,11 +128,20 @@ def auto_strategy(
     return best, reports
 
 
+# bump when the search algorithm or the preset definitions change in a
+# way that should invalidate persisted strategy caches (it is folded
+# into the workload fingerprint alongside the candidate names)
+_SEARCH_VERSION = 2
+
+
 def _workload_fingerprint(kwargs: dict, n_devices: int) -> str:
     """Hash of everything that determines auto_strategy's answer: the
-    abstract parameter tree, batch shapes, objective, HBM budget, and
-    device count — a cache hit for a DIFFERENT model/batch would hand
-    back a strategy that never passed this workload's fit check."""
+    abstract parameter tree, batch shapes, objective, HBM budget,
+    device count, AND the candidate set + search version — a cache hit
+    for a DIFFERENT model/batch would hand back a strategy that never
+    passed this workload's fit check, and a cache written before a
+    preset was added (e.g. the round-3 zero1/zero2 candidates) must not
+    pin the old pick across upgrades."""
     import hashlib
 
     def sig(tree):
@@ -149,12 +158,19 @@ def _workload_fingerprint(kwargs: dict, n_devices: int) -> str:
         lambda a: (tuple(np.shape(a)), str(np.asarray(a).dtype)),
         kwargs["example_batch"],
     )
+    cands = kwargs.get("candidates")
+    cand_names = [
+        c.name for c in (cands if cands is not None
+                         else default_candidates(n_devices))
+    ]
     blob = repr((
         sig(shapes),
         sig(batch_shapes),
         kwargs.get("objective", "fastest"),
         kwargs.get("hbm_capacity_bytes"),
         n_devices,
+        cand_names,
+        _SEARCH_VERSION,
     ))
     return hashlib.sha256(blob.encode()).hexdigest()[:16]
 

@@ -37,6 +37,13 @@ _PROPOSE_TIMEOUT_S = 540.0
 # gives up (and retries, queuing behind the in-flight gate) while the
 # search is still legitimately running
 _CLIENT_TIMEOUT_S = 600.0
+# the search subprocess always runs on the CPU backend, where
+# device_hbm_bytes() reports 0 and the fit check would silently be
+# skipped — a "found" strategy that never passed any memory check could
+# OOM real chips. When the request doesn't carry a budget, assume a
+# conservative TPU one (matches device_hbm_bytes' 16 GiB TPU fallback,
+# parallel/auto.py:46) and say so in the proposal report.
+_DEFAULT_HBM_GB = 16.0
 
 
 def _search_subprocess(req: m.StrategyProposeRequest) -> dict:
@@ -106,6 +113,11 @@ class StrategyEngineService:
 
     def handle(self, msg: Any) -> Any:
         if isinstance(msg, m.StrategyMeasurement):
+            # reject garbage before it can be replayed to later clients
+            # as a found=True proposal that breaks at Strategy.from_json
+            from dlrover_tpu.parallel.strategy import Strategy
+
+            Strategy.from_json(msg.strategy_json)
             key = (msg.model, msg.n_devices, msg.batch, msg.seq,
                    msg.hbm_gb)
             with self._lock:
@@ -123,11 +135,16 @@ class StrategyEngineService:
 
     def propose(self, req: m.StrategyProposeRequest) -> m.StrategyProposal:
         # measured history only applies at the exact shape — at any
-        # other batch/seq the strategy hasn't passed a fit check
+        # other batch/seq the strategy hasn't passed a fit check — and
+        # only for the "fastest" objective: a measured-fastest pick is
+        # exactly what "fastest" asks for, but e.g. "first_fit" callers
+        # want preference order, not speed
         measured_key = (req.model, req.n_devices, req.batch, req.seq,
                         req.hbm_gb)
-        with self._lock:
-            measured = self._measured.get(measured_key)
+        measured = None
+        if req.objective == "fastest":
+            with self._lock:
+                measured = self._measured.get(measured_key)
         if measured is not None:
             return m.StrategyProposal(
                 found=True, strategy_json=measured[1], source="measured",
@@ -212,8 +229,9 @@ def _main() -> None:
     seq = min(cfg.max_seq_len, int(spec["seq"]))
     batch = int(spec["batch"])
     tokens = np.zeros((1, batch, seq + 1), dtype=np.int32)
-    hbm = (int(spec["hbm_gb"] * 2**30)
-           if spec.get("hbm_gb") else None)
+    hbm_assumed = not spec.get("hbm_gb")
+    hbm = int((spec["hbm_gb"] if not hbm_assumed else _DEFAULT_HBM_GB)
+              * 2**30)
     strategy, reports = auto_strategy(
         loss_fn_for=lambda s, mesh: tfm.make_loss_fn(cfg, s, mesh),
         init_params_fn=partial(tfm.init_params, cfg),
@@ -234,6 +252,8 @@ def _main() -> None:
                 if isinstance(v, (int, float, str, bool))
             }
             break
+    if hbm_assumed:
+        report["hbm_assumed_gb"] = _DEFAULT_HBM_GB
     print(json.dumps({
         "strategy_json": strategy.to_json(),
         "report": report,

@@ -42,6 +42,10 @@ logger = get_logger(__name__)
 
 _TAG_BATCH = b"B"
 _TAG_END = b"E"
+# protocol error (e.g. version-skewed request kind): distinct from the
+# end-of-data marker so a confused client raises instead of reading a
+# clean short epoch
+_TAG_ERR = b"X"
 _LEN = struct.Struct("<I")
 
 
@@ -64,9 +68,14 @@ def encode_batch(batch: dict[str, np.ndarray]) -> bytes:
 
 
 def decode_batch(payload: bytes) -> dict[str, np.ndarray] | None:
-    """Inverse of :func:`encode_batch`; ``None`` for the end marker."""
+    """Inverse of :func:`encode_batch`; ``None`` for the end marker.
+    Raises ``ValueError`` on an error frame or an unknown tag."""
     if payload[:1] == _TAG_END:
         return None
+    if payload[:1] == _TAG_ERR:
+        raise ValueError(
+            f"data worker protocol error: {payload[1:].decode(errors='replace')}"
+        )
     if payload[:1] != _TAG_BATCH:
         raise ValueError(f"bad batch frame tag {payload[:1]!r}")
     (hlen,) = _LEN.unpack(payload[1:1 + _LEN.size])
@@ -154,7 +163,11 @@ class DataServiceServer:
                 except (ConnectionError, OSError, ValueError):
                     return
                 if req.get("kind") != "next":
-                    send_frame(conn, _TAG_END)
+                    send_frame(
+                        conn,
+                        _TAG_ERR + f"unknown request kind "
+                                   f"{req.get('kind')!r}".encode(),
+                    )
                     return
                 try:
                     batch = self._next_batch()
@@ -194,13 +207,25 @@ class DataServiceServer:
 
 
 class RemoteBatchLoader:
-    """Trainer side: fan-in iterator over one or more data workers."""
+    """Trainer side: fan-in iterator over one or more data workers.
+
+    A worker FAILURE (unreachable, dropped connection, protocol error) is
+    not a clean end-of-stream: the affected address is recorded in
+    ``failed_workers`` for the current iteration, and with
+    ``strict=True`` the iterator raises at exhaustion instead of handing
+    the training loop a silently short epoch.
+    """
 
     def __init__(self, addrs: list[str], prefetch: int = 4,
-                 connect_timeout: float = 10.0):
+                 connect_timeout: float = 10.0, strict: bool = False):
         self._addrs = list(addrs)
         self._prefetch = prefetch
         self._timeout = connect_timeout
+        self._strict = strict
+        # addresses whose puller ended on a failure (not clean EOF)
+        # during the CURRENT iteration; inspect after exhaustion to
+        # distinguish a truncated epoch from a drained one
+        self.failed_workers: list[str] = []
         self._stop = threading.Event()
         # each __iter__ call is a generation with its own queue; bumping
         # the generation retires the previous iteration's pullers so an
@@ -222,7 +247,8 @@ class RemoteBatchLoader:
                 continue
         return False
 
-    def _pull(self, addr: str, q: queue_mod.Queue, gen: int) -> None:
+    def _pull(self, addr: str, q: queue_mod.Queue, gen: int,
+              failed: list[str]) -> None:
         # the finally-sentinel is load-bearing: __iter__ counts one
         # sentinel per puller, so EVERY exit path must emit it or the
         # training loop waits forever
@@ -236,6 +262,7 @@ class RemoteBatchLoader:
                 conn.settimeout(None)
             except (OSError, ValueError) as e:
                 logger.warning("data worker %s unreachable: %s", addr, e)
+                failed.append(addr)
                 return
             with conn:
                 while not self._retired(gen):
@@ -246,10 +273,12 @@ class RemoteBatchLoader:
                         batch = decode_batch(recv_frame(conn))
                     except (ConnectionError, OSError, ValueError) as e:
                         # ValueError: version-skewed peer sent a frame
-                        # that isn't the batch protocol
+                        # that isn't the batch protocol, or the worker
+                        # answered with an explicit error frame
                         logger.warning(
                             "data worker %s dropped: %s", addr, e
                         )
+                        failed.append(addr)
                         break
                     if batch is None or not self._put(q, gen, batch):
                         break
@@ -267,10 +296,12 @@ class RemoteBatchLoader:
             raise RuntimeError("RemoteBatchLoader is closed")
         self._gen += 1
         gen = self._gen
+        failed: list[str] = []
+        self.failed_workers = failed
         q: queue_mod.Queue = queue_mod.Queue(maxsize=self._prefetch)
         threads = [
             threading.Thread(
-                target=self._pull, args=(a, q, gen), daemon=True,
+                target=self._pull, args=(a, q, gen, failed), daemon=True,
                 name=f"data-pull-g{gen}-{a}",
             )
             for a in self._addrs
@@ -289,6 +320,10 @@ class RemoteBatchLoader:
                 done += 1
                 continue
             yield item
+        if failed and self._strict:
+            raise RuntimeError(
+                f"epoch truncated: data workers failed: {failed}"
+            )
 
     def close(self) -> None:
         self._stop.set()

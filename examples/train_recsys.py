@@ -58,7 +58,83 @@ def parse_args(argv=None):
                    help="with --ckpt-dir: base+delta embedding "
                         "checkpoints (only changed rows per save) every "
                         "--log-interval steps")
+    p.add_argument("--table-shards", type=int, default=0,
+                   help="shard the embedding table across N server "
+                        "processes (the elastic-PS analog, "
+                        "embedding/service.py); 0 = in-process table")
+    p.add_argument("--table-coordinator", default="",
+                   help="connect to an existing embedding coordinator "
+                        "instead of spawning local shard servers")
     return p.parse_args(argv)
+
+
+def _spawn_sharded_table(args, ckpt_dir: str):
+    """Spawn --table-shards local shard-server processes + coordinator;
+    returns (client, cleanup). The multi-host deployment runs the same
+    ``python -m dlrover_tpu.embedding.service`` servers on CPU hosts and
+    passes --table-coordinator instead."""
+    import atexit
+    import subprocess
+
+    from dlrover_tpu.embedding.service import (
+        EmbeddingCoordinator,
+        ShardedKvClient,
+    )
+
+    procs, addrs = [], []
+
+    def _kill_procs():
+        for p_ in procs:
+            p_.terminate()
+        for p_ in procs:
+            try:
+                p_.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p_.kill()
+
+    try:
+        for i in range(args.table_shards):
+            cmd = [sys.executable, "-m", "dlrover_tpu.embedding.service",
+                   "--dim", str(args.dim), "--host", "127.0.0.1",
+                   "--index", str(i),
+                   "--num-shards", str(args.table_shards)]
+            if ckpt_dir:
+                cmd += ["--ckpt-dir",
+                        os.path.join(ckpt_dir, "embedding-shards")]
+            if args.spill_dir:
+                cmd += ["--spill-dir", args.spill_dir]
+            proc = subprocess.Popen(
+                cmd, stdout=subprocess.PIPE, text=True,
+                env={**os.environ, "JAX_PLATFORMS": "cpu",
+                     "DLROVER_TPU_PLATFORM": "cpu"},
+            )
+            procs.append(proc)
+            line = proc.stdout.readline().strip()
+            if not line.startswith("PORT "):
+                raise RuntimeError(
+                    f"shard server {i} failed to start: {line!r}")
+            addrs.append(f"127.0.0.1:{line.split()[1]}")
+        coord = EmbeddingCoordinator(addrs, host="127.0.0.1").start()
+        client = ShardedKvClient(
+            coordinator_addr=f"127.0.0.1:{coord.port}", dim=args.dim
+        )
+    except BaseException:
+        _kill_procs()
+        raise
+
+    def cleanup():
+        if procs:
+            client.close()
+            coord.stop()
+            _kill_procs()
+            procs.clear()
+
+    # a mid-training crash must not orphan the server processes (their
+    # main loop sleeps forever); atexit covers every interpreter exit
+    # path short of SIGKILL, and cleanup() is idempotent for the
+    # success path's explicit call
+    atexit.register(cleanup)
+    return client, cleanup
 
 
 def main(argv=None) -> int:
@@ -72,29 +148,43 @@ def main(argv=None) -> int:
     from dlrover_tpu.trainer import bootstrap
 
     ctx = bootstrap.init_from_env()
-    table = KvEmbeddingTable(dim=args.dim, num_slots=2, seed=1234)
-    if args.spill_dir:
-        os.makedirs(args.spill_dir, exist_ok=True)
-        table.enable_spill(os.path.join(
-            args.spill_dir, f"recsys-{ctx.node_id}.spill"
-        ))
-
+    sharded_cleanup = None
     inc_mgr = None
-    if args.incremental_ckpt and args.ckpt_dir:
-        from dlrover_tpu.embedding.kv_table import (
-            IncrementalCheckpointManager,
-        )
+    if args.table_coordinator:
+        from dlrover_tpu.embedding.service import ShardedKvClient
 
-        # node-scoped like the spill file and the CheckpointEngine:
-        # each node's table has its own base/delta chain
-        inc_mgr = IncrementalCheckpointManager(
-            table,
-            os.path.join(args.ckpt_dir, f"embedding-inc-{ctx.node_id}"),
+        table = ShardedKvClient(
+            coordinator_addr=args.table_coordinator, dim=args.dim
         )
-        restored = inc_mgr.restore()
-        if restored:
-            print(f"[recsys] embedding table restored at version "
-                  f"{restored} ({len(table)} rows)", flush=True)
+    elif args.table_shards:
+        table, sharded_cleanup = _spawn_sharded_table(args, args.ckpt_dir)
+        if args.incremental_ckpt and args.ckpt_dir:
+            restored = table.ckpt_restore()
+            if any(restored):
+                print(f"[recsys] sharded table restored at versions "
+                      f"{restored} ({len(table)} rows)", flush=True)
+    else:
+        table = KvEmbeddingTable(dim=args.dim, num_slots=2, seed=1234)
+        if args.spill_dir:
+            os.makedirs(args.spill_dir, exist_ok=True)
+            table.enable_spill(os.path.join(
+                args.spill_dir, f"recsys-{ctx.node_id}.spill"
+            ))
+        if args.incremental_ckpt and args.ckpt_dir:
+            from dlrover_tpu.embedding.kv_table import (
+                IncrementalCheckpointManager,
+            )
+
+            # node-scoped like the spill file and the CheckpointEngine:
+            # each node's table has its own base/delta chain
+            inc_mgr = IncrementalCheckpointManager(
+                table,
+                os.path.join(args.ckpt_dir, f"embedding-inc-{ctx.node_id}"),
+            )
+            restored = inc_mgr.restore()
+            if restored:
+                print(f"[recsys] embedding table restored at version "
+                      f"{restored} ({len(table)} rows)", flush=True)
 
     # dense tower: concat field embeddings -> MLP -> logit
     d_in = args.fields * args.dim
@@ -164,7 +254,14 @@ def main(argv=None) -> int:
                     # interval's save retries them — keep training
                     print(f"[recsys] incremental ckpt postponed: {e}",
                           flush=True)
-        if args.spill_dir and step % args.spill_interval == 0:
+            elif (args.incremental_ckpt and args.ckpt_dir
+                  and hasattr(table, "ckpt_save")):
+                paths = table.ckpt_save()
+                print(f"[recsys] sharded incremental ckpt: "
+                      f"{[os.path.basename(p) for p in paths]}",
+                      flush=True)
+        if (args.spill_dir and hasattr(table, "evict")
+                and step % args.spill_interval == 0):
             spilled = table.evict(max_freq=args.spill_max_freq)
             if spilled:
                 print(f"[recsys] spilled {spilled} cold rows "
@@ -195,6 +292,8 @@ def main(argv=None) -> int:
             )
     print(f"[recsys] done: {args.steps * args.batch / wall:.0f} examples/s",
           flush=True)
+    if sharded_cleanup is not None:
+        sharded_cleanup()
     return 0
 
 

@@ -187,15 +187,48 @@ class TestService:
             loader = RemoteBatchLoader([f"127.0.0.1:{srv.port}"])
             got = [int(b["weight"][0]) for b in loader]  # must terminate
             assert got == [0]
+            # the failure is programmatically visible, not just a log
+            # line — trainers can tell a truncated epoch from a drained
+            # one (round-3 advisor finding)
+            assert loader.failed_workers == [f"127.0.0.1:{srv.port}"]
+        finally:
+            srv.stop()
+
+    def test_strict_loader_raises_on_truncated_epoch(self):
+        def produce():
+            yield {"weight": np.asarray([0], np.float32)}
+            raise RuntimeError("corrupt shard")
+
+        srv = DataServiceServer(produce, host="127.0.0.1").start()
+        try:
+            loader = RemoteBatchLoader([f"127.0.0.1:{srv.port}"],
+                                       strict=True)
+            with pytest.raises(RuntimeError, match="truncated"):
+                list(loader)
+        finally:
+            srv.stop()
+
+    def test_strict_loader_clean_drain_does_not_raise(self):
+        srv = DataServiceServer(_batches(3), host="127.0.0.1").start()
+        try:
+            loader = RemoteBatchLoader([f"127.0.0.1:{srv.port}"],
+                                       strict=True)
+            assert len(list(loader)) == 3
+            assert loader.failed_workers == []
         finally:
             srv.stop()
 
     def test_protocol_rejects_unknown_kind(self):
+        # an unknown kind answers an ERROR frame, not end-of-data — a
+        # version-skewed client must raise, not read a completed epoch
         srv = DataServiceServer(_batches(2), host="127.0.0.1").start()
         try:
             conn = socket.create_connection(("127.0.0.1", srv.port))
             send_frame(conn, b'{"kind": "bogus"}')
-            assert recv_frame(conn) == b"E"
+            frame = recv_frame(conn)
+            assert frame[:1] == b"X"
+            with pytest.raises(ValueError):
+                decode_batch(frame)
             conn.close()
         finally:
             srv.stop()

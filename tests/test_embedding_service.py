@@ -1,0 +1,295 @@
+"""Sharded embedding service (embedding/service.py) — the elastic-PS
+analog: key-space partition, trainer fan-out, elastic re-shard with row
+migration, sharded delta checkpoints.
+
+Reference: dlrover elastic_ps.py:82 (version-bumped PS cluster),
+tfplus hybrid_embedding/table_manager.h (sharded sparse storage).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.embedding.kv_table import KvEmbeddingTable
+from dlrover_tpu.embedding.service import (
+    EmbeddingCoordinator,
+    EmbeddingShardServer,
+    ShardedKvClient,
+    decode_msg,
+    encode_msg,
+    shard_owner,
+)
+
+DIM = 8
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    """Two shard servers + coordinator + client; yields a dict so tests
+    can grow/shrink the ring."""
+    servers = [
+        EmbeddingShardServer(
+            dim=DIM, num_slots=2, seed=7, host="127.0.0.1",
+            index=i, num_shards=2, ckpt_dir=str(tmp_path / "ckpt"),
+        ).start()
+        for i in range(2)
+    ]
+    addrs = [f"127.0.0.1:{s.port}" for s in servers]
+    coord = EmbeddingCoordinator(addrs, host="127.0.0.1").start()
+    client = ShardedKvClient(
+        coordinator_addr=f"127.0.0.1:{coord.port}", dim=DIM
+    )
+    state = {"servers": servers, "coord": coord, "client": client,
+             "tmp_path": tmp_path}
+    yield state
+    client.close()
+    coord.stop()
+    for s in state["servers"]:
+        s.stop()
+
+
+def _seed_rows(servers, keys, values):
+    """Place known rows on their owner shards (slots zeroed)."""
+    n = len(servers)
+    owners = shard_owner(keys, n)
+    for i, srv in enumerate(servers):
+        sel = owners == i
+        if sel.any():
+            srv.table.import_({
+                "keys": keys[sel],
+                "values": values[sel],
+                "slots": np.zeros(
+                    (int(sel.sum()), 2 * DIM), np.float32
+                ),
+                "freq": np.ones(int(sel.sum()), np.uint32),
+            })
+
+
+class TestProtocol:
+    def test_msg_roundtrip(self):
+        arrays = {
+            "ids": np.arange(5, dtype=np.int64),
+            "vals": np.random.default_rng(0).standard_normal(
+                (5, 3)).astype(np.float32),
+        }
+        op, meta, out = decode_msg(
+            encode_msg("lookup", {"v": 3}, arrays)
+        )
+        assert op == "lookup" and meta == {"v": 3}
+        np.testing.assert_array_equal(out["ids"], arrays["ids"])
+        np.testing.assert_array_equal(out["vals"], arrays["vals"])
+
+    def test_shard_owner_stable_and_balanced(self):
+        ids = np.arange(100_000, dtype=np.int64)
+        o3 = shard_owner(ids, 3)
+        # deterministic
+        np.testing.assert_array_equal(o3, shard_owner(ids, 3))
+        # contiguous id ranges spread across shards (mixing works)
+        counts = np.bincount(o3, minlength=3)
+        assert counts.min() > 25_000
+        # hot contiguous block does not land on one shard
+        assert len(np.unique(shard_owner(ids[:100], 3))) == 3
+
+
+class TestShardedOps:
+    def test_lookup_matches_seeded_rows(self, cluster):
+        keys = np.arange(0, 500, dtype=np.int64)
+        vals = np.random.default_rng(1).standard_normal(
+            (keys.size, DIM)).astype(np.float32)
+        _seed_rows(cluster["servers"], keys, vals)
+        got = cluster["client"].lookup(keys, init_missing=False)
+        np.testing.assert_allclose(got, vals, rtol=0, atol=0)
+
+    def test_apply_matches_local_table(self, cluster):
+        """Sharded Adam == single-table Adam on identical rows."""
+        keys = np.arange(100, dtype=np.int64)
+        vals = np.random.default_rng(2).standard_normal(
+            (keys.size, DIM)).astype(np.float32)
+        _seed_rows(cluster["servers"], keys, vals)
+        local = KvEmbeddingTable(dim=DIM, num_slots=2, seed=99)
+        local.import_({
+            "keys": keys, "values": vals,
+            "slots": np.zeros((keys.size, 2 * DIM), np.float32),
+            "freq": np.ones(keys.size, np.uint32),
+        })
+        rng = np.random.default_rng(3)
+        for step in range(1, 4):
+            grads = rng.standard_normal(
+                (keys.size, DIM)).astype(np.float32)
+            cluster["client"].apply("adam", keys, grads, lr=1e-2,
+                                    step=step)
+            local.apply_adam(keys, grads, lr=1e-2, step=step)
+        got = cluster["client"].lookup(keys, init_missing=False)
+        np.testing.assert_allclose(
+            got, local.lookup(keys, init_missing=False),
+            rtol=1e-6, atol=1e-7,
+        )
+
+    def test_batched_shapes(self, cluster):
+        ids = np.arange(24, dtype=np.int64).reshape(4, 6)
+        out = cluster["client"].lookup(ids)
+        assert out.shape == (4, 6, DIM)
+
+
+class TestElasticReshard:
+    def _snapshot(self, client):
+        snap = client.export_all()
+        order = np.argsort(snap["keys"])
+        return {k: v[order] for k, v in snap.items()}
+
+    def test_scale_up_preserves_rows_and_values(self, cluster):
+        keys = np.arange(2000, dtype=np.int64)
+        vals = np.random.default_rng(4).standard_normal(
+            (keys.size, DIM)).astype(np.float32)
+        _seed_rows(cluster["servers"], keys, vals)
+        # give rows nonzero optimizer slots so slot migration is tested
+        g = np.ones((keys.size, DIM), np.float32)
+        cluster["client"].apply("adam", keys, g, lr=1e-3, step=1)
+        before = self._snapshot(cluster["client"])
+
+        new_srv = EmbeddingShardServer(
+            dim=DIM, num_slots=2, seed=7, host="127.0.0.1", index=2,
+            num_shards=3,
+            ckpt_dir=str(cluster["tmp_path"] / "ckpt"),
+        ).start()
+        cluster["servers"].append(new_srv)
+        addrs = [f"127.0.0.1:{s.port}" for s in cluster["servers"]]
+        cluster["coord"].scale(addrs)
+
+        # every shard now holds exactly its hash partition
+        for i, srv in enumerate(cluster["servers"]):
+            srv_keys = srv.table.export()["keys"]
+            if srv_keys.size:
+                assert (shard_owner(srv_keys, 3) == i).all()
+        assert new_srv.table.export()["keys"].size > 0  # rows moved
+
+        cluster["client"].refresh_route()
+        after = self._snapshot(cluster["client"])
+        np.testing.assert_array_equal(before["keys"], after["keys"])
+        np.testing.assert_allclose(before["values"], after["values"],
+                                   rtol=0, atol=0)
+        np.testing.assert_allclose(before["slots"], after["slots"],
+                                   rtol=0, atol=0)
+        # training continues post-reshard
+        cluster["client"].apply("adam", keys, g, lr=1e-3, step=2)
+        assert cluster["client"].row_count() == keys.size
+
+    def test_scale_down_drains_departing_server(self, cluster):
+        # grow to 3 first, then shrink back to 2
+        keys = np.arange(1500, dtype=np.int64)
+        vals = np.random.default_rng(5).standard_normal(
+            (keys.size, DIM)).astype(np.float32)
+        _seed_rows(cluster["servers"], keys, vals)
+        third = EmbeddingShardServer(
+            dim=DIM, num_slots=2, seed=7, host="127.0.0.1",
+        ).start()
+        cluster["servers"].append(third)
+        all_addrs = [f"127.0.0.1:{s.port}" for s in cluster["servers"]]
+        cluster["coord"].scale(all_addrs)
+        cluster["client"].refresh_route()
+        before = self._snapshot(cluster["client"])
+        assert len(third.table) > 0
+
+        cluster["coord"].scale(all_addrs[:2])
+        assert len(third.table) == 0  # fully drained
+        cluster["client"].refresh_route()
+        after = self._snapshot(cluster["client"])
+        np.testing.assert_array_equal(before["keys"], after["keys"])
+        np.testing.assert_allclose(before["values"], after["values"],
+                                   rtol=0, atol=0)
+
+    def test_stale_client_rerouted_mid_training(self, cluster):
+        """A client that raced the scale keeps training: version errors
+        trigger a route refresh + retry, no updates lost."""
+        keys = np.arange(800, dtype=np.int64)
+        _seed_rows(cluster["servers"], keys,
+                   np.zeros((keys.size, DIM), np.float32))
+        stop = threading.Event()
+        applied = []
+        errors = []
+
+        def trainer():
+            step = 0
+            while not stop.is_set():
+                step += 1
+                try:
+                    cluster["client"].apply(
+                        "adam", keys,
+                        np.ones((keys.size, DIM), np.float32),
+                        lr=1e-3, step=step,
+                    )
+                    applied.append(step)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+                    return
+                time.sleep(0.01)
+
+        t = threading.Thread(target=trainer, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        new_srv = EmbeddingShardServer(
+            dim=DIM, num_slots=2, seed=7, host="127.0.0.1",
+        ).start()
+        cluster["servers"].append(new_srv)
+        addrs = [f"127.0.0.1:{s.port}" for s in cluster["servers"]]
+        cluster["coord"].scale(addrs)
+        time.sleep(0.5)
+        stop.set()
+        t.join(timeout=30)
+        assert not t.is_alive()
+        assert not errors, errors[:1]
+        n_before = len(applied)
+        assert n_before >= 2
+        assert cluster["client"].row_count() == keys.size
+
+
+class TestShardedCheckpoint:
+    def test_sharded_delta_ckpt_roundtrip(self, cluster, tmp_path):
+        keys = np.arange(600, dtype=np.int64)
+        vals = np.random.default_rng(6).standard_normal(
+            (keys.size, DIM)).astype(np.float32)
+        _seed_rows(cluster["servers"], keys, vals)
+        client = cluster["client"]
+        client.ckpt_save()  # base
+        g = np.ones((keys.size, DIM), np.float32)
+        client.apply("adam", keys, g, lr=1e-2, step=1)
+        paths = client.ckpt_save()  # delta (only changed rows)
+        assert any("delta-" in p for p in paths)
+        expect = self._sorted(client.export_all())
+
+        # fresh servers restore base + delta at the same shard layout
+        restored = [
+            EmbeddingShardServer(
+                dim=DIM, num_slots=2, seed=7, host="127.0.0.1",
+                index=i, num_shards=2,
+                ckpt_dir=str(cluster["tmp_path"] / "ckpt"),
+            ).start()
+            for i in range(2)
+        ]
+        try:
+            coord2 = EmbeddingCoordinator(
+                [f"127.0.0.1:{s.port}" for s in restored],
+                host="127.0.0.1",
+            ).start()
+            c2 = ShardedKvClient(
+                coordinator_addr=f"127.0.0.1:{coord2.port}", dim=DIM
+            )
+            c2.ckpt_restore()
+            got = self._sorted(c2.export_all())
+            np.testing.assert_array_equal(expect["keys"], got["keys"])
+            np.testing.assert_allclose(expect["values"], got["values"],
+                                       rtol=0, atol=0)
+            np.testing.assert_allclose(expect["slots"], got["slots"],
+                                       rtol=0, atol=0)
+            c2.close()
+            coord2.stop()
+        finally:
+            for s in restored:
+                s.stop()
+
+    @staticmethod
+    def _sorted(snap):
+        order = np.argsort(snap["keys"])
+        return {k: v[order] for k, v in snap.items()}

@@ -99,3 +99,57 @@ class TestEngineService:
             t.join(timeout=30)
         assert len(calls) == 1, calls
         assert all(r.found and r.source == "dry_run" for r in results)
+
+
+class TestRound4Hardening:
+    """Round-3 advisor findings: fit-check default + measurement
+    validation + objective scoping."""
+
+    def test_default_hbm_fit_check_not_vacuous(self):
+        """With hbm_gb unset the subprocess must assume a conservative
+        TPU budget (16 GiB) rather than skipping the fit check, and say
+        so in the report."""
+        service = StrategyEngineService().start()
+        client = StrategyEngineClient(service.addr)
+        try:
+            prop = client.propose("tiny", 8, batch=8, seq=64)
+            assert prop.found, prop.error
+            assert prop.report.get("hbm_assumed_gb") == 16.0
+            # an explicit budget is used as-is: no assumption note
+            prop2 = client.propose("tiny", 8, batch=8, seq=64,
+                                   hbm_gb=32.0)
+            assert prop2.found, prop2.error
+            assert "hbm_assumed_gb" not in prop2.report
+        finally:
+            client.close()
+            service.stop()
+
+    def test_measurement_rejects_malformed_strategy_json(self):
+        service = StrategyEngineService().start()
+        client = StrategyEngineClient(service.addr)
+        try:
+            with pytest.raises(RuntimeError):
+                client.report_measurement(
+                    "tiny", 8, "not json at all", step_time_s=0.01)
+            # the garbage must not have been stored
+            assert not service._measured
+        finally:
+            client.close()
+            service.stop()
+
+    def test_measured_history_scoped_to_fastest_objective(self):
+        """A first_fit request wants preference order, not the measured
+        fastest pick (advisor: measured key ignored the objective)."""
+        service = StrategyEngineService().start()
+        client = StrategyEngineClient(service.addr)
+        try:
+            client.report_measurement("tiny", 8, fsdp(fsdp_size=8),
+                                      step_time_s=0.01)
+            prop = client.propose("tiny", 8, objective="first_fit")
+            assert prop.found, prop.error
+            assert prop.source == "dry_run"
+            prop2 = client.propose("tiny", 8, objective="fastest")
+            assert prop2.found and prop2.source == "measured"
+        finally:
+            client.close()
+            service.stop()

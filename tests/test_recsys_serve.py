@@ -52,3 +52,34 @@ def test_train_then_serve_roundtrip(tmp_ipc_dir, tmp_path):
     # the parity signal memorized in the embeddings survived the
     # round-trip; chance is 0.5
     assert out["accuracy"] > 0.8, out
+
+
+@pytest.mark.timeout(300)
+def test_train_sharded_table_e2e(tmp_path):
+    """BASELINE config 5 shape: the same training loop over a 2-shard
+    embedding service (spawned server processes), learning the signal
+    and checkpointing across shards."""
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": REPO,
+        "DLROVER_TPU_IPC_DIR": str(tmp_path / "ipc"),
+        "JAX_PLATFORMS": "cpu",
+        "DLROVER_TPU_PLATFORM": "cpu",
+    })
+    train = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples",
+                                      "train_recsys.py"),
+         "--steps", "150", "--batch", "128", "--id-space", "20000",
+         "--table-shards", "2",
+         "--ckpt-dir", str(tmp_path / "ckpt"), "--incremental-ckpt",
+         "--log-interval", "50",
+         "--result-file", str(tmp_path / "train.json")],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=240,
+    )
+    assert train.returncode == 0, train.stderr[-2000:]
+    out = json.load(open(tmp_path / "train.json"))
+    assert out["table_rows"] > 1000
+    assert out["last_loss"] < out["first_loss"]
+    # sharded incremental checkpoints landed (one chain per shard)
+    shard_dirs = os.listdir(tmp_path / "ckpt" / "embedding-shards")
+    assert sorted(shard_dirs) == ["n2-s0", "n2-s1"]
