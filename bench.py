@@ -45,6 +45,16 @@ class StageTimeout(Exception):
     pass
 
 
+def _recompute_factor(cfg) -> float:
+    """Backward-recompute multiplier on model FLOPs for the hw-util
+    estimate (fwd:bwd ~ 1:2; recomputed fraction f of a forward adds
+    f/3 of total)."""
+    if not cfg.remat_scan or cfg.remat_policy not in ("nothing", "full"):
+        return 1.0  # dots saved: only elementwise recompute
+    k = max(1, cfg.remat_interval)
+    return 1.0 + (k - 1 if k > 1 else k) / (3.0 * k)
+
+
 def _train_one(extra: dict, prefix: str, model: str, batch: int, seq: int,
                steps: int, cfg_overrides: dict,
                optimizer: str = "adamw") -> None:
@@ -129,11 +139,13 @@ def _train_one(extra: dict, prefix: str, model: str, batch: int, seq: int,
         f"{prefix}mfu":
             round(flops_per_step / step_s / peak, 4) if peak else None,
         # model-FLOPs MFU understates device work under activation
-        # remat: the backward re-executes ~a full forward (~1.33x model
-        # FLOPs total), so hardware utilization is ~mfu * 4/3 with the
-        # dots_no_batch policy.
+        # remat; the recompute factor depends on the policy: full
+        # recompute re-runs ~a forward (4/3 total), interleaved
+        # remat_interval=k re-runs (k-1)/k of one (1 + (k-1)/(3k)), and
+        # dots-saved policies recompute only elementwise ops (~1).
         f"{prefix}mfu_hw_est": (
-            round(flops_per_step * 4 / 3 / step_s / peak, 4)
+            round(flops_per_step * _recompute_factor(cfg) / step_s
+                  / peak, 4)
             if peak and on_tpu else None),
         # raw XLA cost analysis; undercounts lax.scan/while bodies, so it
         # is NOT a utilization figure — recorded for cross-round tracking
@@ -162,36 +174,41 @@ def bench_train_step(extra: dict) -> None:
         return
 
     # Headline FIRST so a stage deadline can only cost the secondary.
-    # Policy notes (carried from the r03 sweep on gpt2-small, re-checked
-    # on medium in r04): dots_no_batch remat + splash attention + 16-chunk
-    # blockwise CE; scan unroll lets XLA prefetch weights across layers.
+    # Config from the r04 on-chip sweep (17 candidates): b24 +
+    # interleaved remat (remat_interval=2: only every other layer
+    # recomputes in backward) + dots_no_batch for the rematted ones +
+    # splash + 16-chunk CE + 8-bit Adam (the int8 moments are what buy
+    # the headroom: f32 AdamW OOMs every >=0.5-class config). Sweep
+    # landmarks: b32 full-recompute 0.437 (adamw) / 0.455 (8-bit),
+    # b16 int2 0.485, b16 int2+dots 0.513, b24 int2 0.517,
+    # b24 int2+dots 0.520 (pick); b32 int2 0.510, every dots config
+    # >=b32 and all f32-Adam variants OOM (16.1-30.3G vs 15.75G).
     medium_err = None
     try:
         _train_one(
             extra, "medium_", "gpt2-medium",
-            batch=int(os.environ.get("BENCH_MEDIUM_BATCH", "32")),
+            batch=int(os.environ.get("BENCH_MEDIUM_BATCH", "24")),
             seq=int(os.environ.get("BENCH_SEQ", "1024")),
             steps=int(os.environ.get("BENCH_MEDIUM_STEPS", "20")),
             cfg_overrides=dict(
                 remat_scan=True, remat_policy="dots_no_batch",
-                attention="splash", ce_chunks=16,
+                remat_interval=2, attention="splash", ce_chunks=16,
                 scan_unroll=int(os.environ.get("BENCH_MEDIUM_UNROLL",
-                                               "24")),
+                                               "8")),
             ),
+            optimizer="adam8bit",
         )
         extra["mfu_medium"] = extra.get("medium_mfu")
     except Exception as e:  # noqa: BLE001 - keep the secondary alive
         medium_err = f"{type(e).__name__}: {e}"
         extra["mfu_medium_error"] = medium_err[:300]
 
-    # gpt2-small secondary: per-layer remat bounds residuals to one layer
-    # of the scanned stack — without it the 12-layer attention-logit
-    # residuals alone (~9 GB f32 at batch 16 / seq 1024) exceed a v5e's
-    # 16 GB HBM. This config is HBM-BANDWIDTH-bound (r03 ceiling
-    # analysis): every memory<->FLOPs trade measures flat or worse, and
-    # the step's ~0.53 hardware utilization is ~85% of what pure matmul
-    # chains can do at d_model=768. Exhaustive r03 policy sweep:
-    # save_attn_ffn 0.384, save_attn 0.382, dots_no_batch 0.393 (pick).
+    # gpt2-small secondary. NOTE: the r03 "bandwidth-bound ceiling"
+    # analysis (0.393 MFU, ~85% of the d_model=768 matmul roofline) was
+    # measured with attention silently DENSE (the bare-loss_fn bug fixed
+    # above); with splash actually engaged the same geometry measures
+    # 0.61 MFU (r04) — the dense [B,H,S,S] logit traffic, not d_model,
+    # was the ceiling.
     _train_one(
         extra, "", os.environ.get("BENCH_MODEL", "gpt2-small"),
         batch=int(os.environ.get("BENCH_BATCH", "32")),

@@ -105,6 +105,7 @@ class ElasticAgent:
         self._config_tuner = None
         self._buddy_server = None
         self._buddy_replicator = None
+        self._preemption_watcher = None
         self._world: dict[int, int] = {}
         self._node_rank = -1
         self._pending_action = ""
@@ -198,12 +199,15 @@ class ElasticAgent:
         self._start_resource_monitor()
         self._start_config_tuner()
         self._start_buddy_replication()
+        self._start_preemption_watcher()
         try:
             if self._config.network_check:
                 self._run_network_check()
             return self._invoke_run()
         finally:
             self._stopped.set()
+            if self._preemption_watcher is not None:
+                self._preemption_watcher.stop()
             if self._resource_monitor is not None:
                 self._resource_monitor.stop()
             if self._config_tuner is not None:
@@ -428,6 +432,47 @@ class ElasticAgent:
             interval_s=interval,
         )
         self._buddy_replicator.start()
+
+    def _start_preemption_watcher(self) -> None:
+        """Arm the maintenance/preemption-notice watcher
+        (agent/preemption.py); inert unless a notice source env is set."""
+        from dlrover_tpu.agent.preemption import PreemptionWatcher
+
+        watcher = PreemptionWatcher(
+            self._on_preemption_notice, node_id=self._config.node_id,
+            poll_interval_s=min(1.0, self._config.monitor_interval_s),
+        )
+        if watcher.enabled:
+            self._preemption_watcher = watcher.start()
+
+    def _on_preemption_notice(self) -> None:
+        """The kill is coming: protect the snapshot while the host is
+        still alive, then arm the master's fast relaunch. Order matters —
+        the buddy push is what the <10s no-storage restore needs; the
+        storage persist is the belt-and-braces fallback."""
+        start = time.monotonic()
+        # master first: it is a cheap RPC, and if the kill lands during
+        # the (slow, multi-GB) replication/persist below, the master
+        # must already be on the short dead-window or the relaunch waits
+        # the full heartbeat window
+        try:
+            self._client.report_preemption_notice()
+        except (ConnectionError, RuntimeError, OSError) as e:
+            logger.warning("could not report preemption notice: %s", e)
+        replicated = False
+        if self._buddy_replicator is not None:
+            try:
+                # replicate_once is a no-op when the buddy already holds
+                # the current step — "protected" either way
+                self._buddy_replicator.replicate_once()
+                replicated = True
+            except Exception:  # noqa: BLE001 - keep preparing
+                logger.exception("pre-kill buddy replication failed")
+        self._persist_checkpoint(reason="preemption notice")
+        logger.warning(
+            "preemption prepare done in %.2fs (buddy replicated: %s)",
+            time.monotonic() - start, replicated,
+        )
 
     def _restore_from_buddy(self) -> None:
         """Pre-spawn: if this host's shm snapshot is gone (node relaunch

@@ -137,6 +137,12 @@ class MasterClient:
             m.ReportBuddyEndpoint(node_id=self.node_id, addr=addr)
         )
 
+    def report_preemption_notice(self, deadline_s: float = 0.0) -> None:
+        self._client.call(
+            m.PreemptionNotice(node_id=self.node_id,
+                               deadline_s=deadline_s)
+        )
+
     def query_buddy(self) -> m.BuddyQueryResponse:
         return self._client.call(
             m.BuddyQueryRequest(node_id=self.node_id)

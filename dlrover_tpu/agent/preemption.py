@@ -1,0 +1,114 @@
+"""Preemption/maintenance-notice watcher: save BEFORE the kill.
+
+Reference analog: Flash Checkpoint's breakpoint save fires when a
+failure has already happened (reference ckpt_saver.py:631
+save_shm_to_storage, triggered from training.py:590-610). TPU preemption
+is better than that: the platform *announces* the kill (GCE maintenance
+events / TPU preemption notices), and a preempted host VM loses its
+shared memory — restart-in-place never applies (SURVEY §7
+"restart-in-place vs preemption"). So the agent watches for the notice
+and, the moment it lands, (1) force-replicates the current shm snapshot
+to its buddy host over DCN (checkpoint/buddy.py), (2) runs the
+breakpoint persist, and (3) tells the master to arm the short
+dead-window so the replacement host launches seconds after the VM dies.
+The relaunched agent then restores from the buddy with zero storage
+reads (elastic_agent._restore_from_buddy).
+
+Notice sources, in precedence order:
+- ``DLROVER_TPU_PREEMPTION_FILE``: a path; the notice fires when the
+  file exists. ``{node_id}`` in the value is substituted. This is both
+  the test-injection hook and the deployment hook for environments
+  where a node daemon materializes maintenance events as files.
+- ``DLROVER_TPU_PREEMPTION_URL``: polled with a GET; any 200 response
+  whose body is not ``NONE`` fires (the GCE
+  ``instance/maintenance-event`` metadata convention). Requires the
+  metadata server; unset by default.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+ENV_NOTICE_FILE = "DLROVER_TPU_PREEMPTION_FILE"
+ENV_NOTICE_URL = "DLROVER_TPU_PREEMPTION_URL"
+
+
+class PreemptionWatcher:
+    """Polls the configured notice source; fires ``on_notice`` ONCE."""
+
+    def __init__(self, on_notice: Callable[[], None], *,
+                 node_id: int = 0, poll_interval_s: float = 1.0,
+                 notice_file: str | None = None,
+                 notice_url: str | None = None):
+        notice_file = (notice_file
+                       if notice_file is not None
+                       else os.environ.get(ENV_NOTICE_FILE, ""))
+        self._file = (notice_file.replace("{node_id}", str(node_id))
+                      if notice_file else "")
+        self._url = (notice_url
+                     if notice_url is not None
+                     else os.environ.get(ENV_NOTICE_URL, ""))
+        self._on_notice = on_notice
+        self._interval = poll_interval_s
+        self._fired = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="preemption-watcher", daemon=True
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self._file or self._url)
+
+    @property
+    def fired(self) -> bool:
+        return self._fired.is_set()
+
+    def start(self) -> "PreemptionWatcher":
+        if self.enabled:
+            self._thread.start()
+            logger.info(
+                "preemption watcher armed (%s)",
+                self._file or self._url,
+            )
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _noticed(self) -> bool:
+        if self._file and os.path.exists(self._file):
+            return True
+        if self._url:
+            import urllib.request
+
+            try:
+                req = urllib.request.Request(
+                    self._url, headers={"Metadata-Flavor": "Google"}
+                )
+                with urllib.request.urlopen(req, timeout=2.0) as resp:
+                    body = resp.read(256).decode(errors="replace").strip()
+                return bool(body) and body.upper() != "NONE"
+            except OSError:
+                return False
+        return False
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                if self._noticed():
+                    self._fired.set()
+                    logger.warning("preemption notice detected")
+                    try:
+                        self._on_notice()
+                    except Exception:  # noqa: BLE001 - the prepare steps
+                        logger.exception("preemption handler failed")
+                    return  # one-shot: the node is going away
+            except Exception:  # noqa: BLE001 - keep polling
+                logger.exception("preemption poll failed")

@@ -276,8 +276,11 @@ class ShardedCheckpointEngine(CheckpointEngine):
         """
         snap = self._shm_pieces()
         # every process joins the step-agreement collective (a process
-        # with nothing local reports -1), or the others deadlock in it
-        use_shm = self._shm_step_consistent(snap[0] if snap else -1)
+        # with nothing local reports -1), or the others deadlock in it;
+        # the gathered vector is kept so every later branch decision is
+        # computed identically on all processes (collective-uniform)
+        steps = self._allgather_steps(snap[0] if snap else -1)
+        use_shm = bool((steps >= 0).all() and (steps == steps[0]).all())
         built = None
         if use_shm:
             step, registry = snap
@@ -297,9 +300,15 @@ class ShardedCheckpointEngine(CheckpointEngine):
                 built = None
             if built is not None:
                 return step, built
-        elif snap is not None:
+        elif (steps >= 0).any():
+            rolled = self._consensus_rollback(
+                template, shardings, snap, steps
+            )
+            if rolled is not None:
+                return rolled
             logger.info(
-                "shm snapshot steps disagree across nodes; restoring the "
+                "shm snapshot steps disagree across nodes and the oldest "
+                "holder can't serve the full state; restoring the "
                 "committed storage step instead"
             )
         from dlrover_tpu.agent.ckpt_saver import read_tracker
@@ -314,18 +323,94 @@ class ShardedCheckpointEngine(CheckpointEngine):
         return step, self._build(template, shardings, registry)
 
     @staticmethod
-    def _shm_step_consistent(step: int) -> bool:
-        """All processes hold a snapshot of the same step (>= 0)."""
+    def _allgather_steps(step: int) -> np.ndarray:
+        """Every process's snapshot step (-1 = none), identical on all."""
         import jax
 
         if jax.process_count() == 1:
-            return step >= 0
+            return np.asarray([step], np.int64)
         from jax.experimental import multihost_utils
 
-        steps = multihost_utils.process_allgather(
+        return np.asarray(multihost_utils.process_allgather(
             np.asarray(step, np.int64)
+        )).reshape(-1)
+
+    def _consensus_rollback(self, template: Any, shardings: Any,
+                            snap, steps: np.ndarray
+                            ) -> tuple[int, Any] | None:
+        """Steps diverge across processes: roll every node back to the
+        OLDEST snapshot if its holder can serve the full state.
+
+        This is the zero-storage-read preemption recovery: the node that
+        died was restored from its buddy one or two snapshots behind the
+        survivors (the buddy copy lags by the replication cadence), and
+        the survivors cannot rewind their own shm. When the oldest
+        holder's local pieces cover every leaf in full — always true for
+        replicated/dp layouts, where each node snapshots complete
+        arrays — it broadcasts that state and the whole job resumes from
+        the common step; at-least-once data sharding re-runs the few
+        rolled-back steps. Truly sharded layouts return None (storage is
+        the only consistent source there).
+        """
+        import jax
+
+        valid = steps[steps >= 0]
+        if valid.size == 0 or jax.process_count() == 1:
+            return None
+        consensus = int(valid.min())
+        src = int(np.nonzero(steps == consensus)[0][0])
+        i_am_src = jax.process_index() == src
+        full = None
+        if i_am_src and snap is not None:
+            try:
+                full = self._full_host_state(template, snap[1])
+            except (CoverageError, ValueError) as e:
+                logger.info("consensus rollback unavailable: %s", e)
+        from jax.experimental import multihost_utils
+
+        flags = np.asarray(multihost_utils.process_allgather(
+            np.asarray(1 if full is not None else 0, np.int64)
+        )).reshape(-1)
+        if not flags[src]:
+            return None
+        if full is None:
+            full = jax.tree.map(
+                lambda l: np.zeros(tuple(l.shape), l.dtype), template
+            )
+        logger.info(
+            "rolling back to step %d from process %d (steps were %s)",
+            consensus, src, steps.tolist(),
         )
-        return bool((steps >= 0).all() and (steps == steps[0]).all())
+        state = multihost_utils.broadcast_one_to_all(
+            full, is_source=i_am_src
+        )
+        state = jax.tree.map(jax.device_put, state, shardings)
+        return consensus, state
+
+    def _full_host_state(self, template: Any,
+                         registry: dict[str, list[PieceSource]]) -> Any:
+        """Materialize the COMPLETE state host-side from local pieces;
+        raises CoverageError when any leaf isn't fully covered."""
+        named = _leaf_paths(template)
+        leaves = []
+        for name, leaf in named:
+            pieces = registry.get(name)
+            if not pieces:
+                raise CoverageError(f"no local pieces for {name!r}")
+            shape = tuple(pieces[0].global_shape)
+            if tuple(getattr(leaf, "shape", shape)) != shape:
+                raise ValueError(
+                    f"leaf {name!r}: snapshot shape {shape} != template "
+                    f"{tuple(leaf.shape)}"
+                )
+            leaves.append(assemble(
+                [[0, s] for s in shape], pieces[0].dtype, pieces
+            ))
+        import jax
+
+        return jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(template), leaves
+        )
 
     @staticmethod
     def _all_processes_agree(ok: bool) -> bool:

@@ -415,6 +415,19 @@ class ReportBuddyEndpoint:
 
 @register_message
 @dataclasses.dataclass
+class PreemptionNotice:
+    """Agent -> master: this node received a maintenance/preemption
+    notice and will die shortly (TPU preemption kills the whole VM —
+    SURVEY §7 restart-in-place vs preemption). The master switches the
+    node to a short dead-window so silence after the notice becomes a
+    relaunch in seconds, not the full heartbeat window."""
+
+    node_id: int = 0
+    deadline_s: float = 0.0  # advertised seconds until the kill (0 = unknown)
+
+
+@register_message
+@dataclasses.dataclass
 class BuddyQueryRequest:
     node_id: int = 0
 

@@ -54,6 +54,11 @@ class Node:
     # topology hints for rank sorting (reference:
     # dlrover/python/master/elastic_training/net_topology.py:61)
     topology_key: str = ""
+    # wall time a maintenance/preemption notice arrived (0 = none);
+    # armed nodes get the master's short dead-window until the arm
+    # expires (the node survived the event, e.g. a live migration)
+    preempting_since: float = 0.0
+    preempt_deadline_s: float = 0.0  # advertised time-to-kill (0 = unknown)
 
     def update_status(self, status: NodeStatus) -> None:
         self.status = status

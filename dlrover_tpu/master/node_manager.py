@@ -32,8 +32,13 @@ class NodeManager:
         dead_window_s: float = Defaults.HEARTBEAT_DEAD_WINDOW_S,
         on_node_dead: Callable[[int], None] | None = None,
         relaunch_hook: Callable[[Node], None] | None = None,
+        preempt_dead_window_s: float = 15.0,
     ):
         self._dead_window_s = dead_window_s
+        # after a preemption NOTICE, silence means the advertised kill
+        # landed: switch that node to this short window so the relaunch
+        # starts seconds after the VM dies, not a heartbeat-window later
+        self._preempt_dead_window_s = preempt_dead_window_s
         self._on_node_dead = on_node_dead
         # the scaler's entry point: replace the host a failed node ran on
         # (reference: _relaunch_node dist_job_manager.py:605 -> PodScaler).
@@ -69,6 +74,10 @@ class NodeManager:
                 # node came back (relaunch); resurrect
                 node.status = NodeStatus.RUNNING
                 node.heartbeat_time = time.time()
+            # a (re-)registering incarnation is a fresh VM: the old
+            # notice no longer applies
+            node.preempting_since = 0.0
+            node.preempt_deadline_s = 0.0
             self._pending_relaunches.discard(node_id)
             return node
 
@@ -124,6 +133,31 @@ class NodeManager:
             )
             return self._failure_counts[node_id]
 
+    def report_preemption(self, node_id: int, deadline_s: float = 0.0
+                          ) -> None:
+        """A maintenance/preemption notice arrived for this node: expect
+        its death (reference analog: the breakpoint-save trigger of
+        ckpt_saver.py:631 extended to TPU preemption, SURVEY §7)."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                return
+            node.preempting_since = time.time()
+            node.preempt_deadline_s = deadline_s
+        logger.warning(
+            "node %d reports preemption notice (deadline %.0fs): "
+            "short dead-window armed", node_id, deadline_s,
+        )
+
+    @staticmethod
+    def _preempt_arm_ttl(node: Node) -> float:
+        """How long the short dead-window stays armed after a notice: a
+        node that outlives the advertised kill (live migration, a
+        maintenance event that wasn't a preemption) must fall back to
+        the normal window, or any later >window heartbeat gap falsely
+        relaunches a healthy host."""
+        return max(2 * node.preempt_deadline_s, 120.0)
+
     # ------------------------------------------------------------- monitoring
 
     def start(self, interval_s: float = 5.0) -> None:
@@ -151,11 +185,24 @@ class NodeManager:
             for node in self._nodes.values():
                 if node.status != NodeStatus.RUNNING:
                     continue
+                if (node.preempting_since
+                        and now - node.preempting_since
+                        > self._preempt_arm_ttl(node)):
+                    logger.info(
+                        "node %d survived its maintenance event; "
+                        "normal dead-window restored", node.node_id,
+                    )
+                    node.preempting_since = 0.0
+                armed = bool(node.preempting_since)
+                window = (self._preempt_dead_window_s if armed
+                          else self._dead_window_s)
                 if node.heartbeat_time <= 0:
-                    # never reported: give it a full window from creation
-                    if now - node.create_time > self._dead_window_s:
+                    # never reported: window from creation (the armed
+                    # window applies here too — a startup-time notice
+                    # must not wait the full registration grace)
+                    if now - node.create_time > window:
                         dead.append(node.node_id)
-                elif not node.is_alive(self._dead_window_s, now):
+                elif not node.is_alive(window, now):
                     dead.append(node.node_id)
         for nid in dead:
             logger.warning("node %d declared dead (no heartbeat)", nid)

@@ -86,6 +86,11 @@ class MasterServicer:
         if isinstance(msg, m.ReportBuddyEndpoint):
             self._buddy_endpoints[msg.node_id] = msg.addr
             return m.OkResponse()
+        if isinstance(msg, m.PreemptionNotice):
+            self._node_manager.report_preemption(
+                msg.node_id, msg.deadline_s
+            )
+            return m.OkResponse()
         if isinstance(msg, m.BuddyQueryRequest):
             return self._buddy_query(msg)
         if isinstance(msg, m.NodeHeartbeat):

@@ -275,12 +275,16 @@ def sliding_window(window: int = 1024, data_size: int = -1,
 
 
 def pipeline(pipeline_size: int = 2, data_size: int = -1,
-             microbatches: int = 0, remat: str = "none") -> Strategy:
-    """GPipe pipeline over the "pipeline" axis × data parallel.
+             microbatches: int = 0, remat: str = "none",
+             interleave: int = 1) -> Strategy:
+    """Pipeline over the "pipeline" axis × data parallel.
 
     The layer-stack dim shards over the pipeline axis so each stage's
     weights (and their optimizer states — ZeRO for free) live only on that
-    stage's devices; parallel/pipeline.py supplies the schedule.
+    stage's devices; parallel/pipeline.py supplies the schedule:
+    ``interleave=1`` GPipe, ``>1`` the Megatron-interleaved circular
+    schedule (1F1B-class bubble, reference
+    atorch/auto/opt_lib/pipeline_parallel_optimization.py:56).
     """
     return Strategy(
         name="pipeline",
@@ -294,13 +298,14 @@ def pipeline(pipeline_size: int = 2, data_size: int = -1,
         extra={
             "pipeline_stages": pipeline_size,
             "pipeline_microbatches": microbatches,
+            "pipeline_interleave": interleave,
         },
     )
 
 
 def mixed(pipeline_size: int = 2, tensor_size: int = 2,
           data_size: int = -1, microbatches: int = 0,
-          remat: str = "none") -> Strategy:
+          remat: str = "none", interleave: int = 1) -> Strategy:
     """3D: GPipe pipeline × Megatron-style tensor × data parallel.
 
     Reference analog: MixedParallelOptimization's TP+PP+DP combination
@@ -324,6 +329,7 @@ def mixed(pipeline_size: int = 2, tensor_size: int = 2,
         extra={
             "pipeline_stages": pipeline_size,
             "pipeline_microbatches": microbatches,
+            "pipeline_interleave": interleave,
         },
     )
 

@@ -177,7 +177,73 @@ class ShardedPPOTrainer(PPOTrainer):
             out_shardings=(param_shardings, opt_shardings, None),
             donate_argnums=(0, 1),
         )
+        self._serving = None
         logger.info(
             "sharded ppo engine: mesh %s, actor strategy %s, ref %s",
             dict(mesh.shape), self.strategy.name, ref_rules.name,
         )
+
+    # ------------------------------------------------- serving rollouts
+
+    def enable_serving_rollouts(self, *, slots: int = 8,
+                                decode_block: int = 8,
+                                max_len: int = 0,
+                                seed: int = 0) -> None:
+        """Route rollout generation through the continuous-batching
+        serving engine (serving/engine.py) instead of the in-mesh decode.
+
+        Reference analog: ATorch's train<->inference engine split, where
+        PPO rollouts run on a vLLM backend that receives the trainer's
+        weights each iteration
+        (atorch/atorch/rl/model_engine/model_engine.py:1,
+        rl/inference_backend/vllm_backend.py:1). TPU-native: both
+        engines live on one mesh, so the per-iteration "weight sync" is
+        handing the serving engine the actor's parameter BUFFERS (no
+        copy, no staleness window); the decode itself is the same
+        ``sample_logits`` used by the in-mesh path, so sampling
+        semantics cannot drift between backends.
+        """
+        from dlrover_tpu.serving import InferenceEngine
+
+        max_len = max_len or self.cfg.max_seq_len
+        self._serving = InferenceEngine(
+            self.params["model"], self.cfg, slots=slots,
+            max_len=max_len, decode_block=decode_block,
+        )
+        self._serving_seed = seed
+
+    def _generate(self, prompts: np.ndarray, key: jax.Array) -> jax.Array:
+        if self._serving is None:
+            return super()._generate(prompts, key)
+        import numpy as _np
+
+        from dlrover_tpu.serving import SamplingParams
+
+        # per-iteration weight handoff: the engine's jitted programs
+        # take params as an argument, so pointing it at the freshly
+        # updated actor buffers IS the sync step
+        self._serving.params = self.params["model"]
+        self._serving_seed += 1
+        rids = [
+            self._serving.submit(
+                list(map(int, row)),
+                SamplingParams(
+                    temperature=self.ppo.temperature,
+                    max_new_tokens=self.ppo.gen_len,
+                    # per-request seeds: identical prompts in one batch
+                    # must not collapse to identical continuations
+                    seed=self._serving_seed * 100003 + i,
+                ),
+            )
+            for i, row in enumerate(_np.asarray(prompts))
+        ]
+        results = {r.id: r for r in self._serving.run()}
+        gen = _np.stack([
+            _np.asarray(results[rid].tokens[:self.ppo.gen_len],
+                        _np.int32)
+            for rid in rids
+        ])
+        tokens = _np.concatenate(
+            [_np.asarray(prompts, _np.int32), gen], axis=1
+        )
+        return jax.device_put(jnp.asarray(tokens), self._dp_sharding)

@@ -252,10 +252,17 @@ class PPOTrainer:
 
         self._update = jax.jit(update)
 
+    def _generate(self, prompts: np.ndarray, key: jax.Array) -> jax.Array:
+        """Rollout token source [B, P] -> [B, P+gen_len]; the in-mesh
+        KV-cached decode by default. ShardedPPOTrainer can route this
+        through the continuous-batching serving engine instead (the
+        vLLM-inference-backend analog)."""
+        return self._sample(self.params, jnp.asarray(prompts), key)
+
     def rollout(self, prompts: np.ndarray, key: jax.Array) -> dict:
         """One PPO batch from prompts [B, P]."""
         P = prompts.shape[1]
-        tokens = self._sample(self.params, jnp.asarray(prompts), key)
+        tokens = self._generate(prompts, key)
         logp, values, _ = self._logp_values(self.params, tokens)
         ref_logp, _, _ = self._logp_values(self.ref_params, tokens)
 

@@ -167,3 +167,114 @@ class TestPipelineStrategy:
             _, metrics = ct.step(state, batch)
             results[name] = float(metrics["loss"])
         assert results["pp"] == pytest.approx(results["dp"], rel=2e-5)
+
+
+class TestInterleavedSchedule:
+    """Interleaved (circular) pipeline: the 1F1B-class schedule
+    (reference pipeline_parallel_optimization.py:56's schedule family),
+    SPMD-roll form."""
+
+    def test_forward_matches_scan(self):
+        params = T.init_params(CFG, jax.random.PRNGKey(0))
+        tokens = _batch(jax.random.PRNGKey(1))["tokens"][:, :-1]
+        ref = T.forward(params, tokens, CFG)
+        for stages, il in [(2, 2), (4, 1)]:
+            cfg_pp = dataclasses.replace(
+                CFG, pipeline_stages=stages,
+                pipeline_microbatches=stages, pipeline_interleave=il,
+            )
+            got = T.forward(params, tokens, cfg_pp)
+            np.testing.assert_allclose(
+                np.asarray(ref), np.asarray(got), rtol=2e-5, atol=2e-5,
+                err_msg=f"stages={stages} interleave={il}",
+            )
+
+    def test_grads_match_scan(self):
+        params = T.init_params(CFG, jax.random.PRNGKey(0))
+        batch = _batch(jax.random.PRNGKey(1))
+        cfg_pp = dataclasses.replace(
+            CFG, pipeline_stages=2, pipeline_microbatches=2,
+            pipeline_interleave=2,
+        )
+        ref = jax.grad(lambda p: T.loss_fn(p, batch, CFG))(params)
+        got = jax.grad(lambda p: T.loss_fn(p, batch, cfg_pp))(params)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+            ),
+            ref, got,
+        )
+
+    def test_microbatch_constraint(self):
+        from dlrover_tpu.parallel.pipeline import pipeline_apply
+
+        with pytest.raises(ValueError, match="microbatches == stages"):
+            pipeline_apply(
+                lambda h, w: h, jnp.zeros((8, 3)),
+                jnp.zeros((8, 4)), num_stages=2, num_microbatches=4,
+                interleave=2,
+            )
+
+    def test_chunk_divisibility(self):
+        from dlrover_tpu.parallel.pipeline import pipeline_apply
+
+        with pytest.raises(ValueError, match="interleave"):
+            pipeline_apply(
+                lambda h, w: h, jnp.zeros((6, 3)),
+                jnp.zeros((4, 4)), num_stages=2, num_microbatches=2,
+                interleave=4,
+            )
+
+    def test_bubble_fraction_shrinks(self):
+        from dlrover_tpu.parallel.pipeline import bubble_fraction
+
+        gpipe = bubble_fraction(4, 4, 1)
+        il2 = bubble_fraction(4, 4, 2)
+        il4 = bubble_fraction(4, 4, 4)
+        assert gpipe == pytest.approx(3 / 7)
+        assert il2 == pytest.approx(3 / 11)
+        assert il4 == pytest.approx(3 / 19)
+        assert il4 < il2 < gpipe
+
+    def test_interleaved_preset_trains(self):
+        strat = S.pipeline(pipeline_size=2, data_size=4, interleave=2)
+        mesh = strat.build_mesh()
+        ct = compile_train(
+            strategy=strat,
+            mesh=mesh,
+            loss_fn=T.make_loss_fn(CFG, strat, mesh),
+            init_params_fn=lambda rng: T.init_params(CFG, rng),
+            logical_params=T.logical_axes(CFG),
+            optimizer=optax.adamw(1e-2),
+        )
+        state = ct.init(jax.random.PRNGKey(0))
+        losses = []
+        for i in range(8):
+            batch = jax.tree.map(
+                lambda x: x[None], _batch(jax.random.PRNGKey(i))
+            )
+            state, metrics = ct.step(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0]
+
+    def test_interleaved_matches_dp_loss(self):
+        strat_il = S.pipeline(pipeline_size=2, data_size=4, interleave=2)
+        strat_dp = S.dp()
+        results = {}
+        for name, strat in [("il", strat_il), ("dp", strat_dp)]:
+            mesh = strat.build_mesh()
+            ct = compile_train(
+                strategy=strat,
+                mesh=mesh,
+                loss_fn=T.make_loss_fn(CFG, strat, mesh),
+                init_params_fn=lambda rng: T.init_params(CFG, rng),
+                logical_params=T.logical_axes(CFG),
+                optimizer=optax.sgd(1e-2),
+            )
+            state = ct.init(jax.random.PRNGKey(0))
+            batch = jax.tree.map(
+                lambda x: x[None], _batch(jax.random.PRNGKey(42))
+            )
+            _, metrics = ct.step(state, batch)
+            results[name] = float(metrics["loss"])
+        assert results["il"] == pytest.approx(results["dp"], rel=2e-5)
