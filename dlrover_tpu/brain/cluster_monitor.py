@@ -1,0 +1,168 @@
+"""Brain-side cluster monitor: direct k8s observation, not job self-reports.
+
+Reference analog: the Go Brain runs its own k8s watchers
+(dlrover/go/brain/pkg/platform/k8s/watcher/) and ships a standalone
+cluster monitor binary (go/brain/cmd/k8smonitor/main.go) — the Brain's
+cross-job learning must not depend on every job's master faithfully
+reporting over RPC: a job whose master OOMed or never started still
+leaves pod-lifecycle evidence in the cluster. This module watches
+DLRover-TPU pods cluster-wide through the same KubeClient seam the
+operator uses, derives per-job lifecycle facts (running worker counts,
+terminal phases, OOM kills), and persists them into the Brain datastore
+alongside the RPC-reported rows.
+
+What it feeds back: ``BrainDataStore.cluster_oom_count`` lets the
+optimizer's OOM stage size memory up even for jobs that never reported
+their own OOM (the reference's OptimizeJobWorkerCreateOomResource is
+driven by the same platform-watcher data).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+def _pod_facts(pod: dict) -> tuple[str, str, str, bool]:
+    """(job, group, phase, oom_killed) from one pod object."""
+    meta = pod.get("metadata", {})
+    labels = meta.get("labels", {})
+    status = pod.get("status", {})
+    oom = status.get("reason") == "OOMKilled"
+    for cs in status.get("containerStatuses", []) or []:
+        term = (cs.get("state") or {}).get("terminated") or {}
+        if term.get("reason") == "OOMKilled":
+            oom = True
+    return (
+        labels.get("job", ""),
+        labels.get("group", ""),
+        status.get("phase", "Pending"),
+        oom,
+    )
+
+
+class ClusterMonitor:
+    """Watch-driven ingestion loop (list+watch with resync on expiry)."""
+
+    def __init__(self, kube_client, store, namespace: str = "default",
+                 label_selector: str = "app=dlrover-tpu",
+                 resync_interval_s: float = 30.0):
+        self._client = kube_client
+        self._store = store
+        self._ns = namespace
+        self._selector = label_selector
+        self._resync_s = resync_interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="brain-cluster-monitor"
+        )
+        # (pod_name -> last recorded (phase, oom)): dedupe repeated
+        # MODIFIED events so the store keeps transitions, not heartbeats
+        self._last: dict[str, tuple[str, bool]] = {}
+
+    def start(self) -> "ClusterMonitor":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._client.close_watch()
+        except Exception:  # noqa: BLE001 - teardown is best-effort
+            pass
+
+    # ------------------------------------------------------------ ingestion
+
+    def _ingest(self, event_type: str, pod: dict) -> None:
+        job, group, phase, oom = _pod_facts(pod)
+        if not job:
+            return
+        name = pod.get("metadata", {}).get("name", "")
+        key = (phase, oom) if event_type != "DELETED" else ("Deleted",
+                                                           oom)
+        if self._last.get(name) == key:
+            return
+        if event_type == "DELETED":
+            # evict: a long-lived monitor on a churning cluster must
+            # not hold one dedupe entry per pod name forever
+            self._last.pop(name, None)
+        else:
+            self._last[name] = key
+        self._store.record_cluster_event(
+            job_name=job, pod=name, group=group,
+            event=event_type, phase=key[0], oom=oom,
+        )
+        if oom:
+            logger.warning("cluster monitor: pod %s of job %s OOMKilled",
+                           name, job)
+
+    def _resync(self) -> None:
+        for pod in self._client.list_pods(self._ns, self._selector):
+            self._ingest("SYNC", pod)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._resync()
+                # blocking watch; server closes at its timeout, then we
+                # re-list (the standard list+watch contract)
+                for ev in self._client.watch_pods(self._ns,
+                                                  self._selector):
+                    if self._stop.is_set():
+                        return
+                    obj = ev.get("object") or {}
+                    self._ingest(ev.get("type", ""), obj)
+            except Exception as e:  # noqa: BLE001 - monitor must survive
+                if self._stop.is_set():
+                    return
+                logger.warning("cluster monitor watch error: %s; "
+                               "re-listing", e)
+                self._stop.wait(1.0)
+
+
+def main(argv=None) -> int:
+    """Standalone cluster-monitor entrypoint (the k8smonitor analog)."""
+    import argparse
+
+    from dlrover_tpu.brain.service import BrainDataStore
+    from dlrover_tpu.cluster.kube_client import KubernetesClient
+
+    p = argparse.ArgumentParser("dlrover-tpu cluster monitor")
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--api-server", default="",
+                   help="plain API server URL (dev/test; no auth)")
+    p.add_argument("--kubeconfig", default="")
+    p.add_argument("--store", default=":memory:",
+                   help="Brain datastore sqlite path")
+    p.add_argument("--selector", default="app=dlrover-tpu")
+    args = p.parse_args(argv)
+
+    if args.api_server:
+        client = KubernetesClient(args.api_server)
+    elif __import__("os").environ.get("KUBERNETES_SERVICE_HOST"):
+        client = KubernetesClient.in_cluster()
+    else:
+        client = KubernetesClient.from_kubeconfig(args.kubeconfig or None)
+    store = BrainDataStore(args.store)
+    monitor = ClusterMonitor(client, store, namespace=args.namespace,
+                             label_selector=args.selector).start()
+    logger.info("cluster monitor watching %s (%s)", args.namespace,
+                args.selector)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        monitor.stop()
+        client.close()
+        store.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

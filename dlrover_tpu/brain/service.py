@@ -43,6 +43,17 @@ CREATE TABLE IF NOT EXISTS job_metrics (
     timestamp REAL
 );
 CREATE INDEX IF NOT EXISTS idx_signature ON job_metrics (signature);
+CREATE TABLE IF NOT EXISTS cluster_events (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    job_name TEXT NOT NULL,
+    pod TEXT NOT NULL,
+    grp TEXT,
+    event TEXT,
+    phase TEXT,
+    oom INTEGER DEFAULT 0,
+    timestamp REAL
+);
+CREATE INDEX IF NOT EXISTS idx_cluster_job ON cluster_events (job_name);
 """
 
 
@@ -72,6 +83,64 @@ class BrainDataStore:
                 ),
             )
             self._conn.commit()
+
+    def record_cluster_event(self, *, job_name: str, pod: str,
+                             group: str = "", event: str = "",
+                             phase: str = "", oom: bool = False,
+                             timestamp: float = 0.0) -> None:
+        """Platform-watcher ingestion (brain/cluster_monitor.py): pod
+        lifecycle facts observed directly from the cluster, independent
+        of job RPC reports (reference: the Go brain's k8s watcher,
+        go/brain/pkg/platform/k8s/watcher/)."""
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO cluster_events (job_name, pod, grp, event,"
+                " phase, oom, timestamp) VALUES (?, ?, ?, ?, ?, ?, ?)",
+                (job_name, pod, group, event, phase, int(oom),
+                 timestamp or time.time()),
+            )
+            self._conn.commit()
+
+    def cluster_oom_count(self, job_name: str) -> int:
+        """Distinct pods of this job the CLUSTER saw OOM-killed — drives
+        the oom optimize stage even when the job never self-reported."""
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT COUNT(DISTINCT pod) FROM cluster_events"
+                " WHERE job_name = ? AND oom = 1",
+                (job_name,),
+            ).fetchone()
+        return int(row[0] or 0)
+
+    def cluster_oom_any(self, job_names: list[str]) -> bool:
+        """Did the cluster watch ANY of these jobs OOM? (one query —
+        the create_oom stage checks up to 50 history rows at once)."""
+        names = [n for n in job_names if n]
+        if not names:
+            return False
+        marks = ",".join("?" * len(names))
+        with self._lock:
+            row = self._conn.execute(
+                f"SELECT 1 FROM cluster_events WHERE oom = 1 AND"
+                f" job_name IN ({marks}) LIMIT 1",
+                names,
+            ).fetchone()
+        return row is not None
+
+    def cluster_job_pods(self, job_name: str) -> list[tuple]:
+        """Latest observed (pod, group, phase, oom) per pod of a job."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT ce.pod, ce.grp, ce.phase, ce.oom"
+                " FROM cluster_events ce JOIN ("
+                "   SELECT pod, MAX(timestamp) AS ts FROM cluster_events"
+                "   WHERE job_name = ? GROUP BY pod"
+                " ) latest ON ce.pod = latest.pod"
+                "   AND ce.timestamp = latest.ts"
+                " WHERE ce.job_name = ?",
+                (job_name, job_name),
+            ).fetchall()
+        return rows
 
     def history(self, signature: str, limit: int = 50) -> list[tuple]:
         """Latest record per job for a workload signature.
@@ -250,7 +319,16 @@ class BrainService:
             # peak==0 means the OOM rows carried no usage numbers — an
             # all-zero plan would shadow the create stage's sizing, so
             # this algorithm declines and the caller falls through
-            if peak <= 0 or not any(r[5] == "oom" for r in rows):
+            if peak <= 0:
+                return m.BrainOptimizePlan(found=False)
+            # OOM evidence counts whether a job self-reported it OR the
+            # cluster monitor watched the pod get OOMKilled (the
+            # platform-watcher path: a master that died with its worker
+            # never reports)
+            saw_oom = (any(r[5] == "oom" for r in rows)
+                       or self.store.cluster_oom_any(
+                           [r[0] for r in rows]))
+            if not saw_oom:
                 return m.BrainOptimizePlan(found=False)
             return m.BrainOptimizePlan(
                 found=True, memory_mb=2 * peak,

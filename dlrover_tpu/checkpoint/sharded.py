@@ -403,6 +403,16 @@ class ShardedCheckpointEngine(CheckpointEngine):
                     f"leaf {name!r}: snapshot shape {shape} != template "
                     f"{tuple(leaf.shape)}"
                 )
+            want_dtype = getattr(leaf, "dtype", None)
+            if (want_dtype is not None
+                    and np.dtype(want_dtype) != pieces[0].dtype):
+                # non-source processes broadcast zeros of the TEMPLATE
+                # dtype; a mismatched source tree would wedge the
+                # recovery collective instead of falling back to storage
+                raise ValueError(
+                    f"leaf {name!r}: snapshot dtype {pieces[0].dtype} "
+                    f"!= template {np.dtype(want_dtype)}"
+                )
             leaves.append(assemble(
                 [[0, s] for s in shape], pieces[0].dtype, pieces
             ))

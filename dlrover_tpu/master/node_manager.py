@@ -91,6 +91,19 @@ class NodeManager:
                 self._nodes[node_id] = node
             node.heartbeat_time = time.time()
             node.process_restarts = restart_count
+            if (node.preempting_since
+                    and node.heartbeat_time - node.preempting_since
+                    > self._preempt_arm_ttl(node)):
+                # LIFE past the advertised kill window is the survival
+                # evidence (live migration / non-fatal maintenance):
+                # only a heartbeat may disarm — a wall-clock expiry
+                # would clear the short window exactly while a
+                # late-killed node is already silent
+                logger.info(
+                    "node %d heartbeating past its maintenance window; "
+                    "normal dead-window restored", node_id,
+                )
+                node.preempting_since = 0.0
             if (node.status == NodeStatus.FAILED
                     and node.exit_reason == NodeExitReason.KILLED):
                 # the heartbeat monitor declared it dead, but it's clearly
@@ -185,14 +198,9 @@ class NodeManager:
             for node in self._nodes.values():
                 if node.status != NodeStatus.RUNNING:
                     continue
-                if (node.preempting_since
-                        and now - node.preempting_since
-                        > self._preempt_arm_ttl(node)):
-                    logger.info(
-                        "node %d survived its maintenance event; "
-                        "normal dead-window restored", node.node_id,
-                    )
-                    node.preempting_since = 0.0
+                # the arm persists until a HEARTBEAT past the TTL
+                # disarms it (report_heartbeat): a node silent past its
+                # kill deadline is dead, not recovered
                 armed = bool(node.preempting_since)
                 window = (self._preempt_dead_window_s if armed
                           else self._dead_window_s)

@@ -594,6 +594,14 @@ def resolve_config(cfg: TransformerConfig, strategy) -> TransformerConfig:
         updates["attention_window"] = int(extra["attention_window"])
     if extra.get("int8_matmuls"):
         updates["int8_matmuls"] = True
+    # model-level remat knobs (the measured search, parallel/search.py,
+    # expresses its remat cross-product through these)
+    if "remat_scan" in extra:
+        updates["remat_scan"] = bool(extra["remat_scan"])
+    if extra.get("remat_policy"):
+        updates["remat_policy"] = extra["remat_policy"]
+    if int(extra.get("remat_interval", 0)) > 1:
+        updates["remat_interval"] = int(extra["remat_interval"])
     pp = int(extra.get("pipeline_stages", 0))
     if pp > 1:
         # the strategy wins when it pipelines; its microbatch count only

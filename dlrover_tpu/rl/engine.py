@@ -210,7 +210,7 @@ class ShardedPPOTrainer(PPOTrainer):
             self.params["model"], self.cfg, slots=slots,
             max_len=max_len, decode_block=decode_block,
         )
-        self._serving_seed = seed
+        del seed  # kept for API stability; seeds derive from the key
 
     def _generate(self, prompts: np.ndarray, key: jax.Array) -> jax.Array:
         if self._serving is None:
@@ -223,16 +223,24 @@ class ShardedPPOTrainer(PPOTrainer):
         # take params as an argument, so pointing it at the freshly
         # updated actor buffers IS the sync step
         self._serving.params = self.params["model"]
-        self._serving_seed += 1
+        # per-request seeds DERIVED FROM THE CALLER'S KEY: rollout stays
+        # a function of (params, prompts, key) on this backend too —
+        # a counter would make resumed runs replaying the same key
+        # stream irreproducible. fold_in also keeps identical prompts
+        # in one batch from collapsing to identical continuations.
+        seeds = [
+            int(jax.random.randint(
+                jax.random.fold_in(key, i), (), 0, 2**31 - 1
+            ))
+            for i in range(len(prompts))
+        ]
         rids = [
             self._serving.submit(
                 list(map(int, row)),
                 SamplingParams(
                     temperature=self.ppo.temperature,
                     max_new_tokens=self.ppo.gen_len,
-                    # per-request seeds: identical prompts in one batch
-                    # must not collapse to identical continuations
-                    seed=self._serving_seed * 100003 + i,
+                    seed=seeds[i],
                 ),
             )
             for i, row in enumerate(_np.asarray(prompts))

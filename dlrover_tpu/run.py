@@ -121,8 +121,26 @@ def launch_local_master(args, min_nodes: int, max_nodes: int
     raise TimeoutError("standalone master did not report its port in 30s")
 
 
+def auto_configure(args) -> None:
+    """Fill node identity/count from the environment when the CLI left
+    them at defaults.
+
+    Reference analog: ElasticLaunchConfig.auto_configure_params
+    (dlrover/python/elastic_agent/torch/training.py:143) — torchrun-style
+    env-driven configuration so a pod template needs no per-node CLI
+    edits: the scaler/operator injects DLROVER_TPU_NODE_NUM and
+    DLROVER_TPU_NODE_ID and every replica runs the same command line.
+    """
+    env_nnodes = os.environ.get(EnvKey.NODE_NUM, "")
+    if args.nnodes == "1" and env_nnodes:
+        args.nnodes = env_nnodes
+        logger.info("auto-config: nnodes=%s from %s", env_nnodes,
+                    EnvKey.NODE_NUM)
+
+
 def main(argv: list[str] | None = None) -> int:
     args = parse_args(argv)
+    auto_configure(args)
     min_nodes, max_nodes = parse_nnodes(args.nnodes)
 
     master_proc = None

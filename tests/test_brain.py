@@ -6,6 +6,8 @@ Reference analog: the Go brain's optalgorithm table tests
 
 from __future__ import annotations
 
+import time
+
 import pytest
 
 from dlrover_tpu.brain.service import (
@@ -525,3 +527,90 @@ class TestTuningPlanIntegration:
             self._Stats({0: 1000}), speed_monitor=None,
         )
         assert opt.tuning_plan().is_empty()
+
+
+class TestClusterMonitor:
+    """Brain's own k8s observation (brain/cluster_monitor.py) — the
+    go/brain platform-watcher + k8smonitor analog, driven against the
+    real HTTP envtest apiserver."""
+
+    def test_watch_ingests_lifecycle_and_oom(self):
+        from dlrover_tpu.brain.cluster_monitor import ClusterMonitor
+        from dlrover_tpu.brain.service import BrainDataStore
+        from dlrover_tpu.cluster.envtest import FakeKubeApiServer
+        from dlrover_tpu.cluster.kube_client import KubernetesClient
+
+        srv = FakeKubeApiServer().start()
+        client = KubernetesClient(srv.url, watch_timeout_s=2.0)
+        store = BrainDataStore()
+        monitor = ClusterMonitor(client, store,
+                                 resync_interval_s=0.5).start()
+        try:
+            client.create_pod("default", {
+                "metadata": {"name": "job1-worker-0",
+                             "labels": {"app": "dlrover-tpu",
+                                        "job": "job1",
+                                        "group": "worker"}},
+                "spec": {},
+            })
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                if store.cluster_job_pods("job1"):
+                    break
+                time.sleep(0.2)
+            pods = store.cluster_job_pods("job1")
+            assert pods and pods[0][0] == "job1-worker-0"
+
+            # kubelet-style status patch: OOMKilled must be ingested
+            client._request(
+                "PATCH", "/api/v1/namespaces/default/pods/job1-worker-0",
+                body={"status": {"phase": "Failed",
+                                 "reason": "OOMKilled"}},
+            )
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                if store.cluster_oom_count("job1"):
+                    break
+                time.sleep(0.2)
+            assert store.cluster_oom_count("job1") == 1
+        finally:
+            monitor.stop()
+            client.close()
+            srv.stop()
+            store.close()
+
+    def test_cluster_oom_feeds_create_oom_stage(self):
+        """A job whose master never self-reported OOM still drives the
+        create_oom sizing when the cluster watched its pod die."""
+        from dlrover_tpu.brain.service import BrainDataStore, BrainService
+
+        store = BrainDataStore()
+        service = BrainService(store=store)
+        # the job reported ordinary usage rows (status running), never oom
+        store.record(m.BrainJobMetrics(
+            job_name="j-oom", signature="sig-c", workers=4,
+            used_memory_mb=9000, status="running",
+        ))
+        store.record_cluster_event(
+            job_name="j-oom", pod="j-oom-worker-1", group="worker",
+            event="MODIFIED", phase="Failed", oom=True,
+        )
+        plan = service.optimize(m.BrainOptimizeRequest(
+            job_name="new", signature="sig-c", stage="create_oom",
+        ))
+        assert plan.found
+        assert plan.memory_mb == 2 * 9000
+
+    def test_no_oom_evidence_declines(self):
+        from dlrover_tpu.brain.service import BrainDataStore, BrainService
+
+        store = BrainDataStore()
+        service = BrainService(store=store)
+        store.record(m.BrainJobMetrics(
+            job_name="j-ok", signature="sig-d", workers=4,
+            used_memory_mb=9000, status="running",
+        ))
+        plan = service.optimize(m.BrainOptimizeRequest(
+            job_name="new", signature="sig-d", stage="create_oom",
+        ))
+        assert not plan.found

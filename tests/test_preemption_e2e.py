@@ -185,9 +185,11 @@ class TestWatcherUnit:
         node = nm.ensure_node(0)
         assert node.preempting_since == 0.0
 
-    def test_arm_expires_when_node_survives(self):
-        """A live-migrated node that outlives the advertised kill must
-        fall back to the normal dead-window (review finding)."""
+    def test_heartbeat_past_ttl_disarms_silence_does_not(self):
+        """Survival evidence is a HEARTBEAT past the advertised kill
+        window (live migration); mere elapsed time must NOT disarm —
+        a node killed late in its window is silent exactly then
+        (review findings, rounds 4a+4b)."""
         from dlrover_tpu.master.node_manager import NodeManager
 
         dead = []
@@ -197,9 +199,18 @@ class TestWatcherUnit:
         nm.report_heartbeat(0)
         nm.report_preemption(0, deadline_s=30.0)
         node = nm.all_nodes()[0]
-        # force-expire the arm, then lapse past the preempt window
+        # silence past the TTL: the short window still applies -> dead
         node.preempting_since = time.time() - 10_000
-        time.sleep(0.3)
+        node.heartbeat_time = time.time() - 10.0
         nm._check_dead_nodes()
-        assert dead == []          # normal window applies again
-        assert node.preempting_since == 0.0
+        assert dead == [0]
+        # ...whereas a heartbeat past the TTL disarms
+        nm.ensure_node(1)
+        nm.report_heartbeat(1)
+        nm.report_preemption(1, deadline_s=30.0)
+        node1 = [n for n in nm.all_nodes() if n.node_id == 1][0]
+        node1.preempting_since = time.time() - 10_000
+        nm.report_heartbeat(1)
+        assert node1.preempting_since == 0.0
+        nm._check_dead_nodes()
+        assert dead == [0]  # node 1 stays alive on the normal window
