@@ -95,6 +95,11 @@ class TransformerConfig:
     # False -> bidirectional attention (BERT-class encoders); the rest of
     # the block (norms, FFN, sharding rules) is shared with decoders
     causal: bool = True
+    # GLM-class prefix LM (prefix_lm_attention): the batch carries a
+    # per-row "prefix_len" — bidirectional attention inside the prefix,
+    # causal beyond, loss on the generated span. Training-path feature
+    # (dense attention); kernel attention configs are rejected.
+    prefix_lm: bool = False
     # blockwise cross-entropy: compute the vocab logits in this many
     # token chunks under remat instead of materializing the full
     # [B, S, vocab] f32 logits (+ gradient) in HBM — the reference's
@@ -349,6 +354,34 @@ def dense_attention(q, k, v, *, causal: bool = True) -> jax.Array:
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def prefix_lm_attention(q, k, v, prefix_len: jax.Array, *,
+                        causal: bool = True) -> jax.Array:
+    """GLM-class prefix-LM mask: bidirectional inside the per-row
+    prefix, causal beyond it.
+
+    Reference analog: the GLM blocks of atorch's model zoo
+    (atorch/atorch/modules/distributed_modules/modules_registry.py and
+    transformer.py GLM attention/MLP ports) — GLM's objective attends
+    bidirectionally over the conditioning prefix and autoregressively
+    over the generated span. ``allowed(b, q, k) = k <= q  OR
+    k < prefix_len[b]``; ``prefix_len`` is [B] int32. ``causal=False``
+    degenerates to full bidirectional (the mask is a no-op then).
+    """
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        s_q, s_k = q.shape[1], k.shape[1]
+        pos_q = jnp.arange(s_q)
+        pos_k = jnp.arange(s_k)
+        causal_m = pos_q[:, None] >= pos_k[None, :]          # [q, k]
+        prefix_m = (pos_k[None, :]
+                    < prefix_len.astype(jnp.int32)[:, None])  # [B, k]
+        allowed = causal_m[None] | prefix_m[:, None, :]       # [B, q, k]
+        logits = jnp.where(allowed[:, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
 AttentionFn = Callable[..., jax.Array]
 
 
@@ -358,11 +391,12 @@ def forward(
     cfg: TransformerConfig,
     attention_fn: AttentionFn | None = None,
     constrain: Callable[[jax.Array, tuple], jax.Array] | None = None,
+    prefix_len: jax.Array | None = None,
 ) -> jax.Array:
     """Token ids [B, S] -> logits [B, S, vocab]."""
     return forward_with_aux(
         params, tokens, cfg, attention_fn=attention_fn,
-        constrain=constrain,
+        constrain=constrain, prefix_len=prefix_len,
     )[0]
 
 
@@ -375,6 +409,7 @@ def forward_with_aux(
     mask: jax.Array | None = None,
     return_hidden: bool = False,
     inputs_embeds: jax.Array | None = None,
+    prefix_len: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """(logits, aux_loss). aux is the MoE load-balancing term (0 when
     the model has no experts). ``return_hidden`` yields the final normed
@@ -391,7 +426,27 @@ def forward_with_aux(
     c = cfg
     dt = jnp.dtype(c.dtype)
     pin = constrain or (lambda x, a: x)
-    attn = attention_fn or dense_attention
+    if c.prefix_lm:
+        if attention_fn is not None and attention_fn is not dense_attention:
+            raise NotImplementedError(
+                "prefix_lm needs the dense attention path (the sparse "
+                "kernels have no per-row prefix mask); leave "
+                "cfg.attention='dense'"
+            )
+        if c.pipeline_stages > 1:
+            raise NotImplementedError(
+                "prefix_lm + pipeline: the per-row prefix mask is "
+                "closed over at full-batch shape, but pipeline stages "
+                "see microbatches — the shapes cannot line up"
+            )
+        if prefix_len is None:
+            raise ValueError(
+                "cfg.prefix_lm=True but the batch carries no "
+                "'prefix_len' [B] array"
+            )
+        attn = partial(prefix_lm_attention, prefix_len=prefix_len)
+    else:
+        attn = attention_fn or dense_attention
 
     if inputs_embeds is not None:
         B, S = inputs_embeds.shape[:2]
@@ -708,33 +763,54 @@ def loss_fn(
     attention_fn: AttentionFn | None = None,
     constrain=None,
 ) -> jax.Array:
-    """Next-token cross entropy (+ MoE aux). batch: tokens [B, S]."""
+    """Next-token cross entropy (+ MoE aux). batch: tokens [B, S].
+
+    Under ``cfg.prefix_lm`` the batch carries ``prefix_len`` [B]; when no
+    explicit loss mask is given, one is derived so only the generated
+    span (positions >= prefix_len) is scored — GLM's objective shape.
+    """
     tokens = batch["tokens"]
     in_mask = batch.get("mask")
+    prefix_len = batch.get("prefix_len") if cfg.prefix_lm else None
+    # loss_mask scores only the generated span under prefix_lm; it is
+    # NOT fed into forward (there `mask` means token padding and also
+    # weights MoE gating stats — prefix tokens are real tokens). A
+    # padding mask COMBINES with the span mask rather than replacing
+    # it: otherwise a variable-length batch would silently score the
+    # prefix and the objective would degrade to full-sequence LM.
+    loss_mask = in_mask
+    if cfg.prefix_lm and prefix_len is not None:
+        positions = jnp.arange(tokens.shape[1])
+        span = (positions[None, :]
+                >= prefix_len.astype(jnp.int32)[:, None]
+                ).astype(jnp.float32)
+        loss_mask = span if in_mask is None else (
+            in_mask.astype(jnp.float32) * span
+        )
     mask_in = in_mask[:, :-1] if in_mask is not None else None
     targets = tokens[:, 1:]
     if cfg.ce_chunks:
         hidden, aux = forward_with_aux(
             params, tokens[:, :-1], cfg,
             attention_fn=attention_fn, constrain=constrain,
-            mask=mask_in, return_hidden=True,
+            mask=mask_in, return_hidden=True, prefix_len=prefix_len,
         )
         ce = _blockwise_ce(
             hidden, params, targets,
-            in_mask[:, 1:] if in_mask is not None else None, cfg,
+            loss_mask[:, 1:] if loss_mask is not None else None, cfg,
         )
     else:
         logits, aux = forward_with_aux(
             params, tokens[:, :-1], cfg,
             attention_fn=attention_fn, constrain=constrain,
-            mask=mask_in,
+            mask=mask_in, prefix_len=prefix_len,
         )
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(
             logp, targets[..., None], axis=-1
         )[..., 0]
-        if in_mask is not None:
-            m = in_mask[:, 1:].astype(nll.dtype)
+        if loss_mask is not None:
+            m = loss_mask[:, 1:].astype(nll.dtype)
             ce = (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
         else:
             ce = nll.mean()

@@ -1,0 +1,196 @@
+"""GLM-class prefix LM (models/transformer.py prefix_lm_attention):
+bidirectional over the conditioning prefix, causal over the generation,
+loss on the generated span. Reference analog: the GLM blocks of
+atorch's model zoo (modules_registry.py, distributed_modules/
+transformer.py GLM ports)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.models import transformer as T
+from dlrover_tpu.models.transformer import (
+    dense_attention,
+    prefix_lm_attention,
+)
+
+CFG = dataclasses.replace(T.CONFIGS["tiny"], prefix_lm=True,
+                          dtype="float32")
+
+
+def _qkv(key, b=3, s=16, h=2, d=8):
+    ks = jax.random.split(key, 3)
+    return [jax.random.normal(k, (b, s, h, d), jnp.float32) for k in ks]
+
+
+class TestMask:
+    def test_matches_numpy_reference(self):
+        q, k, v = _qkv(jax.random.PRNGKey(0))
+        prefix = jnp.asarray([0, 5, 16], jnp.int32)
+        got = np.asarray(prefix_lm_attention(q, k, v, prefix))
+        B, S, H, D = q.shape
+        qn, kn, vn = (np.asarray(x, np.float64) for x in (q, k, v))
+        for b in range(B):
+            for h in range(H):
+                logits = (qn[b, :, h] @ kn[b, :, h].T) / np.sqrt(D)
+                allowed = np.tril(np.ones((S, S), bool))
+                allowed[:, : int(prefix[b])] = True
+                logits[~allowed] = -1e30
+                p = np.exp(logits - logits.max(-1, keepdims=True))
+                p /= p.sum(-1, keepdims=True)
+                np.testing.assert_allclose(
+                    got[b, :, h], p @ vn[b, :, h], rtol=1e-4, atol=1e-5,
+                )
+
+    def test_zero_prefix_is_causal(self):
+        q, k, v = _qkv(jax.random.PRNGKey(1))
+        zero = jnp.zeros((q.shape[0],), jnp.int32)
+        np.testing.assert_allclose(
+            np.asarray(prefix_lm_attention(q, k, v, zero)),
+            np.asarray(dense_attention(q, k, v, causal=True)),
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_information_flow(self):
+        """A prefix token's change reaches EARLIER prefix positions
+        (bidirectional), but a suffix token's change never flows
+        backward."""
+        params = T.init_params(CFG, jax.random.PRNGKey(0))
+        tokens = np.full((1, 12), 7, dtype=np.int32)
+        prefix = jnp.asarray([6], jnp.int32)
+
+        def logits_for(toks):
+            out, _ = T.forward_with_aux(
+                params, jnp.asarray(toks), CFG, prefix_len=prefix
+            )
+            return np.asarray(out)
+
+        base = logits_for(tokens)
+        bumped = tokens.copy()
+        bumped[0, 4] = 11          # inside the prefix
+        delta = np.abs(logits_for(bumped) - base).max(axis=-1)[0]
+        assert delta[0] > 1e-6     # flowed BACKWARD within the prefix
+        bumped2 = tokens.copy()
+        bumped2[0, 9] = 11         # in the suffix
+        delta2 = np.abs(logits_for(bumped2) - base).max(axis=-1)[0]
+        assert np.all(delta2[:9] < 1e-6)  # nothing flowed backward
+        assert delta2[9] > 1e-6
+
+    def test_kernel_attention_rejected(self):
+        cfg = dataclasses.replace(CFG, attention="splash")
+        params = T.init_params(cfg, jax.random.PRNGKey(0))
+        from dlrover_tpu.parallel import strategy as S
+
+        strat = S.dp()
+        mesh = strat.build_mesh()
+        loss = T.make_loss_fn(cfg, strat, mesh)
+        batch = {
+            "tokens": jnp.zeros((8, 13), jnp.int32),
+            "prefix_len": jnp.full((8,), 4, jnp.int32),
+        }
+        with pytest.raises(NotImplementedError, match="prefix_lm"):
+            loss(params, batch)
+
+    def test_missing_prefix_len_raises(self):
+        params = T.init_params(CFG, jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="prefix_len"):
+            T.forward_with_aux(params, jnp.zeros((2, 8), jnp.int32), CFG)
+
+
+class TestTraining:
+    def test_loss_scores_only_generated_span(self):
+        params = T.init_params(CFG, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, CFG.vocab_size, (4, 16), np.int64)
+        prefix = jnp.full((4,), 8, jnp.int32)
+        batch = {"tokens": jnp.asarray(tokens), "prefix_len": prefix}
+        explicit = dict(batch)
+        explicit["mask"] = (jnp.arange(16)[None, :] >= 8).astype(
+            jnp.float32).repeat(4, 0)
+        auto = float(T.loss_fn(params, batch, CFG))
+        manual = float(T.loss_fn(params, explicit, CFG))
+        assert auto == pytest.approx(manual, rel=1e-6)
+        # a padding mask cannot widen the scored span (the combine
+        # semantics): all-ones padding == no padding
+        full_pad = float(T.loss_fn(
+            params,
+            {**batch, "mask": jnp.ones((4, 16), jnp.float32)}, CFG,
+        ))
+        assert full_pad == pytest.approx(auto, rel=1e-6)
+        # and the span loss differs from scoring every position (same
+        # model, prefix_lm objective off)
+        causal_cfg = dataclasses.replace(CFG, prefix_lm=False)
+        everything = float(T.loss_fn(
+            params, {"tokens": jnp.asarray(tokens)}, causal_cfg,
+        ))
+        assert auto != pytest.approx(everything, rel=1e-4)
+
+    def test_trains_under_strategy_layer(self):
+        from dlrover_tpu.parallel import strategy as S
+        from dlrover_tpu.trainer import compile_train
+
+        strat = S.dp()
+        mesh = strat.build_mesh()
+        ct = compile_train(
+            strategy=strat, mesh=mesh,
+            loss_fn=T.make_loss_fn(CFG, strat, mesh),
+            init_params_fn=lambda rng: T.init_params(CFG, rng),
+            logical_params=T.logical_axes(CFG),
+            optimizer=optax.adamw(1e-2),
+        )
+        state = ct.init(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(1)
+        tokens = rng.integers(0, CFG.vocab_size, (1, 8, 17), np.int64)
+        batch = jax.device_put(
+            {"tokens": jnp.asarray(tokens, jnp.int32),
+             "prefix_len": jnp.full((1, 8), 6, jnp.int32)},
+            ct.batch_sharding,
+        )
+        losses = []
+        for _ in range(6):
+            state, m = ct.step(state, batch)
+            losses.append(float(jax.device_get(m["loss"])))
+        assert losses[-1] < losses[0]
+
+    def test_padding_mask_combines_with_span(self):
+        """A padding mask must INTERSECT the generated-span mask, not
+        replace it (review finding: replacement silently degrades the
+        objective to full-sequence LM)."""
+        params = T.init_params(CFG, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(2)
+        tokens = rng.integers(0, CFG.vocab_size, (4, 16), np.int64)
+        prefix = jnp.full((4,), 8, jnp.int32)
+        pad = jnp.ones((4, 16), jnp.float32)  # all-ones padding mask
+        with_pad = float(T.loss_fn(
+            params, {"tokens": jnp.asarray(tokens),
+                     "prefix_len": prefix, "mask": pad}, CFG,
+        ))
+        without = float(T.loss_fn(
+            params, {"tokens": jnp.asarray(tokens),
+                     "prefix_len": prefix}, CFG,
+        ))
+        assert with_pad == pytest.approx(without, rel=1e-6)
+
+    def test_pipeline_rejected(self):
+        cfg = dataclasses.replace(CFG, pipeline_stages=2,
+                                  n_layers=2)
+        params = T.init_params(cfg, jax.random.PRNGKey(0))
+        with pytest.raises(NotImplementedError, match="pipeline"):
+            T.forward_with_aux(
+                params, jnp.zeros((4, 8), jnp.int32), cfg,
+                prefix_len=jnp.full((4,), 2, jnp.int32),
+            )
+
+    def test_forward_wrapper_threads_prefix_len(self):
+        params = T.init_params(CFG, jax.random.PRNGKey(0))
+        out = T.forward(
+            params, jnp.zeros((2, 8), jnp.int32), CFG,
+            prefix_len=jnp.full((2,), 3, jnp.int32),
+        )
+        assert out.shape == (2, 8, CFG.vocab_size)
