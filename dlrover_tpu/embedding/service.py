@@ -720,6 +720,135 @@ class ShardedKvClient:
         self._socks.clear()
 
 
+class EmbeddingServerScaler:
+    """Scaler-contract adapter for the table tier: the
+    PSTrainingAutoScaler analog (reference
+    dlrover/python/master/node/job_auto_scaler.py:98 resizes parameter
+    servers through the pod scaler + elastic-PS version bump).
+
+    A ScalePlan whose ``replica_resources`` carries the
+    ``"table_server"`` group is executed as: spawn/stop local shard
+    server processes toward the target count, then
+    ``EmbeddingCoordinator.scale`` migrates rows onto the new ring and
+    bumps the routing version. Plugs directly into
+    ``master.auto_scaler.JobAutoScaler`` as its scaler (or alongside a
+    worker scaler via a dispatching wrapper). Pod-based deployments do
+    the same with the operator spawning server pods and an addr-watch
+    feeding ``coordinator.scale``.
+    """
+
+    GROUP = "table_server"
+
+    def __init__(self, dim: int, *, coordinator: EmbeddingCoordinator,
+                 spawn=None, num_slots: int = 2, seed: int = 0,
+                 ckpt_dir: str = "", host: str = "127.0.0.1",
+                 spawn_timeout_s: float = 60.0):
+        self.dim = dim
+        self.num_slots = num_slots
+        self.seed = seed
+        self.ckpt_dir = ckpt_dir
+        self.host = host
+        self.spawn_timeout_s = spawn_timeout_s
+        self._coord = coordinator
+        self._procs: dict[str, object] = {}  # addr -> Popen/server
+        self._lock = threading.Lock()
+        self._spawn = spawn or self._default_spawn
+
+    def _default_spawn(self, index: int) -> tuple[str, object]:
+        """Spawn a shard-server subprocess carrying the TIER'S table
+        configuration — a new server with a different num_slots/seed
+        would reject migrated rows (import_ shape check) or break the
+        deterministic-init contract mid-ring."""
+        import subprocess
+        import sys
+
+        cmd = [sys.executable, "-m", "dlrover_tpu.embedding.service",
+               "--dim", str(self.dim),
+               "--num-slots", str(self.num_slots),
+               "--seed", str(self.seed),
+               "--host", self.host, "--index", str(index)]
+        if self.ckpt_dir:
+            cmd += ["--ckpt-dir", self.ckpt_dir]
+        proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "DLROVER_TPU_PLATFORM": "cpu"},
+        )
+        # bounded readiness wait: a wedged child must not park scale()
+        # (and with it the auto-scaler tick + stop_all) on readline
+        # forever
+        line_box: list[str] = []
+
+        def read():
+            line_box.append(proc.stdout.readline().strip())
+
+        t = threading.Thread(target=read, daemon=True)
+        t.start()
+        t.join(self.spawn_timeout_s)
+        line = line_box[0] if line_box else ""
+        if not line.startswith("PORT "):
+            self._terminate(proc)
+            raise RuntimeError(
+                f"table server not ready within {self.spawn_timeout_s}s"
+                f" (got {line!r})"
+            )
+        return f"{self.host}:{line.split()[1]}", proc
+
+    def scale(self, plan) -> None:
+        target = plan.replica_resources.get(self.GROUP)
+        if target is None:
+            return
+        if target < 1:
+            # an empty ring has nowhere to migrate rows TO — executing
+            # it would strand every row and then kill their holders
+            raise ValueError(
+                f"table_server target {target}: the tier cannot scale "
+                "below 1 (rows need an owner)"
+            )
+        with self._lock:
+            addrs = list(self._coord.addrs)
+            spawned = []
+            while len(addrs) + len(spawned) < target:
+                addr, proc = self._spawn(len(addrs) + len(spawned))
+                self._procs[addr] = proc
+                spawned.append(addr)
+            new_addrs = (addrs + spawned)[:target]
+            retired = [a for a in addrs if a not in new_addrs]
+            if spawned or retired:
+                logger.info(
+                    "table tier %d -> %d servers (%s)", len(addrs),
+                    target, plan.reason or "scale plan",
+                )
+                self._coord.scale(new_addrs)  # migrates, bumps version
+            for addr in retired:  # drained by the migrate; now stop
+                self._terminate(self._procs.pop(addr, None))
+
+    @staticmethod
+    def _terminate(proc) -> None:
+        """terminate -> wait -> kill for subprocesses (no zombies, no
+        SIGTERM-ignoring stragglers); in-process servers (tests,
+        co-located tiers) expose stop()."""
+        import subprocess
+
+        if proc is None:
+            return
+        if hasattr(proc, "terminate") and hasattr(proc, "wait"):
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
+        elif hasattr(proc, "stop"):
+            proc.stop()
+
+    def stop_all(self) -> None:
+        with self._lock:
+            for proc in self._procs.values():
+                self._terminate(proc)
+            self._procs.clear()
+
+
 def main(argv=None) -> int:
     """CLI shard-server entry: prints ``PORT <n>`` once listening (the
     spawner's readiness/port-discovery contract, like data_worker.py)."""

@@ -293,3 +293,178 @@ class TestShardedCheckpoint:
     def _sorted(snap):
         order = np.argsort(snap["keys"])
         return {k: v[order] for k, v in snap.items()}
+
+
+class TestAutoScaledTableTier:
+    """The PSTrainingAutoScaler analog: a ScalePlan resizes the table
+    tier through the master's auto-scaler machinery (reference
+    job_auto_scaler.py:98)."""
+
+    def test_scale_plan_grows_and_shrinks_tier(self, tmp_path):
+        from dlrover_tpu.cluster.crd import ScalePlan
+        from dlrover_tpu.embedding.service import EmbeddingServerScaler
+
+        # in-process spawn (subprocess servers are exercised by the
+        # recsys e2e; here the invariants are the point)
+        servers = []
+
+        def spawn(index):
+            srv = EmbeddingShardServer(
+                dim=DIM, num_slots=2, seed=7, host="127.0.0.1",
+            ).start()
+            servers.append(srv)
+            return f"127.0.0.1:{srv.port}", srv
+
+        first = [EmbeddingShardServer(dim=DIM, num_slots=2, seed=7,
+                                      host="127.0.0.1", index=i,
+                                      num_shards=2).start()
+                 for i in range(2)]
+        servers.extend(first)
+        coord = EmbeddingCoordinator(
+            [f"127.0.0.1:{s.port}" for s in first], host="127.0.0.1"
+        ).start()
+        scaler = EmbeddingServerScaler(DIM, coordinator=coord,
+                                       spawn=spawn)
+        client = ShardedKvClient(
+            coordinator_addr=f"127.0.0.1:{coord.port}", dim=DIM
+        )
+        try:
+            keys = np.arange(1200, dtype=np.int64)
+            client.lookup(keys)  # materialize rows
+            client.apply("adam", keys,
+                         np.ones((keys.size, DIM), np.float32),
+                         lr=1e-2, step=1)
+            before = client.export_all()
+
+            scaler.scale(ScalePlan(
+                replica_resources={"table_server": 3},
+                reason="speed plan",
+            ))
+            assert coord.version == 1 and len(coord.addrs) == 3
+            client.refresh_route()
+            assert client.row_count() == keys.size
+
+            scaler.scale(ScalePlan(
+                replica_resources={"table_server": 2},
+                reason="shrink",
+            ))
+            assert coord.version == 2 and len(coord.addrs) == 2
+            client.refresh_route()
+            after = client.export_all()
+            oa, ob = (np.argsort(after["keys"]),
+                      np.argsort(before["keys"]))
+            np.testing.assert_array_equal(after["keys"][oa],
+                                          before["keys"][ob])
+            np.testing.assert_allclose(after["values"][oa],
+                                       before["values"][ob],
+                                       rtol=0, atol=0)
+            # a plan without the group is a no-op for this scaler
+            scaler.scale(ScalePlan(replica_resources={"worker": 9}))
+            assert coord.version == 2
+        finally:
+            client.close()
+            coord.stop()
+            for s in servers:
+                s.stop()
+
+    def test_plugs_into_job_auto_scaler(self):
+        """JobAutoScaler.execute drives the tier like any other scaler."""
+        from dlrover_tpu.cluster.crd import ScalePlan
+        from dlrover_tpu.embedding.service import EmbeddingServerScaler
+        from dlrover_tpu.master.auto_scaler import JobAutoScaler
+
+        servers = [EmbeddingShardServer(dim=DIM, num_slots=2, seed=7,
+                                        host="127.0.0.1", index=i,
+                                        num_shards=2).start()
+                   for i in range(2)]
+
+        def spawn(index):
+            srv = EmbeddingShardServer(dim=DIM, num_slots=2, seed=7,
+                                       host="127.0.0.1").start()
+            servers.append(srv)
+            return f"127.0.0.1:{srv.port}", srv
+
+        coord = EmbeddingCoordinator(
+            [f"127.0.0.1:{s.port}" for s in servers], host="127.0.0.1"
+        ).start()
+        scaler = EmbeddingServerScaler(DIM, coordinator=coord,
+                                       spawn=spawn)
+
+        class _Opt:  # minimal optimizer stub for the ctor
+            def initial_plan(self):
+                return ScalePlan()
+
+        auto = JobAutoScaler(_Opt(), scaler, node_manager=None)
+        try:
+            auto.execute(ScalePlan(
+                replica_resources={"table_server": 3},
+                reason="auto-scale tick",
+            ))
+            assert len(coord.addrs) == 3 and coord.version == 1
+        finally:
+            coord.stop()
+            for s in servers:
+                s.stop()
+
+    def test_scale_to_zero_rejected(self):
+        from dlrover_tpu.cluster.crd import ScalePlan
+        from dlrover_tpu.embedding.service import EmbeddingServerScaler
+
+        srv = EmbeddingShardServer(dim=DIM, num_slots=2, seed=7,
+                                   host="127.0.0.1").start()
+        coord = EmbeddingCoordinator(
+            [f"127.0.0.1:{srv.port}"], host="127.0.0.1").start()
+        scaler = EmbeddingServerScaler(DIM, coordinator=coord)
+        try:
+            with pytest.raises(ValueError, match="below 1"):
+                scaler.scale(ScalePlan(
+                    replica_resources={"table_server": 0}))
+            assert coord.version == 0  # untouched
+        finally:
+            coord.stop()
+            srv.stop()
+
+    def test_default_spawn_carries_tier_config(self):
+        """Autoscale-spawned subprocess servers must inherit the tier's
+        num_slots/seed — a mismatched server rejects migrated rows
+        (review finding)."""
+        from dlrover_tpu.cluster.crd import ScalePlan
+        from dlrover_tpu.embedding.service import EmbeddingServerScaler
+
+        servers = [EmbeddingShardServer(dim=DIM, num_slots=1, seed=3,
+                                        host="127.0.0.1", index=i,
+                                        num_shards=2).start()
+                   for i in range(2)]
+        coord = EmbeddingCoordinator(
+            [f"127.0.0.1:{s.port}" for s in servers], host="127.0.0.1"
+        ).start()
+        scaler = EmbeddingServerScaler(
+            DIM, coordinator=coord, num_slots=1, seed=3
+        )
+        client = ShardedKvClient(
+            coordinator_addr=f"127.0.0.1:{coord.port}", dim=DIM
+        )
+        try:
+            keys = np.arange(600, dtype=np.int64)
+            client.lookup(keys)
+            client.apply("adagrad", keys,
+                         np.ones((keys.size, DIM), np.float32), lr=0.1)
+            before = client.export_all()
+            # grows via the REAL subprocess spawn path
+            scaler.scale(ScalePlan(
+                replica_resources={"table_server": 3}))
+            client.refresh_route()
+            after = client.export_all()
+            oa, ob = (np.argsort(after["keys"]),
+                      np.argsort(before["keys"]))
+            np.testing.assert_array_equal(after["keys"][oa],
+                                          before["keys"][ob])
+            np.testing.assert_allclose(after["values"][oa],
+                                       before["values"][ob],
+                                       rtol=0, atol=0)
+        finally:
+            client.close()
+            scaler.stop_all()
+            coord.stop()
+            for s in servers:
+                s.stop()
