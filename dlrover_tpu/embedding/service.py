@@ -751,7 +751,11 @@ class EmbeddingServerScaler:
         self.spawn_timeout_s = spawn_timeout_s
         self._coord = coordinator
         self._procs: dict[str, object] = {}  # addr -> Popen/server
+        # _lock guards _procs ONLY (short holds, so stop_all can always
+        # proceed); _scale_lock serializes scale operations, whose
+        # migrate leg is legitimately unbounded on big tables
         self._lock = threading.Lock()
+        self._scale_lock = threading.Lock()
         self._spawn = spawn or self._default_spawn
 
     def _default_spawn(self, index: int) -> tuple[str, object]:
@@ -792,6 +796,12 @@ class EmbeddingServerScaler:
                 f"table server not ready within {self.spawn_timeout_s}s"
                 f" (got {line!r})"
             )
+        # the pipe has served its one purpose; keeping it open leaks an
+        # fd per spawn and would wedge a child that ever filled it
+        try:
+            proc.stdout.close()
+        except OSError:
+            pass
         return f"{self.host}:{line.split()[1]}", proc
 
     def scale(self, plan) -> None:
@@ -805,12 +815,13 @@ class EmbeddingServerScaler:
                 f"table_server target {target}: the tier cannot scale "
                 "below 1 (rows need an owner)"
             )
-        with self._lock:
+        with self._scale_lock:
             addrs = list(self._coord.addrs)
             spawned = []
             while len(addrs) + len(spawned) < target:
                 addr, proc = self._spawn(len(addrs) + len(spawned))
-                self._procs[addr] = proc
+                with self._lock:
+                    self._procs[addr] = proc
                 spawned.append(addr)
             new_addrs = (addrs + spawned)[:target]
             retired = [a for a in addrs if a not in new_addrs]
@@ -819,9 +830,21 @@ class EmbeddingServerScaler:
                     "table tier %d -> %d servers (%s)", len(addrs),
                     target, plan.reason or "scale plan",
                 )
-                self._coord.scale(new_addrs)  # migrates, bumps version
+                try:
+                    self._coord.scale(new_addrs)  # migrates, bumps ver
+                except BaseException:
+                    # a failed migration must not leak the servers just
+                    # spawned for it: they are not in the route, and a
+                    # retried plan would spawn a fresh set on top
+                    for addr in spawned:
+                        with self._lock:
+                            proc = self._procs.pop(addr, None)
+                        self._terminate(proc)
+                    raise
             for addr in retired:  # drained by the migrate; now stop
-                self._terminate(self._procs.pop(addr, None))
+                with self._lock:
+                    proc = self._procs.pop(addr, None)
+                self._terminate(proc)
 
     @staticmethod
     def _terminate(proc) -> None:

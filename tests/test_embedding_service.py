@@ -468,3 +468,45 @@ class TestAutoScaledTableTier:
             coord.stop()
             for s in servers:
                 s.stop()
+
+    def test_failed_migration_cleans_up_spawned_servers(self):
+        """coord.scale raising mid-grow must terminate the servers just
+        spawned for it — a retried tick would otherwise leak one server
+        per failure (review finding)."""
+        from dlrover_tpu.cluster.crd import ScalePlan
+        from dlrover_tpu.embedding.service import EmbeddingServerScaler
+
+        srv = EmbeddingShardServer(dim=DIM, num_slots=2, seed=7,
+                                   host="127.0.0.1", index=0,
+                                   num_shards=1).start()
+        coord = EmbeddingCoordinator(
+            [f"127.0.0.1:{srv.port}"], host="127.0.0.1").start()
+
+        stopped = []
+
+        class _FakeProc:
+            def __init__(self, i):
+                self.i = i
+
+            def stop(self):
+                stopped.append(self.i)
+
+        def spawn(index):
+            return f"127.0.0.1:{59000 + index}", _FakeProc(index)
+
+        scaler = EmbeddingServerScaler(DIM, coordinator=coord,
+                                       spawn=spawn)
+
+        def boom(addrs):
+            raise ConnectionError("shard died mid-migrate")
+
+        coord.scale = boom
+        try:
+            with pytest.raises(ConnectionError):
+                scaler.scale(ScalePlan(
+                    replica_resources={"table_server": 3}))
+            assert sorted(stopped) == [1, 2]  # both spawns reaped
+            assert not scaler._procs
+        finally:
+            coord.stop()
+            srv.stop()
