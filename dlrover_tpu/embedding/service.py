@@ -756,6 +756,7 @@ class EmbeddingServerScaler:
         # migrate leg is legitimately unbounded on big tables
         self._lock = threading.Lock()
         self._scale_lock = threading.Lock()
+        self._stopped = False
         self._spawn = spawn or self._default_spawn
 
     def _default_spawn(self, index: int) -> tuple[str, object]:
@@ -816,31 +817,37 @@ class EmbeddingServerScaler:
                 "below 1 (rows need an owner)"
             )
         with self._scale_lock:
+            if self._stopped:
+                raise RuntimeError("table tier is shut down")
             addrs = list(self._coord.addrs)
-            spawned = []
-            while len(addrs) + len(spawned) < target:
-                addr, proc = self._spawn(len(addrs) + len(spawned))
-                with self._lock:
-                    self._procs[addr] = proc
-                spawned.append(addr)
-            new_addrs = (addrs + spawned)[:target]
-            retired = [a for a in addrs if a not in new_addrs]
-            if spawned or retired:
-                logger.info(
-                    "table tier %d -> %d servers (%s)", len(addrs),
-                    target, plan.reason or "scale plan",
-                )
-                try:
+            spawned: list[str] = []
+            try:
+                while len(addrs) + len(spawned) < target:
+                    # re-check per spawn: a stop_all() racing this scale
+                    # must not have servers registered AFTER its clear
+                    if self._stopped:
+                        raise RuntimeError("table tier is shut down")
+                    addr, proc = self._spawn(len(addrs) + len(spawned))
+                    with self._lock:
+                        self._procs[addr] = proc
+                    spawned.append(addr)
+                new_addrs = (addrs + spawned)[:target]
+                retired = [a for a in addrs if a not in new_addrs]
+                if spawned or retired:
+                    logger.info(
+                        "table tier %d -> %d servers (%s)", len(addrs),
+                        target, plan.reason or "scale plan",
+                    )
                     self._coord.scale(new_addrs)  # migrates, bumps ver
-                except BaseException:
-                    # a failed migration must not leak the servers just
-                    # spawned for it: they are not in the route, and a
-                    # retried plan would spawn a fresh set on top
-                    for addr in spawned:
-                        with self._lock:
-                            proc = self._procs.pop(addr, None)
-                        self._terminate(proc)
-                    raise
+            except BaseException:
+                # a failed spawn OR migration must not leak the servers
+                # just spawned for this plan: they are not in the route,
+                # and a retried plan would spawn a fresh set on top
+                for addr in spawned:
+                    with self._lock:
+                        proc = self._procs.pop(addr, None)
+                    self._terminate(proc)
+                raise
             for addr in retired:  # drained by the migrate; now stop
                 with self._lock:
                     proc = self._procs.pop(addr, None)
@@ -866,10 +873,15 @@ class EmbeddingServerScaler:
             proc.stop()
 
     def stop_all(self) -> None:
+        # flag first so an in-flight/next scale() refuses to spawn more;
+        # terminate OUTSIDE the lock (a straggler's wait must not block
+        # the registrations scale() does under short lock holds)
+        self._stopped = True
         with self._lock:
-            for proc in self._procs.values():
-                self._terminate(proc)
+            procs = list(self._procs.values())
             self._procs.clear()
+        for proc in procs:
+            self._terminate(proc)
 
 
 def main(argv=None) -> int:

@@ -510,3 +510,46 @@ class TestAutoScaledTableTier:
         finally:
             coord.stop()
             srv.stop()
+
+    def test_failed_spawn_cleans_up_earlier_spawns(self):
+        """A readiness failure on spawn #2 must reap spawn #1 (review
+        finding: the leak pattern also exists at the spawn leg)."""
+        from dlrover_tpu.cluster.crd import ScalePlan
+        from dlrover_tpu.embedding.service import EmbeddingServerScaler
+
+        srv = EmbeddingShardServer(dim=DIM, num_slots=2, seed=7,
+                                   host="127.0.0.1", index=0,
+                                   num_shards=1).start()
+        coord = EmbeddingCoordinator(
+            [f"127.0.0.1:{srv.port}"], host="127.0.0.1").start()
+        stopped = []
+
+        class _P:
+            def __init__(self, i): self.i = i
+            def stop(self): stopped.append(self.i)
+
+        calls = []
+
+        def spawn(index):
+            calls.append(index)
+            if len(calls) == 2:
+                raise RuntimeError("server not ready")
+            return f"127.0.0.1:{58000 + index}", _P(index)
+
+        scaler = EmbeddingServerScaler(DIM, coordinator=coord,
+                                       spawn=spawn)
+        try:
+            with pytest.raises(RuntimeError, match="not ready"):
+                scaler.scale(ScalePlan(
+                    replica_resources={"table_server": 3}))
+            assert stopped == [1]
+            assert not scaler._procs
+            assert coord.version == 0  # route untouched
+            # shutdown refuses further scaling
+            scaler.stop_all()
+            with pytest.raises(RuntimeError, match="shut down"):
+                scaler.scale(ScalePlan(
+                    replica_resources={"table_server": 2}))
+        finally:
+            coord.stop()
+            srv.stop()
