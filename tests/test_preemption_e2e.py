@@ -214,3 +214,48 @@ class TestWatcherUnit:
         assert node1.preempting_since == 0.0
         nm._check_dead_nodes()
         assert dead == [0]  # node 1 stays alive on the normal window
+
+    def test_url_source_fires_on_maintenance_event(self):
+        """The metadata-URL notice source (GCE maintenance-event
+        convention): NONE means keep running, anything else fires."""
+        import http.server
+        import threading as th
+
+        from dlrover_tpu.agent.preemption import PreemptionWatcher
+
+        body = {"value": b"NONE"}
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                assert self.headers.get("Metadata-Flavor") == "Google"
+                self.send_response(200)
+                self.send_header("Content-Length",
+                                 str(len(body["value"])))
+                self.end_headers()
+                self.wfile.write(body["value"])
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        th.Thread(target=srv.serve_forever, daemon=True).start()
+        fired = []
+        w = PreemptionWatcher(
+            lambda: fired.append(1), poll_interval_s=0.05,
+            notice_file="",
+            notice_url=f"http://127.0.0.1:{srv.server_address[1]}/",
+        )
+        try:
+            assert w.enabled
+            w.start()
+            time.sleep(0.3)
+            assert fired == []          # NONE: no notice
+            body["value"] = b"TERMINATE_ON_HOST_MAINTENANCE"
+            deadline = time.time() + 5
+            while not fired and time.time() < deadline:
+                time.sleep(0.05)
+            assert fired == [1]
+        finally:
+            w.stop()
+            srv.shutdown()
+            srv.server_close()

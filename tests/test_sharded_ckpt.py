@@ -313,3 +313,54 @@ class TestAssemble:
         pieces = [self._piece(full[:4], [[0, 4], [0, 8]])]
         with pytest.raises(CoverageError):
             assemble([[2, 6], [0, 8]], np.float32, pieces)
+
+
+class TestConsensusRollbackUnits:
+    """Direct coverage for the preemption-recovery rollback pieces
+    (exercised end-to-end in test_preemption_e2e; pinned here)."""
+
+    def test_full_host_state_assembles_and_validates(self):
+        from dlrover_tpu.checkpoint.sharded import (
+            CoverageError,
+            PieceSource,
+            ShardedCheckpointEngine,
+        )
+
+        full = np.arange(12, dtype=np.float32).reshape(3, 4)
+        pieces = {
+            "params/w": [
+                PieceSource("params/w", (3, 4), np.dtype(np.float32),
+                            [[0, 2], [0, 4]], lambda: full[:2]),
+                PieceSource("params/w", (3, 4), np.dtype(np.float32),
+                            [[2, 3], [0, 4]], lambda: full[2:]),
+            ],
+        }
+        template = {"params": {"w": np.zeros((3, 4), np.float32)}}
+        eng = ShardedCheckpointEngine.__new__(ShardedCheckpointEngine)
+        got = eng._full_host_state(template, pieces)
+        np.testing.assert_array_equal(got["params"]["w"], full)
+
+        # a gap raises CoverageError (-> storage fallback, not garbage)
+        gappy = {"params/w": pieces["params/w"][:1]}
+        with pytest.raises(CoverageError):
+            eng._full_host_state(template, gappy)
+
+        # dtype drift (fp16 template vs fp32 snapshot — numpy has no
+        # native bfloat16, same code path) raises ValueError -> storage
+        # fallback; a mismatched broadcast tree would wedge the
+        # recovery collective
+        fp16_template = {
+            "params": {"w": np.zeros((3, 4), np.float16)}
+        }
+        with pytest.raises(ValueError, match="dtype"):
+            eng._full_host_state(fp16_template, pieces)
+
+    def test_allgather_steps_single_process(self):
+        from dlrover_tpu.checkpoint.sharded import (
+            ShardedCheckpointEngine,
+        )
+
+        steps = ShardedCheckpointEngine._allgather_steps(7)
+        assert steps.tolist() == [7]
+        assert ShardedCheckpointEngine._allgather_steps(-1).tolist() \
+            == [-1]
