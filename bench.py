@@ -6,11 +6,14 @@ line on stdout is always a valid result (round-3 lesson: one overrunning
 stage + single end-of-run print produced rc=124 / parsed=null and lost all
 validated numbers).
 
-Budget model: BENCH_BUDGET_S (default 2400 s) is the envelope for the whole
-run. Stages execute headline-first (ckpt, goodput, MFU, serving, int8,
-soak) and each is skipped when the remaining envelope is smaller than its
-cost estimate; a SIGALRM per-stage deadline stops a wedged stage without
-killing the run.
+Budget model: BENCH_BUDGET_S (default 1800 s) is a HARD envelope: a stage
+only starts when the remaining budget covers its full per-stage deadline,
+so the run can never overshoot (r04: the est-based gate let one stage
+overrun by 200 s and the driver's kill timer fired). A SIGALRM per-stage
+deadline stops a wedged stage without killing the run; after every stage
+the cumulative line AND a compact headline-only line are re-printed
+(single atomic os.write), so any tail byte-window capture ends with a
+complete, parseable headline line.
 
 Headline metric: checkpoint save blocking time for a GPT-2-small-class
 (~1.5 GB) train state, against the reference Flash Checkpoint bar of 0.5 s
@@ -203,6 +206,34 @@ def bench_train_step(extra: dict) -> None:
         medium_err = f"{type(e).__name__}: {e}"
         extra["mfu_medium_error"] = medium_err[:300]
 
+    # gpt2-large third geometry (r04 Weak #5: 0.434 MFU with
+    # recompute-vs-OOM configs only; the round-5 sweep adds host-offload
+    # remat to the menu). Config is env-pinned from the measured sweep;
+    # errors must not cost the small/medium numbers.
+    if os.environ.get("BENCH_LARGE", "1") != "0":
+        try:
+            overrides = dict(
+                remat_scan=True,
+                remat_policy=os.environ.get("BENCH_LARGE_POLICY", "full"),
+                attention="splash", ce_chunks=16,
+                scan_unroll=int(os.environ.get("BENCH_LARGE_UNROLL",
+                                               "4")),
+            )
+            interval = int(os.environ.get("BENCH_LARGE_INTERVAL", "1"))
+            if interval > 1:
+                overrides["remat_interval"] = interval
+            _train_one(
+                extra, "large_", "gpt2-large",
+                batch=int(os.environ.get("BENCH_LARGE_BATCH", "12")),
+                seq=int(os.environ.get("BENCH_SEQ", "1024")),
+                steps=int(os.environ.get("BENCH_LARGE_STEPS", "10")),
+                cfg_overrides=overrides,
+                optimizer="adam8bit",
+            )
+            extra["mfu_large"] = extra.get("large_mfu")
+        except Exception as e:  # noqa: BLE001 - rider geometry
+            extra["mfu_large_error"] = f"{type(e).__name__}: {e}"[:300]
+
     # gpt2-small secondary. NOTE: the r03 "bandwidth-bound ceiling"
     # analysis (0.393 MFU, ~85% of the d_model=768 matmul roofline) was
     # measured with attention silently DENSE (the bare-loss_fn bug fixed
@@ -299,11 +330,45 @@ def bench_long_context(extra: dict) -> None:
         extra["lc_dense_error"] = f"{type(e).__name__}"
 
 
+def _disk_bw_probe(dir_path: str, mb: int = 128) -> float:
+    """Measured sequential write bandwidth (GB/s) incl. fsync — the
+    disk-leg sizes are derived from THIS, so a slow or full /tmp can
+    never push the stage into its SIGALRM (r04 lesson: the 12 GB persist
+    + cold-restore legs at ~0.2 GB/s burned the whole 600 s deadline)."""
+    path = os.path.join(dir_path, "bw_probe.bin")
+    chunk = os.urandom(1 << 20)
+    t0 = time.monotonic()
+    try:
+        with open(path, "wb") as f:
+            for _ in range(mb):
+                f.write(chunk)
+            f.flush()
+            os.fsync(f.fileno())
+        dt = time.monotonic() - t0
+    finally:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+    return (mb / 1024) / max(dt, 1e-6)
+
+
 def bench_checkpoint(extra: dict, gb: float | None = None,
                      prefix: str = "ckpt_") -> None:
     """Host-side snapshot/restore path. Default ~1.5 GB GPT-2-small-class
     state; called again with ``gb`` ~12 for the 1B-param config
-    (BASELINE configs 2-3; reference flash_checkpoint.md GPT-2 1.5B)."""
+    (BASELINE configs 2-3; reference flash_checkpoint.md GPT-2 1.5B).
+
+    Save-block headline: for the big state the engine's COW (fork)
+    snapshot is the production mode — blocking cost is the fork, the
+    child does the arena memcpy (this host has ONE core, so the direct
+    path is memcpy-roofline-bound at ~7 GB/s and the reference's
+    per-shard threadpool answer cannot apply). The direct number is
+    reported alongside for honesty, as is the child's copy wall time.
+
+    Disk legs are sized from a measured bandwidth probe and extrapolated
+    to the full state when capped, so they can't blow the stage deadline.
+    """
     os.environ.setdefault("DLROVER_TPU_IPC_DIR",
                           tempfile.mkdtemp(prefix="bench_ipc_"))
     from dlrover_tpu.checkpoint.engine import CheckpointEngine
@@ -318,26 +383,56 @@ def bench_checkpoint(extra: dict, gb: float | None = None,
         "nu": {"w": rng.standard_normal(n).astype(np.float32)},
     }
     state_gb = 3 * n * 4 / (1 << 30)
+    big = state_gb >= 4.0
 
     ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt_")
     engine = CheckpointEngine(ckpt_dir, node_id=int(os.getpid()) % 100000)
     # each leg lands in `extra` AS MEASURED: a stage deadline hitting
-    # the slow tail (the 12 GB persist/cold-restore legs swing with
-    # disk state) must keep the numbers already taken, not void the
+    # the slow tail must keep the numbers already taken, not void the
     # stage (the r04 second rehearsal lost ckpt1b exactly that way)
     extra[f"{prefix}state_gb"] = round(state_gb, 2)
+    sub_engine = None
+    sub_dir = None
     try:
-        engine.save_to_memory(1, state)  # warmup: arena creation
-        # median of 3: these are sub-second host-side numbers, easily
-        # skewed by transient host load during the round's bench run
-        save_times = []
-        for i in range(3):
+        engine.snapshot_mode = "direct"
+        t0 = time.monotonic()
+        engine.save_to_memory(1, state)  # warmup: arena creation+faults
+        warm_s = time.monotonic() - t0
+        direct_reps = 1 if big else 3
+        direct_times = []
+        for i in range(direct_reps):
             t0 = time.monotonic()
             ok = engine.save_to_memory(2 + i, state)
-            save_times.append(time.monotonic() - t0)
+            direct_times.append(time.monotonic() - t0)
             assert ok
-        extra[f"{prefix}save_block_s"] = round(sorted(save_times)[1], 3)
-        last_step = 2 + len(save_times) - 1
+        direct_s = sorted(direct_times)[len(direct_times) // 2]
+        step = 2 + direct_reps - 1
+        # COW (fork) saves: blocking = fork; child copy rides along
+        engine.snapshot_mode = "cow"
+        cow_times, copy_times = [], []
+        for i in range(3):
+            engine.wait_snapshot(timeout=120)  # prior child, untimed —
+            # matches production cadence (training steps between saves)
+            t0 = time.monotonic()
+            ok = engine.save_to_memory(step + 1 + i, state)
+            cow_times.append(time.monotonic() - t0)
+            assert ok
+            engine.wait_snapshot(timeout=120)
+            copy_times.append(engine.last_snapshot_info.get("copy_s"))
+        step = step + 3
+        cow_s = sorted(cow_times)[1]
+        copies = [c for c in copy_times if c is not None]
+        # the BIG state's headline is the COW path (production mode for
+        # states whose direct copy would block >0.5 s); the small state
+        # keeps the direct path as its cross-round-comparable headline
+        extra[f"{prefix}save_block_s"] = round(cow_s if big else direct_s,
+                                               3)
+        extra[f"{prefix}save_block_direct_s"] = round(direct_s, 3)
+        extra[f"{prefix}save_block_cow_s"] = round(cow_s, 4)
+        if copies:
+            extra[f"{prefix}copy_s"] = round(sorted(copies)[1], 3)
+        extra[f"{prefix}arena_warmup_s"] = round(warm_s, 3)
+        engine.snapshot_mode = "direct"
 
         # the production restore path (what examples/train_transformer.py
         # runs): zero-copy arena views handed straight to the consumer
@@ -349,57 +444,103 @@ def bench_checkpoint(extra: dict, gb: float | None = None,
             loaded = engine.load(state, put=lambda _n, a: a.sum(),
                                  zero_copy=True)
             restore_times.append(time.monotonic() - t0)
-            assert loaded is not None and loaded[0] == last_step
+            assert loaded is not None and loaded[0] == step
         extra[f"{prefix}restore_s"] = round(sorted(restore_times)[1], 3)
 
-        # full host-side materialization (np consumers); rides along —
-        # dominated by destination page faults, not the snapshot read
+        # host-side materialization (np consumers); rides along —
+        # dominated by destination page faults, not the snapshot read.
+        # Capped at ~4 GB via a partial template on the big state (the
+        # r04 full-12 GB leg took 64 s under memory pressure).
+        mat_tmpl = ({"params": state["params"]} if big else state)
+        mat_gb = state_gb / 3 if big else state_gb
         t0 = time.monotonic()
-        loaded = engine.load(state)
-        extra[f"{prefix}restore_copy_s"] = round(
-            time.monotonic() - t0, 3)
-        assert loaded is not None and loaded[0] == last_step
+        loaded = engine.load(mat_tmpl)
+        mat_s = time.monotonic() - t0
+        extra[f"{prefix}restore_copy_s"] = round(mat_s, 3)
+        extra[f"{prefix}restore_copy_gb"] = round(mat_gb, 2)
+        if big:
+            extra[f"{prefix}restore_copy_full_est_s"] = round(
+                mat_s * state_gb / mat_gb, 1)
+        assert loaded is not None and loaded[0] == step
         np.testing.assert_array_equal(
-            loaded[1]["params"]["w"], state["params"]["w"]
+            loaded[1]["params"]["w"][:1024], state["params"]["w"][:1024]
         )
+        del loaded
 
+        # ---- disk legs, sized by measured bandwidth ----
+        disk_bw = _disk_bw_probe(ckpt_dir)
+        extra[f"{prefix}disk_write_gbps"] = round(disk_bw, 3)
+        cap_s = float(os.environ.get("BENCH_PERSIST_CAP_S", "35"))
+        persist_gb = min(state_gb, max(0.5, disk_bw * cap_s * 0.9), 4.0)
+        if persist_gb >= state_gb * 0.95:
+            p_engine, p_state, p_gb = engine, state, state_gb
+            p_step = step
+        else:
+            # subsampled state on its own engine/dir; extrapolate
+            m = int(persist_gb * (1 << 30) / 12)
+            p_state = {k: {"w": v["w"][:m]} for k, v in state.items()}
+            p_gb = 3 * m * 4 / (1 << 30)
+            sub_dir = tempfile.mkdtemp(prefix="bench_ckpt_sub_")
+            sub_engine = CheckpointEngine(
+                sub_dir, node_id=(int(os.getpid()) + 1) % 100000)
+            p_engine = sub_engine
+            p_engine.save_to_memory(1, p_state)
+            p_step = 1
+            extra[f"{prefix}persist_capped_gb"] = round(p_gb, 2)
         t0 = time.monotonic()
-        engine.save_to_storage(last_step + 1, state)
-        persisted = engine.wait_for_persist(last_step + 1, timeout=600)
+        p_engine.save_to_storage(p_step + 1, p_state)
+        persisted = p_engine.wait_for_persist(
+            p_step + 1, timeout=max(60, cap_s * 3))
+        p_s = time.monotonic() - t0
         extra[f"{prefix}persist_async_s"] = (
-            round(time.monotonic() - t0, 2) if persisted else None
-        )
+            round(p_s, 2) if persisted else None)
+        if persisted and p_gb < state_gb * 0.95:
+            extra[f"{prefix}persist_async_full_est_s"] = round(
+                p_s * state_gb / p_gb, 1)
 
         # cold storage restore: the path a REAL preemption runs (fresh
         # host: no shm). Drop the shm header so load() takes the storage
         # branch (round-2 Weak #6: this leg was never measured).
-        engine.shm_handler.clear()
-        t0 = time.monotonic()
-        loaded = engine.load(state)
-        extra[f"{prefix}cold_storage_restore_s"] = round(
-            time.monotonic() - t0, 2)
-        assert loaded is not None and loaded[0] == last_step + 1
-        np.testing.assert_array_equal(
-            loaded[1]["params"]["w"][:1024], state["params"]["w"][:1024]
-        )
+        if persisted:
+            p_engine.shm_handler.clear()
+            t0 = time.monotonic()
+            loaded = p_engine.load(p_state)
+            cold_s = time.monotonic() - t0
+            extra[f"{prefix}cold_storage_restore_s"] = round(cold_s, 2)
+            if p_gb < state_gb * 0.95:
+                extra[f"{prefix}cold_storage_restore_full_est_s"] = round(
+                    cold_s * state_gb / p_gb, 1)
+            assert loaded is not None and loaded[0] == p_step + 1
+            np.testing.assert_array_equal(
+                loaded[1]["params"]["w"][:1024],
+                p_state["params"]["w"][:1024]
+            )
     finally:
         # the 12 GB variant leaves its weight in /tmp otherwise — six
         # stale runs filled the disk to 100% during r04 and slowed the
         # very persist leg this stage measures. Nested finally: the
         # stage alarm can fire INSIDE engine.close()'s bounded waits,
         # and the rmtree must survive that too.
-        try:
-            engine.close()
-        finally:
-            import shutil
+        import shutil
 
+        try:
+            try:
+                engine.close()
+            finally:
+                if sub_engine is not None:
+                    sub_engine.close()
+        finally:
             shutil.rmtree(ckpt_dir, ignore_errors=True)
+            if sub_dir:
+                shutil.rmtree(sub_dir, ignore_errors=True)
     if prefix == "ckpt_":
         extra["ckpt_note"] = (
             "host-side snapshot path; D2H excluded (axon tunnel runs "
             "~0.02 GB/s, unrepresentative of a TPU host). ckpt_restore_s "
             "times the production zero-copy view path; "
-            "cold_storage_restore_s is the fresh-host storage read"
+            "cold_storage_restore_s is the fresh-host storage read; "
+            "save_block headline = direct copy (small state) / COW fork "
+            "(big state), both reported"
         )
 
 
@@ -515,9 +656,16 @@ def _snapshot_cost_s(log_path: str, mem_interval: int) -> float:
 
 def _goodput_scenario(extra: dict, prefix: str, child_env: dict,
                       target_s: float, kills: int,
-                      stage_budget_s: float = 1800.0) -> None:
+                      stage_budget_s: float = 1800.0,
+                      cal: tuple[float, float] | None = None,
+                      safety: float = 1.5) -> None:
     """One full goodput measurement (calibrate -> inject-and-measure).
-    ``stage_budget_s`` bounds calibration + measured run together."""
+    ``stage_budget_s`` bounds calibration + measured run together.
+    ``cal`` = (step_s, snap_s) from an earlier scenario on the same
+    backend skips the calibration run (sound on CPU: there is no
+    persistent compile cache to warm there). ``safety`` is the
+    headroom factor between the remaining budget and the measured
+    window (1.5 default; low-kill scenarios can afford less)."""
     import math
     import shutil
 
@@ -563,19 +711,24 @@ def _goodput_scenario(extra: dict, prefix: str, child_env: dict,
         # ---- calibration: steady step time + per-snapshot cost (also
         # warms the compile cache so measured-run restarts don't compile)
         cal_interval = 5
-        rc, tail, _, _, _ = _run_elastic_job(
-            work, env,
-            train_args(cal_interval) + ["--dataset-size", "100000"],
-            max_steps=60, kills=0,
-            deadline_s=min(900, stage_budget_s * 0.45), example=example)
-        if rc != 0:
-            extra[f"{prefix}error"] = f"calibration rc={rc}: {tail}"
-            return
-        cal = compute_goodput(log)
-        step_s = max(1e-4, cal.median_step_s)
-        snap_s = _snapshot_cost_s(log, cal_interval)
+        if cal is None:
+            rc, tail, _, _, _ = _run_elastic_job(
+                work, env,
+                train_args(cal_interval) + ["--dataset-size", "100000"],
+                max_steps=60, kills=0,
+                deadline_s=min(900, stage_budget_s * 0.45),
+                example=example)
+            if rc != 0:
+                extra[f"{prefix}error"] = f"calibration rc={rc}: {tail}"
+                return
+            cal_report = compute_goodput(log)
+            step_s = max(1e-4, cal_report.median_step_s)
+            snap_s = _snapshot_cost_s(log, cal_interval)
+        else:
+            step_s, snap_s = max(1e-4, cal[0]), cal[1]
+        extra[f"{prefix}cal_step_s"] = round(step_s, 5)
         remaining = stage_budget_s - (time.monotonic() - t_stage0) - 60
-        target_s = max(60.0, min(target_s, remaining / 1.5))
+        target_s = max(60.0, min(target_s, remaining / safety))
         total_steps = max(120, min(200000, int(target_s / step_s)))
         # snapshot cadence that balances snapshot overhead against
         # rollback re-compute: minimize steps/interval*snap +
@@ -587,7 +740,8 @@ def _goodput_scenario(extra: dict, prefix: str, child_env: dict,
         else:
             interval = cal_interval
         interval = max(1, min(interval, total_steps // 8))
-        os.remove(log)
+        if os.path.exists(log):
+            os.remove(log)
         shutil.rmtree(os.path.join(work, "ckpt"), ignore_errors=True)
         shutil.rmtree(os.path.join(work, "ipc"), ignore_errors=True)
 
@@ -690,6 +844,34 @@ def bench_goodput(extra: dict, stage_budget_s: float = 900.0) -> None:
         if f"goodput_sys_{k}" in extra:
             name = k if k.startswith("goodput") else f"goodput_{k}"
             extra[name] = extra[f"goodput_sys_{k}"]
+
+
+def bench_goodput_lowrate(extra: dict,
+                          stage_budget_s: float = 620.0) -> None:
+    """Near-baseline-rate goodput in the DRIVER'S evidence (r04 Weak #4:
+    the 20.7-min/one-kill run lived only in prose). One injected SIGKILL
+    across a ~420 s measured window (~8 failures/hr vs the main stage's
+    ~30/hr and the baseline's 1/hr), so the raw number — not just the
+    decomposed at-baseline projection — is close to deployment shape.
+    Reuses the main goodput stage's calibration (same CPU backend, same
+    model) so the whole budget goes to the measured window."""
+    if os.environ.get("BENCH_GOODPUT_LOWRATE", "1") == "0":
+        return
+    cal = None
+    if "goodput_sys_median_step_s" in extra:
+        cal = (extra["goodput_sys_median_step_s"],
+               extra.get("goodput_sys_snapshot_cost_s", 0.0))
+    _goodput_scenario(
+        extra, "goodput_lowrate_", child_env=_cpu_child_env(),
+        target_s=float(os.environ.get("BENCH_GOODPUT_LOWRATE_S", "420")),
+        kills=1, stage_budget_s=stage_budget_s, cal=cal, safety=1.25,
+    )
+    if "goodput_lowrate_goodput" in extra:
+        extra["goodput_lowrate_raw"] = extra["goodput_lowrate_goodput"]
+        total = extra.get("goodput_lowrate_total_s") or 1.0
+        extra["goodput_lowrate_failures_per_hr"] = round(
+            extra.get("goodput_lowrate_failures_injected", 0)
+            * 3600.0 / total, 1)
 
 
 def bench_goodput_tpu(extra: dict, stage_budget_s: float = 700.0) -> None:
@@ -993,25 +1175,41 @@ class Stage:
 
 
 STAGES = [
-    # headline stages first: by minute ~20 every number the round is
-    # judged on has been emitted at least once
-    Stage("ckpt", bench_checkpoint, est_s=90, deadline_s=240),
-    Stage("goodput", bench_goodput, est_s=420, deadline_s=900,
+    # headline stages first: by minute ~10 every number the round is
+    # judged on has been emitted at least once. A stage only STARTS when
+    # the remaining envelope covers its full DEADLINE (r04 lesson: the
+    # est-based gate let ckpt1b legally overrun the envelope by 200 s),
+    # so the run can never exceed BENCH_BUDGET_S. Estimates track the
+    # r04 rehearsal actuals on this host; deadlines are ~1.5-2.5x est.
+    Stage("ckpt", bench_checkpoint, est_s=40, deadline_s=150),
+    Stage("ckpt1b", bench_checkpoint_1b, est_s=150, deadline_s=400),
+    Stage("goodput", bench_goodput, est_s=260, deadline_s=420,
           pass_budget=True),
-    Stage("mfu", bench_train_step, est_s=300, deadline_s=700),
-    Stage("serving", bench_serving, est_s=180, deadline_s=480),
-    Stage("int8", bench_int8, est_s=300, deadline_s=700),
-    Stage("soak", bench_soak, est_s=240, deadline_s=360,
+    Stage("mfu", bench_train_step, est_s=250, deadline_s=520),
+    Stage("serving", bench_serving, est_s=140, deadline_s=300),
+    Stage("soak", bench_soak, est_s=80, deadline_s=160,
           pass_budget=True),
-    # extras, cheapest-information-per-second last. Estimates track the
-    # r04 rehearsal actuals (ckpt1b 416s, goodput_tpu 640s on this
-    # host) so the skip decision is honest.
-    Stage("ckpt1b", bench_checkpoint_1b, est_s=400, deadline_s=600),
-    Stage("long_context", bench_long_context, est_s=180, deadline_s=480),
-    Stage("aot7b", bench_7b_aot, est_s=120, deadline_s=600,
+    Stage("int8", bench_int8, est_s=280, deadline_s=450),
+    Stage("goodput_lowrate", bench_goodput_lowrate, est_s=500,
+          deadline_s=600, pass_budget=True),
+    Stage("aot7b", bench_7b_aot, est_s=20, deadline_s=120,
           pass_budget=True),
-    Stage("goodput_tpu", bench_goodput_tpu, est_s=600, deadline_s=900,
+    Stage("long_context", bench_long_context, est_s=150, deadline_s=300),
+    Stage("goodput_tpu", bench_goodput_tpu, est_s=250, deadline_s=420,
           pass_budget=True),
+]
+
+# the compact tail line: every number the round is judged on, small
+# enough that ANY tail byte-window keeps it intact (r04 lesson: the
+# cumulative line put ckpt/goodput FIRST and the driver's tail window
+# cropped exactly those)
+HEADLINE_KEYS = [
+    "goodput", "goodput_at_baseline_rate", "goodput_lowrate_raw",
+    "goodput_lowrate_failures_per_hr", "mfu", "mfu_medium", "mfu_large",
+    "ckpt_save_block_s", "ckpt_restore_s", "ckpt1b_save_block_s",
+    "ckpt1b_copy_s", "ckpt1b_restore_s", "serving_toks_per_s",
+    "int8_ffn_speedup", "soak_completed", "soak_kills",
+    "lc_best_speedup", "bench_total_s",
 ]
 
 
@@ -1027,25 +1225,51 @@ def _result_line(extra: dict) -> str:
     })
 
 
-def main() -> None:
+def _headline_line(extra: dict, errors: list[str]) -> str:
+    save_s = extra.get("ckpt_save_block_s")
+    head = {k: extra[k] for k in HEADLINE_KEYS if k in extra}
+    if errors:
+        head["n_errors"] = len(errors)
+    return json.dumps({
+        "metric": "ckpt_save_block_s",
+        "value": save_s,
+        "unit": "s",
+        "vs_baseline":
+            round(CKPT_SAVE_BASELINE_S / save_s, 2) if save_s else None,
+        "headline": head,
+    })
+
+
+def main() -> int:
     extra: dict = {}
     errors: list[str] = []
-    budget = float(os.environ.get("BENCH_BUDGET_S", "2400"))
+    budget = float(os.environ.get("BENCH_BUDGET_S", "1800"))
     t_start = time.monotonic()
     extra["bench_budget_s"] = budget
     stage_times: dict = {}
     extra["stage_times"] = stage_times
-
-    def emit() -> None:
+    def emit(final: bool = False) -> None:
+        # one os.write of the whole buffer: Python signal handlers run
+        # between bytecodes, never inside a C syscall, so the write is
+        # atomic w.r.t. the SIGTERM handler — a handler-side emit can
+        # never splice into a half-flushed line (r04 advisor finding on
+        # the reentrant print). The leading newline re-anchors
+        # line-start even if some library left a partial line on stdout.
         if errors:
             extra["errors"] = errors
-        print(_result_line(extra), flush=True)
+        buf = ("\n" + _result_line(extra) + "\n"
+               + _headline_line(extra, errors) + "\n")
+        os.write(1, buf.encode())
 
     def on_alarm(signum, frame):  # noqa: ARG001
         raise StageTimeout()
 
     def on_term(signum, frame):  # noqa: ARG001
         errors.append("SIGTERM: flushed partial results")
+        # ALWAYS emit here: even if the handler interrupted an emit
+        # mid-buffer-build, this emit writes its own complete buffer in
+        # one os.write (the interrupted one simply never lands — its
+        # content is a subset of this one's)
         emit()
         # re-raise default so the driver still sees the termination
         signal.signal(signal.SIGTERM, signal.SIG_DFL)
@@ -1056,11 +1280,11 @@ def main() -> None:
 
     for st in STAGES:
         left = budget - (time.monotonic() - t_start)
-        if left < st.est_s:
+        if left < st.deadline_s:
             stage_times[st.name] = f"skipped ({left:.0f}s left < " \
-                                   f"est {st.est_s:.0f}s)"
+                                   f"deadline {st.deadline_s:.0f}s)"
             continue
-        alarm_s = int(min(st.deadline_s, left))
+        alarm_s = int(st.deadline_s)
         t0 = time.monotonic()
         signal.alarm(alarm_s)
         try:
@@ -1075,10 +1299,14 @@ def main() -> None:
         finally:
             signal.alarm(0)
         stage_times[st.name] = round(time.monotonic() - t0, 1)
+        extra["bench_total_s"] = round(time.monotonic() - t_start, 1)
         emit()
 
     extra["bench_total_s"] = round(time.monotonic() - t_start, 1)
-    emit()
+    emit(final=True)
+    # exit 0 explicitly: a skipped tail is a successful bounded run,
+    # not a failure (three rounds of rc=124 were the alternative)
+    return 0
 
 
 if __name__ == "__main__":

@@ -53,6 +53,7 @@ class CheckpointEngine:
         node_rank: int | None = None,
         world_size: int | None = None,
         replicated: bool = True,
+        snapshot_mode: str = "direct",
     ):
         self.ckpt_dir = ckpt_dir
         self.storage = storage or PosixDiskStorage()
@@ -85,6 +86,18 @@ class CheckpointEngine:
         self._snap_thread: threading.Thread | None = None
         self._device_copy = None
         self._async_ok: bool | None = None
+        # COW (fork) snapshot mode: save_to_memory returns after the fork
+        # and a child process does the arena memcpy (shm_handler.
+        # save_state_dict_fork). "direct" keeps the in-process copy.
+        if snapshot_mode not in ("direct", "cow"):
+            raise ValueError(f"snapshot_mode {snapshot_mode!r}")
+        self.snapshot_mode = (
+            snapshot_mode if hasattr(os, "fork") else "direct"
+        )
+        self._cow_done = threading.Event()
+        self._cow_done.set()
+        self._cow_info: dict = {}
+        self._cow_ok: bool | None = None  # None = no COW save yet
         self._solo_saver = None
         agent_present = client_socket_ready(f"dict_ckpt_node{self.node_id}")
         if not agent_present:
@@ -129,11 +142,17 @@ class CheckpointEngine:
         then got descheduled could overwrite a NEWER sync snapshot the
         persister is about to read.
         """
+        # at most one COW child in flight: its arena write is guarded by
+        # the shm lock the watcher releases, so a second save must wait
+        # for that release rather than silently skip
+        if self.snapshot_mode == "cow":
+            self.wait_snapshot(timeout=300.0)
         if not self.shm_handler.lock.acquire(blocking=False):
             logger.warning(
                 "skipping in-memory save at step %d: persister busy", step
             )
             return False
+        release_lock = True
         try:
             with self._pending_lock:
                 if _async_seq is not None:
@@ -145,16 +164,60 @@ class CheckpointEngine:
                     self._pending = None
             start = time.monotonic()
             tree, extra = self._prepare_state(state)
+            extra_meta = {**self._extra_meta(), **extra}
+            if self.snapshot_mode == "cow":
+                self._cow_done.clear()
+                self._cow_ok = None
+
+                def _on_done(ok: bool, info: dict) -> None:
+                    self._cow_info = info
+                    self._cow_ok = ok
+                    self.shm_handler.lock.release()
+                    self._cow_done.set()
+
+                try:
+                    info = self.shm_handler.save_state_dict_fork(
+                        step, tree, extra_meta=extra_meta,
+                        on_done=_on_done,
+                    )
+                except BaseException:
+                    self._cow_done.set()
+                    raise
+                release_lock = False  # the watcher owns the release now
+                logger.info(
+                    "step %d COW-snapshot forked in %.3fs (child %d "
+                    "copying %.2f GB)", step, info["fork_s"],
+                    info["pid"], info["total_bytes"] / (1 << 30),
+                )
+                return True
             self.shm_handler.save_state_dict(
-                step, tree, extra_meta={**self._extra_meta(), **extra}
+                step, tree, extra_meta=extra_meta
             )
+            # a direct save supersedes any earlier failed COW verdict
+            self._cow_ok = None
             logger.info(
                 "step %d snapshotted to shm in %.3fs",
                 step, time.monotonic() - start,
             )
             return True
         finally:
-            self.shm_handler.lock.release()
+            if release_lock:
+                self.shm_handler.lock.release()
+
+    def wait_snapshot(self, timeout: float = 60.0) -> bool:
+        """Block until any in-flight COW snapshot child has finished.
+        Returns False if it timed out OR the child FAILED (its header
+        was never published — the previous snapshot still stands).
+        True immediately in direct mode."""
+        if not self._cow_done.wait(timeout=timeout):
+            return False
+        return self._cow_ok is not False
+
+    @property
+    def last_snapshot_info(self) -> dict:
+        """Timing of the last completed COW snapshot ({fork_s, copy_s,
+        total_bytes}); empty in direct mode."""
+        return dict(self._cow_info)
 
     def _async_eligible(self) -> bool:
         """The gate lives HERE, not at call sites: sharded engines need
@@ -253,6 +316,23 @@ class CheckpointEngine:
             self.flush_async()
         if not self.save_to_memory(step, state):
             return False
+        # a COW child may still be copying; the persist event must not
+        # race it or the saver would read the previous header. A FAILED
+        # child (OOM-killed mid-memcpy) must not enqueue either — the
+        # header still describes the previous step and the persister
+        # would durably commit the wrong one. Fall back to the direct
+        # in-process copy: slower, but the durable save semantics hold.
+        if not self.wait_snapshot(timeout=300.0):
+            logger.warning(
+                "COW snapshot for step %d failed; falling back to the "
+                "direct copy for the durable save", step,
+            )
+            mode, self.snapshot_mode = self.snapshot_mode, "direct"
+            try:
+                if not self.save_to_memory(step, state):
+                    return False
+            finally:
+                self.snapshot_mode = mode
         if self._should_write_storage():
             self.event_queue.put({"kind": "save", "step": step})
         return True
@@ -288,6 +368,15 @@ class CheckpointEngine:
         """
         if zero_copy and put is None:
             raise ValueError("zero_copy=True requires a consuming `put`")
+        # a COW child mid-copy is overwriting the arena under the OLD
+        # header: reading now would return a torn mix of two steps. A
+        # FAILED child is fine (header untouched, previous snapshot
+        # stands), but an in-flight one must finish first.
+        if not self._cow_done.wait(timeout=300.0):
+            raise RuntimeError(
+                "COW snapshot child still copying after 300s; refusing "
+                "a torn arena read"
+            )
         loaded = self._load_from_memory(copy=not zero_copy)
         if loaded is not None and step is not None and loaded[0] != step:
             loaded = None
@@ -302,6 +391,11 @@ class CheckpointEngine:
         """(step, {leaf_path: array}) without a shape template — for
         states with data-dependent shapes (embedding tables, whose row
         count is only known from the checkpoint itself)."""
+        if not self._cow_done.wait(timeout=300.0):
+            raise RuntimeError(
+                "COW snapshot child still copying after 300s; refusing "
+                "a torn arena read"
+            )
         loaded = self._load_from_memory()
         if loaded is None:
             loaded = self._load_from_storage()
@@ -387,6 +481,7 @@ class CheckpointEngine:
         return False
 
     def close(self) -> None:
+        self.wait_snapshot(timeout=30.0)
         if self._snap_thread is not None:
             self.flush_async(timeout=10.0)
             self._snap_stop.set()

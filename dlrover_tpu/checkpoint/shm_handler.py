@@ -18,7 +18,10 @@ from __future__ import annotations
 
 import json
 import math
+import os
+import struct
 import threading
+import time
 from typing import Any, Callable
 
 import numpy as np
@@ -152,6 +155,124 @@ class SharedMemoryHandler:
         if extra_meta:
             header.update(extra_meta)
         self.meta_dict.set(_HEADER_KEY, header)
+
+    def save_state_dict_fork(self, step: int, tree: Any,
+                             extra_meta: dict | None = None,
+                             on_done: Callable[[bool, dict], None]
+                             | None = None) -> dict:
+        """Copy-on-write snapshot: device leaves are fetched in the caller
+        (D2H must happen here — a forked child must never touch the device
+        runtime), then the process forks and the CHILD copies the host
+        buffers into the shared arena while the parent returns immediately.
+
+        Blocking cost is the ``fork`` itself (page-table duplication —
+        milliseconds even for multi-GB states, THP-backed heaps fork at
+        ~2MB/PTE granularity), not the memcpy: on a single-core host the
+        direct path is memcpy-roofline-bound (~7 GB/s measured, 1.6 s for
+        12 GB) and no threadpool can beat that, but COW moves the copy off
+        the training path entirely. The tax shifts to subsequent steps as
+        COW faults when training rewrites the state — the goodput bench's
+        snapshot-overhead accounting is where that shows up, honestly.
+
+        The header is published ONLY after the child exits cleanly, by a
+        watcher thread in the parent (the SharedDict/SharedLock clients are
+        mutex-guarded, so cross-thread use is safe; the child itself never
+        touches the socket clients — it inherits forked copies of their
+        fds and writing would interleave frames with the parent).
+
+        Returns ``{"pid", "fork_s", "total_bytes"}``; completion is
+        signalled via ``on_done(ok, info)`` from the watcher thread.
+
+        Fork-safety: the copy loop in the child runs over (view, host)
+        ndarray pairs constructed BEFORE the fork, so the child performs
+        no allocations beyond loop temporaries — minimizing the window
+        for the classic fork-while-malloc-locked deadlock.
+        """
+        import jax
+
+        named = _leaf_paths(tree)
+        metas, total = compute_layout(named)
+        fetched = self._fetch_packed(named)
+        if fetched is None:
+            for _, leaf in named:
+                if isinstance(leaf, jax.Array) and hasattr(
+                    leaf, "copy_to_host_async"
+                ):
+                    try:
+                        leaf.copy_to_host_async()
+                    except RuntimeError:
+                        pass
+            fetched = {
+                name: np.asarray(jax.device_get(leaf))
+                for name, leaf in named
+            }
+        with self._local_lock:
+            arena = self._ensure_arena(total)
+        buf = arena.buf
+        pairs = []
+        for name, _ in named:
+            info = metas[name]
+            host = fetched[name]
+            view = np.ndarray(host.shape, dtype=host.dtype,
+                              buffer=buf, offset=info["offset"])
+            pairs.append((view, host))
+        header = {"step": step, "total_size": total, "metas": metas}
+        if extra_meta:
+            header.update(extra_meta)
+
+        r_fd, w_fd = os.pipe()
+        t0 = time.monotonic()
+        import warnings
+
+        with warnings.catch_warnings():
+            # the multithreaded-fork warning is acknowledged: the child
+            # only runs the pre-built memcpy loop and _exit (see above)
+            warnings.simplefilter("ignore", DeprecationWarning)
+            pid = os.fork()
+        if pid == 0:  # ---- child: memcpy + signal, nothing else
+            try:
+                os.close(r_fd)
+                t_c = time.monotonic()
+                for view, host in pairs:
+                    np.copyto(view, host)
+                os.write(w_fd, struct.pack("d", time.monotonic() - t_c))
+                os._exit(0)
+            except BaseException:  # noqa: BLE001 - no cleanup in the child
+                os._exit(1)
+        fork_s = time.monotonic() - t0
+        os.close(w_fd)
+        info = {"pid": pid, "fork_s": fork_s, "total_bytes": total}
+
+        def _watch() -> None:
+            # ok means "copied AND header published": a child that
+            # copied but whose header publish failed must report
+            # failure, or the engine would enqueue a persist against
+            # the previous header believing this step landed
+            ok = False
+            try:
+                payload = os.read(r_fd, 8)
+                _, status = os.waitpid(pid, 0)
+                child_ok = (os.waitstatus_to_exitcode(status) == 0
+                            and len(payload) == 8)
+                if child_ok:
+                    info["copy_s"] = struct.unpack("d", payload)[0]
+                    self.meta_dict.set(_HEADER_KEY, header)
+                    ok = True
+                else:
+                    logger.error(
+                        "COW snapshot child (pid %d) failed; header for "
+                        "step %d not published", pid, step,
+                    )
+            except OSError:
+                logger.exception("COW snapshot watcher failed")
+            finally:
+                os.close(r_fd)
+                if on_done is not None:
+                    on_done(ok, info)
+
+        threading.Thread(target=_watch, name="cow-snapshot-watch",
+                         daemon=True).start()
+        return info
 
     def _fetch_packed(self, named: list[tuple[str, Any]]
                       ) -> dict[str, np.ndarray] | None:

@@ -1,0 +1,80 @@
+"""Raw-array message framing shared by the hot TCP paths.
+
+One message = JSON header (op, meta, array manifest) + concatenated raw
+array bytes, carried inside common/rpc's length-prefixed frame. No
+pickle anywhere (the reference's pickled-dataclass RPC is the one design
+choice SURVEY §7 explicitly refuses to port); arrays travel as raw
+buffers so multi-MB embedding rows / model weights don't pay a JSON
+float tax.
+
+Users: the sharded embedding service (embedding/service.py) and the
+disaggregated RLHF serving worker (rl/serving_worker.py).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as np
+
+_HLEN = struct.Struct("<I")
+
+
+def encode_msg(op: str, meta: dict | None = None,
+               arrays: dict[str, np.ndarray] | None = None) -> bytes:
+    manifest = {}
+    chunks = []
+    off = 0
+    for name, arr in (arrays or {}).items():
+        arr = np.ascontiguousarray(arr)
+        manifest[name] = {
+            "shape": list(arr.shape), "dtype": str(arr.dtype), "offset": off,
+        }
+        chunks.append(arr.tobytes())
+        off += arr.nbytes
+    header = json.dumps(
+        {"op": op, "meta": meta or {}, "arrays": manifest}
+    ).encode()
+    return b"".join([_HLEN.pack(len(header)), header] + chunks)
+
+
+def decode_msg(payload: bytes) -> tuple[str, dict, dict[str, np.ndarray]]:
+    (hlen,) = _HLEN.unpack(payload[:_HLEN.size])
+    header = json.loads(payload[_HLEN.size:_HLEN.size + hlen])
+    base = _HLEN.size + hlen
+    arrays = {}
+    for name, info in header["arrays"].items():
+        dtype = np.dtype(info["dtype"])
+        count = int(np.prod(info["shape"]))
+        arrays[name] = np.frombuffer(
+            payload, dtype=dtype, count=count, offset=base + info["offset"]
+        ).reshape(info["shape"]).copy()
+    return header["op"], header["meta"], arrays
+
+
+def flatten_tree(tree: dict, prefix: str = "") -> dict[str, np.ndarray]:
+    """Flatten a nested-dict pytree of arrays into {slash/path: array}.
+    Dict-only trees (the model-parameter shape) — lists/tuples are not
+    wire-representable here on purpose: a path round-trip must be
+    unambiguous."""
+    out: dict[str, np.ndarray] = {}
+    for k, v in tree.items():
+        path = f"{prefix}/{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(flatten_tree(v, path))
+        else:
+            out[path] = np.asarray(v)
+    return out
+
+
+def unflatten_tree(flat: dict[str, np.ndarray]) -> dict:
+    """Rebuild the nested dict from {slash/path: array}."""
+    root: dict = {}
+    for path, arr in flat.items():
+        parts = path.split("/")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    return root

@@ -532,6 +532,28 @@ class StrategyProposal:
 
 @register_message
 @dataclasses.dataclass
+class StrategyObservationsRequest:
+    """Fetch every measurement reported at a shape key — the persisted
+    surrogate posterior (parallel/surrogate.py: given the fixed kernel,
+    the observation set IS the posterior) a fresh measured search
+    warm-starts from."""
+
+    model: str = ""
+    n_devices: int = 0
+    batch: int = 0
+    seq: int = 0
+    hbm_gb: float = 0.0
+
+
+@register_message
+@dataclasses.dataclass
+class StrategyObservations:
+    # [{"strategy_json": str, "step_time_s": float}], report order
+    observations: list = dataclasses.field(default_factory=list)
+
+
+@register_message
+@dataclasses.dataclass
 class StrategyMeasurement:
     """Trainer-reported measured step time for a strategy — measured
     history outranks the roofline estimate for later proposals at the

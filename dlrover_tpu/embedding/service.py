@@ -34,10 +34,8 @@ bytes, inside the common/rpc length-prefixed frame.
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import socket
-import struct
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -45,6 +43,7 @@ from typing import Iterable
 
 import numpy as np
 
+from dlrover_tpu.common.array_wire import decode_msg, encode_msg
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.common.rpc import recv_frame, send_frame
 from dlrover_tpu.embedding.kv_table import (
@@ -54,7 +53,6 @@ from dlrover_tpu.embedding.kv_table import (
 
 logger = get_logger(__name__)
 
-_HLEN = struct.Struct("<I")
 # rows per migration push: bounded so one frame stays well under
 # rpc.MAX_FRAME even for wide tables with optimizer slots
 _MIGRATE_CHUNK_BYTES = 8 << 20
@@ -68,38 +66,6 @@ def shard_owner(ids: np.ndarray, num_shards: int) -> np.ndarray:
     x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
     x = x ^ (x >> np.uint64(31))
     return (x % np.uint64(num_shards)).astype(np.int64)
-
-
-def encode_msg(op: str, meta: dict | None = None,
-               arrays: dict[str, np.ndarray] | None = None) -> bytes:
-    manifest = {}
-    chunks = []
-    off = 0
-    for name, arr in (arrays or {}).items():
-        arr = np.ascontiguousarray(arr)
-        manifest[name] = {
-            "shape": list(arr.shape), "dtype": str(arr.dtype), "offset": off,
-        }
-        chunks.append(arr.tobytes())
-        off += arr.nbytes
-    header = json.dumps(
-        {"op": op, "meta": meta or {}, "arrays": manifest}
-    ).encode()
-    return b"".join([_HLEN.pack(len(header)), header] + chunks)
-
-
-def decode_msg(payload: bytes) -> tuple[str, dict, dict[str, np.ndarray]]:
-    (hlen,) = _HLEN.unpack(payload[:_HLEN.size])
-    header = json.loads(payload[_HLEN.size:_HLEN.size + hlen])
-    base = _HLEN.size + hlen
-    arrays = {}
-    for name, info in header["arrays"].items():
-        dtype = np.dtype(info["dtype"])
-        count = int(np.prod(info["shape"]))
-        arrays[name] = np.frombuffer(
-            payload, dtype=dtype, count=count, offset=base + info["offset"]
-        ).reshape(info["shape"]).copy()
-    return header["op"], header["meta"], arrays
 
 
 class ShardError(RuntimeError):
@@ -146,6 +112,13 @@ class EmbeddingShardServer:
         # a frozen row set while it repartitions
         self._lock = threading.Lock()
         self._migrating = False
+        # liveness escape: a coordinator that dies between copy and
+        # commit would otherwise leave the gate armed forever. After
+        # the TTL the server self-aborts (safe: phase 1 deleted
+        # nothing); a commit arriving later is rejected (gate no longer
+        # armed) so the coordinator's retry re-runs the whole scale.
+        self._migrating_since = 0.0
+        self.migrate_ttl_s = 1800.0
         self._stop = threading.Event()
         self._sock = socket.create_server((host, port))
         self._sock.settimeout(0.5)
@@ -216,8 +189,19 @@ class EmbeddingShardServer:
 
     def _check_epoch(self, meta: dict) -> None:
         if self._migrating:
-            raise ShardError("migrating", "shard is re-partitioning",
-                             {"retry_ms": 100})
+            if (self._migrating_since
+                    and time.monotonic() - self._migrating_since
+                    > self.migrate_ttl_s):
+                logger.warning(
+                    "migration armed > %.0fs with no commit/abort "
+                    "(dead coordinator?); self-aborting to restore "
+                    "service", self.migrate_ttl_s,
+                )
+                self.abort_migration()
+            else:
+                raise ShardError("migrating",
+                                 "shard is re-partitioning",
+                                 {"retry_ms": 100})
         v = meta.get("v")
         if v is not None and v != self.version:
             raise ShardError(
@@ -270,6 +254,17 @@ class EmbeddingShardServer:
             return encode_msg("ok", {
                 "moved": moved, "rows": len(self.table),
             })
+        if op == "commit_migration":
+            pruned = self.commit_migration(
+                meta["version"], meta["num_shards"],
+                meta.get("index", -1),
+            )
+            return encode_msg("ok", {
+                "pruned": pruned, "rows": len(self.table),
+            })
+        if op == "abort_migration":
+            self.abort_migration()
+            return encode_msg("ok", {"version": self.version})
         if op == "set_epoch":
             with self._lock:
                 self.version = meta["version"]
@@ -286,15 +281,28 @@ class EmbeddingShardServer:
 
     def migrate_to(self, addrs: list[str], new_version: int,
                    self_index: int = -1) -> int:
-        """Re-partition this shard's rows for the routing ``addrs`` and
-        push every row whose new owner isn't this server. ``self_index``
-        is this server's position in the NEW ring, computed by the
-        coordinator from the address it knows this server by (a
-        port-based self-guess would misfire when multiple hosts use the
-        same port); -1 = scale-down, everything moves. Rows transfer
-        WITH optimizer slots and frequency, chunked to bound frame
-        sizes. Returns rows moved."""
+        """Phase 1 of the two-phase scale: COPY every row whose new owner
+        isn't this server to its destination. Nothing is removed and the
+        epoch is not adopted here — this server stays the authoritative
+        owner of all its rows until the coordinator's
+        ``commit_migration`` lands, so a failed push leaves the ring
+        fully intact and a retried scale simply re-pushes (``import_``
+        is last-write-wins). That retires the r04 loss window where rows
+        were deleted per-destination mid-migration and a later failure
+        left them unreachable, with ``lookup(init_missing=True)``
+        silently resurrecting fresh rows.
+
+        ``self_index`` is this server's position in the NEW ring,
+        computed by the coordinator from the address it knows this
+        server by (a port-based self-guess would misfire when multiple
+        hosts use the same port); -1 = scale-down, everything moves.
+        Rows transfer WITH optimizer slots and frequency, chunked to
+        bound frame sizes. The ``_migrating`` gate stays ARMED on
+        success (mutations between copy and commit would be lost after
+        the flip); ``commit_migration``/``abort_migration`` clears it.
+        Returns rows copied."""
         self._migrating = True
+        self._migrating_since = time.monotonic()
         try:
             with self._lock:
                 new_n = len(addrs)
@@ -318,13 +326,70 @@ class EmbeddingShardServer:
                         if "slots" in snap else None,
                         "freq": snap["freq"][sel],
                     })
-                    self.table.remove(keys[sel])
-                self.version = new_version
-                self.num_shards = new_n
-                self.index = my_index if my_index >= 0 else 0
                 return moved
-        finally:
+        except BaseException:
+            # a failed copy aborts THIS server's phase; re-open for
+            # traffic at the old epoch (the coordinator may retry)
             self._migrating = False
+            self._migrating_since = 0.0
+            raise
+
+    def commit_migration(self, new_version: int, num_shards: int,
+                         index: int) -> int:
+        """Phase 2: adopt the new epoch and PRUNE every row this server
+        does not own in the new ring. Pruning by ownership (rather than
+        a remembered moved-key list) is idempotent and self-healing: it
+        also clears dormant copies left by a previously aborted scale.
+        ``index`` < 0 = departing server (drained; prunes everything).
+        Rejected when the gate is no longer armed (the server
+        self-aborted past its TTL): the copies may be stale by now, so
+        the coordinator must re-run the whole scale."""
+        with self._lock:
+            if not self._migrating:
+                raise ShardError(
+                    "not_migrating",
+                    "no armed migration (self-aborted past TTL?); "
+                    "re-run the scale",
+                )
+            snap_keys = self.table.export()["keys"]
+            if index < 0:
+                prune = snap_keys
+            elif snap_keys.size:
+                prune = snap_keys[
+                    shard_owner(snap_keys, num_shards) != index
+                ]
+            else:
+                prune = snap_keys
+            if prune.size:
+                self.table.remove(prune)
+            self.version = new_version
+            self.num_shards = num_shards
+            self.index = max(index, 0)
+            self._migrating = False
+            self._migrating_since = 0.0
+            return int(prune.size)
+
+    def abort_migration(self) -> int:
+        """Roll back phase 1. Nothing was removed from the authoritative
+        owners, so re-opening at the old epoch restores service — but
+        rows already COPIED to surviving destinations are strays there
+        (un-owned at the old epoch) and would double-count in
+        export/checkpoint, where a later restore could replay the stale
+        copy over the authoritative row. Prune them by ownership at the
+        CURRENT epoch."""
+        with self._lock:
+            keys = self.table.export()["keys"]
+            if keys.size and self.num_shards > 0:
+                strays = keys[
+                    shard_owner(keys, self.num_shards) != self.index
+                ]
+                if strays.size:
+                    self.table.remove(strays)
+            else:
+                strays = keys[:0]
+            self._migrating = False
+            self._migrating_since = 0.0
+            return int(strays.size)
 
     def _push_rows(self, addr: str, rows: dict) -> None:
         host, _, port = addr.rpartition(":")
@@ -389,7 +454,13 @@ class EmbeddingCoordinator:
                  port: int = 0):
         self.version = 0
         self.addrs = list(addrs)
+        # _lock guards the (version, addrs) route snapshot and is held
+        # only for instants; _scale_lock serializes scale operations,
+        # which legitimately run for minutes — holding _lock across a
+        # scale (the r04 design) starved `route` requests past the
+        # client timeout and crashed trainers mid-migration
         self._lock = threading.Lock()
+        self._scale_lock = threading.Lock()
         self._stop = threading.Event()
         self._sock = socket.create_server((host, port))
         self._sock.settimeout(0.5)
@@ -475,39 +546,123 @@ class EmbeddingCoordinator:
                 "index": i,
             })
 
-    def scale(self, new_addrs: list[str]) -> None:
-        """Re-partition the table onto ``new_addrs`` (grow or shrink).
+    def scale(self, new_addrs: list[str], migrate_retries: int = 3,
+              retry_backoff_s: float = 0.5) -> None:
+        """Re-partition the table onto ``new_addrs`` (grow or shrink),
+        failure-atomically.
 
-        Order matters: old servers migrate FIRST (each holds rows only it
-        can push; during this window they answer ``migrating`` and
-        clients back off), then the new ring's epochs are set, then the
-        route flips. A scale-down's departing servers are drained by
-        their own migrate (not in the new ring => everything moves)."""
-        with self._lock:
-            old_addrs = list(self.addrs)
-            new_version = self.version + 1
+        Two phases (reference analog: elastic_ps.py:82's versioned
+        cluster, hardened per the r04 verdict):
+
+        1. COPY — every old server pushes the rows whose new owner
+           differs (retried per server: ``import_`` is last-write-wins,
+           so a re-push after a destination hiccup is idempotent).
+           Nothing is deleted; a failure here rolls back by simply
+           re-opening every server at the old epoch. Zero loss.
+        2. COMMIT — pure-new servers adopt the epoch, then every old
+           server prunes the rows it no longer owns and adopts. A
+           failure HERE is rolled *forward* (commits retried), because
+           a committed server has already pruned — rolling back would
+           recreate exactly the loss window phase 1 exists to close.
+           If commits keep failing the scale raises and must be
+           retried; rows are never lost, only unavailable until the
+           retry converges (clients back off on version errors).
+
+        The route flips only after full commit; ``route`` requests are
+        served throughout from the short-hold snapshot lock."""
+        with self._scale_lock:
+            with self._lock:
+                old_addrs = list(self.addrs)
+                new_version = self.version + 1
+            try:
+                for addr in old_addrs:
+                    # the coordinator knows each server by address, so
+                    # IT computes the server's position in the new ring
+                    # (a port-based self-guess would misfire when hosts
+                    # share ports); no timeout cap — a migrate streams
+                    # the shard's whole row set and may legitimately
+                    # run for minutes on big tables
+                    try:
+                        self_index = new_addrs.index(addr)
+                    except ValueError:
+                        self_index = -1
+                    meta = self._retry_shard_call(
+                        addr, "migrate", {
+                            "addrs": new_addrs, "version": new_version,
+                            "self_index": self_index,
+                        }, migrate_retries, retry_backoff_s,
+                        timeout=None,
+                    )
+                    logger.info("shard %s copied %d rows", addr,
+                                meta["moved"])
+            except Exception:
+                # phase-1 rollback: nothing was deleted anywhere; just
+                # re-open every old server (abort is idempotent on the
+                # ones that never armed their gate)
+                for addr in old_addrs:
+                    try:
+                        self._shard_call(addr, "abort_migration")
+                    except Exception:  # noqa: BLE001 - best effort
+                        logger.warning(
+                            "abort_migration to %s failed", addr)
+                raise
+            # phase 2a: epochs for pure-new members first (they only
+            # gain rows). Retried, and STILL rollback-safe on failure —
+            # no old server has pruned anything yet, so abort is the
+            # same clean path as a phase-1 failure (review finding: an
+            # unretried, unrolled-back set_epoch here left every old
+            # server's migrating gate armed until the TTL).
+            try:
+                for i, addr in enumerate(new_addrs):
+                    if addr not in old_addrs:
+                        self._retry_shard_call(
+                            addr, "set_epoch", {
+                                "version": new_version,
+                                "num_shards": len(new_addrs),
+                                "index": i,
+                            }, migrate_retries, retry_backoff_s,
+                        )
+            except Exception:
+                for addr in old_addrs:
+                    try:
+                        self._shard_call(addr, "abort_migration")
+                    except Exception:  # noqa: BLE001 - best effort
+                        logger.warning(
+                            "abort_migration to %s failed", addr)
+                raise
+            # phase 2b: commit (prune+adopt) the old members — from
+            # here failures roll FORWARD (see docstring)
             for addr in old_addrs:
-                # the coordinator knows each server by address, so IT
-                # computes the server's position in the new ring; no
-                # timeout cap — a migrate streams the shard's whole row
-                # set and may legitimately run for minutes on big tables
                 try:
-                    self_index = new_addrs.index(addr)
+                    idx = new_addrs.index(addr)
                 except ValueError:
-                    self_index = -1
-                meta, _ = self._shard_call(addr, "migrate", {
-                    "addrs": new_addrs, "version": new_version,
-                    "self_index": self_index,
-                }, timeout=None)
-                logger.info("shard %s migrated %d rows", addr,
-                            meta["moved"])
-            for i, addr in enumerate(new_addrs):
-                self._shard_call(addr, "set_epoch", {
-                    "version": new_version, "num_shards": len(new_addrs),
-                    "index": i,
-                })
-            self.version = new_version
-            self.addrs = list(new_addrs)
+                    idx = -1
+                self._retry_shard_call(
+                    addr, "commit_migration", {
+                        "version": new_version,
+                        "num_shards": len(new_addrs), "index": idx,
+                    }, migrate_retries, retry_backoff_s,
+                )
+            with self._lock:
+                self.version = new_version
+                self.addrs = list(new_addrs)
+
+    def _retry_shard_call(self, addr: str, op: str, meta: dict,
+                          retries: int, backoff_s: float,
+                          timeout: float | None = 60.0) -> dict:
+        last: Exception | None = None
+        for attempt in range(max(1, retries)):
+            try:
+                rmeta, _ = self._shard_call(addr, op, meta,
+                                            timeout=timeout)
+                return rmeta
+            except (ShardError, ConnectionError, OSError) as e:
+                last = e
+                logger.warning("%s to %s failed (attempt %d/%d): %s",
+                               op, addr, attempt + 1, retries, e)
+                time.sleep(backoff_s * (attempt + 1))
+        raise RuntimeError(f"{op} to {addr} failed after "
+                           f"{retries} attempts: {last}")
 
     def total_rows(self) -> int:
         with self._lock:
@@ -525,11 +680,12 @@ class ShardedKvClient:
 
     def __init__(self, coordinator_addr: str | None = None,
                  addrs: list[str] | None = None, dim: int = 0,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, retry_window_s: float = 600.0):
         if not coordinator_addr and not addrs:
             raise ValueError("need coordinator_addr or addrs")
         self.dim = dim
         self._timeout = timeout
+        self.retry_window_s = retry_window_s
         self._coord_addr = coordinator_addr
         self.version = 0
         self._addrs: list[str] = list(addrs or [])
@@ -584,7 +740,7 @@ class ShardedKvClient:
 
     def _fanout(self, op: str, ids: np.ndarray,
                 per_shard_arrays, meta_extra: dict | None = None,
-                retries: int = 60):
+                retry_window_s: float | None = None):
         """Split by owner, call each touched shard, return per-shard
         (selector, response-arrays) pairs.
 
@@ -596,14 +752,26 @@ class ShardedKvClient:
         cannot double-apply gradients to the shards that succeeded.
         (The residual at-least-once window — a shard that applied but
         whose *response* was lost — is inherent to retrying writes and
-        matches the sharding-client's at-least-once contract.)"""
+        matches the sharding-client's at-least-once contract.)
+
+        The retry budget is TIME-based (default ``self.retry_window_s``,
+        600 s): a big-table scale legitimately blocks shards behind
+        their migrating gate for minutes, and the r04 count-based
+        budget (60 x 0.25 s ~ 15 s) crashed training during exactly the
+        event the retries exist to ride out. ``refresh_route`` failures
+        are themselves retriable — the coordinator answers from a
+        short-hold snapshot lock now, but a momentarily unreachable
+        coordinator must not kill the trainer either."""
         flat = np.ascontiguousarray(ids, np.int64).reshape(-1)
         pending = np.ones(flat.size, dtype=bool)
         results: list[tuple[np.ndarray, dict]] = []
         last: Exception | None = None
-        for _ in range(retries):
-            if not pending.any():
-                return results, flat
+        deadline = time.monotonic() + (
+            retry_window_s if retry_window_s is not None
+            else self.retry_window_s
+        )
+        backoff = 0.25
+        while True:
             n = max(1, len(self._addrs))
             idxs = np.nonzero(pending)[0]
             owners = shard_owner(flat[idxs], n)
@@ -617,7 +785,6 @@ class ShardedKvClient:
                 futures.append((sel, self._pool.submit(
                     self._shard_call, s, op, meta, arrays
                 )))
-            retriable = False
             for sel, fut in futures:
                 try:
                     _, rarrays = fut.result()
@@ -627,18 +794,27 @@ class ShardedKvClient:
                     last = e
                     if e.code not in ("version", "migrating"):
                         raise
-                    retriable = True
                 except (ConnectionError, OSError) as e:
                     # a drained server may already be gone after a
                     # scale-down: re-route instead of crashing training
                     last = e
-                    retriable = True
-            if retriable:
-                time.sleep(0.25)
-                if self._coord_addr:
+            # success is checked AFTER collecting: an iteration that
+            # completes past the deadline keeps its own result instead
+            # of discarding applied gradients as a spurious failure
+            if not pending.any():
+                return results, flat
+            if time.monotonic() >= deadline:
+                break
+            time.sleep(backoff)
+            backoff = min(backoff * 1.5, 2.0)
+            if self._coord_addr:
+                try:
                     self.refresh_route()
+                except (ShardError, ConnectionError, OSError) as e:
+                    last = e  # coordinator busy/unreachable: retry
         raise RuntimeError(
-            f"embedding fanout kept failing after {retries} tries: {last}"
+            f"embedding fanout kept failing after "
+            f"{retry_window_s or self.retry_window_s:.0f}s: {last}"
         )
 
     # ------------------------------------------------------------- user ops

@@ -41,6 +41,7 @@ class JobMaster:
         node_unit: int = 1,
         hang_timeout_s: float = 1800.0,
         heartbeat_dead_window_s: float = Defaults.HEARTBEAT_DEAD_WINDOW_S,
+        heartbeat_interval_s: float = Defaults.HEARTBEAT_INTERVAL_S,
         state_dir: str = "",
     ):
         from dlrover_tpu.master.stats import LocalStatsReporter
@@ -54,6 +55,10 @@ class JobMaster:
         self.node_manager = NodeManager(
             dead_window_s=heartbeat_dead_window_s,
             on_node_dead=self._on_node_dead,
+            # the preempt-armed dead window derives from the AGENTS'
+            # actual cadence (advisor r04): keep this in sync with the
+            # launcher's --heartbeat-interval
+            heartbeat_interval_s=heartbeat_interval_s,
         )
         self.rdzv_managers: dict[str, RendezvousManager] = {
             "training": RendezvousManager(
@@ -226,6 +231,13 @@ def main(argv: list[str] | None = None) -> int:
         help="seconds without a heartbeat before a node is declared dead",
     )
     parser.add_argument(
+        "--heartbeat-interval", type=float,
+        default=Defaults.HEARTBEAT_INTERVAL_S,
+        help="the agents' heartbeat cadence (the preemption-armed dead "
+             "window is derived from it; pass the same value the "
+             "launcher gives its agents)",
+    )
+    parser.add_argument(
         "--state-dir", default="",
         help="persist recoverable master state here (HA restart)",
     )
@@ -244,6 +256,7 @@ def main(argv: list[str] | None = None) -> int:
         node_unit=args.node_unit,
         hang_timeout_s=args.hang_timeout,
         heartbeat_dead_window_s=args.dead_window,
+        heartbeat_interval_s=args.heartbeat_interval,
         state_dir=args.state_dir,
     )
     master.prepare()

@@ -33,12 +33,20 @@ class NodeManager:
         on_node_dead: Callable[[int], None] | None = None,
         relaunch_hook: Callable[[Node], None] | None = None,
         preempt_dead_window_s: float = 15.0,
+        heartbeat_interval_s: float = Defaults.HEARTBEAT_INTERVAL_S,
     ):
         self._dead_window_s = dead_window_s
         # after a preemption NOTICE, silence means the advertised kill
         # landed: switch that node to this short window so the relaunch
         # starts seconds after the VM dies, not a heartbeat-window later
         self._preempt_dead_window_s = preempt_dead_window_s
+        # the armed window must span >=2 heartbeat cadences + slack: a
+        # still-alive node racing its own cadence — especially while the
+        # pre-kill prepare (multi-GB buddy replication + persist) delays
+        # its heartbeat thread — must not be declared dead mid-prepare
+        # (advisor r04: 15 s window == 15 s cadence with a strict '<'
+        # left zero margin)
+        self._heartbeat_interval_s = heartbeat_interval_s
         self._on_node_dead = on_node_dead
         # the scaler's entry point: replace the host a failed node ran on
         # (reference: _relaunch_node dist_job_manager.py:605 -> PodScaler).
@@ -202,7 +210,7 @@ class NodeManager:
                 # disarms it (report_heartbeat): a node silent past its
                 # kill deadline is dead, not recovered
                 armed = bool(node.preempting_since)
-                window = (self._preempt_dead_window_s if armed
+                window = (self._effective_preempt_window() if armed
                           else self._dead_window_s)
                 if node.heartbeat_time <= 0:
                     # never reported: window from creation (the armed
@@ -224,6 +232,13 @@ class NodeManager:
             self.broadcast_action("restart", exclude={nid})
             if self._on_node_dead:
                 self._on_node_dead(nid)
+
+    def _effective_preempt_window(self) -> float:
+        # >=2 cadences + slack (slack scales with the cadence, capped:
+        # prod 15 s interval -> 33 s armed window; test cadences keep
+        # their sub-second windows)
+        hb = self._heartbeat_interval_s
+        return max(self._preempt_dead_window_s, 2.0 * hb + min(3.0, hb))
 
     def broadcast_action(self, action: str, exclude: set[int] | None = None
                          ) -> None:

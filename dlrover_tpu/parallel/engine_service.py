@@ -95,6 +95,9 @@ class StrategyEngineService:
         self._cache: dict[tuple, m.StrategyProposal] = {}
         # key -> (step_time_s, strategy_json)
         self._measured: dict[tuple, tuple[float, str]] = {}
+        # every reported measurement per shape key (the persisted
+        # surrogate posterior; see parallel/surrogate.py)
+        self._observations: dict[tuple, list[dict]] = {}
         # per-key in-flight search locks: N jobs asking at once must
         # run ONE subprocess, not N (the point of a shared engine)
         self._inflight: dict[tuple, threading.Lock] = {}
@@ -128,7 +131,23 @@ class StrategyEngineService:
                     logger.info(
                         "measured best for %s: %.4fs", key, msg.step_time_s
                     )
+                # full observation log (bounded): the persisted
+                # posterior for surrogate warm-starts — dedup by
+                # strategy, keeping the newest measurement
+                obs = self._observations.setdefault(key, [])
+                obs[:] = [o for o in obs
+                          if o["strategy_json"] != msg.strategy_json]
+                obs.append({"strategy_json": msg.strategy_json,
+                            "step_time_s": msg.step_time_s})
+                del obs[:-256]
             return m.OkResponse()
+        if isinstance(msg, m.StrategyObservationsRequest):
+            key = (msg.model, msg.n_devices, msg.batch, msg.seq,
+                   msg.hbm_gb)
+            with self._lock:
+                return m.StrategyObservations(
+                    observations=list(self._observations.get(key, []))
+                )
         if isinstance(msg, m.StrategyProposeRequest):
             return self.propose(msg)
         raise TypeError(f"unhandled message type {type(msg).__name__}")
@@ -207,6 +226,17 @@ class StrategyEngineClient:
             model=model, n_devices=n_devices, batch=batch, seq=seq,
             hbm_gb=hbm_gb, strategy_json=sj, step_time_s=step_time_s,
         ))
+
+    def get_observations(self, model: str, n_devices: int, *,
+                         batch: int = 8, seq: int = 128,
+                         hbm_gb: float = 0.0) -> list[dict]:
+        """The shape key's full measurement log ([{strategy_json,
+        step_time_s}]) — warm-start material for a surrogate fit."""
+        resp = self._rpc.call(m.StrategyObservationsRequest(
+            model=model, n_devices=n_devices, batch=batch, seq=seq,
+            hbm_gb=hbm_gb,
+        ))
+        return list(resp.observations)
 
     def close(self) -> None:
         self._rpc.close()

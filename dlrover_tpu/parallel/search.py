@@ -112,15 +112,26 @@ def measured_search(
     hw=None,
     engine_client=None,
     engine_key: dict | None = None,
+    surrogate_rounds: int = 1,
+    surrogate_proposals: int = 2,
 ) -> tuple[Strategy, dict]:
-    """Roofline-seeded successive halving; returns (winner, report).
+    """Roofline-seeded successive halving + GP-surrogate acquisition;
+    returns (winner, report).
+
+    After the halving rungs, a Gaussian-process surrogate
+    (parallel/surrogate.py — the bayes_opt_sg.py analog) is fitted on
+    EVERY timed measurement and proposes up to ``surrogate_proposals``
+    configs per round from the candidates the roofline seeding ranked
+    OUTSIDE the measured top-k; each proposal is measured at the final
+    rung depth and can take the win. ``surrogate_rounds=0`` disables.
 
     Report: ``{"roofline": [(name, est_s, fits)], "rungs":
-    [{name: measured_s}], "roofline_pick": name, "winner": name,
-    "winner_step_s": s}``. When ``engine_client`` is given, the winner's
-    measurement is reported to the engine service so later
-    ``propose(objective="fastest")`` calls at this shape are served the
-    measured pick (parallel/engine_service.py measured history).
+    [{name: measured_s}], "roofline_pick": name, "surrogate":
+    [{name: measured_s}], "winner": name, "winner_step_s": s}``. When
+    ``engine_client`` is given, every measurement is reported to the
+    engine service — the service's observation store is the persisted
+    posterior a later search warm-starts from — and the winner feeds
+    the measured history that serves ``propose(objective="fastest")``.
     """
     from dlrover_tpu.parallel.auto import (
         default_candidates,
@@ -222,10 +233,54 @@ def measured_search(
             break
     winner = field[0][0]
     winner_s = measured[winner.name]
+
+    # ---- surrogate acquisition: fit a GP on every timed result and
+    # measure the configs it says are promising among the seeded
+    # candidates halving never touched (top_k cut them before any
+    # measurement). by_name carries their already-compiled programs.
+    surrogate_rows: list[dict] = []
+    if surrogate_rounds > 0:
+        from dlrover_tpu.parallel.surrogate import surrogate_propose
+
+        by_name = {s.name: (s, compiled, batch)
+                   for s, compiled, batch, _ in seeded}
+        pool = [s for s, _, _, _ in seeded]
+        for _ in range(surrogate_rounds):
+            observations = [
+                (by_name[n][0], t) for n, t in measured.items()
+                if n in by_name
+            ]
+            try:
+                proposals = surrogate_propose(
+                    observations, pool, n=surrogate_proposals
+                )
+            except Exception as e:  # noqa: BLE001 - optional layer
+                logger.warning("surrogate propose failed: %s", e)
+                break
+            if not proposals:
+                break
+            row: dict[str, float] = {}
+            for s, ei in proposals:
+                _, compiled, batch = by_name[s.name]
+                try:
+                    t = _time_steps(compiled, batch, rungs[-1])
+                except Exception as e:  # noqa: BLE001 - drop it
+                    logger.info("surrogate pick %s failed: %s",
+                                s.name, e)
+                    t = math.inf
+                row[s.name] = t
+                measured[s.name] = t
+                logger.info("surrogate pick %s (EI %.3g): %.4fs",
+                            s.name, ei, t)
+                if t < winner_s:
+                    winner, winner_s = s, t
+            surrogate_rows.append(row)
+
     report = {
         "roofline": roofline_rows,
         "roofline_pick": roofline_pick,
         "rungs": rung_rows,
+        "surrogate": surrogate_rows,
         "winner": winner.name,
         "winner_step_s": winner_s,
     }
@@ -234,11 +289,20 @@ def measured_search(
         "%s)", winner.name, winner_s, roofline_pick,
     )
     if engine_client is not None:
+        # every finite measurement feeds the service's observation
+        # store (the persisted surrogate posterior); the service keeps
+        # the fastest as the measured-history winner
+        name_to_strategy = {s.name: s for s, _, _, _ in seeded}
         try:
-            engine_client.report_measurement(
-                strategy=winner, step_time_s=winner_s,
-                **(engine_key or {}),
-            )
+            for cand_name, t in measured.items():
+                if not math.isfinite(t):
+                    continue
+                cand = name_to_strategy.get(cand_name)
+                if cand is None:
+                    continue
+                engine_client.report_measurement(
+                    strategy=cand, step_time_s=t, **(engine_key or {}),
+                )
         except Exception as e:  # noqa: BLE001 - telemetry, not critical
             logger.warning("engine measurement report failed: %s", e)
     return winner, report

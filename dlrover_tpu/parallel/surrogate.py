@@ -1,0 +1,166 @@
+"""GP surrogate over measured strategy configurations: the
+Bayesian-optimization layer above successive halving.
+
+Reference analog: atorch's strategy engine carries model-based search —
+Bayesian optimization over optimization-method combinations
+(atorch/atorch/auto/engine/sg_algo/bayes_opt_sg.py:1, sg_algo/hebo/,
+combination_sg.py). Halving burns chip time proportional to the
+candidate count; a surrogate model REUSES every timed step: fit a
+Gaussian process on (config features -> log step time) and spend the
+next measurements on the configs the posterior says are promising —
+including configs the roofline seeding ranked OUTSIDE the top-k, which
+pure halving would never touch.
+
+Pure-numpy GP on purpose: the feature space is tiny (one-hot presets +
+a handful of knobs, tens of candidates), where an exact GP with a
+Cholesky solve is both optimal and dependency-free. Features: base
+preset one-hot, strategy-remat one-hot, int8 flag, log2(grad accum),
+model-remat (scan flag, policy one-hot, log2 interval) — the exact
+knob set expand_candidates() crosses.
+
+The "posterior" persisted in the engine service is the observation set
+itself (parallel/engine_service.py keeps every reported measurement per
+shape key): given the fixed kernel, observations ARE the posterior, and
+a later search warm-starts by fitting on them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.parallel.strategy import Strategy
+
+logger = get_logger(__name__)
+
+
+def _base_name(s: Strategy) -> str:
+    return s.name.split("[", 1)[0]
+
+
+class StrategyFeaturizer:
+    """Fixed-vocabulary encoding of the expand_candidates() knob space.
+
+    The vocabularies come from the candidate POOL (not the observed
+    subset) so an unobserved preset still gets its own one-hot column —
+    the GP's prior then treats it as unexplored rather than aliasing it
+    onto a seen preset."""
+
+    def __init__(self, pool: Sequence[Strategy]):
+        self.presets = sorted({_base_name(s) for s in pool})
+        self.remats = sorted({s.remat for s in pool})
+        self.policies = sorted({
+            str(s.extra.get("remat_policy", "")) for s in pool
+        })
+
+    def encode(self, s: Strategy) -> np.ndarray:
+        f: list[float] = []
+        base = _base_name(s)
+        f.extend(1.0 if base == p else 0.0 for p in self.presets)
+        f.extend(1.0 if s.remat == r else 0.0 for r in self.remats)
+        f.append(1.0 if s.extra.get("int8_matmuls") else 0.0)
+        f.append(math.log2(max(1, s.grad_accum)))
+        f.append(1.0 if s.extra.get("remat_scan") else 0.0)
+        pol = str(s.extra.get("remat_policy", ""))
+        f.extend(1.0 if pol == p else 0.0 for p in self.policies)
+        f.append(math.log2(max(1, int(s.extra.get("remat_interval", 1)))))
+        return np.asarray(f, np.float64)
+
+    def encode_all(self, ss: Sequence[Strategy]) -> np.ndarray:
+        return np.stack([self.encode(s) for s in ss])
+
+
+@dataclasses.dataclass
+class GPSurrogate:
+    """Exact GP regression, RBF kernel, median-distance lengthscale."""
+
+    noise: float = 1e-3
+    lengthscale: float = 0.0     # 0 = median pairwise distance heuristic
+
+    def fit(self, X: np.ndarray, y: np.ndarray) -> "GPSurrogate":
+        self.X = np.asarray(X, np.float64)
+        y = np.asarray(y, np.float64)
+        self.y_mean = float(y.mean())
+        self.y_std = float(y.std()) or 1.0
+        self.y = (y - self.y_mean) / self.y_std
+        if not self.lengthscale:
+            d = np.sqrt(
+                ((self.X[:, None] - self.X[None, :]) ** 2).sum(-1)
+            )
+            pos = d[d > 0]
+            self.lengthscale = float(np.median(pos)) if pos.size else 1.0
+        K = self._kernel(self.X, self.X)
+        K[np.diag_indices_from(K)] += self.noise
+        self._L = np.linalg.cholesky(K)
+        self._alpha = np.linalg.solve(
+            self._L.T, np.linalg.solve(self._L, self.y)
+        )
+        return self
+
+    def _kernel(self, A: np.ndarray, B: np.ndarray) -> np.ndarray:
+        d2 = ((A[:, None] - B[None, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / (self.lengthscale ** 2))
+
+    def predict(self, Xs: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Posterior (mean, std) in the ORIGINAL y units."""
+        Ks = self._kernel(np.asarray(Xs, np.float64), self.X)
+        mean = Ks @ self._alpha
+        v = np.linalg.solve(self._L, Ks.T)
+        var = np.clip(1.0 + self.noise - (v ** 2).sum(0), 1e-12, None)
+        return (mean * self.y_std + self.y_mean,
+                np.sqrt(var) * self.y_std)
+
+    def expected_improvement(self, Xs: np.ndarray,
+                             best_y: float) -> np.ndarray:
+        """EI for MINIMIZATION of y."""
+        mean, std = self.predict(Xs)
+        z = (best_y - mean) / std
+        # standard normal pdf/cdf without scipy
+        pdf = np.exp(-0.5 * z * z) / math.sqrt(2 * math.pi)
+        cdf = 0.5 * (1.0 + np.vectorize(math.erf)(z / math.sqrt(2)))
+        return (best_y - mean) * cdf + std * pdf
+
+
+def surrogate_propose(
+    observations: Sequence[tuple[Strategy, float]],
+    pool: Sequence[Strategy],
+    n: int = 2,
+    featurizer: StrategyFeaturizer | None = None,
+) -> list[tuple[Strategy, float]]:
+    """Rank UNTRIED pool configs by expected improvement over the best
+    observed step time. Returns [(strategy, ei)], best first.
+
+    ``observations`` are (strategy, measured_step_s); non-finite times
+    (OOM/crash candidates) are kept as censored high observations so
+    the GP learns to avoid that region instead of re-proposing it."""
+    obs = [(s, t) for s, t in observations if t > 0]
+    if len(obs) < 2:
+        return []
+    feat = featurizer or StrategyFeaturizer(
+        list(pool) + [s for s, _ in obs]
+    )
+    finite = [t for _, t in obs if math.isfinite(t)]
+    if not finite:
+        return []
+    worst = max(finite)
+    y = np.asarray([
+        math.log(t if math.isfinite(t) else worst * 4.0)
+        for _, t in obs
+    ])
+    X = feat.encode_all([s for s, _ in obs])
+    gp = GPSurrogate().fit(X, y)
+    tried = {s.name for s, _ in obs}
+    untried = [s for s in pool if s.name not in tried]
+    if not untried:
+        return []
+    ei = gp.expected_improvement(
+        feat.encode_all(untried), best_y=float(min(
+            math.log(t) for t in finite
+        ))
+    )
+    order = np.argsort(-ei)
+    return [(untried[i], float(ei[i])) for i in order[:n]]

@@ -212,7 +212,99 @@ class ShardedPPOTrainer(PPOTrainer):
         )
         del seed  # kept for API stability; seeds derive from the key
 
+    # ---------------------------------------- disaggregated serving
+
+    def enable_remote_rollouts(self, addr: str | None = None, *,
+                               slots: int = 8, decode_block: int = 8,
+                               max_len: int = 0,
+                               worker_env: dict | None = None) -> None:
+        """Route rollouts through a serving worker in a SEPARATE
+        process, with versioned networked weight sync — the full
+        disaggregated form of the reference's vLLM inference backend
+        (atorch/rl/inference_backend/vllm_backend.py:1). The in-mesh
+        and one-process serving paths stay available; this one
+        exercises the hard part: cross-engine weight transfer and
+        version skew.
+
+        ``addr`` connects to an existing worker; None spawns one as a
+        child process (its own JAX runtime — a CPU mesh in tests, an
+        inference slice in production). Each ``_generate`` pushes the
+        actor weights ONLY when the trainer's version advanced, and
+        every rollout RPC pins ``expect_version``: a worker holding
+        stale weights answers with a structured version error instead
+        of silently generating from them."""
+        from dlrover_tpu.rl.serving_worker import (
+            RemoteServingClient,
+            spawn_worker,
+        )
+
+        self._remote_proc = None
+        if addr is None:
+            addr, self._remote_proc = spawn_worker(env=worker_env)
+        self._remote = RemoteServingClient(addr)
+        self._remote.init(
+            self.cfg, slots=slots,
+            max_len=max_len or self.cfg.max_seq_len,
+            decode_block=decode_block,
+        )
+        self._weights_version = 0
+        self._remote_pushed = -1
+
+    def close_remote(self) -> None:
+        remote = getattr(self, "_remote", None)
+        if remote is not None:
+            remote.stop_worker()
+            remote.close()
+            self._remote = None
+        proc = getattr(self, "_remote_proc", None)
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                proc.kill()
+            self._remote_proc = None
+
+    def _remote_generate(self, prompts: np.ndarray,
+                         key: jax.Array) -> jax.Array:
+        import numpy as _np
+
+        if self._remote_pushed != self._weights_version:
+            # full-tree host fetch + push. Deliberately synchronous:
+            # PPO is on-policy, so the rollout MUST see this
+            # iteration's weights (the version pin below enforces it)
+            host_params = jax.device_get(self.params["model"])
+            self._remote.push_weights(self._weights_version,
+                                      host_params)
+            self._remote_pushed = self._weights_version
+        seeds = [
+            int(jax.random.randint(
+                jax.random.fold_in(key, i), (), 0, 2**31 - 1
+            ))
+            for i in range(len(prompts))
+        ]
+        gen = self._remote.rollout(
+            _np.asarray(prompts, _np.int32), seeds,
+            gen_len=self.ppo.gen_len,
+            temperature=self.ppo.temperature,
+            expect_version=self._weights_version,
+        )
+        tokens = _np.concatenate(
+            [_np.asarray(prompts, _np.int32),
+             _np.asarray(gen, _np.int32)], axis=1,
+        )
+        return jax.device_put(jnp.asarray(tokens), self._dp_sharding)
+
+    def train_step(self, prompts: np.ndarray, key: jax.Array) -> dict:
+        metrics = super().train_step(prompts, key)
+        if getattr(self, "_remote", None) is not None:
+            # the update loop just produced new actor weights
+            self._weights_version += 1
+        return metrics
+
     def _generate(self, prompts: np.ndarray, key: jax.Array) -> jax.Array:
+        if getattr(self, "_remote", None) is not None:
+            return self._remote_generate(prompts, key)
         if self._serving is None:
             return super()._generate(prompts, key)
         import numpy as _np

@@ -1,0 +1,378 @@
+"""Disaggregated RLHF inference: the serving engine in its OWN process,
+with networked, versioned weight sync.
+
+Reference analog: ATorch's train/inference engine split — PPO rollouts
+run on a separate vLLM backend that RECEIVES the trainer's weights each
+iteration (atorch/atorch/rl/inference_backend/vllm_backend.py:1,
+rl/model_engine/model_engine.py:1). The r04 one-mesh form (pointing the
+in-process engine at the actor's buffers) covers the capability but not
+the hard part: cross-engine weight transfer and version skew between the
+train and serve processes. This module is that part.
+
+Shape:
+- ``ServingWorker`` runs in a child process with its own JAX runtime
+  (its own CPU mesh in tests; a dedicated inference slice in
+  production), serving a tiny TCP protocol over the repo's no-pickle
+  raw-array framing (common/array_wire.py):
+  ``init`` (model config) → ``weights`` (versioned full-tree push) →
+  ``rollout`` (prompts + seeds → generated tokens) / ``ping``.
+- ``RemoteServingClient`` is the trainer-side handle.
+- Version skew is EXPLICIT: every weights push carries a version; every
+  rollout carries the version the trainer expects to generate from. A
+  mismatch is a structured ``version`` error, not silently-stale
+  generations — the client's ``rollout`` surfaces it so the trainer
+  re-pushes (exactly the stale-weights hazard the reference's redis
+  sync has to manage).
+
+Determinism contract: the worker decodes with the same
+``sample_logits`` path as the in-mesh decode (serving/engine.py), so
+for equal (weights, prompt, seed, temperature) the generated tokens are
+bit-identical across the process boundary — pinned by
+tests/test_rl_remote_serving.py's parity test.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import socket
+import threading
+from typing import Any
+
+import numpy as np
+
+from dlrover_tpu.common.array_wire import (
+    decode_msg,
+    encode_msg,
+    flatten_tree,
+    unflatten_tree,
+)
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.rpc import recv_frame, send_frame
+
+logger = get_logger(__name__)
+
+
+class RemoteServingError(RuntimeError):
+    def __init__(self, code: str, message: str, meta: dict | None = None):
+        super().__init__(f"{code}: {message}")
+        self.code = code
+        self.meta = meta or {}
+
+
+def _call(sock: socket.socket, op: str, meta: dict | None = None,
+          arrays: dict | None = None) -> tuple[dict, dict]:
+    send_frame(sock, encode_msg(op, meta, arrays))
+    rop, rmeta, rarrays = decode_msg(recv_frame(sock))
+    if rop == "err":
+        raise RemoteServingError(rmeta.get("code", "error"),
+                                 rmeta.get("message", ""), rmeta)
+    return rmeta, rarrays
+
+
+class ServingWorker:
+    """The child-process server: one InferenceEngine behind TCP.
+
+    The engine is (re)built on ``init``; ``weights`` installs a new
+    versioned parameter tree (the engine's jitted programs take params
+    as an argument, so installation is a pointer swap after the host
+    receive — no recompilation)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._sock = socket.create_server((host, port))
+        self._sock.settimeout(0.5)
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._engine = None
+        self._engine_kw: dict = {}
+        self._cfg = None
+        self.version = -1
+        self._thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="serving-worker"
+        )
+
+    @property
+    def port(self) -> int:
+        return self._sock.getsockname()[1]
+
+    def start(self) -> "ServingWorker":
+        self._thread.start()
+        logger.info("serving worker on port %d (pid %d)",
+                    self.port, os.getpid())
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def serve_forever(self) -> None:
+        self.start()
+        while not self._stop.is_set():
+            self._stop.wait(0.5)
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with conn:
+            while not self._stop.is_set():
+                try:
+                    op, meta, arrays = decode_msg(recv_frame(conn))
+                except (ConnectionError, OSError, ValueError):
+                    return
+                try:
+                    resp = self._handle(op, meta, arrays)
+                except RemoteServingError as e:
+                    resp = encode_msg("err", {
+                        "code": e.code, "message": str(e), **e.meta,
+                    })
+                except Exception as e:  # noqa: BLE001 - report to caller
+                    logger.exception("serving op %s failed", op)
+                    resp = encode_msg("err", {
+                        "code": "internal",
+                        "message": f"{type(e).__name__}: {e}",
+                    })
+                try:
+                    send_frame(conn, resp)
+                except (ConnectionError, OSError):
+                    return
+
+    # -------------------------------------------------------------- handlers
+
+    def _handle(self, op: str, meta: dict, arrays: dict) -> bytes:
+        if op == "ping":
+            return encode_msg("ok", {
+                "version": self.version, "pid": os.getpid(),
+                "ready": self._engine is not None,
+            })
+        if op == "init":
+            from dlrover_tpu.models.transformer import TransformerConfig
+
+            with self._lock:
+                self._cfg = TransformerConfig(**meta["cfg"])
+                self._engine_kw = {
+                    "slots": int(meta.get("slots", 8)),
+                    "max_len": int(meta.get("max_len", 0)),
+                    "decode_block": int(meta.get("decode_block", 8)),
+                }
+                self._engine = None  # rebuilt on the next weights push
+                self.version = -1
+            return encode_msg("ok", {"pid": os.getpid()})
+        if op == "weights":
+            return self._install_weights(meta, arrays)
+        if op == "rollout":
+            return self._rollout(meta, arrays)
+        if op == "stop":
+            self._stop.set()
+            return encode_msg("ok", {})
+        raise RemoteServingError("bad_op", f"unknown op {op!r}")
+
+    def _install_weights(self, meta: dict, arrays: dict) -> bytes:
+        if self._cfg is None:
+            raise RemoteServingError("not_initialized", "init first")
+        version = int(meta["version"])
+        params = unflatten_tree(arrays)
+        with self._lock:
+            if self._engine is None:
+                from dlrover_tpu.serving import InferenceEngine
+
+                self._engine = InferenceEngine(
+                    params, self._cfg, **self._engine_kw
+                )
+            else:
+                self._engine.params = params
+            self.version = version
+        logger.info("installed weights v%d (%d leaves)",
+                    version, len(arrays))
+        return encode_msg("ok", {"version": version})
+
+    def _rollout(self, meta: dict, arrays: dict) -> bytes:
+        from dlrover_tpu.serving import SamplingParams
+
+        if self._engine is None:
+            raise RemoteServingError("not_initialized",
+                                     "no weights installed")
+        expect = meta.get("expect_version")
+        with self._lock:
+            if expect is not None and int(expect) != self.version:
+                # version skew is an ERROR, not a silent stale rollout
+                raise RemoteServingError(
+                    "version",
+                    f"trainer expects v{expect}, worker has "
+                    f"v{self.version}",
+                    {"current": self.version},
+                )
+            engine = self._engine
+            version = self.version
+        prompts = arrays["prompts"]
+        seeds = [int(s) for s in arrays["seeds"]]
+        gen_len = int(meta["gen_len"])
+        temperature = float(meta.get("temperature", 1.0))
+        top_p = float(meta.get("top_p", 1.0))
+        rids = [
+            engine.submit(
+                [int(t) for t in row],
+                SamplingParams(
+                    temperature=temperature, top_p=top_p,
+                    max_new_tokens=gen_len, seed=seeds[i],
+                ),
+            )
+            for i, row in enumerate(prompts)
+        ]
+        results = {r.id: r for r in engine.run()}
+        gen = np.stack([
+            np.asarray(
+                (results[rid].tokens + [0] * gen_len)[:gen_len],
+                np.int32,
+            )
+            for rid in rids
+        ])
+        return encode_msg("ok", {"version": version},
+                          arrays={"tokens": gen})
+
+
+class RemoteServingClient:
+    """Trainer-side handle: versioned weight push + rollouts over one
+    persistent connection."""
+
+    def __init__(self, addr: str, timeout: float = 120.0):
+        host, _, port = addr.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self._timeout = timeout
+        self._sock: socket.socket | None = None
+        self._lock = threading.Lock()
+
+    def _conn(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                self._addr, timeout=self._timeout
+            )
+            self._sock.setsockopt(socket.IPPROTO_TCP,
+                                  socket.TCP_NODELAY, 1)
+        return self._sock
+
+    def _call(self, op: str, meta: dict | None = None,
+              arrays: dict | None = None) -> tuple[dict, dict]:
+        with self._lock:
+            try:
+                return _call(self._conn(), op, meta, arrays)
+            except (ConnectionError, OSError):
+                self.close()
+                return _call(self._conn(), op, meta, arrays)
+
+    def ping(self) -> dict:
+        return self._call("ping")[0]
+
+    def init(self, cfg, *, slots: int = 8, max_len: int = 0,
+             decode_block: int = 8) -> None:
+        self._call("init", {
+            "cfg": dataclasses.asdict(cfg), "slots": slots,
+            "max_len": max_len, "decode_block": decode_block,
+        })
+
+    def push_weights(self, version: int, params: dict) -> None:
+        """Ship the full parameter tree (host numpy) with its version."""
+        flat = flatten_tree(params)
+        self._call("weights", {"version": int(version)}, flat)
+
+    def rollout(self, prompts: np.ndarray, seeds: list[int], *,
+                gen_len: int, temperature: float = 1.0,
+                top_p: float = 1.0,
+                expect_version: int | None = None) -> np.ndarray:
+        meta, arrays = self._call("rollout", {
+            "gen_len": gen_len, "temperature": temperature,
+            "top_p": top_p, "expect_version": expect_version,
+        }, {
+            "prompts": np.ascontiguousarray(prompts, np.int32),
+            "seeds": np.asarray(seeds, np.int64),
+        })
+        return arrays["tokens"]
+
+    def stop_worker(self) -> None:
+        try:
+            self._call("stop")
+        except (RemoteServingError, ConnectionError, OSError):
+            pass
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+
+def spawn_worker(env: dict | None = None, host: str = "127.0.0.1",
+                 startup_timeout: float = 120.0):
+    """Launch a ServingWorker as a CHILD PROCESS; returns (addr, proc).
+
+    The child owns its JAX runtime (CPU mesh in tests; point
+    JAX_PLATFORMS/visible-device envs at an inference slice in
+    production). The bound port is discovered through a temp file the
+    child writes — bind-then-report, so there is no port race."""
+    import subprocess
+    import sys
+    import tempfile
+    import time as _time
+
+    port_file = tempfile.mktemp(prefix="serving_worker_port_")
+    child_env = dict(os.environ)
+    child_env.update(env or {})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dlrover_tpu.rl.serving_worker",
+         "--host", host, "--port-file", port_file],
+        env=child_env,
+    )
+    deadline = _time.monotonic() + startup_timeout
+    while _time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"serving worker died at startup (rc={proc.returncode})"
+            )
+        try:
+            with open(port_file) as f:
+                content = f.read().strip()
+            if content:
+                os.remove(port_file)
+                return f"{host}:{int(content)}", proc
+        except (OSError, ValueError):
+            pass
+        _time.sleep(0.05)
+    proc.kill()
+    raise RuntimeError("serving worker did not report its port in time")
+
+
+def main(argv: Any = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--port-file", default="")
+    args = parser.parse_args(argv)
+    worker = ServingWorker(host=args.host, port=args.port)
+    if args.port_file:
+        # write-then-rename: the parent must never read a partial write
+        tmp = args.port_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(worker.port))
+        os.replace(tmp, args.port_file)
+    worker.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

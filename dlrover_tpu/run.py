@@ -102,6 +102,7 @@ def launch_local_master(args, min_nodes: int, max_nodes: int
         "--max-nodes", str(max_nodes),
         "--node-unit", str(args.node_unit),
         "--rdzv-timeout", str(args.rdzv_timeout),
+        "--heartbeat-interval", str(args.heartbeat_interval),
         "--port-file", port_file,
     ]
     proc = subprocess.Popen(cmd, start_new_session=True)

@@ -1,0 +1,101 @@
+"""COW (fork) shm snapshots (CheckpointEngine snapshot_mode="cow").
+
+The 12 GB checkpoint headline path: on a single-core host the direct
+arena write is memcpy-roofline-bound (~7 GB/s -> 1.6 s blocking for
+12 GB), so the engine forks and the child does the copy while training
+continues — blocking cost becomes the fork (page-table duplication,
+milliseconds). Reference bar: 0.5 s save block at 18 GB
+(docs/blogs/megatron_flash_checkpoint.md:159); the reference gets there
+with a per-shard threadpool across many cores
+(dlrover/python/elastic_agent/torch/ckpt_saver.py:542), COW is the
+single-core-honest equivalent.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.checkpoint.engine import CheckpointEngine
+
+
+@pytest.fixture()
+def engine(tmp_ipc_dir, tmp_path):
+    eng = CheckpointEngine(
+        str(tmp_path / "ckpt"), node_id=9, snapshot_mode="cow"
+    )
+    yield eng
+    eng.close()
+
+
+def _state(v: float, n: int = 1 << 20):
+    return {
+        "params": {"w": np.full(n, v, np.float32)},
+        "mu": {"w": np.full(n, v + 0.5, np.float32)},
+    }
+
+
+@pytest.mark.timeout(120)
+def test_cow_snapshot_roundtrip(engine):
+    state = _state(3.0)
+    assert engine.save_to_memory(1, state)  # warmup: arena creation
+    assert engine.wait_snapshot(timeout=60)
+    t0 = time.monotonic()
+    assert engine.save_to_memory(2, state)
+    block_s = time.monotonic() - t0
+    assert engine.wait_snapshot(timeout=60)
+    info = engine.last_snapshot_info
+    # the blocking cost is the fork, not the 8 MB copy
+    assert info["fork_s"] <= block_s + 0.01
+    assert info.get("copy_s") is not None
+    loaded = engine.load(_state(0.0))
+    assert loaded is not None and loaded[0] == 2
+    np.testing.assert_array_equal(loaded[1]["params"]["w"], 3.0)
+    np.testing.assert_array_equal(loaded[1]["mu"]["w"], 3.5)
+
+
+@pytest.mark.timeout(120)
+def test_cow_is_point_in_time(engine):
+    """Mutating the state right after save must not leak into the
+    snapshot: the fork's COW pages preserve the at-save values even
+    while the child is still copying."""
+    state = _state(1.0)
+    assert engine.save_to_memory(1, state)
+    assert engine.wait_snapshot(timeout=60)
+    assert engine.save_to_memory(2, state)
+    # overwrite immediately — the child may still be copying
+    state["params"]["w"][:] = 777.0
+    state["mu"]["w"][:] = 778.0
+    assert engine.wait_snapshot(timeout=60)
+    loaded = engine.load(_state(0.0))
+    assert loaded[0] == 2
+    np.testing.assert_array_equal(loaded[1]["params"]["w"], 1.0)
+    np.testing.assert_array_equal(loaded[1]["mu"]["w"], 1.5)
+
+
+@pytest.mark.timeout(120)
+def test_cow_storage_persist_sees_child_writes(engine, tmp_path):
+    """save_to_storage must wait for the child before enqueueing the
+    persist event, so the saver reads the new header, not the stale one."""
+    state = _state(4.0)
+    assert engine.save_to_memory(1, state)
+    assert engine.save_to_storage(5, _state(5.0))
+    assert engine.wait_for_persist(5, timeout=60)
+    engine.shm_handler.clear()
+    loaded = engine.load(_state(0.0))
+    assert loaded[0] == 5
+    np.testing.assert_array_equal(loaded[1]["params"]["w"], 5.0)
+
+
+@pytest.mark.timeout(120)
+def test_cow_back_to_back_saves_serialize(engine):
+    """A second save while a child is mid-copy waits for the lock release
+    instead of skipping; every snapshot lands in order."""
+    for step in range(1, 5):
+        assert engine.save_to_memory(step, _state(float(step)))
+    assert engine.wait_snapshot(timeout=60)
+    loaded = engine.load(_state(0.0))
+    assert loaded[0] == 4
+    np.testing.assert_array_equal(loaded[1]["params"]["w"], 4.0)

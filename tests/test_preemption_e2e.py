@@ -55,6 +55,9 @@ def test_preemption_notice_buddy_restore_no_storage(tmp_path, monkeypatch):
 
     master = JobMaster(min_nodes=2, max_nodes=2, rdzv_timeout=20.0)
     master.node_manager._preempt_dead_window_s = 3.0
+    # agents heartbeat every 0.5s below; the derived-window floor
+    # (2*interval+slack) must track that, not the 15s prod default
+    master.node_manager._heartbeat_interval_s = 0.5
     log = str(tmp_path / "goodput.jsonl")
     result_file = str(tmp_path / "result.json")
     scaler = LocalProcessScaler(
@@ -174,7 +177,8 @@ class TestWatcherUnit:
 
         dead = []
         nm = NodeManager(dead_window_s=1000.0, on_node_dead=dead.append,
-                         preempt_dead_window_s=0.2)
+                         preempt_dead_window_s=0.2,
+                         heartbeat_interval_s=0.05)
         nm.ensure_node(0)
         nm.report_heartbeat(0)
         nm.report_preemption(0, deadline_s=30.0)
@@ -185,6 +189,32 @@ class TestWatcherUnit:
         node = nm.ensure_node(0)
         assert node.preempting_since == 0.0
 
+    def test_armed_window_spans_heartbeat_cadence(self):
+        """Advisor r04: with the armed window == the heartbeat interval
+        a still-alive node racing its own cadence (heartbeat delayed by
+        the pre-kill prepare) was falsely declared dead mid-prepare.
+        The effective window must span >=2 cadences + slack."""
+        from dlrover_tpu.master.node_manager import NodeManager
+
+        dead = []
+        nm = NodeManager(dead_window_s=1000.0, on_node_dead=dead.append,
+                         preempt_dead_window_s=0.2,
+                         heartbeat_interval_s=0.2)
+        assert nm._effective_preempt_window() >= 0.4
+        nm.ensure_node(0)
+        nm.report_heartbeat(0)
+        nm.report_preemption(0, deadline_s=30.0)
+        # a heartbeat lands a full cadence late (delayed by the
+        # prepare) — inside the derived window, so the node lives
+        time.sleep(0.3)
+        nm._check_dead_nodes()
+        assert dead == []
+        # prod geometry: 15s cadence forces a >=30s armed window even
+        # when the configured preempt window is shorter
+        nm2 = NodeManager(preempt_dead_window_s=15.0,
+                          heartbeat_interval_s=15.0)
+        assert nm2._effective_preempt_window() >= 33.0
+
     def test_heartbeat_past_ttl_disarms_silence_does_not(self):
         """Survival evidence is a HEARTBEAT past the advertised kill
         window (live migration); mere elapsed time must NOT disarm —
@@ -194,7 +224,8 @@ class TestWatcherUnit:
 
         dead = []
         nm = NodeManager(dead_window_s=1000.0, on_node_dead=dead.append,
-                         preempt_dead_window_s=0.2)
+                         preempt_dead_window_s=0.2,
+                         heartbeat_interval_s=0.05)
         nm.ensure_node(0)
         nm.report_heartbeat(0)
         nm.report_preemption(0, deadline_s=30.0)

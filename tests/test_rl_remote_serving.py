@@ -1,0 +1,157 @@
+"""Disaggregated RLHF serving (rl/serving_worker.py): the engine in a
+SEPARATE process, weights streamed over the no-pickle framing with
+explicit versions — the r04 verdict's last uncovered reference
+capability (atorch/rl/inference_backend/vllm_backend.py: a vLLM backend
+receiving trainer weights across engines; the hard part is weight
+transfer + version skew, which the one-mesh form never exercises).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from dlrover_tpu.models import transformer as tfm
+from dlrover_tpu.parallel.strategy import dp
+from dlrover_tpu.rl.engine import ShardedPPOTrainer
+from dlrover_tpu.rl.ppo import PPOConfig
+from dlrover_tpu.rl.serving_worker import (
+    RemoteServingClient,
+    RemoteServingError,
+    ServingWorker,
+)
+
+CFG = tfm.CONFIGS["tiny"]
+
+
+def _reward(tokens: np.ndarray) -> np.ndarray:
+    return (tokens[:, -8:] % 2 == 0).mean(axis=1).astype(np.float32)
+
+
+def _trainer(temperature: float) -> ShardedPPOTrainer:
+    return ShardedPPOTrainer(
+        CFG, PPOConfig(gen_len=8, ppo_epochs=1,
+                       temperature=temperature),
+        _reward, jax.random.PRNGKey(0), strategy=dp(),
+    )
+
+
+@pytest.fixture(scope="module")
+def worker():
+    """In-process worker over real TCP: the full wire protocol without
+    the child-process JAX cold start. The true child-process form is
+    covered once by test_remote_rollouts_via_child_process."""
+    w = ServingWorker(host="127.0.0.1").start()
+    yield w
+    w.stop()
+
+
+class TestWireProtocol:
+    def test_weights_roundtrip_and_versioning(self, worker):
+        client = RemoteServingClient(f"127.0.0.1:{worker.port}")
+        client.init(CFG, slots=2, max_len=CFG.max_seq_len,
+                    decode_block=4)
+        assert client.ping()["version"] == -1
+        params = tfm.init_params(CFG, jax.random.PRNGKey(1))
+        client.push_weights(3, jax.device_get(params))
+        info = client.ping()
+        assert info["version"] == 3 and info["ready"]
+        client.close()
+
+    def test_rollout_requires_weights(self, worker):
+        client = RemoteServingClient(f"127.0.0.1:{worker.port}")
+        client.init(CFG, slots=2, max_len=CFG.max_seq_len)
+        with pytest.raises(RemoteServingError, match="not_initialized"):
+            client.rollout(np.ones((1, 4), np.int32), [0], gen_len=4)
+        client.close()
+
+    def test_version_skew_is_an_error_not_stale_generation(self, worker):
+        client = RemoteServingClient(f"127.0.0.1:{worker.port}")
+        client.init(CFG, slots=2, max_len=CFG.max_seq_len)
+        params = tfm.init_params(CFG, jax.random.PRNGKey(1))
+        client.push_weights(0, jax.device_get(params))
+        prompts = np.tile(np.arange(1, 5, dtype=np.int32)[None], (2, 1))
+        # the trainer moved to v1 but never pushed: the worker must
+        # refuse, not roll out from v0
+        with pytest.raises(RemoteServingError, match="version") as ei:
+            client.rollout(prompts, [1, 2], gen_len=4,
+                           expect_version=1)
+        assert ei.value.meta["current"] == 0
+        # matching version works
+        out = client.rollout(prompts, [1, 2], gen_len=4,
+                             expect_version=0)
+        assert out.shape == (2, 4)
+        client.close()
+
+
+class TestRemoteParity:
+    @pytest.mark.timeout(300)
+    def test_greedy_remote_matches_in_mesh_decode(self, worker):
+        """temperature=0 parity ACROSS THE WIRE: same tokens as the
+        in-mesh decode, and the rollout logprobs computed on them by
+        the training forward match exactly."""
+        t_mesh = _trainer(0.0)
+        t_remote = _trainer(0.0)
+        t_remote.enable_remote_rollouts(
+            f"127.0.0.1:{worker.port}", slots=4, decode_block=4,
+            max_len=CFG.max_seq_len,
+        )
+        prompts = np.tile(
+            np.arange(1, 7, dtype=np.int32)[None], (8, 1)
+        ) + np.arange(8, dtype=np.int32)[:, None]
+        key = jax.random.PRNGKey(3)
+        b_mesh = t_mesh.rollout(prompts, key)
+        b_remote = t_remote.rollout(prompts, key)
+        np.testing.assert_array_equal(
+            np.asarray(b_mesh["tokens"]),
+            np.asarray(b_remote["tokens"]),
+        )
+        np.testing.assert_allclose(
+            np.asarray(b_mesh["old_logp"]),
+            np.asarray(b_remote["old_logp"]), rtol=1e-5, atol=1e-6,
+        )
+        t_remote._remote.close()
+
+    @pytest.mark.timeout(300)
+    def test_train_step_pushes_versioned_weights(self, worker):
+        """After a train step the NEXT rollout must push the updated
+        weights before generating — the worker's version provably
+        tracks the trainer's iteration."""
+        t = _trainer(0.7)
+        t.enable_remote_rollouts(
+            f"127.0.0.1:{worker.port}", slots=4, decode_block=4,
+            max_len=CFG.max_seq_len,
+        )
+        prompts = np.tile(np.arange(1, 7, dtype=np.int32)[None], (8, 1))
+        m1 = t.train_step(prompts, jax.random.PRNGKey(0))
+        assert np.isfinite(m1["loss"])
+        assert t._weights_version == 1
+        # worker still at v0 (the push happens lazily at rollout time)
+        assert t._remote.ping()["version"] == 0
+        m2 = t.train_step(prompts, jax.random.PRNGKey(1))
+        assert np.isfinite(m2["loss"])
+        assert t._remote.ping()["version"] == 1  # v1 pushed for step 2
+        t._remote.close()
+
+
+@pytest.mark.timeout(600)
+def test_remote_rollouts_via_child_process():
+    """The full disaggregated form: the worker spawned as a CHILD
+    PROCESS with its own JAX runtime (own CPU mesh here), weights over
+    TCP, one PPO iteration end-to-end."""
+    t = _trainer(0.7)
+    t.enable_remote_rollouts(slots=4, decode_block=4,
+                             max_len=CFG.max_seq_len)
+    try:
+        info = t._remote.ping()
+        import os
+
+        assert info["pid"] != os.getpid()  # really another process
+        prompts = np.tile(np.arange(1, 7, dtype=np.int32)[None], (8, 1))
+        metrics = t.train_step(prompts, jax.random.PRNGKey(0))
+        assert np.isfinite(metrics["loss"])
+        assert t._remote.ping()["version"] == 0
+    finally:
+        t.close_remote()
