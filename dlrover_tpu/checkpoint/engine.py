@@ -170,10 +170,19 @@ class CheckpointEngine:
                 self._cow_ok = None
 
                 def _on_done(ok: bool, info: dict) -> None:
-                    self._cow_info = info
-                    self._cow_ok = ok
-                    self.shm_handler.lock.release()
-                    self._cow_done.set()
+                    # _cow_done MUST be set even if the lock release
+                    # throws (dead lock-server socket): a missed set()
+                    # wedges every later save/load behind 300s waits
+                    try:
+                        self._cow_info = info
+                        self._cow_ok = ok
+                        self.shm_handler.lock.release()
+                    except Exception:  # noqa: BLE001 - see above
+                        logger.exception(
+                            "COW watcher completion cleanup failed")
+                        self._cow_ok = False
+                    finally:
+                        self._cow_done.set()
 
                 try:
                     info = self.shm_handler.save_state_dict_fork(

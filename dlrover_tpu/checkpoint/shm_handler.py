@@ -16,7 +16,6 @@ back numpy arrays; the caller ``device_put``s them with target shardings
 
 from __future__ import annotations
 
-import json
 import math
 import os
 import struct

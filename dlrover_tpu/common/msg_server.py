@@ -1,0 +1,116 @@
+"""Threaded TCP server base for the raw-array message protocol.
+
+One accept loop + one thread per connection, each request a
+``common/array_wire`` message inside a ``common/rpc`` length-prefixed
+frame; handler errors travel back as structured ``err`` messages.
+Shared by the sharded embedding service (embedding/service.py) and the
+disaggregated RLHF serving worker (rl/serving_worker.py) so protocol
+fixes (timeouts, stop semantics, error framing) land in exactly one
+place.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+from dlrover_tpu.common.array_wire import decode_msg, encode_msg
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.rpc import recv_frame, send_frame
+
+logger = get_logger(__name__)
+
+
+class MsgError(RuntimeError):
+    """Structured protocol error: ``code`` + message + optional meta,
+    serialized as an ``err`` response and re-raised client-side."""
+
+    def __init__(self, code: str, message: str, meta: dict | None = None):
+        super().__init__(f"{code}: {message}")
+        self.code = code
+        self.meta = meta or {}
+
+
+def call_msg(sock: socket.socket, op: str, meta: dict | None = None,
+             arrays: dict | None = None,
+             error_cls: type = MsgError) -> tuple[dict, dict]:
+    """One request/response over an open socket; ``err`` responses are
+    raised as ``error_cls(code, message, meta)``."""
+    send_frame(sock, encode_msg(op, meta, arrays))
+    rop, rmeta, rarrays = decode_msg(recv_frame(sock))
+    if rop == "err":
+        raise error_cls(rmeta.get("code", "error"),
+                        rmeta.get("message", ""), rmeta)
+    return rmeta, rarrays
+
+
+class ArrayMsgServer:
+    """Subclass and implement ``_handle(op, meta, arrays) -> bytes``
+    (raise ``MsgError``/subclass for structured failures)."""
+
+    #: error class whose instances are serialized with their code/meta
+    error_cls: type = MsgError
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 name: str = "msg-server"):
+        self._stop = threading.Event()
+        self._sock = socket.create_server((host, port))
+        self._sock.settimeout(0.5)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name=name,
+        )
+
+    @property
+    def port(self) -> int:
+        return self._sock.getsockname()[1]
+
+    def start(self):
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with conn:
+            while not self._stop.is_set():
+                try:
+                    op, meta, arrays = decode_msg(recv_frame(conn))
+                except (ConnectionError, OSError, ValueError):
+                    return
+                try:
+                    resp = self._handle(op, meta, arrays)
+                except MsgError as e:
+                    resp = encode_msg("err", {
+                        "code": e.code, "message": str(e), **e.meta,
+                    })
+                except Exception as e:  # noqa: BLE001 - report to caller
+                    logger.exception("op %s failed", op)
+                    resp = encode_msg("err", {
+                        "code": "internal",
+                        "message": f"{type(e).__name__}: {e}",
+                    })
+                try:
+                    send_frame(conn, resp)
+                except (ConnectionError, OSError):
+                    return
+
+    def _handle(self, op: str, meta: dict, arrays: dict) -> bytes:
+        raise NotImplementedError

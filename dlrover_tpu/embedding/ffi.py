@@ -1,0 +1,195 @@
+"""In-graph sparse embedding ops: XLA FFI custom calls over the native
+KvVariable runtime.
+
+Reference analog: tfplus's KvVariable gather/apply are GRAPH ops
+(tfplus/kv_variable/ops/kv_variable_ops.cc:37, kernels/
+training_ops.cc) — the sparse hot path never leaves the runtime. The
+repo's default sparse path is host-side Python (SURVEY §7 named the
+in-graph form the trickiest native piece); this module closes it for
+CPU backends: ``kv_gather``/``kv_apply_adam`` lower to XLA custom
+calls (native/kv_ffi.cc), so a jitted train step runs lookup → dense
+tower → backward → sparse Adam with ZERO Python in the loop.
+
+On TPU the table stays host-side by design — an unbounded hash table
+cannot live in device HBM, and XLA:TPU does not execute user C++ —
+so the FFI targets register for the "cpu" platform and the TPU flow
+keeps the host lookup + on-chip dense tower split. That is the same
+division of labor the reference reaches with parameter servers.
+
+Lifetime contract: the compiled program captures the table's raw
+pointer as a call attribute. Keep the ``KvEmbeddingTable`` alive for
+as long as any jitted function built from it can run — the helpers
+here close over the table precisely so Python's GC enforces that.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+
+import numpy as np
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+_registered = False
+_reg_lock = threading.Lock()
+
+
+def ffi_available() -> bool:
+    """True when the native lib exports the FFI handlers (it was built
+    with the jaxlib headers) and this process can register them."""
+    try:
+        from dlrover_tpu.embedding.kv_table import _load_lib
+
+        lib = _load_lib()
+        ctypes.cast(getattr(lib, "KvGather"), ctypes.c_void_p)
+        return True
+    except (AttributeError, OSError, RuntimeError):
+        return False
+
+
+def register_targets() -> bool:
+    """Register the FFI targets for the CPU platform (idempotent)."""
+    global _registered
+    with _reg_lock:
+        if _registered:
+            return True
+        if not ffi_available():
+            return False
+        import jax.ffi
+
+        from dlrover_tpu.embedding.kv_table import _load_lib
+
+        lib = _load_lib()
+        # both id widths: jax without jax_enable_x64 lowers integer
+        # arrays to i32, so that variant is the common jitted path;
+        # the S64 one serves x64-enabled processes
+        for name, sym in (
+            ("dlrover_kv_gather", lib.KvGather),
+            ("dlrover_kv_gather_i32", lib.KvGather32),
+            ("dlrover_kv_apply_adam", lib.KvApplyAdam),
+            ("dlrover_kv_apply_adam_i32", lib.KvApplyAdam32),
+        ):
+            jax.ffi.register_ffi_target(
+                name, jax.ffi.pycapsule(sym), platform="cpu",
+            )
+        _registered = True
+        logger.info("kv FFI targets registered (cpu)")
+        return True
+
+
+def make_ingraph_lookup(table, init_missing: bool = True):
+    """A jittable ``ids [*] -> values [*, dim]`` over ``table``.
+
+    The returned callable closes over the table (lifetime contract
+    above). Works under jit/scan on the CPU backend; no autodiff rule
+    on purpose — gradients w.r.t. the gathered rows flow to the sparse
+    optimizer through :func:`make_ingraph_train_step` or the host-side
+    ``table.apply`` path, never through a dense dL/dtable.
+    """
+    if not register_targets():
+        raise RuntimeError("kv FFI targets unavailable "
+                           "(native lib built without jax headers?)")
+    import jax
+
+    dim = table.dim
+    handle = int(table._handle)
+
+    def lookup(ids):
+        import jax.numpy as jnp
+
+        ids = jnp.asarray(ids)
+        wide = jnp.issubdtype(ids.dtype, jnp.int64)
+        ids = ids.astype(jnp.int64 if wide else jnp.int32)
+        out_shape = (*ids.shape, dim)
+        call = jax.ffi.ffi_call(
+            "dlrover_kv_gather" if wide else "dlrover_kv_gather_i32",
+            jax.ShapeDtypeStruct(out_shape, jnp.float32),
+        )
+        return call(ids.reshape(-1), table=np.int64(handle),
+                    init_missing=bool(init_missing)).reshape(out_shape)
+
+    # keep the table reachable from the closure (lifetime contract)
+    lookup._table = table
+    return lookup
+
+
+def make_ingraph_apply_adam(table, *, lr: float = 1e-3,
+                            beta1: float = 0.9, beta2: float = 0.999,
+                            eps: float = 1e-8, l2: float = 0.0,
+                            group_lasso: float = 0.0):
+    """A jittable ``(ids [*], grads [*, dim], step) -> rows`` applying
+    the sparse Adam update inside the compiled program (the
+    training_ops.cc analog). Marked side-effecting so XLA never CSEs or
+    dead-code-eliminates the update."""
+    if not register_targets():
+        raise RuntimeError("kv FFI targets unavailable "
+                           "(native lib built without jax headers?)")
+    import jax
+
+    handle = int(table._handle)
+
+    def apply_adam(ids, grads, step):
+        import jax.numpy as jnp
+
+        ids = jnp.asarray(ids).reshape(-1)
+        wide = jnp.issubdtype(ids.dtype, jnp.int64)
+        idt = jnp.int64 if wide else jnp.int32
+        ids = ids.astype(idt)
+        grads = jnp.asarray(grads, jnp.float32)
+        grads = grads.reshape(ids.shape[0], -1)
+        # step is a TRACED scalar operand (an attribute would bake it
+        # into the compiled program and force a per-step recompile)
+        step = jnp.asarray(step, idt).reshape(1)
+        call = jax.ffi.ffi_call(
+            "dlrover_kv_apply_adam" if wide
+            else "dlrover_kv_apply_adam_i32",
+            jax.ShapeDtypeStruct((1,), idt),
+            has_side_effect=True,
+        )
+        return call(ids, grads, step, table=np.int64(handle),
+                    lr=np.float32(lr), beta1=np.float32(beta1),
+                    beta2=np.float32(beta2), eps=np.float32(eps),
+                    l2=np.float32(l2),
+                    group_lasso=np.float32(group_lasso))[0]
+
+    apply_adam._table = table
+    return apply_adam
+
+
+def make_ingraph_train_step(table, tower_loss_fn, *, lr: float = 1e-3,
+                            tower_lr: float = 0.1,
+                            init_missing: bool = True, **adam_kw):
+    """One fully in-graph recsys train step: sparse gather → dense
+    tower forward/backward → tower SGD + sparse Adam, all inside ONE
+    jitted program — what the host-side path pays a Python round trip
+    per step for.
+
+    ``tower_loss_fn(tower_params, emb, batch) -> scalar loss``; the
+    embedding cotangent comes from ``jax.grad`` w.r.t. the gathered
+    block, then feeds the in-graph sparse apply. ``step`` (Adam bias
+    correction) is a traced scalar, so one compiled program serves the
+    whole run.
+    """
+    import jax
+
+    lookup = make_ingraph_lookup(table, init_missing=init_missing)
+    apply_ = make_ingraph_apply_adam(table, lr=lr, **adam_kw)
+
+    def train_step(tower, ids, batch, step):
+        emb = lookup(ids)
+
+        def loss_of(tw, e):
+            return tower_loss_fn(tw, e, batch)
+
+        loss, (tower_g, emb_g) = jax.value_and_grad(
+            loss_of, argnums=(0, 1))(tower, emb)
+        tower = jax.tree.map(lambda p, g: p - tower_lr * g,
+                             tower, tower_g)
+        rows = apply_(ids, emb_g, step)
+        return tower, loss, rows
+
+    train_step._table = table
+    return train_step

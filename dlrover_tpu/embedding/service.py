@@ -43,9 +43,15 @@ from typing import Iterable
 
 import numpy as np
 
-from dlrover_tpu.common.array_wire import decode_msg, encode_msg
+# decode_msg is re-exported: tests and tools treat this module as the
+# wire-protocol surface for the embedding tier
+from dlrover_tpu.common.array_wire import decode_msg, encode_msg  # noqa: F401
 from dlrover_tpu.common.log import get_logger
-from dlrover_tpu.common.rpc import recv_frame, send_frame
+from dlrover_tpu.common.msg_server import (
+    ArrayMsgServer,
+    MsgError,
+    call_msg,
+)
 from dlrover_tpu.embedding.kv_table import (
     IncrementalCheckpointManager,
     KvEmbeddingTable,
@@ -68,35 +74,32 @@ def shard_owner(ids: np.ndarray, num_shards: int) -> np.ndarray:
     return (x % np.uint64(num_shards)).astype(np.int64)
 
 
-class ShardError(RuntimeError):
-    def __init__(self, code: str, message: str, meta: dict | None = None):
-        super().__init__(f"{code}: {message}")
-        self.code = code
-        self.meta = meta or {}
+class ShardError(MsgError):
+    pass
 
 
 def _call(sock: socket.socket, op: str, meta: dict | None = None,
           arrays: dict | None = None) -> tuple[dict, dict]:
-    send_frame(sock, encode_msg(op, meta, arrays))
-    rop, rmeta, rarrays = decode_msg(recv_frame(sock))
-    if rop == "err":
-        raise ShardError(rmeta.get("code", "error"),
-                         rmeta.get("message", ""), rmeta)
-    return rmeta, rarrays
+    return call_msg(sock, op, meta, arrays, error_cls=ShardError)
 
 
-class EmbeddingShardServer:
-    """One embedding PS shard: a native KvEmbeddingTable behind TCP.
+class EmbeddingShardServer(ArrayMsgServer):
+    """One embedding PS shard: a native KvEmbeddingTable behind TCP
+    (accept/dispatch scaffolding in common/msg_server.py).
 
     Owns rows with ``shard_owner(id, num_shards) == index`` at the
     current routing version. ``migrate_to`` re-partitions under a new
     epoch, pushing rows to their new owners (the PS migration analog).
     """
 
+    error_cls = ShardError
+
     def __init__(self, dim: int, num_slots: int = 2, *, seed: int = 0,
                  host: str = "0.0.0.0", port: int = 0,
                  version: int = 0, num_shards: int = 1, index: int = 0,
                  ckpt_dir: str = "", base_interval: int = 10):
+        super().__init__(host=host, port=port,
+                         name=f"emb-shard-{index}")
         self.table = KvEmbeddingTable(dim=dim, num_slots=num_slots,
                                       seed=seed + 7919 * index)
         self.dim = dim
@@ -119,73 +122,16 @@ class EmbeddingShardServer:
         # armed) so the coordinator's retry re-runs the whole scale.
         self._migrating_since = 0.0
         self.migrate_ttl_s = 1800.0
-        self._stop = threading.Event()
-        self._sock = socket.create_server((host, port))
-        self._sock.settimeout(0.5)
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, daemon=True,
-            name=f"emb-shard-{index}",
-        )
-
-    # ------------------------------------------------------------ lifecycle
-
-    @property
-    def port(self) -> int:
-        return self._sock.getsockname()[1]
 
     def start(self) -> "EmbeddingShardServer":
-        self._accept_thread.start()
+        super().start()
         logger.info(
             "embedding shard %d/%d v%d serving on port %d",
             self.index, self.num_shards, self.version, self.port,
         )
         return self
 
-    def stop(self) -> None:
-        self._stop.set()
-        try:
-            self._sock.close()
-        except OSError:
-            pass
-
-    def _accept_loop(self) -> None:
-        while not self._stop.is_set():
-            try:
-                conn, _ = self._sock.accept()
-            except socket.timeout:
-                continue
-            except OSError:
-                return
-            threading.Thread(
-                target=self._serve_conn, args=(conn,), daemon=True
-            ).start()
-
     # ------------------------------------------------------------- dispatch
-
-    def _serve_conn(self, conn: socket.socket) -> None:
-        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        with conn:
-            while not self._stop.is_set():
-                try:
-                    op, meta, arrays = decode_msg(recv_frame(conn))
-                except (ConnectionError, OSError, ValueError):
-                    return
-                try:
-                    resp = self._handle(op, meta, arrays)
-                except ShardError as e:
-                    resp = encode_msg("err", {
-                        "code": e.code, "message": str(e), **e.meta,
-                    })
-                except Exception as e:  # noqa: BLE001 - report to caller
-                    logger.exception("shard op %s failed", op)
-                    resp = encode_msg("err", {
-                        "code": "internal",
-                        "message": f"{type(e).__name__}: {e}",
-                    })
-                try:
-                    send_frame(conn, resp)
-                except (ConnectionError, OSError):
-                    return
 
     def _check_epoch(self, meta: dict) -> None:
         if self._migrating:
@@ -262,6 +208,25 @@ class EmbeddingShardServer:
             return encode_msg("ok", {
                 "pruned": pruned, "rows": len(self.table),
             })
+        if op == "prune_unowned":
+            # rollback path for DESTINATIONS of an aborted scale: drop
+            # every row this server does not own under the GIVEN ring
+            # (index < 0 = not in that ring at all -> drop everything
+            # it received). No epoch or gate change.
+            n_shards = int(meta["num_shards"])
+            index = int(meta.get("index", -1))
+            with self._lock:
+                keys = self.table.export()["keys"]
+                if index < 0:
+                    prune = keys
+                elif keys.size:
+                    prune = keys[shard_owner(keys, n_shards) != index]
+                else:
+                    prune = keys
+                if prune.size:
+                    self.table.remove(prune)
+            return encode_msg("ok", {"pruned": int(prune.size),
+                                     "rows": len(self.table)})
         if op == "abort_migration":
             self.abort_migration()
             return encode_msg("ok", {"version": self.version})
@@ -302,7 +267,13 @@ class EmbeddingShardServer:
         the flip); ``commit_migration``/``abort_migration`` clears it.
         Returns rows copied."""
         self._migrating = True
-        self._migrating_since = time.monotonic()
+        # TTL disarmed (0.0) while the copy is IN FLIGHT: the copy's
+        # liveness is proven by its open RPC, and a TTL counted from
+        # copy start would self-abort any legitimately long copy (and
+        # the aborting request thread would block on _lock behind it).
+        # The clock starts when the copy finishes — from then on only a
+        # dead coordinator can leave the gate armed.
+        self._migrating_since = 0.0
         try:
             with self._lock:
                 new_n = len(addrs)
@@ -326,6 +297,7 @@ class EmbeddingShardServer:
                         if "slots" in snap else None,
                         "freq": snap["freq"][sel],
                     })
+                self._migrating_since = time.monotonic()
                 return moved
         except BaseException:
             # a failed copy aborts THIS server's phase; re-open for
@@ -440,7 +412,7 @@ class EmbeddingShardServer:
         return self._ckpt
 
 
-class EmbeddingCoordinator:
+class EmbeddingCoordinator(ArrayMsgServer):
     """Routing authority: (version, shard addrs) + the scale operation.
 
     Reference analog: ElasticPsService's version-bumped PS cluster
@@ -450,8 +422,11 @@ class EmbeddingCoordinator:
     the new ring adopts the bumped epoch. Clients that raced the scale
     get a version error from a shard and re-fetch the route here."""
 
+    error_cls = ShardError
+
     def __init__(self, addrs: Iterable[str], host: str = "0.0.0.0",
                  port: int = 0):
+        super().__init__(host=host, port=port, name="emb-coord")
         self.version = 0
         self.addrs = list(addrs)
         # _lock guards the (version, addrs) route snapshot and is held
@@ -461,75 +436,32 @@ class EmbeddingCoordinator:
         # client timeout and crashed trainers mid-migration
         self._lock = threading.Lock()
         self._scale_lock = threading.Lock()
-        self._stop = threading.Event()
-        self._sock = socket.create_server((host, port))
-        self._sock.settimeout(0.5)
-        self._thread = threading.Thread(
-            target=self._accept_loop, daemon=True, name="emb-coord"
-        )
-
-    @property
-    def port(self) -> int:
-        return self._sock.getsockname()[1]
 
     def start(self) -> "EmbeddingCoordinator":
         self._push_epochs()
-        self._thread.start()
+        super().start()
         logger.info("embedding coordinator on port %d (%d shards)",
                     self.port, len(self.addrs))
         return self
 
-    def stop(self) -> None:
-        self._stop.set()
-        try:
-            self._sock.close()
-        except OSError:
-            pass
-
-    def _accept_loop(self) -> None:
-        while not self._stop.is_set():
+    def _handle(self, op: str, meta: dict, arrays: dict) -> bytes:
+        if op == "route":
+            with self._lock:
+                return encode_msg("ok", {
+                    "version": self.version, "addrs": self.addrs,
+                })
+        if op == "scale":
             try:
-                conn, _ = self._sock.accept()
-            except socket.timeout:
-                continue
-            except OSError:
-                return
-            threading.Thread(
-                target=self._serve_conn, args=(conn,), daemon=True
-            ).start()
-
-    def _serve_conn(self, conn: socket.socket) -> None:
-        with conn:
-            while not self._stop.is_set():
-                try:
-                    op, meta, _ = decode_msg(recv_frame(conn))
-                except (ConnectionError, OSError, ValueError):
-                    return
-                if op == "route":
-                    with self._lock:
-                        resp = encode_msg("ok", {
-                            "version": self.version, "addrs": self.addrs,
-                        })
-                elif op == "scale":
-                    try:
-                        self.scale(meta["addrs"])
-                        with self._lock:
-                            resp = encode_msg("ok", {
-                                "version": self.version,
-                                "addrs": self.addrs,
-                            })
-                    except Exception as e:  # noqa: BLE001
-                        resp = encode_msg("err", {
-                            "code": "scale_failed",
-                            "message": f"{type(e).__name__}: {e}",
-                        })
-                else:
-                    resp = encode_msg("err", {"code": "bad_op",
-                                              "message": op})
-                try:
-                    send_frame(conn, resp)
-                except (ConnectionError, OSError):
-                    return
+                self.scale(meta["addrs"])
+            except Exception as e:  # noqa: BLE001 - report to caller
+                raise ShardError(
+                    "scale_failed", f"{type(e).__name__}: {e}"
+                ) from e
+            with self._lock:
+                return encode_msg("ok", {
+                    "version": self.version, "addrs": self.addrs,
+                })
+        raise ShardError("bad_op", f"unknown op {op!r}")
 
     def _shard_call(self, addr: str, op: str, meta: dict | None = None,
                     timeout: float = 60.0):
@@ -596,15 +528,7 @@ class EmbeddingCoordinator:
                     logger.info("shard %s copied %d rows", addr,
                                 meta["moved"])
             except Exception:
-                # phase-1 rollback: nothing was deleted anywhere; just
-                # re-open every old server (abort is idempotent on the
-                # ones that never armed their gate)
-                for addr in old_addrs:
-                    try:
-                        self._shard_call(addr, "abort_migration")
-                    except Exception:  # noqa: BLE001 - best effort
-                        logger.warning(
-                            "abort_migration to %s failed", addr)
+                self._rollback(old_addrs, new_addrs)
                 raise
             # phase 2a: epochs for pure-new members first (they only
             # gain rows). Retried, and STILL rollback-safe on failure —
@@ -623,12 +547,7 @@ class EmbeddingCoordinator:
                             }, migrate_retries, retry_backoff_s,
                         )
             except Exception:
-                for addr in old_addrs:
-                    try:
-                        self._shard_call(addr, "abort_migration")
-                    except Exception:  # noqa: BLE001 - best effort
-                        logger.warning(
-                            "abort_migration to %s failed", addr)
+                self._rollback(old_addrs, new_addrs)
                 raise
             # phase 2b: commit (prune+adopt) the old members — from
             # here failures roll FORWARD (see docstring)
@@ -646,6 +565,30 @@ class EmbeddingCoordinator:
             with self._lock:
                 self.version = new_version
                 self.addrs = list(new_addrs)
+
+    def _rollback(self, old_addrs: list[str],
+                  new_addrs: list[str]) -> None:
+        """Undo an uncommitted scale: nothing was deleted from the
+        authoritative owners, so re-opening them at the old epoch is
+        the core rollback (abort prunes their own strays). PURE-NEW
+        destinations additionally drop every row they received — they
+        sit outside the old ring, so a stray copy there would otherwise
+        survive until a later scale and could resurrect a row the
+        trainer deleted in between (review finding r05)."""
+        for addr in old_addrs:
+            try:
+                self._shard_call(addr, "abort_migration")
+            except Exception:  # noqa: BLE001 - best effort
+                logger.warning("abort_migration to %s failed", addr)
+        for addr in new_addrs:
+            if addr in old_addrs:
+                continue
+            try:
+                self._shard_call(addr, "prune_unowned",
+                                 {"num_shards": len(old_addrs),
+                                  "index": -1})
+            except Exception:  # noqa: BLE001 - best effort
+                logger.warning("prune_unowned to %s failed", addr)
 
     def _retry_shard_call(self, addr: str, op: str, meta: dict,
                           retries: int, backoff_s: float,

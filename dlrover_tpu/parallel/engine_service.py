@@ -24,6 +24,7 @@ import os
 import subprocess
 import sys
 import threading
+import time
 from typing import Any
 
 from dlrover_tpu.common import messages as m
@@ -89,7 +90,7 @@ def _search_subprocess(req: m.StrategyProposeRequest) -> dict:
 class StrategyEngineService:
     """RPC service: propose strategies, absorb measurements."""
 
-    def __init__(self, port: int = 0):
+    def __init__(self, port: int = 0, db_path: str = ""):
         self._server = RpcServer(self.handle, port=port)
         self._lock = threading.Lock()
         self._cache: dict[tuple, m.StrategyProposal] = {}
@@ -101,6 +102,50 @@ class StrategyEngineService:
         # per-key in-flight search locks: N jobs asking at once must
         # run ONE subprocess, not N (the point of a shared engine)
         self._inflight: dict[tuple, threading.Lock] = {}
+        # cross-job, cross-restart persistence (the Brain-datastore
+        # pattern, reference go/brain/pkg/datastore/): job B's measured
+        # search warm-starts from what job A reported even after the
+        # engine restarts
+        self._db = None
+        if db_path:
+            import sqlite3
+
+            if db_path != ":memory:":
+                os.makedirs(os.path.dirname(db_path) or ".",
+                            exist_ok=True)
+            self._db = sqlite3.connect(db_path,
+                                       check_same_thread=False)
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS strategy_obs ("
+                " model TEXT, n_devices INT, batch INT, seq INT,"
+                " hbm_gb REAL, strategy_json TEXT, step_time_s REAL,"
+                " timestamp REAL,"
+                " PRIMARY KEY (model, n_devices, batch, seq, hbm_gb,"
+                "              strategy_json))"
+            )
+            self._db.commit()
+            for row in self._db.execute(
+                "SELECT model, n_devices, batch, seq, hbm_gb,"
+                " strategy_json, step_time_s FROM strategy_obs"
+                " ORDER BY timestamp"
+            ):
+                key = (row[0], row[1], row[2], row[3], row[4])
+                self._observations.setdefault(key, []).append(
+                    {"strategy_json": row[5], "step_time_s": row[6]}
+                )
+                best = self._measured.get(key)
+                if best is None or row[6] < best[0]:
+                    self._measured[key] = (row[6], row[5])
+            # the same per-key bound the report path enforces: a
+            # long-lived db must not balloon memory or RPC payloads
+            for obs in self._observations.values():
+                del obs[:-256]
+            if self._measured:
+                logger.info(
+                    "engine warm-started from %s: %d shape keys, %d "
+                    "observations", db_path, len(self._measured),
+                    sum(len(v) for v in self._observations.values()),
+                )
 
     @property
     def addr(self) -> str:
@@ -113,6 +158,10 @@ class StrategyEngineService:
 
     def stop(self) -> None:
         self._server.stop()
+        if self._db is not None:
+            with self._lock:
+                self._db.close()
+                self._db = None
 
     def handle(self, msg: Any) -> Any:
         if isinstance(msg, m.StrategyMeasurement):
@@ -140,6 +189,14 @@ class StrategyEngineService:
                 obs.append({"strategy_json": msg.strategy_json,
                             "step_time_s": msg.step_time_s})
                 del obs[:-256]
+                if self._db is not None:
+                    self._db.execute(
+                        "INSERT OR REPLACE INTO strategy_obs VALUES"
+                        " (?, ?, ?, ?, ?, ?, ?, ?)",
+                        (*key, msg.strategy_json, msg.step_time_s,
+                         time.time()),
+                    )
+                    self._db.commit()
             return m.OkResponse()
         if isinstance(msg, m.StrategyObservationsRequest):
             key = (msg.model, msg.n_devices, msg.batch, msg.seq,

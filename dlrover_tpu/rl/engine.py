@@ -253,7 +253,11 @@ class ShardedPPOTrainer(PPOTrainer):
     def close_remote(self) -> None:
         remote = getattr(self, "_remote", None)
         if remote is not None:
-            remote.stop_worker()
+            # only stop a worker THIS trainer spawned: an addr-connected
+            # worker may be a shared inference slice other trainers are
+            # still rolling out against
+            if getattr(self, "_remote_proc", None) is not None:
+                remote.stop_worker()
             remote.close()
             self._remote = None
         proc = getattr(self, "_remote_proc", None)

@@ -43,113 +43,59 @@ from typing import Any
 import numpy as np
 
 from dlrover_tpu.common.array_wire import (
-    decode_msg,
     encode_msg,
     flatten_tree,
     unflatten_tree,
 )
 from dlrover_tpu.common.log import get_logger
-from dlrover_tpu.common.rpc import recv_frame, send_frame
+from dlrover_tpu.common.msg_server import (
+    ArrayMsgServer,
+    MsgError,
+    call_msg,
+)
 
 logger = get_logger(__name__)
 
 
-class RemoteServingError(RuntimeError):
-    def __init__(self, code: str, message: str, meta: dict | None = None):
-        super().__init__(f"{code}: {message}")
-        self.code = code
-        self.meta = meta or {}
+class RemoteServingError(MsgError):
+    pass
 
 
 def _call(sock: socket.socket, op: str, meta: dict | None = None,
           arrays: dict | None = None) -> tuple[dict, dict]:
-    send_frame(sock, encode_msg(op, meta, arrays))
-    rop, rmeta, rarrays = decode_msg(recv_frame(sock))
-    if rop == "err":
-        raise RemoteServingError(rmeta.get("code", "error"),
-                                 rmeta.get("message", ""), rmeta)
-    return rmeta, rarrays
+    return call_msg(sock, op, meta, arrays,
+                    error_cls=RemoteServingError)
 
 
-class ServingWorker:
-    """The child-process server: one InferenceEngine behind TCP.
+class ServingWorker(ArrayMsgServer):
+    """The child-process server: one InferenceEngine behind TCP
+    (accept/dispatch scaffolding in common/msg_server.py).
 
     The engine is (re)built on ``init``; ``weights`` installs a new
     versioned parameter tree (the engine's jitted programs take params
     as an argument, so installation is a pointer swap after the host
     receive — no recompilation)."""
 
+    error_cls = RemoteServingError
+
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        self._sock = socket.create_server((host, port))
-        self._sock.settimeout(0.5)
-        self._stop = threading.Event()
+        super().__init__(host=host, port=port, name="serving-worker")
         self._lock = threading.Lock()
         self._engine = None
         self._engine_kw: dict = {}
         self._cfg = None
         self.version = -1
-        self._thread = threading.Thread(
-            target=self._accept_loop, daemon=True, name="serving-worker"
-        )
-
-    @property
-    def port(self) -> int:
-        return self._sock.getsockname()[1]
 
     def start(self) -> "ServingWorker":
-        self._thread.start()
+        super().start()
         logger.info("serving worker on port %d (pid %d)",
                     self.port, os.getpid())
         return self
-
-    def stop(self) -> None:
-        self._stop.set()
-        try:
-            self._sock.close()
-        except OSError:
-            pass
 
     def serve_forever(self) -> None:
         self.start()
         while not self._stop.is_set():
             self._stop.wait(0.5)
-
-    def _accept_loop(self) -> None:
-        while not self._stop.is_set():
-            try:
-                conn, _ = self._sock.accept()
-            except socket.timeout:
-                continue
-            except OSError:
-                return
-            threading.Thread(
-                target=self._serve_conn, args=(conn,), daemon=True
-            ).start()
-
-    def _serve_conn(self, conn: socket.socket) -> None:
-        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        with conn:
-            while not self._stop.is_set():
-                try:
-                    op, meta, arrays = decode_msg(recv_frame(conn))
-                except (ConnectionError, OSError, ValueError):
-                    return
-                try:
-                    resp = self._handle(op, meta, arrays)
-                except RemoteServingError as e:
-                    resp = encode_msg("err", {
-                        "code": e.code, "message": str(e), **e.meta,
-                    })
-                except Exception as e:  # noqa: BLE001 - report to caller
-                    logger.exception("serving op %s failed", op)
-                    resp = encode_msg("err", {
-                        "code": "internal",
-                        "message": f"{type(e).__name__}: {e}",
-                    })
-                try:
-                    send_frame(conn, resp)
-                except (ConnectionError, OSError):
-                    return
 
     # -------------------------------------------------------------- handlers
 
@@ -207,6 +153,11 @@ class ServingWorker:
             raise RemoteServingError("not_initialized",
                                      "no weights installed")
         expect = meta.get("expect_version")
+        # the lock spans the WHOLE decode: a weights push landing
+        # mid-rollout would otherwise swap engine.params under the
+        # decode loop, producing mixed-version generations tagged with
+        # the old version — exactly the skew the protocol promises
+        # cannot happen. Pushes queue behind in-flight rollouts.
         with self._lock:
             if expect is not None and int(expect) != self.version:
                 # version skew is an ERROR, not a silent stale rollout
@@ -218,22 +169,22 @@ class ServingWorker:
                 )
             engine = self._engine
             version = self.version
-        prompts = arrays["prompts"]
-        seeds = [int(s) for s in arrays["seeds"]]
-        gen_len = int(meta["gen_len"])
-        temperature = float(meta.get("temperature", 1.0))
-        top_p = float(meta.get("top_p", 1.0))
-        rids = [
-            engine.submit(
-                [int(t) for t in row],
-                SamplingParams(
-                    temperature=temperature, top_p=top_p,
-                    max_new_tokens=gen_len, seed=seeds[i],
-                ),
-            )
-            for i, row in enumerate(prompts)
-        ]
-        results = {r.id: r for r in engine.run()}
+            prompts = arrays["prompts"]
+            seeds = [int(s) for s in arrays["seeds"]]
+            gen_len = int(meta["gen_len"])
+            temperature = float(meta.get("temperature", 1.0))
+            top_p = float(meta.get("top_p", 1.0))
+            rids = [
+                engine.submit(
+                    [int(t) for t in row],
+                    SamplingParams(
+                        temperature=temperature, top_p=top_p,
+                        max_new_tokens=gen_len, seed=seeds[i],
+                    ),
+                )
+                for i, row in enumerate(prompts)
+            ]
+            results = {r.id: r for r in engine.run()}
         gen = np.stack([
             np.asarray(
                 (results[rid].tokens + [0] * gen_len)[:gen_len],

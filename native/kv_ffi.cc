@@ -1,0 +1,195 @@
+// XLA FFI custom-call bindings for the KvVariable embedding runtime:
+// the IN-GRAPH sparse lookup/apply path.
+//
+// Reference analog: tfplus's KvVariable is a TF *graph op* — the gather
+// and the sparse optimizer application execute inside the runtime
+// (tfplus/kv_variable/ops/kv_variable_ops.cc:37, kernels/
+// training_ops.cc), with no per-step host/Python round trip. The repo's
+// default sparse path is host-side (XLA's static shapes can't hold an
+// unbounded table), which costs a Python round trip per step. These FFI
+// handlers put the HOT OPS back inside the compiled program on CPU
+// backends (trainer/data hosts that own a table shard): `jax.ffi`
+// lowers them to custom calls, so a jitted step gathers rows and
+// applies the sparse optimizer with zero Python in the loop. On TPU the
+// table stays host-side by design (device HBM cannot hold an unbounded
+// hash table); the dense tower is the on-chip half.
+//
+// The table handle travels as an i64 attribute: it IS the kv_create
+// pointer, registered/owned by the Python KvEmbeddingTable whose
+// lifetime must cover every compiled program that captured it (the
+// Python wrapper enforces this by keeping the table in the closure).
+//
+// Build: linked into libdlrover_tpu_native.so next to kv_variable.cc
+// when the jax FFI headers are available (make FFI_INCLUDE=...); the
+// base runtime builds without them, so environments without jax
+// headers lose only the in-graph path.
+
+#include <cstdint>
+#include <vector>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+extern "C" {
+void kv_lookup(void* handle, const int64_t* keys, int64_t n, float* out,
+               int init_missing);
+void kv_apply_adam(void* handle, const int64_t* keys, const float* grads,
+                   int64_t n, float lr, float beta1, float beta2,
+                   float eps, int64_t step, float l2, float group_lasso);
+int64_t kv_size(void* handle);
+}
+
+static ffi::Error KvGatherImpl(int64_t table, bool init_missing,
+                               ffi::Buffer<ffi::S64> ids,
+                               ffi::ResultBuffer<ffi::F32> out) {
+  if (table == 0) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "kv_gather: null table handle");
+  }
+  const int64_t n = ids.element_count();
+  const int64_t out_elems = out->element_count();
+  if (n == 0) return ffi::Error::Success();
+  if (out_elems % n != 0) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "kv_gather: output size not a multiple of ids");
+  }
+  kv_lookup(reinterpret_cast<void*>(table), ids.typed_data(), n,
+            out->typed_data(), init_missing ? 1 : 0);
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    KvGather, KvGatherImpl,
+    ffi::Ffi::Bind()
+        .Attr<int64_t>("table")
+        .Attr<bool>("init_missing")
+        .Arg<ffi::Buffer<ffi::S64>>()
+        .Ret<ffi::Buffer<ffi::F32>>());
+
+// 32-bit-id variant: jax without jax_enable_x64 lowers every integer
+// array to i32, so this is the path most jitted callers actually take.
+// Keys widen losslessly (i32 ⊂ the table's i64 key space).
+static ffi::Error KvGather32Impl(int64_t table, bool init_missing,
+                                 ffi::Buffer<ffi::S32> ids,
+                                 ffi::ResultBuffer<ffi::F32> out) {
+  if (table == 0) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "kv_gather: null table handle");
+  }
+  const int64_t n = ids.element_count();
+  if (n == 0) return ffi::Error::Success();
+  if (out->element_count() % n != 0) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "kv_gather: output size not a multiple of ids");
+  }
+  std::vector<int64_t> wide(ids.typed_data(), ids.typed_data() + n);
+  kv_lookup(reinterpret_cast<void*>(table), wide.data(), n,
+            out->typed_data(), init_missing ? 1 : 0);
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    KvGather32, KvGather32Impl,
+    ffi::Ffi::Bind()
+        .Attr<int64_t>("table")
+        .Attr<bool>("init_missing")
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Ret<ffi::Buffer<ffi::F32>>());
+
+// Sparse Adam application as a graph op (the training_ops.cc analog).
+// Returns the table's row count so the call has a data result (XLA
+// custom calls need one); callers mark it side-effecting so DCE and
+// CSE keep their hands off.
+// `step` (Adam bias correction) is a TRACED scalar operand, not an
+// attribute: attributes are compile-time constants and would force a
+// recompile per training step.
+static ffi::Error KvApplyAdamImpl(int64_t table, float lr, float beta1,
+                                  float beta2, float eps, float l2,
+                                  float group_lasso,
+                                  ffi::Buffer<ffi::S64> ids,
+                                  ffi::Buffer<ffi::F32> grads,
+                                  ffi::Buffer<ffi::S64> step,
+                                  ffi::ResultBuffer<ffi::S64> rows) {
+  if (table == 0) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "kv_apply_adam: null table handle");
+  }
+  if (step.element_count() != 1) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "kv_apply_adam: step must be a scalar");
+  }
+  const int64_t n = ids.element_count();
+  if (n > 0 && grads.element_count() % n != 0) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "kv_apply_adam: grads size not a multiple of ids");
+  }
+  if (n > 0) {
+    kv_apply_adam(reinterpret_cast<void*>(table), ids.typed_data(),
+                  grads.typed_data(), n, lr, beta1, beta2, eps,
+                  step.typed_data()[0], l2, group_lasso);
+  }
+  rows->typed_data()[0] = kv_size(reinterpret_cast<void*>(table));
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    KvApplyAdam, KvApplyAdamImpl,
+    ffi::Ffi::Bind()
+        .Attr<int64_t>("table")
+        .Attr<float>("lr")
+        .Attr<float>("beta1")
+        .Attr<float>("beta2")
+        .Attr<float>("eps")
+        .Attr<float>("l2")
+        .Attr<float>("group_lasso")
+        .Arg<ffi::Buffer<ffi::S64>>()
+        .Arg<ffi::Buffer<ffi::F32>>()
+        .Arg<ffi::Buffer<ffi::S64>>()
+        .Ret<ffi::Buffer<ffi::S64>>());
+
+static ffi::Error KvApplyAdam32Impl(int64_t table, float lr, float beta1,
+                                    float beta2, float eps, float l2,
+                                    float group_lasso,
+                                    ffi::Buffer<ffi::S32> ids,
+                                    ffi::Buffer<ffi::F32> grads,
+                                    ffi::Buffer<ffi::S32> step,
+                                    ffi::ResultBuffer<ffi::S32> rows) {
+  if (table == 0) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "kv_apply_adam: null table handle");
+  }
+  if (step.element_count() != 1) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "kv_apply_adam: step must be a scalar");
+  }
+  const int64_t n = ids.element_count();
+  if (n > 0 && grads.element_count() % n != 0) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "kv_apply_adam: grads size not a multiple of ids");
+  }
+  if (n > 0) {
+    std::vector<int64_t> wide(ids.typed_data(), ids.typed_data() + n);
+    kv_apply_adam(reinterpret_cast<void*>(table), wide.data(),
+                  grads.typed_data(), n, lr, beta1, beta2, eps,
+                  step.typed_data()[0], l2, group_lasso);
+  }
+  rows->typed_data()[0] =
+      static_cast<int32_t>(kv_size(reinterpret_cast<void*>(table)));
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    KvApplyAdam32, KvApplyAdam32Impl,
+    ffi::Ffi::Bind()
+        .Attr<int64_t>("table")
+        .Attr<float>("lr")
+        .Attr<float>("beta1")
+        .Attr<float>("beta2")
+        .Attr<float>("eps")
+        .Attr<float>("l2")
+        .Attr<float>("group_lasso")
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Arg<ffi::Buffer<ffi::F32>>()
+        .Arg<ffi::Buffer<ffi::S32>>()
+        .Ret<ffi::Buffer<ffi::S32>>());

@@ -7,6 +7,15 @@ gives multi-device semantics without TPU hardware.
 
 import os
 
+# Per-xdist-worker resource scoping: /dev/shm segment names and the
+# default IPC dir both derive from DLROVER_TPU_SHM_PREFIX (read at
+# dlrover_tpu.common.constants import time — this assignment must come
+# first), so two workers' fixed node-id arenas (ckpt_node3 etc.) can
+# never collide. Serial runs are untouched.
+_xdist_worker = os.environ.get("PYTEST_XDIST_WORKER")
+if _xdist_worker:
+    os.environ["DLROVER_TPU_SHM_PREFIX"] = f"dlrover_tpu_{_xdist_worker}"
+
 # Force CPU even when the outer environment points at real hardware
 # (JAX_PLATFORMS=axon/tpu): tests must be hermetic and multi-device. A
 # sitecustomize may already have imported jax to register a TPU plugin, so
@@ -35,6 +44,26 @@ def pytest_configure(config):
         "longer than the given number of seconds (SIGALRM-based; main "
         "thread only, like the reference's pytest-timeout usage)",
     )
+
+
+# Modules that spawn the elastic example as subprocesses AND clean up
+# with broad `pkill -f <example>` patterns: under xdist those pkills
+# would kill a SIBLING worker's children, so they all pin to one
+# worker (xdist_group + --dist loadgroup in pytest.ini). Everything
+# else parallelizes freely — on this one-core host most suite time is
+# subprocess/poll WAITING, so two workers nearly halve the wall clock.
+_E2E_GROUP_FILES = {
+    "test_buddy.py", "test_e2e.py", "test_goodput.py",
+    "test_hang_detector.py", "test_multinode_e2e.py",
+    "test_node_relaunch_e2e.py", "test_preemption_e2e.py",
+    "test_soak.py",
+}
+
+
+def pytest_collection_modifyitems(items):
+    for item in items:
+        if os.path.basename(str(item.fspath)) in _E2E_GROUP_FILES:
+            item.add_marker(pytest.mark.xdist_group("elastic_e2e"))
 
 
 def _alarm_guard(item):

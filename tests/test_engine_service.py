@@ -21,6 +21,72 @@ def engine():
     service.stop()
 
 
+@pytest.mark.timeout(120)
+class TestPersistedPosterior:
+    """Cross-job, cross-restart measurement persistence (r04 verdict
+    missing #5 strategy-layer form + ask 6's 'persist the posterior'):
+    job B — or a restarted engine — warm-starts from what job A
+    reported, via the sqlite-backed observation store (the
+    Brain-datastore pattern, go/brain/pkg/datastore/)."""
+
+    def test_measurements_survive_service_restart(self, tmp_path):
+        db = str(tmp_path / "engine.db")
+        s1 = StrategyEngineService(db_path=db).start()
+        c1 = StrategyEngineClient(s1.addr)
+        try:
+            c1.report_measurement("tiny", 8, fsdp(), 0.031,
+                                  batch=8, seq=64)
+            c1.report_measurement(
+                "tiny", 8, Strategy(name="dp-x",
+                                    mesh_axes={"data": 8},
+                                    rules=[["batch", "data"]]),
+                0.052, batch=8, seq=64)
+        finally:
+            c1.close()
+            s1.stop()
+
+        # "job B": a fresh engine process against the same store
+        s2 = StrategyEngineService(db_path=db).start()
+        c2 = StrategyEngineClient(s2.addr)
+        try:
+            # measured-best survives: propose() serves job A's winner
+            # with no search at all
+            prop = c2.propose("tiny", 8, batch=8, seq=64)
+            assert prop.found and prop.source == "measured"
+            assert Strategy.from_json(prop.strategy_json).name == "fsdp"
+            assert prop.report["measured_step_time_s"] == 0.031
+            # the full observation set (surrogate warm-start material)
+            # survives too
+            obs = c2.get_observations("tiny", 8, batch=8, seq=64)
+            assert {Strategy.from_json(o["strategy_json"]).name
+                    for o in obs} == {"fsdp", "dp-x"}
+        finally:
+            c2.close()
+            s2.stop()
+
+    def test_rereport_updates_persisted_row(self, tmp_path):
+        db = str(tmp_path / "engine.db")
+        s1 = StrategyEngineService(db_path=db).start()
+        c1 = StrategyEngineClient(s1.addr)
+        try:
+            c1.report_measurement("tiny", 8, fsdp(), 0.05,
+                                  batch=8, seq=64)
+            c1.report_measurement("tiny", 8, fsdp(), 0.02,
+                                  batch=8, seq=64)
+        finally:
+            c1.close()
+            s1.stop()
+        s2 = StrategyEngineService(db_path=db).start()
+        c2 = StrategyEngineClient(s2.addr)
+        try:
+            obs = c2.get_observations("tiny", 8, batch=8, seq=64)
+            assert len(obs) == 1  # keyed by strategy, newest wins
+            assert obs[0]["step_time_s"] == 0.02
+        finally:
+            c2.close()
+            s2.stop()
+
+
 @pytest.mark.timeout(570)
 class TestEngineService:
     def test_propose_runs_search_and_caches(self, engine):
