@@ -26,6 +26,7 @@ import threading
 import time
 from enum import Enum
 
+from dlrover_tpu.common.accelerator import sniff_accelerator
 from dlrover_tpu.common.constants import (
     Defaults,
     EnvKey,
@@ -77,6 +78,12 @@ def _detect_local_devices() -> int:
     override = os.environ.get(EnvKey.DEVICE_COUNT_OVERRIDE)
     if override:
         return int(override)
+    # TPU chips must be counted from their kernel device nodes: importing
+    # jax here would initialize libtpu and steal the (exclusive-access)
+    # chips from the trainer child this agent is about to spawn
+    kind, count = sniff_accelerator()
+    if kind == "tpu":
+        return count
     try:
         import jax
 

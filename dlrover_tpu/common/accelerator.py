@@ -1,0 +1,69 @@
+"""Accelerator sniffing WITHOUT initializing JAX.
+
+Reference analog: ``ElasticLaunchConfig.auto_configure_params`` reads
+``torch.cuda.get_device_name()`` / ``device_count()`` in the launcher
+process (dlrover/python/elastic_agent/torch/training.py:143-157). On TPU
+that translation would be a bug: libtpu grants EXCLUSIVE chip access to
+the first process that initializes it, so a launcher or agent that calls
+``jax.local_device_count()`` steals the chips from the trainer child it
+is about to spawn. Instead we look at what the kernel already exposes:
+the TPU driver's ``/dev/accel*`` nodes (v2-v4 PCI hosts), falling back
+to a sysfs PCI scan for Google (vendor 0x1ae0) *processing accelerator*
+(class 0x1200xx) functions — the class check matters because gVNIC NICs
+share Google's vendor id, and on v5+ hosts the chips are VFIO-bound so
+``/dev`` alone cannot distinguish them from any other passthrough
+device.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+__all__ = ["sniff_accelerator"]
+
+_GOOGLE_PCI_VENDOR = "0x1ae0"
+_PCI_CLASS_PROCESSING_ACCEL = "0x1200"  # PCI class 0x12, subclass 0x00
+
+
+def _read(path: str) -> str:
+    try:
+        with open(path) as f:
+            return f.read().strip().lower()
+    except OSError:
+        return ""
+
+
+def sniff_accelerator(
+    dev_root: str = "/dev",
+    sys_pci_root: str = "/sys/bus/pci/devices",
+) -> tuple[str, int]:
+    """Return ``(kind, local_device_count)`` with ``kind`` one of
+    ``"tpu"`` / ``"cpu"``; never touches the accelerator.
+
+    ``dev_root`` / ``sys_pci_root`` are injectable for tests. CPU counts
+    as 1 device: the JAX CPU backend presents one device per process
+    unless ``xla_force_host_platform_device_count`` says otherwise,
+    which the caller controls.
+    """
+    # numbered nodes only, and never the bare /dev/accel DIRECTORY the
+    # generic Linux compute-accelerator subsystem creates (Intel NPU,
+    # Habana, ... hosts) — that one is not a TPU
+    accels = [
+        p
+        for p in glob.glob(os.path.join(dev_root, "accel[0-9]*"))
+        if not os.path.isdir(p)
+    ]
+    if accels:
+        return "tpu", len(accels)
+    tpus = 0
+    for dev in glob.glob(os.path.join(sys_pci_root, "*")):
+        if _read(os.path.join(dev, "vendor")) != _GOOGLE_PCI_VENDOR:
+            continue
+        if _read(os.path.join(dev, "class")).startswith(
+            _PCI_CLASS_PROCESSING_ACCEL
+        ):
+            tpus += 1
+    if tpus:
+        return "tpu", tpus
+    return "cpu", 1

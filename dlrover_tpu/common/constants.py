@@ -119,6 +119,12 @@ class EnvKey:
     MOCK_ERR_RANK = "DLROVER_TPU_MOCK_ERR_RANK"
     DEVICE_COUNT_OVERRIDE = "DLROVER_TPU_DEVICE_COUNT"
     COMPILE_CACHE_DIR = "DLROVER_TPU_COMPILE_CACHE"
+    # coordination-service join timeout (seconds) for
+    # jax.distributed.initialize — the launcher scales it with the node
+    # count (reference analog: auto_configure_params' comm timeouts,
+    # dlrover/python/elastic_agent/torch/training.py:143)
+    INIT_TIMEOUT = "DLROVER_TPU_INIT_TIMEOUT"
+    ACCELERATOR = "DLROVER_TPU_ACCELERATOR"
 
 
 class Defaults:

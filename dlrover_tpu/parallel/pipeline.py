@@ -77,9 +77,10 @@ def pipeline_apply(
     ``interleave=v > 1`` selects the interleaved (circular) schedule:
     each stage holds ``v`` layer chunks and microbatches traverse the
     ring ``v`` times (module docstring). Requires ``L % (P*v) == 0`` and
-    ``M == P`` — with M=P the ring slot a wrapping microbatch needs is
-    exactly the one stage 0 just vacated, so the schedule needs no
-    1F1B-style reordering.
+    ``M % P == 0``: microbatches flow in groups of P, and group g+1's
+    injection into stage 0 starts exactly one step after group g's last
+    stage-0 visit, so the ring never double-books a slot and no
+    1F1B-style reordering is needed (proof in ``_interleaved``).
     """
     leaves = jax.tree_util.tree_leaves(layer_params)
     n_layers = leaves[0].shape[0]
@@ -94,11 +95,11 @@ def pipeline_apply(
     B = x.shape[0]
     if B % M:
         raise ValueError(f"batch={B} not divisible by microbatches={M}")
-    if v > 1 and M != P:
+    if v > 1 and M % P:
         raise ValueError(
-            f"interleaved schedule needs microbatches == stages "
-            f"(got M={M}, P={P}): a wrapping microbatch re-enters stage "
-            f"0 at t=m+P, which is free only once injection ended at M-1"
+            f"interleaved schedule needs microbatches divisible by "
+            f"stages (got M={M}, P={P}): injection runs in groups of P "
+            f"so every wrap-around lands on a slot stage 0 just vacated"
         )
     pin = constrain or (lambda a, names: a)
     state_axes = ("stages", *logical_axes)
@@ -154,16 +155,25 @@ def _interleaved(layer_fn: LayerFn, layer_params: Any, x: jax.Array, *,
 
     Chunk assignment follows Megatron's interleaving: chunk c on stage s
     holds layers [(c*P + s) * lc, +lc) — a microbatch that leaves stage
-    P-1 wraps around to stage 0 with the next chunk. At time t, stage s
-    runs chunk (t - s) // M (clamped): microbatch m reaches stage s for
-    chunk c at exactly t = c*M + m + s, and with M == P the wrap-around
-    slot into stage 0 is always free (proof in pipeline_apply's error
-    message). Warm-up/drain steps compute garbage that is never
-    collected, so its cotangent is zero and AD yields the mirrored
-    backward schedule.
+    P-1 wraps around to stage 0 with the next chunk. Microbatches flow
+    in k = M/P groups of P injected back-to-back: microbatch m of group
+    g sits at stage s running chunk c at exactly
+
+        t = g*v*P + c*P + m + s.
+
+    Conflict-freedom: stage s's visit times decompose uniquely as
+    (g, c, m) in base (v, P), so no slot is ever double-booked; group
+    g's last stage-0 visit is t = g*v*P + (v-1)*P + (P-1) = (g+1)*v*P-1,
+    one step before group g+1's first injection. A microbatch finishing
+    chunk v-1 wraps into slot 0 at a chunk-0 boundary, where it is
+    either overwritten by the next group's injection or (after the last
+    group) left as garbage whose emission check fails. Warm-up/drain
+    steps compute garbage that is never collected, so its cotangent is
+    zero and AD yields the mirrored backward schedule.
     """
     lc = n_layers // (P * v)
     B = x.shape[0]
+    k = M // P
 
     # [L, ...] -> [v, P, lc, ...] -> [P, v, lc, ...]: leaf[s][c] is the
     # chunk-c layer block of stage s
@@ -191,23 +201,32 @@ def _interleaved(layer_fn: LayerFn, layer_params: Any, x: jax.Array, *,
 
     def step(carry, t):
         state, outs = carry
-        # stage 0: fresh microbatch while injecting (t < M), afterwards
-        # the wrapped chunk-handoff from stage P-1 (already in slot 0
-        # from the previous roll) stays
-        inject = lax.dynamic_index_in_dim(
-            x_mb, jnp.minimum(t, M - 1), 0, keepdims=False
-        )
-        slot0 = jnp.where(t < M, inject, state[0])
+        # stage 0 injects microbatch g*P + (t % P) at every chunk-0
+        # boundary (t // P ≡ 0 mod v) while groups remain; other steps
+        # keep the wrapped chunk-handoff from stage P-1 (already in
+        # slot 0 from the previous roll)
+        g_in = t // (v * P)
+        injecting = ((t // P) % v == 0) & (g_in < k)
+        mb_in = jnp.clip(g_in * P + t % P, 0, M - 1)
+        inject = lax.dynamic_index_in_dim(x_mb, mb_in, 0, keepdims=False)
+        slot0 = jnp.where(injecting, inject, state[0])
         state = lax.dynamic_update_index_in_dim(state, slot0, 0, 0)
         state = pin(state, state_axes)
-        chunk = jnp.clip((t - stage_idx) // M, 0, v - 1)
+        # stage s at time t runs chunk ((t - s) // P) mod v; warm-up
+        # (t < s) floor-divides negative but mod keeps it in range —
+        # garbage, never collected
+        chunk = ((t - stage_idx) // P) % v
         out = jax.vmap(stage_fn)(state, stage_ws, chunk)
-        # the final chunk's exit: microbatch m leaves stage P-1 with
-        # chunk v-1 at t = (v-1)*M + m + P - 1. Earlier chunks' exits
-        # (and warm-up garbage) clamp to slot 0 and are overwritten by
-        # the real slot-0 write, which is the LAST clamped one.
-        idx = jnp.clip(t - (P - 1) - (v - 1) * M, 0, M - 1)
-        outs = lax.dynamic_update_index_in_dim(outs, out[-1], idx, 0)
+        # stage P-1 emits microbatch (g, m) exactly when its chunk was
+        # v-1: w = t - (P-1) decomposes as g*v*P + c*P + m
+        w = t - (P - 1)
+        c_em = (w // P) % v
+        g_em = w // (v * P)
+        valid = (w >= 0) & (c_em == v - 1) & (g_em < k)
+        idx = jnp.clip(g_em * P + w % P, 0, M - 1)
+        cur = lax.dynamic_index_in_dim(outs, idx, 0, keepdims=False)
+        emit = jnp.where(valid, out[-1], cur)
+        outs = lax.dynamic_update_index_in_dim(outs, emit, idx, 0)
         state = jnp.roll(out, 1, axis=0)
         return (state, outs), None
 

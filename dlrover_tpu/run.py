@@ -61,6 +61,9 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
                    default=Defaults.HEARTBEAT_INTERVAL_S,
                    help="agent->master heartbeat (and master-action "
                         "delivery) cadence")
+    p.add_argument("--auto-config", action="store_true",
+                   help="derive devices/network-check/comm timeouts from "
+                        "the environment (reference: --auto-config)")
     p.add_argument("--network-check", action="store_true",
                    help="run a collective probe before training")
     p.add_argument("--exclude-straggler", action="store_true",
@@ -122,21 +125,61 @@ def launch_local_master(args, min_nodes: int, max_nodes: int
     raise TimeoutError("standalone master did not report its port in 30s")
 
 
-def auto_configure(args) -> None:
-    """Fill node identity/count from the environment when the CLI left
-    them at defaults.
+def auto_configure(
+    args,
+    dev_root: str = "/dev",
+    sys_pci_root: str = "/sys/bus/pci/devices",
+) -> None:
+    """Fill node identity/count/devices/timeouts from the environment
+    when the CLI left them at defaults.
 
     Reference analog: ElasticLaunchConfig.auto_configure_params
-    (dlrover/python/elastic_agent/torch/training.py:143) — torchrun-style
-    env-driven configuration so a pod template needs no per-node CLI
-    edits: the scaler/operator injects DLROVER_TPU_NODE_NUM and
+    (dlrover/python/elastic_agent/torch/training.py:143-157) — torchrun-
+    style env-driven configuration so a pod template needs no per-node
+    CLI edits: the scaler/operator injects DLROVER_TPU_NODE_NUM and
     DLROVER_TPU_NODE_ID and every replica runs the same command line.
+    The node-count promotion always applies; the rest is gated on
+    ``--auto-config`` exactly as the reference gates on
+    ``self.auto_config``. The derivations, TPU-shaped:
+
+    - node count from env (reference :152);
+    - local device count — the nproc-per-node analog (:155) — sniffed
+      from kernel device nodes and exported for the agent and the
+      network-check payload, WITHOUT initializing JAX (libtpu is
+      exclusive-access; see common/accelerator.py);
+    - accelerator kind exported (:146's get_device_name branch);
+    - auto network-check at >=4 nodes (:157), plus the comm-timeout
+      derivation: the coordination-service join timeout scales with the
+      fleet size (a 512-host restart storm cannot all join in the
+      300 s jax default).
     """
     env_nnodes = os.environ.get(EnvKey.NODE_NUM, "")
     if args.nnodes == "1" and env_nnodes:
         args.nnodes = env_nnodes
         logger.info("auto-config: nnodes=%s from %s", env_nnodes,
                     EnvKey.NODE_NUM)
+    if not args.auto_config:
+        return
+
+    from dlrover_tpu.common.accelerator import sniff_accelerator
+
+    kind, count = sniff_accelerator(dev_root, sys_pci_root)
+    os.environ.setdefault(EnvKey.ACCELERATOR, kind)
+    if kind == "tpu":
+        # the agent reads this instead of importing jax (which would
+        # steal the chips from the trainer it spawns)
+        os.environ.setdefault(EnvKey.DEVICE_COUNT_OVERRIDE, str(count))
+        logger.info("auto-config: %d local tpu device(s)", count)
+
+    _, max_nodes = parse_nnodes(args.nnodes)
+    if max_nodes >= 4 and not args.network_check:
+        args.network_check = True
+        logger.info("auto-config: network check on (%d nodes >= 4)",
+                    max_nodes)
+    if EnvKey.INIT_TIMEOUT not in os.environ:
+        # 300 s jax default, +1 s/node headroom past 64 hosts
+        timeout = max(300, 300 + (max_nodes - 64))
+        os.environ[EnvKey.INIT_TIMEOUT] = str(timeout)
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -166,9 +166,16 @@ def init_from_env(initialize_distributed: bool = True) -> RunContext:
             "joining jax cluster: rank %d/%d coordinator %s (restart %d)",
             ctx.node_rank, ctx.num_nodes, ctx.coordinator, ctx.restart_count,
         )
+        init_kwargs = {}
+        init_timeout = os.environ.get(EnvKey.INIT_TIMEOUT, "")
+        if init_timeout:
+            # launcher-scaled join timeout (run.py auto_configure): a
+            # large fleet's restart storm outlives the 300 s default
+            init_kwargs["initialization_timeout"] = int(float(init_timeout))
         jax.distributed.initialize(
             coordinator_address=ctx.coordinator,
             num_processes=ctx.num_nodes,
             process_id=ctx.node_rank,
+            **init_kwargs,
         )
     return ctx

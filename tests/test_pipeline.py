@@ -178,40 +178,45 @@ class TestInterleavedSchedule:
         params = T.init_params(CFG, jax.random.PRNGKey(0))
         tokens = _batch(jax.random.PRNGKey(1))["tokens"][:, :-1]
         ref = T.forward(params, tokens, CFG)
-        for stages, il in [(2, 2), (4, 1)]:
+        # M > P cases exercise the grouped-injection generalization
+        # (microbatches flow in M/P groups of P through the ring)
+        for stages, mb, il in [(2, 2, 2), (4, 4, 1), (2, 4, 2),
+                               (2, 8, 2)]:
             cfg_pp = dataclasses.replace(
                 CFG, pipeline_stages=stages,
-                pipeline_microbatches=stages, pipeline_interleave=il,
+                pipeline_microbatches=mb, pipeline_interleave=il,
             )
             got = T.forward(params, tokens, cfg_pp)
             np.testing.assert_allclose(
                 np.asarray(ref), np.asarray(got), rtol=2e-5, atol=2e-5,
-                err_msg=f"stages={stages} interleave={il}",
+                err_msg=f"stages={stages} mb={mb} interleave={il}",
             )
 
     def test_grads_match_scan(self):
         params = T.init_params(CFG, jax.random.PRNGKey(0))
         batch = _batch(jax.random.PRNGKey(1))
-        cfg_pp = dataclasses.replace(
-            CFG, pipeline_stages=2, pipeline_microbatches=2,
-            pipeline_interleave=2,
-        )
         ref = jax.grad(lambda p: T.loss_fn(p, batch, CFG))(params)
-        got = jax.grad(lambda p: T.loss_fn(p, batch, cfg_pp))(params)
-        jax.tree.map(
-            lambda a, b: np.testing.assert_allclose(
-                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
-            ),
-            ref, got,
-        )
+        for mb in (2, 4):  # M == P and the grouped M = 2P schedule
+            cfg_pp = dataclasses.replace(
+                CFG, pipeline_stages=2, pipeline_microbatches=mb,
+                pipeline_interleave=2,
+            )
+            got = jax.grad(lambda p: T.loss_fn(p, batch, cfg_pp))(params)
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+                ),
+                ref, got,
+            )
 
     def test_microbatch_constraint(self):
         from dlrover_tpu.parallel.pipeline import pipeline_apply
 
-        with pytest.raises(ValueError, match="microbatches == stages"):
+        with pytest.raises(ValueError,
+                           match="microbatches divisible by stages"):
             pipeline_apply(
                 lambda h, w: h, jnp.zeros((8, 3)),
-                jnp.zeros((8, 4)), num_stages=2, num_microbatches=4,
+                jnp.zeros((6, 4)), num_stages=2, num_microbatches=3,
                 interleave=2,
             )
 
@@ -224,6 +229,52 @@ class TestInterleavedSchedule:
                 jnp.zeros((4, 4)), num_stages=2, num_microbatches=2,
                 interleave=4,
             )
+
+    def test_schedule_parity_matrix(self):
+        """Raw pipeline_apply vs plain layer chain across the full
+        grouped-injection shape matrix (P, v, M/P groups) — tiny
+        matmul layers so the whole matrix costs seconds."""
+        from dlrover_tpu.parallel.pipeline import pipeline_apply
+
+        L, D = 16, 4
+        ws = jax.random.normal(jax.random.PRNGKey(0), (L, D, D)) * 0.5
+
+        def layer(h, w):
+            return jnp.tanh(h @ w)
+
+        def chain(w_, h):
+            out, _ = jax.lax.scan(lambda c, w: (layer(c, w), None), h, w_)
+            return out
+
+        for P in (2, 4):
+            for v in (1, 2, 4):
+                if L % (P * v):
+                    continue
+                for k in (1, 2, 3, 4):
+                    M = k * P
+                    x = jax.random.normal(jax.random.PRNGKey(1), (M, D))
+                    ref = chain(ws, x)
+                    got = pipeline_apply(
+                        layer, ws, x, num_stages=P,
+                        num_microbatches=M, interleave=v,
+                    )
+                    np.testing.assert_allclose(
+                        np.asarray(ref), np.asarray(got),
+                        rtol=1e-5, atol=1e-5,
+                        err_msg=f"P={P} v={v} M={M}",
+                    )
+                    gr = jax.grad(lambda w_: chain(w_, x).sum())(ws)
+                    gg = jax.grad(
+                        lambda w_: pipeline_apply(
+                            layer, w_, x, num_stages=P,
+                            num_microbatches=M, interleave=v,
+                        ).sum()
+                    )(ws)
+                    np.testing.assert_allclose(
+                        np.asarray(gr), np.asarray(gg),
+                        rtol=1e-4, atol=1e-5,
+                        err_msg=f"grad P={P} v={v} M={M}",
+                    )
 
     def test_bubble_fraction_shrinks(self):
         from dlrover_tpu.parallel.pipeline import bubble_fraction

@@ -1,0 +1,119 @@
+"""Launcher auto-configuration (run.py) and accelerator sniffing.
+
+Reference analog: ElasticLaunchConfig.auto_configure_params
+(dlrover/python/elastic_agent/torch/training.py:143-157) — node count
+from env, device count as the nproc-per-node analog, auto network check
+at >=4 nodes. TPU twist under test: the device count must come from
+kernel device nodes, never from initializing JAX in the launcher/agent
+process (libtpu is exclusive-access).
+"""
+
+import os
+
+import pytest
+
+from dlrover_tpu.common.accelerator import sniff_accelerator
+from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.run import auto_configure, parse_args
+
+
+def _args(*argv):
+    return parse_args([*argv, "train.py"])
+
+
+_KEYS = (EnvKey.NODE_NUM, EnvKey.ACCELERATOR,
+         EnvKey.DEVICE_COUNT_OVERRIDE, EnvKey.INIT_TIMEOUT)
+
+
+@pytest.fixture
+def clean_env(monkeypatch):
+    for key in _KEYS:
+        monkeypatch.delenv(key, raising=False)
+    yield monkeypatch
+    # auto_configure writes os.environ directly; monkeypatch only
+    # restores keys that existed before, so scrub the rest explicitly
+    for key in _KEYS:
+        os.environ.pop(key, None)
+
+
+def _pci_dev(root, addr, vendor, pci_class):
+    d = root / addr
+    d.mkdir(parents=True)
+    (d / "vendor").write_text(vendor + "\n")
+    (d / "class").write_text(pci_class + "\n")
+
+
+class TestSniffAccelerator:
+    def test_accel_nodes_counted(self, tmp_path):
+        for i in range(4):
+            (tmp_path / f"accel{i}").touch()
+        assert sniff_accelerator(str(tmp_path), str(tmp_path / "pci")) \
+            == ("tpu", 4)
+
+    def test_sysfs_google_accelerators_counted(self, tmp_path):
+        pci = tmp_path / "pci"
+        _pci_dev(pci, "0000:00:01.0", "0x1ae0", "0x120000")
+        _pci_dev(pci, "0000:00:02.0", "0x1ae0", "0x120000")
+        # gVNIC shares Google's vendor id but is class 0x0200 (NIC):
+        # it must NOT count as a chip
+        _pci_dev(pci, "0000:00:03.0", "0x1ae0", "0x020000")
+        # someone else's VFIO-bound accelerator must not count either
+        _pci_dev(pci, "0000:00:04.0", "0x10de", "0x120000")
+        assert sniff_accelerator(str(tmp_path), str(pci)) == ("tpu", 2)
+
+    def test_bare_host_is_cpu(self, tmp_path):
+        pci = tmp_path / "pci"
+        _pci_dev(pci, "0000:00:03.0", "0x1ae0", "0x020000")  # gVNIC only
+        assert sniff_accelerator(str(tmp_path), str(pci)) == ("cpu", 1)
+
+
+class TestAutoConfigure:
+    def test_nnodes_promoted_from_env(self, clean_env, tmp_path):
+        clean_env.setenv(EnvKey.NODE_NUM, "4:8")
+        args = _args()
+        auto_configure(args, dev_root=str(tmp_path), sys_pci_root=str(tmp_path / 'pci'))
+        assert args.nnodes == "4:8"
+
+    def test_cli_nnodes_wins_over_env(self, clean_env, tmp_path):
+        clean_env.setenv(EnvKey.NODE_NUM, "8")
+        args = _args("--nnodes", "2")
+        auto_configure(args, dev_root=str(tmp_path), sys_pci_root=str(tmp_path / 'pci'))
+        assert args.nnodes == "2"
+
+    def test_device_count_exported_without_jax(self, clean_env, tmp_path):
+        (tmp_path / "accel0").touch()
+        (tmp_path / "accel1").touch()
+        args = _args("--auto-config")
+        auto_configure(args, dev_root=str(tmp_path), sys_pci_root=str(tmp_path / 'pci'))
+        assert os.environ[EnvKey.DEVICE_COUNT_OVERRIDE] == "2"
+        assert os.environ[EnvKey.ACCELERATOR] == "tpu"
+
+    def test_explicit_device_override_kept(self, clean_env, tmp_path):
+        (tmp_path / "accel0").touch()
+        clean_env.setenv(EnvKey.DEVICE_COUNT_OVERRIDE, "7")
+        args = _args("--auto-config")
+        auto_configure(args, dev_root=str(tmp_path), sys_pci_root=str(tmp_path / 'pci'))
+        assert os.environ[EnvKey.DEVICE_COUNT_OVERRIDE] == "7"
+
+    def test_network_check_auto_on_at_4_nodes(self, clean_env, tmp_path):
+        args = _args("--auto-config", "--nnodes", "4")
+        auto_configure(args, dev_root=str(tmp_path), sys_pci_root=str(tmp_path / 'pci'))
+        assert args.network_check
+
+    def test_network_check_stays_off_small(self, clean_env, tmp_path):
+        args = _args("--auto-config", "--nnodes", "2")
+        auto_configure(args, dev_root=str(tmp_path), sys_pci_root=str(tmp_path / 'pci'))
+        assert not args.network_check
+
+    def test_init_timeout_scales_with_fleet(self, clean_env, tmp_path):
+        args = _args("--auto-config", "--nnodes", "512")
+        auto_configure(args, dev_root=str(tmp_path), sys_pci_root=str(tmp_path / 'pci'))
+        assert int(os.environ[EnvKey.INIT_TIMEOUT]) == 300 + (512 - 64)
+
+    def test_gated_off_without_flag(self, clean_env, tmp_path):
+        (tmp_path / "accel0").touch()
+        args = _args("--nnodes", "8")
+        auto_configure(args, dev_root=str(tmp_path), sys_pci_root=str(tmp_path / 'pci'))
+        assert EnvKey.DEVICE_COUNT_OVERRIDE not in os.environ
+        assert not args.network_check
+        assert EnvKey.INIT_TIMEOUT not in os.environ
