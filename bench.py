@@ -6,10 +6,12 @@ line on stdout is always a valid result (round-3 lesson: one overrunning
 stage + single end-of-run print produced rc=124 / parsed=null and lost all
 validated numbers).
 
-Budget model: BENCH_BUDGET_S (default 1800 s) is a HARD envelope: a stage
-only starts when the remaining budget covers its full per-stage deadline,
-so the run can never overshoot (r04: the est-based gate let one stage
-overrun by 200 s and the driver's kill timer fired). A SIGALRM per-stage
+Budget model: BENCH_BUDGET_S (default 1740 s) is a HARD envelope: a stage
+only starts when the remaining budget covers its gate (the full per-stage
+deadline, or min_deadline_s for the adaptive tail stages whose window
+scales with the budget they are given), and its SIGALRM never exceeds the
+remaining budget, so the run can never overshoot (r04: the est-based gate
+let one stage overrun by 200 s and the driver's kill timer fired). A SIGALRM per-stage
 deadline stops a wedged stage without killing the run; after every stage
 the cumulative line AND a compact headline-only line are re-printed
 (single atomic os.write), so any tail byte-window capture ends with a
@@ -496,9 +498,9 @@ def bench_checkpoint(extra: dict, gb: float | None = None,
         extra[f"{prefix}disk_write_gbps"] = round(disk_bw, 3)
         # the 128 MB probe overestimates sustained /tmp bandwidth ~8x
         # (page-cache burst vs the 0.06 GB/s a 4 GB persist measured),
-        # so the hard 2 GB ceiling, not the probe, is the real cap
+        # so the hard 1.5 GB ceiling, not the probe, is the real cap
         cap_s = float(os.environ.get("BENCH_PERSIST_CAP_S", "25"))
-        persist_gb = min(state_gb, max(0.5, disk_bw * cap_s * 0.9), 2.0)
+        persist_gb = min(state_gb, max(0.5, disk_bw * cap_s * 0.9), 1.5)
         if persist_gb >= state_gb * 0.95:
             p_engine, p_state, p_gb = engine, state, state_gb
             p_step = step
@@ -1030,7 +1032,10 @@ def bench_serving(extra: dict) -> None:
         # warmup wave compiles prefill/install/step programs
         eng.submit(list(rng.integers(0, cfg.vocab_size, 16)), sp)
         eng.run()
-        for _ in range(16):
+        # block=1 pays the tunnel RTT per token, so its wave is half
+        # the headline's — the tok/s RATE is unchanged, the stage just
+        # stops spending ~35 s of envelope re-measuring a known tax
+        for _ in range(16 if block > 1 else 8):
             eng.submit(list(rng.integers(0, cfg.vocab_size, 64)), sp)
         t0 = time.monotonic()
         results = eng.run()
@@ -1204,35 +1209,45 @@ def bench_7b_aot(extra: dict, stage_budget_s: float = 600.0) -> None:
 class Stage:
     name: str
     fn: object          # callable(extra) or callable(extra, stage_budget_s)
-    est_s: float        # expected cost: stage is skipped if the remaining
-                        # envelope is below this
+    est_s: float        # expected cost (r05 rehearsal actuals; informational)
     deadline_s: float   # SIGALRM ceiling for the stage
     pass_budget: bool = False  # fn accepts stage_budget_s kwarg
+    # stages that can do useful bounded work with LESS than their full
+    # deadline (their measurement window scales with stage_budget_s) set
+    # this lower gate: the stage starts whenever the remaining envelope
+    # covers min_deadline_s, and its SIGALRM becomes min(deadline_s,
+    # remaining) — the hard-envelope invariant (alarm <= remaining)
+    # holds either way. 0 means the gate is the full deadline.
+    min_deadline_s: float = 0.0
 
 
 STAGES = [
     # headline stages first: by minute ~10 every number the round is
     # judged on has been emitted at least once. A stage only STARTS when
-    # the remaining envelope covers its full DEADLINE (r04 lesson: the
-    # est-based gate let ckpt1b legally overrun the envelope by 200 s),
-    # so the run can never exceed BENCH_BUDGET_S. Estimates track the
-    # r04 rehearsal actuals on this host; deadlines are ~1.5-2.5x est.
-    Stage("ckpt", bench_checkpoint, est_s=40, deadline_s=150),
-    Stage("ckpt1b", bench_checkpoint_1b, est_s=150, deadline_s=400),
-    Stage("goodput", bench_goodput, est_s=260, deadline_s=420,
+    # the remaining envelope covers its gate (r04 lesson: the est-based
+    # gate let ckpt1b legally overrun the envelope by 200 s), so the run
+    # can never exceed BENCH_BUDGET_S. Estimates track the r05
+    # rehearsal actuals on this host (1473.7 s total, rc=0).
+    Stage("ckpt", bench_checkpoint, est_s=45, deadline_s=150),
+    Stage("ckpt1b", bench_checkpoint_1b, est_s=350, deadline_s=400),
+    Stage("goodput", bench_goodput, est_s=290, deadline_s=420,
           pass_budget=True),
-    Stage("mfu", bench_train_step, est_s=250, deadline_s=520),
-    Stage("serving", bench_serving, est_s=140, deadline_s=300),
-    Stage("soak", bench_soak, est_s=80, deadline_s=160,
+    Stage("mfu", bench_train_step, est_s=170, deadline_s=520),
+    Stage("serving", bench_serving, est_s=105, deadline_s=300),
+    Stage("soak", bench_soak, est_s=105, deadline_s=160,
           pass_budget=True),
-    Stage("int8", bench_int8, est_s=280, deadline_s=450),
-    Stage("goodput_lowrate", bench_goodput_lowrate, est_s=500,
-          deadline_s=600, pass_budget=True),
-    Stage("aot7b", bench_7b_aot, est_s=20, deadline_s=120,
+    Stage("int8", bench_int8, est_s=275, deadline_s=450),
+    Stage("aot7b", bench_7b_aot, est_s=15, deadline_s=120,
           pass_budget=True),
-    Stage("long_context", bench_long_context, est_s=150, deadline_s=300),
+    Stage("long_context", bench_long_context, est_s=80, deadline_s=300),
+    # adaptive tail: lowrate sizes its measured window to whatever
+    # envelope remains (>=260 s buys a ~160 s window at safety 1.25 on
+    # top of the reused calibration), so it converts leftover budget
+    # into driver-captured raw-goodput evidence instead of a skip
+    Stage("goodput_lowrate", bench_goodput_lowrate, est_s=420,
+          deadline_s=600, pass_budget=True, min_deadline_s=260),
     Stage("goodput_tpu", bench_goodput_tpu, est_s=250, deadline_s=420,
-          pass_budget=True),
+          pass_budget=True, min_deadline_s=320),
 ]
 
 # the compact tail line: every number the round is judged on, small
@@ -1279,7 +1294,10 @@ def _headline_line(extra: dict, errors: list[str]) -> str:
 def main() -> int:
     extra: dict = {}
     errors: list[str] = []
-    budget = float(os.environ.get("BENCH_BUDGET_S", "1800"))
+    # 1740 not 1800: the envelope must also absorb interpreter + jax
+    # startup (~25 s) under a driver kill timer that may be exactly 30
+    # minutes of WALL clock, not of bench time
+    budget = float(os.environ.get("BENCH_BUDGET_S", "1740"))
     t_start = time.monotonic()
     extra["bench_budget_s"] = budget
     stage_times: dict = {}
@@ -1316,11 +1334,12 @@ def main() -> int:
 
     for st in STAGES:
         left = budget - (time.monotonic() - t_start)
-        if left < st.deadline_s:
+        gate = st.min_deadline_s or st.deadline_s
+        if left < gate:
             stage_times[st.name] = f"skipped ({left:.0f}s left < " \
-                                   f"deadline {st.deadline_s:.0f}s)"
+                                   f"gate {gate:.0f}s)"
             continue
-        alarm_s = int(st.deadline_s)
+        alarm_s = int(min(st.deadline_s, left))
         t0 = time.monotonic()
         signal.alarm(alarm_s)
         try:

@@ -13,6 +13,10 @@ to a sysfs PCI scan for Google (vendor 0x1ae0) *processing accelerator*
 share Google's vendor id, and on v5+ hosts the chips are VFIO-bound so
 ``/dev`` alone cannot distinguish them from any other passthrough
 device.
+
+The returned count uses JAX *device* semantics, not chip semantics:
+v2/v3 chips carry two TensorCores each (two JAX devices per chip,
+recognized by their PCI device ids), while v4+ run megacore (one).
 """
 
 from __future__ import annotations
@@ -24,6 +28,9 @@ __all__ = ["sniff_accelerator"]
 
 _GOOGLE_PCI_VENDOR = "0x1ae0"
 _PCI_CLASS_PROCESSING_ACCEL = "0x1200"  # PCI class 0x12, subclass 0x00
+# PCI device id -> JAX devices (TensorCores) per chip. v2/v3 expose two
+# cores per chip; v4+ (megacore) and the v5/v6 families expose one.
+_CORES_PER_CHIP = {"0x0027": 2, "0x0037": 2}
 
 
 def _read(path: str) -> str:
@@ -34,17 +41,23 @@ def _read(path: str) -> str:
         return ""
 
 
+def _chip_devices(pci_dir: str) -> int:
+    """JAX devices contributed by the chip behind one PCI function."""
+    return _CORES_PER_CHIP.get(_read(os.path.join(pci_dir, "device")), 1)
+
+
 def sniff_accelerator(
     dev_root: str = "/dev",
     sys_pci_root: str = "/sys/bus/pci/devices",
+    sys_accel_root: str = "/sys/class/accel",
 ) -> tuple[str, int]:
     """Return ``(kind, local_device_count)`` with ``kind`` one of
     ``"tpu"`` / ``"cpu"``; never touches the accelerator.
 
-    ``dev_root`` / ``sys_pci_root`` are injectable for tests. CPU counts
-    as 1 device: the JAX CPU backend presents one device per process
-    unless ``xla_force_host_platform_device_count`` says otherwise,
-    which the caller controls.
+    The roots are injectable for tests. CPU counts as 1 device: the
+    JAX CPU backend presents one device per process unless
+    ``xla_force_host_platform_device_count`` says otherwise, which the
+    caller controls.
     """
     # numbered nodes only, and never the bare /dev/accel DIRECTORY the
     # generic Linux compute-accelerator subsystem creates (Intel NPU,
@@ -55,15 +68,23 @@ def sniff_accelerator(
         if not os.path.isdir(p)
     ]
     if accels:
-        return "tpu", len(accels)
-    tpus = 0
+        total = 0
+        for node in accels:
+            # /sys/class/accel/accelN/device is a symlink to the PCI
+            # function; unreadable (older driver layouts) -> megacore
+            pci_dir = os.path.join(
+                sys_accel_root, os.path.basename(node), "device"
+            )
+            total += _chip_devices(pci_dir)
+        return "tpu", total
+    total = 0
     for dev in glob.glob(os.path.join(sys_pci_root, "*")):
         if _read(os.path.join(dev, "vendor")) != _GOOGLE_PCI_VENDOR:
             continue
         if _read(os.path.join(dev, "class")).startswith(
             _PCI_CLASS_PROCESSING_ACCEL
         ):
-            tpus += 1
-    if tpus:
-        return "tpu", tpus
+            total += _chip_devices(dev)
+    if total:
+        return "tpu", total
     return "cpu", 1

@@ -168,8 +168,15 @@ def auto_configure(
     if kind == "tpu":
         # the agent reads this instead of importing jax (which would
         # steal the chips from the trainer it spawns)
-        os.environ.setdefault(EnvKey.DEVICE_COUNT_OVERRIDE, str(count))
-        logger.info("auto-config: %d local tpu device(s)", count)
+        if EnvKey.DEVICE_COUNT_OVERRIDE not in os.environ:
+            os.environ[EnvKey.DEVICE_COUNT_OVERRIDE] = str(count)
+            logger.info("auto-config: %d local tpu device(s)", count)
+        else:
+            logger.info(
+                "auto-config: keeping %s=%s (sniffed %d)",
+                EnvKey.DEVICE_COUNT_OVERRIDE,
+                os.environ[EnvKey.DEVICE_COUNT_OVERRIDE], count,
+            )
 
     _, max_nodes = parse_nnodes(args.nnodes)
     if max_nodes >= 4 and not args.network_check:

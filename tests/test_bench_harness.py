@@ -96,6 +96,26 @@ class TestBudgetEnvelope:
         parsed = json.loads(last)  # tail line is always parseable
         assert "headline" in parsed
 
+    def test_adaptive_stage_starts_on_min_gate_with_clamped_alarm(
+            self, monkeypatch):
+        """A min_deadline_s stage starts when the envelope covers only
+        its lower gate, and its SIGALRM is clamped to the remaining
+        budget (the hard-envelope invariant), not the full deadline."""
+        seen = {}
+
+        def adaptive(extra, stage_budget_s=0.0):
+            seen["budget"] = stage_budget_s
+
+        stages = [
+            bench.Stage("adaptive", adaptive, est_s=1, deadline_s=10_000,
+                        pass_budget=True, min_deadline_s=5),
+        ]
+        rc, out = self._run_main(monkeypatch, budget=60, stages=stages)
+        assert rc == 0
+        # alarm = min(deadline, left): must be ~the 60 s budget, never
+        # the 10_000 s deadline
+        assert 5 <= seen["budget"] <= 60
+
     def test_stage_exception_keeps_run_alive_and_recorded(
             self, monkeypatch):
         def boom(extra):

@@ -61,6 +61,31 @@ class TestSniffAccelerator:
         _pci_dev(pci, "0000:00:04.0", "0x10de", "0x120000")
         assert sniff_accelerator(str(tmp_path), str(pci)) == ("tpu", 2)
 
+    def test_v3_chips_count_two_cores_each(self, tmp_path):
+        """TPU v2/v3 chips (PCI ids 0x0027/0x0037) carry two
+        TensorCores — the count must use JAX-device semantics (4 chips
+        -> 8 devices on a v3-8 host), matching jax.local_device_count."""
+        accel_cls = tmp_path / "accel_class"
+        for i in range(4):
+            (tmp_path / f"accel{i}").touch()
+            d = accel_cls / f"accel{i}" / "device"
+            d.mkdir(parents=True)
+            (d / "device").write_text("0x0037\n")
+        assert sniff_accelerator(
+            str(tmp_path), str(tmp_path / "pci"), str(accel_cls)
+        ) == ("tpu", 8)
+
+    def test_v4_chips_count_one_device_each(self, tmp_path):
+        accel_cls = tmp_path / "accel_class"
+        for i in range(4):
+            (tmp_path / f"accel{i}").touch()
+            d = accel_cls / f"accel{i}" / "device"
+            d.mkdir(parents=True)
+            (d / "device").write_text("0x005e\n")
+        assert sniff_accelerator(
+            str(tmp_path), str(tmp_path / "pci"), str(accel_cls)
+        ) == ("tpu", 4)
+
     def test_bare_host_is_cpu(self, tmp_path):
         pci = tmp_path / "pci"
         _pci_dev(pci, "0000:00:03.0", "0x1ae0", "0x020000")  # gVNIC only
