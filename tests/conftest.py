@@ -47,11 +47,12 @@ def pytest_configure(config):
 
 
 # Modules that spawn the elastic example as subprocesses AND clean up
-# with broad `pkill -f <example>` patterns: under xdist those pkills
-# would kill a SIBLING worker's children, so they all pin to one
-# worker (xdist_group + --dist loadgroup in pytest.ini). Everything
-# else parallelizes freely — on this one-core host most suite time is
-# subprocess/poll WAITING, so two workers nearly halve the wall clock.
+# with broad `pkill -f <example>` patterns: under OPT-IN xdist
+# (`-n 2 --dist loadgroup`; serial is the default — see pytest.ini)
+# those pkills would kill a SIBLING worker's children, so they all pin
+# to one worker via xdist_group. Measured r5: two workers on this
+# one-core host save only ~10% wall clock (jax compiles are CPU-bound)
+# and the sibling's compiles can starve these very e2e jobs.
 _E2E_GROUP_FILES = {
     "test_buddy.py", "test_e2e.py", "test_goodput.py",
     "test_hang_detector.py", "test_multinode_e2e.py",

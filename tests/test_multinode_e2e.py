@@ -135,8 +135,10 @@ def _elastic_launcher(env, addr, tmp_path, nid: int,
         # NB: the agent's --rdzv-timeout is how long it WAITS for a
         # round; the master's --rdzv-timeout is when a round COMPLETES
         # with fewer than max nodes. Setting them equal makes the
-        # client deadline race the completion.
-        "--heartbeat-interval", "2", "--rdzv-timeout", "90",
+        # client deadline race the completion. 150 (not 90): a sibling
+        # xdist worker's jax compiles can starve every child here for
+        # tens of seconds on a one-core host.
+        "--heartbeat-interval", "2", "--rdzv-timeout", "150",
         EXAMPLE, "--",
         "--model", "tiny", "--seq", "128",
         "--global-batch", "24",
@@ -199,7 +201,10 @@ def test_three_nodes_shrink_to_two_on_node_loss(tmp_path):
     env = _env(tmp_path)
     master, addr = _start_master(
         tmp_path, env, min_nodes=2, max_nodes=3,
-        extra=["--rdzv-timeout", "8", "--dead-window", "6"],
+        # short enough for a timely dead-node verdict, long enough that
+        # a starved-but-live node's heartbeat (interval 2) can't miss
+        # the window under a contended core
+        extra=["--rdzv-timeout", "10", "--dead-window", "9"],
     )
 
     launchers = {
@@ -238,7 +243,7 @@ def test_two_nodes_grow_to_three_on_join(tmp_path):
     env = _env(tmp_path)
     master, addr = _start_master(
         tmp_path, env, min_nodes=2, max_nodes=3,
-        extra=["--rdzv-timeout", "6"],
+        extra=["--rdzv-timeout", "8"],
     )
 
     launchers = {

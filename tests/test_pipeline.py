@@ -263,6 +263,11 @@ class TestInterleavedSchedule:
                         rtol=1e-5, atol=1e-5,
                         err_msg=f"P={P} v={v} M={M}",
                     )
+                    # grads double the compile bill, and AD mirrors the
+                    # schedule mechanically — k in {1, 2} (the M == P
+                    # legacy case + one grouped case per (P, v)) pins it
+                    if k > 2:
+                        continue
                     gr = jax.grad(lambda w_: chain(w_, x).sum())(ws)
                     gg = jax.grad(
                         lambda w_: pipeline_apply(
