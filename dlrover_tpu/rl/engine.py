@@ -188,6 +188,7 @@ class ShardedPPOTrainer(PPOTrainer):
     def enable_serving_rollouts(self, *, slots: int = 8,
                                 decode_block: int = 8,
                                 max_len: int = 0,
+                                prefix_cache_entries: int = 8,
                                 seed: int = 0) -> None:
         """Route rollout generation through the continuous-batching
         serving engine (serving/engine.py) instead of the in-mesh decode.
@@ -206,9 +207,15 @@ class ShardedPPOTrainer(PPOTrainer):
         from dlrover_tpu.serving import InferenceEngine
 
         max_len = max_len or self.cfg.max_seq_len
+        # prefix caching pays for itself exactly in the rollout shape
+        # (every prompt in a PPO batch shares the task's system
+        # prefix); the per-iteration weight push invalidates it, which
+        # is also why entries stay modest — reuse only lives within
+        # one iteration's rollout wave
         self._serving = InferenceEngine(
             self.params["model"], self.cfg, slots=slots,
             max_len=max_len, decode_block=decode_block,
+            prefix_cache_entries=prefix_cache_entries,
         )
         del seed  # kept for API stability; seeds derive from the key
 

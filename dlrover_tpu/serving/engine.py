@@ -22,6 +22,13 @@ per-row-position KV cache (models/decode.py forward_cached with vector
 
 Static shapes everywhere: slot count, cache length and prefill length
 are engine constants, so serving never recompiles after warmup.
+
+``prefix_cache_entries > 0`` adds the vLLM automatic-prefix-caching
+analog: prefilled KV rows are cached at chunk-aligned prompt prefixes
+(LRU), and a new prompt resumes prefill from its longest cached aligned
+prefix — shared system prompts (the RLHF rollout shape) skip nearly the
+whole prefill. A hit changes which chunks run, never a program shape,
+and a weight push invalidates the cache wholesale.
 """
 
 from __future__ import annotations
@@ -93,8 +100,9 @@ class InferenceEngine:
 
     def __init__(self, params: Any, cfg: TransformerConfig, *,
                  slots: int = 8, max_len: int = 0,
-                 prefill_len: int = 0, decode_block: int = 1):
-        self.params = params
+                 prefill_len: int = 0, decode_block: int = 1,
+                 prefix_cache_entries: int = 0):
+        self._params = params
         self.cfg = cfg
         self.slots = slots
         self.max_len = max_len or cfg.max_seq_len
@@ -127,6 +135,22 @@ class InferenceEngine:
         # compiles stay bounded) and to 1 whenever any active request
         # uses eos (its stop must be observed token-by-token).
         self.decode_block = max(1, decode_block)
+
+        # prefix caching (the vLLM automatic-prefix-caching analog,
+        # reference atorch/rl/inference_backend/vllm_backend.py): an LRU
+        # of prefilled working rows keyed by CHUNK-ALIGNED token
+        # prefixes. A new prompt resumes prefill from its longest cached
+        # aligned prefix — for RLHF rollouts sharing a system prompt
+        # that removes nearly the whole prefill. TPU-static: entries are
+        # full [L, 1, max_len, ...] KV rows (the same shape the working
+        # row already has), so a hit changes WHICH chunks run, never a
+        # program shape. Each entry pins ~2 * n_layers * max_len *
+        # kv_heads * head_dim * dtype bytes of device memory — size
+        # `prefix_cache_entries` (0 = off) to the HBM you can spare.
+        self.prefix_cache_entries = prefix_cache_entries
+        self._prefix_cache: dict[tuple, tuple] = {}
+        self.prefix_cache_hits = 0
+        self.prefix_cache_queries = 0
 
         self._queue: deque[Request] = deque()
         self._ids = itertools.count()
@@ -214,6 +238,24 @@ class InferenceEngine:
 
     # ----------------------------------------------------------- user API
 
+    @property
+    def params(self) -> Any:
+        return self._params
+
+    @params.setter
+    def params(self, value: Any) -> None:
+        # a weight push (RLHF serving worker swaps actor weights each
+        # iteration) makes every cached prefix row stale — KV computed
+        # under the OLD weights must never prefix a new generation.
+        # Unconditional on purpose: an identity check would silently
+        # keep stale rows for callers that mutate the tree in place and
+        # re-push the same container. The cost of a redundant clear is
+        # one wave of re-prefill; the cost of a stale row is wrong
+        # logits with no error. Reuse within a rollout wave survives:
+        # the RL engine pushes once per iteration, before the wave.
+        self._params = value
+        self._prefix_cache.clear()
+
     def submit(self, prompt: list[int],
                params: SamplingParams | None = None,
                on_token=None) -> int:
@@ -231,6 +273,29 @@ class InferenceEngine:
         self._queue.append(Request(rid, list(prompt), params, on_token))
         return rid
 
+    def _prefix_lookup(self, prompt: list[int]):
+        """Longest chunk-aligned cached prefix of ``prompt``; returns
+        ``(start, (row_k, row_v, pos, last))`` or ``None``. jax arrays
+        are immutable, so handing out the stored row is alias-safe."""
+        P = self.prefill_len
+        top = len(prompt) // P * P
+        key = tuple(prompt[:top])
+        for lo in range(top, 0, -P):
+            ent = self._prefix_cache.get(key)
+            if ent is not None:
+                # refresh LRU recency (dicts iterate in insertion order)
+                self._prefix_cache.pop(key)
+                self._prefix_cache[key] = ent
+                return lo, ent
+            key = key[:-P]
+        return None
+
+    def _prefix_store(self, key: tuple, ent: tuple) -> None:
+        self._prefix_cache.pop(key, None)
+        self._prefix_cache[key] = ent
+        while len(self._prefix_cache) > self.prefix_cache_entries:
+            self._prefix_cache.pop(next(iter(self._prefix_cache)))
+
     def _admit(self) -> None:
         for slot in range(self.slots):
             if self._active[slot] is not None or not self._queue:
@@ -240,7 +305,14 @@ class InferenceEngine:
             row_k, row_v, pos = work["k"], work["v"], work["pos"]
             last = None
             P = self.prefill_len
-            for lo in range(0, len(req.prompt), P):
+            start = 0
+            if self.prefix_cache_entries:
+                self.prefix_cache_queries += 1
+                hit = self._prefix_lookup(req.prompt)
+                if hit is not None:
+                    start, (row_k, row_v, pos, last) = hit
+                    self.prefix_cache_hits += 1
+            for lo in range(start, len(req.prompt), P):
                 chunk = req.prompt[lo: lo + P]
                 toks = np.zeros((1, P), np.int32)
                 toks[0, : len(chunk)] = chunk
@@ -248,6 +320,14 @@ class InferenceEngine:
                     self.params, jnp.asarray(toks), row_k, row_v, pos,
                     jnp.asarray(len(chunk), jnp.int32),
                 )
+                if self.prefix_cache_entries and len(chunk) == P:
+                    # snapshot every aligned boundary: partial overlaps
+                    # between different prompts hit the longest shared
+                    # aligned prefix
+                    self._prefix_store(
+                        tuple(req.prompt[: lo + P]),
+                        (row_k, row_v, pos, last),
+                    )
             (self._cache["k"], self._cache["v"], self._cache["pos"],
              self._last) = self._install(
                 self._cache["k"], self._cache["v"], self._cache["pos"],

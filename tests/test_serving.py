@@ -326,3 +326,83 @@ def test_streaming_callback_receives_tokens_in_order(params):
     res3 = {r.id: r for r in eng.run()}[r3]
     assert res3.finish_reason == "eos"
     assert streamed[r3] == res3.tokens == [eos]
+
+
+@pytest.mark.timeout(300)
+class TestPrefixCache:
+    """vLLM automatic-prefix-caching analog: chunk-aligned KV reuse."""
+
+    SYS = list(range(40, 56))  # 16 tokens = 2 aligned chunks at P=8
+
+    def _run(self, params, prompts, cache_entries, temperature=0.0,
+             seed=None):
+        eng = InferenceEngine(params, CFG, slots=2, max_len=64,
+                              prefill_len=8,
+                              prefix_cache_entries=cache_entries)
+        ids = [
+            eng.submit(p, SamplingParams(
+                temperature=temperature, max_new_tokens=5, seed=seed))
+            for p in prompts
+        ]
+        results = {r.id: r.tokens for r in eng.run()}
+        return eng, [results[i] for i in ids]
+
+    def test_hit_produces_identical_greedy_output(self, params):
+        prompts = [self.SYS + [3, 1], self.SYS + [9],
+                   self.SYS + [3, 1]]
+        _, base = self._run(params, prompts, cache_entries=0)
+        eng, cached = self._run(params, prompts, cache_entries=8)
+        assert cached == base
+        # prompts 2 and 3 must have resumed from the shared prefix
+        assert eng.prefix_cache_hits >= 2
+        assert eng.prefix_cache_queries == 3
+
+    def test_full_prompt_hit_skips_prefill_entirely(self, params):
+        prompt = self.SYS  # exactly 2 chunks: cacheable in full
+        _, base = self._run(params, [prompt, prompt], cache_entries=8)
+        eng, cached = self._run(params, [prompt, prompt],
+                                cache_entries=8)
+        assert cached[0] == cached[1] == base[0]
+        # the second submit must have taken the skip-prefill path, not
+        # silently cold-prefilled to the same answer
+        assert eng.prefix_cache_hits >= 1
+
+    def test_seeded_sampling_unaffected_by_cache(self, params):
+        prompts = [self.SYS + [2], self.SYS + [2]]
+        _, base = self._run(params, prompts, cache_entries=0,
+                            temperature=0.9, seed=1234)
+        eng, cached = self._run(params, prompts, cache_entries=8,
+                                temperature=0.9, seed=1234)
+        assert cached == base
+        assert eng.prefix_cache_hits >= 1  # parity held THROUGH a hit
+
+    def test_lru_bound_holds(self, params):
+        eng = InferenceEngine(params, CFG, slots=1, max_len=64,
+                              prefill_len=8, prefix_cache_entries=2)
+        for base in (10, 20, 30, 40):
+            eng.submit([base + i for i in range(16)],
+                       SamplingParams(temperature=0.0,
+                                      max_new_tokens=2))
+        eng.run()
+        assert len(eng._prefix_cache) <= 2
+
+    def test_weight_push_invalidates(self, params):
+        eng = InferenceEngine(params, CFG, slots=1, max_len=64,
+                              prefill_len=8, prefix_cache_entries=8)
+        eng.submit(self.SYS, SamplingParams(temperature=0.0,
+                                            max_new_tokens=2))
+        eng.run()
+        assert eng._prefix_cache
+        eng.params = jax.tree.map(lambda a: a * 0.5, params)
+        assert not eng._prefix_cache
+        # and generations under the new weights match a fresh engine
+        fresh = InferenceEngine(
+            jax.tree.map(lambda a: a * 0.5, params), CFG, slots=1,
+            max_len=64, prefill_len=8, prefix_cache_entries=8)
+        rid_a = eng.submit(self.SYS + [7], SamplingParams(
+            temperature=0.0, max_new_tokens=4))
+        rid_b = fresh.submit(self.SYS + [7], SamplingParams(
+            temperature=0.0, max_new_tokens=4))
+        out_a = {r.id: r.tokens for r in eng.run()}[rid_a]
+        out_b = {r.id: r.tokens for r in fresh.run()}[rid_b]
+        assert out_a == out_b
