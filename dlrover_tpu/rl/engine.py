@@ -224,6 +224,7 @@ class ShardedPPOTrainer(PPOTrainer):
     def enable_remote_rollouts(self, addr: str | None = None, *,
                                slots: int = 8, decode_block: int = 8,
                                max_len: int = 0,
+                               prefix_cache_entries: int = 8,
                                worker_env: dict | None = None) -> None:
         """Route rollouts through a serving worker in a SEPARATE
         process, with versioned networked weight sync — the full
@@ -253,6 +254,7 @@ class ShardedPPOTrainer(PPOTrainer):
             self.cfg, slots=slots,
             max_len=max_len or self.cfg.max_seq_len,
             decode_block=decode_block,
+            prefix_cache_entries=prefix_cache_entries,
         )
         self._weights_version = 0
         self._remote_pushed = -1

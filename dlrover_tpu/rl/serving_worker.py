@@ -114,6 +114,10 @@ class ServingWorker(ArrayMsgServer):
                     "slots": int(meta.get("slots", 8)),
                     "max_len": int(meta.get("max_len", 0)),
                     "decode_block": int(meta.get("decode_block", 8)),
+                    # each weights push replaces engine.params, which
+                    # clears the cache — stale KV cannot cross versions
+                    "prefix_cache_entries": int(
+                        meta.get("prefix_cache_entries", 8)),
                 }
                 self._engine = None  # rebuilt on the next weights push
                 self.version = -1
@@ -229,10 +233,12 @@ class RemoteServingClient:
         return self._call("ping")[0]
 
     def init(self, cfg, *, slots: int = 8, max_len: int = 0,
-             decode_block: int = 8) -> None:
+             decode_block: int = 8,
+             prefix_cache_entries: int = 8) -> None:
         self._call("init", {
             "cfg": dataclasses.asdict(cfg), "slots": slots,
             "max_len": max_len, "decode_block": decode_block,
+            "prefix_cache_entries": prefix_cache_entries,
         })
 
     def push_weights(self, version: int, params: dict) -> None:
