@@ -36,8 +36,23 @@ from dlrover_tpu.common.storage import (
     build_storage,
 )
 from dlrover_tpu.checkpoint.shm_handler import SharedMemoryHandler
+from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.metrics import registry
 
 logger = get_logger(__name__)
+
+_persist_seconds = registry().histogram(
+    "dlrover_tpu_ckpt_persist_seconds",
+    "shm -> storage persist duration (write + done marker)",
+)
+_persist_bytes = registry().counter(
+    "dlrover_tpu_ckpt_persist_bytes_total",
+    "checkpoint bytes persisted to storage",
+)
+_commit_seconds = registry().histogram(
+    "dlrover_tpu_ckpt_commit_seconds",
+    "all-shards-durable commit wait (rank-0 agent)",
+)
 
 EVENT_SAVE = "save"
 EVENT_STOP = "stop"
@@ -220,17 +235,22 @@ class AsyncCheckpointSaver:
             return
         storage = self._build_storage(header)
         start = time.monotonic()
-        sdir = step_dir(ckpt_dir, step)
-        storage.makedirs(sdir)
-        num_shards = int(header.get("num_shards", 1))
-        storage.write(content, os.path.join(sdir, f"node_{self.node_id}.bin"))
-        storage.write(
-            json.dumps(header),
-            os.path.join(sdir, f"node_{self.node_id}.meta.json"),
-        )
-        storage.write(
-            b"", os.path.join(sdir, done_marker(self.node_id, num_shards))
-        )
+        with get_journal().span("ckpt_persist", step=step,
+                                bytes=len(content)):
+            sdir = step_dir(ckpt_dir, step)
+            storage.makedirs(sdir)
+            num_shards = int(header.get("num_shards", 1))
+            storage.write(content,
+                          os.path.join(sdir, f"node_{self.node_id}.bin"))
+            storage.write(
+                json.dumps(header),
+                os.path.join(sdir, f"node_{self.node_id}.meta.json"),
+            )
+            storage.write(
+                b"", os.path.join(sdir, done_marker(self.node_id, num_shards))
+            )
+        _persist_seconds.observe(time.monotonic() - start)
+        _persist_bytes.inc(len(content))
         self._maybe_commit(storage, header, step,
                            block_s=commit_block_s)
         logger.info(
@@ -276,6 +296,7 @@ class AsyncCheckpointSaver:
                      timeout_s: float = 300.0) -> None:
         sdir = step_dir(ckpt_dir, step)
         suffix = f"_w{num_shards}"
+        start = time.monotonic()
         deadline = time.time() + timeout_s
         done: list = []
         try:
@@ -291,6 +312,7 @@ class AsyncCheckpointSaver:
                         ),
                         tracker_path(ckpt_dir),
                     )
+                    _commit_seconds.observe(time.monotonic() - start)
                     logger.info(
                         "committed checkpoint step %d (%d shards)",
                         step, num_shards,

@@ -38,8 +38,24 @@ from dlrover_tpu.common.constants import (
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.common.rpc import find_free_port
 from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.telemetry.journal import get_journal, set_trace_id
+from dlrover_tpu.telemetry.metrics import registry
 
 logger = get_logger(__name__)
+
+_restarts_total = registry().counter(
+    "dlrover_tpu_agent_restarts_total",
+    "trainer respawns by kind (failure vs planned)",
+    label_names=("kind",),
+)
+_incarnation_gauge = registry().gauge(
+    "dlrover_tpu_agent_incarnation",
+    "current trainer incarnation number on this node",
+)
+_rdzv_wait_seconds = registry().histogram(
+    "dlrover_tpu_agent_rdzv_wait_seconds",
+    "agent-observed rendezvous wait (join -> completed world)",
+)
 
 
 class RunResult(str, Enum):
@@ -113,6 +129,7 @@ class ElasticAgent:
         self._buddy_server = None
         self._buddy_replicator = None
         self._preemption_watcher = None
+        self._metrics_server = None
         self._world: dict[int, int] = {}
         self._node_rank = -1
         self._pending_action = ""
@@ -139,6 +156,7 @@ class ElasticAgent:
             self._config.host_ip
         )
         addr = f"{self._config.host_ip}:{port}"
+        wait_start = time.time()
         self._client.join_rendezvous(
             addr=addr,
             local_devices=self._local_devices,
@@ -149,6 +167,16 @@ class ElasticAgent:
         )
         self._world = world.world
         self._node_rank = world.world[self._config.node_id]
+        # adopt the master-minted job trace id before journaling: this
+        # agent's spans (and the trainer child, via inherited env) link
+        # into the job-wide trace
+        set_trace_id(world.trace_id)
+        waited = time.time() - wait_start
+        _rdzv_wait_seconds.observe(waited)
+        get_journal().emit(
+            "rendezvous_wait", dur=waited, round=world.round,
+            rank=self._node_rank, nodes=len(world.world),
+        )
         logger.info(
             "rendezvous round %d: rank %d of %d nodes, coordinator %s",
             world.round, self._node_rank, len(world.world), world.coordinator,
@@ -181,6 +209,7 @@ class ElasticAgent:
         if self._hang is not None:
             # every incarnation recompiles: fresh grace period
             self._hang.reset()
+        _incarnation_gauge.set(self._incarnation)
         return subprocess.Popen(
             self._config.entrypoint, env=env, start_new_session=True
         )
@@ -201,6 +230,9 @@ class ElasticAgent:
     # ------------------------------------------------------------ main loop
 
     def run(self) -> RunResult:
+        from dlrover_tpu.telemetry.exposition import start_from_env
+
+        self._metrics_server = start_from_env()
         self._start_heartbeat()
         self._start_ckpt_saver()
         self._start_resource_monitor()
@@ -223,6 +255,8 @@ class ElasticAgent:
                 self._buddy_replicator.stop()
             if self._buddy_server is not None:
                 self._buddy_server.stop()
+            if self._metrics_server is not None:
+                self._metrics_server.stop()
             self._kill_child()
 
     def _invoke_run(self) -> RunResult:
@@ -315,12 +349,17 @@ class ElasticAgent:
                 success=False, reason=f"exit code {exit_code}"
             )
             return RunResult.FAILED
-        self._persist_checkpoint(reason="process failure")
-        self._recover_shards()
-        self._restart_count += 1
-        self._incarnation += 1
-        rank, num_nodes, coordinator = self._rendezvous()
-        self._proc = self._spawn(rank, num_nodes, coordinator)
+        _restarts_total.labels("failure").inc()
+        with get_journal().span(
+            "node_restart", kind="failure", exit_code=exit_code,
+            incarnation=self._incarnation + 1,
+        ):
+            self._persist_checkpoint(reason="process failure")
+            self._recover_shards()
+            self._restart_count += 1
+            self._incarnation += 1
+            rank, num_nodes, coordinator = self._rendezvous()
+            self._proc = self._spawn(rank, num_nodes, coordinator)
         return None
 
     def _restart_workers(self, reason: str) -> None:
@@ -329,12 +368,17 @@ class ElasticAgent:
         failures do (reference: _remaining_failovers decrements on failure
         only, training.py:594)."""
         logger.info("restarting workers: %s", reason)
-        self._persist_checkpoint(reason=reason)
-        self._kill_child()
-        self._recover_shards()
-        self._incarnation += 1
-        rank, num_nodes, coordinator = self._rendezvous()
-        self._proc = self._spawn(rank, num_nodes, coordinator)
+        _restarts_total.labels("planned").inc()
+        with get_journal().span(
+            "node_restart", kind="planned", reason=reason,
+            incarnation=self._incarnation + 1,
+        ):
+            self._persist_checkpoint(reason=reason)
+            self._kill_child()
+            self._recover_shards()
+            self._incarnation += 1
+            rank, num_nodes, coordinator = self._rendezvous()
+            self._proc = self._spawn(rank, num_nodes, coordinator)
 
     def _recover_shards(self) -> None:
         """Give the dead trainer's in-flight data shards back to the queue.
@@ -371,7 +415,11 @@ class ElasticAgent:
                     if action:
                         with self._action_lock:
                             self._pending_action = action
-                except ConnectionError:
+                    # piggyback this node's metrics snapshot on the
+                    # heartbeat cadence so the master's exposition
+                    # endpoint serves job-wide series
+                    self._client.report_metrics(registry().snapshot())
+                except (ConnectionError, RuntimeError, OSError):
                     logger.warning("heartbeat failed: master unreachable")
                 self._stopped.wait(self._config.heartbeat_interval_s)
 

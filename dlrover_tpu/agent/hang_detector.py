@@ -29,8 +29,16 @@ import time
 from dlrover_tpu.common.constants import EnvKey
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.common.storage import atomic_write_file
+from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.metrics import registry
 
 logger = get_logger(__name__)
+
+_verdicts_total = registry().counter(
+    "dlrover_tpu_hang_checks_total",
+    "node-local hang-detector verdicts",
+    label_names=("verdict",),
+)
 
 
 def progress_path(node_id: int | None = None) -> str:
@@ -113,7 +121,19 @@ class HangDetector:
         if step is not None and step > self._last_step:
             self._last_step = step
             self._last_advance = now
+            _verdicts_total.labels("progress").inc()
             return False
         if self._last_step < 0:
-            return now - self._spawned_at > self.startup_grace_s
-        return now - self._last_advance > self.timeout_s
+            hung = now - self._spawned_at > self.startup_grace_s
+        else:
+            hung = now - self._last_advance > self.timeout_s
+        _verdicts_total.labels("hung" if hung else "ok").inc()
+        if hung:
+            # one journal line per verdict: the agent kills + respawns
+            # right after, so the restart span carries the consequence
+            get_journal().emit(
+                "hang_verdict", step=self._last_step,
+                stalled_s=round(now - max(self._last_advance,
+                                          self._spawned_at), 3),
+            )
+        return hung

@@ -194,8 +194,21 @@ class MasterClient:
     def report_step(self, step: int) -> None:
         self._client.call(m.GlobalStepReport(node_id=self.node_id, step=step))
 
-    def get_job_stats(self) -> m.JobStatsResponse:
-        return self._client.call(m.JobStatsRequest(node_id=self.node_id))
+    def get_job_stats(self, include_series: bool = False
+                      ) -> m.JobStatsResponse:
+        return self._client.call(
+            m.JobStatsRequest(node_id=self.node_id,
+                              include_series=include_series)
+        )
+
+    def report_metrics(self, samples: list, role: str = "agent") -> None:
+        """Push this process's metrics-registry snapshot
+        (telemetry/metrics.py) for the master's aggregated exposition."""
+        self._client.call(
+            m.MetricsSnapshotRequest(
+                node_id=self.node_id, role=role, samples=samples,
+            )
+        )
 
     def get_running_nodes(self) -> list[m.NodeMeta]:
         return self._client.call(m.RunningNodesRequest()).nodes

@@ -31,12 +31,27 @@ from dlrover_tpu.common.constants import CheckpointStorageType, EnvKey
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.common.multi_process import SharedQueue, client_socket_ready
 from dlrover_tpu.common.storage import CheckpointStorage, PosixDiskStorage
+from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.metrics import registry
 from dlrover_tpu.checkpoint.shm_handler import (
     SharedMemoryHandler,
     restore_pytree,
 )
 
 logger = get_logger(__name__)
+
+# shared by CheckpointEngine.load and ShardedCheckpointEngine.load_sharded
+_restore_seconds = registry().histogram(
+    "dlrover_tpu_ckpt_restore_seconds",
+    "checkpoint restore duration by engine",
+    label_names=("engine",),
+)
+
+
+def _record_restore(engine: str, start_monotonic: float, step: int) -> None:
+    dur = time.monotonic() - start_monotonic
+    _restore_seconds.labels(engine).observe(dur)
+    get_journal().emit("ckpt_restore", dur=dur, step=step, engine=engine)
 
 
 class CheckpointEngine:
@@ -377,6 +392,7 @@ class CheckpointEngine:
         """
         if zero_copy and put is None:
             raise ValueError("zero_copy=True requires a consuming `put`")
+        start = time.monotonic()
         # a COW child mid-copy is overwriting the arena under the OLD
         # header: reading now would return a torn mix of two steps. A
         # FAILED child is fine (header untouched, previous snapshot
@@ -394,7 +410,9 @@ class CheckpointEngine:
         if loaded is None:
             return None
         step, arrays = loaded
-        return step, restore_pytree(template, arrays, put=put)
+        restored = step, restore_pytree(template, arrays, put=put)
+        _record_restore("engine", start, step)
+        return restored
 
     def load_raw(self) -> tuple[int, dict] | None:
         """(step, {leaf_path: array}) without a shape template — for

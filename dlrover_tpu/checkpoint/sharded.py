@@ -264,6 +264,18 @@ class ShardedCheckpointEngine(CheckpointEngine):
 
     def load_sharded(self, template: Any, shardings: Any
                      ) -> tuple[int, Any] | None:
+        import time as _time
+
+        from dlrover_tpu.checkpoint.engine import _record_restore
+
+        start = _time.monotonic()
+        loaded = self._load_sharded_impl(template, shardings)
+        if loaded is not None:
+            _record_restore("sharded", start, loaded[0])
+        return loaded
+
+    def _load_sharded_impl(self, template: Any, shardings: Any
+                           ) -> tuple[int, Any] | None:
         """Restore onto ``shardings`` (any mesh): (step, state) or None.
 
         ``template`` supplies structure/shape/dtype (concrete arrays or

@@ -125,6 +125,13 @@ class EnvKey:
     # dlrover/python/elastic_agent/torch/training.py:143)
     INIT_TIMEOUT = "DLROVER_TPU_INIT_TIMEOUT"
     ACCELERATOR = "DLROVER_TPU_ACCELERATOR"
+    # telemetry (dlrover_tpu/telemetry/): exposition port (unset = fully
+    # off), event-journal directory (unset = no journal), the job trace
+    # id the master mints, and JSON log format
+    METRICS_PORT = "DLROVER_TPU_METRICS_PORT"
+    JOURNAL_DIR = "DLROVER_TPU_JOURNAL_DIR"
+    TRACE_ID = "DLROVER_TPU_TRACE_ID"
+    LOG_JSON = "DLROVER_TPU_LOG_JSON"
 
 
 class Defaults:

@@ -1,13 +1,19 @@
 """Structured logging for all framework processes.
 
-Reference analog: dlrover/python/common/log.py.
+Reference analog: dlrover/python/common/log.py. With
+``DLROVER_TPU_LOG_JSON=1`` records render as one JSON object per line
+carrying ``node_id`` and ``trace_id`` (injected by a ``logging.Filter``
+from the agent/master environment), so logs join cleanly with the event
+journal (telemetry/journal.py) on the same ids.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import sys
+import time
 
 _FORMAT = (
     "[%(asctime)s] [%(levelname)s] "
@@ -15,11 +21,50 @@ _FORMAT = (
 )
 
 
+class ContextFilter(logging.Filter):
+    """Stamp every record with the process's node and trace identity.
+
+    Read per-record, not cached: the trace id arrives via the rendezvous
+    payload *after* most loggers are created.
+    """
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.node_id = os.environ.get("DLROVER_TPU_NODE_ID", "-")
+        record.trace_id = os.environ.get("DLROVER_TPU_TRACE_ID", "-")
+        return True
+
+
+class JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        entry = {
+            "ts": round(record.created, 3),
+            "time": time.strftime(
+                "%Y-%m-%dT%H:%M:%S", time.localtime(record.created)
+            ),
+            "level": record.levelname,
+            "logger": record.name,
+            "line": record.lineno,
+            "node_id": getattr(record, "node_id", "-"),
+            "trace_id": getattr(record, "trace_id", "-"),
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            entry["exc"] = self.formatException(record.exc_info)
+        return json.dumps(entry, ensure_ascii=False)
+
+
+def _make_formatter() -> logging.Formatter:
+    if os.environ.get("DLROVER_TPU_LOG_JSON", "") == "1":
+        return JsonFormatter()
+    return logging.Formatter(_FORMAT)
+
+
 def get_logger(name: str) -> logging.Logger:
     logger = logging.getLogger(name)
     if not logger.handlers:
         handler = logging.StreamHandler(sys.stderr)
-        handler.setFormatter(logging.Formatter(_FORMAT))
+        handler.setFormatter(_make_formatter())
+        handler.addFilter(ContextFilter())
         logger.addHandler(handler)
         logger.setLevel(os.environ.get("DLROVER_TPU_LOG_LEVEL", "INFO"))
         logger.propagate = False

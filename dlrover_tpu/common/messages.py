@@ -70,6 +70,10 @@ class CommWorldResponse:
     world: dict[int, int] = dataclasses.field(default_factory=dict)
     coordinator: str = ""
     total_devices: int = 0
+    # job-wide telemetry trace id, minted by the master at job start and
+    # adopted by agents/trainers (telemetry/journal.py) so spans from
+    # every process of the job link into one trace
+    trace_id: str = ""
 
 
 @register_message
@@ -318,6 +322,9 @@ class NetworkCheckGroupResponse:
 @dataclasses.dataclass
 class JobStatsRequest:
     node_id: int = 0
+    # also return each node's bounded resource time series (the
+    # LocalStatsReporter window), not just the latest sample
+    include_series: bool = False
 
 
 @register_message
@@ -328,6 +335,7 @@ class NodeStatSample:
     used_memory_mb: int = 0
     used_hbm_mb: int = 0
     tpu_chips: int = 0
+    timestamp: float = 0.0
 
 
 @register_message
@@ -338,6 +346,23 @@ class JobStatsResponse:
     steps_per_s: float = 0.0
     goodput: float = 0.0
     nodes: list[NodeStatSample] = dataclasses.field(default_factory=list)
+    # node_id -> full sample window, when include_series was requested
+    series: dict[int, list[NodeStatSample]] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+@register_message
+@dataclasses.dataclass
+class MetricsSnapshotRequest:
+    """Agent -> master: this node's metrics-registry snapshot
+    (telemetry/metrics.py ``MetricsRegistry.snapshot()``), pushed on the
+    heartbeat cadence so the master's exposition endpoint can serve the
+    whole job's series tagged with a ``node`` label."""
+
+    node_id: int = 0
+    role: str = "agent"
+    samples: list = dataclasses.field(default_factory=list)
 
 
 @register_message

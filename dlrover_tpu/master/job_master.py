@@ -13,7 +13,7 @@ import argparse
 import os
 import time
 
-from dlrover_tpu.common.constants import Defaults, NodeStatus
+from dlrover_tpu.common.constants import Defaults, EnvKey, NodeStatus
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.common.rpc import RpcServer
 from dlrover_tpu.master.diagnosis import DiagnosisManager
@@ -45,8 +45,14 @@ class JobMaster:
         state_dir: str = "",
     ):
         from dlrover_tpu.master.stats import LocalStatsReporter
+        from dlrover_tpu.telemetry.journal import mint_trace_id, set_trace_id
 
         self.job_name = job_name
+        # the job-wide telemetry trace id: minted here (or adopted from a
+        # restarted master's env) and handed to agents in the rendezvous
+        # payload so every process's journal spans share one trace
+        self.trace_id = os.environ.get(EnvKey.TRACE_ID) or mint_trace_id()
+        set_trace_id(self.trace_id)
         self.task_manager = TaskManager()
         self.speed_monitor = SpeedMonitor(hang_timeout_s=hang_timeout_s)
         self.kv_store = KVStoreService()
@@ -82,8 +88,10 @@ class JobMaster:
             kv_store=self.kv_store,
             diagnosis=self.diagnosis,
             stats_reporter=self.stats_reporter,
+            trace_id=self.trace_id,
         )
         self._server = RpcServer(self.servicer.handle, port=port)
+        self._metrics_server = None
         self.state_manager = None
         if state_dir:
             from dlrover_tpu.master.state_store import (
@@ -112,12 +120,32 @@ class JobMaster:
             mgr.remove_node(node_id)
         self.stats_reporter.remove(node_id)
 
+    def metrics_text(self) -> str:
+        """Master registry + every node's pushed snapshot, one scrape."""
+        from dlrover_tpu.telemetry.exposition import render, render_snapshot
+
+        parts = [render(extra_labels={"role": "master"})]
+        for (node_id, role), samples in sorted(
+            self.servicer.node_metrics_snapshots().items()
+        ):
+            parts.append(render_snapshot(
+                samples,
+                extra_labels={"node": str(node_id), "role": role},
+                emit_meta=False,
+            ))
+        return "".join(parts)
+
     def prepare(self) -> None:
+        from dlrover_tpu.telemetry.exposition import start_from_env
+        from dlrover_tpu.telemetry.journal import get_journal
+
         if self.state_manager is not None:
             self.state_manager.restore()
             self.state_manager.start()
         self._server.start()
         self.node_manager.start()
+        self._metrics_server = start_from_env(text_fn=self.metrics_text)
+        get_journal().emit("job_start", job=self.job_name)
         logger.info("job master %s serving on port %d", self.job_name,
                     self.port)
 
@@ -209,10 +237,16 @@ class JobMaster:
         return success
 
     def stop(self) -> None:
+        from dlrover_tpu.telemetry.journal import get_journal
+
+        get_journal().emit("job_end", job=self.job_name,
+                           success=self.servicer.job_success)
         if self.state_manager is not None:
             self.state_manager.stop()
         self.node_manager.stop()
         self._server.stop()
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -19,8 +19,26 @@ import threading
 import time
 
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.metrics import registry
 
 logger = get_logger(__name__)
+
+_round_seconds = registry().histogram(
+    "dlrover_tpu_rdzv_round_seconds",
+    "rendezvous round duration (first join -> completion)",
+    label_names=("name",),
+)
+_rounds_total = registry().counter(
+    "dlrover_tpu_rdzv_rounds_total",
+    "completed rendezvous rounds",
+    label_names=("name",),
+)
+_waiting_nodes = registry().gauge(
+    "dlrover_tpu_rdzv_waiting_nodes",
+    "nodes currently waiting in the rendezvous",
+    label_names=("name",),
+)
 
 
 @dataclasses.dataclass
@@ -95,6 +113,7 @@ class RendezvousManager:
                 self.name, node_id, len(self._waiting),
                 self._min_nodes, self._max_nodes,
             )
+            _waiting_nodes.labels(self.name).set(len(self._waiting))
             return self._round
 
     def remove_node(self, node_id: int) -> None:
@@ -153,6 +172,16 @@ class RendezvousManager:
         logger.info(
             "rdzv %s: round %d completed with %d nodes, coordinator %s",
             self.name, self._round, len(world), coordinator,
+        )
+        round_s = max(0.0, time.time() - self._first_join_time)
+        _round_seconds.labels(self.name).observe(round_s)
+        _rounds_total.labels(self.name).inc()
+        _waiting_nodes.labels(self.name).set(len(self._waiting))
+        # one completed-interval line (begin time is derivable from dur):
+        # the job-level stall the lost-time report charges to rendezvous
+        get_journal().emit(
+            "rdzv_round", dur=round_s, rdzv=self.name, round=self._round,
+            nodes=len(world),
         )
 
     def get_comm_world(self, node_id: int) -> CommWorld | None:

@@ -8,6 +8,7 @@ isinstance; same shape here over the typed serde messages.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any
 
 from dlrover_tpu.common import messages as m
@@ -34,11 +35,13 @@ class MasterServicer:
         diagnosis: DiagnosisManager,
         stats_reporter=None,
         metric_collector=None,
+        trace_id: str = "",
     ):
         from dlrover_tpu.master.stats import (
             JobMetricCollector,
             LocalStatsReporter,
         )
+        from dlrover_tpu.telemetry.metrics import registry
 
         self._node_manager = node_manager
         self._task_manager = task_manager
@@ -59,9 +62,42 @@ class MasterServicer:
         self.job_success: bool | None = None
         # node_id -> BuddyServer addr (checkpoint/buddy.py replication)
         self._buddy_endpoints: dict[int, str] = {}
+        self.trace_id = trace_id
+        # (node_id, role) -> last pushed registry snapshot
+        # (MetricsSnapshotRequest); rendered by the master's exposition
+        # endpoint with a per-node label
+        self._node_metrics: dict[tuple[int, str], list] = {}
+        self._node_metrics_lock = threading.Lock()
+        self._rpc_seconds = registry().histogram(
+            "dlrover_tpu_master_rpc_seconds",
+            "master RPC dispatch latency by message type",
+            label_names=("type",),
+        )
+        self._rpc_errors = registry().counter(
+            "dlrover_tpu_master_rpc_errors_total",
+            "master RPC dispatch failures by message type",
+            label_names=("type",),
+        )
 
-    # The single entry point handed to RpcServer.
-    def handle(self, msg: Any) -> Any:  # noqa: C901 - dispatch table
+    # The single entry point handed to RpcServer: dispatch + telemetry.
+    def handle(self, msg: Any) -> Any:
+        msg_type = type(msg).__name__
+        start = time.monotonic()
+        try:
+            return self._dispatch(msg)
+        except Exception:
+            self._rpc_errors.labels(msg_type).inc()
+            raise
+        finally:
+            self._rpc_seconds.labels(msg_type).observe(
+                time.monotonic() - start
+            )
+
+    def node_metrics_snapshots(self) -> dict[tuple[int, str], list]:
+        with self._node_metrics_lock:
+            return dict(self._node_metrics)
+
+    def _dispatch(self, msg: Any) -> Any:  # noqa: C901 - dispatch table
         if isinstance(msg, m.JoinRendezvousRequest):
             return self._join_rendezvous(msg)
         if isinstance(msg, m.CommWorldRequest):
@@ -129,21 +165,11 @@ class MasterServicer:
             )
             return m.OkResponse()
         if isinstance(msg, m.JobStatsRequest):
-            summary = self._metrics.summary()
-            return m.JobStatsResponse(
-                uptime_s=summary["uptime_s"],
-                global_step=summary["global_step"],
-                steps_per_s=summary["steps_per_s"],
-                goodput=summary["goodput"],
-                nodes=[
-                    m.NodeStatSample(
-                        node_id=nid, cpu_percent=s.cpu_percent,
-                        used_memory_mb=s.used_memory_mb,
-                        used_hbm_mb=s.used_hbm_mb, tpu_chips=s.tpu_chips,
-                    )
-                    for nid, s in sorted(self._stats.latest().items())
-                ],
-            )
+            return self._job_stats(msg)
+        if isinstance(msg, m.MetricsSnapshotRequest):
+            with self._node_metrics_lock:
+                self._node_metrics[(msg.node_id, msg.role)] = msg.samples
+            return m.OkResponse()
         if isinstance(msg, m.GlobalStepReport):
             self._speed_monitor.report_step(msg.step, msg.timestamp)
             return m.OkResponse()
@@ -205,6 +231,36 @@ class MasterServicer:
             n = self._kv_store.add(f"sync/{msg.sync_name}", 0)
             return m.KVStoreResponse(found=True, number=n)
         raise TypeError(f"unhandled message type {type(msg).__name__}")
+
+    def _job_stats(self, msg: m.JobStatsRequest) -> m.JobStatsResponse:
+        def sample(nid: int, s) -> m.NodeStatSample:
+            return m.NodeStatSample(
+                node_id=nid, cpu_percent=s.cpu_percent,
+                used_memory_mb=s.used_memory_mb,
+                used_hbm_mb=s.used_hbm_mb, tpu_chips=s.tpu_chips,
+                timestamp=s.timestamp,
+            )
+
+        summary = self._metrics.summary()
+        series: dict[int, list[m.NodeStatSample]] = {}
+        if msg.include_series:
+            series = {
+                nid: [sample(nid, s) for s in samples]
+                for nid, samples in sorted(
+                    self._stats.series_all().items()
+                )
+            }
+        return m.JobStatsResponse(
+            uptime_s=summary["uptime_s"],
+            global_step=summary["global_step"],
+            steps_per_s=summary["steps_per_s"],
+            goodput=summary["goodput"],
+            nodes=[
+                sample(nid, s)
+                for nid, s in sorted(self._stats.latest().items())
+            ],
+            series=series,
+        )
 
     def _buddy_query(self, msg: m.BuddyQueryRequest
                      ) -> m.BuddyQueryResponse:
@@ -292,6 +348,7 @@ class MasterServicer:
             world=dict(world.world),
             coordinator=world.coordinator,
             total_devices=world.total_devices,
+            trace_id=self.trace_id,
         )
 
     def _network_check_group(self, msg: m.NetworkCheckGroupRequest

@@ -21,6 +21,7 @@ class SpeedMonitor:
         self._samples: deque[tuple[float, int]] = deque(maxlen=4096)
         self._global_step = 0
         self._last_report_time = 0.0
+        self._first_report_time = 0.0
         self._start_time = time.time()
         # live goodput bookkeeping: recent intervals between ADVANCING
         # step reports (re-reports after rollback don't advance and so
@@ -42,6 +43,8 @@ class SpeedMonitor:
                         (ts - self._last_advance_time) / delta
                     )
                 self._last_advance_time = ts
+            if not self._first_report_time:
+                self._first_report_time = ts
             self._last_report_time = ts
 
     def goodput(self, now: float | None = None) -> float:
@@ -56,7 +59,13 @@ class SpeedMonitor:
                 return 0.0
             median = statistics.median(self._intervals)
             productive = self._advanced_steps * median
-            total = max(1e-9, (now or time.time()) - self._start_time)
+            # cold-start window: the monitor may be constructed long
+            # before workers first report (pod scheduling, rendezvous,
+            # first compile) — that pre-first-report period is startup,
+            # not lost training time, so the clock starts at the first
+            # report (mid-job rendezvous/restarts still count as lost)
+            started = self._first_report_time or self._start_time
+            total = max(1e-9, (now or time.time()) - started)
         return max(0.0, min(1.0, productive / total))
 
     @property
@@ -88,7 +97,11 @@ class SpeedMonitor:
     def hanged(self) -> bool:
         with self._lock:
             last = self._last_report_time or self._start_time
-            started = self._last_report_time > 0
+            # keyed on the FIRST report, not the last: reset_hang_clock
+            # touches _last_report_time, and before any worker has ever
+            # reported (cold start: scheduling + rendezvous + compile)
+            # silence is startup, not a hang
+            started = self._first_report_time > 0
         return started and (time.time() - last) > self._hang_timeout_s
 
     def reset_hang_clock(self) -> None:

@@ -79,6 +79,13 @@ class LocalStatsReporter:
         with self._lock:
             return list(self._series.get(node_id, ()))
 
+    def series_all(self) -> dict[int, list[ResourceSample]]:
+        """Every node's full sample window — the JobStatsRequest
+        (include_series) payload, so the series is no longer
+        master-internal only."""
+        with self._lock:
+            return {nid: list(s) for nid, s in self._series.items()}
+
     def slow_nodes(self, ratio: float = 0.5, window: int = 8) -> list[int]:
         """Nodes whose CPU usage over the last ``window`` samples is
         anomalously low relative to the fleet (often a wedged/straggling

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import time
 from collections import deque
 from typing import Any
 
@@ -51,8 +52,19 @@ from dlrover_tpu.models.decode import (
     sample_logits,
 )
 from dlrover_tpu.models.transformer import TransformerConfig
+from dlrover_tpu.telemetry.metrics import registry
 
 logger = get_logger(__name__)
+
+_request_seconds = registry().histogram(
+    "dlrover_tpu_serving_request_seconds",
+    "submit -> retire latency per request",
+    label_names=("finish",),
+)
+_tokens_total = registry().counter(
+    "dlrover_tpu_serving_tokens_total",
+    "generated tokens across all requests",
+)
 
 
 @dataclasses.dataclass
@@ -154,6 +166,7 @@ class InferenceEngine:
 
         self._queue: deque[Request] = deque()
         self._ids = itertools.count()
+        self._submit_time: dict[int, float] = {}
         # host-side slot bookkeeping; None = free
         self._active: list[Request | None] = [None] * slots
         self._emitted: list[list[int]] = [[] for _ in range(slots)]
@@ -271,6 +284,7 @@ class InferenceEngine:
             raise ValueError("prompt + max_new_tokens > max_len")
         rid = next(self._ids)
         self._queue.append(Request(rid, list(prompt), params, on_token))
+        self._submit_time[rid] = time.monotonic()
         return rid
 
     def _prefix_lookup(self, prompt: list[int]):
@@ -430,6 +444,12 @@ class InferenceEngine:
             id=req.id, prompt=req.prompt,
             tokens=list(self._emitted[slot]), finish_reason=reason,
         ))
+        submitted = self._submit_time.pop(req.id, None)
+        if submitted is not None:
+            _request_seconds.labels(reason).observe(
+                time.monotonic() - submitted
+            )
+        _tokens_total.inc(len(self._emitted[slot]))
         self._active[slot] = None
         self._emitted[slot] = []
 

@@ -1,0 +1,30 @@
+"""Unified telemetry for the elastic control plane.
+
+Three dependency-free parts (ISSUE 1):
+
+- ``metrics``: a thread-safe labeled metrics registry (Counter, Gauge,
+  Histogram) with one process-default instance. Metric names follow the
+  ``dlrover_tpu_[a-z_]+`` convention enforced by
+  ``native/check_metric_names.py``.
+- ``exposition``: Prometheus text-format rendering plus a tiny stdlib
+  HTTP endpoint, off unless ``DLROVER_TPU_METRICS_PORT`` is set.
+- ``journal``: a crash-safe O_APPEND JSONL span journal with
+  trace/span/parent ids; the trace id is minted by the master at job
+  start and rides the rendezvous payload to agents and trainers.
+  ``python -m dlrover_tpu.telemetry.report`` joins the journal with
+  ``utils/goodput.py`` accounting into a lost-time breakdown.
+"""
+
+from dlrover_tpu.telemetry.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    registry,
+)
+from dlrover_tpu.telemetry.journal import (  # noqa: F401
+    EventJournal,
+    current_trace_id,
+    get_journal,
+    mint_trace_id,
+)

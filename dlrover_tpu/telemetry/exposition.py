@@ -1,0 +1,167 @@
+"""Prometheus text exposition over a stdlib HTTP thread.
+
+Serving is OFF by default: no thread is started and no port is bound
+unless ``DLROVER_TPU_METRICS_PORT`` is set (``0`` binds an ephemeral
+port — useful when master and agents share one host). The master's
+endpoint additionally re-renders the per-node registry snapshots agents
+push via ``MetricsSnapshotRequest``, each tagged with a ``node`` label.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry.metrics import MetricsRegistry, registry
+
+logger = get_logger(__name__)
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _escape(value: str) -> str:
+    return (str(value).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _labels_text(labels: dict, extra: dict | None = None) -> str:
+    merged = dict(labels)
+    if extra:
+        merged.update(extra)
+    if not merged:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape(v)}"' for k, v in sorted(merged.items())
+    )
+    return "{" + inner + "}"
+
+
+def _fmt(value: float) -> str:
+    if value == math.inf:
+        return "+Inf"
+    f = float(value)
+    return repr(int(f)) if f == int(f) else repr(f)
+
+
+def render_snapshot(snapshot: list[dict], extra_labels: dict | None = None,
+                    emit_meta: bool = True) -> str:
+    """Render a ``MetricsRegistry.snapshot()`` (possibly from another
+    process) to Prometheus text format."""
+    lines: list[str] = []
+    for metric in snapshot:
+        name, mtype = metric["name"], metric["type"]
+        if emit_meta:
+            if metric.get("help"):
+                lines.append(f"# HELP {name} {_escape(metric['help'])}")
+            lines.append(f"# TYPE {name} {mtype}")
+        for sample in metric["samples"]:
+            labels = sample.get("labels", {})
+            if mtype == "histogram":
+                bounds = list(metric.get("buckets", ())) + [math.inf]
+                cumulative = 0
+                for bound, n in zip(bounds, sample["buckets"]):
+                    cumulative += n
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_labels_text(labels, {**(extra_labels or {}), 'le': _fmt(bound)})}"
+                        f" {cumulative}"
+                    )
+                lines.append(
+                    f"{name}_sum{_labels_text(labels, extra_labels)}"
+                    f" {_fmt(sample['sum'])}"
+                )
+                lines.append(
+                    f"{name}_count{_labels_text(labels, extra_labels)}"
+                    f" {sample['count']}"
+                )
+            else:
+                lines.append(
+                    f"{name}{_labels_text(labels, extra_labels)}"
+                    f" {_fmt(sample['value'])}"
+                )
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def render(reg: MetricsRegistry | None = None,
+           extra_labels: dict | None = None) -> str:
+    return render_snapshot((reg or registry()).snapshot(),
+                           extra_labels=extra_labels)
+
+
+class MetricsServer:
+    """`GET /metrics` over ``ThreadingHTTPServer``; body from ``text_fn``."""
+
+    def __init__(self, text_fn: Callable[[], str] | None = None,
+                 port: int = 0, host: str = "0.0.0.0"):
+        self._text_fn = text_fn or render
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 - stdlib API
+                if self.path.split("?")[0] not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                try:
+                    body = outer._text_fn().encode("utf-8")
+                except Exception as e:  # noqa: BLE001 - keep serving
+                    self.send_error(500, str(e)[:200])
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args) -> None:  # silence per-scrape spam
+                pass
+
+        class _Server(ThreadingHTTPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> "MetricsServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="metrics-http",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def start_from_env(text_fn: Callable[[], str] | None = None,
+                   ) -> MetricsServer | None:
+    """Start the exposition endpoint iff ``DLROVER_TPU_METRICS_PORT`` is
+    set; returns None (no thread, no bind) otherwise."""
+    raw = os.environ.get(EnvKey.METRICS_PORT, "").strip()
+    if not raw:
+        return None
+    try:
+        port = int(raw)
+    except ValueError:
+        logger.warning("bad %s=%r; metrics endpoint disabled",
+                       EnvKey.METRICS_PORT, raw)
+        return None
+    try:
+        server = MetricsServer(text_fn=text_fn, port=port).start()
+    except OSError as e:
+        logger.warning("metrics endpoint bind failed on port %d: %s",
+                       port, e)
+        return None
+    logger.info("metrics endpoint serving on port %d", server.port)
+    return server

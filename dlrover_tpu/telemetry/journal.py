@@ -1,0 +1,191 @@
+"""Crash-safe cross-process event journal (JSONL spans).
+
+Every framework process (master, agents, trainers, serving) appends
+single-line JSON events to one shared file under
+``DLROVER_TPU_JOURNAL_DIR``. Appends use ``O_APPEND`` with one short
+``os.write`` per line, so concurrent writers interleave at line
+granularity and a SIGKILL loses at most its own final line — the same
+durability contract as ``utils/goodput.py``'s recorder.
+
+Span model: ``trace_id`` identifies the job (minted by the master at
+start, propagated to agents in the rendezvous payload and to trainers
+via ``DLROVER_TPU_TRACE_ID`` in the child env); ``span``/``parent``
+link events into trees across processes. Events are ``b`` (begin),
+``e`` (end, carries ``dur``), or ``p`` (point, optional ``dur`` for a
+completed interval recorded in one line). A begin with no matching end
+means the process died inside the span — the offline report treats it
+as open until the journal's last event.
+
+Span taxonomy (names are load-bearing for ``telemetry/report.py``):
+``rdzv_round`` (master), ``rendezvous_wait`` / ``node_restart`` /
+``ckpt_persist`` / ``hang_verdict`` (agent), ``compile`` /
+``train_step`` / ``ckpt_restore`` (trainer), ``serving_request``
+(serving), ``rpc_error`` (master).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from contextlib import contextmanager
+from typing import Iterator, Optional
+
+from dlrover_tpu.common.constants import EnvKey
+
+JOURNAL_FILE = "events.jsonl"
+
+
+def mint_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def current_trace_id() -> str:
+    return os.environ.get(EnvKey.TRACE_ID, "")
+
+
+def set_trace_id(trace_id: str) -> None:
+    """Adopt a trace id (agents call this with the rendezvous payload's
+    id; children inherit it through the environment)."""
+    if trace_id:
+        os.environ[EnvKey.TRACE_ID] = trace_id
+
+
+def _proc_name() -> str:
+    node = os.environ.get(EnvKey.NODE_ID)
+    if node is None:
+        return f"pid{os.getpid()}"
+    return f"node{node}"
+
+
+class EventJournal:
+    def __init__(self, path: str, proc: str | None = None,
+                 trace_id: str | None = None):
+        self._path = path
+        self._proc = proc or _proc_name()
+        self._trace = trace_id  # None -> read the env per event
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self._fd = os.open(path, os.O_APPEND | os.O_CREAT | os.O_WRONLY,
+                           0o644)
+
+    @property
+    def enabled(self) -> bool:
+        return True
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def _write(self, event: dict) -> None:
+        try:
+            os.write(self._fd,
+                     (json.dumps(event, separators=(",", ":")) + "\n")
+                     .encode("utf-8"))
+        except OSError:
+            pass  # telemetry must never take down the instrumented path
+
+    def _base(self, name: str, ev: str, span_id: str,
+              parent: str | None, fields: dict) -> dict:
+        event = {
+            "t": time.time(),
+            "trace": self._trace if self._trace is not None
+            else current_trace_id(),
+            "span": span_id,
+            "name": name,
+            "ev": ev,
+            "proc": self._proc,
+            "pid": os.getpid(),
+        }
+        if parent:
+            event["parent"] = parent
+        event.update(fields)
+        return event
+
+    def emit(self, name: str, parent: str | None = None,
+             dur: float | None = None, **fields) -> str:
+        """One-line point event; ``dur`` marks a completed interval that
+        ended at the event's timestamp."""
+        span_id = uuid.uuid4().hex[:12]
+        if dur is not None:
+            fields["dur"] = round(float(dur), 6)
+        self._write(self._base(name, "p", span_id, parent, fields))
+        return span_id
+
+    def begin(self, name: str, parent: str | None = None, **fields) -> str:
+        span_id = uuid.uuid4().hex[:12]
+        self._write(self._base(name, "b", span_id, parent, fields))
+        return span_id
+
+    def end(self, span_id: str, name: str, start: float | None = None,
+            **fields) -> None:
+        if start is not None:
+            fields["dur"] = round(time.time() - start, 6)
+        self._write(self._base(name, "e", span_id, None, fields))
+
+    @contextmanager
+    def span(self, name: str, parent: str | None = None,
+             **fields) -> Iterator[str]:
+        start = time.time()
+        span_id = self.begin(name, parent=parent, **fields)
+        try:
+            yield span_id
+        finally:
+            self.end(span_id, name, start=start)
+
+    def close(self) -> None:
+        try:
+            os.close(self._fd)
+        except OSError:
+            pass
+
+
+class NullJournal:
+    """API-compatible no-op used when journaling is not configured."""
+
+    enabled = False
+    path = ""
+
+    def emit(self, name: str, parent: str | None = None,
+             dur: float | None = None, **fields) -> str:
+        return ""
+
+    def begin(self, name: str, parent: str | None = None, **fields) -> str:
+        return ""
+
+    def end(self, span_id: str, name: str, start: float | None = None,
+            **fields) -> None:
+        pass
+
+    @contextmanager
+    def span(self, name: str, parent: str | None = None,
+             **fields) -> Iterator[str]:
+        yield ""
+
+    def close(self) -> None:
+        pass
+
+
+_cached: Optional[tuple[str, int, object]] = None
+
+
+def get_journal():
+    """The process journal: a real one when ``DLROVER_TPU_JOURNAL_DIR``
+    is set, else a no-op. Cached per (dir, pid) so forked children get
+    their own fd."""
+    global _cached
+    journal_dir = os.environ.get(EnvKey.JOURNAL_DIR, "")
+    pid = os.getpid()
+    if _cached is not None and _cached[0] == journal_dir \
+            and _cached[1] == pid:
+        return _cached[2]
+    if not journal_dir:
+        journal: object = NullJournal()
+    else:
+        try:
+            journal = EventJournal(os.path.join(journal_dir, JOURNAL_FILE))
+        except OSError:
+            journal = NullJournal()
+    _cached = (journal_dir, pid, journal)
+    return journal

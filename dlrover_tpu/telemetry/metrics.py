@@ -1,0 +1,228 @@
+"""Thread-safe labeled metrics registry (Counter / Gauge / Histogram).
+
+Prometheus' client-library data model, reimplemented on the stdlib so the
+framework stays dependency-free. Conventions:
+
+- every metric name matches ``dlrover_tpu_[a-z_]+`` and is registered in
+  exactly one call site (``native/check_metric_names.py`` lints this);
+- registration is get-or-create and idempotent, so hot paths may call
+  ``registry().counter`` with the same literal name repeatedly — but
+  callers on genuinely hot loops should still hold the child;
+- ``snapshot()`` returns a JSON-able list the agent ships to the master
+  in a ``MetricsSnapshotRequest`` (common/messages.py), where it is
+  re-rendered with a ``node`` label by the master's exposition endpoint.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Iterable
+
+NAME_RE = re.compile(r"^dlrover_tpu_[a-z_]+$")
+
+# Latency-oriented defaults: control-plane RPCs sit in the ms range,
+# checkpoint persists and rendezvous rounds in seconds-to-minutes.
+DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+)
+
+
+class _Child:
+    """One labeled series of a metric."""
+
+    __slots__ = ("_metric", "_labels", "value", "buckets", "sum", "count")
+
+    def __init__(self, metric: "_Metric", labels: tuple[str, ...]):
+        self._metric = metric
+        self._labels = labels
+        self.value = 0.0
+        if metric.type == "histogram":
+            self.buckets = [0] * (len(metric.buckets) + 1)  # + +Inf
+            self.sum = 0.0
+            self.count = 0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if self._metric.type == "counter" and amount < 0:
+            raise ValueError("counters only go up")
+        with self._metric.lock:
+            self.value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        if self._metric.type != "gauge":
+            raise TypeError("dec() is gauge-only")
+        with self._metric.lock:
+            self.value -= amount
+
+    def set(self, value: float) -> None:
+        if self._metric.type != "gauge":
+            raise TypeError("set() is gauge-only")
+        with self._metric.lock:
+            self.value = float(value)
+
+    def observe(self, value: float) -> None:
+        if self._metric.type != "histogram":
+            raise TypeError("observe() is histogram-only")
+        value = float(value)
+        with self._metric.lock:
+            i = 0
+            bounds = self._metric.buckets
+            while i < len(bounds) and value > bounds[i]:
+                i += 1
+            self.buckets[i] += 1
+            self.sum += value
+            self.count += 1
+
+
+class _Metric:
+    def __init__(self, name: str, help: str, type: str,
+                 label_names: tuple[str, ...],
+                 buckets: tuple[float, ...] = ()):
+        if not NAME_RE.match(name):
+            raise ValueError(
+                f"metric name {name!r} must match {NAME_RE.pattern}"
+            )
+        self.name = name
+        self.help = help
+        self.type = type
+        self.label_names = label_names
+        if type == "histogram":
+            b = tuple(sorted(float(x) for x in buckets or DEFAULT_BUCKETS))
+            if len(set(b)) != len(b):
+                raise ValueError("duplicate histogram buckets")
+            self.buckets = b
+        else:
+            self.buckets = ()
+        self.lock = threading.Lock()
+        self._children: dict[tuple[str, ...], _Child] = {}
+
+    def labels(self, *values: str, **kw: str) -> _Child:
+        if kw:
+            if values:
+                raise ValueError("pass labels positionally or by name")
+            values = tuple(str(kw[n]) for n in self.label_names)
+        else:
+            values = tuple(str(v) for v in values)
+        if len(values) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected labels {self.label_names}, "
+                f"got {values}"
+            )
+        with self.lock:
+            child = self._children.get(values)
+            if child is None:
+                child = self._children[values] = _Child(self, values)
+            return child
+
+    # unlabeled convenience: metric acts as its own single child
+    def _solo(self) -> _Child:
+        if self.label_names:
+            raise ValueError(f"{self.name} requires labels {self.label_names}")
+        return self.labels()
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._solo().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._solo().dec(amount)
+
+    def set(self, value: float) -> None:
+        self._solo().set(value)
+
+    def observe(self, value: float) -> None:
+        self._solo().observe(value)
+
+    def samples(self) -> list[dict]:
+        with self.lock:
+            out = []
+            for values, child in sorted(self._children.items()):
+                s: dict = {"labels": dict(zip(self.label_names, values))}
+                if self.type == "histogram":
+                    s["buckets"] = list(child.buckets)
+                    s["sum"] = child.sum
+                    s["count"] = child.count
+                else:
+                    s["value"] = child.value
+                out.append(s)
+            return out
+
+
+class Counter(_Metric):
+    def __init__(self, name, help="", label_names=()):
+        super().__init__(name, help, "counter", tuple(label_names))
+
+
+class Gauge(_Metric):
+    def __init__(self, name, help="", label_names=()):
+        super().__init__(name, help, "gauge", tuple(label_names))
+
+
+class Histogram(_Metric):
+    def __init__(self, name, help="", label_names=(), buckets=()):
+        super().__init__(name, help, "histogram", tuple(label_names),
+                         buckets=tuple(buckets))
+
+
+class MetricsRegistry:
+    """Process-local registry; get-or-create registration."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def _register(self, cls, name: str, help: str,
+                  label_names: Iterable[str], **kw) -> _Metric:
+        label_names = tuple(label_names)
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if (type(existing) is not cls
+                        or existing.label_names != label_names):
+                    raise ValueError(
+                        f"metric {name!r} already registered with a "
+                        "different type or label set"
+                    )
+                return existing
+            metric = cls(name, help, label_names, **kw)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help: str = "",
+                label_names: Iterable[str] = ()) -> Counter:
+        return self._register(Counter, name, help, label_names)
+
+    def gauge(self, name: str, help: str = "",
+              label_names: Iterable[str] = ()) -> Gauge:
+        return self._register(Gauge, name, help, label_names)
+
+    def histogram(self, name: str, help: str = "",
+                  label_names: Iterable[str] = (),
+                  buckets: Iterable[float] = ()) -> Histogram:
+        return self._register(Histogram, name, help, label_names,
+                              buckets=tuple(buckets))
+
+    def metrics(self) -> list[_Metric]:
+        with self._lock:
+            return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def snapshot(self) -> list[dict]:
+        """JSON-able dump for MetricsSnapshotRequest / cross-process merge."""
+        out = []
+        for metric in self.metrics():
+            out.append({
+                "name": metric.name,
+                "type": metric.type,
+                "help": metric.help,
+                "buckets": list(metric.buckets),
+                "samples": metric.samples(),
+            })
+        return out
+
+
+_default = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    """The process-default registry every instrumented module uses."""
+    return _default

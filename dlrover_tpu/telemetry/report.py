@@ -1,0 +1,284 @@
+"""Offline lost-time attribution: join the event journal with goodput.
+
+``python -m dlrover_tpu.telemetry.report --journal <dir-or-file>
+[--goodput-log <jsonl>]`` prints where the wall-clock went: the total
+lost time comes from ``utils/goodput.py``'s accounting (total −
+productive over the warm window), and the journal's spans attribute it
+by cause — rendezvous vs respawn vs recompile vs restore vs rollback —
+with the remainder reported as unattributed.
+
+Attribution is interval-union based: per category, the spans from every
+process are merged into disjoint intervals and clipped to the goodput
+warm window, so two agents re-rendezvousing concurrently count the
+stall once, the way the job experienced it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+from typing import Iterable, Optional
+
+from dlrover_tpu.utils.goodput import GoodputReport, compute_goodput
+
+# span name -> lost-time category (journal.py documents the taxonomy)
+CATEGORY_OF = {
+    "rdzv_round": "rendezvous",
+    "rendezvous_wait": "rendezvous",
+    "node_restart": "respawn",
+    "compile": "recompile",
+    "ckpt_restore": "restore",
+}
+CATEGORIES = ("rendezvous", "respawn", "recompile", "restore", "rollback")
+
+
+def load_events(path: str) -> list[dict]:
+    """Parse one journal file, or every ``*.jsonl`` in a directory."""
+    files: list[str] = []
+    if os.path.isdir(path):
+        files = sorted(
+            os.path.join(path, f) for f in os.listdir(path)
+            if f.endswith(".jsonl")
+        )
+    elif os.path.exists(path):
+        files = [path]
+    events: list[dict] = []
+    for fp in files:
+        with open(fp) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn final line after a SIGKILL
+                if isinstance(ev, dict) and "t" in ev and "name" in ev:
+                    events.append(ev)
+    events.sort(key=lambda e: e["t"])
+    return events
+
+
+@dataclasses.dataclass
+class Span:
+    span_id: str
+    name: str
+    proc: str
+    trace: str
+    start: float
+    end: float
+    parent: str = ""
+    open: bool = False  # begin with no end: the process died inside
+    fields: dict = dataclasses.field(default_factory=dict)
+
+
+def pair_spans(events: list[dict]) -> list[Span]:
+    """Reassemble spans from b/e/p lines; an unmatched begin is closed at
+    the journal's final timestamp (crash semantics)."""
+    if not events:
+        return []
+    last_t = events[-1]["t"]
+    meta = {"t", "trace", "span", "name", "ev", "proc", "pid", "parent",
+            "dur"}
+    spans: list[Span] = []
+    open_spans: dict[str, Span] = {}
+    for ev in events:
+        kind = ev.get("ev")
+        fields = {k: v for k, v in ev.items() if k not in meta}
+        if kind == "b":
+            span = Span(
+                span_id=ev.get("span", ""), name=ev["name"],
+                proc=ev.get("proc", ""), trace=ev.get("trace", ""),
+                start=ev["t"], end=last_t, parent=ev.get("parent", ""),
+                open=True, fields=fields,
+            )
+            open_spans[span.span_id] = span
+            spans.append(span)
+        elif kind == "e":
+            span = open_spans.pop(ev.get("span", ""), None)
+            if span is not None:
+                span.end = ev["t"]
+                span.open = False
+                span.fields.update(fields)
+        else:  # point
+            dur = float(ev.get("dur", 0.0) or 0.0)
+            spans.append(Span(
+                span_id=ev.get("span", ""), name=ev["name"],
+                proc=ev.get("proc", ""), trace=ev.get("trace", ""),
+                start=ev["t"] - dur, end=ev["t"],
+                parent=ev.get("parent", ""), fields=fields,
+            ))
+    return spans
+
+
+def _union_seconds(intervals: Iterable[tuple[float, float]],
+                   window: tuple[float, float] | None = None) -> float:
+    clipped = []
+    for start, end in intervals:
+        if window is not None:
+            start, end = max(start, window[0]), min(end, window[1])
+        if end > start:
+            clipped.append((start, end))
+    total = 0.0
+    cur_s = cur_e = None
+    for start, end in sorted(clipped):
+        if cur_e is None or start > cur_e:
+            if cur_e is not None:
+                total += cur_e - cur_s
+            cur_s, cur_e = start, end
+        else:
+            cur_e = max(cur_e, end)
+    if cur_e is not None:
+        total += cur_e - cur_s
+    return total
+
+
+@dataclasses.dataclass
+class LostTimeReport:
+    total_s: float
+    productive_s: float
+    lost_s: float
+    goodput: float
+    categories: dict[str, float]
+    unattributed_s: float
+    n_spans: int
+    traces: list[str]
+    goodput_report: Optional[GoodputReport] = None
+
+    def to_dict(self) -> dict:
+        d = {
+            "total_s": round(self.total_s, 4),
+            "productive_s": round(self.productive_s, 4),
+            "lost_s": round(self.lost_s, 4),
+            "goodput": round(self.goodput, 4),
+            "categories": {k: round(v, 4)
+                           for k, v in self.categories.items()},
+            "unattributed_s": round(self.unattributed_s, 4),
+            "n_spans": self.n_spans,
+            "traces": self.traces,
+        }
+        if self.goodput_report is not None:
+            d["goodput_report"] = self.goodput_report.to_dict()
+        return d
+
+
+def build_report(journal_path: str, goodput_log: str | None = None,
+                 end_time: float | None = None,
+                 trace: str | None = None) -> LostTimeReport:
+    events = load_events(journal_path)
+    spans = pair_spans(events)
+    if trace:
+        spans = [s for s in spans if s.trace == trace]
+    traces = sorted({s.trace for s in spans if s.trace})
+
+    greport: GoodputReport | None = None
+    window: tuple[float, float] | None = None
+    median = 0.0
+    if goodput_log:
+        greport = compute_goodput(goodput_log, end_time=end_time)
+        median = greport.median_step_s
+        # reconstruct the warm window's absolute bounds: compute_goodput
+        # measures total_s back from the log's final event (or end_time)
+        from dlrover_tpu.utils.goodput import _parse_events
+
+        gevents = _parse_events(goodput_log)
+        t_end = gevents[-1]["t"]
+        if end_time is not None:
+            t_end = max(t_end, end_time)
+        window = (t_end - greport.total_s, t_end)
+
+    by_cat: dict[str, list[tuple[float, float]]] = {}
+    for span in spans:
+        cat = CATEGORY_OF.get(span.name)
+        if cat is None:
+            continue
+        start, end = span.start, span.end
+        if cat == "recompile" and median > 0:
+            # trainer "compile" events time the whole first step; the
+            # step's own compute is training, not lost time
+            end = max(start, end - median)
+        by_cat.setdefault(cat, []).append((start, end))
+
+    categories = {
+        cat: _union_seconds(by_cat.get(cat, ()), window)
+        for cat in CATEGORIES if cat != "rollback"
+    }
+    categories["rollback"] = (
+        greport.redone_steps * median if greport is not None else 0.0
+    )
+
+    if greport is not None:
+        total, productive = greport.total_s, greport.productive_s
+        lost, goodput = greport.lost_s, greport.goodput
+    else:
+        # journal-only mode: no productive-time accounting, so "lost" is
+        # just the union of everything the journal attributes
+        all_intervals = [iv for ivs in by_cat.values() for iv in ivs]
+        lost = _union_seconds(all_intervals, window)
+        total, productive, goodput = lost, 0.0, 0.0
+
+    attributed = _union_seconds(
+        [iv for ivs in by_cat.values() for iv in ivs], window
+    ) + categories["rollback"]
+    return LostTimeReport(
+        total_s=total,
+        productive_s=productive,
+        lost_s=lost,
+        goodput=goodput,
+        categories=categories,
+        unattributed_s=max(0.0, lost - attributed),
+        n_spans=len(spans),
+        traces=traces,
+        goodput_report=greport,
+    )
+
+
+def format_report(report: LostTimeReport) -> str:
+    lines = [
+        f"lost-time breakdown ({report.n_spans} spans, "
+        f"traces: {', '.join(report.traces) or 'none'})",
+        f"  total wall (warm) : {report.total_s:10.2f} s",
+        f"  productive        : {report.productive_s:10.2f} s"
+        f"   (goodput {report.goodput:.4f})",
+        f"  lost              : {report.lost_s:10.2f} s",
+    ]
+    for cat in CATEGORIES:
+        lines.append(
+            f"    {cat:<14}  : {report.categories.get(cat, 0.0):10.2f} s"
+        )
+    lines.append(f"    {'unattributed':<14}  : "
+                 f"{report.unattributed_s:10.2f} s")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        "python -m dlrover_tpu.telemetry.report",
+        description="attribute lost training time by cause",
+    )
+    parser.add_argument("--journal", required=True,
+                        help="journal file or DLROVER_TPU_JOURNAL_DIR dir")
+    parser.add_argument("--goodput-log", default="",
+                        help="per-step goodput JSONL (utils/goodput.py); "
+                             "anchors total lost time when given")
+    parser.add_argument("--end-time", type=float, default=None)
+    parser.add_argument("--trace", default=None,
+                        help="restrict to one trace id")
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args(argv)
+    report = build_report(
+        args.journal, goodput_log=args.goodput_log or None,
+        end_time=args.end_time, trace=args.trace,
+    )
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=2))
+    else:
+        print(format_report(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

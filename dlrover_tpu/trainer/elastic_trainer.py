@@ -21,9 +21,25 @@ import numpy as np
 from dlrover_tpu.common.constants import EnvKey
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.parallel.mesh import data_parallel_size
+from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.metrics import registry
 from dlrover_tpu.trainer.train_step import CompiledTrain, TrainState
 
 logger = get_logger(__name__)
+
+_step_seconds = registry().histogram(
+    "dlrover_tpu_train_step_seconds",
+    "train_step wall time (dispatch-to-dispatch; first call of an "
+    "incarnation carries the XLA compile)",
+)
+_steps_total = registry().counter(
+    "dlrover_tpu_train_steps_total",
+    "optimizer steps executed by this process",
+)
+_compile_seconds = registry().histogram(
+    "dlrover_tpu_compile_seconds",
+    "first-step wall time per incarnation (XLA compile + one step)",
+)
 
 
 class BatchAssembler:
@@ -90,6 +106,7 @@ class ElasticTrainer:
         from dlrover_tpu.agent.hang_detector import ProgressReporter
 
         self._progress = ProgressReporter()
+        self._first_dispatch = True
         self._client = master_client
         if self._client is None and os.environ.get(EnvKey.MASTER_ADDR):
             from dlrover_tpu.agent.master_client import MasterClient
@@ -102,6 +119,7 @@ class ElasticTrainer:
 
     def train_step(self, state: TrainState, batch: dict
                    ) -> tuple[TrainState, dict]:
+        step_start = time.monotonic()
         if self.num_processes > 1:
             sharding = self.compiled.batch_sharding
             batch = jax.tree.map(
@@ -118,6 +136,21 @@ class ElasticTrainer:
         # host-side counter: reading state.step would block async dispatch
         self._host_step += 1
         step = self._host_step
+        step_wall = time.monotonic() - step_start
+        _step_seconds.observe(step_wall)
+        _steps_total.inc()
+        if self._first_dispatch:
+            # the incarnation's first call traces + compiles (or loads
+            # the persistent compile cache) before dispatching — the
+            # recompile cost class the lost-time report attributes.
+            # jax dispatch is async, so this is an upper bound that
+            # includes one step of compute; the report subtracts the
+            # steady median.
+            self._first_dispatch = False
+            _compile_seconds.observe(step_wall)
+            get_journal().emit("compile", dur=step_wall, step=step)
+        else:
+            get_journal().emit("train_step", dur=step_wall, step=step)
         self._progress.report(step)
         if self._client is not None and step % self._report_interval == 0:
             try:

@@ -1,0 +1,82 @@
+#!/usr/bin/env python3
+"""Lint: every metric registered in dlrover_tpu/ is well-named and unique.
+
+Walks the package source for ``registry().counter("...")`` /
+``.gauge("...")`` / ``.histogram("...")`` registrations and asserts
+
+- every name matches ``dlrover_tpu_[a-z_]+`` (no digits, no dots — the
+  Prometheus-safe subset the exposition endpoint promises), and
+- every name is registered in exactly one call site, so the endpoint can
+  never emit colliding series with divergent help/type/labels.
+
+Invoked from the tier-1 suite (tests/test_telemetry.py) and runnable
+standalone: ``python native/check_metric_names.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+NAME_RE = re.compile(r"^dlrover_tpu_[a-z_]+$")
+REG_RE = re.compile(
+    r"\.\s*(counter|gauge|histogram)\(\s*(?:\n\s*)?"
+    r"(?:(?P<q>['\"])(?P<name>[^'\"]+)(?P=q)|(?P<nonlit>[A-Za-z_f][^,)]*))"
+)
+
+PKG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "dlrover_tpu")
+
+
+def scan(pkg_dir: str = PKG) -> tuple[dict[str, list[str]], list[str]]:
+    """(name -> [call sites], problems)."""
+    names: dict[str, list[str]] = {}
+    problems: list[str] = []
+    for root, _dirs, files in os.walk(pkg_dir):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, os.path.dirname(pkg_dir))
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            for match in REG_RE.finditer(text):
+                line = text.count("\n", 0, match.start()) + 1
+                site = f"{rel}:{line}"
+                if match.group("name") is None:
+                    # non-literal first argument: the lint (and grep-
+                    # ability) relies on literal names at the call site
+                    problems.append(
+                        f"{site}: metric registered with a non-literal "
+                        f"name ({match.group('nonlit')!r})"
+                    )
+                    continue
+                name = match.group("name")
+                if not NAME_RE.match(name):
+                    problems.append(
+                        f"{site}: metric name {name!r} does not match "
+                        f"{NAME_RE.pattern}"
+                    )
+                names.setdefault(name, []).append(site)
+    for name, sites in sorted(names.items()):
+        if len(sites) > 1:
+            problems.append(
+                f"metric {name!r} registered at {len(sites)} call sites "
+                f"({', '.join(sites)}); names must be unique"
+            )
+    return names, problems
+
+
+def main() -> int:
+    names, problems = scan()
+    if problems:
+        for p in problems:
+            print(f"check_metric_names: {p}", file=sys.stderr)
+        return 1
+    print(f"check_metric_names: {len(names)} metric names OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,6 +29,10 @@ def _env(tmp_path) -> dict:
             "DLROVER_TPU_PLATFORM": "cpu",
             "DLROVER_TPU_DEVICE_COUNT": "4",
             "DLROVER_TPU_IPC_DIR": str(tmp_path / "ipc"),
+            # cross-process event journal: master mints the trace id,
+            # agents adopt it from the rendezvous payload, trainers
+            # inherit it through the child env
+            "DLROVER_TPU_JOURNAL_DIR": str(tmp_path / "journal"),
             "PYTHONPATH": REPO,
             # 4 virtual devices per process -> 8 global over 2 nodes
             "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
@@ -301,3 +305,21 @@ def test_two_node_kill_one_trainer_recovers(tmp_path):
     assert r.n_steps == 30
     assert r.n_incarnations >= 2
     assert 0.0 < r.goodput <= 1.0
+    # telemetry acceptance: the report over the journal this run produced
+    # agrees with goodput's (total - productive) within 5%, and the trace
+    # id propagated master -> agents -> trainers
+    from dlrover_tpu.telemetry.report import build_report, load_events
+
+    events = load_events(str(tmp_path / "journal"))
+    assert events, "journal never written"
+    traces = {e["trace"] for e in events if e.get("trace")}
+    assert len(traces) == 1, f"expected one job trace, got {traces}"
+    procs = {e["proc"] for e in events}
+    assert len(procs) >= 2, f"journal only saw {procs}"
+    names = {e["name"] for e in events}
+    assert "rdzv_round" in names          # master-side span
+    assert "node_restart" in names        # agent-side recovery span
+    report = build_report(str(tmp_path / "journal"),
+                          goodput_log=goodput_log)
+    assert abs(report.lost_s - r.lost_s) <= 0.05 * max(r.lost_s, 0.1)
+    assert report.categories["respawn"] > 0.0

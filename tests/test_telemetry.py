@@ -1,0 +1,443 @@
+"""Unified telemetry: registry, exposition, journal, lost-time report.
+
+Covers ISSUE 1's acceptance surface hermetically: registry concurrency,
+histogram bucket edges, Prometheus text rendering (parsed here, no
+external deps), journal span linkage across simulated process death,
+the lost-time report on a synthetic restart trace, the speed-monitor
+cold-start regression, and the metric-name lint.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common import serde
+from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.telemetry.exposition import (
+    MetricsServer,
+    render,
+    render_snapshot,
+    start_from_env,
+)
+from dlrover_tpu.telemetry.journal import EventJournal, NullJournal
+from dlrover_tpu.telemetry.metrics import MetricsRegistry
+from dlrover_tpu.telemetry.report import (
+    build_report,
+    load_events,
+    pair_spans,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------------ registry
+
+
+def test_counter_concurrent_increments():
+    reg = MetricsRegistry()
+    counter = reg.counter("dlrover_tpu_concurrency_total", "t",
+                          label_names=("worker",))
+
+    def worker(i: int) -> None:
+        child = counter.labels(str(i % 2))
+        for _ in range(5000):
+            child.inc()
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    samples = counter.samples()
+    assert sum(s["value"] for s in samples) == 8 * 5000
+    assert {s["labels"]["worker"] for s in samples} == {"0", "1"}
+
+
+def test_histogram_bucket_edges():
+    reg = MetricsRegistry()
+    hist = reg.histogram("dlrover_tpu_edges_seconds", "t",
+                         buckets=(1.0, 2.0))
+    for v in (0.5, 1.0, 1.5, 2.0, 99.0):
+        hist.observe(v)
+    (sample,) = hist.samples()
+    # le is inclusive: observations AT a bound land in that bucket
+    assert sample["buckets"] == [2, 2, 1]  # (<=1, <=2, +Inf)
+    assert sample["count"] == 5
+    assert sample["sum"] == pytest.approx(104.0)
+
+
+def test_registry_rejects_bad_names_and_redefinition():
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError):
+        reg.counter("not_namespaced_total")
+    with pytest.raises(ValueError):
+        reg.counter("dlrover_tpu_bad1_total")  # digits not allowed
+    reg.counter("dlrover_tpu_same_total", label_names=("a",))
+    # get-or-create: identical registration returns the same metric
+    assert reg.counter("dlrover_tpu_same_total", label_names=("a",)) \
+        is reg.counter("dlrover_tpu_same_total", label_names=("a",))
+    with pytest.raises(ValueError):
+        reg.gauge("dlrover_tpu_same_total")  # type change
+    with pytest.raises(ValueError):
+        reg.counter("dlrover_tpu_same_total", label_names=("b",))
+
+
+def test_counter_rejects_negative_and_gauge_moves_both_ways():
+    reg = MetricsRegistry()
+    counter = reg.counter("dlrover_tpu_updown_total")
+    with pytest.raises(ValueError):
+        counter.inc(-1)
+    gauge = reg.gauge("dlrover_tpu_level")
+    gauge.set(5)
+    gauge.dec(2)
+    assert gauge.samples()[0]["value"] == 3
+
+
+# ---------------------------------------------------------------- exposition
+
+
+def _parse_prom(text: str) -> dict[str, float]:
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, value = line.rsplit(" ", 1)
+        out[name] = float(value)
+    return out
+
+
+def test_prometheus_rendering():
+    reg = MetricsRegistry()
+    counter = reg.counter("dlrover_tpu_render_total", "help text",
+                          label_names=("kind",))
+    counter.labels('with"quote').inc(3)
+    hist = reg.histogram("dlrover_tpu_render_seconds", "h",
+                         buckets=(0.5, 1.0))
+    hist.observe(0.2)
+    hist.observe(0.7)
+    text = render(reg)
+    assert "# HELP dlrover_tpu_render_total help text" in text
+    assert "# TYPE dlrover_tpu_render_total counter" in text
+    assert "# TYPE dlrover_tpu_render_seconds histogram" in text
+    values = _parse_prom(text)
+    assert values['dlrover_tpu_render_total{kind="with\\"quote"}'] == 3
+    assert values['dlrover_tpu_render_seconds_bucket{le="0.5"}'] == 1
+    assert values['dlrover_tpu_render_seconds_bucket{le="1"}'] == 2
+    assert values['dlrover_tpu_render_seconds_bucket{le="+Inf"}'] == 2
+    assert values["dlrover_tpu_render_seconds_count"] == 2
+    assert values["dlrover_tpu_render_seconds_sum"] == pytest.approx(0.9)
+    # extra labels (the master's per-node re-render path)
+    merged = render_snapshot(reg.snapshot(), extra_labels={"node": "3"},
+                             emit_meta=False)
+    assert 'node="3"' in merged
+    assert "# TYPE" not in merged
+
+
+def test_http_endpoint_serves_and_env_gates(monkeypatch):
+    reg = MetricsRegistry()
+    reg.counter("dlrover_tpu_http_total").inc(7)
+    server = MetricsServer(text_fn=lambda: render(reg), port=0,
+                           host="127.0.0.1").start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/metrics"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            assert resp.status == 200
+            assert "text/plain" in resp.headers["Content-Type"]
+            body = resp.read().decode()
+        assert _parse_prom(body)["dlrover_tpu_http_total"] == 7
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/nope", timeout=10
+            )
+    finally:
+        server.stop()
+    # fully off unless the env var is set: no thread, no bind
+    monkeypatch.delenv(EnvKey.METRICS_PORT, raising=False)
+    assert start_from_env() is None
+    monkeypatch.setenv(EnvKey.METRICS_PORT, "not-a-port")
+    assert start_from_env() is None
+    monkeypatch.setenv(EnvKey.METRICS_PORT, "0")
+    server = start_from_env(text_fn=lambda: render(reg))
+    try:
+        assert server is not None and server.port > 0
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------------------------- journal
+
+
+def test_journal_disabled_without_env(monkeypatch):
+    from dlrover_tpu.telemetry import journal as journal_mod
+
+    monkeypatch.delenv(EnvKey.JOURNAL_DIR, raising=False)
+    monkeypatch.setattr(journal_mod, "_cached", None)
+    j = journal_mod.get_journal()
+    assert isinstance(j, NullJournal)
+    assert j.emit("x") == ""
+    with j.span("y"):
+        pass  # no file appears anywhere
+
+
+def test_journal_linkage_across_process_death(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    # two writers on one O_APPEND file = two processes of one job
+    agent = EventJournal(path, proc="agent0", trace_id="tr")
+    trainer = EventJournal(path, proc="trainer0", trace_id="tr")
+    restart = agent.begin("node_restart", kind="failure")
+    start = time.time()
+    child = trainer.begin("ckpt_restore", parent=restart, step=7)
+    time.sleep(0.2)
+    trainer.end(child, "ckpt_restore", start=start)
+    # the agent is SIGKILLed before ending its span: no end line ever
+    agent.close()
+    trainer.emit("compile", dur=0.5)  # last event stamps the journal end
+    trainer.close()
+
+    events = load_events(path)
+    assert all(e["trace"] == "tr" for e in events)
+    spans = {(s.name, s.proc): s for s in pair_spans(events)}
+    parent = spans[("node_restart", "agent0")]
+    restore = spans[("ckpt_restore", "trainer0")]
+    assert restore.parent == parent.span_id  # cross-process linkage
+    assert not restore.open
+    assert restore.end - restore.start == pytest.approx(0.2, abs=0.15)
+    # crash semantics: the open span is closed at the journal's last event
+    assert parent.open
+    assert parent.end == max(e["t"] for e in events)
+
+
+def test_journal_survives_torn_final_line(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    j = EventJournal(path, proc="p", trace_id="tr")
+    j.emit("train_step", dur=0.1)
+    j.close()
+    with open(path, "a") as f:
+        f.write('{"t": 1.0, "name": "tr')  # SIGKILL mid-write
+    assert len(load_events(path)) == 1
+
+
+# --------------------------------------------------------- lost-time report
+
+
+def _write_synthetic_trace(tmp_path) -> tuple[str, str]:
+    """10 one-second steps, a 20s crash+recovery, one redone step, 10
+    more steps — with journal spans covering the recovery phases."""
+    t0 = 1_000_000.0
+    glog = tmp_path / "goodput.jsonl"
+    with open(glog, "w") as f:
+        def ev(d):
+            f.write(json.dumps(d) + "\n")
+
+        ev({"ev": "start", "t": t0, "restart": 0})
+        for i in range(1, 11):
+            ev({"ev": "step", "step": i, "t": t0 + i})
+        ev({"ev": "start", "t": t0 + 29.0, "restart": 1})
+        ev({"ev": "step", "step": 10, "t": t0 + 32.0})  # redone after rollback
+        for i in range(11, 21):
+            ev({"ev": "step", "step": i, "t": t0 + 32.0 + (i - 10)})
+
+    jpath = tmp_path / "events.jsonl"
+    with open(jpath, "w") as f:
+        def line(**kw):
+            kw.setdefault("trace", "tr")
+            kw.setdefault("proc", "agent0")
+            f.write(json.dumps(kw) + "\n")
+
+        line(t=t0 + 10.5, name="node_restart", ev="b", span="aaa",
+             kind="failure")
+        line(t=t0 + 18.0, name="rendezvous_wait", ev="p", span="bbb",
+             dur=5.0)
+        line(t=t0 + 29.5, name="node_restart", ev="e", span="aaa")
+        line(t=t0 + 30.0, name="ckpt_restore", ev="p", span="ccc",
+             dur=0.5, proc="trainer0")
+        line(t=t0 + 32.0, name="compile", ev="p", span="ddd", dur=2.8,
+             proc="trainer0")
+        line(t=t0 + 42.0, name="train_step", ev="p", span="eee", dur=1.0,
+             proc="trainer0")
+    return str(jpath), str(glog)
+
+
+def test_lost_time_report_on_synthetic_restart_trace(tmp_path):
+    from dlrover_tpu.utils.goodput import compute_goodput
+
+    jpath, glog = _write_synthetic_trace(tmp_path)
+    greport = compute_goodput(glog)
+    assert greport.n_incarnations == 2
+    assert greport.redone_steps == 1
+
+    report = build_report(jpath, goodput_log=glog)
+    # total lost time anchored to goodput accounting: within 5%
+    assert report.lost_s == pytest.approx(greport.lost_s,
+                                          rel=0.05)
+    assert report.total_s == pytest.approx(greport.total_s, rel=0.05)
+    cats = report.categories
+    assert cats["respawn"] == pytest.approx(19.0, abs=0.1)
+    assert cats["rendezvous"] == pytest.approx(5.0, abs=0.1)
+    assert cats["restore"] == pytest.approx(0.5, abs=0.1)
+    # compile event covers first-step compute too; the report nets out
+    # one steady median step
+    assert cats["recompile"] == pytest.approx(1.8, abs=0.1)
+    assert cats["rollback"] == pytest.approx(greport.median_step_s,
+                                             abs=0.1)
+    # attribution is interval-union based, so overlapping spans never
+    # push the attributed total past the lost total
+    assert report.unattributed_s >= 0.0
+    assert report.unattributed_s <= report.lost_s
+    assert report.traces == ["tr"]
+
+    # journal-only mode still attributes the recovery phases: the union
+    # of node_restart (10.5..29.5) and the unadjusted compile (29.2..32)
+    jonly = build_report(jpath)
+    assert jonly.lost_s == pytest.approx(21.5, abs=0.1)
+
+
+def test_report_cli(tmp_path, capsys):
+    from dlrover_tpu.telemetry.report import main
+
+    jpath, glog = _write_synthetic_trace(tmp_path)
+    assert main(["--journal", jpath, "--goodput-log", glog]) == 0
+    out = capsys.readouterr().out
+    assert "lost-time breakdown" in out
+    assert "rendezvous" in out and "respawn" in out
+    assert main(["--journal", jpath, "--goodput-log", glog,
+                 "--json"]) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["categories"]["respawn"] == pytest.approx(19.0, abs=0.1)
+
+
+# --------------------------------------------- speed monitor cold start fix
+
+
+def test_speed_monitor_cold_start_is_not_a_hang_or_lost_time():
+    from dlrover_tpu.master.speed_monitor import SpeedMonitor
+
+    monitor = SpeedMonitor(hang_timeout_s=5.0)
+    # simulate a monitor constructed long before workers first report
+    # (pod scheduling + rendezvous + first compile)
+    monitor._start_time = time.time() - 500.0
+    assert not monitor.hanged()          # silence pre-first-report != hang
+    monitor.reset_hang_clock()
+    assert not monitor.hanged()          # reset must not fake "started"
+    assert monitor.goodput() == 0.0
+
+    now = time.time()
+    for i in range(1, 6):
+        monitor.report_step(i, timestamp=now - 5 + i)
+    # the 500s cold-start window is startup, not lost time: goodput is
+    # computed from the first report (was ~0.01 before the fix)
+    assert monitor.goodput(now=now) > 0.9
+    assert not monitor.hanged()          # fresh report
+    # and a real post-start stall still trips the hang detector
+    monitor._last_report_time = now - 100.0
+    assert monitor.hanged()
+
+
+# --------------------------------------------------- control-plane plumbing
+
+
+def _local_master(tmp_path):
+    from dlrover_tpu.master.job_master import JobMaster
+
+    return JobMaster(job_name="telemetry-test", port=0, min_nodes=1,
+                     max_nodes=1)
+
+
+def test_metrics_snapshot_rpc_and_master_aggregation(tmp_path, monkeypatch):
+    monkeypatch.delenv(EnvKey.METRICS_PORT, raising=False)
+    monkeypatch.delenv(EnvKey.TRACE_ID, raising=False)
+    master = _local_master(tmp_path)
+    try:
+        assert master.trace_id  # minted at job start
+        reg = MetricsRegistry()
+        reg.counter("dlrover_tpu_pushed_total").inc(4)
+        # over-the-wire shape: encode/decode like the RPC layer does
+        req = serde.decode(serde.encode(m.MetricsSnapshotRequest(
+            node_id=3, role="agent", samples=reg.snapshot(),
+        )))
+        resp = master.servicer.handle(req)
+        assert isinstance(resp, m.OkResponse)
+        text = master.metrics_text()
+        assert 'dlrover_tpu_pushed_total{node="3",role="agent"} 4' in text
+        # master's own dispatch histogram saw the snapshot RPC
+        assert ('dlrover_tpu_master_rpc_seconds_count'
+                '{role="master",type="MetricsSnapshotRequest"}') in text
+    finally:
+        master._server._server.server_close()
+
+
+def test_job_stats_series_over_rpc(tmp_path):
+    master = _local_master(tmp_path)
+    try:
+        for cpu in (10.0, 20.0, 30.0):
+            master.servicer.handle(m.ResourceStats(
+                node_id=1, cpu_percent=cpu, used_memory_mb=100,
+            ))
+        resp = master.servicer.handle(m.JobStatsRequest(include_series=True))
+        resp = serde.decode(serde.encode(resp))  # full wire round-trip
+        assert isinstance(resp, m.JobStatsResponse)
+        assert [s.cpu_percent for s in resp.series[1]] == [10.0, 20.0, 30.0]
+        assert all(s.timestamp > 0 for s in resp.series[1])
+        assert resp.nodes[0].cpu_percent == 30.0
+        # default request stays lean: no series payload
+        lean = master.servicer.handle(m.JobStatsRequest())
+        assert lean.series == {}
+    finally:
+        master._server._server.server_close()
+
+
+def test_comm_world_carries_trace_id(tmp_path):
+    master = _local_master(tmp_path)
+    try:
+        master.servicer.handle(m.JoinRendezvousRequest(
+            node_id=0, addr="127.0.0.1:1", local_devices=4,
+        ))
+        resp = master.servicer.handle(m.CommWorldRequest(node_id=0))
+        assert resp.completed
+        assert resp.trace_id == master.trace_id
+    finally:
+        master._server._server.server_close()
+
+
+# ------------------------------------------------------- json log satellite
+
+
+def test_json_log_format_carries_context(monkeypatch, capsys):
+    import logging
+
+    from dlrover_tpu.common.log import ContextFilter, JsonFormatter
+
+    monkeypatch.setenv(EnvKey.NODE_ID, "7")
+    monkeypatch.setenv(EnvKey.TRACE_ID, "tracey")
+    record = logging.LogRecord("tlog", logging.INFO, "f.py", 12,
+                               "hello %s", ("world",), None)
+    assert ContextFilter().filter(record)
+    entry = json.loads(JsonFormatter().format(record))
+    assert entry["msg"] == "hello world"
+    assert entry["node_id"] == "7"
+    assert entry["trace_id"] == "tracey"
+    assert entry["level"] == "INFO"
+
+
+# -------------------------------------------------------- metric name lint
+
+
+def test_metric_names_lint_passes():
+    spec = importlib.util.spec_from_file_location(
+        "check_metric_names",
+        os.path.join(REPO, "native", "check_metric_names.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    names, problems = mod.scan()
+    assert problems == []
+    assert len(names) >= 10  # the instrumented surface actually registered
+    assert all(name.startswith("dlrover_tpu_") for name in names)
