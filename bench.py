@@ -1082,6 +1082,109 @@ def bench_serving(extra: dict) -> None:
     extra["serving_toks_per_s_block1"] = round(run(1), 1)
 
 
+def bench_gateway(extra: dict) -> None:
+    """Elastic serving gateway under open-loop load with a mid-run
+    replica kill (gateway/: pool + router + admission + autoscaler).
+
+    2 x gpt2-small replicas, seeded Poisson-ish open-loop arrivals.
+    Halfway through the request schedule one replica is killed
+    abruptly; the acceptance bar is ZERO failed in-flight requests
+    (orphans re-route to the survivor, minted seeds keep results
+    identical) while the autoscaler restores the replica count through
+    the ScalePlan path. Reported: completed req/s over the measured
+    window and p95 end-to-end latency — both including the kill, which
+    is the point.
+    """
+    if os.environ.get("BENCH_GATEWAY", "1") == "0":
+        return
+    import jax
+
+    if jax.devices()[0].platform != "tpu":
+        return
+
+    from dlrover_tpu.gateway import Gateway, GatewayAutoscaler, PoolScaler
+    from dlrover_tpu.models import transformer as tfm
+    from dlrover_tpu.serving import InferenceEngine, SamplingParams
+
+    cfg = tfm.CONFIGS["gpt2-small"]
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+
+    def engine_factory():
+        return InferenceEngine(params, cfg, slots=4, max_len=256,
+                               prefill_len=64, decode_block=8,
+                               prefix_cache_entries=8)
+
+    gateway = Gateway(engine_factory, replicas=2, prefill_len=64,
+                      admission_deadline_s=120.0,
+                      health_interval_s=0.2, seed=0)
+    autoscaler = None
+    try:
+        deadline = time.monotonic() + 120
+        while (len(gateway.pool.ready_replicas()) < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.2)
+        # warmup wave: compiles all three programs on both replicas
+        warm = [gateway.submit(
+            list(rng.integers(0, cfg.vocab_size, 32)),
+            SamplingParams(temperature=0.8, max_new_tokens=8),
+        ) for _ in range(4)]
+        for f in warm:
+            f.result(timeout=300)
+
+        autoscaler = GatewayAutoscaler(
+            gateway, PoolScaler(gateway.pool), min_replicas=2,
+            max_replicas=2, interval_s=0.5,
+        ).start()
+
+        n_requests, rate_hz = 48, 4.0
+        sp = SamplingParams(temperature=0.8, top_p=0.95,
+                            max_new_tokens=32)
+        futures, failed = [], 0
+        t0 = time.monotonic()
+        kill_at = n_requests // 2
+        for i in range(n_requests):
+            # open loop: arrivals keyed to the clock, not completions
+            target_t = t0 + i / rate_hz
+            delay = target_t - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            if i == kill_at:
+                ready = gateway.pool.ready_replicas()
+                if ready:
+                    extra["gateway_kill_orphans"] = \
+                        gateway.pool.kill_replica(ready[0].id)
+            futures.append(gateway.submit(
+                list(rng.integers(0, cfg.vocab_size, 32)), sp,
+            ))
+        latencies = []
+        for f in futures:
+            try:
+                latencies.append(f.result(timeout=300).total_s)
+            except Exception:  # noqa: BLE001 - count, don't crash
+                failed += 1
+        wall = time.monotonic() - t0
+        latencies.sort()
+        extra["gateway_req_per_s"] = round(len(latencies) / wall, 2)
+        extra["gateway_p95_s"] = round(
+            latencies[int(0.95 * (len(latencies) - 1))], 3
+        ) if latencies else None
+        extra["gateway_failed"] = failed
+        restore_deadline = time.monotonic() + 60
+        while (gateway.pool.live_count() < 2
+               and time.monotonic() < restore_deadline):
+            time.sleep(0.2)
+        extra["gateway_replicas_restored"] = gateway.pool.live_count()
+        extra["gateway_config"] = (
+            "gpt2-small x2 slots=4 prompt=32 gen=32 "
+            f"rate={rate_hz}/s kill@{kill_at}"
+        )
+    finally:
+        if autoscaler is not None:
+            autoscaler.stop()
+        gateway.stop()
+
+
 def bench_int8(extra: dict) -> None:
     """int8 MXU path vs bf16 on the llama-7B FFN stack (d=4096,
     d_ff=11008, 4 layers, 8192 tokens): forward + both grad
@@ -1266,6 +1369,7 @@ STAGES = [
           pass_budget=True),
     Stage("mfu", bench_train_step, est_s=170, deadline_s=520),
     Stage("serving", bench_serving, est_s=200, deadline_s=340),
+    Stage("gateway", bench_gateway, est_s=80, deadline_s=240),
     Stage("soak", bench_soak, est_s=105, deadline_s=160,
           pass_budget=True),
     Stage("int8", bench_int8, est_s=275, deadline_s=450),
@@ -1291,7 +1395,8 @@ HEADLINE_KEYS = [
     "goodput_lowrate_failures_per_hr", "mfu", "mfu_medium", "mfu_large",
     "ckpt_save_block_s", "ckpt_restore_s", "ckpt1b_save_block_s",
     "ckpt1b_copy_s", "ckpt1b_restore_s", "serving_toks_per_s",
-    "serving_prefix_cache_speedup",
+    "serving_prefix_cache_speedup", "gateway_req_per_s",
+    "gateway_p95_s", "gateway_failed",
     "int8_ffn_speedup", "soak_completed", "soak_kills",
     "lc_best_speedup", "bench_total_s",
 ]

@@ -24,7 +24,11 @@ from __future__ import annotations
 import glob
 import os
 
+from dlrover_tpu.common.log import get_logger
+
 __all__ = ["sniff_accelerator"]
+
+logger = get_logger(__name__)
 
 _GOOGLE_PCI_VENDOR = "0x1ae0"
 _PCI_CLASS_PROCESSING_ACCEL = "0x1200"  # PCI class 0x12, subclass 0x00
@@ -75,6 +79,15 @@ def sniff_accelerator(
             pci_dir = os.path.join(
                 sys_accel_root, os.path.basename(node), "device"
             )
+            if not _read(os.path.join(pci_dir, "device")):
+                # on a v2/v3 host this defaults a 2-TensorCore chip to
+                # 1 device; say so, or the undercount is undiagnosable
+                logger.warning(
+                    "sysfs PCI link %s for %s is unreadable; counting "
+                    "the chip as megacore (1 JAX device) — set "
+                    "DLROVER_TPU_DEVICE_COUNT to override an undercount",
+                    pci_dir, node,
+                )
             total += _chip_devices(pci_dir)
         return "tpu", total
     total = 0

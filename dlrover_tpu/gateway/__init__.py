@@ -1,0 +1,23 @@
+"""Elastic serving gateway: replica pool + health-aware routing +
+telemetry-driven autoscaling over ``serving.InferenceEngine``."""
+
+from dlrover_tpu.gateway.autoscale import (  # noqa: F401
+    GatewayAutoscaler,
+    GatewaySignals,
+    p95_from_buckets,
+)
+from dlrover_tpu.gateway.pool import (  # noqa: F401
+    EngineReplica,
+    PoolScaler,
+    ReplicaPool,
+    ReplicaState,
+    RequestWork,
+)
+from dlrover_tpu.gateway.router import Router  # noqa: F401
+from dlrover_tpu.gateway.server import (  # noqa: F401
+    AdmissionController,
+    AdmissionError,
+    Gateway,
+    GatewayHTTPServer,
+    GatewayResult,
+)

@@ -1,0 +1,202 @@
+"""Telemetry-driven replica autoscaling through the ScalePlan path.
+
+The serving twin of ``master/auto_scaler.py``: a timer loop turns
+telemetry into a ``ScalePlan`` and hands it to a ``Scaler`` (normally
+``gateway.pool.PoolScaler``; a ``cluster/scaler.py`` PodScaler works
+the same way when replicas are pods). Signals, all from the PR-1
+telemetry registry via the gateway:
+
+- queue depth (``dlrover_tpu_gateway_queue_depth``): admitted requests
+  not yet completed;
+- slot occupancy (``dlrover_tpu_gateway_slot_occupancy``): busy decode
+  slots / total;
+- p95 request latency, computed over the WINDOW since the previous tick
+  by differencing cumulative ``dlrover_tpu_gateway_request_seconds``
+  bucket counts (a cumulative p95 would take minutes to notice a
+  regression the window sees immediately).
+
+Policy (deliberately hysteretic — scale-up is one hot tick, scale-down
+needs ``down_ticks`` consecutive cold ones, because a replica build
+costs a prefill/install/step compile):
+
+- UP when the queue is deeper than one full batch per live replica, or
+  occupancy > ``up_occupancy``, or window p95 > ``target_p95_s``;
+- DOWN when the queue is empty and occupancy < ``down_occupancy`` for
+  ``down_ticks`` ticks;
+- always emit a plan when live != target (a killed replica is restored
+  on the next tick without waiting for load signals to notice).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Callable, Sequence
+
+from dlrover_tpu.cluster.crd import ScalePlan
+from dlrover_tpu.cluster.scaler import Scaler
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry.metrics import registry
+
+logger = get_logger(__name__)
+
+_scale_events = registry().counter(
+    "dlrover_tpu_gateway_scale_events_total",
+    "autoscaler plans issued, by direction",
+    label_names=("direction",),
+)
+
+
+def p95_from_buckets(bounds: Sequence[float],
+                     bucket_counts: Sequence[int]) -> float:
+    """p95 estimate from histogram bucket deltas: the upper bound of
+    the bucket holding the 95th percentile (conservative; +Inf bucket
+    reports the largest finite bound)."""
+    total = sum(bucket_counts)
+    if not total:
+        return 0.0
+    rank = 0.95 * total
+    cumulative = 0
+    for i, n in enumerate(bucket_counts):
+        cumulative += n
+        if cumulative >= rank:
+            return float(bounds[i]) if i < len(bounds) \
+                else float(bounds[-1])
+    return float(bounds[-1])
+
+
+@dataclasses.dataclass
+class GatewaySignals:
+    """One tick's view of the serving telemetry (windowed p95 already
+    computed — ``GatewayAutoscaler.tick`` does the differencing)."""
+
+    queue_depth: int
+    slot_occupancy: float
+    p95_s: float
+    live: int
+    slots_per_replica: int = 8
+
+
+class GatewayAutoscaler:
+    def __init__(self, gateway, scaler: Scaler, *,
+                 min_replicas: int = 1, max_replicas: int = 4,
+                 interval_s: float = 2.0,
+                 target_p95_s: float = 0.0,
+                 up_occupancy: float = 0.85,
+                 down_occupancy: float = 0.3,
+                 down_ticks: int = 3,
+                 group: str = "serving",
+                 signals_fn: Callable[[], GatewaySignals] | None = None):
+        if min_replicas < 0 or max_replicas < min_replicas:
+            raise ValueError("need 0 <= min_replicas <= max_replicas")
+        self._gateway = gateway
+        self._scaler = scaler
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self._interval_s = interval_s
+        self.target_p95_s = target_p95_s  # 0 = latency signal off
+        self._up_occupancy = up_occupancy
+        self._down_occupancy = down_occupancy
+        self._down_ticks = down_ticks
+        self._group = group
+        self._signals_fn = signals_fn
+        self.target: int | None = None  # adopted from `live` on tick 1
+        self._cold_streak = 0
+        self._prev_buckets: list[int] | None = None
+        self._stopped = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> "GatewayAutoscaler":
+        self._thread = threading.Thread(
+            target=self._loop, name="gateway-autoscaler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def _loop(self) -> None:
+        while not self._stopped.wait(self._interval_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 - planning must not die
+                logger.exception("gateway autoscale tick failed")
+
+    # ------------------------------------------------------------- signals
+
+    def _signals(self) -> GatewaySignals:
+        if self._signals_fn is not None:
+            return self._signals_fn()
+        gw = self._gateway
+        bounds, buckets, _count, _sum = gw.request_hist_snapshot()
+        prev = self._prev_buckets or [0] * len(buckets)
+        delta = [max(0, b - p) for b, p in zip(buckets, prev)]
+        self._prev_buckets = buckets
+        slots_total = gw.pool.slots_total()
+        live = gw.pool.live_count()
+        return GatewaySignals(
+            queue_depth=gw.admission.pending,
+            slot_occupancy=gw.pool.occupancy(),
+            p95_s=p95_from_buckets(bounds, delta),
+            live=live,
+            slots_per_replica=max(1, slots_total // max(1, live)),
+        )
+
+    # ------------------------------------------------------------ decision
+
+    def decide(self, sig: GatewaySignals) -> int:
+        """Pure policy: next replica target from one tick's signals."""
+        if self.target is None:
+            self.target = min(self.max_replicas,
+                              max(self.min_replicas, sig.live))
+        hot = (
+            sig.queue_depth > sig.slots_per_replica * max(1, sig.live)
+            or sig.slot_occupancy > self._up_occupancy
+            or (self.target_p95_s > 0
+                and sig.p95_s > self.target_p95_s)
+        )
+        cold = (sig.queue_depth == 0
+                and sig.slot_occupancy < self._down_occupancy)
+        if hot:
+            self._cold_streak = 0
+            self.target = min(self.max_replicas, self.target + 1)
+        elif cold:
+            self._cold_streak += 1
+            if self._cold_streak >= self._down_ticks:
+                self._cold_streak = 0
+                self.target = max(self.min_replicas, self.target - 1)
+        else:
+            self._cold_streak = 0
+        return self.target
+
+    def tick(self) -> None:
+        sig = self._signals()
+        before = self.target
+        target = self.decide(sig)
+        if before is not None and target != before:
+            direction = "up" if target > before else "down"
+            _scale_events.labels(direction).inc()
+            logger.info(
+                "gateway scale %s: %d -> %d (queue=%d occ=%.2f "
+                "p95=%.2fs)", direction, before, target,
+                sig.queue_depth, sig.slot_occupancy, sig.p95_s,
+            )
+        elif sig.live < target:
+            # a replica died (kill/preempt): restore the count even
+            # though load signals alone wouldn't trigger a plan
+            _scale_events.labels("restore").inc()
+            logger.warning("gateway restore: %d live < target %d",
+                           sig.live, target)
+        elif sig.live == target:
+            return
+        self._scaler.scale(ScalePlan(
+            job_name="gateway",
+            replica_resources={self._group: target},
+            reason=f"gateway autoscale (live={sig.live}, "
+                   f"queue={sig.queue_depth}, "
+                   f"occ={sig.slot_occupancy:.2f}, "
+                   f"p~{sig.p95_s:.2f}s)",
+        ))

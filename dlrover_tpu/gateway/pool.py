@@ -1,0 +1,486 @@
+"""Serving replica pool: the gateway's control plane.
+
+Reference analog: Podracer/vLLM actor pools — a thin coordinator over
+many single-accelerator engines — crossed with DLRover's node manager:
+replicas are health-checked, drained on preemption notice, and resized
+through the SAME ``ScalePlan`` verb training uses (``PoolScaler`` is
+the serving twin of ``cluster/scaler.py``'s node scalers).
+
+Each ``EngineReplica`` owns one ``serving.InferenceEngine`` on a
+dedicated decode thread (the engine is strictly single-threaded; the
+replica thread is the only thread that ever touches it). Lifecycle::
+
+    STARTING --engine built--> READY --drain()--> DRAINING --empty--> DEAD
+                                 |---kill()/thread death------------> DEAD
+
+- ``drain()`` (graceful: preemption notice, scale-down) stops accepting
+  new work but finishes every in-flight decode before detaching — the
+  preemption contract from ``agent/preemption.py``: the platform
+  announces the kill, so the notice window is spent finishing, not
+  failing.
+- ``kill()`` (abrupt: test/bench injection, or a decode thread dying)
+  returns the queued + in-flight work so the gateway can resubmit it to
+  surviving replicas; per-request seeds (minted by the gateway) make
+  the re-decode bit-identical, so a mid-load replica loss costs latency
+  only, never a failed or divergent request.
+
+The pool's health loop detaches dead replicas, hands their orphans to
+the gateway's resubmit hook, and keeps the ``dlrover_tpu_gateway_*``
+replica/occupancy gauges fresh; the autoscaler reads those and drives
+``PoolScaler.scale`` to restore or resize.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from enum import Enum
+from typing import Any, Callable
+
+from dlrover_tpu.agent.preemption import PreemptionWatcher
+from dlrover_tpu.cluster.crd import ScalePlan
+from dlrover_tpu.cluster.scaler import Scaler
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.metrics import registry
+
+logger = get_logger(__name__)
+
+_replicas_gauge = registry().gauge(
+    "dlrover_tpu_gateway_replicas",
+    "replica count by lifecycle state",
+    label_names=("state",),
+)
+_slot_occupancy = registry().gauge(
+    "dlrover_tpu_gateway_slot_occupancy",
+    "busy fraction of decode slots across READY replicas",
+)
+_drained_total = registry().counter(
+    "dlrover_tpu_gateway_drained_total",
+    "replicas drained, by cause",
+    label_names=("cause",),
+)
+
+
+class ReplicaState(str, Enum):
+    STARTING = "starting"
+    READY = "ready"
+    DRAINING = "draining"
+    DEAD = "dead"
+
+
+@dataclasses.dataclass
+class RequestWork:
+    """One gateway request as it moves through (possibly several)
+    replicas; the future resolves exactly once, with a
+    ``server.GatewayResult``."""
+
+    id: int
+    prompt: list[int]
+    params: Any                  # serving.SamplingParams, seed minted
+    future: Future
+    submit_t: float
+    dispatch_t: float = 0.0
+    first_token_t: float = 0.0
+    replica_id: int = -1
+    attempts: int = 0
+
+
+class EngineReplica:
+    """One InferenceEngine behind an inbox, on its own decode thread.
+
+    ``engine_factory`` runs ON the replica thread (engine construction
+    compiles the prefill/install/step programs; doing it off the caller
+    keeps pool scale-up non-blocking), after which the replica turns
+    READY and starts draining its inbox through ``engine.step()``.
+    """
+
+    def __init__(self, replica_id: int,
+                 engine_factory: Callable[[], Any],
+                 on_done: Callable[[RequestWork, Any], None],
+                 *, on_error: Callable[[RequestWork, Exception],
+                                       None] | None = None,
+                 heartbeat_timeout_s: float = 60.0):
+        self.id = replica_id
+        self._engine_factory = engine_factory
+        self._on_done = on_done
+        self._on_error = on_error or (
+            lambda work, exc: work.future.set_exception(exc)
+        )
+        self._heartbeat_timeout_s = heartbeat_timeout_s
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._state = ReplicaState.STARTING
+        self._inbox: list[RequestWork] = []
+        self._inflight: dict[int, RequestWork] = {}  # engine rid -> work
+        self._draining = False
+        self._killed = False
+        self._last_beat = time.monotonic()
+        self.engine: Any = None
+        self.slots = 0
+        self._thread = threading.Thread(
+            target=self._run, name=f"gateway-replica-{replica_id}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------- state
+
+    @property
+    def state(self) -> ReplicaState:
+        return self._state
+
+    @property
+    def outstanding(self) -> int:
+        with self._lock:
+            return len(self._inbox) + len(self._inflight)
+
+    def healthy(self) -> bool:
+        """Thread alive and stepping recently; False is the health
+        loop's signal to detach and resubmit."""
+        if self._state is ReplicaState.DEAD:
+            return False
+        if not self._thread.is_alive():
+            return False
+        return (time.monotonic() - self._last_beat
+                < self._heartbeat_timeout_s)
+
+    # -------------------------------------------------------------- verbs
+
+    def submit(self, work: RequestWork) -> bool:
+        """Accept work unless draining/dead; False tells the router to
+        pick someone else."""
+        with self._lock:
+            if (self._killed or self._draining
+                    or self._state is ReplicaState.DEAD):
+                return False
+            self._inbox.append(work)
+            self._wake.notify()
+        return True
+
+    def drain(self) -> None:
+        """Graceful: no new work, finish in-flight, then DEAD."""
+        with self._lock:
+            if self._state is ReplicaState.DEAD:
+                return
+            self._draining = True
+            if self._state is ReplicaState.READY:
+                self._state = ReplicaState.DRAINING
+            self._wake.notify()
+
+    def kill(self) -> list[RequestWork]:
+        """Abrupt death (injection / simulated preempt-without-notice):
+        stop stepping now, hand back everything unfinished."""
+        with self._lock:
+            self._killed = True
+            self._state = ReplicaState.DEAD
+            orphans = self._inbox + list(self._inflight.values())
+            self._inbox = []
+            self._inflight = {}
+            self._wake.notify()
+        return orphans
+
+    def take_orphans(self) -> list[RequestWork]:
+        """Reclaim unfinished work from a replica whose thread died on
+        its own (health-loop path; ``kill()`` covers the injected one)."""
+        with self._lock:
+            self._state = ReplicaState.DEAD
+            orphans = self._inbox + list(self._inflight.values())
+            self._inbox = []
+            self._inflight = {}
+        return orphans
+
+    def join(self, timeout: float | None = None) -> None:
+        self._thread.join(timeout)
+
+    # --------------------------------------------------------- decode loop
+
+    def _run(self) -> None:
+        try:
+            engine = self._engine_factory()
+        except Exception:  # noqa: BLE001 - a failed build is a dead replica
+            logger.exception("replica %d engine build failed", self.id)
+            with self._lock:
+                self._state = ReplicaState.DEAD
+            return
+        with self._lock:
+            if self._killed:
+                return
+            self.engine = engine
+            self.slots = engine.slots
+            self._state = ReplicaState.READY
+        logger.info("replica %d ready (%d slots)", self.id, self.slots)
+        while True:
+            with self._lock:
+                while (not self._inbox and not self._inflight
+                       and not self._killed and not self._draining):
+                    self._last_beat = time.monotonic()
+                    self._wake.wait(0.2)
+                if self._killed:
+                    return
+                if (self._draining and not self._inbox
+                        and not self._inflight):
+                    self._state = ReplicaState.DEAD
+                    logger.info("replica %d drained", self.id)
+                    return
+                newly, self._inbox = self._inbox, []
+            for work in newly:
+                work.dispatch_t = time.monotonic()
+                work.replica_id = self.id
+                try:
+                    rid = engine.submit(
+                        work.prompt, work.params,
+                        on_token=self._first_token_cb(work),
+                    )
+                except Exception as e:  # noqa: BLE001 - a bad request
+                    # (prompt too long etc.) fails ITS future only
+                    self._on_error(work, e)
+                    continue
+                with self._lock:
+                    self._inflight[rid] = work
+            engine.step()
+            self._last_beat = time.monotonic()
+            for res in engine.poll_results():
+                with self._lock:
+                    work = self._inflight.pop(res.id, None)
+                if work is None:
+                    # killed mid-step: this result's work was orphaned
+                    # and resolves via resubmission elsewhere
+                    continue
+                try:
+                    self._on_done(work, res)
+                except Exception:  # noqa: BLE001 - a completion-hook bug
+                    logger.exception(  # must not kill the decode loop
+                        "on_done failed (request %d)", work.id
+                    )
+
+    @staticmethod
+    def _first_token_cb(work: RequestWork):
+        def cb(_rid: int, _tok: int) -> None:
+            if not work.first_token_t:
+                work.first_token_t = time.monotonic()
+        return cb
+
+
+class ReplicaPool:
+    """Replica set + health loop + preemption watchers.
+
+    ``on_orphans`` (the gateway's resubmit hook) receives the
+    unfinished work of any replica that dies abruptly; drained replicas
+    never orphan anything by construction.
+    """
+
+    def __init__(self, engine_factory: Callable[[], Any],
+                 on_done: Callable[[RequestWork, Any], None],
+                 on_orphans: Callable[[list[RequestWork]], None],
+                 *, on_error: Callable[[RequestWork, Exception],
+                                       None] | None = None,
+                 health_interval_s: float = 0.5,
+                 preemption_file: str | None = None,
+                 heartbeat_timeout_s: float = 60.0):
+        self._engine_factory = engine_factory
+        self._on_done = on_done
+        self._on_orphans = on_orphans
+        self._on_error = on_error
+        self._preemption_file = preemption_file
+        self._heartbeat_timeout_s = heartbeat_timeout_s
+        self._lock = threading.Lock()
+        # serializes ensure()/scale reconciles (autoscaler tick vs a
+        # direct PoolScaler call must not both spawn for the same gap)
+        self._reconcile_lock = threading.Lock()
+        self._replicas: dict[int, EngineReplica] = {}
+        self._watchers: dict[int, PreemptionWatcher] = {}
+        self._next_id = 0
+        self._stop = threading.Event()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="gateway-pool-health",
+            daemon=True,
+        )
+        self._health_interval_s = health_interval_s
+        self._health_thread.start()
+
+    # ----------------------------------------------------------- queries
+
+    def replicas(self) -> list[EngineReplica]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def ready_replicas(self) -> list[EngineReplica]:
+        return [r for r in self.replicas()
+                if r.state is ReplicaState.READY]
+
+    def live_count(self) -> int:
+        """Replicas counting toward the scale target (STARTING+READY);
+        DRAINING ones are already on their way out."""
+        return sum(
+            r.state in (ReplicaState.STARTING, ReplicaState.READY)
+            for r in self.replicas()
+        )
+
+    def slots_total(self) -> int:
+        return sum(r.slots for r in self.ready_replicas())
+
+    def occupancy(self) -> float:
+        busy = total = 0
+        for r in self.ready_replicas():
+            total += r.slots
+            busy += min(r.outstanding, r.slots)
+        return busy / total if total else 0.0
+
+    # ------------------------------------------------------------- verbs
+
+    def ensure(self, target: int) -> None:
+        """Reconcile live replica count toward ``target`` (grow by
+        spawning, shrink by draining the newest)."""
+        target = max(0, int(target))
+        with self._reconcile_lock:
+            with self._lock:
+                live = [
+                    r for r in self._replicas.values()
+                    if r.state in (ReplicaState.STARTING,
+                                   ReplicaState.READY)
+                ]
+            while len(live) < target:
+                live.append(self._add_replica())
+            for replica in sorted(live, key=lambda r: r.id,
+                                  reverse=True)[: len(live) - target]:
+                self.drain_replica(replica.id, cause="scale_down")
+
+    def _add_replica(self) -> EngineReplica:
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+            replica = EngineReplica(
+                rid, self._engine_factory, self._on_done,
+                on_error=self._on_error,
+                heartbeat_timeout_s=self._heartbeat_timeout_s,
+            )
+            self._replicas[rid] = replica
+            # arm the preemption notice for THIS replica: {node_id} in
+            # the configured file template becomes the replica id, the
+            # same substitution the agent watcher does per node
+            watcher = PreemptionWatcher(
+                lambda rid=rid: self.drain_replica(
+                    rid, cause="preemption"
+                ),
+                node_id=rid, poll_interval_s=0.1,
+                notice_file=self._preemption_file,
+            ).start()
+            if watcher.enabled:
+                self._watchers[rid] = watcher
+        get_journal().emit("gateway_replica_add", replica=rid)
+        return replica
+
+    def drain_replica(self, replica_id: int, cause: str = "drain") -> None:
+        with self._lock:
+            replica = self._replicas.get(replica_id)
+        if replica is None or replica.state is ReplicaState.DEAD:
+            return
+        logger.warning("draining replica %d (%s)", replica_id, cause)
+        _drained_total.labels(cause).inc()
+        get_journal().emit("gateway_replica_drain", replica=replica_id,
+                           cause=cause)
+        replica.drain()
+
+    def kill_replica(self, replica_id: int) -> int:
+        """Abrupt-death injection (tests/bench): detach now, resubmit
+        the orphans; returns how many requests were orphaned."""
+        with self._lock:
+            replica = self._replicas.pop(replica_id, None)
+            watcher = self._watchers.pop(replica_id, None)
+        if replica is None:
+            return 0
+        if watcher is not None:
+            watcher.stop()
+        orphans = replica.kill()
+        logger.warning("replica %d killed with %d in-flight requests",
+                       replica_id, len(orphans))
+        get_journal().emit("gateway_replica_kill", replica=replica_id,
+                           orphans=len(orphans))
+        if orphans:
+            self._on_orphans(orphans)
+        return len(orphans)
+
+    def relaunch_replica(self, replica_id: int) -> None:
+        """ScalePlan relaunch verb: drain the named replica and bring
+        up a replacement (fresh id — replica ids are engine
+        incarnations, never reused)."""
+        self.drain_replica(replica_id, cause="relaunch")
+        self._add_replica()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            replicas = list(self._replicas.values())
+            watchers = list(self._watchers.values())
+            self._replicas.clear()
+            self._watchers.clear()
+        for watcher in watchers:
+            watcher.stop()
+        for replica in replicas:
+            orphans = replica.kill()
+            if orphans:
+                # hand unfinished work back so the gateway can fail the
+                # futures explicitly — a silent kill would leave callers
+                # blocked on results that can never arrive
+                self._on_orphans(orphans)
+
+    # -------------------------------------------------------- health loop
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self._health_interval_s):
+            try:
+                self._health_tick()
+            except Exception:  # noqa: BLE001 - health must keep running
+                logger.exception("pool health tick failed")
+
+    def _health_tick(self) -> None:
+        with self._lock:
+            replicas = list(self._replicas.items())
+        for rid, replica in replicas:
+            if replica.state is ReplicaState.DEAD or not replica.healthy():
+                with self._lock:
+                    self._replicas.pop(rid, None)
+                    watcher = self._watchers.pop(rid, None)
+                if watcher is not None:
+                    watcher.stop()
+                orphans = replica.take_orphans()
+                if orphans:
+                    logger.warning(
+                        "replica %d died with %d unfinished requests; "
+                        "resubmitting", rid, len(orphans),
+                    )
+                    self._on_orphans(orphans)
+        counts = dict.fromkeys(ReplicaState, 0)
+        for replica in self.replicas():
+            counts[replica.state] += 1
+        for state, n in counts.items():
+            _replicas_gauge.labels(state.value).set(n)
+        _slot_occupancy.set(self.occupancy())
+
+
+class PoolScaler(Scaler):
+    """Execute ScalePlans against a ReplicaPool.
+
+    The serving twin of ``cluster/scaler.py``'s node scalers: the
+    gateway autoscaler (and any operator emitting ScalePlan CRs) drives
+    replica count through this one verb, so serving elasticity rides
+    the exact control-plane path training elasticity does.
+    """
+
+    def __init__(self, pool: ReplicaPool, group: str = "serving"):
+        self._pool = pool
+        self._group = group
+
+    def scale(self, plan: ScalePlan) -> None:
+        for rid in plan.remove_nodes:
+            self._pool.drain_replica(rid, cause="scale_down")
+        for rid in plan.relaunch_nodes:
+            self._pool.relaunch_replica(rid)
+        target = plan.replica_resources.get(self._group)
+        if target is not None:
+            logger.info("scaling %s replicas to %d (%s)", self._group,
+                        target, plan.reason or "plan")
+            self._pool.ensure(int(target))

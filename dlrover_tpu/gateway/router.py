@@ -1,0 +1,109 @@
+"""Health-aware request routing: least-outstanding + prefix affinity.
+
+The data-plane half of the Podracer actor-pool idea: a thin router over
+N single-engine replicas scales decode throughput linearly — IF two
+things hold. (1) Load balance: route to the replica with the fewest
+outstanding requests, so no replica queues while another idles.
+(2) Cache locality: each replica owns its own prefix KV cache
+(serving/engine.py's chunk-aligned LRU), so prompts sharing an aligned
+prefix should land on the replica that already holds that prefix's KV
+rows — spraying them round-robin would re-prefill the shared system
+prompt once per replica and hit on none.
+
+Affinity is advisory, load is binding: the prefix owner is preferred
+only while it has a free decode slot or is no busier than the
+least-loaded alternative; a saturated owner loses the request to the
+least-loaded replica (re-prefilling is cheaper than queueing behind a
+full batch while slots idle elsewhere).
+
+The affinity map mirrors the engine's cache-key discipline: keys are
+FINAL chunk-aligned prefixes, lookups probe only stored key lengths
+(bounded work on long prompts), and the map is LRU-bounded. Entries for
+a dead replica are forgotten so affinity never routes to a ghost.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Sequence
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+class Router:
+    def __init__(self, prefill_len: int, *,
+                 max_affinity_entries: int = 1024):
+        if prefill_len < 1:
+            raise ValueError("prefill_len must be >= 1")
+        self._prefill_len = prefill_len
+        self._max = max_affinity_entries
+        self._lock = threading.Lock()
+        # aligned-prefix key -> replica id, insertion-ordered (LRU)
+        self._affinity: dict[tuple, int] = {}
+        self._lens: dict[int, int] = {}  # key length -> stored count
+
+    # ------------------------------------------------------------ routing
+
+    def route(self, prompt: Sequence[int], replicas: Sequence):
+        """Pick a replica for ``prompt`` from ``replicas`` (READY ones,
+        objects with ``id`` / ``outstanding`` / ``slots``); None when
+        the list is empty."""
+        if not replicas:
+            return None
+        by_id = {r.id: r for r in replicas}
+        least = min(replicas, key=lambda r: (r.outstanding, r.id))
+        owner = self._affinity_owner(prompt, by_id)
+        if owner is not None:
+            busy = owner.outstanding
+            if busy < owner.slots or busy <= least.outstanding:
+                return owner
+        return least
+
+    def _affinity_owner(self, prompt: Sequence[int], by_id: dict):
+        P = self._prefill_len
+        top = len(prompt) // P * P
+        with self._lock:
+            for length in sorted(self._lens, reverse=True):
+                if length > top:
+                    continue
+                rid = self._affinity.get(tuple(prompt[:length]))
+                if rid is not None and rid in by_id:
+                    return by_id[rid]
+        return None
+
+    # --------------------------------------------------------- bookkeeping
+
+    def record(self, prompt: Sequence[int], replica_id: int) -> None:
+        """Remember that ``replica_id`` now holds the KV rows for this
+        prompt's final aligned prefix (call at dispatch time)."""
+        P = self._prefill_len
+        top = len(prompt) // P * P
+        if not top:
+            return
+        key = tuple(prompt[:top])
+        with self._lock:
+            if self._affinity.pop(key, None) is None:
+                self._lens[top] = self._lens.get(top, 0) + 1
+            self._affinity[key] = replica_id
+            while len(self._affinity) > self._max:
+                evicted = next(iter(self._affinity))
+                self._affinity.pop(evicted)
+                self._dec_len(len(evicted))
+
+    def forget(self, replica_id: int) -> None:
+        """Drop every affinity entry owned by a detached replica."""
+        with self._lock:
+            dead = [k for k, rid in self._affinity.items()
+                    if rid == replica_id]
+            for key in dead:
+                self._affinity.pop(key)
+                self._dec_len(len(key))
+
+    def _dec_len(self, length: int) -> None:
+        left = self._lens[length] - 1
+        if left:
+            self._lens[length] = left
+        else:
+            del self._lens[length]

@@ -161,6 +161,11 @@ class InferenceEngine:
         # `prefix_cache_entries` (0 = off) to the HBM you can spare.
         self.prefix_cache_entries = prefix_cache_entries
         self._prefix_cache: dict[tuple, tuple] = {}
+        # key length -> number of stored keys of that length: lookups
+        # probe only lengths that exist, so a long-prompt miss costs
+        # O(stored lengths) hashes instead of rebuilding and hashing
+        # every aligned prefix of the prompt (O(n^2/P))
+        self._prefix_lens: dict[int, int] = {}
         self.prefix_cache_hits = 0
         self.prefix_cache_queries = 0
 
@@ -268,6 +273,7 @@ class InferenceEngine:
         # the RL engine pushes once per iteration, before the wave.
         self._params = value
         self._prefix_cache.clear()
+        self._prefix_lens.clear()
 
     def submit(self, prompt: list[int],
                params: SamplingParams | None = None,
@@ -290,25 +296,40 @@ class InferenceEngine:
     def _prefix_lookup(self, prompt: list[int]):
         """Longest chunk-aligned cached prefix of ``prompt``; returns
         ``(start, (row_k, row_v, pos, last))`` or ``None``. jax arrays
-        are immutable, so handing out the stored row is alias-safe."""
+        are immutable, so handing out the stored row is alias-safe.
+
+        Probe depth is capped by the set of key lengths actually stored
+        (``_prefix_lens``): a miss on a long prompt hashes one tuple per
+        DISTINCT stored length, not one per aligned boundary of the
+        prompt."""
         P = self.prefill_len
         top = len(prompt) // P * P
-        key = tuple(prompt[:top])
-        for lo in range(top, 0, -P):
+        for lo in sorted(self._prefix_lens, reverse=True):
+            if lo > top:
+                continue
+            key = tuple(prompt[:lo])
             ent = self._prefix_cache.get(key)
             if ent is not None:
                 # refresh LRU recency (dicts iterate in insertion order)
                 self._prefix_cache.pop(key)
                 self._prefix_cache[key] = ent
                 return lo, ent
-            key = key[:-P]
         return None
 
     def _prefix_store(self, key: tuple, ent: tuple) -> None:
-        self._prefix_cache.pop(key, None)
+        if self._prefix_cache.pop(key, None) is None:
+            self._prefix_lens[len(key)] = (
+                self._prefix_lens.get(len(key), 0) + 1
+            )
         self._prefix_cache[key] = ent
         while len(self._prefix_cache) > self.prefix_cache_entries:
-            self._prefix_cache.pop(next(iter(self._prefix_cache)))
+            evicted = next(iter(self._prefix_cache))
+            self._prefix_cache.pop(evicted)
+            left = self._prefix_lens[len(evicted)] - 1
+            if left:
+                self._prefix_lens[len(evicted)] = left
+            else:
+                del self._prefix_lens[len(evicted)]
 
     def _admit(self) -> None:
         for slot in range(self.slots):
@@ -326,6 +347,7 @@ class InferenceEngine:
                 if hit is not None:
                     start, (row_k, row_v, pos, last) = hit
                     self.prefix_cache_hits += 1
+            final_top = len(req.prompt) // P * P
             for lo in range(start, len(req.prompt), P):
                 chunk = req.prompt[lo: lo + P]
                 toks = np.zeros((1, P), np.int32)
@@ -335,13 +357,18 @@ class InferenceEngine:
                     jnp.asarray(len(chunk), jnp.int32),
                 )
                 if self.prefix_cache_entries and len(chunk) == P:
-                    # snapshot every aligned boundary: partial overlaps
-                    # between different prompts hit the longest shared
-                    # aligned prefix
-                    self._prefix_store(
-                        tuple(req.prompt[: lo + P]),
-                        (row_k, row_v, pos, last),
-                    )
+                    # snapshot the FINAL aligned boundary always;
+                    # intermediate boundaries only when extending an
+                    # already-cached prefix (start > 0, the shared-
+                    # system-prompt chain). A cold non-sharing prompt
+                    # then adds ONE entry instead of top/P, so a wave of
+                    # long unrelated prompts can no longer churn the LRU
+                    # and evict the shared prefixes that actually hit.
+                    if lo + P == final_top or start > 0:
+                        self._prefix_store(
+                            tuple(req.prompt[: lo + P]),
+                            (row_k, row_v, pos, last),
+                        )
             (self._cache["k"], self._cache["v"], self._cache["pos"],
              self._last) = self._install(
                 self._cache["k"], self._cache["v"], self._cache["pos"],
@@ -452,6 +479,23 @@ class InferenceEngine:
         _tokens_total.inc(len(self._emitted[slot]))
         self._active[slot] = None
         self._emitted[slot] = []
+
+    @property
+    def outstanding(self) -> int:
+        """Queued + active requests (the gateway router's load signal)."""
+        return len(self._queue) + sum(
+            r is not None for r in self._active
+        )
+
+    def poll_results(self) -> list[Result]:
+        """Return (and clear) results retired since the last poll.
+
+        The incremental twin of ``run()`` for callers that drive
+        ``step()`` themselves — the gateway replica loop retires
+        finished requests between decode iterations while others keep
+        decoding."""
+        out, self._results = self._results, []
+        return out
 
     def run(self, max_iters: int = 100000) -> list[Result]:
         """Drain the queue and all active slots; returns results in

@@ -5,9 +5,12 @@ Walks the package source for ``registry().counter("...")`` /
 ``.gauge("...")`` / ``.histogram("...")`` registrations and asserts
 
 - every name matches ``dlrover_tpu_[a-z_]+`` (no digits, no dots — the
-  Prometheus-safe subset the exposition endpoint promises), and
+  Prometheus-safe subset the exposition endpoint promises),
 - every name is registered in exactly one call site, so the endpoint can
-  never emit colliding series with divergent help/type/labels.
+  never emit colliding series with divergent help/type/labels, and
+- every ``dlrover_tpu_gateway_*`` name appears verbatim in DESIGN.md:
+  the gateway's scrape surface is an operator contract (deploy/README.md
+  points dashboards at it), so registry and docs must not drift.
 
 Invoked from the tier-1 suite (tests/test_telemetry.py) and runnable
 standalone: ``python native/check_metric_names.py``.
@@ -27,6 +30,24 @@ REG_RE = re.compile(
 
 PKG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                    "dlrover_tpu")
+DESIGN_MD = os.path.join(os.path.dirname(PKG), "DESIGN.md")
+DOCUMENTED_PREFIX = "dlrover_tpu_gateway_"
+
+
+def check_documented(names: dict[str, list[str]],
+                     design_path: str = DESIGN_MD) -> list[str]:
+    """Every gateway metric registered in code must appear in DESIGN.md."""
+    try:
+        with open(design_path, encoding="utf-8") as f:
+            design = f.read()
+    except OSError as e:
+        return [f"cannot read {design_path}: {e}"]
+    return [
+        f"metric {name!r} ({', '.join(sites)}) is not documented in "
+        f"DESIGN.md; add it to the gateway metrics table"
+        for name, sites in sorted(names.items())
+        if name.startswith(DOCUMENTED_PREFIX) and name not in design
+    ]
 
 
 def scan(pkg_dir: str = PKG) -> tuple[dict[str, list[str]], list[str]]:
@@ -65,6 +86,7 @@ def scan(pkg_dir: str = PKG) -> tuple[dict[str, list[str]], list[str]]:
                 f"metric {name!r} registered at {len(sites)} call sites "
                 f"({', '.join(sites)}); names must be unique"
             )
+    problems.extend(check_documented(names))
     return names, problems
 
 

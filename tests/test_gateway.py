@@ -1,0 +1,495 @@
+"""Elastic serving gateway (dlrover_tpu/gateway/).
+
+The properties that make a replica pool a serving system rather than a
+load balancer demo:
+
+- admission is deadline-derived backpressure (429 + Retry-After), not
+  an unbounded queue;
+- routing is least-outstanding with prefix-cache affinity, and
+  affinity yields to load;
+- a replica kill mid-load drops ZERO in-flight requests, and minted
+  seeds make the re-decode bit-identical to any other replica's;
+- a preemption notice drains (finishes in-flight, then detaches)
+  instead of killing;
+- the autoscaler turns telemetry into ScalePlans on the same
+  cluster/scaler.py path training uses, and restores killed replicas.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import jax
+
+from dlrover_tpu.cluster.crd import ScalePlan
+from dlrover_tpu.gateway import (
+    AdmissionController,
+    AdmissionError,
+    Gateway,
+    GatewayAutoscaler,
+    GatewayHTTPServer,
+    GatewaySignals,
+    PoolScaler,
+    ReplicaState,
+    Router,
+    p95_from_buckets,
+)
+from dlrover_tpu.models import transformer as tfm
+from dlrover_tpu.models.decode import generate
+from dlrover_tpu.serving import InferenceEngine, SamplingParams
+
+CFG = tfm.CONFIGS["tiny"]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _factory(params, *, slots=2, prefix_entries=4):
+    def build():
+        return InferenceEngine(
+            params, CFG, slots=slots, max_len=64, prefill_len=8,
+            prefix_cache_entries=prefix_entries,
+        )
+    return build
+
+
+def _wait(cond, timeout=90.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def gateway(params):
+    gw = Gateway(_factory(params), replicas=2, prefill_len=8,
+                 admission_deadline_s=120.0, health_interval_s=0.1)
+    assert _wait(lambda: len(gw.pool.ready_replicas()) == 2)
+    yield gw
+    gw.stop()
+
+
+# ------------------------------------------------------------------ router
+
+
+class _FakeReplica:
+    def __init__(self, rid, outstanding, slots=4):
+        self.id, self.outstanding, self.slots = rid, outstanding, slots
+
+
+class TestRouter:
+    def test_least_outstanding_wins(self):
+        router = Router(8)
+        picked = router.route(
+            [1, 2, 3],
+            [_FakeReplica(0, 3), _FakeReplica(1, 1), _FakeReplica(2, 2)],
+        )
+        assert picked.id == 1
+
+    def test_prefix_affinity_preferred(self):
+        router = Router(8)
+        replicas = [_FakeReplica(0, 2), _FakeReplica(1, 0)]
+        shared = list(range(100, 116))  # two aligned chunks
+        router.record(shared, 0)
+        # replica 0 is busier but owns the prefix KV and has free slots
+        assert router.route(shared + [7], replicas).id == 0
+        # an unrelated prompt still goes least-loaded
+        assert router.route([9, 9, 9], replicas).id == 1
+
+    def test_affinity_yields_to_saturation(self):
+        router = Router(8)
+        shared = list(range(16))
+        router.record(shared, 0)
+        owner = _FakeReplica(0, 4, slots=4)   # no free slot
+        idle = _FakeReplica(1, 0, slots=4)
+        assert router.route(shared + [1], [owner, idle]).id == 1
+        # ...but wins again once a slot frees up
+        owner.outstanding = 3
+        assert router.route(shared + [1], [owner, idle]).id == 0
+
+    def test_forget_dead_replica(self):
+        router = Router(8)
+        shared = list(range(16))
+        router.record(shared, 0)
+        router.forget(0)
+        picked = router.route(
+            shared + [1], [_FakeReplica(0, 5), _FakeReplica(1, 0)]
+        )
+        assert picked.id == 1
+
+    def test_lookup_probes_only_stored_lengths(self):
+        router = Router(8, max_affinity_entries=4)
+        router.record(list(range(16)), 0)
+        probes = 0
+        orig = dict.get
+
+        class Counting(dict):
+            def get(self, *a):
+                nonlocal probes
+                probes += 1
+                return orig(self, *a)
+
+        router._affinity = Counting(router._affinity)
+        long_prompt = list(range(4096))
+        router.route(long_prompt, [_FakeReplica(0, 0)])
+        assert probes <= 1  # one stored length -> one probe, not 512
+
+    def test_affinity_map_is_bounded(self):
+        router = Router(8, max_affinity_entries=3)
+        for base in range(10):
+            router.record([base * 100 + i for i in range(8)], base)
+        assert len(router._affinity) == 3
+        assert sum(router._lens.values()) == 3
+
+
+# --------------------------------------------------------------- admission
+
+
+class TestAdmission:
+    def test_admits_until_deadline_bound(self):
+        adm = AdmissionController(deadline_s=1.0, init_request_s=0.5)
+        # 4 slots, 0.5s each: the 10th request would see an estimated
+        # wait of 9 * 0.5 / 4 > 1s, past the deadline
+        for _ in range(9):
+            adm.try_admit(slots_total=4)
+        with pytest.raises(AdmissionError) as e:
+            adm.try_admit(slots_total=4)
+        assert e.value.retry_after_s >= 1.0
+        assert adm.pending == 9
+
+    def test_release_reopens_and_tracks_ewma(self):
+        adm = AdmissionController(deadline_s=0.0, init_request_s=1.0)
+        adm.try_admit(slots_total=1)      # pending 0 -> est wait 0: ok
+        with pytest.raises(AdmissionError):
+            adm.try_admit(slots_total=1)  # pending 1 -> est 1.0s > 0
+        adm.release(service_s=0.1)
+        assert adm.pending == 0
+        assert adm.ewma_request_s < 1.0
+        adm.try_admit(slots_total=1)      # open again
+
+    def test_bound_scales_with_capacity(self):
+        adm = AdmissionController(deadline_s=1.0, init_request_s=1.0)
+        for _ in range(5):
+            adm.try_admit(slots_total=4)
+        with pytest.raises(AdmissionError):
+            adm.try_admit(slots_total=4)   # est 5/4 s > 1 s
+        # the same backlog fits after the autoscaler doubles capacity
+        adm.try_admit(slots_total=8)       # est 5/8 s
+
+
+# ------------------------------------------------------------- end to end
+
+
+@pytest.mark.timeout(300)
+def test_gateway_matches_solo_generate(gateway, params):
+    """Both replicas produce exactly solo greedy's continuation."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    prompt = [5, 9, 2]
+    sp = SamplingParams(temperature=0.0, max_new_tokens=6)
+    # concurrent wave: least-outstanding routing spreads it over both
+    # replicas (sequential requests would all tie-break to replica 0)
+    futs = [gateway.submit(prompt, sp) for _ in range(4)]
+    results = [f.result(timeout=120) for f in futs]
+    solo = generate(params, jnp.asarray([prompt], jnp.int32), CFG,
+                    gen_len=6, key=jax.random.PRNGKey(1),
+                    temperature=0.0)
+    expect = np.asarray(solo)[0, len(prompt):].tolist()
+    assert all(r.tokens == expect for r in results)
+    # the wave actually spread over both replicas
+    assert len({r.replica_id for r in results}) == 2
+
+
+@pytest.mark.timeout(300)
+def test_seeded_results_replica_independent(gateway):
+    """A sampled request returns identical tokens no matter which
+    replica serves it: the gateway mints the seed, both replicas are
+    forced to serve the same prompt once."""
+    sp = SamplingParams(temperature=0.9, top_p=0.95,
+                        max_new_tokens=10, seed=77)
+    futs = [gateway.submit([5, 9, 2], sp) for _ in range(6)]
+    results = [f.result(timeout=120) for f in futs]
+    assert len({r.replica_id for r in results}) == 2  # both served it
+    assert len({tuple(r.tokens) for r in results}) == 1
+
+
+@pytest.mark.timeout(300)
+def test_replica_kill_drops_zero_requests(gateway):
+    """Mid-load abrupt replica death: every in-flight request still
+    completes (token identity across the kill is pinned separately by
+    test_killed_inflight_reproduces_identical_tokens)."""
+    sp = SamplingParams(temperature=0.8, max_new_tokens=24)
+    prompts = [[i + 1, i + 2] for i in range(10)]
+    futs = [gateway.submit(p, sp) for p in prompts]
+    victim = gateway.pool.ready_replicas()[0].id
+    gateway.pool.kill_replica(victim)
+    results = [f.result(timeout=120) for f in futs]
+    assert len(results) == 10
+    assert all(r.finish_reason == "length" for r in results)
+    assert all(len(r.tokens) == 24 for r in results)
+    # the pool detached the victim
+    assert all(r.id != victim for r in gateway.pool.replicas())
+
+
+@pytest.mark.timeout(300)
+def test_killed_inflight_reproduces_identical_tokens(params):
+    """Strong zero-drop claim: pin seeds explicitly, kill a replica
+    mid-decode, and require the exact tokens an undisturbed gateway
+    produces."""
+    sp = [SamplingParams(temperature=0.8, max_new_tokens=20, seed=1000 + i)
+          for i in range(8)]
+    prompts = [[i + 3, i + 5, i + 7] for i in range(8)]
+
+    quiet = Gateway(_factory(params), replicas=1, prefill_len=8)
+    assert _wait(lambda: len(quiet.pool.ready_replicas()) == 1)
+    want = [quiet.generate(p, s, timeout=120).tokens
+            for p, s in zip(prompts, sp)]
+    quiet.stop()
+
+    gw = Gateway(_factory(params), replicas=2, prefill_len=8,
+                 health_interval_s=0.1)
+    assert _wait(lambda: len(gw.pool.ready_replicas()) == 2)
+    try:
+        futs = [gw.submit(p, s) for p, s in zip(prompts, sp)]
+        victim = gw.pool.ready_replicas()[0].id
+        gw.pool.kill_replica(victim)
+        got = [f.result(timeout=120).tokens for f in futs]
+        assert got == want
+    finally:
+        gw.stop()
+
+
+@pytest.mark.timeout(300)
+def test_preemption_notice_drains_without_drops(params, tmp_path):
+    """A preemption notice finishes in-flight decodes, detaches the
+    replica, and new work routes around it."""
+    template = str(tmp_path / "preempt-{node_id}")
+    gw = Gateway(_factory(params), replicas=2, prefill_len=8,
+                 preemption_file=template, health_interval_s=0.1)
+    assert _wait(lambda: len(gw.pool.ready_replicas()) == 2)
+    try:
+        sp = SamplingParams(temperature=0.0, max_new_tokens=16)
+        futs = [gw.submit([i + 1], sp) for i in range(6)]
+        victim = gw.pool.ready_replicas()[0].id
+        (tmp_path / f"preempt-{victim}").touch()
+        results = [f.result(timeout=120) for f in futs]
+        assert len(results) == 6        # nothing dropped
+        assert _wait(lambda: all(r.id != victim
+                                 for r in gw.pool.replicas()))
+        survivor = gw.pool.ready_replicas()
+        assert survivor and all(r.id != victim for r in survivor)
+        after = gw.generate([9, 9], sp, timeout=120)
+        assert after.replica_id != victim
+    finally:
+        gw.stop()
+
+
+@pytest.mark.timeout(300)
+def test_http_generate_health_metrics(gateway):
+    srv = GatewayHTTPServer(gateway, host="127.0.0.1",
+                            request_timeout_s=120).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        body = json.dumps({
+            "prompt": [5, 9, 2], "max_new_tokens": 6,
+            "temperature": 0.0,
+        }).encode()
+        req = urllib.request.Request(
+            url + "/v1/generate", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            out = json.loads(resp.read())
+        assert out["finish_reason"] == "length"
+        assert len(out["tokens"]) == 6
+        with urllib.request.urlopen(url + "/healthz", timeout=30) as resp:
+            health = json.loads(resp.read())
+        assert health["status"] == "ok" and health["ready"] == 2
+        with urllib.request.urlopen(url + "/metrics", timeout=30) as resp:
+            text = resp.read().decode()
+        assert "dlrover_tpu_gateway_requests_total" in text
+        assert "dlrover_tpu_gateway_queue_depth" in text
+        # malformed request -> 400, not a dead connection
+        bad = urllib.request.Request(
+            url + "/v1/generate", data=b'{"prompt": []}',
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(bad, timeout=30)
+        assert e.value.code == 400
+    finally:
+        srv.stop()
+
+
+@pytest.mark.timeout(300)
+def test_http_backpressure_returns_retry_after(params):
+    """Saturate a tiny-deadline gateway; the front door answers 429
+    with a Retry-After instead of queueing unboundedly."""
+    gw = Gateway(_factory(params), replicas=1, prefill_len=8,
+                 admission_deadline_s=0.0, init_request_s=5.0)
+    assert _wait(lambda: len(gw.pool.ready_replicas()) == 1)
+    srv = GatewayHTTPServer(gw, host="127.0.0.1").start()
+    try:
+        sp = SamplingParams(temperature=0.0, max_new_tokens=32)
+        first = gw.submit([1, 2], sp)  # occupies the estimate
+        body = json.dumps({"prompt": [3, 4], "max_new_tokens": 4}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/generate", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30)
+        assert e.value.code == 429
+        assert int(e.value.headers["Retry-After"]) >= 1
+        first.result(timeout=120)
+    finally:
+        srv.stop()
+        gw.stop()
+
+
+# ------------------------------------------------------------- autoscaler
+
+
+class TestAutoscaler:
+    def _scaler(self, signals):
+        """Autoscaler fed synthetic telemetry, recording plans."""
+        plans = []
+
+        class _Recorder:
+            def scale(self, plan):
+                plans.append(plan)
+
+        it = iter(signals)
+        asc = GatewayAutoscaler(
+            gateway=None, scaler=_Recorder(), min_replicas=1,
+            max_replicas=4, down_ticks=2,
+            signals_fn=lambda: next(it),
+        )
+        return asc, plans
+
+    def test_scales_up_on_queue_depth(self):
+        asc, plans = self._scaler([GatewaySignals(
+            queue_depth=30, slot_occupancy=0.9, p95_s=0.1, live=2,
+            slots_per_replica=4,
+        )])
+        asc.tick()
+        assert asc.target == 3
+        assert plans[-1].replica_resources == {"serving": 3}
+
+    def test_scales_up_on_p95(self):
+        asc, plans = self._scaler([GatewaySignals(
+            queue_depth=0, slot_occupancy=0.4, p95_s=9.0, live=2,
+            slots_per_replica=4,
+        )])
+        asc.target_p95_s = 2.0
+        asc.tick()
+        assert asc.target == 3
+
+    def test_scales_down_only_after_streak(self):
+        cold = GatewaySignals(queue_depth=0, slot_occupancy=0.0,
+                              p95_s=0.0, live=3, slots_per_replica=4)
+        asc, plans = self._scaler([cold, cold, cold])
+        asc.tick()
+        assert asc.target == 3      # first cold tick: no change
+        asc.tick()
+        assert asc.target == 2      # streak reached (down_ticks=2)
+
+    def test_clamped_to_bounds(self):
+        hot = GatewaySignals(queue_depth=100, slot_occupancy=1.0,
+                             p95_s=10.0, live=4, slots_per_replica=4)
+        asc, _ = self._scaler([hot, hot])
+        asc.tick()
+        asc.tick()
+        assert asc.target == 4      # max_replicas
+        cold = GatewaySignals(queue_depth=0, slot_occupancy=0.0,
+                              p95_s=0.0, live=1, slots_per_replica=4)
+        asc2, _ = self._scaler([cold] * 10)
+        for _ in range(10):
+            asc2.tick()
+        assert asc2.target == 1     # min_replicas
+
+    def test_restore_plan_when_live_below_target(self):
+        steady = GatewaySignals(queue_depth=2, slot_occupancy=0.5,
+                                p95_s=0.1, live=1, slots_per_replica=4)
+        asc, plans = self._scaler([steady])
+        asc.target = 2
+        asc.tick()
+        assert plans and plans[-1].replica_resources == {"serving": 2}
+
+    def test_p95_from_buckets(self):
+        bounds = (0.1, 1.0, 5.0)
+        assert p95_from_buckets(bounds, [0, 0, 0, 0]) == 0.0
+        assert p95_from_buckets(bounds, [100, 0, 0, 0]) == 0.1
+        assert p95_from_buckets(bounds, [94, 0, 6, 0]) == 5.0
+        assert p95_from_buckets(bounds, [0, 0, 0, 3]) == 5.0
+
+
+@pytest.mark.timeout(300)
+def test_scaleplan_path_resizes_pool(gateway):
+    """PoolScaler executes the same ScalePlan verbs node scalers do."""
+    scaler = PoolScaler(gateway.pool)
+    scaler.scale(ScalePlan(replica_resources={"serving": 3},
+                           reason="test grow"))
+    assert _wait(lambda: len(gateway.pool.ready_replicas()) == 3)
+    scaler.scale(ScalePlan(replica_resources={"serving": 1},
+                           reason="test shrink"))
+    assert _wait(lambda: gateway.pool.live_count() == 1)
+    assert _wait(lambda: len(gateway.pool.ready_replicas()) == 1)
+    # remove verb drains a NAMED replica
+    victim = gateway.pool.ready_replicas()[0].id
+    scaler.scale(ScalePlan(remove_nodes=[victim], reason="test remove"))
+    assert _wait(lambda: gateway.pool.live_count() == 0)
+
+
+@pytest.mark.timeout(300)
+def test_autoscaler_restores_killed_replica(params):
+    gw = Gateway(_factory(params), replicas=2, prefill_len=8,
+                 health_interval_s=0.1)
+    assert _wait(lambda: len(gw.pool.ready_replicas()) == 2)
+    asc = GatewayAutoscaler(gw, PoolScaler(gw.pool), min_replicas=2,
+                            max_replicas=4, interval_s=0.2).start()
+    try:
+        gw.pool.kill_replica(gw.pool.ready_replicas()[0].id)
+        assert _wait(lambda: len(gw.pool.ready_replicas()) == 2,
+                     timeout=120)
+        # and the restored pool still serves
+        res = gw.generate([4, 2], SamplingParams(temperature=0.0,
+                                                 max_new_tokens=4),
+                          timeout=120)
+        assert len(res.tokens) == 4
+    finally:
+        asc.stop()
+        gw.stop()
+
+
+@pytest.mark.timeout(300)
+def test_requests_survive_window_with_no_ready_replica(params):
+    """Kill the ONLY replica: queued work waits undispatched until the
+    autoscaler brings a replacement, then completes."""
+    gw = Gateway(_factory(params), replicas=1, prefill_len=8,
+                 health_interval_s=0.1)
+    assert _wait(lambda: len(gw.pool.ready_replicas()) == 1)
+    asc = GatewayAutoscaler(gw, PoolScaler(gw.pool), min_replicas=1,
+                            max_replicas=2, interval_s=0.2).start()
+    try:
+        sp = SamplingParams(temperature=0.0, max_new_tokens=8)
+        futs = [gw.submit([i + 1, i + 2], sp) for i in range(4)]
+        gw.pool.kill_replica(gw.pool.ready_replicas()[0].id)
+        results = [f.result(timeout=120) for f in futs]
+        assert len(results) == 4
+        assert all(len(r.tokens) == 8 for r in results)
+    finally:
+        asc.stop()
+        gw.stop()

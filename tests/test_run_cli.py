@@ -50,6 +50,44 @@ class TestSniffAccelerator:
         assert sniff_accelerator(str(tmp_path), str(tmp_path / "pci")) \
             == ("tpu", 4)
 
+    def test_unreadable_sysfs_link_warns(self, tmp_path):
+        """A /dev/accel node whose sysfs PCI link is unreadable falls
+        back to the megacore default — with a warning naming the escape
+        hatch, so a v2/v3 undercount is diagnosable from the log."""
+        import logging
+
+        records: list[logging.LogRecord] = []
+
+        class _Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record)
+
+        # the repo's loggers set propagate=False, so capture directly
+        logger = logging.getLogger("dlrover_tpu.common.accelerator")
+        handler = _Capture(level=logging.WARNING)
+        logger.addHandler(handler)
+        try:
+            (tmp_path / "accel0").touch()
+            kind, count = sniff_accelerator(
+                str(tmp_path), str(tmp_path / "pci"),
+                str(tmp_path / "accel_class"),
+            )
+            assert (kind, count) == ("tpu", 1)
+            assert any("DLROVER_TPU_DEVICE_COUNT" in r.getMessage()
+                       for r in records)
+            # a READABLE link stays quiet
+            records.clear()
+            d = tmp_path / "accel_class" / "accel0" / "device"
+            d.mkdir(parents=True)
+            (d / "device").write_text("0x005e\n")
+            assert sniff_accelerator(
+                str(tmp_path), str(tmp_path / "pci"),
+                str(tmp_path / "accel_class"),
+            ) == ("tpu", 1)
+            assert not records
+        finally:
+            logger.removeHandler(handler)
+
     def test_sysfs_google_accelerators_counted(self, tmp_path):
         pci = tmp_path / "pci"
         _pci_dev(pci, "0000:00:01.0", "0x1ae0", "0x120000")

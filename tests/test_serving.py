@@ -386,6 +386,71 @@ class TestPrefixCache:
         eng.run()
         assert len(eng._prefix_cache) <= 2
 
+    def test_long_prompt_miss_probes_stored_lengths_only(self, params):
+        """Advisor fix (engine.py _prefix_lookup): a cache miss on a
+        long prompt must probe one key per DISTINCT stored length, not
+        hash every aligned prefix of the prompt (O(n^2/P))."""
+        eng = InferenceEngine(params, CFG, slots=1, max_len=64,
+                              prefill_len=8, prefix_cache_entries=8)
+        eng.submit(self.SYS, SamplingParams(temperature=0.0,
+                                            max_new_tokens=2))
+        eng.run()   # stores one entry (final aligned boundary, len 16)
+        probes = 0
+        orig_get = dict.get
+
+        class Counting(dict):
+            def get(self, *a):
+                nonlocal probes
+                probes += 1
+                return orig_get(self, *a)
+
+        eng._prefix_cache = Counting(eng._prefix_cache)
+        # a 4096-token prompt that shares nothing: pre-fix this probed
+        # 512 ever-shorter tuples (~1M hashed elements); now it probes
+        # exactly the one stored length
+        assert eng._prefix_lookup(list(range(100, 4196))) is None
+        assert probes == 1
+        # and a real hit through the capped path still resolves
+        probes = 0
+        hit = eng._prefix_lookup(self.SYS + [1, 2, 3])
+        assert hit is not None and hit[0] == 16
+        assert probes == 1
+
+    def test_cold_long_prompts_do_not_churn_lru(self, params):
+        """Advisor fix (engine.py _admit): a cold non-sharing prompt
+        snapshots only its FINAL aligned boundary, so a wave of long
+        unrelated prompts cannot evict a shared system prefix."""
+        eng = InferenceEngine(params, CFG, slots=1, max_len=64,
+                              prefill_len=8, prefix_cache_entries=4)
+        sp = SamplingParams(temperature=0.0, max_new_tokens=2)
+        eng.submit(self.SYS, sp)              # the shared prefix: 1 entry
+        eng.run()
+        for base in (200, 300):               # cold 32-token prompts
+            eng.submit([base + i for i in range(32)], sp)
+            eng.run()
+        # each cold prompt added ONE entry (len 32), not 4 (8/16/24/32)
+        assert len(eng._prefix_cache) == 3
+        assert sorted(len(k) for k in eng._prefix_cache) == [16, 32, 32]
+        # the shared system prefix survived the churn and still hits
+        hits_before = eng.prefix_cache_hits
+        eng.submit(self.SYS + [7], sp)
+        eng.run()
+        assert eng.prefix_cache_hits == hits_before + 1
+
+    def test_extension_snapshots_intermediate_boundaries(self, params):
+        """Extending an already-cached prefix DOES snapshot the chain:
+        that is the shared-system-prompt shape the cache exists for."""
+        eng = InferenceEngine(params, CFG, slots=1, max_len=64,
+                              prefill_len=8, prefix_cache_entries=8)
+        sp = SamplingParams(temperature=0.0, max_new_tokens=2)
+        eng.submit(self.SYS, sp)              # cache len-16 prefix
+        eng.run()
+        eng.submit(self.SYS + list(range(60, 76)), sp)  # 32 tokens
+        eng.run()
+        # resumed at 16 (a hit), then snapshotted 24 AND 32
+        assert eng.prefix_cache_hits >= 1
+        assert sorted(len(k) for k in eng._prefix_cache) == [16, 24, 32]
+
     def test_weight_push_invalidates(self, params):
         eng = InferenceEngine(params, CFG, slots=1, max_len=64,
                               prefill_len=8, prefix_cache_entries=8)
