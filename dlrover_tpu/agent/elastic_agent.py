@@ -230,9 +230,21 @@ class ElasticAgent:
     # ------------------------------------------------------------ main loop
 
     def run(self) -> RunResult:
+        from dlrover_tpu.telemetry.bundle import install_sigusr2
         from dlrover_tpu.telemetry.exposition import start_from_env
 
         self._metrics_server = start_from_env()
+        # operator runbook: `kill -USR2 <agent pid>` captures a full
+        # flight-recorder bundle (incl. the live trainer's stacks) on
+        # demand without disturbing the job
+        install_sigusr2(
+            on_bundle=self._report_bundle,
+            child_pid_fn=lambda: (
+                self._proc.pid
+                if self._proc is not None and self._proc.poll() is None
+                else None
+            ),
+        )
         self._start_heartbeat()
         self._start_ckpt_saver()
         self._start_resource_monitor()
@@ -292,6 +304,16 @@ class ElasticAgent:
                     "for %.0fs; killing the wedged trainer",
                     hang.last_step(), self._config.hang_timeout_s,
                 )
+                # flight recorder FIRST: the wedged child's C-level
+                # stack dump (SIGUSR2 -> faulthandler) is only readable
+                # while it is still alive
+                self._write_bundle(
+                    "hang",
+                    child_pid=(self._proc.pid
+                               if self._proc is not None else None),
+                    extra={"last_step": hang.last_step(),
+                           "timeout_s": self._config.hang_timeout_s},
+                )
                 self._kill_child()
                 continue
             # healthy: check for membership changes / master actions
@@ -317,6 +339,15 @@ class ElasticAgent:
             "training process exited with code %d (%s) -> %s",
             exit_code, reason.value, action.value,
         )
+        if exit_code != 0:
+            # pre-respawn flight recorder: journal tail, metrics and env
+            # as they were when the worker died (the child is gone — any
+            # stale armed stack dump it left is scooped up, not poked)
+            self._write_bundle(
+                "crash",
+                extra={"exit_code": exit_code, "reason": reason.value,
+                       "action": action.value},
+            )
         self._client.report_failure(
             error_data=f"exit code {exit_code} ({reason.value})",
             restart_count=self._restart_count,
@@ -379,6 +410,26 @@ class ElasticAgent:
             self._incarnation += 1
             rank, num_nodes, coordinator = self._rendezvous()
             self._proc = self._spawn(rank, num_nodes, coordinator)
+
+    def _write_bundle(self, reason: str, child_pid: int | None = None,
+                      extra: dict | None = None) -> str | None:
+        """Capture a flight-recorder bundle and report its path to the
+        master; best-effort and off via DLROVER_TPU_BUNDLES=0."""
+        if os.environ.get(EnvKey.BUNDLES, "1") == "0":
+            return None
+        from dlrover_tpu.telemetry.bundle import write_bundle
+
+        path = write_bundle(reason, node_id=self._config.node_id,
+                            child_pid=child_pid, extra=extra)
+        if path:
+            self._report_bundle(path, reason)
+        return path
+
+    def _report_bundle(self, path: str, reason: str) -> None:
+        try:
+            self._client.report_debug_bundle(path, reason, proc="agent")
+        except (ConnectionError, RuntimeError, OSError) as e:
+            logger.warning("debug bundle report failed: %s", e)
 
     def _recover_shards(self) -> None:
         """Give the dead trainer's in-flight data shards back to the queue.

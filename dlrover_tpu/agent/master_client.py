@@ -210,6 +210,25 @@ class MasterClient:
             )
         )
 
+    def report_debug_bundle(self, path: str, reason: str,
+                            proc: str = "") -> None:
+        """Tell the master a flight-recorder bundle landed on this node
+        (telemetry/bundle.py), so one master query lists them all."""
+        import socket
+
+        self._client.call(
+            m.DebugBundleReport(
+                node_id=self.node_id, path=path, reason=reason,
+                host=socket.gethostname(), proc=proc,
+                timestamp=time.time(),
+            )
+        )
+
+    def list_debug_bundles(self) -> list[m.DebugBundleReport]:
+        return self._client.call(
+            m.DebugBundleListRequest(node_id=self.node_id)
+        ).bundles
+
     def get_running_nodes(self) -> list[m.NodeMeta]:
         return self._client.call(m.RunningNodesRequest()).nodes
 

@@ -13,8 +13,18 @@ from __future__ import annotations
 import threading
 
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry.metrics import registry
 
 logger = get_logger(__name__)
+
+# set from the TRAINER process (the one that owns the chips) and shipped
+# to the master inside its pushed registry snapshot, where the
+# exposition endpoint re-renders it with the node label
+_device_memory_bytes = registry().gauge(
+    "dlrover_tpu_device_memory_bytes",
+    "per-device HBM from jax memory_stats() (kind: used | limit)",
+    label_names=("device", "kind"),
+)
 
 
 try:
@@ -75,17 +85,35 @@ class ResourceMonitor:
                 logger.warning("resource report failed: %s", e)
 
 
-def local_hbm_used_mb() -> int:
-    """HBM bytes in use across this process's local devices (0 if the
-    runtime doesn't expose memory_stats — e.g. CPU or tunneled backends)."""
+def publish_device_memory() -> int:
+    """Per-device HBM used/limit gauges + total used MB.
+
+    Reads ``jax.local_devices()[i].memory_stats()`` — None on backends
+    without it (CPU, some tunnels), so every field access is None-safe
+    and a statless backend publishes nothing and returns 0. Must only be
+    called from the process that owns the chips (the trainer)."""
     try:
         import jax
 
         total = 0
         for d in jax.local_devices():
             stats = d.memory_stats()
-            if stats:
-                total += int(stats.get("bytes_in_use", 0))
+            if not stats:
+                continue
+            used = int(stats.get("bytes_in_use", 0) or 0)
+            limit = int(stats.get("bytes_limit", 0) or 0)
+            _device_memory_bytes.labels(str(d.id), "used").set(used)
+            if limit > 0:
+                _device_memory_bytes.labels(str(d.id), "limit").set(limit)
+            total += used
         return total // (1 << 20)
     except Exception:  # noqa: BLE001
         return 0
+
+
+def local_hbm_used_mb() -> int:
+    """HBM bytes in use across this process's local devices (0 if the
+    runtime doesn't expose memory_stats — e.g. CPU or tunneled backends).
+    Also refreshes the per-device ``dlrover_tpu_device_memory_bytes``
+    gauges as a side effect."""
+    return publish_device_memory()

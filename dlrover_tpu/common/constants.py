@@ -132,6 +132,13 @@ class EnvKey:
     JOURNAL_DIR = "DLROVER_TPU_JOURNAL_DIR"
     TRACE_ID = "DLROVER_TPU_TRACE_ID"
     LOG_JSON = "DLROVER_TPU_LOG_JSON"
+    # flight recorder (telemetry/bundle.py, telemetry/journal.py): where
+    # crash/hang debug bundles land (default <journal dir>/bundles), the
+    # journal size cap in MB (0/unset = unbounded), and the "1"-default
+    # switch for automatic bundles on hang/crash verdicts
+    BUNDLE_DIR = "DLROVER_TPU_BUNDLE_DIR"
+    JOURNAL_MAX_MB = "DLROVER_TPU_JOURNAL_MAX_MB"
+    BUNDLES = "DLROVER_TPU_BUNDLES"
 
 
 class Defaults:

@@ -367,6 +367,36 @@ class MetricsSnapshotRequest:
 
 @register_message
 @dataclasses.dataclass
+class DebugBundleReport:
+    """Node -> master: a flight-recorder debug bundle was written
+    (telemetry/bundle.py) — hang/crash verdict or operator SIGUSR2. The
+    master keeps a bounded ledger so one query lists every bundle in the
+    job (the path is node-local; ``host`` says which pod/VM holds it)."""
+
+    node_id: int = 0
+    path: str = ""
+    reason: str = ""     # hang | crash | sigusr2 | ...
+    host: str = ""
+    proc: str = ""       # writer identity: nodeN agent vs trainer child
+    timestamp: float = 0.0
+
+
+@register_message
+@dataclasses.dataclass
+class DebugBundleListRequest:
+    node_id: int = 0
+
+
+@register_message
+@dataclasses.dataclass
+class DebugBundleListResponse:
+    bundles: list[DebugBundleReport] = dataclasses.field(
+        default_factory=list
+    )
+
+
+@register_message
+@dataclasses.dataclass
 class NetworkCheckStatusRequest:
     node_id: int = 0
 

@@ -29,6 +29,23 @@ class DiagnosisManager:
         self._results: dict[int, dict[int, _ProbeResult]] = {}
         self._expected_nodes: set[int] = set()
         self._generation = -1
+        # node_id -> score: stragglers flagged by the CONTINUOUS runtime
+        # detector (telemetry/anomaly.py) between probe rounds; surfaced
+        # next to probe-detected ones so the failure ladder can prefer
+        # restarting the slow node
+        self._runtime_stragglers: dict[int, float] = {}
+
+    def set_runtime_straggler(self, node_id: int, flagged: bool,
+                              score: float = 0.0) -> None:
+        with self._lock:
+            if flagged:
+                self._runtime_stragglers[node_id] = score
+            else:
+                self._runtime_stragglers.pop(node_id, None)
+
+    def runtime_stragglers(self) -> list[int]:
+        with self._lock:
+            return sorted(self._runtime_stragglers)
 
     def set_expected_nodes(self, node_ids: set[int],
                            generation: int = 0) -> None:

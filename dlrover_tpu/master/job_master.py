@@ -26,6 +26,7 @@ from dlrover_tpu.master.rdzv_manager import (
 from dlrover_tpu.master.servicer import MasterServicer
 from dlrover_tpu.master.speed_monitor import SpeedMonitor
 from dlrover_tpu.master.task_manager import TaskManager
+from dlrover_tpu.telemetry.anomaly import StragglerDetector
 
 logger = get_logger(__name__)
 
@@ -57,6 +58,10 @@ class JobMaster:
         self.speed_monitor = SpeedMonitor(hang_timeout_s=hang_timeout_s)
         self.kv_store = KVStoreService()
         self.diagnosis = DiagnosisManager()
+        # continuous straggler detection from the step series trainers
+        # push with their metrics snapshots (telemetry/anomaly.py) —
+        # probe rounds diagnose at rendezvous, this watches the live run
+        self.anomaly = StragglerDetector(diagnosis=self.diagnosis)
         self.stats_reporter = LocalStatsReporter()
         self.node_manager = NodeManager(
             dead_window_s=heartbeat_dead_window_s,
@@ -89,6 +94,7 @@ class JobMaster:
             diagnosis=self.diagnosis,
             stats_reporter=self.stats_reporter,
             trace_id=self.trace_id,
+            anomaly=self.anomaly,
         )
         self._server = RpcServer(self.servicer.handle, port=port)
         self._metrics_server = None
@@ -119,6 +125,9 @@ class JobMaster:
         for mgr in self.rdzv_managers.values():
             mgr.remove_node(node_id)
         self.stats_reporter.remove(node_id)
+        # a dead node's step series (and any straggler verdict on it)
+        # must not outlive it — its relaunch starts with a clean slate
+        self.anomaly.remove_node(node_id)
 
     def metrics_text(self) -> str:
         """Master registry + every node's pushed snapshot, one scrape."""
@@ -152,17 +161,22 @@ class JobMaster:
     def run(self, poll_interval_s: float = 2.0,
             all_exited_grace_s: float = 30.0,
             recovery_grace_s: float | None = None,
-            max_hang_restarts: int = 3) -> bool:
+            max_hang_restarts: int = 3,
+            max_straggler_restarts: int = 2) -> bool:
         """Block until the job finishes; returns success.
 
         ``max_hang_restarts`` bounds hang-triggered restarts over the whole
         job lifetime: the per-incident budget below replenishes on
         post-restart progress, so without a lifetime cap a worker that
         reports once and wedges again would be restarted forever.
+        ``max_straggler_restarts`` likewise bounds the targeted
+        slow-node restarts the continuous straggler detector can trigger
+        (0 disables the rung; verdicts still journal and export).
         """
         all_exited_since = 0.0
         hang_restarts = 0
         total_hang_restarts = 0
+        straggler_restarts = 0
         restart_broadcast_time = 0.0
         if recovery_grace_s is None:
             # recovery may legitimately exceed the hang window with no
@@ -213,6 +227,25 @@ class JobMaster:
                 logger.error("job still hung after a restart; stopping")
                 self.servicer.job_success = False
                 break
+            # targeted slow-node rung: a node the continuous detector has
+            # held flagged long enough gets a restart-in-place (snapshot
+            # persists, rank respawns) — the node-restart rung of the
+            # failure ladder, preferred over restarting the whole job
+            for nid in self.anomaly.take_actionable():
+                if straggler_restarts >= max_straggler_restarts:
+                    logger.warning(
+                        "straggler node %d flagged but the restart "
+                        "budget (%d) is spent; leaving it running",
+                        nid, max_straggler_restarts,
+                    )
+                    continue
+                straggler_restarts += 1
+                if self.node_manager.send_action(nid, "restart"):
+                    logger.warning(
+                        "persistent straggler: restarting node %d in "
+                        "place (%d/%d straggler restarts used)",
+                        nid, straggler_restarts, max_straggler_restarts,
+                    )
             # every node reached a terminal state without an explicit job
             # exit (e.g. the last host left for relaunch and no scaler will
             # replace it): don't hang forever (reference: the all-exited

@@ -248,6 +248,17 @@ class NodeManager:
                 if nid not in exclude and node.status == NodeStatus.RUNNING:
                     self._pending_actions[nid] = action
 
+    def send_action(self, node_id: int, action: str) -> bool:
+        """Queue an action for ONE running node (delivered on its next
+        heartbeat) — the targeted rung the straggler path uses: restart
+        the slow node, not the job."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None or node.status != NodeStatus.RUNNING:
+                return False
+            self._pending_actions[node_id] = action
+            return True
+
     # ---------------------------------------------------------------- queries
 
     def running_nodes(self) -> list[Node]:

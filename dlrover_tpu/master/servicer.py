@@ -36,6 +36,7 @@ class MasterServicer:
         stats_reporter=None,
         metric_collector=None,
         trace_id: str = "",
+        anomaly=None,
     ):
         from dlrover_tpu.master.stats import (
             JobMetricCollector,
@@ -68,6 +69,13 @@ class MasterServicer:
         # endpoint with a per-node label
         self._node_metrics: dict[tuple[int, str], list] = {}
         self._node_metrics_lock = threading.Lock()
+        # continuous straggler detector (telemetry/anomaly.py), fed from
+        # the same pushed snapshots; None = feature not wired
+        self._anomaly = anomaly
+        # bounded ledger of flight-recorder bundles reported by nodes
+        self._bundles: list[m.DebugBundleReport] = []
+        self._bundles_lock = threading.Lock()
+        self.max_bundles = 200
         self._rpc_seconds = registry().histogram(
             "dlrover_tpu_master_rpc_seconds",
             "master RPC dispatch latency by message type",
@@ -169,7 +177,25 @@ class MasterServicer:
         if isinstance(msg, m.MetricsSnapshotRequest):
             with self._node_metrics_lock:
                 self._node_metrics[(msg.node_id, msg.role)] = msg.samples
+            if self._anomaly is not None:
+                # the straggler detector mines the step-duration series
+                # out of the same push (no-op for snapshots without it)
+                self._anomaly.observe_snapshot(msg.node_id, msg.samples)
             return m.OkResponse()
+        if isinstance(msg, m.DebugBundleReport):
+            if not msg.timestamp:
+                msg.timestamp = time.time()
+            logger.warning(
+                "debug bundle from node %d (%s): %s on host %s",
+                msg.node_id, msg.reason, msg.path, msg.host,
+            )
+            with self._bundles_lock:
+                self._bundles.append(msg)
+                del self._bundles[:-self.max_bundles]
+            return m.OkResponse()
+        if isinstance(msg, m.DebugBundleListRequest):
+            with self._bundles_lock:
+                return m.DebugBundleListResponse(bundles=list(self._bundles))
         if isinstance(msg, m.GlobalStepReport):
             self._speed_monitor.report_step(msg.step, msg.timestamp)
             return m.OkResponse()
@@ -397,6 +423,11 @@ class MasterServicer:
 
     def _network_check_status(self) -> m.NetworkCheckStatusResponse:
         done, abnormal, stragglers = self._diagnosis.bisect_status()
+        # runtime stragglers (continuous detector) surface beside
+        # probe-detected ones; `completed` still tracks the probe rounds
+        stragglers = sorted(
+            set(stragglers) | set(self._diagnosis.runtime_stragglers())
+        )
         return m.NetworkCheckStatusResponse(
             completed=done,
             abnormal_nodes=abnormal,

@@ -1,6 +1,15 @@
 """Unified telemetry for the elastic control plane.
 
-Three dependency-free parts (ISSUE 1):
+Dependency-free parts (ISSUE 1, flight recorder in ISSUE 3):
+
+- ``anomaly``: continuous straggler detection on the master from the
+  step-duration series trainers push with their registry snapshots.
+- ``bundle``: crash/hang/SIGUSR2 flight-recorder debug bundles (stack
+  dumps, journal tail, metrics, env/device manifest).
+- ``timeline``: ``python -m dlrover_tpu.telemetry.timeline`` renders
+  journals as Perfetto-loadable Chrome trace-event JSON.
+
+And the ISSUE-1 substrate:
 
 - ``metrics``: a thread-safe labeled metrics registry (Counter, Gauge,
   Histogram) with one process-default instance. Metric names follow the

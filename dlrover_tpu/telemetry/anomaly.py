@@ -1,0 +1,214 @@
+"""Continuous straggler detection from live per-node step series.
+
+The probe-round diagnosis (``master/diagnosis.py``) answers "is this
+node slow?" only when a network check runs — between probes a degraded
+host (thermal throttling, a sick PCIe link, a noisy neighbor) silently
+drags every collective-gated step while the job reports "healthy".
+ElasWave (PAPERS.md) makes the general point: recovery decisions are
+only as good as the runtime signals behind them.
+
+This detector runs on the master and consumes the per-node step-duration
+series the job already ships: trainers push their metrics-registry
+snapshot (``MetricsSnapshotRequest``), and the delta of the
+``dlrover_tpu_train_step_seconds`` histogram's (sum, count) between two
+consecutive snapshots is that node's mean step time over the interval —
+no new RPC, no probe round, no extra device work.
+
+Verdict rule (same ``straggler_ratio`` spirit as ``DiagnosisManager``,
+but continuous): a node is flagged when its recent median step time
+exceeds ``ratio`` x the fleet median, and cleared with hysteresis below
+``clear_ratio`` x — the gap keeps a node oscillating around the
+threshold from flapping verdicts. A robust z-score
+(0.6745 x (node - median) / MAD) is journaled as evidence alongside the
+median-ratio score. Verdict transitions are journaled
+(``straggler_verdict`` spans), exported as
+``dlrover_tpu_straggler_score{node}`` gauges, and fed to
+``DiagnosisManager`` so the failure ladder sees runtime stragglers next
+to probe-detected ones and the master can prefer restarting the slow
+node over restarting the job.
+"""
+
+from __future__ import annotations
+
+import statistics
+import threading
+from collections import deque
+
+from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.metrics import registry
+
+STEP_METRIC = "dlrover_tpu_train_step_seconds"
+
+_score_gauge = registry().gauge(
+    "dlrover_tpu_straggler_score",
+    "per-node median step time over the fleet median (>1 = slower; "
+    "flagged while above the detector ratio)",
+    label_names=("node",),
+)
+_verdicts_total = registry().counter(
+    "dlrover_tpu_straggler_verdicts_total",
+    "runtime straggler verdict transitions",
+    label_names=("state",),
+)
+
+
+def _step_stats(samples: list) -> tuple[float, int] | None:
+    """(sum, count) of the step-duration histogram in a pushed registry
+    snapshot (``MetricsRegistry.snapshot()`` wire shape), or None."""
+    for metric in samples:
+        if not isinstance(metric, dict) or metric.get("name") != STEP_METRIC:
+            continue
+        total = 0.0
+        count = 0
+        for sample in metric.get("samples", ()):
+            total += float(sample.get("sum", 0.0))
+            count += int(sample.get("count", 0))
+        return total, count
+    return None
+
+
+class _NodeSeries:
+    __slots__ = ("cum_sum", "cum_count", "points", "flagged", "streak",
+                 "acted")
+
+    def __init__(self, window: int):
+        self.cum_sum = 0.0
+        self.cum_count = 0
+        self.points: deque[float] = deque(maxlen=window)
+        self.flagged = False
+        self.streak = 0   # consecutive evaluations flagged
+        self.acted = False  # a restart was already issued this episode
+
+    def recent(self) -> float:
+        return statistics.median(self.points)
+
+
+class StragglerDetector:
+    """Online median-ratio straggler detector over pushed step series."""
+
+    def __init__(self, diagnosis=None, *, ratio: float = 2.0,
+                 clear_ratio: float = 1.4, min_nodes: int = 3,
+                 min_points: int = 3, window: int = 32,
+                 action_streak: int = 3):
+        if clear_ratio >= ratio:
+            raise ValueError("clear_ratio must sit below ratio (hysteresis)")
+        self._diagnosis = diagnosis
+        self._ratio = ratio
+        self._clear_ratio = clear_ratio
+        self._min_nodes = min_nodes
+        self._min_points = min_points
+        self._window = window
+        self._action_streak = action_streak
+        self._lock = threading.Lock()
+        self._nodes: dict[int, _NodeSeries] = {}
+
+    # ------------------------------------------------------------ ingestion
+
+    def observe_snapshot(self, node_id: int, samples: list) -> None:
+        """Feed one pushed registry snapshot; cheap no-op when it carries
+        no step histogram (agent-role snapshots)."""
+        stats = _step_stats(samples)
+        if stats is None:
+            return
+        total, count = stats
+        with self._lock:
+            series = self._nodes.get(node_id)
+            if series is None:
+                series = self._nodes[node_id] = _NodeSeries(self._window)
+            dsum = total - series.cum_sum
+            dcount = count - series.cum_count
+            if dcount < 0 or dsum < 0:
+                # trainer respawned: cumulative counters restarted
+                dsum, dcount = total, count
+            series.cum_sum, series.cum_count = total, count
+            if dcount > 0:
+                series.points.append(dsum / dcount)
+            transitions = self._evaluate_locked()
+        for node, flagged, score, z in transitions:
+            self._publish(node, flagged, score, z)
+
+    def remove_node(self, node_id: int) -> None:
+        """Forget a departed node so a relaunched id starts clean."""
+        with self._lock:
+            series = self._nodes.pop(node_id, None)
+            was_flagged = bool(series and series.flagged)
+        _score_gauge.labels(str(node_id)).set(0.0)
+        if was_flagged and self._diagnosis is not None:
+            self._diagnosis.set_runtime_straggler(node_id, False)
+
+    # ------------------------------------------------------------ verdicts
+
+    def _evaluate_locked(self) -> list[tuple[int, bool, float, float]]:
+        recents = {
+            nid: s.recent() for nid, s in self._nodes.items()
+            if len(s.points) >= self._min_points
+        }
+        if len(recents) < self._min_nodes:
+            return []
+        med = statistics.median(recents.values())
+        if med <= 0:
+            return []
+        mad = statistics.median(abs(v - med) for v in recents.values())
+        transitions: list[tuple[int, bool, float, float]] = []
+        for nid, val in recents.items():
+            score = val / med
+            z = 0.6745 * (val - med) / mad if mad > 0 else 0.0
+            series = self._nodes[nid]
+            if not series.flagged and score > self._ratio:
+                series.flagged = True
+                series.streak = 1
+                transitions.append((nid, True, score, z))
+            elif series.flagged and score < self._clear_ratio:
+                series.flagged = False
+                series.streak = 0
+                series.acted = False
+                transitions.append((nid, False, score, z))
+            elif series.flagged:
+                series.streak += 1
+                _score_gauge.labels(str(nid)).set(round(score, 4))
+            else:
+                _score_gauge.labels(str(nid)).set(round(score, 4))
+        return transitions
+
+    def _publish(self, node_id: int, flagged: bool, score: float,
+                 z: float) -> None:
+        state = "flagged" if flagged else "cleared"
+        _score_gauge.labels(str(node_id)).set(round(score, 4))
+        _verdicts_total.labels(state).inc()
+        get_journal().emit(
+            "straggler_verdict", node=node_id, state=state,
+            score=round(score, 4), robust_z=round(z, 4),
+        )
+        if self._diagnosis is not None:
+            self._diagnosis.set_runtime_straggler(node_id, flagged, score)
+
+    # -------------------------------------------------------------- queries
+
+    def stragglers(self) -> list[int]:
+        with self._lock:
+            return sorted(n for n, s in self._nodes.items() if s.flagged)
+
+    def score(self, node_id: int) -> float:
+        with self._lock:
+            series = self._nodes.get(node_id)
+            if series is None or len(series.points) < self._min_points:
+                return 0.0
+            recents = [
+                s.recent() for s in self._nodes.values()
+                if len(s.points) >= self._min_points
+            ]
+            med = statistics.median(recents) if recents else 0.0
+            return series.recent() / med if med > 0 else 0.0
+
+    def take_actionable(self) -> list[int]:
+        """Nodes flagged for >= ``action_streak`` consecutive evaluations
+        that have not yet been acted on this episode; marks them acted so
+        one straggler episode yields at most one restart."""
+        out: list[int] = []
+        with self._lock:
+            for nid, series in sorted(self._nodes.items()):
+                if (series.flagged and not series.acted
+                        and series.streak >= self._action_streak):
+                    series.acted = True
+                    out.append(nid)
+        return out

@@ -1,0 +1,299 @@
+"""Flight-recorder debug bundles: evidence captured at the failure.
+
+When the agent kills a wedged trainer or respawns a crashed one, the
+operator's forensic window closes with the process. A bundle freezes it
+first: one self-contained directory per incident holding
+
+- ``stacks.txt``       — all-thread stack dump of the writing process
+  (``faulthandler``), plus ``child_stacks.txt`` when the agent poked a
+  live (possibly wedged) trainer child first;
+- ``journal_tail.jsonl`` — the last N event-journal lines (rotation-
+  aware), i.e. what the job was doing right before the verdict;
+- ``metrics.json``     — the process metrics-registry snapshot;
+- ``manifest.json``    — reason, identity (node/proc/pid/trace), host,
+  filtered env (``DLROVER_TPU_*``/``JAX_*``/``XLA_*``/``TPU_*``), and
+  JAX device + memory stats when JAX is already loaded.
+
+Bundles land under ``DLROVER_TPU_BUNDLE_DIR`` (default:
+``$DLROVER_TPU_JOURNAL_DIR/bundles``, else a tmpdir). Writers report the
+path to the master (``DebugBundleReport``) so one master query lists
+every bundle in the job.
+
+Wedged-trainer capture: a fully stuck child (deadlocked collective,
+stuck host callback) cannot run Python signal handlers, so the trainer
+arms ``faulthandler.register(SIGUSR2)`` at bootstrap — a C-level dump
+that works even while the GIL is held — writing to a deterministic
+per-node file the agent scoops into its bundle after signalling the
+child. The agent itself (healthy by definition when it writes) installs
+a Python-level SIGUSR2 handler producing a full on-demand bundle.
+
+Bundle writing must never take down the instrumented path: every public
+function swallows its own failures.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import json
+import os
+import signal
+import socket
+import sys
+import tempfile
+import threading
+import time
+import uuid
+
+from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry.journal import (
+    JOURNAL_FILE,
+    ROTATED_SUFFIX,
+    current_trace_id,
+    get_journal,
+)
+from dlrover_tpu.telemetry.metrics import registry
+
+logger = get_logger(__name__)
+
+_bundles_total = registry().counter(
+    "dlrover_tpu_debug_bundles_total",
+    "flight-recorder debug bundles written by this process",
+    label_names=("reason",),
+)
+
+JOURNAL_TAIL_LINES = 400
+
+# keep the faulthandler target file object alive: faulthandler keeps only
+# the fd, and a GC'd file would dump into whatever reused it
+_armed_file = None
+
+
+def bundle_root() -> str:
+    root = os.environ.get(EnvKey.BUNDLE_DIR, "")
+    if not root:
+        journal_dir = os.environ.get(EnvKey.JOURNAL_DIR, "")
+        if journal_dir:
+            root = os.path.join(journal_dir, "bundles")
+    if not root:
+        root = os.path.join(tempfile.gettempdir(), "dlrover_tpu_bundles")
+    return root
+
+
+def _proc_name() -> str:
+    node = os.environ.get(EnvKey.NODE_ID)
+    return f"node{node}" if node is not None else f"pid{os.getpid()}"
+
+
+def child_stacks_path(node_id: int) -> str:
+    """Where node ``node_id``'s trainer dumps its C-level stacks on
+    SIGUSR2 — deterministic so the agent can find it without IPC."""
+    return os.path.join(bundle_root(), f"stacks_node{node_id}_child.txt")
+
+
+def arm_child_dump(node_id: int | None = None) -> str | None:
+    """Trainer-side: register a C-level all-thread stack dump on SIGUSR2.
+
+    ``faulthandler.register`` dumps from the signal handler in C without
+    taking the GIL, so it works even when every Python thread is wedged
+    inside a collective. Returns the dump file path, or None when the
+    platform has no SIGUSR2 or the file cannot be created.
+    """
+    global _armed_file
+    if not hasattr(signal, "SIGUSR2"):
+        return None
+    if node_id is None:
+        node_id = int(os.environ.get(EnvKey.NODE_ID, "0"))
+    path = child_stacks_path(node_id)
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        # "w": each incarnation's dump replaces the last one's
+        _armed_file = open(path, "w")
+        faulthandler.register(signal.SIGUSR2, file=_armed_file,
+                              all_threads=True, chain=False)
+    except (OSError, ValueError) as e:
+        logger.warning("could not arm SIGUSR2 stack dump: %s", e)
+        return None
+    return path
+
+
+def collect_child_stacks(node_id: int, child_pid: int | None = None,
+                         timeout_s: float = 2.0) -> str:
+    """Agent-side: signal the trainer child (if given and alive) and wait
+    for its armed dump file to stop growing; returns the dump text ('' on
+    failure)."""
+    path = child_stacks_path(node_id)
+    try:
+        before = os.path.getsize(path)
+    except OSError:
+        before = -1
+    if child_pid is not None and hasattr(signal, "SIGUSR2"):
+        try:
+            os.kill(child_pid, signal.SIGUSR2)
+        except (ProcessLookupError, PermissionError, OSError):
+            child_pid = None  # already gone: fall back to any stale dump
+    if child_pid is not None:
+        deadline = time.monotonic() + timeout_s
+        last = before
+        while time.monotonic() < deadline:
+            time.sleep(0.1)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            if size > before and size == last:
+                break  # grew, then went quiet: dump finished
+            last = size
+    try:
+        with open(path) as f:
+            return f.read()
+    except OSError:
+        return ""
+
+
+def _journal_tail(max_lines: int) -> list[str]:
+    journal_dir = os.environ.get(EnvKey.JOURNAL_DIR, "")
+    if not journal_dir:
+        return []
+    base = os.path.join(journal_dir, JOURNAL_FILE)
+    lines: list[str] = []
+    for path in (base + ROTATED_SUFFIX, base):
+        try:
+            with open(path, errors="replace") as f:
+                lines.extend(f.readlines())
+        except OSError:
+            continue
+    return lines[-max_lines:]
+
+
+def _device_manifest() -> list[dict]:
+    """JAX device identity + memory stats — only if JAX is ALREADY
+    imported. Importing it here would initialize a backend (and in the
+    agent, steal the exclusive-access TPU chips from the trainer)."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return []
+    try:
+        out = []
+        for d in jax.local_devices():
+            info: dict = {
+                "id": int(d.id),
+                "platform": str(d.platform),
+                "kind": str(getattr(d, "device_kind", "")),
+            }
+            stats = d.memory_stats()  # None on backends without it (CPU)
+            if stats:
+                info["memory_stats"] = {
+                    k: v for k, v in stats.items()
+                    if isinstance(v, (int, float))
+                }
+            out.append(info)
+        return out
+    except Exception:  # noqa: BLE001 - a sick runtime is why we're here
+        return []
+
+
+def _env_manifest() -> dict[str, str]:
+    prefixes = ("DLROVER_TPU_", "JAX_", "XLA_", "TPU_", "LIBTPU")
+    return {
+        k: v for k, v in sorted(os.environ.items())
+        if k.startswith(prefixes)
+    }
+
+
+def write_bundle(reason: str, *, node_id: int | None = None,
+                 child_pid: int | None = None, extra: dict | None = None,
+                 out_root: str | None = None,
+                 journal_tail: int = JOURNAL_TAIL_LINES) -> str | None:
+    """Write one self-contained bundle dir; returns its path (None on
+    failure). Never raises. ``child_pid`` asks a live trainer child for
+    its C-level stack dump before snapshotting."""
+    try:
+        if node_id is None:
+            node_id = int(os.environ.get(EnvKey.NODE_ID, "0"))
+        root = out_root or bundle_root()
+        name = (f"bundle_{time.strftime('%Y%m%d_%H%M%S')}_{_proc_name()}"
+                f"_{reason}_{uuid.uuid4().hex[:6]}")
+        path = os.path.join(root, name)
+        os.makedirs(path, exist_ok=True)
+
+        with open(os.path.join(path, "stacks.txt"), "w") as f:
+            f.write(f"# all-thread stacks of {_proc_name()} "
+                    f"(pid {os.getpid()}) reason={reason}\n")
+            f.flush()
+            faulthandler.dump_traceback(file=f, all_threads=True)
+
+        child_dump = ""
+        if child_pid is not None or os.path.exists(
+                child_stacks_path(node_id)):
+            child_dump = collect_child_stacks(node_id, child_pid=child_pid)
+        if child_dump:
+            with open(os.path.join(path, "child_stacks.txt"), "w") as f:
+                f.write(child_dump)
+
+        tail = _journal_tail(journal_tail)
+        if tail:
+            with open(os.path.join(path, "journal_tail.jsonl"), "w") as f:
+                f.writelines(tail)
+
+        with open(os.path.join(path, "metrics.json"), "w") as f:
+            json.dump(registry().snapshot(), f, indent=1)
+
+        manifest = {
+            "reason": reason,
+            "written_at": time.time(),
+            "written_at_iso": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+            "proc": _proc_name(),
+            "pid": os.getpid(),
+            "node_id": node_id,
+            "trace_id": current_trace_id(),
+            "hostname": socket.gethostname(),
+            "python": sys.version,
+            "argv": list(sys.argv),
+            "threads": [t.name for t in threading.enumerate()],
+            "child_stacks": bool(child_dump),
+            "env": _env_manifest(),
+            "devices": _device_manifest(),
+        }
+        if extra:
+            manifest["extra"] = extra
+        with open(os.path.join(path, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=1)
+    except Exception:  # noqa: BLE001 - evidence capture must never crash
+        logger.exception("debug bundle write failed (reason=%s)", reason)
+        return None
+    _bundles_total.labels(reason).inc()
+    get_journal().emit("debug_bundle", reason=reason, path=path)
+    logger.warning("debug bundle written: %s (reason=%s)", path, reason)
+    return path
+
+
+def install_sigusr2(on_bundle=None, child_pid_fn=None) -> bool:
+    """Install a Python-level SIGUSR2 handler that writes a full bundle
+    on demand (operator runbook: ``kill -USR2 <agent pid>``). Only valid
+    in the main thread; returns False (and stays uninstalled) elsewhere
+    or on platforms without SIGUSR2. ``child_pid_fn`` supplies the
+    current trainer child's pid so its stacks ride along; ``on_bundle``
+    is called with (path, reason) after a successful write."""
+    if not hasattr(signal, "SIGUSR2"):
+        return False
+
+    def _handler(signum, frame):
+        child_pid = None
+        if child_pid_fn is not None:
+            try:
+                child_pid = child_pid_fn()
+            except Exception:  # noqa: BLE001
+                child_pid = None
+        path = write_bundle("sigusr2", child_pid=child_pid)
+        if path and on_bundle is not None:
+            try:
+                on_bundle(path, "sigusr2")
+            except Exception:  # noqa: BLE001 - reporting is best-effort
+                logger.exception("bundle report failed")
+
+    try:
+        signal.signal(signal.SIGUSR2, _handler)
+    except ValueError:  # not the main thread
+        return False
+    return True

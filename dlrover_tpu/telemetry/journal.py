@@ -16,11 +16,18 @@ completed interval recorded in one line). A begin with no matching end
 means the process died inside the span — the offline report treats it
 as open until the journal's last event.
 
-Span taxonomy (names are load-bearing for ``telemetry/report.py``):
-``rdzv_round`` (master), ``rendezvous_wait`` / ``node_restart`` /
-``ckpt_persist`` / ``hang_verdict`` (agent), ``compile`` /
-``train_step`` / ``ckpt_restore`` (trainer), ``serving_request``
-(serving), ``rpc_error`` (master).
+Span taxonomy (names are load-bearing for ``telemetry/report.py`` and
+``telemetry/timeline.py``; ``native/check_metric_names.py`` lints that
+every name is documented in DESIGN.md): ``rdzv_round`` / ``job_start`` /
+``job_end`` / ``straggler_verdict`` (master), ``rendezvous_wait`` /
+``node_restart`` / ``ckpt_persist`` / ``hang_verdict`` /
+``debug_bundle`` (agent), ``compile`` / ``train_step`` /
+``ckpt_restore`` (trainer), ``gateway_*`` (serving gateway).
+
+Rotation: when ``DLROVER_TPU_JOURNAL_MAX_MB`` is set, a file that
+reaches the cap is atomically renamed to ``.1`` (replacing the previous
+one) and reopened, bounding a long soak's footprint at ~2x the cap;
+``report``/``timeline`` read the rotated sibling transparently.
 """
 
 from __future__ import annotations
@@ -35,6 +42,18 @@ from typing import Iterator, Optional
 from dlrover_tpu.common.constants import EnvKey
 
 JOURNAL_FILE = "events.jsonl"
+ROTATED_SUFFIX = ".1"
+
+
+def max_journal_bytes() -> int:
+    """Size cap from ``DLROVER_TPU_JOURNAL_MAX_MB`` (0/unset = unbounded)."""
+    raw = os.environ.get(EnvKey.JOURNAL_MAX_MB, "").strip()
+    if not raw:
+        return 0
+    try:
+        return max(0, int(float(raw) * (1 << 20)))
+    except ValueError:
+        return 0
 
 
 def mint_trace_id() -> str:
@@ -65,6 +84,7 @@ class EventJournal:
         self._path = path
         self._proc = proc or _proc_name()
         self._trace = trace_id  # None -> read the env per event
+        self._max_bytes = max_journal_bytes()
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
         self._fd = os.open(path, os.O_APPEND | os.O_CREAT | os.O_WRONLY,
@@ -78,8 +98,35 @@ class EventJournal:
     def path(self) -> str:
         return self._path
 
+    def _maybe_rotate(self) -> None:
+        """Size-capped rotation (``DLROVER_TPU_JOURNAL_MAX_MB``): rename
+        the full file to ``.1`` (replacing the previous ``.1``) and
+        reopen, so a long soak holds at most ~2x the cap on disk.
+
+        Crash-safety is preserved: writes stay single short ``O_APPEND``
+        appends and the rename is atomic. With several writer processes
+        on one file, only the writer whose fd still IS the live file
+        performs the rename — a writer that lost the race (its fd now
+        points at the rotated file) just reopens the fresh one.
+        """
+        if self._max_bytes <= 0:
+            return
+        st = os.fstat(self._fd)
+        if st.st_size < self._max_bytes:
+            return
+        try:
+            live_ino = os.stat(self._path).st_ino
+        except FileNotFoundError:
+            live_ino = -1
+        if live_ino == st.st_ino:
+            os.replace(self._path, self._path + ROTATED_SUFFIX)
+        os.close(self._fd)
+        self._fd = os.open(self._path,
+                           os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644)
+
     def _write(self, event: dict) -> None:
         try:
+            self._maybe_rotate()
             os.write(self._fd,
                      (json.dumps(event, separators=(",", ":")) + "\n")
                      .encode("utf-8"))

@@ -36,15 +36,20 @@ CATEGORIES = ("rendezvous", "respawn", "recompile", "restore", "rollback")
 
 
 def load_events(path: str) -> list[dict]:
-    """Parse one journal file, or every ``*.jsonl`` in a directory."""
+    """Parse one journal file, or every ``*.jsonl`` in a directory.
+
+    Rotated siblings (``*.jsonl.1``, see ``journal.py`` size-capped
+    rotation) are read transparently — before the live file, so spans
+    split across a rotation reassemble in time order.
+    """
     files: list[str] = []
     if os.path.isdir(path):
         files = sorted(
             os.path.join(path, f) for f in os.listdir(path)
-            if f.endswith(".jsonl")
+            if f.endswith(".jsonl") or f.endswith(".jsonl.1")
         )
-    elif os.path.exists(path):
-        files = [path]
+    elif os.path.exists(path) or os.path.exists(path + ".1"):
+        files = [p for p in (path + ".1", path) if os.path.exists(p)]
     events: list[dict] = []
     for fp in files:
         with open(fp) as f:

@@ -1,0 +1,151 @@
+"""Render the event journal as a Perfetto-loadable job timeline.
+
+``python -m dlrover_tpu.telemetry.timeline --journal <dir-or-file>...``
+joins one or more journals (rotated ``.jsonl.1`` siblings included) into
+Chrome trace-event JSON (the legacy format Perfetto's trace processor
+and ui.perfetto.dev both accept):
+
+- one ``pid`` (process track) per journal ``proc`` — i.e. one track per
+  node plus one for the master — named via ``process_name`` metadata;
+- one ``tid`` lane per span name inside each track (``rendezvous_wait``,
+  ``compile``, ``train_step``, ``ckpt_persist``, ``ckpt_restore``, ...),
+  so overlapping phases never corrupt each other's nesting;
+- duration spans become ``ph="X"`` complete events; verdict-ish points
+  (``hang_verdict``, ``straggler_verdict``, ``debug_bundle``,
+  ``job_start``/``job_end``) and zero-duration points become ``ph="i"``
+  instants;
+- spans a crashed process never closed (begin without end) carry
+  ``args.open=true`` — the visual signature of "died in here".
+
+Timestamps are microseconds relative to the earliest event, which keeps
+the numbers small and makes the goodput report's lost-time categories
+visually auditable: rendezvous storms, serial recompiles, and restore
+stalls line up across node tracks.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from dlrover_tpu.telemetry.report import Span, load_events, pair_spans
+
+# names rendered as instants even when they carry a tiny duration
+INSTANT_NAMES = frozenset({
+    "hang_verdict", "straggler_verdict", "debug_bundle",
+    "job_start", "job_end",
+})
+
+
+def _lane_key(span: Span) -> tuple[str, str]:
+    return span.proc or "unknown", span.name
+
+
+def build_trace(paths: list[str], trace: str | None = None) -> dict:
+    """Trace-event JSON dict from journal paths (files or dirs)."""
+    events: list[dict] = []
+    for path in paths:
+        events.extend(load_events(path))
+    events.sort(key=lambda e: e["t"])
+    spans = pair_spans(events)
+    if trace:
+        spans = [s for s in spans if s.trace == trace]
+
+    procs = sorted({s.proc or "unknown" for s in spans})
+    pid_of = {proc: i + 1 for i, proc in enumerate(procs)}
+    lanes = sorted({_lane_key(s) for s in spans})
+    tid_of: dict[tuple[str, str], int] = {}
+    for proc in procs:
+        names = [name for p, name in lanes if p == proc]
+        for i, name in enumerate(sorted(names)):
+            tid_of[(proc, name)] = i + 1
+
+    out: list[dict] = []
+    for proc in procs:
+        out.append({
+            "ph": "M", "name": "process_name", "pid": pid_of[proc],
+            "args": {"name": proc},
+        })
+        out.append({
+            "ph": "M", "name": "process_sort_index", "pid": pid_of[proc],
+            "args": {"sort_index": pid_of[proc]},
+        })
+    for (proc, name), tid in sorted(tid_of.items()):
+        out.append({
+            "ph": "M", "name": "thread_name", "pid": pid_of[proc],
+            "tid": tid, "args": {"name": name},
+        })
+
+    t0 = min((s.start for s in spans), default=0.0)
+    for span in spans:
+        proc = span.proc or "unknown"
+        pid, tid = pid_of[proc], tid_of[(proc, span.name)]
+        args = dict(span.fields)
+        args["span_id"] = span.span_id
+        if span.parent:
+            args["parent"] = span.parent
+        if span.open:
+            args["open"] = True
+        ts = round((span.start - t0) * 1e6, 3)
+        dur = round((span.end - span.start) * 1e6, 3)
+        if span.name in INSTANT_NAMES or dur <= 0:
+            out.append({
+                "ph": "i", "name": span.name, "cat": "verdict"
+                if span.name in INSTANT_NAMES else "point",
+                # instants mark the moment they were EMITTED (span.start
+                # backdates points by their dur)
+                "ts": round((span.end - t0) * 1e6, 3),
+                "pid": pid, "tid": tid, "s": "t", "args": args,
+            })
+        else:
+            out.append({
+                "ph": "X", "name": span.name, "cat": span.name,
+                "ts": ts, "dur": dur, "pid": pid, "tid": tid,
+                "args": args,
+            })
+
+    traces = sorted({s.trace for s in spans if s.trace})
+    return {
+        "traceEvents": out,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "generator": "dlrover_tpu.telemetry.timeline",
+            "traces": traces,
+            "epoch_t0": t0,
+            "n_spans": len(spans),
+        },
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        "python -m dlrover_tpu.telemetry.timeline",
+        description="journal -> Chrome trace-event JSON (open in "
+                    "ui.perfetto.dev or chrome://tracing)",
+    )
+    parser.add_argument("--journal", required=True, nargs="+",
+                        help="journal file(s) or DLROVER_TPU_JOURNAL_DIR "
+                             "dir(s); rotated .1 siblings are included")
+    parser.add_argument("--trace", default=None,
+                        help="restrict to one trace id")
+    parser.add_argument("--out", default="",
+                        help="output path (default: stdout)")
+    parser.add_argument("--indent", type=int, default=None,
+                        help="pretty-print with this indent")
+    args = parser.parse_args(argv)
+    trace = build_trace(args.journal, trace=args.trace)
+    text = json.dumps(trace, indent=args.indent)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        print(f"wrote {len(trace['traceEvents'])} trace events "
+              f"({trace['otherData']['n_spans']} spans) to {args.out}",
+              file=sys.stderr)
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

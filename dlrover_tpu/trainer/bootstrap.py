@@ -149,6 +149,15 @@ def init_from_env(initialize_distributed: bool = True) -> RunContext:
             logger.warning("backend already initialized; cannot force %s",
                            platform)
     setup_compilation_cache()
+    if os.environ.get(EnvKey.MASTER_ADDR):
+        # arm the flight recorder's C-level SIGUSR2 stack dump
+        # (telemetry/bundle.py): faulthandler dumps without the GIL, so
+        # the agent can read this process's stacks even when it is
+        # wedged inside a collective — the evidence a hang verdict's
+        # debug bundle scoops up before the kill
+        from dlrover_tpu.telemetry.bundle import arm_child_dump
+
+        arm_child_dump()
     ctx = RunContext(
         job_name=os.environ.get(EnvKey.JOB_NAME, "local"),
         node_id=int(os.environ.get(EnvKey.NODE_ID, "0")),

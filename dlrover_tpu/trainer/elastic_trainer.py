@@ -107,6 +107,8 @@ class ElasticTrainer:
 
         self._progress = ProgressReporter()
         self._first_dispatch = True
+        self._last_metrics_push = float("-inf")
+        self._metrics_push_interval_s = 1.0
         self._client = master_client
         if self._client is None and os.environ.get(EnvKey.MASTER_ADDR):
             from dlrover_tpu.agent.master_client import MasterClient
@@ -166,6 +168,18 @@ class ElasticTrainer:
                 if hbm > 0:
                     self._client.report_resource(
                         cpu_percent=0.0, used_memory_mb=0, used_hbm_mb=hbm
+                    )
+                # push the registry snapshot (rate-limited): carries the
+                # step-duration histogram the master's continuous
+                # straggler detector consumes (telemetry/anomaly.py) and
+                # the per-device HBM gauges, both re-exposed under this
+                # node's label by the master's /metrics
+                now = time.monotonic()
+                if (now - self._last_metrics_push
+                        >= self._metrics_push_interval_s):
+                    self._last_metrics_push = now
+                    self._client.report_metrics(
+                        registry().snapshot(), role="trainer"
                     )
             except (ConnectionError, RuntimeError, OSError) as e:
                 # telemetry is best-effort: a master mid-failover answers

@@ -1,5 +1,5 @@
 #!/usr/bin/env python3
-"""Lint: every metric registered in dlrover_tpu/ is well-named and unique.
+"""Lint: metric names AND journal span names are well-formed + documented.
 
 Walks the package source for ``registry().counter("...")`` /
 ``.gauge("...")`` / ``.histogram("...")`` registrations and asserts
@@ -12,8 +12,16 @@ Walks the package source for ``registry().counter("...")`` /
   the gateway's scrape surface is an operator contract (deploy/README.md
   points dashboards at it), so registry and docs must not drift.
 
-Invoked from the tier-1 suite (tests/test_telemetry.py) and runnable
-standalone: ``python native/check_metric_names.py``.
+It also walks journal emissions (``.emit("...")`` / ``.begin("...")`` /
+``.span("...")``) and asserts every span name matches ``[a-z_]+``, is
+passed as a literal, and appears verbatim in DESIGN.md — span names are
+the contract ``telemetry/report.py`` attributes lost time by and
+``telemetry/timeline.py`` renders, so a span shipped undocumented is a
+span the operator can't read.
+
+Invoked from the tier-1 suite (tests/test_telemetry.py +
+tests/test_flight_recorder.py) and runnable standalone:
+``python native/check_metric_names.py``.
 """
 
 from __future__ import annotations
@@ -27,6 +35,14 @@ REG_RE = re.compile(
     r"\.\s*(counter|gauge|histogram)\(\s*(?:\n\s*)?"
     r"(?:(?P<q>['\"])(?P<name>[^'\"]+)(?P=q)|(?P<nonlit>[A-Za-z_f][^,)]*))"
 )
+SPAN_NAME_RE = re.compile(r"^[a-z_]+$")
+SPAN_RE = re.compile(
+    r"\.\s*(emit|begin|span)\(\s*(?:\n\s*)?"
+    r"(?:(?P<q>['\"])(?P<name>[^'\"]+)(?P=q)|(?P<nonlit>[A-Za-z_f][^,)]*))"
+)
+# the journal implementation itself forwards caller-supplied names
+# (EventJournal.span -> self.begin(name, ...)): not an emission site
+SPAN_SCAN_EXCLUDE = (os.path.join("telemetry", "journal.py"),)
 
 PKG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                    "dlrover_tpu")
@@ -48,6 +64,53 @@ def check_documented(names: dict[str, list[str]],
         for name, sites in sorted(names.items())
         if name.startswith(DOCUMENTED_PREFIX) and name not in design
     ]
+
+
+def scan_spans(pkg_dir: str = PKG,
+               design_path: str = DESIGN_MD) -> tuple[dict[str, list[str]],
+                                                      list[str]]:
+    """(span name -> [emission sites], problems) for journal spans."""
+    names: dict[str, list[str]] = {}
+    problems: list[str] = []
+    for root, _dirs, files in os.walk(pkg_dir):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, os.path.dirname(pkg_dir))
+            if rel.endswith(SPAN_SCAN_EXCLUDE):
+                continue
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            for match in SPAN_RE.finditer(text):
+                line = text.count("\n", 0, match.start()) + 1
+                site = f"{rel}:{line}"
+                if match.group("name") is None:
+                    problems.append(
+                        f"{site}: journal span emitted with a non-literal "
+                        f"name ({match.group('nonlit')!r})"
+                    )
+                    continue
+                name = match.group("name")
+                if not SPAN_NAME_RE.match(name):
+                    problems.append(
+                        f"{site}: span name {name!r} does not match "
+                        f"{SPAN_NAME_RE.pattern}"
+                    )
+                names.setdefault(name, []).append(site)
+    try:
+        with open(design_path, encoding="utf-8") as f:
+            design = f.read()
+    except OSError as e:
+        problems.append(f"cannot read {design_path}: {e}")
+        return names, problems
+    for name, sites in sorted(names.items()):
+        if name not in design:
+            problems.append(
+                f"journal span {name!r} ({', '.join(sites)}) is not "
+                f"documented in DESIGN.md; add it to the span-name table"
+            )
+    return names, problems
 
 
 def scan(pkg_dir: str = PKG) -> tuple[dict[str, list[str]], list[str]]:
@@ -92,11 +155,14 @@ def scan(pkg_dir: str = PKG) -> tuple[dict[str, list[str]], list[str]]:
 
 def main() -> int:
     names, problems = scan()
+    span_names, span_problems = scan_spans()
+    problems = problems + span_problems
     if problems:
         for p in problems:
             print(f"check_metric_names: {p}", file=sys.stderr)
         return 1
-    print(f"check_metric_names: {len(names)} metric names OK")
+    print(f"check_metric_names: {len(names)} metric names, "
+          f"{len(span_names)} span names OK")
     return 0
 
 
