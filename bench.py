@@ -1007,6 +1007,45 @@ def bench_soak(extra: dict, stage_budget_s: float = 300.0) -> None:
         shutil.rmtree(work, ignore_errors=True)
 
 
+def bench_chaos(extra: dict, stage_budget_s: float = 300.0) -> None:
+    """Replay the canned chaos schedule (trainer SIGKILLed mid-save, the
+    newest shard bit-flipped on its way to disk, master RPC dropped on
+    the re-join) against a local elastic job and report recovery time
+    and goodput-under-faults beside the clean-goodput headlines
+    (dlrover_tpu/chaos/scenario.py; DESIGN.md §15.2)."""
+    import shutil
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from dlrover_tpu.chaos.scenario import canned_scenario, run_scenario
+
+    work = tempfile.mkdtemp(prefix="bench_chaos_")
+    try:
+        scenario = canned_scenario(
+            seed=int(os.environ.get("BENCH_CHAOS_SEED", "1234"))
+        )
+        res = run_scenario(scenario, work, env_extra=_cpu_child_env(),
+                           deadline_s=max(90, stage_budget_s - 30))
+        extra["chaos_completed"] = res.completed
+        extra["chaos_faults_injected"] = len(res.trail["faults"])
+        extra["chaos_rollbacks"] = sum(
+            1 for r in res.trail["recovery"] if r[0] == "ckpt_rollback"
+        )
+        extra["chaos_verified_step"] = res.verified_step
+        if res.recovery_seconds is not None:
+            extra["chaos_recovery_seconds"] = round(res.recovery_seconds, 2)
+        if res.goodput is not None:
+            # goodput of the sabotaged leg: restart + re-join retries +
+            # rolled-back steps all charged, same accounting as the
+            # clean goodput stage
+            extra["chaos_goodput"] = round(res.goodput, 4)
+        if not res.completed and res.legs:
+            extra["chaos_tail"] = res.legs[-1].tail[-1500:]
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def bench_serving(extra: dict) -> None:
     """Continuous-batching decode throughput (serving/engine.py).
 
@@ -1378,6 +1417,8 @@ STAGES = [
     Stage("gateway", bench_gateway, est_s=80, deadline_s=240),
     Stage("soak", bench_soak, est_s=105, deadline_s=160,
           pass_budget=True),
+    Stage("chaos", bench_chaos, est_s=130, deadline_s=300,
+          pass_budget=True, min_deadline_s=180),
     Stage("int8", bench_int8, est_s=275, deadline_s=450),
     Stage("aot7b", bench_7b_aot, est_s=15, deadline_s=120,
           pass_budget=True),
@@ -1404,6 +1445,7 @@ HEADLINE_KEYS = [
     "serving_prefix_cache_speedup", "gateway_req_per_s",
     "gateway_p95_s", "gateway_failed",
     "int8_ffn_speedup", "soak_completed", "soak_kills",
+    "chaos_completed", "chaos_recovery_seconds", "chaos_goodput",
     "lc_best_speedup", "bench_total_s",
 ]
 

@@ -35,6 +35,7 @@ from dlrover_tpu.common.storage import (
     PosixDiskStorage,
     build_storage,
 )
+from dlrover_tpu.checkpoint import integrity
 from dlrover_tpu.checkpoint.shm_handler import SharedMemoryHandler
 from dlrover_tpu.telemetry.journal import get_journal
 from dlrover_tpu.telemetry.metrics import registry
@@ -240,6 +241,14 @@ class AsyncCheckpointSaver:
             sdir = step_dir(ckpt_dir, step)
             storage.makedirs(sdir)
             num_shards = int(header.get("num_shards", 1))
+            # integrity manifest: the shard's CRC32 rides in the meta
+            # AND the done marker, so rank-0's COMMIT can list every
+            # shard's checksum without re-reading the bytes
+            # (checkpoint/integrity.py verifies against it at restore)
+            crc = integrity.crc32_bytes(content)
+            header = dict(header)
+            header["crc32"] = crc
+            header["bin_bytes"] = len(content)
             storage.write(content,
                           os.path.join(sdir, f"node_{self.node_id}.bin"))
             storage.write(
@@ -247,7 +256,8 @@ class AsyncCheckpointSaver:
                 os.path.join(sdir, f"node_{self.node_id}.meta.json"),
             )
             storage.write(
-                b"", os.path.join(sdir, done_marker(self.node_id, num_shards))
+                json.dumps({"crc32": crc, "bytes": len(content)}),
+                os.path.join(sdir, done_marker(self.node_id, num_shards)),
             )
         _persist_seconds.observe(time.monotonic() - start)
         _persist_bytes.inc(len(content))
@@ -306,6 +316,21 @@ class AsyncCheckpointSaver:
                     if f.startswith("done_") and f.endswith(suffix)
                 ]
                 if len(done) >= num_shards:
+                    # terminal COMMIT before the tracker moves: the
+                    # manifest of every shard's crc32, assembled from
+                    # the done markers (restore verifies against it and
+                    # rolls back on any mismatch)
+                    shards: dict = {}
+                    for f in done:
+                        nid = f[len("done_"):-len(suffix)]
+                        try:
+                            shards[nid] = json.loads(
+                                storage.read_text(os.path.join(sdir, f))
+                            )
+                        except (ValueError, OSError):
+                            shards[nid] = {}  # legacy empty marker
+                    integrity.write_commit(storage, sdir, step,
+                                           num_shards, shards)
                     storage.write(
                         json.dumps(
                             {"step": step, "num_shards": num_shards}

@@ -17,6 +17,7 @@ launch_agent:695). TPU-native differences:
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import signal
 import socket
@@ -26,6 +27,7 @@ import threading
 import time
 from enum import Enum
 
+from dlrover_tpu import chaos
 from dlrover_tpu.common.accelerator import sniff_accelerator
 from dlrover_tpu.common.constants import (
     Defaults,
@@ -316,11 +318,42 @@ class ElasticAgent:
                 )
                 self._kill_child()
                 continue
+            if chaos.ENABLED:
+                self._chaos_kill_check()
             # healthy: check for membership changes / master actions
             if self._master_action() == "restart":
                 self._restart_workers(reason="master restart action")
             elif self._membership_changed():
                 self._restart_workers(reason="membership change")
+
+    def _chaos_kill_check(self) -> None:
+        """Chaos plan ``agent_kill_trainer`` point: kill the live trainer
+        with a chosen signal once its reported step matches the rule
+        (e.g. ``{"match": {"step_gte": 8}, "args": {"sig": 9}}`` — the
+        agent then observes exit code -sig and runs the normal failover
+        ladder). The step comes from the hang detector's progress file,
+        so the kill lands at a training position, not a wall-clock one.
+        """
+        from dlrover_tpu.agent.hang_detector import progress_path
+
+        step = -1
+        try:
+            with open(progress_path(self._config.node_id)) as f:
+                step = int(json.load(f)["step"])
+        except (OSError, ValueError, KeyError):
+            pass
+        fault = chaos.fire("agent_kill_trainer", step=step,
+                           incarnation=self._incarnation)
+        if fault is None or self._proc is None \
+                or self._proc.poll() is not None:
+            return
+        sig = int(fault.args.get("sig", signal.SIGKILL))
+        logger.warning("chaos: killing trainer with signal %d at step %d",
+                       sig, step)
+        try:
+            os.killpg(self._proc.pid, sig)
+        except ProcessLookupError:
+            pass
 
     def _handle_failure(self, exit_code: int) -> RunResult | None:
         """Classify the exit and act on it; None means restarted, keep

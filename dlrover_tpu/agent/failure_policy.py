@@ -49,6 +49,10 @@ def classify_exit(exit_code: int) -> NodeExitReason:
         sig = -exit_code
     elif exit_code > _SIGNAL_BASE:
         sig = exit_code - _SIGNAL_BASE
+    if sig is not None and not 0 < sig < signal.NSIG:
+        # not a real signal number (e.g. exit code 255 -> "signal 127"):
+        # a software error that happens to exit above 128, not a kill
+        sig = None
     if sig == signal.SIGKILL:
         # the OOM killer and hard preemption both SIGKILL; without more
         # signal treat it as an external kill (restartable)

@@ -1,0 +1,68 @@
+"""Deterministic fault injection (chaos harness).
+
+Gating contract — the part hot paths rely on:
+
+- ``chaos.ENABLED`` is a plain module bool. Injection sites guard every
+  ``chaos.fire(...)`` with ``if chaos.ENABLED:``, so with chaos off the
+  cost on a hot path is one attribute read — no env lookups, no
+  function calls, no allocation.
+- ``ENABLED`` is computed ONCE at import from ``DLROVER_TPU_CHAOS``
+  (a JSON plan file path, or inline JSON). Subprocesses inherit the env
+  and boot their own controller, so one plan covers the whole job tree
+  (master, agents, trainers) with independent per-process counters.
+- Tests flip it in-process with ``install(plan)`` / ``uninstall()``.
+
+See ``chaos/injector.py`` for rule semantics and ``chaos/scenario.py``
+for the scenario spec + runner that drives whole jobs through fault
+schedules and checks recovery invariants.
+"""
+
+from __future__ import annotations
+
+from dlrover_tpu.chaos.injector import (  # noqa: F401
+    ChaosController,
+    Fault,
+    FaultRule,
+    controller_from_environ,
+)
+
+ENABLED = False
+_controller: ChaosController | None = None
+
+
+def install(plan) -> ChaosController:
+    """Install a controller (``ChaosController`` or a plan dict) and
+    enable injection for this process."""
+    global ENABLED, _controller
+    if not isinstance(plan, ChaosController):
+        plan = ChaosController.from_spec(plan)
+    _controller = plan
+    ENABLED = True
+    return plan
+
+
+def uninstall() -> None:
+    global ENABLED, _controller
+    ENABLED = False
+    _controller = None
+
+
+def fire(point: str, **ctx) -> Fault | None:
+    """Consult the installed plan at a named injection point. Returns
+    the fired ``Fault`` or None. Sites must guard the call with
+    ``if chaos.ENABLED:`` — calling with no controller is a safe no-op,
+    but costs a function call the gate exists to avoid."""
+    controller = _controller
+    if controller is None:
+        return None
+    return controller.fire(point, **ctx)
+
+
+def controller() -> ChaosController | None:
+    return _controller
+
+
+_boot = controller_from_environ()
+if _boot is not None:
+    install(_boot)
+del _boot

@@ -1,0 +1,222 @@
+"""Deterministic, seeded fault injection core.
+
+The chaos analog of the reference's chaosblade experiments
+(docs/tech_report/fault_tolerance_exps.md): instead of an external tool
+randomly killing pods, named injection points are compiled into the
+three trust boundaries (RPC transport, checkpoint storage, agent
+process management) and a *plan* — a seed plus a list of count-matched
+fault rules — decides which firings happen. Count matching (``after`` /
+``every`` / ``times`` over rule matches) rather than wall-clock
+triggers is what makes a chaos run replayable: two runs of the same
+job with the same plan inject the same fault sequence, so the
+fault/recovery journal trail is comparable across runs.
+
+A rule fires when its ``point`` matches the injection site, its
+``match`` conditions hold against the site's context, its occurrence
+window (``after``/``every``/``times``) admits this match, and its
+``prob`` coin (per-rule seeded RNG stream, independent of other rules)
+lands. Every firing is journaled (``chaos_fault``) and counted
+(``dlrover_tpu_chaos_faults_total{point}``), so PR 3's timeline renders
+chaos runs with the faults on them.
+
+The module is inert unless a plan is installed (normally from the
+``DLROVER_TPU_CHAOS`` env var — a JSON file path or inline JSON); see
+``dlrover_tpu/chaos/__init__.py`` for the zero-overhead gating
+contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import threading
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.metrics import registry
+
+logger = get_logger(__name__)
+
+_faults_total = registry().counter(
+    "dlrover_tpu_chaos_faults_total",
+    "injected chaos faults by injection point",
+    label_names=("point",),
+)
+
+# ctx fields that would collide with the journal event envelope
+_RESERVED = frozenset(
+    {"t", "trace", "span", "name", "ev", "proc", "pid", "parent",
+     "point", "action", "seq"}
+)
+
+
+@dataclasses.dataclass
+class FaultRule:
+    """One fault in a plan.
+
+    ``match`` keys are compared against the injection site's context:
+    a plain key means equality; ``<key>_gte`` / ``<key>_lte`` compare
+    numerically; ``<key>_suffix`` / ``<key>_contains`` compare as
+    strings. A missing context key never matches.
+    """
+
+    point: str
+    action: str
+    args: dict = dataclasses.field(default_factory=dict)
+    match: dict = dataclasses.field(default_factory=dict)
+    prob: float = 1.0
+    after: int = 0   # skip the first N matches
+    every: int = 1   # then admit every k-th match
+    times: int = 1   # max firings (0 = unlimited)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultRule":
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown fault-rule fields: {sorted(unknown)}")
+        return cls(**d)
+
+    def matches(self, ctx: dict) -> bool:
+        for key, want in self.match.items():
+            for suffix in ("_gte", "_lte", "_suffix", "_contains"):
+                if key.endswith(suffix):
+                    base = key[: -len(suffix)]
+                    break
+            else:
+                suffix, base = "", key
+            if base not in ctx:
+                return False
+            have = ctx[base]
+            if suffix == "_gte":
+                if not have >= want:
+                    return False
+            elif suffix == "_lte":
+                if not have <= want:
+                    return False
+            elif suffix == "_suffix":
+                if not str(have).endswith(str(want)):
+                    return False
+            elif suffix == "_contains":
+                if str(want) not in str(have):
+                    return False
+            elif have != want:
+                return False
+        return True
+
+
+@dataclasses.dataclass
+class Fault:
+    """A fired fault, as handed to the injection site. ``rand`` is a
+    pre-drawn uniform [0,1) from the rule's own seeded stream — sites
+    use it for deterministic choices (which byte to flip) instead of
+    reaching for a global RNG."""
+
+    point: str
+    action: str
+    args: dict
+    seq: int
+    rand: float
+
+
+class ChaosController:
+    """Evaluates a plan's rules at injection points (thread-safe).
+
+    Per-rule RNG streams are seeded from ``(seed, rule index)``, so one
+    rule's coin flips never depend on how often other rules were
+    consulted — the property that keeps multi-rule plans replayable.
+    Counters are per process: each process in the job tree loads the
+    plan from the inherited env and counts its own matches.
+    """
+
+    def __init__(self, rules: list[FaultRule], seed: int = 0):
+        self.rules = list(rules)
+        self.seed = int(seed)
+        self._rngs = [
+            random.Random((self.seed << 16) ^ (i + 1))
+            for i in range(len(self.rules))
+        ]
+        self._match_counts = [0] * len(self.rules)
+        self._fire_counts = [0] * len(self.rules)
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- loading
+
+    @classmethod
+    def from_spec(cls, spec: dict) -> "ChaosController":
+        rules = [FaultRule.from_dict(d) for d in spec.get("faults", [])]
+        return cls(rules, seed=int(spec.get("seed", 0)))
+
+    @classmethod
+    def from_env(cls, env_value: str) -> "ChaosController":
+        """``DLROVER_TPU_CHAOS``: inline JSON (starts with ``{``) or a
+        path to a JSON plan file."""
+        text = env_value.strip()
+        if not text.startswith("{"):
+            with open(text, encoding="utf-8") as f:
+                text = f.read()
+        return cls.from_spec(json.loads(text))
+
+    # -------------------------------------------------------------- firing
+
+    def fire(self, point: str, **ctx) -> Fault | None:
+        """The first rule for ``point`` that matches and is admitted
+        fires; returns the ``Fault`` (or None). The journal line and
+        metric land here so every injected fault leaves a trail."""
+        for i, rule in enumerate(self.rules):
+            if rule.point != point or not rule.matches(ctx):
+                continue
+            with self._lock:
+                mc = self._match_counts[i]
+                self._match_counts[i] = mc + 1
+                if mc < rule.after:
+                    continue
+                if (mc - rule.after) % max(1, rule.every) != 0:
+                    continue
+                if rule.times and self._fire_counts[i] >= rule.times:
+                    continue
+                rand = self._rngs[i].random()
+                if rule.prob < 1.0 and rand >= rule.prob:
+                    continue
+                self._fire_counts[i] += 1
+                seq = self._seq
+                self._seq += 1
+            fault = Fault(point=point, action=rule.action,
+                          args=dict(rule.args), seq=seq, rand=rand)
+            _faults_total.labels(point).inc()
+            fields = {
+                k: v for k, v in ctx.items()
+                if k not in _RESERVED
+                and isinstance(v, (str, int, float, bool))
+            }
+            get_journal().emit("chaos_fault", point=point,
+                               action=rule.action, seq=seq, **fields)
+            logger.warning("chaos: %s -> %s (seq %d, ctx %s)",
+                           point, rule.action, seq, fields)
+            return fault
+        return None
+
+    def fire_counts(self) -> list[int]:
+        with self._lock:
+            return list(self._fire_counts)
+
+
+def controller_from_environ() -> ChaosController | None:
+    """Build the process controller from ``DLROVER_TPU_CHAOS`` (one env
+    read, at import time — never on a hot path). A malformed plan
+    disables injection rather than taking the process down, but loudly:
+    a silently-ignored chaos plan would turn a failed drill green."""
+    from dlrover_tpu.common.constants import EnvKey
+
+    raw = os.environ.get(EnvKey.CHAOS, "")
+    if not raw:
+        return None
+    try:
+        return ChaosController.from_env(raw)
+    except (OSError, ValueError, TypeError) as e:
+        logger.error("ignoring malformed %s plan (%s); chaos DISABLED",
+                     EnvKey.CHAOS, e)
+        return None

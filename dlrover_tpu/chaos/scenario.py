@@ -1,0 +1,390 @@
+"""Chaos scenario spec + runner: drive a local job through a scheduled
+fault sequence and check recovery invariants.
+
+A ``Scenario`` is a seed plus job *legs*; each leg runs the elastic
+example under ``dlrover_tpu.run --standalone`` with that leg's fault
+plan installed through ``DLROVER_TPU_CHAOS`` (inherited by the master,
+agent, and trainer processes). Legs share one checkpoint directory and
+one journal, so a later leg restores what an earlier, sabotaged leg
+persisted — the cross-restart corruption cases (bit-flipped newest
+shard, torn tracker) that can't be exercised inside a single process
+tree, because a respawned-in-place trainer restores from shared memory
+and never touches storage.
+
+Recovery invariants checked by ``ScenarioResult.assert_invariants``:
+
+- every leg reaches its target step with its expected exit code
+  (zero lost data shards: the at-least-once sharding re-runs whatever
+  the faults rolled back, and the run still completes);
+- the checkpoint directory's newest VERIFIED step equals the final
+  step (restore-time verification would accept exactly what the job
+  durably committed — nothing corrupt is reachable);
+- recovery after the injected kill is bounded (``max_recovery_s``);
+- every injected fault left a ``chaos_fault`` journal line
+  (``trail["faults"]`` length matches the plan's firing budget).
+
+The canonical *trail* is replay-comparable: two runs of the same
+scenario with the same seed must produce an identical trail (the
+tier-1 determinism assertion in tests/test_chaos.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DEFAULT_EXAMPLE = os.path.join(REPO, "examples", "train_transformer.py")
+
+# journal names treated as recovery evidence in the canonical trail
+RECOVERY_EVENTS = (
+    "node_restart", "ckpt_verify_failed", "ckpt_rollback",
+    "state_rollback", "degraded_mode",
+)
+
+
+@dataclasses.dataclass
+class JobLeg:
+    """One elastic job run inside a scenario."""
+
+    name: str
+    max_steps: int
+    faults: list[dict] = dataclasses.field(default_factory=list)
+    cli_args: list[str] = dataclasses.field(default_factory=list)
+    train_args: list[str] = dataclasses.field(default_factory=list)
+    expect_rc: int = 0
+
+
+@dataclasses.dataclass
+class Scenario:
+    name: str
+    seed: int
+    legs: list[JobLeg]
+    max_recovery_s: float = 120.0
+
+    def planned_firings(self) -> int:
+        """Upper bound on chaos_fault lines this scenario should emit
+        (only rules with a finite ``times`` budget are countable)."""
+        total = 0
+        for leg in self.legs:
+            for rule in leg.faults:
+                total += int(rule.get("times", 1)) or 0
+        return total
+
+
+@dataclasses.dataclass
+class LegResult:
+    name: str
+    rc: int
+    result: dict | None     # the trainer's --result-file payload
+    tail: str
+    elapsed_s: float
+
+
+@dataclasses.dataclass
+class ScenarioResult:
+    scenario: Scenario
+    legs: list[LegResult]
+    trail: dict
+    recovery_seconds: float | None
+    verified_step: int | None
+    goodput: float | None
+    work_dir: str
+
+    @property
+    def completed(self) -> bool:
+        return all(
+            leg.rc == spec.expect_rc
+            and (spec.expect_rc != 0 or (
+                leg.result is not None
+                and leg.result.get("final_step") == spec.max_steps))
+            for leg, spec in zip(self.legs, self.scenario.legs)
+        )
+
+    def assert_invariants(self) -> None:
+        for leg, spec in zip(self.legs, self.scenario.legs):
+            assert leg.rc == spec.expect_rc, (
+                f"leg {leg.name}: rc {leg.rc} != {spec.expect_rc}\n"
+                f"{leg.tail}"
+            )
+            if spec.expect_rc == 0:
+                assert leg.result is not None, \
+                    f"leg {leg.name}: no result file\n{leg.tail}"
+                assert leg.result["final_step"] == spec.max_steps, (
+                    f"leg {leg.name}: lost progress — final step "
+                    f"{leg.result['final_step']} != {spec.max_steps}"
+                )
+        final = self.legs[-1].result
+        if final is not None:
+            assert self.verified_step == final["final_step"], (
+                f"newest verified step {self.verified_step} != final "
+                f"step {final['final_step']} (lost or corrupt shards)"
+            )
+        planned = self.scenario.planned_firings()
+        assert len(self.trail["faults"]) == planned, (
+            f"{len(self.trail['faults'])} chaos_fault journal lines for "
+            f"{planned} planned firings: {self.trail['faults']}"
+        )
+        if self.recovery_seconds is not None:
+            assert self.recovery_seconds <= self.scenario.max_recovery_s, (
+                f"recovery took {self.recovery_seconds:.1f}s "
+                f"(bound {self.scenario.max_recovery_s:.0f}s)"
+            )
+
+
+# ------------------------------------------------------------------ journal
+
+
+def _read_journal(journal_dir: str) -> list[dict]:
+    events: list[dict] = []
+    base = os.path.join(journal_dir, "events.jsonl")
+    for path in (base + ".1", base):
+        try:
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    try:
+                        events.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue  # torn final line of a killed writer
+        except OSError:
+            continue
+    return events
+
+
+def fault_trail(journal_dir: str) -> dict:
+    """Canonical, replay-comparable fault/recovery trail.
+
+    Chaos firings are reduced to sorted ``(point, action, k)`` triples
+    (k = per-(point,action) occurrence index): invariant to journal
+    interleaving across processes/threads, sensitive to any change in
+    what actually fired. Recovery events keep their deterministic
+    fields (verify kind + step, rollback from/to, restart kind) and are
+    sorted the same way.
+    """
+    events = _read_journal(journal_dir)
+    fault_counts: dict[tuple[str, str], int] = {}
+    faults: list[list[Any]] = []
+    recovery: list[list[Any]] = []
+    for e in events:
+        name = e.get("name")
+        if name == "chaos_fault":
+            key = (e.get("point", "?"), e.get("action", "?"))
+            k = fault_counts.get(key, 0)
+            fault_counts[key] = k + 1
+            faults.append([key[0], key[1], k])
+        elif name == "node_restart" and e.get("ev") == "b":
+            recovery.append(["node_restart", e.get("kind", "")])
+        elif name == "ckpt_verify_failed":
+            recovery.append(["ckpt_verify_failed", e.get("kind", ""),
+                             e.get("step", -1)])
+        elif name == "ckpt_rollback":
+            recovery.append(["ckpt_rollback", e.get("from_step", -1),
+                             e.get("to_step", -1)])
+        elif name == "state_rollback":
+            recovery.append(["state_rollback"])
+        elif name == "degraded_mode":
+            recovery.append(["degraded_mode", e.get("state", "")])
+    return {"faults": sorted(faults), "recovery": sorted(recovery)}
+
+
+def _recovery_seconds(journal_dir: str) -> float | None:
+    """Injected trainer kill -> the respawned trainer's restore."""
+    events = _read_journal(journal_dir)
+    t_kill = None
+    for e in events:
+        if e.get("name") == "chaos_fault" \
+                and e.get("point") == "agent_kill_trainer":
+            t_kill = e["t"]
+            break
+    if t_kill is None:
+        return None
+    restores = [
+        e["t"] for e in events
+        if e.get("name") == "ckpt_restore" and e.get("t", 0) > t_kill
+    ]
+    return min(restores) - t_kill if restores else None
+
+
+# ------------------------------------------------------------------- runner
+
+
+def run_scenario(scenario: Scenario, work_dir: str, *,
+                 env_extra: dict | None = None,
+                 example: str = DEFAULT_EXAMPLE,
+                 deadline_s: float = 600.0,
+                 goodput_leg: int = 0) -> ScenarioResult:
+    """Run every leg, then assemble the trail + invariant inputs.
+
+    The runner owns all shared paths (ckpt dir, journal, per-leg plan
+    files, IPC dirs — each leg gets a FRESH IPC dir, so a later leg's
+    trainer cannot shortcut recovery through the previous leg's shm
+    snapshot and must exercise the storage restore path).
+    """
+    os.makedirs(work_dir, exist_ok=True)
+    ckpt_dir = os.path.join(work_dir, "ckpt")
+    journal_dir = os.path.join(work_dir, "journal")
+    goodput_log = os.path.join(work_dir, "goodput.jsonl")
+    deadline = time.monotonic() + deadline_s
+    legs: list[LegResult] = []
+    ipc_dirs: list[str] = []
+    try:
+        for i, leg in enumerate(scenario.legs):
+            plan_path = os.path.join(work_dir, f"plan_{leg.name}.json")
+            with open(plan_path, "w", encoding="utf-8") as f:
+                json.dump({"seed": scenario.seed, "faults": leg.faults}, f)
+            env = dict(os.environ)
+            env.update(env_extra or {})
+            env.setdefault("DLROVER_TPU_PLATFORM", "cpu")
+            env.setdefault("DLROVER_TPU_DEVICE_COUNT", "1")
+            # IPC dirs hold AF_UNIX sockets, whose path limit (~108
+            # chars) a nested work_dir easily exceeds: keep them short
+            # and top-level, removed in the finally below
+            ipc_dir = tempfile.mkdtemp(prefix=f"chaos{i}_")
+            ipc_dirs.append(ipc_dir)
+            env.update({
+                "DLROVER_TPU_CHAOS": plan_path,
+                "DLROVER_TPU_JOURNAL_DIR": journal_dir,
+                "DLROVER_TPU_IPC_DIR": ipc_dir,
+                "PYTHONPATH": (env.get("PYTHONPATH", "")
+                               + os.pathsep + REPO),
+            })
+            result_file = os.path.join(work_dir,
+                                       f"result_{leg.name}.json")
+            cmd = [
+                sys.executable, "-m", "dlrover_tpu.run", "--standalone",
+                "--monitor-interval", "0.3", "--max-restarts", "3",
+                *leg.cli_args,
+                example, "--",
+                "--model", "tiny", "--global-batch", "8", "--seq", "128",
+                "--log-interval", "5",
+                "--ckpt-dir", ckpt_dir,
+                "--result-file", result_file,
+                "--max-steps", str(leg.max_steps),
+                *([] if i != goodput_leg
+                  else ["--goodput-log", goodput_log]),
+                *leg.train_args,
+            ]
+            budget = deadline - time.monotonic()
+            if budget <= 10:
+                legs.append(LegResult(leg.name, -1, None,
+                                      "scenario deadline exhausted", 0.0))
+                break
+            t0 = time.monotonic()
+            logger.info("chaos leg %s: %d faults, %d steps",
+                        leg.name, len(leg.faults), leg.max_steps)
+            try:
+                proc = subprocess.run(
+                    cmd, env=env, cwd=REPO, timeout=budget,
+                    capture_output=True, text=True,
+                )
+                rc, tail = proc.returncode, (proc.stdout
+                                             + proc.stderr)[-3000:]
+            except subprocess.TimeoutExpired as e:
+                rc = -2
+                tail = ((e.stdout or b"")[-3000:].decode(errors="replace")
+                        if isinstance(e.stdout, bytes)
+                        else str(e.stdout or "")[-3000:])
+            result = None
+            if os.path.exists(result_file):
+                try:
+                    with open(result_file, encoding="utf-8") as f:
+                        result = json.load(f)
+                except (OSError, json.JSONDecodeError):
+                    pass
+            legs.append(LegResult(leg.name, rc, result, tail,
+                                  time.monotonic() - t0))
+    finally:
+        # never leak a detached standalone master or wedged trainer
+        subprocess.run(["pkill", "-9", "-f", example],
+                       capture_output=True)
+        subprocess.run(
+            ["pkill", "-9", "-f", "dlrover_tpu.master.job_master"],
+            capture_output=True,
+        )
+        for d in ipc_dirs:
+            shutil.rmtree(d, ignore_errors=True)
+
+    # snapshot the trail BEFORE the verification pass below, which can
+    # emit its own journal events if the caller journals to the same dir
+    trail = fault_trail(journal_dir)
+    recovery_s = _recovery_seconds(journal_dir)
+
+    from dlrover_tpu.checkpoint.integrity import resolve_restore_step
+    from dlrover_tpu.common.storage import PosixDiskStorage
+
+    verified = resolve_restore_step(PosixDiskStorage(), ckpt_dir)
+    goodput = None
+    if os.path.exists(goodput_log):
+        try:
+            from dlrover_tpu.utils.goodput import compute_goodput
+
+            goodput = compute_goodput(goodput_log).goodput
+        except Exception:  # noqa: BLE001 - diagnostics only
+            logger.exception("goodput aggregation failed")
+    return ScenarioResult(
+        scenario=scenario,
+        legs=legs,
+        trail=trail,
+        recovery_seconds=recovery_s,
+        verified_step=verified[0] if verified else None,
+        goodput=goodput,
+        work_dir=work_dir,
+    )
+
+
+# ------------------------------------------------------------------- canned
+
+
+def canned_scenario(seed: int = 1234, *, kill_step: int = 7,
+                    save_interval: int = 6, max_steps: int = 14,
+                    resume_steps: int = 20) -> Scenario:
+    """The acceptance schedule: trainer SIGKILLed mid-save (an injected
+    slow fsync stretches the step-``save_interval`` persist so the kill
+    provably lands inside it), the newest shard bit-flipped on its way
+    to disk, and the master RPC flaking on the post-kill re-join. Leg 2
+    restores from storage in a fresh process tree and must roll back to
+    the newest verified step.
+    """
+    leg1 = JobLeg(
+        name="train_kill_mid_save",
+        max_steps=max_steps,
+        faults=[
+            {"point": "storage_write", "action": "slow_fsync",
+             "args": {"s": 2.0},
+             "match": {"path_contains": f"step-{save_interval}/",
+                       "path_suffix": ".bin"},
+             "times": 1},
+            {"point": "agent_kill_trainer", "action": "kill",
+             "args": {"sig": 9},
+             "match": {"step_gte": kill_step}, "times": 1},
+            {"point": "rpc_call", "action": "drop",
+             "match": {"msg": "JoinRendezvousRequest"},
+             "after": 1, "times": 1},
+            {"point": "storage_write", "action": "bit_flip",
+             "match": {"path_contains": f"step-{max_steps}/",
+                       "path_suffix": ".bin"},
+             "times": 1},
+        ],
+        train_args=["--ckpt-interval", str(save_interval),
+                    "--mem-ckpt-interval", "2", "--step-delay", "0.15"],
+    )
+    leg2 = JobLeg(
+        name="restore_verify_rollback",
+        max_steps=resume_steps,
+        faults=[],
+        train_args=["--ckpt-interval", str(save_interval),
+                    "--mem-ckpt-interval", "2"],
+    )
+    return Scenario(name="kill_flip_flake", seed=seed, legs=[leg1, leg2])

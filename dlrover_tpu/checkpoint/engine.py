@@ -453,10 +453,16 @@ class CheckpointEngine:
 
     def _load_from_storage(self, step: int | None = None
                            ) -> tuple[int, dict[str, np.ndarray]] | None:
-        from dlrover_tpu.agent.ckpt_saver import read_tracker, step_dir
+        from dlrover_tpu.agent.ckpt_saver import step_dir
+        from dlrover_tpu.checkpoint.integrity import resolve_restore_step
 
         if step is None:
-            committed = read_tracker(self.storage, self.ckpt_dir)
+            # newest VERIFIED step: crc-checked against the COMMIT
+            # manifest, rolling back past corrupt/incomplete steps —
+            # a flipped bit must cost a checkpoint interval, never a
+            # silent restore of bad bytes. An explicitly pinned `step`
+            # (best-model reload) bypasses this by caller contract.
+            committed = resolve_restore_step(self.storage, self.ckpt_dir)
             if committed is None:
                 return None
             step, _ = committed

@@ -1,0 +1,212 @@
+"""Checkpoint end-to-end integrity: CRC manifests, COMMIT markers,
+restore-time verification with rollback.
+
+Commit protocol (extends agent/ckpt_saver.py's done-marker scheme, in
+the spirit of Orbax's distributed commit — every shard durable and
+checksummed before the step becomes visible)::
+
+    <ckpt_dir>/step-<N>/node_<id>.bin          shard bytes (atomic write)
+    <ckpt_dir>/step-<N>/node_<id>.meta.json    leaf metas + crc32/bin_bytes
+    <ckpt_dir>/step-<N>/done_<id>_w<W>         per-writer marker, now
+                                               carrying {"crc32", "bytes"}
+    <ckpt_dir>/step-<N>/commit_w<W>            terminal COMMIT marker:
+                                               the full shard manifest,
+                                               written by rank-0's agent
+                                               AFTER all done markers
+    <ckpt_dir>/latest                          tracker (unchanged)
+
+Restore-time verification (``resolve_restore_step``) starts from the
+tracker and accepts a step only when its COMMIT manifest is complete
+and every listed shard's bytes match their recorded CRC32; a corrupt or
+incomplete step is journaled (``ckpt_verify_failed``) and the search
+rolls back through older step directories to the newest step that
+verifies (``ckpt_rollback``). Before this layer, a flipped bit in a
+shard restored silently; now it costs at most one checkpoint interval.
+
+Pre-integrity checkpoints (no COMMIT marker, empty done markers) are
+still accepted on done-marker completeness alone — they carry no CRCs
+to check, and refusing them would strand every checkpoint written
+before the upgrade.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import zlib
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.metrics import registry
+
+logger = get_logger(__name__)
+
+_verify_failed_total = registry().counter(
+    "dlrover_tpu_ckpt_verify_failed_total",
+    "checkpoint steps rejected by restore-time verification, by kind",
+    label_names=("kind",),
+)
+_rollback_total = registry().counter(
+    "dlrover_tpu_ckpt_rollback_total",
+    "restores rolled back past a corrupt/incomplete newest step",
+)
+
+STEP_DIR_RE = re.compile(r"^step-(\d+)$")
+_COMMIT_RE = re.compile(r"^commit_w(\d+)$")
+_DONE_RE = re.compile(r"^done_(.+)_w(\d+)$")
+
+
+def crc32_bytes(data: bytes) -> int:
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def commit_marker(num_shards: int) -> str:
+    """Like done markers, the COMMIT is world-size-qualified: a re-save
+    of the same step after an elastic reshape must not be validated
+    against a previous incarnation's manifest."""
+    return f"commit_w{num_shards}"
+
+
+def write_commit(storage, sdir: str, step: int, num_shards: int,
+                 shards: dict) -> None:
+    """Terminal COMMIT: ``shards`` maps node id (str) -> {"crc32",
+    "bytes"} as collected from the done markers. Atomic via the
+    storage's tmp+fsync+rename write."""
+    storage.write(
+        json.dumps({"step": step, "num_shards": num_shards,
+                    "shards": shards}),
+        os.path.join(sdir, commit_marker(num_shards)),
+    )
+
+
+def _shard_crc(storage, path: str) -> tuple[int, int]:
+    """(crc32, size). Streams local files so verifying a multi-GB shard
+    never materializes it in memory."""
+    from dlrover_tpu.common.storage import PosixDiskStorage
+
+    if isinstance(storage, PosixDiskStorage):
+        crc = 0
+        size = 0
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                crc = zlib.crc32(chunk, crc)
+                size += len(chunk)
+        return crc & 0xFFFFFFFF, size
+    blob = storage.read(path)
+    return crc32_bytes(blob), len(blob)
+
+
+def verify_step_dir(storage, sdir: str, num_shards: int) -> str | None:
+    """None when the step verifies; else a short failure kind.
+
+    With a COMMIT marker: the manifest must list ``num_shards`` shards
+    and every one must exist with matching size and CRC32. Without one:
+    legacy acceptance on done-marker count alone.
+    """
+    files = storage.listdir(sdir)
+    marker = commit_marker(num_shards)
+    if marker not in files:
+        done = [
+            f for f in files
+            if f.startswith("done_") and f.endswith(f"_w{num_shards}")
+        ]
+        return None if len(done) >= num_shards else "missing_commit"
+    try:
+        manifest = json.loads(
+            storage.read_text(os.path.join(sdir, marker))
+        )
+        shards = dict(manifest.get("shards", {}))
+    except (ValueError, OSError, TypeError):
+        return "corrupt_commit"
+    if len(shards) < int(manifest.get("num_shards", num_shards)):
+        return "incomplete_manifest"
+    for nid, entry in shards.items():
+        bin_path = os.path.join(sdir, f"node_{nid}.bin")
+        meta_path = os.path.join(sdir, f"node_{nid}.meta.json")
+        if not storage.exists(bin_path) or not storage.exists(meta_path):
+            return "missing_shard"
+        want = (entry or {}).get("crc32")
+        if want is None:
+            continue  # mixed-version writer: nothing to check against
+        crc, size = _shard_crc(storage, bin_path)
+        want_bytes = (entry or {}).get("bytes")
+        if want_bytes is not None and size != int(want_bytes):
+            return "truncated_shard"
+        if crc != int(want):
+            return "crc_mismatch"
+    return None
+
+
+def _dir_worlds(files: list[str]) -> list[int]:
+    """Candidate writer world sizes recorded in a step dir's markers."""
+    worlds = set()
+    for f in files:
+        m = _COMMIT_RE.match(f) or _DONE_RE.match(f)
+        if m:
+            worlds.add(int(m.group(m.lastindex)))
+    return sorted(worlds, reverse=True)
+
+
+def _reject(step: int, kind: str) -> None:
+    _verify_failed_total.labels(kind).inc()
+    get_journal().emit("ckpt_verify_failed", step=step, kind=kind)
+    logger.error("checkpoint step %d failed verification: %s", step, kind)
+
+
+def resolve_restore_step(storage, ckpt_dir: str
+                         ) -> tuple[int, int] | None:
+    """The newest VERIFIED (step, num_shards) to restore from.
+
+    Starts at the tracker's step; if that step fails verification (or
+    the tracker itself is torn), walks the step directories newest
+    first and returns the first that verifies, journaling the rollback.
+    Returns None when nothing restorable exists — the caller starts
+    fresh, which beats silently installing corrupt weights.
+    """
+    from dlrover_tpu.agent.ckpt_saver import read_tracker, step_dir
+
+    tracked: tuple[int, int] | None = None
+    try:
+        tracked = read_tracker(storage, ckpt_dir)
+    except (ValueError, OSError):
+        _reject(-1, "corrupt_tracker")
+    steps = []
+    for name in storage.listdir(ckpt_dir):
+        m = STEP_DIR_RE.match(name)
+        if m:
+            steps.append(int(m.group(1)))
+    steps.sort(reverse=True)
+
+    checked: set[int] = set()
+    candidates: list[tuple[int, int | None]] = []
+    if tracked is not None:
+        candidates.append(tracked)
+    candidates.extend((s, None) for s in steps)
+    for step, num_shards in candidates:
+        if step in checked:
+            continue
+        checked.add(step)
+        sdir = step_dir(ckpt_dir, step)
+        if not storage.exists(sdir):
+            _reject(step, "missing_dir")
+            continue
+        worlds = ([num_shards] if num_shards
+                  else _dir_worlds(storage.listdir(sdir)))
+        fail_kind = "unverifiable"
+        for world in worlds:
+            kind = verify_step_dir(storage, sdir, world)
+            if kind is None:
+                if tracked is not None and step != tracked[0]:
+                    _rollback_total.inc()
+                    get_journal().emit("ckpt_rollback",
+                                       from_step=tracked[0], to_step=step)
+                    logger.warning(
+                        "rolling back restore: step %d failed "
+                        "verification, using newest verified step %d",
+                        tracked[0], step,
+                    )
+                return step, world
+            fail_kind = kind
+        _reject(step, fail_kind)
+    return None

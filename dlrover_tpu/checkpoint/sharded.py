@@ -323,9 +323,12 @@ class ShardedCheckpointEngine(CheckpointEngine):
                 "holder can't serve the full state; restoring the "
                 "committed storage step instead"
             )
-        from dlrover_tpu.agent.ckpt_saver import read_tracker
+        from dlrover_tpu.checkpoint.integrity import resolve_restore_step
 
-        committed = read_tracker(self.storage, self.ckpt_dir)
+        # newest VERIFIED step (crc manifest + COMMIT marker): every
+        # process resolves independently but deterministically — same
+        # storage, same walk — so the choice stays collective-uniform
+        committed = resolve_restore_step(self.storage, self.ckpt_dir)
         if committed is None:
             return None
         step, num_shards = committed

@@ -139,6 +139,10 @@ class EnvKey:
     BUNDLE_DIR = "DLROVER_TPU_BUNDLE_DIR"
     JOURNAL_MAX_MB = "DLROVER_TPU_JOURNAL_MAX_MB"
     BUNDLES = "DLROVER_TPU_BUNDLES"
+    # chaos harness (dlrover_tpu/chaos/): a JSON fault plan (file path
+    # or inline JSON). Unset = injection compiled out to one boolean
+    # check at every point (read once, at chaos package import).
+    CHAOS = "DLROVER_TPU_CHAOS"
 
 
 class Defaults:

@@ -11,6 +11,7 @@ plenty and keeps the framework dependency-free.
 from __future__ import annotations
 
 import json
+import random
 import socket
 import socketserver
 import struct
@@ -20,10 +21,21 @@ import uuid
 from collections import OrderedDict
 from typing import Any, Callable, Optional
 
+from dlrover_tpu import chaos
 from dlrover_tpu.common import serde
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry.metrics import registry
 
 logger = get_logger(__name__)
+
+_retry_total = registry().counter(
+    "dlrover_tpu_rpc_retry_total",
+    "client rpc attempts retried after a transport error",
+)
+_deadline_total = registry().counter(
+    "dlrover_tpu_rpc_retry_deadline_exceeded_total",
+    "client rpc calls abandoned at the per-call deadline",
+)
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 256 * 1024 * 1024
@@ -153,16 +165,34 @@ class RpcServer:
 
 
 class RpcClient:
-    """Persistent-connection client with reconnect + retry."""
+    """Persistent-connection client with reconnect + jittered-backoff retry.
 
-    def __init__(self, addr: str, timeout: float = 30.0, retries: int = 5,
-                 retry_interval: float = 1.0):
+    Retry policy: exponential backoff from ``backoff_base_s`` doubling
+    up to ``backoff_max_s``, with equal jitter (half the window fixed,
+    half uniform-random) so N agents reconnecting after a master
+    restart spread out instead of thundering in lockstep — the fixed
+    1s interval this replaced re-synchronized the whole fleet onto the
+    same retry ticks. ``deadline_s`` bounds one ``call`` end to end
+    regardless of how many attempts fit; both abandonment paths are
+    counted (``dlrover_tpu_rpc_retry_total`` /
+    ``..._retry_deadline_exceeded_total``).
+    """
+
+    def __init__(self, addr: str, timeout: float = 30.0, retries: int = 8,
+                 retry_interval: float | None = None,
+                 backoff_base_s: float = 0.1, backoff_max_s: float = 3.0,
+                 deadline_s: float = 60.0):
         host, _, port = addr.rpartition(":")
         self._host = host or "127.0.0.1"
         self._port = int(port)
         self._timeout = timeout
-        self._retries = retries
-        self._retry_interval = retry_interval
+        self._retries = max(1, retries)
+        if retry_interval is not None:
+            # legacy fixed-interval knob: honored as the backoff ceiling
+            backoff_max_s = max(backoff_base_s, retry_interval)
+        self._backoff_base_s = backoff_base_s
+        self._backoff_max_s = backoff_max_s
+        self._deadline_s = deadline_s
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
 
@@ -191,14 +221,24 @@ class RpcClient:
         """Send one message, wait for the typed response.
 
         Raises RuntimeError if the server reported an error, ConnectionError
-        if the master is unreachable after retries.
+        if the master is unreachable after retries or past the per-call
+        deadline.
         """
         env = serde.encode_obj(msg)
         env["rid"] = uuid.uuid4().hex
         payload = json.dumps(env).encode("utf-8")
+        deadline = time.monotonic() + self._deadline_s
         last_err: Exception | None = None
-        for attempt in range(self._retries):
+        attempt = 0
+        while True:
             try:
+                if chaos.ENABLED:
+                    fault = chaos.fire(
+                        "rpc_call", msg=type(msg).__name__,
+                        addr=self.addr, attempt=attempt,
+                    )
+                    if fault is not None:
+                        self._apply_rpc_fault(fault)
                 with self._lock:
                     sock = self._connect()
                     send_frame(sock, payload)
@@ -210,11 +250,48 @@ class RpcClient:
             except (ConnectionError, OSError, TimeoutError) as e:
                 last_err = e
                 self.close()
-                if attempt < self._retries - 1:
-                    time.sleep(self._retry_interval)
-        raise ConnectionError(
-            f"rpc to {self.addr} failed after {self._retries} tries: {last_err}"
-        )
+                attempt += 1
+                now = time.monotonic()
+                if now >= deadline:
+                    _deadline_total.inc()
+                    raise ConnectionError(
+                        f"rpc to {self.addr} exceeded its "
+                        f"{self._deadline_s:.0f}s deadline after {attempt} "
+                        f"tries: {last_err}"
+                    ) from e
+                if attempt >= self._retries:
+                    raise ConnectionError(
+                        f"rpc to {self.addr} failed after {attempt} "
+                        f"tries: {last_err}"
+                    ) from e
+                _retry_total.inc()
+                cap = min(self._backoff_max_s,
+                          self._backoff_base_s * (2 ** (attempt - 1)))
+                sleep_s = cap / 2 + random.uniform(0.0, cap / 2)
+                time.sleep(max(0.0, min(sleep_s, deadline - now)))
+
+    def _apply_rpc_fault(self, fault: chaos.Fault) -> None:
+        """Injected transport faults (chaos plan ``rpc_call`` point):
+        ``delay`` (sleep), ``drop`` (request never sent), ``reset``
+        (connection torn down mid-call), ``garble`` (a corrupt frame —
+        oversized declared length — reaches the server, exercising its
+        framing guard). All but ``delay`` surface as the transport
+        errors the retry loop already handles."""
+        if fault.action == "delay":
+            time.sleep(float(fault.args.get("s", 0.05)))
+        elif fault.action == "drop":
+            raise ConnectionError("chaos: rpc request dropped")
+        elif fault.action == "reset":
+            self.close()
+            raise ConnectionResetError("chaos: connection reset")
+        elif fault.action == "garble":
+            with self._lock:
+                sock = self._connect()
+                sock.sendall(_LEN.pack(MAX_FRAME + 1) + b"\xde\xad\xbe\xef")
+            self.close()
+            raise ConnectionError("chaos: garbled frame sent")
+        else:
+            logger.warning("chaos: unknown rpc_call action %r", fault.action)
 
 
 def find_free_port(host: str = "127.0.0.1") -> int:

@@ -9,11 +9,15 @@ agent-side persister can reconstruct the trainer-configured storage backend
 from __future__ import annotations
 
 import dataclasses
+import errno
 import importlib
 import os
 import shutil
+import time
 from abc import ABC, abstractmethod
 from typing import Any
+
+from dlrover_tpu import chaos
 
 
 @dataclasses.dataclass
@@ -71,15 +75,62 @@ class CheckpointStorage(ABC):
         return {}
 
 
+def _apply_write_fault(content: bytes | str, path: str
+                       ) -> tuple[bytes | str, float]:
+    """Injected storage faults (chaos plan ``storage_write`` point).
+
+    ``bit_flip`` corrupts one bit of the payload (position drawn from
+    the rule's seeded stream — the disk lies, the writer never knows),
+    ``enospc`` raises the classic full-disk OSError, ``slow_fsync``
+    returns an fsync delay (a sick device that still completes), and
+    ``torn`` leaves a PARTIAL file at the final path and raises — the
+    non-atomic crash mid-write the tmp+rename protocol exists to
+    prevent, forced past it. Returns (possibly mutated content,
+    fsync delay seconds).
+    """
+    fault = chaos.fire("storage_write", path=path)
+    if fault is None:
+        return content, 0.0
+    if fault.action == "enospc":
+        raise OSError(errno.ENOSPC,
+                      f"chaos: no space left on device: {path}")
+    if fault.action == "slow_fsync":
+        return content, float(fault.args.get("s", 0.5))
+    data = bytearray(
+        content if isinstance(content, bytes) else content.encode("utf-8")
+    )
+    if fault.action == "bit_flip":
+        if data:
+            pos = int(fault.args.get("offset", -1))
+            if pos < 0 or pos >= len(data):
+                pos = int(fault.rand * len(data))
+            data[pos] ^= 1 << (fault.seq % 8)
+        return bytes(data), 0.0
+    if fault.action == "torn":
+        cut = max(0, min(len(data) - 1,
+                         int(len(data) * float(fault.args.get("frac", 0.5)))))
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(bytes(data[:cut]))
+        raise OSError(f"chaos: torn write of {path} "
+                      f"({cut}/{len(data)} bytes)")
+    return content, 0.0
+
+
 def atomic_write_file(content: bytes | str, path: str) -> None:
     """Durable atomic file publish: tmp + fsync + rename. Without the
     fsync a crash right after the rename can publish a truncated file."""
+    fsync_delay = 0.0
+    if chaos.ENABLED:
+        content, fsync_delay = _apply_write_fault(content, path)
     mode = "wb" if isinstance(content, bytes) else "w"
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, mode) as f:
         f.write(content)
         f.flush()
+        if fsync_delay > 0:
+            time.sleep(fsync_delay)
         os.fsync(f.fileno())
     os.replace(tmp, path)
 

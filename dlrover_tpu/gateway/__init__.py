@@ -6,6 +6,7 @@ from dlrover_tpu.gateway.autoscale import (  # noqa: F401
     GatewaySignals,
     p95_from_buckets,
 )
+from dlrover_tpu.gateway.control import MasterLink  # noqa: F401
 from dlrover_tpu.gateway.pool import (  # noqa: F401
     EngineReplica,
     PoolScaler,

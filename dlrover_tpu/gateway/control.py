@@ -1,0 +1,130 @@
+"""Gateway <-> master control-plane link with graceful degradation.
+
+The gateway's data plane (admission, routing, decode) is deliberately
+self-contained; this link is the OPTIONAL control-plane coupling — it
+pushes the gateway's metrics snapshot to the job master on a heartbeat
+cadence (so the master's one-scrape ``/metrics`` covers serving too)
+and pulls a desired replica target from the master KV store, applying
+it through the same ``ScalePlan`` path the autoscaler uses.
+
+Degradation contract: when the master becomes unreachable the gateway
+KEEPS SERVING with its last-known replica pool and last-applied target —
+control-plane loss must never fail data-plane requests. The transition
+is observable: a ``degraded_mode`` journal instant on enter/exit and
+the ``dlrover_tpu_gateway_degraded`` gauge (1 while degraded) for
+alerting. Control actions simply resume when the master returns.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from dlrover_tpu.cluster.crd import ScalePlan
+from dlrover_tpu.cluster.scaler import Scaler
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.metrics import registry
+
+logger = get_logger(__name__)
+
+_degraded_gauge = registry().gauge(
+    "dlrover_tpu_gateway_degraded",
+    "1 while the gateway serves without a reachable master",
+)
+
+
+class MasterLink:
+    """Heartbeat loop binding a ``Gateway`` to a job master.
+
+    ``client`` is an ``agent.master_client.MasterClient`` (or anything
+    with ``report_metrics``/``kv_get``); ``scaler`` (optional) receives
+    a ScalePlan when the master's ``kv_key`` names a new replica
+    target. The loop never raises: every master error flips the link
+    into degraded mode and the next successful tick flips it back.
+    """
+
+    def __init__(self, gateway, client, *, scaler: Scaler | None = None,
+                 interval_s: float = 5.0,
+                 kv_key: str = "gateway/replica_target",
+                 group: str = "serving"):
+        self._gateway = gateway
+        self._client = client
+        self._scaler = scaler
+        self._interval_s = interval_s
+        self._kv_key = kv_key
+        self._group = group
+        self._degraded = False
+        self._last_target: int | None = None
+        self._stopped = threading.Event()
+        self._thread: threading.Thread | None = None
+        _degraded_gauge.set(0)
+        if gateway is not None:
+            gateway.master_link = self
+
+    @property
+    def degraded(self) -> bool:
+        return self._degraded
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> "MasterLink":
+        self._thread = threading.Thread(
+            target=self._loop, name="gateway-master-link", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def _loop(self) -> None:
+        while not self._stopped.wait(self._interval_s):
+            self.tick()
+
+    # ---------------------------------------------------------------- tick
+
+    def tick(self) -> None:
+        try:
+            self._client.report_metrics(registry().snapshot(),
+                                        role="gateway")
+            raw = self._client.kv_get(self._kv_key)
+        except (ConnectionError, RuntimeError, OSError) as e:
+            self._enter_degraded(e)
+            return
+        self._exit_degraded()
+        if not raw:
+            return
+        try:
+            target = int(raw.decode("utf-8").strip())
+        except (ValueError, UnicodeDecodeError):
+            logger.warning("ignoring malformed %s value %r",
+                           self._kv_key, raw[:64])
+            return
+        if self._scaler is not None and target != self._last_target:
+            self._last_target = target
+            self._scaler.scale(ScalePlan(
+                job_name="gateway",
+                replica_resources={self._group: target},
+                reason=f"master kv target ({self._kv_key})",
+            ))
+
+    def _enter_degraded(self, err: Exception) -> None:
+        if self._degraded:
+            return
+        self._degraded = True
+        _degraded_gauge.set(1)
+        get_journal().emit("degraded_mode", state="enter",
+                           component="gateway", error=str(err)[:200])
+        logger.warning(
+            "master unreachable (%s); gateway serving in degraded mode "
+            "with its last-known replica pool", err,
+        )
+
+    def _exit_degraded(self) -> None:
+        if not self._degraded:
+            return
+        self._degraded = False
+        _degraded_gauge.set(0)
+        get_journal().emit("degraded_mode", state="exit",
+                           component="gateway")
+        logger.info("master reachable again; gateway left degraded mode")

@@ -171,6 +171,8 @@ class Gateway:
             preemption_file=preemption_file,
         )
         self._seed = seed
+        # set by gateway.control.MasterLink when a master is attached
+        self.master_link = None
         self._dispatch_timeout_s = dispatch_timeout_s
         self._ids_lock = threading.Lock()
         self._next_id = 0
@@ -220,6 +222,8 @@ class Gateway:
     def stats(self) -> dict:
         states = [r.state.value for r in self.pool.replicas()]
         return {
+            "degraded": bool(self.master_link is not None
+                             and self.master_link.degraded),
             "replicas": {s: states.count(s) for s in set(states)},
             "ready": len(self.pool.ready_replicas()),
             "slots_total": self.pool.slots_total(),

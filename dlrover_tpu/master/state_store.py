@@ -17,11 +17,19 @@ import json
 import os
 import threading
 import time
+import zlib
 from typing import Any
 
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.metrics import registry
 
 logger = get_logger(__name__)
+
+_state_rollback_total = registry().counter(
+    "dlrover_tpu_master_state_rollback_total",
+    "master restarts recovered from the previous state snapshot",
+)
 
 
 class StateBackend:
@@ -44,7 +52,14 @@ class MemoryStateBackend(StateBackend):
 
 
 class FileStateBackend(StateBackend):
-    """Atomic JSON file (k8s analog: a ConfigMap or PVC file)."""
+    """Atomic checksummed JSON file (k8s analog: a ConfigMap or PVC file).
+
+    Snapshots are wrapped as ``{"crc32", "body"}`` so a restarted
+    master can tell torn/corrupt bytes from valid state, and every save
+    rotates the previous snapshot to ``<path>.prev`` — a corrupt (or
+    mid-write-crashed) current snapshot recovers from the previous one
+    instead of crashing the master or silently starting fresh.
+    """
 
     def __init__(self, path: str):
         self._path = path
@@ -52,17 +67,53 @@ class FileStateBackend(StateBackend):
     def save(self, state: dict) -> None:
         from dlrover_tpu.common.storage import atomic_write_file
 
-        atomic_write_file(json.dumps(state), self._path)
+        body = json.dumps(state)
+        wrapped = json.dumps({
+            "crc32": zlib.crc32(body.encode("utf-8")) & 0xFFFFFFFF,
+            "body": body,
+        })
+        if os.path.exists(self._path):
+            try:
+                os.replace(self._path, self._path + ".prev")
+            except OSError:
+                pass
+        atomic_write_file(wrapped, self._path)
 
     def load(self) -> dict | None:
-        if not os.path.exists(self._path):
+        state = self._load_one(self._path)
+        if state is not None:
+            return state
+        state = self._load_one(self._path + ".prev")
+        if state is not None:
+            _state_rollback_total.inc()
+            get_journal().emit("state_rollback", path=self._path)
+            logger.warning(
+                "current state snapshot unusable; recovered from the "
+                "previous snapshot %s.prev", self._path,
+            )
+            return state
+        return None
+
+    def _load_one(self, path: str) -> dict | None:
+        if not os.path.exists(path):
             return None
         try:
-            with open(self._path) as f:
-                return json.load(f)
+            with open(path) as f:
+                data = json.load(f)
         except (json.JSONDecodeError, OSError):
-            logger.exception("state restore failed; starting fresh")
+            logger.exception("state snapshot %s unreadable", path)
             return None
+        if isinstance(data, dict) and "body" in data and "crc32" in data:
+            body = data["body"]
+            if zlib.crc32(body.encode("utf-8")) & 0xFFFFFFFF \
+                    != int(data["crc32"]):
+                logger.error("state snapshot %s failed its checksum", path)
+                return None
+            try:
+                return json.loads(body)
+            except json.JSONDecodeError:
+                return None
+        return data  # pre-checksum snapshot: accepted as-is
 
 
 class MasterStateManager:

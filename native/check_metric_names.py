@@ -19,6 +19,11 @@ the contract ``telemetry/report.py`` attributes lost time by and
 ``telemetry/timeline.py`` renders, so a span shipped undocumented is a
 span the operator can't read.
 
+Chaos fault points (``chaos.fire("...")`` injection sites) are linted
+the same way: literal ``[a-z_]+`` names, each documented in DESIGN.md —
+a fault point a chaos plan can't be written against (because nobody
+can discover its name) is dead weight in a hot path.
+
 Invoked from the tier-1 suite (tests/test_telemetry.py +
 tests/test_flight_recorder.py) and runnable standalone:
 ``python native/check_metric_names.py``.
@@ -43,6 +48,15 @@ SPAN_RE = re.compile(
 # the journal implementation itself forwards caller-supplied names
 # (EventJournal.span -> self.begin(name, ...)): not an emission site
 SPAN_SCAN_EXCLUDE = (os.path.join("telemetry", "journal.py"),)
+
+POINT_NAME_RE = re.compile(r"^[a-z_]+$")
+POINT_RE = re.compile(
+    r"chaos\s*\.\s*fire\(\s*(?:\n\s*)?"
+    r"(?:(?P<q>['\"])(?P<name>[^'\"]+)(?P=q)|(?P<nonlit>[A-Za-z_f][^,)]*))"
+)
+# the chaos package itself forwards caller-supplied point names and its
+# docstrings discuss the call form: not injection sites
+POINT_SCAN_EXCLUDE = (os.path.join("dlrover_tpu", "chaos") + os.sep,)
 
 PKG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                    "dlrover_tpu")
@@ -113,6 +127,54 @@ def scan_spans(pkg_dir: str = PKG,
     return names, problems
 
 
+def scan_fault_points(pkg_dir: str = PKG,
+                      design_path: str = DESIGN_MD
+                      ) -> tuple[dict[str, list[str]], list[str]]:
+    """(fault point name -> [injection sites], problems) for the chaos
+    harness's ``chaos.fire("...")`` call sites."""
+    names: dict[str, list[str]] = {}
+    problems: list[str] = []
+    for root, _dirs, files in os.walk(pkg_dir):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, os.path.dirname(pkg_dir))
+            if any(ex in rel for ex in POINT_SCAN_EXCLUDE):
+                continue
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            for match in POINT_RE.finditer(text):
+                line = text.count("\n", 0, match.start()) + 1
+                site = f"{rel}:{line}"
+                if match.group("name") is None:
+                    problems.append(
+                        f"{site}: chaos fault point fired with a "
+                        f"non-literal name ({match.group('nonlit')!r})"
+                    )
+                    continue
+                name = match.group("name")
+                if not POINT_NAME_RE.match(name):
+                    problems.append(
+                        f"{site}: fault point name {name!r} does not "
+                        f"match {POINT_NAME_RE.pattern}"
+                    )
+                names.setdefault(name, []).append(site)
+    try:
+        with open(design_path, encoding="utf-8") as f:
+            design = f.read()
+    except OSError as e:
+        problems.append(f"cannot read {design_path}: {e}")
+        return names, problems
+    for name, sites in sorted(names.items()):
+        if name not in design:
+            problems.append(
+                f"chaos fault point {name!r} ({', '.join(sites)}) is not "
+                f"documented in DESIGN.md; add it to the fault-point table"
+            )
+    return names, problems
+
+
 def scan(pkg_dir: str = PKG) -> tuple[dict[str, list[str]], list[str]]:
     """(name -> [call sites], problems)."""
     names: dict[str, list[str]] = {}
@@ -156,13 +218,15 @@ def scan(pkg_dir: str = PKG) -> tuple[dict[str, list[str]], list[str]]:
 def main() -> int:
     names, problems = scan()
     span_names, span_problems = scan_spans()
-    problems = problems + span_problems
+    point_names, point_problems = scan_fault_points()
+    problems = problems + span_problems + point_problems
     if problems:
         for p in problems:
             print(f"check_metric_names: {p}", file=sys.stderr)
         return 1
     print(f"check_metric_names: {len(names)} metric names, "
-          f"{len(span_names)} span names OK")
+          f"{len(span_names)} span names, "
+          f"{len(point_names)} chaos fault points OK")
     return 0
 
 

@@ -54,7 +54,7 @@ def pytest_configure(config):
 # one-core host save only ~10% wall clock (jax compiles are CPU-bound)
 # and the sibling's compiles can starve these very e2e jobs.
 _E2E_GROUP_FILES = {
-    "test_buddy.py", "test_e2e.py", "test_goodput.py",
+    "test_buddy.py", "test_chaos.py", "test_e2e.py", "test_goodput.py",
     "test_hang_detector.py", "test_multinode_e2e.py",
     "test_node_relaunch_e2e.py", "test_preemption_e2e.py",
     "test_soak.py",

@@ -20,15 +20,24 @@ from dlrover_tpu.common.constants import NodeType
 
 
 @pytest.mark.parametrize("code,reason", [
+    # the full exit-code contract (failure_policy.py module docstring)
     (0, NodeExitReason.SUCCEEDED),
-    (EXIT_CODE_OOM, NodeExitReason.OOM),
-    (EXIT_CODE_HARDWARE, NodeExitReason.HARDWARE_ERROR),
+    (EXIT_CODE_OOM, NodeExitReason.OOM),        # 210
+    (EXIT_CODE_HARDWARE, NodeExitReason.HARDWARE_ERROR),  # 211
     (-9, NodeExitReason.KILLED),
-    (137, NodeExitReason.KILLED),       # 128+9
+    (137, NodeExitReason.KILLED),       # 128+9  SIGKILL
+    (139, NodeExitReason.KILLED),       # 128+11 SIGSEGV
+    (-11, NodeExitReason.KILLED),
     (-15, NodeExitReason.PREEMPTED),
-    (143, NodeExitReason.PREEMPTED),    # 128+15
+    (143, NodeExitReason.PREEMPTED),    # 128+15 SIGTERM
     (1, NodeExitReason.UNKNOWN),
     (17, NodeExitReason.UNKNOWN),
+    (128, NodeExitReason.UNKNOWN),      # not above the signal base
+    # >128 but not a valid signal number: a software error exiting 255
+    # must NOT classify as "killed by signal 127"
+    (255, NodeExitReason.UNKNOWN),
+    (254, NodeExitReason.UNKNOWN),      # "signal 126" is not a signal
+    (-200, NodeExitReason.UNKNOWN),     # out-of-range negative code
 ])
 def test_classify(code, reason):
     assert classify_exit(code) == reason

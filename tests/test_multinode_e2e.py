@@ -18,6 +18,16 @@ import time
 
 import pytest
 
+# Slow tier: a genuine jax.distributed world over CPU+Gloo. This
+# container's jax CPU backend cannot complete multi-process collectives
+# (known since the telemetry PR — see CHANGES.md), so under tier-1 these
+# four e2es burned ~60 s failing by timeout on every run without
+# asserting anything. The slow tier keeps them collected by a plain
+# `pytest tests/` on hosts whose backend supports the multi-process
+# world (VERDICT.md: "move the slowest e2e bodies behind a tiered
+# marker the driver still runs").
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLE = os.path.join(REPO, "examples", "train_transformer.py")
 
