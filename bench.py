@@ -639,7 +639,14 @@ def _run_elastic_job(work: str, env: dict, train_args: list[str],
                     ["pgrep", "-f", f"^{sys.executable} {example}"],
                     capture_output=True, text=True,
                 )
-                pids = [int(p) for p in out.stdout.split()]
+                from dlrover_tpu.agent.standby import parked_standby_pids
+
+                # a parked warm standby has the same cmdline as the live
+                # trainer: killing it would waste the injection AND turn
+                # the next recovery cold
+                standbys = parked_standby_pids(env.get("DLROVER_TPU_IPC_DIR"))
+                pids = [int(p) for p in out.stdout.split()
+                        if int(p) not in standbys]
                 if pids:
                     os.kill(pids[-1], _signal.SIGKILL)
                     killed += 1
@@ -714,10 +721,18 @@ def _goodput_scenario(extra: dict, prefix: str, child_env: dict,
     model = os.environ.get("BENCH_GOODPUT_MODEL", "tiny")
     work = tempfile.mkdtemp(prefix="bench_goodput_")
     log = os.path.join(work, "goodput.jsonl")
+    journal_dir = os.path.join(work, "journal")
     env = dict(os.environ)
     env.update(child_env)
     env.update({
         "DLROVER_TPU_IPC_DIR": os.path.join(work, "ipc"),
+        # the PR-1 journal is the evidence source for the per-failure
+        # phase breakdown emitted below — every goodput headline ships
+        # with its respawn/rendezvous/restore/recompile/redone split
+        "DLROVER_TPU_JOURNAL_DIR": journal_dir,
+        # warm recovery on (the default) — pinned so an outer env can't
+        # silently bench the cold path
+        "DLROVER_TPU_STANDBY": env.get("DLROVER_TPU_STANDBY", "1"),
         "PYTHONPATH": env.get("PYTHONPATH", "") + os.pathsep + repo,
     })
     if env.get("DLROVER_TPU_PLATFORM") != "cpu":
@@ -782,6 +797,8 @@ def _goodput_scenario(extra: dict, prefix: str, child_env: dict,
             os.remove(log)
         shutil.rmtree(os.path.join(work, "ckpt"), ignore_errors=True)
         shutil.rmtree(os.path.join(work, "ipc"), ignore_errors=True)
+        # the phase breakdown must describe the MEASURED run only
+        shutil.rmtree(journal_dir, ignore_errors=True)
 
         rc, tail, killed, t_launch, t_exit = _run_elastic_job(
             work, env,
@@ -832,6 +849,24 @@ def _goodput_scenario(extra: dict, prefix: str, child_env: dict,
             f"{prefix}total_s": round(report.total_s, 1),
             f"{prefix}exit_code": rc,
         })
+        # per-failure phase breakdown from the journal (same vocabulary
+        # as telemetry/report): where each failure's lost time went.
+        # Union seconds per category / failures injected.
+        try:
+            from dlrover_tpu.telemetry.report import build_report
+
+            lrep = build_report(journal_dir, goodput_log=log,
+                                end_time=t_exit)
+            denom = max(1, killed)
+            for cat in ("respawn", "rendezvous", "restore",
+                        "recompile", "redone"):
+                extra[f"{prefix}{cat}_s"] = round(
+                    lrep.categories.get(cat, 0.0) / denom, 2)
+            extra[f"{prefix}unattributed_s"] = round(
+                lrep.unattributed_s / denom, 2)
+        except Exception as e:  # noqa: BLE001 - breakdown is evidence,
+            # not a reason to lose the headline numbers
+            extra[f"{prefix}phase_breakdown_error"] = str(e)
         if rc != 0:
             extra[f"{prefix}tail"] = tail
     finally:
@@ -886,7 +921,9 @@ def bench_goodput(extra: dict, stage_budget_s: float = 900.0) -> None:
     # at-the-bar without its rate qualifier (VERDICT r5 item 9)
     for k in ("goodput", "goodput_cold", "goodput_at_baseline_rate",
               "per_failure_cost_s", "failures_injected", "failures_per_hr",
-              "incarnations", "steps", "median_step_s", "total_s"):
+              "incarnations", "steps", "median_step_s", "total_s",
+              "respawn_s", "rendezvous_s", "restore_s", "recompile_s",
+              "redone_s"):
         if f"goodput_sys_{k}" in extra:
             name = k if k.startswith("goodput") else f"goodput_{k}"
             extra[name] = extra[f"goodput_sys_{k}"]

@@ -133,6 +133,7 @@ class ElasticAgent:
         self._preemption_watcher = None
         self._metrics_server = None
         self._world: dict[int, int] = {}
+        self._standby = None  # agent/standby.py StandbyManager
         self._node_rank = -1
         self._pending_action = ""
         self._action_lock = threading.Lock()
@@ -187,22 +188,32 @@ class ElasticAgent:
 
     # ----------------------------------------------------------- child mgmt
 
+    def _child_env_update(self, rank: int, num_nodes: int,
+                          coordinator: str) -> dict[str, str]:
+        """The env-var contract one trainer incarnation runs under —
+        shared by cold spawns and standby promotions."""
+        update = {
+            EnvKey.JOB_NAME: self._config.job_name,
+            EnvKey.MASTER_ADDR: self._client._client.addr,
+            EnvKey.NODE_ID: str(self._config.node_id),
+            EnvKey.NODE_RANK: str(rank),
+            EnvKey.NODE_NUM: str(num_nodes),
+            EnvKey.COORDINATOR: coordinator,
+            EnvKey.RESTART_COUNT: str(self._incarnation),
+        }
+        trace = os.environ.get(EnvKey.TRACE_ID)
+        if trace:
+            # a parked standby was spawned before the first rendezvous
+            # delivered the job trace id: promotion must carry it
+            update[EnvKey.TRACE_ID] = trace
+        if self._config_tuner is not None:
+            update[EnvKey.PARAL_CONFIG_PATH] = self._config_tuner.path
+        return update
+
     def _spawn(self, rank: int, num_nodes: int, coordinator: str
                ) -> subprocess.Popen:
         env = dict(os.environ)
-        env.update(
-            {
-                EnvKey.JOB_NAME: self._config.job_name,
-                EnvKey.MASTER_ADDR: self._client._client.addr,
-                EnvKey.NODE_ID: str(self._config.node_id),
-                EnvKey.NODE_RANK: str(rank),
-                EnvKey.NODE_NUM: str(num_nodes),
-                EnvKey.COORDINATOR: coordinator,
-                EnvKey.RESTART_COUNT: str(self._incarnation),
-            }
-        )
-        if self._config_tuner is not None:
-            env[EnvKey.PARAL_CONFIG_PATH] = self._config_tuner.path
+        env.update(self._child_env_update(rank, num_nodes, coordinator))
         logger.info(
             "spawning training process (incarnation %d, failures %d): %s",
             self._incarnation, self._restart_count,
@@ -215,6 +226,50 @@ class ElasticAgent:
         return subprocess.Popen(
             self._config.entrypoint, env=env, start_new_session=True
         )
+
+    def _respawn(self, rank: int, num_nodes: int, coordinator: str
+                 ) -> subprocess.Popen:
+        """Warm path first: promote the parked standby (it has already
+        paid spawn + imports and may have a restore prefetch running),
+        then re-arm a fresh one in the background. Cold `_spawn` when
+        standbys are off, dead, or never armed."""
+        if self._standby is not None:
+            proc = self._standby.promote(
+                self._child_env_update(rank, num_nodes, coordinator)
+            )
+            if proc is not None:
+                if self._hang is not None:
+                    self._hang.reset()
+                _incarnation_gauge.set(self._incarnation)
+                self._standby.arm_async()
+                return proc
+        return self._spawn(rank, num_nodes, coordinator)
+
+    def _arm_standby(self) -> None:
+        from dlrover_tpu.agent.standby import StandbyManager, standby_enabled
+
+        if not standby_enabled() or not self._config.entrypoint:
+            return
+        if self._standby is None:
+            self._standby = StandbyManager(
+                self._config.entrypoint, self._config.node_id
+            )
+        self._standby.arm_async()
+
+    def _prepare_standby_restore(self) -> None:
+        """Failure time, post-persist: point the parked standby at the
+        checkpoint dir so its storage restore prefetch overlaps the
+        rendezvous round this agent is about to run."""
+        if self._standby is None or self._ckpt_saver is None:
+            return
+        try:
+            header = self._ckpt_saver.shm_handler.header()
+        except Exception:  # noqa: BLE001 - prefetch is best-effort
+            return
+        if header:
+            ckpt_dir = header.get("ckpt_dir") or ""
+            if ckpt_dir:
+                self._standby.prepare(ckpt_dir)
 
     def _kill_child(self) -> None:
         if self._proc is None or self._proc.poll() is not None:
@@ -271,12 +326,17 @@ class ElasticAgent:
                 self._buddy_server.stop()
             if self._metrics_server is not None:
                 self._metrics_server.stop()
+            if self._standby is not None:
+                self._standby.discard()
             self._kill_child()
 
     def _invoke_run(self) -> RunResult:
         rank, num_nodes, coordinator = self._rendezvous()
         self._restore_from_buddy()
         self._proc = self._spawn(rank, num_nodes, coordinator)
+        # arm the warm standby only after the live trainer exists: the
+        # first spawn must never queue behind the standby's import cost
+        self._arm_standby()
         hang = self._hang
         while True:
             time.sleep(self._config.monitor_interval_s)
@@ -419,11 +479,14 @@ class ElasticAgent:
             incarnation=self._incarnation + 1,
         ):
             self._persist_checkpoint(reason="process failure")
+            # the persist is durable: the standby's restore prefetch can
+            # now run concurrently with the rendezvous round below
+            self._prepare_standby_restore()
             self._recover_shards()
             self._restart_count += 1
             self._incarnation += 1
             rank, num_nodes, coordinator = self._rendezvous()
-            self._proc = self._spawn(rank, num_nodes, coordinator)
+            self._proc = self._respawn(rank, num_nodes, coordinator)
         return None
 
     def _restart_workers(self, reason: str) -> None:
@@ -439,10 +502,11 @@ class ElasticAgent:
         ):
             self._persist_checkpoint(reason=reason)
             self._kill_child()
+            self._prepare_standby_restore()
             self._recover_shards()
             self._incarnation += 1
             rank, num_nodes, coordinator = self._rendezvous()
-            self._proc = self._spawn(rank, num_nodes, coordinator)
+            self._proc = self._respawn(rank, num_nodes, coordinator)
 
     def _write_bundle(self, reason: str, child_pid: int | None = None,
                       extra: dict | None = None) -> str | None:

@@ -1,0 +1,323 @@
+"""Warm-standby trainers: pre-spawned, parked, promoted on failure.
+
+The dominant per-failure cost on the recovery path is serial process
+bring-up: a cold trainer spawn pays interpreter start + the Python/JAX
+import graph (seconds) before it can even begin rendezvous-dependent
+work. The agent therefore keeps ONE standby trainer per node that has
+already paid those costs and is parked inside
+``bootstrap.init_from_env`` waiting for a rendezvous payload. On worker
+death, ``ElasticAgent`` *promotes* the standby — hands it the payload
+over a file-based IPC handshake — instead of cold-starting a process,
+then re-arms a fresh standby in the background.
+
+What the standby pre-pays: process spawn, Python + JAX import, platform
+config, compilation-cache setup, flight-recorder arming. What it must
+NOT touch before promotion: the accelerator backend (TPU chips are
+exclusive-access — the dying trainer still owns them) and
+``jax.distributed.initialize`` (needs the coordinator address only the
+completed rendezvous provides). Both happen immediately after the
+payload lands.
+
+Handshake (all under the IPC dir, atomic renames only):
+
+- ``<base>.ready``   written by the parked child: imports done, parked.
+- ``<base>.prepare`` written by the agent at failure time, BEFORE the
+  rendezvous round: carries the checkpoint dir so the standby starts
+  the storage restore prefetch (``checkpoint/engine.py``) concurrently
+  with rendezvous — the overlapped-restore half of warm recovery.
+- ``<base>``         the promotion payload: the env-var dict a cold
+  spawn would have received; the child adopts it and resumes bring-up.
+
+Disable with ``DLROVER_TPU_STANDBY=0`` (the promotion path is also
+skipped whenever the standby died while parked — promotion falls back
+to a cold spawn, so the feature can only ever help).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import tempfile
+import threading
+import time
+
+from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.metrics import registry
+
+logger = get_logger(__name__)
+
+_promotions_total = registry().counter(
+    "dlrover_tpu_standby_promotions_total",
+    "trainer respawns served by promoting a pre-spawned standby",
+    label_names=("warm",),
+)
+_warm_gauge = registry().gauge(
+    "dlrover_tpu_standby_warm",
+    "1 while a fully-parked standby trainer is available on this node",
+)
+
+_POLL_S = 0.05
+
+
+def standby_enabled() -> bool:
+    return os.environ.get(EnvKey.STANDBY, "1") != "0"
+
+
+def _handshake_dir() -> str:
+    return os.environ.get("DLROVER_TPU_IPC_DIR") or tempfile.gettempdir()
+
+
+def _atomic_write(path: str, payload: dict) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+class StandbyManager:
+    """Agent-side: owns at most one parked standby trainer process."""
+
+    def __init__(self, entrypoint: list[str], node_id: int,
+                 base_env: dict | None = None):
+        self._entrypoint = list(entrypoint)
+        self._node_id = node_id
+        self._base_env = base_env
+        self._proc: subprocess.Popen | None = None
+        self._payload_path = ""
+        self._serial = 0
+        self._lock = threading.Lock()
+        self._armed_at = 0.0
+
+    # ------------------------------------------------------------------ arm
+
+    def arm(self) -> None:
+        """Spawn a fresh standby (non-blocking: the child pays its import
+        cost in the background). No-op if one is already parked alive."""
+        with self._lock:
+            if self._proc is not None and self._proc.poll() is None:
+                return
+            self._serial += 1
+            base = os.path.join(
+                _handshake_dir(),
+                f"standby_{self._node_id}_{os.getpid()}_{self._serial}.json",
+            )
+            self._cleanup_files(base)
+            env = dict(self._base_env or os.environ)
+            env.update({
+                EnvKey.NODE_ID: str(self._node_id),
+                EnvKey.STANDBY_FILE: base,
+            })
+            # stale rank/coordinator vars from the agent's own env must
+            # not leak into the parked child: promotion delivers them
+            for key in (EnvKey.NODE_RANK, EnvKey.NODE_NUM,
+                        EnvKey.COORDINATOR, EnvKey.RESTART_COUNT):
+                env.pop(key, None)
+            try:
+                self._proc = subprocess.Popen(
+                    self._entrypoint, env=env, start_new_session=True
+                )
+            except OSError as e:
+                logger.warning("standby spawn failed: %s", e)
+                self._proc = None
+                return
+            self._payload_path = base
+            self._armed_at = time.monotonic()
+            logger.info("standby trainer armed (pid %d)", self._proc.pid)
+
+    def arm_async(self) -> None:
+        threading.Thread(target=self.arm, name="standby-arm",
+                         daemon=True).start()
+
+    # ----------------------------------------------------------- inspection
+
+    def is_warm(self) -> bool:
+        """Alive AND fully parked (imports done)."""
+        with self._lock:
+            warm = (
+                self._proc is not None and self._proc.poll() is None
+                and os.path.exists(self._payload_path + ".ready")
+            )
+        _warm_gauge.set(1 if warm else 0)
+        return warm
+
+    # ------------------------------------------------------------- failover
+
+    def prepare(self, ckpt_dir: str) -> bool:
+        """Failure detected: tell the parked standby to start its restore
+        prefetch NOW, so the storage read + integrity verification run
+        concurrently with the rendezvous round the agent is about to
+        enter. Safe to call only after the breakpoint persist completed
+        (the prefetch must see the newest storage state)."""
+        with self._lock:
+            if not ckpt_dir or self._proc is None \
+                    or self._proc.poll() is not None:
+                return False
+            try:
+                _atomic_write(self._payload_path + ".prepare",
+                              {"ckpt_dir": ckpt_dir})
+            except OSError as e:
+                logger.warning("standby prepare write failed: %s", e)
+                return False
+        return True
+
+    def promote(self, env_update: dict) -> subprocess.Popen | None:
+        """Hand the rendezvous payload to the parked standby; it becomes
+        the live trainer. Returns None (caller cold-spawns) when no
+        live standby exists."""
+        with self._lock:
+            proc, path = self._proc, self._payload_path
+            if proc is None or proc.poll() is not None:
+                self._proc = None
+                _warm_gauge.set(0)
+                return None
+            warm = os.path.exists(path + ".ready")
+            with get_journal().span(
+                "standby_promote", pid=proc.pid, warm=warm,
+                parked_s=round(time.monotonic() - self._armed_at, 3),
+            ):
+                try:
+                    _atomic_write(path, {"env": env_update})
+                except OSError as e:
+                    logger.warning(
+                        "standby promotion failed (%s); cold spawn", e)
+                    return None
+            _promotions_total.labels("1" if warm else "0").inc()
+            _warm_gauge.set(0)
+            self._proc = None
+            logger.info(
+                "promoted standby pid %d (warm=%s) to live trainer",
+                proc.pid, warm,
+            )
+            return proc
+
+    # ------------------------------------------------------------- teardown
+
+    def discard(self) -> None:
+        """Kill the parked standby (agent shutdown / feature turn-off)."""
+        with self._lock:
+            proc, self._proc = self._proc, None
+            path, self._payload_path = self._payload_path, ""
+        _warm_gauge.set(0)
+        if proc is not None and proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+                proc.wait(timeout=10)
+            except (ProcessLookupError, subprocess.TimeoutExpired):
+                pass
+        if path:
+            self._cleanup_files(path)
+
+    @staticmethod
+    def _cleanup_files(base: str) -> None:
+        for suffix in ("", ".ready", ".prepare"):
+            try:
+                os.remove(base + suffix)
+            except OSError:
+                pass
+
+
+def parked_standby_pids(ipc_dir: str | None = None) -> set[int]:
+    """PIDs of currently-parked standbys on this host (from the
+    ``.ready`` markers, which carry the child's pid and are removed at
+    promotion). Kill-based harnesses (bench fault injection, sigkill
+    e2e tests) use this to aim at the LIVE trainer — a parked standby
+    has the same cmdline, and killing it would silently turn the next
+    recovery cold without testing anything."""
+    d = ipc_dir or _handshake_dir()
+    pids: set[int] = set()
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return pids
+    for name in names:
+        if not (name.startswith("standby_") and name.endswith(".ready")):
+            continue
+        try:
+            with open(os.path.join(d, name), encoding="utf-8") as f:
+                pids.add(int(f.read().strip()))
+        except (OSError, ValueError):
+            continue
+    return pids
+
+
+# -------------------------------------------------------------- child side
+
+
+def park_if_standby() -> dict | None:
+    """Called from ``bootstrap.init_from_env``: if this process was
+    spawned as a standby, publish readiness and block until the agent
+    delivers the promotion payload, then adopt its env vars and return
+    the payload. Returns None in a normally-spawned trainer.
+
+    A ``.prepare`` file observed while parked starts the checkpoint
+    restore prefetch immediately (overlapping the master's rendezvous
+    round); the registered prefetch is later consumed by the
+    ``CheckpointEngine`` the promoted trainer builds.
+    """
+    path = os.environ.pop(EnvKey.STANDBY_FILE, "")
+    if not path:
+        return None
+    try:
+        with open(path + ".ready", "w", encoding="utf-8") as f:
+            f.write(str(os.getpid()))
+    except OSError as e:
+        logger.warning("standby ready marker write failed: %s", e)
+    logger.info("standby trainer parked; waiting for promotion")
+    prefetch_started = False
+    agent_pid = os.getppid()
+    while True:
+        if os.path.exists(path):
+            break
+        if os.getppid() != agent_pid:
+            # the agent died (own-session child: its killpg missed us);
+            # an orphaned standby polling forever would leak one parked
+            # interpreter per hard-killed agent
+            logger.info("standby orphaned (agent gone); exiting")
+            raise SystemExit(0)
+        if not prefetch_started and os.path.exists(path + ".prepare"):
+            prefetch_started = True
+            try:
+                with open(path + ".prepare", encoding="utf-8") as f:
+                    ckpt_dir = json.load(f).get("ckpt_dir", "")
+                if ckpt_dir:
+                    from dlrover_tpu.checkpoint.engine import (
+                        start_restore_prefetch,
+                    )
+
+                    start_restore_prefetch(
+                        ckpt_dir,
+                        node_id=int(os.environ.get(EnvKey.NODE_ID, "0")),
+                    )
+                    logger.info(
+                        "standby: restore prefetch started for %s "
+                        "(overlapping rendezvous)", ckpt_dir,
+                    )
+            except (OSError, ValueError) as e:
+                logger.warning("standby prepare read failed: %s", e)
+        time.sleep(_POLL_S)
+    try:
+        with open(path, encoding="utf-8") as f:
+            payload = json.load(f)
+    except (OSError, ValueError) as e:
+        # a torn payload would strand this process with no rank: die and
+        # let the agent's monitor loop cold-spawn a replacement
+        logger.error("standby payload unreadable: %s", e)
+        raise SystemExit(1)
+    env_update = payload.get("env", {})
+    os.environ.update({k: str(v) for k, v in env_update.items()})
+    for suffix in ("", ".ready", ".prepare"):
+        try:
+            os.remove(path + suffix)
+        except OSError:
+            pass
+    logger.info(
+        "standby promoted: rank %s of %s, coordinator %s",
+        env_update.get(EnvKey.NODE_RANK),
+        env_update.get(EnvKey.NODE_NUM),
+        env_update.get(EnvKey.COORDINATOR),
+    )
+    return payload

@@ -46,12 +46,155 @@ _restore_seconds = registry().histogram(
     "checkpoint restore duration by engine",
     label_names=("engine",),
 )
+_snapshot_seconds = registry().histogram(
+    "dlrover_tpu_ckpt_snapshot_seconds",
+    "in-memory (shm) snapshot duration on the training path — the C "
+    "the Young-Daly interval tuner prices",
+)
 
 
 def _record_restore(engine: str, start_monotonic: float, step: int) -> None:
     dur = time.monotonic() - start_monotonic
     _restore_seconds.labels(engine).observe(dur)
     get_journal().emit("ckpt_restore", dur=dur, step=step, engine=engine)
+
+
+def _read_storage_arrays(storage: CheckpointStorage, ckpt_dir: str,
+                         node_id: int, step: int | None = None
+                         ) -> tuple[int, dict[str, np.ndarray]] | None:
+    """CRC-verified storage read: resolve the newest VERIFIED step (or a
+    pinned one) and materialize its arrays. Pure function of storage
+    state so it can run on the restore-prefetch thread concurrently
+    with rendezvous/compile as well as inline."""
+    from dlrover_tpu.agent.ckpt_saver import step_dir
+    from dlrover_tpu.checkpoint.integrity import resolve_restore_step
+
+    if step is None:
+        # newest VERIFIED step: crc-checked against the COMMIT
+        # manifest, rolling back past corrupt/incomplete steps —
+        # a flipped bit must cost a checkpoint interval, never a
+        # silent restore of bad bytes. An explicitly pinned `step`
+        # (best-model reload) bypasses this by caller contract.
+        committed = resolve_restore_step(storage, ckpt_dir)
+        if committed is None:
+            return None
+        step, _ = committed
+    sdir = step_dir(ckpt_dir, step)
+    # replicated ckpt: one node file holds everything; prefer our own,
+    # else the smallest node id present.
+    metas = [
+        f for f in storage.listdir(sdir) if f.endswith(".meta.json")
+    ]
+    if not metas:
+        return None
+    own = f"node_{node_id}.meta.json"
+    meta_file = own if own in metas else sorted(metas)[0]
+    header = json.loads(
+        storage.read_text(os.path.join(sdir, meta_file))
+    )
+    if meta_file != own and not header.get("replicated", True):
+        # Sharded checkpoint: another node's file holds a different
+        # shard — loading it would silently install wrong weights.
+        raise FileNotFoundError(
+            f"sharded checkpoint at {sdir} is missing this node's "
+            f"shard {own}; refusing to load another node's shard"
+        )
+    bin_file = meta_file.replace(".meta.json", ".bin")
+    blob = storage.read(os.path.join(sdir, bin_file))
+    arrays: dict[str, np.ndarray] = {}
+    for name, info in header["metas"].items():
+        arr = np.frombuffer(
+            blob, dtype=np.dtype(info["dtype"]),
+            count=max(1, int(np.prod(info["shape"] or [1]))),
+            offset=info["offset"],
+        ).reshape(info["shape"])
+        arrays[name] = arr
+    logger.info("restored step %d from storage %s", step, sdir)
+    return step, arrays
+
+
+class RestorePrefetch:
+    """Background storage restore: the read + integrity verification run
+    on a daemon thread while the process is busy with rendezvous,
+    ``jax.distributed.initialize`` or the first compile; ``join`` hands
+    the verified arrays over before the first step needs them.
+
+    Failure ordering is safe by construction: the thread runs the same
+    ``resolve_restore_step`` rollback logic as the inline path, a
+    raised error or timeout makes ``join`` return None (callers fall
+    back to the synchronous read), and a consumer that pins a different
+    step than the prefetch resolved discards the prefetched result.
+    """
+
+    def __init__(self, ckpt_dir: str, node_id: int,
+                 storage: CheckpointStorage | None = None):
+        self.ckpt_dir = ckpt_dir
+        self.node_id = node_id
+        self.storage = storage or PosixDiskStorage()
+        self._result: tuple[int, dict[str, np.ndarray]] | None = None
+        self._error: BaseException | None = None
+        self._done = threading.Event()
+        self._started = time.monotonic()
+        threading.Thread(
+            target=self._run, name="restore-prefetch", daemon=True
+        ).start()
+
+    def _run(self) -> None:
+        try:
+            self._result = _read_storage_arrays(
+                self.storage, self.ckpt_dir, self.node_id
+            )
+        except BaseException as e:  # noqa: BLE001 - reported via join()
+            logger.warning("restore prefetch failed: %s", e)
+            self._error = e
+        finally:
+            dur = time.monotonic() - self._started
+            self._done.set()
+            get_journal().emit(
+                "restore_prefetch", dur=dur,
+                step=self._result[0] if self._result else -1,
+                ok=self._error is None,
+            )
+
+    def join(self, timeout: float = 120.0
+             ) -> tuple[int, dict[str, np.ndarray]] | None:
+        """The verified (step, arrays), or None on no-checkpoint /
+        error / timeout — None always means 'do the synchronous read'."""
+        if not self._done.wait(timeout):
+            logger.warning("restore prefetch still running after %.0fs; "
+                           "falling back to the synchronous read", timeout)
+            return None
+        if self._error is not None:
+            return None
+        return self._result
+
+
+_prefetch_lock = threading.Lock()
+_prefetches: dict[tuple[str, int], RestorePrefetch] = {}
+
+
+def start_restore_prefetch(ckpt_dir: str, node_id: int | None = None,
+                           storage: CheckpointStorage | None = None
+                           ) -> RestorePrefetch:
+    """Begin the storage restore read + verification NOW (idempotent per
+    (ckpt_dir, node)); the next ``CheckpointEngine`` load for the same
+    checkpoint consumes it. Called by a parked standby trainer when the
+    agent signals an imminent promotion (overlap with the rendezvous
+    round) and by trainer mains before distributed init / compile."""
+    nid = (node_id if node_id is not None
+           else int(os.environ.get(EnvKey.NODE_ID, "0")))
+    key = (os.path.abspath(ckpt_dir), nid)
+    with _prefetch_lock:
+        pf = _prefetches.get(key)
+        if pf is None:
+            pf = _prefetches[key] = RestorePrefetch(ckpt_dir, nid, storage)
+        return pf
+
+
+def take_restore_prefetch(ckpt_dir: str, node_id: int
+                          ) -> RestorePrefetch | None:
+    with _prefetch_lock:
+        return _prefetches.pop((os.path.abspath(ckpt_dir), node_id), None)
 
 
 class CheckpointEngine:
@@ -219,9 +362,11 @@ class CheckpointEngine:
             )
             # a direct save supersedes any earlier failed COW verdict
             self._cow_ok = None
+            snap_s = time.monotonic() - start
+            # the training-path cost the Young-Daly tuner prices (C)
+            _snapshot_seconds.observe(snap_s)
             logger.info(
-                "step %d snapshotted to shm in %.3fs",
-                step, time.monotonic() - start,
+                "step %d snapshotted to shm in %.3fs", step, snap_s,
             )
             return True
         finally:
@@ -407,6 +552,10 @@ class CheckpointEngine:
             loaded = None
         if loaded is None:
             loaded = self._load_from_storage(step=step)
+        else:
+            # shm fast path won: release any overlapped storage prefetch
+            # so its arrays don't linger for the process lifetime
+            take_restore_prefetch(self.ckpt_dir, self.node_id)
         if loaded is None:
             return None
         step, arrays = loaded
@@ -453,51 +602,22 @@ class CheckpointEngine:
 
     def _load_from_storage(self, step: int | None = None
                            ) -> tuple[int, dict[str, np.ndarray]] | None:
-        from dlrover_tpu.agent.ckpt_saver import step_dir
-        from dlrover_tpu.checkpoint.integrity import resolve_restore_step
-
-        if step is None:
-            # newest VERIFIED step: crc-checked against the COMMIT
-            # manifest, rolling back past corrupt/incomplete steps —
-            # a flipped bit must cost a checkpoint interval, never a
-            # silent restore of bad bytes. An explicitly pinned `step`
-            # (best-model reload) bypasses this by caller contract.
-            committed = resolve_restore_step(self.storage, self.ckpt_dir)
-            if committed is None:
-                return None
-            step, _ = committed
-        sdir = step_dir(self.ckpt_dir, step)
-        # replicated ckpt: one node file holds everything; prefer our own,
-        # else the smallest node id present.
-        metas = [
-            f for f in self.storage.listdir(sdir) if f.endswith(".meta.json")
-        ]
-        if not metas:
-            return None
-        own = f"node_{self.node_id}.meta.json"
-        meta_file = own if own in metas else sorted(metas)[0]
-        header = json.loads(
-            self.storage.read_text(os.path.join(sdir, meta_file))
+        prefetch = take_restore_prefetch(self.ckpt_dir, self.node_id)
+        if prefetch is not None:
+            got = prefetch.join()
+            if got is not None and (step is None or got[0] == step):
+                logger.info(
+                    "restored step %d from the overlapped prefetch", got[0]
+                )
+                return got
+            # the prefetch lost its race (errored, resolved a different
+            # step than the pinned one, or a later failure changed the
+            # storage state it read): fall through to a fresh
+            # synchronous read, which re-runs the rollback logic
+            logger.info("restore prefetch discarded; reading storage")
+        return _read_storage_arrays(
+            self.storage, self.ckpt_dir, self.node_id, step=step
         )
-        if meta_file != own and not header.get("replicated", True):
-            # Sharded checkpoint: another node's file holds a different
-            # shard — loading it would silently install wrong weights.
-            raise FileNotFoundError(
-                f"sharded checkpoint at {sdir} is missing this node's "
-                f"shard {own}; refusing to load another node's shard"
-            )
-        bin_file = meta_file.replace(".meta.json", ".bin")
-        blob = self.storage.read(os.path.join(sdir, bin_file))
-        arrays: dict[str, np.ndarray] = {}
-        for name, info in header["metas"].items():
-            arr = np.frombuffer(
-                blob, dtype=np.dtype(info["dtype"]),
-                count=max(1, int(np.prod(info["shape"] or [1]))),
-                offset=info["offset"],
-            ).reshape(info["shape"])
-            arrays[name] = arr
-        logger.info("restored step %d from storage %s", step, sdir)
-        return step, arrays
 
     def latest_persisted_step(self) -> int:
         from dlrover_tpu.agent.ckpt_saver import read_tracker

@@ -1,0 +1,206 @@
+"""Young–Daly adaptive snapshot cadence.
+
+The optimal interval between checkpoints that minimizes expected lost
+work is the Young/Daly first-order optimum ``T* = sqrt(2 · C · MTBF)``
+(C = cost of one checkpoint, MTBF = mean time between failures). A
+fixed cadence is tuned for exactly one failure rate: at DLRover's
+stressed bench rates (10+ failures/hr) a fixed interval of ~90 steps
+redoes ~40% more steps per failure than the optimum, and at calm rates
+it pays superfluous snapshot overhead.
+
+``IntervalTuner`` closes the loop from telemetry the system already
+records: the master feeds it failure reports (MTBF), the trainer-pushed
+``dlrover_tpu_ckpt_snapshot_seconds`` histogram (C) and
+``dlrover_tpu_train_step_seconds`` (to convert T* from seconds to the
+step units trainers snapshot on). The recommendation is clamped to
+``[min_steps, max_steps]``, moves at most ``max_move_factor``× per
+retune, and is hysteretic (ignores moves smaller than ``hysteresis``
+of the current value) so the cadence drifts deliberately instead of
+chasing noise. Every applied retune journals a
+``snapshot_interval_retune`` event carrying its full evidence.
+
+Wiring: the master servicer owns one tuner when
+``DLROVER_TPU_SNAPSHOT_INTERVAL=auto`` and pushes applied retunes to
+trainers through the existing paral-config channel
+(``ParalConfig.snapshot_interval``; agent mirrors the file, trainer
+hot-reloads — no restart, the cadence is not compile-baked).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.metrics import registry
+
+logger = get_logger(__name__)
+
+_interval_gauge = registry().gauge(
+    "dlrover_tpu_snapshot_interval_steps",
+    "current Young-Daly-tuned shm snapshot interval (steps); 0 until "
+    "the first retune",
+)
+_retunes_total = registry().counter(
+    "dlrover_tpu_snapshot_interval_retunes_total",
+    "applied snapshot-interval retunes",
+)
+
+STEP_METRIC = "dlrover_tpu_train_step_seconds"
+SNAPSHOT_METRIC = "dlrover_tpu_ckpt_snapshot_seconds"
+
+
+def _histogram_mean(samples: list, name: str) -> float | None:
+    """Mean of a histogram in a pushed registry snapshot (wire shape of
+    ``MetricsRegistry.snapshot()``), or None when absent/empty."""
+    for metric in samples:
+        if not isinstance(metric, dict) or metric.get("name") != name:
+            continue
+        total = 0.0
+        count = 0
+        for sample in metric.get("samples", ()):
+            total += float(sample.get("sum", 0.0))
+            count += int(sample.get("count", 0))
+        if count > 0:
+            return total / count
+        return None
+    return None
+
+
+class IntervalTuner:
+    """Pure state machine: observations in, clamped/hysteretic interval
+    out. Thread-safe; a fake ``clock`` makes it unit-testable."""
+
+    def __init__(
+        self,
+        initial_steps: int = 0,
+        min_steps: int = 1,
+        max_steps: int = 1000,
+        hysteresis: float = 0.25,
+        max_move_factor: float = 2.0,
+        min_failures: int = 2,
+        window_s: float = 3600.0,
+        ewma: float = 0.3,
+        clock=time.monotonic,
+    ):
+        self.min_steps = max(1, min_steps)
+        self.max_steps = max(self.min_steps, max_steps)
+        self.hysteresis = hysteresis
+        self.max_move_factor = max(1.0, max_move_factor)
+        self.min_failures = max(1, min_failures)
+        self.window_s = window_s
+        self._ewma = ewma
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._failures: deque[float] = deque(maxlen=256)
+        self._snap_cost_s: float | None = None
+        self._step_s: float | None = None
+        self._current = int(initial_steps)
+        self._retunes = 0
+
+    # -------------------------------------------------------- observations
+
+    def observe_failure(self, t: float | None = None) -> None:
+        with self._lock:
+            self._failures.append(self._clock() if t is None else t)
+
+    def observe_snapshot_cost(self, cost_s: float) -> None:
+        if cost_s <= 0:
+            return
+        with self._lock:
+            self._snap_cost_s = self._blend(self._snap_cost_s, cost_s)
+
+    def observe_step_time(self, step_s: float) -> None:
+        if step_s <= 0:
+            return
+        with self._lock:
+            self._step_s = self._blend(self._step_s, step_s)
+
+    def observe_metrics_snapshot(self, samples: list) -> None:
+        """Convenience feed from a trainer's pushed registry snapshot."""
+        step = _histogram_mean(samples, STEP_METRIC)
+        if step is not None:
+            self.observe_step_time(step)
+        snap = _histogram_mean(samples, SNAPSHOT_METRIC)
+        if snap is not None:
+            self.observe_snapshot_cost(snap)
+
+    def _blend(self, old: float | None, new: float) -> float:
+        return new if old is None else (1 - self._ewma) * old \
+            + self._ewma * new
+
+    # ------------------------------------------------------------- tuning
+
+    @property
+    def current_steps(self) -> int:
+        with self._lock:
+            return self._current
+
+    def mtbf_s(self, now: float | None = None) -> float | None:
+        """Windowed MTBF estimate; None below ``min_failures``."""
+        with self._lock:
+            return self._mtbf_locked(self._clock() if now is None else now)
+
+    def _mtbf_locked(self, now: float) -> float | None:
+        while self._failures and now - self._failures[0] > self.window_s:
+            self._failures.popleft()
+        n = len(self._failures)
+        if n < self.min_failures:
+            return None
+        # n failures over the span since the oldest one — the span is
+        # open-ended at `now` so a quiet period after the last failure
+        # properly stretches the estimate
+        span = max(now - self._failures[0], 1e-6)
+        return span / n
+
+    def recommend(self, now: float | None = None) -> int | None:
+        """Unclamped-by-current Young-Daly recommendation in steps, or
+        None while any of (MTBF, snapshot cost, step time) is unknown."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            mtbf = self._mtbf_locked(now)
+            if mtbf is None or not self._snap_cost_s or not self._step_s:
+                return None
+            t_opt_s = math.sqrt(2.0 * self._snap_cost_s * mtbf)
+            steps = int(round(t_opt_s / self._step_s))
+            return max(self.min_steps, min(self.max_steps, steps))
+
+    def maybe_retune(self, now: float | None = None) -> int | None:
+        """Apply hysteresis + move clamping; returns the NEW interval
+        when it changed (journaled with evidence), else None."""
+        now = self._clock() if now is None else now
+        rec = self.recommend(now)
+        if rec is None:
+            return None
+        with self._lock:
+            current = self._current
+            if current > 0:
+                if abs(rec - current) < self.hysteresis * current:
+                    return None
+                # move slowly: one retune can at most double/halve
+                lo = max(self.min_steps,
+                         int(math.floor(current / self.max_move_factor)))
+                hi = min(self.max_steps,
+                         int(math.ceil(current * self.max_move_factor)))
+                rec = max(lo, min(hi, rec))
+                if rec == current:
+                    return None
+            self._current = rec
+            self._retunes += 1
+            mtbf = self._mtbf_locked(now)
+            evidence = {
+                "old_steps": current,
+                "new_steps": rec,
+                "mtbf_s": round(mtbf, 3) if mtbf else None,
+                "snapshot_cost_s": round(self._snap_cost_s, 5),
+                "step_s": round(self._step_s, 5),
+                "failures_in_window": len(self._failures),
+            }
+        _interval_gauge.set(rec)
+        _retunes_total.inc()
+        get_journal().emit("snapshot_interval_retune", **evidence)
+        logger.info("snapshot interval retuned: %s", evidence)
+        return rec

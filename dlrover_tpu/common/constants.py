@@ -143,6 +143,15 @@ class EnvKey:
     # or inline JSON). Unset = injection compiled out to one boolean
     # check at every point (read once, at chaos package import).
     CHAOS = "DLROVER_TPU_CHAOS"
+    # warm recovery (agent/standby.py): "0" disables the pre-spawned
+    # standby trainer the agent promotes on worker death; STANDBY_FILE
+    # is the internal handshake path the agent hands a standby child
+    STANDBY = "DLROVER_TPU_STANDBY"
+    STANDBY_FILE = "DLROVER_TPU_STANDBY_FILE"
+    # "auto" lets the master's Young-Daly tuner
+    # (checkpoint/interval_tuner.py) drive the shm snapshot cadence via
+    # the paral-config push; unset/other keeps the trainer's CLI value
+    SNAPSHOT_INTERVAL = "DLROVER_TPU_SNAPSHOT_INTERVAL"
 
 
 class Defaults:

@@ -542,6 +542,10 @@ class ParalConfig:
     dataloader_version: int = 0
     grad_accum_steps: int = 0
     prefetch_batches: int = 0
+    # Young-Daly tuned shm snapshot cadence (checkpoint/interval_tuner);
+    # 0 = no suggestion, trainer keeps its CLI value. Hot-applied — the
+    # cadence is not baked into the compiled program.
+    snapshot_interval: int = 0
     # knobs that require a recompile take effect at the next incarnation;
     # this flag asks the agent to restart workers to apply them
     restart_required: bool = False

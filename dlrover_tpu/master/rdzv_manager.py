@@ -39,6 +39,12 @@ _waiting_nodes = registry().gauge(
     "nodes currently waiting in the rendezvous",
     label_names=("name",),
 )
+_fast_readmits = registry().counter(
+    "dlrover_tpu_rdzv_fast_readmit_total",
+    "rendezvous rounds completed via the unchanged-membership fast "
+    "path (no waiting_timeout backoff)",
+    label_names=("name",),
+)
 
 
 @dataclasses.dataclass
@@ -82,6 +88,12 @@ class RendezvousManager:
         self._latest: CommWorld | None = None
         self._round = 0
         self._first_join_time = 0.0
+        # node set of the last COMPLETED round — survives the round's
+        # invalidation by a rejoin, so a restart-in-place with unchanged
+        # membership can be re-admitted immediately instead of sitting
+        # out the waiting_timeout backoff. Cleared whenever a node is
+        # REMOVED (dead/scaled away): that is a true membership change.
+        self._prev_world: frozenset[int] | None = None
 
     def update_node_bounds(self, min_nodes: int, max_nodes: int) -> None:
         with self._lock:
@@ -119,6 +131,10 @@ class RendezvousManager:
     def remove_node(self, node_id: int) -> None:
         with self._lock:
             self._waiting.pop(node_id, None)
+            if self._prev_world and node_id in self._prev_world:
+                # a genuinely departed member disqualifies the
+                # unchanged-membership fast path until the next full round
+                self._prev_world = None
             if self._latest and node_id in self._latest.world:
                 logger.info(
                     "rdzv %s: node %s removed from completed round", self.name,
@@ -147,7 +163,16 @@ class RendezvousManager:
         timed_out = (
             time.time() - self._first_join_time >= self._waiting_timeout
         )
-        if n < self._max_nodes and not timed_out:
+        # warm-recovery fast path: restart-in-place re-joins with the
+        # exact node set of the previous completed round. Nothing new
+        # can arrive that wasn't there before the failure — waiting out
+        # the backoff would only stretch every recovery by up to
+        # waiting_timeout. Re-admit immediately.
+        fast = (
+            self._prev_world is not None
+            and frozenset(self._waiting) == self._prev_world
+        )
+        if n < self._max_nodes and not timed_out and not fast:
             return
         usable = min(n, self._max_nodes)
         usable -= usable % self._node_unit
@@ -169,19 +194,23 @@ class RendezvousManager:
         )
         for w in nodes:
             self._waiting.pop(w.node_id, None)
+        self._prev_world = frozenset(world)
         logger.info(
-            "rdzv %s: round %d completed with %d nodes, coordinator %s",
-            self.name, self._round, len(world), coordinator,
+            "rdzv %s: round %d completed with %d nodes%s, coordinator %s",
+            self.name, self._round, len(world),
+            " (fast re-admit)" if fast else "", coordinator,
         )
         round_s = max(0.0, time.time() - self._first_join_time)
         _round_seconds.labels(self.name).observe(round_s)
         _rounds_total.labels(self.name).inc()
+        if fast:
+            _fast_readmits.labels(self.name).inc()
         _waiting_nodes.labels(self.name).set(len(self._waiting))
         # one completed-interval line (begin time is derivable from dur):
         # the job-level stall the lost-time report charges to rendezvous
         get_journal().emit(
             "rdzv_round", dur=round_s, rdzv=self.name, round=self._round,
-            nodes=len(world),
+            nodes=len(world), fast=fast,
         )
 
     def get_comm_world(self, node_id: int) -> CommWorld | None:

@@ -7,12 +7,13 @@ isinstance; same shape here over the typed serde messages.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any
 
 from dlrover_tpu.common import messages as m
-from dlrover_tpu.common.constants import NodeExitReason, NodeStatus
+from dlrover_tpu.common.constants import EnvKey, NodeExitReason, NodeStatus
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.master.diagnosis import DiagnosisManager
 from dlrover_tpu.master.kv_store import KVStoreService
@@ -56,6 +57,16 @@ class MasterServicer:
         )
         self._paral_config = m.ParalConfig()
         self._paral_lock = threading.Lock()
+        # Young-Daly snapshot-cadence tuner (checkpoint/interval_tuner):
+        # only armed when the operator opts in with
+        # DLROVER_TPU_SNAPSHOT_INTERVAL=auto; fed below by FailureReport
+        # (MTBF) and trainer MetricsSnapshotRequest pushes (snapshot
+        # cost + step time), applied through the paral-config channel
+        self._interval_tuner = None
+        if os.environ.get(EnvKey.SNAPSHOT_INTERVAL, "").lower() == "auto":
+            from dlrover_tpu.checkpoint.interval_tuner import IntervalTuner
+
+            self._interval_tuner = IntervalTuner()
         self._oom_bump_threshold = 0
         self._last_oom_bump = 0.0
         self.oom_bump_cooldown_s = 30.0
@@ -153,6 +164,9 @@ class MasterServicer:
             )
             if "(oom)" in msg.error_data:
                 self._suggest_higher_accum(msg.restart_count)
+            if self._interval_tuner is not None:
+                self._interval_tuner.observe_failure()
+                self._maybe_retune_snapshot_interval()
             return m.OkResponse()
         if isinstance(msg, m.ResourceStats):
             # partial-update semantics: the agent reports host cpu/mem, the
@@ -181,6 +195,11 @@ class MasterServicer:
                 # the straggler detector mines the step-duration series
                 # out of the same push (no-op for snapshots without it)
                 self._anomaly.observe_snapshot(msg.node_id, msg.samples)
+            if self._interval_tuner is not None and msg.role == "trainer":
+                # same push carries the snapshot-cost and step-time
+                # histograms the Young-Daly optimum needs
+                self._interval_tuner.observe_metrics_snapshot(msg.samples)
+                self._maybe_retune_snapshot_interval()
             return m.OkResponse()
         if isinstance(msg, m.DebugBundleReport):
             if not msg.timestamp:
@@ -310,6 +329,26 @@ class MasterServicer:
             found=True, buddy_node_id=nxt,
             addr=self._buddy_endpoints[nxt],
         )
+
+    def _maybe_retune_snapshot_interval(self) -> None:
+        """Push an applied Young-Daly retune to trainers through the
+        paral-config channel (agent mirrors the file; the trainer
+        hot-reloads — no restart, cadence is not compile-baked)."""
+        import dataclasses as _dc
+
+        new = self._interval_tuner.maybe_retune()
+        if new is None:
+            return
+        with self._paral_lock:
+            self._paral_config = _dc.replace(
+                self._paral_config,
+                snapshot_interval=new,
+                version=self._paral_config.version + 1,
+            )
+            logger.info(
+                "snapshot interval retuned to %d steps (paral config v%d)",
+                new, self._paral_config.version,
+            )
 
     def _suggest_higher_accum(self, restart_count: int) -> None:
         """Device-OOM mitigation: double gradient accumulation (smaller

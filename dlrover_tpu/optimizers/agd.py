@@ -62,10 +62,12 @@ def agd(
             lambda g, pg: jnp.where(is_first, g, g - pg),
             updates, state.prev_grad,
         )
-        mu = optax.tree.update_moment(updates, state.mu, b1, 1)
-        bu = optax.tree.update_moment_per_elem_norm(diff, state.bu, b2, 2)
-        mu_hat = optax.tree.bias_correction(mu, b1, count)
-        bu_hat = optax.tree.bias_correction(bu, b2, count)
+        # optax 0.2.x exposes these under tree_utils (optax.tree.* is 0.2.4+)
+        tu = optax.tree_utils
+        mu = tu.tree_update_moment(updates, state.mu, b1, 1)
+        bu = tu.tree_update_moment_per_elem_norm(diff, state.bu, b2, 2)
+        mu_hat = tu.tree_bias_correction(mu, b1, count)
+        bu_hat = tu.tree_bias_correction(bu, b2, count)
         # auto-switch: max(sqrt(bu_hat), delta) — small curvature
         # estimates degrade to momentum / delta (SGD regime)
         scaled = jax.tree.map(

@@ -70,6 +70,9 @@ def dry_run(
     )
     try:
         cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            # newer jax returns one dict per executable module
+            cost = cost[0] if cost else None
         if cost:
             report.flops = float(cost.get("flops", 0.0))
             report.bytes_accessed = float(cost.get("bytes accessed", 0.0))

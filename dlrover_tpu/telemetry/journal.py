@@ -19,10 +19,11 @@ as open until the journal's last event.
 Span taxonomy (names are load-bearing for ``telemetry/report.py`` and
 ``telemetry/timeline.py``; ``native/check_metric_names.py`` lints that
 every name is documented in DESIGN.md): ``rdzv_round`` / ``job_start`` /
-``job_end`` / ``straggler_verdict`` (master), ``rendezvous_wait`` /
-``node_restart`` / ``ckpt_persist`` / ``hang_verdict`` /
-``debug_bundle`` (agent), ``compile`` / ``train_step`` /
-``ckpt_restore`` (trainer), ``gateway_*`` (serving gateway).
+``job_end`` / ``straggler_verdict`` / ``snapshot_interval_retune``
+(master), ``rendezvous_wait`` / ``node_restart`` / ``ckpt_persist`` /
+``hang_verdict`` / ``debug_bundle`` / ``standby_promote`` (agent),
+``compile`` / ``train_step`` / ``ckpt_restore`` / ``restore_prefetch``
+(trainer), ``gateway_*`` (serving gateway).
 
 Rotation: when ``DLROVER_TPU_JOURNAL_MAX_MB`` is set, a file that
 reaches the cap is atomically renamed to ``.1`` (replacing the previous

@@ -4,13 +4,21 @@
 [--goodput-log <jsonl>]`` prints where the wall-clock went: the total
 lost time comes from ``utils/goodput.py``'s accounting (total −
 productive over the warm window), and the journal's spans attribute it
-by cause — rendezvous vs respawn vs recompile vs restore vs rollback —
-with the remainder reported as unattributed.
+by cause — respawn vs rendezvous vs restore vs recompile vs redone —
+with the remainder reported as unattributed. The category names are
+ONE vocabulary with the bench's per-failure phase breakdown
+(``bench.py`` emits ``goodput_*_{respawn,rendezvous,restore,recompile,
+redone}_s`` from the same journal), so the offline report and the
+bench artifact always agree on what a phase is called.
 
 Attribution is interval-union based: per category, the spans from every
 process are merged into disjoint intervals and clipped to the goodput
 warm window, so two agents re-rendezvousing concurrently count the
-stall once, the way the job experienced it.
+stall once, the way the job experienced it. Beyond the job-wide
+totals, the report attributes the same phases **per incarnation**
+(windows between ``node_restart`` spans, keyed by their journaled
+incarnation number), so a single slow recovery is visible instead of
+averaged away.
 """
 
 from __future__ import annotations
@@ -24,7 +32,10 @@ from typing import Iterable, Optional
 
 from dlrover_tpu.utils.goodput import GoodputReport, compute_goodput
 
-# span name -> lost-time category (journal.py documents the taxonomy)
+# span name -> lost-time category (journal.py documents the taxonomy).
+# restore_prefetch is deliberately absent: an overlapped prefetch runs
+# concurrently with rendezvous/compile, OFF the critical path — charging
+# it as lost time would double-count the phases it hides behind.
 CATEGORY_OF = {
     "rdzv_round": "rendezvous",
     "rendezvous_wait": "rendezvous",
@@ -32,7 +43,8 @@ CATEGORY_OF = {
     "compile": "recompile",
     "ckpt_restore": "restore",
 }
-CATEGORIES = ("rendezvous", "respawn", "recompile", "restore", "rollback")
+# one vocabulary with bench.py's per-failure phase breakdown
+CATEGORIES = ("respawn", "rendezvous", "restore", "recompile", "redone")
 
 
 def load_events(path: str) -> list[dict]:
@@ -152,6 +164,11 @@ class LostTimeReport:
     n_spans: int
     traces: list[str]
     goodput_report: Optional[GoodputReport] = None
+    # per-incarnation rows, bench's phase vocabulary:
+    # {"incarnation": k, "respawn_s": ..., "rendezvous_s": ...,
+    #  "restore_s": ..., "recompile_s": ..., "redone_steps": ...,
+    #  "redone_s": ...}
+    incarnations: list[dict] = dataclasses.field(default_factory=list)
 
     def to_dict(self) -> dict:
         d = {
@@ -164,6 +181,7 @@ class LostTimeReport:
             "unattributed_s": round(self.unattributed_s, 4),
             "n_spans": self.n_spans,
             "traces": self.traces,
+            "incarnations": self.incarnations,
         }
         if self.goodput_report is not None:
             d["goodput_report"] = self.goodput_report.to_dict()
@@ -209,9 +227,9 @@ def build_report(journal_path: str, goodput_log: str | None = None,
 
     categories = {
         cat: _union_seconds(by_cat.get(cat, ()), window)
-        for cat in CATEGORIES if cat != "rollback"
+        for cat in CATEGORIES if cat != "redone"
     }
-    categories["rollback"] = (
+    categories["redone"] = (
         greport.redone_steps * median if greport is not None else 0.0
     )
 
@@ -227,7 +245,7 @@ def build_report(journal_path: str, goodput_log: str | None = None,
 
     attributed = _union_seconds(
         [iv for ivs in by_cat.values() for iv in ivs], window
-    ) + categories["rollback"]
+    ) + categories["redone"]
     return LostTimeReport(
         total_s=total,
         productive_s=productive,
@@ -238,7 +256,94 @@ def build_report(journal_path: str, goodput_log: str | None = None,
         n_spans=len(spans),
         traces=traces,
         goodput_report=greport,
+        incarnations=_per_incarnation(
+            spans, window, median,
+            goodput_log if greport is not None else None,
+        ),
     )
+
+
+def _redone_by_incarnation(goodput_log: str) -> dict[int, int]:
+    """Steps re-run per incarnation: an incarnation whose first step is
+    at or below the previous incarnations' high-water mark is redoing
+    rolled-back work until it passes it."""
+    from dlrover_tpu.utils.goodput import _parse_events
+
+    redone: dict[int, int] = {}
+    cur_inc = 0
+    max_step = 0
+    first_step_pending = False
+    for ev in _parse_events(goodput_log):
+        kind = ev.get("ev")
+        if kind == "start":
+            cur_inc = int(ev.get("restart", 0) or 0)
+            first_step_pending = True
+        elif kind == "step":
+            step = int(ev.get("step", 0) or 0)
+            if first_step_pending:
+                first_step_pending = False
+                if max_step and step <= max_step:
+                    redone[cur_inc] = (
+                        redone.get(cur_inc, 0) + max_step - step + 1
+                    )
+            max_step = max(max_step, step)
+    return redone
+
+
+def _per_incarnation(spans: list[Span],
+                     window: tuple[float, float] | None,
+                     median: float,
+                     goodput_log: str | None) -> list[dict]:
+    """Attribute each phase to the incarnation it recovered INTO.
+
+    Incarnation windows come from ``node_restart`` spans (each carries
+    the incarnation it is bringing up); spans are binned by start time,
+    so one slow rendezvous or restore is pinned to the incarnation that
+    suffered it rather than averaged over the job.
+    """
+    restarts = sorted(
+        (s for s in spans if s.name == "node_restart"),
+        key=lambda s: s.start,
+    )
+    # (incarnation, window_start): incarnation 0 runs from the beginning
+    bounds: list[tuple[int, float]] = [(0, float("-inf"))]
+    for s in restarts:
+        try:
+            inc = int(s.fields.get("incarnation", bounds[-1][0] + 1))
+        except (TypeError, ValueError):
+            inc = bounds[-1][0] + 1
+        if inc == bounds[-1][0]:
+            continue  # another node's restart for the same incarnation
+        bounds.append((inc, s.start))
+    per_inc: dict[int, dict[str, list[tuple[float, float]]]] = {}
+    for span in spans:
+        cat = CATEGORY_OF.get(span.name)
+        if cat is None:
+            continue
+        inc = bounds[0][0]
+        for b_inc, b_start in bounds:
+            if span.start >= b_start:
+                inc = b_inc
+            else:
+                break
+        start, end = span.start, span.end
+        if cat == "recompile" and median > 0:
+            end = max(start, end - median)
+        per_inc.setdefault(inc, {}).setdefault(cat, []).append((start, end))
+    redone = _redone_by_incarnation(goodput_log) if goodput_log else {}
+    rows = []
+    for inc in sorted(set(per_inc) | set(redone)):
+        row: dict = {"incarnation": inc}
+        for cat in CATEGORIES:
+            if cat == "redone":
+                continue
+            row[f"{cat}_s"] = round(_union_seconds(
+                per_inc.get(inc, {}).get(cat, ()), window
+            ), 4)
+        row["redone_steps"] = redone.get(inc, 0)
+        row["redone_s"] = round(redone.get(inc, 0) * median, 4)
+        rows.append(row)
+    return rows
 
 
 def format_report(report: LostTimeReport) -> str:
@@ -256,6 +361,19 @@ def format_report(report: LostTimeReport) -> str:
         )
     lines.append(f"    {'unattributed':<14}  : "
                  f"{report.unattributed_s:10.2f} s")
+    if report.incarnations:
+        lines.append("  per incarnation (same phase names as bench):")
+        lines.append("    inc   respawn  rendezvous   restore  recompile"
+                     "    redone")
+        for row in report.incarnations:
+            lines.append(
+                f"    {row['incarnation']:>3}"
+                f"  {row.get('respawn_s', 0.0):8.2f}"
+                f"  {row.get('rendezvous_s', 0.0):10.2f}"
+                f"  {row.get('restore_s', 0.0):8.2f}"
+                f"  {row.get('recompile_s', 0.0):9.2f}"
+                f"  {row.get('redone_s', 0.0):8.2f}"
+            )
     return "\n".join(lines)
 
 

@@ -158,6 +158,17 @@ def init_from_env(initialize_distributed: bool = True) -> RunContext:
         from dlrover_tpu.telemetry.bundle import arm_child_dump
 
         arm_child_dump()
+    if os.environ.get(EnvKey.STANDBY_FILE):
+        # warm-standby trainer (agent/standby.py): everything above —
+        # interpreter + import graph, platform config, compile cache,
+        # flight recorder — is pre-paid; park here until the agent
+        # promotes this process with the rendezvous payload. The
+        # accelerator backend and jax.distributed.initialize must wait
+        # for promotion (chips are exclusive to the live trainer, and
+        # the coordinator address only exists after rendezvous).
+        from dlrover_tpu.agent.standby import park_if_standby
+
+        park_if_standby()
     ctx = RunContext(
         job_name=os.environ.get(EnvKey.JOB_NAME, "local"),
         node_id=int(os.environ.get(EnvKey.NODE_ID, "0")),

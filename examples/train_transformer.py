@@ -109,6 +109,20 @@ def main(argv=None) -> int:
 
     import dataclasses
 
+    if not args.sharded_ckpt \
+            and not os.environ.get("DLROVER_TPU_STANDBY_FILE"):
+        # overlapped restore: kick off the storage read + integrity
+        # verification NOW, so it runs concurrently with the
+        # distributed/coordination bring-up inside init_from_env and the
+        # XLA compile below; engine.load() joins it before the first
+        # step. A STANDBY must not prefetch here — it is parked long
+        # before the failure, so this read would see pre-failure state;
+        # its prefetch starts from the agent's post-persist `.prepare`
+        # signal instead (agent/standby.py), which is always fresh.
+        from dlrover_tpu.checkpoint.engine import start_restore_prefetch
+
+        start_restore_prefetch(args.ckpt_dir)
+
     ctx = bootstrap.init_from_env()
     cfg = tfm.CONFIGS[args.model]
     if args.attention:
@@ -283,8 +297,15 @@ def main(argv=None) -> int:
 
     on_cpu = jax.devices()[0].platform == "cpu"
 
+    def mem_interval() -> int:
+        # Young-Daly tuned cadence from the master (paral-config push,
+        # hot-applied — snapshot cadence is not compile-baked); the CLI
+        # value stands until the tuner's first retune arrives
+        suggested = int(paral.get("snapshot_interval", 0) or 0)
+        return suggested if suggested > 0 else args.mem_ckpt_interval
+
     def checkpointer(step: int, st) -> None:
-        if step % args.mem_ckpt_interval == 0:
+        if step % mem_interval() == 0:
             if step % args.ckpt_interval == 0:
                 engine.save_to_storage(step, st)
             else:

@@ -8,9 +8,11 @@ Walks the package source for ``registry().counter("...")`` /
   Prometheus-safe subset the exposition endpoint promises),
 - every name is registered in exactly one call site, so the endpoint can
   never emit colliding series with divergent help/type/labels, and
-- every ``dlrover_tpu_gateway_*`` name appears verbatim in DESIGN.md:
-  the gateway's scrape surface is an operator contract (deploy/README.md
-  points dashboards at it), so registry and docs must not drift.
+- every ``dlrover_tpu_gateway_*``, ``dlrover_tpu_standby_*`` and
+  interval-tuner (``dlrover_tpu_snapshot_interval_*``) name appears
+  verbatim in DESIGN.md: those scrape surfaces are operator contracts
+  (deploy/README.md points dashboards and the "recovery is slow"
+  runbook at them), so registry and docs must not drift.
 
 It also walks journal emissions (``.emit("...")`` / ``.begin("...")`` /
 ``.span("...")``) and asserts every span name matches ``[a-z_]+``, is
@@ -61,12 +63,19 @@ POINT_SCAN_EXCLUDE = (os.path.join("dlrover_tpu", "chaos") + os.sep,)
 PKG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                    "dlrover_tpu")
 DESIGN_MD = os.path.join(os.path.dirname(PKG), "DESIGN.md")
-DOCUMENTED_PREFIX = "dlrover_tpu_gateway_"
+# metric families whose names are an operator contract: every
+# registered name under these prefixes must appear verbatim in DESIGN.md
+DOCUMENTED_PREFIXES = (
+    "dlrover_tpu_gateway_",
+    "dlrover_tpu_standby_",
+    "dlrover_tpu_snapshot_interval_",
+)
 
 
 def check_documented(names: dict[str, list[str]],
                      design_path: str = DESIGN_MD) -> list[str]:
-    """Every gateway metric registered in code must appear in DESIGN.md."""
+    """Every contract-family metric registered in code must appear in
+    DESIGN.md (gateway, warm-standby, interval tuner)."""
     try:
         with open(design_path, encoding="utf-8") as f:
             design = f.read()
@@ -74,9 +83,10 @@ def check_documented(names: dict[str, list[str]],
         return [f"cannot read {design_path}: {e}"]
     return [
         f"metric {name!r} ({', '.join(sites)}) is not documented in "
-        f"DESIGN.md; add it to the gateway metrics table"
+        f"DESIGN.md; add it to its metrics table"
         for name, sites in sorted(names.items())
-        if name.startswith(DOCUMENTED_PREFIX) and name not in design
+        if any(name.startswith(p) for p in DOCUMENTED_PREFIXES)
+        and name not in design
     ]
 
 

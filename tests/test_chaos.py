@@ -389,6 +389,10 @@ def _scenario_env(tmp_path) -> dict:
     return {
         "DLROVER_TPU_PLATFORM": "cpu",
         "DLROVER_TPU_DEVICE_COUNT": "1",
+        # warm recovery is a recovery path: the acceptance scenario must
+        # stay deterministic WITH standby promotion in the loop (pinned
+        # explicitly, independent of the feature's default)
+        "DLROVER_TPU_STANDBY": "1",
     }
 
 
@@ -436,4 +440,56 @@ def test_seeded_scenario_recovers_and_replays_identically(tmp_path):
         assert res.recovery_seconds is not None
 
     # determinism: identical fault/recovery journal trail across runs
+    assert results[0].trail == results[1].trail
+
+
+@pytest.mark.timeout(300)
+def test_standby_promotion_is_deterministic_under_kill_chaos(tmp_path):
+    """Warm-standby promotion IS the recovery path when the chaos
+    harness kills the trainer: the respawn must be served by promoting
+    the parked standby (standby_promote journal span present), the job
+    must still complete losing nothing, and two seeded runs must leave
+    an identical fault/recovery trail — promotion gets the same
+    deterministic-replay guarantee as a cold respawn."""
+    from dlrover_tpu.chaos.scenario import (
+        JobLeg,
+        Scenario,
+        _read_journal,
+        run_scenario,
+    )
+
+    def scenario():
+        return Scenario(
+            name="standby_kill", seed=424242,
+            legs=[JobLeg(
+                name="kill_promote", max_steps=14,
+                faults=[{"point": "agent_kill_trainer", "action": "kill",
+                         "args": {"sig": 9},
+                         "match": {"step_gte": 8}, "times": 1}],
+                train_args=["--ckpt-interval", "1000000",
+                            "--mem-ckpt-interval", "2",
+                            "--step-delay", "0.15"],
+            )],
+        )
+
+    results = []
+    for run in ("run_a", "run_b"):
+        work = str(tmp_path / run)
+        res = run_scenario(
+            scenario(), work,
+            env_extra=_scenario_env(tmp_path), deadline_s=140,
+        )
+        res.assert_invariants()
+        assert res.legs[0].result["restart_count"] == 1
+        assert res.legs[0].result["final_step"] == 14
+        # the kill recovered from the shm snapshot, not from step 0
+        assert res.legs[0].result["resumed_from"] >= 8
+        # the respawn was a PROMOTION: the agent journaled the
+        # standby_promote span around handing over the payload
+        events = _read_journal(os.path.join(work, "journal"))
+        promotes = [e for e in events
+                    if e.get("name") == "standby_promote"]
+        assert promotes, "no standby_promote span: respawn went cold"
+        results.append(res)
+
     assert results[0].trail == results[1].trail

@@ -13,7 +13,12 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:
+    # this container's jax predates the top-level alias (the package's
+    # own collectives.py carries the same fallback)
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
 from dlrover_tpu.models import transformer as T

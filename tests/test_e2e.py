@@ -111,7 +111,13 @@ def test_sigkill_recovers(tmp_path):
                     ["pgrep", "-f", f"^{sys.executable} {EXAMPLE}"],
                     capture_output=True, text=True,
                 )
-                pids = [int(p) for p in out.stdout.split()]
+                from dlrover_tpu.agent.standby import parked_standby_pids
+
+                # never aim the kill at the parked warm standby (same
+                # cmdline as the live trainer)
+                standbys = parked_standby_pids(str(tmp_path / "ipc"))
+                pids = [int(p) for p in out.stdout.split()
+                        if int(p) not in standbys]
                 ckpt_meta = tmp_path / "ckpt" / "latest"
                 if pids and ckpt_meta.exists():
                     # a snapshot exists: safe to kill and still recover
@@ -135,6 +141,10 @@ def test_sigkill_recovers(tmp_path):
 
 
 @pytest.mark.timeout(300)
+# slow tier (tier-1 envelope): among the heaviest bodies in this
+# file on XLA:CPU; core behavior stays covered by the lighter
+# tests in-tier. `pytest tests/` still runs it.
+@pytest.mark.slow
 def test_fsdp_sharded_ckpt_crash_recovers(tmp_path):
     """FSDP strategy + per-shard snapshots: crash -> reshard-on-load."""
     cmd, result_file = _cli_cmd(
@@ -154,6 +164,10 @@ def test_fsdp_sharded_ckpt_crash_recovers(tmp_path):
 
 
 @pytest.mark.timeout(480)
+# slow tier (tier-1 envelope): among the heaviest bodies in this
+# file on XLA:CPU; core behavior stays covered by the lighter
+# tests in-tier. `pytest tests/` still runs it.
+@pytest.mark.slow
 def test_pipeline_strategy_crash_recovers(tmp_path):
     """GPipe pipeline strategy: crash mid-run -> restore + completion
     (recovery must hold for pipeline-sharded state, not just dp/fsdp).
@@ -175,6 +189,10 @@ def test_pipeline_strategy_crash_recovers(tmp_path):
 
 
 @pytest.mark.timeout(300)
+# slow tier (tier-1 envelope): among the heaviest bodies in this
+# file on XLA:CPU; core behavior stays covered by the lighter
+# tests in-tier. `pytest tests/` still runs it.
+@pytest.mark.slow
 def test_network_check_then_train(tmp_path):
     """--network-check runs the probe rendezvous + payload before training."""
     cmd, result_file = _cli_cmd(
@@ -190,6 +208,10 @@ def test_network_check_then_train(tmp_path):
 
 
 @pytest.mark.timeout(300)
+# slow tier (tier-1 envelope): among the heaviest bodies in this file —
+# the exit-code ladder / parity it exercises is also unit-covered.
+# `pytest tests/` still runs it.
+@pytest.mark.slow
 def test_restarts_exhausted_fails_job(tmp_path):
     cmd, result_file = _cli_cmd(
         tmp_path, ["--max-restarts", "1"],
@@ -204,6 +226,10 @@ def test_restarts_exhausted_fails_job(tmp_path):
 
 
 @pytest.mark.timeout(300)
+# slow tier (tier-1 envelope): among the heaviest bodies in this file —
+# the exit-code ladder / parity it exercises is also unit-covered.
+# `pytest tests/` still runs it.
+@pytest.mark.slow
 def test_oom_exit_restarts_in_place(tmp_path):
     """Exit code 210 (OOM contract) restarts and recovers like software."""
     cmd, result_file = _cli_cmd(
@@ -223,6 +249,10 @@ def test_oom_exit_restarts_in_place(tmp_path):
 
 
 @pytest.mark.timeout(300)
+# slow tier (tier-1 envelope): among the heaviest bodies in this file —
+# the exit-code ladder / parity it exercises is also unit-covered.
+# `pytest tests/` still runs it.
+@pytest.mark.slow
 def test_hardware_exit_escalates_to_node_relaunch(tmp_path):
     """Exit code 211 -> agent exits with the node-relaunch code (3) after
     persisting the snapshot, instead of restarting on the bad host."""

@@ -111,6 +111,10 @@ class TestMoeFfn:
 
 
 class TestMoeTransformer:
+    # slow tier (tier-1 envelope): among the heaviest bodies in this
+    # file on XLA:CPU; core behavior stays covered by the lighter
+    # tests in-tier. `pytest tests/` still runs it.
+    @pytest.mark.slow
     def test_trains_and_loss_decreases(self):
         cfg = tfm.CONFIGS["tiny-moe"]
         params = tfm.init_params(cfg, jax.random.PRNGKey(0))
@@ -135,6 +139,10 @@ class TestMoeTransformer:
             params, state, loss = step(params, state)
             losses.append(float(loss))
         assert losses[-1] < losses[0]
+    # slow tier (tier-1 envelope): among the heaviest bodies in this
+    # file on XLA:CPU; core behavior stays covered by the lighter
+    # tests in-tier. `pytest tests/` still runs it.
+    @pytest.mark.slow
 
     def test_expert_parallel_sharding_on_mesh(self):
         """moe strategy: expert weights shard over the expert axis and a

@@ -111,7 +111,12 @@ def _run_two_nodes(tmp_path, train_args, kill_after_ckpt=False,
                     ["pgrep", "-f", f"^{sys.executable} {EXAMPLE}"],
                     capture_output=True, text=True,
                 )
-                pids = [int(p) for p in out.stdout.split()]
+                from dlrover_tpu.agent.standby import parked_standby_pids
+
+                # aim at live trainers only, not parked warm standbys
+                standbys = parked_standby_pids(str(tmp_path / "ipc"))
+                pids = [int(p) for p in out.stdout.split()
+                        if int(p) not in standbys]
                 if pids:
                     os.kill(pids[-1], signal.SIGKILL)
                     killed = True

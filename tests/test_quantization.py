@@ -82,6 +82,10 @@ class TestInt8Matmul:
 
 
 class TestInt8Model:
+    # slow tier (tier-1 envelope): among the heaviest bodies in this
+    # file on XLA:CPU; core behavior stays covered by the lighter
+    # tests in-tier. `pytest tests/` still runs it.
+    @pytest.mark.slow
     def test_tiny_trains(self):
         cfg = dataclasses.replace(T.CONFIGS["tiny"], int8_matmuls=True)
         params = T.init_params(cfg, jax.random.PRNGKey(0))
@@ -111,6 +115,10 @@ class TestInt8Model:
         lq = float(T.loss_fn(params, tokens, cfg=cfg_q))
         lf = float(T.loss_fn(params, tokens, cfg=cfg_f))
         assert lq == pytest.approx(lf, rel=2e-2), (lq, lf)
+    # slow tier (tier-1 envelope): among the heaviest bodies in this
+    # file on XLA:CPU; core behavior stays covered by the lighter
+    # tests in-tier. `pytest tests/` still runs it.
+    @pytest.mark.slow
 
     def test_gpt2_variant_and_remat(self):
         """int8 + gpt2 biases + per-layer remat compose."""

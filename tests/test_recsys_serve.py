@@ -72,7 +72,12 @@ def test_train_sharded_table_e2e(tmp_path):
          "--steps", "150", "--batch", "128", "--id-space", "20000",
          "--table-shards", "2",
          "--ckpt-dir", str(tmp_path / "ckpt"), "--incremental-ckpt",
-         "--log-interval", "50",
+         # first_loss is the loss at the FIRST log point: at interval 50
+         # the model has already converged by then (≈0.077) and the
+         # decreasing-loss assertion compares converged noise against
+         # converged noise. Interval 25 samples genuinely-early training
+         # (≈0.195 on this seed), giving the assertion a real margin.
+         "--log-interval", "25",
          "--result-file", str(tmp_path / "train.json")],
         env=env, cwd=REPO, capture_output=True, text=True, timeout=240,
     )

@@ -88,6 +88,9 @@ class TestWireProtocol:
 
 class TestRemoteParity:
     @pytest.mark.timeout(300)
+    # slow tier (tier-1 envelope): heaviest body in this file on
+    # XLA:CPU. `pytest tests/` still runs it.
+    @pytest.mark.slow
     def test_greedy_remote_matches_in_mesh_decode(self, worker):
         """temperature=0 parity ACROSS THE WIRE: same tokens as the
         in-mesh decode, and the rollout logprobs computed on them by
@@ -115,6 +118,9 @@ class TestRemoteParity:
         t_remote._remote.close()
 
     @pytest.mark.timeout(300)
+    # slow tier (tier-1 envelope): heaviest body in this file on
+    # XLA:CPU. `pytest tests/` still runs it.
+    @pytest.mark.slow
     def test_train_step_pushes_versioned_weights(self, worker):
         """After a train step the NEXT rollout must push the updated
         weights before generating — the worker's version provably
@@ -137,6 +143,10 @@ class TestRemoteParity:
 
 
 @pytest.mark.timeout(600)
+# slow tier (tier-1 envelope): among the heaviest bodies in this file —
+# the exit-code ladder / parity it exercises is also unit-covered.
+# `pytest tests/` still runs it.
+@pytest.mark.slow
 def test_remote_rollouts_via_child_process():
     """The full disaggregated form: the worker spawned as a CHILD
     PROCESS with its own JAX runtime (own CPU mesh here), weights over

@@ -78,6 +78,10 @@ class TestExpand:
 
 
 class TestMeasuredSearch:
+    # slow tier (tier-1 envelope): among the heaviest single tests in
+    # the suite — a full measured-search/compile cycle. `pytest tests/`
+    # still runs it.
+    @pytest.mark.slow
     def test_winner_not_slower_than_roofline_pick(self):
         """VERDICT r03 #4's done-bar: the searched pick must beat (or
         tie) the roofline pick's MEASURED step time — the roofline pick
@@ -106,6 +110,10 @@ class TestMeasuredSearch:
         assert (report["winner_step_s"]
                 <= report["rungs"][0][rp] * 1.25)
 
+    # slow tier (tier-1 envelope): among the heaviest single tests in
+    # the suite — a full measured-search/compile cycle. `pytest tests/`
+    # still runs it.
+    @pytest.mark.slow
     def test_halving_structure(self):
         _, report = measured_search(
             **_search_kwargs(),
@@ -231,6 +239,10 @@ class TestMeasuredSearch:
         assert winner.name in surrogate_names
         assert report["winner_step_s"] == 0.5
 
+    # slow tier (tier-1 envelope): among the heaviest single tests in
+    # the suite — a full measured-search/compile cycle. `pytest tests/`
+    # still runs it.
+    @pytest.mark.slow
     def test_observation_store_is_persisted_posterior(self):
         """Every measurement lands in the engine service's observation
         store and comes back via get_observations — the warm-start

@@ -84,7 +84,12 @@ def test_soak_many_kills(tmp_path):
                 out = subprocess.run(
                     ["pgrep", "-f", f"^{sys.executable} {EXAMPLE}"],
                     capture_output=True, text=True)
-                pids = [int(p) for p in out.stdout.split()]
+                from dlrover_tpu.agent.standby import parked_standby_pids
+
+                # aim at live trainers only, not parked warm standbys
+                standbys = parked_standby_pids(str(tmp_path / "ipc"))
+                pids = [int(p) for p in out.stdout.split()
+                        if int(p) not in standbys]
                 if pids:
                     os.kill(rng.choice(pids), signal.SIGKILL)
                     kills += 1

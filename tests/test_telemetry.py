@@ -286,8 +286,13 @@ def test_lost_time_report_on_synthetic_restart_trace(tmp_path):
     # compile event covers first-step compute too; the report nets out
     # one steady median step
     assert cats["recompile"] == pytest.approx(1.8, abs=0.1)
-    assert cats["rollback"] == pytest.approx(greport.median_step_s,
-                                             abs=0.1)
+    assert cats["redone"] == pytest.approx(greport.median_step_s,
+                                           abs=0.1)
+    # per-incarnation rows use the bench's phase vocabulary and pin the
+    # recovery to incarnation 1 (the one it recovered INTO)
+    rows = {r["incarnation"]: r for r in report.incarnations}
+    assert rows[1]["respawn_s"] == pytest.approx(19.0, abs=0.1)
+    assert rows[1]["redone_steps"] == greport.redone_steps
     # attribution is interval-union based, so overlapping spans never
     # push the attributed total past the lost total
     assert report.unattributed_s >= 0.0

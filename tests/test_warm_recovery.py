@@ -1,0 +1,434 @@
+"""Warm recovery path (DESIGN.md §16): standby trainers, overlapped
+restore, rendezvous fast re-admit, Young–Daly snapshot cadence.
+
+The chaos-level determinism of standby promotion lives in
+tests/test_chaos.py (two seeded runs, identical trails); this file
+covers the mechanisms in isolation: the tuner's math/clamping/
+hysteresis, the prefetch's failure ordering (a restore losing the race
+to a second failure rolls back exactly like the inline path), the
+park/promote handshake, and the unchanged-membership rendezvous fast
+path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dlrover_tpu.checkpoint import engine as engine_mod
+from dlrover_tpu.checkpoint.engine import (
+    CheckpointEngine,
+    start_restore_prefetch,
+    take_restore_prefetch,
+)
+from dlrover_tpu.checkpoint.interval_tuner import IntervalTuner
+from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.common.storage import PosixDiskStorage
+from dlrover_tpu.master.rdzv_manager import RendezvousManager
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------ Young–Daly tuner
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _tuner(**kw) -> tuple[IntervalTuner, FakeClock]:
+    clock = FakeClock()
+    kw.setdefault("clock", clock)
+    return IntervalTuner(**kw), clock
+
+
+class TestIntervalTuner:
+    def test_needs_min_failures_and_both_costs(self):
+        tuner, clock = _tuner()
+        assert tuner.recommend() is None
+        tuner.observe_failure()
+        clock.t = 600.0
+        tuner.observe_failure()
+        assert tuner.recommend() is None  # no costs yet
+        tuner.observe_snapshot_cost(0.5)
+        assert tuner.recommend() is None  # still no step time
+        tuner.observe_step_time(0.1)
+        assert tuner.recommend() is not None
+
+    def test_young_daly_math(self):
+        tuner, clock = _tuner()
+        tuner.observe_failure(t=0.0)
+        tuner.observe_failure(t=600.0)
+        tuner.observe_snapshot_cost(0.5)
+        tuner.observe_step_time(0.1)
+        clock.t = 1200.0
+        # MTBF = 1200/2 = 600s; T* = sqrt(2*0.5*600) = 24.49s
+        # -> 245 steps at 0.1 s/step
+        assert tuner.recommend() == 245
+
+    def test_clamping(self):
+        tuner, clock = _tuner(min_steps=10, max_steps=50)
+        tuner.observe_failure(t=0.0)
+        tuner.observe_failure(t=600.0)
+        tuner.observe_snapshot_cost(0.5)
+        tuner.observe_step_time(0.1)
+        clock.t = 1200.0
+        assert tuner.recommend() == 50  # 245 clamped to max
+        # an absurdly cheap snapshot under a storm clamps low
+        fast, fclock = _tuner(min_steps=10, max_steps=50)
+        fast.observe_failure(t=0.0)
+        fast.observe_failure(t=0.5)
+        fast.observe_snapshot_cost(1e-5)
+        fast.observe_step_time(1.0)
+        fclock.t = 1.0
+        assert fast.recommend() == 10  # tiny T* clamped to min
+
+    def test_first_retune_applies_then_hysteresis_holds(self):
+        tuner, clock = _tuner()
+        tuner.observe_failure(t=0.0)
+        tuner.observe_failure(t=600.0)
+        tuner.observe_snapshot_cost(0.5)
+        tuner.observe_step_time(0.1)
+        clock.t = 1200.0
+        assert tuner.maybe_retune() == 245
+        assert tuner.current_steps == 245
+        # a <25% drift is noise: no retune even though recommend moves
+        clock.t = 1500.0  # MTBF 750 -> rec ~274 (+12%)
+        assert tuner.recommend() == 274
+        assert tuner.maybe_retune() is None
+        assert tuner.current_steps == 245
+
+    def test_moves_are_bounded_by_max_move_factor(self):
+        tuner, clock = _tuner()
+        tuner.observe_failure(t=0.0)
+        tuner.observe_failure(t=10.0)
+        tuner.observe_snapshot_cost(0.5)
+        tuner.observe_step_time(0.1)
+        clock.t = 20.0
+        first = tuner.maybe_retune()  # MTBF 10 -> sqrt(10)=3.16s -> 32
+        assert first == 32
+        # failures stop: MTBF stretches enormously, but one retune can
+        # at most double the interval
+        clock.t = 3000.0
+        assert tuner.recommend() > 64
+        assert tuner.maybe_retune() == 64
+        assert tuner.current_steps == 64
+
+    def test_metrics_snapshot_feed(self):
+        tuner, clock = _tuner()
+        samples = [
+            {"name": "dlrover_tpu_train_step_seconds",
+             "type": "histogram",
+             "samples": [{"sum": 10.0, "count": 100}]},
+            {"name": "dlrover_tpu_ckpt_snapshot_seconds",
+             "type": "histogram",
+             "samples": [{"sum": 2.0, "count": 10}]},
+        ]
+        tuner.observe_metrics_snapshot(samples)
+        tuner.observe_failure(t=0.0)
+        tuner.observe_failure(t=800.0)
+        clock.t = 1600.0
+        # step 0.1s, snap 0.2s, MTBF 800 -> sqrt(320)=17.9s -> 179
+        assert tuner.recommend() == 179
+
+    def test_failures_age_out_of_the_window(self):
+        tuner, clock = _tuner(window_s=100.0)
+        tuner.observe_failure(t=0.0)
+        tuner.observe_failure(t=1.0)
+        tuner.observe_snapshot_cost(0.5)
+        tuner.observe_step_time(0.1)
+        clock.t = 500.0  # both failures long gone
+        assert tuner.recommend() is None
+
+
+# --------------------------------------------------- overlapped restore
+
+
+def _state(step: int):
+    return {
+        "w": jnp.arange(32, dtype=jnp.float32) * (step + 1),
+        "step": jnp.asarray(step, jnp.int32),
+    }
+
+
+@pytest.fixture()
+def committed_engine(tmp_ipc_dir, tmp_path):
+    """A solo engine with steps 5 and 10 durably committed."""
+    ckpt = str(tmp_path / "ckpt")
+    eng = CheckpointEngine(ckpt)
+    for step in (5, 10):
+        assert eng.save_to_storage(step, _state(step))
+        assert eng.wait_for_persist(step, timeout=60)
+    yield eng, ckpt
+    # drop any prefetch a test left behind so the registry stays clean
+    take_restore_prefetch(ckpt, eng.node_id)
+    eng.close()
+
+
+class TestRestorePrefetch:
+    def test_load_consumes_the_prefetch(self, committed_engine,
+                                        monkeypatch):
+        eng, ckpt = committed_engine
+        pf = start_restore_prefetch(ckpt)
+        assert pf.join(timeout=30) is not None
+        # the prefetched result alone must satisfy the load: a fresh
+        # synchronous read would blow up here
+        monkeypatch.setattr(
+            engine_mod, "_read_storage_arrays",
+            lambda *a, **k: (_ for _ in ()).throw(
+                AssertionError("inline read used despite prefetch")),
+        )
+        loaded = eng._load_from_storage()
+        assert loaded is not None and loaded[0] == 10
+        np.testing.assert_array_equal(
+            np.asarray(loaded[1]["w"]),
+            np.arange(32, dtype=np.float32) * 11,
+        )
+
+    def test_idempotent_registration(self, committed_engine):
+        eng, ckpt = committed_engine
+        a = start_restore_prefetch(ckpt)
+        b = start_restore_prefetch(ckpt)
+        assert a is b
+        assert take_restore_prefetch(ckpt, eng.node_id) is a
+        assert take_restore_prefetch(ckpt, eng.node_id) is None
+
+    def test_pinned_step_mismatch_discards_prefetch(self,
+                                                   committed_engine):
+        eng, ckpt = committed_engine
+        pf = start_restore_prefetch(ckpt)
+        assert pf.join(timeout=30)[0] == 10
+        loaded = eng._load_from_storage(step=5)  # best-model style pin
+        assert loaded is not None and loaded[0] == 5
+
+    def test_prefetch_losing_race_to_second_failure_rolls_back(
+            self, committed_engine):
+        """The overlapped-restore failure ordering: a second failure
+        corrupts the newest step before/while the prefetch reads it.
+        The prefetch runs the same resolve_restore_step rollback as the
+        inline path, so the restore lands on the newest VERIFIED step —
+        never the corrupt bytes, never step 0."""
+        eng, ckpt = committed_engine
+        bin_path = os.path.join(ckpt, "step-10", "node_0.bin")
+        blob = bytearray(open(bin_path, "rb").read())
+        blob[7] ^= 0x40
+        with open(bin_path, "wb") as f:
+            f.write(blob)
+        pf = start_restore_prefetch(ckpt)
+        got = pf.join(timeout=30)
+        assert got is not None and got[0] == 5  # rolled back, verified
+        loaded = eng._load_from_storage()
+        assert loaded is not None and loaded[0] == 5
+        np.testing.assert_array_equal(
+            np.asarray(loaded[1]["w"]),
+            np.arange(32, dtype=np.float32) * 6,
+        )
+
+    def test_prefetch_error_falls_back_to_sync_read(self,
+                                                    committed_engine):
+        eng, ckpt = committed_engine
+
+        class BrokenStorage(PosixDiskStorage):
+            def read(self, path):  # noqa: ARG002
+                raise OSError("nfs went away")
+
+            def read_text(self, path):  # noqa: ARG002
+                raise OSError("nfs went away")
+
+        pf = start_restore_prefetch(ckpt, storage=BrokenStorage())
+        assert pf.join(timeout=30) is None
+        # the engine's own (healthy) storage still restores
+        loaded = eng._load_from_storage()
+        assert loaded is not None and loaded[0] == 10
+
+
+# ------------------------------------------------ standby park/promote
+
+
+class TestStandbyHandshake:
+    def _manager(self, tmp_path, child_body: str, extra_env=None):
+        from dlrover_tpu.agent.standby import StandbyManager
+
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["DLROVER_TPU_IPC_DIR"] = str(tmp_path / "ipc")
+        env.update(extra_env or {})
+        os.makedirs(env["DLROVER_TPU_IPC_DIR"], exist_ok=True)
+        os.environ["DLROVER_TPU_IPC_DIR"] = env["DLROVER_TPU_IPC_DIR"]
+        entry = [sys.executable, "-c", textwrap.dedent(child_body)]
+        return StandbyManager(entry, node_id=0, base_env=env)
+
+    def test_park_promote_delivers_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DLROVER_TPU_IPC_DIR", str(tmp_path / "ipc"))
+        out = str(tmp_path / "out.json")
+        mgr = self._manager(
+            tmp_path,
+            f"""
+            import json, os
+            from dlrover_tpu.agent.standby import park_if_standby
+            park_if_standby()
+            with open({out!r}, "w") as f:
+                json.dump({{
+                    "rank": os.environ.get("DLROVER_TPU_NODE_RANK"),
+                    "coord": os.environ.get("DLROVER_TPU_COORDINATOR"),
+                }}, f)
+            """,
+        )
+        try:
+            mgr.arm()
+            deadline = time.time() + 60
+            while time.time() < deadline and not mgr.is_warm():
+                time.sleep(0.1)
+            assert mgr.is_warm(), "standby never parked"
+            proc = mgr.promote({
+                EnvKey.NODE_RANK: "3",
+                EnvKey.COORDINATOR: "127.0.0.1:9999",
+            })
+            assert proc is not None
+            assert proc.wait(timeout=60) == 0
+            got = json.load(open(out))
+            assert got == {"rank": "3", "coord": "127.0.0.1:9999"}
+            # consumed: a second promotion has nothing to hand over
+            assert mgr.promote({EnvKey.NODE_RANK: "4"}) is None
+        finally:
+            mgr.discard()
+
+    def test_dead_standby_promotes_to_none(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DLROVER_TPU_IPC_DIR", str(tmp_path / "ipc"))
+        mgr = self._manager(tmp_path, "raise SystemExit(3)")
+        try:
+            mgr.arm()
+            deadline = time.time() + 30
+            while time.time() < deadline and mgr._proc.poll() is None:
+                time.sleep(0.05)
+            assert mgr.promote({EnvKey.NODE_RANK: "1"}) is None
+            assert not mgr.is_warm()
+        finally:
+            mgr.discard()
+
+    def test_prepare_signals_the_parked_child(self, tmp_path,
+                                              monkeypatch):
+        monkeypatch.setenv("DLROVER_TPU_IPC_DIR", str(tmp_path / "ipc"))
+        mgr = self._manager(tmp_path, "import time; time.sleep(600)")
+        try:
+            mgr.arm()
+            assert mgr.prepare(str(tmp_path / "ckpt"))
+            prep = mgr._payload_path + ".prepare"
+            assert json.load(open(prep))["ckpt_dir"] == \
+                str(tmp_path / "ckpt")
+        finally:
+            mgr.discard()
+
+    def test_disabled_by_env(self, monkeypatch):
+        from dlrover_tpu.agent.standby import standby_enabled
+
+        monkeypatch.delenv("DLROVER_TPU_STANDBY", raising=False)
+        assert standby_enabled()
+        monkeypatch.setenv("DLROVER_TPU_STANDBY", "0")
+        assert not standby_enabled()
+
+
+# -------------------------------------------- rendezvous fast re-admit
+
+
+class TestRendezvousFastReadmit:
+    def test_unchanged_membership_readmits_immediately(self):
+        mgr = RendezvousManager(min_nodes=2, max_nodes=4,
+                                waiting_timeout=0.5)
+        mgr.join(0, "a:1", 1)
+        mgr.join(1, "b:1", 1)
+        assert mgr.get_comm_world(0) is None  # below max, no timeout yet
+        time.sleep(0.6)
+        first = mgr.get_comm_world(0)
+        assert first is not None and first.round == 1
+        # restart-in-place: the SAME two nodes rejoin
+        mgr.join(0, "a:2", 1)
+        assert mgr.get_comm_world(0) is None  # partial rejoin: wait
+        mgr.join(1, "b:2", 1)
+        t0 = time.monotonic()
+        second = mgr.get_comm_world(0)
+        assert second is not None and second.round == 2
+        assert time.monotonic() - t0 < 0.1  # no backoff round
+        assert second.node_addrs[0] == "a:2"  # fresh addrs adopted
+
+    def test_true_membership_change_still_backs_off(self):
+        mgr = RendezvousManager(min_nodes=2, max_nodes=4,
+                                waiting_timeout=0.5)
+        mgr.join(0, "a:1", 1)
+        mgr.join(1, "b:1", 1)
+        time.sleep(0.6)
+        assert mgr.get_comm_world(0) is not None
+        # node 1 is REMOVED (dead) — the fast path must disarm even
+        # though the waiting set momentarily equals the old world
+        mgr.remove_node(1)
+        mgr.join(0, "a:2", 1)
+        mgr.join(1, "b:2", 1)
+        assert mgr.get_comm_world(0) is None  # full backoff round again
+        time.sleep(0.6)
+        got = mgr.get_comm_world(0)
+        assert got is not None and got.round == 2
+
+    def test_scale_up_join_disables_fast_path(self):
+        mgr = RendezvousManager(min_nodes=2, max_nodes=4,
+                                waiting_timeout=0.5)
+        mgr.join(0, "a:1", 1)
+        mgr.join(1, "b:1", 1)
+        time.sleep(0.6)
+        assert mgr.get_comm_world(0) is not None
+        # a NEW node appears alongside the rejoining members: this is a
+        # genuine membership change, wait for the round to gather
+        mgr.join(0, "a:2", 1)
+        mgr.join(1, "b:2", 1)
+        mgr.join(2, "c:1", 1)
+        assert mgr.get_comm_world(0) is None
+        time.sleep(0.6)
+        got = mgr.get_comm_world(0)
+        assert got is not None and len(got.world) == 3
+
+
+# ------------------------------------------- master tuner wiring (e2e)
+
+
+def test_master_pushes_retune_through_paral_config(tmp_path,
+                                                   monkeypatch):
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.master.job_master import JobMaster
+
+    monkeypatch.setenv(EnvKey.SNAPSHOT_INTERVAL, "auto")
+    master = JobMaster(port=0, rdzv_timeout=2.0)
+    master.prepare()
+    try:
+        c = MasterClient(master.addr, 0)
+        samples = [
+            {"name": "dlrover_tpu_train_step_seconds",
+             "type": "histogram",
+             "samples": [{"sum": 10.0, "count": 100}]},
+            {"name": "dlrover_tpu_ckpt_snapshot_seconds",
+             "type": "histogram",
+             "samples": [{"sum": 2.0, "count": 10}]},
+        ]
+        c.report_metrics(samples, role="trainer")
+        assert c.get_paral_config().snapshot_interval == 0  # no MTBF yet
+        c.report_failure("exit code 9 (killed)", restart_count=0)
+        time.sleep(0.05)
+        c.report_failure("exit code 9 (killed)", restart_count=1)
+        c.report_metrics(samples, role="trainer")
+        cfg = c.get_paral_config()
+        assert cfg.snapshot_interval >= 1
+        assert cfg.version >= 1
+        assert not cfg.restart_required  # cadence hot-applies
+        c.close()
+    finally:
+        master.stop()
