@@ -733,6 +733,12 @@ def _goodput_scenario(extra: dict, prefix: str, child_env: dict,
         # warm recovery on (the default) — pinned so an outer env can't
         # silently bench the cold path
         "DLROVER_TPU_STANDBY": env.get("DLROVER_TPU_STANDBY", "1"),
+        # hermetic per-run AOT executable cache (DESIGN.md §17): the
+        # calibration run warms it, so measured-run respawns load the
+        # executable instead of recompiling — the recompile_warm_s vs
+        # recompile_cold_s split below proves it
+        "DLROVER_TPU_COMPILE_CACHE_DIR": os.path.join(work,
+                                                      "compile_cache"),
         "PYTHONPATH": env.get("PYTHONPATH", "") + os.pathsep + repo,
     })
     if env.get("DLROVER_TPU_PLATFORM") != "cpu":
@@ -858,8 +864,12 @@ def _goodput_scenario(extra: dict, prefix: str, child_env: dict,
             lrep = build_report(journal_dir, goodput_log=log,
                                 end_time=t_exit)
             denom = max(1, killed)
+            # recompile_warm_s vs recompile_cold_s: the compile-cache
+            # proof — a warm recovery's "recompile" is an executable
+            # load, and this split shows it (DESIGN.md §17)
             for cat in ("respawn", "rendezvous", "restore",
-                        "recompile", "redone"):
+                        "recompile", "recompile_warm",
+                        "recompile_cold", "redone"):
                 extra[f"{prefix}{cat}_s"] = round(
                     lrep.categories.get(cat, 0.0) / denom, 2)
             extra[f"{prefix}unattributed_s"] = round(

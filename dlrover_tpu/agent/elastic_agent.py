@@ -58,6 +58,13 @@ _rdzv_wait_seconds = registry().histogram(
     "dlrover_tpu_agent_rdzv_wait_seconds",
     "agent-observed rendezvous wait (join -> completed world)",
 )
+_reshard_choices = registry().counter(
+    "dlrover_tpu_agent_reshard_choice_total",
+    "recovery rendezvous outcomes by path: covered=true means the "
+    "compile cache already holds an executable for the new topology "
+    "and the incarnation takes the reshard-with-fallback path",
+    label_names=("covered",),
+)
 
 
 class RunResult(str, Enum):
@@ -184,7 +191,47 @@ class ElasticAgent:
             "rendezvous round %d: rank %d of %d nodes, coordinator %s",
             world.round, self._node_rank, len(world.world), world.coordinator,
         )
+        self._reshard_decision(world)
         return self._node_rank, len(world.world), world.coordinator
+
+    def _reshard_decision(self, world) -> None:
+        """Choose the recovery path for the world this round produced:
+        when the master's compile cache already holds an executable for
+        the new topology (published by the pre-failure incarnation or
+        the fallback-AOT daemon), the upcoming incarnation is a
+        *reshard* event — it will load the program instead of cold
+        compiling — and the journal records the choice so the recovery
+        trail reads ``reshard`` rather than a cold compile. No coverage
+        means today's restart path, unchanged (DESIGN.md §17)."""
+        from dlrover_tpu.master.kv_store import node_topology_prefix
+
+        try:
+            # scan by world size, not device count: the program key pins
+            # the exact device topology, but the agent's chip count and
+            # the trainer's jax device count can differ (virtual test
+            # meshes), and the question here is only "does the N-node
+            # world have a pre-compiled program"
+            resp = self._client.compile_cache_query(
+                node_topology_prefix(len(world.world))
+            )
+        except (ConnectionError, RuntimeError, OSError) as e:
+            logger.warning("compile-cache coverage query failed: %s", e)
+            return
+        covered = bool(resp.covered)
+        _reshard_choices.labels(str(covered).lower()).inc()
+        if covered:
+            get_journal().emit(
+                "reshard", nodes=len(world.world),
+                devices=world.total_devices,
+                executables=resp.executables,
+                shrink=bool(world.reshard),
+            )
+            logger.info(
+                "recovery is a reshard event: %d pre-compiled "
+                "executable(s) for %d nodes / %d devices%s",
+                resp.executables, len(world.world), world.total_devices,
+                " (membership shrink)" if world.reshard else "",
+            )
 
     # ----------------------------------------------------------- child mgmt
 

@@ -130,6 +130,35 @@ class MasterClient:
             time.sleep(0.1)
         return False
 
+    # --------------------------------------------------------- compile cache
+
+    def compile_cache_put(self, key: str, payload: bytes,
+                          meta: dict | None = None) -> bool:
+        resp = self._client.call(
+            m.CompileCachePutRequest(
+                node_id=self.node_id, key=key, payload=payload,
+                meta=meta or {},
+            )
+        )
+        return bool(resp.success)
+
+    def compile_cache_get(self, key: str
+                          ) -> tuple[bytes, dict] | None:
+        resp = self._client.call(
+            m.CompileCacheGetRequest(node_id=self.node_id, key=key)
+        )
+        return (resp.payload, resp.meta) if resp.found else None
+
+    def compile_cache_query(self, topology: str
+                            ) -> m.CompileCacheQueryResponse:
+        """Coverage for a topology tag (kv_store.topology_tag) — the
+        agent's reshard-with-fallback vs cold-restart decision input."""
+        return self._client.call(
+            m.CompileCacheQueryRequest(
+                node_id=self.node_id, topology=topology
+            )
+        )
+
     # ---------------------------------------------------- buddy replication
 
     def report_buddy_endpoint(self, addr: str) -> None:

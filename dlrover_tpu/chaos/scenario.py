@@ -51,7 +51,7 @@ DEFAULT_EXAMPLE = os.path.join(REPO, "examples", "train_transformer.py")
 # journal names treated as recovery evidence in the canonical trail
 RECOVERY_EVENTS = (
     "node_restart", "ckpt_verify_failed", "ckpt_rollback",
-    "state_rollback", "degraded_mode",
+    "state_rollback", "degraded_mode", "reshard",
 )
 
 
@@ -196,6 +196,11 @@ def fault_trail(journal_dir: str) -> dict:
             recovery.append(["state_rollback"])
         elif name == "degraded_mode":
             recovery.append(["degraded_mode", e.get("state", "")])
+        elif name == "reshard":
+            # the reshard-recovery choice (agent) and the state remap
+            # (mesh) share the name; keep only the deterministic fields
+            recovery.append(["reshard", e.get("nodes", 0),
+                             bool(e.get("shrink", False))])
     return {"faults": sorted(faults), "recovery": sorted(recovery)}
 
 
@@ -248,6 +253,12 @@ def run_scenario(scenario: Scenario, work_dir: str, *,
             env.update(env_extra or {})
             env.setdefault("DLROVER_TPU_PLATFORM", "cpu")
             env.setdefault("DLROVER_TPU_DEVICE_COUNT", "1")
+            # hermetic compile cache, shared across this scenario's legs
+            # (the satellite shared-dir contract) but never across
+            # scenarios/test runs — a stale /tmp hit would silently turn
+            # a cold-compile assertion warm
+            env.setdefault("DLROVER_TPU_COMPILE_CACHE_DIR",
+                           os.path.join(work_dir, "compile_cache"))
             # IPC dirs hold AF_UNIX sockets, whose path limit (~108
             # chars) a nested work_dir easily exceeds: keep them short
             # and top-level, removed in the finally below

@@ -619,6 +619,56 @@ class CheckpointEngine:
             self.storage, self.ckpt_dir, self.node_id, step=step
         )
 
+    # ------------------------------------------------------------- reshard
+
+    def reshard_state(self, old_mesh, new_mesh, state,
+                      step: int | None = None):
+        """Membership change as a resharding event, not a restart
+        (ElasWave; DESIGN.md §17): remap the live state's DP/TP/PP
+        shards onto a reshaped mesh through this node's shm snapshot.
+
+        The state is snapshotted into the shm arena first (sub-second;
+        the training cadence usually already did it), then every leaf
+        is scattered host-side onto ``new_mesh`` under its remapped
+        PartitionSpec — the surviving incarnation resumes on the
+        pre-compiled fallback program without a cold ``pjit`` compile,
+        and the snapshot doubles as the rollback point if the reshape
+        itself dies. Falls back to a direct device gather for leaves
+        the snapshot cannot serve.
+        """
+        import jax
+
+        from dlrover_tpu.checkpoint.shm_handler import _leaf_paths
+        from dlrover_tpu.parallel import mesh as mesh_mod
+
+        if step is None:
+            step_leaf = getattr(state, "step", None)
+            step = int(jax.device_get(step_leaf)) \
+                if step_leaf is not None else 0
+        arrays: dict[str, np.ndarray] | None = None
+        if self.save_to_memory(step, state) and self.wait_snapshot():
+            snap = self._load_from_memory(copy=False)
+            if snap is not None and snap[0] == step:
+                arrays = snap[1]
+        names = iter(n for n, _ in _leaf_paths(state))
+
+        def _put(leaf, new_sharding):
+            name = next(names)
+            host = arrays.get(name) if arrays is not None else None
+            if host is None:
+                host = np.asarray(jax.device_get(leaf))
+            return jax.device_put(host, new_sharding)
+
+        out = mesh_mod.reshard_state(old_mesh, new_mesh, state, put=_put)
+        # the resharded state's whole purpose is to feed the
+        # pre-compiled (donating) fallback executable: re-stage the
+        # device_put-built leaves into proper per-device buffers
+        # (compile_cache.launder) or the donation corrupts them in
+        # place on the CPU backend
+        from dlrover_tpu.parallel.compile_cache import launder
+
+        return launder(out)
+
     def latest_persisted_step(self) -> int:
         from dlrover_tpu.agent.ckpt_saver import read_tracker
 

@@ -119,6 +119,13 @@ class EnvKey:
     MOCK_ERR_RANK = "DLROVER_TPU_MOCK_ERR_RANK"
     DEVICE_COUNT_OVERRIDE = "DLROVER_TPU_DEVICE_COUNT"
     COMPILE_CACHE_DIR = "DLROVER_TPU_COMPILE_CACHE"
+    # escape hatch: pin the ONE compile-cache directory every
+    # incarnation, parked standby, and serving replica on this node
+    # shares (XLA persistent cache + serialized AOT executables). The
+    # default derives from the job name for the same sharing property;
+    # this exists for operators who must place the cache explicitly
+    # (job-shared NFS, a ramdisk, a pre-warmed image path).
+    COMPILE_CACHE_SHARED_DIR = "DLROVER_TPU_COMPILE_CACHE_DIR"
     # coordination-service join timeout (seconds) for
     # jax.distributed.initialize — the launcher scales it with the node
     # count (reference analog: auto_configure_params' comm timeouts,

@@ -74,6 +74,9 @@ class CommWorldResponse:
     # adopted by agents/trainers (telemetry/journal.py) so spans from
     # every process of the job link into one trace
     trace_id: str = ""
+    # this round completed via the membership-shrink fast path: the
+    # recovery is a reshard event (rdzv_manager; DESIGN.md §17)
+    reshard: bool = False
 
 
 @register_message
@@ -117,6 +120,58 @@ class KVStoreResponse:
     found: bool = False
     value: bytes = b""
     number: int = 0
+
+
+# ------------------------------------------------------------- compile cache
+
+
+@register_message
+@dataclasses.dataclass
+class CompileCachePutRequest:
+    """Trainer -> master: publish a serialized AOT executable under its
+    topology × model × strategy fingerprint (DESIGN.md §17). ``meta``
+    carries the raw fingerprint inputs so a reader can verify the match
+    instead of trusting the digest."""
+
+    node_id: int = 0
+    key: str = ""        # "<topology_tag>/<digest>"
+    payload: bytes = b""
+    meta: dict = dataclasses.field(default_factory=dict)
+
+
+@register_message
+@dataclasses.dataclass
+class CompileCacheGetRequest:
+    node_id: int = 0
+    key: str = ""
+
+
+@register_message
+@dataclasses.dataclass
+class CompileCacheGetResponse:
+    found: bool = False
+    payload: bytes = b""
+    meta: dict = dataclasses.field(default_factory=dict)
+
+
+@register_message
+@dataclasses.dataclass
+class CompileCacheQueryRequest:
+    """Agent -> master: is any executable pre-compiled for this
+    topology tag? Drives the reshard-with-fallback vs cold-restart
+    choice on the recovery path."""
+
+    node_id: int = 0
+    topology: str = ""   # kv_store.topology_tag(total_devices, num_nodes)
+
+
+@register_message
+@dataclasses.dataclass
+class CompileCacheQueryResponse:
+    covered: bool = False
+    executables: int = 0
+    cache_entries: int = 0
+    cache_bytes: int = 0
 
 
 # -------------------------------------------------------- node state / health

@@ -45,6 +45,13 @@ _fast_readmits = registry().counter(
     "path (no waiting_timeout backoff)",
     label_names=("name",),
 )
+_reshard_rounds = registry().counter(
+    "dlrover_tpu_rdzv_reshard_rounds_total",
+    "rendezvous rounds completed via the membership-shrink fast path "
+    "(all survivors of the previous round re-joined after a removal): "
+    "the reshard-event rounds of DESIGN.md §17",
+    label_names=("name",),
+)
 
 
 @dataclasses.dataclass
@@ -63,6 +70,11 @@ class CommWorld:
     coordinator: str = ""
     total_devices: int = 0
     node_addrs: dict[int, str] = dataclasses.field(default_factory=dict)
+    # True when this round is a membership SHRINK of the previous
+    # completed round (survivors only, dead members removed): agents
+    # treat the recovery as a resharding event — the fallback topology
+    # may already be pre-compiled (DESIGN.md §17)
+    reshard: bool = False
 
 
 class RendezvousManager:
@@ -91,9 +103,14 @@ class RendezvousManager:
         # node set of the last COMPLETED round — survives the round's
         # invalidation by a rejoin, so a restart-in-place with unchanged
         # membership can be re-admitted immediately instead of sitting
-        # out the waiting_timeout backoff. Cleared whenever a node is
-        # REMOVED (dead/scaled away): that is a true membership change.
+        # out the waiting_timeout backoff. ``_departed`` tracks members
+        # REMOVED since that round (dead/scaled away): while non-empty
+        # the unchanged-membership path disarms, but a waiting set equal
+        # to exactly the SURVIVORS completes immediately as a *reshard*
+        # round — a node loss becomes a mesh-reshape event, not a
+        # waiting_timeout backoff (DESIGN.md §17).
         self._prev_world: frozenset[int] | None = None
+        self._departed: set[int] = set()
 
     def update_node_bounds(self, min_nodes: int, max_nodes: int) -> None:
         with self._lock:
@@ -133,8 +150,10 @@ class RendezvousManager:
             self._waiting.pop(node_id, None)
             if self._prev_world and node_id in self._prev_world:
                 # a genuinely departed member disqualifies the
-                # unchanged-membership fast path until the next full round
-                self._prev_world = None
+                # unchanged-membership fast path until the next full
+                # round — but arms the shrink (reshard) fast path for
+                # the surviving set
+                self._departed.add(node_id)
             if self._latest and node_id in self._latest.world:
                 logger.info(
                     "rdzv %s: node %s removed from completed round", self.name,
@@ -167,12 +186,27 @@ class RendezvousManager:
         # exact node set of the previous completed round. Nothing new
         # can arrive that wasn't there before the failure — waiting out
         # the backoff would only stretch every recovery by up to
-        # waiting_timeout. Re-admit immediately.
+        # waiting_timeout. Re-admit immediately. A removed member that
+        # re-joins is a genuine membership change: full backoff.
         fast = (
             self._prev_world is not None
+            and not self._departed
             and frozenset(self._waiting) == self._prev_world
         )
-        if n < self._max_nodes and not timed_out and not fast:
+        # reshard fast path: every SURVIVOR of the previous round is
+        # back and the only difference is the removed member(s). The
+        # membership change is fully known — complete immediately and
+        # mark the round a reshard event so agents/trainers take the
+        # pre-compiled fallback-topology path instead of a cold compile.
+        reshard = (
+            not fast
+            and self._prev_world is not None
+            and bool(self._departed)
+            and frozenset(self._waiting)
+            == self._prev_world - self._departed
+        )
+        if n < self._max_nodes and not timed_out and not fast \
+                and not reshard:
             return
         usable = min(n, self._max_nodes)
         usable -= usable % self._node_unit
@@ -191,26 +225,31 @@ class RendezvousManager:
             coordinator=coordinator,
             total_devices=sum(w.local_devices for w in nodes),
             node_addrs={w.node_id: w.addr for w in nodes},
+            reshard=reshard,
         )
         for w in nodes:
             self._waiting.pop(w.node_id, None)
         self._prev_world = frozenset(world)
+        self._departed.clear()
         logger.info(
             "rdzv %s: round %d completed with %d nodes%s, coordinator %s",
             self.name, self._round, len(world),
-            " (fast re-admit)" if fast else "", coordinator,
+            " (fast re-admit)" if fast
+            else " (reshard)" if reshard else "", coordinator,
         )
         round_s = max(0.0, time.time() - self._first_join_time)
         _round_seconds.labels(self.name).observe(round_s)
         _rounds_total.labels(self.name).inc()
         if fast:
             _fast_readmits.labels(self.name).inc()
+        if reshard:
+            _reshard_rounds.labels(self.name).inc()
         _waiting_nodes.labels(self.name).set(len(self._waiting))
         # one completed-interval line (begin time is derivable from dur):
         # the job-level stall the lost-time report charges to rendezvous
         get_journal().emit(
             "rdzv_round", dur=round_s, rdzv=self.name, round=self._round,
-            nodes=len(world), fast=fast,
+            nodes=len(world), fast=fast, reshard=reshard,
         )
 
     def get_comm_world(self, node_id: int) -> CommWorld | None:

@@ -16,7 +16,7 @@ from dlrover_tpu.common import messages as m
 from dlrover_tpu.common.constants import EnvKey, NodeExitReason, NodeStatus
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.master.diagnosis import DiagnosisManager
-from dlrover_tpu.master.kv_store import KVStoreService
+from dlrover_tpu.master.kv_store import CompileCacheService, KVStoreService
 from dlrover_tpu.master.node_manager import NodeManager
 from dlrover_tpu.master.rdzv_manager import RendezvousManager
 from dlrover_tpu.master.speed_monitor import SpeedMonitor
@@ -38,6 +38,7 @@ class MasterServicer:
         metric_collector=None,
         trace_id: str = "",
         anomaly=None,
+        compile_cache: CompileCacheService | None = None,
     ):
         from dlrover_tpu.master.stats import (
             JobMetricCollector,
@@ -50,6 +51,9 @@ class MasterServicer:
         self._rdzv_managers = rdzv_managers
         self._speed_monitor = speed_monitor
         self._kv_store = kv_store
+        # persistent compile cache (DESIGN.md §17): serialized AOT
+        # executables served across incarnations/standbys/replicas
+        self._compile_cache = compile_cache or CompileCacheService()
         self._diagnosis = diagnosis
         self._stats = stats_reporter or LocalStatsReporter()
         self._metrics = metric_collector or JobMetricCollector(
@@ -137,6 +141,25 @@ class MasterServicer:
         if isinstance(msg, m.KVStoreAddRequest):
             return m.KVStoreResponse(
                 found=True, number=self._kv_store.add(msg.key, msg.amount)
+            )
+        if isinstance(msg, m.CompileCachePutRequest):
+            ok = self._compile_cache.put(msg.key, msg.payload, msg.meta)
+            return m.OkResponse(success=ok)
+        if isinstance(msg, m.CompileCacheGetRequest):
+            entry = self._compile_cache.get(msg.key)
+            if entry is None:
+                return m.CompileCacheGetResponse(found=False)
+            payload, meta = entry
+            return m.CompileCacheGetResponse(
+                found=True, payload=payload, meta=meta
+            )
+        if isinstance(msg, m.CompileCacheQueryRequest):
+            n = self._compile_cache.covers(msg.topology)
+            stats = self._compile_cache.stats()
+            return m.CompileCacheQueryResponse(
+                covered=n > 0, executables=n,
+                cache_entries=stats["entries"],
+                cache_bytes=stats["bytes"],
             )
         if isinstance(msg, m.ReportBuddyEndpoint):
             self._buddy_endpoints[msg.node_id] = msg.addr
@@ -414,6 +437,7 @@ class MasterServicer:
             coordinator=world.coordinator,
             total_devices=world.total_devices,
             trace_id=self.trace_id,
+            reshard=world.reshard,
         )
 
     def _network_check_group(self, msg: m.NetworkCheckGroupRequest

@@ -1,0 +1,467 @@
+"""Elastic compile cache: serialized AOT executables keyed on
+topology × model-shape × strategy fingerprint (DESIGN.md §17).
+
+The residual per-failure cost after the warm-recovery path (PR 5) is
+XLA recompilation: respawn/rendezvous/restore are ~0, but every
+incarnation re-traces and re-compiles the same program — ~7s on CPU,
+20-30s per real XLA:TPU compile (BENCH_r04 ``compile_s``). ElasWave
+(PAPERS.md 2510.00606) closes this gap by making a membership change a
+resharding event instead of a restart; the enabling piece is that the
+program for the post-change topology must already exist.
+
+This module is the trainer half of that cache:
+
+- ``compile_fingerprint``: canonical key over everything that changes
+  the executable — device topology, mesh axes, model config, strategy,
+  abstract arg shapes/shardings, jax version + backend.
+- ``serialize_executable_blob`` / ``load_executable_blob``: the
+  ``jax.experimental.serialize_executable`` round-trip, wrapped in a
+  CRC-checked envelope (a torn cache file must read as a miss, never a
+  misloaded program).
+- ``CompileCacheClient``: two layers — a node-local directory (shared
+  by every incarnation and the parked standby on the host, the
+  ``DLROVER_TPU_COMPILE_CACHE_DIR`` satellite) in front of the
+  master-served store (``master/kv_store.py::CompileCacheService``)
+  that survives node relaunches and feeds freshly joined hosts.
+- ``load_or_compile``: the one call sites use — returns the loaded
+  executable on a key hit (~0.1s) or compiles, publishes, and returns.
+- ``FallbackPrecompiler``: the AOT-fallback-topology daemon — after a
+  successful rendezvous it lowers and compiles the N−1/N+1 meshes in
+  the background (reusing the offline AOT machinery of
+  ``parallel/dry_run.py``: compile is host-side and needs no exclusive
+  chip access) and publishes them, so the fallback executable is
+  already resident when a node dies.
+
+Module top level is jax-free on purpose: the metrics live in
+``master/kv_store.py`` (one registration site serves both the master
+and this client), and jax is imported lazily so control-plane processes
+can import the fingerprint helpers without initializing a backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import pickle
+import threading
+import time
+import zlib
+from typing import Any, Callable, Sequence
+
+from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.master.kv_store import (
+    cache_hits_total,
+    cache_misses_total,
+    cache_puts_total,
+    topology_tag,
+)
+from dlrover_tpu.telemetry.journal import get_journal
+
+logger = get_logger(__name__)
+
+_ENVELOPE_MAGIC = b"DLRTPU-AOT1"
+
+
+def aot_cache_enabled() -> bool:
+    """The executable cache rides ``serialize_executable`` (a pickled
+    XLA executable + arg tree) — unlike the XLA persistent-cache-dir
+    path it round-trips correctly on this CPU backend, so it defaults
+    on everywhere. ``DLROVER_TPU_AOT_CACHE=0`` turns it off."""
+    return os.environ.get("DLROVER_TPU_AOT_CACHE", "1") != "0"
+
+
+# ----------------------------------------------------------- fingerprinting
+
+
+def _canonical(value: Any) -> Any:
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        return _canonical(dataclasses.asdict(value))
+    if isinstance(value, dict):
+        return {str(k): _canonical(v) for k, v in sorted(value.items())}
+    if isinstance(value, (list, tuple)):
+        return [_canonical(v) for v in value]
+    if isinstance(value, (str, int, float, bool)) or value is None:
+        return value
+    return repr(value)
+
+
+def abstract_signature(tree: Any) -> list:
+    """Shape/dtype/sharding-spec triples of a pytree of abstract args —
+    the part of the fingerprint that pins the program's calling
+    convention (a resharded batch dim or a changed accumulation factor
+    must map to a different executable)."""
+    import jax
+
+    sig = []
+    for leaf in jax.tree_util.tree_leaves(tree):
+        sharding = getattr(leaf, "sharding", None)
+        spec = getattr(sharding, "spec", None)
+        sig.append([
+            list(getattr(leaf, "shape", ())),
+            str(getattr(leaf, "dtype", "?")),
+            repr(spec) if spec is not None else "",
+        ])
+    return sig
+
+
+def compile_fingerprint(
+    *,
+    num_nodes: int,
+    total_devices: int,
+    mesh_axes: dict,
+    model: Any,
+    strategy: Any,
+    args_signature: Any = None,
+    extra: dict | None = None,
+) -> tuple[str, dict]:
+    """(key, inputs): the cache key is ``<topology_tag>/<digest>`` and
+    ``inputs`` is the raw material (stored beside the artifact so a
+    reader verifies the match instead of trusting the digest)."""
+    import jax
+
+    strategy_json = (
+        strategy.to_json() if hasattr(strategy, "to_json")
+        else json.dumps(_canonical(strategy))
+    )
+    inputs = {
+        "num_nodes": int(num_nodes),
+        "total_devices": int(total_devices),
+        "mesh_axes": _canonical(dict(mesh_axes)),
+        "model": _canonical(model),
+        "strategy": json.loads(strategy_json),
+        "args": _canonical(args_signature) if args_signature else [],
+        "jax": jax.__version__,
+        "platform": jax.default_backend(),
+        "extra": _canonical(extra or {}),
+    }
+    digest = hashlib.sha256(
+        json.dumps(inputs, sort_keys=True).encode()
+    ).hexdigest()[:32]
+    tag = topology_tag(total_devices, num_nodes)
+    return f"{tag}/{digest}", inputs
+
+
+# ------------------------------------------------------- artifact envelope
+
+
+def serialize_executable_blob(compiled, inputs: dict) -> bytes:
+    """Envelope a compiled (AOT) executable: magic + crc32 + pickle of
+    the serialize_executable triple and the fingerprint inputs."""
+    from jax.experimental.serialize_executable import serialize
+
+    payload, in_tree, out_tree = serialize(compiled)
+    body = pickle.dumps({
+        "exe": payload,
+        "in_tree": in_tree,
+        "out_tree": out_tree,
+        "inputs": inputs,
+        "created": time.time(),
+    })
+    crc = zlib.crc32(body) & 0xFFFFFFFF
+    return _ENVELOPE_MAGIC + crc.to_bytes(4, "big") + body
+
+
+def load_executable_blob(blob: bytes, expect_inputs: dict | None = None):
+    """Deserialize an envelope back into a callable executable; returns
+    None (a miss) on any damage or fingerprint-input mismatch."""
+    from jax.experimental.serialize_executable import deserialize_and_load
+
+    try:
+        if not blob.startswith(_ENVELOPE_MAGIC):
+            return None
+        off = len(_ENVELOPE_MAGIC)
+        crc = int.from_bytes(blob[off:off + 4], "big")
+        body = blob[off + 4:]
+        if (zlib.crc32(body) & 0xFFFFFFFF) != crc:
+            logger.warning("compile-cache artifact failed CRC; ignoring")
+            return None
+        record = pickle.loads(body)
+        if expect_inputs is not None and record.get("inputs") != \
+                expect_inputs:
+            # digest collision or stale writer: same key, different
+            # program inputs — must read as a miss, never a wrong load
+            logger.warning("compile-cache fingerprint mismatch; ignoring")
+            return None
+        return deserialize_and_load(
+            record["exe"], record["in_tree"], record["out_tree"]
+        )
+    except Exception as e:  # noqa: BLE001 - any damage is a miss
+        logger.warning("compile-cache artifact unusable: %s", e)
+        return None
+
+
+# ----------------------------------------------------------------- client
+
+
+def default_local_dir() -> str:
+    """Node-local artifact dir, shared by every incarnation and the
+    parked standby of one job on the host. ``DLROVER_TPU_COMPILE_CACHE_DIR``
+    overrides (the shared-dir escape hatch); the default is keyed by
+    job name so co-hosted jobs cannot cross-hit."""
+    explicit = os.environ.get(EnvKey.COMPILE_CACHE_SHARED_DIR)
+    if explicit:
+        return os.path.join(explicit, "aot")
+    job = os.environ.get(EnvKey.JOB_NAME, "local") or "local"
+    return os.path.join("/tmp", f"dlrover_tpu_aot_{job}")
+
+
+class CompileCacheClient:
+    """Two-layer artifact cache: node-local directory in front of the
+    master store. ``master_client=None`` (standalone notebooks, tests)
+    degrades to the local layer only."""
+
+    def __init__(self, local_dir: str | None = None, master_client=None,
+                 max_local_files: int = 32):
+        self.local_dir = local_dir or default_local_dir()
+        self.max_local_files = max_local_files
+        self._master = master_client
+        if self._master is None and os.environ.get(EnvKey.MASTER_ADDR):
+            from dlrover_tpu.agent.master_client import MasterClient
+
+            try:
+                self._master = MasterClient.singleton()
+            except RuntimeError:
+                self._master = None
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.local_dir, key.replace("/", "_") + ".aot")
+
+    def get(self, key: str) -> tuple[bytes, str] | None:
+        """(blob, layer) or None. A local hit also refreshes mtime so
+        LRU pruning keeps live topologies resident."""
+        path = self._path(key)
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+            os.utime(path, None)
+            cache_hits_total.labels("local").inc()
+            return blob, "local"
+        except OSError:
+            cache_misses_total.labels("local").inc()
+        if self._master is not None:
+            try:
+                got = self._master.compile_cache_get(key)
+            except (ConnectionError, RuntimeError, OSError) as e:
+                logger.warning("master compile-cache get failed: %s", e)
+                got = None
+            if got is not None:
+                blob = got[0]
+                self._write_local(key, blob)
+                return blob, "master"
+        return None
+
+    def put(self, key: str, blob: bytes, meta: dict | None = None) -> None:
+        self._write_local(key, blob)
+        cache_puts_total.labels("local").inc()
+        if self._master is not None:
+            try:
+                self._master.compile_cache_put(key, blob, meta or {})
+            except (ConnectionError, RuntimeError, OSError) as e:
+                logger.warning("master compile-cache put failed: %s", e)
+
+    def _write_local(self, key: str, blob: bytes) -> None:
+        try:
+            os.makedirs(self.local_dir, exist_ok=True)
+            path = self._path(key)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)  # atomic: readers never see torn files
+            self._prune()
+        except OSError as e:
+            logger.warning("compile-cache local write failed: %s", e)
+
+    def _prune(self) -> None:
+        try:
+            files = [
+                os.path.join(self.local_dir, f)
+                for f in os.listdir(self.local_dir) if f.endswith(".aot")
+            ]
+            if len(files) <= self.max_local_files:
+                return
+            files.sort(key=lambda p: os.path.getmtime(p))
+            for p in files[:len(files) - self.max_local_files]:
+                os.unlink(p)
+        except OSError:
+            pass
+
+
+def launder(tree: Any):
+    """Rebuild a pytree of arrays through a jitted copy so every leaf
+    owns proper per-device buffers.
+
+    Required before handing a RESTORED state to a cached (deserialized)
+    executable that donates its inputs: ``jax.device_put`` on the CPU
+    backend may ADOPT an aligned host buffer (and ``device_get`` hands
+    out views), so the per-device "copies" of a restored leaf can share
+    one host allocation. A deserialized ``Compiled`` skips pjit's input
+    re-staging and, with donation, performs its updates in place — each
+    device's ``step + 1`` then lands on the SAME buffer and compounds
+    (observed: +8 per call on an 8-device mesh, weight corruption when
+    the buffers alias the shm arena). A jitted copy is exactly pjit's
+    re-staging, paid once per restore instead of silently never.
+
+    States produced by jit programs (``compiled.init``, a previous step
+    call) are already properly staged; only host-built trees (snapshot
+    restore, ``reshard_state`` output) need this.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    return jax.jit(lambda t: jax.tree.map(jnp.copy, t))(tree)
+
+
+# ---------------------------------------------------------- load-or-compile
+
+
+@dataclasses.dataclass
+class AotStep:
+    fn: Callable            # the executable, original pytree signature
+    cache_hit: bool
+    source: str             # "local" | "master" | "compiled" | "disabled"
+    seconds: float          # load (hit) or compile+publish (miss) time
+    key: str = ""
+
+
+def load_or_compile(
+    key: str,
+    inputs: dict,
+    compile_fn: Callable[[], Any],
+    cache: CompileCacheClient | None = None,
+) -> AotStep:
+    """The elastic-recovery compile path: serve the executable from the
+    cache when this (topology, model, strategy, shapes) was compiled by
+    ANY earlier incarnation — promoted standby, pre-failure fallback
+    precompile, another node — else compile via ``compile_fn`` (which
+    must return an AOT-compiled executable, i.e. ``jit(...).lower(
+    *abstract).compile()``) and publish the result."""
+    start = time.monotonic()
+    if not aot_cache_enabled():
+        compiled = compile_fn()
+        return AotStep(fn=compiled, cache_hit=False, source="disabled",
+                       seconds=time.monotonic() - start, key=key)
+    cache = cache or CompileCacheClient()
+    got = cache.get(key)
+    if got is not None:
+        loaded = load_executable_blob(got[0], expect_inputs=inputs)
+        if loaded is not None:
+            dur = time.monotonic() - start
+            get_journal().emit("compile_cache", dur=dur, hit=True,
+                               layer=got[1], key=key)
+            logger.info("compile cache HIT (%s) for %s in %.2fs",
+                        got[1], key, dur)
+            return AotStep(fn=loaded, cache_hit=True, source=got[1],
+                           seconds=dur, key=key)
+    compiled = compile_fn()
+    try:
+        blob = serialize_executable_blob(compiled, inputs)
+        cache.put(key, blob, meta={"inputs": inputs, "bytes": len(blob)})
+    except Exception as e:  # noqa: BLE001 - publishing is best-effort
+        logger.warning("compile-cache publish failed: %s", e)
+    dur = time.monotonic() - start
+    get_journal().emit("compile_cache", dur=dur, hit=False,
+                       layer="none", key=key)
+    logger.info("compile cache MISS for %s; compiled+published in %.2fs",
+                key, dur)
+    return AotStep(fn=compiled, cache_hit=False, source="compiled",
+                   seconds=dur, key=key)
+
+
+# --------------------------------------------------- fallback pre-compiler
+
+
+class FallbackPrecompiler:
+    """Ahead-of-time compilation of the N−1/N+1 fallback topologies.
+
+    After each successful rendezvous the trainer starts this daemon; it
+    walks the candidate world sizes, asks ``build_fn(n_nodes)`` for
+    ``(key, inputs, compile_fn)`` (None = that world is infeasible —
+    indivisible mesh, no spare devices), compiles off the training path
+    (XLA compilation is host-side work; like ``parallel/dry_run.py`` it
+    needs no exclusive accelerator access), and publishes the artifact.
+    When a node later dies, the surviving incarnation's
+    ``load_or_compile`` for the N−1 world is a cache hit and recovery
+    skips the cold compile entirely.
+
+    ``budget_s`` bounds total background compile time; already-cached
+    topologies are skipped so re-arming after every rendezvous is
+    cheap.
+    """
+
+    def __init__(
+        self,
+        build_fn: Callable[[int], tuple[str, dict, Callable] | None],
+        world_sizes: Sequence[int],
+        cache: CompileCacheClient | None = None,
+        budget_s: float = 600.0,
+        delay_s: float = 1.0,
+    ):
+        self.build_fn = build_fn
+        self.world_sizes = [n for n in world_sizes if n >= 1]
+        self.cache = cache or CompileCacheClient()
+        self.budget_s = budget_s
+        self.delay_s = delay_s
+        self.results: dict[int, str] = {}  # n_nodes -> outcome
+        self._done = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "FallbackPrecompiler":
+        self._thread = threading.Thread(
+            target=self._run, name="aot-fallback", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def wait(self, timeout: float = 600.0) -> bool:
+        return self._done.wait(timeout)
+
+    def _run(self) -> None:
+        # let the live incarnation's own first compile win the host's
+        # compile threads before background work starts
+        time.sleep(self.delay_s)
+        deadline = time.monotonic() + self.budget_s
+        try:
+            for n in self.world_sizes:
+                if time.monotonic() > deadline:
+                    self.results[n] = "budget_exhausted"
+                    continue
+                t0 = time.monotonic()
+                try:
+                    built = self.build_fn(n)
+                    if built is None:
+                        self.results[n] = "infeasible"
+                        continue
+                    key, inputs, compile_fn = built
+                    if self.cache.get(key) is not None:
+                        self.results[n] = "already_cached"
+                        continue
+                    compiled = compile_fn()
+                    blob = serialize_executable_blob(compiled, inputs)
+                    self.cache.put(key, blob,
+                                   meta={"inputs": inputs,
+                                         "bytes": len(blob)})
+                    self.results[n] = "published"
+                    get_journal().emit(
+                        "aot_fallback", dur=time.monotonic() - t0,
+                        nodes=n, key=key, ok=True,
+                    )
+                    logger.info(
+                        "fallback topology %d nodes pre-compiled and "
+                        "published in %.1fs (%s)", n,
+                        time.monotonic() - t0, key,
+                    )
+                except Exception as e:  # noqa: BLE001 - a failed fallback
+                    # compile must never touch the live incarnation
+                    self.results[n] = f"error: {e}"
+                    get_journal().emit(
+                        "aot_fallback", dur=time.monotonic() - t0,
+                        nodes=n, ok=False,
+                    )
+                    logger.warning(
+                        "fallback precompile for %d nodes failed: %s", n, e
+                    )
+        finally:
+            self._done.set()

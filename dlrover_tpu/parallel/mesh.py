@@ -13,16 +13,25 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Sequence
+import time
+from typing import Any, Sequence
 
 import jax
 import numpy as np
 from jax.experimental import mesh_utils
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.metrics import registry
 
 logger = get_logger(__name__)
+
+_reshard_seconds = registry().histogram(
+    "dlrover_tpu_reshard_seconds",
+    "live state reshard duration (old mesh -> new mesh remap of every "
+    "DP/TP/PP shard)",
+)
 
 # Canonical axis order: slow (DCN-friendly) -> fast (ICI-friendly). Data
 # parallelism tolerates the highest latency (one gradient reduce per step),
@@ -142,3 +151,85 @@ def data_parallel_size(mesh: Mesh) -> int:
 def batch_axes(mesh: Mesh) -> tuple[str, ...]:
     """Mesh axes the global batch dimension is sharded over."""
     return tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+
+
+# -------------------------------------------------------- elastic reshard
+
+
+def remap_spec(spec: PartitionSpec, new_mesh: Mesh) -> PartitionSpec:
+    """Carry a PartitionSpec onto a reshaped mesh: axis names the new
+    mesh kept stay sharded (at the new axis size), names it dropped
+    (e.g. ``tensor`` collapsed to 1 and pruned by ``MeshSpec.resolved``)
+    replicate that dimension. This is the layout half of an elastic
+    N -> N±1 reshape — the math is unchanged, only shard ownership
+    moves."""
+    if spec is None:
+        return PartitionSpec()
+    dims = []
+    for entry in spec:
+        if entry is None:
+            dims.append(None)
+        elif isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in new_mesh.axis_names)
+            dims.append(kept if len(kept) > 1
+                        else (kept[0] if kept else None))
+        else:
+            dims.append(entry if entry in new_mesh.axis_names else None)
+    while dims and dims[-1] is None:
+        dims.pop()
+    return PartitionSpec(*dims)
+
+
+def _leaf_spec(leaf: Any) -> PartitionSpec:
+    sharding = getattr(leaf, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    return spec if spec is not None else PartitionSpec()
+
+
+def reshard_state(old_mesh: Mesh, new_mesh: Mesh, state: Any,
+                  put: Any | None = None) -> Any:
+    """Remap a live train state across a mesh reshape (ElasWave's
+    resharding event): each leaf keeps its logical PartitionSpec, host-
+    gathers its shards off the old mesh, and scatters onto the new one.
+
+    The surviving incarnation resumes on the pre-compiled N−1 program
+    with this state — no restart, no cold ``pjit`` compile. Host-side
+    gather/scatter is deliberate: a device-to-device resharding program
+    would itself need compiling, which is the cost this path exists to
+    avoid. ``put(leaf_host_array, new_sharding)`` overrides the scatter
+    (the checkpoint engine passes a shm-snapshot-backed reader).
+
+    NB: leaves come back as ``device_put``-built arrays. Before handing
+    the result to a cached AOT executable that DONATES its inputs,
+    re-stage it with ``parallel.compile_cache.launder`` (the engine's
+    ``reshard_state`` does this for you) — see launder's docstring for
+    the CPU buffer-adoption hazard.
+    """
+    del old_mesh  # the old layout is read off each leaf's sharding
+    start = time.monotonic()
+    n_leaves = 0
+
+    def _move(leaf):
+        nonlocal n_leaves
+        n_leaves += 1
+        new_sharding = NamedSharding(
+            new_mesh, remap_spec(_leaf_spec(leaf), new_mesh)
+        )
+        if put is not None:
+            return put(leaf, new_sharding)
+        return jax.device_put(np.asarray(jax.device_get(leaf)),
+                              new_sharding)
+
+    out = jax.tree.map(_move, state)
+    dur = time.monotonic() - start
+    _reshard_seconds.observe(dur)
+    get_journal().emit(
+        "reshard", dur=dur, leaves=n_leaves,
+        new_devices=new_mesh.devices.size,
+        new_axes=dict(new_mesh.shape),
+    )
+    logger.info(
+        "resharded %d leaves onto mesh %s (%d devices) in %.3fs",
+        n_leaves, dict(new_mesh.shape), new_mesh.devices.size, dur,
+    )
+    return out

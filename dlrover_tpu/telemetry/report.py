@@ -41,10 +41,29 @@ CATEGORY_OF = {
     "rendezvous_wait": "rendezvous",
     "node_restart": "respawn",
     "compile": "recompile",
+    # under the elastic compile cache (DESIGN.md §17) the XLA compile —
+    # or its ~0.1s cached-executable load — happens inside
+    # load_or_compile BEFORE the first dispatch; this event carries
+    # that cost, while "compile" keeps the (now small) first-step time
+    "compile_cache": "recompile",
     "ckpt_restore": "restore",
 }
 # one vocabulary with bench.py's per-failure phase breakdown
 CATEGORIES = ("respawn", "rendezvous", "restore", "recompile", "redone")
+# recompile splits on the cache outcome (elastic compile cache,
+# DESIGN.md §17): warm = the executable was served from the cache (the
+# interval is a ~0.1s load), cold = a real XLA compile. The flag field
+# is "hit" on compile_cache events and "cache_hit" on first-dispatch
+# compile events; events from before the cache (no flag) count as cold
+# — that is what they were. The subcategories tile the parent:
+# recompile == recompile_warm + recompile_cold (up to interval overlap).
+RECOMPILE_SUBCATEGORIES = ("recompile_warm", "recompile_cold")
+
+
+def _recompile_sub(span: "Span") -> str:
+    hit = (span.fields.get("hit") if span.name == "compile_cache"
+           else span.fields.get("cache_hit"))
+    return "recompile_warm" if hit else "recompile_cold"
 
 
 def load_events(path: str) -> list[dict]:
@@ -219,15 +238,19 @@ def build_report(journal_path: str, goodput_log: str | None = None,
         if cat is None:
             continue
         start, end = span.start, span.end
-        if cat == "recompile" and median > 0:
+        if span.name == "compile" and median > 0:
             # trainer "compile" events time the whole first step; the
             # step's own compute is training, not lost time
             end = max(start, end - median)
         by_cat.setdefault(cat, []).append((start, end))
+        if cat == "recompile":
+            by_cat.setdefault(_recompile_sub(span), []).append(
+                (start, end))
 
     categories = {
         cat: _union_seconds(by_cat.get(cat, ()), window)
-        for cat in CATEGORIES if cat != "redone"
+        for cat in CATEGORIES + RECOMPILE_SUBCATEGORIES
+        if cat != "redone"
     }
     categories["redone"] = (
         greport.redone_steps * median if greport is not None else 0.0
@@ -327,14 +350,17 @@ def _per_incarnation(spans: list[Span],
             else:
                 break
         start, end = span.start, span.end
-        if cat == "recompile" and median > 0:
+        if span.name == "compile" and median > 0:
             end = max(start, end - median)
         per_inc.setdefault(inc, {}).setdefault(cat, []).append((start, end))
+        if cat == "recompile":
+            per_inc.setdefault(inc, {}).setdefault(
+                _recompile_sub(span), []).append((start, end))
     redone = _redone_by_incarnation(goodput_log) if goodput_log else {}
     rows = []
     for inc in sorted(set(per_inc) | set(redone)):
         row: dict = {"incarnation": inc}
-        for cat in CATEGORIES:
+        for cat in CATEGORIES + RECOMPILE_SUBCATEGORIES:
             if cat == "redone":
                 continue
             row[f"{cat}_s"] = round(_union_seconds(
@@ -359,6 +385,13 @@ def format_report(report: LostTimeReport) -> str:
         lines.append(
             f"    {cat:<14}  : {report.categories.get(cat, 0.0):10.2f} s"
         )
+        if cat == "recompile":
+            for sub in RECOMPILE_SUBCATEGORIES:
+                label = sub.replace("recompile_", "· ")
+                lines.append(
+                    f"      {label:<12}  : "
+                    f"{report.categories.get(sub, 0.0):10.2f} s"
+                )
     lines.append(f"    {'unattributed':<14}  : "
                  f"{report.unattributed_s:10.2f} s")
     if report.incarnations:

@@ -84,16 +84,24 @@ def setup_compilation_cache(path: str | None = None) -> str | None:
     torch re-executes eagerly — this cost class only exists under XLA, and
     this is its native fix.
 
-    Default path is host-local (/tmp): it survives process death and
-    restart-in-place. Point DLROVER_TPU_COMPILE_CACHE at job-shared
-    storage to also cover node relaunches onto fresh hosts; set it to
-    ``off`` to disable.
+    Default path is host-local (/tmp), keyed by job name: every
+    incarnation, the parked standby, and a co-started serving replica
+    of ONE job share a single cache dir (a per-process dir would
+    silently re-pay every compile), while co-hosted jobs stay apart.
+    ``DLROVER_TPU_COMPILE_CACHE_DIR`` pins the *location* only (shared
+    NFS, ramdisk, pre-warmed image path) — the platform gating below
+    still decides whether the XLA cache is safe to enable at all.
+    ``DLROVER_TPU_COMPILE_CACHE`` keeps its stronger legacy meaning:
+    an explicit dir there enables the cache anywhere. Either set to
+    ``off`` disables.
     """
     import jax
 
     explicit = path or os.environ.get(EnvKey.COMPILE_CACHE_DIR)
-    if explicit and explicit.lower() in ("off", "none", "0"):
-        return None
+    shared = os.environ.get(EnvKey.COMPILE_CACHE_SHARED_DIR)
+    for v in (explicit, shared):
+        if v and v.lower() in ("off", "none", "0"):
+            return None
     if not explicit:
         # already configured (JAX_COMPILATION_CACHE_DIR env or caller):
         # don't override a deliberate per-job cache location
@@ -118,7 +126,9 @@ def setup_compilation_cache(path: str | None = None) -> str | None:
 
             if importlib.util.find_spec("libtpu") is None:
                 return None
-    cache_dir = explicit or "/tmp/dlrover_tpu_xla_cache"
+    job = os.environ.get(EnvKey.JOB_NAME, "") or "default"
+    cache_dir = explicit or shared or os.path.join(
+        "/tmp/dlrover_tpu_xla_cache", job)
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         # cache even fast compiles: restart storms re-pay them N times

@@ -150,7 +150,15 @@ class ElasticTrainer:
             # steady median.
             self._first_dispatch = False
             _compile_seconds.observe(step_wall)
-            get_journal().emit("compile", dur=step_wall, step=step)
+            # cache_hit distinguishes the warm path (AOT executable
+            # served by the compile cache — this event times only the
+            # load + one step) from a cold XLA compile; the lost-time
+            # report splits the recompile category on it
+            hit = getattr(self.compiled, "cache_hit", None)
+            get_journal().emit(
+                "compile", dur=step_wall, step=step,
+                cache_hit=bool(hit) if hit is not None else None,
+            )
         else:
             get_journal().emit("train_step", dur=step_wall, step=step)
         self._progress.report(step)

@@ -92,6 +92,11 @@ class CompiledTrain:
     init: Callable[..., TrainState]          # (rng, *init_args) -> state
     step: Callable[[TrainState, Any], tuple[TrainState, dict]]
     constrain: Callable[[jax.Array, tuple], jax.Array]
+    # set by the elastic compile-cache path (parallel/compile_cache.py)
+    # when `step` was swapped for a pre-compiled AOT executable: True =
+    # served from cache (warm), False = compiled cold this incarnation,
+    # None = plain jit path (compiles lazily at the first dispatch)
+    cache_hit: bool | None = None
 
 
 def compile_train(

@@ -185,32 +185,6 @@ def main(argv=None) -> int:
         logical_params=tfm.logical_axes(cfg),
         optimizer=optax.adamw(args.lr),
     )
-    state = compiled.init(jax.random.PRNGKey(0))
-
-    # multi-node state is sharded across processes: only the sharded
-    # engine can snapshot it (each node persists its addressable pieces)
-    if args.sharded_ckpt or ctx.num_nodes > 1:
-        from dlrover_tpu.checkpoint.sharded import ShardedCheckpointEngine
-
-        engine = ShardedCheckpointEngine(
-            args.ckpt_dir, node_id=ctx.node_id, node_rank=ctx.node_rank,
-            world_size=ctx.num_nodes,
-        )
-        loaded = engine.load_sharded(state, compiled.state_shardings)
-    else:
-        engine = CheckpointEngine(args.ckpt_dir, node_id=ctx.node_id,
-                                  node_rank=ctx.node_rank,
-                                  world_size=ctx.num_nodes)
-        shard_of = dict(_leaf_paths(compiled.state_shardings))
-        loaded = engine.load(
-            state,
-            put=lambda name, arr: jax.device_put(arr, shard_of[name]),
-            zero_copy=True,
-        )
-    resumed_from = 0
-    if loaded is not None:
-        resumed_from, state = loaded
-        print(f"[trainer] resumed from step {resumed_from}", flush=True)
 
     dp = data_parallel_size(mesh)
     # honor the master's paral-config suggestion (e.g. OOM -> higher grad
@@ -227,11 +201,171 @@ def main(argv=None) -> int:
                   f"micro_batch={micro}", flush=True)
         else:
             micro = max(1, args.global_batch // dp)
+
+    # ---- elastic compile cache (DESIGN.md §17): the train-step
+    # executable for this exact (topology, model, strategy, shapes) may
+    # already exist — compiled by the pre-failure incarnation, by the
+    # fallback-AOT daemon for this world size, or by another node — so
+    # recovery loads it in ~0.1s instead of re-paying the XLA compile.
+    # state/batch abstracts come from eval_shape: no compile, no arrays.
+    from dlrover_tpu.parallel import compile_cache as cc
+
+    state_abs = jax.eval_shape(compiled.init, jax.random.PRNGKey(0))
+
+    def _batch_abstract(mesh_, compiled_, micro_, accum_):
+        step_batch = micro_ * data_parallel_size(mesh_)
+        if args.objective == "mlm":
+            shapes = {"tokens": ((accum_, step_batch, seq), np.int32),
+                      "targets": ((accum_, step_batch, seq), np.int32),
+                      "mlm_mask": ((accum_, step_batch, seq), np.bool_)}
+        else:
+            shapes = {"tokens": ((accum_, step_batch, seq + 1), np.int32)}
+        return {
+            k: jax.ShapeDtypeStruct(shp, dt,
+                                    sharding=compiled_.batch_sharding)
+            for k, (shp, dt) in shapes.items()
+        }
+
+    accum = max(1, args.global_batch // (micro * dp))
+    state_abs_sharded = jax.tree.map(
+        lambda leaf, sh: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                              sharding=sh),
+        state_abs, compiled.state_shardings,
+        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct),
+    )
+    batch_abs = _batch_abstract(mesh, compiled, micro, accum)
+    cache_client = cc.CompileCacheClient()
+    key, key_inputs = cc.compile_fingerprint(
+        num_nodes=ctx.num_nodes,
+        total_devices=len(jax.devices()),
+        mesh_axes=dict(mesh.shape),
+        model=cfg,
+        strategy=strategy,
+        args_signature=cc.abstract_signature((state_abs_sharded,
+                                              batch_abs)),
+        extra={"lr": args.lr, "objective": args.objective},
+    )
+    aot = cc.load_or_compile(
+        key, key_inputs,
+        compile_fn=lambda: compiled.step.lower(
+            state_abs_sharded, batch_abs).compile(),
+        cache=cache_client,
+    )
+    compiled.step = aot.fn
+    compiled.cache_hit = aot.cache_hit
+    verb = "loaded from compile cache" if aot.cache_hit else "compiled"
+    print(f"[trainer] train step {verb} in {aot.seconds:.2f}s "
+          f"({aot.source})", flush=True)
+
+    # multi-node state is sharded across processes: only the sharded
+    # engine can snapshot it (each node persists its addressable pieces)
+    if args.sharded_ckpt or ctx.num_nodes > 1:
+        from dlrover_tpu.checkpoint.sharded import ShardedCheckpointEngine
+
+        state = compiled.init(jax.random.PRNGKey(0))
+        engine = ShardedCheckpointEngine(
+            args.ckpt_dir, node_id=ctx.node_id, node_rank=ctx.node_rank,
+            world_size=ctx.num_nodes,
+        )
+        loaded = engine.load_sharded(state, compiled.state_shardings)
+    else:
+        engine = CheckpointEngine(args.ckpt_dir, node_id=ctx.node_id,
+                                  node_rank=ctx.node_rank,
+                                  world_size=ctx.num_nodes)
+        shard_of = dict(_leaf_paths(compiled.state_shardings))
+        # restore against the ABSTRACT template: every leaf arrives via
+        # device_put from the snapshot, so a successful restore never
+        # pays the init program's compile (the other recompile-class
+        # cost on the recovery path)
+        try:
+            loaded = engine.load(
+                state_abs,
+                put=lambda name, arr: jax.device_put(arr, shard_of[name]),
+                zero_copy=True,
+            )
+        except (KeyError, ValueError) as e:
+            # snapshot from an older model/optimizer shape: fall back to
+            # a fresh init rather than installing mismatched leaves
+            print(f"[trainer] snapshot incompatible ({e}); starting "
+                  "fresh", flush=True)
+            loaded = None
+        if loaded is None:
+            state = compiled.init(jax.random.PRNGKey(0))
+    resumed_from = 0
+    if loaded is not None:
+        resumed_from, state = loaded
+        # restored leaves were built by device_put from host buffers;
+        # the AOT step executable donates its inputs and skips pjit's
+        # input re-staging, so they must be rebuilt into proper
+        # per-device buffers first (see compile_cache.launder —
+        # skipping this corrupts state on the CPU backend)
+        state = cc.launder(state)
+        print(f"[trainer] resumed from step {resumed_from}", flush=True)
+
     trainer = ElasticTrainer(
         compiled,
         global_batch_size=args.global_batch,
         micro_batch_size=micro,
     )
+
+    # ---- fallback-topology AOT daemon: pre-compile the N−1/N+1 worlds
+    # in the background and publish them to the compile cache, so a
+    # membership change finds its executable already resident. Compile
+    # is host-side (parallel/dry_run.py does the same offline), so this
+    # never touches the accelerator's execution stream. Multi-node only
+    # by default: a standalone world has no neighbor topologies.
+    fallback_on = os.environ.get("DLROVER_TPU_FALLBACK_AOT", "")
+    if (fallback_on != "0" and (ctx.num_nodes > 1 or fallback_on == "1")
+            and cc.aot_cache_enabled()):
+        def _build_for_nodes(n_nodes: int):
+            devices = jax.devices()
+            per_node = max(1, len(devices) // ctx.num_nodes)
+            subset = devices[:n_nodes * per_node]
+            if n_nodes == ctx.num_nodes or not subset \
+                    or len(subset) != n_nodes * per_node:
+                return None
+            try:
+                fb_mesh = strategy.build_mesh(subset)
+            except (ValueError, AssertionError):
+                return None  # mesh axes don't divide this world
+            fb = compile_train(
+                strategy=strategy, mesh=fb_mesh,
+                loss_fn=loss_for(strategy, fb_mesh),
+                init_params_fn=lambda rng: tfm.init_params(cfg, rng),
+                logical_params=tfm.logical_axes(cfg),
+                optimizer=optax.adamw(args.lr),
+            )
+            fb_dp = data_parallel_size(fb_mesh)
+            fb_micro = max(1, args.global_batch // fb_dp)
+            if args.global_batch % (fb_micro * fb_dp):
+                return None
+            fb_accum = args.global_batch // (fb_micro * fb_dp)
+            fb_state = jax.eval_shape(fb.init, jax.random.PRNGKey(0))
+            fb_state = jax.tree.map(
+                lambda leaf, sh: jax.ShapeDtypeStruct(
+                    leaf.shape, leaf.dtype, sharding=sh),
+                fb_state, fb.state_shardings,
+                is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct),
+            )
+            fb_batch = _batch_abstract(fb_mesh, fb, fb_micro, fb_accum)
+            fb_key, fb_inputs = cc.compile_fingerprint(
+                num_nodes=n_nodes,
+                total_devices=len(subset),
+                mesh_axes=dict(fb_mesh.shape),
+                model=cfg,
+                strategy=strategy,
+                args_signature=cc.abstract_signature((fb_state, fb_batch)),
+                extra={"lr": args.lr, "objective": args.objective},
+            )
+            return fb_key, fb_inputs, (
+                lambda: fb.step.lower(fb_state, fb_batch).compile()
+            )
+
+        cc.FallbackPrecompiler(
+            _build_for_nodes,
+            world_sizes=[ctx.num_nodes - 1, ctx.num_nodes + 1],
+            cache=cache_client,
+        ).start()
 
     # ---- data: master-fed dynamic shards under the agent, local otherwise
     vocab = cfg.vocab_size
@@ -305,6 +439,10 @@ def main(argv=None) -> int:
         return suggested if suggested > 0 else args.mem_ckpt_interval
 
     def checkpointer(step: int, st) -> None:
+        if os.environ.get("DLROVER_TPU_DEBUG_LEAF"):
+            import jax as _j
+            print(f"[dbg] host={step} leaf={int(_j.device_get(st.step))}",
+                  flush=True)
         if step % mem_interval() == 0:
             if step % args.ckpt_interval == 0:
                 engine.save_to_storage(step, st)

@@ -69,6 +69,10 @@ DOCUMENTED_PREFIXES = (
     "dlrover_tpu_gateway_",
     "dlrover_tpu_standby_",
     "dlrover_tpu_snapshot_interval_",
+    # elastic resharding + compile cache (DESIGN.md §17): the runbook
+    # "failover is recompiling" keys on these names
+    "dlrover_tpu_compile_cache_",
+    "dlrover_tpu_reshard_",
 )
 
 

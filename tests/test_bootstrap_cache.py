@@ -18,6 +18,8 @@ from dlrover_tpu.trainer import bootstrap
 @pytest.fixture()
 def clean_cache_config(monkeypatch):
     monkeypatch.delenv(EnvKey.COMPILE_CACHE_DIR, raising=False)
+    monkeypatch.delenv(EnvKey.COMPILE_CACHE_SHARED_DIR, raising=False)
+    monkeypatch.delenv(EnvKey.JOB_NAME, raising=False)
     monkeypatch.delenv("DLROVER_TPU_PLATFORM", raising=False)
     monkeypatch.delenv("JAX_PLATFORMS", raising=False)
     before = jax.config.jax_compilation_cache_dir
@@ -35,8 +37,37 @@ def test_explicit_cpu_platform_disables(clean_cache_config, monkeypatch):
 def test_tpu_platform_enables_default_dir(clean_cache_config, monkeypatch):
     monkeypatch.setenv("JAX_PLATFORMS", "tpu")
     path = bootstrap.setup_compilation_cache()
-    assert path == "/tmp/dlrover_tpu_xla_cache"
+    assert path == "/tmp/dlrover_tpu_xla_cache/default"
     assert jax.config.jax_compilation_cache_dir == path
+
+
+def test_default_dir_shared_per_job_not_per_process(clean_cache_config,
+                                                    monkeypatch):
+    # one job's incarnations and its parked standby must resolve the
+    # SAME dir (or every respawn silently re-pays its compiles), while
+    # a co-hosted job resolves a different one
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    monkeypatch.setenv(EnvKey.JOB_NAME, "jobA")
+    first = bootstrap.setup_compilation_cache()
+    assert first == bootstrap.setup_compilation_cache()
+    monkeypatch.setenv(EnvKey.JOB_NAME, "jobB")
+    jax.config.update("jax_compilation_cache_dir", None)
+    assert bootstrap.setup_compilation_cache() != first
+
+
+def test_shared_dir_escape_hatch(clean_cache_config, monkeypatch,
+                                 tmp_path):
+    # DLROVER_TPU_COMPILE_CACHE_DIR pins WHERE the node-shared cache
+    # lives; the platform gate still decides WHETHER (XLA:CPU loads
+    # misexecute — an operator relocating the cache must not silently
+    # enable it on CPU)
+    monkeypatch.setenv(EnvKey.COMPILE_CACHE_SHARED_DIR,
+                       str(tmp_path / "shared"))
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    assert bootstrap.setup_compilation_cache() == str(tmp_path / "shared")
+    jax.config.update("jax_compilation_cache_dir", None)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert bootstrap.setup_compilation_cache() is None
 
 
 def test_off_sentinel_wins_over_platform(clean_cache_config, monkeypatch):

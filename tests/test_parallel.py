@@ -377,16 +377,17 @@ class TestAutoStrategy:
 
 
 class TestStrategyNumericEquivalence:
-    # slow tier: cross-layout loss equivalence (tp/fsdp_tp vs dp) holds
-    # on TPU but diverges ~0.1-0.3% on this container's XLA:CPU
-    # (reduction order / dot codegen differs per sharding in this jax
-    # build) — and the test compiles four full strategies, among the
-    # heaviest single tests in tier-1. `pytest tests/` still runs it;
-    # revisit with a numerics-focused pass.
+    # slow tier for COMPILE COST only (four full strategy compiles,
+    # ~20s; tests/test_pipeline.py::test_matches_dp_loss carries the
+    # cross-layout equivalence in tier-1). The bound is the
+    # reduction-order-tolerant one: different shardings reassociate the
+    # bf16-compute reduce trees on XLA:CPU (measured 0.1-0.3% here),
+    # while a genuinely wrong sharding shifts the loss by O(1).
     @pytest.mark.slow
     def test_same_loss_across_strategies(self):
         """DP/FSDP/TP/FSDP+TP are layout choices, not math choices: the
-        same params and batch produce the same loss on every mesh."""
+        same params and batch produce the same loss on every mesh
+        (within the reduction-order bound)."""
         import optax
         from functools import partial
 
@@ -414,9 +415,12 @@ class TestStrategyNumericEquivalence:
             )
             _, metrics = compiled.step(state, batch)
             losses[strat.name] = float(jax.device_get(metrics["loss"]))
+        from tests.test_pipeline import RTOL_CROSS_LAYOUT
+
         ref = losses["dp"]
         for name, loss in losses.items():
-            assert loss == pytest.approx(ref, rel=2e-4), losses
+            assert loss == pytest.approx(ref, rel=RTOL_CROSS_LAYOUT), \
+                losses
 
 
     def test_zero1_shards_opt_state_and_matches_dp(self):
