@@ -158,6 +158,32 @@ def _train_one(extra: dict, prefix: str, model: str, batch: int, seq: int,
     })
     extra["device"] = dev.device_kind
 
+    # live-gauge agreement (DESIGN.md §18 acceptance): drive the
+    # efficiency monitor with the SAME model-FLOPs number and measured
+    # step times a live trainer would see, then read the
+    # dlrover_tpu_mfu gauge back — proving the gauge plumbing (labels,
+    # rolling window, registry) reproduces the bench headline
+    if peak:
+        from dlrover_tpu.telemetry.efficiency import (
+            EfficiencyMonitor,
+            live_mfu,
+        )
+
+        mon = EfficiencyMonitor(
+            model=model, strategy="dp", flops_per_step=flops_per_step,
+            peak_flops=peak, num_devices=1, journal_every=0,
+        )
+        for i in range(1, steps + 1):
+            mon.end_step(i, step_s)
+        live = live_mfu(model, "dp")
+        bench_mfu = extra.get(f"{prefix}mfu")
+        extra[f"{prefix}mfu_live"] = (round(live, 4)
+                                      if live is not None else None)
+        extra[f"{prefix}mfu_live_agree"] = (
+            abs(live - bench_mfu) <= 0.10 * bench_mfu
+            if live is not None and bench_mfu else None
+        )
+
 
 def bench_train_step(extra: dict) -> None:
     """Training MFU. Headline geometry is gpt2-medium (d_model=1024 —
@@ -874,6 +900,31 @@ def _goodput_scenario(extra: dict, prefix: str, child_env: dict,
                     lrep.categories.get(cat, 0.0) / denom, 2)
             extra[f"{prefix}unattributed_s"] = round(
                 lrep.unattributed_s / denom, 2)
+            # steady-state efficiency beside the lost-time numbers
+            # (telemetry/efficiency.py journal samples): where a
+            # HEALTHY step's time goes in the same artifact. Live MFU
+            # appears only on devices with a known peak (not the CPU
+            # harness).
+            eff_rows = lrep.efficiency
+            if eff_rows:
+                def _mean_of(key):
+                    vals = [r[key] for r in eff_rows
+                            if r.get(key) is not None]
+                    return sum(vals) / len(vals) if vals else None
+
+                blocked = _mean_of("host_blocked_pct")
+                if blocked is not None:
+                    extra[f"{prefix}host_blocked_pct"] = round(blocked, 1)
+                mfu_live = _mean_of("mfu_mean")
+                if mfu_live is not None:
+                    extra[f"{prefix}live_mfu"] = round(mfu_live, 4)
+                phases: dict[str, list[float]] = {}
+                for r in eff_rows:
+                    for p, v in (r.get("phase_s") or {}).items():
+                        phases.setdefault(p, []).append(v)
+                for p, vals in sorted(phases.items()):
+                    extra[f"{prefix}phase_{p}_ms"] = round(
+                        1e3 * sum(vals) / len(vals), 3)
         except Exception as e:  # noqa: BLE001 - breakdown is evidence,
             # not a reason to lose the headline numbers
             extra[f"{prefix}phase_breakdown_error"] = str(e)

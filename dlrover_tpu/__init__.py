@@ -62,6 +62,9 @@ _LAZY_API = {
     "StrategyEngineClient": ("dlrover_tpu.parallel.engine_service",
                              "StrategyEngineClient"),
     "flops_breakdown": ("dlrover_tpu.utils.profiler", "flops_breakdown"),
+    # efficiency observatory (DESIGN.md §18)
+    "EfficiencyMonitor": ("dlrover_tpu.telemetry.efficiency",
+                          "EfficiencyMonitor"),
 }
 
 

@@ -428,10 +428,28 @@ class ElasticAgent:
             if chaos.ENABLED:
                 self._chaos_kill_check()
             # healthy: check for membership changes / master actions
-            if self._master_action() == "restart":
+            action = self._master_action()
+            if action == "restart":
                 self._restart_workers(reason="master restart action")
+            elif action.startswith("profile"):
+                self._arm_profile(action)
             elif self._membership_changed():
                 self._restart_workers(reason="membership change")
+
+    def _arm_profile(self, action: str) -> None:
+        """Master-requested on-demand profiler capture ("profile:<K>"):
+        hand the request to the live trainer via the bundle-root file
+        (telemetry/efficiency.py) — the trainer owns the jax runtime,
+        so the capture must run there, not here."""
+        from dlrover_tpu.telemetry.efficiency import arm_profile_request
+
+        try:
+            steps = max(1, int(action.split(":", 1)[1]))
+        except (IndexError, ValueError):
+            steps = 5
+        arm_profile_request(self._config.node_id, steps)
+        logger.info("profiler capture armed for the trainer "
+                    "(%d steps)", steps)
 
     def _chaos_kill_check(self) -> None:
         """Chaos plan ``agent_kill_trainer`` point: kill the live trainer

@@ -258,6 +258,15 @@ class MasterClient:
             m.DebugBundleListRequest(node_id=self.node_id)
         ).bundles
 
+    def request_profile(self, node_id: int, steps: int = 5
+                        ) -> m.ProfileResponse:
+        """Arm an on-demand jax.profiler capture on ``node_id`` for
+        ``steps`` train steps (telemetry/efficiency.py); the xplane
+        trace lands as a debug bundle on that node."""
+        return self._client.call(
+            m.ProfileRequest(node_id=node_id, steps=steps)
+        )
+
     def get_running_nodes(self) -> list[m.NodeMeta]:
         return self._client.call(m.RunningNodesRequest()).nodes
 

@@ -438,6 +438,28 @@ class DebugBundleReport:
 
 @register_message
 @dataclasses.dataclass
+class ProfileRequest:
+    """Operator -> master: arm an on-demand ``jax.profiler`` capture on
+    ONE node for ``steps`` train steps (telemetry/efficiency.py). The
+    master queues a ``profile:<steps>`` action on the node's heartbeat
+    channel (``NodeManager.send_action`` — the same targeted rung the
+    straggler restart uses); the agent hands it to the trainer via the
+    bundle-root request file, and the xplane trace comes back through
+    the debug-bundle transport."""
+
+    node_id: int = 0
+    steps: int = 5
+
+
+@register_message
+@dataclasses.dataclass
+class ProfileResponse:
+    armed: bool = False
+    reason: str = ""
+
+
+@register_message
+@dataclasses.dataclass
 class DebugBundleListRequest:
     node_id: int = 0
 

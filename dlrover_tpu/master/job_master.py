@@ -130,19 +130,24 @@ class JobMaster:
         self.anomaly.remove_node(node_id)
 
     def metrics_text(self) -> str:
-        """Master registry + every node's pushed snapshot, one scrape."""
-        from dlrover_tpu.telemetry.exposition import render, render_snapshot
+        """Master registry + every node's pushed snapshot, one scrape.
 
-        parts = [render(extra_labels={"role": "master"})]
+        Rendered family-grouped with one ``# HELP``/``# TYPE`` pair per
+        family (promtool-parseable): node-pushed families the master
+        never registers (train step/phase histograms, MFU gauges) get
+        their meta from the pushing node's snapshot.
+        """
+        from dlrover_tpu.telemetry.exposition import render_grouped
+        from dlrover_tpu.telemetry.metrics import registry
+
+        parts = [(registry().snapshot(), {"role": "master"})]
         for (node_id, role), samples in sorted(
             self.servicer.node_metrics_snapshots().items()
         ):
-            parts.append(render_snapshot(
-                samples,
-                extra_labels={"node": str(node_id), "role": role},
-                emit_meta=False,
-            ))
-        return "".join(parts)
+            parts.append(
+                (samples, {"node": str(node_id), "role": role})
+            )
+        return render_grouped(parts)
 
     def prepare(self) -> None:
         from dlrover_tpu.telemetry.exposition import start_from_env

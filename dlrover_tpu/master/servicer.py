@@ -238,6 +238,20 @@ class MasterServicer:
         if isinstance(msg, m.DebugBundleListRequest):
             with self._bundles_lock:
                 return m.DebugBundleListResponse(bundles=list(self._bundles))
+        if isinstance(msg, m.ProfileRequest):
+            # targeted capture: delivered on the node's next heartbeat
+            # (seconds), captured for K steps, shipped back as a debug
+            # bundle the ledger above lists
+            steps = max(1, int(msg.steps or 1))
+            ok = self._node_manager.send_action(
+                msg.node_id, f"profile:{steps}"
+            )
+            logger.info("profile request for node %d (%d steps): %s",
+                        msg.node_id, steps,
+                        "armed" if ok else "node not running")
+            return m.ProfileResponse(
+                armed=ok, reason="" if ok else "node not running"
+            )
         if isinstance(msg, m.GlobalStepReport):
             self._speed_monitor.report_step(msg.step, msg.timestamp)
             return m.OkResponse()

@@ -147,9 +147,22 @@ def compile_fingerprint(
 # ------------------------------------------------------- artifact envelope
 
 
-def serialize_executable_blob(compiled, inputs: dict) -> bytes:
+def executable_stats(compiled) -> dict:
+    """Cheap post-compile facts worth caching beside the executable —
+    today the program's FLOPs (XLA cost analysis), the number the live
+    MFU gauge needs. Computed ONCE at compile time and stored in the
+    envelope, so a warm cache load never re-lowers just to count."""
+    from dlrover_tpu.utils.profiler import executable_flops
+
+    flops = executable_flops(compiled)
+    return {"flops": flops} if flops > 0 else {}
+
+
+def serialize_executable_blob(compiled, inputs: dict,
+                              stats: dict | None = None) -> bytes:
     """Envelope a compiled (AOT) executable: magic + crc32 + pickle of
-    the serialize_executable triple and the fingerprint inputs."""
+    the serialize_executable triple, the fingerprint inputs, and
+    post-compile ``stats`` (``executable_stats``; None = compute)."""
     from jax.experimental.serialize_executable import serialize
 
     payload, in_tree, out_tree = serialize(compiled)
@@ -158,17 +171,15 @@ def serialize_executable_blob(compiled, inputs: dict) -> bytes:
         "in_tree": in_tree,
         "out_tree": out_tree,
         "inputs": inputs,
+        "stats": executable_stats(compiled) if stats is None else stats,
         "created": time.time(),
     })
     crc = zlib.crc32(body) & 0xFFFFFFFF
     return _ENVELOPE_MAGIC + crc.to_bytes(4, "big") + body
 
 
-def load_executable_blob(blob: bytes, expect_inputs: dict | None = None):
-    """Deserialize an envelope back into a callable executable; returns
-    None (a miss) on any damage or fingerprint-input mismatch."""
-    from jax.experimental.serialize_executable import deserialize_and_load
-
+def _parse_blob(blob: bytes) -> dict | None:
+    """CRC-checked envelope record, or None on any damage."""
     try:
         if not blob.startswith(_ENVELOPE_MAGIC):
             return None
@@ -179,6 +190,29 @@ def load_executable_blob(blob: bytes, expect_inputs: dict | None = None):
             logger.warning("compile-cache artifact failed CRC; ignoring")
             return None
         record = pickle.loads(body)
+        return record if isinstance(record, dict) else None
+    except Exception as e:  # noqa: BLE001 - any damage is a miss
+        logger.warning("compile-cache artifact unusable: %s", e)
+        return None
+
+
+def blob_stats(blob: bytes) -> dict:
+    """The cached post-compile stats of an envelope ({} on damage or
+    pre-stats blobs) — read WITHOUT deserializing the executable."""
+    record = _parse_blob(blob)
+    stats = (record or {}).get("stats")
+    return dict(stats) if isinstance(stats, dict) else {}
+
+
+def load_executable_blob(blob: bytes, expect_inputs: dict | None = None):
+    """Deserialize an envelope back into a callable executable; returns
+    None (a miss) on any damage or fingerprint-input mismatch."""
+    from jax.experimental.serialize_executable import deserialize_and_load
+
+    try:
+        record = _parse_blob(blob)
+        if record is None:
+            return None
         if expect_inputs is not None and record.get("inputs") != \
                 expect_inputs:
             # digest collision or stale writer: same key, different
@@ -324,6 +358,10 @@ class AotStep:
     source: str             # "local" | "master" | "compiled" | "disabled"
     seconds: float          # load (hit) or compile+publish (miss) time
     key: str = ""
+    # compiled-program FLOPs per call (XLA cost analysis) — computed at
+    # compile time and cached in the envelope, so warm loads feed the
+    # live MFU gauge without re-lowering; 0.0 when unknown
+    flops: float = 0.0
 
 
 def load_or_compile(
@@ -342,23 +380,28 @@ def load_or_compile(
     if not aot_cache_enabled():
         compiled = compile_fn()
         return AotStep(fn=compiled, cache_hit=False, source="disabled",
-                       seconds=time.monotonic() - start, key=key)
+                       seconds=time.monotonic() - start, key=key,
+                       flops=executable_stats(compiled).get("flops", 0.0))
     cache = cache or CompileCacheClient()
     got = cache.get(key)
     if got is not None:
         loaded = load_executable_blob(got[0], expect_inputs=inputs)
         if loaded is not None:
             dur = time.monotonic() - start
+            stats = blob_stats(got[0])
             get_journal().emit("compile_cache", dur=dur, hit=True,
                                layer=got[1], key=key)
             logger.info("compile cache HIT (%s) for %s in %.2fs",
                         got[1], key, dur)
             return AotStep(fn=loaded, cache_hit=True, source=got[1],
-                           seconds=dur, key=key)
+                           seconds=dur, key=key,
+                           flops=float(stats.get("flops", 0.0) or 0.0))
     compiled = compile_fn()
+    stats = executable_stats(compiled)
     try:
-        blob = serialize_executable_blob(compiled, inputs)
-        cache.put(key, blob, meta={"inputs": inputs, "bytes": len(blob)})
+        blob = serialize_executable_blob(compiled, inputs, stats=stats)
+        cache.put(key, blob, meta={"inputs": inputs, "bytes": len(blob),
+                                   "stats": stats})
     except Exception as e:  # noqa: BLE001 - publishing is best-effort
         logger.warning("compile-cache publish failed: %s", e)
     dur = time.monotonic() - start
@@ -367,7 +410,8 @@ def load_or_compile(
     logger.info("compile cache MISS for %s; compiled+published in %.2fs",
                 key, dur)
     return AotStep(fn=compiled, cache_hit=False, source="compiled",
-                   seconds=dur, key=key)
+                   seconds=dur, key=key,
+                   flops=float(stats.get("flops", 0.0) or 0.0))
 
 
 # --------------------------------------------------- fallback pre-compiler

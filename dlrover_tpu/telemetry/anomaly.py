@@ -22,10 +22,18 @@ threshold from flapping verdicts. A robust z-score
 (0.6745 x (node - median) / MAD) is journaled as evidence alongside the
 median-ratio score. Verdict transitions are journaled
 (``straggler_verdict`` spans), exported as
-``dlrover_tpu_straggler_score{node}`` gauges, and fed to
-``DiagnosisManager`` so the failure ladder sees runtime stragglers next
-to probe-detected ones and the master can prefer restarting the slow
-node over restarting the job.
+``dlrover_tpu_straggler_score{node,straggler_phase}`` gauges, and fed
+to ``DiagnosisManager`` so the failure ladder sees runtime stragglers
+next to probe-detected ones and the master can prefer restarting the
+slow node over restarting the job.
+
+Phase attribution (DESIGN.md §18): the same pushed snapshots carry the
+step-phase histograms (``telemetry/efficiency.py``); the detector
+keeps per-phase mean-seconds windows from their (sum, count) deltas
+and stamps each flagged verdict with the node's dominant phase — a
+straggler slow on ``data_wait`` is a data problem, not a sick chip.
+The phase rides the journal verdict (``phase`` field) and the
+``straggler_phase`` gauge label.
 """
 
 from __future__ import annotations
@@ -34,6 +42,7 @@ import statistics
 import threading
 from collections import deque
 
+from dlrover_tpu.telemetry.efficiency import PHASE_METRIC, PHASES
 from dlrover_tpu.telemetry.journal import get_journal
 from dlrover_tpu.telemetry.metrics import registry
 
@@ -42,8 +51,10 @@ STEP_METRIC = "dlrover_tpu_train_step_seconds"
 _score_gauge = registry().gauge(
     "dlrover_tpu_straggler_score",
     "per-node median step time over the fleet median (>1 = slower; "
-    "flagged while above the detector ratio)",
-    label_names=("node",),
+    "flagged while above the detector ratio). straggler_phase carries "
+    "the dominant step phase while flagged (data_wait/h2d/dispatch/"
+    "block/ckpt), empty when healthy or unattributed",
+    label_names=("node", "straggler_phase"),
 )
 _verdicts_total = registry().counter(
     "dlrover_tpu_straggler_verdicts_total",
@@ -67,9 +78,28 @@ def _step_stats(samples: list) -> tuple[float, int] | None:
     return None
 
 
+def _phase_stats(samples: list) -> dict[str, tuple[float, int]]:
+    """Per-phase (sum, count) of the step-phase histogram in a pushed
+    snapshot (telemetry/efficiency.py families); {} when absent."""
+    out: dict[str, tuple[float, int]] = {}
+    for metric in samples:
+        if not isinstance(metric, dict) \
+                or metric.get("name") != PHASE_METRIC:
+            continue
+        for sample in metric.get("samples", ()):
+            phase = (sample.get("labels") or {}).get("phase", "")
+            if phase not in PHASES:
+                continue
+            prev = out.get(phase, (0.0, 0))
+            out[phase] = (prev[0] + float(sample.get("sum", 0.0)),
+                          prev[1] + int(sample.get("count", 0)))
+    return out
+
+
 class _NodeSeries:
     __slots__ = ("cum_sum", "cum_count", "points", "flagged", "streak",
-                 "acted")
+                 "acted", "phase_cum", "phase_points", "phase",
+                 "gauge_phase")
 
     def __init__(self, window: int):
         self.cum_sum = 0.0
@@ -78,9 +108,31 @@ class _NodeSeries:
         self.flagged = False
         self.streak = 0   # consecutive evaluations flagged
         self.acted = False  # a restart was already issued this episode
+        # per-phase cumulative (sum, count) + recent mean-seconds window
+        # (same delta trick as the step series) — the verdict's
+        # dominant-phase evidence
+        self.phase_cum: dict[str, tuple[float, int]] = {}
+        self.phase_points: dict[str, deque[float]] = {
+            p: deque(maxlen=window) for p in PHASES
+        }
+        self.phase = ""        # dominant phase while flagged
+        self.gauge_phase = ""  # label the score gauge was last set under
 
     def recent(self) -> float:
         return statistics.median(self.points)
+
+    def dominant_phase(self) -> str:
+        """The phase eating the most per-step seconds in the recent
+        window; '' when no phase series arrived (pre-efficiency
+        trainers, agent-role snapshots)."""
+        best, best_s = "", 0.0
+        for phase, points in self.phase_points.items():
+            if not points:
+                continue
+            med = statistics.median(points)
+            if med > best_s:
+                best, best_s = phase, med
+        return best
 
 
 class StragglerDetector:
@@ -123,22 +175,44 @@ class StragglerDetector:
             series.cum_sum, series.cum_count = total, count
             if dcount > 0:
                 series.points.append(dsum / dcount)
+            for phase, (psum, pcount) in _phase_stats(samples).items():
+                prev = series.phase_cum.get(phase, (0.0, 0))
+                dps, dpc = psum - prev[0], pcount - prev[1]
+                if dpc < 0 or dps < 0:  # respawn reset
+                    dps, dpc = psum, pcount
+                series.phase_cum[phase] = (psum, pcount)
+                if dpc > 0:
+                    series.phase_points[phase].append(dps / dpc)
             transitions = self._evaluate_locked()
-        for node, flagged, score, z in transitions:
-            self._publish(node, flagged, score, z)
+        for node, flagged, score, z, phase in transitions:
+            self._publish(node, flagged, score, z, phase)
 
     def remove_node(self, node_id: int) -> None:
         """Forget a departed node so a relaunched id starts clean."""
         with self._lock:
             series = self._nodes.pop(node_id, None)
             was_flagged = bool(series and series.flagged)
-        _score_gauge.labels(str(node_id)).set(0.0)
+            stale = series.gauge_phase if series else ""
+        if stale:
+            _score_gauge.labels(str(node_id), stale).set(0.0)
+        _score_gauge.labels(str(node_id), "").set(0.0)
         if was_flagged and self._diagnosis is not None:
             self._diagnosis.set_runtime_straggler(node_id, False)
 
     # ------------------------------------------------------------ verdicts
 
-    def _evaluate_locked(self) -> list[tuple[int, bool, float, float]]:
+    def _set_score(self, nid: int, series: _NodeSeries,
+                   value: float) -> None:
+        """Set the score gauge under the series' current phase label,
+        zeroing the series left under a previous phase so a changed
+        attribution never leaves a stale duplicate."""
+        if series.gauge_phase != series.phase:
+            _score_gauge.labels(str(nid), series.gauge_phase).set(0.0)
+            series.gauge_phase = series.phase
+        _score_gauge.labels(str(nid), series.phase).set(value)
+
+    def _evaluate_locked(self
+                         ) -> list[tuple[int, bool, float, float, str]]:
         recents = {
             nid: s.recent() for nid, s in self._nodes.items()
             if len(s.points) >= self._min_points
@@ -149,7 +223,7 @@ class StragglerDetector:
         if med <= 0:
             return []
         mad = statistics.median(abs(v - med) for v in recents.values())
-        transitions: list[tuple[int, bool, float, float]] = []
+        transitions: list[tuple[int, bool, float, float, str]] = []
         for nid, val in recents.items():
             score = val / med
             z = 0.6745 * (val - med) / mad if mad > 0 else 0.0
@@ -157,27 +231,35 @@ class StragglerDetector:
             if not series.flagged and score > self._ratio:
                 series.flagged = True
                 series.streak = 1
-                transitions.append((nid, True, score, z))
+                # attribute the verdict to its dominant phase NOW, from
+                # the same window that tripped the threshold
+                series.phase = series.dominant_phase()
+                transitions.append((nid, True, score, z, series.phase))
             elif series.flagged and score < self._clear_ratio:
                 series.flagged = False
                 series.streak = 0
                 series.acted = False
-                transitions.append((nid, False, score, z))
+                phase, series.phase = series.phase, ""
+                transitions.append((nid, False, score, z, phase))
             elif series.flagged:
                 series.streak += 1
-                _score_gauge.labels(str(nid)).set(round(score, 4))
+                self._set_score(nid, series, round(score, 4))
             else:
-                _score_gauge.labels(str(nid)).set(round(score, 4))
+                self._set_score(nid, series, round(score, 4))
         return transitions
 
     def _publish(self, node_id: int, flagged: bool, score: float,
-                 z: float) -> None:
+                 z: float, phase: str) -> None:
         state = "flagged" if flagged else "cleared"
-        _score_gauge.labels(str(node_id)).set(round(score, 4))
+        with self._lock:
+            series = self._nodes.get(node_id)
+            if series is not None:
+                self._set_score(node_id, series, round(score, 4))
         _verdicts_total.labels(state).inc()
         get_journal().emit(
             "straggler_verdict", node=node_id, state=state,
             score=round(score, 4), robust_z=round(z, 4),
+            phase=phase or None,
         )
         if self._diagnosis is not None:
             self._diagnosis.set_runtime_straggler(node_id, flagged, score)

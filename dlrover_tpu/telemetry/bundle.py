@@ -36,6 +36,7 @@ from __future__ import annotations
 import faulthandler
 import json
 import os
+import shutil
 import signal
 import socket
 import sys
@@ -204,10 +205,14 @@ def _env_manifest() -> dict[str, str]:
 def write_bundle(reason: str, *, node_id: int | None = None,
                  child_pid: int | None = None, extra: dict | None = None,
                  out_root: str | None = None,
-                 journal_tail: int = JOURNAL_TAIL_LINES) -> str | None:
+                 journal_tail: int = JOURNAL_TAIL_LINES,
+                 attach: dict | None = None) -> str | None:
     """Write one self-contained bundle dir; returns its path (None on
     failure). Never raises. ``child_pid`` asks a live trainer child for
-    its C-level stack dump before snapshotting."""
+    its C-level stack dump before snapshotting. ``attach`` maps bundle
+    subdir names to existing files/dirs copied in whole — the transport
+    the on-demand profiler capture ships its xplane trace through
+    (telemetry/efficiency.py)."""
     try:
         if node_id is None:
             node_id = int(os.environ.get(EnvKey.NODE_ID, "0"))
@@ -239,6 +244,20 @@ def write_bundle(reason: str, *, node_id: int | None = None,
         with open(os.path.join(path, "metrics.json"), "w") as f:
             json.dump(registry().snapshot(), f, indent=1)
 
+        attached = []
+        for arcname, src in sorted((attach or {}).items()):
+            dst = os.path.join(path, os.path.basename(str(arcname)))
+            try:
+                if os.path.isdir(src):
+                    shutil.copytree(src, dst, dirs_exist_ok=True)
+                elif os.path.exists(src):
+                    shutil.copy2(src, dst)
+                else:
+                    continue
+                attached.append(os.path.basename(str(arcname)))
+            except OSError as e:
+                logger.warning("bundle attach %s failed: %s", src, e)
+
         manifest = {
             "reason": reason,
             "written_at": time.time(),
@@ -252,6 +271,7 @@ def write_bundle(reason: str, *, node_id: int | None = None,
             "argv": list(sys.argv),
             "threads": [t.name for t in threading.enumerate()],
             "child_stacks": bool(child_dump),
+            "attached": attached,
             "env": _env_manifest(),
             "devices": _device_manifest(),
         }

@@ -48,42 +48,84 @@ def _fmt(value: float) -> str:
     return repr(int(f)) if f == int(f) else repr(f)
 
 
+def _meta_lines(metric: dict, lines: list[str]) -> None:
+    """``# HELP``/``# TYPE`` for one family — HELP always emitted (the
+    registry requires help text on contract families; promtool treats a
+    family without HELP as a lint warning), TYPE always."""
+    name = metric["name"]
+    if metric.get("help"):
+        lines.append(f"# HELP {name} {_escape(metric['help'])}")
+    lines.append(f"# TYPE {name} {metric['type']}")
+
+
+def _sample_lines(metric: dict, extra_labels: dict | None,
+                  lines: list[str]) -> None:
+    name, mtype = metric["name"], metric["type"]
+    for sample in metric["samples"]:
+        labels = sample.get("labels", {})
+        if mtype == "histogram":
+            bounds = list(metric.get("buckets", ())) + [math.inf]
+            cumulative = 0
+            for bound, n in zip(bounds, sample["buckets"]):
+                cumulative += n
+                lines.append(
+                    f"{name}_bucket"
+                    f"{_labels_text(labels, {**(extra_labels or {}), 'le': _fmt(bound)})}"
+                    f" {cumulative}"
+                )
+            lines.append(
+                f"{name}_sum{_labels_text(labels, extra_labels)}"
+                f" {_fmt(sample['sum'])}"
+            )
+            lines.append(
+                f"{name}_count{_labels_text(labels, extra_labels)}"
+                f" {sample['count']}"
+            )
+        else:
+            lines.append(
+                f"{name}{_labels_text(labels, extra_labels)}"
+                f" {_fmt(sample['value'])}"
+            )
+
+
 def render_snapshot(snapshot: list[dict], extra_labels: dict | None = None,
                     emit_meta: bool = True) -> str:
     """Render a ``MetricsRegistry.snapshot()`` (possibly from another
     process) to Prometheus text format."""
     lines: list[str] = []
     for metric in snapshot:
-        name, mtype = metric["name"], metric["type"]
         if emit_meta:
-            if metric.get("help"):
-                lines.append(f"# HELP {name} {_escape(metric['help'])}")
-            lines.append(f"# TYPE {name} {mtype}")
-        for sample in metric["samples"]:
-            labels = sample.get("labels", {})
-            if mtype == "histogram":
-                bounds = list(metric.get("buckets", ())) + [math.inf]
-                cumulative = 0
-                for bound, n in zip(bounds, sample["buckets"]):
-                    cumulative += n
-                    lines.append(
-                        f"{name}_bucket"
-                        f"{_labels_text(labels, {**(extra_labels or {}), 'le': _fmt(bound)})}"
-                        f" {cumulative}"
-                    )
-                lines.append(
-                    f"{name}_sum{_labels_text(labels, extra_labels)}"
-                    f" {_fmt(sample['sum'])}"
-                )
-                lines.append(
-                    f"{name}_count{_labels_text(labels, extra_labels)}"
-                    f" {sample['count']}"
-                )
-            else:
-                lines.append(
-                    f"{name}{_labels_text(labels, extra_labels)}"
-                    f" {_fmt(sample['value'])}"
-                )
+            _meta_lines(metric, lines)
+        _sample_lines(metric, extra_labels, lines)
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def render_grouped(parts) -> str:
+    """Render several snapshots as ONE promtool-parseable exposition.
+
+    ``parts`` is an iterable of ``(snapshot, extra_labels | None)``.
+    Prometheus' text format requires every sample of a family to sit
+    contiguously under a single ``# HELP``/``# TYPE`` pair — naive
+    concatenation of per-node renders interleaves families and repeats
+    meta lines, which the stricter parsers reject. Here families are
+    merged across all snapshots first (meta from the first snapshot
+    carrying the family; each sample keeps its own snapshot's bucket
+    bounds), which is what the master's one-scrape endpoint serves.
+    """
+    families: dict[str, list[tuple[dict, dict | None]]] = {}
+    order: list[str] = []
+    for snapshot, extra in parts:
+        for metric in snapshot:
+            name = metric["name"]
+            if name not in families:
+                families[name] = []
+                order.append(name)
+            families[name].append((metric, extra))
+    lines: list[str] = []
+    for name in sorted(order):
+        _meta_lines(families[name][0][0], lines)
+        for metric, extra in families[name]:
+            _sample_lines(metric, extra, lines)
     return "\n".join(lines) + ("\n" if lines else "")
 
 

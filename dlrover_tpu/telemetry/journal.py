@@ -21,9 +21,11 @@ Span taxonomy (names are load-bearing for ``telemetry/report.py`` and
 every name is documented in DESIGN.md): ``rdzv_round`` / ``job_start`` /
 ``job_end`` / ``straggler_verdict`` / ``snapshot_interval_retune``
 (master), ``rendezvous_wait`` / ``node_restart`` / ``ckpt_persist`` /
-``hang_verdict`` / ``debug_bundle`` / ``standby_promote`` (agent),
-``compile`` / ``train_step`` / ``ckpt_restore`` / ``restore_prefetch``
-(trainer), ``gateway_*`` (serving gateway).
+``hang_verdict`` / ``debug_bundle`` / ``standby_promote`` /
+``profile_request`` (agent), ``compile`` / ``train_step`` /
+``ckpt_restore`` / ``restore_prefetch`` / ``metrics_sample`` /
+``step_phase`` / ``profile_capture`` (trainer), ``gateway_*`` (serving
+gateway).
 
 Rotation: when ``DLROVER_TPU_JOURNAL_MAX_MB`` is set, a file that
 reaches the cap is atomically renamed to ``.1`` (replacing the previous

@@ -19,6 +19,14 @@ totals, the report attributes the same phases **per incarnation**
 (windows between ``node_restart`` spans, keyed by their journaled
 incarnation number), so a single slow recovery is visible instead of
 averaged away.
+
+Beside the lost-time table the report renders a **steady-state
+efficiency** table (DESIGN.md §18) from the trainer's journaled
+``metrics_sample``/``step_phase`` points: per-incarnation MFU,
+mean step time, %-of-samples host-blocked, and the phase breakdown —
+"where does a healthy step go" next to "where did the failures' time
+go". ``--format json`` emits the whole report as one stable-keyed
+document for bench/CI consumption.
 """
 
 from __future__ import annotations
@@ -188,6 +196,12 @@ class LostTimeReport:
     #  "restore_s": ..., "recompile_s": ..., "redone_steps": ...,
     #  "redone_s": ...}
     incarnations: list[dict] = dataclasses.field(default_factory=list)
+    # steady-state efficiency rows per incarnation, from the trainer's
+    # journaled metrics_sample/step_phase points
+    # (telemetry/efficiency.py): {"incarnation", "samples", "mfu_mean",
+    # "mfu_min", "mfu_max", "step_s_mean", "host_blocked_pct",
+    # "phase_s": {phase: mean seconds}, "phase_pct": {phase: share}}
+    efficiency: list[dict] = dataclasses.field(default_factory=list)
 
     def to_dict(self) -> dict:
         d = {
@@ -201,6 +215,7 @@ class LostTimeReport:
             "n_spans": self.n_spans,
             "traces": self.traces,
             "incarnations": self.incarnations,
+            "efficiency": self.efficiency,
         }
         if self.goodput_report is not None:
             d["goodput_report"] = self.goodput_report.to_dict()
@@ -239,8 +254,12 @@ def build_report(journal_path: str, goodput_log: str | None = None,
             continue
         start, end = span.start, span.end
         if span.name == "compile" and median > 0:
-            # trainer "compile" events time the whole first step; the
-            # step's own compute is training, not lost time
+            # older journals' "compile" events timed the whole first
+            # step (compute included); current trainers emit the
+            # pre-block dispatch wall. Netting a steady median (clamped
+            # at zero) corrects the former and at most trims one step
+            # off a real compile for the latter — conservative either
+            # way: the step's own compute is training, not lost time
             end = max(start, end - median)
         by_cat.setdefault(cat, []).append((start, end))
         if cat == "recompile":
@@ -283,6 +302,7 @@ def build_report(journal_path: str, goodput_log: str | None = None,
             spans, window, median,
             goodput_log if greport is not None else None,
         ),
+        efficiency=_efficiency_rows(spans),
     )
 
 
@@ -313,6 +333,106 @@ def _redone_by_incarnation(goodput_log: str) -> dict[int, int]:
     return redone
 
 
+def _incarnation_bounds(spans: list[Span]) -> list[tuple[int, float]]:
+    """(incarnation, window_start) bins from ``node_restart`` spans;
+    incarnation 0 runs from the beginning."""
+    restarts = sorted(
+        (s for s in spans if s.name == "node_restart"),
+        key=lambda s: s.start,
+    )
+    bounds: list[tuple[int, float]] = [(0, float("-inf"))]
+    for s in restarts:
+        try:
+            inc = int(s.fields.get("incarnation", bounds[-1][0] + 1))
+        except (TypeError, ValueError):
+            inc = bounds[-1][0] + 1
+        if inc == bounds[-1][0]:
+            continue  # another node's restart for the same incarnation
+        bounds.append((inc, s.start))
+    return bounds
+
+
+def _bin_incarnation(bounds: list[tuple[int, float]], t: float) -> int:
+    inc = bounds[0][0]
+    for b_inc, b_start in bounds:
+        if t >= b_start:
+            inc = b_inc
+        else:
+            break
+    return inc
+
+
+def _efficiency_rows(spans: list[Span]) -> list[dict]:
+    """Steady-state efficiency per incarnation from the trainer's
+    journaled ``metrics_sample``/``step_phase`` points
+    (telemetry/efficiency.py): MFU summary, mean step time, per-phase
+    seconds and share of step, and the %-of-samples host-blocked — the
+    table that answers "where does a healthy step go" beside the
+    lost-time table's "where did the failures' time go"."""
+    bounds = _incarnation_bounds(spans)
+    per_inc: dict[int, dict] = {}
+
+    def bucket(inc: int) -> dict:
+        return per_inc.setdefault(inc, {
+            "mfu": [], "step_s": [], "blocked": [], "phases": {},
+        })
+
+    for span in spans:
+        if span.name == "metrics_sample":
+            b = bucket(_bin_incarnation(bounds, span.end))
+            mfu = span.fields.get("mfu")
+            if isinstance(mfu, (int, float)):
+                b["mfu"].append(float(mfu))
+            step_s = span.fields.get("step_s")
+            if isinstance(step_s, (int, float)):
+                b["step_s"].append(float(step_s))
+            frac = span.fields.get("host_blocked_frac")
+            if isinstance(frac, (int, float)):
+                b["blocked"].append(float(frac))
+        elif span.name == "step_phase":
+            b = bucket(_bin_incarnation(bounds, span.end))
+            phase = span.fields.get("phase")
+            if isinstance(phase, str) and phase:
+                b["phases"].setdefault(phase, []).append(
+                    max(0.0, span.end - span.start)
+                )
+
+    def mean(xs: list[float]) -> float | None:
+        return sum(xs) / len(xs) if xs else None
+
+    rows: list[dict] = []
+    for inc in sorted(per_inc):
+        b = per_inc[inc]
+        if not (b["step_s"] or b["mfu"] or b["phases"]):
+            continue
+        phase_s = {p: mean(v) for p, v in sorted(b["phases"].items())}
+        step_mean = mean(b["step_s"])
+        denom = step_mean or sum(v for v in phase_s.values() if v) or 0.0
+        counts = [len(b["step_s"]), len(b["mfu"])]
+        counts += [len(v) for v in b["phases"].values()]
+        row = {
+            "incarnation": inc,
+            "samples": max(counts),
+            "mfu_mean": round(mean(b["mfu"]), 4) if b["mfu"] else None,
+            "mfu_min": round(min(b["mfu"]), 4) if b["mfu"] else None,
+            "mfu_max": round(max(b["mfu"]), 4) if b["mfu"] else None,
+            "step_s_mean": round(step_mean, 6) if step_mean else None,
+            "host_blocked_pct": (
+                round(100.0 * mean(b["blocked"]), 1)
+                if b["blocked"] else None
+            ),
+            "phase_s": {p: round(v, 6) for p, v in phase_s.items()
+                        if v is not None},
+            "phase_pct": {
+                p: round(100.0 * v / denom, 1)
+                for p, v in phase_s.items()
+                if v is not None and denom > 0
+            },
+        }
+        rows.append(row)
+    return rows
+
+
 def _per_incarnation(spans: list[Span],
                      window: tuple[float, float] | None,
                      median: float,
@@ -324,31 +444,13 @@ def _per_incarnation(spans: list[Span],
     so one slow rendezvous or restore is pinned to the incarnation that
     suffered it rather than averaged over the job.
     """
-    restarts = sorted(
-        (s for s in spans if s.name == "node_restart"),
-        key=lambda s: s.start,
-    )
-    # (incarnation, window_start): incarnation 0 runs from the beginning
-    bounds: list[tuple[int, float]] = [(0, float("-inf"))]
-    for s in restarts:
-        try:
-            inc = int(s.fields.get("incarnation", bounds[-1][0] + 1))
-        except (TypeError, ValueError):
-            inc = bounds[-1][0] + 1
-        if inc == bounds[-1][0]:
-            continue  # another node's restart for the same incarnation
-        bounds.append((inc, s.start))
+    bounds = _incarnation_bounds(spans)
     per_inc: dict[int, dict[str, list[tuple[float, float]]]] = {}
     for span in spans:
         cat = CATEGORY_OF.get(span.name)
         if cat is None:
             continue
-        inc = bounds[0][0]
-        for b_inc, b_start in bounds:
-            if span.start >= b_start:
-                inc = b_inc
-            else:
-                break
+        inc = _bin_incarnation(bounds, span.start)
         start, end = span.start, span.end
         if span.name == "compile" and median > 0:
             end = max(start, end - median)
@@ -407,6 +509,27 @@ def format_report(report: LostTimeReport) -> str:
                 f"  {row.get('recompile_s', 0.0):9.2f}"
                 f"  {row.get('redone_s', 0.0):8.2f}"
             )
+    if report.efficiency:
+        lines.append("  steady-state efficiency (journaled samples, "
+                     "telemetry/efficiency.py):")
+        lines.append("    inc       mfu    step_s  %host-blocked"
+                     "  phase breakdown (% of step)")
+        def cell(v, width: int, fmt: str) -> str:
+            return f"{v:{width}{fmt}}" if v is not None else f"{'n/a':>{width}}"
+
+        for row in report.efficiency:
+            phases = ", ".join(
+                f"{p}={v:.0f}%" for p, v in
+                sorted(row.get("phase_pct", {}).items(),
+                       key=lambda kv: -kv[1])
+            )
+            lines.append(
+                f"    {row['incarnation']:>3}"
+                f"  {cell(row.get('mfu_mean'), 8, '.4f')}"
+                f"  {cell(row.get('step_s_mean'), 8, '.4f')}"
+                f"  {cell(row.get('host_blocked_pct'), 13, '.1f')}"
+                f"  {phases}"
+            )
     return "\n".join(lines)
 
 
@@ -423,14 +546,19 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--end-time", type=float, default=None)
     parser.add_argument("--trace", default=None,
                         help="restrict to one trace id")
-    parser.add_argument("--json", action="store_true")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text",
+                        help="json: one document with stable keys "
+                             "(CI/bench consumption)")
+    parser.add_argument("--json", action="store_true",
+                        help="alias for --format json")
     args = parser.parse_args(argv)
     report = build_report(
         args.journal, goodput_log=args.goodput_log or None,
         end_time=args.end_time, trace=args.trace,
     )
-    if args.json:
-        print(json.dumps(report.to_dict(), indent=2))
+    if args.json or args.format == "json":
+        print(json.dumps(report.to_dict(), indent=2, sort_keys=True))
     else:
         print(format_report(report))
     return 0

@@ -15,7 +15,12 @@ and ui.perfetto.dev both accept):
   ``job_start``/``job_end``) and zero-duration points become ``ph="i"``
   instants;
 - spans a crashed process never closed (begin without end) carry
-  ``args.open=true`` — the visual signature of "died in here".
+  ``args.open=true`` — the visual signature of "died in here";
+- journaled efficiency samples (``metrics_sample`` points,
+  telemetry/efficiency.py) render as ``ph="C"`` counter tracks: an
+  ``mfu`` lane and a stacked ``step_phase_seconds`` lane per process,
+  so utilization dips line up visually with the span lanes that
+  caused them.
 
 Timestamps are microseconds relative to the earliest event, which keeps
 the numbers small and makes the goodput report's lost-time categories
@@ -34,8 +39,13 @@ from dlrover_tpu.telemetry.report import Span, load_events, pair_spans
 # names rendered as instants even when they carry a tiny duration
 INSTANT_NAMES = frozenset({
     "hang_verdict", "straggler_verdict", "debug_bundle",
-    "job_start", "job_end",
+    "job_start", "job_end", "profile_request", "profile_capture",
 })
+
+# journaled metric samples (telemetry/efficiency.py metrics_sample
+# points) render as Perfetto COUNTER tracks (ph="C"), not spans: an MFU
+# lane and a stacked step-phase lane beside the span lanes
+COUNTER_NAMES = frozenset({"metrics_sample"})
 
 
 def _lane_key(span: Span) -> tuple[str, str]:
@@ -51,8 +61,11 @@ def build_trace(paths: list[str], trace: str | None = None) -> dict:
     spans = pair_spans(events)
     if trace:
         spans = [s for s in spans if s.trace == trace]
+    counters = [s for s in spans if s.name in COUNTER_NAMES]
+    spans = [s for s in spans if s.name not in COUNTER_NAMES]
 
-    procs = sorted({s.proc or "unknown" for s in spans})
+    procs = sorted({s.proc or "unknown" for s in spans}
+                   | {s.proc or "unknown" for s in counters})
     pid_of = {proc: i + 1 for i, proc in enumerate(procs)}
     lanes = sorted({_lane_key(s) for s in spans})
     tid_of: dict[tuple[str, str], int] = {}
@@ -77,7 +90,9 @@ def build_trace(paths: list[str], trace: str | None = None) -> dict:
             "tid": tid, "args": {"name": name},
         })
 
-    t0 = min((s.start for s in spans), default=0.0)
+    t0 = min(
+        (s.start for s in spans + counters), default=0.0
+    ) if spans or counters else 0.0
     for span in spans:
         proc = span.proc or "unknown"
         pid, tid = pid_of[proc], tid_of[(proc, span.name)]
@@ -105,7 +120,33 @@ def build_trace(paths: list[str], trace: str | None = None) -> dict:
                 "args": args,
             })
 
-    traces = sorted({s.trace for s in spans if s.trace})
+    # counter tracks: MFU lane + stacked step-phase lane per process,
+    # so the efficiency series read alongside the span lanes
+    for sample in counters:
+        proc = sample.proc or "unknown"
+        pid = pid_of[proc]
+        ts = round((sample.end - t0) * 1e6, 3)
+        mfu = sample.fields.get("mfu")
+        if isinstance(mfu, (int, float)):
+            out.append({
+                "ph": "C", "name": "mfu", "cat": "efficiency",
+                "ts": ts, "pid": pid, "args": {"mfu": float(mfu)},
+            })
+        phases = sample.fields.get("phases")
+        if isinstance(phases, dict) and phases:
+            out.append({
+                "ph": "C", "name": "step_phase_seconds",
+                "cat": "efficiency", "ts": ts, "pid": pid,
+                "args": {
+                    str(p): float(v) for p, v in sorted(phases.items())
+                    if isinstance(v, (int, float))
+                },
+            })
+
+    traces = sorted(
+        {s.trace for s in spans if s.trace}
+        | {s.trace for s in counters if s.trace}
+    )
     return {
         "traceEvents": out,
         "displayTimeUnit": "ms",
@@ -114,6 +155,7 @@ def build_trace(paths: list[str], trace: str | None = None) -> dict:
             "traces": traces,
             "epoch_t0": t0,
             "n_spans": len(spans),
+            "n_counter_samples": len(counters),
         },
     }
 

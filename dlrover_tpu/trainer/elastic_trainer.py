@@ -21,6 +21,7 @@ import numpy as np
 from dlrover_tpu.common.constants import EnvKey
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.parallel.mesh import data_parallel_size
+from dlrover_tpu.telemetry.efficiency import EfficiencyMonitor
 from dlrover_tpu.telemetry.journal import get_journal
 from dlrover_tpu.telemetry.metrics import registry
 from dlrover_tpu.trainer.train_step import CompiledTrain, TrainState
@@ -38,7 +39,9 @@ _steps_total = registry().counter(
 )
 _compile_seconds = registry().histogram(
     "dlrover_tpu_compile_seconds",
-    "first-step wall time per incarnation (XLA compile + one step)",
+    "first-dispatch wall time per incarnation (trace + XLA compile, or "
+    "the AOT executable's near-zero re-dispatch; device compute of the "
+    "step itself is excluded)",
 )
 
 
@@ -74,6 +77,7 @@ class ElasticTrainer:
         micro_batch_size: int,
         report_step_interval: int = 1,
         master_client=None,
+        model_name: str = "",
     ):
         self.compiled = compiled
         dp = data_parallel_size(compiled.mesh)
@@ -114,9 +118,39 @@ class ElasticTrainer:
             from dlrover_tpu.agent.master_client import MasterClient
 
             self._client = MasterClient.singleton()
+        # efficiency observatory (telemetry/efficiency.py): live MFU +
+        # step-phase attribution + on-demand profiler capture. The block
+        # phase syncs on the step's replicated metrics each step, which
+        # trades the one-step host/device overlap for clean host-vs-
+        # device attribution; DLROVER_TPU_STEP_PHASES=0 keeps the
+        # fire-and-forget dispatch (phases then report dispatch-time
+        # only).
+        self._phase_block = os.environ.get(
+            "DLROVER_TPU_STEP_PHASES", "1") != "0"
+        from dlrover_tpu.utils.profiler import device_peak_flops
+
+        self.efficiency = EfficiencyMonitor(
+            model=model_name,
+            strategy=getattr(compiled.strategy, "name", "") or "",
+            flops_per_step=getattr(compiled, "flops_per_step", 0.0),
+            peak_flops=device_peak_flops(),
+            num_devices=jax.device_count(),
+            on_bundle=self._report_profile_bundle,
+        )
+        self._last_step_end = 0.0
         logger.info(
             "elastic trainer: dp=%d accum=%d global_batch=%d (fixed)",
             dp, self.accum, global_batch_size,
+        )
+
+    def _report_profile_bundle(self, path: str) -> None:
+        """List an on-demand profiler capture in the master's bundle
+        ledger, next to crash/hang bundles."""
+        if self._client is None:
+            return
+        node = os.environ.get(EnvKey.NODE_ID, "?")
+        self._client.report_debug_bundle(
+            path, "profile", proc=f"node{node} trainer"
         )
 
     def train_step(self, state: TrainState, batch: dict
@@ -134,7 +168,23 @@ class ElasticTrainer:
             )
         else:
             batch = jax.device_put(batch, self.compiled.batch_sharding)
+        t_dispatch = time.monotonic()
+        self.efficiency.observe_phase("h2d", t_dispatch - step_start)
         state, metrics = self.compiled.step(state, batch)
+        t_block = time.monotonic()
+        # up to dispatch-return: on a first call this carries the trace
+        # + XLA compile (or the AOT executable's ~0 re-dispatch), never
+        # the step's device compute — that lands in the block phase
+        dispatch_wall = t_block - step_start
+        self.efficiency.observe_phase("dispatch", t_block - t_dispatch)
+        if self._phase_block:
+            # block_until_ready on the replicated metrics scalars is the
+            # host-vs-device separator: everything still in flight after
+            # dispatch returns is device compute, attributed as "block"
+            jax.block_until_ready(metrics)
+            self.efficiency.observe_phase(
+                "block", time.monotonic() - t_block
+            )
         # host-side counter: reading state.step would block async dispatch
         self._host_step += 1
         step = self._host_step
@@ -145,22 +195,31 @@ class ElasticTrainer:
             # the incarnation's first call traces + compiles (or loads
             # the persistent compile cache) before dispatching — the
             # recompile cost class the lost-time report attributes.
-            # jax dispatch is async, so this is an upper bound that
-            # includes one step of compute; the report subtracts the
-            # steady median.
+            # Timed to dispatch-return (pre-block), so the first step's
+            # own device compute never inflates the recompile category;
+            # the report's median netting stays as a clamp for journals
+            # from builds where dispatch was synchronous.
             self._first_dispatch = False
-            _compile_seconds.observe(step_wall)
+            _compile_seconds.observe(dispatch_wall)
             # cache_hit distinguishes the warm path (AOT executable
             # served by the compile cache — this event times only the
             # load + one step) from a cold XLA compile; the lost-time
             # report splits the recompile category on it
             hit = getattr(self.compiled, "cache_hit", None)
             get_journal().emit(
-                "compile", dur=step_wall, step=step,
+                "compile", dur=dispatch_wall, step=step,
                 cache_hit=bool(hit) if hit is not None else None,
             )
+            self._maybe_install_flops(state, batch)
         else:
             get_journal().emit("train_step", dur=step_wall, step=step)
+        # step cadence (previous end -> this end) feeds the rolling MFU:
+        # it includes data_wait/callbacks/ckpt, i.e. real throughput
+        now = time.monotonic()
+        cadence = (now - self._last_step_end if self._last_step_end
+                   else step_wall)
+        self._last_step_end = now
+        self.efficiency.end_step(step, cadence)
         self._progress.report(step)
         if self._client is not None and step % self._report_interval == 0:
             try:
@@ -195,6 +254,26 @@ class ElasticTrainer:
                 # the training loop over it
                 logger.warning("step report failed: %s", e)
         return state, metrics
+
+    def _maybe_install_flops(self, state: TrainState, batch: dict) -> None:
+        """Plain-jit fallback for the live MFU gauge: when the AOT path
+        didn't supply FLOPs and the device has a known peak (real TPU —
+        never on the CPU test backend), count the compiled program once
+        via the already-populated compile cache. Uses the NEW state's
+        avals (the donated input's buffers are gone, its avals are not
+        what ``.lower`` needs anyway)."""
+        if self.efficiency.flops_per_step > 0 \
+                or not self.efficiency.peak_flops \
+                or not hasattr(self.compiled.step, "lower"):
+            return
+        try:
+            from dlrover_tpu.utils.profiler import compiled_flops
+
+            flops = compiled_flops(self.compiled.step, state, batch)
+            if flops > 0:
+                self.efficiency.set_flops(flops)
+        except Exception:  # noqa: BLE001 - MFU is telemetry, not training
+            logger.exception("post-compile FLOPs count failed")
 
     def run(
         self,
@@ -233,19 +312,40 @@ class ElasticTrainer:
             logger.info("restored at step %d >= max_steps %d; nothing to do",
                         self._host_step, max_steps)
             return state
-        for batch in batches:
-            state, metrics = self.train_step(state, batch)
-            step = self._host_step
-            if on_step is not None:
-                # metrics stay on device: fetching here would serialize
-                # host and device every step; callbacks device_get at
-                # their own cadence
-                on_step(step, metrics)
-            if (checkpointer is not None and checkpoint_interval
-                    and step % checkpoint_interval == 0):
-                checkpointer(step, state)
-            if max_steps is not None and step >= max_steps:
-                break
+        # data_wait/ckpt are observed here (train_step owns h2d/dispatch/
+        # block); the ckpt phase of step N folds into step N+1's
+        # accumulator — per-step attribution is one step skewed for it,
+        # aggregate histograms are exact
+        it = iter(batches)
+        try:
+            while True:
+                t0 = time.monotonic()
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    break
+                self.efficiency.observe_phase(
+                    "data_wait", time.monotonic() - t0
+                )
+                state, metrics = self.train_step(state, batch)
+                step = self._host_step
+                if on_step is not None:
+                    # metrics stay on device: fetching here would
+                    # serialize host and device every step; callbacks
+                    # device_get at their own cadence
+                    on_step(step, metrics)
+                if (checkpointer is not None and checkpoint_interval
+                        and step % checkpoint_interval == 0):
+                    t0 = time.monotonic()
+                    checkpointer(step, state)
+                    self.efficiency.observe_phase(
+                        "ckpt", time.monotonic() - t0
+                    )
+                if max_steps is not None and step >= max_steps:
+                    break
+        finally:
+            # a capture armed mid-loop must not leak an open trace
+            self.efficiency.close()
         logger.info(
             "training loop exited at step %d after %.1fs",
             self._host_step, time.monotonic() - start,

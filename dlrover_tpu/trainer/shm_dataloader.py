@@ -21,6 +21,7 @@ import json
 import multiprocessing
 import queue as queue_mod
 import struct
+import time
 from typing import Any, Callable, Iterator
 
 import numpy as np
@@ -30,8 +31,24 @@ from dlrover_tpu.common.multi_process import (
     SharedMemoryArena,
     SharedQueue,
 )
+from dlrover_tpu.telemetry.metrics import registry
 
 logger = get_logger(__name__)
+
+# The efficiency observatory's data_wait phase (telemetry/efficiency.py)
+# says THAT the trainer starved; these two say WHY: a producer blocked on
+# a free slot means the consumer is the bottleneck (ring full), a low
+# ready depth at get() time means the producers are (ring empty).
+_slot_wait = registry().histogram(
+    "dlrover_tpu_shm_slot_wait_seconds",
+    "shm data producers' wait for a free ring slot (consumer-bound "
+    "when high)",
+)
+_ready_depth = registry().gauge(
+    "dlrover_tpu_shm_ready_batches",
+    "ready batches in the shm ring observed at each consumer get() "
+    "(producer-bound when ~0 while the trainer waits on data)",
+)
 
 _LEN = struct.Struct("<I")
 
@@ -102,7 +119,9 @@ class ShmBatchQueue:
 
     def put(self, batch: dict[str, np.ndarray],
             timeout: float | None = None) -> None:
+        t0 = time.monotonic()
         item = self._free.get(timeout=timeout)
+        _slot_wait.observe(time.monotonic() - t0)
         slot = int(item["slot"])
         _write_batch(self._arena.buf, slot * self.slot_size,
                      self.slot_size, batch)
@@ -116,6 +135,10 @@ class ShmBatchQueue:
     def get(self, timeout: float | None = None
             ) -> dict[str, np.ndarray] | None:
         """Next batch, or None at end-of-stream."""
+        try:
+            _ready_depth.set(self._ready.qsize())
+        except Exception:  # noqa: BLE001 - depth is advisory telemetry
+            pass
         item = self._ready.get(timeout=timeout)
         if item.get("end"):
             return None

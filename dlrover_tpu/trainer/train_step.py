@@ -97,6 +97,12 @@ class CompiledTrain:
     # served from cache (warm), False = compiled cold this incarnation,
     # None = plain jit path (compiles lazily at the first dispatch)
     cache_hit: bool | None = None
+    # compiled-program FLOPs per step call (XLA cost analysis), fed to
+    # the live MFU gauge (telemetry/efficiency.py). Set by the AOT path
+    # (AotStep.flops — cached in the compile-cache envelope so warm
+    # loads never re-lower); 0.0 = unknown (plain jit path on a device
+    # with no known peak never needs it)
+    flops_per_step: float = 0.0
 
 
 def compile_train(

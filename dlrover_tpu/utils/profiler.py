@@ -43,6 +43,24 @@ def device_peak_flops(device=None) -> float | None:
     return PEAK_FLOPS.get(getattr(device, "device_kind", ""))
 
 
+def executable_flops(compiled) -> float:
+    """FLOPs of an ALREADY-compiled executable (no lower/compile).
+
+    Works for both fresh ``jit(f).lower(...).compile()`` results and
+    deserialized AOT executables; this backend's ``cost_analysis``
+    returns a list of dicts, which is unwrapped. Returns 0.0 when the
+    backend doesn't report a cost analysis.
+    """
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        return float((cost or {}).get("flops", 0.0))
+    except Exception:  # noqa: BLE001 - profiling must never break training
+        logger.exception("cost analysis failed")
+        return 0.0
+
+
 def compiled_flops(fn: Callable, *args, **kwargs) -> float:
     """Exact FLOPs of the compiled program for these (abstract) args.
 
@@ -51,11 +69,7 @@ def compiled_flops(fn: Callable, *args, **kwargs) -> float:
     Returns 0.0 when the backend doesn't report a cost analysis.
     """
     try:
-        compiled = fn.lower(*args, **kwargs).compile()
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else {}
-        return float((cost or {}).get("flops", 0.0))
+        return executable_flops(fn.lower(*args, **kwargs).compile())
     except Exception:  # noqa: BLE001 - profiling must never break training
         logger.exception("cost analysis failed")
         return 0.0

@@ -253,6 +253,9 @@ def main(argv=None) -> int:
     )
     compiled.step = aot.fn
     compiled.cache_hit = aot.cache_hit
+    # the compiled program's FLOPs ride the AOT envelope (a warm load
+    # never re-lowers just to count) and feed the live MFU gauge
+    compiled.flops_per_step = aot.flops
     verb = "loaded from compile cache" if aot.cache_hit else "compiled"
     print(f"[trainer] train step {verb} in {aot.seconds:.2f}s "
           f"({aot.source})", flush=True)
@@ -306,6 +309,7 @@ def main(argv=None) -> int:
         compiled,
         global_batch_size=args.global_batch,
         micro_batch_size=micro,
+        model_name=args.model,
     )
 
     # ---- fallback-topology AOT daemon: pre-compile the N−1/N+1 worlds

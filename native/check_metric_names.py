@@ -73,7 +73,50 @@ DOCUMENTED_PREFIXES = (
     # "failover is recompiling" keys on these names
     "dlrover_tpu_compile_cache_",
     "dlrover_tpu_reshard_",
+    # efficiency observatory (DESIGN.md §18): the "MFU dropped" runbook
+    # keys on the live MFU gauge, the step-phase histogram, and the
+    # profiler-capture counters
+    "dlrover_tpu_mfu",
+    "dlrover_tpu_step_phase_",
+    "dlrover_tpu_profile_",
 )
+
+# label names that are themselves an operator contract (dashboards and
+# runbooks filter on them): each must be used by a registration in the
+# package AND appear verbatim in DESIGN.md
+CONTRACT_LABELS = ("straggler_phase",)
+
+
+def check_contract_labels(pkg_dir: str = PKG,
+                          design_path: str = DESIGN_MD) -> list[str]:
+    """Contract labels must exist in code and be documented."""
+    problems: list[str] = []
+    source = []
+    for root, _dirs, files in os.walk(pkg_dir):
+        for fname in sorted(files):
+            if fname.endswith(".py"):
+                with open(os.path.join(root, fname),
+                          encoding="utf-8") as f:
+                    source.append(f.read())
+    source_text = "\n".join(source)
+    try:
+        with open(design_path, encoding="utf-8") as f:
+            design = f.read()
+    except OSError as e:
+        return [f"cannot read {design_path}: {e}"]
+    for label in CONTRACT_LABELS:
+        if f'"{label}"' not in source_text \
+                and f"'{label}'" not in source_text:
+            problems.append(
+                f"contract label {label!r} is not used by any metric "
+                "registration in the package"
+            )
+        if label not in design:
+            problems.append(
+                f"contract label {label!r} is not documented in "
+                "DESIGN.md; add it to its metrics table"
+            )
+    return problems
 
 
 def check_documented(names: dict[str, list[str]],
@@ -233,7 +276,8 @@ def main() -> int:
     names, problems = scan()
     span_names, span_problems = scan_spans()
     point_names, point_problems = scan_fault_points()
-    problems = problems + span_problems + point_problems
+    problems = (problems + span_problems + point_problems
+                + check_contract_labels())
     if problems:
         for p in problems:
             print(f"check_metric_names: {p}", file=sys.stderr)

@@ -175,8 +175,10 @@ def test_straggler_verdict_through_spawned_master(journal_dir, monkeypatch):
         # probe-round machinery never ran
         assert not status.completed
         text = master.metrics_text()
-        assert 'dlrover_tpu_straggler_score{node="1",role="master"} 5' \
-            in text
+        # straggler_phase is empty here: the snapshots carried no
+        # step-phase histogram to attribute the verdict to
+        assert ('dlrover_tpu_straggler_score'
+                '{node="1",role="master",straggler_phase=""} 5') in text
         events = load_events(os.path.join(journal_dir, "events.jsonl"))
         flagged = [e for e in events
                    if e["name"] == "straggler_verdict"
