@@ -22,7 +22,7 @@ logger = get_logger(__name__)
 
 
 def default_config_path(node_id: int) -> str:
-    base = os.environ.get("DLROVER_TPU_IPC_DIR") or "/tmp"
+    base = os.environ.get(EnvKey.IPC_DIR) or "/tmp"
     job = os.environ.get(EnvKey.JOB_NAME, "local")
     return os.path.join(base, f"paral_config_{job}_{node_id}.json")
 

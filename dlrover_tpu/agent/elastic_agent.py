@@ -28,6 +28,7 @@ import time
 from enum import Enum
 
 from dlrover_tpu import chaos
+from dlrover_tpu.common import envspec
 from dlrover_tpu.common.accelerator import sniff_accelerator
 from dlrover_tpu.common.constants import (
     Defaults,
@@ -676,7 +677,7 @@ class ElasticAgent:
         this agent serves its peers' pushes and streams its own node's
         new snapshots to the master-assigned ring buddy. Disable with
         DLROVER_TPU_BUDDY=0."""
-        if os.environ.get("DLROVER_TPU_BUDDY", "1") == "0":
+        if not envspec.get_bool(EnvKey.BUDDY):
             return
         from dlrover_tpu.checkpoint.buddy import (
             BuddyReplicator,
@@ -692,9 +693,7 @@ class ElasticAgent:
             logger.warning("buddy server unavailable: %s", e)
             self._buddy_server = None
             return
-        interval = float(os.environ.get(
-            "DLROVER_TPU_BUDDY_INTERVAL", "2.0"
-        ))
+        interval = envspec.get_float(EnvKey.BUDDY_INTERVAL)
         self._buddy_replicator = BuddyReplicator(
             self._ckpt_saver.shm_handler, self._client,
             interval_s=interval,
@@ -751,7 +750,7 @@ class ElasticAgent:
         Independent of the local BuddyServer: fetching OUR snapshot only
         needs the buddy's server — a recycled VM whose own server failed
         to bind must still restore."""
-        if os.environ.get("DLROVER_TPU_BUDDY", "1") == "0" \
+        if not envspec.get_bool(EnvKey.BUDDY) \
                 or self._ckpt_saver is None:
             return
         handler = self._ckpt_saver.shm_handler

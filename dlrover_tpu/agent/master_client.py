@@ -130,6 +130,22 @@ class MasterClient:
             time.sleep(0.1)
         return False
 
+    def sync_join(self, sync_name: str) -> int:
+        """Join a named sync group; returns the member count so far.
+
+        Reference analog: MasterClient.join_sync (reference
+        master_client.py); the master counts joiners in its kv store.
+        """
+        return self._client.call(
+            m.SyncJoin(node_id=self.node_id, sync_name=sync_name)
+        ).number
+
+    def sync_finished(self, sync_name: str) -> int:
+        """Current member count of a sync group without joining."""
+        return self._client.call(
+            m.SyncFinishedRequest(sync_name=sync_name)
+        ).number
+
     # --------------------------------------------------------- compile cache
 
     def compile_cache_put(self, key: str, payload: bytes,

@@ -15,13 +15,16 @@ import subprocess
 import sys
 import time
 
+from dlrover_tpu.common import envspec
 from dlrover_tpu.common.constants import EnvKey
 from dlrover_tpu.common.log import get_logger
 
 logger = get_logger(__name__)
 
-PROBE_TIMEOUT_S = float(os.environ.get("DLROVER_TPU_PROBE_TIMEOUT", "300"))
-GLOBAL_RANK_ENV = "DLROVER_TPU_GLOBAL_RANK"
+# import-time read by design (envspec: restart_required) — the probe
+# budget must be identical across every probe child of one agent
+PROBE_TIMEOUT_S = envspec.get_float(EnvKey.PROBE_TIMEOUT)
+GLOBAL_RANK_ENV = EnvKey.GLOBAL_RANK
 
 
 def _probe_payload() -> float:
@@ -38,7 +41,7 @@ def _probe_payload() -> float:
     import jax
     import jax.numpy as jnp
 
-    platform = os.environ.get("DLROVER_TPU_PLATFORM")
+    platform = os.environ.get(EnvKey.PLATFORM)
     if platform:  # hermetic tests force the CPU backend
         try:
             jax.config.update("jax_platforms", platform)

@@ -31,12 +31,13 @@ import os
 import threading
 from typing import Callable
 
+from dlrover_tpu.common.constants import EnvKey
 from dlrover_tpu.common.log import get_logger
 
 logger = get_logger(__name__)
 
-ENV_NOTICE_FILE = "DLROVER_TPU_PREEMPTION_FILE"
-ENV_NOTICE_URL = "DLROVER_TPU_PREEMPTION_URL"
+ENV_NOTICE_FILE = EnvKey.PREEMPTION_FILE
+ENV_NOTICE_URL = EnvKey.PREEMPTION_URL
 
 
 class PreemptionWatcher:

@@ -45,6 +45,7 @@ import time
 
 from dlrover_tpu.common.constants import EnvKey
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.storage import atomic_write_file
 from dlrover_tpu.telemetry.journal import get_journal
 from dlrover_tpu.telemetry.metrics import registry
 
@@ -68,14 +69,13 @@ def standby_enabled() -> bool:
 
 
 def _handshake_dir() -> str:
-    return os.environ.get("DLROVER_TPU_IPC_DIR") or tempfile.gettempdir()
+    return os.environ.get(EnvKey.IPC_DIR) or tempfile.gettempdir()
 
 
 def _atomic_write(path: str, payload: dict) -> None:
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w", encoding="utf-8") as f:
-        json.dump(payload, f)
-    os.replace(tmp, path)
+    # one blessed publisher for every handshake file (tmp + fsync +
+    # rename, and the chaos storage_write injection point rides along)
+    atomic_write_file(json.dumps(payload), path)
 
 
 class StandbyManager:
@@ -262,8 +262,9 @@ def park_if_standby() -> dict | None:
     if not path:
         return None
     try:
-        with open(path + ".ready", "w", encoding="utf-8") as f:
-            f.write(str(os.getpid()))
+        # the agent polls for this marker: atomic publish so it can
+        # never read a torn/empty pid
+        atomic_write_file(str(os.getpid()), path + ".ready")
     except OSError as e:
         logger.warning("standby ready marker write failed: %s", e)
     logger.info("standby trainer parked; waiting for promotion")

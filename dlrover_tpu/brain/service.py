@@ -499,8 +499,11 @@ def main(argv=None) -> int:
     service = BrainService(BrainDataStore(args.db), port=args.port)
     service.start()
     if args.port_file:
-        with open(args.port_file, "w") as f:
-            f.write(str(service._server.port))
+        # launchers poll this file: publish atomically so a reader can
+        # never see an empty/truncated port
+        from dlrover_tpu.common.storage import atomic_write_file
+
+        atomic_write_file(str(service._server.port), args.port_file)
     try:
         while True:
             time.sleep(60)

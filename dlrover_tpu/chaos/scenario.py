@@ -40,7 +40,9 @@ import tempfile
 import time
 from typing import Any
 
+from dlrover_tpu.common.constants import EnvKey
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.storage import atomic_write_file
 
 logger = get_logger(__name__)
 
@@ -247,17 +249,22 @@ def run_scenario(scenario: Scenario, work_dir: str, *,
     try:
         for i, leg in enumerate(scenario.legs):
             plan_path = os.path.join(work_dir, f"plan_{leg.name}.json")
-            with open(plan_path, "w", encoding="utf-8") as f:
-                json.dump({"seed": scenario.seed, "faults": leg.faults}, f)
+            # the leg subprocess reads this via DLROVER_TPU_CHAOS:
+            # publish atomically (a torn plan would silently disable
+            # injection and desync the replay trail)
+            atomic_write_file(
+                json.dumps({"seed": scenario.seed, "faults": leg.faults}),
+                plan_path,
+            )
             env = dict(os.environ)
             env.update(env_extra or {})
-            env.setdefault("DLROVER_TPU_PLATFORM", "cpu")
-            env.setdefault("DLROVER_TPU_DEVICE_COUNT", "1")
+            env.setdefault(EnvKey.PLATFORM, "cpu")
+            env.setdefault(EnvKey.DEVICE_COUNT_OVERRIDE, "1")
             # hermetic compile cache, shared across this scenario's legs
             # (the satellite shared-dir contract) but never across
             # scenarios/test runs — a stale /tmp hit would silently turn
             # a cold-compile assertion warm
-            env.setdefault("DLROVER_TPU_COMPILE_CACHE_DIR",
+            env.setdefault(EnvKey.COMPILE_CACHE_SHARED_DIR,
                            os.path.join(work_dir, "compile_cache"))
             # IPC dirs hold AF_UNIX sockets, whose path limit (~108
             # chars) a nested work_dir easily exceeds: keep them short
@@ -265,9 +272,9 @@ def run_scenario(scenario: Scenario, work_dir: str, *,
             ipc_dir = tempfile.mkdtemp(prefix=f"chaos{i}_")
             ipc_dirs.append(ipc_dir)
             env.update({
-                "DLROVER_TPU_CHAOS": plan_path,
-                "DLROVER_TPU_JOURNAL_DIR": journal_dir,
-                "DLROVER_TPU_IPC_DIR": ipc_dir,
+                EnvKey.CHAOS: plan_path,
+                EnvKey.JOURNAL_DIR: journal_dir,
+                EnvKey.IPC_DIR: ipc_dir,
                 "PYTHONPATH": (env.get("PYTHONPATH", "")
                                + os.pathsep + REPO),
             })

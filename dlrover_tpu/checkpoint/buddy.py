@@ -32,6 +32,8 @@ import socket
 import socketserver
 import threading
 
+from dlrover_tpu.common import envspec
+from dlrover_tpu.common.constants import EnvKey
 from dlrover_tpu.common.log import get_logger
 
 logger = get_logger(__name__)
@@ -43,9 +45,7 @@ def _max_snapshot_bytes() -> int:
     """Upper bound on one pushed snapshot (refuses runaway/malicious
     nbytes before buffering; a TPU host's training state tops out near
     its host RAM)."""
-    return int(os.environ.get(
-        "DLROVER_TPU_BUDDY_MAX_BYTES", str(64 << 30)
-    ))
+    return envspec.get_int(EnvKey.BUDDY_MAX_BYTES)
 
 
 def _read_line(rfile) -> bytes:

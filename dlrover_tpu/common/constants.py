@@ -159,6 +159,36 @@ class EnvKey:
     # (checkpoint/interval_tuner.py) drive the shm snapshot cadence via
     # the paral-config push; unset/other keeps the trainer's CLI value
     SNAPSHOT_INTERVAL = "DLROVER_TPU_SNAPSHOT_INTERVAL"
+    # platform/backend selection (run.py --platform mirror; "cpu"
+    # forces JAX_PLATFORMS=cpu in children)
+    PLATFORM = "DLROVER_TPU_PLATFORM"
+    # directory for cross-process handshake files (standby promotion
+    # payloads, paral-config mirror, chaos scenario legs); default
+    # tempdir — co-hosted jobs override to avoid collisions
+    IPC_DIR = "DLROVER_TPU_IPC_DIR"
+    SHM_PREFIX = "DLROVER_TPU_SHM_PREFIX"
+    # serialized-AOT-executable cache ("0" disables; DESIGN.md §17) and
+    # the example's force-switch for the fallback-topology precompiler
+    AOT_CACHE = "DLROVER_TPU_AOT_CACHE"
+    FALLBACK_AOT = "DLROVER_TPU_FALLBACK_AOT"
+    # efficiency observatory (DESIGN.md §18): per-step phase split
+    # ("0" restores fire-and-forget dispatch) and the journal cadence
+    # of metrics_sample/step_phase points
+    STEP_PHASES = "DLROVER_TPU_STEP_PHASES"
+    EFFICIENCY_JOURNAL_EVERY = "DLROVER_TPU_EFFICIENCY_JOURNAL_EVERY"
+    # buddy-replication of shm snapshots (checkpoint/buddy.py): "0"
+    # disables, interval between pushes, per-push byte cap
+    BUDDY = "DLROVER_TPU_BUDDY"
+    BUDDY_INTERVAL = "DLROVER_TPU_BUDDY_INTERVAL"
+    BUDDY_MAX_BYTES = "DLROVER_TPU_BUDDY_MAX_BYTES"
+    # network-check probe budget (agent/node_check.py, read at import)
+    # and the probe child's rank assignment
+    PROBE_TIMEOUT = "DLROVER_TPU_PROBE_TIMEOUT"
+    GLOBAL_RANK = "DLROVER_TPU_GLOBAL_RANK"
+    LOG_LEVEL = "DLROVER_TPU_LOG_LEVEL"
+    # preemption/maintenance-notice sources (agent/preemption.py)
+    PREEMPTION_FILE = "DLROVER_TPU_PREEMPTION_FILE"
+    PREEMPTION_URL = "DLROVER_TPU_PREEMPTION_URL"
 
 
 class Defaults:
@@ -172,5 +202,7 @@ class Defaults:
     SPEED_WINDOW_S = 6.0
     RPC_TIMEOUT_S = 30.0
     # overridable so parallel test runs / co-hosted jobs can't collide on
-    # POSIX shm names (children inherit the env, so agent+trainer agree)
-    SHM_PREFIX = os.environ.get("DLROVER_TPU_SHM_PREFIX", "dlrover_tpu")
+    # POSIX shm names (children inherit the env, so agent+trainer agree).
+    # Import-time read by design (envspec marks it restart_required):
+    # every shm name derives from it, so it must be frozen per process.
+    SHM_PREFIX = os.environ.get(EnvKey.SHM_PREFIX, "dlrover_tpu")

@@ -1,0 +1,271 @@
+"""The one registry of every ``DLROVER_TPU_*`` environment variable.
+
+Before this module existed, the env surface was 100+ scattered
+``os.environ`` reads: some through ``EnvKey`` constants, some raw string
+literals, with defaults duplicated (and drifting) at call sites and no
+record of which vars are safe to flip on a live job versus baked in at
+process start. ``native/analyze`` rule ``env-registry`` (DESIGN.md §19)
+now machine-enforces the contract this module declares:
+
+- every ``EnvKey`` constant has exactly one ``EnvVar`` entry here (and
+  vice versa), so a var cannot be added without declaring its default,
+  restart semantics and DESIGN.md anchor;
+- ``DLROVER_TPU_*`` string literals may appear ONLY in
+  ``common/constants.py`` and this file — call sites go through
+  ``EnvKey``/the helpers below, so the name is always greppable from
+  the registry;
+- a module-level (import-time) env read is only legal for vars declared
+  ``restart_required=True`` — an import-time read of a "live-tunable"
+  var would silently freeze it per process;
+- every registered var appears verbatim in DESIGN.md (the generated
+  reference table, ``python -m native.analyze --env-table``), mirroring
+  the metric-name documentation contract.
+
+Helpers read ``os.environ`` live (monkeypatch/test friendly) and apply
+the registered default; ``restart_required`` is metadata enforcement,
+not runtime caching.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+from dlrover_tpu.common.constants import EnvKey
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvVar:
+    """Declaration of one environment variable.
+
+    ``restart_required=True`` means the value is bound at process start
+    (import-time read, process identity, logger/backend configuration)
+    — changing it on a live job has no effect until the next
+    incarnation. ``anchor`` names the DESIGN.md section that explains
+    the subsystem the var belongs to.
+    """
+
+    name: str
+    default: Optional[str]
+    help: str
+    anchor: str
+    restart_required: bool = False
+
+
+# NOTE for the reader adding a var: the name literal must ALSO exist as
+# an EnvKey constant (the analyzer enforces the bijection), and the
+# generated table in DESIGN.md §19 must be refreshed via
+# ``python -m native.analyze --env-table``.
+SPECS: tuple[EnvVar, ...] = (
+    # ------------------------------------------------- identity / placement
+    EnvVar("DLROVER_TPU_JOB_NAME", None,
+           "job name; keys shared caches and shm namespaces", "§1",
+           restart_required=True),
+    EnvVar("DLROVER_TPU_MASTER_ADDR", None,
+           "master RPC endpoint host:port (MasterClient singleton binds "
+           "at first use)", "§1", restart_required=True),
+    EnvVar("DLROVER_TPU_NODE_ID", "0",
+           "this node's stable id, assigned by the launcher", "§1",
+           restart_required=True),
+    EnvVar("DLROVER_TPU_NODE_RANK", "0",
+           "rank within the current rendezvous round", "§1",
+           restart_required=True),
+    EnvVar("DLROVER_TPU_NODE_NUM", "1",
+           "world size of the current rendezvous round", "§1",
+           restart_required=True),
+    EnvVar("DLROVER_TPU_COORDINATOR", None,
+           "jax.distributed coordinator address for this round", "§2",
+           restart_required=True),
+    EnvVar("DLROVER_TPU_RESTART_COUNT", "0",
+           "incarnation counter the agent bumps per respawn", "§6",
+           restart_required=True),
+    EnvVar("DLROVER_TPU_PLATFORM", None,
+           "platform/backend selection (cpu|tpu|k8s|ray contexts); "
+           "'cpu' forces JAX_PLATFORMS=cpu in children", "§1",
+           restart_required=True),
+    EnvVar("DLROVER_TPU_ACCELERATOR", None,
+           "accelerator kind hint set by the launcher", "§2",
+           restart_required=True),
+    EnvVar("DLROVER_TPU_DEVICE_COUNT", None,
+           "override visible device count (virtual meshes, tests)", "§2",
+           restart_required=True),
+    EnvVar("DLROVER_TPU_INIT_TIMEOUT", None,
+           "jax.distributed.initialize join timeout (s); launcher "
+           "scales with node count", "§2", restart_required=True),
+    EnvVar("DLROVER_TPU_GLOBAL_RANK", None,
+           "probe child's rank in a network-check subgroup", "§6",
+           restart_required=True),
+    EnvVar("DLROVER_TPU_PROBE_TIMEOUT", "300",
+           "network-check probe budget in seconds (read at module "
+           "import)", "§6", restart_required=True),
+    EnvVar("DLROVER_TPU_MOCK_ERR_RANK", None,
+           "test hook: rank that raises a mock training error", "§15",
+           restart_required=True),
+    # ------------------------------------------------------- config handoff
+    EnvVar("DLROVER_TPU_PARAL_CONFIG", None,
+           "path of the agent-mirrored paral-config file the trainer "
+           "hot-reloads", "§6", restart_required=True),
+    EnvVar("DLROVER_TPU_IPC_DIR", None,
+           "directory for cross-process handshake files (standby "
+           "payloads, config mirror, chaos legs); default tempdir",
+           "§16", restart_required=True),
+    EnvVar("DLROVER_TPU_SHM_PREFIX", "dlrover_tpu",
+           "POSIX shm name prefix (read once at import: every shm name "
+           "derives from it)", "§11", restart_required=True),
+    # ----------------------------------------------------------- checkpoint
+    EnvVar("DLROVER_TPU_CKPT_META_DIR", None,
+           "where the agent-side saver finds shm checkpoint meta", "§16",
+           restart_required=True),
+    EnvVar("DLROVER_TPU_SNAPSHOT_INTERVAL", None,
+           "'auto' arms the master's Young-Daly cadence tuner; other "
+           "values keep the trainer CLI cadence", "§16"),
+    EnvVar("DLROVER_TPU_BUDDY", "1",
+           "'0' disables buddy replication of shm snapshots", "§16"),
+    EnvVar("DLROVER_TPU_BUDDY_INTERVAL", "2.0",
+           "seconds between buddy snapshot pushes", "§16"),
+    EnvVar("DLROVER_TPU_BUDDY_MAX_BYTES", str(64 << 30),
+           "upper bound on one pushed buddy snapshot", "§16"),
+    # -------------------------------------------------------- warm recovery
+    EnvVar("DLROVER_TPU_STANDBY", "1",
+           "'0' disables the pre-spawned standby trainer", "§16"),
+    EnvVar("DLROVER_TPU_STANDBY_FILE", None,
+           "internal: promotion-payload path the agent hands a parked "
+           "standby child", "§16", restart_required=True),
+    EnvVar("DLROVER_TPU_PREEMPTION_FILE", None,
+           "preemption notice file path ({node_id} substituted); "
+           "fires save-before-kill when it appears", "§16"),
+    EnvVar("DLROVER_TPU_PREEMPTION_URL", None,
+           "preemption notice poll URL (GCE maintenance-event "
+           "convention)", "§16"),
+    # -------------------------------------------------------- compile cache
+    EnvVar("DLROVER_TPU_COMPILE_CACHE", None,
+           "XLA persistent compilation cache dir (location only)", "§17",
+           restart_required=True),
+    EnvVar("DLROVER_TPU_COMPILE_CACHE_DIR", None,
+           "shared artifact dir for serialized AOT executables + XLA "
+           "cache (default keyed by job name)", "§17"),
+    EnvVar("DLROVER_TPU_AOT_CACHE", "1",
+           "'0' disables the serialized-AOT-executable cache", "§17"),
+    EnvVar("DLROVER_TPU_FALLBACK_AOT", None,
+           "force the fallback-topology precompiler on/off (default: "
+           "on when multi-node)", "§17"),
+    # ------------------------------------------------------------ telemetry
+    EnvVar("DLROVER_TPU_METRICS_PORT", None,
+           "Prometheus exposition port (unset = exposition off)", "§12",
+           restart_required=True),
+    EnvVar("DLROVER_TPU_JOURNAL_DIR", None,
+           "event-journal directory (unset = no journal)", "§12"),
+    EnvVar("DLROVER_TPU_JOURNAL_MAX_MB", None,
+           "journal size cap in MB before atomic rotation to .1", "§14"),
+    EnvVar("DLROVER_TPU_TRACE_ID", None,
+           "job-wide trace id minted by the master; adopted via the "
+           "rendezvous payload", "§12"),
+    EnvVar("DLROVER_TPU_LOG_JSON", None,
+           "'1' switches process logs to JSON lines", "§12",
+           restart_required=True),
+    EnvVar("DLROVER_TPU_LOG_LEVEL", "INFO",
+           "root log level for framework loggers", "§12",
+           restart_required=True),
+    EnvVar("DLROVER_TPU_BUNDLE_DIR", None,
+           "flight-recorder bundle root (default <journal dir>/bundles)",
+           "§14"),
+    EnvVar("DLROVER_TPU_BUNDLES", "1",
+           "'0' disables automatic debug bundles on hang/crash", "§14"),
+    EnvVar("DLROVER_TPU_STEP_PHASES", "1",
+           "'0' restores fire-and-forget dispatch (no per-step phase "
+           "split)", "§18", restart_required=True),
+    EnvVar("DLROVER_TPU_EFFICIENCY_JOURNAL_EVERY", "25",
+           "steps between metrics_sample/step_phase journal points "
+           "(0 disables)", "§18"),
+    # ---------------------------------------------------------------- chaos
+    EnvVar("DLROVER_TPU_CHAOS", None,
+           "JSON fault plan (path or inline); read ONCE at chaos "
+           "package import", "§15", restart_required=True),
+)
+
+SPEC_BY_NAME: dict[str, EnvVar] = {spec.name: spec for spec in SPECS}
+
+
+def _check_bijection() -> None:
+    """Fail the import when EnvKey and the registry drift — the same
+    contract rule ``env-registry`` enforces statically, kept dynamic
+    too so a drifted tree cannot even start."""
+    keys = {
+        value for attr, value in vars(EnvKey).items()
+        if not attr.startswith("_") and isinstance(value, str)
+    }
+    registered = set(SPEC_BY_NAME)
+    missing = keys - registered
+    unknown = registered - keys
+    if missing or unknown:
+        raise RuntimeError(
+            "envspec drift: EnvKey constants without a registry entry "
+            f"{sorted(missing)}; registry entries without an EnvKey "
+            f"constant {sorted(unknown)}"
+        )
+
+
+_check_bijection()
+
+
+def spec(name: str) -> EnvVar:
+    return SPEC_BY_NAME[name]
+
+
+def get(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Live read with the registered default ( ``default`` overrides
+    it for call sites that need a contextual fallback)."""
+    fallback = default if default is not None \
+        else SPEC_BY_NAME[name].default
+    value = os.environ.get(name)
+    return value if value not in (None, "") else fallback
+
+
+def get_bool(name: str) -> bool:
+    """The framework's switch convention: anything but '0' is on (so
+    defaults can be on without the launcher exporting anything)."""
+    return get(name) != "0"
+
+
+def get_int(name: str, default: Optional[int] = None) -> Optional[int]:
+    raw = get(name, None if default is None else str(default))
+    if raw is None:
+        return None
+    try:
+        return int(float(raw))
+    except ValueError:
+        return default if default is not None else int(
+            SPEC_BY_NAME[name].default or 0
+        )
+
+
+def get_float(name: str, default: Optional[float] = None
+              ) -> Optional[float]:
+    raw = get(name, None if default is None else str(default))
+    if raw is None:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return default if default is not None else float(
+            SPEC_BY_NAME[name].default or 0
+        )
+
+
+def markdown_table() -> str:
+    """The DESIGN.md §19 reference table — generated, never hand-edited
+    (rule ``env-registry`` fails when a registered var is missing from
+    DESIGN.md, mirroring the metric-name contract)."""
+    lines = [
+        "| variable | default | restart req. | anchor | purpose |",
+        "|---|---|---|---|---|",
+    ]
+    for s in SPECS:
+        default = "—" if s.default is None else f"`{s.default}`"
+        restart = "yes" if s.restart_required else "no"
+        lines.append(
+            f"| `{s.name}` | {default} | {restart} | {s.anchor} | "
+            f"{s.help} |"
+        )
+    return "\n".join(lines)

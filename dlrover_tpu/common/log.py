@@ -15,6 +15,8 @@ import os
 import sys
 import time
 
+from dlrover_tpu.common.constants import EnvKey
+
 _FORMAT = (
     "[%(asctime)s] [%(levelname)s] "
     "[%(name)s:%(lineno)d] %(message)s"
@@ -29,8 +31,8 @@ class ContextFilter(logging.Filter):
     """
 
     def filter(self, record: logging.LogRecord) -> bool:
-        record.node_id = os.environ.get("DLROVER_TPU_NODE_ID", "-")
-        record.trace_id = os.environ.get("DLROVER_TPU_TRACE_ID", "-")
+        record.node_id = os.environ.get(EnvKey.NODE_ID, "-")
+        record.trace_id = os.environ.get(EnvKey.TRACE_ID, "-")
         return True
 
 
@@ -54,7 +56,7 @@ class JsonFormatter(logging.Formatter):
 
 
 def _make_formatter() -> logging.Formatter:
-    if os.environ.get("DLROVER_TPU_LOG_JSON", "") == "1":
+    if os.environ.get(EnvKey.LOG_JSON, "") == "1":
         return JsonFormatter()
     return logging.Formatter(_FORMAT)
 
@@ -66,6 +68,6 @@ def get_logger(name: str) -> logging.Logger:
         handler.setFormatter(_make_formatter())
         handler.addFilter(ContextFilter())
         logger.addHandler(handler)
-        logger.setLevel(os.environ.get("DLROVER_TPU_LOG_LEVEL", "INFO"))
+        logger.setLevel(os.environ.get(EnvKey.LOG_LEVEL, "INFO"))
         logger.propagate = False
     return logger

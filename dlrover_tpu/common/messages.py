@@ -593,15 +593,6 @@ class SyncFinishedRequest:
 
 @register_message
 @dataclasses.dataclass
-class CheckpointSyncRequest:
-    """Master-coordinated 'everyone persists shm now' barrier before restart."""
-
-    node_id: int = 0
-    step: int = 0
-
-
-@register_message
-@dataclasses.dataclass
 class ParalConfigRequest:
     node_id: int = 0
 

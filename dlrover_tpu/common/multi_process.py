@@ -25,7 +25,7 @@ import time
 from multiprocessing import resource_tracker, shared_memory
 from typing import Any, Optional
 
-from dlrover_tpu.common.constants import Defaults
+from dlrover_tpu.common.constants import Defaults, EnvKey
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.common.rpc import recv_frame, send_frame
 
@@ -34,7 +34,7 @@ logger = get_logger(__name__)
 
 def _socket_dir() -> str:
     d = os.environ.get(
-        "DLROVER_TPU_IPC_DIR", os.path.join("/tmp", Defaults.SHM_PREFIX + "_ipc")
+        EnvKey.IPC_DIR, os.path.join("/tmp", Defaults.SHM_PREFIX + "_ipc")
     )
     os.makedirs(d, exist_ok=True)
     return d

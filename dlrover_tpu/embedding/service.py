@@ -46,6 +46,7 @@ import numpy as np
 # decode_msg is re-exported: tests and tools treat this module as the
 # wire-protocol surface for the embedding tier
 from dlrover_tpu.common.array_wire import decode_msg, encode_msg  # noqa: F401
+from dlrover_tpu.common.constants import EnvKey
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.common.msg_server import (
     ArrayMsgServer,
@@ -896,7 +897,7 @@ class EmbeddingServerScaler:
         proc = subprocess.Popen(
             cmd, stdout=subprocess.PIPE, text=True,
             env={**os.environ, "JAX_PLATFORMS": "cpu",
-                 "DLROVER_TPU_PLATFORM": "cpu"},
+                 EnvKey.PLATFORM: "cpu"},
         )
         # bounded readiness wait: a wedged child must not park scale()
         # (and with it the auto-scaler tick + stop_all) on readline

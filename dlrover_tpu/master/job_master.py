@@ -333,8 +333,11 @@ def main(argv: list[str] | None = None) -> int:
     )
     master.prepare()
     if args.port_file:
-        with open(args.port_file, "w") as f:
-            f.write(str(master.port))
+        # launchers poll this file: publish atomically so a reader can
+        # never see an empty/truncated port
+        from dlrover_tpu.common.storage import atomic_write_file
+
+        atomic_write_file(str(master.port), args.port_file)
     ok = master.run()
     master.stop()
     return 0 if ok else 1

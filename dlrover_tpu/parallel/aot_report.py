@@ -42,9 +42,11 @@ def main(argv=None) -> int:
 
     import jax
 
+    from dlrover_tpu.common.constants import EnvKey
+
     # an eagerly-registered TPU plugin beats the JAX_PLATFORMS env var;
     # the live config does not (same trick as trainer/bootstrap.py)
-    platform = os.environ.get("DLROVER_TPU_PLATFORM")
+    platform = os.environ.get(EnvKey.PLATFORM)
     if platform:
         jax.config.update("jax_platforms", platform)
     import numpy as np

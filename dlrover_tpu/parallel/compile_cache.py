@@ -50,6 +50,7 @@ import time
 import zlib
 from typing import Any, Callable, Sequence
 
+from dlrover_tpu.common import envspec
 from dlrover_tpu.common.constants import EnvKey
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.master.kv_store import (
@@ -70,7 +71,7 @@ def aot_cache_enabled() -> bool:
     XLA executable + arg tree) — unlike the XLA persistent-cache-dir
     path it round-trips correctly on this CPU backend, so it defaults
     on everywhere. ``DLROVER_TPU_AOT_CACHE=0`` turns it off."""
-    return os.environ.get("DLROVER_TPU_AOT_CACHE", "1") != "0"
+    return envspec.get_bool(EnvKey.AOT_CACHE)
 
 
 # ----------------------------------------------------------- fingerprinting

@@ -123,7 +123,7 @@ def live_mfu(model: str, strategy: str) -> float | None:
 def journal_sample_every(default: int = 25) -> int:
     """Cadence (in steps) of metrics_sample/step_phase journal points;
     ``DLROVER_TPU_EFFICIENCY_JOURNAL_EVERY`` overrides, 0 disables."""
-    raw = os.environ.get("DLROVER_TPU_EFFICIENCY_JOURNAL_EVERY", "").strip()
+    raw = (os.environ.get(EnvKey.EFFICIENCY_JOURNAL_EVERY) or "").strip()
     if not raw:
         return default
     try:

@@ -115,7 +115,7 @@ def setup_compilation_cache(path: str | None = None) -> str | None:
         # enable it on a bare CPU run with no platform env set at all.
         # (The backend itself can't be queried here: that would
         # initialize it before jax.distributed.initialize.)
-        platform = (os.environ.get("DLROVER_TPU_PLATFORM")
+        platform = (os.environ.get(EnvKey.PLATFORM)
                     or os.environ.get("JAX_PLATFORMS", "")).lower()
         if "cpu" in platform:
             return None  # explicitly CPU: never cache
@@ -149,7 +149,7 @@ def init_from_env(initialize_distributed: bool = True) -> RunContext:
     hermetic multi-device runs) — a plain ``JAX_PLATFORMS`` env var loses to
     an eagerly registered TPU plugin, the live config does not.
     """
-    platform = os.environ.get("DLROVER_TPU_PLATFORM")
+    platform = os.environ.get(EnvKey.PLATFORM)
     if platform:
         import jax
 

@@ -18,6 +18,7 @@ from typing import Any, Callable, Iterator
 import jax
 import numpy as np
 
+from dlrover_tpu.common import envspec
 from dlrover_tpu.common.constants import EnvKey
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.parallel.mesh import data_parallel_size
@@ -125,8 +126,7 @@ class ElasticTrainer:
         # device attribution; DLROVER_TPU_STEP_PHASES=0 keeps the
         # fire-and-forget dispatch (phases then report dispatch-time
         # only).
-        self._phase_block = os.environ.get(
-            "DLROVER_TPU_STEP_PHASES", "1") != "0"
+        self._phase_block = envspec.get_bool(EnvKey.STEP_PHASES)
         from dlrover_tpu.utils.profiler import device_peak_flops
 
         self.efficiency = EfficiencyMonitor(

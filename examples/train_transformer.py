@@ -318,7 +318,9 @@ def main(argv=None) -> int:
     # is host-side (parallel/dry_run.py does the same offline), so this
     # never touches the accelerator's execution stream. Multi-node only
     # by default: a standalone world has no neighbor topologies.
-    fallback_on = os.environ.get("DLROVER_TPU_FALLBACK_AOT", "")
+    from dlrover_tpu.common.constants import EnvKey
+
+    fallback_on = os.environ.get(EnvKey.FALLBACK_AOT, "")
     if (fallback_on != "0" and (ctx.num_nodes > 1 or fallback_on == "1")
             and cc.aot_cache_enabled()):
         def _build_for_nodes(n_nodes: int):

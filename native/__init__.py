@@ -1,0 +1,1 @@
+"""Native tooling: C++ kernels (kv_variable) and repo lint/analysis."""

@@ -1,0 +1,38 @@
+"""Invariant analyzer: AST/dataflow lints that machine-enforce the
+codebase's hard-won runtime contracts (DESIGN.md §19).
+
+Seven PRs of incident reports distilled three classes of invariant that
+only existed as prose: trees restored from snapshots must be laundered
+before their first deserialized-``Compiled`` call (the PR-6 CPU
+donation/adoption weight-corruption hazard), files another process will
+read must be published by atomic rename (``atomic_write_file``), and
+shared state touched by daemon threads must be touched under a held
+lock. This package turns them — plus the env-var, RPC-message and
+journal-span contracts — into checkers that run in tier-1.
+
+Usage::
+
+    python -m native.analyze dlrover_tpu \
+        --baseline native/analyze/baseline.json --format json
+
+Programmatic::
+
+    from native.analyze import run_analysis
+    result = run_analysis()          # repo root + dlrover_tpu defaults
+    assert not result.new_findings
+
+Checkers live in ``native.analyze.checkers`` and register themselves on
+import; grandfathered findings live in the committed
+``native/analyze/baseline.json`` with a one-line justification each.
+"""
+
+from native.analyze.core import (  # noqa: F401
+    CHECKERS,
+    Checker,
+    Finding,
+    Module,
+    Project,
+    register,
+)
+from native.analyze.baseline import Baseline, load_baseline  # noqa: F401
+from native.analyze.cli import AnalysisResult, main, run_analysis  # noqa: F401

@@ -1,0 +1,91 @@
+"""Committed baseline of grandfathered findings.
+
+The baseline is the escape hatch that lets the analyzer run red-line in
+tier-1 from day one: a finding that is deliberate (or not worth fixing
+yet) is recorded by its stable key with a one-line justification, and
+the suite fails only on NEW findings. Entries expire loudly — a
+baseline row whose finding no longer exists fails the run too, so the
+file can only shrink honestly (``--update-baseline`` rewrites it from
+the current findings, preserving justifications for keys that remain).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+from native.analyze.core import Finding
+
+
+@dataclasses.dataclass
+class BaselineEntry:
+    key: str
+    justification: str = ""
+    rule: str = ""
+    path: str = ""
+
+
+@dataclasses.dataclass
+class Baseline:
+    entries: list[BaselineEntry] = dataclasses.field(default_factory=list)
+    path: str = ""
+
+    @property
+    def keys(self) -> set[str]:
+        return {e.key for e in self.entries}
+
+    def split(self, findings: list[Finding]
+              ) -> tuple[list[Finding], list[Finding], list[BaselineEntry]]:
+        """(new, grandfathered, stale_entries)."""
+        known = self.keys
+        new = [f for f in findings if f.key not in known]
+        old = [f for f in findings if f.key in known]
+        live = {f.key for f in findings}
+        stale = [e for e in self.entries if e.key not in live]
+        return new, old, stale
+
+
+def load_baseline(path: str) -> Baseline:
+    if not os.path.exists(path):
+        return Baseline(path=path)
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    entries = [
+        BaselineEntry(
+            key=e["key"],
+            justification=e.get("justification", ""),
+            rule=e.get("rule", ""),
+            path=e.get("path", ""),
+        )
+        for e in data.get("entries", [])
+    ]
+    return Baseline(entries=entries, path=path)
+
+
+def save_baseline(path: str, findings: list[Finding],
+                  previous: Baseline | None = None) -> Baseline:
+    """Rewrite the baseline from the current findings, carrying forward
+    justifications for keys that survive; new keys get a TODO marker the
+    reviewer must replace (the tier-1 test asserts none remain)."""
+    carried = {e.key: e.justification for e in previous.entries} \
+        if previous else {}
+    entries = [
+        BaselineEntry(
+            key=f.key,
+            justification=carried.get(f.key, "TODO: justify or fix"),
+            rule=f.rule,
+            path=f.path,
+        )
+        for f in sorted(findings, key=lambda f: f.key)
+    ]
+    data = {
+        "version": 1,
+        "entries": [dataclasses.asdict(e) for e in entries],
+    }
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return Baseline(entries=entries, path=path)

@@ -1,0 +1,12 @@
+"""Production checkers. Importing this package registers every rule
+with ``native.analyze.core.CHECKERS``."""
+
+from native.analyze.checkers import (  # noqa: F401
+    aot_launder,
+    atomic_write,
+    env_registry,
+    journal_span,
+    lock_discipline,
+    metric_names,
+    rpc_contract,
+)

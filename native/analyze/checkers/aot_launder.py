@@ -1,0 +1,207 @@
+"""Rule ``aot-launder``: host-built pytrees must be laundered before a
+deserialized ``Compiled`` call.
+
+The incident (PR 6, memory: aot-executable-cpu-hazards): a deserialized
+AOT executable skips pjit's input re-staging, and on the CPU backend
+``jax.device_put`` may ADOPT an aligned host buffer — so with input
+donation the executable's in-place update lands on one shared host
+allocation per restored leaf and compounds across devices (observed +8
+per step on an 8-device mesh; weight corruption when the buffers alias
+the shm arena). The contract: any tree sourced from checkpoint restore,
+``reshard_state`` or an shm read must pass through
+``parallel.compile_cache.launder`` (a jitted copy — exactly the
+re-staging pjit would have done) before reaching an executable obtained
+from the compile cache (``load_or_compile(...).fn``,
+``load_executable_blob``, ``deserialize_and_load``).
+
+Dataflow, per function, statements in source order (a lint, not an
+interpreter: both branches of a conditional are walked, taint survives
+joins, reassignment clears it):
+
+- ``x = restore*/reshard_state/load_raw/shm read`` taints ``x``;
+- ``x = launder(y)`` (resolved cross-module) produces a clean tree;
+- calling an AOT-sourced executable with a tainted variable anywhere in
+  its arguments is the violation.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from native.analyze.core import Checker, Finding, Module, Project, register
+
+# a call whose callee's last dotted segment matches one of these (or
+# starts with "restore") produces a HOST-BUILT tree
+SOURCE_SUFFIXES = {
+    "reshard_state",
+    "restore",
+    "load_raw",
+    "load_snapshot",
+    "read_snapshot",
+    "shm_read",
+    "read_state",
+}
+SOURCE_PREFIX = "restore"
+
+LAUNDER_SUFFIX = "launder"
+
+# calls that produce a deserialized/cached executable
+AOT_LOADER_SUFFIXES = {"load_executable_blob", "deserialize_and_load"}
+AOT_STEP_SUFFIX = "load_or_compile"   # returns AotStep; .fn is the callable
+
+
+def _target_names(target: ast.AST) -> list[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        names = []
+        for elt in target.elts:
+            names.extend(_target_names(elt))
+        return names
+    return []
+
+
+def _is_source_call(module: Module, call: ast.Call) -> bool:
+    suffix = module.call_suffix(call)
+    return suffix in SOURCE_SUFFIXES or (
+        suffix.startswith(SOURCE_PREFIX) and suffix != SOURCE_PREFIX + "d"
+    )
+
+
+class _FunctionState:
+    def __init__(self) -> None:
+        self.tainted: dict[str, str] = {}   # var -> source description
+        self.aot_callables: set[str] = set()
+        self.aot_steps: set[str] = set()
+
+
+@register
+class AotLaunderChecker(Checker):
+    rule = "aot-launder"
+    description = ("trees from restore/reshard_state/shm reads must go "
+                   "through compile_cache.launder before a deserialized "
+                   "Compiled call")
+    hint = ("state = compile_cache.launder(state)  # jitted copy: "
+            "re-stages every leaf into proper per-device buffers before "
+            "the donating AOT executable runs")
+
+    def check(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for module in project.modules:
+            for _symbol, func in module.functions():
+                findings.extend(self._check_function(module, func))
+        return findings
+
+    # ------------------------------------------------------------ per-func
+
+    def _check_function(self, module: Module,
+                        func: ast.FunctionDef) -> list[Finding]:
+        state = _FunctionState()
+        findings: list[Finding] = []
+        for stmt in func.body:
+            self._walk_stmt(module, stmt, state, findings)
+        return findings
+
+    def _walk_stmt(self, module: Module, stmt: ast.stmt,
+                   state: _FunctionState,
+                   findings: list[Finding]) -> None:
+        # nested defs get their own pass via Module.functions()
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.Assign):
+            self._sink_check(module, stmt.value, state, findings)
+            names: list[str] = []
+            for target in stmt.targets:
+                names.extend(_target_names(target))
+            self._transfer(module, stmt.value, names, state)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._sink_check(module, stmt.value, state, findings)
+            self._transfer(module, stmt.value,
+                           _target_names(stmt.target), state)
+        else:
+            for node in ast.iter_child_nodes(stmt):
+                if isinstance(node, ast.stmt):
+                    self._walk_stmt(module, node, state, findings)
+                elif isinstance(node, ast.expr):
+                    self._sink_check(module, node, state, findings)
+            return
+
+    def _transfer(self, module: Module, value: ast.AST,
+                  targets: list[str], state: _FunctionState) -> None:
+        """Propagate taint/executable facts through one assignment."""
+        if not targets:
+            return
+        if isinstance(value, ast.Call):
+            suffix = module.call_suffix(value)
+            if suffix == LAUNDER_SUFFIX:
+                for name in targets:
+                    state.tainted.pop(name, None)
+                return
+            if _is_source_call(module, value):
+                for name in targets:
+                    state.tainted[name] = suffix
+                return
+            if suffix in AOT_LOADER_SUFFIXES:
+                state.aot_callables.update(targets)
+                return
+            if suffix == AOT_STEP_SUFFIX:
+                state.aot_steps.update(targets)
+                return
+            # result of calling the executable itself is properly staged
+            for name in targets:
+                state.tainted.pop(name, None)
+                state.aot_callables.discard(name)
+            return
+        if isinstance(value, ast.Name):
+            for name in targets:
+                if value.id in state.tainted:
+                    state.tainted[name] = state.tainted[value.id]
+                else:
+                    state.tainted.pop(name, None)
+                if value.id in state.aot_callables:
+                    state.aot_callables.add(name)
+            return
+        if isinstance(value, ast.Attribute) and value.attr == "fn" \
+                and isinstance(value.value, ast.Name) \
+                and value.value.id in state.aot_steps:
+            state.aot_callables.update(targets)
+            return
+        if isinstance(value, ast.Tuple):
+            # conservative: tuple packs lose tracking
+            for name in targets:
+                state.tainted.pop(name, None)
+            return
+        for name in targets:
+            state.tainted.pop(name, None)
+
+    def _sink_check(self, module: Module, expr: ast.AST,
+                    state: _FunctionState,
+                    findings: list[Finding]) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = node.func
+            is_aot_call = (
+                (isinstance(callee, ast.Name)
+                 and callee.id in state.aot_callables)
+                or (isinstance(callee, ast.Attribute)
+                    and callee.attr == "fn"
+                    and isinstance(callee.value, ast.Name)
+                    and callee.value.id in state.aot_steps)
+            )
+            if not is_aot_call:
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name) \
+                            and sub.id in state.tainted:
+                        findings.append(self.finding(
+                            module, node,
+                            f"host-built tree {sub.id!r} (from "
+                            f"{state.tainted[sub.id]}) reaches a "
+                            "deserialized Compiled call without "
+                            "compile_cache.launder — CPU donation/"
+                            "adoption corrupts restored buffers",
+                        ))
+                        break

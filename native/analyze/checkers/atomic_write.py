@@ -1,0 +1,162 @@
+"""Rule ``atomic-write``: files another process consumes must be
+published atomically.
+
+The integrity story PR 4 built (Orbax-style, PAPERS.md) only holds if
+every cross-process handoff file — promotion payloads, request/ready/
+done markers, port files, snapshots, chaos plans — appears on disk
+either complete or not at all. ``common/storage.atomic_write_file``
+(tmp + fsync + rename) is the blessed publisher; it is also the chaos
+harness's ``storage_write`` injection point, so a handoff that bypasses
+it silently escapes fault coverage too.
+
+Heuristic: an ``open(path, "w"/"wb")`` (or ``.write_text``/
+``.write_bytes``) whose path expression mentions a handoff token
+(payload/request/ready/done/port/plan/...) is flagged, unless the
+enclosing function already implements the tmp+rename idiom
+(``os.replace``/``os.rename`` present) or delegates to
+``atomic_write_file``. ``common/storage.py`` itself is exempt (it is
+the implementation and the chaos torn-write site).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from native.analyze.core import Checker, Finding, Module, Project, register
+
+HANDOFF_TOKENS = (
+    "payload",
+    "request",
+    "response",
+    "ready",
+    "done",
+    "marker",
+    "port",
+    "plan",
+    "prepare",
+    "handshake",
+    "snapshot",
+)
+
+EXEMPT_SUFFIXES = ("common/storage.py",)
+
+_ATOMIC_CALLS = {"replace", "rename", "atomic_write_file"}
+
+
+def _write_mode(call: ast.Call) -> bool:
+    """True for open(..., "w"/"wb"/"w+"...) literal modes."""
+    mode_node = None
+    if len(call.args) > 1:
+        mode_node = call.args[1]
+    else:
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode_node = kw.value
+    if not isinstance(mode_node, ast.Constant) \
+            or not isinstance(mode_node.value, str):
+        return False
+    mode = mode_node.value
+    return "w" in mode and "r" not in mode and "a" not in mode
+
+
+_WORD_RE = re.compile(r"[a-z]+")
+
+
+def _expr_tokens(node: ast.AST) -> set[str]:
+    """Lowercased word chunks of an expression (identifiers split on
+    underscores/case so 'report'/'transport' never match 'port')."""
+    try:
+        text = ast.unparse(node).lower()
+    except Exception:  # pragma: no cover - unparse of odd nodes
+        return set()
+    return set(_WORD_RE.findall(text))
+
+
+def _function_is_atomic(func: ast.AST) -> bool:
+    """The enclosing scope already publishes via rename or delegates to
+    atomic_write_file."""
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call):
+            callee = node.func
+            name = callee.attr if isinstance(callee, ast.Attribute) \
+                else getattr(callee, "id", "")
+            if name in _ATOMIC_CALLS:
+                return True
+    return False
+
+
+@register
+class AtomicWriteChecker(Checker):
+    rule = "atomic-write"
+    description = ("cross-process handoff files (payload/request/ready/"
+                   "done/port/plan/snapshot paths) must be published via "
+                   "atomic_write_file, never a bare open('w')")
+    hint = ("from dlrover_tpu.common.storage import atomic_write_file\n"
+            "    atomic_write_file(content, path)  # tmp + fsync + "
+            "rename; also the chaos storage_write injection point")
+
+    def check(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for module in project.modules:
+            if module.relpath.endswith(EXEMPT_SUFFIXES):
+                continue
+            findings.extend(self._check_module(module))
+        return findings
+
+    def _check_module(self, module: Module) -> list[Finding]:
+        findings: list[Finding] = []
+        # map each write site to its innermost enclosing function so the
+        # tmp+rename idiom suppression is scoped correctly
+        scopes: list[ast.AST] = [module.tree]
+
+        def visit(node: ast.AST) -> None:
+            is_scope = isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))
+            if is_scope:
+                scopes.append(node)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if is_scope:
+                scopes.pop()
+                return
+            if not isinstance(node, ast.Call):
+                return
+            site = self._handoff_write(node)
+            if site is None:
+                return
+            kind, tokens = site
+            if _function_is_atomic(scopes[-1]):
+                return
+            token = next(
+                (t for t in HANDOFF_TOKENS if t in tokens), "?"
+            )
+            findings.append(self.finding(
+                module, node,
+                f"{kind} to a cross-process handoff path "
+                f"(token {token!r}) bypasses atomic_write_file — a "
+                "crash mid-write publishes a torn file to its reader",
+            ))
+
+        visit(module.tree)
+        return findings
+
+    def _handoff_write(self, call: ast.Call
+                       ) -> tuple[str, set[str]] | None:
+        """(description, path word chunks) when this call is a
+        non-atomic handoff write candidate."""
+        callee = call.func
+        if isinstance(callee, ast.Name) and callee.id == "open" \
+                and call.args:
+            if not _write_mode(call):
+                return None
+            tokens = _expr_tokens(call.args[0])
+            if tokens & set(HANDOFF_TOKENS):
+                return "open(mode='w')", tokens
+            return None
+        if isinstance(callee, ast.Attribute) \
+                and callee.attr in ("write_text", "write_bytes"):
+            tokens = _expr_tokens(callee.value)
+            if tokens & set(HANDOFF_TOKENS):
+                return f".{callee.attr}()", tokens
+        return None

@@ -1,0 +1,230 @@
+"""Rule ``env-registry``: every ``DLROVER_TPU_*`` env var resolves
+through the ``common/envspec.py`` registry.
+
+What used to be 100+ scattered ``os.environ`` reads with duplicated
+defaults is now a closed contract (see ``common/envspec.py``'s module
+docstring for the full rationale):
+
+1. ``DLROVER_TPU_*`` string literals are legal ONLY in
+   ``common/constants.py`` (the ``EnvKey`` names) and
+   ``common/envspec.py`` (the registry) — call sites must go through
+   ``EnvKey``/envspec helpers, so every var name is greppable from one
+   place;
+2. ``EnvKey`` constants and registry entries are a bijection — you
+   cannot add a name without declaring default/restart/anchor metadata,
+   nor register a var no constant exposes;
+3. every registered var appears verbatim in DESIGN.md (the generated
+   §19 reference table);
+4. module-level (import-time) env reads are legal only for vars
+   declared ``restart_required=True`` — an import-time read silently
+   freezes the value per process, so the registry must say so.
+
+All checks are static (the registry and EnvKey are parsed, never
+imported), so the rule works on test fixtures too.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from native.analyze.core import (
+    Checker,
+    Finding,
+    Module,
+    Project,
+    call_arg,
+    dotted,
+    literal_str,
+    register,
+)
+
+VAR_RE = re.compile(r"^DLROVER_TPU_[A-Z0-9_]*[A-Z0-9]$")
+
+ALLOWED_LITERAL_SUFFIXES = ("common/constants.py", "common/envspec.py")
+
+CONSTANTS_SUFFIX = "common/constants.py"
+ENVSPEC_SUFFIX = "common/envspec.py"
+
+
+def parse_envkey(module: Module) -> dict[str, str]:
+    """EnvKey attribute -> literal var name, from constants.py."""
+    out: dict[str, str] = {}
+    for node in module.tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == "EnvKey":
+            for stmt in node.body:
+                if isinstance(stmt, ast.Assign) \
+                        and isinstance(stmt.targets[0], ast.Name):
+                    value = literal_str(stmt.value)
+                    if value is not None:
+                        out[stmt.targets[0].id] = value
+    return out
+
+
+def parse_envspec(module: Module) -> dict[str, dict]:
+    """var name -> {restart_required, anchor, line}, from the EnvVar
+    constructions in envspec.py."""
+    specs: dict[str, dict] = {}
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name_node = dotted(node.func)
+        if not name_node or name_node.rsplit(".", 1)[-1] != "EnvVar":
+            continue
+        name_arg = call_arg(node, 0, "name")
+        name = literal_str(name_arg) if name_arg is not None else None
+        if name is None:
+            continue
+        restart = False
+        restart_arg = call_arg(node, 4, "restart_required")
+        if isinstance(restart_arg, ast.Constant):
+            restart = bool(restart_arg.value)
+        anchor_arg = call_arg(node, 3, "anchor")
+        anchor = literal_str(anchor_arg) if anchor_arg is not None else ""
+        specs[name] = {
+            "restart_required": restart,
+            "anchor": anchor or "",
+            "line": node.lineno,
+        }
+    return specs
+
+
+def _env_read_name(node: ast.Call | ast.Subscript, module: Module,
+                   envkey: dict[str, str]) -> str | None:
+    """The var name an os.environ read resolves to (literal or EnvKey
+    attribute), else None."""
+    if isinstance(node, ast.Call):
+        base = dotted(node.func)
+        if not base or not base.endswith("environ.get"):
+            return None
+        arg = call_arg(node, 0, "key")
+    else:
+        base = dotted(node.value)
+        if not base or not base.endswith("environ"):
+            return None
+        arg = node.slice
+    if arg is None:
+        return None
+    lit = literal_str(arg)
+    if lit is not None:
+        return lit
+    text = dotted(arg)
+    if text:
+        attr = text.rsplit(".", 1)[-1]
+        if attr in envkey:
+            return envkey[attr]
+    return None
+
+
+@register
+class EnvRegistryChecker(Checker):
+    rule = "env-registry"
+    description = ("DLROVER_TPU_* env vars resolve through the "
+                   "common/envspec.py registry: literals only in "
+                   "constants/envspec, EnvKey<->registry bijection, "
+                   "DESIGN.md documented, import-time reads only when "
+                   "restart_required")
+    hint = ("declare the var once: EnvKey.<NAME> in common/constants.py "
+            "+ EnvVar(...) in common/envspec.py (default, restart flag, "
+            "DESIGN.md anchor), then read via os.environ.get(EnvKey.X) "
+            "or envspec.get/get_bool; refresh the DESIGN.md table with "
+            "`python -m native.analyze --env-table`")
+
+    def check(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        constants = project.module_by_suffix(CONSTANTS_SUFFIX)
+        envspec = project.module_by_suffix(ENVSPEC_SUFFIX)
+        envkey = parse_envkey(constants) if constants else {}
+        specs = parse_envspec(envspec) if envspec else {}
+
+        # 1. literals outside the two declaration files
+        for module in project.modules:
+            if module.relpath.endswith(ALLOWED_LITERAL_SUFFIXES):
+                continue
+            for node in ast.walk(module.tree):
+                value = literal_str(node)
+                if value is not None and VAR_RE.match(value):
+                    findings.append(self.finding(
+                        module, node,
+                        f"raw env-var literal {value!r} outside the "
+                        "registry — use EnvKey/envspec so the name "
+                        "resolves through common/envspec.py",
+                    ))
+
+        # 2. bijection (only when both declaration files exist — test
+        # fixtures may exercise just the literal rule)
+        if constants is not None and envspec is not None:
+            for attr, name in sorted(envkey.items()):
+                if name not in specs:
+                    findings.append(self.finding(
+                        constants, constants.tree,
+                        f"EnvKey.{attr} ({name}) has no EnvVar entry in "
+                        "common/envspec.py",
+                    ))
+            for name, meta in sorted(specs.items()):
+                if VAR_RE.match(name) and name not in envkey.values():
+                    findings.append(Finding(
+                        rule=self.rule, path=envspec.relpath,
+                        line=meta["line"],
+                        message=f"registered var {name} has no EnvKey "
+                                "constant",
+                        hint=self.hint, symbol="<module>",
+                    ))
+            # 3. documentation
+            for name, meta in sorted(specs.items()):
+                if name not in project.design_text:
+                    findings.append(Finding(
+                        rule=self.rule, path=envspec.relpath,
+                        line=meta["line"],
+                        message=f"registered var {name} is not "
+                                "documented in DESIGN.md; regenerate "
+                                "the §19 env table",
+                        hint=self.hint, symbol="<module>",
+                    ))
+
+        # 4. import-time reads
+        for module in project.modules:
+            findings.extend(
+                self._import_time_reads(module, envkey, specs)
+            )
+        return findings
+
+    def _module_level_nodes(self, module: Module):
+        """Statements executed at import: module body plus class bodies
+        at module level (function bodies excluded)."""
+        def expand(stmts):
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(stmt, ast.ClassDef):
+                    yield from expand(stmt.body)
+                else:
+                    yield stmt
+
+        yield from expand(module.tree.body)
+
+    def _import_time_reads(self, module: Module,
+                           envkey: dict[str, str],
+                           specs: dict[str, dict]) -> list[Finding]:
+        findings: list[Finding] = []
+        if not specs:
+            return findings
+        for stmt in self._module_level_nodes(module):
+            for node in ast.walk(stmt):
+                if not isinstance(node, (ast.Call, ast.Subscript)):
+                    continue
+                name = _env_read_name(node, module, envkey)
+                if name is None or not VAR_RE.match(name):
+                    continue
+                spec = specs.get(name)
+                if spec is not None and spec["restart_required"]:
+                    continue
+                findings.append(self.finding(
+                    module, node,
+                    f"import-time read of {name} which is not declared "
+                    "restart_required in envspec — the value freezes "
+                    "per process; move the read into the consumer or "
+                    "flag the var restart_required",
+                ))
+        return findings

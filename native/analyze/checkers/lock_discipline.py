@@ -1,0 +1,292 @@
+"""Rule ``lock-discipline``: shared state touched by daemon threads is
+mutated under a held lock, and lock acquisition order is cycle-free.
+
+The elastic recovery paths (ElasWave-style resharding, warm standby,
+buddy replication — PAPERS.md) are full of classes that spawn
+``threading.Thread(target=self._loop, daemon=True)`` and then mutate
+``self.*`` attributes both from that loop and from the caller-facing
+API. Until now the "hold the lock" rule was convention enforced by
+review; this checker makes it structural:
+
+- Per class, find *thread-entry* methods: ``target=self.X`` of any
+  ``threading.Thread(...)`` construction in the class, plus ``run`` on
+  ``Thread`` subclasses. Methods reachable from an entry through
+  ``self.Y()`` calls count as thread context too.
+- An attribute mutated (assigned/augassigned/subscript-stored) both in
+  thread context and in non-thread methods (``__init__`` excluded —
+  it runs before the thread exists) is *shared*; every mutation site of
+  a shared attribute must sit inside ``with self.<lock>:`` for some
+  lock attribute (``threading.Lock/RLock/Condition`` created in the
+  class). A class with shared mutations and no lock at all is flagged
+  once at the class line.
+- While walking ``with self.A:`` bodies, nested ``with self.B:`` adds
+  the edge ``Class.A -> Class.B`` to a project-wide acquisition graph;
+  any cycle is a deadlock ordering and is reported on one edge site.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from native.analyze.core import (
+    Checker,
+    Finding,
+    Module,
+    Project,
+    dotted,
+    register,
+)
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+_MUTATOR_EXEMPT_METHODS = {"__init__", "__post_init__"}
+
+
+def _is_self_attr(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+@dataclasses.dataclass
+class _Mutation:
+    attr: str
+    method: str
+    node: ast.AST
+    guarded: bool
+
+
+class _ClassInfo:
+    def __init__(self, module: Module, node: ast.ClassDef):
+        self.module = module
+        self.node = node
+        self.name = node.name
+        self.methods: dict[str, ast.FunctionDef] = {
+            child.name: child for child in node.body
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        self.lock_attrs: set[str] = set()
+        self.thread_entries: set[str] = set()
+        self.calls: dict[str, set[str]] = {}   # method -> self.X() callees
+        self.mutations: list[_Mutation] = []
+        self.lock_edges: list[tuple[str, str, ast.AST]] = []
+        self._scan()
+
+    # ------------------------------------------------------------- scanning
+
+    def _scan(self) -> None:
+        is_thread_subclass = any(
+            (dotted(base) or "").endswith("Thread")
+            for base in self.node.bases
+        )
+        if is_thread_subclass and "run" in self.methods:
+            self.thread_entries.add("run")
+        for name, method in self.methods.items():
+            self._scan_method(name, method)
+
+    def _scan_method(self, method_name: str,
+                     method: ast.FunctionDef) -> None:
+        callees: set[str] = set()
+        self.calls[method_name] = callees
+        held: list[str] = []   # stack of held self-lock attrs
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not method:
+                return   # nested defs: skip (closures get no credit)
+            if isinstance(node, ast.With):
+                # only bare `with self._lock:` counts — explicit
+                # .acquire() calls don't establish a guard scope
+                lock_attrs = [
+                    attr for item in node.items
+                    if (attr := _is_self_attr(item.context_expr))
+                    is not None and attr in self.lock_attrs
+                ]
+                for attr in lock_attrs:
+                    for holder in held:
+                        if holder != attr:
+                            self.lock_edges.append((holder, attr, node))
+                held.extend(lock_attrs)
+                for item in node.items:
+                    visit(item.context_expr)
+                for child in node.body:
+                    visit(child)
+                for _ in lock_attrs:
+                    held.pop()
+                return
+            # lock attribute creation
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                suffix = self.module.call_suffix(node.value)
+                if suffix in _LOCK_FACTORIES:
+                    for target in node.targets:
+                        attr = _is_self_attr(target)
+                        if attr is not None:
+                            self.lock_attrs.add(attr)
+            # thread entry discovery: threading.Thread(target=self.X)
+            if isinstance(node, ast.Call):
+                suffix = self.module.call_suffix(node)
+                if suffix == "Thread":
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            attr = _is_self_attr(kw.value)
+                            if attr is not None:
+                                self.thread_entries.add(attr)
+                callee_attr = _is_self_attr(node.func)
+                if callee_attr is not None:
+                    callees.add(callee_attr)
+            # mutations
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    self._record_mutation(target, method_name, held)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                self._record_mutation(node.target, method_name, held)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        visit(method)
+
+    def _record_mutation(self, target: ast.AST, method_name: str,
+                         held: list[str]) -> None:
+        attr = _is_self_attr(target)
+        if attr is None and isinstance(target, ast.Subscript):
+            attr = _is_self_attr(target.value)
+        if attr is None and isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._record_mutation(elt, method_name, held)
+            return
+        if attr is None:
+            return
+        self.mutations.append(_Mutation(
+            attr=attr, method=method_name, node=target,
+            guarded=bool(held),
+        ))
+
+    # ------------------------------------------------------------ analysis
+
+    def thread_methods(self) -> set[str]:
+        """Entries plus methods reachable from them via self.X() calls."""
+        reachable = set(self.thread_entries)
+        frontier = list(reachable)
+        while frontier:
+            current = frontier.pop()
+            for callee in self.calls.get(current, ()):
+                if callee in self.methods and callee not in reachable:
+                    reachable.add(callee)
+                    frontier.append(callee)
+        return reachable
+
+    def shared_unguarded(self) -> tuple[list[_Mutation], set[str]]:
+        """Unguarded mutation sites of attributes mutated in both thread
+        and non-thread contexts; plus the set of shared attrs."""
+        in_thread = self.thread_methods()
+        by_attr: dict[str, list[_Mutation]] = {}
+        for mutation in self.mutations:
+            if mutation.method in _MUTATOR_EXEMPT_METHODS:
+                continue
+            if mutation.attr in self.lock_attrs:
+                continue
+            by_attr.setdefault(mutation.attr, []).append(mutation)
+        shared: set[str] = set()
+        unguarded: list[_Mutation] = []
+        for attr, sites in by_attr.items():
+            contexts = {site.method in in_thread for site in sites}
+            if contexts != {True, False}:
+                continue   # mutated from one side only
+            shared.add(attr)
+            seen_methods: set[str] = set()
+            for site in sites:
+                if site.guarded or site.method in seen_methods:
+                    continue
+                seen_methods.add(site.method)   # one finding per method
+                unguarded.append(site)
+        return unguarded, shared
+
+
+@register
+class LockDisciplineChecker(Checker):
+    rule = "lock-discipline"
+    description = ("attributes mutated both inside daemon-thread context "
+                   "and outside must be mutated under a held lock; the "
+                   "lock acquisition graph must be cycle-free")
+    hint = ("guard every mutation site: `with self._lock: self.attr = "
+            "...` (create `self._lock = threading.Lock()` in __init__); "
+            "for ordering cycles, acquire locks in one global order or "
+            "collapse to a single lock")
+
+    def check(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        all_edges: list[tuple[str, str, Module, ast.AST]] = []
+        for module in project.modules:
+            for class_node in module.classes():
+                info = _ClassInfo(module, class_node)
+                if not info.thread_entries:
+                    continue
+                unguarded, shared = info.shared_unguarded()
+                if unguarded and not info.lock_attrs:
+                    findings.append(self.finding(
+                        module, class_node,
+                        f"class {info.name} runs thread(s) "
+                        f"({', '.join(sorted(info.thread_entries))}) and "
+                        f"mutates shared attribute(s) "
+                        f"{sorted(shared)} with no lock attribute at all",
+                    ))
+                    continue
+                for site in unguarded:
+                    findings.append(self.finding(
+                        module, site.node,
+                        f"{info.name}.{site.attr} is mutated in "
+                        f"{site.method}() without a held lock, but is "
+                        "also mutated from "
+                        + ("thread context"
+                           if site.method not in info.thread_methods()
+                           else "non-thread context"),
+                    ))
+                for src, dst, node in info.lock_edges:
+                    all_edges.append((f"{info.name}.{src}",
+                                      f"{info.name}.{dst}", module, node))
+        findings.extend(self._cycle_findings(all_edges))
+        return findings
+
+    def _cycle_findings(
+        self, edges: list[tuple[str, str, Module, ast.AST]]
+    ) -> list[Finding]:
+        graph: dict[str, set[str]] = {}
+        sites: dict[tuple[str, str], tuple[Module, ast.AST]] = {}
+        for src, dst, module, node in edges:
+            graph.setdefault(src, set()).add(dst)
+            sites.setdefault((src, dst), (module, node))
+        findings: list[Finding] = []
+        reported: set[frozenset[str]] = set()
+
+        def dfs(node: str, stack: list[str], visiting: set[str],
+                done: set[str]) -> None:
+            visiting.add(node)
+            stack.append(node)
+            for nxt in sorted(graph.get(node, ())):
+                if nxt in visiting:
+                    cycle = stack[stack.index(nxt):] + [nxt]
+                    key = frozenset(cycle)
+                    if key not in reported:
+                        reported.add(key)
+                        module, site = sites[(node, nxt)]
+                        findings.append(self.finding(
+                            module, site,
+                            "lock acquisition cycle "
+                            + " -> ".join(cycle)
+                            + " — two threads taking opposite ends "
+                            "deadlock",
+                        ))
+                elif nxt not in done:
+                    dfs(nxt, stack, visiting, done)
+            stack.pop()
+            visiting.discard(node)
+            done.add(node)
+
+        done: set[str] = set()
+        for node in sorted(graph):
+            if node not in done:
+                dfs(node, [], set(), done)
+        return findings

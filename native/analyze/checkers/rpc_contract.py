@@ -1,0 +1,264 @@
+"""Rule ``rpc-contract``: the typed control-plane message surface is
+closed — every message sent has a handler, and field usage matches the
+declared dataclasses.
+
+The servicer dispatches on ``isinstance`` and ends in ``raise
+TypeError`` for unknown types, so a message class added to
+``common/messages.py`` and sent by a client without a matching branch
+only fails at runtime, mid-recovery, over RPC. Statically enforced
+instead:
+
+- every message constructed inside a ``*.call(...)`` anywhere in the
+  package has an ``isinstance`` dispatch branch SOMEWHERE in the
+  package (the master servicer is one dispatcher among several — the
+  brain service and the strategy engine service run their own);
+- every ``*Request`` message class is dispatched by some handler, and
+  the ones the MASTER servicer handles also have a ``master_client``
+  construction (the typed client is the API surface — a master request
+  only reachable by hand-rolled RPC is a contract gap);
+- every keyword in any ``m.X(...)`` construction is a declared field of
+  ``X`` (dataclass kwargs explode at call time, far from the typo);
+- inside an ``isinstance(msg, m.X)`` branch of any dispatcher, every
+  ``msg.attr`` access is a declared field (or method) of ``X``.
+
+Modules are located by path suffix (``common/messages.py``,
+``master/servicer.py``, ``agent/master_client.py``), so fixtures can
+supply miniature versions.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from native.analyze.core import (
+    Checker,
+    Finding,
+    Module,
+    Project,
+    dotted,
+    register,
+)
+
+MESSAGES_SUFFIX = "common/messages.py"
+SERVICER_SUFFIX = "master/servicer.py"
+CLIENT_SUFFIX = "agent/master_client.py"
+
+
+def message_classes(module: Module) -> dict[str, set[str]]:
+    """class name -> declared field/method names."""
+    classes: dict[str, set[str]] = {}
+    for node in module.tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        members: set[str] = set()
+        for stmt in node.body:
+            if isinstance(stmt, ast.AnnAssign) \
+                    and isinstance(stmt.target, ast.Name):
+                members.add(stmt.target.id)
+            elif isinstance(stmt, ast.Assign):
+                for target in stmt.targets:
+                    if isinstance(target, ast.Name):
+                        members.add(target.id)
+            elif isinstance(stmt, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                members.add(stmt.name)
+        # single inheritance between messages: fold base fields in
+        for base in node.bases:
+            base_name = (dotted(base) or "").rsplit(".", 1)[-1]
+            if base_name in classes:
+                members |= classes[base_name]
+        classes[node.name] = members
+    return classes
+
+
+def _message_ref(node: ast.AST, classes: dict[str, set[str]]
+                 ) -> str | None:
+    """Resolve an expression like ``m.FooRequest``/``FooRequest`` to a
+    known message class name."""
+    text = dotted(node)
+    if not text:
+        return None
+    name = text.rsplit(".", 1)[-1]
+    return name if name in classes else None
+
+
+def _isinstance_branch(test: ast.AST, classes: dict[str, set[str]]
+                       ) -> tuple[str, str] | None:
+    """(varname, class) for ``isinstance(<var>, m.X)`` tests."""
+    if isinstance(test, ast.Call) and isinstance(test.func, ast.Name) \
+            and test.func.id == "isinstance" and len(test.args) == 2 \
+            and isinstance(test.args[0], ast.Name):
+        cls = _message_ref(test.args[1], classes)
+        if cls is not None:
+            return test.args[0].id, cls
+    return None
+
+
+@register
+class RpcContractChecker(Checker):
+    rule = "rpc-contract"
+    description = ("every sent message has a servicer handler, every "
+                   "*Request a master_client method, and constructor "
+                   "kwargs / msg.attr accesses match declared fields")
+    hint = ("add the isinstance branch to MasterServicer._dispatch and "
+            "a typed method to agent/master_client.py; fields must be "
+            "declared on the @register_message dataclass in "
+            "common/messages.py")
+
+    def check(self, project: Project) -> list[Finding]:
+        messages = project.module_by_suffix(MESSAGES_SUFFIX)
+        servicer = project.module_by_suffix(SERVICER_SUFFIX)
+        client = project.module_by_suffix(CLIENT_SUFFIX)
+        if messages is None or servicer is None or client is None:
+            return []   # not a control-plane tree (fixture subsets)
+        classes = message_classes(messages)
+        findings: list[Finding] = []
+
+        master_handled = self._handled_classes(servicer, classes)
+        handled_anywhere: set[str] = set()
+        for module in project.modules:
+            handled_anywhere |= self._handled_classes(module, classes)
+        client_built = self._constructed(client, classes)
+        sent = self._sent_classes(project, classes)
+
+        for cls, node in sorted(sent.items()):
+            if cls not in handled_anywhere:
+                module, site = node
+                findings.append(self.finding(
+                    module, site,
+                    f"message {cls} is sent over RPC but no dispatcher "
+                    "in the package has an isinstance branch for it — "
+                    "the call raises TypeError at runtime",
+                ))
+        for cls in sorted(classes):
+            if not cls.endswith("Request"):
+                continue
+            class_node = self._class_node(messages, cls)
+            if cls not in handled_anywhere:
+                findings.append(self.finding(
+                    messages, class_node,
+                    f"request message {cls} has no dispatcher handling "
+                    "it anywhere in the package",
+                ))
+            if cls in master_handled and cls not in client_built:
+                findings.append(self.finding(
+                    messages, class_node,
+                    f"master-handled request {cls} has no master_client "
+                    "method constructing it",
+                ))
+
+        findings.extend(self._kwarg_findings(project, classes))
+        for module in project.modules:
+            findings.extend(self._branch_field_findings(module, classes))
+        return findings
+
+    # ------------------------------------------------------------- helpers
+
+    def _class_node(self, messages: Module, name: str) -> ast.AST:
+        for node in messages.tree.body:
+            if isinstance(node, ast.ClassDef) and node.name == name:
+                return node
+        return messages.tree
+
+    def _handled_classes(self, servicer: Module,
+                         classes: dict[str, set[str]]) -> set[str]:
+        handled: set[str] = set()
+        for node in ast.walk(servicer.tree):
+            if isinstance(node, ast.If):
+                branch = _isinstance_branch(node.test, classes)
+                if branch is not None:
+                    handled.add(branch[1])
+        return handled
+
+    def _constructed(self, module: Module,
+                     classes: dict[str, set[str]]) -> set[str]:
+        built: set[str] = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                cls = _message_ref(node.func, classes)
+                if cls is not None:
+                    built.add(cls)
+        # typed pass-through methods (e.g. report_paral_config(config:
+        # m.ParalConfig)) send a parameter instead of constructing
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for arg in node.args.args:
+                    if arg.annotation is not None:
+                        cls = _message_ref(arg.annotation, classes)
+                        if cls is not None:
+                            built.add(cls)
+        return built
+
+    def _sent_classes(self, project: Project,
+                      classes: dict[str, set[str]]
+                      ) -> dict[str, tuple[Module, ast.AST]]:
+        """Message classes constructed directly inside a ``*.call(...)``
+        argument anywhere in the package."""
+        sent: dict[str, tuple[Module, ast.AST]] = {}
+        for module in project.modules:
+            for node in ast.walk(module.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "call" and node.args):
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Call):
+                    cls = _message_ref(arg.func, classes)
+                    if cls is not None and cls not in sent:
+                        sent[cls] = (module, node)
+        return sent
+
+    def _kwarg_findings(self, project: Project,
+                        classes: dict[str, set[str]]) -> list[Finding]:
+        findings: list[Finding] = []
+        for module in project.modules:
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                cls = _message_ref(node.func, classes)
+                if cls is None:
+                    continue
+                # only message-module references (m.X / messages.X) or
+                # names imported from the messages module count — a
+                # same-named local class elsewhere is out of scope
+                qual = module.qualname(node.func) or ""
+                if "messages" not in qual and not module.relpath.endswith(
+                        MESSAGES_SUFFIX):
+                    continue
+                fields = classes[cls]
+                for kw in node.keywords:
+                    if kw.arg is not None and kw.arg not in fields:
+                        findings.append(self.finding(
+                            module, node,
+                            f"{cls}(...) constructed with unknown "
+                            f"field {kw.arg!r} — declared fields: "
+                            f"{sorted(fields)}",
+                        ))
+        return findings
+
+    def _branch_field_findings(self, servicer: Module,
+                               classes: dict[str, set[str]]
+                               ) -> list[Finding]:
+        findings: list[Finding] = []
+        common = {"__class__", "__dict__"}
+        for node in ast.walk(servicer.tree):
+            if not isinstance(node, ast.If):
+                continue
+            branch = _isinstance_branch(node.test, classes)
+            if branch is None:
+                continue
+            var, cls = branch
+            fields = classes[cls] | common
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Attribute) \
+                            and isinstance(sub.value, ast.Name) \
+                            and sub.value.id == var \
+                            and sub.attr not in fields:
+                        findings.append(self.finding(
+                            servicer, sub,
+                            f"access {var}.{sub.attr} inside the "
+                            f"isinstance({var}, {cls}) branch, but "
+                            f"{cls} declares no field {sub.attr!r}",
+                        ))
+        return findings

@@ -1,0 +1,176 @@
+"""`python -m native.analyze` — run the invariant checkers and gate on
+the committed baseline.
+
+Exit codes: 0 = clean (every finding grandfathered), 1 = new findings
+or stale baseline entries, 2 = usage error. Tier-1 runs::
+
+    python -m native.analyze dlrover_tpu \
+        --format json --baseline native/analyze/baseline.json
+
+``--fix-hints`` appends each rule's remediation snippet to text output;
+``--env-table`` prints the DLROVER_TPU_* reference table DESIGN.md
+embeds (generated from ``common/envspec.py`` so docs cannot drift).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+from native.analyze import checkers as _checkers  # noqa: F401 - registers
+from native.analyze.baseline import (
+    Baseline,
+    BaselineEntry,
+    load_baseline,
+    save_baseline,
+)
+from native.analyze.core import CHECKERS, Finding, Project
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "native", "analyze",
+                                "baseline.json")
+
+
+@dataclasses.dataclass
+class AnalysisResult:
+    findings: list[Finding]
+    new_findings: list[Finding]
+    grandfathered: list[Finding]
+    stale_entries: list[BaselineEntry]
+    rules: list[str]
+
+    @property
+    def ok(self) -> bool:
+        return not self.new_findings and not self.stale_entries
+
+    def to_dict(self) -> dict:
+        counts: dict[str, int] = {}
+        for f in self.findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        return {
+            "ok": self.ok,
+            "rules": self.rules,
+            "counts": counts,
+            "findings": [f.to_dict() for f in self.findings],
+            "new": [f.key for f in self.new_findings],
+            "grandfathered": [f.key for f in self.grandfathered],
+            "stale_baseline": [e.key for e in self.stale_entries],
+        }
+
+
+def run_analysis(root: str = REPO_ROOT, package: str = "dlrover_tpu",
+                 rules: list[str] | None = None,
+                 baseline: Baseline | str | None = None,
+                 design_path: str | None = None) -> AnalysisResult:
+    """Parse the package once, run the selected checkers, split against
+    the baseline. ``baseline`` may be a path, a loaded Baseline, or
+    None (everything counts as new)."""
+    selected = sorted(rules if rules is not None else CHECKERS)
+    unknown = [r for r in selected if r not in CHECKERS]
+    if unknown:
+        raise ValueError(
+            f"unknown rule(s) {unknown}; available: {sorted(CHECKERS)}"
+        )
+    project = Project(root, package=package, design_path=design_path)
+    findings: list[Finding] = list(project.parse_failures)
+    for rule in selected:
+        findings.extend(CHECKERS[rule]().check(project))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    if isinstance(baseline, str):
+        baseline = load_baseline(baseline)
+    if baseline is None:
+        baseline = Baseline()
+    new, old, stale = baseline.split(findings)
+    return AnalysisResult(findings=findings, new_findings=new,
+                          grandfathered=old, stale_entries=stale,
+                          rules=selected)
+
+
+def _print_text(result: AnalysisResult, fix_hints: bool) -> None:
+    for f in result.new_findings:
+        print(f.render(fix_hints=fix_hints))
+    for e in result.stale_entries:
+        print(f"stale baseline entry (fixed? remove it or run "
+              f"--update-baseline): {e.key}")
+    n_rules = len(result.rules)
+    if result.ok:
+        grandfathered = len(result.grandfathered)
+        extra = f", {grandfathered} baselined" if grandfathered else ""
+        print(f"analyze: OK — {n_rules} rules, 0 new findings{extra}")
+    else:
+        print(
+            f"analyze: FAIL — {len(result.new_findings)} new finding(s), "
+            f"{len(result.stale_entries)} stale baseline entr(ies) "
+            f"across {n_rules} rules",
+            file=sys.stderr,
+        )
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m native.analyze",
+        description="invariant analyzer (DESIGN.md §19)",
+    )
+    parser.add_argument("package", nargs="?", default="dlrover_tpu",
+                        help="package dir under --root to analyze")
+    parser.add_argument("--root", default=REPO_ROOT)
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline JSON path (default: none)")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite --baseline from current findings, "
+                             "keeping surviving justifications")
+    parser.add_argument("--fix-hints", action="store_true",
+                        help="print the remediation snippet per finding")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule subset")
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--env-table", action="store_true",
+                        help="print the env-var reference table from "
+                             "common/envspec.py and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(CHECKERS):
+            print(f"{rule}: {CHECKERS[rule].description}")
+        return 0
+    if args.env_table:
+        sys.path.insert(0, args.root)
+        from dlrover_tpu.common import envspec
+
+        print(envspec.markdown_table())
+        return 0
+
+    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    baseline_path = args.baseline
+    try:
+        result = run_analysis(
+            root=args.root, package=args.package, rules=rules,
+            baseline=baseline_path,
+        )
+    except ValueError as e:
+        print(f"analyze: {e}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        if not baseline_path:
+            print("analyze: --update-baseline requires --baseline",
+                  file=sys.stderr)
+            return 2
+        previous = load_baseline(baseline_path)
+        save_baseline(baseline_path, result.findings, previous=previous)
+        print(f"analyze: baseline rewritten with "
+              f"{len(result.findings)} entr(ies) at {baseline_path}")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps(result.to_dict(), indent=2))
+    else:
+        _print_text(result, fix_hints=args.fix_hints)
+    return 0 if result.ok else 1

@@ -1,0 +1,287 @@
+"""Analyzer core: finding objects, the checker registry, and the parsed
+project model every checker walks.
+
+Design constraints that shaped this module:
+
+- **Stable baseline keys.** A grandfathered finding must keep matching
+  its baseline entry while unrelated edits shift line numbers, so a
+  ``Finding``'s identity is ``rule|path|symbol|message`` (the enclosing
+  ``Class.method`` symbol, never the line). Messages therefore must not
+  embed line numbers.
+- **Cross-module symbol resolution without imports.** Checkers need to
+  know that ``launder(...)``, ``compile_cache.launder(...)`` and
+  ``from ...compile_cache import launder as L; L(...)`` are the same
+  function. Each ``Module`` builds an alias→dotted-path import map and
+  ``Module.qualname`` resolves any Name/Attribute chain through it —
+  purely static, so the analyzer never executes package code.
+- **Fixture-friendly.** A ``Project`` is rooted anywhere (tests point it
+  at a tmp dir with seeded violations); nothing hardcodes the real
+  package path.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Iterator, Optional
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation at a file:line, with a remediation hint."""
+
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    message: str       # line-number free (baseline key stability)
+    hint: str = ""
+    symbol: str = ""   # enclosing "Class.method" / "function" / "<module>"
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}|{self.path}|{self.symbol}|{self.message}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "symbol": self.symbol,
+            "message": self.message,
+            "hint": self.hint,
+            "key": self.key,
+        }
+
+    def render(self, fix_hints: bool = False) -> str:
+        text = f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+        if fix_hints and self.hint:
+            text += f"\n    fix: {self.hint}"
+        return text
+
+
+class Checker:
+    """Base class: subclass, set ``rule``/``description``/``hint``,
+    implement ``check``, and decorate with ``@register``."""
+
+    rule: str = ""
+    description: str = ""
+    # generic remediation snippet shown by --fix-hints (per-finding
+    # hints override it)
+    hint: str = ""
+
+    def check(self, project: "Project") -> list[Finding]:
+        raise NotImplementedError
+
+    def finding(self, module: "Module", node: ast.AST, message: str,
+                hint: str = "") -> Finding:
+        return Finding(
+            rule=self.rule,
+            path=module.relpath,
+            line=getattr(node, "lineno", 1),
+            message=message,
+            hint=hint or self.hint,
+            symbol=module.symbol_at(node),
+        )
+
+
+CHECKERS: dict[str, type[Checker]] = {}
+
+
+def register(cls: type[Checker]) -> type[Checker]:
+    if not cls.rule:
+        raise ValueError(f"{cls.__name__} has no rule id")
+    CHECKERS[cls.rule] = cls
+    return cls
+
+
+# ------------------------------------------------------------------ modules
+
+
+def _import_map(tree: ast.AST) -> dict[str, str]:
+    """alias -> fully qualified dotted path, from the module's imports."""
+    mapping: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    mapping[alias.asname] = alias.name
+                else:
+                    # ``import a.b.c`` binds ``a``; resolving the head
+                    # segment is enough for dotted-chain resolution
+                    head = alias.name.split(".")[0]
+                    mapping.setdefault(head, head)
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            for alias in node.names:
+                mapping[alias.asname or alias.name] = \
+                    f"{node.module}.{alias.name}"
+    return mapping
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """Textual dotted path of a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class Module:
+    """One parsed source file plus resolution helpers."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.tree = ast.parse(source, filename=relpath)
+        self.imports = _import_map(self.tree)
+        self._symbols: Optional[list[tuple[int, int, str]]] = None
+
+    def qualname(self, node: ast.AST) -> Optional[str]:
+        """Resolve a Name/Attribute chain through this module's imports
+        to a fully qualified dotted path (best effort)."""
+        text = dotted(node)
+        if text is None:
+            return None
+        head, _, rest = text.partition(".")
+        resolved = self.imports.get(head)
+        if resolved is None:
+            return text
+        return f"{resolved}.{rest}" if rest else resolved
+
+    def call_suffix(self, call: ast.Call) -> str:
+        """Last dotted segment of a call's callee ('' when dynamic)."""
+        text = dotted(call.func)
+        return text.rsplit(".", 1)[-1] if text else ""
+
+    def _build_symbols(self) -> list[tuple[int, int, str]]:
+        spans: list[tuple[int, int, str]] = []
+
+        def visit(node: ast.AST, prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.ClassDef)):
+                    name = f"{prefix}.{child.name}" if prefix else child.name
+                    end = getattr(child, "end_lineno", child.lineno)
+                    spans.append((child.lineno, end, name))
+                    visit(child, name)
+                else:
+                    visit(child, prefix)
+
+        visit(self.tree, "")
+        # innermost span wins: sort by size so later lookups can take
+        # the narrowest enclosing one
+        spans.sort(key=lambda s: (s[1] - s[0]), reverse=True)
+        return spans
+
+    def symbol_at(self, node: ast.AST) -> str:
+        """Innermost enclosing Class.func symbol for a node."""
+        line = getattr(node, "lineno", 0)
+        if not line:
+            return "<module>"
+        if self._symbols is None:
+            self._symbols = self._build_symbols()
+        best = "<module>"
+        for start, end, name in self._symbols:
+            if start <= line <= end:
+                best = name  # spans sorted widest-first: keep narrowing
+        return best
+
+    def functions(self) -> Iterator[tuple[str, ast.FunctionDef]]:
+        """(symbol, node) for every function/method in the module."""
+
+        def visit(node: ast.AST, prefix: str):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    name = f"{prefix}.{child.name}" if prefix else child.name
+                    yield name, child
+                    yield from visit(child, name)
+                elif isinstance(child, ast.ClassDef):
+                    cname = f"{prefix}.{child.name}" if prefix \
+                        else child.name
+                    yield from visit(child, cname)
+                else:
+                    yield from visit(child, prefix)
+
+        yield from visit(self.tree, "")
+
+    def classes(self) -> Iterator[ast.ClassDef]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ClassDef):
+                yield node
+
+
+# ------------------------------------------------------------------ project
+
+
+class Project:
+    """All parsed modules of one package tree plus shared context
+    (DESIGN.md text) checkers assert contracts against."""
+
+    def __init__(self, root: str, package: str = "dlrover_tpu",
+                 design_path: str | None = None):
+        self.root = os.path.abspath(root)
+        self.package = package
+        self.package_dir = os.path.join(self.root, package)
+        self.modules: list[Module] = []
+        self.parse_failures: list[Finding] = []
+        for dirpath, dirnames, filenames in os.walk(self.package_dir):
+            dirnames[:] = sorted(
+                d for d in dirnames if d != "__pycache__"
+            )
+            for fname in sorted(filenames):
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                rel = os.path.relpath(path, self.root)
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+                try:
+                    self.modules.append(Module(path, rel, source))
+                except SyntaxError as e:
+                    self.parse_failures.append(Finding(
+                        rule="parse-error", path=rel.replace(os.sep, "/"),
+                        line=e.lineno or 1,
+                        message=f"cannot parse: {e.msg}",
+                    ))
+        design = design_path or os.path.join(self.root, "DESIGN.md")
+        try:
+            with open(design, encoding="utf-8") as f:
+                self.design_text = f.read()
+        except OSError:
+            self.design_text = ""
+        self.design_path = design
+
+    def module_by_suffix(self, suffix: str) -> Optional[Module]:
+        """Find the one module whose relpath ends with ``suffix``
+        (e.g. ``common/messages.py``)."""
+        suffix = suffix.replace(os.sep, "/")
+        for module in self.modules:
+            if module.relpath.endswith(suffix):
+                return module
+        return None
+
+
+# ------------------------------------------------------------ shared helpers
+
+
+def literal_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def call_arg(call: ast.Call, index: int, keyword: str) -> Optional[ast.AST]:
+    """Positional-or-keyword argument of a call, else None."""
+    if len(call.args) > index:
+        return call.args[index]
+    for kw in call.keywords:
+        if kw.arg == keyword:
+            return kw.value
+    return None

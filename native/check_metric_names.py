@@ -1,292 +1,44 @@
 #!/usr/bin/env python3
-"""Lint: metric names AND journal span names are well-formed + documented.
+"""Shim: the metric/span/fault-point name lint moved into the invariant
+analyzer (``native/analyze/checkers/metric_names.py`` — rule
+``metric-name`` — and ``journal_span.py`` — rule ``journal-span``).
 
-Walks the package source for ``registry().counter("...")`` /
-``.gauge("...")`` / ``.histogram("...")`` registrations and asserts
+This entry point is kept so existing invocations and the tier-1 tests
+that load it by file path keep working unchanged; it re-exports the
+full legacy API and CLI. New code should run the framework instead::
 
-- every name matches ``dlrover_tpu_[a-z_]+`` (no digits, no dots — the
-  Prometheus-safe subset the exposition endpoint promises),
-- every name is registered in exactly one call site, so the endpoint can
-  never emit colliding series with divergent help/type/labels, and
-- every ``dlrover_tpu_gateway_*``, ``dlrover_tpu_standby_*`` and
-  interval-tuner (``dlrover_tpu_snapshot_interval_*``) name appears
-  verbatim in DESIGN.md: those scrape surfaces are operator contracts
-  (deploy/README.md points dashboards and the "recovery is slow"
-  runbook at them), so registry and docs must not drift.
-
-It also walks journal emissions (``.emit("...")`` / ``.begin("...")`` /
-``.span("...")``) and asserts every span name matches ``[a-z_]+``, is
-passed as a literal, and appears verbatim in DESIGN.md — span names are
-the contract ``telemetry/report.py`` attributes lost time by and
-``telemetry/timeline.py`` renders, so a span shipped undocumented is a
-span the operator can't read.
-
-Chaos fault points (``chaos.fire("...")`` injection sites) are linted
-the same way: literal ``[a-z_]+`` names, each documented in DESIGN.md —
-a fault point a chaos plan can't be written against (because nobody
-can discover its name) is dead weight in a hot path.
-
-Invoked from the tier-1 suite (tests/test_telemetry.py +
-tests/test_flight_recorder.py) and runnable standalone:
-``python native/check_metric_names.py``.
+    python -m native.analyze dlrover_tpu --rules metric-name,journal-span
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-NAME_RE = re.compile(r"^dlrover_tpu_[a-z_]+$")
-REG_RE = re.compile(
-    r"\.\s*(counter|gauge|histogram)\(\s*(?:\n\s*)?"
-    r"(?:(?P<q>['\"])(?P<name>[^'\"]+)(?P=q)|(?P<nonlit>[A-Za-z_f][^,)]*))"
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from native.analyze.checkers.metric_names import (  # noqa: E402,F401
+    CONTRACT_LABELS,
+    DESIGN_MD,
+    DOCUMENTED_PREFIXES,
+    NAME_RE,
+    PKG,
+    POINT_NAME_RE,
+    POINT_RE,
+    POINT_SCAN_EXCLUDE,
+    REG_RE,
+    SPAN_NAME_RE,
+    SPAN_RE,
+    SPAN_SCAN_EXCLUDE,
+    check_contract_labels,
+    check_documented,
+    main,
+    scan,
+    scan_fault_points,
+    scan_spans,
 )
-SPAN_NAME_RE = re.compile(r"^[a-z_]+$")
-SPAN_RE = re.compile(
-    r"\.\s*(emit|begin|span)\(\s*(?:\n\s*)?"
-    r"(?:(?P<q>['\"])(?P<name>[^'\"]+)(?P=q)|(?P<nonlit>[A-Za-z_f][^,)]*))"
-)
-# the journal implementation itself forwards caller-supplied names
-# (EventJournal.span -> self.begin(name, ...)): not an emission site
-SPAN_SCAN_EXCLUDE = (os.path.join("telemetry", "journal.py"),)
-
-POINT_NAME_RE = re.compile(r"^[a-z_]+$")
-POINT_RE = re.compile(
-    r"chaos\s*\.\s*fire\(\s*(?:\n\s*)?"
-    r"(?:(?P<q>['\"])(?P<name>[^'\"]+)(?P=q)|(?P<nonlit>[A-Za-z_f][^,)]*))"
-)
-# the chaos package itself forwards caller-supplied point names and its
-# docstrings discuss the call form: not injection sites
-POINT_SCAN_EXCLUDE = (os.path.join("dlrover_tpu", "chaos") + os.sep,)
-
-PKG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                   "dlrover_tpu")
-DESIGN_MD = os.path.join(os.path.dirname(PKG), "DESIGN.md")
-# metric families whose names are an operator contract: every
-# registered name under these prefixes must appear verbatim in DESIGN.md
-DOCUMENTED_PREFIXES = (
-    "dlrover_tpu_gateway_",
-    "dlrover_tpu_standby_",
-    "dlrover_tpu_snapshot_interval_",
-    # elastic resharding + compile cache (DESIGN.md §17): the runbook
-    # "failover is recompiling" keys on these names
-    "dlrover_tpu_compile_cache_",
-    "dlrover_tpu_reshard_",
-    # efficiency observatory (DESIGN.md §18): the "MFU dropped" runbook
-    # keys on the live MFU gauge, the step-phase histogram, and the
-    # profiler-capture counters
-    "dlrover_tpu_mfu",
-    "dlrover_tpu_step_phase_",
-    "dlrover_tpu_profile_",
-)
-
-# label names that are themselves an operator contract (dashboards and
-# runbooks filter on them): each must be used by a registration in the
-# package AND appear verbatim in DESIGN.md
-CONTRACT_LABELS = ("straggler_phase",)
-
-
-def check_contract_labels(pkg_dir: str = PKG,
-                          design_path: str = DESIGN_MD) -> list[str]:
-    """Contract labels must exist in code and be documented."""
-    problems: list[str] = []
-    source = []
-    for root, _dirs, files in os.walk(pkg_dir):
-        for fname in sorted(files):
-            if fname.endswith(".py"):
-                with open(os.path.join(root, fname),
-                          encoding="utf-8") as f:
-                    source.append(f.read())
-    source_text = "\n".join(source)
-    try:
-        with open(design_path, encoding="utf-8") as f:
-            design = f.read()
-    except OSError as e:
-        return [f"cannot read {design_path}: {e}"]
-    for label in CONTRACT_LABELS:
-        if f'"{label}"' not in source_text \
-                and f"'{label}'" not in source_text:
-            problems.append(
-                f"contract label {label!r} is not used by any metric "
-                "registration in the package"
-            )
-        if label not in design:
-            problems.append(
-                f"contract label {label!r} is not documented in "
-                "DESIGN.md; add it to its metrics table"
-            )
-    return problems
-
-
-def check_documented(names: dict[str, list[str]],
-                     design_path: str = DESIGN_MD) -> list[str]:
-    """Every contract-family metric registered in code must appear in
-    DESIGN.md (gateway, warm-standby, interval tuner)."""
-    try:
-        with open(design_path, encoding="utf-8") as f:
-            design = f.read()
-    except OSError as e:
-        return [f"cannot read {design_path}: {e}"]
-    return [
-        f"metric {name!r} ({', '.join(sites)}) is not documented in "
-        f"DESIGN.md; add it to its metrics table"
-        for name, sites in sorted(names.items())
-        if any(name.startswith(p) for p in DOCUMENTED_PREFIXES)
-        and name not in design
-    ]
-
-
-def scan_spans(pkg_dir: str = PKG,
-               design_path: str = DESIGN_MD) -> tuple[dict[str, list[str]],
-                                                      list[str]]:
-    """(span name -> [emission sites], problems) for journal spans."""
-    names: dict[str, list[str]] = {}
-    problems: list[str] = []
-    for root, _dirs, files in os.walk(pkg_dir):
-        for fname in sorted(files):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(root, fname)
-            rel = os.path.relpath(path, os.path.dirname(pkg_dir))
-            if rel.endswith(SPAN_SCAN_EXCLUDE):
-                continue
-            with open(path, encoding="utf-8") as f:
-                text = f.read()
-            for match in SPAN_RE.finditer(text):
-                line = text.count("\n", 0, match.start()) + 1
-                site = f"{rel}:{line}"
-                if match.group("name") is None:
-                    problems.append(
-                        f"{site}: journal span emitted with a non-literal "
-                        f"name ({match.group('nonlit')!r})"
-                    )
-                    continue
-                name = match.group("name")
-                if not SPAN_NAME_RE.match(name):
-                    problems.append(
-                        f"{site}: span name {name!r} does not match "
-                        f"{SPAN_NAME_RE.pattern}"
-                    )
-                names.setdefault(name, []).append(site)
-    try:
-        with open(design_path, encoding="utf-8") as f:
-            design = f.read()
-    except OSError as e:
-        problems.append(f"cannot read {design_path}: {e}")
-        return names, problems
-    for name, sites in sorted(names.items()):
-        if name not in design:
-            problems.append(
-                f"journal span {name!r} ({', '.join(sites)}) is not "
-                f"documented in DESIGN.md; add it to the span-name table"
-            )
-    return names, problems
-
-
-def scan_fault_points(pkg_dir: str = PKG,
-                      design_path: str = DESIGN_MD
-                      ) -> tuple[dict[str, list[str]], list[str]]:
-    """(fault point name -> [injection sites], problems) for the chaos
-    harness's ``chaos.fire("...")`` call sites."""
-    names: dict[str, list[str]] = {}
-    problems: list[str] = []
-    for root, _dirs, files in os.walk(pkg_dir):
-        for fname in sorted(files):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(root, fname)
-            rel = os.path.relpath(path, os.path.dirname(pkg_dir))
-            if any(ex in rel for ex in POINT_SCAN_EXCLUDE):
-                continue
-            with open(path, encoding="utf-8") as f:
-                text = f.read()
-            for match in POINT_RE.finditer(text):
-                line = text.count("\n", 0, match.start()) + 1
-                site = f"{rel}:{line}"
-                if match.group("name") is None:
-                    problems.append(
-                        f"{site}: chaos fault point fired with a "
-                        f"non-literal name ({match.group('nonlit')!r})"
-                    )
-                    continue
-                name = match.group("name")
-                if not POINT_NAME_RE.match(name):
-                    problems.append(
-                        f"{site}: fault point name {name!r} does not "
-                        f"match {POINT_NAME_RE.pattern}"
-                    )
-                names.setdefault(name, []).append(site)
-    try:
-        with open(design_path, encoding="utf-8") as f:
-            design = f.read()
-    except OSError as e:
-        problems.append(f"cannot read {design_path}: {e}")
-        return names, problems
-    for name, sites in sorted(names.items()):
-        if name not in design:
-            problems.append(
-                f"chaos fault point {name!r} ({', '.join(sites)}) is not "
-                f"documented in DESIGN.md; add it to the fault-point table"
-            )
-    return names, problems
-
-
-def scan(pkg_dir: str = PKG) -> tuple[dict[str, list[str]], list[str]]:
-    """(name -> [call sites], problems)."""
-    names: dict[str, list[str]] = {}
-    problems: list[str] = []
-    for root, _dirs, files in os.walk(pkg_dir):
-        for fname in sorted(files):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(root, fname)
-            rel = os.path.relpath(path, os.path.dirname(pkg_dir))
-            with open(path, encoding="utf-8") as f:
-                text = f.read()
-            for match in REG_RE.finditer(text):
-                line = text.count("\n", 0, match.start()) + 1
-                site = f"{rel}:{line}"
-                if match.group("name") is None:
-                    # non-literal first argument: the lint (and grep-
-                    # ability) relies on literal names at the call site
-                    problems.append(
-                        f"{site}: metric registered with a non-literal "
-                        f"name ({match.group('nonlit')!r})"
-                    )
-                    continue
-                name = match.group("name")
-                if not NAME_RE.match(name):
-                    problems.append(
-                        f"{site}: metric name {name!r} does not match "
-                        f"{NAME_RE.pattern}"
-                    )
-                names.setdefault(name, []).append(site)
-    for name, sites in sorted(names.items()):
-        if len(sites) > 1:
-            problems.append(
-                f"metric {name!r} registered at {len(sites)} call sites "
-                f"({', '.join(sites)}); names must be unique"
-            )
-    problems.extend(check_documented(names))
-    return names, problems
-
-
-def main() -> int:
-    names, problems = scan()
-    span_names, span_problems = scan_spans()
-    point_names, point_problems = scan_fault_points()
-    problems = (problems + span_problems + point_problems
-                + check_contract_labels())
-    if problems:
-        for p in problems:
-            print(f"check_metric_names: {p}", file=sys.stderr)
-        return 1
-    print(f"check_metric_names: {len(names)} metric names, "
-          f"{len(span_names)} span names, "
-          f"{len(point_names)} chaos fault points OK")
-    return 0
-
 
 if __name__ == "__main__":
     sys.exit(main())
