@@ -570,6 +570,15 @@ def bench_checkpoint(extra: dict, gb: float | None = None,
                 loaded[1]["params"]["w"][:1024],
                 p_state["params"]["w"][:1024]
             )
+
+        # ---- sharded parallel persist + topology-change restore ----
+        # (DESIGN.md §20): N simulated hosts each persist only their
+        # own slice through the chunked parallel writer, then M=N-1
+        # fresh hosts reassemble — the save@N / restore@N-1 leg the
+        # elastic shrink runs. Reported beside the single-writer
+        # numbers above; the acceptance bar is that these do NOT grow
+        # with host count (each host touches 1/N of the state).
+        _bench_sharded_parallel(extra, p_state, prefix)
     finally:
         # the 12 GB variant leaves its weight in /tmp otherwise — six
         # stale runs filled the disk to 100% during r04 and slowed the
@@ -606,6 +615,116 @@ def bench_checkpoint(extra: dict, gb: float | None = None,
             "save_block headline = direct copy (small state) / COW fork "
             "(big state), both reported"
         )
+
+
+def _bench_sharded_parallel(extra: dict, state: dict, prefix: str,
+                            hosts: int = 4) -> None:
+    """Save@N / restore@N−1 through the §20 sharded path.
+
+    Each simulated host owns a contiguous 1/N row range of every leaf
+    (replica 0, persist-flagged), snapshots it, and persists through
+    its own solo saver — all N persists run concurrently, as N real
+    agents would. The restore wall time is M=N−1 hosts concurrently
+    assembling THEIR new (wider) slices from the committed step's piece
+    registry, verified bit-exact against the source.
+    """
+    import threading
+
+    from dlrover_tpu.checkpoint.integrity import resolve_restore_plan
+    from dlrover_tpu.checkpoint.sharded import (
+        ShardedCheckpointEngine,
+        assemble,
+        storage_piece_registry,
+    )
+    from dlrover_tpu.common.storage import PosixDiskStorage
+
+    shard_dir = tempfile.mkdtemp(prefix="bench_ckpt_shard_")
+    leaves = {f"{k}/w": v["w"] for k, v in state.items()}
+    n = len(next(iter(leaves.values())))
+    bounds = [round(n * i / hosts) for i in range(hosts + 1)]
+    base_id = (int(os.getpid()) + 10) % 100000
+    engines = []
+    try:
+        engines = [
+            ShardedCheckpointEngine(
+                shard_dir, node_id=base_id + i, node_rank=i,
+                world_size=hosts,
+            )
+            for i in range(hosts)
+        ]
+        for i, eng in enumerate(engines):
+            pieces, index = {}, {}
+            for name, arr in leaves.items():
+                key = f"{name}::p0"
+                pieces[key] = arr[bounds[i]:bounds[i + 1]]
+                index[key] = {
+                    "path": name, "global_shape": [n],
+                    "dtype": str(arr.dtype),
+                    "index": [[bounds[i], bounds[i + 1]]],
+                    "replica": 0, "persist": True,
+                }
+            eng.snapshot_pieces(1, pieces, index)
+
+        def _persist(i: int) -> None:
+            eng = engines[i]
+            eng._solo_saver._persist_step(
+                1, commit_block_s=60.0 if i == 0 else 0.0
+            )
+
+        t0 = time.monotonic()
+        threads = [threading.Thread(target=_persist, args=(i,))
+                   for i in range(hosts)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        extra[f"{prefix}persist_parallel_s"] = round(
+            time.monotonic() - t0, 2)
+
+        storage = PosixDiskStorage()
+        plan = resolve_restore_plan(storage, shard_dir)
+        assert plan is not None and plan.step == 1, plan
+        m = hosts - 1
+        new_bounds = [round(n * j / m) for j in range(m + 1)]
+        outs: list[dict] = [{} for _ in range(m)]
+
+        def _restore(j: int) -> None:
+            registry = storage_piece_registry(
+                storage, shard_dir, plan.step, plan.num_shards,
+                bad_pieces=plan.bad_pieces,
+            )
+            for name in leaves:
+                outs[j][name] = assemble(
+                    [[new_bounds[j], new_bounds[j + 1]]],
+                    np.dtype(leaves[name].dtype), registry[name],
+                )
+
+        t0 = time.monotonic()
+        threads = [threading.Thread(target=_restore, args=(j,))
+                   for j in range(m)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        extra[f"{prefix}restore_parallel_s"] = round(
+            time.monotonic() - t0, 2)
+        # topology-change bit-exactness: N-host save == (N-1)-host view
+        got = np.concatenate([outs[j]["params/w"] for j in range(m)])
+        np.testing.assert_array_equal(got[:4096],
+                                      leaves["params/w"][:4096])
+        np.testing.assert_array_equal(got[-4096:],
+                                      leaves["params/w"][-4096:])
+        extra[f"{prefix}shard_hosts"] = hosts
+    finally:
+        import shutil
+
+        for eng in engines:
+            try:
+                eng.shm_handler.close(unlink=True)
+                eng.close()
+            except Exception:  # noqa: BLE001 - cleanup best-effort
+                pass
+        shutil.rmtree(shard_dir, ignore_errors=True)
 
 
 def _run_elastic_job(work: str, env: dict, train_args: list[str],
@@ -1539,7 +1658,8 @@ HEADLINE_KEYS = [
     "goodput", "goodput_at_baseline_rate", "goodput_lowrate_raw",
     "goodput_lowrate_failures_per_hr", "mfu", "mfu_medium", "mfu_large",
     "ckpt_save_block_s", "ckpt_restore_s", "ckpt1b_save_block_s",
-    "ckpt1b_copy_s", "ckpt1b_restore_s", "serving_toks_per_s",
+    "ckpt1b_copy_s", "ckpt1b_restore_s", "ckpt1b_persist_parallel_s",
+    "ckpt1b_restore_parallel_s", "serving_toks_per_s",
     "serving_prefix_cache_speedup", "gateway_req_per_s",
     "gateway_p95_s", "gateway_failed",
     "int8_ffn_speedup", "soak_completed", "soak_kills",

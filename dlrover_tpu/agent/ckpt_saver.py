@@ -11,10 +11,21 @@ agent calls ``save_shm_to_storage`` so no snapshot is ever lost.
 
 Storage layout (one directory per step)::
 
-    <ckpt_dir>/step-<N>/node_<id>.bin        raw arena bytes
-    <ckpt_dir>/step-<N>/node_<id>.meta.json  leaf metas + save config
-    <ckpt_dir>/step-<N>/done_<id>            per-writer commit marker
+    <ckpt_dir>/step-<N>/node_<id>.bin        this writer's shard bytes
+                                             (persist-flagged pieces
+                                             only — replica-group dedup,
+                                             DESIGN.md §20), written via
+                                             the chunked parallel path
+    <ckpt_dir>/step-<N>/node_<id>.meta.json  leaf metas (+ per-piece
+                                             crc32) + save config
+    <ckpt_dir>/step-<N>/done_<id>_w<W>       per-writer marker carrying
+                                             its manifest entry
     <ckpt_dir>/latest                        tracker: committed step number
+
+Commit: every writer also ACKs the master (PersistAckReport); rank-0's
+waiter polls the ack ledger (storage markers as the no-master fallback)
+and writes the global manifest + tracker only once all W writers are
+durable.
 """
 
 from __future__ import annotations
@@ -27,6 +38,8 @@ import threading
 import time
 from typing import Optional
 
+from dlrover_tpu.common import envspec
+from dlrover_tpu.common.constants import EnvKey
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.common.multi_process import SharedQueue
 from dlrover_tpu.common.storage import (
@@ -45,6 +58,11 @@ logger = get_logger(__name__)
 _persist_seconds = registry().histogram(
     "dlrover_tpu_ckpt_persist_seconds",
     "shm -> storage persist duration (write + done marker)",
+)
+_persist_parallel_seconds = registry().histogram(
+    "dlrover_tpu_ckpt_persist_parallel_seconds",
+    "this host's shard write through the chunked parallel storage "
+    "path — flat in host count by design (no global writer phase)",
 )
 _persist_bytes = registry().counter(
     "dlrover_tpu_ckpt_persist_bytes_total",
@@ -228,6 +246,49 @@ class AsyncCheckpointSaver:
             self._last_persisted_step = step
             return True
 
+    @staticmethod
+    def _repack_persist_pieces(header: dict, content: bytes
+                               ) -> tuple[dict, bytes, dict]:
+        """(header', content', pieces): drop pieces flagged
+        ``persist=False`` (replica-group dedup — another host's agent
+        writes that shard) and recompute offsets + per-piece CRC32s
+        over the repacked bytes. ``pieces`` is this writer's manifest
+        contribution: piece key -> {crc32, path, index, replica}."""
+        index_map = header.get("sharded_index")
+        metas = dict(header.get("metas", {}))
+        if not index_map:
+            return header, content, {}
+        kept = {k: e for k, e in index_map.items()
+                if e.get("persist", True)}
+        new_metas: dict[str, dict] = {}
+        pieces: dict[str, dict] = {}
+        chunks: list[bytes] = []
+        offset = 0
+        for key in kept:
+            info = metas.get(key)
+            if info is None:
+                continue
+            nbytes = int(info["nbytes"])
+            blob = content[info["offset"]:info["offset"] + nbytes]
+            new_metas[key] = {**info, "offset": offset,
+                              "crc32": integrity.crc32_bytes(blob)}
+            pieces[key] = {
+                "crc32": new_metas[key]["crc32"],
+                "path": kept[key].get("path", key),
+                "index": kept[key].get("index", []),
+                "replica": int(kept[key].get("replica", 0)),
+            }
+            chunks.append(blob)
+            pad = -(offset + nbytes) % 64
+            if pad:
+                chunks.append(b"\x00" * pad)
+            offset += nbytes + pad
+        header = dict(header)
+        header["metas"] = new_metas
+        header["sharded_index"] = kept
+        header["total_size"] = offset
+        return header, b"".join(chunks), pieces
+
     def _write_files(self, header: dict, content: bytes, step: int,
                      commit_block_s: float = 0.0) -> None:
         ckpt_dir = header.get("ckpt_dir", "")
@@ -236,11 +297,16 @@ class AsyncCheckpointSaver:
             return
         storage = self._build_storage(header)
         start = time.monotonic()
+        num_shards = int(header.get("num_shards", 1))
+        # replica-group dedup: persist only the pieces this host is the
+        # designated writer for (checkpoint/sharded.py flags them)
+        header, content, pieces = self._repack_persist_pieces(
+            header, content
+        )
         with get_journal().span("ckpt_persist", step=step,
                                 bytes=len(content)):
             sdir = step_dir(ckpt_dir, step)
             storage.makedirs(sdir)
-            num_shards = int(header.get("num_shards", 1))
             # integrity manifest: the shard's CRC32 rides in the meta
             # AND the done marker, so rank-0's COMMIT can list every
             # shard's checksum without re-reading the bytes
@@ -249,24 +315,56 @@ class AsyncCheckpointSaver:
             header = dict(header)
             header["crc32"] = crc
             header["bin_bytes"] = len(content)
-            storage.write(content,
-                          os.path.join(sdir, f"node_{self.node_id}.bin"))
+            shard_entry = {"crc32": crc, "bytes": len(content),
+                           "pieces": pieces}
+            # one writer per host, chunked concurrent I/O: the blocking
+            # cost of a save is this host's OWN shard, independent of
+            # how many hosts the job has (Orbax-grade scaling)
+            t_par = time.monotonic()
+            with get_journal().span("ckpt_persist_shard", step=step,
+                                    writer=str(self.node_id),
+                                    pieces=len(pieces)):
+                storage.write_parallel(
+                    content,
+                    os.path.join(sdir, f"node_{self.node_id}.bin"),
+                    chunk_bytes=envspec.get_int(
+                        EnvKey.CKPT_PERSIST_CHUNK_MB) << 20,
+                    workers=envspec.get_int(EnvKey.CKPT_PERSIST_WORKERS),
+                )
+            _persist_parallel_seconds.observe(time.monotonic() - t_par)
             storage.write(
                 json.dumps(header),
                 os.path.join(sdir, f"node_{self.node_id}.meta.json"),
             )
             storage.write(
-                json.dumps({"crc32": crc, "bytes": len(content)}),
+                json.dumps(shard_entry),
                 os.path.join(sdir, done_marker(self.node_id, num_shards)),
             )
         _persist_seconds.observe(time.monotonic() - start)
         _persist_bytes.inc(len(content))
+        self._ack_persist(step, num_shards, shard_entry)
         self._maybe_commit(storage, header, step,
                            block_s=commit_block_s)
         logger.info(
             "persisted step %d (%d bytes) in %.2fs",
             step, len(content), time.monotonic() - start,
         )
+
+    def _ack_persist(self, step: int, num_shards: int,
+                     shard_entry: dict) -> None:
+        """Tell the master this host's shard is durable. Best-effort:
+        with no master (solo mode) or a flaky RPC the rank-0 committer
+        falls back to the storage done-marker scan."""
+        if not os.environ.get(EnvKey.MASTER_ADDR):
+            return
+        try:
+            from dlrover_tpu.agent.master_client import MasterClient
+
+            MasterClient.singleton().report_persist_ack(
+                step, num_shards, shard_entry
+            )
+        except (ConnectionError, RuntimeError, OSError) as e:
+            logger.warning("persist ack failed (step %d): %s", step, e)
 
     def _maybe_commit(self, storage: CheckpointStorage, header: dict,
                       step: int, block_s: float = 0.0) -> None:
@@ -301,9 +399,33 @@ class AsyncCheckpointSaver:
         if block_s > 0:
             waiter.join(timeout=block_s)
 
+    def _acked_shards(self, step: int, num_shards: int) -> dict | None:
+        """The full shard-manifest map from the master's persist-ack
+        ledger, or None when incomplete/unreachable. The RPC path is
+        what keeps commit latency flat on object stores whose LIST is
+        slow or eventually consistent; the storage scan below stays the
+        no-master fallback."""
+        if not os.environ.get(EnvKey.MASTER_ADDR):
+            return None
+        try:
+            from dlrover_tpu.agent.master_client import MasterClient
+
+            resp = MasterClient.singleton().persist_status(
+                step, num_shards
+            )
+        except (ConnectionError, RuntimeError, OSError):
+            return None
+        return dict(resp.shards) if resp.complete else None
+
     def _commit_wait(self, storage: CheckpointStorage, ckpt_dir: str,
                      step: int, num_shards: int,
                      timeout_s: float = 300.0) -> None:
+        """Rank-0's all-hosts-durable wait: every writer must ACK (via
+        the master ledger) or leave a done marker (storage fallback)
+        before the global manifest + tracker move. A host that died
+        mid-save never acks, the wait times out, and the step stays
+        invisible to restore — ``resolve_restore_plan`` then serves the
+        previous committed step (the chaos acceptance scenario)."""
         sdir = step_dir(ckpt_dir, step)
         suffix = f"_w{num_shards}"
         start = time.monotonic()
@@ -311,24 +433,31 @@ class AsyncCheckpointSaver:
         done: list = []
         try:
             while time.time() < deadline and not self._stopped.is_set():
-                done = [
-                    f for f in storage.listdir(sdir)
-                    if f.startswith("done_") and f.endswith(suffix)
-                ]
-                if len(done) >= num_shards:
+                shards = self._acked_shards(step, num_shards)
+                if shards is None:
+                    done = [
+                        f for f in storage.listdir(sdir)
+                        if f.startswith("done_") and f.endswith(suffix)
+                    ]
+                    if len(done) >= num_shards:
+                        # assemble the manifest from the done markers
+                        # (each carries its writer's crc + piece map)
+                        shards = {}
+                        for f in done:
+                            nid = f[len("done_"):-len(suffix)]
+                            try:
+                                shards[nid] = json.loads(
+                                    storage.read_text(
+                                        os.path.join(sdir, f))
+                                )
+                            except (ValueError, OSError):
+                                shards[nid] = {}  # legacy empty marker
+                if shards is not None:
                     # terminal COMMIT before the tracker moves: the
-                    # manifest of every shard's crc32, assembled from
-                    # the done markers (restore verifies against it and
-                    # rolls back on any mismatch)
-                    shards: dict = {}
-                    for f in done:
-                        nid = f[len("done_"):-len(suffix)]
-                        try:
-                            shards[nid] = json.loads(
-                                storage.read_text(os.path.join(sdir, f))
-                            )
-                        except (ValueError, OSError):
-                            shards[nid] = {}  # legacy empty marker
+                    # global manifest of every shard's crc32 + piece
+                    # index (restore verifies against it and rolls
+                    # back — per shard when a twin exists — on any
+                    # mismatch)
                     integrity.write_commit(storage, sdir, step,
                                            num_shards, shards)
                     storage.write(

@@ -203,7 +203,11 @@ class ElasticAgent:
         *reshard* event — it will load the program instead of cold
         compiling — and the journal records the choice so the recovery
         trail reads ``reshard`` rather than a cold compile. No coverage
-        means today's restart path, unchanged (DESIGN.md §17)."""
+        means today's restart path, unchanged (DESIGN.md §17). The
+        event also records the newest VERIFIED storage step: a
+        multi-host reshard whose missing shards have no live copy falls
+        back to storage (``reshard_state``'s piece registry, DESIGN.md
+        §20) — the journal shows up front whether that net exists."""
         from dlrover_tpu.master.kv_store import node_topology_prefix
 
         try:
@@ -226,6 +230,7 @@ class ElasticAgent:
                 devices=world.total_devices,
                 executables=resp.executables,
                 shrink=bool(world.reshard),
+                storage_step=self._verified_storage_step(),
             )
             logger.info(
                 "recovery is a reshard event: %d pre-compiled "
@@ -233,6 +238,26 @@ class ElasticAgent:
                 resp.executables, len(world.world), world.total_devices,
                 " (membership shrink)" if world.reshard else "",
             )
+
+    def _verified_storage_step(self) -> int:
+        """Newest fully-verified checkpoint step in storage (-1 = none
+        / unknown): the reshard's missing-shard fallback source."""
+        if self._ckpt_saver is None:
+            return -1
+        try:
+            header = self._ckpt_saver.shm_handler.header() or {}
+            ckpt_dir = header.get("ckpt_dir") or ""
+            if not ckpt_dir:
+                return -1
+            from dlrover_tpu.common.storage import PosixDiskStorage
+            from dlrover_tpu.checkpoint.integrity import (
+                resolve_restore_step,
+            )
+
+            got = resolve_restore_step(PosixDiskStorage(), ckpt_dir)
+            return -1 if got is None else got[0]
+        except Exception:  # noqa: BLE001 - evidence only, never blocks
+            return -1
 
     # ----------------------------------------------------------- child mgmt
 

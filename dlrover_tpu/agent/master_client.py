@@ -175,6 +175,28 @@ class MasterClient:
             )
         )
 
+    # ------------------------------------------------------- persist acks
+
+    def report_persist_ack(self, step: int, num_shards: int,
+                           shard: dict) -> None:
+        """Ack this host's durable checkpoint shard to the master's
+        ledger; the rank-0 committer assembles the global manifest from
+        these instead of polling storage (DESIGN.md §20)."""
+        self._client.call(
+            m.PersistAckReport(
+                node_id=self.node_id, step=step,
+                num_shards=num_shards, shard=shard,
+            )
+        )
+
+    def persist_status(self, step: int, num_shards: int
+                       ) -> m.PersistStatusResponse:
+        return self._client.call(
+            m.PersistStatusRequest(
+                node_id=self.node_id, step=step, num_shards=num_shards,
+            )
+        )
+
     # ---------------------------------------------------- buddy replication
 
     def report_buddy_endpoint(self, addr: str) -> None:

@@ -53,7 +53,7 @@ DEFAULT_EXAMPLE = os.path.join(REPO, "examples", "train_transformer.py")
 # journal names treated as recovery evidence in the canonical trail
 RECOVERY_EVENTS = (
     "node_restart", "ckpt_verify_failed", "ckpt_rollback",
-    "state_rollback", "degraded_mode", "reshard",
+    "ckpt_shard_rollback", "state_rollback", "degraded_mode", "reshard",
 )
 
 
@@ -194,6 +194,9 @@ def fault_trail(journal_dir: str) -> dict:
         elif name == "ckpt_rollback":
             recovery.append(["ckpt_rollback", e.get("from_step", -1),
                              e.get("to_step", -1)])
+        elif name == "ckpt_shard_rollback":
+            recovery.append(["ckpt_shard_rollback", e.get("step", -1),
+                             e.get("writer", ""), e.get("kind", "")])
         elif name == "state_rollback":
             recovery.append(["state_rollback"])
         elif name == "degraded_mode":
@@ -363,6 +366,197 @@ def run_scenario(scenario: Scenario, work_dir: str, *,
 
 
 # ------------------------------------------------------------------- canned
+
+
+def canned_sharded_scenario(seed: int = 4242) -> dict:
+    """The sharded-persist acceptance schedule (DESIGN.md §20): N=3
+    hosts save step 4 (committed, one primary + one ring twin per
+    shard), then step 8's save loses host 2 mid-write (injected ENOSPC
+    = the host died before its shard landed — no done marker, no ack,
+    no commit), step 4's primary shard 0 is bit-flipped on its way to
+    disk, and a restore-time read of shard 1 is slowed
+    (``storage_read``). ``run_sharded_scenario`` replays it: the
+    restore on M=N−1 hosts must land on step 4 — the newest FULLY
+    verified step — bit-exactly, through a per-shard twin rollback.
+    """
+    return {
+        "seed": seed,
+        "faults": [
+            # host 2 dies mid-sharded-save of step 8
+            {"point": "storage_write", "action": "enospc",
+             "match": {"path_contains": "step-8/",
+                       "path_suffix": "node_2.bin"},
+             "times": 1},
+            # the committed step's primary shard 0 rots on disk
+            {"point": "storage_write", "action": "bit_flip",
+             "match": {"path_contains": "step-4/",
+                       "path_suffix": "node_0.bin"},
+             "times": 1},
+            # a sick disk slows one verification read at restore
+            {"point": "storage_read", "action": "slow",
+             "args": {"s": 0.05},
+             "match": {"path_suffix": "node_1.bin"},
+             "times": 1},
+        ],
+    }
+
+
+@dataclasses.dataclass
+class ShardedScenarioResult:
+    restored_step: int | None
+    bad_writers: list[str]
+    restored_crc: int           # crc32 over the assembled restored rows
+    expected_crc: int           # crc32 over the step-4 source rows
+    trail: dict
+
+    @property
+    def bit_exact(self) -> bool:
+        return self.restored_crc == self.expected_crc
+
+    def assert_invariants(self) -> None:
+        assert self.restored_step == 4, (
+            f"restore landed on {self.restored_step}, not the newest "
+            "fully-verified step 4"
+        )
+        assert self.bit_exact, "restored rows are not bit-exact"
+        assert "0" in self.bad_writers, (
+            "the bit-flipped shard 0 was not excluded via per-shard "
+            f"rollback (bad={self.bad_writers})"
+        )
+
+
+def run_sharded_scenario(work_dir: str, *, seed: int = 4242,
+                         hosts: int = 3, rows: int = 24,
+                         cols: int = 16) -> ShardedScenarioResult:
+    """Drive the canned sharded-save schedule IN PROCESS.
+
+    Multi-host persist is simulated with ``hosts`` solo-mode
+    ``ShardedCheckpointEngine`` instances sharing one checkpoint dir
+    (the jax CPU backend cannot run true multi-process collectives in
+    this container; the storage/commit/verify path under test is
+    process-count-agnostic). Host ``i`` owns rows ``[i*k, (i+1)*k)`` as
+    replica 0 and carries host ``i-1``'s rows as the replica-1 ring
+    twin (``DLROVER_TPU_CKPT_PERSIST_REPLICAS=2``).
+    """
+    import zlib
+
+    import numpy as np
+
+    from dlrover_tpu import chaos
+    from dlrover_tpu.checkpoint.integrity import resolve_restore_plan
+    from dlrover_tpu.checkpoint.sharded import (
+        ShardedCheckpointEngine,
+        assemble,
+        storage_piece_registry,
+    )
+    from dlrover_tpu.common.storage import PosixDiskStorage
+
+    assert rows % hosts == 0
+    k = rows // hosts
+    os.makedirs(work_dir, exist_ok=True)
+    ckpt_dir = os.path.join(work_dir, "ckpt")
+    journal_dir = os.path.join(work_dir, "journal")
+    spec = canned_sharded_scenario(seed)
+    spec["faults"] = [dict(r) for r in spec["faults"]]
+
+    def state_at(step: int) -> np.ndarray:
+        rng = np.random.default_rng(seed + step)
+        return rng.standard_normal((rows, cols)).astype(np.float32)
+
+    def host_pieces(data: np.ndarray, i: int) -> tuple[dict, dict]:
+        pieces, index = {}, {}
+        for replica, owner in ((0, i), (1, (i - 1) % hosts)):
+            key = f"w::piece{replica}"
+            pieces[key] = data[owner * k:(owner + 1) * k]
+            index[key] = {
+                "path": "w", "global_shape": [rows, cols],
+                "dtype": "float32",
+                "index": [[owner * k, (owner + 1) * k], [0, cols]],
+                "replica": replica, "persist": True,
+            }
+        return pieces, index
+
+    prev_env = os.environ.get(EnvKey.CKPT_PERSIST_REPLICAS)
+    prev_journal = os.environ.get(EnvKey.JOURNAL_DIR)
+    os.environ[EnvKey.CKPT_PERSIST_REPLICAS] = "2"
+    os.environ[EnvKey.JOURNAL_DIR] = journal_dir
+    chaos.install({"seed": seed, "faults": spec["faults"]})
+    engines = []
+    try:
+        engines = [
+            ShardedCheckpointEngine(
+                ckpt_dir, node_id=i, node_rank=i, world_size=hosts,
+            )
+            for i in range(hosts)
+        ]
+        for step in (4, 8):
+            data = state_at(step)
+            for i, eng in enumerate(engines):
+                pieces, index = host_pieces(data, i)
+                eng.snapshot_pieces(step, pieces, index)
+                try:
+                    # rank-0 last so its commit wait sees the peers
+                    if i != 0:
+                        eng._solo_saver._persist_step(step)
+                except OSError as e:
+                    logger.warning("host %d lost mid-save of step %d: "
+                                   "%s", i, step, e)
+            try:
+                # join the commit only for the step that CAN commit:
+                # step 8's waiter must not stall the schedule (it polls
+                # in the background and dies with the saver, exactly
+                # like a real agent outliving a dead peer)
+                engines[0]._solo_saver._persist_step(
+                    step, commit_block_s=20.0 if step == 4 else 0.0
+                )
+            except OSError as e:
+                logger.warning("host 0 lost mid-save of step %d: %s",
+                               step, e)
+        # restore on M = N-1 fresh hosts, storage only
+        storage = PosixDiskStorage()
+        plan = resolve_restore_plan(storage, ckpt_dir)
+        restored_step = plan.step if plan else None
+        bad = sorted(plan.bad_pieces) if plan else []
+        restored_crc = -1
+        if plan is not None:
+            registry = storage_piece_registry(
+                storage, ckpt_dir, plan.step, plan.num_shards,
+                bad_pieces=plan.bad_pieces,
+            )
+            m = hosts - 1
+            parts = []
+            bounds = [round(rows * j / m) for j in range(m + 1)]
+            for j in range(m):  # each surviving host pulls its slice
+                parts.append(assemble(
+                    [[bounds[j], bounds[j + 1]], [0, cols]],
+                    np.dtype("float32"), registry["w"],
+                ))
+            restored = np.concatenate(parts, axis=0)
+            restored_crc = zlib.crc32(restored.tobytes()) & 0xFFFFFFFF
+    finally:
+        chaos.uninstall()
+        for eng in engines:
+            try:
+                eng.shm_handler.close(unlink=True)
+                eng.close()
+            except Exception:  # noqa: BLE001 - cleanup best-effort
+                pass
+        if prev_env is None:
+            os.environ.pop(EnvKey.CKPT_PERSIST_REPLICAS, None)
+        else:
+            os.environ[EnvKey.CKPT_PERSIST_REPLICAS] = prev_env
+        if prev_journal is None:
+            os.environ.pop(EnvKey.JOURNAL_DIR, None)
+        else:
+            os.environ[EnvKey.JOURNAL_DIR] = prev_journal
+    expected = state_at(4)
+    return ShardedScenarioResult(
+        restored_step=restored_step,
+        bad_writers=bad,
+        restored_crc=restored_crc,
+        expected_crc=zlib.crc32(expected.tobytes()) & 0xFFFFFFFF,
+        trail=fault_trail(journal_dir),
+    )
 
 
 def canned_scenario(seed: int = 1234, *, kill_step: int = 7,

@@ -19,6 +19,7 @@ Runs in two modes:
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import threading
@@ -57,6 +58,34 @@ def _record_restore(engine: str, start_monotonic: float, step: int) -> None:
     dur = time.monotonic() - start_monotonic
     _restore_seconds.labels(engine).observe(dur)
     get_journal().emit("ckpt_restore", dur=dur, step=step, engine=engine)
+
+
+@dataclasses.dataclass
+class PersistWait:
+    """Typed outcome of a durable-persist wait.
+
+    Truthiness preserves the old bool contract, but ``kind`` makes a
+    timeout distinguishable from "no checkpoint was ever requested" at
+    every call site — the silent-False bug class where a caller shut
+    down believing the step was durable. Every timeout is journaled
+    (``ckpt_persist_timeout``), so the trail shows exactly which steps
+    the job gave up waiting for.
+    """
+
+    ok: bool
+    kind: str            # "ok" | "timeout"
+    step: int
+    waited_s: float
+    persisted_step: int  # newest step durably committed when we stopped
+
+    def __bool__(self) -> bool:
+        return self.ok
+
+
+def _journal_persist_timeout(what: str, step: int, waited_s: float,
+                             **fields) -> None:
+    get_journal().emit("ckpt_persist_timeout", what=what, step=step,
+                       waited_s=waited_s, **fields)
 
 
 def _read_storage_arrays(storage: CheckpointStorage, ckpt_dir: str,
@@ -113,6 +142,38 @@ def _read_storage_arrays(storage: CheckpointStorage, ckpt_dir: str,
     return step, arrays
 
 
+def _storage_fallback_leaf(storage: CheckpointStorage, ckpt_dir: str,
+                           name: str, leaf, registry_box: list
+                           ) -> np.ndarray | None:
+    """Assemble a full leaf from the newest VERIFIED storage step's
+    piece registry — the path a MULTI-host reshard takes for shards
+    whose only live copy died with a host. ``registry_box`` caches the
+    resolved plan across leaves of one reshard (lazy: resolved on the
+    first miss)."""
+    from dlrover_tpu.checkpoint import sharded as sharded_mod
+    from dlrover_tpu.checkpoint.integrity import resolve_restore_plan
+
+    if not registry_box:
+        plan = resolve_restore_plan(storage, ckpt_dir)
+        registry_box.append(
+            None if plan is None else
+            sharded_mod.storage_piece_registry(
+                storage, ckpt_dir, plan.step, plan.num_shards,
+                bad_pieces=plan.bad_pieces,
+            )
+        )
+    registry = registry_box[0]
+    pieces = (registry or {}).get(name)
+    if not pieces:
+        return None
+    shape = tuple(pieces[0].global_shape)
+    if shape != tuple(getattr(leaf, "shape", shape)):
+        return None
+    return sharded_mod.assemble(
+        [[0, s] for s in shape], pieces[0].dtype, pieces
+    )
+
+
 class RestorePrefetch:
     """Background storage restore: the read + integrity verification run
     on a daemon thread while the process is busy with rendezvous,
@@ -133,6 +194,7 @@ class RestorePrefetch:
         self.storage = storage or PosixDiskStorage()
         self._result: tuple[int, dict[str, np.ndarray]] | None = None
         self._error: BaseException | None = None
+        self.outcome = "pending"  # "ok"|"empty"|"error"|"timeout"
         self._done = threading.Event()
         self._started = time.monotonic()
         threading.Thread(
@@ -159,13 +221,22 @@ class RestorePrefetch:
     def join(self, timeout: float = 120.0
              ) -> tuple[int, dict[str, np.ndarray]] | None:
         """The verified (step, arrays), or None on no-checkpoint /
-        error / timeout — None always means 'do the synchronous read'."""
+        error / timeout — None always means 'do the synchronous read'.
+        ``outcome`` ("ok" | "empty" | "error" | "timeout") types WHY,
+        and a timeout is journaled (``ckpt_persist_timeout``) — a
+        prefetch thread wedged on sick storage must be visible, not a
+        silently slower restore."""
         if not self._done.wait(timeout):
+            self.outcome = "timeout"
+            _journal_persist_timeout("restore_prefetch", -1, timeout,
+                                     ckpt_dir=self.ckpt_dir)
             logger.warning("restore prefetch still running after %.0fs; "
                            "falling back to the synchronous read", timeout)
             return None
         if self._error is not None:
+            self.outcome = "error"
             return None
+        self.outcome = "ok" if self._result is not None else "empty"
         return self._result
 
 
@@ -651,12 +722,30 @@ class CheckpointEngine:
             if snap is not None and snap[0] == step:
                 arrays = snap[1]
         names = iter(n for n, _ in _leaf_paths(state))
+        registry_box: list = []  # lazy plan cache for _storage_fallback_leaf
 
         def _put(leaf, new_sharding):
             name = next(names)
             host = arrays.get(name) if arrays is not None else None
             if host is None:
-                host = np.asarray(jax.device_get(leaf))
+                try:
+                    host = np.asarray(jax.device_get(leaf))
+                except (RuntimeError, ValueError) as e:
+                    # a live shard is gone (its host died): fall back
+                    # to the committed storage step instead of aborting
+                    # the reshard (DESIGN.md §20)
+                    host = _storage_fallback_leaf(
+                        self.storage, self.ckpt_dir, name, leaf,
+                        registry_box,
+                    )
+                    if host is None:
+                        raise RuntimeError(
+                            f"reshard cannot source leaf {name!r}: no "
+                            "shm snapshot, no live device copy, and no "
+                            "verified storage piece covers it"
+                        ) from e
+                    get_journal().emit("ckpt_restore_shard", step=step,
+                                       writer="storage", leaf=name)
             return jax.device_put(host, new_sharding)
 
         out = mesh_mod.reshard_state(old_mesh, new_mesh, state, put=_put)
@@ -675,13 +764,34 @@ class CheckpointEngine:
         committed = read_tracker(self.storage, self.ckpt_dir)
         return -1 if committed is None else committed[0]
 
-    def wait_for_persist(self, step: int, timeout: float = 120.0) -> bool:
+    def wait_for_persist(self, step: int, timeout: float = 120.0
+                         ) -> PersistWait:
+        """Block until ``step`` is durably committed (tracker moved past
+        it). Returns a truthy ``PersistWait``; on timeout the result is
+        falsy with ``kind="timeout"`` and the journal carries a
+        ``ckpt_persist_timeout`` record — callers must not treat the
+        step as durable (shutdown paths, checkpoint rotation)."""
+        start = time.monotonic()
         deadline = time.time() + timeout
+        newest = -1
         while time.time() < deadline:
-            if self.latest_persisted_step() >= step:
-                return True
+            newest = self.latest_persisted_step()
+            if newest >= step:
+                return PersistWait(
+                    ok=True, kind="ok", step=step,
+                    waited_s=time.monotonic() - start,
+                    persisted_step=newest,
+                )
             time.sleep(0.1)
-        return False
+        waited = time.monotonic() - start
+        _journal_persist_timeout("persist", step, waited,
+                                 persisted_step=newest)
+        logger.warning(
+            "persist of step %d not durable after %.0fs (newest "
+            "committed: %d)", step, waited, newest,
+        )
+        return PersistWait(ok=False, kind="timeout", step=step,
+                           waited_s=waited, persisted_step=newest)
 
     def close(self) -> None:
         self.wait_snapshot(timeout=30.0)

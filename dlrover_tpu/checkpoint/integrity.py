@@ -31,6 +31,7 @@ before the upgrade.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import re
@@ -50,6 +51,12 @@ _verify_failed_total = registry().counter(
 _rollback_total = registry().counter(
     "dlrover_tpu_ckpt_rollback_total",
     "restores rolled back past a corrupt/incomplete newest step",
+)
+_shard_rollback_total = registry().counter(
+    "dlrover_tpu_ckpt_shard_rollback_total",
+    "restores that skipped a corrupt shard file because every piece it "
+    "held verifies on a replica twin (per-shard, not whole-step, "
+    "rollback)",
 )
 
 STEP_DIR_RE = re.compile(r"^step-(\d+)$")
@@ -71,8 +78,11 @@ def commit_marker(num_shards: int) -> str:
 def write_commit(storage, sdir: str, step: int, num_shards: int,
                  shards: dict) -> None:
     """Terminal COMMIT: ``shards`` maps node id (str) -> {"crc32",
-    "bytes"} as collected from the done markers. Atomic via the
-    storage's tmp+fsync+rename write."""
+    "bytes", "pieces": {key: {"crc32", "path", "index", "replica"}}}
+    as collected from the persist acks (or done markers). The piece
+    map is what quorum verification + per-shard rollback reason over;
+    legacy entries without it degrade to whole-file semantics. Atomic
+    via the storage's tmp+fsync+rename write."""
     storage.write(
         json.dumps({"step": step, "num_shards": num_shards,
                     "shards": shards}),
@@ -82,10 +92,13 @@ def write_commit(storage, sdir: str, step: int, num_shards: int,
 
 def _shard_crc(storage, path: str) -> tuple[int, int]:
     """(crc32, size). Streams local files so verifying a multi-GB shard
-    never materializes it in memory."""
+    never materializes it in memory. Under an installed chaos plan the
+    read goes through ``storage.read`` instead, so ``storage_read``
+    faults hit verification exactly like any other consumer."""
+    from dlrover_tpu import chaos
     from dlrover_tpu.common.storage import PosixDiskStorage
 
-    if isinstance(storage, PosixDiskStorage):
+    if isinstance(storage, PosixDiskStorage) and not chaos.ENABLED:
         crc = 0
         size = 0
         with open(path, "rb") as f:
@@ -97,13 +110,71 @@ def _shard_crc(storage, path: str) -> tuple[int, int]:
     return crc32_bytes(blob), len(blob)
 
 
-def verify_step_dir(storage, sdir: str, num_shards: int) -> str | None:
-    """None when the step verifies; else a short failure kind.
+@dataclasses.dataclass
+class StepVerdict:
+    """Outcome of quorum verification of one step directory.
 
-    With a COMMIT marker: the manifest must list ``num_shards`` shards
-    and every one must exist with matching size and CRC32. Without one:
-    legacy acceptance on done-marker count alone.
+    ``fail_kind`` None means the step is restorable. ``bad_pieces``
+    maps writer node id -> the set of its piece keys that must NOT be
+    read (``None`` = the whole shard file is unusable); every such
+    piece verified on a replica twin held by another writer, or the
+    step would have failed. ``rollbacks`` is the per-shard-rollback
+    evidence (bad writer, failure kind, pieces recovered via twins).
     """
+
+    fail_kind: str | None = None
+    bad_pieces: dict[str, set | None] = dataclasses.field(
+        default_factory=dict)
+    rollbacks: list[dict] = dataclasses.field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return self.fail_kind is None
+
+
+def _piece_key(entry: dict) -> tuple:
+    return (entry.get("path", ""),
+            json.dumps(entry.get("index", []), sort_keys=True))
+
+
+def _verify_writer_pieces(storage, sdir: str, nid: str,
+                          pieces: dict) -> set | None:
+    """Which of a corrupt shard file's pieces are INDIVIDUALLY bad,
+    checked against the per-piece CRCs in the meta via ranged reads;
+    None when per-piece verification is impossible (missing meta /
+    pre-piece writer) — then the whole file is unusable."""
+    meta_path = os.path.join(sdir, f"node_{nid}.meta.json")
+    try:
+        header = json.loads(storage.read_text(meta_path))
+        metas = dict(header.get("metas", {}))
+    except (ValueError, OSError, TypeError, FileNotFoundError):
+        return None
+    bin_path = os.path.join(sdir, f"node_{nid}.bin")
+    bad: set = set()
+    for key, entry in pieces.items():
+        info = metas.get(key) or {}
+        want = (entry or {}).get("crc32", info.get("crc32"))
+        offset, nbytes = info.get("offset"), info.get("nbytes")
+        if want is None or offset is None or nbytes is None:
+            return None  # can't attribute the damage; whole file bad
+        try:
+            blob = storage.read_range(bin_path, int(offset), int(nbytes))
+        except (OSError, FileNotFoundError):
+            return None
+        if len(blob) != int(nbytes) or crc32_bytes(blob) != int(want):
+            bad.add(key)
+    return bad
+
+
+def verify_step_quorum(storage, sdir: str, num_shards: int
+                       ) -> StepVerdict:
+    """Quorum semantics: a step is restorable iff its COMMIT manifest
+    is complete AND every piece it lists verifies on at least one
+    writer. A corrupt shard file no longer condemns the whole step when
+    a replica twin (``DLROVER_TPU_CKPT_PERSIST_REPLICAS`` >= 2) holds
+    verified copies of every piece the file contributed — that is the
+    per-shard rollback. Without a COMMIT marker: legacy acceptance on
+    done-marker count alone (those checkpoints carry no CRCs)."""
     files = storage.listdir(sdir)
     marker = commit_marker(num_shards)
     if marker not in files:
@@ -111,31 +182,104 @@ def verify_step_dir(storage, sdir: str, num_shards: int) -> str | None:
             f for f in files
             if f.startswith("done_") and f.endswith(f"_w{num_shards}")
         ]
-        return None if len(done) >= num_shards else "missing_commit"
+        if len(done) >= num_shards:
+            return StepVerdict()
+        return StepVerdict(fail_kind="missing_commit")
     try:
         manifest = json.loads(
             storage.read_text(os.path.join(sdir, marker))
         )
         shards = dict(manifest.get("shards", {}))
     except (ValueError, OSError, TypeError):
-        return "corrupt_commit"
+        return StepVerdict(fail_kind="corrupt_commit")
     if len(shards) < int(manifest.get("num_shards", num_shards)):
-        return "incomplete_manifest"
+        return StepVerdict(fail_kind="incomplete_manifest")
+    bad_pieces: dict[str, set | None] = {}
+    fail_kinds: dict[str, str] = {}
     for nid, entry in shards.items():
+        entry = entry or {}
         bin_path = os.path.join(sdir, f"node_{nid}.bin")
         meta_path = os.path.join(sdir, f"node_{nid}.meta.json")
         if not storage.exists(bin_path) or not storage.exists(meta_path):
-            return "missing_shard"
-        want = (entry or {}).get("crc32")
+            bad_pieces[nid] = None
+            fail_kinds[nid] = "missing_shard"
+            continue
+        want = entry.get("crc32")
         if want is None:
             continue  # mixed-version writer: nothing to check against
-        crc, size = _shard_crc(storage, bin_path)
-        want_bytes = (entry or {}).get("bytes")
+        try:
+            crc, size = _shard_crc(storage, bin_path)
+        except (OSError, FileNotFoundError):
+            bad_pieces[nid] = None
+            fail_kinds[nid] = "missing_shard"
+            continue
+        want_bytes = entry.get("bytes")
         if want_bytes is not None and size != int(want_bytes):
-            return "truncated_shard"
-        if crc != int(want):
-            return "crc_mismatch"
-    return None
+            fail_kinds[nid] = "truncated_shard"
+        elif crc != int(want):
+            fail_kinds[nid] = "crc_mismatch"
+        else:
+            continue
+        # whole-file damage: per-piece CRCs decide WHICH pieces died
+        # (read-side bit flips are transient — the range re-read can
+        # verify clean even though the streaming pass did not)
+        pieces = dict(entry.get("pieces") or {})
+        bad = (_verify_writer_pieces(storage, sdir, nid, pieces)
+               if pieces else None)
+        if bad == set():
+            # every piece individually verifies on re-read: the damage
+            # was transient (or outside any piece's bytes); keep the
+            # writer but note the anomaly
+            logger.warning(
+                "shard node_%s in %s failed the whole-file CRC but "
+                "every piece verifies on ranged re-read; keeping it",
+                nid, sdir,
+            )
+            continue
+        bad_pieces[nid] = bad
+    if not bad_pieces:
+        return StepVerdict()
+    # quorum: every piece listed by a BAD writer must verify somewhere
+    # else. Build piece -> surviving-holder coverage over good writers
+    # (and the undamaged pieces of partially-bad writers).
+    held: dict[tuple, int] = {}
+    legacy_bad = False
+    for nid, entry in shards.items():
+        pieces = dict((entry or {}).get("pieces") or {})
+        if nid in bad_pieces and not pieces:
+            legacy_bad = True  # pre-piece writer: no coverage algebra
+            continue
+        bad = bad_pieces.get(nid, set())
+        for key, pentry in pieces.items():
+            if bad is None or key in bad:
+                continue
+            held[_piece_key(pentry)] = held.get(_piece_key(pentry), 0) + 1
+    if legacy_bad:
+        worst = next(iter(fail_kinds.values()), "crc_mismatch")
+        return StepVerdict(fail_kind=worst, bad_pieces=bad_pieces)
+    rollbacks: list[dict] = []
+    for nid, bad in bad_pieces.items():
+        pieces = dict((shards.get(nid) or {}).get("pieces") or {})
+        lost = [key for key, pentry in pieces.items()
+                if (bad is None or key in bad)
+                and held.get(_piece_key(pentry), 0) == 0]
+        if lost:
+            return StepVerdict(
+                fail_kind=fail_kinds.get(nid, "crc_mismatch"),
+                bad_pieces=bad_pieces,
+            )
+        rollbacks.append({
+            "writer": nid,
+            "kind": fail_kinds.get(nid, "crc_mismatch"),
+            "pieces": len(pieces) if bad is None else len(bad),
+        })
+    return StepVerdict(bad_pieces=bad_pieces, rollbacks=rollbacks)
+
+
+def verify_step_dir(storage, sdir: str, num_shards: int) -> str | None:
+    """None when the step verifies (possibly via per-shard twin
+    rollback); else a short failure kind."""
+    return verify_step_quorum(storage, sdir, num_shards).fail_kind
 
 
 def _dir_worlds(files: list[str]) -> list[int]:
@@ -154,15 +298,28 @@ def _reject(step: int, kind: str) -> None:
     logger.error("checkpoint step %d failed verification: %s", step, kind)
 
 
-def resolve_restore_step(storage, ckpt_dir: str
-                         ) -> tuple[int, int] | None:
-    """The newest VERIFIED (step, num_shards) to restore from.
+@dataclasses.dataclass
+class RestorePlan:
+    """The newest verified step PLUS which shard files to avoid: the
+    restore registry must not read pieces a per-shard rollback proved
+    corrupt (their replica twins serve those slices instead)."""
+
+    step: int
+    num_shards: int
+    bad_pieces: dict[str, set | None] = dataclasses.field(
+        default_factory=dict)
+
+
+def resolve_restore_plan(storage, ckpt_dir: str) -> RestorePlan | None:
+    """The newest VERIFIED restore plan (quorum semantics).
 
     Starts at the tracker's step; if that step fails verification (or
     the tracker itself is torn), walks the step directories newest
-    first and returns the first that verifies, journaling the rollback.
-    Returns None when nothing restorable exists — the caller starts
-    fresh, which beats silently installing corrupt weights.
+    first and returns the first that verifies, journaling the
+    rollback. A step that verifies only via replica twins journals
+    ``ckpt_shard_rollback`` per recovered shard. Returns None when
+    nothing restorable exists — the caller starts fresh, which beats
+    silently installing corrupt weights.
     """
     from dlrover_tpu.agent.ckpt_saver import read_tracker, step_dir
 
@@ -195,8 +352,21 @@ def resolve_restore_step(storage, ckpt_dir: str
                   else _dir_worlds(storage.listdir(sdir)))
         fail_kind = "unverifiable"
         for world in worlds:
-            kind = verify_step_dir(storage, sdir, world)
-            if kind is None:
+            verdict = verify_step_quorum(storage, sdir, world)
+            if verdict.ok:
+                for rb in verdict.rollbacks:
+                    _shard_rollback_total.inc()
+                    get_journal().emit(
+                        "ckpt_shard_rollback", step=step,
+                        writer=str(rb["writer"]), kind=rb["kind"],
+                        pieces=rb["pieces"],
+                    )
+                    logger.warning(
+                        "per-shard rollback at step %d: shard node_%s "
+                        "failed (%s); its %d piece(s) restore from "
+                        "replica twins", step, rb["writer"], rb["kind"],
+                        rb["pieces"],
+                    )
                 if tracked is not None and step != tracked[0]:
                     _rollback_total.inc()
                     get_journal().emit("ckpt_rollback",
@@ -206,7 +376,17 @@ def resolve_restore_step(storage, ckpt_dir: str
                         "verification, using newest verified step %d",
                         tracked[0], step,
                     )
-                return step, world
-            fail_kind = kind
+                return RestorePlan(step=step, num_shards=world,
+                                   bad_pieces=verdict.bad_pieces)
+            fail_kind = verdict.fail_kind
         _reject(step, fail_kind)
     return None
+
+
+def resolve_restore_step(storage, ckpt_dir: str
+                         ) -> tuple[int, int] | None:
+    """(step, num_shards) view of ``resolve_restore_plan`` — the
+    compatibility surface for callers that restore whole node files
+    (the replicated engine path)."""
+    plan = resolve_restore_plan(storage, ckpt_dir)
+    return None if plan is None else (plan.step, plan.num_shards)

@@ -25,13 +25,30 @@ from typing import Any, Callable, Sequence
 
 import numpy as np
 
+from dlrover_tpu.common import envspec
+from dlrover_tpu.common.constants import EnvKey
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.metrics import registry
 from dlrover_tpu.checkpoint.engine import CheckpointEngine
 from dlrover_tpu.checkpoint.shm_handler import _leaf_paths
 
 logger = get_logger(__name__)
 
 PIECE_SEP = "::piece"
+
+_restore_parallel_seconds = registry().histogram(
+    "dlrover_tpu_ckpt_restore_parallel_seconds",
+    "per-host sharded storage restore duration (parallel piece reads "
+    "+ assembly) — flat in host count by design",
+)
+
+
+def persist_replicas() -> int:
+    """How many DP replica copies of each shard are persisted to
+    storage. 1 = exactly-one-writer dedup (smallest checkpoint);
+    2 = primary + twin, the redundancy the per-shard rollback needs."""
+    return max(1, envspec.get_int(EnvKey.CKPT_PERSIST_REPLICAS))
 
 
 class CoverageError(RuntimeError):
@@ -55,11 +72,12 @@ class PieceSource:
 
     def __init__(self, path: str, global_shape: tuple[int, ...],
                  dtype: np.dtype, index: list[list[int]],
-                 read: Callable[[], np.ndarray]):
+                 read: Callable[[], np.ndarray], replica: int = 0):
         self.path = path
         self.global_shape = global_shape
         self.dtype = dtype
         self.index = index  # [[start, stop], ...] in the global array
+        self.replica = replica  # DP replica rank of the saved copy
         self._read = read
 
     def data(self) -> np.ndarray:
@@ -93,6 +111,124 @@ def assemble(target_index: list[list[int]], dtype: np.dtype,
             f"target {target_index}"
         )
     return out
+
+
+def _registry_entries(metas: dict, index_map: dict,
+                      view: Callable[[dict], np.ndarray]
+                      ) -> dict[str, list[PieceSource]]:
+    registry: dict[str, list[PieceSource]] = {}
+    for key, entry in index_map.items():
+        info = metas.get(key)
+        if info is None:
+            continue
+        registry.setdefault(entry["path"], []).append(
+            PieceSource(
+                path=entry["path"],
+                global_shape=tuple(entry["global_shape"]),
+                dtype=np.dtype(entry["dtype"]),
+                index=[list(p) for p in entry["index"]],
+                read=lambda info=info: view(info),
+                replica=int(entry.get("replica", 0)),
+            )
+        )
+    return registry
+
+
+def storage_piece_registry(
+    storage, ckpt_dir: str, step: int, num_shards: int,
+    bad_pieces: dict[str, set | None] | None = None,
+) -> dict[str, list[PieceSource]] | None:
+    """Piece registry over the COMMITTED world's files for ``step``.
+
+    Only node files named by a ``done_<id>_w<num_shards>`` marker are
+    read: a step directory may also hold stale files from a previous
+    incarnation with a different world size (same step re-reached after
+    an elastic reshape), and blending those would restore divergent
+    weights. ``bad_pieces`` (from the integrity RestorePlan) excludes
+    shard files — or individual pieces — that failed verification, so
+    their replica twins serve those slices instead.
+
+    The per-node metadata reads run CONCURRENTLY (each inside a
+    ``ckpt_restore_shard`` span): against an object store these are
+    round trips, and a restore's setup must stay flat as the writer
+    count grows. Piece BYTES stay lazy — memmap windows locally,
+    ``read_range`` slices remotely — so a topology-changing restore
+    pulls only the byte ranges the local mesh actually needs.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    from dlrover_tpu.agent.ckpt_saver import step_dir
+    from dlrover_tpu.common.storage import PosixDiskStorage
+
+    sdir = step_dir(ckpt_dir, step)
+    if not storage.exists(sdir):
+        return None
+    suffix = f"_w{num_shards}"
+    node_ids = [
+        f[len("done_"):-len(suffix)]
+        for f in storage.listdir(sdir)
+        if f.startswith("done_") and f.endswith(suffix)
+    ]
+    bad_pieces = bad_pieces or {}
+    local = isinstance(storage, PosixDiskStorage)
+
+    def _node_part(nid: str) -> dict[str, list[PieceSource]]:
+        bad = bad_pieces.get(nid, set())
+        if bad is None:
+            return {}  # whole shard file failed; twins cover it
+        meta_path = os.path.join(sdir, f"node_{nid}.meta.json")
+        if not storage.exists(meta_path):
+            return {}
+        with get_journal().span("ckpt_restore_shard", step=step,
+                                writer=str(nid)):
+            header = json.loads(storage.read_text(meta_path))
+            index_map = {
+                k: v
+                for k, v in (header.get("sharded_index") or {}).items()
+                if k not in bad
+            }
+            if not index_map:
+                return {}
+            bin_path = os.path.join(sdir, f"node_{nid}.bin")
+            if local:
+                # memmap keeps restore lazy: only bytes a target slice
+                # needs are paged in
+                blob = np.memmap(bin_path, dtype=np.uint8, mode="r")
+
+                def view(info, blob=blob):
+                    return np.ndarray(
+                        tuple(info["shape"]),
+                        dtype=np.dtype(info["dtype"]),
+                        buffer=blob, offset=info["offset"],
+                    )
+            else:
+                # ranged reads: one GET per needed piece, never a
+                # whole-file download
+                def view(info, bin_path=bin_path):
+                    raw = storage.read_range(
+                        bin_path, int(info["offset"]),
+                        int(info["nbytes"]),
+                    )
+                    return np.frombuffer(
+                        raw, dtype=np.dtype(info["dtype"])
+                    ).reshape(tuple(info["shape"]))
+            return _registry_entries(header["metas"], index_map, view)
+
+    registry: dict[str, list[PieceSource]] = {}
+    ordered = sorted(nid for nid in node_ids)
+    if len(ordered) > 1:
+        with ThreadPoolExecutor(max_workers=min(8, len(ordered))) as pool:
+            parts = list(pool.map(_node_part, ordered))
+    else:
+        parts = [_node_part(nid) for nid in ordered]
+    for part in parts:
+        for path, lst in part.items():
+            registry.setdefault(path, []).extend(lst)
+    # primary replicas first: overlapping twin pieces hold the same
+    # bytes, but deterministic order keeps assembly stable
+    for lst in registry.values():
+        lst.sort(key=lambda p: p.replica)
+    return registry or None
 
 
 class ShardedCheckpointEngine(CheckpointEngine):
@@ -136,8 +272,20 @@ class ShardedCheckpointEngine(CheckpointEngine):
     # ------------------------------------------------------------------ save
 
     def _prepare_state(self, state: Any) -> tuple[Any, dict]:
+        """Split the pytree into this node's addressable pieces.
+
+        Every piece carries its global index, its REPLICA rank, and a
+        ``persist`` flag: the shm snapshot keeps full local coverage
+        (restart-in-place, buddy replication), but the agent persister
+        writes only flagged pieces — ``replica_id <
+        DLROVER_TPU_CKPT_PERSIST_REPLICAS`` — so exactly one DP replica
+        (or one primary + one twin at replicas=2) writes each shard to
+        storage, with zero cross-host coordination: the writer
+        assignment is a pure function of the sharding.
+        """
         import jax
 
+        keep = persist_replicas()
         pieces: dict[str, Any] = {}
         index_map: dict[str, dict] = {}
         for name, leaf in _leaf_paths(state):
@@ -157,8 +305,13 @@ class ShardedCheckpointEngine(CheckpointEngine):
                         "global_shape": list(leaf.shape),
                         "dtype": str(np.dtype(leaf.dtype)),
                         "index": _norm_index(s.index, leaf.shape),
+                        "replica": int(s.replica_id),
+                        "persist": bool(s.replica_id < keep),
                     }
             else:
+                # host leaves are replicated on every node: the node
+                # RANK is the replica rank, so rank 0 (and rank 1 at
+                # replicas=2) persists and the rest dedup away
                 arr = np.asarray(leaf)
                 pieces[name] = arr
                 index_map[name] = {
@@ -168,8 +321,28 @@ class ShardedCheckpointEngine(CheckpointEngine):
                     "index": _norm_index(
                         tuple(slice(None) for _ in arr.shape), arr.shape
                     ),
+                    "replica": int(self.node_rank),
+                    "persist": bool(self.node_rank < keep),
                 }
         return pieces, {"sharded_index": index_map}
+
+    def snapshot_pieces(self, step: int, pieces: dict[str, np.ndarray],
+                        index_map: dict[str, dict]) -> None:
+        """Install an explicit piece set as this node's shm snapshot
+        (bench / chaos-scenario hosts simulated in one process, remote
+        producers). ``index_map`` entries need path/global_shape/dtype/
+        index; replica defaults to 0 (persisted)."""
+        for key, entry in index_map.items():
+            entry.setdefault("replica", 0)
+            entry.setdefault("persist",
+                             entry["replica"] < persist_replicas())
+            if key not in pieces:
+                raise KeyError(f"index_map key {key!r} has no piece")
+        self.shm_handler.save_state_dict(
+            step, dict(pieces),
+            extra_meta={**self._extra_meta(),
+                        "sharded_index": dict(index_map)},
+        )
 
     # ------------------------------------------------------------------ load
 
@@ -190,87 +363,36 @@ class ShardedCheckpointEngine(CheckpointEngine):
             ),
         )
 
-    def _storage_pieces(self, step: int, num_shards: int
+    def _storage_pieces(self, step: int, num_shards: int,
+                        bad_pieces: dict[str, set | None] | None = None,
                         ) -> dict[str, list[PieceSource]] | None:
-        """Piece registry over the COMMITTED world's files for ``step``.
-
-        Only node files named by a ``done_<id>_w<num_shards>`` marker are
-        read: a step directory may also hold stale files from a previous
-        incarnation with a different world size (same step re-reached after
-        an elastic reshape), and blending those would restore divergent
-        weights.
-        """
-        from dlrover_tpu.agent.ckpt_saver import step_dir
-        from dlrover_tpu.common.storage import PosixDiskStorage
-
-        sdir = step_dir(self.ckpt_dir, step)
-        if not self.storage.exists(sdir):
-            return None
-        suffix = f"_w{num_shards}"
-        node_ids = [
-            f[len("done_"):-len(suffix)]
-            for f in self.storage.listdir(sdir)
-            if f.startswith("done_") and f.endswith(suffix)
-        ]
-        registry: dict[str, list[PieceSource]] = {}
-        local = isinstance(self.storage, PosixDiskStorage)
-        for nid in sorted(node_ids):
-            meta_path = os.path.join(sdir, f"node_{nid}.meta.json")
-            if not self.storage.exists(meta_path):
-                continue
-            header = json.loads(self.storage.read_text(meta_path))
-            index_map = header.get("sharded_index")
-            if not index_map:
-                continue
-            bin_path = os.path.join(sdir, f"node_{nid}.bin")
-            if local:
-                # memmap keeps restore lazy: only bytes a target slice
-                # needs are paged in
-                blob = np.memmap(bin_path, dtype=np.uint8, mode="r")
-            else:
-                blob = np.frombuffer(
-                    self.storage.read(bin_path), dtype=np.uint8
-                )
-            part = self._registry_from(
-                header["metas"], index_map,
-                lambda info, blob=blob: np.ndarray(
-                    tuple(info["shape"]), dtype=np.dtype(info["dtype"]),
-                    buffer=blob, offset=info["offset"],
-                ),
-            )
-            for path, lst in part.items():
-                registry.setdefault(path, []).extend(lst)
-        return registry or None
+        return storage_piece_registry(
+            self.storage, self.ckpt_dir, step, num_shards,
+            bad_pieces=bad_pieces,
+        )
 
     @staticmethod
     def _registry_from(metas: dict, index_map: dict,
                        view: Callable[[dict], np.ndarray]
                        ) -> dict[str, list[PieceSource]]:
-        registry: dict[str, list[PieceSource]] = {}
-        for key, entry in index_map.items():
-            info = metas.get(key)
-            if info is None:
-                continue
-            registry.setdefault(entry["path"], []).append(
-                PieceSource(
-                    path=entry["path"],
-                    global_shape=tuple(entry["global_shape"]),
-                    dtype=np.dtype(entry["dtype"]),
-                    index=[list(p) for p in entry["index"]],
-                    read=lambda info=info: view(info),
-                )
-            )
-        return registry
+        return _registry_entries(metas, index_map, view)
 
     def load_sharded(self, template: Any, shardings: Any
                      ) -> tuple[int, Any] | None:
         import time as _time
 
         from dlrover_tpu.checkpoint.engine import _record_restore
+        from dlrover_tpu.parallel.compile_cache import launder
 
         start = _time.monotonic()
         loaded = self._load_sharded_impl(template, shardings)
         if loaded is not None:
+            # every branch below builds the tree host-side (arena views
+            # / storage pieces through device_put or
+            # make_array_from_callback): re-stage before ANY cached AOT
+            # executable can see it, or donation corrupts it in place
+            # on the CPU backend (DESIGN.md §17.4)
+            loaded = (loaded[0], launder(loaded[1]))
             _record_restore("sharded", start, loaded[0])
         return loaded
 
@@ -323,19 +445,26 @@ class ShardedCheckpointEngine(CheckpointEngine):
                 "holder can't serve the full state; restoring the "
                 "committed storage step instead"
             )
-        from dlrover_tpu.checkpoint.integrity import resolve_restore_step
+        import time as _time
 
-        # newest VERIFIED step (crc manifest + COMMIT marker): every
-        # process resolves independently but deterministically — same
-        # storage, same walk — so the choice stays collective-uniform
-        committed = resolve_restore_step(self.storage, self.ckpt_dir)
-        if committed is None:
+        from dlrover_tpu.checkpoint.integrity import resolve_restore_plan
+
+        # newest VERIFIED restore plan (crc manifest + COMMIT marker +
+        # quorum over replica twins): every process resolves
+        # independently but deterministically — same storage, same walk
+        # — so the choice stays collective-uniform
+        plan = resolve_restore_plan(self.storage, self.ckpt_dir)
+        if plan is None:
             return None
-        step, num_shards = committed
-        registry = self._storage_pieces(step, num_shards)
+        registry = self._storage_pieces(
+            plan.step, plan.num_shards, bad_pieces=plan.bad_pieces
+        )
         if registry is None:
             return None
-        return step, self._build(template, shardings, registry)
+        t0 = _time.monotonic()
+        built = self._build(template, shardings, registry)
+        _restore_parallel_seconds.observe(_time.monotonic() - t0)
+        return plan.step, built
 
     @staticmethod
     def _allgather_steps(step: int) -> np.ndarray:

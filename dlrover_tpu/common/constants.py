@@ -189,6 +189,13 @@ class EnvKey:
     # preemption/maintenance-notice sources (agent/preemption.py)
     PREEMPTION_FILE = "DLROVER_TPU_PREEMPTION_FILE"
     PREEMPTION_URL = "DLROVER_TPU_PREEMPTION_URL"
+    # per-host parallel checkpoint persist (DESIGN.md §20): how many
+    # DP replicas of each shard are written to storage (2 enables
+    # per-shard twin rollback), the concurrent chunk writers per host,
+    # and the chunk size for the chunked object-store writes
+    CKPT_PERSIST_REPLICAS = "DLROVER_TPU_CKPT_PERSIST_REPLICAS"
+    CKPT_PERSIST_WORKERS = "DLROVER_TPU_CKPT_PERSIST_WORKERS"
+    CKPT_PERSIST_CHUNK_MB = "DLROVER_TPU_CKPT_PERSIST_CHUNK_MB"
 
 
 class Defaults:

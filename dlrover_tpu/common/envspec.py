@@ -126,6 +126,15 @@ SPECS: tuple[EnvVar, ...] = (
            "seconds between buddy snapshot pushes", "§16"),
     EnvVar("DLROVER_TPU_BUDDY_MAX_BYTES", str(64 << 30),
            "upper bound on one pushed buddy snapshot", "§16"),
+    EnvVar("DLROVER_TPU_CKPT_PERSIST_REPLICAS", "1",
+           "DP replica copies of each shard persisted to storage; 2 "
+           "enables per-shard twin rollback at restore", "§20"),
+    EnvVar("DLROVER_TPU_CKPT_PERSIST_WORKERS", "4",
+           "concurrent chunk writers per host in the parallel persist "
+           "path", "§20"),
+    EnvVar("DLROVER_TPU_CKPT_PERSIST_CHUNK_MB", "64",
+           "chunk size (MB) of the chunked concurrent storage writes",
+           "§20"),
     # -------------------------------------------------------- warm recovery
     EnvVar("DLROVER_TPU_STANDBY", "1",
            "'0' disables the pre-spawned standby trainer", "§16"),

@@ -580,6 +580,43 @@ class BuddyQueryResponse:
 
 @register_message
 @dataclasses.dataclass
+class PersistAckReport:
+    """One host's ack that its checkpoint shard is durable.
+
+    ``shard`` is the manifest entry for this writer — whole-file crc32
+    + bytes + the per-piece (index, crc, replica) map — so the rank-0
+    committer can assemble the GLOBAL manifest from acks alone, without
+    listing or re-reading storage (DESIGN.md §20)."""
+
+    node_id: int = 0
+    step: int = 0
+    num_shards: int = 1
+    shard: dict = dataclasses.field(default_factory=dict)
+
+
+@register_message
+@dataclasses.dataclass
+class PersistStatusRequest:
+    node_id: int = 0
+    step: int = 0
+    num_shards: int = 1
+
+
+@register_message
+@dataclasses.dataclass
+class PersistStatusResponse:
+    """Ack ledger for one (step, writer-world): ``complete`` once every
+    expected writer acked; ``shards`` maps node id (str) -> its acked
+    manifest entry."""
+
+    acked: int = 0
+    num_shards: int = 1
+    complete: bool = False
+    shards: dict = dataclasses.field(default_factory=dict)
+
+
+@register_message
+@dataclasses.dataclass
 class SyncJoin:
     sync_name: str = ""
     node_id: int = 0

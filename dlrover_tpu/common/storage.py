@@ -43,6 +43,19 @@ def build_storage(meta: ClassMeta) -> "CheckpointStorage":
 
 
 class CheckpointStorage(ABC):
+    """Pluggable checkpoint backend (object-store-grade interface).
+
+    The six abstract methods are the minimum contract; the ranged /
+    chunked operations below have whole-blob default implementations so
+    a naive backend is correct, just not parallel. Backends over real
+    object stores (GCS/S3 composite uploads) override ``write_parallel``
+    with multi-part uploads and ``read_range`` with ranged GETs — the
+    topology-changing restore reads only the byte ranges the local mesh
+    needs through these. Contract tests:
+    tests/test_parallel_ckpt.py::StorageContract runs any backend
+    against the semantics the checkpoint layer assumes.
+    """
+
     @abstractmethod
     def write(self, content: bytes | str, path: str) -> None: ...
 
@@ -63,6 +76,24 @@ class CheckpointStorage(ABC):
 
     def read_text(self, path: str) -> str:
         return self.read(path).decode("utf-8")
+
+    # ------------------------------------------- object-store-grade ops
+
+    def size(self, path: str) -> int:
+        return len(self.read(path))
+
+    def read_range(self, path: str, offset: int, length: int) -> bytes:
+        """``length`` bytes at ``offset``; short only at end-of-object
+        (mirrors ranged-GET semantics)."""
+        return self.read(path)[offset:offset + length]
+
+    def write_parallel(self, content: bytes | memoryview, path: str,
+                       chunk_bytes: int = 64 << 20,
+                       workers: int = 4) -> None:
+        """Publish one blob with chunked concurrent I/O; atomic — a
+        reader never observes a partial object at ``path``. Default
+        degrades to the plain atomic write."""
+        self.write(bytes(content), path)
 
     def class_meta(self) -> ClassMeta:
         return ClassMeta(
@@ -117,6 +148,37 @@ def _apply_write_fault(content: bytes | str, path: str
     return content, 0.0
 
 
+def _apply_read_fault(data: bytes, path: str) -> bytes:
+    """Injected storage faults on the READ side (chaos plan
+    ``storage_read`` point), mirroring ``storage_write``:
+
+    ``bit_flip`` corrupts one bit of the returned bytes (the medium
+    rotted after a clean write — the CRC layer must catch it),
+    ``missing`` raises FileNotFoundError (an object-store eventual-
+    consistency hole or deleted shard), and ``slow`` sleeps before
+    returning (a degraded disk / throttled bucket). The fault applies
+    to what the CALLER sees; the bytes on storage stay intact, so a
+    retry or a twin read can succeed — exactly the transient-read
+    failure class the per-shard rollback exists for.
+    """
+    fault = chaos.fire("storage_read", path=path)
+    if fault is None:
+        return data
+    if fault.action == "missing":
+        raise FileNotFoundError(f"chaos: missing object: {path}")
+    if fault.action == "slow":
+        time.sleep(float(fault.args.get("s", 0.5)))
+        return data
+    if fault.action == "bit_flip" and data:
+        out = bytearray(data)
+        pos = int(fault.args.get("offset", -1))
+        if pos < 0 or pos >= len(out):
+            pos = int(fault.rand * len(out))
+        out[pos] ^= 1 << (fault.seq % 8)
+        return bytes(out)
+    return data
+
+
 def atomic_write_file(content: bytes | str, path: str) -> None:
     """Durable atomic file publish: tmp + fsync + rename. Without the
     fsync a crash right after the rename can publish a truncated file."""
@@ -143,7 +205,76 @@ class PosixDiskStorage(CheckpointStorage):
 
     def read(self, path: str) -> bytes:
         with open(path, "rb") as f:
-            return f.read()
+            data = f.read()
+        if chaos.ENABLED:
+            data = _apply_read_fault(data, path)
+        return data
+
+    def size(self, path: str) -> int:
+        return os.path.getsize(path)
+
+    def read_range(self, path: str, offset: int, length: int) -> bytes:
+        with open(path, "rb") as f:
+            f.seek(offset)
+            data = f.read(length)
+        if chaos.ENABLED:
+            data = _apply_read_fault(data, path)
+        return data
+
+    def write_parallel(self, content: bytes | memoryview, path: str,
+                       chunk_bytes: int = 64 << 20,
+                       workers: int = 4) -> None:
+        """Chunked concurrent pwrite into a tmp file, then fsync +
+        rename — same atomicity as ``atomic_write_file``, but the body
+        lands through ``workers`` parallel writers (one core sees no
+        gain; NFS/FUSE object mounts and multi-queue NVMe do). The
+        chaos ``storage_write`` fault applies to the WHOLE blob before
+        chunking, so write-side bit flips stay byte-deterministic
+        regardless of worker interleaving."""
+        view = memoryview(content)
+        fsync_delay = 0.0
+        if chaos.ENABLED:
+            mutated, fsync_delay = _apply_write_fault(bytes(view), path)
+            view = memoryview(
+                mutated if isinstance(mutated, bytes)
+                else mutated.encode("utf-8")
+            )
+        total = len(view)
+        workers = max(1, int(workers))
+        chunk_bytes = max(1 << 20, int(chunk_bytes))
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+        try:
+            os.truncate(fd, total)
+            offsets = list(range(0, total, chunk_bytes))
+            if len(offsets) <= 1 or workers == 1:
+                off = 0
+                while off < total:
+                    off += os.pwrite(fd, view[off:off + chunk_bytes], off)
+            else:
+                from concurrent.futures import ThreadPoolExecutor
+
+                def _put(off: int) -> None:
+                    end = min(off + chunk_bytes, total)
+                    while off < end:
+                        off += os.pwrite(fd, view[off:end], off)
+
+                with ThreadPoolExecutor(max_workers=workers) as pool:
+                    # list() re-raises the first worker error here
+                    list(pool.map(_put, offsets))
+            if fsync_delay > 0:
+                time.sleep(fsync_delay)
+            os.fsync(fd)
+        except BaseException:
+            os.close(fd)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        os.close(fd)
+        os.replace(tmp, path)
 
     def exists(self, path: str) -> bool:
         return os.path.exists(path)

@@ -205,12 +205,23 @@ class EngineReplica:
             with self._lock:
                 self._state = ReplicaState.DEAD
             return
+        aot = self._warm_engine(engine)
         with self._lock:
             if self._killed:
                 return
             self.engine = engine
             self.slots = engine.slots
             self._state = ReplicaState.READY
+        # the compile_cache evidence of this replica's cold start: a
+        # later replica of the same (model, slots, max_len) reads
+        # hit=True here — its cold compile became an executable load
+        get_journal().emit(
+            "gateway_replica_ready", replica=self.id,
+            aot=aot is not None,
+            aot_hit=bool(aot.cache_hit) if aot else False,
+            aot_source=aot.source if aot else "",
+            aot_seconds=aot.seconds if aot else 0.0,
+        )
         logger.info("replica %d ready (%d slots)", self.id, self.slots)
         while True:
             with self._lock:
@@ -262,6 +273,24 @@ class EngineReplica:
             if not work.first_token_t:
                 work.first_token_t = time.monotonic()
         return cb
+
+    def _warm_engine(self, engine: Any):
+        """Route the replica cold start through the elastic compile
+        cache (``parallel/compile_cache.load_or_compile``): the decode
+        step — the program every request pays for — is loaded from any
+        earlier replica's publish instead of cold-compiled. Off with
+        ``DLROVER_TPU_AOT_CACHE=0`` or for engines without the hook."""
+        from dlrover_tpu.common import envspec
+        from dlrover_tpu.common.constants import EnvKey
+
+        warm = getattr(engine, "warm_aot_step", None)
+        if warm is None or not envspec.get_bool(EnvKey.AOT_CACHE):
+            return None
+        try:
+            return warm()
+        except Exception:  # noqa: BLE001 - warming is best-effort
+            logger.exception("replica %d AOT warmup failed", self.id)
+            return None
 
 
 class ReplicaPool:

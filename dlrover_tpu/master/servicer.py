@@ -78,6 +78,12 @@ class MasterServicer:
         self.job_success: bool | None = None
         # node_id -> BuddyServer addr (checkpoint/buddy.py replication)
         self._buddy_endpoints: dict[int, str] = {}
+        # (step, num_shards) -> {node_id(str): shard manifest entry}:
+        # the persist-ack ledger the rank-0 committer polls instead of
+        # listing storage (DESIGN.md §20); bounded to the newest steps
+        self._persist_acks: dict[tuple[int, int], dict[str, dict]] = {}
+        self._persist_lock = threading.Lock()
+        self.max_persist_steps = 8
         self.trace_id = trace_id
         # (node_id, role) -> last pushed registry snapshot
         # (MetricsSnapshotRequest); rendered by the master's exposition
@@ -306,6 +312,27 @@ class MasterServicer:
             return m.OkResponse()
         if isinstance(msg, m.JobExitRequest):
             return self._job_exit(msg)
+        if isinstance(msg, m.PersistAckReport):
+            key = (int(msg.step), int(msg.num_shards))
+            with self._persist_lock:
+                self._persist_acks.setdefault(key, {})[
+                    str(msg.node_id)
+                ] = dict(msg.shard)
+                if len(self._persist_acks) > self.max_persist_steps:
+                    for old in sorted(self._persist_acks)[
+                        : len(self._persist_acks) - self.max_persist_steps
+                    ]:
+                        del self._persist_acks[old]
+            return m.OkResponse()
+        if isinstance(msg, m.PersistStatusRequest):
+            key = (int(msg.step), int(msg.num_shards))
+            with self._persist_lock:
+                shards = dict(self._persist_acks.get(key, {}))
+            return m.PersistStatusResponse(
+                acked=len(shards), num_shards=int(msg.num_shards),
+                complete=len(shards) >= int(msg.num_shards),
+                shards=shards,
+            )
         if isinstance(msg, m.SyncJoin):
             n = self._kv_store.add(f"sync/{msg.sync_name}", 1)
             return m.KVStoreResponse(found=True, number=n)

@@ -253,6 +253,72 @@ class InferenceEngine:
         self._step_block = jax.jit(
             _step_block, static_argnames=("n_steps",)
         )
+        # the AOT decode-step program (warm_aot_step): replaces the
+        # n_steps=1 jit dispatch when armed, so a fresh serving replica
+        # whose (model, slots, max_len) was compiled by ANY earlier
+        # replica skips the cold compile (DESIGN.md §17 / ROADMAP item
+        # 1 leftover). Other block sizes keep the jit ladder.
+        self._aot_step = None
+        self.aot_info = None
+
+    # ------------------------------------------------------- AOT cold start
+
+    def _step_sample_args(self) -> tuple:
+        """The exact runtime argument tuple of a decode step (zero
+        requests active), built through the same conversions ``step()``
+        performs — lowering against these pins the true avals."""
+        temp, top_k, top_p = self._sampling_tensors()
+        active = np.zeros((self.slots,), bool)
+        return (self.params, self._cache["k"], self._cache["v"],
+                self._cache["pos"], self._last,
+                jnp.asarray(self._seeds), jnp.asarray(self._sampled),
+                temp, top_k, top_p, jnp.asarray(active))
+
+    def warm_aot_step(self, cache=None):
+        """Compile-or-load the n_steps=1 decode-step program through the
+        elastic compile cache; returns the ``AotStep`` evidence (None
+        when jax/caching is unavailable). Safe to skip: the jit path
+        stays fully functional. The engine's params/cache are laundered
+        first — a deserialized ``Compiled`` skips pjit's input
+        re-staging, and host-built trees must own proper per-device
+        buffers before it ever sees them (DESIGN.md §17.4)."""
+        from dlrover_tpu.parallel.compile_cache import (
+            abstract_signature,
+            compile_fingerprint,
+            launder,
+            load_or_compile,
+        )
+
+        try:
+            self._params = launder(self._params)
+            self._cache = launder(self._cache)
+            self._last = launder(self._last)
+            sample = self._step_sample_args()
+            key, inputs = compile_fingerprint(
+                num_nodes=1,
+                total_devices=jax.local_device_count(),
+                mesh_axes={},
+                model=self.cfg,
+                strategy={"kind": "serving_step", "slots": self.slots,
+                          "max_len": self.max_len,
+                          "prefill_len": self.prefill_len,
+                          "n_steps": 1},
+                args_signature=abstract_signature(sample),
+            )
+            aot = load_or_compile(
+                key, inputs,
+                lambda: self._step_block.lower(
+                    *sample, n_steps=1
+                ).compile(),
+                cache=cache,
+            )
+        except Exception:  # noqa: BLE001 - cold path must keep serving
+            logger.exception("AOT decode-step warmup failed; keeping "
+                             "the jit path")
+            return None
+        self._aot_step = aot.fn
+        self.aot_info = aot
+        return aot
 
     # ----------------------------------------------------------- user API
 
@@ -430,13 +496,18 @@ class InferenceEngine:
             return 0
         temp, top_k, top_p = self._sampling_tensors()
         block = self._block_size()
-        toks_dev, k, v, pos, last = self._step_block(
+        args = (
             self.params, self._cache["k"], self._cache["v"],
             self._cache["pos"], self._last,
             jnp.asarray(self._seeds), jnp.asarray(self._sampled),
             temp, top_k, top_p, jnp.asarray(active_mask),
-            n_steps=block,
         )
+        if block == 1 and self._aot_step is not None:
+            toks_dev, k, v, pos, last = self._aot_step(*args)
+        else:
+            toks_dev, k, v, pos, last = self._step_block(
+                *args, n_steps=block,
+            )
         self._sampled[active_mask] += block
         self._cache["k"], self._cache["v"] = k, v
         self._cache["pos"] = pos

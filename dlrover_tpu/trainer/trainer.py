@@ -630,11 +630,20 @@ class Trainer:
             step = self.state.global_step
             if self._last_save_step < step:
                 self._save_checkpoint(step, state)
-            self.engine.wait_for_persist(step)
-            # in-loop rotations see whatever the async persister had
-            # committed at the time; with the final step durable, this
-            # pass makes the retained set deterministic
-            self._rotate_checkpoints(step)
+            waited = self.engine.wait_for_persist(step)
+            if waited:
+                # in-loop rotations see whatever the async persister had
+                # committed at the time; with the final step durable,
+                # this pass makes the retained set deterministic
+                self._rotate_checkpoints(step)
+            else:
+                # the final step never became durable: rotating now
+                # could delete the only restorable older step
+                logger.warning(
+                    "final checkpoint (step %d) not durable after "
+                    "%.0fs (newest committed: %d); skipping rotation",
+                    step, waited.waited_s, waited.persisted_step,
+                )
         if args.load_best_model_at_end and self.state.best_step is not None:
             best = self.state.best_step
             if best != self.state.global_step:
@@ -661,7 +670,12 @@ class Trainer:
             return None
         # NB: a later step's commit also satisfies this wait — the pinned
         # load below is what actually verifies step N is on disk
-        self.engine.wait_for_persist(step)
+        waited = self.engine.wait_for_persist(step)
+        if not waited:
+            logger.warning(
+                "best-model step %d not durable after %.0fs; the "
+                "pinned reload will likely fail", step, waited.waited_s,
+            )
         shard_of = dict(_leaf_paths(self.compiled.state_shardings))
         loaded = self.engine.load(
             template,

@@ -274,7 +274,11 @@ def main(argv=None) -> int:
         engine = CheckpointEngine(args.ckpt_dir, node_id=ctx.node_id)
         state = {"dense": params, "embedding": table.export()}
         engine.save_to_storage(args.steps, state)
-        engine.wait_for_persist(args.steps, timeout=120)
+        waited = engine.wait_for_persist(args.steps, timeout=120)
+        if not waited:
+            print("[recsys] WARNING: final checkpoint not durable "
+                  f"(newest committed: {waited.persisted_step})",
+                  flush=True)
         engine.close()
         print(f"[recsys] checkpointed {len(table)} rows", flush=True)
 

@@ -524,7 +524,10 @@ def main(argv=None) -> int:
         goodput.done()
         goodput.close()
     engine.save_to_storage(final_step, state)
-    engine.wait_for_persist(final_step, timeout=120)
+    waited = engine.wait_for_persist(final_step, timeout=120)
+    if not waited:
+        print(f"[train] WARNING: final step {final_step} not durable "
+              f"(newest committed: {waited.persisted_step})", flush=True)
     engine.close()
 
     if args.result_file and ctx.node_rank == 0:

@@ -9,4 +9,5 @@ from native.analyze.checkers import (  # noqa: F401
     lock_discipline,
     metric_names,
     rpc_contract,
+    storage_interface,
 )

@@ -67,6 +67,9 @@ DOCUMENTED_PREFIXES = (
     "dlrover_tpu_mfu",
     "dlrover_tpu_step_phase_",
     "dlrover_tpu_profile_",
+    # parallel persist / verified restore (DESIGN.md §20): the "restore
+    # after shrinking the job" runbook keys on the ckpt family
+    "dlrover_tpu_ckpt_",
 )
 
 # label names that are themselves an operator contract (dashboards and

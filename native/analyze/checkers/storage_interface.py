@@ -1,0 +1,124 @@
+"""Rule ``storage-interface``: every ``CheckpointStorage`` subclass
+implements the full backend contract.
+
+The checkpoint layer (DESIGN.md §20) treats storage as pluggable: the
+agent persister, the integrity verifier and the topology-changing
+restore all dispatch through the ``CheckpointStorage`` interface, and a
+backend that silently lacks one of the six abstract operations fails at
+the worst possible time — inside a recovery path, on the first restore
+after a shrink. Python's ABC machinery only raises at INSTANTIATION,
+which for the agent-side persister happens after a crash, via
+``build_storage`` reflection — far from the author's test run. This
+rule moves that failure to lint time: any class that (transitively,
+within the project) inherits ``CheckpointStorage`` must define or
+inherit ``write``/``read``/``exists``/``listdir``/``makedirs``/
+``delete``. The ranged/chunked operations (``read_range``, ``size``,
+``write_parallel``) have correct whole-blob defaults in the base class
+and are deliberately not required.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from native.analyze.core import (
+    Checker,
+    Finding,
+    Module,
+    Project,
+    register,
+)
+
+BASE_NAME = "CheckpointStorage"
+REQUIRED = ("write", "read", "exists", "listdir", "makedirs", "delete")
+
+
+def _class_info(project: Project) -> dict[str, tuple[Module, ast.ClassDef,
+                                                     list[str], set[str]]]:
+    """qualified class name -> (module, node, resolved base paths,
+    defined method names). Classes are also keyed by their bare name so
+    same-module inheritance resolves without imports."""
+    info: dict = {}
+    for module in project.modules:
+        mod_path = module.relpath[:-3].replace("/", ".")
+        for node in module.classes():
+            bases = []
+            for base in node.bases:
+                q = module.qualname(base)
+                if q:
+                    bases.append(q)
+            methods = {
+                item.name for item in node.body
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))
+            }
+            entry = (module, node, bases, methods)
+            info[f"{mod_path}.{node.name}"] = entry
+            info.setdefault(node.name, entry)
+    return info
+
+
+@register
+class StorageInterfaceChecker(Checker):
+    rule = "storage-interface"
+    description = ("CheckpointStorage subclasses define or inherit the "
+                   "full abstract backend interface (write/read/exists/"
+                   "listdir/makedirs/delete)")
+    hint = ("implement the missing methods (or inherit a complete "
+            "backend); the checkpoint layer reaches every one of them "
+            "through build_storage reflection inside recovery paths, "
+            "where an AttributeError costs the restore")
+
+    def check(self, project: Project) -> list[Finding]:
+        info = _class_info(project)
+        findings: list[Finding] = []
+        for name, (module, node, bases, _methods) in info.items():
+            if "." not in name:
+                continue  # bare-name alias entries
+            if node.name == BASE_NAME:
+                continue
+            chain = self._storage_chain(name, info)
+            if chain is None:
+                continue
+            inherited: set[str] = set()
+            for link in chain:
+                entry = info.get(link)
+                if entry is None or entry[1].name == BASE_NAME:
+                    # the ABC's own abstract stubs are declarations,
+                    # not implementations — they must not satisfy the
+                    # contract for a subclass
+                    continue
+                inherited |= entry[3]
+            missing = [m for m in REQUIRED if m not in inherited]
+            if missing:
+                findings.append(self.finding(
+                    module, node,
+                    f"storage backend {node.name} is missing "
+                    f"{', '.join(missing)} — the CheckpointStorage "
+                    "contract the recovery paths dispatch through",
+                ))
+        return findings
+
+    def _storage_chain(self, name: str, info: dict) -> list[str] | None:
+        """The project-visible inheritance chain from ``name`` down to
+        CheckpointStorage (inclusive of ``name``), or None when the
+        class is not a storage backend."""
+        seen: set[str] = set()
+        chain: list[str] = []
+
+        def walk(n: str) -> bool:
+            if n in seen:
+                return False
+            seen.add(n)
+            entry = info.get(n)
+            if entry is None:
+                # unknown base: a storage subclass only if the name
+                # itself says so
+                return n == BASE_NAME or n.endswith(f".{BASE_NAME}")
+            chain.append(n)
+            if entry[1].name == BASE_NAME:
+                return True
+            return any(walk(b) for b in entry[2])
+
+        hit = walk(name)
+        return chain if hit else None

@@ -583,6 +583,82 @@ def test_metric_name_clean_twin(tmp_path):
     assert _run(root, "metric-name").findings == []
 
 
+# ---------------------------------------------------------- storage-interface
+
+
+STORAGE_BAD = """\
+from dlrover_tpu.common.storage import CheckpointStorage
+
+
+class HoleyStorage(CheckpointStorage):  # VIOLATION
+    def write(self, content, path): ...
+    def read(self, path): ...
+    def exists(self, path): ...
+    def listdir(self, path): ...
+    def makedirs(self, path): ...
+"""
+
+STORAGE_CLEAN = """\
+from dlrover_tpu.common.storage import CheckpointStorage
+
+
+class BlobStorage(CheckpointStorage):
+    def write(self, content, path): ...
+    def read(self, path): ...
+    def exists(self, path): ...
+    def listdir(self, path): ...
+    def makedirs(self, path): ...
+    def delete(self, path): ...
+
+
+class CachedBlobStorage(BlobStorage):
+    # inherits the full contract; overriding a subset is fine
+    def read(self, path): ...
+
+
+class NotAStorage:
+    # no CheckpointStorage ancestry: the rule must ignore it entirely
+    def write(self, content, path): ...
+"""
+
+
+def test_storage_interface_detects_missing_method(tmp_path):
+    root = _project(tmp_path, {"mod.py": STORAGE_BAD})
+    result = _run(root, "storage-interface")
+    assert len(result.findings) == 1
+    f = result.findings[0]
+    assert f.line == _marked_line(STORAGE_BAD)
+    assert "delete" in f.message and "HoleyStorage" in f.message
+
+
+def test_storage_interface_clean_subclass_and_inheritance(tmp_path):
+    root = _project(tmp_path, {"mod.py": STORAGE_CLEAN})
+    result = _run(root, "storage-interface")
+    assert result.findings == []
+
+
+def test_storage_interface_abstract_stubs_do_not_satisfy(tmp_path):
+    """A same-project ABC twin: its own stub defs are declarations, so
+    a subclass defining nothing must still flag every required op."""
+    src = """\
+class CheckpointStorage:
+    def write(self, content, path): ...
+    def read(self, path): ...
+    def exists(self, path): ...
+    def listdir(self, path): ...
+    def makedirs(self, path): ...
+    def delete(self, path): ...
+
+
+class LazyStorage(CheckpointStorage):  # VIOLATION
+    pass
+"""
+    root = _project(tmp_path, {"mod.py": src})
+    result = _run(root, "storage-interface")
+    assert len(result.findings) == 1
+    assert result.findings[0].line == _marked_line(src)
+
+
 # ------------------------------------------------------------------- baseline
 
 
@@ -701,12 +777,13 @@ def test_analyzer_clean_on_package():
         assert "TODO" not in entry.justification, entry.key
 
 
-def test_all_seven_rules_registered():
+def test_all_eight_rules_registered():
     from native.analyze import CHECKERS
 
     assert set(CHECKERS) == {
         "aot-launder", "atomic-write", "lock-discipline", "env-registry",
         "rpc-contract", "journal-span", "metric-name",
+        "storage-interface",
     }
 
 
