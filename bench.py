@@ -185,6 +185,146 @@ def _train_one(extra: dict, prefix: str, model: str, batch: int, seq: int,
         )
 
 
+def _mpmd_leg(extra: dict, prefix: str, model: str, batch: int, seq: int,
+              steps: int = 3, stages: int = 2, microbatches: int = 4
+              ) -> None:
+    """MPMD pipeline rider beside the MFU headline (DESIGN.md §21):
+    build the per-stage runtime, run a few steps, and report the
+    measured 1F1B schedule bubble against its bound plus the per-stage
+    compile and ZeRO optimizer-sharding evidence. Needs >= ``stages``
+    devices (on the single-chip TPU bench host only the bound is
+    emitted)."""
+    import dataclasses as _dc
+
+    import jax
+    import optax
+
+    from dlrover_tpu.models import transformer as tfm
+    from dlrover_tpu.parallel import strategy as strat_lib
+    from dlrover_tpu.parallel.pipeline import bubble_fraction
+
+    cfg = tfm.CONFIGS[model]
+    extra[f"{prefix}bubble_frac_bound"] = round(
+        bubble_fraction(stages, microbatches), 4)
+    if len(jax.devices()) < stages:
+        extra[f"{prefix}mpmd_note"] = (
+            f"measured leg needs >= {stages} devices; bound only"
+        )
+        return
+    from dlrover_tpu.parallel.mpmd import MpmdTrain
+
+    cfg = _dc.replace(cfg, dtype="float32")
+    seq = min(cfg.max_seq_len, seq)
+    per = len(jax.devices()) // stages
+    step_batch = microbatches * per * max(
+        1, batch // (microbatches * per))
+    mt = MpmdTrain(
+        cfg, strat_lib.mpmd(stages), optax.adamw(1e-4),
+        num_stages=stages, microbatches=microbatches, seq=seq,
+        step_batch=step_batch,
+    )
+    state = mt.init(jax.random.PRNGKey(0))
+    tokens = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (1, step_batch, seq + 1), dtype=np.int32
+    )
+    batch_dev = jax.device_put({"tokens": tokens}, mt.batch_sharding)
+    losses = []
+    t0 = time.monotonic()
+    for _ in range(steps):
+        state, metrics = mt.step(state, batch_dev)
+        losses.append(float(jax.device_get(metrics["loss"])))
+    step_s = (time.monotonic() - t0) / steps
+    by0 = mt.opt_bytes[0]
+    extra.update({
+        f"{prefix}bubble_frac": round(mt.last_bubble_frac, 4),
+        f"{prefix}bubble_le_bound":
+            mt.last_bubble_frac <= mt.bubble_bound + 1e-9,
+        f"{prefix}stage_compile_s": round(
+            max(p.compile_seconds for p in mt.stages), 2),
+        f"{prefix}stage_compile_warm":
+            bool(mt.cache_hit),
+        f"{prefix}mpmd_step_time_s": round(step_s, 4),
+        f"{prefix}mpmd_loss": round(losses[-1], 4),
+        # ZeRO weight-update sharding evidence: optimizer bytes per
+        # device, sharded vs replicated counterfactual
+        f"{prefix}opt_bytes_sharded": by0["sharded"],
+        f"{prefix}opt_bytes_replicated": by0["replicated"],
+    })
+
+
+def _stage_recompile_leg(extra: dict) -> None:
+    """Per-stage recompile evidence beside the goodput headline
+    (DESIGN.md §21): cold-build the MPMD stage programs into a
+    hermetic cache, evict ONE stage's artifacts (= that stage's
+    replacement trainer lost its local cache), rebuild, and assert the
+    journal shows cold ``pipeline_stage_compile`` entries for exactly
+    that stage while the other P−1 hit the cache."""
+    import dataclasses as _dc
+    import json as _json
+
+    import jax
+    import optax
+
+    if len(jax.devices()) < 2:
+        extra["goodput_stage_recompile_note"] = "needs >= 2 devices"
+        return
+    import glob as _glob
+
+    from dlrover_tpu.models import transformer as tfm
+    from dlrover_tpu.parallel import compile_cache as cc
+    from dlrover_tpu.parallel import strategy as strat_lib
+    from dlrover_tpu.parallel.mpmd import MpmdTrain
+
+    cfg = _dc.replace(tfm.CONFIGS["tiny"], n_layers=4, dtype="float32")
+    work = tempfile.mkdtemp(prefix="bench_mpmd_recompile_")
+    old_cache = os.environ.get("DLROVER_TPU_COMPILE_CACHE_DIR")
+    old_journal = os.environ.get("DLROVER_TPU_JOURNAL_DIR")
+    os.environ["DLROVER_TPU_COMPILE_CACHE_DIR"] = os.path.join(
+        work, "aot")
+    os.environ["DLROVER_TPU_JOURNAL_DIR"] = os.path.join(work, "jr")
+    try:
+        def build():
+            t0 = time.monotonic()
+            mt = MpmdTrain(
+                cfg, strat_lib.mpmd(2), optax.sgd(1e-2), num_stages=2,
+                microbatches=4, seq=32, step_batch=16,
+            )
+            return mt, time.monotonic() - t0
+
+        _, cold_s = build()
+        n_events = sum(1 for _ in open(
+            os.path.join(work, "jr", "events.jsonl")))
+        for f in _glob.glob(
+                os.path.join(cc.default_local_dir(), "*pp0of2*")):
+            os.unlink(f)
+        mt, rebuild_s = build()
+        events = [
+            _json.loads(line) for line in open(
+                os.path.join(work, "jr", "events.jsonl"))
+        ][n_events:]
+        events = [e for e in events
+                  if e["name"] == "pipeline_stage_compile"]
+        cold_stages = sorted({e["stage"] for e in events
+                              if not e["hit"]})
+        warm_stages = sorted({e["stage"] for e in events if e["hit"]})
+        extra.update({
+            "goodput_stage_cold_build_s": round(cold_s, 2),
+            "goodput_stage_rebuild_s": round(rebuild_s, 2),
+            "goodput_stage_recompile_cold_stages": cold_stages,
+            "goodput_stage_recompile_warm_stages": warm_stages,
+            # THE assertion: a one-stage failure recompiles one stage
+            "goodput_stage_recompile_only_failed":
+                cold_stages == [0] and warm_stages == [1],
+        })
+    finally:
+        for key, old in (("DLROVER_TPU_COMPILE_CACHE_DIR", old_cache),
+                         ("DLROVER_TPU_JOURNAL_DIR", old_journal)):
+            if old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = old
+
+
 def bench_train_step(extra: dict) -> None:
     """Training MFU. Headline geometry is gpt2-medium (d_model=1024 —
     compute-bound on the MXU: bf16 matmul chains reach 0.76+ utilization
@@ -201,6 +341,8 @@ def bench_train_step(extra: dict) -> None:
                    steps=int(os.environ.get("BENCH_STEPS", "5")),
                    cfg_overrides=dict(remat_scan=True,
                                       remat_policy="save_attn"))
+        _mpmd_leg(extra, "", os.environ.get("BENCH_MODEL", "tiny"),
+                  batch=16, seq=32)
         return
 
     # Headline FIRST so a stage deadline can only cost the secondary.
@@ -268,6 +410,16 @@ def bench_train_step(extra: dict) -> None:
             extra["mfu_large"] = extra.get("large_mfu")
         except Exception as e:  # noqa: BLE001 - rider geometry
             extra["mfu_large_error"] = f"{type(e).__name__}: {e}"[:300]
+        try:
+            # MPMD schedule evidence beside the large headline (the
+            # single-chip bench host emits the 1F1B bound; multi-chip
+            # hosts run the measured leg)
+            _mpmd_leg(extra, "large_", "gpt2-large",
+                      batch=int(os.environ.get("BENCH_LARGE_BATCH",
+                                               "32")),
+                      seq=int(os.environ.get("BENCH_SEQ", "1024")))
+        except Exception as e:  # noqa: BLE001 - rider leg
+            extra["large_mpmd_error"] = f"{type(e).__name__}: {e}"[:300]
 
     # gpt2-small secondary. NOTE: the r03 "bandwidth-bound ceiling"
     # analysis (0.393 MFU, ~85% of the d_model=768 matmul roofline) was
@@ -1107,6 +1259,13 @@ def bench_goodput(extra: dict, stage_budget_s: float = 900.0) -> None:
         if f"goodput_sys_{k}" in extra:
             name = k if k.startswith("goodput") else f"goodput_{k}"
             extra[name] = extra[f"goodput_sys_{k}"]
+    try:
+        # per-stage recompile evidence (DESIGN.md §21): an MPMD
+        # single-stage failure must cold-compile ONLY the failed stage
+        _stage_recompile_leg(extra)
+    except Exception as e:  # noqa: BLE001 - rider leg
+        extra["goodput_stage_recompile_error"] = (
+            f"{type(e).__name__}: {e}"[:300])
 
 
 def bench_goodput_lowrate(extra: dict,
@@ -1657,6 +1816,8 @@ STAGES = [
 HEADLINE_KEYS = [
     "goodput", "goodput_at_baseline_rate", "goodput_lowrate_raw",
     "goodput_lowrate_failures_per_hr", "mfu", "mfu_medium", "mfu_large",
+    "bubble_frac", "stage_compile_s",
+    "goodput_stage_recompile_only_failed",
     "ckpt_save_block_s", "ckpt_restore_s", "ckpt1b_save_block_s",
     "ckpt1b_copy_s", "ckpt1b_restore_s", "ckpt1b_persist_parallel_s",
     "ckpt1b_restore_parallel_s", "serving_toks_per_s",

@@ -223,21 +223,54 @@ class ElasticAgent:
             logger.warning("compile-cache coverage query failed: %s", e)
             return
         covered = bool(resp.covered)
+        stage_execs = self._stage_coverage(len(world.world),
+                                           world.total_devices)
         _reshard_choices.labels(str(covered).lower()).inc()
         if covered:
             get_journal().emit(
                 "reshard", nodes=len(world.world),
                 devices=world.total_devices,
                 executables=resp.executables,
+                stage_executables=stage_execs,
                 shrink=bool(world.reshard),
                 storage_step=self._verified_storage_step(),
             )
             logger.info(
                 "recovery is a reshard event: %d pre-compiled "
-                "executable(s) for %d nodes / %d devices%s",
+                "executable(s) for %d nodes / %d devices%s%s",
                 resp.executables, len(world.world), world.total_devices,
+                f" ({stage_execs} per-stage pipeline programs — the "
+                "incarnation reloads per stage)" if stage_execs else "",
                 " (membership shrink)" if world.reshard else "",
             )
+
+    def _stage_coverage(self, nodes: int, total_devices: int) -> int:
+        """Per-stage MPMD program coverage for this world (DESIGN.md
+        §21): stage keys carry a ``pp`` marker right after the topology
+        tag (``compile_cache.stage_key``), so one prefix scan counts
+        them. An MPMD job's recovery is per-stage — this is the
+        evidence that only the affected stage will compile cold. Note
+        stage submeshes are a SLICE of the world, so the scan uses the
+        per-stage device count when the world divides evenly; 0 simply
+        means "not an MPMD job" and is not journaled as coverage."""
+        from dlrover_tpu.master.kv_store import topology_tag
+
+        count = 0
+        seen = set()
+        for per_stage_devices in {total_devices, *(
+            total_devices // p for p in (2, 4, 8)
+            if total_devices % p == 0 and total_devices // p >= 1
+        )}:
+            prefix = topology_tag(per_stage_devices, nodes) + "/pp"
+            if prefix in seen:
+                continue
+            seen.add(prefix)
+            try:
+                resp = self._client.compile_cache_query(prefix)
+                count += int(resp.executables)
+            except (ConnectionError, RuntimeError, OSError):
+                return 0
+        return count
 
     def _verified_storage_step(self) -> int:
         """Newest fully-verified checkpoint step in storage (-1 = none

@@ -281,12 +281,19 @@ class SharedMemoryHandler:
         import jax.numpy as jnp
 
         total = 0
-        groups: dict[str, list[tuple[str, Any]]] = {}
+        groups: dict[tuple, list[tuple[str, Any]]] = {}
         for name, leaf in named:
             if not isinstance(leaf, jax.Array):
                 return None
             total += leaf.nbytes
-            groups.setdefault(str(leaf.dtype), []).append((name, leaf))
+            # group by (dtype, device set): an MPMD state's stages live
+            # on disjoint submeshes and one jitted concat cannot span
+            # device sets — per-group packing keeps the fast path
+            devs = tuple(sorted(
+                d.id for d in getattr(leaf.sharding, "device_set", ())
+            ))
+            groups.setdefault((str(leaf.dtype), devs),
+                              []).append((name, leaf))
         if total > self.PACK_LIMIT_BYTES:
             return None
         if self._pack_fn is None:
@@ -298,13 +305,13 @@ class SharedMemoryHandler:
         out: dict[str, np.ndarray] = {}
         try:
             flats = {
-                dt: self._pack_fn([leaf for _, leaf in items])
-                for dt, items in groups.items()
+                key: self._pack_fn([leaf for _, leaf in items])
+                for key, items in groups.items()
             }
             for f in flats.values():
                 f.copy_to_host_async()
-            for dt, items in groups.items():
-                host = np.asarray(jax.device_get(flats[dt]))
+            for key, items in groups.items():
+                host = np.asarray(jax.device_get(flats[key]))
                 off = 0
                 for name, leaf in items:
                     n = int(np.prod(leaf.shape or (1,)))

@@ -385,87 +385,27 @@ def prefix_lm_attention(q, k, v, prefix_len: jax.Array, *,
 AttentionFn = Callable[..., jax.Array]
 
 
-def forward(
-    params: Params,
-    tokens: jax.Array,
-    cfg: TransformerConfig,
-    attention_fn: AttentionFn | None = None,
-    constrain: Callable[[jax.Array, tuple], jax.Array] | None = None,
-    prefix_len: jax.Array | None = None,
-) -> jax.Array:
-    """Token ids [B, S] -> logits [B, S, vocab]."""
-    return forward_with_aux(
-        params, tokens, cfg, attention_fn=attention_fn,
-        constrain=constrain, prefix_len=prefix_len,
-    )[0]
-
-
-def forward_with_aux(
-    params: Params,
-    tokens: jax.Array,
+def make_layer_fn(
     cfg: TransformerConfig,
     attention_fn: AttentionFn | None = None,
     constrain: Callable[[jax.Array, tuple], jax.Array] | None = None,
     mask: jax.Array | None = None,
-    return_hidden: bool = False,
-    inputs_embeds: jax.Array | None = None,
-    prefix_len: jax.Array | None = None,
-) -> tuple[jax.Array, jax.Array]:
-    """(logits, aux_loss). aux is the MoE load-balancing term (0 when
-    the model has no experts). ``return_hidden`` yields the final normed
-    hidden states instead of logits (value heads, probes).
+) -> Callable[[jax.Array, Any], tuple[jax.Array, jax.Array]]:
+    """One transformer block as a reusable ``(x, w) -> (x, aux)``.
 
-    ``constrain(x, logical_axes)`` optionally pins activation shardings
-    (supplied by the strategy layer); identity when absent.
-
-    ``inputs_embeds`` [B, S, d_model] bypasses the token embedding (and
-    the gpt2 position add) — the caller owns the front end. This is how
-    non-token modalities (ViT patches, models/vision.py) reuse the block
-    stack with every strategy unchanged.
+    This IS the scan body of :func:`forward_with_aux` (hoisted to module
+    level so the MPMD runtime, ``parallel/mpmd.py``, can build per-stage
+    programs from the exact same math — any divergence here would break
+    the cross-schedule loss-equivalence bound ``RTOL_CROSS_LAYOUT``).
+    ``w`` is one layer's weight dict (a single slice of the stacked
+    ``params["layers"]``); ``aux`` is the MoE load-balancing increment
+    (0 for dense FFNs).
     """
     c = cfg
     dt = jnp.dtype(c.dtype)
     pin = constrain or (lambda x, a: x)
-    if c.prefix_lm:
-        if attention_fn is not None and attention_fn is not dense_attention:
-            raise NotImplementedError(
-                "prefix_lm needs the dense attention path (the sparse "
-                "kernels have no per-row prefix mask); leave "
-                "cfg.attention='dense'"
-            )
-        if c.pipeline_stages > 1:
-            raise NotImplementedError(
-                "prefix_lm + pipeline: the per-row prefix mask is "
-                "closed over at full-batch shape, but pipeline stages "
-                "see microbatches — the shapes cannot line up"
-            )
-        if prefix_len is None:
-            raise ValueError(
-                "cfg.prefix_lm=True but the batch carries no "
-                "'prefix_len' [B] array"
-            )
-        attn = partial(prefix_lm_attention, prefix_len=prefix_len)
-    else:
-        attn = attention_fn or dense_attention
-
-    if inputs_embeds is not None:
-        B, S = inputs_embeds.shape[:2]
-        x = pin(inputs_embeds.astype(dt), ("batch", "sequence", "embed"))
-    else:
-        B, S = tokens.shape
-        # pin the gather result BEFORE the position add: with the table
-        # sharded (vocab x embed) and tokens (batch x sequence), the
-        # partitioner otherwise leaves the gather's layout ambiguous and
-        # falls back to involuntary full rematerialization of the embedding
-        # (seen in the r02 4D dryrun tail)
-        x = pin(params["embed"].astype(dt)[tokens],
-                ("batch", "sequence", "embed"))
-        if c.variant == "gpt2":
-            x = x + params["pos_embed"].astype(dt)[:S][None]
-            x = pin(x, ("batch", "sequence", "embed"))
-
+    attn = attention_fn or dense_attention
     n_rep = c.n_heads // c.n_kv_heads
-
     # muP: attention logits scale 1/d_head instead of 1/sqrt(d_head) —
     # pre-scaling q composes with the attention impl's 1/sqrt(d)
     mup_q_scale = (
@@ -548,6 +488,131 @@ def forward_with_aux(
         x = pin(x + ff, ("batch", "sequence", "embed"))
         return x, aux
 
+    return layer
+
+
+def embed_tokens(
+    params: Params,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    constrain: Callable[[jax.Array, tuple], jax.Array] | None = None,
+) -> jax.Array:
+    """Token ids [B, S] -> embedded activations [B, S, E] (the model's
+    front end, shared by :func:`forward_with_aux` and the MPMD stage-0
+    program)."""
+    c = cfg
+    dt = jnp.dtype(c.dtype)
+    pin = constrain or (lambda x, a: x)
+    # pin the gather result BEFORE the position add: with the table
+    # sharded (vocab x embed) and tokens (batch x sequence), the
+    # partitioner otherwise leaves the gather's layout ambiguous and
+    # falls back to involuntary full rematerialization of the embedding
+    # (seen in the r02 4D dryrun tail)
+    x = pin(params["embed"].astype(dt)[tokens],
+            ("batch", "sequence", "embed"))
+    if c.variant == "gpt2":
+        x = x + params["pos_embed"].astype(dt)[:tokens.shape[1]][None]
+        x = pin(x, ("batch", "sequence", "embed"))
+    return x
+
+
+def final_norm(params: Params, x: jax.Array,
+               cfg: TransformerConfig) -> jax.Array:
+    """The post-stack norm (``ln_f``): the model's tail starts here."""
+    return _norm(x, params["ln_f"], params.get("ln_f_b"), cfg.variant)
+
+
+def lm_logits(params: Params, hidden: jax.Array,
+              cfg: TransformerConfig) -> jax.Array:
+    """Final-normed hidden [B, S, E] -> fp32 logits [B, S, vocab]."""
+    dt = jnp.dtype(cfg.dtype)
+    logits = jnp.einsum("bse,ev->bsv", hidden, params["lm_head"].astype(dt))
+    if cfg.mup_base_width:
+        # muP readout multiplier keeps logit scale width-invariant
+        logits = logits * (cfg.mup_base_width / cfg.d_model)
+    return logits.astype(jnp.float32)
+
+
+def token_ce(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean next-token cross entropy (the unmasked branch of
+    :func:`loss_fn`, shared with the MPMD last-stage program — a mean
+    over equal-size microbatches composes to the full-batch mean)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    attention_fn: AttentionFn | None = None,
+    constrain: Callable[[jax.Array, tuple], jax.Array] | None = None,
+    prefix_len: jax.Array | None = None,
+) -> jax.Array:
+    """Token ids [B, S] -> logits [B, S, vocab]."""
+    return forward_with_aux(
+        params, tokens, cfg, attention_fn=attention_fn,
+        constrain=constrain, prefix_len=prefix_len,
+    )[0]
+
+
+def forward_with_aux(
+    params: Params,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    attention_fn: AttentionFn | None = None,
+    constrain: Callable[[jax.Array, tuple], jax.Array] | None = None,
+    mask: jax.Array | None = None,
+    return_hidden: bool = False,
+    inputs_embeds: jax.Array | None = None,
+    prefix_len: jax.Array | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """(logits, aux_loss). aux is the MoE load-balancing term (0 when
+    the model has no experts). ``return_hidden`` yields the final normed
+    hidden states instead of logits (value heads, probes).
+
+    ``constrain(x, logical_axes)`` optionally pins activation shardings
+    (supplied by the strategy layer); identity when absent.
+
+    ``inputs_embeds`` [B, S, d_model] bypasses the token embedding (and
+    the gpt2 position add) — the caller owns the front end. This is how
+    non-token modalities (ViT patches, models/vision.py) reuse the block
+    stack with every strategy unchanged.
+    """
+    c = cfg
+    dt = jnp.dtype(c.dtype)
+    pin = constrain or (lambda x, a: x)
+    if c.prefix_lm:
+        if attention_fn is not None and attention_fn is not dense_attention:
+            raise NotImplementedError(
+                "prefix_lm needs the dense attention path (the sparse "
+                "kernels have no per-row prefix mask); leave "
+                "cfg.attention='dense'"
+            )
+        if c.pipeline_stages > 1:
+            raise NotImplementedError(
+                "prefix_lm + pipeline: the per-row prefix mask is "
+                "closed over at full-batch shape, but pipeline stages "
+                "see microbatches — the shapes cannot line up"
+            )
+        if prefix_len is None:
+            raise ValueError(
+                "cfg.prefix_lm=True but the batch carries no "
+                "'prefix_len' [B] array"
+            )
+        attn = partial(prefix_lm_attention, prefix_len=prefix_len)
+    else:
+        attn = attention_fn or dense_attention
+
+    if inputs_embeds is not None:
+        x = pin(inputs_embeds.astype(dt), ("batch", "sequence", "embed"))
+    else:
+        x = embed_tokens(params, tokens, cfg, constrain=constrain)
+
+    layer = make_layer_fn(cfg, attention_fn=attn, constrain=constrain,
+                          mask=mask)
+
     if c.remat_interval > 1 and (not c.remat_scan or c.pipeline_stages > 1):
         # would be silently ignored below — reject so sweeps can't
         # attribute numbers to an interleaving that never ran
@@ -621,14 +686,10 @@ def forward_with_aux(
             unroll=max(1, c.scan_unroll),
         )
 
-    x = _norm(x, params["ln_f"], params.get("ln_f_b"), c.variant)
+    x = final_norm(params, x, c)
     if return_hidden:
         return x, aux
-    logits = jnp.einsum("bse,ev->bsv", x, params["lm_head"].astype(dt))
-    if c.mup_base_width:
-        # muP readout multiplier keeps logit scale width-invariant
-        logits = logits * (c.mup_base_width / c.d_model)
-    return logits.astype(jnp.float32), aux
+    return lm_logits(params, x, c), aux
 
 
 def resolve_config(cfg: TransformerConfig, strategy) -> TransformerConfig:

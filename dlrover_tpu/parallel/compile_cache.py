@@ -145,6 +145,22 @@ def compile_fingerprint(
     return f"{tag}/{digest}", inputs
 
 
+def stage_key(base_key: str, *, stage: int, num_stages: int, phase: str,
+              interleave: int = 1) -> str:
+    """Per-stage compile-cache key for an MPMD pipeline program
+    (``parallel/mpmd.py``): the stage index, stage count, chunk config
+    and program phase (``fwd``/``bwd``/``update``) ride IN the key —
+    readable in cache listings and scannable by prefix. The ``pp``
+    marker directly after the topology tag lets the agent's reshard
+    decision count per-stage executables with one coverage scan
+    (``<tag>/pp``); ``base_key`` must come from
+    :func:`compile_fingerprint` with the same stage facts in ``extra``
+    (the digest is what actually pins the program)."""
+    tag, digest = base_key.split("/", 1)
+    return (f"{tag}/pp{int(stage)}of{int(num_stages)}"
+            f"v{max(1, int(interleave))}{phase}_{digest}")
+
+
 # ------------------------------------------------------- artifact envelope
 
 
@@ -342,11 +358,30 @@ def launder(tree: Any):
     States produced by jit programs (``compiled.init``, a previous step
     call) are already properly staged; only host-built trees (snapshot
     restore, ``reshard_state`` output) need this.
+
+    Leaves are grouped by device set before the jitted copy: an MPMD
+    state's stages live on disjoint submeshes (``parallel/mpmd.py``)
+    and one jitted program cannot span device sets — each group gets
+    its own copy program, same re-staging guarantee.
     """
     import jax
     import jax.numpy as jnp
 
-    return jax.jit(lambda t: jax.tree.map(jnp.copy, t))(tree)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    groups: dict[tuple, list[int]] = {}
+    for i, leaf in enumerate(leaves):
+        devs = ()
+        if isinstance(leaf, jax.Array):
+            devs = tuple(sorted(
+                d.id for d in getattr(leaf.sharding, "device_set", ())
+            ))
+        groups.setdefault(devs, []).append(i)
+    copy = jax.jit(lambda xs: [jnp.copy(x) for x in xs])
+    out = list(leaves)
+    for idxs in groups.values():
+        for i, copied in zip(idxs, copy([leaves[i] for i in idxs])):
+            out[i] = copied
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 # ---------------------------------------------------------- load-or-compile

@@ -119,6 +119,83 @@ def collective_bytes(hlo_text: str) -> dict[str, float]:
 
 
 @dataclasses.dataclass
+class PipelineSchedule:
+    """Schedule shape of a pipelined candidate, for the bubble + p2p
+    terms of :func:`estimate_step_time`.
+
+    ``kind``:
+
+    - ``"spmd_gpipe"`` / ``"spmd_interleaved"``: the single-program
+      SPMD-roll schedules of ``parallel/pipeline.py`` — every ring step
+      runs in lockstep, so each of the ``vM + P - 1`` slots is paced by
+      the SLOWEST stage (at 1/v of its per-microbatch work when
+      interleaved).
+    - ``"mpmd_1f1b"``: the per-stage-program runtime
+      (``parallel/mpmd.py``) — stages advance independently, so the
+      fill/drain ramp pays each stage's own cost once and steady state
+      is paced only by the slowest stage:
+      ``T = (M - 1) * max_s(t_s) + sum_s(t_s)``.
+
+    ``stage_time_s``: optional per-stage per-microbatch fwd+bwd times
+    for heterogeneous stages; when absent, stages are assumed uniform
+    and derived from the roofline work term. ``activation_bytes``: size
+    of one microbatch's boundary activation — every stage handoff moves
+    it once forward and once backward (the inter-stage p2p wire term
+    the SPMD roll pays as collective-permutes inside the HLO and MPMD
+    pays as explicit device-to-device transfers).
+    """
+
+    kind: str = "spmd_gpipe"
+    num_stages: int = 1
+    num_microbatches: int = 0
+    interleave: int = 1
+    activation_bytes: float = 0.0
+    stage_time_s: tuple = ()
+
+    def shape(self) -> tuple[int, int, int]:
+        P = max(1, int(self.num_stages))
+        M = int(self.num_microbatches) or P
+        v = max(1, int(self.interleave))
+        return P, M, v
+
+
+def pipeline_schedule_time(schedule: PipelineSchedule,
+                           work_s: float) -> tuple[float, float]:
+    """(scheduled_s, bubble_s) for one step whose ideal (bubble-free)
+    per-device work is ``work_s``.
+
+    Uniform stages: every schedule degrades to
+    ``work_s * (1 + (P-1)/(vM))`` — the classic bubble fraction
+    ``(P-1)/(vM+P-1)`` of the total. Heterogeneous stages are where the
+    kinds separate: the lockstep SPMD roll charges every slot at the
+    slowest stage's pace, MPMD 1F1B pays other stages' cost only during
+    fill/drain (the ISSUE's "stages with heterogeneous cost no longer
+    pay the slowest stage's bubble").
+    """
+    P, M, v = schedule.shape()
+    if P <= 1:
+        return work_s, 0.0
+    times = [float(t) for t in (schedule.stage_time_s or ())]
+    if len(times) != P:
+        # ``work_s`` is PER-DEVICE (one stage's work over all M
+        # microbatches under pipeline sharding), so the uniform
+        # per-microbatch stage time is work_s / M
+        times = [work_s / M] * P
+    t_max = max(times)
+    # bubble-free floor: all stages perfectly overlapped, wall time set
+    # by the busiest device
+    ideal = M * t_max
+    if schedule.kind == "mpmd_1f1b":
+        sched = (M - 1) * t_max + sum(times)
+    else:
+        # lockstep SPMD roll: vM + P - 1 ring steps of 1/v-sized work,
+        # each paced by the slowest stage
+        sched = (v * M + P - 1) * t_max / v
+    sched = max(sched, ideal)
+    return sched, sched - ideal
+
+
+@dataclasses.dataclass
 class StepTimeEstimate:
     est_step_s: float = 0.0
     compute_s: float = 0.0
@@ -127,6 +204,11 @@ class StepTimeEstimate:
     dcn_s: float = 0.0
     comm_bytes: float = 0.0
     by_collective: dict = dataclasses.field(default_factory=dict)
+    # schedule-aware terms (0 / "" without a pipeline schedule)
+    bubble_s: float = 0.0
+    bubble_frac: float = 0.0
+    p2p_s: float = 0.0
+    schedule_kind: str = ""
 
 
 def estimate_step_time(
@@ -136,6 +218,7 @@ def estimate_step_time(
     hlo_text: str = "",
     hw: HardwareSpec | None = None,
     dcn_fraction: float = 0.0,
+    schedule: PipelineSchedule | None = None,
 ) -> StepTimeEstimate:
     """Roofline step time from AOT compile artifacts (all per-device).
 
@@ -145,6 +228,14 @@ def estimate_step_time(
     at ICI bandwidth; callers ranking multi-slice candidates over a
     hybrid mesh pass the fraction their mesh layout implies (e.g. the
     dp-over-DCN share from parallel/mesh.py's hybrid builder).
+
+    ``schedule``: pipeline schedule shape. Without it the estimate is
+    schedule-blind (the pre-MPMD behavior, unchanged); with it the work
+    term is stretched by the schedule's fill/drain bubble — lockstep
+    for the SPMD roll, per-stage-independent for MPMD 1F1B — and an
+    explicit inter-stage p2p wire term is charged for the boundary
+    activations (2 crossings per microbatch per boundary: fwd
+    activation + bwd cotangent).
     """
     hw = hw or HardwareSpec.for_device()
     by = collective_bytes(hlo_text) if hlo_text else {}
@@ -153,12 +244,52 @@ def estimate_step_time(
     hbm_s = bytes_accessed / hw.hbm_bps if bytes_accessed else 0.0
     ici_s = comm * (1.0 - dcn_fraction) / hw.ici_bps
     dcn_s = comm * dcn_fraction / hw.dcn_bps
+    work_s = max(compute_s, hbm_s)
+    bubble_s = 0.0
+    bubble_frac = 0.0
+    p2p_s = 0.0
+    kind = ""
+    if schedule is not None and schedule.num_stages > 1:
+        P, M, _v = schedule.shape()
+        kind = schedule.kind
+        work_s, bubble_s = pipeline_schedule_time(schedule, work_s)
+        bubble_frac = bubble_s / work_s if work_s else 0.0
+        # a stage's device sends + receives one boundary activation per
+        # microbatch in each direction (fwd activation, bwd cotangent)
+        p2p_s = 2.0 * M * schedule.activation_bytes / hw.ici_bps
     return StepTimeEstimate(
-        est_step_s=max(compute_s, hbm_s) + ici_s + dcn_s,
+        est_step_s=work_s + ici_s + dcn_s + p2p_s,
         compute_s=compute_s,
         hbm_s=hbm_s,
         ici_s=ici_s,
         dcn_s=dcn_s,
         comm_bytes=comm,
         by_collective=by,
+        bubble_s=bubble_s,
+        bubble_frac=bubble_frac,
+        p2p_s=p2p_s,
+        schedule_kind=kind,
     )
+
+
+def rank_schedules(
+    candidates: dict[str, PipelineSchedule],
+    *,
+    flops: float,
+    bytes_accessed: float,
+    hw: HardwareSpec | None = None,
+) -> list[tuple[str, StepTimeEstimate]]:
+    """Rank pipeline schedule candidates for ONE model geometry,
+    fastest first — the MPMD-vs-SPMD gate (``parallel/mpmd.py``'s
+    ``choose_schedule`` and the example's ``--schedule auto`` consume
+    the head). Same constants across candidates, so only the schedule
+    terms separate them."""
+    hw = hw or HardwareSpec.for_device()
+    ranked = [
+        (name,
+         estimate_step_time(flops=flops, bytes_accessed=bytes_accessed,
+                            hw=hw, schedule=sched))
+        for name, sched in candidates.items()
+    ]
+    ranked.sort(key=lambda pair: pair[1].est_step_s)
+    return ranked

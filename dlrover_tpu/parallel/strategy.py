@@ -303,6 +303,32 @@ def pipeline(pipeline_size: int = 2, data_size: int = -1,
     )
 
 
+def mpmd(pipeline_size: int = 2, microbatches: int = 0) -> Strategy:
+    """MPMD pipeline (parallel/mpmd.py): per-stage compiled programs on
+    disjoint device submeshes, host-side 1F1B schedule, ZeRO-sharded
+    weight update per stage (2412.14374 + 2004.13336).
+
+    Unlike the SPMD presets this strategy does NOT describe one mesh:
+    each stage builds its own ``{"data": devices/P}`` submesh and the
+    optimizer state shards over that data axis (``zero1`` semantics per
+    stage). ``mesh_axes`` here is only the batch-sharding world of
+    stage 0. Per-stage programs are what buy per-stage elastic
+    recovery: a stage failure recompiles/reloads only that stage.
+    """
+    return Strategy(
+        name="mpmd",
+        mesh_axes={"data": -1},
+        rules=[["batch", "data"]],
+        extra={
+            "mpmd": True,
+            "zero1": True,
+            "pipeline_stages": pipeline_size,
+            "pipeline_microbatches": microbatches,
+            "pipeline_interleave": 1,
+        },
+    )
+
+
 def mixed(pipeline_size: int = 2, tensor_size: int = 2,
           data_size: int = -1, microbatches: int = 0,
           remat: str = "none", interleave: int = 1) -> Strategy:
@@ -354,6 +380,7 @@ PRESETS = {
     "ulysses": ulysses,
     "sliding_window": sliding_window,
     "pipeline": pipeline,
+    "mpmd": mpmd,
     "mixed": mixed,
     "moe": moe,
 }

@@ -2,6 +2,7 @@ from dlrover_tpu.trainer.train_step import (  # noqa: F401
     CompiledTrain,
     TrainState,
     compile_train,
+    zero_shard_specs,
 )
 from dlrover_tpu.trainer.elastic_trainer import ElasticTrainer  # noqa: F401
 from dlrover_tpu.trainer.sharding_client import (  # noqa: F401

@@ -71,9 +71,20 @@ class BatchAssembler:
 
 
 class ElasticTrainer:
+    """Drives a compiled train step at a fixed global batch.
+
+    ``compiled`` is either a ``CompiledTrain`` (one SPMD program) or
+    any duck-type of it — the MPMD pipeline runtime
+    (``parallel.mpmd.MpmdTrain``) plugs in here unchanged: its ``mesh``
+    is stage 0's submesh (whose data axis is the batch-sharding world),
+    its ``step`` is the host-side 1F1B scheduler, and its per-stage
+    metrics (``dlrover_tpu_pipeline_*``) ride the same snapshot pushes
+    as everything else.
+    """
+
     def __init__(
         self,
-        compiled: CompiledTrain,
+        compiled: "CompiledTrain | Any",
         global_batch_size: int,
         micro_batch_size: int,
         report_step_interval: int = 1,

@@ -81,6 +81,37 @@ def derive_opt_specs(optimizer, params: Any, param_specs: Any) -> Any:
     )
 
 
+def zero_shard_specs(specs: Any, example: Any, mesh: Mesh) -> Any:
+    """ZeRO-style cross-replica sharding (Xu et al., 2004.13336): give
+    every REPLICATED leaf's first divisible dim to the mesh's data axes,
+    leaving already-sharded leaves untouched. Used for optimizer-state
+    sharding by the ``zero1``/``zero2`` strategies here and by the MPMD
+    per-stage weight-update programs (``parallel/mpmd.py``) — the math
+    is identical to replicated (a layout choice, not an algorithm
+    change); XLA derives the update all-gather from the out shardings.
+    ``specs``/``example`` are same-structure trees of PartitionSpec and
+    array(-shape) leaves."""
+    z_axes = batch_axes(mesh)
+    z_n = 1
+    for a in z_axes:
+        z_n *= mesh.shape[a]
+    z_axis = z_axes if len(z_axes) > 1 else (
+        z_axes[0] if z_axes else None)
+
+    def _spec(spec, leaf):
+        if spec != PartitionSpec() or leaf.ndim == 0 or z_axis is None:
+            return spec
+        for d, size in enumerate(leaf.shape):
+            if size % z_n == 0 and size >= z_n:
+                return PartitionSpec(*([None] * d), z_axis)
+        return spec
+
+    return jax.tree.map(
+        _spec, specs, example,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )
+
+
 @dataclasses.dataclass
 class CompiledTrain:
     """Everything a training loop needs, pre-sharded and jitted."""
@@ -159,25 +190,7 @@ def compile_train(
         # params stay replicated — each leaf's first divisible dim gets
         # the axis; the update all-gather comes from out_shardings. The
         # math is identical to dp (layout, not algorithm).
-        z_axes = batch_axes(mesh)
-        z_n = 1
-        for a in z_axes:
-            z_n *= mesh.shape[a]
-        z_axis = z_axes if len(z_axes) > 1 else (
-            z_axes[0] if z_axes else None)
-
-        def _zero1_spec(spec, leaf):
-            if spec != PartitionSpec() or leaf.ndim == 0 or z_axis is None:
-                return spec
-            for d, size in enumerate(leaf.shape):
-                if size % z_n == 0 and size >= z_n:
-                    return PartitionSpec(*([None] * d), z_axis)
-            return spec
-
-        opt_specs = jax.tree.map(
-            _zero1_spec, opt_specs, example.opt_state,
-            is_leaf=lambda x: isinstance(x, PartitionSpec),
-        )
+        opt_specs = zero_shard_specs(opt_specs, example.opt_state, mesh)
     state_shardings = TrainState(
         step=NamedSharding(mesh, PartitionSpec()),
         params=param_shardings,
@@ -271,10 +284,7 @@ def compile_train(
         # the same first-divisible-dim rule the moments used, so a
         # zero2 strategy with sharded params keeps grads and moments on
         # one layout instead of resharding between them
-        mu_specs = jax.tree.map(
-            _zero1_spec, param_specs, example.params,
-            is_leaf=lambda x: isinstance(x, PartitionSpec),
-        )
+        mu_specs = zero_shard_specs(param_specs, example.params, mesh)
 
         def grad_constraint(grads):
             return jax.tree.map(

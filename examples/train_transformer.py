@@ -42,6 +42,13 @@ def parse_args(argv=None):
                         "default)")
     p.add_argument("--strategy", default="dp",
                    help="strategy preset name (parallel/strategy.py)")
+    p.add_argument("--schedule", default="spmd",
+                   choices=["spmd", "mpmd", "auto"],
+                   help="pipeline runtime: spmd = the single-program "
+                        "roll (parallel/pipeline.py), mpmd = per-stage "
+                        "programs + host 1F1B (parallel/mpmd.py, "
+                        "per-stage compile cache + recovery), auto = "
+                        "cost-model gate (parallel/cost_model.py)")
     p.add_argument("--objective", default="clm", choices=["clm", "mlm"],
                    help="clm: causal next-token; mlm: BERT-class "
                         "bidirectional masked-LM (models/encoder.py)")
@@ -176,17 +183,49 @@ def main(argv=None) -> int:
         print(f"[trainer] auto strategy: {strategy.name}", flush=True)
     else:
         strategy = PRESETS[args.strategy]()
-    mesh = strategy.build_mesh()
-    compiled = compile_train(
-        strategy=strategy,
-        mesh=mesh,
-        loss_fn=loss_for(strategy, mesh),
-        init_params_fn=lambda rng: tfm.init_params(cfg, rng),
-        logical_params=tfm.logical_axes(cfg),
-        optimizer=optax.adamw(args.lr),
-    )
 
-    dp = data_parallel_size(mesh)
+    # ---- schedule resolution (DESIGN.md §21): the MPMD runtime builds
+    # per-stage programs instead of one SPMD step; the "auto" gate asks
+    # the schedule-aware cost model which schedule this geometry favors
+    schedule = args.schedule
+    sx = getattr(strategy, "extra", {}) or {}
+    if sx.get("mpmd"):
+        schedule = "mpmd"
+    pp_stages = int(sx.get("pipeline_stages", 0) or 0) or 2
+    if schedule == "auto":
+        from dlrover_tpu.parallel.mpmd import choose_schedule
+
+        schedule, ests = choose_schedule(
+            cfg, num_stages=pp_stages,
+            step_batch=max(1, args.global_batch), seq=seq,
+            microbatches=int(sx.get("pipeline_microbatches", 0) or 0),
+            interleave=int(sx.get("pipeline_interleave", 1) or 1),
+        )
+        print(f"[trainer] schedule gate picked {schedule} "
+              f"(est step s: { {k: round(v, 6) for k, v in ests.items()} })",
+              flush=True)
+    mpmd_mode = schedule == "mpmd"
+    if mpmd_mode and args.objective == "mlm":
+        raise SystemExit("--schedule mpmd supports the clm objective "
+                         "only (the stage programs are token->CE)")
+
+    if mpmd_mode:
+        # stage submeshes are built by the runtime; dp is stage 0's
+        # data axis (the batch-sharding world)
+        compiled = None
+        mesh = None
+        dp = max(1, len(jax.devices()) // pp_stages)
+    else:
+        mesh = strategy.build_mesh()
+        compiled = compile_train(
+            strategy=strategy,
+            mesh=mesh,
+            loss_fn=loss_for(strategy, mesh),
+            init_params_fn=lambda rng: tfm.init_params(cfg, rng),
+            logical_params=tfm.logical_axes(cfg),
+            optimizer=optax.adamw(args.lr),
+        )
+        dp = data_parallel_size(mesh)
     # honor the master's paral-config suggestion (e.g. OOM -> higher grad
     # accumulation at a fixed global batch) unless the user pinned one
     from dlrover_tpu.agent.config_tuner import ParalConfigReader
@@ -210,7 +249,32 @@ def main(argv=None) -> int:
     # state/batch abstracts come from eval_shape: no compile, no arrays.
     from dlrover_tpu.parallel import compile_cache as cc
 
-    state_abs = jax.eval_shape(compiled.init, jax.random.PRNGKey(0))
+    cache_client = cc.CompileCacheClient()
+    if mpmd_mode:
+        # per-stage programs, each load_or_compile'd under its own
+        # stage fingerprint (DESIGN.md §21) — recovery after a
+        # single-stage failure recompiles only that stage
+        from dlrover_tpu.parallel.mpmd import MpmdTrain
+
+        accum = max(1, args.global_batch // (micro * dp))
+        compiled = MpmdTrain(
+            cfg, strategy, optax.adamw(args.lr),
+            num_stages=pp_stages,
+            microbatches=int(sx.get("pipeline_microbatches", 0) or 0),
+            seq=seq, step_batch=micro * dp, accum=accum,
+            cache=cache_client, num_nodes=ctx.num_nodes,
+            extra_fingerprint={"lr": args.lr,
+                               "objective": args.objective},
+        )
+        mesh = compiled.mesh
+        state_abs = compiled.abstract_state()
+        print(f"[trainer] mpmd runtime: {compiled.num_stages} stages x "
+              f"{compiled.microbatches} microbatches, "
+              f"{'warm' if compiled.cache_hit else 'cold'} stage "
+              f"programs, bubble bound "
+              f"{compiled.bubble_bound:.3f}", flush=True)
+    else:
+        state_abs = jax.eval_shape(compiled.init, jax.random.PRNGKey(0))
 
     def _batch_abstract(mesh_, compiled_, micro_, accum_):
         step_batch = micro_ * data_parallel_size(mesh_)
@@ -226,39 +290,41 @@ def main(argv=None) -> int:
             for k, (shp, dt) in shapes.items()
         }
 
-    accum = max(1, args.global_batch // (micro * dp))
-    state_abs_sharded = jax.tree.map(
-        lambda leaf, sh: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
-                                              sharding=sh),
-        state_abs, compiled.state_shardings,
-        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct),
-    )
-    batch_abs = _batch_abstract(mesh, compiled, micro, accum)
-    cache_client = cc.CompileCacheClient()
-    key, key_inputs = cc.compile_fingerprint(
-        num_nodes=ctx.num_nodes,
-        total_devices=len(jax.devices()),
-        mesh_axes=dict(mesh.shape),
-        model=cfg,
-        strategy=strategy,
-        args_signature=cc.abstract_signature((state_abs_sharded,
-                                              batch_abs)),
-        extra={"lr": args.lr, "objective": args.objective},
-    )
-    aot = cc.load_or_compile(
-        key, key_inputs,
-        compile_fn=lambda: compiled.step.lower(
-            state_abs_sharded, batch_abs).compile(),
-        cache=cache_client,
-    )
-    compiled.step = aot.fn
-    compiled.cache_hit = aot.cache_hit
-    # the compiled program's FLOPs ride the AOT envelope (a warm load
-    # never re-lowers just to count) and feed the live MFU gauge
-    compiled.flops_per_step = aot.flops
-    verb = "loaded from compile cache" if aot.cache_hit else "compiled"
-    print(f"[trainer] train step {verb} in {aot.seconds:.2f}s "
-          f"({aot.source})", flush=True)
+    if not mpmd_mode:
+        accum = max(1, args.global_batch // (micro * dp))
+        state_abs_sharded = jax.tree.map(
+            lambda leaf, sh: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                                  sharding=sh),
+            state_abs, compiled.state_shardings,
+            is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct),
+        )
+        batch_abs = _batch_abstract(mesh, compiled, micro, accum)
+        key, key_inputs = cc.compile_fingerprint(
+            num_nodes=ctx.num_nodes,
+            total_devices=len(jax.devices()),
+            mesh_axes=dict(mesh.shape),
+            model=cfg,
+            strategy=strategy,
+            args_signature=cc.abstract_signature((state_abs_sharded,
+                                                  batch_abs)),
+            extra={"lr": args.lr, "objective": args.objective},
+        )
+        aot = cc.load_or_compile(
+            key, key_inputs,
+            compile_fn=lambda: compiled.step.lower(
+                state_abs_sharded, batch_abs).compile(),
+            cache=cache_client,
+        )
+        compiled.step = aot.fn
+        compiled.cache_hit = aot.cache_hit
+        # the compiled program's FLOPs ride the AOT envelope (a warm
+        # load never re-lowers just to count) and feed the live MFU
+        # gauge
+        compiled.flops_per_step = aot.flops
+        verb = ("loaded from compile cache" if aot.cache_hit
+                else "compiled")
+        print(f"[trainer] train step {verb} in {aot.seconds:.2f}s "
+              f"({aot.source})", flush=True)
 
     # multi-node state is sharded across processes: only the sharded
     # engine can snapshot it (each node persists its addressable pieces)
@@ -322,7 +388,7 @@ def main(argv=None) -> int:
 
     fallback_on = os.environ.get(EnvKey.FALLBACK_AOT, "")
     if (fallback_on != "0" and (ctx.num_nodes > 1 or fallback_on == "1")
-            and cc.aot_cache_enabled()):
+            and cc.aot_cache_enabled() and not mpmd_mode):
         def _build_for_nodes(n_nodes: int):
             devices = jax.devices()
             per_node = max(1, len(devices) // ctx.num_nodes)

@@ -70,6 +70,9 @@ DOCUMENTED_PREFIXES = (
     # parallel persist / verified restore (DESIGN.md §20): the "restore
     # after shrinking the job" runbook keys on the ckpt family
     "dlrover_tpu_ckpt_",
+    # MPMD pipeline runtime (DESIGN.md §21): the "one pipeline stage is
+    # slow / recompiling" runbook keys on the per-stage families
+    "dlrover_tpu_pipeline_",
 )
 
 # label names that are themselves an operator contract (dashboards and

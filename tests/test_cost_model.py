@@ -22,8 +22,10 @@ from dlrover_tpu.models import transformer as T
 from dlrover_tpu.parallel import strategy as S
 from dlrover_tpu.parallel.cost_model import (
     HardwareSpec,
+    PipelineSchedule,
     collective_bytes,
     estimate_step_time,
+    rank_schedules,
 )
 
 HLO = """
@@ -75,6 +77,78 @@ class TestRoofline:
         assert est.ici_s == pytest.approx(2.0)
         assert est.est_step_s == pytest.approx(4.0)
         assert est.comm_bytes == pytest.approx(2e9)
+
+
+class TestScheduleAwareEstimate:
+    """ISSUE-10 satellite: the estimate must model the schedule shape —
+    before this, a GPipe and an MPMD candidate with identical HLO were
+    indistinguishable."""
+
+    HW = HardwareSpec(peak_flops=1e12, hbm_bps=1e12, ici_bps=1e9,
+                      mxu_efficiency=1.0)
+
+    def test_no_schedule_is_the_old_estimate(self):
+        est = estimate_step_time(flops=2e12, bytes_accessed=1e10,
+                                 hlo_text="", hw=self.HW)
+        assert est.est_step_s == pytest.approx(2.0)
+        assert est.bubble_s == 0.0 and est.p2p_s == 0.0
+        assert est.schedule_kind == ""
+
+    def test_uniform_stages_bubble_matches_1f1b_fraction(self):
+        """Uniform stages: scheduled time = work * (1 + (P-1)/(vM)),
+        i.e. bubble fraction (P-1)/(vM+P-1) of the step."""
+        from dlrover_tpu.parallel.pipeline import bubble_fraction
+
+        P, M = 4, 8
+        est = estimate_step_time(
+            flops=1e12, bytes_accessed=0, hw=self.HW,
+            schedule=PipelineSchedule(kind="spmd_gpipe", num_stages=P,
+                                      num_microbatches=M),
+        )
+        assert est.bubble_frac == pytest.approx(bubble_fraction(P, M))
+        assert est.est_step_s == pytest.approx(
+            1.0 * (M + P - 1) / M
+        )
+
+    def test_heterogeneous_ordering_mpmd_beats_interleaved_beats_gpipe(self):
+        """The tentpole ordering: with one slow stage, lockstep GPipe
+        pays (M+P-1) slots at the slow stage's pace, the interleaved
+        roll shrinks per-slot work v-fold, and MPMD pays other stages'
+        cost only during fill/drain — strictly fastest."""
+        stage_t = (0.001, 0.001, 0.001, 0.004)
+        P, M = 4, 8
+        common = dict(num_stages=P, num_microbatches=M,
+                      stage_time_s=stage_t)
+        ranked = rank_schedules(
+            {
+                "gpipe": PipelineSchedule(kind="spmd_gpipe", **common),
+                "interleaved": PipelineSchedule(
+                    kind="spmd_interleaved", interleave=2, **common),
+                "mpmd": PipelineSchedule(kind="mpmd_1f1b", **common),
+            },
+            flops=0.0, bytes_accessed=0.0, hw=self.HW,
+        )
+        order = [name for name, _ in ranked]
+        assert order == ["mpmd", "interleaved", "gpipe"]
+        by = dict(ranked)
+        # pinned closed forms for the heterogeneous case
+        assert by["gpipe"].est_step_s == pytest.approx((M + P - 1) * 0.004)
+        assert by["interleaved"].est_step_s == pytest.approx(
+            (2 * M + P - 1) * 0.004 / 2)
+        assert by["mpmd"].est_step_s == pytest.approx(
+            (M - 1) * 0.004 + sum(stage_t))
+
+    def test_p2p_term_charged_per_microbatch_boundary(self):
+        act = 1e6  # 1 MB boundary activation
+        est = estimate_step_time(
+            flops=1e12, bytes_accessed=0, hw=self.HW,
+            schedule=PipelineSchedule(kind="mpmd_1f1b", num_stages=2,
+                                      num_microbatches=4,
+                                      activation_bytes=act),
+        )
+        # 2 crossings (fwd act + bwd cotangent) x M microbatches
+        assert est.p2p_s == pytest.approx(2 * 4 * act / self.HW.ici_bps)
+        assert est.p2p_s > 0 and est.est_step_s > est.bubble_s
 
 
 def _auto(cfg, batch, candidates, objective="fastest"):
