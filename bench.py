@@ -1422,6 +1422,146 @@ def bench_chaos(extra: dict, stage_budget_s: float = 300.0) -> None:
         shutil.rmtree(work, ignore_errors=True)
 
 
+def bench_control_plane(extra: dict,
+                        stage_budget_s: float = 300.0) -> None:
+    """Master-saturation stage (DESIGN.md §22; runs on CPU, no devices).
+
+    Drives the real in-process JobMaster with seeded simulated fleets
+    (dlrover_tpu/fleetsim) at >=2 node-count tiers and reports where the
+    control plane's time goes: master_rpc_p99_ms / master_joins_per_s /
+    snapshot_ingest_ms per tier, plus the measured win of the
+    delta-compressed snapshot pushes (same 1k profile, delta vs full —
+    wire bytes and ingest cost). The per-tier ``master_rpc`` journal
+    rows also land in telemetry/report.py's master_saturation section,
+    whose dominant cost center per tier is echoed here.
+    """
+    import shutil
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from dlrover_tpu.common.constants import EnvKey
+    from dlrover_tpu.fleetsim import FleetProfile, FleetSimulator
+
+    t_start = time.monotonic()
+    seed = int(os.environ.get("BENCH_CP_SEED", "2026"))
+
+    def tier_profile(nodes: int, full_every: int = 10) -> FleetProfile:
+        # churn (failure + death waves) only at the small tier: each
+        # wave re-distributes the O(nodes)-sized comm world to every
+        # agent — the measured O(nodes^2) cost that at 5k nodes would
+        # eat the stage deadline for no extra signal
+        churn = nodes <= 1000
+        return FleetProfile(
+            name=f"cp{nodes}_f{full_every}",
+            seed=seed,
+            nodes=nodes,
+            duration_s=45.0 if churn else 30.0,
+            snapshot_interval_s=15.0 if churn else 20.0,
+            heartbeat_interval_s=15.0,
+            straggler_frac=0.004 if churn else 0.0,
+            failures=1 if churn else 0,
+            deaths=1 if churn else 0,
+            ckpt_interval_s=20.0,
+            # the real per-node registry is ~58 families of which a
+            # handful change between pushes (§12.1): shape the
+            # synthetic snapshots accordingly so the delta comparison
+            # measures the production ratio, not a toy one
+            families=40,
+            changed_families=3,
+            snapshot_full_every=full_every,
+        )
+
+    journal_dir = tempfile.mkdtemp(prefix="bench_cp_journal_")
+    prev_journal = os.environ.get(EnvKey.JOURNAL_DIR)
+    os.environ[EnvKey.JOURNAL_DIR] = journal_dir
+    tiers_done: list[int] = []
+
+    def record_tier(nodes: int, res) -> None:
+        tiers_done.append(nodes)
+        extra[f"cp_master_rpc_p99_ms_n{nodes}"] = round(
+            res.overall_p99_ms(), 3)
+        extra[f"cp_master_joins_per_s_n{nodes}"] = round(
+            res.joins_per_s())
+        extra[f"cp_join_mean_ms_n{nodes}"] = round(
+            res.join_mean_ms(), 4)
+        extra[f"cp_snapshot_ingest_ms_n{nodes}"] = round(
+            res.snapshot_ingest_mean_ms(), 4)
+        extra[f"cp_rounds_n{nodes}"] = len(res.rounds)
+        extra[f"cp_sim_wall_s_n{nodes}"] = round(res.wall_s, 1)
+
+    try:
+        # delta-compressed snapshot pushes vs full, same seeded 1k
+        # profile: wire bytes + master ingest cost per push. Full runs
+        # FIRST so the delta (production-shape) run's master_rpc rows
+        # are the ones the report keeps for the 1k tier.
+        full = FleetSimulator(tier_profile(1000, full_every=1)).run()
+        delta = FleetSimulator(tier_profile(1000, full_every=10)).run()
+        assert delta.trail == full.trail, \
+            "delta/full runs must replay the same event trail"
+        record_tier(1000, delta)
+
+        # ~wall cost scales with nodes^2 (the O(world)-sized comm-world
+        # response goes to every agent): gate the big tiers on what is
+        # left of the stage budget
+        for nodes, est_s in ((5000, 160), (10000, 600)):
+            left = stage_budget_s - (time.monotonic() - t_start)
+            if left < est_s + 30:
+                break
+            record_tier(nodes, FleetSimulator(tier_profile(nodes)).run())
+        extra["cp_tiers"] = tiers_done
+
+        # the join hot path must stay ~flat across tiers (the §22 O(1)
+        # rendezvous contract): report the measured ratio
+        if len(tiers_done) >= 2:
+            lo, hi = tiers_done[0], tiers_done[-1]
+            lo_ms = extra[f"cp_join_mean_ms_n{lo}"]
+            hi_ms = extra[f"cp_join_mean_ms_n{hi}"]
+            if lo_ms > 0:
+                ratio = hi_ms / lo_ms
+                extra["cp_join_cost_ratio"] = round(ratio, 2)
+                # the simulator assertion behind the §22 O(1) claim: a
+                # per-join O(world) regression shows up as ~nodes-ratio
+                # growth (5-10x across these tiers), far past this bound
+                extra["cp_join_cost_flat"] = bool(ratio < 4.0)
+                assert ratio < 4.0, (
+                    f"join handling cost grew {ratio:.1f}x from {lo} "
+                    f"to {hi} nodes — the O(1) rendezvous contract is "
+                    "broken"
+                )
+        extra["cp_snapshot_wire_bytes_full"] = full.snapshot_wire_bytes()
+        extra["cp_snapshot_wire_bytes_delta"] = \
+            delta.snapshot_wire_bytes()
+        extra["cp_snapshot_ingest_ms_full"] = round(
+            full.snapshot_ingest_mean_ms(), 4)
+        extra["cp_snapshot_ingest_ms_delta"] = round(
+            delta.snapshot_ingest_mean_ms(), 4)
+        if full.snapshot_wire_bytes():
+            extra["cp_snapshot_wire_reduction"] = round(
+                1.0 - delta.snapshot_wire_bytes()
+                / full.snapshot_wire_bytes(), 4)
+        if full.snapshot_ingest_mean_ms():
+            extra["cp_snapshot_ingest_reduction"] = round(
+                1.0 - delta.snapshot_ingest_mean_ms()
+                / full.snapshot_ingest_mean_ms(), 4)
+
+        # fold the journal's master_rpc rows through the report: the
+        # dominant cost center per tier is the headline diagnosis
+        from dlrover_tpu.telemetry.report import build_report
+
+        saturation = build_report(journal_dir).master_saturation
+        extra["cp_dominant"] = {
+            str(tier["nodes"]): tier["dominant"]
+            for tier in saturation if tier["nodes"] in tiers_done
+        }
+    finally:
+        if prev_journal is None:
+            os.environ.pop(EnvKey.JOURNAL_DIR, None)
+        else:
+            os.environ[EnvKey.JOURNAL_DIR] = prev_journal
+        shutil.rmtree(journal_dir, ignore_errors=True)
+
+
 def bench_serving(extra: dict) -> None:
     """Continuous-batching decode throughput (serving/engine.py).
 
@@ -1795,6 +1935,11 @@ STAGES = [
           pass_budget=True),
     Stage("chaos", bench_chaos, est_s=130, deadline_s=300,
           pass_budget=True, min_deadline_s=180),
+    # control-plane saturation (CPU-only, no devices): 1k tier + the
+    # delta-snapshot comparison fit in ~60 s; the 5k tier rides when
+    # the budget allows (min gate covers 1k + delta/full)
+    Stage("control_plane", bench_control_plane, est_s=240,
+          deadline_s=420, pass_budget=True, min_deadline_s=90),
     Stage("int8", bench_int8, est_s=275, deadline_s=450),
     Stage("aot7b", bench_7b_aot, est_s=15, deadline_s=120,
           pass_budget=True),
@@ -1825,6 +1970,10 @@ HEADLINE_KEYS = [
     "gateway_p95_s", "gateway_failed",
     "int8_ffn_speedup", "soak_completed", "soak_kills",
     "chaos_completed", "chaos_recovery_seconds", "chaos_goodput",
+    "cp_master_rpc_p99_ms_n1000", "cp_master_rpc_p99_ms_n5000",
+    "cp_master_joins_per_s_n1000", "cp_master_joins_per_s_n5000",
+    "cp_snapshot_ingest_ms_n1000", "cp_join_cost_ratio",
+    "cp_snapshot_wire_reduction", "cp_snapshot_ingest_reduction",
     "lc_best_speedup", "bench_total_s",
 ]
 
@@ -1856,9 +2005,19 @@ def _headline_line(extra: dict, errors: list[str]) -> str:
     })
 
 
-def main() -> int:
+def main(argv: list[str] | None = None) -> int:
     extra: dict = {}
     errors: list[str] = []
+    # optional stage-name filter: `python bench.py control_plane chaos`
+    # runs only the named stages. Explicit argv only — callers invoking
+    # main() in-process (the harness tests) always get the full sweep.
+    selected = [a for a in (argv or []) if not a.startswith("-")]
+    unknown = [s for s in selected
+               if s not in {st.name for st in STAGES}]
+    if unknown:
+        print(f"unknown stage(s) {unknown}; "
+              f"known: {[st.name for st in STAGES]}", file=sys.stderr)
+        return 2
     # 1740 not 1800: the envelope must also absorb interpreter + jax
     # startup (~25 s) under a driver kill timer that may be exactly 30
     # minutes of WALL clock, not of bench time
@@ -1898,6 +2057,8 @@ def main() -> int:
     signal.signal(signal.SIGTERM, on_term)
 
     for st in STAGES:
+        if selected and st.name not in selected:
+            continue
         left = budget - (time.monotonic() - t_start)
         gate = st.min_deadline_s or st.deadline_s
         if left < gate:
@@ -1930,4 +2091,4 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(sys.argv[1:]))

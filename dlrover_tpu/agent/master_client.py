@@ -29,9 +29,18 @@ class MasterClient:
     _instance: Optional["MasterClient"] = None
     _instance_lock = threading.Lock()
 
-    def __init__(self, master_addr: str, node_id: int):
-        self._client = RpcClient(master_addr)
+    def __init__(self, master_addr: str, node_id: int, transport=None,
+                 snapshot_full_every: int | None = None):
+        # ``transport`` is any object with RpcClient's call/close
+        # surface; the fleet simulator passes an in-process loopback so
+        # thousands of simulated agents exercise the genuine typed
+        # client + serde path without a socket each
+        self._client = transport or RpcClient(master_addr)
         self.node_id = node_id
+        # per-role delta state for metrics pushes (one pushing loop per
+        # role per process: heartbeat thread, trainer cadence, gateway)
+        self._snapshot_full_every = snapshot_full_every
+        self._delta_trackers: dict[str, "SnapshotDeltaTracker"] = {}
 
     # ------------------------------------------------------------- singleton
 
@@ -270,12 +279,30 @@ class MasterClient:
 
     def report_metrics(self, samples: list, role: str = "agent") -> None:
         """Push this process's metrics-registry snapshot
-        (telemetry/metrics.py) for the master's aggregated exposition."""
+        (telemetry/metrics.py) for the master's aggregated exposition.
+
+        Pushes are delta-compressed (telemetry/snapshot_delta.py):
+        between periodic full snapshots only the families whose content
+        changed since the last *acknowledged* push go on the wire — the
+        tracker commits its base only after the RPC returned, so a lost
+        push re-sends what the master missed."""
+        tracker = self._delta_trackers.get(role)
+        if tracker is None:
+            from dlrover_tpu.telemetry.snapshot_delta import (
+                SnapshotDeltaTracker,
+            )
+
+            tracker = self._delta_trackers[role] = SnapshotDeltaTracker(
+                full_every=self._snapshot_full_every
+            )
+        payload, is_delta = tracker.prepare(samples)
         self._client.call(
             m.MetricsSnapshotRequest(
-                node_id=self.node_id, role=role, samples=samples,
+                node_id=self.node_id, role=role, samples=payload,
+                is_delta=is_delta,
             )
         )
+        tracker.commit()
 
     def report_debug_bundle(self, path: str, reason: str,
                             proc: str = "") -> None:

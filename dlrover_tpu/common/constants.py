@@ -159,6 +159,10 @@ class EnvKey:
     # (checkpoint/interval_tuner.py) drive the shm snapshot cadence via
     # the paral-config push; unset/other keeps the trainer's CLI value
     SNAPSHOT_INTERVAL = "DLROVER_TPU_SNAPSHOT_INTERVAL"
+    # delta-compressed metrics-snapshot pushes
+    # (telemetry/snapshot_delta.py): every Kth push is a full snapshot,
+    # the ones between suppress unchanged families; 0/1 = always full
+    SNAPSHOT_FULL_EVERY = "DLROVER_TPU_SNAPSHOT_FULL_EVERY"
     # platform/backend selection (run.py --platform mirror; "cpu"
     # forces JAX_PLATFORMS=cpu in children)
     PLATFORM = "DLROVER_TPU_PLATFORM"

@@ -120,6 +120,9 @@ SPECS: tuple[EnvVar, ...] = (
     EnvVar("DLROVER_TPU_SNAPSHOT_INTERVAL", None,
            "'auto' arms the master's Young-Daly cadence tuner; other "
            "values keep the trainer CLI cadence", "§16"),
+    EnvVar("DLROVER_TPU_SNAPSHOT_FULL_EVERY", "10",
+           "every Kth metrics-snapshot push is full; pushes between "
+           "suppress unchanged families (0/1 = always full)", "§22"),
     EnvVar("DLROVER_TPU_BUDDY", "1",
            "'0' disables buddy replication of shm snapshots", "§16"),
     EnvVar("DLROVER_TPU_BUDDY_INTERVAL", "2.0",

@@ -418,6 +418,13 @@ class MetricsSnapshotRequest:
     node_id: int = 0
     role: str = "agent"
     samples: list = dataclasses.field(default_factory=list)
+    # delta-compressed push (telemetry/snapshot_delta.py): ``samples``
+    # carries only the families whose content changed since this node's
+    # last push; the master merges into its stored copy. Full snapshots
+    # (is_delta=False) replace it outright — sent every
+    # DLROVER_TPU_SNAPSHOT_FULL_EVERY pushes so a restarted master
+    # converges within one period.
+    is_delta: bool = False
 
 
 @register_message

@@ -1,0 +1,13 @@
+"""Discrete-event fleet simulator for the master control plane.
+
+Drives a real in-process ``JobMaster`` with 1k-10k simulated agents
+speaking the genuine typed RPC surface (DESIGN.md §22): joins,
+heartbeats, metrics-snapshot pushes, persist-ack storms, failure
+reports — traffic shaped by a seeded ``FleetProfile`` and
+replay-identical across runs, chaos-trail style.
+"""
+
+from dlrover_tpu.fleetsim.profile import FleetProfile
+from dlrover_tpu.fleetsim.sim import FleetSimulator, SimResult
+
+__all__ = ["FleetProfile", "FleetSimulator", "SimResult"]

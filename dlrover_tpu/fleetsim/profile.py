@@ -1,0 +1,98 @@
+"""``FleetProfile``: the seeded traffic shape one simulation replays.
+
+A profile is to the fleet simulator what a fault plan is to the chaos
+harness (``chaos/injector.py``): a small, serializable spec that — with
+its seed — fully determines the event trail. Two runs of the same
+profile must produce identical trails (the §22 determinism contract,
+pinned in tests/test_fleetsim.py), so nothing here may depend on wall
+clock or unseeded randomness.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+
+@dataclasses.dataclass
+class FleetProfile:
+    """Traffic shape for one simulated fleet.
+
+    Times are *virtual* seconds on the simulator's discrete-event
+    clock; real handler latencies are measured separately and never
+    feed back into event ordering (that is what keeps the trail
+    replay-identical while the measured numbers vary run to run).
+    """
+
+    name: str = "default"
+    seed: int = 1234
+    nodes: int = 1000
+    # virtual run length AFTER the initial rendezvous settles
+    duration_s: float = 60.0
+    # initial joins are spread uniformly over this window
+    join_window_s: float = 2.0
+    # agents poll get_comm_world at this cadence while waiting
+    poll_interval_s: float = 0.5
+    heartbeat_interval_s: float = 15.0
+    # metrics-snapshot push cadence (every agent), and the fraction of
+    # agents that also push a trainer-role snapshot carrying the
+    # step-duration histogram the straggler miner consumes
+    snapshot_interval_s: float = 30.0
+    trainer_frac: float = 1.0
+    # synthetic registry shape: families per snapshot, of which
+    # ``changed_families`` actually change between pushes — the ratio
+    # the delta compression exploits
+    families: int = 12
+    changed_families: int = 2
+    # snapshot wire mode: every Kth push full, deltas between
+    # (1 = always full); mirrors DLROVER_TPU_SNAPSHOT_FULL_EVERY
+    snapshot_full_every: int = 10
+    # synthetic steady-state step time, and the seeded stragglers that
+    # run ``straggler_factor`` slower (drives real verdicts on the
+    # master's continuous detector)
+    step_time_s: float = 0.1
+    straggler_frac: float = 0.0
+    straggler_factor: float = 3.0
+    # restart-in-place recovery waves: a trainer dies, every agent
+    # re-joins, the round must complete via the fast re-admit path
+    failures: int = 1
+    # node deaths (NodeEventReport FAILED -> remove_node): survivors
+    # re-join and the round completes as a reshard event
+    deaths: int = 0
+    # persist-ack storms: every alive agent acks a checkpoint shard at
+    # this cadence and rank 0 polls the ledger (0 disables)
+    ckpt_interval_s: float = 30.0
+    # compile-cache artifacts seeded at start so recovery-wave coverage
+    # queries scan a non-empty LRU
+    compile_cache_entries: int = 4
+
+    def __post_init__(self) -> None:
+        if self.nodes < 1:
+            raise ValueError("nodes must be >= 1")
+        if self.deaths >= self.nodes:
+            raise ValueError("deaths must leave at least one node")
+        if not 0.0 <= self.trainer_frac <= 1.0:
+            raise ValueError("trainer_frac must be in [0, 1]")
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "FleetProfile":
+        return cls(**json.loads(text))
+
+
+def smoke_profile(nodes: int = 1000, seed: int = 4321) -> FleetProfile:
+    """The tier-1 smoke shape: one failure wave, a few stragglers, one
+    ckpt storm — small virtual window so the wall cost stays seconds."""
+    return FleetProfile(
+        name=f"smoke{nodes}",
+        seed=seed,
+        nodes=nodes,
+        duration_s=32.0,
+        snapshot_interval_s=15.0,
+        heartbeat_interval_s=15.0,
+        straggler_frac=0.004,
+        failures=1,
+        ckpt_interval_s=20.0,
+    )

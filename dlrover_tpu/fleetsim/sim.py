@@ -1,0 +1,572 @@
+"""Discrete-event fleet simulator over a real in-process ``JobMaster``.
+
+The question this answers (ROADMAP item 5, DESIGN.md §22): where does
+the single-process master saturate as the fleet grows — before anyone
+tries to shard or hierarchify it. The simulator is to the control plane
+what ``chaos/scenario.py`` is to the recovery path: a seeded,
+replay-identical driver whose *trail* is comparable across runs while
+the *measurements* (handler latency, wire bytes, ingest cost) are the
+evidence a bench stage pins.
+
+Design:
+
+- **Real master, real RPC surface.** Agents are ``MasterClient``
+  instances — the typed client the PR-8 ``rpc-contract`` rule governs —
+  over an in-process loopback transport that serde-encodes every
+  request/response exactly like ``RpcClient``/``RpcServer`` (so wire
+  bytes and decode cost are genuine) and dispatches into
+  ``JobMaster.servicer.handle``. No sockets: 10k simulated agents cost
+  10k Python objects, not 10k connections.
+- **Virtual clock.** Events (join, poll, heartbeat, snapshot push,
+  persist-ack storm, failure/death waves) order on a seeded virtual
+  timeline; measured wall latencies never feed back into ordering, so
+  two runs of one ``FleetProfile`` produce identical trails even though
+  their measured numbers differ.
+- **Trail.** Chaos-style: sorted deterministic tuples (round
+  completions with their fast/reshard flags, failures, deaths, storms,
+  straggler verdicts) — the tier-1 determinism assertion compares two
+  runs' trails verbatim.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import json
+import os
+import random
+import time
+from typing import Any
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common import serde
+from dlrover_tpu.common.constants import EnvKey, NodeEventType, NodeStatus
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.fleetsim.profile import FleetProfile
+from dlrover_tpu.master.saturation import (
+    histogram_percentile,
+    journal_master_rpc,
+)
+from dlrover_tpu.telemetry.journal import get_journal
+
+logger = get_logger(__name__)
+
+STEP_FAMILY = "dlrover_tpu_train_step_seconds"
+
+
+class _RpcStat:
+    """Exact per-RPC-type measurements (the master histogram's bucketed
+    view rides beside this; the simulator keeps raw samples so bench
+    p99s are not bucket upper bounds)."""
+
+    __slots__ = ("calls", "bytes_in", "bytes_out", "total_s", "samples")
+
+    def __init__(self):
+        self.calls = 0
+        self.bytes_in = 0
+        self.bytes_out = 0
+        self.total_s = 0.0
+        self.samples: list[float] = []
+
+    def observe(self, seconds: float, nbytes_in: int,
+                nbytes_out: int) -> None:
+        self.calls += 1
+        self.bytes_in += nbytes_in
+        self.bytes_out += nbytes_out
+        self.total_s += seconds
+        self.samples.append(seconds)
+
+    def percentile(self, q: float) -> float:
+        if not self.samples:
+            return 0.0
+        ordered = sorted(self.samples)
+        idx = min(len(ordered) - 1, int(q * len(ordered)))
+        return ordered[idx]
+
+    def to_row(self, rpc: str) -> dict:
+        return {
+            "rpc": rpc,
+            "calls": self.calls,
+            "total_ms": round(1000.0 * self.total_s, 3),
+            "p99_ms": round(1000.0 * self.percentile(0.99), 4),
+            "mean_ms": round(
+                1000.0 * self.total_s / self.calls, 4
+            ) if self.calls else 0.0,
+            "bytes_in": self.bytes_in,
+            "bytes_out": self.bytes_out,
+        }
+
+
+class _LoopbackTransport:
+    """``RpcClient``-shaped in-process transport.
+
+    Encodes the request and decodes it server-side through the same
+    ``common/serde`` path the TCP transport uses — the measured handle
+    time therefore includes deserialize + dispatch + serialize, which
+    is what the real master pays per RPC (minus the kernel socket).
+    Shared by every simulated agent; the engine is single-threaded so
+    no lock is needed and the queue-depth gauge honestly reads 1.
+    """
+
+    def __init__(self, handler):
+        self._handler = handler
+        self.stats: dict[str, _RpcStat] = {}
+
+    def call(self, msg: Any) -> Any:
+        name = type(msg).__name__
+        t0 = time.perf_counter()
+        raw = serde.encode(msg)
+        resp = self._handler(serde.decode(raw))
+        raw_out = serde.encode(resp) if resp is not None else b""
+        out = serde.decode(raw_out) if raw_out else None
+        elapsed = time.perf_counter() - t0
+        stat = self.stats.get(name)
+        if stat is None:
+            stat = self.stats[name] = _RpcStat()
+        stat.observe(elapsed, len(raw), len(raw_out))
+        return out
+
+    def close(self) -> None:
+        pass
+
+
+class _SimAgent:
+    __slots__ = ("node_id", "client", "alive", "is_trainer",
+                 "is_straggler", "push_idx", "trainer_cum_sum",
+                 "trainer_cum_count", "last_round")
+
+    def __init__(self, node_id: int, client: MasterClient,
+                 is_trainer: bool, is_straggler: bool):
+        self.node_id = node_id
+        self.client = client
+        self.alive = True
+        self.is_trainer = is_trainer
+        self.is_straggler = is_straggler
+        self.push_idx = 0
+        self.trainer_cum_sum = 0.0
+        self.trainer_cum_count = 0
+        self.last_round = 0
+
+
+@dataclasses.dataclass
+class SimResult:
+    profile: FleetProfile
+    trail: dict
+    rpc: dict[str, dict]          # rpc type -> _RpcStat.to_row
+    rounds: list[dict]            # completed rendezvous rounds, in order
+    stragglers_flagged: list[int]
+    wall_s: float
+    virtual_s: float
+
+    # ------------------------------------------------------ derived views
+
+    def overall_p99_ms(self) -> float:
+        """p99 across every RPC the master handled (weighted by call)."""
+        merged: list[float] = []
+        for row in self.rpc.values():
+            merged.extend(row.get("_samples", ()))
+        if not merged:
+            return 0.0
+        merged.sort()
+        return 1000.0 * merged[min(len(merged) - 1,
+                                   int(0.99 * len(merged)))]
+
+    def joins_per_s(self) -> float:
+        """Join-handling throughput capacity: joins handled per second
+        of handler time (single-threaded master ceiling)."""
+        row = self.rpc.get("JoinRendezvousRequest")
+        if not row or not row["total_ms"]:
+            return 0.0
+        return 1000.0 * row["calls"] / row["total_ms"]
+
+    def join_mean_ms(self) -> float:
+        row = self.rpc.get("JoinRendezvousRequest")
+        return row["mean_ms"] if row else 0.0
+
+    def snapshot_ingest_mean_ms(self) -> float:
+        row = self.rpc.get("MetricsSnapshotRequest")
+        return row["mean_ms"] if row else 0.0
+
+    def snapshot_wire_bytes(self) -> int:
+        row = self.rpc.get("MetricsSnapshotRequest")
+        return row["bytes_in"] if row else 0
+
+    def to_dict(self) -> dict:
+        return {
+            "profile": json.loads(self.profile.to_json()),
+            "trail": self.trail,
+            "rpc": {k: {kk: vv for kk, vv in v.items()
+                        if kk != "_samples"}
+                    for k, v in sorted(self.rpc.items())},
+            "rounds": self.rounds,
+            "stragglers_flagged": self.stragglers_flagged,
+            "wall_s": round(self.wall_s, 3),
+            "virtual_s": round(self.virtual_s, 3),
+            "master_rpc_p99_ms": round(self.overall_p99_ms(), 4),
+            "master_joins_per_s": round(self.joins_per_s(), 1),
+            "snapshot_ingest_ms": round(
+                self.snapshot_ingest_mean_ms(), 4),
+            "snapshot_wire_bytes": self.snapshot_wire_bytes(),
+        }
+
+
+class FleetSimulator:
+    """Run one ``FleetProfile`` against a fresh in-process master."""
+
+    # event kinds, dispatched in _run_loop
+    _JOIN, _POLL, _HEARTBEAT, _SNAPSHOT, _STORM, _FAIL, _DEATH = (
+        "join", "poll", "heartbeat", "snapshot", "storm", "fail",
+        "death",
+    )
+
+    def __init__(self, profile: FleetProfile):
+        self.profile = profile
+        self._heap: list[tuple[float, int, str, int]] = []
+        self._seq = 0
+        self._trail_events: list[list] = []
+        self._rounds: list[dict] = []
+        self._seen_rounds: set[int] = set()
+        self._storm_step = 0
+
+    # ------------------------------------------------------------ engine
+
+    def _schedule(self, t: float, kind: str, node: int = -1) -> None:
+        self._seq += 1
+        heapq.heappush(self._heap, (t, self._seq, kind, node))
+
+    def _trail(self, *entry) -> None:
+        self._trail_events.append(list(entry))
+        get_journal().emit("fleetsim_event", kind=entry[0],
+                           detail=list(entry[1:]),
+                           sim=self.profile.name)
+
+    def run(self) -> SimResult:
+        from dlrover_tpu.master.job_master import JobMaster
+        from dlrover_tpu.master.saturation import lock_wait_seconds
+
+        p = self.profile
+        prev_trace = os.environ.get(EnvKey.TRACE_ID)
+        t_wall = time.perf_counter()
+        master = JobMaster(
+            job_name=f"fleetsim_{p.name}",
+            min_nodes=max(1, p.nodes - p.deaths),
+            max_nodes=p.nodes,
+            rdzv_timeout=3600.0,
+        )
+        lock_base = {
+            s["labels"]["structure"]: (list(s["buckets"]), s["sum"],
+                                       s["count"])
+            for s in lock_wait_seconds.samples()
+        }
+        transport = _LoopbackTransport(master.servicer.handle)
+        rng_jitter = random.Random(f"{p.seed}:jitter")
+        rng_pick = random.Random(f"{p.seed}:pick")
+        k = round(p.nodes * p.straggler_frac)
+        stragglers = set(rng_pick.sample(range(p.nodes), k)) if k \
+            else set()
+        trainer_cut = int(p.nodes * p.trainer_frac)
+        self._agents = [
+            _SimAgent(
+                i,
+                MasterClient(
+                    "fleetsim", i, transport=transport,
+                    snapshot_full_every=p.snapshot_full_every,
+                ),
+                is_trainer=i < trainer_cut,
+                is_straggler=i in stragglers,
+            )
+            for i in range(p.nodes)
+        ]
+        self._master = master
+        self._trail("start", p.nodes, p.seed)
+        for node in sorted(stragglers):
+            self._trail("straggler", node)
+
+        # seed the compile-cache LRU so recovery coverage queries scan
+        # real entries (kv_store.covers is a prefix walk)
+        for j in range(p.compile_cache_entries):
+            self._agents[0].client.compile_cache_put(
+                f"n{p.nodes}t{4 * p.nodes}/sim{j:02d}",
+                b"x" * 256, {"sim": True},
+            )
+
+        # initial rendezvous: joins spread over the join window
+        for agent in self._agents:
+            self._schedule(rng_jitter.uniform(0.0, p.join_window_s),
+                           self._JOIN, agent.node_id)
+        horizon = p.join_window_s + p.duration_s
+        # recovery waves, evenly placed inside the steady window
+        waves = p.failures + p.deaths
+        for w in range(waves):
+            t = p.join_window_s + p.duration_s * (w + 1) / (waves + 1)
+            kind = self._FAIL if w < p.failures else self._DEATH
+            self._schedule(t, kind, -1)
+        if p.ckpt_interval_s > 0:
+            self._schedule(p.join_window_s + p.ckpt_interval_s,
+                           self._STORM, -1)
+
+        try:
+            self._run_loop(horizon, rng_jitter, rng_pick)
+        finally:
+            # the master was never prepare()d: no threads to stop, but
+            # the RpcServer construction bound a socket — release it
+            # without RpcServer.stop() (shutdown() would block forever
+            # on a serve_forever loop that never ran)
+            try:
+                master._server._server.server_close()
+            except OSError:
+                pass
+            if prev_trace is None:
+                os.environ.pop(EnvKey.TRACE_ID, None)
+            else:
+                os.environ[EnvKey.TRACE_ID] = prev_trace
+
+        flagged = sorted(master.anomaly.stragglers())
+        for node in flagged:
+            self._trail("straggler_flagged", node)
+        self._trail("end", len(self._rounds))
+        wall = time.perf_counter() - t_wall
+
+        rpc_rows: dict[str, dict] = {}
+        for name, stat in sorted(transport.stats.items()):
+            row = stat.to_row(name)
+            row["_samples"] = stat.samples
+            rpc_rows[name] = row
+        self._journal_saturation(rpc_rows, lock_base,
+                                 lock_wait_seconds)
+        result = SimResult(
+            profile=p,
+            trail=self._canonical_trail(),
+            rpc=rpc_rows,
+            rounds=self._rounds,
+            stragglers_flagged=flagged,
+            wall_s=wall,
+            virtual_s=horizon,
+        )
+        logger.info(
+            "fleetsim %s: %d nodes, %d rounds, %d rpc types, "
+            "wall %.2fs, rpc p99 %.3fms", p.name, p.nodes,
+            len(self._rounds), len(rpc_rows), wall,
+            result.overall_p99_ms(),
+        )
+        return result
+
+    def _run_loop(self, horizon: float, rng_jitter: random.Random,
+                  rng_pick: random.Random) -> None:
+        p = self.profile
+        while self._heap:
+            t, _seq, kind, node = heapq.heappop(self._heap)
+            if t > horizon:
+                break
+            if kind == self._JOIN:
+                agent = self._agents[node]
+                if not agent.alive:
+                    continue
+                agent.client.join_rendezvous(
+                    f"10.0.{node >> 8}.{node & 255}:7777",
+                    local_devices=4,
+                    topology_key=f"{node:06d}",
+                )
+                self._schedule(t + p.poll_interval_s, self._POLL, node)
+            elif kind == self._POLL:
+                self._on_poll(t, node)
+            elif kind == self._HEARTBEAT:
+                agent = self._agents[node]
+                if agent.alive:
+                    agent.client.report_heartbeat(0)
+                    self._schedule(t + p.heartbeat_interval_s,
+                                   self._HEARTBEAT, node)
+            elif kind == self._SNAPSHOT:
+                self._on_snapshot(t, node)
+            elif kind == self._STORM:
+                self._on_storm(t)
+            elif kind in (self._FAIL, self._DEATH):
+                self._on_wave(t, kind, rng_jitter, rng_pick)
+
+    # ------------------------------------------------------------ events
+
+    def _on_poll(self, t: float, node: int) -> None:
+        agent = self._agents[node]
+        if not agent.alive:
+            return
+        resp = agent.client.get_comm_world()
+        if resp.completed and resp.round > agent.last_round:
+            first_world = agent.last_round == 0
+            agent.last_round = resp.round
+            if resp.round not in self._seen_rounds:
+                self._seen_rounds.add(resp.round)
+                self._rounds.append({
+                    "round": resp.round,
+                    "nodes": len(resp.world),
+                    "reshard": bool(resp.reshard),
+                })
+                self._trail("round", resp.round, len(resp.world),
+                            int(bool(resp.reshard)))
+            if first_world:
+                # steady-state loops start once the agent has a world
+                self._schedule(t + self.profile.heartbeat_interval_s,
+                               self._HEARTBEAT, node)
+                self._schedule(t + self.profile.snapshot_interval_s,
+                               self._SNAPSHOT, node)
+        else:
+            self._schedule(t + self.profile.poll_interval_s,
+                           self._POLL, node)
+
+    def _agent_families(self, agent: _SimAgent) -> list:
+        """Synthetic agent-role registry snapshot: ``families`` metric
+        families of which only ``changed_families`` differ between
+        pushes — the shape delta compression exploits."""
+        p = self.profile
+        out = []
+        for i in range(p.families):
+            changes = i < p.changed_families
+            out.append({
+                "name": f"dlrover_tpu_sim_family_{i:02d}",
+                "type": "counter",
+                "help": "",
+                "buckets": [],
+                "samples": [{
+                    "labels": {},
+                    "value": float(agent.push_idx + 1) if changes
+                    else 1.0,
+                }],
+            })
+        return out
+
+    def _trainer_families(self, agent: _SimAgent) -> list:
+        """Cumulative step-duration histogram family feeding the
+        master's continuous straggler miner; stragglers report
+        ``straggler_factor``-slower means."""
+        p = self.profile
+        step_s = p.step_time_s * (
+            p.straggler_factor if agent.is_straggler else 1.0
+        )
+        steps = max(1, int(p.snapshot_interval_s / p.step_time_s))
+        agent.trainer_cum_count += steps
+        agent.trainer_cum_sum += steps * step_s
+        return [{
+            "name": STEP_FAMILY,
+            "type": "histogram",
+            "help": "",
+            "buckets": [],
+            "samples": [{
+                "labels": {},
+                "buckets": [],
+                "sum": agent.trainer_cum_sum,
+                "count": agent.trainer_cum_count,
+            }],
+        }]
+
+    def _on_snapshot(self, t: float, node: int) -> None:
+        agent = self._agents[node]
+        if not agent.alive:
+            return
+        agent.client.report_metrics(self._agent_families(agent))
+        if agent.is_trainer:
+            agent.client.report_metrics(
+                self._trainer_families(agent), role="trainer"
+            )
+        agent.push_idx += 1
+        if node == 0:
+            agent.client.report_step(agent.trainer_cum_count)
+        self._schedule(t + self.profile.snapshot_interval_s,
+                       self._SNAPSHOT, node)
+
+    def _on_storm(self, t: float) -> None:
+        """Checkpoint-persist storm: every alive host acks its shard,
+        then the lowest-id host polls the ledger — the §20 commit wait
+        against the ack ledger, fleet-sized."""
+        self._storm_step += 1
+        step = self._storm_step
+        alive = [a for a in self._agents if a.alive]
+        for agent in alive:
+            agent.client.report_persist_ack(
+                step=step, num_shards=len(alive),
+                shard={"crc": (step * 2654435761 + agent.node_id)
+                       & 0xFFFFFFFF,
+                       "bytes": 1 << 20, "pieces": {}},
+            )
+        status = alive[0].client.persist_status(step, len(alive))
+        self._trail("ckpt_storm", step, int(status.acked))
+        self._schedule(t + self.profile.ckpt_interval_s, self._STORM,
+                       -1)
+
+    def _on_wave(self, t: float, kind: str, rng_jitter: random.Random,
+                 rng_pick: random.Random) -> None:
+        """A failure (restart-in-place: everyone re-joins, fast
+        re-admit) or a death (membership shrink: survivors re-join,
+        reshard round)."""
+        p = self.profile
+        alive = [a for a in self._agents if a.alive]
+        if len(alive) < 2:
+            return
+        victim = alive[rng_pick.randrange(len(alive))]
+        if kind == self._FAIL:
+            self._trail("fail", victim.node_id)
+            victim.client.report_failure(
+                "exit code 9 (killed)", restart_count=1
+            )
+            rejoining = alive
+        else:
+            self._trail("death", victim.node_id)
+            victim.client.report_node_event(
+                NodeEventType.MODIFIED,
+                status=NodeStatus.FAILED.value,
+            )
+            victim.alive = False
+            rejoining = [a for a in alive if a is not victim]
+        # post-recovery, agents also ask whether the new topology is
+        # covered by precompiled executables (the §17 reshard decision)
+        rejoining[0].client.compile_cache_query(f"n{len(rejoining)}t")
+        for agent in rejoining:
+            self._schedule(
+                t + rng_jitter.uniform(0.0, p.join_window_s),
+                self._JOIN, agent.node_id,
+            )
+
+    # ------------------------------------------------------- aggregation
+
+    def _canonical_trail(self) -> dict:
+        """Occurrence-indexed, sorted — invariant to event interleaving
+        (chaos-trail convention), sensitive to any change in what
+        actually happened."""
+        counts: dict[str, int] = {}
+        entries: list[list] = []
+        for event in self._trail_events:
+            key = json.dumps(event)
+            k = counts.get(key, 0)
+            counts[key] = k + 1
+            entries.append(event + [k])
+        return {"events": sorted(entries, key=json.dumps)}
+
+    def _journal_saturation(self, rpc_rows: dict, lock_base: dict,
+                            lock_metric) -> None:
+        """Emit this run's ``master_rpc`` saturation rows: exact
+        per-RPC measurements plus the run's *delta* of the master lock
+        histograms (the registry is process-global; subtracting the
+        pre-run sample keeps multi-sim processes honest)."""
+        rows = [
+            {k: v for k, v in row.items() if k != "_samples"}
+            for row in rpc_rows.values()
+        ]
+        for sample in lock_metric.samples():
+            structure = sample["labels"].get("structure", "")
+            base_buckets, base_sum, base_count = lock_base.get(
+                structure, ([0] * len(sample["buckets"]), 0.0, 0)
+            )
+            count = sample["count"] - base_count
+            if count <= 0:
+                continue
+            delta_buckets = [
+                b - a for b, a in zip(sample["buckets"], base_buckets)
+            ]
+            rows.append({
+                "rpc": f"lock/{structure}",
+                "calls": count,
+                "total_ms": round(
+                    1000.0 * (sample["sum"] - base_sum), 3),
+                "p99_ms": round(1000.0 * histogram_percentile(
+                    lock_metric.buckets, delta_buckets, count, 0.99
+                ), 4),
+            })
+        journal_master_rpc(rows, nodes=self.profile.nodes)

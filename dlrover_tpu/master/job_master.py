@@ -277,6 +277,13 @@ class JobMaster:
     def stop(self) -> None:
         from dlrover_tpu.telemetry.journal import get_journal
 
+        # where the master's own dispatch time went, one master_rpc
+        # point per cost center (DESIGN.md §22): feeds the report's
+        # master_saturation section for real jobs the way the fleet
+        # simulator feeds it for synthetic tiers
+        self.servicer.journal_saturation(
+            nodes=len(self.node_manager.all_nodes())
+        )
         get_journal().emit("job_end", job=self.job_name,
                            success=self.servicer.job_success)
         if self.state_manager is not None:

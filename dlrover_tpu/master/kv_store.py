@@ -117,9 +117,13 @@ class CompileCacheService:
 
     def __init__(self, max_bytes: int = 512 << 20,
                  max_entry_bytes: int = 128 << 20):
+        from dlrover_tpu.master.saturation import TimedLock
+
         self.max_bytes = max_bytes
         self.max_entry_bytes = min(max_entry_bytes, max_bytes)
-        self._lock = threading.Lock()
+        # instrumented: the LRU is one of the named hot master
+        # structures the saturation layer attributes wait time to
+        self._lock = TimedLock("compile_cache_lru")
         # key -> (payload, meta); OrderedDict end = most recently used
         self._entries: OrderedDict[str, tuple[bytes, dict]] = OrderedDict()
         self._bytes = 0

@@ -75,7 +75,9 @@ class NodeManager:
                     status=NodeStatus.RUNNING,
                 )
                 self._nodes[node_id] = node
-                logger.info("node %d registered (%s)", node_id, addr)
+                # debug, not info: registration is per-join and a 10k
+                # fleet would pay 10k log lines per round (§22)
+                logger.debug("node %d registered (%s)", node_id, addr)
             elif addr:
                 node.addr = addr
             if node.status in NodeStatus.terminal():

@@ -15,10 +15,10 @@ the analog of the reference's access-switch sort (net_topology.py:61).
 from __future__ import annotations
 
 import dataclasses
-import threading
 import time
 
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.master.saturation import TimedLock
 from dlrover_tpu.telemetry.journal import get_journal
 from dlrover_tpu.telemetry.metrics import registry
 
@@ -95,7 +95,7 @@ class RendezvousManager:
         # world sizes must be a multiple of node_unit (e.g. hosts per TPU
         # slice), mirroring the reference's node_unit rounding.
         self._node_unit = max(1, node_unit)
-        self._lock = threading.Lock()
+        self._lock = TimedLock("rdzv")
         self._waiting: dict[int, _WaitingNode] = {}
         self._latest: CommWorld | None = None
         self._round = 0
@@ -111,6 +111,15 @@ class RendezvousManager:
         # waiting_timeout backoff (DESIGN.md §17).
         self._prev_world: frozenset[int] | None = None
         self._departed: set[int] = set()
+        # O(1)-per-event bookkeeping (DESIGN.md §22): the fast/reshard
+        # checks used to rebuild frozenset(self._waiting) on EVERY
+        # get_comm_world poll — O(world) per event, O(world²) per round
+        # at fleet scale. Instead this counts the waiting nodes that are
+        # *survivors* of the previous round (in ``_prev_world``, not in
+        # ``_departed``); set equality then reduces to two size checks,
+        # because survivors-waiting == |waiting| means waiting ⊆
+        # survivors, and matching cardinalities force equality.
+        self._waiting_survivors = 0
 
     def update_node_bounds(self, min_nodes: int, max_nodes: int) -> None:
         with self._lock:
@@ -119,10 +128,19 @@ class RendezvousManager:
 
     def join(self, node_id: int, addr: str, local_devices: int,
              topology_key: str = "") -> int:
-        """A node (re-)joins; returns the round it will participate in."""
+        """A node (re-)joins; returns the round it will participate in.
+
+        O(1) per join: survivor membership is two hash probes and the
+        incremental count replaces any full waiting/world comparison.
+        """
         with self._lock:
             if not self._waiting:
                 self._first_join_time = time.time()
+            if (node_id not in self._waiting
+                    and self._prev_world is not None
+                    and node_id in self._prev_world
+                    and node_id not in self._departed):
+                self._waiting_survivors += 1
             self._waiting[node_id] = _WaitingNode(
                 node_id=node_id,
                 addr=addr,
@@ -137,7 +155,10 @@ class RendezvousManager:
                     self.name, node_id, self._latest.round,
                 )
                 self._latest = None
-            logger.info(
+            # debug, not info: at fleet scale (1k-10k joins per round,
+            # DESIGN.md §22) a per-join info line is itself a measurable
+            # master cost; round completion still logs at info
+            logger.debug(
                 "rdzv %s: node %s joined (%d waiting, need %d-%d)",
                 self.name, node_id, len(self._waiting),
                 self._min_nodes, self._max_nodes,
@@ -147,7 +168,15 @@ class RendezvousManager:
 
     def remove_node(self, node_id: int) -> None:
         with self._lock:
+            was_counted = (
+                node_id in self._waiting
+                and self._prev_world is not None
+                and node_id in self._prev_world
+                and node_id not in self._departed
+            )
             self._waiting.pop(node_id, None)
+            if was_counted:
+                self._waiting_survivors -= 1
             if self._prev_world and node_id in self._prev_world:
                 # a genuinely departed member disqualifies the
                 # unchanged-membership fast path until the next full
@@ -164,15 +193,15 @@ class RendezvousManager:
     def num_nodes_waiting(self) -> int:
         """Nodes waiting for a round beyond the current completed world.
 
-        Agents poll this to detect membership changes
-        (reference: training.py:676 _membership_changed).
+        Agents poll this to detect membership changes (reference:
+        training.py:676 _membership_changed). O(1): while ``_latest``
+        stands, no waiting node can be one of its members — a member
+        re-joining nulls ``_latest`` in ``join`` and completion pops
+        every member out of the waiting set — so the waiting count IS
+        the beyond-the-world count.
         """
         with self._lock:
-            if self._latest is None:
-                return 0 if not self._waiting else len(self._waiting)
-            return len(
-                [n for n in self._waiting if n not in self._latest.world]
-            )
+            return len(self._waiting)
 
     def _try_complete(self) -> None:
         # caller holds the lock
@@ -188,23 +217,27 @@ class RendezvousManager:
         # the backoff would only stretch every recovery by up to
         # waiting_timeout. Re-admit immediately. A removed member that
         # re-joins is a genuine membership change: full backoff.
-        fast = (
-            self._prev_world is not None
-            and not self._departed
-            and frozenset(self._waiting) == self._prev_world
+        # Both set comparisons run on the O(1) survivor count
+        # (maintained in join/remove_node): waiting == survivors iff
+        # every waiting node is a survivor AND the cardinalities match
+        # (DESIGN.md §22 — the frozenset rebuild this replaces was
+        # O(world) on every get_comm_world poll).
+        survivors = (
+            len(self._prev_world) - len(self._departed)
+            if self._prev_world is not None else -1
         )
+        waiting_is_survivor_set = (
+            self._prev_world is not None
+            and n == survivors
+            and self._waiting_survivors == n
+        )
+        fast = waiting_is_survivor_set and not self._departed
         # reshard fast path: every SURVIVOR of the previous round is
         # back and the only difference is the removed member(s). The
         # membership change is fully known — complete immediately and
         # mark the round a reshard event so agents/trainers take the
         # pre-compiled fallback-topology path instead of a cold compile.
-        reshard = (
-            not fast
-            and self._prev_world is not None
-            and bool(self._departed)
-            and frozenset(self._waiting)
-            == self._prev_world - self._departed
-        )
+        reshard = waiting_is_survivor_set and bool(self._departed)
         if n < self._max_nodes and not timed_out and not fast \
                 and not reshard:
             return
@@ -231,6 +264,9 @@ class RendezvousManager:
             self._waiting.pop(w.node_id, None)
         self._prev_world = frozenset(world)
         self._departed.clear()
+        # any node still waiting was NOT selected, so it is not in the
+        # new previous-round world: the survivor count restarts at zero
+        self._waiting_survivors = 0
         logger.info(
             "rdzv %s: round %d completed with %d nodes%s, coordinator %s",
             self.name, self._round, len(world),
@@ -263,6 +299,7 @@ class RendezvousManager:
     def clear_waiting(self) -> None:
         with self._lock:
             self._waiting.clear()
+            self._waiting_survivors = 0
 
 
 class NetworkCheckRendezvousManager(RendezvousManager):

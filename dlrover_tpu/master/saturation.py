@@ -1,0 +1,135 @@
+"""Master saturation telemetry: where does the control plane's time go.
+
+Every scale story funnels through one single-process master — rendezvous,
+the persist-ack ledger, metrics-snapshot ingest, the compile-cache LRU —
+and before it can be sharded or hierarchified (ROADMAP item 5) the
+instrument has to exist. This module provides the shared pieces the
+servicer and the hot master structures hang their attribution on:
+
+- ``TimedLock``: a drop-in ``threading.Lock`` wrapper that attributes
+  acquisition *wait* and *hold* time to a named hot structure
+  (``dlrover_tpu_master_lock_wait_seconds{structure}`` /
+  ``..._lock_hold_seconds{structure}``). Wait time rising under load is
+  the first visible symptom of a saturating master: handlers queue on
+  the structure before RPC latency blows up.
+- fine-grained latency buckets (``FINE_BUCKETS``): control-plane
+  handlers run in the µs–ms range; the registry's default buckets start
+  at 5 ms and would flatten every p99 into one bucket.
+- ``histogram_percentile``: conservative (upper-bound) percentile from
+  a bucketed sample, for the journal summary a real master emits at
+  job end.
+- ``journal_master_rpc``: one ``master_rpc`` journal point per RPC
+  type/lock/cost-center row, tagged with the node-count tier, which
+  ``telemetry/report.py`` folds into its ``master_saturation`` section.
+
+The fleet simulator (``dlrover_tpu/fleetsim``) emits the same
+``master_rpc`` rows from its own exact per-call measurements, so a
+simulated 5k-node run and a real job land in the same report section.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.metrics import registry
+
+# Control-plane handlers live in the µs-to-ms range; the top buckets
+# still catch a wedged structure (a lock held across storage I/O).
+FINE_BUCKETS = (
+    0.00001, 0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 5.0,
+)
+
+# exported: the servicer's saturation_rows() reads these back — a
+# single registration site keeps the metric-name lint contract
+lock_wait_seconds = registry().histogram(
+    "dlrover_tpu_master_lock_wait_seconds",
+    "time spent waiting to acquire a named hot master structure's lock "
+    "(rdzv / ack_ledger / metrics_registry / compile_cache_lru)",
+    label_names=("structure",),
+    buckets=FINE_BUCKETS,
+)
+lock_hold_seconds = registry().histogram(
+    "dlrover_tpu_master_lock_hold_seconds",
+    "time a named hot master structure's lock was held per acquisition",
+    label_names=("structure",),
+    buckets=FINE_BUCKETS,
+)
+
+
+class TimedLock:
+    """``threading.Lock`` with wait/hold attribution to one structure.
+
+    Context-manager and ``acquire``/``release`` compatible, so existing
+    ``with self._lock:`` call sites (and the lock-discipline analyzer
+    rule that reads them) are unchanged. The hold stamp is written only
+    by the current holder, so no extra synchronization is needed.
+    """
+
+    __slots__ = ("_lock", "_wait", "_hold", "_acquired_at")
+
+    def __init__(self, structure: str):
+        self._lock = threading.Lock()
+        self._wait = lock_wait_seconds.labels(structure)
+        self._hold = lock_hold_seconds.labels(structure)
+        self._acquired_at = 0.0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        t0 = time.monotonic()
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            now = time.monotonic()
+            self._wait.observe(now - t0)
+            self._acquired_at = now
+        return ok
+
+    def release(self) -> None:
+        held = time.monotonic() - self._acquired_at
+        self._lock.release()
+        self._hold.observe(held)
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> "TimedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def histogram_percentile(bounds, buckets, count: int, q: float) -> float:
+    """Upper-bound percentile of a bucketed histogram sample.
+
+    ``bounds`` are the finite bucket upper edges, ``buckets`` the
+    per-bucket (non-cumulative) counts including the +Inf bucket. The
+    +Inf bucket reports the largest finite bound (nothing tighter is
+    known). Conservative by construction: the true quantile is <= the
+    returned edge.
+    """
+    if count <= 0:
+        return 0.0
+    rank = q * count
+    cumulative = 0
+    for i, n in enumerate(buckets):
+        cumulative += n
+        if cumulative >= rank:
+            return float(bounds[i]) if i < len(bounds) \
+                else float(bounds[-1]) if bounds else 0.0
+    return float(bounds[-1]) if bounds else 0.0
+
+
+def journal_master_rpc(rows: list[dict], nodes: int = 0) -> None:
+    """Emit one ``master_rpc`` journal point per saturation row.
+
+    Each row carries at least ``rpc`` (an RPC message type, or a
+    synthetic cost center like ``lock/rdzv`` / ``snapshot_ingest``),
+    ``calls``, ``total_ms`` and ``p99_ms``; ``nodes`` tags the tier so
+    the report can compare cost centers across fleet sizes.
+    """
+    journal = get_journal()
+    for row in rows:
+        journal.emit("master_rpc", nodes=nodes, **row)

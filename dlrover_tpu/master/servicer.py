@@ -19,6 +19,12 @@ from dlrover_tpu.master.diagnosis import DiagnosisManager
 from dlrover_tpu.master.kv_store import CompileCacheService, KVStoreService
 from dlrover_tpu.master.node_manager import NodeManager
 from dlrover_tpu.master.rdzv_manager import RendezvousManager
+from dlrover_tpu.master.saturation import (
+    FINE_BUCKETS,
+    TimedLock,
+    histogram_percentile,
+    journal_master_rpc,
+)
 from dlrover_tpu.master.speed_monitor import SpeedMonitor
 from dlrover_tpu.master.task_manager import TaskManager
 
@@ -82,14 +88,14 @@ class MasterServicer:
         # the persist-ack ledger the rank-0 committer polls instead of
         # listing storage (DESIGN.md §20); bounded to the newest steps
         self._persist_acks: dict[tuple[int, int], dict[str, dict]] = {}
-        self._persist_lock = threading.Lock()
+        self._persist_lock = TimedLock("ack_ledger")
         self.max_persist_steps = 8
         self.trace_id = trace_id
-        # (node_id, role) -> last pushed registry snapshot
-        # (MetricsSnapshotRequest); rendered by the master's exposition
-        # endpoint with a per-node label
+        # (node_id, role) -> last merged registry snapshot
+        # (MetricsSnapshotRequest, delta pushes folded in); rendered by
+        # the master's exposition endpoint with a per-node label
         self._node_metrics: dict[tuple[int, str], list] = {}
-        self._node_metrics_lock = threading.Lock()
+        self._node_metrics_lock = TimedLock("metrics_registry")
         # continuous straggler detector (telemetry/anomaly.py), fed from
         # the same pushed snapshots; None = feature not wired
         self._anomaly = anomaly
@@ -100,17 +106,44 @@ class MasterServicer:
         self._rpc_seconds = registry().histogram(
             "dlrover_tpu_master_rpc_seconds",
             "master RPC dispatch latency by message type",
-            label_names=("type",),
+            label_names=("rpc",),
+            buckets=FINE_BUCKETS,
         )
         self._rpc_errors = registry().counter(
             "dlrover_tpu_master_rpc_errors_total",
             "master RPC dispatch failures by message type",
-            label_names=("type",),
+            label_names=("rpc",),
+        )
+        # handlers concurrently inside handle(): under a threaded RPC
+        # server this is the live queue depth — the saturation signal
+        # that rises BEFORE p99 does (DESIGN.md §22)
+        self._rpc_queue_depth = registry().gauge(
+            "dlrover_tpu_master_rpc_queue_depth",
+            "RPC handlers currently executing inside the master "
+            "servicer (threaded server: in-flight + queued-on-locks)",
+        )
+        self._snapshot_ingest = registry().histogram(
+            "dlrover_tpu_master_snapshot_ingest_seconds",
+            "cost of ingesting one MetricsSnapshotRequest push: merge "
+            "into the per-node store + straggler/tuner mining",
+            buckets=FINE_BUCKETS,
+        )
+        self._snapshot_pushes = registry().counter(
+            "dlrover_tpu_master_snapshot_push_total",
+            "metrics-snapshot pushes ingested, by wire kind "
+            "(full vs delta-compressed)",
+            label_names=("kind",),
+        )
+        self._snapshot_families = registry().counter(
+            "dlrover_tpu_master_snapshot_families_total",
+            "metric families carried by ingested snapshot pushes "
+            "(the ingest volume deltas suppress)",
         )
 
     # The single entry point handed to RpcServer: dispatch + telemetry.
     def handle(self, msg: Any) -> Any:
         msg_type = type(msg).__name__
+        self._rpc_queue_depth.inc()
         start = time.monotonic()
         try:
             return self._dispatch(msg)
@@ -121,10 +154,61 @@ class MasterServicer:
             self._rpc_seconds.labels(msg_type).observe(
                 time.monotonic() - start
             )
+            self._rpc_queue_depth.dec()
 
     def node_metrics_snapshots(self) -> dict[tuple[int, str], list]:
         with self._node_metrics_lock:
             return dict(self._node_metrics)
+
+    # ------------------------------------------------------- saturation
+
+    def saturation_rows(self) -> list[dict]:
+        """Per-cost-center rows of where the master's dispatch time went
+        (DESIGN.md §22): one row per RPC type from the dispatch
+        histogram, one per instrumented hot lock, one for snapshot
+        ingest. p99s are bucket upper bounds (conservative)."""
+        from dlrover_tpu.master.saturation import lock_wait_seconds
+
+        rows: list[dict] = []
+        bounds = self._rpc_seconds.buckets
+        for sample in self._rpc_seconds.samples():
+            rows.append({
+                "rpc": sample["labels"].get("rpc", ""),
+                "calls": sample["count"],
+                "total_ms": round(1000.0 * sample["sum"], 3),
+                "p99_ms": round(1000.0 * histogram_percentile(
+                    bounds, sample["buckets"], sample["count"], 0.99
+                ), 3),
+            })
+        lock_wait = lock_wait_seconds
+        for sample in lock_wait.samples():
+            rows.append({
+                "rpc": "lock/" + sample["labels"].get("structure", ""),
+                "calls": sample["count"],
+                "total_ms": round(1000.0 * sample["sum"], 3),
+                "p99_ms": round(1000.0 * histogram_percentile(
+                    lock_wait.buckets, sample["buckets"],
+                    sample["count"], 0.99
+                ), 3),
+            })
+        for sample in self._snapshot_ingest.samples():
+            rows.append({
+                "rpc": "snapshot_ingest",
+                "calls": sample["count"],
+                "total_ms": round(1000.0 * sample["sum"], 3),
+                "p99_ms": round(1000.0 * histogram_percentile(
+                    self._snapshot_ingest.buckets, sample["buckets"],
+                    sample["count"], 0.99
+                ), 3),
+            })
+        return [r for r in rows if r["calls"] > 0]
+
+    def journal_saturation(self, nodes: int = 0) -> None:
+        """Emit the saturation rows as ``master_rpc`` journal points for
+        the report's ``master_saturation`` section; ``nodes`` tags the
+        fleet-size tier (the simulator passes its profile's node count,
+        a real master the node-manager census)."""
+        journal_master_rpc(self.saturation_rows(), nodes=nodes)
 
     def _dispatch(self, msg: Any) -> Any:  # noqa: C901 - dispatch table
         if isinstance(msg, m.JoinRendezvousRequest):
@@ -218,8 +302,27 @@ class MasterServicer:
         if isinstance(msg, m.JobStatsRequest):
             return self._job_stats(msg)
         if isinstance(msg, m.MetricsSnapshotRequest):
+            ingest_start = time.monotonic()
+            key = (msg.node_id, msg.role)
             with self._node_metrics_lock:
-                self._node_metrics[(msg.node_id, msg.role)] = msg.samples
+                if msg.is_delta:
+                    # delta push: changed families only — fold into the
+                    # stored copy (telemetry/snapshot_delta.py); a
+                    # restarted master's empty base converges at the
+                    # pusher's next periodic full snapshot
+                    from dlrover_tpu.telemetry.snapshot_delta import (
+                        merge_snapshot,
+                    )
+
+                    self._node_metrics[key] = merge_snapshot(
+                        self._node_metrics.get(key, []), msg.samples
+                    )
+                else:
+                    self._node_metrics[key] = msg.samples
+            # miners get the PUSHED families, not the merged store: a
+            # family absent from a delta is unchanged, so its (sum,
+            # count) delta would be zero anyway — skipping it outright
+            # is both correct and the ingest saving deltas exist for
             if self._anomaly is not None:
                 # the straggler detector mines the step-duration series
                 # out of the same push (no-op for snapshots without it)
@@ -229,6 +332,13 @@ class MasterServicer:
                 # histograms the Young-Daly optimum needs
                 self._interval_tuner.observe_metrics_snapshot(msg.samples)
                 self._maybe_retune_snapshot_interval()
+            self._snapshot_pushes.labels(
+                "delta" if msg.is_delta else "full"
+            ).inc()
+            self._snapshot_families.inc(len(msg.samples))
+            self._snapshot_ingest.observe(
+                time.monotonic() - ingest_start
+            )
             return m.OkResponse()
         if isinstance(msg, m.DebugBundleReport):
             if not msg.timestamp:

@@ -99,12 +99,18 @@ def _phase_stats(samples: list) -> dict[str, tuple[float, int]]:
 class _NodeSeries:
     __slots__ = ("cum_sum", "cum_count", "points", "flagged", "streak",
                  "acted", "phase_cum", "phase_points", "phase",
-                 "gauge_phase")
+                 "gauge_phase", "_recent")
 
     def __init__(self, window: int):
         self.cum_sum = 0.0
         self.cum_count = 0
         self.points: deque[float] = deque(maxlen=window)
+        # cached median of ``points``, invalidated on append: the fleet
+        # evaluation runs on EVERY snapshot push and at 5k-10k nodes
+        # recomputing every node's window median per push is the
+        # dominant ingest cost (measured by fleetsim, DESIGN.md §22);
+        # one push appends to exactly one node's series
+        self._recent: float | None = None
         self.flagged = False
         self.streak = 0   # consecutive evaluations flagged
         self.acted = False  # a restart was already issued this episode
@@ -118,8 +124,14 @@ class _NodeSeries:
         self.phase = ""        # dominant phase while flagged
         self.gauge_phase = ""  # label the score gauge was last set under
 
+    def append_point(self, value: float) -> None:
+        self.points.append(value)
+        self._recent = None
+
     def recent(self) -> float:
-        return statistics.median(self.points)
+        if self._recent is None:
+            self._recent = statistics.median(self.points)
+        return self._recent
 
     def dominant_phase(self) -> str:
         """The phase eating the most per-step seconds in the recent
@@ -174,7 +186,7 @@ class StragglerDetector:
                 dsum, dcount = total, count
             series.cum_sum, series.cum_count = total, count
             if dcount > 0:
-                series.points.append(dsum / dcount)
+                series.append_point(dsum / dcount)
             for phase, (psum, pcount) in _phase_stats(samples).items():
                 prev = series.phase_cum.get(phase, (0.0, 0))
                 dps, dpc = psum - prev[0], pcount - prev[1]

@@ -25,8 +25,12 @@ efficiency** table (DESIGN.md §18) from the trainer's journaled
 ``metrics_sample``/``step_phase`` points: per-incarnation MFU,
 mean step time, %-of-samples host-blocked, and the phase breakdown —
 "where does a healthy step go" next to "where did the failures' time
-go". ``--format json`` emits the whole report as one stable-keyed
-document for bench/CI consumption.
+go" — and a **master saturation** table (DESIGN.md §22) from the
+``master_rpc`` points a real master emits at stop and the fleet
+simulator emits per run: per node-count tier, the dominant
+control-plane cost center with per-center totals and p99s. ``--format
+json`` emits the whole report as one stable-keyed document for
+bench/CI consumption.
 """
 
 from __future__ import annotations
@@ -202,6 +206,15 @@ class LostTimeReport:
     # "mfu_min", "mfu_max", "step_s_mean", "host_blocked_pct",
     # "phase_s": {phase: mean seconds}, "phase_pct": {phase: share}}
     efficiency: list[dict] = dataclasses.field(default_factory=list)
+    # master control-plane saturation per node-count tier (DESIGN.md
+    # §22), from the ``master_rpc`` points a real master emits at stop
+    # and the fleet simulator emits per run: {"nodes", "dominant",
+    # "dominant_total_ms", "total_ms": {center: ms}, "rpc_p99_ms":
+    # {center: ms}} — centers are RPC types, ``lock/<structure>``
+    # waits, and ``snapshot_ingest``
+    master_saturation: list[dict] = dataclasses.field(
+        default_factory=list
+    )
 
     def to_dict(self) -> dict:
         d = {
@@ -216,6 +229,7 @@ class LostTimeReport:
             "traces": self.traces,
             "incarnations": self.incarnations,
             "efficiency": self.efficiency,
+            "master_saturation": self.master_saturation,
         }
         if self.goodput_report is not None:
             d["goodput_report"] = self.goodput_report.to_dict()
@@ -303,6 +317,7 @@ def build_report(journal_path: str, goodput_log: str | None = None,
             goodput_log if greport is not None else None,
         ),
         efficiency=_efficiency_rows(spans),
+        master_saturation=_master_saturation_rows(spans),
     )
 
 
@@ -433,6 +448,50 @@ def _efficiency_rows(spans: list[Span]) -> list[dict]:
     return rows
 
 
+def _master_saturation_rows(spans: list[Span]) -> list[dict]:
+    """Control-plane saturation per node-count tier (DESIGN.md §22).
+
+    ``master_rpc`` journal points — one per cost center, emitted by a
+    real master at stop and by each fleet-simulator run — are grouped
+    by their ``nodes`` tier; within a tier the center with the largest
+    total handler time is named dominant. Repeated emissions for the
+    same (tier, center) keep the last one (cumulative counters: the
+    final emission supersedes earlier ones).
+    """
+    tiers: dict[int, dict[str, dict]] = {}
+    for span in spans:
+        if span.name != "master_rpc":
+            continue
+        center = str(span.fields.get("rpc", "") or "")
+        if not center:
+            continue
+        try:
+            tier = int(span.fields.get("nodes", 0) or 0)
+            row = {
+                "rpc": center,
+                "calls": int(span.fields.get("calls", 0) or 0),
+                "total_ms": float(span.fields.get("total_ms", 0.0)
+                                  or 0.0),
+                "p99_ms": float(span.fields.get("p99_ms", 0.0) or 0.0),
+            }
+        except (TypeError, ValueError):
+            continue
+        tiers.setdefault(tier, {})[center] = row
+    out: list[dict] = []
+    for tier in sorted(tiers):
+        rows = sorted(tiers[tier].values(),
+                      key=lambda r: (-r["total_ms"], r["rpc"]))
+        out.append({
+            "nodes": tier,
+            "dominant": rows[0]["rpc"],
+            "dominant_total_ms": rows[0]["total_ms"],
+            "total_ms": {r["rpc"]: r["total_ms"] for r in rows},
+            "rpc_p99_ms": {r["rpc"]: r["p99_ms"] for r in rows},
+            "calls": {r["rpc"]: r["calls"] for r in rows},
+        })
+    return out
+
+
 def _per_incarnation(spans: list[Span],
                      window: tuple[float, float] | None,
                      median: float,
@@ -530,6 +589,24 @@ def format_report(report: LostTimeReport) -> str:
                 f"  {cell(row.get('host_blocked_pct'), 13, '.1f')}"
                 f"  {phases}"
             )
+    if report.master_saturation:
+        lines.append("  master saturation (control-plane cost centers "
+                     "per node tier, DESIGN.md §22):")
+        for tier in report.master_saturation:
+            lines.append(
+                f"    {tier['nodes']:>6} nodes  dominant: "
+                f"{tier['dominant']} "
+                f"({tier['dominant_total_ms']:.1f} ms total)"
+            )
+            top = sorted(tier["total_ms"].items(),
+                         key=lambda kv: -kv[1])[:5]
+            for center, total_ms in top:
+                p99 = tier["rpc_p99_ms"].get(center, 0.0)
+                calls = tier["calls"].get(center, 0)
+                lines.append(
+                    f"      {center:<28} {total_ms:10.1f} ms"
+                    f"  p99 {p99:8.3f} ms  x{calls}"
+                )
     return "\n".join(lines)
 
 

@@ -1,0 +1,98 @@
+"""Delta-compressed metrics-snapshot pushes (DESIGN.md §22).
+
+A node's registry snapshot is ~50 families, but between two pushes only
+a handful change (the step/phase histograms while training, a couple of
+counters). Shipping the full snapshot on every heartbeat makes the
+master's ingest cost — deserialize, store, mine — proportional to the
+*registry size* times the fleet, when the information content is
+proportional to what *changed*. The fleet simulator's saturation bench
+(``bench.py control_plane``) measures exactly this.
+
+The delta is **unchanged-family suppression**, not value diffing: a
+family whose rendered content (its ``(sum, count)``/value samples)
+changed since the last acked push is sent in full — still cumulative,
+so master-side consumers that delta the ``(sum, count)`` themselves
+(``telemetry/anomaly.py``, ``checkpoint/interval_tuner.py``) read a
+delta-compressed push exactly like a full one; an unchanged family is
+simply omitted and the master keeps its last copy. Every
+``DLROVER_TPU_SNAPSHOT_FULL_EVERY``-th push (default 10) is a full
+snapshot so a restarted master — whose merge base is empty — converges
+within one period; ``0``/``1`` disables deltas entirely.
+
+Client side: ``SnapshotDeltaTracker`` (held per role inside
+``MasterClient``) prepares the payload and commits its base only after
+the RPC succeeded, so a lost push can never strand a family stale until
+the next full. Master side: ``merge_snapshot`` folds a delta into the
+stored per-node family list.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.common.envspec import get_int
+
+
+class SnapshotDeltaTracker:
+    """Per-(node, role) push-side state for delta-compressed snapshots.
+
+    Not thread-safe: one tracker belongs to one pushing loop (the
+    heartbeat thread, the trainer's report cadence).
+    """
+
+    def __init__(self, full_every: Optional[int] = None):
+        if full_every is None:
+            full_every = get_int(EnvKey.SNAPSHOT_FULL_EVERY) or 0
+        self.full_every = max(0, int(full_every))
+        self._base: dict[str, dict] = {}
+        self._pushes = 0
+        self._pending: Optional[dict[str, dict]] = None
+
+    @property
+    def enabled(self) -> bool:
+        return self.full_every > 1
+
+    def prepare(self, samples: list) -> tuple[list, bool]:
+        """(payload, is_delta) for one push; call ``commit()`` after the
+        RPC succeeds (an uncommitted prepare leaves the base untouched,
+        so the retry re-sends everything the master missed)."""
+        families = {
+            f.get("name", ""): f for f in samples if isinstance(f, dict)
+        }
+        self._pending = families
+        if not self.enabled or self._pushes % self.full_every == 0:
+            return samples, False
+        changed = [
+            fam for name, fam in families.items()
+            if self._base.get(name) != fam
+        ]
+        return changed, True
+
+    def commit(self) -> None:
+        if self._pending is not None:
+            self._base = self._pending
+            self._pending = None
+            self._pushes += 1
+
+    def reset(self) -> None:
+        """Force the next push full (e.g. after a reconnect to a master
+        that may have lost the merge base)."""
+        self._base = {}
+        self._pushes = 0
+        self._pending = None
+
+
+def merge_snapshot(base: list, delta: list) -> list:
+    """Fold a delta push into the stored family list, name-keyed.
+
+    Families present in the delta replace (or add to) the base; absent
+    families keep their last pushed content. The result is sorted by
+    family name — the same order ``MetricsRegistry.snapshot()`` ships —
+    so exposition output is independent of push history.
+    """
+    merged = {f.get("name", ""): f for f in base if isinstance(f, dict)}
+    for fam in delta:
+        if isinstance(fam, dict):
+            merged[fam.get("name", "")] = fam
+    return [merged[name] for name in sorted(merged)]

@@ -38,7 +38,9 @@ from native.analyze.core import (
     register,
 )
 
-_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+# TimedLock is master/saturation.py's instrumented threading.Lock
+# wrapper: same guard semantics, so it earns the same credit
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "TimedLock"}
 _MUTATOR_EXEMPT_METHODS = {"__init__", "__post_init__"}
 
 

@@ -73,6 +73,9 @@ DOCUMENTED_PREFIXES = (
     # MPMD pipeline runtime (DESIGN.md §21): the "one pipeline stage is
     # slow / recompiling" runbook keys on the per-stage families
     "dlrover_tpu_pipeline_",
+    # control-plane observatory (DESIGN.md §22): the "master is slow"
+    # runbook keys on the dispatch/lock/ingest attribution families
+    "dlrover_tpu_master_",
 )
 
 # label names that are themselves an operator contract (dashboards and

@@ -1,0 +1,327 @@
+"""Fleet simulator + master saturation telemetry (DESIGN.md §22).
+
+Pins the §22 contracts: seeded replay determinism (chaos-style trails),
+the 1k-node smoke inside the tier-1 budget with a bounded master RPC
+p99, RPC-surface conformance (simulated agents speak only the typed
+MasterClient surface), delta-compressed snapshot pushes (wire
+reduction + master-store convergence + full-every-K), and the
+``master_saturation`` report section fed by ``master_rpc`` journal
+rows.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import time
+
+import pytest
+
+from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.fleetsim import FleetProfile, FleetSimulator
+from dlrover_tpu.fleetsim.profile import smoke_profile
+
+FLEETSIM_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "dlrover_tpu", "fleetsim",
+)
+
+
+def small_profile(**overrides) -> FleetProfile:
+    base = dict(
+        name="unit", seed=77, nodes=120, duration_s=60.0,
+        join_window_s=1.0, snapshot_interval_s=15.0,
+        heartbeat_interval_s=20.0, straggler_frac=0.03,
+        straggler_factor=4.0, failures=1, deaths=1,
+        ckpt_interval_s=25.0,
+    )
+    base.update(overrides)
+    return FleetProfile(**base)
+
+
+@pytest.fixture(scope="module")
+def smoke_1k():
+    """One 1k-node run shared by the smoke/p99/flatness assertions."""
+    t0 = time.monotonic()
+    result = FleetSimulator(smoke_profile(1000)).run()
+    return result, time.monotonic() - t0
+
+
+# ------------------------------------------------------------ determinism
+
+
+def test_profile_json_roundtrip():
+    p = small_profile()
+    assert FleetProfile.from_json(p.to_json()) == p
+
+
+def test_seeded_determinism_identical_trails():
+    """Two runs of one seeded profile replay the exact same event
+    trail — including rendezvous round shapes, the failure and death
+    waves, ckpt storms, and the straggler verdicts the master's real
+    detector issued (the §22 analog of the chaos-trail assertion)."""
+    p = small_profile()
+    r1 = FleetSimulator(p).run()
+    r2 = FleetSimulator(FleetProfile.from_json(p.to_json())).run()
+    assert r1.trail == r2.trail
+    kinds = {e[0] for e in r1.trail["events"]}
+    # the trail exercised the paths it claims to: initial round, a
+    # restart-in-place wave (fast re-admit) and a shrink wave (reshard)
+    assert {"start", "round", "fail", "death", "ckpt_storm",
+            "end"} <= kinds
+    rounds = [e for e in r1.trail["events"] if e[0] == "round"]
+    assert len(rounds) >= 3
+    assert any(e[3] == 1 for e in rounds), "no reshard round in trail"
+    # seeded stragglers were actually flagged by the live detector
+    assert r1.stragglers_flagged == r2.stragglers_flagged
+    assert r1.stragglers_flagged, "stragglers never flagged"
+
+
+def test_deaths_shrink_world():
+    p = small_profile(nodes=40, failures=0, deaths=1,
+                      straggler_frac=0.0)
+    r = FleetSimulator(p).run()
+    assert r.rounds[0]["nodes"] == 40
+    assert r.rounds[-1]["nodes"] == 39
+    assert r.rounds[-1]["reshard"] is True
+
+
+# ----------------------------------------------------- 1k smoke + bounds
+
+
+def test_smoke_1k_completes_fast(smoke_1k):
+    result, wall = smoke_1k
+    assert result.rounds and result.rounds[0]["nodes"] == 1000
+    # tier-1 budget: the smoke leg must stay comfortably inside 30 s
+    assert wall < 30.0, f"1k smoke took {wall:.1f}s"
+
+
+def test_saturation_regression_p99_bound(smoke_1k):
+    """The §22 regression gate: master RPC p99 at 1k nodes under the
+    fixed smoke profile stays under a pinned bound. The measured value
+    on this container is ~1-3 ms; the bound leaves CI-noise headroom
+    while still catching an O(world)-per-event regression (which lands
+    in the tens of ms)."""
+    result, _ = smoke_1k
+    p99 = result.overall_p99_ms()
+    assert 0.0 < p99 < 25.0, f"master rpc p99 {p99:.2f}ms"
+    assert result.joins_per_s() > 500, result.rpc[
+        "JoinRendezvousRequest"]
+
+
+def test_join_cost_flat_across_tiers(smoke_1k):
+    """Join handling is O(1) per event: mean join handle time at 1k
+    nodes stays within a small factor of a 250-node fleet (pre-§22 the
+    fast-path comparison made it O(world) per poll)."""
+    result_1k, _ = smoke_1k
+    small = FleetSimulator(
+        small_profile(nodes=250, failures=0, deaths=0,
+                      straggler_frac=0.0, duration_s=30.0)
+    ).run()
+    lo, hi = small.join_mean_ms(), result_1k.join_mean_ms()
+    assert lo > 0 and hi > 0
+    assert hi < 1.0, f"join mean {hi:.3f}ms at 1k nodes"
+    assert hi / lo < 8.0, (
+        f"join cost grew {hi / lo:.1f}x from 250 to 1000 nodes "
+        f"({lo:.4f}ms -> {hi:.4f}ms)"
+    )
+
+
+# ------------------------------------------------- RPC-surface conformance
+
+
+def test_rpc_surface_conformance():
+    """Simulated agents speak ONLY the typed MasterClient surface: the
+    fleetsim package constructs no message dataclass and issues no raw
+    transport ``.call`` outside the loopback shim itself (the PR-8
+    ``rpc-contract`` rule then governs every method it uses)."""
+    for fname in sorted(os.listdir(FLEETSIM_DIR)):
+        if not fname.endswith(".py"):
+            continue
+        with open(os.path.join(FLEETSIM_DIR, fname),
+                  encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=fname)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                assert not (node.module or "").endswith(
+                    "common.messages"
+                ), f"{fname}: imports the raw message module"
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "call":
+                # the only legal .call is the loopback transport's own
+                # handler invocation surface used by MasterClient
+                assert fname == "sim.py" and isinstance(
+                    node.func.value, ast.Name
+                ), f"{fname}:{node.lineno}: raw transport .call"
+
+
+# ----------------------------------------------------- delta snapshots
+
+
+def test_snapshot_delta_tracker_contract():
+    from dlrover_tpu.telemetry.snapshot_delta import (
+        SnapshotDeltaTracker,
+        merge_snapshot,
+    )
+
+    def fam(name, value):
+        return {"name": name, "type": "counter", "help": "",
+                "buckets": [], "samples": [{"labels": {},
+                                            "value": value}]}
+
+    tracker = SnapshotDeltaTracker(full_every=3)
+    full = [fam("dlrover_tpu_a", 1.0), fam("dlrover_tpu_b", 1.0)]
+    payload, is_delta = tracker.prepare(full)
+    assert payload == full and not is_delta     # push 0: full
+    tracker.commit()
+    changed = [fam("dlrover_tpu_a", 2.0), fam("dlrover_tpu_b", 1.0)]
+    payload, is_delta = tracker.prepare(changed)
+    assert is_delta and [f["name"] for f in payload] == [
+        "dlrover_tpu_a"]                        # b unchanged: suppressed
+    # NOT committed (simulating a lost push): the same delta re-sends
+    payload2, _ = tracker.prepare(changed)
+    assert payload2 == payload
+    tracker.commit()
+    payload, is_delta = tracker.prepare(changed)
+    assert is_delta and payload == []           # nothing changed now
+    tracker.commit()
+    payload, is_delta = tracker.prepare(changed)
+    assert not is_delta                         # push 3: periodic full
+    # master-side merge: delta replaces named families, keeps the rest
+    merged = merge_snapshot(full, [fam("dlrover_tpu_a", 5.0)])
+    assert {f["name"]: f["samples"][0]["value"] for f in merged} == {
+        "dlrover_tpu_a": 5.0, "dlrover_tpu_b": 1.0,
+    }
+    # 0/1 disables deltas entirely
+    always_full = SnapshotDeltaTracker(full_every=1)
+    for _ in range(3):
+        _, is_delta = always_full.prepare(full)
+        always_full.commit()
+        assert not is_delta
+
+
+def test_delta_reduces_wire_and_converges():
+    """Same seeded profile, delta vs always-full: identical trails,
+    materially fewer snapshot wire bytes, and the master's merged
+    per-node store converges to the full family set."""
+    base = dict(nodes=100, failures=0, deaths=0, straggler_frac=0.0,
+                duration_s=60.0, snapshot_interval_s=10.0,
+                families=12, changed_families=2)
+    sim_delta = FleetSimulator(
+        small_profile(snapshot_full_every=10, **base))
+    r_delta = sim_delta.run()
+    sim_full = FleetSimulator(
+        small_profile(snapshot_full_every=1, **base))
+    r_full = sim_full.run()
+    assert r_delta.trail == r_full.trail
+    assert r_full.snapshot_wire_bytes() > 0
+    ratio = r_delta.snapshot_wire_bytes() / r_full.snapshot_wire_bytes()
+    assert ratio < 0.6, f"delta wire ratio {ratio:.2f}"
+    # convergence: the merged store serves the FULL family set for a
+    # node whose last pushes were deltas
+    merged = sim_delta._master.servicer.node_metrics_snapshots()[
+        (7, "agent")]
+    names = [f["name"] for f in merged]
+    assert len(names) == 12 and names == sorted(names)
+    by_name = {f["name"]: f["samples"][0]["value"] for f in merged}
+    # a changing family reflects its latest pushed value, a static one
+    # its original
+    assert by_name["dlrover_tpu_sim_family_00"] > 1.0
+    assert by_name["dlrover_tpu_sim_family_11"] == 1.0
+
+
+def test_servicer_counts_push_kinds():
+    from dlrover_tpu.telemetry.metrics import registry
+
+    pushes = registry().counter(
+        "dlrover_tpu_master_snapshot_push_total",
+        label_names=("kind",),
+    )
+    full0 = pushes.labels("full").value
+    delta0 = pushes.labels("delta").value
+    sim = FleetSimulator(small_profile(
+        nodes=30, failures=0, deaths=0, straggler_frac=0.0,
+        duration_s=60.0, snapshot_interval_s=10.0,
+    ))
+    sim.run()
+    assert pushes.labels("full").value > full0
+    assert pushes.labels("delta").value > delta0
+
+
+# ------------------------------------------------ saturation attribution
+
+
+def test_timed_lock_attributes_wait_and_hold():
+    from dlrover_tpu.master.saturation import (
+        TimedLock,
+        lock_hold_seconds,
+        lock_wait_seconds,
+    )
+
+    lock = TimedLock("unit_test_structure")
+    wait = lock_wait_seconds.labels("unit_test_structure")
+    hold = lock_hold_seconds.labels("unit_test_structure")
+    with lock:
+        pass
+    assert wait.count == 1 and hold.count == 1
+    assert lock.acquire(blocking=False)
+    assert not lock.acquire(blocking=False)
+    lock.release()
+    assert wait.count == 2 and hold.count == 2
+
+
+def test_histogram_percentile_upper_bound():
+    from dlrover_tpu.master.saturation import histogram_percentile
+
+    bounds = (0.001, 0.01, 0.1)
+    # 90 obs <=1ms, 9 <=10ms, 1 in +Inf
+    assert histogram_percentile(bounds, [90, 9, 0, 1], 100, 0.5) \
+        == 0.001
+    assert histogram_percentile(bounds, [90, 9, 0, 1], 100, 0.98) \
+        == 0.01
+    assert histogram_percentile(bounds, [90, 9, 0, 1], 100, 1.0) == 0.1
+    assert histogram_percentile(bounds, [], 0, 0.99) == 0.0
+
+
+def test_master_saturation_report_section(tmp_path, monkeypatch):
+    """Simulator runs journal ``master_rpc`` rows; the report folds
+    them into a per-tier ``master_saturation`` section naming the
+    dominant cost center — present in both to_dict (json CLI) and the
+    text rendering."""
+    from dlrover_tpu.telemetry.report import build_report, format_report
+
+    monkeypatch.setenv(EnvKey.JOURNAL_DIR, str(tmp_path))
+    sim = FleetSimulator(small_profile(
+        nodes=60, failures=1, deaths=0, straggler_frac=0.0,
+        duration_s=45.0,
+    ))
+    sim.run()
+    report = build_report(str(tmp_path))
+    assert report.master_saturation, "no master_rpc rows surfaced"
+    tier = report.master_saturation[-1]
+    assert tier["nodes"] == 60
+    assert tier["dominant"] in tier["total_ms"]
+    assert "JoinRendezvousRequest" in tier["rpc_p99_ms"]
+    assert any(c.startswith("lock/") for c in tier["total_ms"]), \
+        "lock wait rows missing"
+    assert report.to_dict()["master_saturation"]
+    text = format_report(report)
+    assert "master saturation" in text and tier["dominant"] in text
+
+
+def test_fleetsim_events_journaled(tmp_path, monkeypatch):
+    import json as _json
+
+    monkeypatch.setenv(EnvKey.JOURNAL_DIR, str(tmp_path))
+    FleetSimulator(small_profile(
+        nodes=25, failures=1, deaths=0, straggler_frac=0.0,
+        duration_s=40.0,
+    )).run()
+    events = []
+    with open(tmp_path / "events.jsonl", encoding="utf-8") as f:
+        for line in f:
+            events.append(_json.loads(line))
+    kinds = {e.get("kind") for e in events
+             if e.get("name") == "fleetsim_event"}
+    assert {"start", "round", "fail", "end"} <= kinds

@@ -374,7 +374,7 @@ def test_metrics_snapshot_rpc_and_master_aggregation(tmp_path, monkeypatch):
         assert 'dlrover_tpu_pushed_total{node="3",role="agent"} 4' in text
         # master's own dispatch histogram saw the snapshot RPC
         assert ('dlrover_tpu_master_rpc_seconds_count'
-                '{role="master",type="MetricsSnapshotRequest"}') in text
+                '{role="master",rpc="MetricsSnapshotRequest"}') in text
     finally:
         master._server._server.server_close()
 
