@@ -1644,106 +1644,287 @@ def bench_serving(extra: dict) -> None:
 
 
 def bench_gateway(extra: dict) -> None:
-    """Elastic serving gateway under open-loop load with a mid-run
-    replica kill (gateway/: pool + router + admission + autoscaler).
+    """Disagg-vs-unified A/B over an open-loop MULTI-TENANT trace with
+    per-tenant SLO accounting (gateway/: prefill+decode pools, paged
+    KV, chunked admission — DESIGN.md §23).
 
-    2 x gpt2-small replicas, seeded Poisson-ish open-loop arrivals.
-    Halfway through the request schedule one replica is killed
-    abruptly; the acceptance bar is ZERO failed in-flight requests
-    (orphans re-route to the survivor, minted seeds keep results
-    identical) while the autoscaler restores the replica count through
-    the ScalePlan path. Reported: completed req/s over the measured
-    window and p95 end-to-end latency — both including the kill, which
-    is the point.
+    Three tenant shapes stress different pools: `chat` (shared system
+    prompt, medium decode — the prefix-cache/affinity shape),
+    `summarize` (long prefill, short decode — the TTFT killer) and
+    `generate` (short prompt, long decode — the slot pinner). The same
+    seeded trace runs against a unified gateway and a disaggregated
+    one (prefill pool + paged decode pool); per tenant we report TTFT
+    p95 (submit -> first token), inter-token p95 (per-token arrival
+    stamps) and goodput (fraction meeting the tenant's TTFT SLO). The
+    disagg leg keeps the PR-2 mid-run replica kill (zero failed
+    requests, autoscaler restore). The acceptance bound — decode stall
+    during a long-prompt admission <= one prefill chunk — is asserted
+    from the `dlrover_tpu_engine_decode_stall_seconds` histogram,
+    expressed in single-chunk units.
+
+    Runs on CPU with the tiny config (same structure, smaller trace)
+    so the A/B evidence exists in every container; gpt2-small on TPU.
     """
     if os.environ.get("BENCH_GATEWAY", "1") == "0":
         return
     import jax
 
-    if jax.devices()[0].platform != "tpu":
-        return
-
-    from dlrover_tpu.gateway import Gateway, GatewayAutoscaler, PoolScaler
+    from dlrover_tpu.gateway import (
+        DisaggAutoscaler,
+        Gateway,
+        GatewayAutoscaler,
+        PoolScaler,
+    )
     from dlrover_tpu.models import transformer as tfm
     from dlrover_tpu.serving import InferenceEngine, SamplingParams
+    from dlrover_tpu.serving import engine as engine_mod
 
-    cfg = tfm.CONFIGS["gpt2-small"]
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        cfg = tfm.CONFIGS["gpt2-small"]
+        geo = dict(slots=4, max_len=256, prefill_len=64,
+                   decode_block=8, kv_pages=48)
+        n_requests, rate_hz, replicas = 48, 8.0, 2
+        # (prompt_len, max_new) per tenant shape; sys prefix for chat
+        shapes = {"chat": (32, 32), "summarize": (192, 8),
+                  "generate": (16, 96)}
+        sys_len = 128
+        ttft_slo = {"chat": 2.0, "summarize": 4.0, "generate": 2.0}
+    else:
+        cfg = tfm.CONFIGS["tiny"]
+        geo = dict(slots=2, max_len=64, prefill_len=8,
+                   decode_block=4, kv_pages=24)
+        # burst arrivals into ONE decode replica: the queueing regime
+        # where slot policy (dense pinning vs paged fair-share)
+        # decides TTFT — at lower offered load the tiny model never
+        # queues and both legs measure pure noise
+        n_requests, rate_hz, replicas = 36, 200.0, 1
+        shapes = {"chat": (4, 8), "summarize": (40, 4),
+                  "generate": (2, 48)}
+        sys_len = 16
+        ttft_slo = {"chat": 2.5, "summarize": 4.0, "generate": 2.5}
+    P = geo["prefill_len"]
     params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+
+    def make_factory(kv_pages):
+        def engine_factory():
+            return InferenceEngine(params, cfg, prefix_cache_entries=8,
+                                   **dict(geo, kv_pages=kv_pages))
+        return engine_factory
+
+    # the seeded multi-tenant trace, shared verbatim by both legs:
+    # chat = shared-system-prompt + medium decode, summarize =
+    # long-prefill short-decode, generate = short-prompt long-decode
     rng = np.random.default_rng(0)
-
-    def engine_factory():
-        return InferenceEngine(params, cfg, slots=4, max_len=256,
-                               prefill_len=64, decode_block=8,
-                               prefix_cache_entries=8)
-
-    gateway = Gateway(engine_factory, replicas=2, prefill_len=64,
-                      admission_deadline_s=120.0,
-                      health_interval_s=0.2, seed=0)
-    autoscaler = None
-    try:
-        deadline = time.monotonic() + 120
-        while (len(gateway.pool.ready_replicas()) < 2
-               and time.monotonic() < deadline):
-            time.sleep(0.2)
-        # warmup wave: compiles all three programs on both replicas
-        warm = [gateway.submit(
-            list(rng.integers(0, cfg.vocab_size, 32)),
-            SamplingParams(temperature=0.8, max_new_tokens=8),
-        ) for _ in range(4)]
-        for f in warm:
-            f.result(timeout=300)
-
-        autoscaler = GatewayAutoscaler(
-            gateway, PoolScaler(gateway.pool), min_replicas=2,
-            max_replicas=2, interval_s=0.5,
-        ).start()
-
-        n_requests, rate_hz = 48, 4.0
+    system_prompt = list(rng.integers(0, cfg.vocab_size, sys_len))
+    tenants = ("chat", "summarize", "generate")
+    trace = []
+    for i in range(n_requests):
+        tenant = tenants[i % 3]
+        plen, max_new = shapes[tenant]
+        prompt = list(rng.integers(0, cfg.vocab_size, plen))
+        if tenant == "chat":
+            prompt = system_prompt + prompt
         sp = SamplingParams(temperature=0.8, top_p=0.95,
-                            max_new_tokens=32)
-        futures, failed = [], 0
-        t0 = time.monotonic()
-        kill_at = n_requests // 2
-        for i in range(n_requests):
-            # open loop: arrivals keyed to the clock, not completions
-            target_t = t0 + i / rate_hz
-            delay = target_t - time.monotonic()
-            if delay > 0:
-                time.sleep(delay)
-            if i == kill_at:
-                ready = gateway.pool.ready_replicas()
-                if ready:
-                    extra["gateway_kill_orphans"] = \
-                        gateway.pool.kill_replica(ready[0].id)
-            futures.append(gateway.submit(
-                list(rng.integers(0, cfg.vocab_size, 32)), sp,
-            ))
-        latencies = []
-        for f in futures:
-            try:
-                latencies.append(f.result(timeout=300).total_s)
-            except Exception:  # noqa: BLE001 - count, don't crash
-                failed += 1
-        wall = time.monotonic() - t0
-        latencies.sort()
-        extra["gateway_req_per_s"] = round(len(latencies) / wall, 2)
-        extra["gateway_p95_s"] = round(
-            latencies[int(0.95 * (len(latencies) - 1))], 3
-        ) if latencies else None
-        extra["gateway_failed"] = failed
-        restore_deadline = time.monotonic() + 60
-        while (gateway.pool.live_count() < 2
-               and time.monotonic() < restore_deadline):
-            time.sleep(0.2)
-        extra["gateway_replicas_restored"] = gateway.pool.live_count()
-        extra["gateway_config"] = (
-            "gpt2-small x2 slots=4 prompt=32 gen=32 "
-            f"rate={rate_hz}/s kill@{kill_at}"
+                            max_new_tokens=max_new)
+        trace.append((i / rate_hz, tenant, prompt, sp))
+
+    def pctl(values, q):
+        if not values:
+            return None
+        values = sorted(values)
+        return values[int(q * (len(values) - 1))]
+
+    stall_bounds = engine_mod._decode_stall_seconds.buckets
+
+    def stall_buckets():
+        samp = engine_mod._decode_stall_seconds.samples()
+        return (list(samp[0]["buckets"]) if samp
+                else [0] * (len(stall_bounds) + 1))
+
+    def run_leg(disagg: bool) -> dict:
+        # the unified leg runs the PR-2 data plane (dense slots, no
+        # pool split) as the A/B baseline; the disagg leg runs the §23
+        # plane (paged decode pool + dedicated prefill pool). Token
+        # identity between the two is pinned by tests/test_disagg.py —
+        # this measures latency shape, not correctness.
+        gateway = Gateway(
+            make_factory(geo["kv_pages"] if disagg else 0),
+            replicas=replicas, prefill_len=P,
+            prefill_replicas=1 if disagg else 0,
+            admission_deadline_s=300.0, health_interval_s=0.2, seed=0,
         )
-    finally:
-        if autoscaler is not None:
-            autoscaler.stop()
-        gateway.stop()
+        autoscaler = None
+        try:
+            deadline = time.monotonic() + 180
+            while (len(gateway.pool.ready_replicas()) < replicas
+                   and time.monotonic() < deadline):
+                time.sleep(0.2)
+            if disagg:
+                while (len(gateway.prefill_pool.ready_replicas()) < 1
+                       and time.monotonic() < deadline):
+                    time.sleep(0.2)
+            # warmup wave: compiles prefill/install/step on every pool
+            # — slots+1 concurrent medium decodes also force one
+            # park/resume cycle on the paged leg, so the gather/scatter
+            # jits never compile inside the measured trace
+            warm = [gateway.submit(
+                trace[j][2], SamplingParams(
+                    temperature=0.8,
+                    max_new_tokens=min(geo["prefill_len"] + 4, 12)),
+            ) for j in range(geo["slots"] + 1)]
+            for f in warm:
+                f.result(timeout=300)
+            if disagg:
+                autoscaler = DisaggAutoscaler(
+                    gateway,
+                    PoolScaler(gateway.prefill_pool, group="prefill"),
+                    PoolScaler(gateway.pool, group="decode"),
+                    min_prefill=1, max_prefill=1,
+                    min_decode=replicas, max_decode=replicas,
+                    interval_s=0.5,
+                ).start()
+            else:
+                autoscaler = GatewayAutoscaler(
+                    gateway, PoolScaler(gateway.pool),
+                    min_replicas=replicas, max_replicas=replicas,
+                    interval_s=0.5,
+                ).start()
+            stall_start = stall_buckets()
+            futures, failed = [], 0
+            t0 = time.monotonic()
+            for _, (t_off, tenant, prompt, sp) in enumerate(trace):
+                # open loop: arrivals keyed to the clock, not
+                # completions
+                delay = t0 + t_off - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                futures.append((tenant, gateway.submit(prompt, sp)))
+            # kill when most of the backlog has drained, in BOTH legs:
+            # A/B symmetry, zero-drop evidence, and a pre-kill stall
+            # window untainted by the replacement replica's compiles
+            kill_deadline = time.monotonic() + 120
+            while (gateway.admission.pending > n_requests // 4
+                   and time.monotonic() < kill_deadline):
+                time.sleep(0.02)
+            stall_prekill = stall_buckets()
+            ready = gateway.pool.ready_replicas()
+            if ready:
+                orphans = gateway.pool.kill_replica(ready[0].id)
+                if disagg:
+                    extra["gateway_kill_orphans"] = orphans
+            per_tenant = {t: {"ttft": [], "itl": [], "ok": 0, "n": 0}
+                          for t in tenants}
+            latencies = []
+            for tenant, fut in futures:
+                rec = per_tenant[tenant]
+                rec["n"] += 1
+                try:
+                    res = fut.result(timeout=300)
+                except Exception:  # noqa: BLE001 - count, don't crash
+                    failed += 1
+                    continue
+                latencies.append(res.total_s)
+                ttft = res.queue_s + res.prefill_s
+                rec["ttft"].append(ttft)
+                rec["itl"].extend(
+                    b - a for a, b in zip(res.token_times,
+                                          res.token_times[1:]))
+                if ttft <= ttft_slo[tenant]:
+                    rec["ok"] += 1
+            wall = time.monotonic() - t0
+            leg = {
+                "req_per_s": round(len(latencies) / wall, 2),
+                "p95_s": round(pctl(latencies, 0.95), 3)
+                if latencies else None,
+                "failed": failed,
+                "ttft_p95_s": round(pctl(
+                    [t for r in per_tenant.values()
+                     for t in r["ttft"]], 0.95) or 0.0, 3),
+                "itl_p95_s": round(pctl(
+                    [t for r in per_tenant.values()
+                     for t in r["itl"]], 0.95) or 0.0, 4),
+                "tenants": {
+                    t: {
+                        "ttft_p95_s": round(
+                            pctl(rec["ttft"], 0.95) or 0.0, 3),
+                        "itl_p95_s": round(
+                            pctl(rec["itl"], 0.95) or 0.0, 4),
+                        "goodput": round(rec["ok"] / rec["n"], 3)
+                        if rec["n"] else None,
+                    }
+                    for t, rec in per_tenant.items()
+                },
+            }
+            leg["stall_delta"] = [
+                b - a for a, b in zip(stall_start, stall_prekill)]
+            restore_deadline = time.monotonic() + 60
+            while (gateway.pool.live_count() < replicas
+                   and time.monotonic() < restore_deadline):
+                time.sleep(0.2)
+            leg["replicas_restored"] = gateway.pool.live_count()
+            return leg
+        finally:
+            if autoscaler is not None:
+                autoscaler.stop()
+            gateway.stop()
+
+    # one-chunk reference time: the unit of the stall-bound assertion
+    probe = make_factory(0)()
+    run = probe.prefill_begin(list(rng.integers(0, cfg.vocab_size, P)))
+    probe.prefill_step(run)                      # compile
+    run2 = probe.prefill_begin(list(rng.integers(0, cfg.vocab_size, P)))
+    t0 = time.monotonic()
+    probe.prefill_step(run2)
+    chunk_s = time.monotonic() - t0
+    del probe
+
+    unified = run_leg(disagg=False)
+    disagg = run_leg(disagg=True)
+
+    # decode-stall p99 from the disagg leg's PRE-KILL histogram delta,
+    # expressed in single-chunk units: the tentpole's bounded-stall
+    # acceptance (<= 1 chunk by construction; conservative bucket
+    # upper bounds absorb scheduler noise)
+    delta = disagg["stall_delta"]
+    total = sum(delta)
+    p99_s = 0.0
+    if total:
+        acc = 0
+        for i, n in enumerate(delta):
+            acc += n
+            if acc >= 0.99 * total:
+                p99_s = float(
+                    stall_bounds[min(i, len(stall_bounds) - 1)])
+                break
+    extra["gateway_stall_p99_s"] = round(p99_s, 4)
+    extra["gateway_chunk_s"] = round(chunk_s, 4)
+    extra["gateway_stall_p99_bound_chunks"] = round(
+        p99_s / max(chunk_s, 1e-6), 2)
+
+    extra["gateway_req_per_s"] = disagg["req_per_s"]
+    extra["gateway_p95_s"] = disagg["p95_s"]
+    extra["gateway_failed"] = unified["failed"] + disagg["failed"]
+    extra["gateway_replicas_restored"] = disagg.get(
+        "replicas_restored")
+    extra["gateway_ttft_p95_s"] = disagg["ttft_p95_s"]
+    extra["gateway_itl_p95_s"] = disagg["itl_p95_s"]
+    extra["gateway_ttft_p95_unified_s"] = unified["ttft_p95_s"]
+    if disagg["ttft_p95_s"]:
+        extra["gateway_disagg_ttft_speedup"] = round(
+            unified["ttft_p95_s"] / disagg["ttft_p95_s"], 2)
+    for t in tenants:
+        for k, v in disagg["tenants"][t].items():
+            extra[f"gateway_{t}_{k}"] = v
+        extra[f"gateway_{t}_ttft_p95_unified_s"] = \
+            unified["tenants"][t]["ttft_p95_s"]
+    extra["gateway_config"] = (
+        f"{'gpt2-small' if on_tpu else 'tiny'} decode x{replicas} + "
+        f"prefill x1 slots={geo['slots']} kv_pages={geo['kv_pages']} "
+        f"P={P} rate={rate_hz}/s n={n_requests} "
+        f"kill@backlog<{n_requests // 4} (both legs) vs unified "
+        f"x{replicas} dense"
+    )
 
 
 def bench_int8(extra: dict) -> None:
@@ -1930,7 +2111,7 @@ STAGES = [
           pass_budget=True),
     Stage("mfu", bench_train_step, est_s=170, deadline_s=520),
     Stage("serving", bench_serving, est_s=200, deadline_s=340),
-    Stage("gateway", bench_gateway, est_s=80, deadline_s=240),
+    Stage("gateway", bench_gateway, est_s=120, deadline_s=300),
     Stage("soak", bench_soak, est_s=105, deadline_s=160,
           pass_budget=True),
     Stage("chaos", bench_chaos, est_s=130, deadline_s=300,
@@ -1967,7 +2148,9 @@ HEADLINE_KEYS = [
     "ckpt1b_copy_s", "ckpt1b_restore_s", "ckpt1b_persist_parallel_s",
     "ckpt1b_restore_parallel_s", "serving_toks_per_s",
     "serving_prefix_cache_speedup", "gateway_req_per_s",
-    "gateway_p95_s", "gateway_failed",
+    "gateway_p95_s", "gateway_failed", "gateway_ttft_p95_s",
+    "gateway_itl_p95_s", "gateway_ttft_p95_unified_s",
+    "gateway_disagg_ttft_speedup", "gateway_stall_p99_bound_chunks",
     "int8_ffn_speedup", "soak_completed", "soak_kills",
     "chaos_completed", "chaos_recovery_seconds", "chaos_goodput",
     "cp_master_rpc_p99_ms_n1000", "cp_master_rpc_p99_ms_n5000",

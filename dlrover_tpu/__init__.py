@@ -46,6 +46,9 @@ _LAZY_API = {
                           "TrainingArguments"),
     "InferenceEngine": ("dlrover_tpu.serving.engine", "InferenceEngine"),
     "SamplingParams": ("dlrover_tpu.serving.engine", "SamplingParams"),
+    # disaggregated serving (DESIGN.md §23)
+    "KVBundle": ("dlrover_tpu.serving.engine", "KVBundle"),
+    "PrefillEngine": ("dlrover_tpu.serving.prefill", "PrefillEngine"),
     "generate": ("dlrover_tpu.models.decode", "generate"),
     "PackedTokenDataset": ("dlrover_tpu.trainer.token_dataset",
                            "PackedTokenDataset"),

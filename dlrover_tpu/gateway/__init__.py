@@ -2,6 +2,8 @@
 telemetry-driven autoscaling over ``serving.InferenceEngine``."""
 
 from dlrover_tpu.gateway.autoscale import (  # noqa: F401
+    DisaggAutoscaler,
+    DisaggSignals,
     GatewayAutoscaler,
     GatewaySignals,
     p95_from_buckets,
@@ -14,11 +16,20 @@ from dlrover_tpu.gateway.pool import (  # noqa: F401
     ReplicaState,
     RequestWork,
 )
-from dlrover_tpu.gateway.router import Router  # noqa: F401
+from dlrover_tpu.gateway.router import Router, ShardRing  # noqa: F401
 from dlrover_tpu.gateway.server import (  # noqa: F401
     AdmissionController,
     AdmissionError,
     Gateway,
     GatewayHTTPServer,
     GatewayResult,
+)
+from dlrover_tpu.serving import (  # noqa: F401
+    InferenceEngine,
+    KVBundle,
+    PrefillEngine,
+    PrefillResult,
+    Request,
+    Result,
+    SamplingParams,
 )

@@ -42,8 +42,8 @@ logger = get_logger(__name__)
 
 _scale_events = registry().counter(
     "dlrover_tpu_gateway_scale_events_total",
-    "autoscaler plans issued, by direction",
-    label_names=("direction",),
+    "autoscaler plans issued, by direction and pool",
+    label_names=("direction", "pool"),
 )
 
 
@@ -178,7 +178,7 @@ class GatewayAutoscaler:
         target = self.decide(sig)
         if before is not None and target != before:
             direction = "up" if target > before else "down"
-            _scale_events.labels(direction).inc()
+            _scale_events.labels(direction, "serving").inc()
             logger.info(
                 "gateway scale %s: %d -> %d (queue=%d occ=%.2f "
                 "p95=%.2fs)", direction, before, target,
@@ -187,7 +187,7 @@ class GatewayAutoscaler:
         elif sig.live < target:
             # a replica died (kill/preempt): restore the count even
             # though load signals alone wouldn't trigger a plan
-            _scale_events.labels("restore").inc()
+            _scale_events.labels("restore", "serving").inc()
             logger.warning("gateway restore: %d live < target %d",
                            sig.live, target)
         elif sig.live == target:
@@ -200,3 +200,194 @@ class GatewayAutoscaler:
                    f"occ={sig.slot_occupancy:.2f}, "
                    f"p~{sig.p95_s:.2f}s)",
         ))
+
+
+# --------------------------------------------------- disaggregated pools
+
+
+@dataclasses.dataclass
+class DisaggSignals:
+    """One tick's view of a disaggregated gateway: the PREFILL pool is
+    sized by its prompt backlog, the DECODE pool by slot occupancy /
+    admitted queue — two different saturation modes that must not
+    thrash against one shared signal."""
+
+    prefill_backlog: int       # prompts queued/in-flight in prefill pool
+    prefill_live: int
+    decode_queue: int          # bundles awaiting a decode slot
+    decode_occupancy: float
+    decode_live: int
+    slots_per_replica: int = 8
+    p95_s: float = 0.0
+
+
+class _PoolPolicy:
+    """Per-pool hysteresis: up on one hot tick, down only after
+    ``down_ticks`` consecutive cold ones (a replica build compiles)."""
+
+    def __init__(self, min_replicas: int, max_replicas: int,
+                 down_ticks: int):
+        if min_replicas < 0 or max_replicas < min_replicas:
+            raise ValueError("need 0 <= min_replicas <= max_replicas")
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self._down_ticks = down_ticks
+        self._cold_streak = 0
+        self.target: int | None = None
+
+    def decide(self, hot: bool, cold: bool, live: int) -> int:
+        if self.target is None:
+            self.target = min(self.max_replicas,
+                              max(self.min_replicas, live))
+        if hot:
+            self._cold_streak = 0
+            self.target = min(self.max_replicas, self.target + 1)
+        elif cold:
+            self._cold_streak += 1
+            if self._cold_streak >= self._down_ticks:
+                self._cold_streak = 0
+                self.target = max(self.min_replicas, self.target - 1)
+        else:
+            self._cold_streak = 0
+        return self.target
+
+
+class DisaggAutoscaler:
+    """Scale prefill and decode pools independently through one
+    ScalePlan: ``replica_resources={"prefill": P, "decode": D}``,
+    executed by each pool's ``PoolScaler`` (group "prefill" /
+    "decode"). Prefill-bound load (deep prompt backlog, idle decode
+    slots) grows only the prefill pool; decode-bound load (high slot
+    occupancy, empty prefill queue) grows only the decode pool.
+    """
+
+    def __init__(self, gateway, prefill_scaler: Scaler,
+                 decode_scaler: Scaler, *,
+                 min_prefill: int = 1, max_prefill: int = 4,
+                 min_decode: int = 1, max_decode: int = 4,
+                 interval_s: float = 2.0,
+                 target_p95_s: float = 0.0,
+                 up_occupancy: float = 0.85,
+                 down_occupancy: float = 0.3,
+                 backlog_per_prefill: float = 2.0,
+                 down_ticks: int = 3,
+                 signals_fn: Callable[[], DisaggSignals] | None = None):
+        self._gateway = gateway
+        self._prefill_scaler = prefill_scaler
+        self._decode_scaler = decode_scaler
+        self._interval_s = interval_s
+        self.target_p95_s = target_p95_s
+        self._up_occupancy = up_occupancy
+        self._down_occupancy = down_occupancy
+        self._backlog_per_prefill = backlog_per_prefill
+        self.prefill_policy = _PoolPolicy(min_prefill, max_prefill,
+                                          down_ticks)
+        self.decode_policy = _PoolPolicy(min_decode, max_decode,
+                                         down_ticks)
+        self._signals_fn = signals_fn
+        self._prev_buckets: list[int] | None = None
+        self._stopped = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> "DisaggAutoscaler":
+        self._thread = threading.Thread(
+            target=self._loop, name="gateway-disagg-autoscaler",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def _loop(self) -> None:
+        while not self._stopped.wait(self._interval_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 - planning must not die
+                logger.exception("disagg autoscale tick failed")
+
+    # ------------------------------------------------------------- signals
+
+    def _signals(self) -> DisaggSignals:
+        if self._signals_fn is not None:
+            return self._signals_fn()
+        gw = self._gateway
+        bounds, buckets, _count, _sum = gw.request_hist_snapshot()
+        prev = self._prev_buckets or [0] * len(buckets)
+        delta = [max(0, b - p) for b, p in zip(buckets, prev)]
+        self._prev_buckets = buckets
+        wait_prefill, wait_decode = gw.undispatched_counts()
+        slots_total = gw.pool.slots_total()
+        decode_live = gw.pool.live_count()
+        return DisaggSignals(
+            prefill_backlog=(gw.prefill_pool.outstanding_total()
+                             + wait_prefill),
+            prefill_live=gw.prefill_pool.live_count(),
+            decode_queue=wait_decode,
+            decode_occupancy=gw.pool.occupancy(),
+            decode_live=decode_live,
+            slots_per_replica=max(
+                1, slots_total // max(1, decode_live)),
+            p95_s=p95_from_buckets(bounds, delta),
+        )
+
+    # ------------------------------------------------------------ decision
+
+    def decide(self, sig: DisaggSignals) -> tuple[int, int]:
+        """Pure policy: (prefill target, decode target)."""
+        prefill_hot = (
+            sig.prefill_backlog
+            > self._backlog_per_prefill * max(1, sig.prefill_live)
+        )
+        prefill_cold = sig.prefill_backlog == 0
+        decode_hot = (
+            sig.decode_occupancy > self._up_occupancy
+            or sig.decode_queue
+            > sig.slots_per_replica * max(1, sig.decode_live)
+            or (self.target_p95_s > 0
+                and sig.p95_s > self.target_p95_s)
+        )
+        decode_cold = (sig.decode_queue == 0
+                       and sig.decode_occupancy < self._down_occupancy)
+        return (
+            self.prefill_policy.decide(prefill_hot, prefill_cold,
+                                       sig.prefill_live),
+            self.decode_policy.decide(decode_hot, decode_cold,
+                                      sig.decode_live),
+        )
+
+    def tick(self) -> None:
+        sig = self._signals()
+        before = (self.prefill_policy.target, self.decode_policy.target)
+        pt, dt = self.decide(sig)
+        changed = False
+        for name, prev, target, live in (
+            ("prefill", before[0], pt, sig.prefill_live),
+            ("decode", before[1], dt, sig.decode_live),
+        ):
+            if prev is not None and target != prev:
+                direction = "up" if target > prev else "down"
+                _scale_events.labels(direction, name).inc()
+                logger.info("gateway %s pool scale %s: %d -> %d",
+                            name, direction, prev, target)
+                changed = True
+            elif live < target:
+                _scale_events.labels("restore", name).inc()
+                logger.warning("gateway %s pool restore: %d live < "
+                               "target %d", name, live, target)
+                changed = True
+        if not changed and (sig.prefill_live, sig.decode_live) == (pt, dt):
+            return
+        plan = ScalePlan(
+            job_name="gateway",
+            replica_resources={"prefill": pt, "decode": dt},
+            reason=f"disagg autoscale (prefill backlog="
+                   f"{sig.prefill_backlog}, decode occ="
+                   f"{sig.decode_occupancy:.2f}, "
+                   f"queue={sig.decode_queue})",
+        )
+        self._prefill_scaler.scale(plan)
+        self._decode_scaler.scale(plan)

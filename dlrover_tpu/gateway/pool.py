@@ -50,17 +50,19 @@ logger = get_logger(__name__)
 
 _replicas_gauge = registry().gauge(
     "dlrover_tpu_gateway_replicas",
-    "replica count by lifecycle state",
-    label_names=("state",),
+    "replica count by lifecycle state and pool "
+    "(serving, or prefill/decode when disaggregated)",
+    label_names=("state", "pool"),
 )
 _slot_occupancy = registry().gauge(
     "dlrover_tpu_gateway_slot_occupancy",
-    "busy fraction of decode slots across READY replicas",
+    "busy fraction of decode slots across READY replicas, per pool",
+    label_names=("pool",),
 )
 _drained_total = registry().counter(
     "dlrover_tpu_gateway_drained_total",
-    "replicas drained, by cause",
-    label_names=("cause",),
+    "replicas drained, by cause and pool",
+    label_names=("cause", "pool"),
 )
 
 
@@ -86,6 +88,13 @@ class RequestWork:
     first_token_t: float = 0.0
     replica_id: int = -1
     attempts: int = 0
+    # disaggregated serving: the prefill pool's KV handoff product;
+    # None routes to the prefill pool (or straight to a unified
+    # replica), non-None routes to the decode pool
+    bundle: Any = None
+    # per-token arrival stamps (the bench's inter-token-latency p95
+    # source); reset with first_token_t on resubmission
+    token_times: list = dataclasses.field(default_factory=list)
 
 
 class EngineReplica:
@@ -238,13 +247,24 @@ class EngineReplica:
                     return
                 newly, self._inbox = self._inbox, []
             for work in newly:
-                work.dispatch_t = time.monotonic()
+                if not work.dispatch_t:
+                    # first dispatch only: for disaggregated requests
+                    # the prefill dispatch starts the service clock and
+                    # the decode dispatch must not reset it
+                    work.dispatch_t = time.monotonic()
                 work.replica_id = self.id
                 try:
-                    rid = engine.submit(
-                        work.prompt, work.params,
-                        on_token=self._first_token_cb(work),
-                    )
+                    if work.bundle is not None:
+                        rid = engine.submit_prefilled(
+                            work.prompt, work.params,
+                            bundle=work.bundle,
+                            on_token=self._token_cb(work),
+                        )
+                    else:
+                        rid = engine.submit(
+                            work.prompt, work.params,
+                            on_token=self._token_cb(work),
+                        )
                 except Exception as e:  # noqa: BLE001 - a bad request
                     # (prompt too long etc.) fails ITS future only
                     self._on_error(work, e)
@@ -268,10 +288,12 @@ class EngineReplica:
                     )
 
     @staticmethod
-    def _first_token_cb(work: RequestWork):
+    def _token_cb(work: RequestWork):
         def cb(_rid: int, _tok: int) -> None:
+            now = time.monotonic()
             if not work.first_token_t:
-                work.first_token_t = time.monotonic()
+                work.first_token_t = now
+            work.token_times.append(now)
         return cb
 
     def _warm_engine(self, engine: Any):
@@ -308,7 +330,11 @@ class ReplicaPool:
                                        None] | None = None,
                  health_interval_s: float = 0.5,
                  preemption_file: str | None = None,
-                 heartbeat_timeout_s: float = 60.0):
+                 heartbeat_timeout_s: float = 60.0,
+                 name: str = "serving"):
+        # the metrics `pool` label: "serving" for a unified pool,
+        # "prefill"/"decode" for the disaggregated pair
+        self.name = name
         self._engine_factory = engine_factory
         self._on_done = on_done
         self._on_orphans = on_orphans
@@ -357,6 +383,12 @@ class ReplicaPool:
             total += r.slots
             busy += min(r.outstanding, r.slots)
         return busy / total if total else 0.0
+
+    def outstanding_total(self) -> int:
+        """Queued + in-flight work across live replicas (the
+        disaggregated autoscaler's prefill-backlog signal)."""
+        return sum(r.outstanding for r in self.replicas()
+                   if r.state is not ReplicaState.DEAD)
 
     # ------------------------------------------------------------- verbs
 
@@ -408,7 +440,7 @@ class ReplicaPool:
         if replica is None or replica.state is ReplicaState.DEAD:
             return
         logger.warning("draining replica %d (%s)", replica_id, cause)
-        _drained_total.labels(cause).inc()
+        _drained_total.labels(cause, self.name).inc()
         get_journal().emit("gateway_replica_drain", replica=replica_id,
                            cause=cause)
         replica.drain()
@@ -486,8 +518,8 @@ class ReplicaPool:
         for replica in self.replicas():
             counts[replica.state] += 1
         for state, n in counts.items():
-            _replicas_gauge.labels(state.value).set(n)
-        _slot_occupancy.set(self.occupancy())
+            _replicas_gauge.labels(state.value, self.name).set(n)
+        _slot_occupancy.labels(self.name).set(self.occupancy())
 
 
 class PoolScaler(Scaler):

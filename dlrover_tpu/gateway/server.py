@@ -92,6 +92,9 @@ class GatewayResult:
     queue_s: float
     prefill_s: float
     decode_s: float
+    # monotonic arrival stamp per token (the bench derives TTFT and
+    # inter-token-latency percentiles from these)
+    token_times: list = dataclasses.field(default_factory=list)
 
 
 class AdmissionController:
@@ -149,10 +152,22 @@ class Gateway:
     replica (runs on the replica's thread); ``prefill_len`` must match
     the engines' chunk size so router affinity keys line up with the
     engines' prefix-cache keys.
+
+    ``prefill_replicas > 0`` disaggregates: a PREFILL pool
+    (``serving.PrefillEngine`` replicas) runs prompts and ships
+    page-granular KV bundles; the main pool becomes the DECODE pool
+    and installs bundles via ``submit_prefilled``. Prefix affinity
+    routes to the prefill pool (that's where the prefix caches live);
+    decode dispatch is pure least-outstanding. The two pools scale
+    independently (``DisaggAutoscaler``) — and because the minted seed,
+    chunk program and install path are identical, a request's tokens
+    are bit-identical to the unified path.
     """
 
     def __init__(self, engine_factory, *, replicas: int = 1,
                  prefill_len: int = 64,
+                 prefill_replicas: int = 0,
+                 prefill_engine_factory=None,
                  admission_deadline_s: float = 30.0,
                  init_request_s: float = 0.5,
                  dispatch_timeout_s: float = 120.0,
@@ -164,12 +179,28 @@ class Gateway:
             deadline_s=admission_deadline_s,
             init_request_s=init_request_s,
         )
+        self.disaggregated = prefill_replicas > 0
         self.pool = ReplicaPool(
             engine_factory, self._on_done, self._resubmit,
             on_error=self._fail,
             health_interval_s=health_interval_s,
             preemption_file=preemption_file,
+            name="decode" if self.disaggregated else "serving",
         )
+        self.prefill_pool = None
+        if self.disaggregated:
+            from dlrover_tpu.serving import PrefillEngine
+
+            factory = prefill_engine_factory or (
+                lambda: PrefillEngine(engine_factory())
+            )
+            self.prefill_pool = ReplicaPool(
+                factory, self._on_prefilled, self._resubmit,
+                on_error=self._fail,
+                health_interval_s=health_interval_s,
+                preemption_file=preemption_file,
+                name="prefill",
+            )
         self._seed = seed
         # set by gateway.control.MasterLink when a master is attached
         self.master_link = None
@@ -185,6 +216,8 @@ class Gateway:
         )
         self._dispatcher.start()
         self.pool.ensure(replicas)
+        if self.prefill_pool is not None:
+            self.prefill_pool.ensure(prefill_replicas)
 
     # ----------------------------------------------------------- user API
 
@@ -221,6 +254,27 @@ class Gateway:
 
     def stats(self) -> dict:
         states = [r.state.value for r in self.pool.replicas()]
+        if self.prefill_pool is not None:
+            pf = self.prefill_pool
+            return {
+                "degraded": bool(self.master_link is not None
+                                 and self.master_link.degraded),
+                "disaggregated": True,
+                "replicas": {s: states.count(s) for s in set(states)},
+                "ready": len(self.pool.ready_replicas()),
+                "prefill_ready": len(pf.ready_replicas()),
+                "prefill_backlog": pf.outstanding_total(),
+                "slots_total": self.pool.slots_total(),
+                "slot_occupancy": round(self.pool.occupancy(), 4),
+                "queue_depth": self.admission.pending,
+                "ewma_request_s": round(
+                    self.admission.ewma_request_s, 4),
+                "estimated_wait_s": round(
+                    self.admission.estimated_wait_s(
+                        self.pool.slots_total()
+                    ), 4,
+                ),
+            }
         return {
             "degraded": bool(self.master_link is not None
                              and self.master_link.degraded),
@@ -236,6 +290,14 @@ class Gateway:
                 ), 4,
             ),
         }
+
+    def undispatched_counts(self) -> tuple[int, int]:
+        """(awaiting-prefill, awaiting-decode) requests no replica has
+        accepted yet — the disaggregated autoscaler's backlog split."""
+        with self._undispatched_lock:
+            pre = sum(1 for w in self._undispatched
+                      if w.bundle is None)
+            return pre, len(self._undispatched) - pre
 
     def request_hist_snapshot(self) -> tuple[tuple[float, ...], list[int],
                                              int, float]:
@@ -254,6 +316,8 @@ class Gateway:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.prefill_pool is not None:
+            self.prefill_pool.stop()
         self.pool.stop()
         with self._undispatched_lock:
             pending, self._undispatched = list(self._undispatched), deque()
@@ -273,6 +337,26 @@ class Gateway:
         return int.from_bytes(digest, "big")
 
     def _try_dispatch(self, work: RequestWork) -> bool:
+        if self.prefill_pool is not None and work.bundle is None:
+            # disaggregated: prefix affinity targets the PREFILL pool
+            # (its engines own the prefix caches the affinity exists
+            # for); the bundle comes back through _on_prefilled
+            replica = self.router.route(
+                work.prompt, self.prefill_pool.ready_replicas()
+            )
+            if replica is None or not replica.submit(work):
+                return False
+            self.router.record(work.prompt, replica.id)
+            return True
+        if self.prefill_pool is not None:
+            # decode dispatch: the KV arrives with the bundle, so pure
+            # least-outstanding beats any affinity
+            replicas = self.pool.ready_replicas()
+            if not replicas:
+                return False
+            replica = min(replicas,
+                          key=lambda r: (r.outstanding, r.id))
+            return replica.submit(work)
         replica = self.router.route(
             work.prompt, self.pool.ready_replicas()
         )
@@ -280,6 +364,14 @@ class Gateway:
             return False
         self.router.record(work.prompt, replica.id)
         return True
+
+    def _on_prefilled(self, work: RequestWork, res: Any) -> None:
+        """Prefill-pool completion hook: attach the KV bundle and hand
+        the request to the decode pool."""
+        work.bundle = res.bundle
+        if not self._try_dispatch(work):
+            with self._undispatched_lock:
+                self._undispatched.append(work)
 
     def _dispatch_loop(self) -> None:
         # retries work that found no READY replica (all starting, or a
@@ -308,6 +400,7 @@ class Gateway:
             self.router.forget(work.replica_id)
             work.attempts += 1
             work.first_token_t = 0.0
+            work.token_times = []
             with self._undispatched_lock:
                 self._undispatched.append(work)
 
@@ -342,6 +435,7 @@ class Gateway:
                 replica_id=work.replica_id, attempts=work.attempts,
                 total_s=total, queue_s=queue_s, prefill_s=prefill_s,
                 decode_s=decode_s,
+                token_times=list(work.token_times),
             ))
 
     def _fail(self, work: RequestWork, exc: Exception) -> None:

@@ -1,6 +1,11 @@
 from dlrover_tpu.serving.engine import (  # noqa: F401
     InferenceEngine,
+    KVBundle,
     Request,
     Result,
     SamplingParams,
+)
+from dlrover_tpu.serving.prefill import (  # noqa: F401
+    PrefillEngine,
+    PrefillResult,
 )

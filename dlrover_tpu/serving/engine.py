@@ -17,11 +17,36 @@ per-row-position KV cache (models/decode.py forward_cached with vector
 - **install**: dynamic-update the prefilled row into the slot batch's
   cache at a traced slot index.
 - **decode step**: one token for ALL slots at their own positions;
-  per-slot sampling params are vectorized (temperature/top_k/top_p as
-  [slots] arrays), finished slots are host-side bookkeeping.
+  per-slot sampling params are vectorized (temperature/top_k/top_p/
+  eos_id as [slots] arrays), finished slots are host-side bookkeeping.
 
 Static shapes everywhere: slot count, cache length and prefill length
 are engine constants, so serving never recompiles after warmup.
+
+**Chunked-prefill admission**: ``step()`` runs at most ONE prefill
+chunk (plus at most one install) of admission work between decode
+iterations, so a long prompt joining the batch never stalls active
+decodes for more than one chunk's compute — the stall is measured into
+the ``dlrover_tpu_engine_decode_stall_seconds`` histogram and each
+completed admission emits an ``engine_admit`` journal instant.
+
+**Paged KV slots** (``kv_pages > 0``): a physical page pool
+``[L, pages, page_size, kv_heads, head_dim]`` backs the dense decode
+cache. Admission reserves ``ceil((prompt+max_new)/page_size)`` pages —
+capacity is a page ledger, not a dense-slot count — and a long-running
+generation can be PARKED (its dense row scattered to its pages through
+an ``_install``-style jitted helper) to free its slot for waiting
+work, then resumed bit-identically (pages gathered back, host-side
+seed/sample counters restored). Fair-share rotation falls out: the
+scheduling quantum is one page of decoded tokens.
+
+**Prefill/decode disaggregation**: ``prefill_begin``/``prefill_step``
+run the chunk loop without touching decode slots and yield a
+``KVBundle`` — page-granular (k, v) plus (pos, last) — that a DECODE
+engine installs via ``submit_prefilled`` (the ``kv_handoff`` journal
+instant). Bundles round-trip through host numpy, so they ship over the
+shm ckpt channel / array_wire framing unchanged; in-process the
+``device_put`` is the jnp.asarray at install.
 
 ``prefix_cache_entries > 0`` adds the vLLM automatic-prefix-caching
 analog: prefilled KV rows are cached at chunk-aligned prompt prefixes
@@ -52,6 +77,7 @@ from dlrover_tpu.models.decode import (
     sample_logits,
 )
 from dlrover_tpu.models.transformer import TransformerConfig
+from dlrover_tpu.telemetry.journal import get_journal
 from dlrover_tpu.telemetry.metrics import registry
 
 logger = get_logger(__name__)
@@ -64,6 +90,21 @@ _request_seconds = registry().histogram(
 _tokens_total = registry().counter(
     "dlrover_tpu_serving_tokens_total",
     "generated tokens across all requests",
+)
+_decode_stall_seconds = registry().histogram(
+    "dlrover_tpu_engine_decode_stall_seconds",
+    "admission work (prefill chunk / install) run between decode "
+    "steps while slots were actively decoding",
+    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+             2.5, 5.0),
+)
+_kv_parked_total = registry().counter(
+    "dlrover_tpu_engine_kv_parked_total",
+    "active generations parked to their KV pages to free a decode slot",
+)
+_kv_handoffs_total = registry().counter(
+    "dlrover_tpu_engine_kv_handoffs_total",
+    "prefilled KV bundles installed from a prefill engine",
 )
 
 
@@ -90,6 +131,8 @@ class Request:
     # tokens arrive in bursts of up to block size — streaming-latency-
     # sensitive callers trade throughput with decode_block=1.
     on_token: Any = None
+    # a prefill-pool product to install instead of running prefill here
+    bundle: Any = None
 
 
 @dataclasses.dataclass
@@ -98,6 +141,67 @@ class Result:
     prompt: list[int]
     tokens: list[int]          # generated continuation (no prompt)
     finish_reason: str         # "eos" | "length"
+
+
+@dataclasses.dataclass
+class KVBundle:
+    """Prefilled KV handed from a prefill engine to a decode engine.
+
+    Page-granular and host-resident: ``k``/``v`` are
+    ``[L, n_pages, page_size, kv_heads, head_dim]`` numpy arrays
+    covering only the pages the prompt actually filled, so the handoff
+    ships ``ceil(prompt/page)`` pages, never a full max_len row. Plain
+    numpy means the same bundle travels in-process (jnp.asarray at
+    install = the explicit device_put) or across processes over the
+    array_wire / shm ckpt framing.
+    """
+
+    k: Any
+    v: Any
+    pos: int                   # true prompt length
+    last: Any                  # [vocab] float32 logits of the last token
+    page_size: int
+    prefix_key: tuple          # final-aligned-boundary prefix key
+
+
+@dataclasses.dataclass
+class _PrefillRun:
+    """One in-flight chunked prefill (admission or prefill-pool)."""
+
+    prompt: list[int]
+    row_k: Any
+    row_v: Any
+    pos: Any
+    last: Any
+    next_lo: int               # next chunk start offset
+    start: int                 # where prefill resumed (prefix-cache hit)
+    chunks: int = 0
+    work_s: float = 0.0
+    done: bool = False
+
+
+@dataclasses.dataclass
+class _PendingAdmit:
+    """A request between queue and slot: its prefill run + page lease."""
+
+    req: Request
+    run: _PrefillRun
+    pages: list[int]
+    kind: str = "cold"         # cold | hit | handoff
+
+
+@dataclasses.dataclass
+class _Parked:
+    """A generation evicted from its slot: truth lives in its pages
+    plus this host-side continuation state."""
+
+    req: Request
+    pages: list[int]
+    pos: int
+    last: Any                  # [vocab] device array
+    seed: int
+    sampled: int
+    emitted: list[int]
 
 
 class InferenceEngine:
@@ -113,7 +217,8 @@ class InferenceEngine:
     def __init__(self, params: Any, cfg: TransformerConfig, *,
                  slots: int = 8, max_len: int = 0,
                  prefill_len: int = 0, decode_block: int = 1,
-                 prefix_cache_entries: int = 0):
+                 prefix_cache_entries: int = 0,
+                 kv_pages: int = 0, page_size: int = 0):
         self._params = params
         self.cfg = cfg
         self.slots = slots
@@ -144,9 +249,43 @@ class InferenceEngine:
         # per-token host round trip (sync + dispatch) otherwise bounds
         # throughput on high-RTT hosts. Shrunk per step to the smallest
         # remaining budget among active slots (power-of-two ladder, so
-        # compiles stay bounded) and to 1 whenever any active request
-        # uses eos (its stop must be observed token-by-token).
+        # compiles stay bounded). eos is observed INSIDE the compiled
+        # block (per-slot [slots] eos ids; a row that samples its eos
+        # keeps emitting eos for the rest of the block and stops
+        # advancing its cache position), so one eos-bearing request no
+        # longer collapses its whole batch to token-at-a-time decode.
         self.decode_block = max(1, decode_block)
+
+        # paged KV slots: physical page pool + per-slot page lease.
+        # Capacity is a PAGE ledger — a request holds
+        # ceil((prompt+max_new)/page_size) pages from admission to
+        # retire — so short requests no longer cost a whole dense
+        # slot's worth of memory headroom, and a long generation can be
+        # parked to its pages (freeing the slot) and resumed
+        # bit-identically. Page 0 is a scratch page: unused page-table
+        # entries point at it, so the scatter/gather helpers stay
+        # mask-free (garbage beyond a request's allocation is never
+        # attended — positions past pos sit under the causal mask).
+        self.page_size = page_size or self.prefill_len
+        if self.max_len % self.page_size:
+            raise ValueError(
+                f"page_size {self.page_size} must divide max_len "
+                f"{self.max_len}"
+            )
+        self.kv_pages = int(kv_pages)
+        self.pages_per_slot = self.max_len // self.page_size
+        self._paging = self.kv_pages > 0
+        if self._paging:
+            c = cfg
+            pool_shape = (c.n_layers, self.kv_pages + 1, self.page_size,
+                          c.n_kv_heads, c.head_dim)
+            self._kpool = jnp.zeros(pool_shape, jnp.dtype(c.dtype))
+            self._vpool = jnp.zeros(pool_shape, jnp.dtype(c.dtype))
+            self._free_pages: list[int] = list(
+                range(1, self.kv_pages + 1))
+        else:
+            self._kpool = self._vpool = None
+            self._free_pages = []
 
         # prefix caching (the vLLM automatic-prefix-caching analog,
         # reference atorch/rl/inference_backend/vllm_backend.py): an LRU
@@ -175,7 +314,18 @@ class InferenceEngine:
         # host-side slot bookkeeping; None = free
         self._active: list[Request | None] = [None] * slots
         self._emitted: list[list[int]] = [[] for _ in range(slots)]
+        self._slot_pages: list[list[int] | None] = [None] * slots
+        self._since_install = [0] * slots
         self._results: list[Result] = []
+        # admission state machine: at most one pending chunked prefill
+        # plus a FIFO of parked generations awaiting a slot
+        self._pending: _PendingAdmit | None = None
+        self._parked: deque[_Parked] = deque()
+        self.kv_parked_total = 0
+        # sampling tensors are invalidated only on admit/park/retire —
+        # steady-state decode re-uses the uploaded arrays instead of
+        # rebuilding + re-uploading [slots] vectors every step
+        self._samp_cache: tuple | None = None
 
         self._cache = init_cache(cfg, slots, self.max_len)
         self._cache["pos"] = jnp.zeros((slots,), jnp.int32)
@@ -187,7 +337,7 @@ class InferenceEngine:
         self._sampled = np.zeros((slots,), np.int64)
         self._seed_gen = np.random.default_rng(0)
 
-        # --- compiled programs (three, total) -------------------------
+        # --- compiled programs ---------------------------------------
         def _prefill_chunk(params, tokens, k, v, pos, true_len):
             # one prefill_len chunk into a [1, max_len] working cache;
             # long prompts loop this program (cache pos carries across
@@ -216,6 +366,43 @@ class InferenceEngine:
 
         self._install = jax.jit(_install)
 
+        if self._paging:
+            L = cfg.n_layers
+            pps, ps = self.pages_per_slot, self.page_size
+
+            def _park_out(cache_k, cache_v, kpool, vpool, slot, table):
+                # scatter slot `slot`'s dense row into its pages
+                # (`table`: [pages_per_slot] physical ids, unused
+                # entries -> scratch page 0)
+                row_k = lax.dynamic_index_in_dim(
+                    cache_k, slot, axis=1, keepdims=False)
+                row_v = lax.dynamic_index_in_dim(
+                    cache_v, slot, axis=1, keepdims=False)
+                shape = (L, pps, ps) + row_k.shape[2:]
+                kpool = kpool.at[:, table].set(row_k.reshape(shape))
+                vpool = vpool.at[:, table].set(row_v.reshape(shape))
+                return kpool, vpool
+
+            self._park_out = jax.jit(_park_out)
+
+            def _resume_install(cache_k, cache_v, pos_all, last_all,
+                                kpool, vpool, table, slot, pos,
+                                last_row):
+                # gather pages back into a dense row and install it —
+                # the resume twin of `_install`
+                shape = (L, pps * ps) + kpool.shape[3:]
+                row_k = kpool[:, table].reshape(shape)
+                row_v = vpool[:, table].reshape(shape)
+                cache_k = lax.dynamic_update_index_in_dim(
+                    cache_k, row_k, slot, axis=1)
+                cache_v = lax.dynamic_update_index_in_dim(
+                    cache_v, row_v, slot, axis=1)
+                pos_all = pos_all.at[slot].set(pos)
+                last_all = last_all.at[slot].set(last_row)
+                return cache_k, cache_v, pos_all, last_all
+
+            self._resume_install = jax.jit(_resume_install)
+
         def _row_keys(seeds, counts):
             # per-row key = f(request seed, index of this draw): pure
             # per-request randomness, batch-composition-independent
@@ -226,27 +413,37 @@ class InferenceEngine:
             )(seeds, counts)
 
         def _step_block(params, k, v, pos, last, seeds, counts,
-                        temperature, top_k, top_p, active, n_steps):
+                        temperature, top_k, top_p, active, eos_ids,
+                        n_steps):
             # per-row sampling params as VECTORS: one compiled program
-            # regardless of the mix of requests in the batch
+            # regardless of the mix of requests in the batch. eos_ids
+            # [slots] (-1 = none): a row that samples its eos keeps
+            # emitting eos and stops advancing — the host retires it
+            # after the block, so the batchmates never drop to
+            # token-at-a-time decode.
             def body(carry, i):
-                k, v, pos, last = carry
+                k, v, pos, last, done = carry
                 nxt = sample_logits(
                     last, _row_keys(seeds, counts + i), temperature,
                     top_k, top_p,
                 )
+                nxt = jnp.where(done, jnp.maximum(eos_ids, 0), nxt)
+                hit = (eos_ids >= 0) & (nxt == eos_ids)
                 cache = {"k": k, "v": v, "pos": pos}
                 logits, cache = forward_cached(
                     params, nxt[:, None], cache, cfg
                 )
-                # inactive rows must not advance (their pos would creep
-                # past max_len and clamp the next install's attention)
-                new_pos = jnp.where(active, cache["pos"], pos)
+                # inactive/finished rows must not advance (their pos
+                # would creep past max_len and clamp the next install's
+                # attention)
+                run = active & ~done
+                new_pos = jnp.where(run, cache["pos"], pos)
                 return (cache["k"], cache["v"], new_pos,
-                        logits[:, 0]), nxt
+                        logits[:, 0], done | hit), nxt
 
-            (k, v, pos, last), toks = lax.scan(
-                body, (k, v, pos, last), jnp.arange(n_steps)
+            done0 = jnp.zeros(active.shape, bool)
+            (k, v, pos, last, _), toks = lax.scan(
+                body, (k, v, pos, last, done0), jnp.arange(n_steps)
             )
             return toks, k, v, pos, last
 
@@ -267,12 +464,12 @@ class InferenceEngine:
         """The exact runtime argument tuple of a decode step (zero
         requests active), built through the same conversions ``step()``
         performs — lowering against these pins the true avals."""
-        temp, top_k, top_p = self._sampling_tensors()
+        temp, top_k, top_p, eos_ids = self._sampling_tensors()
         active = np.zeros((self.slots,), bool)
         return (self.params, self._cache["k"], self._cache["v"],
                 self._cache["pos"], self._last,
                 jnp.asarray(self._seeds), jnp.asarray(self._sampled),
-                temp, top_k, top_p, jnp.asarray(active))
+                temp, top_k, top_p, jnp.asarray(active), eos_ids)
 
     def warm_aot_step(self, cache=None):
         """Compile-or-load the n_steps=1 decode-step program through the
@@ -293,6 +490,7 @@ class InferenceEngine:
             self._params = launder(self._params)
             self._cache = launder(self._cache)
             self._last = launder(self._last)
+            self._samp_cache = None
             sample = self._step_sample_args()
             key, inputs = compile_fingerprint(
                 num_nodes=1,
@@ -341,10 +539,8 @@ class InferenceEngine:
         self._prefix_cache.clear()
         self._prefix_lens.clear()
 
-    def submit(self, prompt: list[int],
-               params: SamplingParams | None = None,
-               on_token=None) -> int:
-        params = params or SamplingParams()
+    def _validate(self, prompt: list[int],
+                  params: SamplingParams) -> None:
         if not prompt:
             raise ValueError("empty prompt")
         if params.max_new_tokens < 1:
@@ -354,10 +550,49 @@ class InferenceEngine:
             )
         if len(prompt) + params.max_new_tokens > self.max_len:
             raise ValueError("prompt + max_new_tokens > max_len")
+        if self._paging:
+            need = -(-(len(prompt) + params.max_new_tokens)
+                     // self.page_size)
+            if need > self.kv_pages:
+                raise ValueError(
+                    f"request needs {need} KV pages, pool has "
+                    f"{self.kv_pages}"
+                )
+
+    def submit(self, prompt: list[int],
+               params: SamplingParams | None = None,
+               on_token=None) -> int:
+        params = params or SamplingParams()
+        self._validate(list(prompt), params)
         rid = next(self._ids)
         self._queue.append(Request(rid, list(prompt), params, on_token))
         self._submit_time[rid] = time.monotonic()
         return rid
+
+    def submit_prefilled(self, prompt: list[int],
+                         params: SamplingParams | None = None,
+                         bundle: KVBundle | None = None,
+                         on_token=None) -> int:
+        """Submit a request whose prefill already ran on a PREFILL
+        engine: admission installs ``bundle`` (one install, zero
+        chunks) instead of re-running the prompt."""
+        if bundle is None:
+            raise ValueError("submit_prefilled requires a KVBundle")
+        params = params or SamplingParams()
+        prompt = list(prompt)
+        self._validate(prompt, params)
+        if bundle.pos != len(prompt):
+            raise ValueError(
+                f"bundle covers {bundle.pos} tokens, prompt has "
+                f"{len(prompt)}"
+            )
+        rid = next(self._ids)
+        self._queue.append(Request(rid, prompt, params, on_token,
+                                   bundle=bundle))
+        self._submit_time[rid] = time.monotonic()
+        return rid
+
+    # ------------------------------------------------------ prefix cache
 
     def _prefix_lookup(self, prompt: list[int]):
         """Longest chunk-aligned cached prefix of ``prompt``; returns
@@ -397,87 +632,317 @@ class InferenceEngine:
             else:
                 del self._prefix_lens[len(evicted)]
 
-    def _admit(self) -> None:
-        for slot in range(self.slots):
-            if self._active[slot] is not None or not self._queue:
-                continue
-            req = self._queue.popleft()
-            work = init_cache(self.cfg, 1, self.max_len)
-            row_k, row_v, pos = work["k"], work["v"], work["pos"]
-            last = None
-            P = self.prefill_len
-            start = 0
-            if self.prefix_cache_entries:
-                self.prefix_cache_queries += 1
-                hit = self._prefix_lookup(req.prompt)
-                if hit is not None:
-                    start, (row_k, row_v, pos, last) = hit
-                    self.prefix_cache_hits += 1
-            final_top = len(req.prompt) // P * P
-            for lo in range(start, len(req.prompt), P):
-                chunk = req.prompt[lo: lo + P]
-                toks = np.zeros((1, P), np.int32)
-                toks[0, : len(chunk)] = chunk
-                row_k, row_v, pos, last = self._prefill_chunk(
-                    self.params, jnp.asarray(toks), row_k, row_v, pos,
-                    jnp.asarray(len(chunk), jnp.int32),
+    # ------------------------------------------------- chunked prefill
+
+    def prefill_begin(self, prompt: list[int]) -> _PrefillRun:
+        """Start a chunked prefill into a fresh working row (resuming
+        from the longest cached aligned prefix). Drives both admission
+        and the disaggregated prefill pool."""
+        work = init_cache(self.cfg, 1, self.max_len)
+        row_k, row_v, pos = work["k"], work["v"], work["pos"]
+        last = None
+        start = 0
+        if self.prefix_cache_entries:
+            self.prefix_cache_queries += 1
+            hit = self._prefix_lookup(prompt)
+            if hit is not None:
+                start, (row_k, row_v, pos, last) = hit
+                self.prefix_cache_hits += 1
+        return _PrefillRun(
+            prompt=list(prompt), row_k=row_k, row_v=row_v, pos=pos,
+            last=last, next_lo=start, start=start,
+            done=start >= len(prompt),
+        )
+
+    def prefill_step(self, run: _PrefillRun) -> bool:
+        """Run ONE prefill chunk of ``run``; returns True when the
+        prompt is fully prefilled. Blocks on the chunk so admission
+        stall accounting is honest."""
+        if run.done:
+            return True
+        P = self.prefill_len
+        t0 = time.monotonic()
+        lo = run.next_lo
+        chunk = run.prompt[lo: lo + P]
+        toks = np.zeros((1, P), np.int32)
+        toks[0, : len(chunk)] = chunk
+        run.row_k, run.row_v, run.pos, run.last = self._prefill_chunk(
+            self.params, jnp.asarray(toks), run.row_k, run.row_v,
+            run.pos, jnp.asarray(len(chunk), jnp.int32),
+        )
+        final_top = len(run.prompt) // P * P
+        if self.prefix_cache_entries and len(chunk) == P:
+            # snapshot the FINAL aligned boundary always; intermediate
+            # boundaries only when extending an already-cached prefix
+            # (start > 0, the shared-system-prompt chain). A cold
+            # non-sharing prompt then adds ONE entry instead of top/P,
+            # so a wave of long unrelated prompts can no longer churn
+            # the LRU and evict the shared prefixes that actually hit.
+            if lo + P == final_top or run.start > 0:
+                self._prefix_store(
+                    tuple(run.prompt[: lo + P]),
+                    (run.row_k, run.row_v, run.pos, run.last),
                 )
-                if self.prefix_cache_entries and len(chunk) == P:
-                    # snapshot the FINAL aligned boundary always;
-                    # intermediate boundaries only when extending an
-                    # already-cached prefix (start > 0, the shared-
-                    # system-prompt chain). A cold non-sharing prompt
-                    # then adds ONE entry instead of top/P, so a wave of
-                    # long unrelated prompts can no longer churn the LRU
-                    # and evict the shared prefixes that actually hit.
-                    if lo + P == final_top or start > 0:
-                        self._prefix_store(
-                            tuple(req.prompt[: lo + P]),
-                            (row_k, row_v, pos, last),
-                        )
-            (self._cache["k"], self._cache["v"], self._cache["pos"],
-             self._last) = self._install(
-                self._cache["k"], self._cache["v"], self._cache["pos"],
-                self._last, row_k, row_v, last,
-                jnp.asarray(slot, jnp.int32),
-                jnp.asarray(len(req.prompt), jnp.int32),
+        run.next_lo = lo + P
+        run.chunks += 1
+        run.done = run.next_lo >= len(run.prompt)
+        jax.block_until_ready(run.last)
+        run.work_s += time.monotonic() - t0
+        return run.done
+
+    def make_bundle(self, run: _PrefillRun) -> KVBundle:
+        """Package a finished prefill run as a page-granular host
+        bundle for handoff to a decode engine."""
+        if not run.done:
+            raise ValueError("prefill run not finished")
+        P = self.page_size
+        n_tok = len(run.prompt)
+        n_pages = -(-n_tok // P)
+        # device_get can return views of device buffers on CPU — copy,
+        # so the bundle owns its bytes wherever it travels
+        rk = np.ascontiguousarray(
+            np.asarray(jax.device_get(run.row_k))[:, 0, : n_pages * P])
+        rv = np.ascontiguousarray(
+            np.asarray(jax.device_get(run.row_v))[:, 0, : n_pages * P])
+        shape = (rk.shape[0], n_pages, P) + rk.shape[2:]
+        top = n_tok // self.prefill_len * self.prefill_len
+        return KVBundle(
+            k=rk.reshape(shape), v=rv.reshape(shape), pos=n_tok,
+            last=np.asarray(jax.device_get(run.last)),
+            page_size=P, prefix_key=tuple(run.prompt[:top]),
+        )
+
+    def _run_from_bundle(self, req: Request) -> _PrefillRun:
+        """Rebuild a finished working row from a handoff bundle (the
+        decode-side half of the KV handoff — pad the shipped pages to
+        a max_len row, then install through the normal path)."""
+        b = req.bundle
+        if b.page_size != self.page_size:
+            raise ValueError(
+                f"bundle page_size {b.page_size} != engine page_size "
+                f"{self.page_size}"
             )
-            self._active[slot] = req
-            self._emitted[slot] = []
-            seed = (req.params.seed if req.params.seed is not None
-                    else int(self._seed_gen.integers(0, 2**32)))
-            # normalize arbitrary ints (time_ns(), 64-bit random) into
-            # the uint32 fold_in domain instead of overflowing mid-run
-            self._seeds[slot] = np.uint32(seed % (2**32))
-            self._sampled[slot] = 0
+        covered = b.k.shape[1] * b.page_size
+        L = b.k.shape[0]
+
+        def pad(pages):
+            # one fresh buffer per tensor: CPU device_put may ADOPT an
+            # aligned writable host buffer (DESIGN.md §17.4), so k and
+            # v must never share one staging array
+            row = np.zeros((L, 1, self.max_len) + pages.shape[3:],
+                           dtype=pages.dtype)
+            row[:, 0, :covered] = pages.reshape(
+                (L, covered) + pages.shape[3:])
+            return jnp.asarray(row)
+
+        row_k, row_v = pad(b.k), pad(b.v)
+        return _PrefillRun(
+            prompt=list(req.prompt), row_k=row_k, row_v=row_v,
+            pos=jnp.asarray(b.pos, jnp.int32),
+            last=jnp.asarray(b.last), next_lo=len(req.prompt),
+            start=0, done=True,
+        )
+
+    # --------------------------------------------------------- admission
+
+    def _pages_needed(self, req: Request) -> int:
+        total = len(req.prompt) + req.params.max_new_tokens
+        return -(-total // self.page_size)
+
+    def _take_slot(self) -> int | None:
+        """A free slot, or (paging only) free one by parking the
+        longest-running active generation that has decoded at least one
+        page since its install (the anti-thrash quantum)."""
+        for s in range(self.slots):
+            if self._active[s] is None:
+                return s
+        if not self._paging:
+            return None
+        victim = None
+        for s in range(self.slots):
+            if self._since_install[s] < self.page_size:
+                continue
+            if victim is None or (len(self._emitted[s])
+                                  > len(self._emitted[victim])):
+                victim = s
+        if victim is None:
+            return None
+        self._park_slot(victim)
+        return victim
+
+    def _park_slot(self, slot: int) -> None:
+        req = self._active[slot]
+        pages = self._slot_pages[slot] or []
+        table = np.zeros((self.pages_per_slot,), np.int32)
+        table[: len(pages)] = pages
+        self._kpool, self._vpool = self._park_out(
+            self._cache["k"], self._cache["v"], self._kpool,
+            self._vpool, jnp.asarray(slot, jnp.int32),
+            jnp.asarray(table),
+        )
+        self._parked.append(_Parked(
+            req=req, pages=pages,
+            pos=int(self._cache["pos"][slot]),
+            last=self._last[slot],
+            seed=int(self._seeds[slot]),
+            sampled=int(self._sampled[slot]),
+            emitted=self._emitted[slot],
+        ))
+        self._active[slot] = None
+        self._emitted[slot] = []
+        self._slot_pages[slot] = None
+        self._samp_cache = None
+        self.kv_parked_total += 1
+        _kv_parked_total.inc()
+
+    def _resume_parked(self, slot: int, parked: _Parked) -> None:
+        table = np.zeros((self.pages_per_slot,), np.int32)
+        table[: len(parked.pages)] = parked.pages
+        (self._cache["k"], self._cache["v"], self._cache["pos"],
+         self._last) = self._resume_install(
+            self._cache["k"], self._cache["v"], self._cache["pos"],
+            self._last, self._kpool, self._vpool, jnp.asarray(table),
+            jnp.asarray(slot, jnp.int32),
+            jnp.asarray(parked.pos, jnp.int32), parked.last,
+        )
+        self._active[slot] = parked.req
+        self._emitted[slot] = parked.emitted
+        self._slot_pages[slot] = parked.pages
+        self._seeds[slot] = np.uint32(parked.seed)
+        self._sampled[slot] = parked.sampled
+        self._since_install[slot] = 0
+        self._samp_cache = None
+        jax.block_until_ready(self._last)
+        get_journal().emit(
+            "engine_admit", request=parked.req.id, kind="resume",
+            chunks=0, emitted=len(parked.emitted),
+        )
+
+    def _start_admission(self) -> bool:
+        """Pop the queue head into a pending admission (reserving its
+        pages) if capacity allows. FIFO on purpose: head-of-line
+        bypass would starve long prompts under page pressure."""
+        if not self._queue:
+            return False
+        req = self._queue[0]
+        pages: list[int] = []
+        if self._paging:
+            need = self._pages_needed(req)  # fits: validated at submit
+            if len(self._free_pages) < need:
+                return False
+            pages = [self._free_pages.pop() for _ in range(need)]
+        self._queue.popleft()
+        if req.bundle is not None:
+            run = self._run_from_bundle(req)
+            kind = "handoff"
+        else:
+            run = self.prefill_begin(req.prompt)
+            kind = "hit" if run.start else "cold"
+        self._pending = _PendingAdmit(req=req, run=run, pages=pages,
+                                      kind=kind)
+        return True
+
+    def _install_admit(self, slot: int, pa: _PendingAdmit) -> None:
+        req, run = pa.req, pa.run
+        (self._cache["k"], self._cache["v"], self._cache["pos"],
+         self._last) = self._install(
+            self._cache["k"], self._cache["v"], self._cache["pos"],
+            self._last, run.row_k, run.row_v, run.last,
+            jnp.asarray(slot, jnp.int32),
+            jnp.asarray(len(req.prompt), jnp.int32),
+        )
+        jax.block_until_ready(self._last)
+        self._active[slot] = req
+        self._emitted[slot] = []
+        self._slot_pages[slot] = pa.pages
+        self._since_install[slot] = 0
+        seed = (req.params.seed if req.params.seed is not None
+                else int(self._seed_gen.integers(0, 2**32)))
+        # normalize arbitrary ints (time_ns(), 64-bit random) into
+        # the uint32 fold_in domain instead of overflowing mid-run
+        self._seeds[slot] = np.uint32(seed % (2**32))
+        self._sampled[slot] = 0
+        self._samp_cache = None
+        journal = get_journal()
+        journal.emit(
+            "engine_admit", request=req.id, kind=pa.kind,
+            chunks=run.chunks, dur=round(run.work_s, 6),
+            tokens=len(req.prompt),
+        )
+        if pa.kind == "handoff":
+            _kv_handoffs_total.inc()
+            journal.emit(
+                "kv_handoff", request=req.id,
+                pages=int(req.bundle.k.shape[1]),
+                tokens=len(req.prompt),
+                bytes=int(req.bundle.k.nbytes + req.bundle.v.nbytes),
+            )
+
+    def _admit_tick(self) -> bool:
+        """At most ONE unit of admission work — a single prefill chunk,
+        plus at most one install — so active decodes are never stalled
+        longer than one chunk's compute. Returns True when device work
+        ran (the caller observes the stall histogram)."""
+        if self._pending is None:
+            # resumes first: their pages are already paid for and their
+            # requester has waited longest
+            if self._parked:
+                slot = self._take_slot()
+                if slot is None:
+                    return False
+                self._resume_parked(slot, self._parked.popleft())
+                return True
+            if not self._start_admission():
+                return False
+        pa = self._pending
+        worked = False
+        if not pa.run.done:
+            self.prefill_step(pa.run)
+            worked = True
+        if pa.run.done:
+            slot = self._take_slot()
+            if slot is not None:
+                self._install_admit(slot, pa)
+                self._pending = None
+                worked = True
+        return worked
+
+    def _admit(self) -> None:
+        """Drain every possible admission synchronously (compat/test
+        helper; ``step()`` uses the incremental ``_admit_tick``)."""
+        while self._admit_tick():
+            pass
+
+    # ------------------------------------------------------------- decode
 
     def _sampling_tensors(self):
-        V = self.cfg.vocab_size
+        if self._samp_cache is not None:
+            return self._samp_cache
         temp = np.ones((self.slots,), np.float32)
         top_p = np.ones((self.slots,), np.float32)
         top_k = np.zeros((self.slots,), np.int32)
+        eos = np.full((self.slots,), -1, np.int32)
         for s, req in enumerate(self._active):
             if req is None:
                 continue
             temp[s] = req.params.temperature
             top_p[s] = req.params.top_p
             top_k[s] = req.params.top_k or 0
-        return (jnp.asarray(temp), jnp.asarray(top_k),
-                jnp.asarray(top_p))
+            if req.params.eos_id is not None:
+                eos[s] = req.params.eos_id
+        self._samp_cache = (jnp.asarray(temp), jnp.asarray(top_k),
+                            jnp.asarray(top_p), jnp.asarray(eos))
+        return self._samp_cache
 
     def _block_size(self) -> int:
         """Largest safe compiled block: never past any active slot's
-        remaining budget, 1 when any active request needs per-token eos
-        checks; power-of-two ladder keeps distinct compiles bounded."""
-        remaining = []
-        for s, req in enumerate(self._active):
-            if req is None:
-                continue
-            if req.params.eos_id is not None:
-                return 1
-            remaining.append(
-                req.params.max_new_tokens - len(self._emitted[s])
-            )
+        remaining budget; power-of-two ladder keeps distinct compiles
+        bounded. eos no longer caps the block — stops are observed
+        per-slot inside the compiled scan and retired on the host."""
+        remaining = [
+            req.params.max_new_tokens - len(self._emitted[s])
+            for s, req in enumerate(self._active) if req is not None
+        ]
         cap = min(self.decode_block, min(remaining))
         block = 1
         while block * 2 <= cap:
@@ -485,22 +950,38 @@ class InferenceEngine:
         return block
 
     def step(self) -> int:
-        """Admit waiting requests, decode one token (or one compiled
-        block of tokens) for every active slot, retire finished ones.
-        Returns number of active slots."""
-        self._admit()
+        """Admit (at most one chunk of) waiting work, decode one token
+        (or one compiled block) for every active slot, retire finished
+        ones. Returns number of active slots."""
+        had_active = any(r is not None for r in self._active)
+        t0 = time.monotonic()
+        admitted = self._admit_tick()
+        if had_active and admitted:
+            # the decode stall this admission cost the active batch —
+            # bounded by one prefill chunk (+ install) by construction
+            _decode_stall_seconds.observe(time.monotonic() - t0)
+        elif not had_active:
+            # nobody was decoding: no stall to bound, so fill the
+            # batch like the pre-chunking admission did (cold bursts —
+            # the dominant test/rollout shape — keep their old step
+            # count; the one-unit bound only governs LIVE batches)
+            while (admitted
+                   and any(r is None for r in self._active)
+                   and (self._queue or self._parked
+                        or self._pending is not None)):
+                admitted = self._admit_tick()
         active_mask = np.array(
             [r is not None for r in self._active], bool
         )
         if not active_mask.any():
             return 0
-        temp, top_k, top_p = self._sampling_tensors()
+        temp, top_k, top_p, eos_ids = self._sampling_tensors()
         block = self._block_size()
         args = (
             self.params, self._cache["k"], self._cache["v"],
             self._cache["pos"], self._last,
             jnp.asarray(self._seeds), jnp.asarray(self._sampled),
-            temp, top_k, top_p, jnp.asarray(active_mask),
+            temp, top_k, top_p, jnp.asarray(active_mask), eos_ids,
         )
         if block == 1 and self._aot_step is not None:
             toks_dev, k, v, pos, last = self._aot_step(*args)
@@ -520,6 +1001,7 @@ class InferenceEngine:
             for j in range(block):
                 t = int(toks[j, s])
                 self._emitted[s].append(t)
+                self._since_install[s] += 1
                 if req.on_token is not None:
                     try:
                         req.on_token(req.id, t)
@@ -550,13 +1032,24 @@ class InferenceEngine:
         _tokens_total.inc(len(self._emitted[slot]))
         self._active[slot] = None
         self._emitted[slot] = []
+        self._samp_cache = None
+        pages = self._slot_pages[slot]
+        if pages:
+            self._free_pages.extend(pages)
+        self._slot_pages[slot] = None
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free_pages)
 
     @property
     def outstanding(self) -> int:
-        """Queued + active requests (the gateway router's load signal)."""
-        return len(self._queue) + sum(
-            r is not None for r in self._active
-        )
+        """Queued + admitting + parked + active requests (the gateway
+        router's load signal)."""
+        return (len(self._queue)
+                + (1 if self._pending is not None else 0)
+                + len(self._parked)
+                + sum(r is not None for r in self._active))
 
     def poll_results(self) -> list[Result]:
         """Return (and clear) results retired since the last poll.
@@ -572,15 +1065,14 @@ class InferenceEngine:
         """Drain the queue and all active slots; returns results in
         completion order."""
         for _ in range(max_iters):
-            if not self._queue and not any(
-                r is not None for r in self._active
-            ):
+            if not self.outstanding:
                 break
             self.step()
         else:
             raise RuntimeError(
                 f"run() exhausted {max_iters} iterations with "
-                f"{len(self._queue)} queued and "
+                f"{len(self._queue)} queued, {len(self._parked)} "
+                f"parked and "
                 f"{sum(r is not None for r in self._active)} active "
                 "requests still unfinished"
             )

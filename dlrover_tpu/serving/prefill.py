@@ -1,0 +1,120 @@
+"""Prefill-only engine: the disaggregated prefill pool's worker.
+
+The compute-role split of Podracer actor/learner pods applied to
+serving: PREFILL replicas run only the chunked prefill program and ship
+page-granular ``KVBundle``s; DECODE replicas install bundles and run
+only the decode-step program. The two pools scale independently —
+long-prompt-heavy load grows the prefill pool, long-generation-heavy
+load grows the decode pool — and a prompt joining the system never
+steals a decode step from anyone.
+
+``PrefillEngine`` wraps a normal ``InferenceEngine`` (sharing its
+params, chunk program and final-aligned-boundary prefix cache) but
+exposes the replica surface ``gateway/pool.py`` drives —
+``submit / step / poll_results / outstanding / slots`` — so a prefill
+pool is just a ``ReplicaPool`` over this factory. ``step()`` runs ONE
+prefill chunk, keeping drain/kill responsive mid-prompt, exactly like
+the decode engine's chunked admission.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from collections import deque
+from typing import Any
+
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.serving.engine import InferenceEngine, KVBundle
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class PrefillResult:
+    """One finished prefill: what the gateway hands to the decode pool."""
+
+    id: int
+    prompt: list[int]
+    bundle: KVBundle
+    chunks: int
+    finish_reason: str = "prefilled"
+    tokens: tuple = ()
+
+
+class PrefillEngine:
+    """One chunked prefill at a time behind the replica surface.
+
+    Single-threaded like the decode engine: only the owning replica
+    thread may touch it. ``slots`` mirrors the wrapped engine's slot
+    count purely as the pool's occupancy denominator (a prefill replica
+    saturates at roughly one queued prompt per decode slot it feeds).
+    """
+
+    def __init__(self, engine: InferenceEngine):
+        self.engine = engine
+        self.slots = max(1, engine.slots)
+        self._ids = itertools.count()
+        self._queue: deque[tuple[int, list[int]]] = deque()
+        self._current: tuple[int, Any] | None = None   # (rid, run)
+        self._results: list[PrefillResult] = []
+
+    # ------------------------------------------------------- replica surface
+
+    @property
+    def params(self) -> Any:
+        return self.engine.params
+
+    @params.setter
+    def params(self, value: Any) -> None:
+        # weight pushes flow through to the wrapped engine, clearing
+        # its prefix cache (stale KV must never prefix a new bundle)
+        self.engine.params = value
+
+    @property
+    def outstanding(self) -> int:
+        return len(self._queue) + (1 if self._current else 0)
+
+    def submit(self, prompt: list[int], params: Any = None,
+               on_token: Any = None) -> int:
+        """Queue a prompt for prefill. ``params``/``on_token`` are
+        accepted for replica-surface compatibility; tokens only exist
+        once the decode pool takes over."""
+        prompt = list(prompt)
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) > self.engine.max_len:
+            raise ValueError("prompt > max_len")
+        rid = next(self._ids)
+        self._queue.append((rid, prompt))
+        return rid
+
+    def step(self) -> int:
+        """Run ONE prefill chunk of the current prompt (starting the
+        next queued one if idle); returns outstanding count."""
+        if self._current is None and self._queue:
+            rid, prompt = self._queue.popleft()
+            self._current = (rid, self.engine.prefill_begin(prompt))
+        if self._current is not None:
+            rid, run = self._current
+            if self.engine.prefill_step(run):
+                self._results.append(PrefillResult(
+                    id=rid, prompt=run.prompt,
+                    bundle=self.engine.make_bundle(run),
+                    chunks=run.chunks,
+                ))
+                self._current = None
+        return self.outstanding
+
+    def poll_results(self) -> list[PrefillResult]:
+        out, self._results = self._results, []
+        return out
+
+    def run(self, max_iters: int = 100000) -> list[PrefillResult]:
+        """Drain the queue (test/offline helper)."""
+        for _ in range(max_iters):
+            if not self.outstanding:
+                break
+            self.step()
+        out, self._results = self._results, []
+        return out

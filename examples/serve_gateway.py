@@ -49,6 +49,17 @@ def parse_args(argv=None):
     p.add_argument("--prefill-len", type=int, default=64)
     p.add_argument("--decode-block", type=int, default=8)
     p.add_argument("--prefix-cache-entries", type=int, default=8)
+    p.add_argument("--prefill-replicas", type=int, default=0,
+                   help="> 0 disaggregates: a prefill pool of this "
+                        "size ships paged KV bundles to the decode "
+                        "pool (DESIGN.md §23)")
+    p.add_argument("--max-prefill-replicas", type=int, default=0,
+                   help="0 = use --prefill-replicas")
+    p.add_argument("--kv-pages", type=int, default=0,
+                   help="physical KV pages per engine (paged "
+                        "admission + park/resume; 0 = dense slots)")
+    p.add_argument("--page-size", type=int, default=0,
+                   help="tokens per KV page (default: prefill-len)")
     p.add_argument("--admission-deadline", type=float, default=30.0,
                    help="seconds of estimated queue wait past which "
                         "the gateway answers 429 + Retry-After")
@@ -96,6 +107,7 @@ def main(argv=None) -> int:
     args = parse_args(argv)
 
     from dlrover_tpu.gateway import (
+        DisaggAutoscaler,
         Gateway,
         GatewayAutoscaler,
         GatewayHTTPServer,
@@ -117,22 +129,40 @@ def main(argv=None) -> int:
             prefill_len=args.prefill_len,
             decode_block=args.decode_block,
             prefix_cache_entries=args.prefix_cache_entries,
+            kv_pages=args.kv_pages,
+            page_size=args.page_size,
         )
 
     gateway = Gateway(
         engine_factory, replicas=args.replicas,
         prefill_len=args.prefill_len,
+        prefill_replicas=args.prefill_replicas,
         admission_deadline_s=args.admission_deadline,
         preemption_file=args.preemption_file or None,
     )
-    autoscaler = GatewayAutoscaler(
-        gateway, PoolScaler(gateway.pool),
-        min_replicas=args.min_replicas or args.replicas,
-        max_replicas=max(args.max_replicas,
-                         args.min_replicas or args.replicas),
-        interval_s=args.autoscale_interval,
-        target_p95_s=args.target_p95,
-    ).start()
+    if args.prefill_replicas:
+        autoscaler = DisaggAutoscaler(
+            gateway,
+            PoolScaler(gateway.prefill_pool, group="prefill"),
+            PoolScaler(gateway.pool, group="decode"),
+            min_prefill=args.prefill_replicas,
+            max_prefill=max(args.max_prefill_replicas,
+                            args.prefill_replicas),
+            min_decode=args.min_replicas or args.replicas,
+            max_decode=max(args.max_replicas,
+                           args.min_replicas or args.replicas),
+            interval_s=args.autoscale_interval,
+            target_p95_s=args.target_p95,
+        ).start()
+    else:
+        autoscaler = GatewayAutoscaler(
+            gateway, PoolScaler(gateway.pool),
+            min_replicas=args.min_replicas or args.replicas,
+            max_replicas=max(args.max_replicas,
+                             args.min_replicas or args.replicas),
+            interval_s=args.autoscale_interval,
+            target_p95_s=args.target_p95,
+        ).start()
     server = GatewayHTTPServer(gateway, host=args.host,
                                port=args.port).start()
     exposition.start_from_env()  # optional extra bare /metrics port

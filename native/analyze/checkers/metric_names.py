@@ -76,6 +76,10 @@ DOCUMENTED_PREFIXES = (
     # control-plane observatory (DESIGN.md §22): the "master is slow"
     # runbook keys on the dispatch/lock/ingest attribution families
     "dlrover_tpu_master_",
+    # disaggregated serving data plane (DESIGN.md §23): the "TTFT is
+    # spiking" runbook keys on the decode-stall histogram and the
+    # paged-KV park/handoff counters
+    "dlrover_tpu_engine_",
 )
 
 # label names that are themselves an operator contract (dashboards and

@@ -1,0 +1,360 @@
+"""Disaggregated prefill/decode serving (ISSUE 12 tentpole).
+
+The properties that make the split worth shipping:
+
+- token identity: a request prefilled on the prefill pool and decoded
+  on the decode pool emits bit-identical tokens to the unified path
+  (same gateway-minted seed);
+- paged KV: eviction (park) + readmission round-trips bit-identically
+  under a seeded open-loop trace, and a long generation no longer
+  blocks a short one behind a dense slot;
+- the shard ring keeps prefix families on one gateway shard and moves
+  ~1/N of the keyspace on membership change;
+- the split autoscaler sizes the prefill pool by prompt backlog and
+  the decode pool by occupancy — independently, with hysteresis.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+import jax
+
+from dlrover_tpu.gateway import (
+    DisaggAutoscaler,
+    DisaggSignals,
+    Gateway,
+    PoolScaler,
+    ShardRing,
+)
+from dlrover_tpu.models import transformer as tfm
+from dlrover_tpu.serving import (
+    InferenceEngine,
+    PrefillEngine,
+    SamplingParams,
+)
+
+CFG = tfm.CONFIGS["tiny"]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _factory(params, *, kv_pages=0):
+    def build():
+        return InferenceEngine(
+            params, CFG, slots=2, max_len=64, prefill_len=8,
+            prefix_cache_entries=4, kv_pages=kv_pages,
+        )
+    return build
+
+
+def _wait(cond, timeout=90.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ------------------------------------------------------------- shard ring
+
+
+class TestShardRing:
+    def test_prefix_family_colocates(self):
+        ring = ShardRing(8, ["gw-0", "gw-1", "gw-2"])
+        sys_prompt = list(range(100, 108))
+        shards = {
+            ring.shard_for(sys_prompt + [extra, extra + 1])
+            for extra in range(20)
+        }
+        # every member of the prefix family lands on ONE shard
+        assert len(shards) == 1
+
+    def test_distribution_covers_all_shards(self):
+        ring = ShardRing(8, [f"gw-{i}" for i in range(4)])
+        hits = {}
+        for base in range(200):
+            s = ring.shard_for([base * 17 + j for j in range(8)])
+            hits[s] = hits.get(s, 0) + 1
+        assert len(hits) == 4          # nobody starved
+        assert max(hits.values()) < 200 * 0.6  # nobody owns everything
+
+    def test_membership_change_moves_bounded_fraction(self):
+        shards = [f"gw-{i}" for i in range(4)]
+        ring = ShardRing(8, shards)
+        keys = [[base * 31 + j for j in range(8)] for base in range(300)]
+        before = [ring.shard_for(k) for k in keys]
+        ring.remove_shard("gw-2")
+        after = [ring.shard_for(k) for k in keys]
+        moved = sum(1 for b, a in zip(before, after) if b != a)
+        # only gw-2's keys move (~1/4 of the space), nothing else
+        assert all(b == "gw-2" for b, a in zip(before, after) if b != a)
+        assert 0 < moved < 300 * 0.5
+        # re-adding restores the original assignment exactly
+        ring.add_shard("gw-2")
+        assert [ring.shard_for(k) for k in keys] == before
+
+    def test_short_prompts_and_empty_ring(self):
+        ring = ShardRing(8)
+        assert ring.shard_for([1, 2, 3]) is None
+        ring.add_shard("gw-0")
+        assert ring.shard_for([1, 2]) == "gw-0"
+        assert ring.shards() == ["gw-0"]
+
+
+# ----------------------------------------------------- split autoscaler
+
+
+class TestDisaggAutoscaler:
+    def _asc(self, signals, **kw):
+        plans = []
+
+        class _Recorder:
+            def scale(self, plan):
+                plans.append(plan)
+
+        it = iter(signals)
+        asc = DisaggAutoscaler(
+            gateway=None, prefill_scaler=_Recorder(),
+            decode_scaler=_Recorder(),
+            min_prefill=1, max_prefill=4, min_decode=1, max_decode=4,
+            down_ticks=2, signals_fn=lambda: next(it), **kw,
+        )
+        return asc, plans
+
+    def test_prefill_backlog_scales_only_prefill(self):
+        sig = DisaggSignals(prefill_backlog=10, prefill_live=1,
+                            decode_queue=0, decode_occupancy=0.5,
+                            decode_live=2, slots_per_replica=2)
+        asc, plans = self._asc([sig])
+        asc.tick()
+        assert asc.prefill_policy.target == 2
+        assert asc.decode_policy.target == 2      # untouched
+        # both scalers saw the SAME plan carrying both groups
+        assert plans[-1].replica_resources == {"prefill": 2,
+                                               "decode": 2}
+
+    def test_decode_occupancy_scales_only_decode(self):
+        sig = DisaggSignals(prefill_backlog=0, prefill_live=2,
+                            decode_queue=0, decode_occupancy=0.95,
+                            decode_live=2, slots_per_replica=2)
+        asc, _ = self._asc([sig])
+        asc.tick()
+        assert asc.decode_policy.target == 3
+        # empty prefill queue is COLD for prefill, but hysteresis holds
+        # the first tick
+        assert asc.prefill_policy.target == 2
+
+    def test_down_needs_streak_per_pool(self):
+        cold = DisaggSignals(prefill_backlog=0, prefill_live=3,
+                             decode_queue=0, decode_occupancy=0.1,
+                             decode_live=3, slots_per_replica=2)
+        asc, _ = self._asc([cold, cold, cold])
+        asc.tick()
+        assert (asc.prefill_policy.target,
+                asc.decode_policy.target) == (3, 3)
+        asc.tick()   # streak of 2 reached for both pools
+        assert (asc.prefill_policy.target,
+                asc.decode_policy.target) == (2, 2)
+
+    def test_mixed_load_diverges_pools(self):
+        """Prefill-bound then decode-bound load drives the two targets
+        in opposite directions — the thrash a single shared signal
+        could never avoid."""
+        prefill_bound = DisaggSignals(
+            prefill_backlog=12, prefill_live=1, decode_queue=0,
+            decode_occupancy=0.1, decode_live=2, slots_per_replica=2)
+        asc, _ = self._asc([prefill_bound] * 3)
+        for _ in range(3):
+            asc.tick()
+        assert asc.prefill_policy.target > 2
+        assert asc.decode_policy.target <= 2
+
+    def test_restore_emits_plan(self):
+        steady = DisaggSignals(prefill_backlog=1, prefill_live=0,
+                               decode_queue=0, decode_occupancy=0.5,
+                               decode_live=2, slots_per_replica=2)
+        asc, plans = self._asc([steady])
+        asc.prefill_policy.target = 1
+        asc.decode_policy.target = 2
+        asc.tick()
+        assert plans and plans[-1].replica_resources["prefill"] == 1
+
+
+# ------------------------------------------------------ prefill engine
+
+
+@pytest.mark.timeout(300)
+def test_prefill_engine_chunks_and_bundles(params):
+    """One chunk per step (drain/kill stay responsive mid-prompt);
+    bundles are page-granular, covering exactly ceil(prompt/page)."""
+    eng = PrefillEngine(_factory(params)())
+    long_prompt = list(range(19))            # 3 chunks at P=8
+    rid = eng.submit(long_prompt)
+    steps = 0
+    while eng.outstanding:
+        eng.step()
+        steps += 1
+        assert steps < 20
+    assert steps >= 3                        # chunked, not monolithic
+    [res] = eng.poll_results()
+    assert res.id == rid and res.chunks == 3
+    assert res.bundle.pos == 19
+    assert res.bundle.k.shape[1] == 3        # ceil(19/8) pages shipped
+    with pytest.raises(ValueError):
+        eng.submit([])
+
+
+# ------------------------------------------------- disagg token identity
+
+
+@pytest.mark.timeout(300)
+def test_disagg_tokens_identical_to_unified(params):
+    """ISSUE 12 acceptance: prefill on the prefill pool + decode on the
+    decode pool == the unified path, bit for bit, for greedy AND
+    sampled requests (the gateway mints the same seed either way)."""
+    prompts = [[5, 9, 2],
+               list(range(40, 56)) + [3],    # 2 aligned chunks + tail
+               [7, 7, 7, 7, 1]]
+    sps = [SamplingParams(temperature=0.9, top_p=0.95,
+                          max_new_tokens=8),
+           SamplingParams(temperature=0.0, max_new_tokens=6),
+           SamplingParams(temperature=0.7, top_k=20,
+                          max_new_tokens=5)]
+
+    uni = Gateway(_factory(params), replicas=1, prefill_len=8, seed=42)
+    assert _wait(lambda: len(uni.pool.ready_replicas()) == 1)
+    want = [uni.generate(p, s, timeout=120).tokens
+            for p, s in zip(prompts, sps)]
+    uni.stop()
+
+    dis = Gateway(_factory(params, kv_pages=16), replicas=1,
+                  prefill_len=8, prefill_replicas=1, seed=42)
+    assert _wait(lambda: len(dis.pool.ready_replicas()) == 1
+                 and len(dis.prefill_pool.ready_replicas()) == 1)
+    try:
+        got = [dis.generate(p, s, timeout=120).tokens
+               for p, s in zip(prompts, sps)]
+        assert got == want
+        stats = dis.stats()
+        assert stats["disaggregated"] and stats["prefill_ready"] == 1
+    finally:
+        dis.stop()
+
+
+@pytest.mark.timeout(300)
+# slow tier (tier-1 envelope): the ScalePlan resize path is already
+# pinned per-pool by test_gateway's scaleplan test + the pure
+# DisaggAutoscaler tests above; this e2e re-proves it with live
+# engine builds. `pytest tests/` still runs it.
+@pytest.mark.slow
+def test_disagg_pools_scale_independently(params):
+    """The ScalePlan path resizes each pool by its own group key."""
+    gw = Gateway(_factory(params), replicas=1, prefill_len=8,
+                 prefill_replicas=1, health_interval_s=0.1)
+    assert _wait(lambda: len(gw.pool.ready_replicas()) == 1
+                 and len(gw.prefill_pool.ready_replicas()) == 1)
+    try:
+        from dlrover_tpu.cluster.crd import ScalePlan
+
+        prefill_scaler = PoolScaler(gw.prefill_pool, group="prefill")
+        decode_scaler = PoolScaler(gw.pool, group="decode")
+        plan = ScalePlan(replica_resources={"prefill": 2, "decode": 1},
+                         reason="test")
+        prefill_scaler.scale(plan)
+        decode_scaler.scale(plan)
+        assert _wait(
+            lambda: len(gw.prefill_pool.ready_replicas()) == 2)
+        assert len(gw.pool.ready_replicas()) == 1
+        # and the grown prefill tier still serves identical results
+        res = gw.generate([5, 9, 2], SamplingParams(
+            temperature=0.0, max_new_tokens=4), timeout=120)
+        assert len(res.tokens) == 4
+    finally:
+        gw.stop()
+
+
+# --------------------------------------------- paged eviction round trip
+
+
+@pytest.mark.timeout(300)
+def test_paged_eviction_readmission_seeded_trace(params):
+    """Seeded open-loop-shaped trace on a page-pooled engine: parks
+    and resumes MUST happen, every request completes, and every token
+    stream is bit-identical to the dense (no-paging) engine."""
+    import random
+
+    rng = random.Random(7)
+    reqs = []
+    for i in range(8):
+        plen = rng.randint(1, 12)
+        reqs.append((
+            [rng.randrange(CFG.vocab_size) for _ in range(plen)],
+            SamplingParams(
+                temperature=rng.choice([0.0, 0.8]),
+                max_new_tokens=rng.randint(2, 20),
+                seed=1000 + i),
+        ))
+
+    def run(kv_pages):
+        eng = InferenceEngine(params, CFG, slots=2, max_len=64,
+                              prefill_len=8, kv_pages=kv_pages)
+        order = []
+        ids = [eng.submit(p, sp) for p, sp in reqs]
+        out = {}
+        for r in eng.run():
+            out[r.id] = r.tokens
+            order.append(r.id)
+        return eng, [out[i] for i in ids], order
+
+    dense_eng, dense, _ = run(0)
+    paged_eng, paged, order = run(24)
+    assert paged == dense                      # bit-identical streams
+    assert paged_eng.kv_parked_total >= 1      # eviction actually ran
+    assert paged_eng.free_pages == 24          # every page returned
+    assert dense_eng.kv_parked_total == 0
+
+
+@pytest.mark.timeout(300)
+# slow tier (tier-1 envelope): the park/resume identity + ledger
+# accounting stay covered in-tier by the seeded round-trip test
+# above; this adds the completion-ORDER claim. `pytest tests/`
+# still runs it.
+@pytest.mark.slow
+def test_paged_long_generation_does_not_block_short(params):
+    """The ROADMAP complaint: one long generation pinning a dense slot
+    starves admission. With paging, the short request is parked IN and
+    finishes first; the long one resumes and still matches dense."""
+    eng = InferenceEngine(params, CFG, slots=1, max_len=64,
+                          prefill_len=8, kv_pages=16)
+    long_id = eng.submit([5, 9, 2], SamplingParams(
+        temperature=0.0, max_new_tokens=30))
+    short_id = eng.submit([7, 7], SamplingParams(
+        temperature=0.0, max_new_tokens=4))
+    results = eng.run()
+    assert [r.id for r in results] == [short_id, long_id]
+    assert eng.kv_parked_total >= 1
+
+    dense = InferenceEngine(params, CFG, slots=1, max_len=64,
+                            prefill_len=8)
+    d_long = dense.submit([5, 9, 2], SamplingParams(
+        temperature=0.0, max_new_tokens=30))
+    d_short = dense.submit([7, 7], SamplingParams(
+        temperature=0.0, max_new_tokens=4))
+    dense_out = {r.id: r.tokens for r in dense.run()}
+    paged_out = {r.id: r.tokens for r in results}
+    assert paged_out[long_id] == dense_out[d_long]
+    assert paged_out[short_id] == dense_out[d_short]
+
+    # page ledger at submit time: a request that cannot ever fit the
+    # pool is rejected up front, not wedged in the queue
+    tiny = InferenceEngine(params, CFG, slots=1, max_len=64,
+                           prefill_len=8, kv_pages=2)
+    with pytest.raises(ValueError, match="pages"):
+        tiny.submit([1] * 10, SamplingParams(max_new_tokens=20))

@@ -249,6 +249,12 @@ class TestInterleavedSchedule:
                 interleave=4,
             )
 
+    # slow tier (tier-1 envelope): the heaviest body in this file —
+    # the full (P, v, M/P) matrix compiles many schedule variants;
+    # single-point parity stays covered in-tier by grads_match_scan /
+    # interleaved_matches_dp_loss_small / interleaved_preset_trains.
+    # `pytest tests/` still runs it.
+    @pytest.mark.slow
     def test_schedule_parity_matrix(self):
         """Raw pipeline_apply vs plain layer chain across the full
         grouped-injection shape matrix (P, v, M/P groups) — tiny

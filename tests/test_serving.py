@@ -124,6 +124,110 @@ def test_block_decode_matches_per_token(params):
     assert len(out[1][0]) == 12 and len(out[1][1]) == 7
 
 
+@pytest.mark.timeout(300)
+def test_eos_request_no_longer_serializes_batchmates(params):
+    """ISSUE 12 satellite: eos is observed per-slot INSIDE the compiled
+    block — one eos-bearing request must not collapse the whole batch
+    to token-at-a-time decode, and its mate's tokens are unchanged."""
+    eng = InferenceEngine(params, CFG, slots=2, max_len=64,
+                          prefill_len=8, decode_block=8)
+    # reference first, on the SAME engine (seeded per-request streams
+    # are batch-independent, so engine reuse is sound and saves a
+    # second 3-program compile in the tier-1 envelope)
+    ref_mate = eng.submit([4, 2], SamplingParams(
+        temperature=0.0, max_new_tokens=12))
+    want_mate = {r.id: r for r in eng.run()}[ref_mate].tokens
+    blocks = []
+    orig = eng._step_block
+
+    def spy(*a, n_steps=1):
+        blocks.append(n_steps)
+        return orig(*a, n_steps=n_steps)
+
+    eng._step_block = spy
+    probe = generate(params, jnp.asarray([[5, 9, 2]], jnp.int32), CFG,
+                     gen_len=1, key=jax.random.PRNGKey(0),
+                     temperature=0.0)
+    eos = int(np.asarray(probe)[0, -1])
+    r_eos = eng.submit([5, 9, 2], SamplingParams(
+        temperature=0.0, max_new_tokens=20, eos_id=eos))
+    r_mate = eng.submit([4, 2], SamplingParams(
+        temperature=0.0, max_new_tokens=12))
+    res = {r.id: r for r in eng.run()}
+    # the eos request still stops AT its eos...
+    assert res[r_eos].finish_reason == "eos"
+    assert res[r_eos].tokens == [eos]
+    # ...while blocks > 1 actually ran (pre-fix this was all 1s)
+    assert max(blocks) > 1, blocks
+    # and the mate decoded exactly what a no-eos batch produces
+    assert res[r_mate].tokens == want_mate
+
+
+@pytest.mark.timeout(300)
+def test_chunked_admission_bounds_decode_stall(params):
+    """ISSUE 12 tentpole (a): a long prompt joining the batch runs at
+    most ONE prefill chunk between decode steps — the active slot keeps
+    emitting tokens while the newcomer prefills, and the stall
+    histogram records each admission slice."""
+    from dlrover_tpu.serving import engine as engine_mod
+
+    eng = InferenceEngine(params, CFG, slots=2, max_len=64,
+                          prefill_len=8)
+    chunk_calls = []
+    orig = eng._prefill_chunk
+
+    def spy(*a):
+        chunk_calls.append(True)
+        return orig(*a)
+
+    eng._prefill_chunk = spy
+    active = eng.submit([1, 2], SamplingParams(temperature=0.0,
+                                               max_new_tokens=30))
+    eng.step()                      # admit + first token
+    assert eng._active[0] is not None
+    samp = engine_mod._decode_stall_seconds.samples()
+    count_before = samp[0]["count"] if samp else 0
+    long_prompt = list((np.arange(40) * 3 + 1) % CFG.vocab_size)
+    eng.submit(long_prompt, SamplingParams(temperature=0.0,
+                                           max_new_tokens=4))  # 5 chunks
+    emitted_at = []
+    while not any(r is not None and r.id != active
+                  for r in eng._active):
+        chunks_before = len(chunk_calls)
+        eng.step()
+        # at most one chunk of admission work ran in this step...
+        assert len(chunk_calls) - chunks_before <= 1
+        # ...and the active request took a decode step alongside it
+        emitted_at.append(len(eng._emitted[0]))
+        assert len(emitted_at) < 30
+    # the active slot made progress on EVERY step of the admission
+    assert emitted_at == sorted(emitted_at)
+    assert emitted_at[-1] - emitted_at[0] >= 3
+    # every admission slice landed in the stall histogram
+    stall_hist = engine_mod._decode_stall_seconds.samples()[0]
+    assert stall_hist["count"] > count_before
+    eng.run()
+
+
+def test_sampling_tensors_cached_between_steps(params):
+    """ISSUE 12 satellite: temp/top_k/top_p/eos vectors upload once per
+    active-set change, not once per step."""
+    eng = InferenceEngine(params, CFG, slots=2, max_len=64,
+                          prefill_len=8)
+    eng.submit([1, 2], SamplingParams(temperature=0.7,
+                                      max_new_tokens=6))
+    eng.step()
+    t1 = eng._sampling_tensors()
+    eng.step()
+    assert eng._sampling_tensors() is t1       # steady state: cached
+    eng.submit([3], SamplingParams(temperature=0.2, max_new_tokens=2))
+    eng.step()                                  # admit -> invalidated
+    t2 = eng._sampling_tensors()
+    assert t2 is not t1
+    eng.run()
+    assert eng._sampling_tensors() is not t2   # retire -> invalidated
+
+
 def _shard_params(preset_name, params, cfg, **preset_kwargs):
     """Place params per a strategy preset's specs on the CPU mesh."""
     from jax.sharding import NamedSharding
