@@ -2078,6 +2078,180 @@ def bench_7b_aot(extra: dict, stage_budget_s: float = 600.0) -> None:
         extra["aot_7b_error"] = (proc.stderr or line)[-400:]
 
 
+def bench_autopilot(extra: dict) -> None:
+    """Strategy autopilot (DESIGN.md §24.5), CPU-runnable: (a) plan the
+    tiny config via AOT enumeration, train it, record the measurement
+    into a per-run history, re-plan — the cached list must re-rank from
+    the measured entry (journaled `autopilot_plan source=history`) and
+    agree with a fresh measurement within 25%; (b) a seeded forced-
+    contradiction leg (wrong-estimate injection) times the closed-loop
+    retune and reports the post-retune MFU delta under a synthetic CPU
+    peak."""
+    import functools
+    import statistics
+
+    import jax
+    import optax
+
+    from dlrover_tpu.autopilot import (
+        AutopilotController,
+        PlanHistory,
+        load_or_plan,
+    )
+    from dlrover_tpu.autopilot import apply as autopilot_apply
+    from dlrover_tpu.models import transformer as tfm
+    from dlrover_tpu.parallel.strategy import dp, zero1
+    from dlrover_tpu.trainer.elastic_trainer import ElasticTrainer
+    from dlrover_tpu.trainer.train_step import compile_train
+
+    cfg = tfm.CONFIGS["tiny"]
+    seq, bsz, steps = 16, 8, 14
+    n_dev = len(jax.devices())
+    # synthetic peak so MFU is computable on CPU (the cost model's own
+    # CPU constant); on a real TPU the true peak applies upstream
+    peak = 2e11
+
+    kwargs = dict(
+        model="tiny",
+        loss_fn_for=lambda s, m: tfm.make_loss_fn(cfg, s, m),
+        init_params_fn=functools.partial(tfm.init_params, cfg),
+        logical_params=tfm.logical_axes(cfg),
+        optimizer=optax.adamw(1e-3),
+        example_batch={
+            "tokens": np.zeros((1, bsz, seq + 1), np.int32)
+        },
+        batch=bsz, seq=seq, model_cfg=cfg,
+        points=[(dp(), "spmd"), (zero1(), "spmd")],
+    )
+
+    def batches(n, seed=4242):
+        for i in range(n):
+            g = np.random.Generator(np.random.Philox(key=seed + i))
+            yield {"tokens": g.integers(
+                0, cfg.vocab_size, (1, bsz, seq + 1), dtype=np.int32
+            )}
+
+    def launch(plan):
+        strategy = plan.strategy()
+        mesh = strategy.build_mesh()
+        compiled = compile_train(
+            strategy=strategy, mesh=mesh,
+            loss_fn=kwargs["loss_fn_for"](strategy, mesh),
+            init_params_fn=kwargs["init_params_fn"],
+            logical_params=kwargs["logical_params"],
+            optimizer=kwargs["optimizer"],
+        )
+        return compiled, compiled.init(jax.random.PRNGKey(0))
+
+    def run(compiled, state, n, hook=None):
+        trainer = ElasticTrainer(
+            compiled, global_batch_size=bsz,
+            micro_batch_size=max(1, bsz // n_dev), model_name="tiny",
+        )
+        trainer.retune_hook = hook
+        step_walls: list[float] = []
+        last = [time.monotonic()]
+
+        def on_step(_s, m):
+            jax.device_get(m["loss"])  # pace host to device on CPU
+            now = time.monotonic()
+            step_walls.append(now - last[0])
+            last[0] = now
+
+        trainer.run_batches(state, batches(n), max_steps=n,
+                            on_step=on_step)
+        return trainer, step_walls
+
+    with tempfile.TemporaryDirectory() as tmp:
+        hist = PlanHistory(db_path=os.path.join(tmp, "hist.sqlite"))
+        cache = os.path.join(tmp, "plan.json")
+        ranked = load_or_plan(cache, history=hist, **kwargs)
+        plan = ranked.winner
+        compiled, state = launch(plan)
+        _, walls = run(compiled, state, steps)
+        measured = statistics.median(walls[1:])  # drop the compile step
+        hist.record(plan.strategy_json, measured, model="tiny",
+                    n_devices=n_dev, batch=bsz, seq=seq,
+                    mfu=plan.pred_flops / measured / (peak * n_dev))
+
+        # ---- history-seeded re-planning: cached list, measured entry
+        ranked2 = load_or_plan(cache, history=hist, **kwargs)
+        plan2 = ranked2.winner
+        extra["autopilot_plan_source"] = plan2.source
+        extra["autopilot_pred_step_s"] = round(plan2.pred_step_s, 5)
+        compiled2, state2 = launch(plan2)
+        _, walls2 = run(compiled2, state2, steps)
+        remeasured = statistics.median(walls2[1:])
+        extra["autopilot_measured_step_s"] = round(remeasured, 5)
+        agree = (min(plan2.pred_step_s, remeasured)
+                 / max(plan2.pred_step_s, remeasured)
+                 if plan2.pred_step_s and remeasured else 0.0)
+        extra["autopilot_agreement"] = round(agree, 3)
+        if plan2.source != "history":
+            raise RuntimeError(
+                "history-seeded re-plan did not reuse the measured "
+                f"entry (source={plan2.source})"
+            )
+
+        # ---- forced contradiction: wrong-estimate injection fires
+        # exactly one retune; time it and report the MFU delta
+        bad = ranked2.plans[0]
+        alt = ranked2.plans[1]
+        bad.pred_step_s = measured / 10.0
+        bad.source = "history"
+        ctrl = AutopilotController(
+            tolerance=1.5, clear_ratio=1.2, action_streak=3,
+            min_points=3, max_retunes=1,
+        )
+        ctrl.arm(bad, [alt])
+        compiled3, state3 = launch(bad)
+        apply_s: list[float] = []
+        retuned_step: list[int] = []
+        last = [time.monotonic()]
+
+        def hook(step, st):
+            now = time.monotonic()
+            d = ctrl.observe_step_time(now - last[0])
+            last[0] = now
+            if d is None:
+                return None
+            applied = autopilot_apply.apply_plan(
+                d.to_plan, state=st,
+                loss_fn_for=kwargs["loss_fn_for"],
+                init_params_fn=kwargs["init_params_fn"],
+                logical_params=kwargs["logical_params"],
+                optimizer=kwargs["optimizer"],
+                path=d.path,
+            )
+            apply_s.append(applied.seconds)
+            retuned_step.append(step)
+            return applied.compiled, applied.state
+
+        _trainer3, walls3 = run(compiled3, state3, steps, hook=hook)
+        if retuned_step:
+            k = retuned_step[0]  # 1-based step the decision fired on
+            # decision -> resumed training: walls3[k] spans from the
+            # hook's decision stamp through apply (program build/load +
+            # state move/launder) to the first completed step on the
+            # new plan (the hook re-bases last[] before applying)
+            first_post = walls3[k] if len(walls3) > k else 0.0
+            extra["autopilot_retune_seconds"] = round(first_post, 4)
+            extra["autopilot_apply_s"] = round(apply_s[0], 4)
+            pre = statistics.median(walls3[1:k]) if k > 1 \
+                else walls3[0]
+            post = statistics.median(walls3[k + 1:]) \
+                if len(walls3) > k + 1 else first_post
+            mfu_pre = plan2.pred_flops / pre / (peak * n_dev) \
+                if pre else 0.0
+            mfu_post = plan2.pred_flops / post / (peak * n_dev) \
+                if post else 0.0
+            extra["autopilot_retune_mfu_delta"] = round(
+                mfu_post - mfu_pre, 4
+            )
+        extra["autopilot_retunes"] = len(retuned_step)
+        hist.close()
+
+
 # ---------------------------------------------------------------------------
 # Stage harness
 # ---------------------------------------------------------------------------
@@ -2122,6 +2296,9 @@ STAGES = [
     Stage("control_plane", bench_control_plane, est_s=240,
           deadline_s=420, pass_budget=True, min_deadline_s=90),
     Stage("int8", bench_int8, est_s=275, deadline_s=450),
+    # strategy autopilot (CPU-runnable): plan-vs-measured agreement,
+    # history-seeded re-planning, seeded forced-contradiction retune
+    Stage("autopilot", bench_autopilot, est_s=60, deadline_s=200),
     Stage("aot7b", bench_7b_aot, est_s=15, deadline_s=120,
           pass_budget=True),
     Stage("long_context", bench_long_context, est_s=80, deadline_s=300),
@@ -2151,7 +2328,9 @@ HEADLINE_KEYS = [
     "gateway_p95_s", "gateway_failed", "gateway_ttft_p95_s",
     "gateway_itl_p95_s", "gateway_ttft_p95_unified_s",
     "gateway_disagg_ttft_speedup", "gateway_stall_p99_bound_chunks",
-    "int8_ffn_speedup", "soak_completed", "soak_kills",
+    "int8_ffn_speedup", "autopilot_agreement", "autopilot_pred_step_s",
+    "autopilot_retune_seconds", "autopilot_retune_mfu_delta",
+    "soak_completed", "soak_kills",
     "chaos_completed", "chaos_recovery_seconds", "chaos_goodput",
     "cp_master_rpc_p99_ms_n1000", "cp_master_rpc_p99_ms_n5000",
     "cp_master_joins_per_s_n1000", "cp_master_joins_per_s_n5000",

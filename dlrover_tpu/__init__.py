@@ -68,6 +68,14 @@ _LAZY_API = {
     # efficiency observatory (DESIGN.md §18)
     "EfficiencyMonitor": ("dlrover_tpu.telemetry.efficiency",
                           "EfficiencyMonitor"),
+    # strategy autopilot (DESIGN.md §24)
+    "Plan": ("dlrover_tpu.autopilot.planner", "Plan"),
+    "enumerate_plans": ("dlrover_tpu.autopilot.planner",
+                        "enumerate_plans"),
+    "load_or_plan": ("dlrover_tpu.autopilot.planner", "load_or_plan"),
+    "AutopilotController": ("dlrover_tpu.autopilot.controller",
+                            "AutopilotController"),
+    "PlanHistory": ("dlrover_tpu.autopilot.history", "PlanHistory"),
 }
 
 

@@ -304,6 +304,20 @@ class MasterClient:
         )
         tracker.commit()
 
+    def report_autopilot_plan(self, plan_json: str,
+                              alternatives_json: list | None = None
+                              ) -> None:
+        """Arm the master's autopilot controller (DESIGN.md §24) with
+        the plan this trainer launched and the planner's ranked
+        alternatives — the retune menu a sustained plan-vs-measured
+        contradiction picks from."""
+        self._client.call(
+            m.AutopilotPlanReport(
+                node_id=self.node_id, plan_json=plan_json,
+                alternatives_json=list(alternatives_json or []),
+            )
+        )
+
     def report_debug_bundle(self, path: str, reason: str,
                             proc: str = "") -> None:
         """Tell the master a flight-recorder bundle landed on this node

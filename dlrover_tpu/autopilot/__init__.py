@@ -1,0 +1,48 @@
+"""Strategy autopilot: closed-loop ``auto_accelerate`` for JAX.
+
+PAPER.md's ATorch centerpiece is ``auto_accelerate`` — automatic
+strategy search over DP/ZeRO/FSDP/TP/PP — plus the Brain service that
+retunes running jobs from observed metrics. This package is the loop
+that connects the repo's existing ingredients (DESIGN.md §24):
+
+- :mod:`~dlrover_tpu.autopilot.planner` enumerates feasible
+  (strategy preset × mesh shape × schedule) points for the current
+  world size via AOT lowering (``parallel/dry_run.py`` — no chips
+  touched), ranks them with the schedule-aware cost model, and emits a
+  typed :class:`~dlrover_tpu.autopilot.planner.Plan` the trainer
+  launches through the existing ``load_or_compile`` path.
+- :mod:`~dlrover_tpu.autopilot.controller` runs master-side, riding
+  the trainer snapshot pushes like ``telemetry/anomaly.py``: it
+  compares live step time / MFU against the plan's prediction and, on
+  sustained contradiction, picks the best ranked alternative and
+  applies it the cheapest way that works (hot program swap, the PR-6
+  reshard path, or an SPMD↔MPMD reschedule), journaling an
+  ``autopilot_retune`` decision trail — bounded retunes per job.
+- :mod:`~dlrover_tpu.autopilot.history` persists (plan fingerprint →
+  measured step_s/MFU) into the strategy-engine measured history so
+  the next job with the same workload fingerprint seeds its ranking
+  from measurements instead of the analytic model — the Brain-style
+  cross-job learning of PAPER.md §1.
+- :mod:`~dlrover_tpu.autopilot.apply` is the trainer-side applier: it
+  rebuilds the step program for the new plan (through the compile
+  cache), reshards the live state onto the new layout, and launders it
+  — the job never restarts.
+"""
+
+from dlrover_tpu.autopilot.controller import (  # noqa: F401
+    AutopilotController,
+    RetuneDecision,
+    choose_path,
+)
+from dlrover_tpu.autopilot.history import (  # noqa: F401
+    PlanHistory,
+    canonical_strategy_json,
+    plan_fingerprint,
+    shape_key,
+)
+from dlrover_tpu.autopilot.planner import (  # noqa: F401
+    Plan,
+    RankedPlans,
+    enumerate_plans,
+    load_or_plan,
+)

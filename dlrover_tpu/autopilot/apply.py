@@ -1,0 +1,178 @@
+"""Trainer-side plan application: retune without a restart.
+
+The controller's decision (``autopilot_retune``) names a target plan;
+this module morphs the RUNNING trainer onto it in-process:
+
+1. build the target strategy's step program through the existing
+   ``load_or_compile`` path (so a plan the fallback precompiler or a
+   previous incarnation already compiled loads in ~0.1s instead of
+   paying XLA again — the same warm path a launch takes);
+2. move the live state onto the target layout: each leaf is host-
+   gathered off its current sharding and ``device_put`` onto the target
+   program's exact state sharding (the PR-6 reshard semantics; for a
+   ``hot`` retune — same mesh, same schedule — this is a near-no-op
+   re-put);
+3. launder the moved tree (``compile_cache.launder`` — the §17 CPU
+   buffer-adoption hazard: a host-built tree must never reach a
+   deserialized donating executable un-re-staged).
+
+``can_apply`` is the applicability predicate the controller consults:
+this applier morphs SPMD↔SPMD plans whose batch geometry matches the
+running loader (the data pipeline keeps streaming untouched through a
+retune); SPMD↔MPMD rescheduling additionally requires the runtime
+rebuild the example wires (``MpmdTrain`` construction), so it is only
+offered where that path is present.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Optional
+
+from dlrover_tpu.autopilot.planner import Plan
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry.metrics import registry
+
+logger = get_logger(__name__)
+
+_apply_seconds = registry().histogram(
+    "dlrover_tpu_autopilot_apply_seconds",
+    "wall time of one in-process retune application (program build/"
+    "load + state move + launder), by path",
+    label_names=("path",),
+)
+
+
+def can_apply(current: Plan, target: Plan,
+              step_batch: int | None = None) -> bool:
+    """True when :func:`apply_plan` can morph ``current`` into
+    ``target`` on the live job: SPMD on both sides and, when the
+    caller states its per-step global batch, a target mesh that can
+    shard it. The assembled batch shape ``[accum, step_batch, ...]``
+    is independent of the data-parallel width (step_batch =
+    global/accum), so a dp-width change IS retunable — only a mesh
+    whose batch axes don't divide the step batch (or that fails to
+    build on this world) is a restart-class change."""
+    if current.schedule != "spmd" or target.schedule != "spmd":
+        return False
+    if step_batch is not None:
+        try:
+            import jax
+
+            from dlrover_tpu.parallel.mesh import data_parallel_size
+
+            mesh = target.strategy().build_mesh(jax.devices())
+            if step_batch % data_parallel_size(mesh):
+                return False
+        except (ValueError, AssertionError):
+            return False
+    return True
+
+
+@dataclasses.dataclass
+class AppliedPlan:
+    compiled: Any
+    state: Any
+    path: str
+    seconds: float
+    cache_hit: bool = False
+
+
+def apply_plan(
+    target: Plan,
+    *,
+    state: Any,
+    loss_fn_for,
+    init_params_fn,
+    logical_params,
+    optimizer,
+    model_cfg=None,
+    path: str = "hot",
+    cache=None,
+    num_nodes: int = 1,
+    example_batch: Any = None,
+    extra_fingerprint: Optional[dict] = None,
+) -> AppliedPlan:
+    """Build the target plan's program and carry the live state onto
+    it. Returns the new (compiled, state) pair — the caller swaps them
+    into the running trainer (``ElasticTrainer.swap_compiled``); no
+    process restarts, no rendezvous."""
+    import jax
+    import numpy as np
+
+    from dlrover_tpu.parallel import compile_cache as cc
+    from dlrover_tpu.trainer.train_step import compile_train
+
+    start = time.monotonic()
+    strategy = target.strategy()
+    mesh = strategy.build_mesh()
+    compiled = compile_train(
+        strategy=strategy,
+        mesh=mesh,
+        loss_fn=loss_fn_for(strategy, mesh),
+        init_params_fn=init_params_fn,
+        logical_params=logical_params,
+        optimizer=optimizer,
+    )
+    cache_hit = False
+    if example_batch is not None and cc.aot_cache_enabled():
+        # the launch path's load_or_compile, verbatim: a retune target
+        # the fallback daemon (or a sibling) already built loads warm
+        state_abs = jax.eval_shape(compiled.init, jax.random.PRNGKey(0))
+        state_abs = jax.tree.map(
+            lambda leaf, sh: jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype, sharding=sh
+            ),
+            state_abs, compiled.state_shardings,
+            is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct),
+        )
+        batch_abs = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(
+                np.shape(a), np.asarray(a).dtype,
+                sharding=compiled.batch_sharding,
+            ),
+            example_batch,
+        )
+        key, key_inputs = cc.compile_fingerprint(
+            num_nodes=num_nodes,
+            total_devices=len(jax.devices()),
+            mesh_axes=dict(mesh.shape),
+            model=model_cfg if model_cfg is not None else target.model,
+            strategy=strategy,
+            args_signature=cc.abstract_signature((state_abs, batch_abs)),
+            extra=extra_fingerprint,
+        )
+        aot = cc.load_or_compile(
+            key, key_inputs,
+            compile_fn=lambda: compiled.step.lower(
+                state_abs, batch_abs).compile(),
+            cache=cache,
+        )
+        compiled.step = aot.fn
+        compiled.cache_hit = aot.cache_hit
+        compiled.flops_per_step = aot.flops
+        cache_hit = bool(aot.cache_hit)
+
+    # state move: host-gather each leaf and re-put under the TARGET
+    # program's exact sharding (exact, not remapped — the new program
+    # dictates the layout); hot retunes re-put onto identical shardings
+    def _move(leaf, sharding):
+        return jax.device_put(
+            np.asarray(jax.device_get(leaf)), sharding
+        )
+
+    new_state = jax.tree.map(_move, state, compiled.state_shardings)
+    # host-built tree + (possibly deserialized, donating) executable:
+    # re-stage before the first step call (the §17 hazard)
+    new_state = cc.launder(new_state)
+    dur = time.monotonic() - start
+    _apply_seconds.labels(path).observe(dur)
+    logger.info(
+        "autopilot applied plan %s via %s in %.2fs (aot %s)",
+        target.name, path, dur, "hit" if cache_hit else "miss",
+    )
+    return AppliedPlan(
+        compiled=compiled, state=new_state, path=path, seconds=dur,
+        cache_hit=cache_hit,
+    )

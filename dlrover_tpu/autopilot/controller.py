@@ -1,0 +1,358 @@
+"""Autopilot controller: the Brain-style closed loop over a launched
+plan.
+
+Runs master-side, riding the trainer snapshot pushes exactly like the
+continuous straggler detector (``telemetry/anomaly.py``): the delta of
+the ``dlrover_tpu_train_step_seconds`` histogram's (sum, count) between
+two pushes is that node's mean step time over the interval — no new
+RPC, no probe round. The controller compares the fleet's recent median
+against the launched plan's prediction
+(:class:`~dlrover_tpu.autopilot.planner.Plan.pred_step_s`); live MFU
+rides the same pushes as corroborating evidence.
+
+Contradiction rule (hysteretic, same spirit as the PR-5 interval
+tuner): ``measured / predicted > tolerance`` on ``action_streak``
+consecutive evaluations fires a retune; a ratio back under
+``clear_ratio`` resets the streak, so a transient dip (one slow data
+shard, a neighbor's compile) never triggers anything. A retune picks
+the best APPLICABLE alternative from the planner's ranked list and
+applies it the cheapest way that works:
+
+==================  =======================================  =========
+plan delta          mechanism                                path
+==================  =======================================  =========
+same mesh+schedule  swap the step program (compile cache),   ``hot``
+                    state buffers untouched
+mesh axes differ    PR-6 reshard: rebuild program + move     ``reshard``
+                    state shards (``mesh.reshard_state``
+                    semantics), launder, resume
+schedule differs    SPMD<->MPMD runtime rebuild              ``reschedule``
+==================  =======================================  =========
+
+None of the paths restarts a process. Every decision journals an
+``autopilot_retune`` instant carrying the full evidence that triggered
+it; retunes are bounded per job (``DLROVER_TPU_AUTOPILOT_MAX_RETUNES``)
+— a plan that keeps contradicting after the budget is an operator
+page, not an oscillation (DESIGN.md §24 runbook).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+import threading
+from collections import deque
+from typing import Callable, Optional
+
+from dlrover_tpu.autopilot.planner import Plan, _pred_step_gauge
+from dlrover_tpu.common import envspec
+from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry.anomaly import _step_stats
+from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.metrics import registry
+
+logger = get_logger(__name__)
+
+MFU_METRIC = "dlrover_tpu_mfu"
+
+_step_ratio_gauge = registry().gauge(
+    "dlrover_tpu_autopilot_step_ratio",
+    "recent measured step time over the launched plan's prediction "
+    "(>1 = slower than planned; a sustained excursion past the "
+    "tolerance is the retune trigger)",
+)
+_retunes_total = registry().counter(
+    "dlrover_tpu_autopilot_retunes_total",
+    "applied autopilot retunes by application path "
+    "(hot/reshard/reschedule)",
+    label_names=("path",),
+)
+_contradiction_streak = registry().gauge(
+    "dlrover_tpu_autopilot_contradiction_streak",
+    "consecutive evaluations the live step time has contradicted the "
+    "plan's prediction (resets under the clear ratio)",
+)
+
+
+def choose_path(current: Plan, target: Plan) -> str:
+    """The retune decision table: cheapest mechanism that can morph
+    ``current`` into ``target`` without a restart."""
+    if target.schedule != current.schedule:
+        return "reschedule"
+    if dict(target.mesh_axes) != dict(current.mesh_axes):
+        return "reshard"
+    return "hot"
+
+
+def _mfu_value(samples: list) -> Optional[float]:
+    """Latest ``dlrover_tpu_mfu`` gauge value in a pushed snapshot, or
+    None (CPU backends leave the gauge unset)."""
+    for metric in samples:
+        if not isinstance(metric, dict) \
+                or metric.get("name") != MFU_METRIC:
+            continue
+        values = [float(s.get("value", 0.0))
+                  for s in metric.get("samples", ())]
+        values = [v for v in values if v > 0]
+        if values:
+            return max(values)
+    return None
+
+
+@dataclasses.dataclass
+class RetuneDecision:
+    """One journaled retune: evidence in, chosen alternative out."""
+
+    from_plan: Plan
+    to_plan: Plan
+    path: str
+    evidence: dict
+
+
+class _NodeSteps:
+    """Per-node cumulative (sum, count) tracker — the anomaly.py delta
+    trick, kept separately so the controller works without a straggler
+    detector in the loop."""
+
+    __slots__ = ("cum_sum", "cum_count")
+
+    def __init__(self):
+        self.cum_sum = 0.0
+        self.cum_count = 0
+
+    def delta(self, total: float, count: int) -> Optional[float]:
+        dsum = total - self.cum_sum
+        dcount = count - self.cum_count
+        if dcount < 0 or dsum < 0:  # trainer respawned: counters reset
+            dsum, dcount = total, count
+        self.cum_sum, self.cum_count = total, count
+        return dsum / dcount if dcount > 0 else None
+
+
+class AutopilotController:
+    """Hysteretic plan-vs-measured contradiction detector + retuner.
+
+    ``on_retune(decision)`` is the application hook: the master
+    servicer wires it to a ParalConfig push (the trainer hot-applies
+    through ``autopilot/apply.py``); in-process harnesses call the
+    applier directly. ``applicable(current, target)`` lets the caller
+    veto alternatives its apply path cannot morph to (e.g. a batch
+    geometry the running loader cannot feed) — the controller then
+    falls through to the next ranked alternative.
+    """
+
+    def __init__(
+        self,
+        *,
+        tolerance: float = 1.5,
+        clear_ratio: float = 1.2,
+        action_streak: int = 3,
+        window: int = 8,
+        min_points: int = 3,
+        max_retunes: Optional[int] = None,
+        on_retune: Optional[Callable[[RetuneDecision], None]] = None,
+        applicable: Optional[Callable[[Plan, Plan], bool]] = None,
+    ):
+        if clear_ratio >= tolerance:
+            raise ValueError(
+                "clear_ratio must sit below tolerance (hysteresis)"
+            )
+        self.tolerance = tolerance
+        self.clear_ratio = clear_ratio
+        self.action_streak = max(1, action_streak)
+        self.min_points = max(1, min_points)
+        if max_retunes is None:
+            max_retunes = envspec.get_int(
+                EnvKey.AUTOPILOT_MAX_RETUNES, 2
+            )
+        self.max_retunes = max(0, int(max_retunes))
+        self._on_retune = on_retune
+        self._applicable = applicable
+        self._lock = threading.Lock()
+        self._window = window
+        self._points: deque[float] = deque(maxlen=window)
+        self._nodes: dict[int, _NodeSteps] = {}
+        self._plan: Optional[Plan] = None
+        self._alternatives: list[Plan] = []
+        self._streak = 0
+        self._retunes_used = 0
+        self._calibrated = False
+        self._last_mfu: Optional[float] = None
+
+    # ------------------------------------------------------------- arming
+
+    def arm(self, plan: Plan, alternatives: list[Plan]) -> None:
+        """Install the launched plan and its ranked retune menu; resets
+        the measurement window (a fresh plan gets a fresh verdict).
+
+        A ``source="model"`` prediction is CALIBRATED from the first
+        healthy window before it can be contradicted: the roofline's
+        constants rank candidates against each other, but its absolute
+        scale is backend-dependent (parallel/cost_model.py says so
+        outright) — the contradiction signal for an analytic plan is a
+        DEGRADATION relative to its own early steps (sick host, data
+        stall), not disagreement with the roofline's absolute guess.
+        ``source="history"`` predictions are real measurements and are
+        held to directly."""
+        with self._lock:
+            self._plan = plan
+            self._alternatives = list(alternatives)
+            self._points.clear()
+            self._streak = 0
+            self._calibrated = plan.source == "history"
+        logger.info(
+            "autopilot armed: plan %s pred %.4fs/step, %d alternatives, "
+            "%d/%d retunes used", plan.name, plan.pred_step_s,
+            len(alternatives), self._retunes_used, self.max_retunes,
+        )
+
+    @property
+    def armed(self) -> bool:
+        with self._lock:
+            return self._plan is not None
+
+    @property
+    def plan(self) -> Optional[Plan]:
+        with self._lock:
+            return self._plan
+
+    @property
+    def retunes_used(self) -> int:
+        with self._lock:
+            return self._retunes_used
+
+    # ---------------------------------------------------------- ingestion
+
+    def observe_snapshot(self, node_id: int, samples: list
+                         ) -> Optional[RetuneDecision]:
+        """Feed one pushed registry snapshot (the servicer calls this
+        beside the straggler detector); cheap no-op when unarmed or the
+        push carries no step histogram."""
+        if not self.armed:
+            return None
+        stats = _step_stats(samples)
+        if stats is None:
+            return None
+        mfu = _mfu_value(samples)
+        with self._lock:
+            tracker = self._nodes.setdefault(node_id, _NodeSteps())
+            step_s = tracker.delta(*stats)
+            if mfu is not None:
+                self._last_mfu = mfu
+        if step_s is None:
+            return None
+        return self.observe_step_time(step_s)
+
+    def observe_step_time(self, step_s: float
+                          ) -> Optional[RetuneDecision]:
+        """Direct feed (in-process harnesses, the trainer-side loop);
+        returns the decision when this observation fired a retune."""
+        if step_s <= 0:
+            return None
+        with self._lock:
+            if self._plan is None:
+                return None
+            self._points.append(step_s)
+            decision = self._evaluate_locked()
+        if decision is not None:
+            self._publish(decision)
+        return decision
+
+    # ---------------------------------------------------------- evaluation
+
+    def _evaluate_locked(self) -> Optional[RetuneDecision]:
+        plan = self._plan
+        if len(self._points) < self.min_points:
+            return None
+        measured = statistics.median(self._points)
+        if not self._calibrated or plan.pred_step_s <= 0:
+            plan.pred_step_s = measured
+            self._calibrated = True
+            _pred_step_gauge.set(round(measured, 6))
+            logger.info(
+                "autopilot calibrated plan %s baseline to %.4fs/step "
+                "(analytic prediction replaced by the first healthy "
+                "window)", plan.name, measured,
+            )
+            return None
+        ratio = measured / plan.pred_step_s
+        _step_ratio_gauge.set(round(ratio, 4))
+        if ratio > self.tolerance:
+            self._streak += 1
+        elif ratio < self.clear_ratio:
+            self._streak = 0
+        _contradiction_streak.set(self._streak)
+        if self._streak < self.action_streak:
+            return None
+        if self._retunes_used >= self.max_retunes:
+            # budget spent: keep journal-visible evidence flowing (the
+            # ratio gauge) but never thrash — the §24 runbook case
+            return None
+        target = self._pick_alternative_locked(plan)
+        if target is None:
+            return None
+        self._retunes_used += 1
+        evidence = {
+            "measured_step_s": round(measured, 6),
+            "pred_step_s": round(plan.pred_step_s, 6),
+            "ratio": round(ratio, 4),
+            "streak": self._streak,
+            "tolerance": self.tolerance,
+            "mfu": round(self._last_mfu, 4)
+            if self._last_mfu is not None else None,
+            "retunes_used": self._retunes_used,
+            "max_retunes": self.max_retunes,
+        }
+        path = choose_path(plan, target)
+        # re-arm on the target: its own prediction becomes the new
+        # baseline and the window restarts clean
+        self._alternatives = [
+            p for p in self._alternatives
+            if p.fingerprint != target.fingerprint
+        ] + [plan]
+        self._plan = target
+        self._points.clear()
+        self._streak = 0
+        self._calibrated = target.source == "history"
+        return RetuneDecision(
+            from_plan=plan, to_plan=target, path=path, evidence=evidence
+        )
+
+    def _pick_alternative_locked(self, plan: Plan) -> Optional[Plan]:
+        for cand in sorted(self._alternatives,
+                           key=lambda p: (p.pred_step_s, p.rank)):
+            if cand.fingerprint == plan.fingerprint:
+                continue
+            if self._applicable is not None \
+                    and not self._applicable(plan, cand):
+                continue
+            return cand
+        return None
+
+    def _publish(self, decision: RetuneDecision) -> None:
+        _retunes_total.labels(decision.path).inc()
+        get_journal().emit(
+            "autopilot_retune",
+            from_plan=decision.from_plan.name,
+            from_fingerprint=decision.from_plan.fingerprint,
+            to_plan=decision.to_plan.name,
+            to_fingerprint=decision.to_plan.fingerprint,
+            to_source=decision.to_plan.source,
+            path=decision.path,
+            **decision.evidence,
+        )
+        logger.warning(
+            "autopilot retune: %s -> %s via %s (measured %.4fs vs "
+            "pred %.4fs, streak %d, %d/%d retunes)",
+            decision.from_plan.name, decision.to_plan.name,
+            decision.path, decision.evidence["measured_step_s"],
+            decision.evidence["pred_step_s"],
+            decision.evidence["streak"],
+            decision.evidence["retunes_used"], self.max_retunes,
+        )
+        if self._on_retune is not None:
+            try:
+                self._on_retune(decision)
+            except Exception:  # noqa: BLE001 - the hook must not kill ingest
+                logger.exception("autopilot on_retune hook failed")

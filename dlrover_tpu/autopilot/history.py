@@ -1,0 +1,176 @@
+"""The autopilot's measured-history vocabulary and store.
+
+One fingerprint vocabulary (ISSUE-13 satellite): the successive-halving
+winner (``parallel/search.py``), the strategy-engine measured history
+(``parallel/engine_service.py``) and the autopilot planner all key
+measurements the same way —
+
+- :func:`shape_key` is the workload identity ``(model, n_devices,
+  batch, seq, hbm_gb)`` — exactly the tuple the engine service uses for
+  ``_measured``/``_observations`` and its sqlite primary key; a
+  measurement only transfers at the exact shape it ran at (any other
+  batch/seq never passed the fit check).
+- :func:`canonical_strategy_json` is the per-plan identity within a
+  shape key: the strategy's JSON with sorted keys and no whitespace, so
+  ``Strategy.to_json`` (indent=2, field order) and a planner-minted
+  plan compare equal. The schedule needs no separate axis — it is
+  encoded in the strategy itself (``extra.mpmd`` / the ``pipeline``
+  preset), which is what lets the engine service stay schedule-blind.
+- :func:`plan_fingerprint` is the short stable digest of that identity
+  used in journals and the retune decision trail.
+
+:class:`PlanHistory` reads/writes the engine-service store through
+either a live :class:`~dlrover_tpu.parallel.engine_service.\
+StrategyEngineClient` or an in-process (unstarted) service with a
+sqlite path — same message types, same store, so a search winner
+recorded by job A seeds job B's planner ranking.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Optional
+
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+def shape_key(model: str, n_devices: int, batch: int, seq: int,
+              hbm_gb: float = 0.0) -> tuple:
+    """The workload identity tuple — byte-for-byte the key
+    ``StrategyEngineService`` indexes its measured history by."""
+    return (str(model), int(n_devices), int(batch), int(seq),
+            float(hbm_gb))
+
+
+def canonical_strategy_json(strategy: Any) -> str:
+    """Whitespace/ordering-normalized strategy JSON.
+
+    Accepts a ``Strategy``, a JSON string, or an already-parsed dict;
+    two serializations of the same strategy always canonicalize to the
+    same string, so dict lookups keyed on it behave like strategy
+    equality."""
+    if hasattr(strategy, "to_json"):
+        obj = json.loads(strategy.to_json())
+    elif isinstance(strategy, str):
+        obj = json.loads(strategy)
+    else:
+        obj = strategy
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"))
+
+
+def plan_fingerprint(strategy: Any, schedule: str = "spmd") -> str:
+    """Short digest identifying one plan point (strategy + schedule)
+    for journals and retune evidence. The schedule rides along even
+    though the strategy JSON implies it — the trail must stay readable
+    without parsing strategy extras."""
+    blob = canonical_strategy_json(strategy) + "|" + str(schedule)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+class PlanHistory:
+    """Measured (plan → step_s/MFU) history over the engine-service
+    store.
+
+    Backends (first non-None wins): ``client`` — a typed
+    ``StrategyEngineClient`` talking to a running service; ``service``
+    — an in-process ``StrategyEngineService`` (started or not: reads
+    and writes go through ``handle()`` directly); ``db_path`` — sugar
+    that builds an in-process service around the sqlite file, giving a
+    masterless job cross-run persistence with the exact schema a later
+    shared engine would warm-start from.
+    """
+
+    def __init__(self, client=None, service=None, db_path: str = ""):
+        self._client = client
+        self._service = service
+        if self._client is None and self._service is None and db_path:
+            from dlrover_tpu.parallel.engine_service import (
+                StrategyEngineService,
+            )
+
+            self._service = StrategyEngineService(db_path=db_path)
+            self._owns_service = True
+        else:
+            self._owns_service = False
+
+    @property
+    def available(self) -> bool:
+        return self._client is not None or self._service is not None
+
+    # ------------------------------------------------------------- reads
+
+    def lookup(self, model: str, n_devices: int, batch: int, seq: int,
+               hbm_gb: float = 0.0) -> dict[str, dict]:
+        """{canonical_strategy_json: {"step_time_s": s, "mfu": m}} for
+        the shape key; {} when the store is empty/unreachable (the
+        planner then ranks purely analytically)."""
+        if not self.available:
+            return {}
+        try:
+            if self._client is not None:
+                obs = self._client.get_observations(
+                    model, n_devices, batch=batch, seq=seq, hbm_gb=hbm_gb
+                )
+            else:
+                from dlrover_tpu.common import messages as m
+
+                obs = list(self._service.handle(
+                    m.StrategyObservationsRequest(
+                        model=model, n_devices=n_devices, batch=batch,
+                        seq=seq, hbm_gb=hbm_gb,
+                    )
+                ).observations)
+        except (ConnectionError, RuntimeError, OSError) as e:
+            logger.warning("plan history lookup failed: %s", e)
+            return {}
+        out: dict[str, dict] = {}
+        for o in obs:
+            try:
+                key = canonical_strategy_json(o["strategy_json"])
+            except (KeyError, ValueError, TypeError):
+                continue
+            out[key] = {
+                "step_time_s": float(o.get("step_time_s", 0.0)),
+                "mfu": float(o.get("mfu", 0.0) or 0.0),
+            }
+        return out
+
+    # ------------------------------------------------------------ writes
+
+    def record(self, strategy: Any, step_time_s: float, *, model: str,
+               n_devices: int, batch: int, seq: int,
+               hbm_gb: float = 0.0, mfu: Optional[float] = None) -> bool:
+        """Report one measured (plan → step_s/MFU) observation; best
+        effort — history is an accelerant, never a correctness
+        dependency."""
+        if not self.available or step_time_s <= 0:
+            return False
+        sj = canonical_strategy_json(strategy)
+        try:
+            if self._client is not None:
+                self._client.report_measurement(
+                    model=model, n_devices=n_devices, strategy=sj,
+                    step_time_s=float(step_time_s), batch=batch,
+                    seq=seq, hbm_gb=hbm_gb, mfu=float(mfu or 0.0),
+                )
+            else:
+                from dlrover_tpu.common import messages as m
+
+                self._service.handle(m.StrategyMeasurement(
+                    model=model, n_devices=n_devices, batch=batch,
+                    seq=seq, hbm_gb=hbm_gb, strategy_json=sj,
+                    step_time_s=float(step_time_s),
+                    mfu=float(mfu or 0.0),
+                ))
+            return True
+        except (ConnectionError, RuntimeError, OSError, ValueError) as e:
+            logger.warning("plan history record failed: %s", e)
+            return False
+
+    def close(self) -> None:
+        if self._owns_service and self._service is not None:
+            self._service.stop()
+            self._service = None

@@ -200,6 +200,12 @@ class EnvKey:
     CKPT_PERSIST_REPLICAS = "DLROVER_TPU_CKPT_PERSIST_REPLICAS"
     CKPT_PERSIST_WORKERS = "DLROVER_TPU_CKPT_PERSIST_WORKERS"
     CKPT_PERSIST_CHUNK_MB = "DLROVER_TPU_CKPT_PERSIST_CHUNK_MB"
+    # strategy autopilot (DESIGN.md §24): the stated per-device memory
+    # envelope for backends whose runtime reports none (CPU/tunneled —
+    # the planner's feasibility filter), and the per-job bound on
+    # closed-loop retunes the master-side controller may apply
+    DEVICE_HBM_BYTES = "DLROVER_TPU_DEVICE_HBM_BYTES"
+    AUTOPILOT_MAX_RETUNES = "DLROVER_TPU_AUTOPILOT_MAX_RETUNES"
 
 
 class Defaults:

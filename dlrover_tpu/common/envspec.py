@@ -194,6 +194,14 @@ SPECS: tuple[EnvVar, ...] = (
     EnvVar("DLROVER_TPU_CHAOS", None,
            "JSON fault plan (path or inline); read ONCE at chaos "
            "package import", "§15", restart_required=True),
+    # ------------------------------------------------------------ autopilot
+    EnvVar("DLROVER_TPU_DEVICE_HBM_BYTES", None,
+           "stated per-device memory envelope in bytes for backends "
+           "whose runtime reports none (CPU/tunneled); the planner's "
+           "AOT feasibility filter uses it", "§24"),
+    EnvVar("DLROVER_TPU_AUTOPILOT_MAX_RETUNES", "2",
+           "per-job bound on closed-loop autopilot retunes; 0 keeps "
+           "the controller observe-only", "§24"),
 )
 
 SPEC_BY_NAME: dict[str, EnvVar] = {spec.name: spec for spec in SPECS}

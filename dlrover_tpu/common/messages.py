@@ -658,6 +658,10 @@ class ParalConfig:
     # 0 = no suggestion, trainer keeps its CLI value. Hot-applied — the
     # cadence is not baked into the compiled program.
     snapshot_interval: int = 0
+    # autopilot retune target (autopilot/controller.py): the JSON of
+    # the plan the trainer should morph onto in-process
+    # (autopilot/apply.py) — hot-applied, never a restart
+    autopilot_plan: str = ""
     # knobs that require a recompile take effect at the next incarnation;
     # this flag asks the agent to restart workers to apply them
     restart_required: bool = False
@@ -741,3 +745,20 @@ class StrategyMeasurement:
     hbm_gb: float = 0.0
     strategy_json: str = ""
     step_time_s: float = 0.0
+    # measured model-FLOPs utilization alongside the step time (0 =
+    # unknown, e.g. CPU backends without a stated peak) — the autopilot
+    # history persists (plan fingerprint -> step_s/MFU) pairs
+    mfu: float = 0.0
+
+
+@register_message
+@dataclasses.dataclass
+class AutopilotPlanReport:
+    """Trainer-reported launched autopilot plan (DESIGN.md §24): arms
+    the master-side controller with the plan it must judge the live
+    metrics against plus the ranked alternatives it may retune to."""
+
+    node_id: int = 0
+    plan_json: str = ""            # planner.Plan.to_json of the launch
+    # planner.Plan.to_json of each ranked alternative, best first
+    alternatives_json: list = dataclasses.field(default_factory=list)

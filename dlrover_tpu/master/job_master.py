@@ -117,6 +117,15 @@ class JobMaster:
         return self._server.port
 
     @property
+    def autopilot(self):
+        """The servicer-owned strategy-autopilot controller
+        (autopilot/controller.py, DESIGN.md §24): armed by trainer
+        ``AutopilotPlanReport``s, fed by the same snapshot pushes the
+        straggler detector mines; exposed for operators/tests to read
+        the armed plan and the retune budget."""
+        return self.servicer._autopilot
+
+    @property
     def addr(self) -> str:
         return f"127.0.0.1:{self.port}"
 

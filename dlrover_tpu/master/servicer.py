@@ -45,6 +45,7 @@ class MasterServicer:
         trace_id: str = "",
         anomaly=None,
         compile_cache: CompileCacheService | None = None,
+        autopilot=None,
     ):
         from dlrover_tpu.master.stats import (
             JobMetricCollector,
@@ -99,6 +100,19 @@ class MasterServicer:
         # continuous straggler detector (telemetry/anomaly.py), fed from
         # the same pushed snapshots; None = feature not wired
         self._anomaly = anomaly
+        # strategy-autopilot controller (autopilot/controller.py,
+        # DESIGN.md §24): armed by AutopilotPlanReport, fed by the same
+        # trainer snapshot pushes; its retune decisions go back out
+        # through the paral-config channel (hot-applied, no restart)
+        if autopilot is None:
+            from dlrover_tpu.autopilot.controller import (
+                AutopilotController,
+            )
+
+            autopilot = AutopilotController(
+                on_retune=self._apply_autopilot_retune
+            )
+        self._autopilot = autopilot
         # bounded ledger of flight-recorder bundles reported by nodes
         self._bundles: list[m.DebugBundleReport] = []
         self._bundles_lock = threading.Lock()
@@ -327,6 +341,12 @@ class MasterServicer:
                 # the straggler detector mines the step-duration series
                 # out of the same push (no-op for snapshots without it)
                 self._anomaly.observe_snapshot(msg.node_id, msg.samples)
+            if self._autopilot is not None and msg.role == "trainer":
+                # same push feeds the plan-vs-measured contradiction
+                # detector (no-op while no plan is armed); a fired
+                # retune reaches trainers via _apply_autopilot_retune
+                self._autopilot.observe_snapshot(msg.node_id,
+                                                 msg.samples)
             if self._interval_tuner is not None and msg.role == "trainer":
                 # same push carries the snapshot-cost and step-time
                 # histograms the Young-Daly optimum needs
@@ -412,6 +432,8 @@ class MasterServicer:
             return self._network_check_group(msg)
         if isinstance(msg, m.NetworkCheckStatusRequest):
             return self._network_check_status()
+        if isinstance(msg, m.AutopilotPlanReport):
+            return self._autopilot_plan_report(msg)
         if isinstance(msg, m.ParalConfigRequest):
             with self._paral_lock:
                 return self._paral_config
@@ -503,6 +525,45 @@ class MasterServicer:
             found=True, buddy_node_id=nxt,
             addr=self._buddy_endpoints[nxt],
         )
+
+    def _autopilot_plan_report(self, msg: m.AutopilotPlanReport
+                               ) -> m.OkResponse:
+        """Arm the autopilot controller with the trainer's launched
+        plan + ranked alternatives (DESIGN.md §24). Re-reports after an
+        elastic restart re-arm idempotently (the retune budget is the
+        controller's and survives re-arming)."""
+        from dlrover_tpu.autopilot.planner import Plan
+
+        try:
+            plan = Plan.from_json(msg.plan_json)
+            alternatives = [Plan.from_json(a)
+                            for a in msg.alternatives_json]
+        except (ValueError, TypeError, KeyError) as e:
+            logger.warning("unparseable autopilot plan report from "
+                           "node %d: %s", msg.node_id, e)
+            return m.OkResponse(success=False)
+        self._autopilot.arm(plan, alternatives)
+        return m.OkResponse()
+
+    def _apply_autopilot_retune(self, decision) -> None:
+        """Push a fired retune to trainers through the paral-config
+        channel: the agent mirrors the file, the trainer hot-applies
+        the target plan in-process (autopilot/apply.py) — never a
+        restart."""
+        import dataclasses as _dc
+
+        with self._paral_lock:
+            self._paral_config = _dc.replace(
+                self._paral_config,
+                autopilot_plan=decision.to_plan.to_json(),
+                version=self._paral_config.version + 1,
+            )
+            logger.info(
+                "autopilot retune pushed: %s -> %s via %s (paral "
+                "config v%d)", decision.from_plan.name,
+                decision.to_plan.name, decision.path,
+                self._paral_config.version,
+            )
 
     def _maybe_retune_snapshot_interval(self) -> None:
         """Push an applied Young-Daly retune to trainers through the

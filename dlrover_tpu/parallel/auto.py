@@ -33,9 +33,21 @@ logger = get_logger(__name__)
 
 def device_hbm_bytes(device=None) -> int:
     """Per-device memory budget; a conservative default when the runtime
-    doesn't report one (CPU/tunneled backends)."""
+    doesn't report one (CPU/tunneled backends).
+
+    ``DLROVER_TPU_DEVICE_HBM_BYTES`` (DESIGN.md §24) wins outright: a
+    CPU or tunneled backend whose runtime reports nothing can state the
+    REAL target envelope, so the autopilot planner's feasibility filter
+    rejects OOM plans instead of silently skipping the check (0 = no
+    check)."""
     import jax as _jax
 
+    from dlrover_tpu.common import envspec
+    from dlrover_tpu.common.constants import EnvKey
+
+    stated = envspec.get_int(EnvKey.DEVICE_HBM_BYTES)
+    if stated is not None and stated > 0:
+        return stated
     device = device or _jax.devices()[0]
     try:
         stats = device.memory_stats()

@@ -27,6 +27,10 @@ import threading
 import time
 from typing import Any
 
+from dlrover_tpu.autopilot.history import (
+    canonical_strategy_json,
+    shape_key,
+)
 from dlrover_tpu.common import messages as m
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.common.rpc import RpcClient, RpcServer
@@ -119,19 +123,29 @@ class StrategyEngineService:
                 "CREATE TABLE IF NOT EXISTS strategy_obs ("
                 " model TEXT, n_devices INT, batch INT, seq INT,"
                 " hbm_gb REAL, strategy_json TEXT, step_time_s REAL,"
-                " timestamp REAL,"
+                " timestamp REAL, mfu REAL DEFAULT 0,"
                 " PRIMARY KEY (model, n_devices, batch, seq, hbm_gb,"
                 "              strategy_json))"
             )
+            try:
+                # dbs written before the autopilot's (step_s, MFU)
+                # pairs gain the column in place
+                self._db.execute(
+                    "ALTER TABLE strategy_obs"
+                    " ADD COLUMN mfu REAL DEFAULT 0"
+                )
+            except sqlite3.OperationalError:
+                pass  # column already present
             self._db.commit()
             for row in self._db.execute(
                 "SELECT model, n_devices, batch, seq, hbm_gb,"
-                " strategy_json, step_time_s FROM strategy_obs"
+                " strategy_json, step_time_s, mfu FROM strategy_obs"
                 " ORDER BY timestamp"
             ):
-                key = (row[0], row[1], row[2], row[3], row[4])
+                key = shape_key(row[0], row[1], row[2], row[3], row[4])
                 self._observations.setdefault(key, []).append(
-                    {"strategy_json": row[5], "step_time_s": row[6]}
+                    {"strategy_json": row[5], "step_time_s": row[6],
+                     "mfu": row[7] or 0.0}
                 )
                 best = self._measured.get(key)
                 if best is None or row[6] < best[0]:
@@ -153,11 +167,18 @@ class StrategyEngineService:
 
     def start(self) -> "StrategyEngineService":
         self._server.start()
+        self._started = True
         logger.info("strategy engine serving on %s", self.addr)
         return self
 
     def stop(self) -> None:
-        self._server.stop()
+        # an in-process (never-started) engine — the autopilot's
+        # PlanHistory db backend — must not call the socketserver's
+        # shutdown(): BaseServer.shutdown blocks on an event only
+        # serve_forever ever sets
+        if getattr(self, "_started", False):
+            self._server.stop()
+            self._started = False
         if self._db is not None:
             with self._lock:
                 self._db.close()
@@ -170,13 +191,17 @@ class StrategyEngineService:
             from dlrover_tpu.parallel.strategy import Strategy
 
             Strategy.from_json(msg.strategy_json)
-            key = (msg.model, msg.n_devices, msg.batch, msg.seq,
-                   msg.hbm_gb)
+            # ONE fingerprint vocabulary (autopilot/history.py): the
+            # stored key is shape_key and the per-plan identity is the
+            # canonical JSON — a search winner and an autopilot lookup
+            # can never miss each other over formatting
+            sj = canonical_strategy_json(msg.strategy_json)
+            key = shape_key(msg.model, msg.n_devices, msg.batch,
+                            msg.seq, msg.hbm_gb)
             with self._lock:
                 best = self._measured.get(key)
                 if best is None or msg.step_time_s < best[0]:
-                    self._measured[key] = (msg.step_time_s,
-                                           msg.strategy_json)
+                    self._measured[key] = (msg.step_time_s, sj)
                     logger.info(
                         "measured best for %s: %.4fs", key, msg.step_time_s
                     )
@@ -185,22 +210,26 @@ class StrategyEngineService:
                 # strategy, keeping the newest measurement
                 obs = self._observations.setdefault(key, [])
                 obs[:] = [o for o in obs
-                          if o["strategy_json"] != msg.strategy_json]
-                obs.append({"strategy_json": msg.strategy_json,
-                            "step_time_s": msg.step_time_s})
+                          if canonical_strategy_json(o["strategy_json"])
+                          != sj]
+                obs.append({"strategy_json": sj,
+                            "step_time_s": msg.step_time_s,
+                            "mfu": msg.mfu or 0.0})
                 del obs[:-256]
                 if self._db is not None:
                     self._db.execute(
-                        "INSERT OR REPLACE INTO strategy_obs VALUES"
-                        " (?, ?, ?, ?, ?, ?, ?, ?)",
-                        (*key, msg.strategy_json, msg.step_time_s,
-                         time.time()),
+                        "INSERT OR REPLACE INTO strategy_obs"
+                        " (model, n_devices, batch, seq, hbm_gb,"
+                        "  strategy_json, step_time_s, timestamp, mfu)"
+                        " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                        (*key, sj, msg.step_time_s, time.time(),
+                         msg.mfu or 0.0),
                     )
                     self._db.commit()
             return m.OkResponse()
         if isinstance(msg, m.StrategyObservationsRequest):
-            key = (msg.model, msg.n_devices, msg.batch, msg.seq,
-                   msg.hbm_gb)
+            key = shape_key(msg.model, msg.n_devices, msg.batch,
+                            msg.seq, msg.hbm_gb)
             with self._lock:
                 return m.StrategyObservations(
                     observations=list(self._observations.get(key, []))
@@ -215,8 +244,8 @@ class StrategyEngineService:
         # only for the "fastest" objective: a measured-fastest pick is
         # exactly what "fastest" asks for, but e.g. "first_fit" callers
         # want preference order, not speed
-        measured_key = (req.model, req.n_devices, req.batch, req.seq,
-                        req.hbm_gb)
+        measured_key = shape_key(req.model, req.n_devices, req.batch,
+                                 req.seq, req.hbm_gb)
         measured = None
         if req.objective == "fastest":
             with self._lock:
@@ -277,11 +306,21 @@ class StrategyEngineClient:
     def report_measurement(self, model: str, n_devices: int,
                            strategy, step_time_s: float, *,
                            batch: int = 8, seq: int = 128,
-                           hbm_gb: float = 0.0) -> None:
-        sj = strategy if isinstance(strategy, str) else strategy.to_json()
+                           hbm_gb: float = 0.0,
+                           mfu: float = 0.0) -> None:
+        # canonical on the wire too, so a service log/db is greppable
+        # under the one vocabulary (autopilot/history.py); malformed
+        # input passes through raw so the SERVICE stays the one place
+        # that rejects it (its Strategy.from_json guard + RpcError)
+        try:
+            sj = canonical_strategy_json(strategy)
+        except (ValueError, TypeError):
+            sj = strategy if isinstance(strategy, str) \
+                else strategy.to_json()
         self._rpc.call(m.StrategyMeasurement(
             model=model, n_devices=n_devices, batch=batch, seq=seq,
             hbm_gb=hbm_gb, strategy_json=sj, step_time_s=step_time_s,
+            mfu=mfu,
         ))
 
     def get_observations(self, model: str, n_devices: int, *,

@@ -291,7 +291,12 @@ def measured_search(
     if engine_client is not None:
         # every finite measurement feeds the service's observation
         # store (the persisted surrogate posterior); the service keeps
-        # the fastest as the measured-history winner
+        # the fastest as the measured-history winner. Client + service
+        # normalize through autopilot/history.py's ONE fingerprint
+        # vocabulary (shape_key + canonical strategy JSON), so the
+        # winner written here is exactly what a later autopilot
+        # planner's history lookup reads back (pinned by
+        # tests/test_autopilot.py).
         name_to_strategy = {s.name: s for s, _, _, _ in seeded}
         try:
             for cand_name, t in measured.items():

@@ -239,6 +239,14 @@ class EfficiencyMonitor:
             return None
         return self._flops / mean / (self.peak_flops * self.num_devices)
 
+    def step_seconds(self) -> float | None:
+        """Rolling-window MEDIAN step cadence — the measured step time
+        the autopilot records into the plan history (robust to the
+        first dispatch's compile spike); None before any step."""
+        if not self._steps:
+            return None
+        return statistics.median(self._steps)
+
     def host_blocked_frac(self) -> float:
         if not self._blocked:
             return 0.0

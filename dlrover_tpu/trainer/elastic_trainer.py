@@ -149,9 +149,30 @@ class ElasticTrainer:
             on_bundle=self._report_profile_bundle,
         )
         self._last_step_end = 0.0
+        # autopilot retune hook (autopilot/apply.py, DESIGN.md §24):
+        # called once per step with (step, state); returning
+        # (new_compiled, new_state) swaps the running program in place
+        # — the no-restart strategy retune path
+        self.retune_hook = None
         logger.info(
             "elastic trainer: dp=%d accum=%d global_batch=%d (fixed)",
             dp, self.accum, global_batch_size,
+        )
+
+    def swap_compiled(self, compiled: "CompiledTrain | Any") -> None:
+        """Install a retuned step program mid-run (same batch geometry
+        — the applier's ``can_apply`` guards that). The next dispatch
+        is treated as a first dispatch so its compile/load cost lands
+        in the recompile cost class, and the MFU gauge re-bases on the
+        new program's FLOPs."""
+        self.compiled = compiled
+        self._first_dispatch = True
+        flops = getattr(compiled, "flops_per_step", 0.0) or 0.0
+        if flops > 0:
+            self.efficiency.set_flops(flops)
+        logger.info(
+            "swapped compiled step program (strategy %s)",
+            getattr(getattr(compiled, "strategy", None), "name", "?"),
         )
 
     def _report_profile_bundle(self, path: str) -> None:
@@ -352,6 +373,11 @@ class ElasticTrainer:
                     self.efficiency.observe_phase(
                         "ckpt", time.monotonic() - t0
                     )
+                if self.retune_hook is not None:
+                    swapped = self.retune_hook(step, state)
+                    if swapped is not None:
+                        new_compiled, state = swapped
+                        self.swap_compiled(new_compiled)
                 if max_steps is not None and step >= max_steps:
                     break
         finally:

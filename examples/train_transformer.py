@@ -41,7 +41,15 @@ def parse_args(argv=None):
                    help="blockwise cross-entropy chunks (0 = model "
                         "default)")
     p.add_argument("--strategy", default="dp",
-                   help="strategy preset name (parallel/strategy.py)")
+                   help="strategy preset name (parallel/strategy.py), "
+                        "or 'auto' for the autopilot planner "
+                        "(autopilot/planner.py: AOT-enumerated "
+                        "strategy x mesh x schedule, cost-model/"
+                        "history ranked, closed-loop retuned)")
+    p.add_argument("--autopilot-history", default="",
+                   help="measured-history sqlite for --strategy auto "
+                        "(empty = <ckpt-dir>/autopilot_history.sqlite, "
+                        "'0' disables history seeding/recording)")
     p.add_argument("--schedule", default="spmd",
                    choices=["spmd", "mpmd", "auto"],
                    help="pipeline runtime: spmd = the single-program "
@@ -155,8 +163,17 @@ def main(argv=None) -> int:
         def loss_for(s, m):
             return tfm.make_loss_fn(cfg, s, m)
 
+    autopilot_plan = None
+    autopilot_ranked = None
+    autopilot_history = None
     if args.strategy == "auto":
-        from dlrover_tpu.parallel.auto import cached_auto_strategy
+        # the autopilot planner (DESIGN.md §24): AOT-enumerate feasible
+        # (strategy x mesh x schedule) points, rank by the cost model
+        # seeded from measured history, and launch the winner as a
+        # typed Plan. Cached next to the checkpoints so an elastic
+        # restart reuses the ranked list instead of burning the
+        # recovery window on N candidate compiles.
+        from dlrover_tpu.autopilot import PlanHistory, load_or_plan
 
         bsz = max(1, args.global_batch)
         if args.objective == "mlm":
@@ -169,18 +186,36 @@ def main(argv=None) -> int:
             example_batch = {
                 "tokens": np.zeros((1, bsz, seq + 1), np.int32)
             }
-        # cached next to the checkpoints: an elastic restart reuses the
-        # tuned pick instead of burning the recovery window on N
-        # candidate compiles (re-searched when the world size changes)
-        strategy, _ = cached_auto_strategy(
-            os.path.join(args.ckpt_dir, "strategy.json"),
+        if args.autopilot_history != "0":
+            autopilot_history = PlanHistory(
+                db_path=args.autopilot_history or os.path.join(
+                    args.ckpt_dir, "autopilot_history.sqlite"
+                )
+            )
+        n_dev = len(jax.devices())
+        ranked = load_or_plan(
+            os.path.join(args.ckpt_dir, "autopilot_plan.json"),
+            model=args.model,
             loss_fn_for=loss_for,
             init_params_fn=lambda rng: tfm.init_params(cfg, rng),
             logical_params=tfm.logical_axes(cfg),
             optimizer=optax.adamw(args.lr),
             example_batch=example_batch,
+            batch=bsz, seq=seq,
+            history=autopilot_history,
+            model_cfg=cfg,
+            # the MPMD schedule axis: only for the clm stage programs
+            # and only when the world splits into whole stages
+            mpmd_stages=(2 if args.objective == "clm"
+                         and n_dev % 2 == 0 and n_dev >= 4 else 0),
         )
-        print(f"[trainer] auto strategy: {strategy.name}", flush=True)
+        autopilot_ranked = ranked
+        autopilot_plan = ranked.winner
+        strategy = autopilot_plan.strategy()
+        print(f"[trainer] autopilot plan: {autopilot_plan.name} "
+              f"(source={autopilot_plan.source}, pred "
+              f"{autopilot_plan.pred_step_s:.4f}s/step, "
+              f"{len(ranked.plans)} feasible)", flush=True)
     else:
         strategy = PRESETS[args.strategy]()
 
@@ -377,6 +412,78 @@ def main(argv=None) -> int:
         micro_batch_size=micro,
         model_name=args.model,
     )
+
+    # ---- autopilot closed loop (DESIGN.md §24): arm the master-side
+    # controller with the launched plan + ranked alternatives (it rides
+    # the trainer's metrics-snapshot pushes), and hot-apply any retune
+    # it sends back through the paral-config channel — the job never
+    # restarts for a strategy change.
+    if autopilot_plan is not None and not mpmd_mode:
+        from dlrover_tpu.common.constants import EnvKey
+
+        if ctx.node_rank == 0 and os.environ.get(EnvKey.MASTER_ADDR):
+            from dlrover_tpu.agent.master_client import MasterClient
+
+            try:
+                MasterClient.singleton().report_autopilot_plan(
+                    autopilot_plan.to_json(),
+                    [p.to_json()
+                     for p in autopilot_ranked.alternatives()],
+                )
+            except (ConnectionError, RuntimeError, OSError) as e:
+                print(f"[trainer] autopilot plan report failed: {e}",
+                      flush=True)
+
+        from dlrover_tpu.autopilot import Plan
+        from dlrover_tpu.autopilot import apply as autopilot_apply
+
+        apply_batch = {
+            k: np.zeros(v.shape, v.dtype) for k, v in batch_abs.items()
+        }
+
+        vetoed: set = set()
+
+        def _retune_hook(step: int, st):
+            nonlocal autopilot_plan
+            pj = paral.get("autopilot_plan", "")
+            if not pj:
+                return None
+            try:
+                target = Plan.from_json(pj)
+            except (ValueError, TypeError, KeyError):
+                return None
+            if target.fingerprint == autopilot_plan.fingerprint \
+                    or target.fingerprint in vetoed:
+                return None
+            if not autopilot_apply.can_apply(
+                    autopilot_plan, target,
+                    step_batch=trainer.step_batch_size):
+                vetoed.add(target.fingerprint)
+                print(f"[trainer] autopilot retune to {target.name} "
+                      "not applicable in-process; ignoring", flush=True)
+                return None
+            applied = autopilot_apply.apply_plan(
+                target,
+                state=st,
+                loss_fn_for=loss_for,
+                init_params_fn=lambda rng: tfm.init_params(cfg, rng),
+                logical_params=tfm.logical_axes(cfg),
+                optimizer=optax.adamw(args.lr),
+                model_cfg=cfg,
+                path="hot" if dict(target.mesh_axes)
+                == dict(autopilot_plan.mesh_axes) else "reshard",
+                cache=cache_client,
+                num_nodes=ctx.num_nodes,
+                example_batch=apply_batch,
+                extra_fingerprint={"lr": args.lr,
+                                   "objective": args.objective},
+            )
+            autopilot_plan = target
+            print(f"[trainer] autopilot retune applied: {target.name} "
+                  f"in {applied.seconds:.2f}s (no restart)", flush=True)
+            return applied.compiled, applied.state
+
+        trainer.retune_hook = _retune_hook
 
     # ---- fallback-topology AOT daemon: pre-compile the N−1/N+1 worlds
     # in the background and publish them to the compile cache, so a
@@ -589,6 +696,24 @@ def main(argv=None) -> int:
     if goodput is not None:
         goodput.done()
         goodput.close()
+    # persist this run's measurement into the autopilot history: the
+    # next job with the same workload fingerprint ranks from evidence
+    # (journaled `autopilot_plan source=history`) instead of the model
+    if autopilot_plan is not None and autopilot_history is not None \
+            and ctx.node_rank == 0:
+        measured = trainer.efficiency.step_seconds()
+        if measured and measured > 0:
+            autopilot_history.record(
+                autopilot_plan.strategy_json, measured,
+                model=args.model, n_devices=len(jax.devices()),
+                batch=max(1, args.global_batch), seq=seq,
+                mfu=trainer.efficiency.mfu(),
+            )
+            print(f"[trainer] autopilot history: recorded "
+                  f"{measured:.4f}s/step for {autopilot_plan.name}",
+                  flush=True)
+    if autopilot_history is not None:
+        autopilot_history.close()
     engine.save_to_storage(final_step, state)
     waited = engine.wait_for_persist(final_step, timeout=120)
     if not waited:

@@ -80,6 +80,10 @@ DOCUMENTED_PREFIXES = (
     # spiking" runbook keys on the decode-stall histogram and the
     # paged-KV park/handoff counters
     "dlrover_tpu_engine_",
+    # strategy autopilot (DESIGN.md §24): the "autopilot picked a bad
+    # plan" runbook keys on the plan/retune counters and the
+    # contradiction gauges
+    "dlrover_tpu_autopilot_",
 )
 
 # label names that are themselves an operator contract (dashboards and
