@@ -2170,8 +2170,12 @@ def bench_autopilot(extra: dict) -> None:
         compiled, state = launch(plan)
         _, walls = run(compiled, state, steps)
         measured = statistics.median(walls[1:])  # drop the compile step
+        # key by the plan's stamped hbm_gb: the re-plan's lookup uses
+        # the same envelope-derived key (nonzero whenever
+        # DLROVER_TPU_DEVICE_HBM_BYTES or a real TPU states a peak)
         hist.record(plan.strategy_json, measured, model="tiny",
                     n_devices=n_dev, batch=bsz, seq=seq,
+                    hbm_gb=plan.hbm_gb,
                     mfu=plan.pred_flops / measured / (peak * n_dev))
 
         # ---- history-seeded re-planning: cached list, measured entry

@@ -305,16 +305,19 @@ class MasterClient:
         tracker.commit()
 
     def report_autopilot_plan(self, plan_json: str,
-                              alternatives_json: list | None = None
-                              ) -> None:
+                              alternatives_json: list | None = None,
+                              step_batch: int = 0) -> None:
         """Arm the master's autopilot controller (DESIGN.md §24) with
         the plan this trainer launched and the planner's ranked
         alternatives — the retune menu a sustained plan-vs-measured
-        contradiction picks from."""
+        contradiction picks from. ``step_batch`` states the running
+        loader's per-step global batch so the controller never arms an
+        alternative the trainer's apply path would veto."""
         self._client.call(
             m.AutopilotPlanReport(
                 node_id=self.node_id, plan_json=plan_json,
                 alternatives_json=list(alternatives_json or []),
+                step_batch=int(step_batch),
             )
         )
 

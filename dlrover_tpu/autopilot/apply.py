@@ -16,12 +16,15 @@ this module morphs the RUNNING trainer onto it in-process:
    buffer-adoption hazard: a host-built tree must never reach a
    deserialized donating executable un-re-staged).
 
-``can_apply`` is the applicability predicate the controller consults:
-this applier morphs SPMD↔SPMD plans whose batch geometry matches the
-running loader (the data pipeline keeps streaming untouched through a
-retune); SPMD↔MPMD rescheduling additionally requires the runtime
-rebuild the example wires (``MpmdTrain`` construction), so it is only
-offered where that path is present.
+``can_apply`` is the trainer-side applicability predicate (it builds
+the real target mesh on this world); ``plan_applicable`` is its
+device-free master-side mirror the controller consults before arming a
+retune. Both encode the same rule: this applier morphs SPMD↔SPMD plans
+whose batch geometry matches the running loader (the data pipeline
+keeps streaming untouched through a retune); SPMD↔MPMD rescheduling
+additionally requires the runtime rebuild the example wires
+(``MpmdTrain`` construction), so it is only offered where that path is
+present.
 """
 
 from __future__ import annotations
@@ -66,6 +69,36 @@ def can_apply(current: Plan, target: Plan,
             if step_batch % data_parallel_size(mesh):
                 return False
         except (ValueError, AssertionError):
+            return False
+    return True
+
+
+def plan_applicable(current: Plan, target: Plan,
+                    step_batch: int | None = None) -> bool:
+    """Device-free mirror of :func:`can_apply` for the MASTER-side
+    controller: same schedule gate and dp-width divisibility, resolved
+    arithmetically from the plan's stamped ``mesh_axes``/``n_devices``
+    instead of building a mesh over the caller's own devices (the
+    master's device set is not the trainer's). Wired as the
+    controller's ``applicable`` predicate so a retune the trainer's
+    apply path would veto is never armed, journaled, or charged
+    against the retune budget."""
+    if current.schedule != "spmd" or target.schedule != "spmd":
+        return False
+    if step_batch:
+        from dlrover_tpu.parallel.mesh import MeshSpec
+
+        n = target.n_devices or current.n_devices
+        if not n:
+            return True  # no stamped world: only the schedule gate
+        try:
+            sizes = MeshSpec(axes=dict(target.mesh_axes)).resolved(n)
+        except (ValueError, TypeError):
+            return False
+        dp_width = 1
+        for axis in ("data", "fsdp"):
+            dp_width *= sizes.get(axis, 1)
+        if step_batch % dp_width:
             return False
     return True
 

@@ -762,3 +762,8 @@ class AutopilotPlanReport:
     plan_json: str = ""            # planner.Plan.to_json of the launch
     # planner.Plan.to_json of each ranked alternative, best first
     alternatives_json: list = dataclasses.field(default_factory=list)
+    # the trainer's per-step global batch dim: the controller's
+    # applicability predicate (autopilot/apply.py plan_applicable)
+    # rejects alternatives whose mesh cannot shard it, BEFORE a retune
+    # is armed/journaled/charged; 0 = unknown (schedule gate only)
+    step_batch: int = 0

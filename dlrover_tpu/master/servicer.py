@@ -103,14 +103,21 @@ class MasterServicer:
         # strategy-autopilot controller (autopilot/controller.py,
         # DESIGN.md §24): armed by AutopilotPlanReport, fed by the same
         # trainer snapshot pushes; its retune decisions go back out
-        # through the paral-config channel (hot-applied, no restart)
+        # through the paral-config channel (hot-applied, no restart).
+        # The applicability predicate mirrors the trainer's can_apply
+        # so a retune the apply path would veto is never armed,
+        # journaled, or charged against the budget — without it the
+        # controller would judge live metrics against a plan that is
+        # not actually running.
+        self._autopilot_step_batch = 0
         if autopilot is None:
             from dlrover_tpu.autopilot.controller import (
                 AutopilotController,
             )
 
             autopilot = AutopilotController(
-                on_retune=self._apply_autopilot_retune
+                on_retune=self._apply_autopilot_retune,
+                applicable=self._autopilot_applicable,
             )
         self._autopilot = autopilot
         # bounded ledger of flight-recorder bundles reported by nodes
@@ -542,8 +549,23 @@ class MasterServicer:
             logger.warning("unparseable autopilot plan report from "
                            "node %d: %s", msg.node_id, e)
             return m.OkResponse(success=False)
+        self._autopilot_step_batch = int(
+            getattr(msg, "step_batch", 0) or 0
+        )
         self._autopilot.arm(plan, alternatives)
         return m.OkResponse()
+
+    def _autopilot_applicable(self, current, target) -> bool:
+        """The controller's applicability predicate: the device-free
+        mirror of the trainer's apply.can_apply — same-schedule SPMD
+        morphs whose mesh can shard the trainer's reported per-step
+        batch (autopilot/apply.py plan_applicable)."""
+        from dlrover_tpu.autopilot.apply import plan_applicable
+
+        return plan_applicable(
+            current, target,
+            step_batch=self._autopilot_step_batch or None,
+        )
 
     def _apply_autopilot_retune(self, decision) -> None:
         """Push a fired retune to trainers through the paral-config

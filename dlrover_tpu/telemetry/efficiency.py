@@ -247,6 +247,14 @@ class EfficiencyMonitor:
             return None
         return statistics.median(self._steps)
 
+    def reset_window(self) -> None:
+        """Drop the rolling step/blocked windows. A retune swaps the
+        running program mid-job: the post-swap median (what the
+        autopilot history records, attributed to the NEW plan) must
+        never span steps executed under the old one."""
+        self._steps.clear()
+        self._blocked.clear()
+
     def host_blocked_frac(self) -> float:
         if not self._blocked:
             return 0.0

@@ -164,12 +164,15 @@ class ElasticTrainer:
         — the applier's ``can_apply`` guards that). The next dispatch
         is treated as a first dispatch so its compile/load cost lands
         in the recompile cost class, and the MFU gauge re-bases on the
-        new program's FLOPs."""
+        new program's FLOPs; the rolling step window resets so the
+        post-retune median (the value the autopilot history records
+        against the new plan) never spans pre-retune steps."""
         self.compiled = compiled
         self._first_dispatch = True
         flops = getattr(compiled, "flops_per_step", 0.0) or 0.0
         if flops > 0:
             self.efficiency.set_flops(flops)
+        self.efficiency.reset_window()
         logger.info(
             "swapped compiled step program (strategy %s)",
             getattr(getattr(compiled, "strategy", None), "name", "?"),

@@ -429,6 +429,7 @@ def main(argv=None) -> int:
                     autopilot_plan.to_json(),
                     [p.to_json()
                      for p in autopilot_ranked.alternatives()],
+                    step_batch=trainer.step_batch_size,
                 )
             except (ConnectionError, RuntimeError, OSError) as e:
                 print(f"[trainer] autopilot plan report failed: {e}",
@@ -703,10 +704,17 @@ def main(argv=None) -> int:
             and ctx.node_rank == 0:
         measured = trainer.efficiency.step_seconds()
         if measured and measured > 0:
+            # key the record by the plan's STAMPED shape fields — the
+            # planner's lookup keys on the same tuple (incl. hbm_gb
+            # from the device envelope), and a mismatched key would
+            # silently never seed a later ranking
             autopilot_history.record(
                 autopilot_plan.strategy_json, measured,
-                model=args.model, n_devices=len(jax.devices()),
-                batch=max(1, args.global_batch), seq=seq,
+                model=autopilot_plan.model or args.model,
+                n_devices=autopilot_plan.n_devices or len(jax.devices()),
+                batch=autopilot_plan.batch or max(1, args.global_batch),
+                seq=autopilot_plan.seq or seq,
+                hbm_gb=autopilot_plan.hbm_gb,
                 mfu=trainer.efficiency.mfu(),
             )
             print(f"[trainer] autopilot history: recorded "

@@ -158,6 +158,39 @@ class TestFingerprintVocabulary:
         assert shape_key("tiny", 8, 8, 16, 0.0) == ("tiny", 8, 8, 16,
                                                     0.0)
 
+    def test_record_key_matches_lookup_under_env_envelope(
+            self, monkeypatch):
+        """The end-of-run record must key on the SAME hbm_gb the
+        planner's lookup derives from the device envelope: with
+        DLROVER_TPU_DEVICE_HBM_BYTES set (or a real TPU peak), a
+        record that omits hbm_gb lands under a different shape key
+        and cross-job seeding silently never happens."""
+        from dlrover_tpu.parallel.engine_service import (
+            StrategyEngineService,
+        )
+
+        monkeypatch.setenv(EnvKey.DEVICE_HBM_BYTES, str(8 << 30))
+        hist = PlanHistory(service=StrategyEngineService())
+        kwargs = _planner_kwargs()
+        ranked = enumerate_plans(points=[(dp(), "spmd")], history=hist,
+                                 **kwargs)
+        plan = ranked.winner
+        assert plan.source == "model"
+        assert plan.hbm_gb == pytest.approx(8.0)
+        # the trainer's end-of-run record: keyed by the plan's STAMPED
+        # shape fields, exactly what examples/train_transformer.py and
+        # bench.py now pass
+        assert hist.record(
+            plan.strategy_json, 0.033, model=plan.model,
+            n_devices=plan.n_devices, batch=plan.batch, seq=plan.seq,
+            hbm_gb=plan.hbm_gb,
+        )
+        ranked2 = enumerate_plans(points=[(dp(), "spmd")],
+                                  history=hist, **kwargs)
+        assert ranked2.winner.source == "history"
+        assert ranked2.winner.pred_step_s == pytest.approx(0.033)
+        hist.close()
+
 
 # ----------------------------------------------------------------- planner
 
@@ -386,13 +419,78 @@ class TestController:
         assert fired[0].to_plan.name == "dp/spmd"
 
 
+# ------------------------------------------ master-side applicability
+
+
+class TestPlanApplicable:
+    """plan_applicable: the device-free mirror of apply.can_apply the
+    servicer wires as the controller's predicate — an alternative the
+    trainer would veto is never armed, journaled, or charged."""
+
+    def test_schedule_gate(self):
+        from dlrover_tpu.autopilot.apply import plan_applicable
+
+        cur = _mk_plan(zero1())
+        assert plan_applicable(cur, _mk_plan(dp()))
+        assert not plan_applicable(
+            cur, _mk_plan(mpmd(pipeline_size=2), schedule="mpmd")
+        )
+
+    def test_batch_divisibility_from_stamped_world(self):
+        """dp width resolves arithmetically from the plan's stamped
+        mesh_axes/n_devices — the master never builds a mesh over its
+        OWN devices (which are not the trainer's)."""
+        from dlrover_tpu.autopilot.apply import plan_applicable
+
+        cur = _mk_plan(zero1())
+        wide = _mk_plan(dp(), mesh_axes={"data": 8})
+        assert plan_applicable(cur, wide, step_batch=8)
+        assert not plan_applicable(cur, wide, step_batch=4)
+        # -1 (fill) axes resolve against the stamped world too
+        fill = _mk_plan(dp())  # mesh_axes={"data": -1}, n_devices=8
+        assert not plan_applicable(cur, fill, step_batch=4)
+
+    def test_unbuildable_mesh_rejected(self):
+        from dlrover_tpu.autopilot.apply import plan_applicable
+
+        cur = _mk_plan(zero1())
+        bad = _mk_plan(dp(), mesh_axes={"data": 3})  # 3 ∤ 8 devices
+        assert not plan_applicable(cur, bad, step_batch=8)
+
+
+def test_swap_compiled_resets_step_window():
+    """A retune's program swap re-bases the rolling step window: the
+    post-swap median (what the autopilot history records, attributed
+    to the NEW plan) must never span pre-retune steps."""
+    import types
+
+    from dlrover_tpu.trainer.elastic_trainer import ElasticTrainer
+
+    mesh = dp().build_mesh()
+    fake = types.SimpleNamespace(mesh=mesh, strategy=None,
+                                 flops_per_step=0.0)
+    trainer = ElasticTrainer(fake, global_batch_size=TINY_BATCH,
+                             micro_batch_size=1, model_name="tiny")
+    trainer.efficiency.end_step(1, 0.04)
+    trainer.efficiency.end_step(2, 0.04)
+    assert trainer.efficiency.step_seconds() == pytest.approx(0.04)
+    trainer.swap_compiled(fake)
+    assert trainer.efficiency.step_seconds() is None
+    trainer.efficiency.end_step(3, 0.01)
+    assert trainer.efficiency.step_seconds() == pytest.approx(0.01)
+
+
 # ---------------------------------------------------- master push wiring
 
 
 def test_master_arms_and_pushes_retune(tmp_path, monkeypatch):
     """AutopilotPlanReport arms the servicer's controller; trainer
     snapshot pushes feed it; a sustained contradiction lands the target
-    plan in ParalConfig (hot channel, no restart_required)."""
+    plan in ParalConfig (hot channel, no restart_required). The
+    servicer's applicability predicate (plan_applicable over the
+    reported step_batch) skips alternatives the trainer's apply path
+    would veto — the pushed plan is always one that actually applies,
+    so the budget/journal/baseline never charge a phantom retune."""
     from dlrover_tpu.agent.master_client import MasterClient
     from dlrover_tpu.master.job_master import JobMaster
 
@@ -402,8 +500,18 @@ def test_master_arms_and_pushes_retune(tmp_path, monkeypatch):
     try:
         c = MasterClient(master.addr, 0)
         plan = _mk_plan(zero1(), pred=0.01, source="history")
+        # two faster-but-inapplicable alternatives ranked ahead of the
+        # one the trainer can actually morph to
+        mp = _mk_plan(mpmd(pipeline_size=2), schedule="mpmd",
+                      pred=0.004, source="history")
+        bad = _mk_plan(dp(grad_compression=True), pred=0.005,
+                       source="history", mesh_axes={"data": 3})
         alt = _mk_plan(dp(), pred=0.012, source="history")
-        c.report_autopilot_plan(plan.to_json(), [alt.to_json()])
+        c.report_autopilot_plan(
+            plan.to_json(),
+            [mp.to_json(), bad.to_json(), alt.to_json()],
+            step_batch=TINY_BATCH,
+        )
         total = 0.0
         count = 0
         for _ in range(8):
