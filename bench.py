@@ -2256,6 +2256,196 @@ def bench_autopilot(extra: dict) -> None:
         hist.close()
 
 
+def _hist_p95_bound(name: str, before: dict | None = None) -> float:
+    """p95 upper-bound bucket of a registry histogram (optionally net of
+    a ``before`` bucket snapshot) — the PR-12 stall-bucket idiom: exact
+    p95s need raw samples, bucket bounds are what the scrape exposes."""
+    from dlrover_tpu.telemetry.metrics import registry
+
+    for fam in registry().snapshot():
+        if fam["name"] != name:
+            continue
+        bounds = list(fam["buckets"]) + [float("inf")]
+        for s in fam["samples"]:
+            per = [float(c) for c in s.get("buckets", ())]
+            if before is not None:
+                prev = before.get(name, [0.0] * len(per))
+                per = [c - p for c, p in zip(per, prev)]
+            total = sum(per)
+            if total <= 0:
+                return 0.0
+            running = 0.0
+            for bound, c in zip(bounds, per):
+                running += c
+                if running >= 0.95 * total:
+                    return bound
+    return 0.0
+
+
+def _hist_buckets(name: str) -> dict:
+    from dlrover_tpu.telemetry.metrics import registry
+
+    for fam in registry().snapshot():
+        if fam["name"] == name:
+            for s in fam["samples"]:
+                return {name: [float(c) for c in s.get("buckets", ())]}
+    return {}
+
+
+def bench_embedding(extra: dict) -> None:
+    """Elastic embedding fabric (DESIGN.md §25), CPU-only in-process:
+    a 3-server hash ring under a seeded recsys-shaped lookup+apply load
+    with async gradient streaming, surviving a seeded churn leg — shard
+    server emb-1 killed mid-run (respawned, ring re-routed, rows
+    restored from the verified checkpoint) and a 3→4 grow mid-run.
+    Reports `lookups_per_s`, `apply_lag_p95`, `staleness_p95`, and
+    `embedding_scale_moved_frac` (the ~1/N migration bound evidence).
+    """
+    import threading
+
+    from dlrover_tpu.common.constants import EnvKey
+    from dlrover_tpu.embedding.fabric import (
+        FabricClient,
+        FabricShardServer,
+        start_local_fabric,
+    )
+
+    dim, fields, batch = 16, 8, 256
+    steps, kill_at, grow_at = 240, 80, 160
+    seed = 4242
+    prev_journal = os.environ.get(EnvKey.JOURNAL_DIR)
+    with tempfile.TemporaryDirectory() as tmp:
+        journal_dir = os.path.join(tmp, "journal")
+        ckpt_dir = os.path.join(tmp, "ckpt")
+        os.environ[EnvKey.JOURNAL_DIR] = journal_dir
+        coord = None
+        servers: list = []
+        client = None
+        churn_err: list = []
+        try:
+            coord, servers = start_local_fabric(
+                3, dim=dim, seed=seed, replicas=2, ckpt_dir=ckpt_dir,
+            )
+            client = FabricClient(
+                coordinator_addr=coord.addr, dim=dim,
+                retry_window_s=60.0,
+            )
+            rng = np.random.default_rng(seed)
+            lag_before = _hist_buckets(
+                "dlrover_tpu_embedding_apply_lag_seconds"
+            )
+
+            def churn_kill():
+                try:
+                    victim = servers[1]
+                    victim.stop()          # rows gone with the process
+                    fresh = FabricShardServer(
+                        dim=dim, num_slots=2, member=victim.member,
+                        seed=seed, host="127.0.0.1",
+                    ).start()
+                    servers[1] = fresh
+                    # same ring, new addr: the route bump re-dials every
+                    # client; only the dead shard's rows refill from the
+                    # newest verified checkpoint
+                    coord.repair(victim.member, fresh.addr)
+                except Exception as e:  # noqa: BLE001 - surfaced below
+                    churn_err.append(f"kill leg: {e}")
+
+            def churn_grow():
+                try:
+                    grown = FabricShardServer(
+                        dim=dim, num_slots=2, member="emb-3",
+                        seed=seed, host="127.0.0.1",
+                    ).start()
+                    servers.append(grown)
+                    coord.scale({s.member: s.addr for s in servers})
+                except Exception as e:  # noqa: BLE001 - surfaced below
+                    churn_err.append(f"grow leg: {e}")
+
+            lookup_s: list[float] = []
+            staleness: list[int] = []
+            threads: list[threading.Thread] = []
+            total_ids = 0
+            t_run = time.monotonic()
+            for step in range(1, steps + 1):
+                ids = (rng.zipf(1.3, size=(batch, fields)).astype(
+                    np.int64) % 1_000_000)
+                t0 = time.monotonic()
+                emb = client.lookup(ids)
+                lookup_s.append(time.monotonic() - t0)
+                total_ids += ids.size
+                grads = (emb * 1e-3).reshape(-1, dim)
+                client.apply("adam", ids, grads, lr=1e-2)
+                staleness.append(client.staleness())
+                if step == kill_at // 2:
+                    client.persist(step)   # the churn leg's restore point
+                if step in (kill_at, grow_at):
+                    th = threading.Thread(
+                        target=churn_kill if step == kill_at
+                        else churn_grow, daemon=True,
+                    )
+                    th.start()
+                    threads.append(th)
+            for th in threads:
+                th.join(timeout=60.0)
+            client.drain(timeout=60.0)
+            run_wall = time.monotonic() - t_run
+            if churn_err:
+                raise RuntimeError("; ".join(churn_err))
+
+            extra["embedding_lookups_per_s"] = round(
+                total_ids / sum(lookup_s)
+            )
+            extra["embedding_steps_per_s"] = round(steps / run_wall, 1)
+            extra["embedding_apply_lag_p95_s"] = _hist_p95_bound(
+                "dlrover_tpu_embedding_apply_lag_seconds", lag_before
+            )
+            extra["embedding_staleness_p95"] = float(
+                np.percentile(staleness, 95)
+            )
+            # the grow's journaled evidence: moved rows / ring rows
+            moved_frac = None
+            for e in _bench_read_journal(journal_dir):
+                if (e.get("name") == "embedding_scale" and e.get("ok")
+                        and e.get("to_n") == 4):
+                    moved_frac = (e["moved"]
+                                  / max(1, e.get("total_rows", 0)))
+            if moved_frac is None:
+                raise RuntimeError("no journaled 3->4 embedding_scale")
+            extra["embedding_scale_moved_frac"] = round(moved_frac, 4)
+            if not moved_frac or moved_frac > 1.6 / 4:
+                raise RuntimeError(
+                    f"3->4 moved {moved_frac:.2f} of rows; ring bound "
+                    "is ~1/N"
+                )
+        finally:
+            if client is not None:
+                client.close()
+            if coord is not None:
+                coord.stop()
+            for s in servers:
+                s.stop()
+            if prev_journal is None:
+                os.environ.pop(EnvKey.JOURNAL_DIR, None)
+            else:
+                os.environ[EnvKey.JOURNAL_DIR] = prev_journal
+
+
+def _bench_read_journal(journal_dir: str) -> list[dict]:
+    events = []
+    try:
+        with open(os.path.join(journal_dir, "events.jsonl"),
+                  encoding="utf-8") as f:
+            for line in f:
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    except OSError:
+        pass
+    return events
+
+
 # ---------------------------------------------------------------------------
 # Stage harness
 # ---------------------------------------------------------------------------
@@ -2303,6 +2493,9 @@ STAGES = [
     # strategy autopilot (CPU-runnable): plan-vs-measured agreement,
     # history-seeded re-planning, seeded forced-contradiction retune
     Stage("autopilot", bench_autopilot, est_s=60, deadline_s=200),
+    # elastic embedding fabric (CPU-only, in-process): seeded churn —
+    # shard-server kill+repair and a 3→4 ring grow mid-run
+    Stage("embedding", bench_embedding, est_s=60, deadline_s=200),
     Stage("aot7b", bench_7b_aot, est_s=15, deadline_s=120,
           pass_budget=True),
     Stage("long_context", bench_long_context, est_s=80, deadline_s=300),
@@ -2334,6 +2527,8 @@ HEADLINE_KEYS = [
     "gateway_disagg_ttft_speedup", "gateway_stall_p99_bound_chunks",
     "int8_ffn_speedup", "autopilot_agreement", "autopilot_pred_step_s",
     "autopilot_retune_seconds", "autopilot_retune_mfu_delta",
+    "embedding_lookups_per_s", "embedding_apply_lag_p95_s",
+    "embedding_staleness_p95", "embedding_scale_moved_frac",
     "soak_completed", "soak_kills",
     "chaos_completed", "chaos_recovery_seconds", "chaos_goodput",
     "cp_master_rpc_p99_ms_n1000", "cp_master_rpc_p99_ms_n5000",

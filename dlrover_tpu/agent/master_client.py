@@ -187,22 +187,30 @@ class MasterClient:
     # ------------------------------------------------------- persist acks
 
     def report_persist_ack(self, step: int, num_shards: int,
-                           shard: dict) -> None:
+                           shard: dict, *, writer_id: int | str | None = None,
+                           group: str = "") -> None:
         """Ack this host's durable checkpoint shard to the master's
         ledger; the rank-0 committer assembles the global manifest from
-        these instead of polling storage (DESIGN.md §20)."""
+        these instead of polling storage (DESIGN.md §20). ``writer_id``
+        overrides the manifest key for non-host writers (the embedding
+        fabric acks ``emb-<i>`` shard servers under ``group=
+        "embedding"`` so its ledger entries can never complete a dense
+        commit of the same step/world, §25)."""
         self._client.call(
             m.PersistAckReport(
-                node_id=self.node_id, step=step,
-                num_shards=num_shards, shard=shard,
+                node_id=(self.node_id if writer_id is None
+                         else writer_id),
+                step=step, num_shards=num_shards, shard=shard,
+                group=group,
             )
         )
 
-    def persist_status(self, step: int, num_shards: int
-                       ) -> m.PersistStatusResponse:
+    def persist_status(self, step: int, num_shards: int, *,
+                       group: str = "") -> m.PersistStatusResponse:
         return self._client.call(
             m.PersistStatusRequest(
                 node_id=self.node_id, step=step, num_shards=num_shards,
+                group=group,
             )
         )
 

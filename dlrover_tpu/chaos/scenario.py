@@ -54,6 +54,7 @@ DEFAULT_EXAMPLE = os.path.join(REPO, "examples", "train_transformer.py")
 RECOVERY_EVENTS = (
     "node_restart", "ckpt_verify_failed", "ckpt_rollback",
     "ckpt_shard_rollback", "state_rollback", "degraded_mode", "reshard",
+    "embedding_scale", "embedding_restore",
 )
 
 
@@ -206,6 +207,16 @@ def fault_trail(journal_dir: str) -> dict:
             # (mesh) share the name; keep only the deterministic fields
             recovery.append(["reshard", e.get("nodes", 0),
                              bool(e.get("shrink", False))])
+        elif name == "embedding_scale":
+            # ring scale events are deterministic given stable member
+            # ids + seeded rows: moved counts replay exactly (§25)
+            recovery.append(["embedding_scale", e.get("from_n", 0),
+                             e.get("to_n", 0), e.get("moved", -1),
+                             bool(e.get("ok", False))])
+        elif name == "embedding_restore":
+            recovery.append(["embedding_restore", e.get("step", -1),
+                             e.get("rows", -1), e.get("from_w", 0),
+                             e.get("to_w", 0)])
     return {"faults": sorted(faults), "recovery": sorted(recovery)}
 
 
@@ -557,6 +568,204 @@ def run_sharded_scenario(work_dir: str, *, seed: int = 4242,
         expected_crc=zlib.crc32(expected.tobytes()) & 0xFFFFFFFF,
         trail=fault_trail(journal_dir),
     )
+
+
+def canned_embedding_scenario(seed: int = 4242) -> dict:
+    """The embedding-fabric acceptance schedule (DESIGN.md §25): a
+    3-server ring persists step 4 (verified, replicas=2), then a scale
+    3→4 loses the new shard server mid-migration — the first
+    ``import_rows`` push lands, every later one hits a dead connection
+    (``embedding_msg`` reset, enough firings to exhaust the migrate
+    retries) — so the coordinator must roll the scale back zero-loss;
+    a respawned destination re-runs the scale to completion. Step 8's
+    save then bit-flips shard server emb-0's file on its way to disk
+    (``storage_write``), and the restore must land on step 8 anyway via
+    the per-shard twin rollback (emb-0's block verifies in its ring
+    successor's file). ``run_embedding_scenario`` replays it.
+    """
+    return {
+        "seed": seed,
+        "faults": [
+            # the new shard server dies mid-migration: the first row
+            # push lands, then the wire goes dead — 3 firings cover
+            # every migrate retry so phase 1 provably fails
+            {"point": "embedding_msg", "action": "reset",
+             "match": {"op": "import_rows"},
+             "after": 1, "times": 3},
+            # the newest step's primary shard rots on its way to disk
+            {"point": "storage_write", "action": "bit_flip",
+             "match": {"path_contains": "step-8/",
+                       "path_suffix": "node_emb-0.bin"},
+             "times": 1},
+        ],
+    }
+
+
+@dataclasses.dataclass
+class EmbeddingScenarioResult:
+    moved: int                  # rows moved by the successful re-scale
+    total_rows: int             # ring row count at the scale event
+    restored_step: int | None
+    restored_crc: int           # crc32 over the reassembled restored rows
+    expected_crc: int           # crc32 over the pre-persist source rows
+    rows_after_rollback: int    # ring rows right after the failed scale
+    trail: dict
+
+    @property
+    def bit_exact(self) -> bool:
+        return self.restored_crc == self.expected_crc
+
+    @property
+    def moved_frac(self) -> float:
+        return self.moved / max(1, self.total_rows)
+
+    def assert_invariants(self) -> None:
+        assert self.rows_after_rollback == self.total_rows, (
+            "the failed scale lost rows: "
+            f"{self.rows_after_rollback} != {self.total_rows}"
+        )
+        assert 0 < self.moved_frac <= 1.6 / 4, (
+            f"3→4 scale moved {self.moved_frac:.2f} of rows; the ring "
+            "bound is ~1/N"
+        )
+        assert self.restored_step == 8, (
+            f"restore landed on {self.restored_step}, not the newest "
+            "verified step 8 (twin rollback should cover the bit flip)"
+        )
+        assert self.bit_exact, "restored rows are not row-exact"
+
+
+def run_embedding_scenario(work_dir: str, *, seed: int = 4242,
+                           dim: int = 8, rows: int = 96
+                           ) -> EmbeddingScenarioResult:
+    """Drive the canned embedding schedule IN PROCESS (CPU-only).
+
+    A real multi-host fabric runs the same ``FabricShardServer``
+    processes over TCP; in-process servers exercise the identical wire
+    protocol (every call crosses a real socket), so the
+    migration-rollback and twin-restore paths under test are
+    deployment-agnostic.
+    """
+    import zlib
+
+    import numpy as np
+
+    from dlrover_tpu import chaos
+    from dlrover_tpu.embedding.fabric import (
+        FabricClient,
+        FabricShardServer,
+        start_local_fabric,
+    )
+
+    os.makedirs(work_dir, exist_ok=True)
+    ckpt_dir = os.path.join(work_dir, "ckpt")
+    journal_dir = os.path.join(work_dir, "journal")
+    spec = canned_embedding_scenario(seed)
+
+    prev_journal = os.environ.get(EnvKey.JOURNAL_DIR)
+    os.environ[EnvKey.JOURNAL_DIR] = journal_dir
+    coord = None
+    servers: list = []
+    client = None
+    try:
+        coord, servers = start_local_fabric(
+            3, dim=dim, num_slots=2, seed=seed, replicas=2,
+            ckpt_dir=ckpt_dir,
+        )
+        client = FabricClient(coordinator_addr=coord.addr, dim=dim,
+                              async_apply=False, retry_window_s=20.0)
+        rng = np.random.default_rng(seed)
+        ids = rng.choice(1 << 20, size=rows, replace=False).astype(
+            np.int64
+        )
+        client.lookup(ids)
+        for _ in range(4):
+            client.apply("adam", ids,
+                         rng.standard_normal((rows, dim)).astype(
+                             np.float32), lr=1e-2)
+        client.persist(4)
+
+        chaos.install({"seed": spec["seed"], "faults": spec["faults"]})
+        # the destination that will die mid-migration
+        doomed = FabricShardServer(dim=dim, num_slots=2,
+                                   member="emb-3", seed=seed,
+                                   host="127.0.0.1").start()
+        members4 = {s.member: s.addr for s in servers}
+        members4["emb-3"] = doomed.addr
+        total = coord.total_rows()
+        try:
+            coord.scale(members4, migrate_retries=3)
+            raise AssertionError(
+                "scale survived the mid-migration kill"
+            )
+        except Exception:  # noqa: BLE001 - the injected failure
+            pass
+        # rollback left the OLD ring serving every row
+        rows_after_rollback = coord.total_rows()
+        # the "killed" server really dies; a respawn takes its place
+        doomed.stop()
+        respawn = FabricShardServer(dim=dim, num_slots=2,
+                                    member="emb-3", seed=seed,
+                                    host="127.0.0.1").start()
+        servers.append(respawn)
+        members4["emb-3"] = respawn.addr
+        route = coord.scale(members4, migrate_retries=3)
+        moved = int(_read_moved(journal_dir, version=route.version))
+        client.refresh_route()
+        for _ in range(4):
+            client.apply("adam", ids,
+                         rng.standard_normal((rows, dim)).astype(
+                             np.float32), lr=1e-2)
+        expected = client.export(with_slots=True)
+        order = np.argsort(expected["keys"], kind="stable")
+        expected_crc = zlib.crc32(
+            expected["values"][order].tobytes()
+        ) & 0xFFFFFFFF
+        client.persist(8)    # emb-0's file bit-flips on the way down
+
+        # sabotage the live tables so only a real restore can match
+        for s in servers:
+            if s.table is not None and len(s.table):
+                snap = s.table.export(with_slots=False)
+                s.table.remove(snap["keys"])
+        restored = coord.restore()
+        restored_step = restored["step"] if restored else None
+        got = client.export(with_slots=True)
+        order = np.argsort(got["keys"], kind="stable")
+        restored_crc = zlib.crc32(
+            got["values"][order].tobytes()
+        ) & 0xFFFFFFFF
+    finally:
+        chaos.uninstall()
+        if client is not None:
+            client.close()
+        if coord is not None:
+            coord.stop()
+        for s in servers:
+            s.stop()
+        if prev_journal is None:
+            os.environ.pop(EnvKey.JOURNAL_DIR, None)
+        else:
+            os.environ[EnvKey.JOURNAL_DIR] = prev_journal
+    return EmbeddingScenarioResult(
+        moved=moved,
+        total_rows=total,
+        restored_step=restored_step,
+        restored_crc=restored_crc,
+        expected_crc=expected_crc,
+        rows_after_rollback=rows_after_rollback,
+        trail=fault_trail(journal_dir),
+    )
+
+
+def _read_moved(journal_dir: str, version: int) -> int:
+    """Moved-row count of the ``embedding_scale`` event that committed
+    ``version`` (the journal is the scale's evidence of record)."""
+    for e in _read_journal(journal_dir):
+        if e.get("name") == "embedding_scale" and e.get("ok") \
+                and int(e.get("version", -1)) == version:
+            return int(e.get("moved", -1))
+    return -1
 
 
 def canned_scenario(seed: int = 1234, *, kill_step: int = 7,

@@ -76,16 +76,24 @@ def commit_marker(num_shards: int) -> str:
 
 
 def write_commit(storage, sdir: str, step: int, num_shards: int,
-                 shards: dict) -> None:
+                 shards: dict, extra: dict | None = None) -> None:
     """Terminal COMMIT: ``shards`` maps node id (str) -> {"crc32",
     "bytes", "pieces": {key: {"crc32", "path", "index", "replica"}}}
     as collected from the persist acks (or done markers). The piece
     map is what quorum verification + per-shard rollback reason over;
-    legacy entries without it degrade to whole-file semantics. Atomic
-    via the storage's tmp+fsync+rename write."""
+    legacy entries without it degrade to whole-file semantics.
+    ``extra`` merges additional top-level manifest fields (the
+    embedding fabric records its hash-shard identity there — ring
+    members, table geometry, applied version — so ``import_`` can
+    reassemble any saved ring size onto the current one; verification
+    ignores unknown fields). Atomic via the storage's tmp+fsync+rename
+    write."""
+    manifest = {"step": step, "num_shards": num_shards,
+                "shards": shards}
+    for key, value in (extra or {}).items():
+        manifest.setdefault(key, value)
     storage.write(
-        json.dumps({"step": step, "num_shards": num_shards,
-                    "shards": shards}),
+        json.dumps(manifest),
         os.path.join(sdir, commit_marker(num_shards)),
     )
 

@@ -206,6 +206,16 @@ class EnvKey:
     # closed-loop retunes the master-side controller may apply
     DEVICE_HBM_BYTES = "DLROVER_TPU_DEVICE_HBM_BYTES"
     AUTOPILOT_MAX_RETUNES = "DLROVER_TPU_AUTOPILOT_MAX_RETUNES"
+    # elastic embedding fabric (DESIGN.md §25): the async-apply
+    # staleness bound (steps of un-flushed gradient the trainer may run
+    # ahead; back-pressures the step past it), the checkpoint replica
+    # count (2 writes each shard block to its ring successor too,
+    # enabling per-shard twin rollback at restore), the background
+    # flusher's idle poll interval, and the bounded send-queue depth
+    EMBEDDING_MAX_STALENESS = "DLROVER_TPU_EMBEDDING_MAX_STALENESS"
+    EMBEDDING_REPLICAS = "DLROVER_TPU_EMBEDDING_REPLICAS"
+    EMBEDDING_FLUSH_MS = "DLROVER_TPU_EMBEDDING_FLUSH_MS"
+    EMBEDDING_QUEUE = "DLROVER_TPU_EMBEDDING_QUEUE"
 
 
 class Defaults:

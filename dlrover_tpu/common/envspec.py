@@ -202,6 +202,20 @@ SPECS: tuple[EnvVar, ...] = (
     EnvVar("DLROVER_TPU_AUTOPILOT_MAX_RETUNES", "2",
            "per-job bound on closed-loop autopilot retunes; 0 keeps "
            "the controller observe-only", "§24"),
+    # ----------------------------------------------------- embedding fabric
+    EnvVar("DLROVER_TPU_EMBEDDING_MAX_STALENESS", "8",
+           "async-apply staleness bound in steps (lookup version minus "
+           "applied version); the training step back-pressures past it",
+           "§25"),
+    EnvVar("DLROVER_TPU_EMBEDDING_REPLICAS", "1",
+           "copies of each embedding shard block persisted per "
+           "checkpoint; 2 adds the ring-successor twin that per-shard "
+           "rollback restores from", "§25"),
+    EnvVar("DLROVER_TPU_EMBEDDING_FLUSH_MS", "5",
+           "embedding gradient flusher idle poll interval (ms)", "§25"),
+    EnvVar("DLROVER_TPU_EMBEDDING_QUEUE", "64",
+           "bounded embedding send-queue depth in apply batches; a "
+           "full queue blocks apply() like the staleness bound", "§25"),
 )
 
 SPEC_BY_NAME: dict[str, EnvVar] = {spec.name: spec for spec in SPECS}

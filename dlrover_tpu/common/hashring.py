@@ -1,0 +1,131 @@
+"""Shared consistent-hash ring (blake2s points, virtual nodes).
+
+One construction, two consumers with very different key shapes:
+
+- ``gateway/router.ShardRing`` routes *prompts* (a prefix-family key is
+  a short token tuple) to gateway shards — one ``owner_of`` call per
+  request, hashed with blake2s like the ring points themselves.
+- ``embedding/fabric.OwnerRing`` routes *feature ids* (int64 arrays,
+  millions per second) to embedding shard servers — per-id blake2s in
+  Python would dominate the lookup path, so id positions come from the
+  vectorized splitmix64 finalizer (the same avalanche-quality mixer
+  ``embedding/service.shard_owner`` already used) and land on the ring
+  via one ``np.searchsorted``.
+
+Both agree on the ring itself: ``vnodes`` points per member at
+``blake2s("{member}#{v}")`` over a 64-bit keyspace, ownership =
+clockwise successor (``bisect_right`` with wraparound), first owner
+keeps a collided point. That is byte-for-byte the PR-12 ``ShardRing``
+construction, factored here so a membership change moves ~1/N of the
+keyspace for every consumer — the property the embedding fabric's
+bounded-migration scale events (DESIGN.md §25) and the gateway's
+cache-locality-preserving scale-outs (§23) both rest on.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import threading
+from typing import Sequence
+
+import numpy as np
+
+
+def hash_point(data: bytes) -> int:
+    """64-bit ring position of an arbitrary byte key."""
+    return int.from_bytes(
+        hashlib.blake2s(data, digest_size=8).digest(), "big"
+    )
+
+
+def id_points(ids: np.ndarray) -> np.ndarray:
+    """Vectorized 64-bit ring positions for int64 feature ids
+    (splitmix64 finalizer — raw ids would put every hot contiguous id
+    range on one arc)."""
+    x = np.asarray(ids, dtype=np.uint64)
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
+
+
+class HashRing:
+    """Consistent hashing over opaque member ids. Thread-safe; the
+    vectorized path works on an immutable snapshot so the hot lookup
+    loop never takes the membership lock."""
+
+    def __init__(self, members: Sequence[str] = (), *,
+                 vnodes: int = 64):
+        if vnodes < 1:
+            raise ValueError("vnodes must be >= 1")
+        self._vnodes = vnodes
+        self._lock = threading.Lock()
+        self._points: list[int] = []          # sorted ring positions
+        self._owner: dict[int, str] = {}      # point -> member id
+        for member in members:
+            self.add(member)
+
+    # ---------------------------------------------------------- membership
+
+    def add(self, member: str) -> None:
+        with self._lock:
+            for v in range(self._vnodes):
+                point = hash_point(f"{member}#{v}".encode())
+                if point in self._owner:        # vanishing collision:
+                    continue                    # first owner keeps it
+                self._owner[point] = member
+                bisect.insort(self._points, point)
+
+    def remove(self, member: str) -> None:
+        with self._lock:
+            dead = [p for p, m in self._owner.items() if m == member]
+            for point in dead:
+                del self._owner[point]
+                idx = bisect.bisect_left(self._points, point)
+                del self._points[idx]
+
+    def members(self) -> list[str]:
+        with self._lock:
+            return sorted(set(self._owner.values()))
+
+    # ------------------------------------------------------------- routing
+
+    def owner_of_point(self, point: int) -> str | None:
+        """Member owning one ring position; None on an empty ring."""
+        with self._lock:
+            if not self._points:
+                return None
+            idx = bisect.bisect_right(self._points, point)
+            if idx == len(self._points):
+                idx = 0                          # wrap around the ring
+            return self._owner[self._points[idx]]
+
+    def owner_of(self, key: bytes) -> str | None:
+        return self.owner_of_point(hash_point(key))
+
+    def snapshot(self, members: Sequence[str]
+                 ) -> tuple[np.ndarray, np.ndarray]:
+        """(sorted ring points, owner index into ``members`` per point)
+        — the immutable arrays ``owner_indices`` resolves against, taken
+        once per route version rather than per batch."""
+        order = {m: i for i, m in enumerate(members)}
+        with self._lock:
+            points = np.asarray(self._points, dtype=np.uint64)
+            owners = np.asarray(
+                [order[self._owner[int(p)]] for p in self._points],
+                dtype=np.int64,
+            ) if len(self._points) else np.zeros(0, np.int64)
+        return points, owners
+
+    @staticmethod
+    def owner_indices(points: np.ndarray, owners: np.ndarray,
+                      positions: np.ndarray) -> np.ndarray:
+        """Vectorized clockwise-successor lookup: for each 64-bit
+        ``positions`` entry, the owning member's index per a
+        ``snapshot``. ``searchsorted(side='right')`` + wraparound is
+        exactly the scalar ``owner_of_point`` bisect."""
+        if points.size == 0:
+            raise ValueError("empty ring")
+        idx = np.searchsorted(points, positions, side="right")
+        idx[idx == points.size] = 0
+        return owners[idx]

@@ -593,12 +593,18 @@ class PersistAckReport:
     ``shard`` is the manifest entry for this writer — whole-file crc32
     + bytes + the per-piece (index, crc, replica) map — so the rank-0
     committer can assemble the GLOBAL manifest from acks alone, without
-    listing or re-reading storage (DESIGN.md §20)."""
+    listing or re-reading storage (DESIGN.md §20). ``group`` namespaces
+    the ledger: the embedding fabric acks its hash-shard writers under
+    ``"embedding"`` so a same-step, same-world dense save can never be
+    committed against embedding acks (or vice versa); dense writers use
+    the default ``""``. ``node_id`` tolerates string writer ids for the
+    same reason (fabric writers are ``emb-<i>``, not host ranks)."""
 
-    node_id: int = 0
+    node_id: int | str = 0
     step: int = 0
     num_shards: int = 1
     shard: dict = dataclasses.field(default_factory=dict)
+    group: str = ""
 
 
 @register_message
@@ -607,6 +613,7 @@ class PersistStatusRequest:
     node_id: int = 0
     step: int = 0
     num_shards: int = 1
+    group: str = ""
 
 
 @register_message

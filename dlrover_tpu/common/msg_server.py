@@ -56,6 +56,13 @@ class ArrayMsgServer:
         self._stop = threading.Event()
         self._sock = socket.create_server((host, port))
         self._sock.settimeout(0.5)
+        # live accepted connections, closed on stop(): without this a
+        # stopped server still answers one in-flight request per open
+        # socket (the per-conn loop re-checks the stop event only after
+        # a full serve iteration), so kill-based tests and drains would
+        # see a half-dead server instead of a dead one
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name=name,
         )
@@ -74,6 +81,14 @@ class ArrayMsgServer:
             self._sock.close()
         except OSError:
             pass
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -83,12 +98,24 @@ class ArrayMsgServer:
                 continue
             except OSError:
                 return
+            with self._conns_lock:
+                self._conns.add(conn)
             threading.Thread(
                 target=self._serve_conn, args=(conn,), daemon=True
             ).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
-        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            self._serve_conn_inner(conn)
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+
+    def _serve_conn_inner(self, conn: socket.socket) -> None:
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass  # already closed by a racing stop()
         with conn:
             while not self._stop.is_set():
                 try:

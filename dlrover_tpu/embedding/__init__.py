@@ -1,1 +1,6 @@
 from dlrover_tpu.embedding.kv_table import KvEmbeddingTable  # noqa: F401
+
+# the elastic embedding fabric (DESIGN.md §25) is imported lazily by
+# its users (examples, gateway, bench) — importing it here would drag
+# checkpoint/telemetry into every `from dlrover_tpu.embedding import
+# KvEmbeddingTable`

@@ -1,0 +1,1486 @@
+"""Elastic KV embedding fabric: one hash table, trained and served.
+
+The promotion of ``embedding/service.py``'s PS-style sharded table into
+the ROADMAP-3 subsystem (DESIGN.md §25). Four changes over the PS tier:
+
+1. **Consistent-hash ownership.** Row ownership is a vnode hash ring
+   (``common/hashring`` — the same blake2s/64-vnode construction as the
+   gateway's ``ShardRing``) over stable member ids, not
+   ``splitmix64(id) % N``: a scale event N→N±1 migrates ~1/N of the
+   rows instead of reshuffling nearly everything. Every scale journals
+   ``embedding_scale`` with moved-row counts.
+2. **Async gradient streaming.** ``FabricClient.apply`` enqueues the
+   sparse update into a bounded send queue and returns; a background
+   flusher streams batches to the shard servers. Staleness — the
+   newest enqueued apply version minus the newest flushed one, in
+   steps — is a live gauge (``dlrover_tpu_embedding_staleness_steps``)
+   with a hard bound (``DLROVER_TPU_EMBEDDING_MAX_STALENESS``) that
+   back-pressures the training step, and ``drain()`` is the barrier
+   every checkpoint snapshot takes so saved state is update-complete.
+3. **Verified shard checkpoints.** Shard exports are deterministic
+   packed blocks (rows sorted by key, optimizer slots + frequency
+   included) written through ``CheckpointStorage.write_parallel`` with
+   per-piece CRC32s; with ``DLROVER_TPU_EMBEDDING_REPLICAS=2`` each
+   block also lands in its ring successor's file, so restore runs the
+   §20 ``resolve_restore_plan`` quorum semantics and rolls a corrupt
+   shard back to its replica twin instead of losing the step. The
+   ``commit_w<W>`` manifest carries hash-shard identity (members,
+   table geometry, applied version), and restore reassembles any saved
+   ring size onto the current one (N→M→N row-exact).
+4. **Train + serve from one table.** A ``mode="serve"`` client is
+   read-only (lookups never materialize rows), version-pinned (every
+   request carries the routing version; a scale event answers with a
+   structured error and the client re-routes), and stamps the applied
+   training version on each response — the gateway's embedding lookup
+   route (``gateway/server.py``) serves the *live* training ring.
+
+Wire framing, chunked row pushes and the two-phase scale protocol are
+the hardened r04/r05 designs from ``embedding/service.py``; the fabric
+reuses its ``_call`` (which is also the ``embedding_msg`` chaos
+injection point) and error type so transport fixes land once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import socket
+import threading
+import time
+import zlib
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable
+
+import numpy as np
+
+from dlrover_tpu.checkpoint import integrity
+from dlrover_tpu.common import envspec
+from dlrover_tpu.common.array_wire import decode_msg, encode_msg
+from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.common.hashring import HashRing, id_points
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.msg_server import ArrayMsgServer
+from dlrover_tpu.common.storage import PosixDiskStorage
+from dlrover_tpu.embedding.kv_table import KvEmbeddingTable
+from dlrover_tpu.embedding.service import ShardError, _call
+from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.metrics import registry
+
+logger = get_logger(__name__)
+
+_staleness_steps = registry().gauge(
+    "dlrover_tpu_embedding_staleness_steps",
+    "async-apply staleness: newest enqueued apply version minus newest "
+    "flushed one, in training steps",
+)
+_apply_lag_seconds = registry().histogram(
+    "dlrover_tpu_embedding_apply_lag_seconds",
+    "enqueue -> flushed-to-shards latency of one async apply batch",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0),
+)
+_flush_queue_depth = registry().gauge(
+    "dlrover_tpu_embedding_flush_queue_depth",
+    "apply batches enqueued and not yet flushed to the shard servers",
+)
+_backpressure_total = registry().counter(
+    "dlrover_tpu_embedding_backpressure_total",
+    "apply() calls that blocked on the staleness bound or a full queue",
+)
+_scale_moved_rows_total = registry().counter(
+    "dlrover_tpu_embedding_scale_moved_rows_total",
+    "rows migrated between shard servers by fabric ring scale events",
+)
+_lookups_total = registry().counter(
+    "dlrover_tpu_embedding_lookups_total",
+    "fabric lookup batches by client mode",
+    label_names=("mode",),
+)
+
+# rows per migration/import push: bounded so one frame stays well under
+# rpc.MAX_FRAME even for wide tables with optimizer slots
+_PUSH_CHUNK_BYTES = 8 << 20
+
+
+# --------------------------------------------------------------- ring route
+
+
+@dataclasses.dataclass
+class RingRoute:
+    """One immutable routing epoch: (version, ring members, addresses).
+
+    Members are STABLE ids (``emb-0`` …), decoupled from addresses: the
+    ring hashes member ids, so row placement — and therefore the moved
+    fraction of a scale event — is deterministic across runs even
+    though listen ports are ephemeral."""
+
+    version: int
+    members: list[str]
+    addrs: dict[str, str]
+    replicas: int = 1
+    vnodes: int = 64
+
+    def __post_init__(self):
+        self.members = list(self.members)
+        self.addrs = dict(self.addrs)
+        ring = HashRing(self.members, vnodes=self.vnodes)
+        self._points, self._owners = ring.snapshot(self.members)
+
+    def owner_indices(self, ids: np.ndarray) -> np.ndarray:
+        """Index into ``members`` of each id's owning shard server."""
+        return HashRing.owner_indices(
+            self._points, self._owners, id_points(ids)
+        )
+
+    def twin(self, member: str) -> str:
+        """The ring-successor replica twin that also persists
+        ``member``'s block when ``replicas >= 2``."""
+        i = self.members.index(member)
+        return self.members[(i + 1) % len(self.members)]
+
+    def to_meta(self) -> dict:
+        return {"version": self.version, "members": self.members,
+                "addrs": self.addrs, "replicas": self.replicas,
+                "vnodes": self.vnodes}
+
+    @classmethod
+    def from_meta(cls, meta: dict) -> "RingRoute":
+        return cls(version=int(meta["version"]),
+                   members=list(meta["members"]),
+                   addrs=dict(meta["addrs"]),
+                   replicas=int(meta.get("replicas", 1)),
+                   vnodes=int(meta.get("vnodes", 64)))
+
+
+# ------------------------------------------------------------ block packing
+
+
+def pack_block(member: str, snap: dict, applied_version: int) -> bytes:
+    """Deterministically serialize one shard's row set: rows sorted by
+    key, values + optimizer slots + frequency all included, framed with
+    ``array_wire``. Determinism is what makes the replica twin's copy
+    byte-identical to the owner's — the quorum restore's coverage
+    algebra (§20) matches pieces by content CRC."""
+    keys = np.asarray(snap["keys"], np.int64)
+    order = np.argsort(keys, kind="stable")
+    arrays = {"keys": keys[order]}
+    for name in ("values", "slots", "freq"):
+        if name in snap:
+            arrays[name] = np.ascontiguousarray(
+                np.asarray(snap[name])[order]
+            )
+    return encode_msg("emb_block", {
+        "member": member, "rows": int(keys.size),
+        "applied_version": int(applied_version),
+        "step": int(snap.get("step", 0)),
+    }, arrays)
+
+
+def unpack_block(blob: bytes) -> tuple[dict, dict]:
+    op, meta, arrays = decode_msg(blob)
+    if op != "emb_block":
+        raise ValueError(f"not an embedding block: op={op!r}")
+    return meta, arrays
+
+
+def _push_rows(addr: str, rows: dict, dim: int, num_slots: int,
+               meta: dict | None = None, timeout: float = 30.0) -> None:
+    """Chunked ``import_rows`` push to one shard server (bounded frame
+    sizes for wide tables with slots)."""
+    host, _, port = addr.rpartition(":")
+    row_bytes = dim * 4 * (1 + num_slots) + 8 + 4
+    chunk = max(1, _PUSH_CHUNK_BYTES // row_bytes)
+    with socket.create_connection(
+        (host or "127.0.0.1", int(port)), timeout=timeout
+    ) as conn:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        n = int(rows["keys"].size)
+        for i in range(0, n, chunk):
+            sl = slice(i, i + chunk)
+            payload = {
+                k: rows[k][sl]
+                for k in ("keys", "values", "slots", "freq")
+                if rows.get(k) is not None
+            }
+            _call(conn, "import_rows", meta or {}, payload)
+
+
+# ------------------------------------------------------------- shard server
+
+
+class FabricShardServer(ArrayMsgServer):
+    """One fabric shard: a native KvEmbeddingTable owning the rows the
+    hash ring maps to this member at the current routing version.
+
+    Beyond the PS-tier server this one tracks ``applied_version`` (the
+    newest async-apply version it has folded in — stamped on every
+    lookup response so serving clients know how fresh their rows are)
+    and owns the verified-persist surface: ``persist_prepare`` packs
+    the deterministic block, ``hold_block`` parks a peer's block for
+    twin redundancy, ``persist_write`` lands this writer's file through
+    ``CheckpointStorage.write_parallel`` with per-piece CRCs and
+    returns the manifest/ack entry."""
+
+    error_cls = ShardError
+
+    def __init__(self, dim: int, num_slots: int = 2, *, member: str,
+                 seed: int = 0, host: str = "0.0.0.0", port: int = 0,
+                 storage=None):
+        super().__init__(host=host, port=port,
+                         name=f"emb-fabric-{member}")
+        self.dim = dim
+        self.num_slots = num_slots
+        self.member = member
+        # member-derived seed: deterministic distinct init per shard,
+        # stable across respawns of the same member id
+        self.table = KvEmbeddingTable(
+            dim=dim, num_slots=num_slots,
+            seed=seed + (zlib.crc32(member.encode()) & 0xFFFF),
+        )
+        self.storage = storage or PosixDiskStorage()
+        self.route: RingRoute | None = None
+        self.applied_version = 0
+        self._lock = threading.Lock()
+        self._migrating = False
+        self._migrating_since = 0.0
+        self.migrate_ttl_s = 1800.0
+        self._prepared: dict[int, bytes] = {}       # step -> own block
+        self._held: dict[tuple[int, str], bytes] = {}  # (step, owner)
+
+    def start(self) -> "FabricShardServer":
+        super().start()
+        logger.info("fabric shard %s serving on port %d", self.member,
+                    self.port)
+        return self
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    # ------------------------------------------------------------- dispatch
+
+    def _check_epoch(self, meta: dict) -> None:
+        if self._migrating:
+            if (self._migrating_since
+                    and time.monotonic() - self._migrating_since
+                    > self.migrate_ttl_s):
+                logger.warning(
+                    "migration armed > %.0fs with no commit/abort "
+                    "(dead coordinator?); self-aborting to restore "
+                    "service", self.migrate_ttl_s,
+                )
+                self.abort_migration()
+            else:
+                raise ShardError("migrating",
+                                 "shard is re-partitioning",
+                                 {"retry_ms": 100})
+        v = meta.get("v")
+        if self.route is not None and v is not None \
+                and v != self.route.version:
+            raise ShardError(
+                "version",
+                f"client routing v{v} != shard v{self.route.version}",
+                {"current": self.route.version},
+            )
+
+    def _handle(self, op: str, meta: dict, arrays: dict) -> bytes:
+        if op == "ping":
+            route = self.route
+            return encode_msg("ok", {
+                "member": self.member, "rows": len(self.table),
+                "version": route.version if route else -1,
+                "applied_version": self.applied_version,
+            })
+        if op == "lookup":
+            self._check_epoch(meta)
+            with self._lock:
+                values = self.table.lookup(
+                    arrays["ids"], init_missing=meta.get("init", True)
+                )
+                applied = self.applied_version
+            return encode_msg("ok", {"applied_version": applied},
+                              arrays={"values": values})
+        if op == "apply":
+            self._check_epoch(meta)
+            with self._lock:
+                self.table.apply(
+                    meta["optimizer"], arrays["ids"], arrays["grads"],
+                    **meta.get("kwargs", {}),
+                )
+                version = int(meta.get("version", 0))
+                if version > self.applied_version:
+                    self.applied_version = version
+            return encode_msg("ok", {"rows": len(self.table)})
+        if op == "import_rows":
+            # migration/restore push: no epoch check — the pusher runs
+            # ahead of the version bump by design
+            with self._lock:
+                self.table.import_(dict(arrays))
+                version = int(meta.get("applied_version", 0))
+                if version > self.applied_version:
+                    self.applied_version = version
+            return encode_msg("ok", {"rows": len(self.table)})
+        if op == "export":
+            with self._lock:
+                snap = self.table.export(
+                    min_freq=meta.get("min_freq", 0)
+                )
+            return encode_msg("ok", {"rows": int(snap["keys"].size)},
+                              arrays=snap)
+        if op == "rows":
+            return encode_msg("ok", {"rows": len(self.table)})
+        if op == "set_route":
+            with self._lock:
+                self.route = RingRoute.from_meta(meta["route"])
+            return encode_msg("ok", {"version": self.route.version})
+        if op == "set_applied":
+            with self._lock:
+                self.applied_version = int(meta["version"])
+            return encode_msg("ok", {})
+        if op == "migrate":
+            moved = self.migrate_to(RingRoute.from_meta(meta["route"]))
+            return encode_msg("ok", {
+                "moved": moved, "rows": len(self.table),
+            })
+        if op == "commit_migration":
+            pruned = self.commit_migration(
+                RingRoute.from_meta(meta["route"])
+            )
+            return encode_msg("ok", {
+                "pruned": pruned, "rows": len(self.table),
+            })
+        if op == "abort_migration":
+            return encode_msg("ok", {"pruned": self.abort_migration()})
+        if op == "prune_all":
+            # rollback path for pure-new destinations of an aborted
+            # scale: they sit outside the old ring, so every row they
+            # received is a stray
+            with self._lock:
+                keys = self.table.export(with_slots=False)["keys"]
+                if keys.size:
+                    self.table.remove(keys)
+            return encode_msg("ok", {"pruned": int(keys.size)})
+        if op == "persist_prepare":
+            return encode_msg("ok", self.persist_prepare(
+                int(meta["step"])
+            ))
+        if op == "send_block":
+            self.send_block(int(meta["step"]), meta["dest_addr"])
+            return encode_msg("ok", {})
+        if op == "hold_block":
+            with self._lock:
+                self._held[(int(meta["step"]), meta["owner"])] = \
+                    arrays["blob"].tobytes()
+            return encode_msg("ok", {})
+        if op == "persist_write":
+            entry = self.persist_write(
+                int(meta["step"]), meta["dir"],
+                int(meta["num_shards"]),
+            )
+            return encode_msg("ok", {"entry": entry})
+        raise ShardError("bad_op", f"unknown op {op!r}")
+
+    # ------------------------------------------------------------ migration
+
+    def migrate_to(self, new_route: RingRoute) -> int:
+        """Phase 1 of the two-phase scale: COPY every row whose owner
+        under ``new_route``'s ring differs from this member to its new
+        owner. Nothing is deleted and the epoch is not adopted — the
+        same zero-loss protocol as the PS tier (service.py), with the
+        splitmix-mod partition swapped for ring ownership. The
+        ``_migrating`` gate stays armed until commit/abort; its TTL
+        clock starts when the copy finishes."""
+        self._migrating = True
+        self._migrating_since = 0.0
+        try:
+            with self._lock:
+                snap = self.table.export()
+                keys = snap["keys"]
+                moved = 0
+                if keys.size:
+                    owners = new_route.owner_indices(keys)
+                    for dest_idx, dest in enumerate(new_route.members):
+                        if dest == self.member:
+                            continue
+                        sel = owners == dest_idx
+                        if not np.any(sel):
+                            continue
+                        moved += int(sel.sum())
+                        _push_rows(
+                            new_route.addrs[dest], {
+                                "keys": keys[sel],
+                                "values": snap["values"][sel],
+                                "slots": snap["slots"][sel]
+                                if "slots" in snap else None,
+                                "freq": snap["freq"][sel],
+                            }, self.dim, self.num_slots,
+                            # the destination adopts the source's
+                            # freshness: migrated rows must not read
+                            # as applied_version 0 on serve lookups
+                            meta={"applied_version":
+                                  self.applied_version},
+                        )
+                self._migrating_since = time.monotonic()
+                return moved
+        except BaseException:
+            self._migrating = False
+            self._migrating_since = 0.0
+            raise
+
+    def commit_migration(self, new_route: RingRoute) -> int:
+        """Phase 2: adopt the new epoch and PRUNE every row this member
+        does not own under the new ring (idempotent, self-healing —
+        also clears dormant strays of an earlier aborted scale). A
+        member absent from the new ring is departing and prunes
+        everything."""
+        with self._lock:
+            if not self._migrating:
+                raise ShardError(
+                    "not_migrating",
+                    "no armed migration (self-aborted past TTL?); "
+                    "re-run the scale",
+                )
+            keys = self.table.export(with_slots=False)["keys"]
+            if self.member not in new_route.members:
+                prune = keys
+            elif keys.size:
+                mine = new_route.members.index(self.member)
+                prune = keys[new_route.owner_indices(keys) != mine]
+            else:
+                prune = keys
+            if prune.size:
+                self.table.remove(prune)
+            self.route = new_route
+            self._migrating = False
+            self._migrating_since = 0.0
+            return int(prune.size)
+
+    def abort_migration(self) -> int:
+        """Roll back phase 1: stay at the current epoch, prune the
+        strays this member holds beyond its current-ring ownership
+        (copies it received from an aborted peer push)."""
+        with self._lock:
+            keys = self.table.export(with_slots=False)["keys"]
+            route = self.route
+            if keys.size and route is not None \
+                    and self.member in route.members:
+                mine = route.members.index(self.member)
+                strays = keys[route.owner_indices(keys) != mine]
+                if strays.size:
+                    self.table.remove(strays)
+            else:
+                strays = keys[:0]
+            self._migrating = False
+            self._migrating_since = 0.0
+            return int(strays.size)
+
+    # ----------------------------------------------------------- persistence
+
+    def persist_prepare(self, step: int) -> dict:
+        """Pack this member's full row set into the deterministic block
+        for ``step``; parked until ``persist_write`` consumes it."""
+        with self._lock:
+            blob = pack_block(
+                self.member, self.table.export(), self.applied_version
+            )
+            self._prepared[step] = blob
+            return {
+                "rows": len(self.table),
+                "crc32": zlib.crc32(blob) & 0xFFFFFFFF,
+                "bytes": len(blob),
+                "applied_version": self.applied_version,
+            }
+
+    def send_block(self, step: int, dest_addr: str) -> None:
+        """Push the prepared block to the ring-successor twin — the
+        BYTES travel verbatim, so owner and twin write byte-identical
+        copies and the manifest's per-piece CRCs agree."""
+        with self._lock:
+            blob = self._prepared.get(step)
+        if blob is None:
+            raise ShardError("not_prepared",
+                             f"no prepared block for step {step}")
+        host, _, port = dest_addr.rpartition(":")
+        with socket.create_connection(
+            (host or "127.0.0.1", int(port)), timeout=30.0
+        ) as conn:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _call(conn, "hold_block",
+                  {"step": step, "owner": self.member},
+                  {"blob": np.frombuffer(blob, np.uint8)})
+
+    def persist_write(self, step: int, ckpt_dir: str,
+                      num_shards: int) -> dict:
+        """Write this writer's shard file (own block + any held twin
+        blocks, deterministically ordered) through
+        ``CheckpointStorage.write_parallel``, plus the piece-offset
+        meta the §20 ranged re-verification reads. Returns the
+        manifest/ack entry."""
+        with self._lock:
+            own = self._prepared.pop(step, None)
+            if own is None:
+                raise ShardError("not_prepared",
+                                 f"no prepared block for step {step}")
+            blocks = [(self.member, own)]
+            for (s, owner), blob in list(self._held.items()):
+                if s == step:
+                    blocks.append((owner, blob))
+                    del self._held[(s, owner)]
+                elif s < step:          # stale leftovers of a failed save
+                    del self._held[(s, owner)]
+            blocks.sort(key=lambda kv: kv[0])
+            metas: dict[str, dict] = {}
+            pieces: dict[str, dict] = {}
+            off = 0
+            for owner, blob in blocks:
+                crc = zlib.crc32(blob) & 0xFFFFFFFF
+                key = f"emb/{owner}"
+                metas[key] = {"crc32": crc, "offset": off,
+                              "nbytes": len(blob)}
+                pieces[key] = {
+                    "path": key, "index": [], "crc32": crc,
+                    "replica": 0 if owner == self.member else 1,
+                }
+                off += len(blob)
+            bin_bytes = b"".join(blob for _, blob in blocks)
+            sdir = os.path.join(ckpt_dir, f"step-{step}")
+            self.storage.makedirs(sdir)
+            self.storage.write_parallel(
+                bin_bytes, os.path.join(sdir, f"node_{self.member}.bin")
+            )
+            self.storage.write(
+                json.dumps({"metas": metas}),
+                os.path.join(sdir, f"node_{self.member}.meta.json"),
+            )
+            return {
+                "crc32": zlib.crc32(bin_bytes) & 0xFFFFFFFF,
+                "bytes": len(bin_bytes),
+                "pieces": pieces,
+            }
+
+
+# -------------------------------------------------------------- coordinator
+
+
+class FabricCoordinator(ArrayMsgServer):
+    """Routing authority + scale/persist/restore driver for the ring.
+
+    The PS tier's version-bumped coordinator, upgraded to ring
+    ownership and the verified-persist protocol: ``scale`` runs the
+    two-phase migration and journals ``embedding_scale`` with moved-row
+    counts; ``persist`` collects prepared blocks, places twin copies,
+    has every shard server write + ack, and commits the rank-0
+    ``commit_w<W>`` manifest (through the master's persist-ack ledger
+    under ``group="embedding"`` when a master client is attached);
+    ``restore`` resolves the newest verified plan and reassembles any
+    saved ring size onto the current one."""
+
+    error_cls = ShardError
+
+    def __init__(self, members: dict[str, str], *, dim: int,
+                 num_slots: int = 2, replicas: int | None = None,
+                 ckpt_dir: str = "", storage=None, master_client=None,
+                 host: str = "0.0.0.0", port: int = 0):
+        super().__init__(host=host, port=port, name="emb-fabric-coord")
+        self.dim = dim
+        self.num_slots = num_slots
+        if replicas is None:
+            replicas = envspec.get_int(EnvKey.EMBEDDING_REPLICAS)
+        self.ckpt_dir = ckpt_dir
+        self.storage = storage or PosixDiskStorage()
+        self.master_client = master_client
+        self.route = RingRoute(version=0, members=list(members),
+                               addrs=dict(members), replicas=replicas)
+        # _lock guards the route snapshot (instant holds); _scale_lock
+        # serializes scale/persist/restore, which legitimately run for
+        # minutes on big tables (the r04 starvation lesson)
+        self._lock = threading.Lock()
+        self._scale_lock = threading.Lock()
+
+    def start(self) -> "FabricCoordinator":
+        self._push_route(self.route)
+        super().start()
+        logger.info("fabric coordinator on port %d (%d shards, "
+                    "replicas=%d)", self.port,
+                    len(self.route.members), self.route.replicas)
+        return self
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def _handle(self, op: str, meta: dict, arrays: dict) -> bytes:
+        if op == "route":
+            with self._lock:
+                return encode_msg("ok", {"route": self.route.to_meta()})
+        if op == "scale":
+            try:
+                self.scale(dict(meta["members"]))
+            except Exception as e:  # noqa: BLE001 - report to caller
+                raise ShardError(
+                    "scale_failed", f"{type(e).__name__}: {e}"
+                ) from e
+            with self._lock:
+                return encode_msg("ok", {"route": self.route.to_meta()})
+        if op == "persist":
+            try:
+                info = self.persist(int(meta["step"]),
+                                    meta.get("dir") or None)
+            except Exception as e:  # noqa: BLE001 - report to caller
+                raise ShardError(
+                    "persist_failed", f"{type(e).__name__}: {e}"
+                ) from e
+            return encode_msg("ok", info)
+        if op == "restore":
+            try:
+                info = self.restore(meta.get("dir") or None)
+            except Exception as e:  # noqa: BLE001 - report to caller
+                raise ShardError(
+                    "restore_failed", f"{type(e).__name__}: {e}"
+                ) from e
+            return encode_msg("ok", {"restored": info})
+        if op == "repair":
+            try:
+                info = self.repair(meta["member"], meta["addr"])
+            except Exception as e:  # noqa: BLE001 - report to caller
+                raise ShardError(
+                    "repair_failed", f"{type(e).__name__}: {e}"
+                ) from e
+            return encode_msg("ok", info)
+        raise ShardError("bad_op", f"unknown op {op!r}")
+
+    # ------------------------------------------------------------- plumbing
+
+    def _shard_call(self, addr: str, op: str, meta: dict | None = None,
+                    arrays: dict | None = None,
+                    timeout: float | None = 60.0):
+        host, _, port = addr.rpartition(":")
+        with socket.create_connection(
+            (host or "127.0.0.1", int(port)), timeout=timeout
+        ) as conn:
+            return _call(conn, op, meta, arrays)
+
+    def _retry_shard_call(self, addr: str, op: str, meta: dict,
+                          retries: int = 3, backoff_s: float = 0.5,
+                          timeout: float | None = 60.0) -> dict:
+        last: Exception | None = None
+        for attempt in range(max(1, retries)):
+            try:
+                rmeta, _ = self._shard_call(addr, op, meta,
+                                            timeout=timeout)
+                return rmeta
+            except (ShardError, ConnectionError, OSError) as e:
+                last = e
+                logger.warning("%s to %s failed (attempt %d/%d): %s",
+                               op, addr, attempt + 1, retries, e)
+                time.sleep(backoff_s * (attempt + 1))
+        raise RuntimeError(f"{op} to {addr} failed after "
+                           f"{retries} attempts: {last}")
+
+    def _push_route(self, route: RingRoute) -> None:
+        for member in route.members:
+            self._shard_call(route.addrs[member], "set_route",
+                             {"route": route.to_meta()})
+
+    def total_rows(self) -> int:
+        with self._lock:
+            route = self.route
+        return sum(
+            self._shard_call(route.addrs[m], "rows")[0]["rows"]
+            for m in route.members
+        )
+
+    # ----------------------------------------------------------------- scale
+
+    def scale(self, new_members: dict[str, str],
+              migrate_retries: int = 3) -> RingRoute:
+        """Re-partition the ring onto ``new_members`` (member id ->
+        addr), failure-atomically: COPY (zero-loss, rolled back on
+        failure), then COMMIT (rolled forward). Journals
+        ``embedding_scale`` with the moved-row count — the number the
+        ~1/N migration bound is asserted against."""
+        with self._scale_lock:
+            with self._lock:
+                old = self.route
+            new_route = RingRoute(
+                version=old.version + 1,
+                members=list(new_members), addrs=dict(new_members),
+                replicas=old.replicas, vnodes=old.vnodes,
+            )
+            t0 = time.monotonic()
+            total_before = sum(
+                self._shard_call(old.addrs[m], "rows")[0]["rows"]
+                for m in old.members
+            )
+            moved = 0
+            try:
+                for member in old.members:
+                    rmeta = self._retry_shard_call(
+                        old.addrs[member], "migrate",
+                        {"route": new_route.to_meta()},
+                        migrate_retries, timeout=None,
+                    )
+                    moved += int(rmeta["moved"])
+                    logger.info("fabric shard %s copied %d rows",
+                                member, rmeta["moved"])
+                # pure-new members adopt first: they only gained rows,
+                # so a failure here still rolls back cleanly
+                for member in new_route.members:
+                    if member not in old.members:
+                        self._retry_shard_call(
+                            new_route.addrs[member], "set_route",
+                            {"route": new_route.to_meta()},
+                            migrate_retries,
+                        )
+            except Exception:
+                self._rollback(old, new_route)
+                get_journal().emit(
+                    "embedding_scale", from_n=len(old.members),
+                    to_n=len(new_route.members), moved=moved,
+                    version=new_route.version, ok=False,
+                )
+                raise
+            # commit the old members — from here failures roll FORWARD
+            for member in old.members:
+                self._retry_shard_call(
+                    old.addrs[member], "commit_migration",
+                    {"route": new_route.to_meta()}, migrate_retries,
+                )
+            with self._lock:
+                self.route = new_route
+            _scale_moved_rows_total.inc(moved)
+            get_journal().emit(
+                "embedding_scale", from_n=len(old.members),
+                to_n=len(new_route.members), moved=moved,
+                total_rows=total_before, version=new_route.version,
+                ok=True, dur=time.monotonic() - t0,
+            )
+            return new_route
+
+    def _rollback(self, old: RingRoute, new_route: RingRoute) -> None:
+        for member in old.members:
+            try:
+                self._shard_call(old.addrs[member], "abort_migration")
+            except Exception:  # noqa: BLE001 - best effort
+                logger.warning("abort_migration to %s failed", member)
+        for member in new_route.members:
+            if member in old.members:
+                continue
+            try:
+                self._shard_call(new_route.addrs[member], "prune_all")
+            except Exception:  # noqa: BLE001 - best effort
+                logger.warning("prune_all to %s failed", member)
+
+    # --------------------------------------------------------------- persist
+
+    def persist(self, step: int, ckpt_dir: str | None = None) -> dict:
+        """Verified shard checkpoint of the whole ring at ``step``.
+
+        The caller owns the drain barrier (``FabricClient.drain()`` /
+        ``persist_fabric``): the fabric cannot see un-flushed client
+        queues, so snapshotting without draining would save
+        update-incomplete state."""
+        ckpt_dir = ckpt_dir or self.ckpt_dir
+        if not ckpt_dir:
+            raise ValueError("no checkpoint directory configured")
+        with self._scale_lock:
+            with self._lock:
+                route = self.route
+            W = len(route.members)
+            t0 = time.monotonic()
+            prepared: dict[str, dict] = {}
+            for member in route.members:
+                rmeta, _ = self._shard_call(
+                    route.addrs[member], "persist_prepare",
+                    {"step": step},
+                )
+                prepared[member] = rmeta
+            if route.replicas >= 2 and W >= 2:
+                for member in route.members:
+                    self._shard_call(
+                        route.addrs[member], "send_block",
+                        {"step": step,
+                         "dest_addr": route.addrs[route.twin(member)]},
+                    )
+            shards: dict[str, dict] = {}
+            for member in route.members:
+                rmeta, _ = self._shard_call(
+                    route.addrs[member], "persist_write",
+                    {"step": step, "dir": ckpt_dir, "num_shards": W},
+                    timeout=None,
+                )
+                shards[member] = dict(rmeta["entry"])
+            applied = max(
+                int(p.get("applied_version", 0))
+                for p in prepared.values()
+            )
+            rows = sum(int(p.get("rows", 0)) for p in prepared.values())
+            # every shard server acks the master's persist ledger (the
+            # §20 commit path, namespaced group="embedding"); the
+            # commit manifest is then assembled from the ledger so a
+            # writer that died before acking keeps the step invisible
+            if self.master_client is not None:
+                for member, entry in shards.items():
+                    self.master_client.report_persist_ack(
+                        step, W, entry, writer_id=member,
+                        group="embedding",
+                    )
+                status = self.master_client.persist_status(
+                    step, W, group="embedding"
+                )
+                if not status.complete:
+                    raise RuntimeError(
+                        f"persist ledger incomplete: {status.acked}"
+                        f"/{W} acks for step {step}"
+                    )
+                shards = {m: dict(e) for m, e in status.shards.items()}
+            sdir = os.path.join(ckpt_dir, f"step-{step}")
+            integrity.write_commit(
+                self.storage, sdir, step, W, shards,
+                extra={
+                    "kind": "embedding", "dim": self.dim,
+                    "num_slots": self.num_slots,
+                    "members": list(route.members),
+                    "replicas": route.replicas,
+                    "applied_version": applied,
+                },
+            )
+            self.storage.write(
+                json.dumps({"step": step, "num_shards": W}),
+                os.path.join(ckpt_dir, "latest"),
+            )
+            info = {"step": step, "num_shards": W, "rows": rows,
+                    "applied_version": applied}
+            get_journal().emit("embedding_persist", step=step,
+                               num_shards=W, rows=rows,
+                               dur=time.monotonic() - t0)
+            return info
+
+    # --------------------------------------------------------------- restore
+
+    def restore(self, ckpt_dir: str | None = None) -> dict | None:
+        """Restore the newest VERIFIED fabric checkpoint onto the
+        CURRENT ring (any saved ring size; optimizer slots + frequency
+        row-exact). Runs §20 quorum semantics: a corrupt shard file
+        whose block verifies in its ring-successor twin's file restores
+        from the twin (``ckpt_shard_rollback``); a step with an
+        uncovered block rolls back whole-step to the newest verified
+        one. Returns None when nothing restorable exists."""
+        ckpt_dir = ckpt_dir or self.ckpt_dir
+        if not ckpt_dir:
+            raise ValueError("no checkpoint directory configured")
+        with self._scale_lock:
+            loaded = self._load_checkpoint(ckpt_dir)
+            if loaded is None:
+                return None
+            plan, manifest, keys, rows = loaded
+            with self._lock:
+                route = self.route
+            owners = route.owner_indices(keys)
+            applied = int(manifest.get("applied_version", 0))
+            for idx, member in enumerate(route.members):
+                sel = owners == idx
+                if not np.any(sel):
+                    continue
+                _push_rows(
+                    route.addrs[member],
+                    {"keys": keys[sel],
+                     **{k: v[sel] for k, v in rows.items()}},
+                    self.dim, self.num_slots,
+                    meta={"applied_version": applied},
+                )
+            for member in route.members:
+                self._shard_call(route.addrs[member], "set_applied",
+                                 {"version": applied})
+            info = {"step": plan.step, "rows": int(keys.size),
+                    "applied_version": applied,
+                    "saved_members": list(manifest.get("members", [])),
+                    "num_shards": plan.num_shards}
+            get_journal().emit(
+                "embedding_restore", step=plan.step,
+                rows=int(keys.size), from_w=plan.num_shards,
+                to_w=len(route.members),
+            )
+            return info
+
+    def _load_checkpoint(self, ckpt_dir: str):
+        """(plan, manifest, keys, row arrays) of the newest VERIFIED
+        embedding checkpoint, or None with nothing restorable."""
+        plan = integrity.resolve_restore_plan(self.storage, ckpt_dir)
+        if plan is None:
+            return None
+        sdir = os.path.join(ckpt_dir, f"step-{plan.step}")
+        manifest = json.loads(self.storage.read_text(os.path.join(
+            sdir, integrity.commit_marker(plan.num_shards)
+        )))
+        if manifest.get("kind") != "embedding":
+            raise ValueError(
+                f"step {plan.step} is not an embedding checkpoint"
+            )
+        blocks = self._read_blocks(sdir, manifest, plan)
+        keys = np.concatenate([b["keys"] for b in blocks])
+        rows = {
+            name: np.concatenate([b[name] for b in blocks])
+            for name in ("values", "slots", "freq")
+            if all(name in b for b in blocks)
+        }
+        return plan, manifest, keys, rows
+
+    # ---------------------------------------------------------------- repair
+
+    def repair(self, member: str, new_addr: str,
+               ckpt_dir: str | None = None) -> dict:
+        """Replace a DEAD shard server: same ring membership (ownership
+        does not move), ``member`` re-homed to ``new_addr`` under a
+        bumped route version (every client re-dials), and ONLY the dead
+        member's rows refilled from the newest verified checkpoint —
+        the surviving shards keep their live (possibly newer) rows, so
+        the blast radius of a shard-server loss is one shard's
+        since-last-checkpoint updates, not the ring."""
+        ckpt_dir = ckpt_dir or self.ckpt_dir
+        with self._scale_lock:
+            with self._lock:
+                old = self.route
+            if member not in old.members:
+                raise ValueError(f"{member!r} is not a ring member")
+            addrs = dict(old.addrs)
+            addrs[member] = new_addr
+            new_route = RingRoute(
+                version=old.version + 1, members=list(old.members),
+                addrs=addrs, replicas=old.replicas, vnodes=old.vnodes,
+            )
+            t0 = time.monotonic()
+            self._push_route(new_route)
+            with self._lock:
+                self.route = new_route
+            restored_rows = 0
+            step = None
+            if ckpt_dir:
+                loaded = self._load_checkpoint(ckpt_dir)
+                if loaded is not None:
+                    plan, manifest, keys, rows = loaded
+                    applied = int(manifest.get("applied_version", 0))
+                    mine = new_route.members.index(member)
+                    sel = new_route.owner_indices(keys) == mine
+                    if np.any(sel):
+                        _push_rows(
+                            new_addr,
+                            {"keys": keys[sel],
+                             **{k: v[sel] for k, v in rows.items()}},
+                            self.dim, self.num_slots,
+                            meta={"applied_version": applied},
+                        )
+                    self._shard_call(new_addr, "set_applied",
+                                     {"version": applied})
+                    restored_rows = int(sel.sum())
+                    step = plan.step
+            get_journal().emit(
+                "embedding_repair", member=member, step=step,
+                rows=restored_rows, version=new_route.version,
+                dur=time.monotonic() - t0,
+            )
+            return {"member": member, "rows": restored_rows,
+                    "step": step, "version": new_route.version}
+
+    def _read_blocks(self, sdir: str, manifest: dict, plan) -> list[dict]:
+        """One verified block per saved member, preferring the primary
+        writer and falling back to any writer whose copy of the piece
+        the restore plan did not condemn."""
+        shards: dict[str, dict] = dict(manifest.get("shards", {}))
+        out: list[dict] = []
+        for member in manifest.get("members", []):
+            key = f"emb/{member}"
+            block = None
+            # primary writer first, then every twin holder
+            writers = sorted(
+                (w for w, e in shards.items()
+                 if key in (e or {}).get("pieces", {})),
+                key=lambda w: (w != member, w),
+            )
+            for writer in writers:
+                bad = plan.bad_pieces.get(writer, set())
+                if bad is None or (bad and key in bad):
+                    continue
+                try:
+                    block = self._read_piece(sdir, writer, key)
+                    break
+                except (OSError, ValueError) as e:
+                    logger.warning(
+                        "block %s unreadable from writer %s: %s",
+                        key, writer, e,
+                    )
+            if block is None:
+                raise OSError(
+                    f"no verified copy of block {key} in {sdir}"
+                )
+            meta, arrays = unpack_block(block)
+            out.append(arrays)
+        return out
+
+    def _read_piece(self, sdir: str, writer: str, key: str) -> bytes:
+        header = json.loads(self.storage.read_text(
+            os.path.join(sdir, f"node_{writer}.meta.json")
+        ))
+        info = header["metas"][key]
+        blob = self.storage.read_range(
+            os.path.join(sdir, f"node_{writer}.bin"),
+            int(info["offset"]), int(info["nbytes"]),
+        )
+        if len(blob) != int(info["nbytes"]) \
+                or zlib.crc32(blob) & 0xFFFFFFFF != int(info["crc32"]):
+            raise ValueError(f"piece {key} of writer {writer} corrupt")
+        return blob
+
+
+# ------------------------------------------------------------------- client
+
+
+@dataclasses.dataclass
+class _ApplyItem:
+    version: int
+    optimizer: str
+    ids: np.ndarray
+    grads: np.ndarray
+    kwargs: dict
+    t_enqueue: float
+
+
+class FabricClient:
+    """Ring-routed table client: the KvEmbeddingTable surface over the
+    fabric, with async gradient streaming in ``mode="train"`` and a
+    read-only, version-pinned view in ``mode="serve"``.
+
+    Train mode: ``apply`` enqueues and returns; the flusher thread
+    streams batches shard-ward in order. ``drain()`` is the checkpoint
+    barrier. The staleness bound back-pressures ``apply`` (the step
+    blocks) once the flusher falls more than
+    ``DLROVER_TPU_EMBEDDING_MAX_STALENESS`` versions behind.
+
+    Serve mode: lookups never materialize missing rows and each call
+    stamps the applied training version of the touched shards
+    (``last_lookup_info``) so responses carry their freshness.
+    """
+
+    def __init__(self, coordinator_addr: str | None = None,
+                 route: RingRoute | None = None, dim: int = 0, *,
+                 mode: str = "train", async_apply: bool | None = None,
+                 max_staleness: int | None = None,
+                 flush_ms: float | None = None,
+                 queue_batches: int | None = None,
+                 timeout: float = 30.0, retry_window_s: float = 600.0):
+        if not coordinator_addr and route is None:
+            raise ValueError("need coordinator_addr or route")
+        if mode not in ("train", "serve"):
+            raise ValueError(f"unknown mode {mode!r}")
+        self.dim = dim
+        self.mode = mode
+        self._timeout = timeout
+        self.retry_window_s = retry_window_s
+        self._coord_addr = coordinator_addr
+        self._route = route
+        self._tls = threading.local()
+        self._sock_gen = 0
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="emb-fabric-client"
+        )
+        self._step = 0
+        self._applied = 0
+        self._queue: deque[_ApplyItem] = deque()
+        self._flush_error: Exception | None = None
+        self._closed = False
+        self.last_lookup_info: dict = {}
+        if max_staleness is None:
+            max_staleness = envspec.get_int(
+                EnvKey.EMBEDDING_MAX_STALENESS
+            )
+        self.max_staleness = max(1, int(max_staleness))
+        if flush_ms is None:
+            flush_ms = envspec.get_float(EnvKey.EMBEDDING_FLUSH_MS)
+        self._flush_s = max(0.0005, float(flush_ms) / 1000.0)
+        if queue_batches is None:
+            queue_batches = envspec.get_int(EnvKey.EMBEDDING_QUEUE)
+        self.queue_batches = max(1, int(queue_batches))
+        if coordinator_addr:
+            self.refresh_route()
+        self._async = (mode == "train"
+                       and (async_apply is None or async_apply))
+        self._flusher: threading.Thread | None = None
+        if self._async:
+            self._flusher = threading.Thread(
+                target=self._flush_loop, daemon=True,
+                name="emb-fabric-flusher",
+            )
+            self._flusher.start()
+
+    # ------------------------------------------------------------- plumbing
+
+    def refresh_route(self) -> None:
+        host, _, port = self._coord_addr.rpartition(":")
+        with socket.create_connection(
+            (host or "127.0.0.1", int(port)), timeout=self._timeout
+        ) as conn:
+            meta, _ = _call(conn, "route")
+        with self._lock:
+            self._route = RingRoute.from_meta(meta["route"])
+            # bump the socket generation: every worker thread re-dials
+            # lazily, so stale sockets to drained servers die here too
+            self._sock_gen += 1
+
+    @property
+    def route(self) -> RingRoute:
+        with self._lock:
+            return self._route
+
+    @property
+    def version(self) -> int:
+        return self.route.version
+
+    def _sock_for(self, addr: str) -> socket.socket:
+        # per-worker-thread connection maps: lookups (caller thread
+        # pool) and the flusher fan out concurrently, and two frames
+        # interleaved on one socket would corrupt the protocol
+        tls = self._tls
+        with self._lock:
+            gen = self._sock_gen
+        if getattr(tls, "gen", None) != gen:
+            for s in getattr(tls, "socks", {}).values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            tls.socks = {}
+            tls.gen = gen
+        s = tls.socks.get(addr)
+        if s is None:
+            host, _, port = addr.rpartition(":")
+            s = socket.create_connection(
+                (host or "127.0.0.1", int(port)), timeout=self._timeout
+            )
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            tls.socks[addr] = s
+        return s
+
+    def _evict_sock(self, addr: str) -> None:
+        s = getattr(self._tls, "socks", {}).pop(addr, None)
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _shard_call(self, addr: str, op: str, meta: dict,
+                    arrays: dict) -> tuple[dict, dict]:
+        try:
+            return _call(self._sock_for(addr), op, meta, arrays)
+        except (ConnectionError, OSError):
+            # evict + one immediate re-dial (dead/drained server); a
+            # second failure evicts again so the retry loop dials fresh
+            self._evict_sock(addr)
+            try:
+                return _call(self._sock_for(addr), op, meta, arrays)
+            except (ConnectionError, OSError):
+                self._evict_sock(addr)
+                raise
+
+    def _fanout(self, op: str, ids: np.ndarray,
+                per_shard_arrays: Callable,
+                meta_extra: dict | None = None):
+        """Ring-owner fan-out with per-id retry completion (the §25
+        twin of the PS tier's ``_fanout``): version errors and
+        migrating gates re-route under a refreshed route; only the ids
+        whose shard call failed are re-sent."""
+        flat = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        pending = np.ones(flat.size, dtype=bool)
+        results: list[tuple[np.ndarray, dict, dict]] = []
+        last: Exception | None = None
+        deadline = time.monotonic() + self.retry_window_s
+        backoff = 0.25
+        while True:
+            route = self.route
+            idxs = np.nonzero(pending)[0]
+            owners = route.owner_indices(flat[idxs])
+            futures = []
+            for s, member in enumerate(route.members):
+                sel = idxs[owners == s]
+                if sel.size == 0:
+                    continue
+                meta = {"v": route.version, **(meta_extra or {})}
+                arrays = per_shard_arrays(flat[sel], sel)
+                futures.append((sel, self._pool.submit(
+                    self._shard_call, route.addrs[member], op, meta,
+                    arrays,
+                )))
+            for sel, fut in futures:
+                try:
+                    rmeta, rarrays = fut.result()
+                    results.append((sel, rmeta, rarrays))
+                    pending[sel] = False
+                except ShardError as e:
+                    last = e
+                    if e.code not in ("version", "migrating"):
+                        raise
+                except (ConnectionError, OSError) as e:
+                    last = e
+            if not pending.any():
+                return results, flat
+            if time.monotonic() >= deadline:
+                break
+            time.sleep(backoff)
+            backoff = min(backoff * 1.5, 2.0)
+            if self._coord_addr:
+                try:
+                    self.refresh_route()
+                except (ShardError, ConnectionError, OSError) as e:
+                    last = e  # coordinator busy/unreachable: retry
+        raise RuntimeError(
+            f"embedding fabric fanout kept failing after "
+            f"{self.retry_window_s:.0f}s: {last}"
+        )
+
+    # ------------------------------------------------------------- user ops
+
+    def lookup(self, ids: np.ndarray, init_missing: bool = True
+               ) -> np.ndarray:
+        values, _info = self.lookup_with_info(ids, init_missing)
+        return values
+
+    def lookup_with_info(self, ids: np.ndarray,
+                         init_missing: bool = True
+                         ) -> tuple[np.ndarray, dict]:
+        """Gather + freshness info. Serve-mode lookups never create
+        rows regardless of ``init_missing`` (a read path must not
+        mutate the model); the info dict stamps the routing version and
+        the applied training version of the touched shards (min = the
+        step every returned row is guaranteed to reflect)."""
+        if self.mode == "serve":
+            init_missing = False
+        _lookups_total.labels(self.mode).inc()
+        flat_shape = np.shape(ids)
+        parts, flat = self._fanout(
+            "lookup", ids,
+            lambda shard_ids, sel: {"ids": shard_ids},
+            meta_extra={"init": init_missing},
+        )
+        out = np.empty((flat.size, self.dim), np.float32)
+        applied = []
+        for sel, rmeta, rarrays in parts:
+            out[sel] = rarrays["values"]
+            applied.append(int(rmeta.get("applied_version", 0)))
+        info = {
+            "version": self.version,
+            "applied_version": min(applied) if applied else 0,
+            "applied_version_max": max(applied) if applied else 0,
+        }
+        info["staleness"] = (info["applied_version_max"]
+                             - info["applied_version"])
+        self.last_lookup_info = info
+        return out.reshape(*flat_shape, self.dim), info
+
+    def apply(self, optimizer: str, ids: np.ndarray,
+              grads: np.ndarray, **kwargs) -> None:
+        """Sparse update. Async (default in train mode): enqueue and
+        return, back-pressuring once the flusher is more than
+        ``max_staleness`` versions behind or the queue is full."""
+        if self.mode != "train":
+            raise RuntimeError("serve-mode clients are read-only")
+        g = np.ascontiguousarray(grads, np.float32).reshape(-1, self.dim)
+        flat = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        if g.shape[0] != flat.size:
+            raise ValueError(
+                f"{flat.size} ids but {g.shape[0]} gradient rows"
+            )
+        with self._cond:
+            if self._flush_error is not None:
+                raise RuntimeError(
+                    "embedding flusher died"
+                ) from self._flush_error
+            version = self._step + 1
+            self._step = version
+        if optimizer in ("adam", "group_adam", "radam"):
+            kwargs.setdefault("step", version)
+        item = _ApplyItem(version, optimizer, flat, g, dict(kwargs),
+                          time.monotonic())
+        if not self._async:
+            self._flush_item(item)
+            with self._cond:
+                self._applied = version
+            return
+        blocked = False
+        with self._cond:
+            while (not self._closed and self._flush_error is None
+                   and (version - self._applied > self.max_staleness
+                        or len(self._queue) >= self.queue_batches)):
+                if not blocked:
+                    blocked = True
+                    _backpressure_total.inc()
+                self._cond.wait(0.05)
+            if self._flush_error is not None:
+                raise RuntimeError(
+                    "embedding flusher died"
+                ) from self._flush_error
+            if self._closed:
+                raise RuntimeError("client is closed")
+            self._queue.append(item)
+            _flush_queue_depth.set(len(self._queue))
+            _staleness_steps.set(self._step - self._applied)
+            self._cond.notify_all()
+
+    def _flush_item(self, item: _ApplyItem) -> None:
+        self._fanout(
+            "apply", item.ids,
+            lambda shard_ids, sel: {"ids": shard_ids,
+                                    "grads": item.grads[sel]},
+            meta_extra={"optimizer": item.optimizer,
+                        "kwargs": item.kwargs,
+                        "version": item.version},
+        )
+
+    def _flush_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._closed:
+                    self._cond.wait(self._flush_s)
+                if self._closed and not self._queue:
+                    return
+                item = self._queue.popleft()
+                _flush_queue_depth.set(len(self._queue))
+            try:
+                self._flush_item(item)
+            except Exception as e:  # noqa: BLE001 - surface to apply/drain
+                logger.error("embedding flusher died: %s", e)
+                with self._cond:
+                    self._flush_error = e
+                    self._cond.notify_all()
+                return
+            with self._cond:
+                self._applied = item.version
+                _apply_lag_seconds.observe(
+                    time.monotonic() - item.t_enqueue
+                )
+                _staleness_steps.set(
+                    max(0, self._step - self._applied)
+                )
+                self._cond.notify_all()
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """The checkpoint barrier: block until every enqueued apply has
+        been flushed to the shard servers, so a snapshot taken after a
+        successful drain is update-complete. Returns False on timeout;
+        raises if the flusher died (those gradients are NOT durable)."""
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        with self._cond:
+            while self._queue or self._applied < self._step:
+                if self._flush_error is not None:
+                    raise RuntimeError(
+                        "embedding flusher died with updates queued"
+                    ) from self._flush_error
+                if deadline is not None \
+                        and time.monotonic() >= deadline:
+                    return False
+                self._cond.wait(0.05)
+        return True
+
+    def staleness(self) -> int:
+        with self._cond:
+            return max(0, self._step - self._applied)
+
+    def resume_from(self, applied_version: int) -> None:
+        """Adopt a restored checkpoint's applied version so post-resume
+        applies continue the version sequence (Adam step counters and
+        staleness accounting stay monotonic)."""
+        with self._cond:
+            self._step = max(self._step, int(applied_version))
+            self._applied = max(self._applied, int(applied_version))
+
+    # --------------------------------------------------- coordinator bridge
+
+    def _coord_call(self, op: str, meta: dict | None = None) -> dict:
+        host, _, port = self._coord_addr.rpartition(":")
+        with socket.create_connection(
+            (host or "127.0.0.1", int(port)), timeout=self._timeout
+        ) as conn:
+            rmeta, _ = _call(conn, op, meta)
+        return rmeta
+
+    def persist(self, step: int, timeout: float | None = None) -> dict:
+        """Drain barrier + coordinator-driven verified checkpoint."""
+        if not self.drain(timeout):
+            raise TimeoutError(
+                "drain did not complete before the checkpoint"
+            )
+        return self._coord_call("persist", {"step": step})
+
+    def row_count(self) -> int:
+        route = self.route
+        total = 0
+        for member in route.members:
+            rmeta, _ = self._shard_call(route.addrs[member], "rows",
+                                        {}, {})
+            total += rmeta["rows"]
+        return total
+
+    def __len__(self) -> int:
+        return self.row_count()
+
+    def export(self, min_freq: int = 0, with_slots: bool = True
+               ) -> dict[str, np.ndarray]:
+        """KvEmbeddingTable-compatible full-table snapshot."""
+        route = self.route
+        snaps = []
+        for member in route.members:
+            _, arrays = self._shard_call(route.addrs[member], "export",
+                                         {"min_freq": min_freq}, {})
+            snaps.append(arrays)
+        out: dict[str, np.ndarray] = {}
+        for k in ("keys", "values", "slots", "freq"):
+            if all(k in s for s in snaps):
+                out[k] = np.concatenate([s[k] for s in snaps])
+        if not with_slots:
+            out.pop("slots", None)
+        return out
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if self._flusher is not None:
+            self._flusher.join(timeout=5.0)
+        self._pool.shutdown(wait=False)
+        for s in getattr(self._tls, "socks", {}).values():
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+# ------------------------------------------------------------- conveniences
+
+
+def start_local_fabric(n: int, *, dim: int, num_slots: int = 2,
+                       seed: int = 0, replicas: int | None = None,
+                       ckpt_dir: str = "", master_client=None,
+                       host: str = "127.0.0.1"
+                       ) -> tuple[FabricCoordinator,
+                                  list[FabricShardServer]]:
+    """In-process ring of ``n`` shard servers + coordinator (tests,
+    bench, the single-host example). Member ids are ``emb-<i>`` —
+    stable across runs, so row placement and scale-event moved counts
+    are deterministic."""
+    servers = [
+        FabricShardServer(
+            dim=dim, num_slots=num_slots, member=f"emb-{i}",
+            seed=seed, host=host,
+        ).start()
+        for i in range(n)
+    ]
+    members = {s.member: s.addr for s in servers}
+    coord = FabricCoordinator(
+        members, dim=dim, num_slots=num_slots, replicas=replicas,
+        ckpt_dir=ckpt_dir, master_client=master_client, host=host,
+    ).start()
+    return coord, servers

@@ -43,6 +43,8 @@ from typing import Iterable
 
 import numpy as np
 
+from dlrover_tpu import chaos
+
 # decode_msg is re-exported: tests and tools treat this module as the
 # wire-protocol surface for the embedding tier
 from dlrover_tpu.common.array_wire import decode_msg, encode_msg  # noqa: F401
@@ -79,8 +81,47 @@ class ShardError(MsgError):
     pass
 
 
+def _apply_msg_fault(fault, sock: socket.socket) -> None:
+    """Injected embedding-transport faults (chaos plan ``embedding_msg``
+    point). The embedding tier's raw-array TCP framing bypasses
+    ``RpcClient``, so the PR-4 ``rpc_call`` rules never touch it —
+    this point closes that blind spot at the one client-side choke
+    point every lookup/apply/migration push goes through.
+
+    ``delay`` sleeps before sending (a congested link), ``drop`` loses
+    the request before it hits the wire (the server never sees it),
+    ``reset`` kills the connection mid-exchange (server death /
+    conntrack reset — the socket is poisoned and must be re-dialed),
+    ``garble`` poisons the stream with a corrupt frame (the server
+    closes it; protocol state is unrecoverable on this socket).
+    """
+    if fault.action == "delay":
+        time.sleep(float(fault.args.get("s", 0.2)))
+        return
+    if fault.action == "drop":
+        raise ConnectionError("chaos: dropped embedding message")
+    if fault.action == "reset":
+        try:
+            sock.close()
+        except OSError:
+            pass
+        raise ConnectionResetError("chaos: embedding connection reset")
+    if fault.action == "garble":
+        from dlrover_tpu.common.rpc import send_frame
+
+        try:
+            send_frame(sock, b"\x00garbled-embedding-frame")
+        except OSError:
+            pass
+        raise ConnectionError("chaos: garbled embedding frame")
+
+
 def _call(sock: socket.socket, op: str, meta: dict | None = None,
           arrays: dict | None = None) -> tuple[dict, dict]:
+    if chaos.ENABLED:
+        fault = chaos.fire("embedding_msg", op=op)
+        if fault is not None:
+            _apply_msg_fault(fault, sock)
     return call_msg(sock, op, meta, arrays, error_cls=ShardError)
 
 
@@ -672,15 +713,36 @@ class ShardedKvClient:
             self._socks[addr] = s
         return s
 
+    def _evict_sock(self, addr: str) -> None:
+        """Close-and-forget a socket that failed: popping without
+        closing (the r05 behavior) leaked one fd per dead server, and
+        leaving it cached re-sent the NEXT call into the same dead
+        connection — recovery then had to come from the slower
+        version-error/route-refresh path instead of a fresh dial."""
+        s = self._socks.pop(addr, None)
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+
     def _shard_call(self, idx: int, op: str, meta: dict,
                     arrays: dict) -> tuple[dict, dict]:
         addr = self._addrs[idx]
         try:
             return _call(self._sock_for(addr), op, meta, arrays)
         except (ConnectionError, OSError):
-            # one reconnect: the server may have restarted between ops
-            self._socks.pop(addr, None)
-            return _call(self._sock_for(addr), op, meta, arrays)
+            # evict + one immediate re-dial: the server may have
+            # restarted between ops (same addr, new process)
+            self._evict_sock(addr)
+            try:
+                return _call(self._sock_for(addr), op, meta, arrays)
+            except (ConnectionError, OSError):
+                # still down: evict again so the retry loop's NEXT
+                # attempt (after a route refresh) dials fresh instead
+                # of reusing a half-dead connection
+                self._evict_sock(addr)
+                raise
 
     def _fanout(self, op: str, ids: np.ndarray,
                 per_shard_arrays, meta_extra: dict | None = None,

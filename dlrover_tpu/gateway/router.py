@@ -24,11 +24,10 @@ a dead replica are forgotten so affinity never routes to a ghost.
 
 from __future__ import annotations
 
-import bisect
-import hashlib
 import threading
 from typing import Sequence
 
+from dlrover_tpu.common.hashring import HashRing, hash_point
 from dlrover_tpu.common.log import get_logger
 
 logger = get_logger(__name__)
@@ -120,10 +119,12 @@ class ShardRing:
     prompt start): every prompt of a prefix family hashes to the same
     shard, so that shard's replicas accumulate the family's prefix KV
     — keying on the final aligned boundary would scatter a family
-    across shards by total length. Classic consistent hashing with
-    ``vnodes`` virtual points per shard: adding or removing a shard
-    moves ~1/N of the keyspace instead of reshuffling everything, so a
-    front-tier scale-out invalidates a bounded slice of cache locality.
+    across shards by total length. The ring itself is the shared
+    ``common/hashring.HashRing`` (blake2s points, ``vnodes`` virtual
+    points per shard — the same construction the embedding fabric's
+    owner map uses): adding or removing a shard moves ~1/N of the
+    keyspace instead of reshuffling everything, so a front-tier
+    scale-out invalidates a bounded slice of cache locality.
 
     Thread-safe; shards are opaque ids (URL, pod name, index).
     """
@@ -132,21 +133,8 @@ class ShardRing:
                  shards: Sequence[str] = (), *, vnodes: int = 64):
         if prefill_len < 1:
             raise ValueError("prefill_len must be >= 1")
-        if vnodes < 1:
-            raise ValueError("vnodes must be >= 1")
         self._prefill_len = prefill_len
-        self._vnodes = vnodes
-        self._lock = threading.Lock()
-        self._points: list[int] = []          # sorted ring positions
-        self._owner: dict[int, str] = {}      # point -> shard id
-        for shard in shards:
-            self.add_shard(shard)
-
-    @staticmethod
-    def _hash(data: bytes) -> int:
-        return int.from_bytes(
-            hashlib.blake2s(data, digest_size=8).digest(), "big"
-        )
+        self._ring = HashRing(shards, vnodes=vnodes)
 
     def _key(self, prompt: Sequence[int]) -> bytes:
         P = self._prefill_len
@@ -156,36 +144,17 @@ class ShardRing:
     # ------------------------------------------------------------ membership
 
     def add_shard(self, shard: str) -> None:
-        with self._lock:
-            for v in range(self._vnodes):
-                point = self._hash(f"{shard}#{v}".encode())
-                if point in self._owner:        # vanishing collision:
-                    continue                    # first owner keeps it
-                self._owner[point] = shard
-                bisect.insort(self._points, point)
+        self._ring.add(shard)
 
     def remove_shard(self, shard: str) -> None:
-        with self._lock:
-            dead = [p for p, s in self._owner.items() if s == shard]
-            for point in dead:
-                del self._owner[point]
-                idx = bisect.bisect_left(self._points, point)
-                del self._points[idx]
+        self._ring.remove(shard)
 
     def shards(self) -> list[str]:
-        with self._lock:
-            return sorted(set(self._owner.values()))
+        return self._ring.members()
 
     # --------------------------------------------------------------- routing
 
     def shard_for(self, prompt: Sequence[int]) -> str | None:
         """The shard owning this prompt's prefix family; None with no
         shards registered."""
-        with self._lock:
-            if not self._points:
-                return None
-            h = self._hash(self._key(prompt))
-            idx = bisect.bisect_right(self._points, h)
-            if idx == len(self._points):
-                idx = 0                          # wrap around the ring
-            return self._owner[self._points[idx]]
+        return self._ring.owner_of_point(hash_point(self._key(prompt)))

@@ -71,6 +71,11 @@ _resubmitted_total = registry().counter(
     "dlrover_tpu_gateway_resubmitted_total",
     "requests re-routed after an abrupt replica death",
 )
+_embedding_lookups_total = registry().counter(
+    "dlrover_tpu_gateway_embedding_lookups_total",
+    "embedding-route lookups by outcome code (200/400/503)",
+    label_names=("code",),
+)
 
 
 class AdmissionError(RuntimeError):
@@ -452,14 +457,26 @@ class GatewayHTTPServer:
       "temperature"?, "top_k"?, "top_p"?, "eos_id"?, "seed"?}`` ->
       ``{"id", "tokens", "finish_reason", "replica", "attempts"}``;
       429 + ``Retry-After`` under backpressure.
+    - ``POST /v1/embedding/lookup`` (with ``embedding_client``):
+      ``{"ids": [[...]]}`` -> ``{"values", "version",
+      "applied_version", "staleness"}`` — rows served from the LIVE
+      training ring through a read-only, version-pinned fabric client
+      (DESIGN.md §25); missing ids score as zero vectors, never
+      materialize rows. 503 while the ring is unreachable.
     - ``GET /healthz``: replica/queue summary; 503 with no READY replica.
     - ``GET /metrics``: Prometheus text (``dlrover_tpu_gateway_*`` et al).
+
+    ``gateway`` may be None for an embedding-only front door (the
+    recsys serving example): the generate route then answers 503.
     """
 
-    def __init__(self, gateway: Gateway, *, host: str = "0.0.0.0",
-                 port: int = 0, request_timeout_s: float = 300.0):
+    def __init__(self, gateway: Optional[Gateway], *,
+                 host: str = "0.0.0.0", port: int = 0,
+                 request_timeout_s: float = 300.0,
+                 embedding_client=None):
         outer = self
         self.gateway = gateway
+        self.embedding_client = embedding_client
         self._request_timeout_s = request_timeout_s
 
         class _Handler(BaseHTTPRequestHandler):
@@ -477,9 +494,56 @@ class GatewayHTTPServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _embedding_lookup(self) -> None:
+                client = outer.embedding_client
+                if client is None:
+                    _embedding_lookups_total.labels("503").inc()
+                    self._json(503, {"error": "no embedding ring "
+                               "attached to this gateway"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(length))
+                    ids = req["ids"]
+                    if not isinstance(ids, list) or not ids:
+                        raise ValueError("ids must be a non-empty list")
+                except (KeyError, TypeError, ValueError,
+                        json.JSONDecodeError) as e:
+                    _embedding_lookups_total.labels("400").inc()
+                    self._json(400, {"error": f"bad request: {e}"})
+                    return
+                try:
+                    import numpy as np
+
+                    values, info = client.lookup_with_info(
+                        np.asarray(ids, dtype=np.int64),
+                        init_missing=False,
+                    )
+                except Exception as e:  # noqa: BLE001 - report to client
+                    _embedding_lookups_total.labels("503").inc()
+                    self._json(503, {
+                        "error": f"{type(e).__name__}: {e}",
+                    })
+                    return
+                _embedding_lookups_total.labels("200").inc()
+                self._json(200, {
+                    "values": values.tolist(),
+                    "version": info["version"],
+                    "applied_version": info["applied_version"],
+                    "staleness": info["staleness"],
+                })
+
             def do_GET(self) -> None:  # noqa: N802 - stdlib API
                 path = self.path.split("?")[0]
                 if path == "/healthz":
+                    if outer.gateway is None:
+                        ok = outer.embedding_client is not None
+                        self._json(200 if ok else 503, {
+                            "ready": ok,
+                            "status": "embedding_only" if ok
+                            else "no_backends",
+                        })
+                        return
                     stats = outer.gateway.stats()
                     code = 200 if stats["ready"] else 503
                     stats["status"] = "ok" if stats["ready"] else "no_replicas"
@@ -495,9 +559,16 @@ class GatewayHTTPServer:
                     self.send_error(404)
 
             def do_POST(self) -> None:  # noqa: N802 - stdlib API
-                if self.path.split("?")[0] not in ("/v1/generate",
-                                                   "/generate"):
+                path = self.path.split("?")[0]
+                if path == "/v1/embedding/lookup":
+                    self._embedding_lookup()
+                    return
+                if path not in ("/v1/generate", "/generate"):
                     self.send_error(404)
+                    return
+                if outer.gateway is None:
+                    self._json(503, {"error": "no decode backend "
+                               "(embedding-only gateway)"})
                     return
                 try:
                     length = int(self.headers.get("Content-Length", 0))

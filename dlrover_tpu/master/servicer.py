@@ -85,10 +85,14 @@ class MasterServicer:
         self.job_success: bool | None = None
         # node_id -> BuddyServer addr (checkpoint/buddy.py replication)
         self._buddy_endpoints: dict[int, str] = {}
-        # (step, num_shards) -> {node_id(str): shard manifest entry}:
-        # the persist-ack ledger the rank-0 committer polls instead of
-        # listing storage (DESIGN.md §20); bounded to the newest steps
-        self._persist_acks: dict[tuple[int, int], dict[str, dict]] = {}
+        # (step, num_shards, group) -> {writer(str): shard manifest
+        # entry}: the persist-ack ledger the rank-0 committer polls
+        # instead of listing storage (DESIGN.md §20); group "" = dense
+        # checkpoint hosts, "embedding" = fabric hash-shard writers
+        # (§25); bounded to the newest steps
+        self._persist_acks: dict[
+            tuple[int, int, str], dict[str, dict]
+        ] = {}
         self._persist_lock = TimedLock("ack_ledger")
         self.max_persist_steps = 8
         self.trace_id = trace_id
@@ -452,7 +456,7 @@ class MasterServicer:
         if isinstance(msg, m.JobExitRequest):
             return self._job_exit(msg)
         if isinstance(msg, m.PersistAckReport):
-            key = (int(msg.step), int(msg.num_shards))
+            key = (int(msg.step), int(msg.num_shards), str(msg.group))
             with self._persist_lock:
                 self._persist_acks.setdefault(key, {})[
                     str(msg.node_id)
@@ -464,7 +468,7 @@ class MasterServicer:
                         del self._persist_acks[old]
             return m.OkResponse()
         if isinstance(msg, m.PersistStatusRequest):
-            key = (int(msg.step), int(msg.num_shards))
+            key = (int(msg.step), int(msg.num_shards), str(msg.group))
             with self._persist_lock:
                 shards = dict(self._persist_acks.get(key, {}))
             return m.PersistStatusResponse(

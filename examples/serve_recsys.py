@@ -28,7 +28,15 @@ if _REPO not in sys.path:
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser("serve_recsys")
-    p.add_argument("--ckpt-dir", required=True)
+    p.add_argument("--ckpt-dir", default="",
+                   help="flash checkpoint with the dense tower (+ the "
+                        "embedding table unless --coordinator serves "
+                        "it live)")
+    p.add_argument("--coordinator", default="",
+                   help="serve embeddings from a LIVE training fabric "
+                        "ring (DESIGN.md §25): read-only version-"
+                        "pinned lookups with the applied training "
+                        "version stamped on every batch")
     p.add_argument("--fields", type=int, default=8)
     p.add_argument("--dim", type=int, default=16)
     p.add_argument("--id-space", type=int, default=1_000_000)
@@ -47,26 +55,59 @@ def main(argv=None) -> int:
     from dlrover_tpu.checkpoint.engine import CheckpointEngine
     from dlrover_tpu.embedding import KvEmbeddingTable
 
-    # raw (template-free) restore: the embedding arrays' row count is
-    # only known from the checkpoint itself
-    engine = CheckpointEngine(args.ckpt_dir)
-    loaded = engine.load_raw()
-    engine.close()
-    if loaded is None:
-        print("no checkpoint found", file=sys.stderr)
-        return 1
-    step, arrays = loaded
-    params = {
-        name.split("/", 1)[1]: jnp.asarray(arr)
-        for name, arr in arrays.items() if name.startswith("dense/")
-    }
-    table = KvEmbeddingTable(dim=args.dim, num_slots=2, seed=1234)
-    table.import_({
-        name.split("/", 1)[1]: np.asarray(arr)
-        for name, arr in arrays.items() if name.startswith("embedding/")
-    })
-    print(f"restored step {step}: {len(table)} embedding rows",
-          file=sys.stderr)
+    if not args.ckpt_dir and not args.coordinator:
+        print("need --ckpt-dir and/or --coordinator", file=sys.stderr)
+        return 2
+
+    # dense tower: from the flash checkpoint when available, else a
+    # fresh tower (live-ring smoke mode — scores are untrained)
+    step = None
+    arrays: dict = {}
+    if args.ckpt_dir:
+        # raw (template-free) restore: the embedding arrays' row count
+        # is only known from the checkpoint itself
+        engine = CheckpointEngine(args.ckpt_dir)
+        loaded = engine.load_raw()
+        engine.close()
+        if loaded is None:
+            print("no checkpoint found", file=sys.stderr)
+            return 1
+        step, arrays = loaded
+    if arrays:
+        params = {
+            name.split("/", 1)[1]: jnp.asarray(arr)
+            for name, arr in arrays.items() if name.startswith("dense/")
+        }
+    else:
+        k0, k1 = jax.random.split(jax.random.PRNGKey(0))
+        d_in = args.fields * args.dim
+        params = {
+            "w1": jax.random.normal(k0, (d_in, 64)) / np.sqrt(d_in),
+            "b1": jnp.zeros((64,)),
+            "w2": jax.random.normal(k1, (64, 1)) / 8.0,
+            "b2": jnp.zeros((1,)),
+        }
+
+    if args.coordinator:
+        # one table, training and serving: a read-only fabric client
+        # over the live ring — lookups never materialize rows, every
+        # batch is version-pinned and stamped with the applied
+        # training version it reflects
+        from dlrover_tpu.embedding.fabric import FabricClient
+
+        table = FabricClient(coordinator_addr=args.coordinator,
+                             dim=args.dim, mode="serve")
+        print(f"serving from live ring v{table.version} "
+              f"({table.route.members})", file=sys.stderr)
+    else:
+        table = KvEmbeddingTable(dim=args.dim, num_slots=2, seed=1234)
+        table.import_({
+            name.split("/", 1)[1]: np.asarray(arr)
+            for name, arr in arrays.items()
+            if name.startswith("embedding/")
+        })
+        print(f"restored step {step}: {len(table)} embedding rows",
+              file=sys.stderr)
 
     @jax.jit
     def forward(params, emb):
@@ -98,6 +139,12 @@ def main(argv=None) -> int:
         "table_rows": len(table),
         "restored_step": step,
     }
+    if args.coordinator:
+        info = table.last_lookup_info
+        out["ring_version"] = info.get("version")
+        out["applied_version"] = info.get("applied_version")
+        out["staleness"] = info.get("staleness")
+        table.close()
     print(json.dumps(out))
     if args.result_file:
         with open(args.result_file, "w") as f:

@@ -65,7 +65,90 @@ def parse_args(argv=None):
     p.add_argument("--table-coordinator", default="",
                    help="connect to an existing embedding coordinator "
                         "instead of spawning local shard servers")
+    p.add_argument("--fabric", type=int, default=0,
+                   help="elastic embedding fabric (DESIGN.md §25): run "
+                        "the table as a consistent-hash ring of N "
+                        "in-process shard servers with async gradient "
+                        "streaming and verified shard checkpoints")
+    p.add_argument("--fabric-coordinator", default="",
+                   help="connect to an existing fabric coordinator "
+                        "(host:port) instead of spawning a local ring")
+    p.add_argument("--sync-apply", action="store_true",
+                   help="fabric only: block every step on the sparse "
+                        "update instead of streaming it asynchronously")
+    p.add_argument("--serve-port", type=int, default=0,
+                   help="fabric only: serve the LIVE training ring "
+                        "over HTTP on this port (POST "
+                        "/v1/embedding/lookup — the train+serve-from-"
+                        "one-table path; 0 = off)")
     return p.parse_args(argv)
+
+
+def _start_fabric(args):
+    """Fabric-mode table: ring client (async apply), optional restore,
+    optional live-serving HTTP front door. Returns (client, cleanup,
+    persist_fn) — persist_fn(step) runs the drain barrier + verified
+    ring checkpoint when a checkpoint dir is configured."""
+    from dlrover_tpu.embedding.fabric import FabricClient, start_local_fabric
+
+    coord = None
+    servers: list = []
+    http = None
+    serve_client = None
+    fabric_ckpt = (os.path.join(args.ckpt_dir, "embedding-fabric")
+                   if args.ckpt_dir else "")
+    if args.fabric_coordinator:
+        coord_addr = args.fabric_coordinator
+    else:
+        coord, servers = start_local_fabric(
+            args.fabric, dim=args.dim, num_slots=2, seed=1234,
+            ckpt_dir=fabric_ckpt,
+        )
+        coord_addr = coord.addr
+    client = FabricClient(coordinator_addr=coord_addr, dim=args.dim,
+                          async_apply=not args.sync_apply)
+    restored = None
+    if coord is not None and fabric_ckpt:
+        restored = coord.restore()
+        if restored:
+            print(f"[recsys] fabric restored step {restored['step']} "
+                  f"({restored['rows']} rows from a "
+                  f"{restored['num_shards']}-shard save onto "
+                  f"{len(client.route.members)} shards)", flush=True)
+            client.resume_from(restored["applied_version"])
+    if args.serve_port:
+        from dlrover_tpu.gateway.server import GatewayHTTPServer
+
+        serve_client = FabricClient(coordinator_addr=coord_addr,
+                                    dim=args.dim, mode="serve")
+        http = GatewayHTTPServer(
+            None, host="127.0.0.1", port=args.serve_port,
+            embedding_client=serve_client,
+        ).start()
+        print(f"[recsys] live embedding lookups on port {http.port}",
+              flush=True)
+
+    def persist_fn(step: int) -> None:
+        info = client.persist(step)
+        print(f"[recsys] fabric ckpt step {step}: {info['rows']} rows "
+              f"across {info['num_shards']} shards "
+              f"(applied v{info['applied_version']})", flush=True)
+
+    def cleanup() -> None:
+        if http is not None:
+            http.stop()
+        if serve_client is not None:
+            serve_client.close()
+        client.close()
+        if coord is not None:
+            coord.stop()
+        for s in servers:
+            s.stop()
+
+    # an external coordinator owns its own checkpoint dir; a local ring
+    # persists only when --ckpt-dir gave it one
+    can_persist = bool(fabric_ckpt or args.fabric_coordinator)
+    return client, cleanup, (persist_fn if can_persist else None)
 
 
 def _spawn_sharded_table(args, ckpt_dir: str):
@@ -150,7 +233,10 @@ def main(argv=None) -> int:
     ctx = bootstrap.init_from_env()
     sharded_cleanup = None
     inc_mgr = None
-    if args.table_coordinator:
+    fabric_persist = None
+    if args.fabric or args.fabric_coordinator:
+        table, sharded_cleanup, fabric_persist = _start_fabric(args)
+    elif args.table_coordinator:
         from dlrover_tpu.embedding.service import ShardedKvClient
 
         table = ShardedKvClient(
@@ -244,7 +330,15 @@ def main(argv=None) -> int:
             losses.append(float(loss))
             print(f"[recsys] step {step} loss {losses[-1]:.4f} "
                   f"table={len(table)}", flush=True)
-            if inc_mgr is not None:
+            if fabric_persist is not None:
+                try:
+                    fabric_persist(step)
+                except (OSError, RuntimeError, TimeoutError) as e:
+                    # a failed ring save never blocks training; the
+                    # next interval (and the final save) retry it
+                    print(f"[recsys] fabric ckpt postponed: {e}",
+                          flush=True)
+            elif inc_mgr is not None:
                 try:
                     path = inc_mgr.save()
                     print(f"[recsys] incremental ckpt: "
@@ -272,7 +366,13 @@ def main(argv=None) -> int:
         from dlrover_tpu.checkpoint.engine import CheckpointEngine
 
         engine = CheckpointEngine(args.ckpt_dir, node_id=ctx.node_id)
-        state = {"dense": params, "embedding": table.export()}
+        if fabric_persist is not None:
+            # the ring checkpoints itself (drain barrier + verified
+            # shard manifest); the engine carries only the dense tower
+            fabric_persist(args.steps)
+            state = {"dense": params}
+        else:
+            state = {"dense": params, "embedding": table.export()}
         engine.save_to_storage(args.steps, state)
         waited = engine.wait_for_persist(args.steps, timeout=120)
         if not waited:
@@ -291,6 +391,8 @@ def main(argv=None) -> int:
                     "first_loss": losses[0] if losses else None,
                     "table_rows": len(table),
                     "examples_per_s": round(args.steps * args.batch / wall),
+                    **({"staleness": table.staleness()}
+                       if hasattr(table, "staleness") else {}),
                 },
                 f,
             )

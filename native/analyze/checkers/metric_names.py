@@ -84,6 +84,10 @@ DOCUMENTED_PREFIXES = (
     # plan" runbook keys on the plan/retune counters and the
     # contradiction gauges
     "dlrover_tpu_autopilot_",
+    # elastic embedding fabric (DESIGN.md §25): the "embedding
+    # staleness is climbing" runbook keys on the staleness gauge and
+    # the backpressure/apply-lag families
+    "dlrover_tpu_embedding_",
 )
 
 # label names that are themselves an operator contract (dashboards and
