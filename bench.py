@@ -1501,6 +1501,26 @@ def bench_control_plane(extra: dict,
             "delta/full runs must replay the same event trail"
         record_tier(1000, delta)
 
+        # §26 master-restart leg at 1k: the sim snapshots the live
+        # master, rebuilds it from the snapshot mid-run, and measures
+        # reconvergence — virtual seconds until every agent's
+        # epoch-fence reconcile landed, plus the re-registered curve
+        restart_profile = tier_profile(1000)
+        restart_profile.name = "cp1000_mr"
+        restart_profile.master_restarts = 1
+        mr = FleetSimulator(restart_profile).run()
+        assert mr.master_recovery_s is not None, \
+            "master restart never reconverged"
+        extra["cp_master_recovery_s_n1000"] = round(
+            mr.master_recovery_s, 3)
+        extra["cp_reregistered_nodes_n1000"] = (
+            mr.reregistered_curve[-1][1] if mr.reregistered_curve
+            else 0)
+        extra["cp_reregistered_curve_n1000"] = [
+            [dt, n] for dt, n in mr.reregistered_curve[:: max(
+                1, len(mr.reregistered_curve) // 20)]
+        ]
+
         # ~wall cost scales with nodes^2 (the O(world)-sized comm-world
         # response goes to every agent): gate the big tiers on what is
         # left of the stage budget
@@ -2535,6 +2555,7 @@ HEADLINE_KEYS = [
     "cp_master_joins_per_s_n1000", "cp_master_joins_per_s_n5000",
     "cp_snapshot_ingest_ms_n1000", "cp_join_cost_ratio",
     "cp_snapshot_wire_reduction", "cp_snapshot_ingest_reduction",
+    "cp_master_recovery_s_n1000", "cp_reregistered_nodes_n1000",
     "lc_best_speedup", "bench_total_s",
 ]
 

@@ -141,6 +141,7 @@ class ElasticAgent:
         self._preemption_watcher = None
         self._metrics_server = None
         self._world: dict[int, int] = {}
+        self._master_link = None  # agent/master_link.py, set at run()
         self._standby = None  # agent/standby.py StandbyManager
         self._node_rank = -1
         self._pending_action = ""
@@ -168,11 +169,25 @@ class ElasticAgent:
         )
         addr = f"{self._config.host_ip}:{port}"
         wait_start = time.time()
-        self._client.join_rendezvous(
-            addr=addr,
-            local_devices=self._local_devices,
-            topology_key=self._config.topology_key,
-        )
+        join_deadline = wait_start + self._config.rdzv_timeout_s
+        while True:
+            try:
+                self._client.join_rendezvous(
+                    addr=addr,
+                    local_devices=self._local_devices,
+                    topology_key=self._config.topology_key,
+                )
+                break
+            except (ConnectionError, TimeoutError, OSError) as e:
+                # a master mid-restart must delay the rendezvous, not
+                # kill the agent (§26): re-resolve from the port file
+                # and retry inside the rendezvous budget
+                if time.time() >= join_deadline:
+                    raise
+                logger.warning("rendezvous join failed (%s); "
+                               "re-dialing the master", e)
+                self._client.maybe_redial()
+                time.sleep(0.5)
         world = self._client.wait_comm_world(
             timeout=self._config.rdzv_timeout_s
         )
@@ -579,9 +594,9 @@ class ElasticAgent:
             # persist the snapshot first: the replacement host restores
             # from storage, not from this host's shm
             self._persist_checkpoint(reason="node relaunch")
-            self._client.report_node_event(
-                NodeEventType.MODIFIED, NodeStatus.FAILED.value,
-                reason, f"exit code {exit_code}",
+            self._report_terminal(
+                NodeStatus.FAILED.value, reason,
+                f"exit code {exit_code}",
             )
             return RunResult.NODE_RELAUNCH
         if action == FailureAction.GIVE_UP:
@@ -589,13 +604,16 @@ class ElasticAgent:
                 "no failovers remain (%d used); job failed",
                 self._restart_count,
             )
-            self._client.report_node_event(
-                NodeEventType.MODIFIED, NodeStatus.FAILED.value,
-                NodeExitReason.FATAL_ERROR, f"exit code {exit_code}",
+            self._report_terminal(
+                NodeStatus.FAILED.value, NodeExitReason.FATAL_ERROR,
+                f"exit code {exit_code}",
             )
-            self._client.report_job_exit(
-                success=False, reason=f"exit code {exit_code}"
-            )
+            try:
+                self._client.report_job_exit(
+                    success=False, reason=f"exit code {exit_code}"
+                )
+            except (ConnectionError, TimeoutError, OSError) as e:
+                logger.warning("job-exit report failed: %s", e)
             return RunResult.FAILED
         _restarts_total.labels("failure").inc()
         with get_journal().span(
@@ -612,6 +630,18 @@ class ElasticAgent:
             rank, num_nodes, coordinator = self._rendezvous()
             self._proc = self._respawn(rank, num_nodes, coordinator)
         return None
+
+    def _report_terminal(self, status: str, exit_reason, message: str
+                         ) -> None:
+        """Terminal node-status reports must not crash the ladder when
+        the master is mid-restart (§26): the outcome is also visible
+        through the launcher exit code either way."""
+        try:
+            self._client.report_node_event(
+                NodeEventType.MODIFIED, status, exit_reason, message
+            )
+        except (ConnectionError, TimeoutError, OSError) as e:
+            logger.warning("terminal node event report failed: %s", e)
 
     def _restart_workers(self, reason: str) -> None:
         """Planned restart (membership change / config update): bumps the
@@ -678,6 +708,16 @@ class ElasticAgent:
     # ------------------------------------------------------------- services
 
     def _start_heartbeat(self) -> None:
+        from dlrover_tpu.agent.master_link import MasterLink
+
+        # degraded-mode link (DESIGN.md §26): a master outage is ONE
+        # journal instant + a counter (rate-limited warnings), the
+        # trainer keeps stepping, and every failed tick re-resolves
+        # the master address from the port file so a restarted master
+        # is picked up within one heartbeat
+        link = MasterLink(self._client, component="agent")
+        self._master_link = link
+
         def loop():
             while not self._stopped.is_set():
                 try:
@@ -691,8 +731,9 @@ class ElasticAgent:
                     # heartbeat cadence so the master's exposition
                     # endpoint serves job-wide series
                     self._client.report_metrics(registry().snapshot())
-                except (ConnectionError, RuntimeError, OSError):
-                    logger.warning("heartbeat failed: master unreachable")
+                    link.ok()
+                except (ConnectionError, RuntimeError, OSError) as e:
+                    link.failed(e)
                 self._stopped.wait(self._config.heartbeat_interval_s)
 
         threading.Thread(target=loop, name="agent-heartbeat",

@@ -10,19 +10,48 @@ from __future__ import annotations
 import os
 import threading
 import time
+import uuid
+from collections import deque
 from typing import Optional
 
+from dlrover_tpu.common import envspec
 from dlrover_tpu.common import messages as m
 from dlrover_tpu.common.constants import (
     EnvKey,
     NodeEventType,
     NodeExitReason,
+    NodeStatus,
     TrainingExceptionLevel,
 )
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.common.rpc import RpcClient
 
 logger = get_logger(__name__)
+
+_reconcile_total = None
+_redelivery_total = None
+
+
+def _failover_metrics():
+    """Lazy registration keeps this module import-light (it is pulled
+    in by trainer children before jax init)."""
+    global _reconcile_total, _redelivery_total
+    if _reconcile_total is None:
+        from dlrover_tpu.telemetry.metrics import registry
+
+        _reconcile_total = registry().counter(
+            "dlrover_tpu_agent_reconcile_total",
+            "epoch-fence reconciles run after observing a master "
+            "restart (re-register + full metrics push + redelivery "
+            "replay)",
+        )
+        _redelivery_total = registry().counter(
+            "dlrover_tpu_agent_redelivery_total",
+            "redelivery-queue traffic for unacked one-way reports, by "
+            "outcome",
+            label_names=("outcome",),
+        )
+    return _reconcile_total, _redelivery_total
 
 
 class MasterClient:
@@ -41,6 +70,166 @@ class MasterClient:
         # role per process: heartbeat thread, trainer cadence, gateway)
         self._snapshot_full_every = snapshot_full_every
         self._delta_trackers: dict[str, "SnapshotDeltaTracker"] = {}
+        # epoch fence (DESIGN.md §26): last master epoch observed on a
+        # response (field or transport envelope); an INCREASE triggers
+        # the reconcile, a decrease is a stale master and is ignored
+        self._epoch_lock = threading.Lock()
+        self._master_epoch = 0
+        self._reconciling = False
+        # bounded redelivery queue of unacked one-way reports
+        # (PersistAckReport/FailureReport), replayed on reconnect with
+        # their original rids — the master dedups, so replay can never
+        # double-count
+        self._redelivery: deque = deque()
+        self._redelivery_bound = int(
+            envspec.get_int(EnvKey.REDELIVERY_QUEUE, 64) or 64
+        )
+        self._wire_epoch_hook(self._client)
+
+    def _wire_epoch_hook(self, transport) -> None:
+        # RpcClient forwards the response-envelope epoch; other
+        # transports (fleetsim loopback) fence via the explicit
+        # HeartbeatResponse/CommWorldResponse fields instead
+        if hasattr(transport, "on_epoch"):
+            transport.on_epoch = self._observe_epoch
+
+    # ------------------------------------------------------- epoch fence
+
+    @property
+    def master_epoch(self) -> int:
+        with self._epoch_lock:
+            return self._master_epoch
+
+    def _observe_epoch(self, epoch: int) -> None:
+        if epoch <= 0:
+            return
+        with self._epoch_lock:
+            prev = self._master_epoch
+            if epoch <= prev:
+                return  # unchanged, or a stale/zombie master: fenced
+            self._master_epoch = epoch
+            first = prev == 0
+            if self._reconciling:
+                return
+            self._reconciling = True
+        if first:
+            # first contact with any master: adopt, nothing to repair
+            with self._epoch_lock:
+                self._reconciling = False
+            return
+        try:
+            self._reconcile(prev, epoch)
+        finally:
+            with self._epoch_lock:
+                self._reconciling = False
+
+    def _reconcile(self, old_epoch: int, new_epoch: int) -> None:
+        """The epoch-fence reconcile: the master restarted between our
+        last two RPCs. Re-register this node, force the next metrics
+        push to a full snapshot (the restarted master's delta base is
+        empty), and replay any unacked reports (rid-idempotent on the
+        master's side)."""
+        from dlrover_tpu.telemetry.journal import get_journal
+
+        reconciles, _ = _failover_metrics()
+        reconciles.inc()
+        get_journal().emit(
+            "agent_reconcile", node=self.node_id,
+            old_epoch=old_epoch, new_epoch=new_epoch,
+            queued=len(self._redelivery),
+        )
+        logger.warning(
+            "master epoch changed %d -> %d (master restarted): "
+            "reconciling (%d queued reports to replay)",
+            old_epoch, new_epoch, len(self._redelivery),
+        )
+        try:
+            self.report_node_event(
+                NodeEventType.MODIFIED, NodeStatus.RUNNING.value
+            )
+        except (ConnectionError, TimeoutError, OSError) as e:
+            logger.warning("reconcile re-register failed: %s", e)
+        for tracker in self._delta_trackers.values():
+            tracker.force_full()
+        self.flush_redelivery()
+
+    # --------------------------------------------------- redelivery queue
+
+    def _send_or_queue(self, msg) -> bool:
+        """Send a one-way report; on transport failure try one re-dial
+        (the master may have restarted on a new port) and otherwise
+        queue the message — same rid — for replay on reconnect."""
+        try:
+            self._client.call(msg)
+            return True
+        except (ConnectionError, TimeoutError, OSError) as first:
+            if self.maybe_redial():
+                try:
+                    self._client.call(msg)
+                    return True
+                except (ConnectionError, TimeoutError, OSError):
+                    pass
+            _, redelivery = _failover_metrics()
+            self._redelivery.append(msg)
+            redelivery.labels("queued").inc()
+            while len(self._redelivery) > self._redelivery_bound:
+                self._redelivery.popleft()
+                redelivery.labels("dropped").inc()
+            logger.warning(
+                "%s queued for redelivery (master unreachable: %s; "
+                "%d queued)", type(msg).__name__, first,
+                len(self._redelivery),
+            )
+            return False
+
+    def flush_redelivery(self) -> int:
+        """Replay queued reports in order; stops at the first transport
+        failure (they stay queued). Returns how many were delivered."""
+        _, redelivery = _failover_metrics()
+        sent = 0
+        while self._redelivery:
+            msg = self._redelivery[0]
+            try:
+                self._client.call(msg)
+            except (ConnectionError, TimeoutError, OSError):
+                break
+            self._redelivery.popleft()
+            redelivery.labels("replayed").inc()
+            sent += 1
+        return sent
+
+    @property
+    def redelivery_pending(self) -> int:
+        return len(self._redelivery)
+
+    # ------------------------------------------------------------ re-dial
+
+    def maybe_redial(self) -> bool:
+        """Re-resolve the master address from the atomic port file
+        (DLROVER_TPU_MASTER_PORT_FILE) — a restarted master binds a
+        fresh port and republishes it there. Returns True when the
+        client moved to a new address."""
+        path = envspec.get(EnvKey.MASTER_PORT_FILE)
+        if not path or not isinstance(self._client, RpcClient):
+            return False
+        try:
+            with open(path) as f:
+                text = f.read().strip()
+            port = int(text)
+        except (OSError, ValueError):
+            return False
+        host = self._client.addr.rsplit(":", 1)[0]
+        new_addr = f"{host}:{port}"
+        if new_addr == self._client.addr:
+            return False
+        old = self._client
+        fresh = old.clone(new_addr)
+        self._wire_epoch_hook(fresh)
+        self._client = fresh
+        old.close()
+        logger.info("re-dialed master at %s (was %s)", new_addr,
+                    old.addr)
+        return True
 
     # ------------------------------------------------------------- singleton
 
@@ -83,21 +272,35 @@ class MasterClient:
 
     def get_comm_world(self, rdzv_name: str = "training"
                        ) -> m.CommWorldResponse:
-        return self._client.call(
+        resp = self._client.call(
             m.CommWorldRequest(node_id=self.node_id, rdzv_name=rdzv_name)
         )
+        self._observe_epoch(int(getattr(resp, "master_epoch", 0) or 0))
+        return resp
 
     def wait_comm_world(self, rdzv_name: str = "training",
                         timeout: float = 600.0,
                         poll_interval: float = 0.2) -> m.CommWorldResponse:
+        """Polls through a master outage: transport errors re-resolve
+        the master address from the port file and keep polling until
+        the rendezvous timeout — a master restart mid-rendezvous is a
+        delay, not an agent crash (DESIGN.md §26)."""
         deadline = time.time() + timeout
+        last_err: Exception | None = None
         while time.time() < deadline:
-            resp = self.get_comm_world(rdzv_name)
+            try:
+                resp = self.get_comm_world(rdzv_name)
+            except (ConnectionError, TimeoutError, OSError) as e:
+                last_err = e
+                self.maybe_redial()
+                time.sleep(poll_interval)
+                continue
             if resp.completed:
                 return resp
             time.sleep(poll_interval)
         raise TimeoutError(
             f"rendezvous {rdzv_name!r} did not complete in {timeout}s"
+            + (f" (last master error: {last_err})" if last_err else "")
         )
 
     def num_nodes_waiting(self, rdzv_name: str = "training") -> int:
@@ -195,13 +398,17 @@ class MasterClient:
         overrides the manifest key for non-host writers (the embedding
         fabric acks ``emb-<i>`` shard servers under ``group=
         "embedding"`` so its ledger entries can never complete a dense
-        commit of the same step/world, §25)."""
-        self._client.call(
+        commit of the same step/world, §25).
+
+        Transport failures never raise: the ack is queued (with its
+        rid) for replay on reconnect — the rank-0 committer's storage
+        done-marker scan covers the gap meanwhile (§26)."""
+        self._send_or_queue(
             m.PersistAckReport(
                 node_id=(self.node_id if writer_id is None
                          else writer_id),
                 step=step, num_shards=num_shards, shard=shard,
-                group=group,
+                group=group, rid=uuid.uuid4().hex,
             )
         )
 
@@ -239,6 +446,11 @@ class MasterClient:
             m.NodeHeartbeat(node_id=self.node_id,
                             restart_count=restart_count)
         )
+        self._observe_epoch(int(getattr(resp, "master_epoch", 0) or 0))
+        if self._redelivery:
+            # the master is reachable again (maybe it never died, just
+            # a partition): drain whatever queued meanwhile
+            self.flush_redelivery()
         return resp.action
 
     def report_node_event(
@@ -258,10 +470,15 @@ class MasterClient:
     def report_failure(self, error_data: str, restart_count: int = 0,
                        level: TrainingExceptionLevel =
                        TrainingExceptionLevel.PROCESS_ERROR) -> None:
-        self._client.call(
+        """Transport failures never raise: a failure report during a
+        master outage is queued for rid-deduped replay — the agent's
+        restart ladder must keep moving while the master is down
+        (§26)."""
+        self._send_or_queue(
             m.FailureReport(
                 node_id=self.node_id, restart_count=restart_count,
                 level=level, error_data=error_data,
+                rid=uuid.uuid4().hex,
             )
         )
 

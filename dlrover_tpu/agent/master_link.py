@@ -1,0 +1,123 @@
+"""Shared agent <-> master degraded-mode link (DESIGN.md §26).
+
+This generalizes the pattern the serving gateway pioneered
+(``gateway/control.py``): a component that talks to the master keeps
+doing its real job through a master outage, and the outage itself is
+ONE observable transition — a ``degraded_mode`` journal instant on
+enter/exit, the ``dlrover_tpu_agent_degraded{component}`` gauge for
+alerting, and the ``dlrover_tpu_agent_master_unreachable_total``
+counter for rate — instead of a per-tick log line ("heartbeat failed:
+master unreachable" × every 15 s × every node was the pre-§26 state).
+
+Every failed tick also attempts a re-dial: a restarted master binds a
+fresh port and republishes it in the atomic port file
+(``DLROVER_TPU_MASTER_PORT_FILE``), so the link is what moves an
+agent's client onto the new incarnation; the epoch fence on the first
+successful RPC then runs the client's reconcile.
+
+Users: the elastic agent's heartbeat loop (``component="agent"``), the
+gateway control link (``gateway/control.py``, with its legacy unlabeled
+gauge), and the embedding fabric coordinator's persist-ledger path
+(``component="embedding"``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from dlrover_tpu.common import envspec
+from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.metrics import registry
+
+logger = get_logger(__name__)
+
+_degraded_gauge = registry().gauge(
+    "dlrover_tpu_agent_degraded",
+    "1 while this component runs without a reachable master, by "
+    "component (training keeps stepping; control actions queue)",
+    label_names=("component",),
+)
+_unreachable_total = registry().counter(
+    "dlrover_tpu_agent_master_unreachable_total",
+    "master-unreachable ticks observed by degraded links, by component",
+    label_names=("component",),
+)
+
+
+class MasterLink:
+    """Degraded-mode state machine around a master client.
+
+    ``client`` needs nothing beyond being the object whose calls the
+    owner guards; when it exposes ``maybe_redial()`` (MasterClient),
+    failed ticks re-resolve the master address from the port file.
+    ``gauge`` overrides the labeled default (the gateway keeps its
+    documented unlabeled ``dlrover_tpu_gateway_degraded``).
+    """
+
+    def __init__(self, client, *, component: str = "agent",
+                 gauge=None, warn_every_s: float | None = None):
+        self._client = client
+        self.component = component
+        self._gauge = gauge if gauge is not None \
+            else _degraded_gauge.labels(component)
+        if warn_every_s is None:
+            warn_every_s = envspec.get_float(EnvKey.DEGRADED_WARN_S,
+                                             30.0) or 30.0
+        self._warn_every_s = warn_every_s
+        self._lock = threading.Lock()
+        self._degraded = False
+        self._last_warn = 0.0
+        self._gauge.set(0)
+
+    @property
+    def degraded(self) -> bool:
+        with self._lock:
+            return self._degraded
+
+    # ------------------------------------------------------------- ticks
+
+    def ok(self) -> None:
+        """A master call succeeded: leave degraded mode (one journal
+        instant; control actions simply resume)."""
+        with self._lock:
+            if not self._degraded:
+                return
+            self._degraded = False
+        self._gauge.set(0)
+        get_journal().emit("degraded_mode", state="exit",
+                           component=self.component)
+        logger.info("master reachable again; %s left degraded mode",
+                    self.component)
+
+    def failed(self, err: Exception) -> None:
+        """A master call failed: count it, enter degraded mode on the
+        first failure (one journal instant), rate-limit the repeats,
+        and try to re-resolve the master address from the port file."""
+        _unreachable_total.labels(self.component).inc()
+        now = time.monotonic()
+        with self._lock:
+            entered = not self._degraded
+            self._degraded = True
+            warn = entered or now - self._last_warn >= self._warn_every_s
+            if warn:
+                self._last_warn = now
+        if entered:
+            self._gauge.set(1)
+            get_journal().emit("degraded_mode", state="enter",
+                               component=self.component,
+                               error=str(err)[:200])
+        if warn:
+            logger.warning(
+                "master unreachable (%s); %s running degraded "
+                "(repeats suppressed for %.0fs)", err, self.component,
+                self._warn_every_s,
+            )
+        redial = getattr(self._client, "maybe_redial", None)
+        if redial is not None:
+            try:
+                redial()
+            except Exception:  # noqa: BLE001 - re-dial is best-effort
+                logger.exception("master re-dial failed")

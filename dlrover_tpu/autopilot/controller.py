@@ -207,6 +207,39 @@ class AutopilotController:
             len(alternatives), self._retunes_used, self.max_retunes,
         )
 
+    def export_state(self) -> dict:
+        """Armed plan + retune budget for the master state snapshot
+        (DESIGN.md §26). The contradiction streak/window deliberately
+        stay out: post-restart metrics deltas re-baseline anyway, and a
+        retune must be re-earned by fresh evidence — but the BUDGET
+        already charged must survive, or a crash-restart would re-grant
+        spent retunes (the double-retune hazard)."""
+        with self._lock:
+            return {
+                "plan": self._plan.to_json() if self._plan else "",
+                "alternatives": [p.to_json()
+                                 for p in self._alternatives],
+                "retunes_used": self._retunes_used,
+            }
+
+    def restore_state(self, state: dict) -> None:
+        with self._lock:
+            self._retunes_used = max(
+                self._retunes_used, int(state.get("retunes_used", 0))
+            )
+        plan_json = state.get("plan", "")
+        if not plan_json:
+            return
+        try:
+            plan = Plan.from_json(plan_json)
+            alternatives = [Plan.from_json(a)
+                            for a in state.get("alternatives", ())]
+        except (ValueError, TypeError, KeyError):
+            logger.warning("unparseable autopilot snapshot state; "
+                           "controller stays unarmed", exc_info=True)
+            return
+        self.arm(plan, alternatives)
+
     @property
     def armed(self) -> bool:
         with self._lock:

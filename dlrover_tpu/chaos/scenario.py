@@ -758,6 +758,404 @@ def run_embedding_scenario(work_dir: str, *, seed: int = 4242,
     )
 
 
+def master_kill_trail(journal_dir: str) -> dict:
+    """Canonical, replay-comparable trail of a master-kill scenario
+    (DESIGN.md §26): master restarts (epoch sequence), agent epoch-fence
+    reconciles, rendezvous rounds, autopilot retunes and snapshot
+    rollbacks — occurrence-indexed and sorted like the chaos fault
+    trail, so two seeded runs compare verbatim."""
+    entries: list[list[Any]] = []
+    for e in _read_journal(journal_dir):
+        name = e.get("name")
+        if name == "master_restore":
+            entries.append(["master_restore", e.get("epoch", -1),
+                            e.get("version", 0),
+                            e.get("components", "")])
+        elif name == "agent_reconcile":
+            entries.append(["agent_reconcile", e.get("node", -1),
+                            e.get("old_epoch", 0), e.get("new_epoch", 0)])
+        elif name == "rdzv_round":
+            entries.append(["rdzv_round", e.get("round", 0),
+                            e.get("nodes", 0), bool(e.get("fast")),
+                            bool(e.get("reshard"))])
+        elif name == "autopilot_retune":
+            entries.append(["autopilot_retune", e.get("from_plan", ""),
+                            e.get("to_plan", ""), e.get("path", "")])
+        elif name in ("state_rollback", "state_legacy_snapshot"):
+            entries.append([name])
+        elif name == "degraded_mode":
+            entries.append(["degraded_mode", e.get("component", ""),
+                            e.get("state", "")])
+    counts: dict[str, int] = {}
+    indexed: list[list[Any]] = []
+    for entry in entries:
+        key = json.dumps(entry)
+        k = counts.get(key, 0)
+        counts[key] = k + 1
+        indexed.append(entry + [k])
+    return {"events": sorted(indexed, key=json.dumps)}
+
+
+@dataclasses.dataclass
+class MasterKillScenarioResult:
+    """What survived four SIGKILLs of the master (§26 acceptance)."""
+
+    epochs: list[int]              # epoch of each restarted master
+    round_after_restart: int       # rendezvous round completed on M2
+    commit_step: int | None        # newest verified step post-commit
+    commit_writers: list[str]      # writers in the commit_w<W> manifest
+    dense_writers: list[str]       # dense ledger writers (group "")
+    embedding_writers: list[str]   # embedding ledger writers
+    compile_cache_warm: bool       # CompileCacheGet hit after restart
+    retune_events: int             # autopilot_retune journal lines
+    retunes_used_final: int        # budget charged per the final state
+    restart_actions: int           # "restart" actions agents received
+    trail: dict
+
+    def assert_invariants(self) -> None:
+        assert self.epochs == [2, 3, 4, 5], (
+            f"master epochs not monotonic across restarts: {self.epochs}"
+        )
+        assert self.round_after_restart == 2, (
+            "the mid-rendezvous restart did not continue the round "
+            f"sequence (round {self.round_after_restart})"
+        )
+        assert self.commit_step == 4, (
+            f"the in-flight step never committed (verified step "
+            f"{self.commit_step})"
+        )
+        assert sorted(self.commit_writers) == ["0", "1"], (
+            f"commit manifest incomplete: {self.commit_writers}"
+        )
+        assert sorted(self.dense_writers) == ["0", "1"] \
+            and self.embedding_writers == ["emb-0"], (
+            "restored ledger mixed the dense and embedding groups: "
+            f"dense={self.dense_writers} emb={self.embedding_writers}"
+        )
+        assert self.compile_cache_warm, \
+            "restarted master answered CompileCacheGet cold"
+        assert self.retune_events == 1 and self.retunes_used_final == 1, (
+            f"retune budget double-charged or phantom retune: "
+            f"{self.retune_events} events, {self.retunes_used_final} used"
+        )
+        assert self.restart_actions == 0, (
+            f"trainers were asked to restart {self.restart_actions} "
+            "times during master failover"
+        )
+
+
+def run_master_kill_scenario(work_dir: str, *, seed: int = 4242
+                             ) -> MasterKillScenarioResult:
+    """SIGKILL a REAL master subprocess at three in-flight points —
+    mid-rendezvous, mid-commit-wait, mid-autopilot-streak (plus once
+    more post-retune to pin the budget) — and drive typed
+    ``MasterClient`` agents through the §26 failover machinery: port
+    re-resolve from the atomic port file, epoch-fence reconcile,
+    redelivery replay, restored ack ledger/rendezvous/autopilot state.
+    The kill points are state-based (the snapshot provably contains the
+    in-flight mutation before the SIGKILL lands), so the trail is
+    replay-identical across runs of the same seed."""
+    import zlib
+
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.autopilot.planner import Plan
+    from dlrover_tpu.checkpoint import integrity
+    from dlrover_tpu.checkpoint.integrity import resolve_restore_step
+    from dlrover_tpu.common.rpc import RpcClient
+    from dlrover_tpu.common.storage import PosixDiskStorage
+
+    os.makedirs(work_dir, exist_ok=True)
+    state_dir = os.path.join(work_dir, "state")
+    journal_dir = os.path.join(work_dir, "journal")
+    ckpt_dir = os.path.join(work_dir, "ckpt")
+    port_file = os.path.join(work_dir, "master.port")
+    log_path = os.path.join(work_dir, "master.log")
+    os.makedirs(state_dir, exist_ok=True)
+
+    env = dict(os.environ)
+    env.update({
+        EnvKey.JOURNAL_DIR: journal_dir,
+        EnvKey.TRACE_ID: f"mk{seed}",
+        # budget 1 makes "not double-charged" sharp: one retune total,
+        # across however many master incarnations
+        EnvKey.AUTOPILOT_MAX_RETUNES: "1",
+        "PYTHONPATH": env.get("PYTHONPATH", "") + os.pathsep + REPO,
+    })
+    prev_env = {
+        k: os.environ.get(k)
+        for k in (EnvKey.MASTER_PORT_FILE, EnvKey.JOURNAL_DIR)
+    }
+    os.environ[EnvKey.MASTER_PORT_FILE] = port_file
+    os.environ[EnvKey.JOURNAL_DIR] = journal_dir
+
+    log = open(log_path, "ab")
+    procs: list[subprocess.Popen] = []
+
+    def spawn_master(prev_port: str) -> str:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "dlrover_tpu.master.job_master",
+             "--job-name", "mk", "--min-nodes", "2", "--max-nodes", "2",
+             "--rdzv-timeout", "60", "--state-dir", state_dir,
+             "--port-file", port_file],
+            env=env, cwd=REPO, stdout=log, stderr=log,
+        )
+        procs.append(proc)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"master exited early rc={proc.returncode}"
+                )
+            try:
+                with open(port_file) as f:
+                    text = f.read().strip()
+                if text and text != prev_port:
+                    return text
+            except OSError:
+                pass
+            time.sleep(0.05)
+        raise TimeoutError("master never published its port")
+
+    def sigkill_master() -> None:
+        proc = procs[-1]
+        os.kill(proc.pid, 9)
+        proc.wait(timeout=10)
+
+    def read_state() -> dict:
+        try:
+            with open(os.path.join(state_dir, "mk.state.json")) as f:
+                wrapped = json.load(f)
+            return json.loads(wrapped["body"])
+        except (OSError, ValueError, KeyError):
+            return {}
+
+    def wait_state(pred, what: str, timeout: float = 15.0) -> dict:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            state = read_state()
+            if state and pred(state):
+                return state
+            time.sleep(0.05)
+        raise TimeoutError(f"master snapshot never showed: {what}")
+
+    actions: list[str] = []
+
+    def reconnect(agent: MasterClient, timeout: float = 20.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            agent.maybe_redial()
+            try:
+                actions.append(agent.report_heartbeat(0))
+                return
+            except (ConnectionError, TimeoutError, OSError):
+                time.sleep(0.1)
+        raise TimeoutError("agent could not reconnect to the master")
+
+    def trainer_push(agent: MasterClient, cum: list[float]) -> None:
+        # one trainer-role snapshot whose step-histogram delta reads as
+        # 1.0 s/step — 10x the armed plan's 0.1 s prediction
+        cum[0] += 1.0
+        cum[1] += 1
+        agent.report_metrics([{
+            "name": "dlrover_tpu_train_step_seconds",
+            "type": "histogram", "help": "", "buckets": [],
+            "samples": [{"labels": {}, "buckets": [],
+                         "sum": cum[0], "count": int(cum[1])}],
+        }], role="trainer")
+
+    a0 = a1 = None
+    try:
+        port = spawn_master("")
+        addr = f"127.0.0.1:{port}"
+
+        def make_agent(nid: int) -> MasterClient:
+            return MasterClient(
+                addr, nid,
+                transport=RpcClient(addr, retries=2, deadline_s=4.0,
+                                    backoff_base_s=0.05,
+                                    backoff_max_s=0.2),
+            )
+
+        a0, a1 = make_agent(0), make_agent(1)
+        a0.join_rendezvous("127.0.0.1:7770", 4)
+        a1.join_rendezvous("127.0.0.1:7771", 4)
+        assert a0.wait_comm_world(timeout=30).round == 1
+        actions.append(a0.report_heartbeat(0))
+        actions.append(a1.report_heartbeat(0))
+        # the artifact a restarted master must keep serving warm
+        blob = (b"mkblob" * 11)[: 64]
+        a0.compile_cache_put(f"n2t8/mk{seed % 100:02d}", blob,
+                             {"seed": seed})
+
+        # ---- kill 1: mid-rendezvous (a respawned node has re-joined,
+        # its peer has not) -------------------------------------------
+        a0.join_rendezvous("127.0.0.1:7770", 4)
+
+        def _mid_rendezvous(s: dict) -> bool:
+            # the kill must land with the FULL in-flight picture
+            # durable: round 1 completed, node 0 re-joined (round
+            # invalidated), and the compile-cache artifact spilled —
+            # an earlier snapshot (round 0's join) also shows node 0
+            # waiting and would make the trail non-deterministic
+            rdzv = s.get("rendezvous", {}).get("training", {})
+            return (
+                int(rdzv.get("round", 0)) == 1
+                and [int(w.get("node_id", -1))
+                     for w in rdzv.get("waiting", ())] == [0]
+                and bool(s.get("compile_cache"))
+            )
+
+        wait_state(_mid_rendezvous, "round 1 + node 0 re-joined + "
+                                    "spilled compile cache")
+        sigkill_master()
+        spawn_master(port)
+        reconnect(a1)
+        a1.join_rendezvous("127.0.0.1:7771", 4)
+        w0 = a0.wait_comm_world(timeout=30)
+        w1 = a1.wait_comm_world(timeout=30)
+        assert w0.round == w1.round, "agents disagree on the round"
+        round_after_restart = w0.round
+        epochs = [a0.master_epoch]
+        warm = a0.compile_cache_get(f"n2t8/mk{seed % 100:02d}")
+        compile_cache_warm = warm is not None and warm[0] == blob
+        port = open(port_file).read().strip()
+
+        # ---- kill 2: mid-commit-wait (one dense writer + the
+        # embedding fabric have acked; the other dense writer has not) -
+        sdir = os.path.join(ckpt_dir, "step-4")
+        entries: dict[str, dict] = {}
+        for nid in (0, 1):
+            payload = bytes([seed % 256, nid]) * 64
+            atomic_write_file(payload,
+                              os.path.join(sdir, f"node_{nid}.bin"))
+            atomic_write_file(json.dumps({"metas": {}}),
+                              os.path.join(sdir,
+                                           f"node_{nid}.meta.json"))
+            entries[str(nid)] = {
+                "crc32": zlib.crc32(payload) & 0xFFFFFFFF,
+                "bytes": len(payload), "pieces": {},
+            }
+        a0.report_persist_ack(4, 1, {"crc32": 1, "bytes": 8},
+                              writer_id="emb-0", group="embedding")
+        a1.report_persist_ack(4, 2, entries["1"])
+        wait_state(
+            lambda s: {
+                (e["group"], w)
+                for e in s.get("persist_acks", {}).get("acks", ())
+                for w in e.get("shards", {})
+            } >= {("embedding", "emb-0"), ("", "1")},
+            "embedding + dense acks in the ledger",
+        )
+        sigkill_master()
+        spawn_master(port)
+        reconnect(a0)
+        reconnect(a1)
+        a0.report_persist_ack(4, 2, entries["0"])
+        dense = a0.persist_status(4, 2)
+        emb = a1.persist_status(4, 1, group="embedding")
+        dense_writers = sorted(dense.shards)
+        embedding_writers = sorted(emb.shards)
+        commit_step = None
+        commit_writers: list[str] = []
+        if dense.complete:
+            # rank-0's commit wait completes against the RESTORED
+            # ledger: the terminal manifest lands, the tracker moves
+            storage = PosixDiskStorage()
+            integrity.write_commit(storage, sdir, 4, 2,
+                                   dict(dense.shards))
+            storage.write(json.dumps({"step": 4, "num_shards": 2}),
+                          os.path.join(ckpt_dir, "latest"))
+            got = resolve_restore_step(storage, ckpt_dir)
+            if got is not None:
+                commit_step = got[0]
+            with open(os.path.join(sdir, "commit_w2")) as f:
+                commit_writers = sorted(
+                    json.load(f).get("shards", {}))
+        epochs.append(a0.master_epoch)
+        port = open(port_file).read().strip()
+
+        # ---- kill 3: mid-autopilot-streak (armed plan + a building
+        # contradiction streak, retune not yet fired) ------------------
+        plan = Plan(name="mk-a", schedule="spmd",
+                    mesh_axes={"data": 1}, pred_step_s=0.1,
+                    source="history", fingerprint="mk-a", n_devices=1)
+        alt = Plan(name="mk-b", schedule="spmd",
+                   mesh_axes={"data": 1}, pred_step_s=0.1,
+                   source="history", fingerprint="mk-b", n_devices=1,
+                   rank=1)
+        a0.report_autopilot_plan(plan.to_json(), [alt.to_json()],
+                                 step_batch=8)
+        cum = [0.0, 0.0]
+        for _ in range(4):      # streak 2 of the 3 needed: mid-flight
+            trainer_push(a0, cum)
+        wait_state(lambda s: s.get("autopilot", {}).get("plan"),
+                   "armed autopilot plan")
+        sigkill_master()
+        spawn_master(port)
+        reconnect(a0)
+        for _ in range(5):      # re-earn the contradiction: ONE retune
+            trainer_push(a0, cum)
+        cfg = a0.get_paral_config()
+        assert cfg.autopilot_plan, "retune never reached paral config"
+        for _ in range(4):      # budget spent: must NOT retune again
+            trainer_push(a0, cum)
+        state = wait_state(
+            lambda s: s.get("autopilot", {}).get("retunes_used", 0) >= 1,
+            "charged retune budget",
+        )
+        epochs.append(a0.master_epoch)
+        port = open(port_file).read().strip()
+
+        # ---- kill 4: post-retune — the restored budget must read as
+        # SPENT (no phantom second retune) -----------------------------
+        sigkill_master()
+        spawn_master(port)
+        reconnect(a0)
+        for _ in range(5):
+            trainer_push(a0, cum)
+        state = wait_state(
+            lambda s: s.get("autopilot", {}).get("retunes_used", 0) >= 1,
+            "retune budget restored as spent",
+        )
+        retunes_used_final = int(
+            state.get("autopilot", {}).get("retunes_used", 0))
+        epochs.append(a0.master_epoch)
+    finally:
+        for proc in procs:
+            try:
+                proc.kill()
+                proc.wait(timeout=5)
+            except (ProcessLookupError, subprocess.TimeoutExpired):
+                pass
+        for agent in (a0, a1):
+            if agent is not None:
+                agent.close()
+        log.close()
+        for key, value in prev_env.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+
+    retune_events = sum(
+        1 for e in _read_journal(journal_dir)
+        if e.get("name") == "autopilot_retune"
+    )
+    return MasterKillScenarioResult(
+        epochs=epochs,
+        round_after_restart=round_after_restart,
+        commit_step=commit_step,
+        commit_writers=commit_writers,
+        dense_writers=dense_writers,
+        embedding_writers=embedding_writers,
+        compile_cache_warm=compile_cache_warm,
+        retune_events=retune_events,
+        retunes_used_final=retunes_used_final,
+        restart_actions=sum(1 for a in actions if a == "restart"),
+        trail=master_kill_trail(journal_dir),
+    )
+
+
 def _read_moved(journal_dir: str, version: int) -> int:
     """Moved-row count of the ``embedding_scale`` event that committed
     ``version`` (the journal is the scale's evidence of record)."""

@@ -132,6 +132,40 @@ class IntervalTuner:
         return new if old is None else (1 - self._ewma) * old \
             + self._ewma * new
 
+    # -------------------------------------------- crash-failover state (§26)
+
+    def export_state(self) -> dict:
+        """MTBF window + blended costs for the master snapshot. Failure
+        times are exported as AGES (now - t): the clock is monotonic
+        and resets across a process restart, so absolute values would
+        be meaningless in the restoring process."""
+        with self._lock:
+            now = self._clock()
+            return {
+                "failure_ages": [round(now - t, 3)
+                                 for t in self._failures],
+                "snap_cost_s": self._snap_cost_s,
+                "step_s": self._step_s,
+                "current": self._current,
+                "retunes": self._retunes,
+            }
+
+    def restore_state(self, state: dict) -> None:
+        with self._lock:
+            now = self._clock()
+            ages = sorted(
+                (float(a) for a in state.get("failure_ages", ())),
+                reverse=True,
+            )
+            self._failures.clear()
+            self._failures.extend(now - a for a in ages)
+            if state.get("snap_cost_s") is not None:
+                self._snap_cost_s = float(state["snap_cost_s"])
+            if state.get("step_s") is not None:
+                self._step_s = float(state["step_s"])
+            self._current = int(state.get("current", self._current))
+            self._retunes = int(state.get("retunes", self._retunes))
+
     # ------------------------------------------------------------- tuning
 
     @property

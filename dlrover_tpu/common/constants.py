@@ -216,6 +216,15 @@ class EnvKey:
     EMBEDDING_REPLICAS = "DLROVER_TPU_EMBEDDING_REPLICAS"
     EMBEDDING_FLUSH_MS = "DLROVER_TPU_EMBEDDING_FLUSH_MS"
     EMBEDDING_QUEUE = "DLROVER_TPU_EMBEDDING_QUEUE"
+    # master crash-failover (DESIGN.md §26): where the master persists
+    # its full-state snapshot (unset = snapshots off), the atomic port
+    # file agents re-resolve a restarted master's address from, the
+    # agent-side redelivery queue bound for unacked one-way reports,
+    # and the rate limit on "master unreachable" warnings while degraded
+    MASTER_STATE_DIR = "DLROVER_TPU_MASTER_STATE_DIR"
+    MASTER_PORT_FILE = "DLROVER_TPU_MASTER_PORT_FILE"
+    REDELIVERY_QUEUE = "DLROVER_TPU_REDELIVERY_QUEUE"
+    DEGRADED_WARN_S = "DLROVER_TPU_DEGRADED_WARN_S"
 
 
 class Defaults:

@@ -216,6 +216,24 @@ SPECS: tuple[EnvVar, ...] = (
     EnvVar("DLROVER_TPU_EMBEDDING_QUEUE", "64",
            "bounded embedding send-queue depth in apply batches; a "
            "full queue blocks apply() like the staleness bound", "§25"),
+    # ------------------------------------------------- master crash-failover
+    EnvVar("DLROVER_TPU_MASTER_STATE_DIR", None,
+           "directory for the master's full-state snapshot (v2: ack "
+           "ledger, rendezvous, autopilot, compile-cache spill); unset "
+           "= snapshots off, a master crash loses control-plane state",
+           "§26"),
+    EnvVar("DLROVER_TPU_MASTER_PORT_FILE", None,
+           "atomic port file agents re-resolve the master address "
+           "from after a master restart (the standalone launcher "
+           "exports it automatically)", "§26"),
+    EnvVar("DLROVER_TPU_REDELIVERY_QUEUE", "64",
+           "bound on the agent-side redelivery queue of unacked "
+           "PersistAckReport/FailureReport messages replayed on "
+           "reconnect (oldest dropped past the bound)", "§26"),
+    EnvVar("DLROVER_TPU_DEGRADED_WARN_S", "30",
+           "seconds between repeated 'master unreachable' warnings "
+           "while an agent link is degraded (the outage itself is one "
+           "journal instant + a counter, not log spam)", "§26"),
 )
 
 SPEC_BY_NAME: dict[str, EnvVar] = {spec.name: spec for spec in SPECS}

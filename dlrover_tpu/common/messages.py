@@ -77,6 +77,8 @@ class CommWorldResponse:
     # this round completed via the membership-shrink fast path: the
     # recovery is a reshard event (rdzv_manager; DESIGN.md §17)
     reshard: bool = False
+    # epoch fence (§26): see HeartbeatResponse.master_epoch
+    master_epoch: int = 0
 
 
 @register_message
@@ -190,6 +192,13 @@ class NodeHeartbeat:
 class HeartbeatResponse:
     # master-initiated actions delivered on the heartbeat channel
     action: str = ""  # "", "restart", "stop"
+    # epoch fence (DESIGN.md §26): the master's monotonic incarnation
+    # counter, bumped on every restart. A client observing an increase
+    # runs its reconcile (re-register, full metrics push, redelivery
+    # replay); a DECREASE is a stale/zombie master and is ignored.
+    # Carried as a field (not only the transport envelope) so loopback
+    # transports — the fleet simulator — fence identically.
+    master_epoch: int = 0
 
 
 @register_message
@@ -209,6 +218,12 @@ class FailureReport:
     restart_count: int = 0
     level: TrainingExceptionLevel = TrainingExceptionLevel.PROCESS_ERROR
     error_data: str = ""
+    # redelivery identity (§26): minted once per report; a replay after
+    # a master restart carries the same rid, and the master's
+    # rid-idempotent dedup (persisted in the state snapshot) keeps a
+    # redelivered failure from double-counting in the MTBF window or
+    # the per-node failure ladder. "" = pre-failover client, no dedup.
+    rid: str = ""
 
 
 @register_message
@@ -605,6 +620,10 @@ class PersistAckReport:
     num_shards: int = 1
     shard: dict = dataclasses.field(default_factory=dict)
     group: str = ""
+    # redelivery identity (§26): see FailureReport.rid. The ledger is
+    # already idempotent per (step, world, group, writer); the rid makes
+    # the replay observable and uniform across redelivered kinds.
+    rid: str = ""
 
 
 @register_message

@@ -74,8 +74,15 @@ class RpcServer:
     """
 
     def __init__(self, handler: Callable[[Any], Any], host: str = "0.0.0.0",
-                 port: int = 0):
+                 port: int = 0,
+                 epoch_fn: Callable[[], int] | None = None):
         self._handler = handler
+        # epoch fence (DESIGN.md §26): when set, every response envelope
+        # is stamped with the master's current epoch (`"me"` key, the
+        # response-side mirror of the request's `"rid"`), so a client
+        # detects a master restart on its very next RPC of ANY type —
+        # not just the messages that carry an explicit epoch field.
+        self._epoch_fn = epoch_fn
         # Replay cache: request-id -> encoded response. A client retry after
         # a lost *response* must not re-apply non-idempotent messages
         # (TaskResult completions, KV barrier increments). Large responses
@@ -137,7 +144,10 @@ class RpcServer:
             resp = self._handler(msg)
             if resp is None:
                 resp = RpcError()
-            encoded = serde.encode(resp)
+            out = serde.encode_obj(resp)
+            if self._epoch_fn is not None:
+                out["me"] = int(self._epoch_fn())
+            encoded = json.dumps(out).encode("utf-8")
             if rid is not None and len(encoded) <= 64 * 1024:
                 with self._replay_lock:
                     self._replay[rid] = encoded
@@ -154,8 +164,13 @@ class RpcServer:
             return serde.encode(RpcError(error=f"{type(e).__name__}: {e}"))
 
     def start(self) -> None:
+        # 50 ms shutdown poll (socketserver default: 500 ms): stop()
+        # blocks until serve_forever notices, and every master/test
+        # teardown pays it — at ~0.5 s per server it was a measurable
+        # slice of the tier-1 envelope
         self._thread = threading.Thread(
-            target=self._server.serve_forever, name="rpc-server", daemon=True
+            target=lambda: self._server.serve_forever(poll_interval=0.05),
+            name="rpc-server", daemon=True,
         )
         self._thread.start()
 
@@ -195,10 +210,25 @@ class RpcClient:
         self._deadline_s = deadline_s
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        # epoch fence (§26): invoked with the epoch stamped on each
+        # response envelope (outside the socket lock); the owner —
+        # MasterClient — decides whether it changed and reconciles.
+        self.on_epoch: Optional[Callable[[int], None]] = None
 
     @property
     def addr(self) -> str:
         return f"{self._host}:{self._port}"
+
+    def clone(self, addr: str) -> "RpcClient":
+        """A fresh client to ``addr`` with this one's retry/deadline
+        configuration — the re-dial path after a master restart moved
+        the port (the epoch hook is NOT copied; the owner rewires it)."""
+        return RpcClient(
+            addr, timeout=self._timeout, retries=self._retries,
+            backoff_base_s=self._backoff_base_s,
+            backoff_max_s=self._backoff_max_s,
+            deadline_s=self._deadline_s,
+        )
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
@@ -243,7 +273,13 @@ class RpcClient:
                     sock = self._connect()
                     send_frame(sock, payload)
                     raw = recv_frame(sock)
-                resp = serde.decode(raw)
+                obj = json.loads(raw.decode("utf-8"))
+                epoch = obj.pop("me", None)
+                resp = serde.decode_obj(obj)
+                if epoch is not None and self.on_epoch is not None:
+                    # outside the lock: the hook may issue its own
+                    # calls through this client (reconcile)
+                    self.on_epoch(int(epoch))
                 if isinstance(resp, RpcError) and resp.error:
                     raise RuntimeError(f"rpc error: {resp.error}")
                 return resp

@@ -13,6 +13,7 @@ import errno
 import importlib
 import os
 import shutil
+import threading
 import time
 from abc import ABC, abstractmethod
 from typing import Any
@@ -187,7 +188,10 @@ def atomic_write_file(content: bytes | str, path: str) -> None:
         content, fsync_delay = _apply_write_fault(content, path)
     mode = "wb" if isinstance(content, bytes) else "w"
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    tmp = f"{path}.tmp.{os.getpid()}"
+    # pid alone is not unique enough: two threads of one process
+    # publishing the same path (the master's periodic state loop vs an
+    # on-demand snapshot) would share a tmp name and race the rename
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
     with open(tmp, mode) as f:
         f.write(content)
         f.flush()

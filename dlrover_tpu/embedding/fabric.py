@@ -598,6 +598,18 @@ class FabricCoordinator(ArrayMsgServer):
         # minutes on big tables (the r04 starvation lesson)
         self._lock = threading.Lock()
         self._scale_lock = threading.Lock()
+        self._link = None  # lazy agent/master_link.py degraded link
+
+    def _master_link(self):
+        """Degraded-mode link for the master-ledger coupling (§26):
+        created lazily so coordinators without a master client never
+        register it."""
+        if self._link is None:
+            from dlrover_tpu.agent.master_link import MasterLink
+
+            self._link = MasterLink(self.master_client,
+                                    component="embedding")
+        return self._link
 
     def start(self) -> "FabricCoordinator":
         self._push_route(self.route)
@@ -820,22 +832,39 @@ class FabricCoordinator(ArrayMsgServer):
             # every shard server acks the master's persist ledger (the
             # §20 commit path, namespaced group="embedding"); the
             # commit manifest is then assembled from the ledger so a
-            # writer that died before acking keeps the step invisible
+            # writer that died before acking keeps the step invisible.
+            # A master OUTAGE must not fail the persist (§26): the
+            # coordinator collected every writer's entry synchronously
+            # above — its local map is ground truth — so it commits
+            # from that, journals degraded mode, and the queued acks
+            # replay when the master returns.
             if self.master_client is not None:
-                for member, entry in shards.items():
-                    self.master_client.report_persist_ack(
-                        step, W, entry, writer_id=member,
-                        group="embedding",
+                try:
+                    for member, entry in shards.items():
+                        self.master_client.report_persist_ack(
+                            step, W, entry, writer_id=member,
+                            group="embedding",
+                        )
+                    status = self.master_client.persist_status(
+                        step, W, group="embedding"
                     )
-                status = self.master_client.persist_status(
-                    step, W, group="embedding"
-                )
-                if not status.complete:
-                    raise RuntimeError(
-                        f"persist ledger incomplete: {status.acked}"
-                        f"/{W} acks for step {step}"
-                    )
-                shards = {m: dict(e) for m, e in status.shards.items()}
+                    if status.complete:
+                        shards = {m: dict(e)
+                                  for m, e in status.shards.items()}
+                        self._master_link().ok()
+                    else:
+                        # acks were queued for redelivery (outage) or
+                        # the restarted master's ledger is catching up:
+                        # the local map stands
+                        logger.warning(
+                            "persist ledger incomplete (%d/%d acks for "
+                            "step %d); committing from the "
+                            "coordinator's local manifest",
+                            status.acked, W, step,
+                        )
+                except (ConnectionError, TimeoutError, OSError,
+                        RuntimeError) as e:
+                    self._master_link().failed(e)
             sdir = os.path.join(ckpt_dir, f"step-{step}")
             integrity.write_commit(
                 self.storage, sdir, step, W, shards,

@@ -65,6 +65,13 @@ class FleetProfile:
     # compile-cache artifacts seeded at start so recovery-wave coverage
     # queries scan a non-empty LRU
     compile_cache_entries: int = 4
+    # master crash-restarts (§26): the in-process master is snapshotted,
+    # torn down and rebuilt from the snapshot with a bumped epoch;
+    # every agent's next heartbeat observes the epoch fence and runs
+    # its reconcile. The sim measures master_recovery_s (virtual time
+    # from the restart until every alive agent re-registered) and the
+    # re-registered-nodes curve. Placed mid-window after the waves.
+    master_restarts: int = 0
 
     def __post_init__(self) -> None:
         if self.nodes < 1:

@@ -157,6 +157,12 @@ class SimResult:
     stragglers_flagged: list[int]
     wall_s: float
     virtual_s: float
+    # §26 master-restart measurements (virtual seconds): time from the
+    # restart until every alive agent's reconcile landed, plus the
+    # re-registered-nodes curve [(dt, count)...]; None/[] without a
+    # master_restarts profile
+    master_recovery_s: float | None = None
+    reregistered_curve: list = dataclasses.field(default_factory=list)
 
     # ------------------------------------------------------ derived views
 
@@ -207,6 +213,13 @@ class SimResult:
             "snapshot_ingest_ms": round(
                 self.snapshot_ingest_mean_ms(), 4),
             "snapshot_wire_bytes": self.snapshot_wire_bytes(),
+            "master_recovery_s": (
+                round(self.master_recovery_s, 3)
+                if self.master_recovery_s is not None else None
+            ),
+            "reregistered_curve": [
+                [dt, n] for dt, n in self.reregistered_curve
+            ],
         }
 
 
@@ -218,6 +231,7 @@ class FleetSimulator:
         "join", "poll", "heartbeat", "snapshot", "storm", "fail",
         "death",
     )
+    _MASTER_RESTART = "master_restart"
 
     def __init__(self, profile: FleetProfile):
         self.profile = profile
@@ -227,6 +241,12 @@ class FleetSimulator:
         self._rounds: list[dict] = []
         self._seen_rounds: set[int] = set()
         self._storm_step = 0
+        # §26 master-restart bookkeeping (virtual-time measurements)
+        self._restart_t: float | None = None
+        self._restart_epoch = 0
+        self._reregistered: set[int] = set()
+        self._rereg_curve: list[tuple[float, int]] = []
+        self._recovery_s: float | None = None
 
     # ------------------------------------------------------------ engine
 
@@ -244,14 +264,21 @@ class FleetSimulator:
         from dlrover_tpu.master.job_master import JobMaster
         from dlrover_tpu.master.saturation import lock_wait_seconds
 
+        from dlrover_tpu.master.state_store import MemoryStateBackend
+
         p = self.profile
         prev_trace = os.environ.get(EnvKey.TRACE_ID)
         t_wall = time.perf_counter()
+        # an in-memory state backend from the start: the §26 restart
+        # event snapshots the live master and rebuilds a new one from
+        # the snapshot, exactly the crash-failover path minus the disk
+        self._state_backend = MemoryStateBackend()
         master = JobMaster(
             job_name=f"fleetsim_{p.name}",
             min_nodes=max(1, p.nodes - p.deaths),
             max_nodes=p.nodes,
             rdzv_timeout=3600.0,
+            state_backend=self._state_backend,
         )
         lock_base = {
             s["labels"]["structure"]: (list(s["buckets"]), s["sum"],
@@ -259,6 +286,7 @@ class FleetSimulator:
             for s in lock_wait_seconds.samples()
         }
         transport = _LoopbackTransport(master.servicer.handle)
+        self._transport = transport
         rng_jitter = random.Random(f"{p.seed}:jitter")
         rng_pick = random.Random(f"{p.seed}:pick")
         k = round(p.nodes * p.straggler_frac)
@@ -304,6 +332,14 @@ class FleetSimulator:
         if p.ckpt_interval_s > 0:
             self._schedule(p.join_window_s + p.ckpt_interval_s,
                            self._STORM, -1)
+        for r in range(p.master_restarts):
+            # offset off the wave grid so a restart never shares a
+            # virtual instant with a failure/death event
+            self._schedule(
+                p.join_window_s
+                + p.duration_s * (r + 0.62) / (p.master_restarts + 1),
+                self._MASTER_RESTART, -1,
+            )
 
         try:
             self._run_loop(horizon, rng_jitter, rng_pick)
@@ -311,9 +347,10 @@ class FleetSimulator:
             # the master was never prepare()d: no threads to stop, but
             # the RpcServer construction bound a socket — release it
             # without RpcServer.stop() (shutdown() would block forever
-            # on a serve_forever loop that never ran)
+            # on a serve_forever loop that never ran). self._master: a
+            # §26 restart event may have replaced the original.
             try:
-                master._server._server.server_close()
+                self._master._server._server.server_close()
             except OSError:
                 pass
             if prev_trace is None:
@@ -321,7 +358,7 @@ class FleetSimulator:
             else:
                 os.environ[EnvKey.TRACE_ID] = prev_trace
 
-        flagged = sorted(master.anomaly.stragglers())
+        flagged = sorted(self._master.anomaly.stragglers())
         for node in flagged:
             self._trail("straggler_flagged", node)
         self._trail("end", len(self._rounds))
@@ -342,6 +379,8 @@ class FleetSimulator:
             stragglers_flagged=flagged,
             wall_s=wall,
             virtual_s=horizon,
+            master_recovery_s=self._recovery_s,
+            reregistered_curve=list(self._rereg_curve),
         )
         logger.info(
             "fleetsim %s: %d nodes, %d rounds, %d rpc types, "
@@ -374,12 +413,17 @@ class FleetSimulator:
                 agent = self._agents[node]
                 if agent.alive:
                     agent.client.report_heartbeat(0)
+                    if self._restart_t is not None \
+                            and self._recovery_s is None:
+                        self._track_recovery(t, agent)
                     self._schedule(t + p.heartbeat_interval_s,
                                    self._HEARTBEAT, node)
             elif kind == self._SNAPSHOT:
                 self._on_snapshot(t, node)
             elif kind == self._STORM:
                 self._on_storm(t)
+            elif kind == self._MASTER_RESTART:
+                self._on_master_restart(t)
             elif kind in (self._FAIL, self._DEATH):
                 self._on_wave(t, kind, rng_jitter, rng_pick)
 
@@ -490,6 +534,58 @@ class FleetSimulator:
         self._trail("ckpt_storm", step, int(status.acked))
         self._schedule(t + self.profile.ckpt_interval_s, self._STORM,
                        -1)
+
+    def _on_master_restart(self, t: float) -> None:
+        """§26 master crash-restart: snapshot the live master, tear it
+        down abruptly (no graceful stop — this is a crash), rebuild a
+        new one from the snapshot with a bumped epoch, and rebind the
+        loopback transport. Every agent's next heartbeat observes the
+        epoch fence and runs the real MasterClient reconcile
+        (re-register + full-snapshot push + redelivery replay) through
+        the measured RPC path."""
+        from dlrover_tpu.master.job_master import JobMaster
+
+        p = self.profile
+        old = self._master
+        old.state_manager.snapshot()
+        try:
+            old._server._server.server_close()
+        except OSError:
+            pass
+        master = JobMaster(
+            job_name=f"fleetsim_{p.name}",
+            min_nodes=max(1, p.nodes - p.deaths),
+            max_nodes=p.nodes,
+            rdzv_timeout=3600.0,
+            state_backend=self._state_backend,
+        )
+        master.restore_state()
+        self._master = master
+        self._transport._handler = master.servicer.handle
+        self._restart_t = t
+        self._restart_epoch = master.master_epoch
+        self._reregistered = set()
+        self._rereg_curve = [(0.0, 0)]
+        self._recovery_s = None
+        self._trail("master_restart", master.master_epoch)
+
+    def _track_recovery(self, t: float, agent: _SimAgent) -> None:
+        """One post-restart heartbeat landed: if the agent's client has
+        adopted the new epoch (its reconcile ran inside that RPC), it
+        counts as re-registered. All alive agents re-registered ==
+        recovery complete; both the curve and the total are VIRTUAL
+        time, so they replay identically."""
+        if agent.client.master_epoch != self._restart_epoch \
+                or agent.node_id in self._reregistered:
+            return
+        self._reregistered.add(agent.node_id)
+        dt = t - self._restart_t
+        self._rereg_curve.append((round(dt, 3),
+                                  len(self._reregistered)))
+        alive = sum(1 for a in self._agents if a.alive)
+        if len(self._reregistered) >= alive:
+            self._recovery_s = dt
+            self._trail("master_recovered", len(self._reregistered))
 
     def _on_wave(self, t: float, kind: str, rng_jitter: random.Random,
                  rng_pick: random.Random) -> None:

@@ -13,16 +13,20 @@ control-plane loss must never fail data-plane requests. The transition
 is observable: a ``degraded_mode`` journal instant on enter/exit and
 the ``dlrover_tpu_gateway_degraded`` gauge (1 while degraded) for
 alerting. Control actions simply resume when the master returns.
+
+Since §26 the enter/exit/re-dial machinery is the shared
+``agent/master_link.py`` core (this was its prototype); the gateway
+keeps its documented unlabeled gauge and its kv-target tick.
 """
 
 from __future__ import annotations
 
 import threading
 
+from dlrover_tpu.agent.master_link import MasterLink as _DegradedLink
 from dlrover_tpu.cluster.crd import ScalePlan
 from dlrover_tpu.cluster.scaler import Scaler
 from dlrover_tpu.common.log import get_logger
-from dlrover_tpu.telemetry.journal import get_journal
 from dlrover_tpu.telemetry.metrics import registry
 
 logger = get_logger(__name__)
@@ -33,7 +37,7 @@ _degraded_gauge = registry().gauge(
 )
 
 
-class MasterLink:
+class MasterLink(_DegradedLink):
     """Heartbeat loop binding a ``Gateway`` to a job master.
 
     ``client`` is an ``agent.master_client.MasterClient`` (or anything
@@ -47,23 +51,18 @@ class MasterLink:
                  interval_s: float = 5.0,
                  kv_key: str = "gateway/replica_target",
                  group: str = "serving"):
+        super().__init__(client, component="gateway",
+                         gauge=_degraded_gauge)
         self._gateway = gateway
-        self._client = client
         self._scaler = scaler
         self._interval_s = interval_s
         self._kv_key = kv_key
         self._group = group
-        self._degraded = False
         self._last_target: int | None = None
         self._stopped = threading.Event()
         self._thread: threading.Thread | None = None
-        _degraded_gauge.set(0)
         if gateway is not None:
             gateway.master_link = self
-
-    @property
-    def degraded(self) -> bool:
-        return self._degraded
 
     # ----------------------------------------------------------- lifecycle
 
@@ -89,9 +88,9 @@ class MasterLink:
                                         role="gateway")
             raw = self._client.kv_get(self._kv_key)
         except (ConnectionError, RuntimeError, OSError) as e:
-            self._enter_degraded(e)
+            self.failed(e)
             return
-        self._exit_degraded()
+        self.ok()
         if not raw:
             return
         try:
@@ -107,24 +106,3 @@ class MasterLink:
                 replica_resources={self._group: target},
                 reason=f"master kv target ({self._kv_key})",
             ))
-
-    def _enter_degraded(self, err: Exception) -> None:
-        if self._degraded:
-            return
-        self._degraded = True
-        _degraded_gauge.set(1)
-        get_journal().emit("degraded_mode", state="enter",
-                           component="gateway", error=str(err)[:200])
-        logger.warning(
-            "master unreachable (%s); gateway serving in degraded mode "
-            "with its last-known replica pool", err,
-        )
-
-    def _exit_degraded(self) -> None:
-        if not self._degraded:
-            return
-        self._degraded = False
-        _degraded_gauge.set(0)
-        get_journal().emit("degraded_mode", state="exit",
-                           component="gateway")
-        logger.info("master reachable again; gateway left degraded mode")

@@ -44,6 +44,7 @@ class JobMaster:
         heartbeat_dead_window_s: float = Defaults.HEARTBEAT_DEAD_WINDOW_S,
         heartbeat_interval_s: float = Defaults.HEARTBEAT_INTERVAL_S,
         state_dir: str = "",
+        state_backend=None,
     ):
         from dlrover_tpu.master.stats import LocalStatsReporter
         from dlrover_tpu.telemetry.journal import mint_trace_id, set_trace_id
@@ -96,21 +97,44 @@ class JobMaster:
             trace_id=self.trace_id,
             anomaly=self.anomaly,
         )
-        self._server = RpcServer(self.servicer.handle, port=port)
+        # epoch fence (DESIGN.md §26): a monotonic incarnation counter,
+        # persisted in the state snapshot and bumped past the restored
+        # value by restore_state() BEFORE the server starts — stamped
+        # on every RPC response so agents detect the restart and run
+        # their reconcile. Fresh jobs start at epoch 1.
+        self.master_epoch = 1
+        self.servicer.master_epoch = self.master_epoch
+        self._server = RpcServer(
+            self.servicer.handle, port=port,
+            epoch_fn=lambda: self.servicer.master_epoch,
+        )
         self._metrics_server = None
         self.state_manager = None
-        if state_dir:
+        from dlrover_tpu.common import envspec
+
+        state_dir = state_dir or (
+            envspec.get(EnvKey.MASTER_STATE_DIR) or ""
+        )
+        if state_dir or state_backend is not None:
             from dlrover_tpu.master.state_store import (
                 FileStateBackend,
                 MasterStateManager,
             )
 
+            spill_dir = (os.path.join(state_dir, "compile_cache")
+                         if state_dir else None)
             self.state_manager = MasterStateManager(
                 self,
-                FileStateBackend(
+                state_backend or FileStateBackend(
                     os.path.join(state_dir, f"{job_name}.state.json")
                 ),
+                spill_dir=spill_dir,
             )
+            # state-changing dispatches (persist acks, failures,
+            # autopilot arm/retune, rendezvous joins) nudge an early
+            # snapshot so they are durable within milliseconds
+            self.servicer.on_state_change = \
+                self.state_manager.request_snapshot
 
     @property
     def port(self) -> int:
@@ -158,12 +182,44 @@ class JobMaster:
             )
         return render_grouped(parts)
 
+    def restore_state(self) -> bool:
+        """Restore the full-state snapshot (if any) and bump the epoch
+        past the restored one. Must run BEFORE the RPC server serves:
+        the bumped epoch on the very first response is what fences
+        agents off the dead incarnation (DESIGN.md §26)."""
+        from dlrover_tpu.telemetry.metrics import registry
+
+        restored = False
+        if self.state_manager is not None:
+            restored = self.state_manager.restore()
+            if restored:
+                self.master_epoch = \
+                    self.state_manager.restored_epoch + 1
+                self.servicer.master_epoch = self.master_epoch
+                logger.info(
+                    "master restarted: epoch %d (restored epoch %d)",
+                    self.master_epoch,
+                    self.state_manager.restored_epoch,
+                )
+        registry().gauge(
+            "dlrover_tpu_master_epoch",
+            "this master incarnation's epoch-fence counter (bumped on "
+            "every restart; agents reconcile on any increase)",
+        ).set(self.master_epoch)
+        return restored
+
     def prepare(self) -> None:
         from dlrover_tpu.telemetry.exposition import start_from_env
         from dlrover_tpu.telemetry.journal import get_journal
 
+        self.restore_state()
         if self.state_manager is not None:
-            self.state_manager.restore()
+            # persist the bumped epoch immediately: a crash loop must
+            # keep the fence monotonic even between periodic snapshots
+            try:
+                self.state_manager.snapshot()
+            except Exception:  # noqa: BLE001 - never block startup
+                logger.exception("post-restore snapshot failed")
             self.state_manager.start()
         self._server.start()
         self.node_manager.start()

@@ -19,11 +19,15 @@ speaks to every node.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import OrderedDict
 
+from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.telemetry.metrics import registry
+
+logger = get_logger(__name__)
 
 # Shared by the master-side service (layer="master") and the trainer's
 # node-local directory layer (layer="local", parallel/compile_cache.py):
@@ -163,6 +167,77 @@ class CompileCacheService:
             self._bytes -= len(entry[0])
             _cache_bytes.set(self._bytes)
             return True
+
+    # -------------------------------------------- crash-failover state (§26)
+
+    def export_state(self, spill_dir: str | None) -> list[dict]:
+        """Entry metadata for the master snapshot, blobs spilled to
+        ``spill_dir`` (same ``<key with / -> _>.aot`` naming as the
+        node-local ``DLROVER_TPU_COMPILE_CACHE_DIR`` layer, so the dir
+        is inspectable with the same tooling). ``spill_dir=None``
+        exports metadata only — a restarted master then serves misses
+        for the blobs, which is a degradation, not corruption.
+        Already-spilled blobs are skipped by size (content is
+        CRC-guarded at restore)."""
+        import zlib
+
+        with self._lock:
+            entries = list(self._entries.items())
+        exported: list[dict] = []
+        for key, (payload, meta) in entries:
+            record = {
+                "key": key, "meta": dict(meta),
+                "bytes": len(payload),
+                "crc32": zlib.crc32(payload) & 0xFFFFFFFF,
+            }
+            if spill_dir:
+                path = os.path.join(spill_dir,
+                                    key.replace("/", "_") + ".aot")
+                try:
+                    if not os.path.exists(path) \
+                            or os.path.getsize(path) != len(payload):
+                        from dlrover_tpu.common.storage import (
+                            atomic_write_file,
+                        )
+
+                        atomic_write_file(payload, path)
+                    record["spilled"] = True
+                except OSError:
+                    logger.warning("compile-cache spill of %s failed",
+                                   key, exc_info=True)
+            exported.append(record)
+        return exported
+
+    def restore_state(self, exported: list[dict],
+                      spill_dir: str | None) -> int:
+        """Re-hydrate spilled entries in their original LRU order;
+        returns how many blobs came back. A missing/corrupt spill file
+        drops that entry (the client treats the miss as a cold
+        compile — never a wrong program)."""
+        import zlib
+
+        restored = 0
+        for record in exported:
+            key = record.get("key", "")
+            if not key or not spill_dir or not record.get("spilled"):
+                continue
+            path = os.path.join(spill_dir,
+                                key.replace("/", "_") + ".aot")
+            try:
+                with open(path, "rb") as f:
+                    payload = f.read()
+            except OSError:
+                continue
+            if zlib.crc32(payload) & 0xFFFFFFFF \
+                    != int(record.get("crc32", -1)):
+                logger.warning(
+                    "spilled compile-cache blob %s failed its CRC; "
+                    "dropped (will recompile)", key,
+                )
+                continue
+            if self.put(key, payload, record.get("meta")):
+                restored += 1
+        return restored
 
     def covers(self, topology: str) -> int:
         """Number of cached executables under a topology prefix (a full

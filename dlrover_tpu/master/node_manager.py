@@ -261,6 +261,55 @@ class NodeManager:
             self._pending_actions[node_id] = action
             return True
 
+    # -------------------------------------------- crash-failover state (§26)
+
+    def export_state(self) -> dict:
+        """Census + incarnation/failure counters for the master
+        snapshot. Liveness bookkeeping (heartbeat times, preemption
+        arms) deliberately stays out: a restarted master re-learns
+        liveness from the next heartbeat cadence, with the fresh
+        ``create_time`` providing the registration grace."""
+        with self._lock:
+            return {
+                str(nid): {
+                    "status": node.status.value,
+                    "exit_reason": node.exit_reason.value,
+                    "addr": node.addr,
+                    "process_restarts": node.process_restarts,
+                    "relaunch_count": node.relaunch_count,
+                    "failures": self._failure_counts.get(nid, 0),
+                }
+                for nid, node in self._nodes.items()
+            }
+
+    def restore_state(self, state: dict) -> None:
+        with self._lock:
+            for nid_s, entry in state.items():
+                nid = int(nid_s)
+                if nid in self._nodes:
+                    continue
+                try:
+                    status = NodeStatus(entry.get("status", "running"))
+                except ValueError:
+                    status = NodeStatus.RUNNING
+                try:
+                    exit_reason = NodeExitReason(
+                        entry.get("exit_reason", "unknown"))
+                except ValueError:
+                    exit_reason = NodeExitReason.UNKNOWN
+                node = Node(
+                    node_type=NodeType.HOST, node_id=nid,
+                    addr=entry.get("addr", ""), status=status,
+                )
+                node.exit_reason = exit_reason
+                node.process_restarts = int(
+                    entry.get("process_restarts", 0))
+                node.relaunch_count = int(entry.get("relaunch_count", 0))
+                self._nodes[nid] = node
+                failures = int(entry.get("failures", 0))
+                if failures:
+                    self._failure_counts[nid] = failures
+
     # ---------------------------------------------------------------- queries
 
     def running_nodes(self) -> list[Node]:

@@ -301,6 +301,78 @@ class RendezvousManager:
             self._waiting.clear()
             self._waiting_survivors = 0
 
+    # -------------------------------------------- crash-failover state (§26)
+
+    def export_state(self) -> dict:
+        """Round counter + world/waiting sets for the master snapshot:
+        a restarted master continues the round sequence (epoch fencing
+        — round numbers are never reissued) and resumes a rendezvous
+        that was mid-flight when it died."""
+        with self._lock:
+            latest = None
+            if self._latest is not None:
+                latest = {
+                    "round": self._latest.round,
+                    "world": dict(self._latest.world),
+                    "coordinator": self._latest.coordinator,
+                    "total_devices": self._latest.total_devices,
+                    "node_addrs": dict(self._latest.node_addrs),
+                    "reshard": self._latest.reshard,
+                }
+            return {
+                "round": self._round,
+                "prev_world": sorted(self._prev_world)
+                if self._prev_world is not None else None,
+                "departed": sorted(self._departed),
+                "waiting": [
+                    {"node_id": w.node_id, "addr": w.addr,
+                     "local_devices": w.local_devices,
+                     "topology_key": w.topology_key}
+                    for w in self._waiting.values()
+                ],
+                "latest": latest,
+            }
+
+    def restore_state(self, state: dict) -> None:
+        with self._lock:
+            self._round = max(self._round, int(state.get("round", 0)))
+            prev = state.get("prev_world")
+            self._prev_world = (
+                frozenset(int(n) for n in prev)
+                if prev is not None else None
+            )
+            self._departed = {int(n) for n in state.get("departed", ())}
+            now = time.time()
+            self._waiting = {}
+            for w in state.get("waiting", ()):
+                nid = int(w["node_id"])
+                self._waiting[nid] = _WaitingNode(
+                    node_id=nid, addr=w.get("addr", ""),
+                    local_devices=int(w.get("local_devices", 0)),
+                    topology_key=w.get("topology_key", ""),
+                    join_time=now,
+                )
+            if self._waiting:
+                self._first_join_time = now
+            self._waiting_survivors = sum(
+                1 for nid in self._waiting
+                if self._prev_world is not None
+                and nid in self._prev_world
+                and nid not in self._departed
+            )
+            latest = state.get("latest")
+            if latest:
+                self._latest = CommWorld(
+                    round=int(latest["round"]),
+                    world={int(k): int(v)
+                           for k, v in latest.get("world", {}).items()},
+                    coordinator=latest.get("coordinator", ""),
+                    total_devices=int(latest.get("total_devices", 0)),
+                    node_addrs={int(k): v for k, v
+                                in latest.get("node_addrs", {}).items()},
+                    reshard=bool(latest.get("reshard", False)),
+                )
+
 
 class NetworkCheckRendezvousManager(RendezvousManager):
     """Pairwise-group rendezvous for fault-node bisection.

@@ -10,6 +10,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+from collections import OrderedDict
 from typing import Any
 
 from dlrover_tpu.common import messages as m
@@ -81,6 +82,24 @@ class MasterServicer:
         self._oom_bump_threshold = 0
         self._last_oom_bump = 0.0
         self.oom_bump_cooldown_s = 30.0
+        # epoch fence (DESIGN.md §26): the owning JobMaster stamps its
+        # monotonic incarnation counter here; it rides every
+        # HeartbeatResponse/CommWorldResponse and the RPC envelope so
+        # clients detect a master restart and reconcile
+        self.master_epoch = 1
+        # JobMaster wires this to MasterStateManager.request_snapshot:
+        # called after state-changing dispatches (persist acks, failure
+        # reports, autopilot arm/retune, rendezvous joins) so those are
+        # durable within milliseconds, not a periodic interval
+        self.on_state_change = None
+        # newest round whose completion this incarnation already made
+        # durable, per rendezvous (snapshot nudge dedup)
+        self._seen_rounds: dict[str, int] = {}
+        # rid-idempotent dedup for redelivered one-way reports (§26):
+        # bounded insertion-ordered set, persisted in the snapshot so a
+        # replay across the restart cannot double-count
+        self._seen_rids: "OrderedDict[str, None]" = OrderedDict()
+        self.max_seen_rids = 4096
         self.job_exit_event = threading.Event()
         self.job_success: bool | None = None
         # node_id -> BuddyServer addr (checkpoint/buddy.py replication)
@@ -185,6 +204,78 @@ class MasterServicer:
         with self._node_metrics_lock:
             return dict(self._node_metrics)
 
+    @property
+    def compile_cache(self) -> CompileCacheService:
+        return self._compile_cache
+
+    # ------------------------------------------- crash-failover state (§26)
+
+    def _state_changed(self) -> None:
+        if self.on_state_change is not None:
+            try:
+                self.on_state_change()
+            except Exception:  # noqa: BLE001 - snapshot nudge only
+                logger.exception("state-change hook failed")
+
+    def _rid_seen(self, rid: str) -> bool:
+        """True when a redelivered report was already applied; records
+        fresh rids (bounded, insertion-ordered, snapshot-persisted)."""
+        if not rid:
+            return False
+        with self._persist_lock:
+            if rid in self._seen_rids:
+                return True
+            self._seen_rids[rid] = None
+            while len(self._seen_rids) > self.max_seen_rids:
+                self._seen_rids.popitem(last=False)
+        return False
+
+    def export_persist_state(self) -> dict:
+        """Ack ledger (both groups) + rid-dedup set for the snapshot."""
+        with self._persist_lock:
+            acks = [
+                {"step": step, "num_shards": num, "group": group,
+                 "shards": {w: dict(e) for w, e in shards.items()}}
+                for (step, num, group), shards
+                in self._persist_acks.items()
+            ]
+            rids = list(self._seen_rids)
+        return {"acks": acks, "rids": rids}
+
+    def restore_persist_state(self, state: dict) -> None:
+        with self._persist_lock:
+            for entry in state.get("acks", ()):
+                key = (int(entry["step"]), int(entry["num_shards"]),
+                       str(entry.get("group", "")))
+                self._persist_acks.setdefault(key, {}).update(
+                    entry.get("shards", {})
+                )
+            for rid in state.get("rids", ()):
+                self._seen_rids[str(rid)] = None
+            while len(self._seen_rids) > self.max_seen_rids:
+                self._seen_rids.popitem(last=False)
+
+    def export_autopilot_state(self) -> dict:
+        state = self._autopilot.export_state() \
+            if self._autopilot is not None else {}
+        if state:
+            state["step_batch"] = self._autopilot_step_batch
+        return state
+
+    def restore_autopilot_state(self, state: dict) -> None:
+        if self._autopilot is None or not state:
+            return
+        self._autopilot_step_batch = int(state.get("step_batch", 0))
+        self._autopilot.restore_state(state)
+
+    def export_tuner_state(self) -> dict | None:
+        return self._interval_tuner.export_state() \
+            if self._interval_tuner is not None else None
+
+    def restore_tuner_state(self, state: dict) -> None:
+        if self._interval_tuner is not None and state:
+            self._interval_tuner.restore_state(state)
+
     # ------------------------------------------------------- saturation
 
     def saturation_rows(self) -> list[dict]:
@@ -259,6 +350,11 @@ class MasterServicer:
             )
         if isinstance(msg, m.CompileCachePutRequest):
             ok = self._compile_cache.put(msg.key, msg.payload, msg.meta)
+            if ok:
+                # spill promptly: a restarted master must answer
+                # CompileCacheGet warm (§26) — losing the artifact is
+                # a recompile storm, not just a cold scrape
+                self._state_changed()
             return m.OkResponse(success=ok)
         if isinstance(msg, m.CompileCacheGetRequest):
             entry = self._compile_cache.get(msg.key)
@@ -290,10 +386,16 @@ class MasterServicer:
             action = self._node_manager.report_heartbeat(
                 msg.node_id, msg.restart_count
             )
-            return m.HeartbeatResponse(action=action)
+            return m.HeartbeatResponse(action=action,
+                                       master_epoch=self.master_epoch)
         if isinstance(msg, m.NodeEventReport):
             return self._node_event(msg)
         if isinstance(msg, m.FailureReport):
+            if self._rid_seen(msg.rid):
+                # redelivered across a master restart and already
+                # applied pre-crash: ack without re-counting (MTBF
+                # window / failure ladder stay single-charged)
+                return m.OkResponse()
             self._node_manager.report_failure(msg.node_id)
             logger.warning(
                 "failure report from node %d (restart %d, %s): %s",
@@ -305,6 +407,7 @@ class MasterServicer:
             if self._interval_tuner is not None:
                 self._interval_tuner.observe_failure()
                 self._maybe_retune_snapshot_interval()
+            self._state_changed()
             return m.OkResponse()
         if isinstance(msg, m.ResourceStats):
             # partial-update semantics: the agent reports host cpu/mem, the
@@ -456,6 +559,8 @@ class MasterServicer:
         if isinstance(msg, m.JobExitRequest):
             return self._job_exit(msg)
         if isinstance(msg, m.PersistAckReport):
+            if self._rid_seen(msg.rid):
+                return m.OkResponse()
             key = (int(msg.step), int(msg.num_shards), str(msg.group))
             with self._persist_lock:
                 self._persist_acks.setdefault(key, {})[
@@ -466,6 +571,7 @@ class MasterServicer:
                         : len(self._persist_acks) - self.max_persist_steps
                     ]:
                         del self._persist_acks[old]
+            self._state_changed()
             return m.OkResponse()
         if isinstance(msg, m.PersistStatusRequest):
             key = (int(msg.step), int(msg.num_shards), str(msg.group))
@@ -557,6 +663,7 @@ class MasterServicer:
             getattr(msg, "step_batch", 0) or 0
         )
         self._autopilot.arm(plan, alternatives)
+        self._state_changed()
         return m.OkResponse()
 
     def _autopilot_applicable(self, current, target) -> bool:
@@ -590,6 +697,9 @@ class MasterServicer:
                 decision.to_plan.name, decision.path,
                 self._paral_config.version,
             )
+        # the charged retune budget must survive a crash: a restarted
+        # master re-granting spent retunes would double-retune (§26)
+        self._state_changed()
 
     def _maybe_retune_snapshot_interval(self) -> None:
         """Push an applied Young-Daly retune to trainers through the
@@ -656,6 +766,10 @@ class MasterServicer:
         rnd = mgr.join(
             msg.node_id, msg.addr, msg.local_devices, msg.topology_key
         )
+        # a join mutates the waiting set the snapshot carries: make it
+        # durable promptly so a mid-rendezvous master crash resumes the
+        # round instead of stranding the joined agents
+        self._state_changed()
         return m.JoinRendezvousResponse(round=rnd)
 
     def _get_comm_world(self, msg: m.CommWorldRequest) -> m.CommWorldResponse:
@@ -665,6 +779,12 @@ class MasterServicer:
         world = mgr.get_comm_world(msg.node_id)
         if world is None:
             return m.CommWorldResponse(completed=False)
+        if world.round > self._seen_rounds.get(msg.rdzv_name, 0):
+            # a COMPLETED round advanced the monotonic counter: persist
+            # it before a crash can reissue the round number (§26) —
+            # once per round, not per poll
+            self._seen_rounds[msg.rdzv_name] = world.round
+            self._state_changed()
         if msg.rdzv_name == "network-check":
             self._diagnosis.set_expected_nodes(set(world.world),
                                                generation=world.round)
@@ -676,6 +796,7 @@ class MasterServicer:
             total_devices=world.total_devices,
             trace_id=self.trace_id,
             reshard=world.reshard,
+            master_epoch=self.master_epoch,
         )
 
     def _network_check_group(self, msg: m.NetworkCheckGroupRequest
@@ -740,6 +861,11 @@ class MasterServicer:
             status = NodeStatus(msg.status) if msg.status else NodeStatus.UNKNOWN
         except ValueError:
             status = NodeStatus.UNKNOWN
+        if status == NodeStatus.RUNNING:
+            # the epoch-fence reconcile re-registers with a RUNNING
+            # event: a restarted master whose snapshot missed the node
+            # must (re-)create it, not silently drop the update
+            self._node_manager.ensure_node(msg.node_id)
         self._node_manager.update_status(msg.node_id, status, msg.exit_reason)
         if status in (NodeStatus.FAILED, NodeStatus.DELETED):
             self._task_manager.recover_tasks_of_node(msg.node_id)

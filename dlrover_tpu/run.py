@@ -93,8 +93,8 @@ def parse_nnodes(spec: str) -> tuple[int, int]:
 
 
 def launch_local_master(args, min_nodes: int, max_nodes: int
-                        ) -> tuple[subprocess.Popen, str]:
-    """Spawn the standalone master; return (proc, addr)."""
+                        ) -> tuple[subprocess.Popen, str, str]:
+    """Spawn the standalone master; return (proc, addr, port_file)."""
     port_file = os.path.join(
         tempfile.mkdtemp(prefix="dlrover_tpu_master_"), "port"
     )
@@ -119,7 +119,7 @@ def launch_local_master(args, min_nodes: int, max_nodes: int
             with open(port_file) as f:
                 text = f.read().strip()
             if text:
-                return proc, f"127.0.0.1:{text}"
+                return proc, f"127.0.0.1:{text}", port_file
         time.sleep(0.05)
     proc.kill()
     raise TimeoutError("standalone master did not report its port in 30s")
@@ -196,10 +196,15 @@ def main(argv: list[str] | None = None) -> int:
 
     master_proc = None
     if args.standalone:
-        master_proc, master_addr = launch_local_master(
+        master_proc, master_addr, port_file = launch_local_master(
             args, min_nodes, max_nodes
         )
         logger.info("standalone master at %s", master_addr)
+        # a restarted master binds a fresh port and republishes it in
+        # the atomic port file: exporting the path lets the agent (and
+        # its trainer children) re-resolve the address instead of
+        # retrying a dead socket forever (DESIGN.md §26)
+        os.environ.setdefault(EnvKey.MASTER_PORT_FILE, port_file)
     else:
         master_addr = args.master_addr or os.environ.get(
             EnvKey.MASTER_ADDR, ""

@@ -75,6 +75,13 @@ class SnapshotDeltaTracker:
             self._pending = None
             self._pushes += 1
 
+    def force_full(self) -> None:
+        """Make the next push a full snapshot — the epoch-fence
+        reconcile calls this after a master restart, whose merged
+        store started empty (DESIGN.md §26)."""
+        self._pushes = 0
+        self._pending = None
+
     def reset(self) -> None:
         """Force the next push full (e.g. after a reconnect to a master
         that may have lost the merge base)."""

@@ -88,6 +88,10 @@ DOCUMENTED_PREFIXES = (
     # staleness is climbing" runbook keys on the staleness gauge and
     # the backpressure/apply-lag families
     "dlrover_tpu_embedding_",
+    # master crash-failover (DESIGN.md §26): the "the master died"
+    # runbook keys on the degraded/unreachable/reconcile/redelivery
+    # families and the epoch gauge
+    "dlrover_tpu_agent_",
 )
 
 # label names that are themselves an operator contract (dashboards and

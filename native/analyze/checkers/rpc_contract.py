@@ -43,6 +43,12 @@ MESSAGES_SUFFIX = "common/messages.py"
 SERVICER_SUFFIX = "master/servicer.py"
 CLIENT_SUFFIX = "agent/master_client.py"
 
+# Epoch fence (DESIGN.md §26): these response messages are the
+# transport-independent carriers of the master's incarnation counter —
+# loopback transports (the fleet simulator) have no RPC envelope, so
+# removing the field silently disables restart detection there.
+EPOCH_FENCED = ("HeartbeatResponse", "CommWorldResponse")
+
 
 def message_classes(module: Module) -> dict[str, set[str]]:
     """class name -> declared field/method names."""
@@ -145,6 +151,16 @@ class RpcContractChecker(Checker):
                     messages, class_node,
                     f"master-handled request {cls} has no master_client "
                     "method constructing it",
+                ))
+
+        for cls in EPOCH_FENCED:
+            if cls in classes and "master_epoch" not in classes[cls]:
+                findings.append(self.finding(
+                    messages, self._class_node(messages, cls),
+                    f"epoch-fenced response {cls} must declare a "
+                    "master_epoch field — without it, loopback "
+                    "transports (fleetsim) cannot detect a master "
+                    "restart (DESIGN.md §26)",
                 ))
 
         findings.extend(self._kwarg_findings(project, classes))

@@ -478,6 +478,26 @@ def test_rpc_contract_bad_branch_field_access(tmp_path):
     assert bad[0].path == "pkg/master/servicer.py"
 
 
+def test_rpc_contract_epoch_fenced_response_needs_field(tmp_path):
+    # the §26 fence: HeartbeatResponse without master_epoch silently
+    # disables restart detection on loopback transports
+    messages = RPC_MESSAGES + (
+        "\n\n@dataclasses.dataclass\nclass HeartbeatResponse:\n"
+        "    action: str = ''\n"
+    )
+    root = _rpc_project(tmp_path, RPC_SERVICER_CLEAN, RPC_CLIENT_CLEAN,
+                        messages=messages)
+    result = _run(root, "rpc-contract")
+    assert any("epoch-fenced response HeartbeatResponse" in f.message
+               for f in result.findings)
+    fixed = messages.replace("    action: str = ''",
+                             "    action: str = ''\n"
+                             "    master_epoch: int = 0")
+    root2 = _rpc_project(tmp_path / "clean", RPC_SERVICER_CLEAN,
+                         RPC_CLIENT_CLEAN, messages=fixed)
+    assert _run(root2, "rpc-contract").findings == []
+
+
 def test_rpc_contract_master_request_needs_client_method(tmp_path):
     # handled by the master servicer but never constructed by the
     # typed client -> the SyncFinishedRequest-style gap

@@ -1,0 +1,485 @@
+"""Master crash-failover (DESIGN.md §26): full-state snapshot v2,
+epoch fencing, agent re-dial/reconcile/redelivery, and the master-kill
+chaos acceptance.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.constants import EnvKey
+
+
+def _crash(master) -> None:
+    """Abrupt master death for in-process tests: the RPC server stops
+    answering and the state loop is frozen WITHOUT the final snapshot
+    (a SIGKILL writes nothing)."""
+    master._server.stop()
+    master.node_manager.stop()
+    if master.state_manager is not None:
+        master.state_manager._stopped.set()
+
+
+def _master(tmp_path, **kw):
+    from dlrover_tpu.master.job_master import JobMaster
+
+    kw.setdefault("job_name", "fo")
+    kw.setdefault("state_dir", str(tmp_path / "state"))
+    master = JobMaster(**kw)
+    master.prepare()
+    return master
+
+
+# ------------------------------------------------------- snapshot v2 units
+
+
+def test_ledger_groups_survive_restart_and_stay_separate(tmp_path):
+    """The satellite pin: master restart lands BETWEEN a fabric
+    writer's embedding ack and the dense rank-0 commit wait — the
+    restored ledger keeps the groups separate and the dense step still
+    commits."""
+    m1 = _master(tmp_path)
+    m1.servicer.handle(m.PersistAckReport(
+        node_id="emb-0", step=4, num_shards=1,
+        shard={"crc32": 7}, group="embedding", rid="e1",
+    ))
+    m1.servicer.handle(m.PersistAckReport(
+        node_id=1, step=4, num_shards=2, shard={"crc32": 8}, rid="d1",
+    ))
+    m1.state_manager.snapshot()
+    _crash(m1)
+
+    m2 = _master(tmp_path)
+    try:
+        assert m2.master_epoch == m1.master_epoch + 1
+        # embedding acks alone can never complete the dense commit
+        dense = m2.servicer.handle(
+            m.PersistStatusRequest(step=4, num_shards=2))
+        assert not dense.complete and sorted(dense.shards) == ["1"]
+        # ... and the late dense writer completes it on the NEW master
+        m2.servicer.handle(m.PersistAckReport(
+            node_id=0, step=4, num_shards=2, shard={"crc32": 9},
+            rid="d0",
+        ))
+        dense = m2.servicer.handle(
+            m.PersistStatusRequest(step=4, num_shards=2))
+        assert dense.complete and sorted(dense.shards) == ["0", "1"]
+        emb = m2.servicer.handle(
+            m.PersistStatusRequest(step=4, num_shards=1,
+                                   group="embedding"))
+        assert emb.complete and sorted(emb.shards) == ["emb-0"]
+    finally:
+        m2.stop()
+
+
+def test_rid_dedup_survives_restart(tmp_path):
+    m1 = _master(tmp_path)
+    m1.servicer.handle(m.FailureReport(node_id=3, rid="f-1"))
+    m1.state_manager.snapshot()
+    _crash(m1)
+    m2 = _master(tmp_path)
+    try:
+        # the redelivered replay must not double-count
+        m2.servicer.handle(m.FailureReport(node_id=3, rid="f-1"))
+        assert m2.node_manager._failure_counts.get(3, 0) == 0
+        m2.servicer.handle(m.FailureReport(node_id=3, rid="f-2"))
+        assert m2.node_manager._failure_counts[3] == 1
+    finally:
+        m2.stop()
+
+
+def test_rendezvous_round_monotonic_and_waiting_restored(tmp_path):
+    m1 = _master(tmp_path, min_nodes=2, max_nodes=2)
+    for nid, addr in ((0, "a:1"), (1, "b:1")):
+        m1.servicer.handle(m.JoinRendezvousRequest(
+            node_id=nid, addr=addr, local_devices=4))
+    w = m1.servicer.handle(m.CommWorldRequest(node_id=0))
+    assert w.completed and w.round == 1
+    # node 0 re-joins (respawn) and the master dies mid-rendezvous
+    m1.servicer.handle(m.JoinRendezvousRequest(
+        node_id=0, addr="a:1", local_devices=4))
+    m1.state_manager.snapshot()
+    _crash(m1)
+
+    m2 = _master(tmp_path, min_nodes=2, max_nodes=2)
+    try:
+        m2.servicer.handle(m.JoinRendezvousRequest(
+            node_id=1, addr="b:1", local_devices=4))
+        w2 = m2.servicer.handle(m.CommWorldRequest(node_id=0))
+        assert w2.completed
+        assert w2.round == 2  # continues the sequence, never reissued
+        assert w2.master_epoch == m2.master_epoch
+        assert sorted(w2.world) == [0, 1]
+    finally:
+        m2.stop()
+
+
+def test_compile_cache_spilled_and_served_warm(tmp_path):
+    blob = b"\x00executable\xff" * 9
+    m1 = _master(tmp_path)
+    m1.servicer.handle(m.CompileCachePutRequest(
+        node_id=0, key="n2t8/deadbeef", payload=blob,
+        meta={"jax": "x"}))
+    m1.state_manager.snapshot()
+    _crash(m1)
+    spill = tmp_path / "state" / "compile_cache"
+    assert (spill / "n2t8_deadbeef.aot").exists()
+
+    m2 = _master(tmp_path)
+    try:
+        got = m2.servicer.handle(
+            m.CompileCacheGetRequest(node_id=0, key="n2t8/deadbeef"))
+        assert got.found and got.payload == blob \
+            and got.meta == {"jax": "x"}
+    finally:
+        m2.stop()
+
+
+def test_corrupt_spilled_blob_drops_to_miss(tmp_path):
+    m1 = _master(tmp_path)
+    m1.servicer.handle(m.CompileCachePutRequest(
+        node_id=0, key="n2t8/feed", payload=b"Z" * 64))
+    m1.state_manager.snapshot()
+    _crash(m1)
+    path = tmp_path / "state" / "compile_cache" / "n2t8_feed.aot"
+    path.write_bytes(b"Y" * 64)  # same size, wrong bytes: CRC catches
+    m2 = _master(tmp_path)
+    try:
+        got = m2.servicer.handle(
+            m.CompileCacheGetRequest(node_id=0, key="n2t8/feed"))
+        assert not got.found  # a miss (recompile), never wrong bytes
+    finally:
+        m2.stop()
+
+
+def test_autopilot_budget_restored_as_spent():
+    from dlrover_tpu.autopilot.controller import AutopilotController
+    from dlrover_tpu.autopilot.planner import Plan
+
+    plan = Plan(name="p", pred_step_s=0.1, source="history",
+                fingerprint="p")
+    alt = Plan(name="q", pred_step_s=0.1, source="history",
+               fingerprint="q", rank=1)
+    c1 = AutopilotController(max_retunes=2, min_points=1,
+                             action_streak=1)
+    c1.arm(plan, [alt])
+    assert c1.observe_step_time(1.0) is not None  # one retune fired
+    state = c1.export_state()
+
+    c2 = AutopilotController(max_retunes=2, min_points=1,
+                             action_streak=1)
+    c2.restore_state(state)
+    assert c2.retunes_used == 1
+    assert c2.armed and c2.plan.fingerprint == "q"
+    # one more is within budget; the one after must be refused
+    assert c2.observe_step_time(1.0) is not None
+    assert c2.observe_step_time(1.0) is None
+    assert c2.retunes_used == 2
+
+
+def test_interval_tuner_ages_roundtrip():
+    from dlrover_tpu.checkpoint.interval_tuner import IntervalTuner
+
+    clock = [1000.0]
+    t1 = IntervalTuner(clock=lambda: clock[0])
+    t1.observe_failure()
+    clock[0] += 100
+    t1.observe_failure()
+    t1.observe_snapshot_cost(2.0)
+    t1.observe_step_time(0.5)
+    state = t1.export_state()
+
+    clock2 = [5.0]  # a fresh process: monotonic clock restarted
+    t2 = IntervalTuner(clock=lambda: clock2[0])
+    t2.restore_state(state)
+    assert t2.mtbf_s() == pytest.approx(t1.mtbf_s(), rel=1e-6)
+    assert t2.recommend() == t1.recommend()
+
+
+def test_v1_snapshot_still_restores_datasets(tmp_path):
+    from dlrover_tpu.master.state_store import (
+        FileStateBackend,
+        MasterStateManager,
+    )
+
+    m1 = _master(tmp_path)
+    backend = FileStateBackend(str(tmp_path / "v1.json"))
+    backend.save({"version": 1, "timestamp": time.time(),
+                  "job_name": "fo",
+                  "datasets": m1.task_manager.export_state()})
+    mgr = MasterStateManager(m1, backend)
+    assert mgr.restore()
+    assert mgr.restored_epoch == 0  # pre-epoch snapshot: fresh fence
+    _crash(m1)
+
+
+def test_legacy_pre_checksum_snapshot_journals(tmp_path, monkeypatch):
+    monkeypatch.setenv(EnvKey.JOURNAL_DIR, str(tmp_path))
+    from dlrover_tpu.master.state_store import FileStateBackend
+
+    path = tmp_path / "legacy.json"
+    path.write_text(json.dumps({"version": 1, "datasets": {}}))
+    state = FileStateBackend(str(path)).load()
+    assert state == {"version": 1, "datasets": {}}
+    events = [json.loads(line) for line in
+              open(tmp_path / "events.jsonl", encoding="utf-8")]
+    legacy = [e for e in events
+              if e["name"] == "state_legacy_snapshot"]
+    assert len(legacy) == 1 and legacy[0]["path"] == str(path)
+
+
+def test_state_manager_stop_joins_loop_thread(tmp_path):
+    from dlrover_tpu.master.state_store import (
+        FileStateBackend,
+        MasterStateManager,
+    )
+
+    m1 = _master(tmp_path / "m")
+    mgr = MasterStateManager(
+        m1, FileStateBackend(str(tmp_path / "s.json")),
+        interval_s=0.05, min_gap_s=0.0,
+    )
+    mgr.start()
+    time.sleep(0.12)
+    mgr.stop()
+    assert not mgr._thread.is_alive()  # no periodic writer survives
+    assert (tmp_path / "s.json").exists()  # the final snapshot landed
+    _crash(m1)
+
+
+# ---------------------------------------------------------- epoch fencing
+
+
+def test_rpc_envelope_carries_epoch(tmp_path):
+    from dlrover_tpu.common.rpc import RpcClient, RpcServer
+
+    epoch = [3]
+    server = RpcServer(lambda msg: m.OkResponse(), port=0,
+                       epoch_fn=lambda: epoch[0])
+    server.start()
+    try:
+        client = RpcClient(f"127.0.0.1:{server.port}")
+        seen: list[int] = []
+        client.on_epoch = seen.append
+        client.call(m.KVStoreGetRequest(key="k"))
+        epoch[0] = 4
+        client.call(m.KVStoreGetRequest(key="k"))
+        assert seen == [3, 4]
+        client.close()
+    finally:
+        server.stop()
+
+
+class _FenceTransport:
+    """Scripted transport: returns HeartbeatResponse with the current
+    epoch; records everything sent; raises while .down."""
+
+    def __init__(self):
+        self.epoch = 1
+        self.down = False
+        self.sent: list = []
+
+    def call(self, msg):
+        if self.down:
+            raise ConnectionError("down")
+        self.sent.append(msg)
+        if isinstance(msg, m.NodeHeartbeat):
+            return m.HeartbeatResponse(master_epoch=self.epoch)
+        return m.OkResponse()
+
+    def close(self):
+        pass
+
+
+def _client(transport):
+    from dlrover_tpu.agent.master_client import MasterClient
+
+    return MasterClient("127.0.0.1:1", 5, transport=transport)
+
+
+def test_epoch_change_runs_reconcile(monkeypatch, tmp_path):
+    monkeypatch.setenv(EnvKey.JOURNAL_DIR, str(tmp_path))
+    transport = _FenceTransport()
+    client = _client(transport)
+    client.report_heartbeat(0)          # adopt epoch 1: no reconcile
+    assert client.master_epoch == 1
+    assert not any(isinstance(s, m.NodeEventReport)
+                   for s in transport.sent)
+
+    transport.epoch = 2                  # master restarted
+    client.report_heartbeat(0)
+    assert client.master_epoch == 2
+    reregs = [s for s in transport.sent
+              if isinstance(s, m.NodeEventReport)]
+    assert len(reregs) == 1 and reregs[0].status == "running"
+    events = [json.loads(line) for line in
+              open(tmp_path / "events.jsonl", encoding="utf-8")]
+    rec = [e for e in events if e["name"] == "agent_reconcile"]
+    assert len(rec) == 1
+    assert (rec[0]["old_epoch"], rec[0]["new_epoch"]) == (1, 2)
+
+
+def test_stale_epoch_is_fenced_off():
+    transport = _FenceTransport()
+    transport.epoch = 5
+    client = _client(transport)
+    client.report_heartbeat(0)
+    transport.epoch = 3                  # zombie master answering late
+    client.report_heartbeat(0)
+    assert client.master_epoch == 5
+    assert not any(isinstance(s, m.NodeEventReport)
+                   for s in transport.sent)
+
+
+def test_reconcile_forces_full_metrics_push():
+    transport = _FenceTransport()
+    client = _client(transport)
+    fam = [{"name": "f", "type": "counter", "help": "", "buckets": [],
+            "samples": [{"labels": {}, "value": 1.0}]}]
+    client.report_metrics(fam)           # full (first push)
+    client.report_metrics(fam)           # unchanged -> delta
+    pushes = [s for s in transport.sent
+              if isinstance(s, m.MetricsSnapshotRequest)]
+    assert [p.is_delta for p in pushes] == [False, True]
+    client.report_heartbeat(0)
+    transport.epoch = 2
+    client.report_heartbeat(0)           # reconcile: force_full
+    client.report_metrics(fam)
+    pushes = [s for s in transport.sent
+              if isinstance(s, m.MetricsSnapshotRequest)]
+    assert pushes[-1].is_delta is False
+
+
+def test_redelivery_queue_replays_with_same_rid():
+    transport = _FenceTransport()
+    client = _client(transport)
+    client.report_heartbeat(0)
+    transport.down = True
+    client.report_persist_ack(7, 2, {"crc32": 1})   # must not raise
+    client.report_failure("exit code 9 (killed)")
+    assert client.redelivery_pending == 2
+    queued_rids = [q.rid for q in client._redelivery]
+    transport.down = False
+    client.report_heartbeat(0)           # reachable again: drain
+    assert client.redelivery_pending == 0
+    acks = [s for s in transport.sent
+            if isinstance(s, m.PersistAckReport)]
+    fails = [s for s in transport.sent
+             if isinstance(s, m.FailureReport)]
+    assert [a.rid for a in acks] + [f.rid for f in fails] == queued_rids
+
+
+def test_redelivery_queue_bounded(monkeypatch):
+    monkeypatch.setenv(EnvKey.REDELIVERY_QUEUE, "3")
+    transport = _FenceTransport()
+    transport.down = True
+    client = _client(transport)
+    for step in range(5):
+        client.report_persist_ack(step, 1, {})
+    assert client.redelivery_pending == 3
+    assert [q.step for q in client._redelivery] == [2, 3, 4]
+
+
+def test_maybe_redial_follows_port_file(monkeypatch, tmp_path):
+    from dlrover_tpu.common.rpc import RpcClient, RpcServer
+    from dlrover_tpu.common.storage import atomic_write_file
+
+    port_file = tmp_path / "port"
+    monkeypatch.setenv(EnvKey.MASTER_PORT_FILE, str(port_file))
+    server = RpcServer(lambda msg: m.OkResponse(), port=0,
+                       epoch_fn=lambda: 2)
+    server.start()
+    try:
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        client = MasterClient(
+            "127.0.0.1:1",  # a dead address
+            5, transport=RpcClient("127.0.0.1:1", retries=1,
+                                   deadline_s=1.0),
+        )
+        atomic_write_file(str(server.port), str(port_file))
+        assert client.maybe_redial()
+        assert client._client.addr == f"127.0.0.1:{server.port}"
+        # the cloned client keeps the retry config and the epoch hook
+        assert client._client._retries == 1
+        client.kv_set("k", b"v")         # proves the new link works
+        assert client.master_epoch == 2  # envelope observed post-clone
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------------- degraded link
+
+
+def test_master_link_one_instant_plus_counter(monkeypatch, tmp_path):
+    monkeypatch.setenv(EnvKey.JOURNAL_DIR, str(tmp_path))
+    from dlrover_tpu.agent.master_link import (
+        MasterLink,
+        _unreachable_total,
+    )
+
+    link = MasterLink(object(), component="agent", warn_every_s=60.0)
+    base = _unreachable_total.labels("agent").value
+    for _ in range(5):
+        link.failed(ConnectionError("refused"))
+    assert link.degraded
+    assert _unreachable_total.labels("agent").value == base + 5
+    link.ok()
+    link.ok()                            # idempotent exit
+    assert not link.degraded
+    events = [json.loads(line) for line in
+              open(tmp_path / "events.jsonl", encoding="utf-8")]
+    modes = [(e["component"], e["state"]) for e in events
+             if e["name"] == "degraded_mode"]
+    assert modes == [("agent", "enter"), ("agent", "exit")]
+
+
+# ------------------------------------------- the chaos acceptance (§26.4)
+
+
+def test_master_kill_scenario_replay_identical(tmp_path):
+    """The §26 acceptance: a REAL master subprocess SIGKILLed
+    mid-rendezvous, mid-commit-wait, mid-retune and post-retune; the
+    in-flight step commits, groups stay separate, the compile cache
+    answers warm, the retune budget is charged exactly once, trainers
+    never restart — and two seeded runs produce identical trails."""
+    from dlrover_tpu.chaos.scenario import run_master_kill_scenario
+
+    r1 = run_master_kill_scenario(str(tmp_path / "run1"), seed=4242)
+    r1.assert_invariants()
+    r2 = run_master_kill_scenario(str(tmp_path / "run2"), seed=4242)
+    r2.assert_invariants()
+    assert r1.trail == r2.trail
+
+
+# ------------------------------------------------- fleetsim master restart
+
+
+def test_fleetsim_master_restart_reconverges():
+    from dlrover_tpu.fleetsim.profile import FleetProfile
+    from dlrover_tpu.fleetsim.sim import FleetSimulator
+
+    profile = FleetProfile(
+        name="mr", seed=11, nodes=200, duration_s=40.0,
+        failures=0, deaths=0, ckpt_interval_s=25.0,
+        straggler_frac=0.0, master_restarts=1,
+    )
+    res = FleetSimulator(profile).run()
+    assert res.master_recovery_s is not None
+    # bounded by the (staggered) heartbeat cadence
+    assert res.master_recovery_s <= profile.heartbeat_interval_s + 1.0
+    counts = [n for _, n in res.reregistered_curve]
+    assert counts == sorted(counts) and counts[-1] == profile.nodes
+    kinds = {e[0] for e in res.trail["events"]}
+    assert {"master_restart", "master_recovered"} <= kinds
+    # the §26 fleetsim contract: the measurement is virtual-time and
+    # the trail seeded — a replay is identical, recovery included
+    res2 = FleetSimulator(profile).run()
+    assert res2.trail == res.trail
+    assert res2.master_recovery_s == res.master_recovery_s
