@@ -1411,6 +1411,54 @@ def bench_chaos(extra: dict, stage_budget_s: float = 300.0) -> None:
         extra["chaos_verified_step"] = res.verified_step
         if res.recovery_seconds is not None:
             extra["chaos_recovery_seconds"] = round(res.recovery_seconds, 2)
+            # §27 reconciliation: the assembled incident tree must
+            # contain the respawned trainer's ckpt_restore (attached
+            # via SPAN_CTX), and kill -> that restore must agree with
+            # chaos_recovery_seconds within 10% — disagreement means
+            # the trace fabric lost a recovery hop
+            try:
+                from dlrover_tpu.chaos.scenario import _read_journal
+                from dlrover_tpu.telemetry import trace as trace_mod
+
+                jdir = os.path.join(work, "journal")
+                t_kill = next(
+                    (e["t"] for e in _read_journal(jdir)
+                     if e.get("name") == "chaos_fault"
+                     and e.get("point") == "agent_kill_trainer"), None)
+                incidents = [
+                    r for r in trace_mod.find_incident_roots(
+                        trace_mod.build_forest(
+                            trace_mod.load_spans([jdir])))
+                    if r.span.fields.get("kind") == "failure"
+                    and (t_kill is None or r.end > t_kill)]
+                if t_kill is None or not incidents:
+                    raise RuntimeError(
+                        "no failure incident tree after the kill")
+                inc = min(incidents, key=lambda n: n.start)
+                restores = [n for n in inc.walk()
+                            if n.span.name == "ckpt_restore"]
+                if not restores:
+                    raise RuntimeError(
+                        "no ckpt_restore attached under the incident")
+                trace_rec = min(r.end for r in restores) - t_kill
+                segs = trace_mod.critical_path(inc)
+                top = max(segs, key=lambda s: s["self_s"])
+                frac = abs(trace_rec - res.recovery_seconds) \
+                    / max(res.recovery_seconds, 1e-9)
+                extra["chaos_trace_recovery_s"] = round(trace_rec, 2)
+                extra["chaos_trace_critical_path_top"] = (
+                    f"{top['name']}={top['self_s']:.2f}s")
+                extra["chaos_trace_agreement_frac"] = round(frac, 4)
+                extra["chaos_trace_agrees_10pct"] = frac <= 0.10
+                if frac > 0.10:
+                    raise RuntimeError(
+                        f"incident trace recovery {trace_rec:.2f}s vs "
+                        f"chaos_recovery_seconds "
+                        f"{res.recovery_seconds:.2f}s: off by "
+                        f"{frac:.0%}")
+            except Exception as e:  # noqa: BLE001 - keep stage numbers
+                extra["chaos_trace_error"] = repr(e)
+                extra.setdefault("chaos_trace_agrees_10pct", False)
         if res.goodput is not None:
             # goodput of the sabotaged leg: restart + re-join retries +
             # rolled-back steps all charged, same accounting as the
@@ -1900,7 +1948,18 @@ def bench_gateway(extra: dict) -> None:
     del probe
 
     unified = run_leg(disagg=False)
-    disagg = run_leg(disagg=True)
+    # journal the disagg leg (§27): the assembled request traces and
+    # their critical paths ship as headline evidence below
+    trace_dir = tempfile.mkdtemp(prefix="bench_gw_trace_")
+    prev_jdir = os.environ.get("DLROVER_TPU_JOURNAL_DIR")
+    os.environ["DLROVER_TPU_JOURNAL_DIR"] = trace_dir
+    try:
+        disagg = run_leg(disagg=True)
+    finally:
+        if prev_jdir is None:
+            os.environ.pop("DLROVER_TPU_JOURNAL_DIR", None)
+        else:
+            os.environ["DLROVER_TPU_JOURNAL_DIR"] = prev_jdir
 
     # decode-stall p99 from the disagg leg's PRE-KILL histogram delta,
     # expressed in single-chunk units: the tentpole's bounded-stall
@@ -1945,6 +2004,37 @@ def bench_gateway(extra: dict) -> None:
         f"kill@backlog<{n_requests // 4} (both legs) vs unified "
         f"x{replicas} dense"
     )
+
+    # assemble the disagg leg's request traces (§27): the slowest
+    # request's critical path names where its TTFT went, and the phase
+    # children must tile its wall (the 5% acceptance bound lives in
+    # tests/test_gateway.py — here the fraction is evidence)
+    import shutil
+    try:
+        from dlrover_tpu.telemetry import trace as trace_mod
+        roots = trace_mod.build_forest(
+            trace_mod.load_spans([trace_dir]))
+        reqs = [r for r in trace_mod.find_request_roots(roots)
+                if r.span.fields.get("disagg")]
+        if reqs:
+            slowest = max(reqs, key=lambda n: n.dur)
+            segs = trace_mod.critical_path(slowest)
+            top = max(segs, key=lambda s: s["self_s"])
+            phases = trace_mod.request_phases(slowest)
+            phase_sum = sum(v for k, v in phases.items()
+                            if k != "wall_s")
+            extra["gateway_trace_requests"] = len(reqs)
+            extra["gateway_trace_critical_path_s"] = round(
+                slowest.dur, 4)
+            extra["gateway_trace_critical_path_hops"] = len(segs)
+            extra["gateway_trace_critical_path_top"] = (
+                f"{top['name']}={top['self_s']:.4f}s")
+            extra["gateway_trace_phase_sum_frac"] = round(
+                phase_sum / max(slowest.dur, 1e-9), 4)
+    except Exception as e:  # noqa: BLE001 - trace evidence is a rider
+        extra["gateway_trace_error"] = repr(e)
+    finally:
+        shutil.rmtree(trace_dir, ignore_errors=True)
 
 
 def bench_int8(extra: dict) -> None:

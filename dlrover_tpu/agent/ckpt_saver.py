@@ -340,9 +340,12 @@ class AsyncCheckpointSaver:
                 json.dumps(shard_entry),
                 os.path.join(sdir, done_marker(self.node_id, num_shards)),
             )
+            # inside the span on purpose (§27): the ack report captures
+            # this ckpt_persist context at mint, so the master's ledger
+            # entry — even a redelivered one — joins this trace tree
+            self._ack_persist(step, num_shards, shard_entry)
         _persist_seconds.observe(time.monotonic() - start)
         _persist_bytes.inc(len(content))
-        self._ack_persist(step, num_shards, shard_entry)
         self._maybe_commit(storage, header, step,
                            block_s=commit_block_s)
         logger.info(

@@ -41,7 +41,11 @@ from dlrover_tpu.common.constants import (
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.common.rpc import find_free_port
 from dlrover_tpu.agent.master_client import MasterClient
-from dlrover_tpu.telemetry.journal import get_journal, set_trace_id
+from dlrover_tpu.telemetry.journal import (
+    current_ctx,
+    get_journal,
+    set_trace_id,
+)
 from dlrover_tpu.telemetry.metrics import registry
 
 logger = get_logger(__name__)
@@ -145,6 +149,9 @@ class ElasticAgent:
         self._standby = None  # agent/standby.py StandbyManager
         self._node_rank = -1
         self._pending_action = ""
+        # span context (§27) of the config push that requested a restart:
+        # the planned node_restart attaches under the master's verdict
+        self._pending_restart_sctx = ""
         self._action_lock = threading.Lock()
         self._hang = None
         if config.hang_timeout_s > 0:
@@ -202,6 +209,7 @@ class ElasticAgent:
         get_journal().emit(
             "rendezvous_wait", dur=waited, round=world.round,
             rank=self._node_rank, nodes=len(world.world),
+            remote_parent=world.sctx,
         )
         logger.info(
             "rendezvous round %d: rank %d of %d nodes, coordinator %s",
@@ -327,6 +335,10 @@ class ElasticAgent:
             # a parked standby was spawned before the first rendezvous
             # delivered the job trace id: promotion must carry it
             update[EnvKey.TRACE_ID] = trace
+        # span context (§27): a child spawned inside a recovery incident
+        # attaches its restore/recompile spans under it. Unconditional so
+        # a stale inherited value never leaks into a healthy incarnation.
+        update[EnvKey.SPAN_CTX] = current_ctx()
         if self._config_tuner is not None:
             update[EnvKey.PARAL_CONFIG_PATH] = self._config_tuner.path
         return update
@@ -580,17 +592,20 @@ class ElasticAgent:
                 extra={"exit_code": exit_code, "reason": reason.value,
                        "action": action.value},
             )
-        self._client.report_failure(
-            error_data=f"exit code {exit_code} ({reason.value})",
-            restart_count=self._restart_count,
-            level=(
-                TrainingExceptionLevel.NODE_ERROR
-                if reason in (NodeExitReason.HARDWARE_ERROR,
-                              NodeExitReason.OOM)
-                else TrainingExceptionLevel.PROCESS_ERROR
-            ),
-        )
+        def _report_failure() -> None:
+            self._client.report_failure(
+                error_data=f"exit code {exit_code} ({reason.value})",
+                restart_count=self._restart_count,
+                level=(
+                    TrainingExceptionLevel.NODE_ERROR
+                    if reason in (NodeExitReason.HARDWARE_ERROR,
+                                  NodeExitReason.OOM)
+                    else TrainingExceptionLevel.PROCESS_ERROR
+                ),
+            )
+
         if action == FailureAction.RELAUNCH_NODE:
+            _report_failure()
             # persist the snapshot first: the replacement host restores
             # from storage, not from this host's shm
             self._persist_checkpoint(reason="node relaunch")
@@ -600,6 +615,7 @@ class ElasticAgent:
             )
             return RunResult.NODE_RELAUNCH
         if action == FailureAction.GIVE_UP:
+            _report_failure()
             logger.error(
                 "no failovers remain (%d used); job failed",
                 self._restart_count,
@@ -620,6 +636,11 @@ class ElasticAgent:
             "node_restart", kind="failure", exit_code=exit_code,
             incarnation=self._incarnation + 1,
         ):
+            # incident root (§27): opened at failure detection so the
+            # failure report and every recovery phase below — persist,
+            # rendezvous, restore, respawn, and the trainer child's own
+            # restore/recompile (via SPAN_CTX) — journal as children
+            _report_failure()
             self._persist_checkpoint(reason="process failure")
             # the persist is durable: the standby's restore prefetch can
             # now run concurrently with the rendezvous round below
@@ -650,9 +671,12 @@ class ElasticAgent:
         only, training.py:594)."""
         logger.info("restarting workers: %s", reason)
         _restarts_total.labels("planned").inc()
+        with self._action_lock:
+            push_sctx = self._pending_restart_sctx
+            self._pending_restart_sctx = ""
         with get_journal().span(
             "node_restart", kind="planned", reason=reason,
-            incarnation=self._incarnation + 1,
+            incarnation=self._incarnation + 1, remote_parent=push_sctx,
         ):
             self._persist_checkpoint(reason=reason)
             self._kill_child()
@@ -765,6 +789,7 @@ class ElasticAgent:
                 # recompile-class knobs apply at the next incarnation
                 with self._action_lock:
                     self._pending_action = "restart"
+                    self._pending_restart_sctx = config.get("sctx", "")
 
         self._config_tuner = ParalConfigTuner(
             self._client, on_update=on_update

@@ -54,6 +54,16 @@ def _failover_metrics():
     return _reconcile_total, _redelivery_total
 
 
+def _mint_sctx() -> str:
+    """Span context (§27) captured at message MINT time: a queued
+    report replayed by flush_redelivery later must carry the context
+    of the work that produced it, not of the reconcile that flushed
+    it. Import is local to keep this module import-light."""
+    from dlrover_tpu.telemetry.journal import current_ctx
+
+    return current_ctx()
+
+
 class MasterClient:
     _instance: Optional["MasterClient"] = None
     _instance_lock = threading.Lock()
@@ -408,7 +418,7 @@ class MasterClient:
                 node_id=(self.node_id if writer_id is None
                          else writer_id),
                 step=step, num_shards=num_shards, shard=shard,
-                group=group, rid=uuid.uuid4().hex,
+                group=group, rid=uuid.uuid4().hex, sctx=_mint_sctx(),
             )
         )
 
@@ -478,7 +488,7 @@ class MasterClient:
             m.FailureReport(
                 node_id=self.node_id, restart_count=restart_count,
                 level=level, error_data=error_data,
-                rid=uuid.uuid4().hex,
+                rid=uuid.uuid4().hex, sctx=_mint_sctx(),
             )
         )
 

@@ -49,7 +49,11 @@ from dlrover_tpu.common import envspec
 from dlrover_tpu.common.constants import EnvKey
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.telemetry.anomaly import _step_stats
-from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.journal import (
+    current_trace_id,
+    format_ctx,
+    get_journal,
+)
 from dlrover_tpu.telemetry.metrics import registry
 
 logger = get_logger(__name__)
@@ -108,6 +112,9 @@ class RetuneDecision:
     to_plan: Plan
     path: str
     evidence: dict
+    # span context (§27) of the journaled autopilot_retune verdict —
+    # the ParalConfig push and the trainer's apply journal as children
+    sctx: str = ""
 
 
 class _NodeSteps:
@@ -365,7 +372,7 @@ class AutopilotController:
 
     def _publish(self, decision: RetuneDecision) -> None:
         _retunes_total.labels(decision.path).inc()
-        get_journal().emit(
+        verdict_span = get_journal().emit(
             "autopilot_retune",
             from_plan=decision.from_plan.name,
             from_fingerprint=decision.from_plan.fingerprint,
@@ -375,6 +382,7 @@ class AutopilotController:
             path=decision.path,
             **decision.evidence,
         )
+        decision.sctx = format_ctx(current_trace_id(), verdict_span)
         logger.warning(
             "autopilot retune: %s -> %s via %s (measured %.4fs vs "
             "pred %.4fs, streak %d, %d/%d retunes)",

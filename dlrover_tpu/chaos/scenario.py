@@ -289,6 +289,14 @@ def run_scenario(scenario: Scenario, work_dir: str, *,
                 EnvKey.CHAOS: plan_path,
                 EnvKey.JOURNAL_DIR: journal_dir,
                 EnvKey.IPC_DIR: ipc_dir,
+                # deterministic span ids (§27): two runs of the same
+                # seeded scenario assemble byte-identical trace trees.
+                # The leg name is part of the seed — every leg restarts
+                # its processes (resetting the per-process span counter),
+                # so legs sharing a seed would repeat id streams into the
+                # same journal and collide in the assembler's id map
+                EnvKey.TRACE_SEED:
+                    f"{scenario.name}:{leg.name}:{scenario.seed}",
                 "PYTHONPATH": (env.get("PYTHONPATH", "")
                                + os.pathsep + REPO),
             })
@@ -876,6 +884,7 @@ def run_master_kill_scenario(work_dir: str, *, seed: int = 4242
     env.update({
         EnvKey.JOURNAL_DIR: journal_dir,
         EnvKey.TRACE_ID: f"mk{seed}",
+        EnvKey.TRACE_SEED: f"mk:{seed}",
         # budget 1 makes "not double-charged" sharp: one retune total,
         # across however many master incarnations
         EnvKey.AUTOPILOT_MAX_RETUNES: "1",

@@ -32,7 +32,7 @@ from dlrover_tpu.common.constants import CheckpointStorageType, EnvKey
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.common.multi_process import SharedQueue, client_socket_ready
 from dlrover_tpu.common.storage import CheckpointStorage, PosixDiskStorage
-from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.journal import get_journal, spawn_ctx
 from dlrover_tpu.telemetry.metrics import registry
 from dlrover_tpu.checkpoint.shm_handler import (
     SharedMemoryHandler,
@@ -57,7 +57,10 @@ _snapshot_seconds = registry().histogram(
 def _record_restore(engine: str, start_monotonic: float, step: int) -> None:
     dur = time.monotonic() - start_monotonic
     _restore_seconds.labels(engine).observe(dur)
-    get_journal().emit("ckpt_restore", dur=dur, step=step, engine=engine)
+    # spawn_ctx (§27): a restore in a child respawned during a recovery
+    # incident journals under that incident's node_restart root
+    get_journal().emit("ckpt_restore", dur=dur, step=step, engine=engine,
+                       remote_parent=spawn_ctx())
 
 
 @dataclasses.dataclass
@@ -215,7 +218,7 @@ class RestorePrefetch:
             get_journal().emit(
                 "restore_prefetch", dur=dur,
                 step=self._result[0] if self._result else -1,
-                ok=self._error is None,
+                ok=self._error is None, remote_parent=spawn_ctx(),
             )
 
     def join(self, timeout: float = 120.0

@@ -34,7 +34,11 @@ import time
 from collections import deque
 
 from dlrover_tpu.common.log import get_logger
-from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.journal import (
+    current_trace_id,
+    format_ctx,
+    get_journal,
+)
 from dlrover_tpu.telemetry.metrics import registry
 
 logger = get_logger(__name__)
@@ -100,6 +104,8 @@ class IntervalTuner:
         self._step_s: float | None = None
         self._current = int(initial_steps)
         self._retunes = 0
+        # span context (§27) of the most recent retune verdict
+        self.last_retune_sctx = ""
 
     # -------------------------------------------------------- observations
 
@@ -235,6 +241,11 @@ class IntervalTuner:
             }
         _interval_gauge.set(rec)
         _retunes_total.inc()
-        get_journal().emit("snapshot_interval_retune", **evidence)
+        verdict_span = get_journal().emit("snapshot_interval_retune",
+                                          **evidence)
+        # span context (§27) of this verdict: the servicer stamps it on
+        # the ParalConfig push so the retune's application traces back
+        self.last_retune_sctx = format_ctx(current_trace_id(),
+                                           verdict_span)
         logger.info("snapshot interval retuned: %s", evidence)
         return rec

@@ -139,6 +139,19 @@ class EnvKey:
     JOURNAL_DIR = "DLROVER_TPU_JOURNAL_DIR"
     TRACE_ID = "DLROVER_TPU_TRACE_ID"
     LOG_JSON = "DLROVER_TPU_LOG_JSON"
+    # causal trace fabric (DESIGN.md §27): head-sampling rate for
+    # per-request serving traces (incidents/control-plane are always
+    # sampled), the seed that makes span ids deterministic under the
+    # chaos/fleetsim replay discipline, and the spawn-time span context
+    # an agent hands its children so trainer-side recovery spans attach
+    # under the incident that respawned them
+    TRACE_SAMPLE = "DLROVER_TPU_TRACE_SAMPLE"
+    TRACE_SEED = "DLROVER_TPU_TRACE_SEED"
+    SPAN_CTX = "DLROVER_TPU_SPAN_CTX"
+    # span-id namespace: disambiguates co-located processes that would
+    # otherwise share a deterministic id stream under TRACE_SEED (the
+    # standalone master and the agent both run with no NODE_ID)
+    SPAN_NS = "DLROVER_TPU_SPAN_NS"
     # flight recorder (telemetry/bundle.py, telemetry/journal.py): where
     # crash/hang debug bundles land (default <journal dir>/bundles), the
     # journal size cap in MB (0/unset = unbounded), and the "1"-default

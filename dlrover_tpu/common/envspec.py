@@ -173,6 +173,24 @@ SPECS: tuple[EnvVar, ...] = (
     EnvVar("DLROVER_TPU_TRACE_ID", None,
            "job-wide trace id minted by the master; adopted via the "
            "rendezvous payload", "§12"),
+    EnvVar("DLROVER_TPU_TRACE_SAMPLE", "1.0",
+           "head-sampling rate [0,1] for per-request serving traces; "
+           "incidents and control-plane traces are always sampled",
+           "§27"),
+    EnvVar("DLROVER_TPU_TRACE_SEED", None,
+           "makes span ids deterministic (per-name counter streams) "
+           "so seeded chaos/fleetsim runs produce byte-identical trace "
+           "trees; unset = random ids", "§27"),
+    EnvVar("DLROVER_TPU_SPAN_NS", None,
+           "internal: span-id namespace disambiguating co-located "
+           "processes (e.g. the standalone master) in the TRACE_SEED "
+           "deterministic id stream", "§27",
+           restart_required=True),
+    EnvVar("DLROVER_TPU_SPAN_CTX", None,
+           "internal: spawn-time span context (trace:span) the agent "
+           "hands its children so recovery spans attach under the "
+           "incident that respawned them", "§27",
+           restart_required=True),
     EnvVar("DLROVER_TPU_LOG_JSON", None,
            "'1' switches process logs to JSON lines", "§12",
            restart_required=True),

@@ -79,6 +79,9 @@ class CommWorldResponse:
     reshard: bool = False
     # epoch fence (§26): see HeartbeatResponse.master_epoch
     master_epoch: int = 0
+    # span context (§27) of the master's rendezvous round — agents link
+    # their rendezvous_wait span to the round that admitted them
+    sctx: str = ""
 
 
 @register_message
@@ -224,6 +227,10 @@ class FailureReport:
     # redelivered failure from double-counting in the MTBF window or
     # the per-node failure ladder. "" = pre-failover client, no dedup.
     rid: str = ""
+    # span context (§27) captured when the report was MINTED — a
+    # redelivery after a master restart replays the original context,
+    # so incident trees survive the restart (never re-stamped at flush)
+    sctx: str = ""
 
 
 @register_message
@@ -624,6 +631,10 @@ class PersistAckReport:
     # already idempotent per (step, world, group, writer); the rid makes
     # the replay observable and uniform across redelivered kinds.
     rid: str = ""
+    # span context (§27) captured at mint time, inside the writer's
+    # ckpt_persist span — a checkpoint commit traces to every writer,
+    # and a post-restart redelivery keeps the ORIGINAL context
+    sctx: str = ""
 
 
 @register_message
@@ -692,6 +703,9 @@ class ParalConfig:
     # this flag asks the agent to restart workers to apply them
     restart_required: bool = False
     version: int = 0
+    # span context (§27) of the verdict that produced this config —
+    # master-initiated retunes/restarts journal as its children
+    sctx: str = ""
 
 
 @register_message

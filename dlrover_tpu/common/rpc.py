@@ -24,6 +24,7 @@ from typing import Any, Callable, Optional
 from dlrover_tpu import chaos
 from dlrover_tpu.common import serde
 from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry.journal import adopt_remote_ctx, current_ctx
 from dlrover_tpu.telemetry.metrics import registry
 
 logger = get_logger(__name__)
@@ -135,13 +136,18 @@ class RpcServer:
         try:
             obj = json.loads(raw.decode("utf-8"))
             rid = obj.pop("rid", None)
+            # span context (DESIGN.md §27): the caller's trace:span,
+            # riding beside rid/me — adopt it for the handler so every
+            # journal emission inside is a child of the caller's span
+            sctx = obj.pop("sctx", "")
             if rid is not None:
                 with self._replay_lock:
                     cached = self._replay.get(rid)
                 if cached is not None:
                     return cached
             msg = serde.decode_obj(obj)
-            resp = self._handler(msg)
+            with adopt_remote_ctx(sctx):
+                resp = self._handler(msg)
             if resp is None:
                 resp = RpcError()
             out = serde.encode_obj(resp)
@@ -256,6 +262,9 @@ class RpcClient:
         """
         env = serde.encode_obj(msg)
         env["rid"] = uuid.uuid4().hex
+        sctx = current_ctx()
+        if sctx:
+            env["sctx"] = sctx
         payload = json.dumps(env).encode("utf-8")
         deadline = time.monotonic() + self._deadline_s
         last_err: Exception | None = None

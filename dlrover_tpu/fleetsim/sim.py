@@ -268,6 +268,10 @@ class FleetSimulator:
 
         p = self.profile
         prev_trace = os.environ.get(EnvKey.TRACE_ID)
+        # deterministic span ids (§27): a seeded sim's journal trees
+        # are byte-identical across replays
+        prev_trace_seed = os.environ.get(EnvKey.TRACE_SEED)
+        os.environ[EnvKey.TRACE_SEED] = f"fleetsim:{p.seed}"
         t_wall = time.perf_counter()
         # an in-memory state backend from the start: the §26 restart
         # event snapshots the live master and rebuilds a new one from
@@ -357,6 +361,10 @@ class FleetSimulator:
                 os.environ.pop(EnvKey.TRACE_ID, None)
             else:
                 os.environ[EnvKey.TRACE_ID] = prev_trace
+            if prev_trace_seed is None:
+                os.environ.pop(EnvKey.TRACE_SEED, None)
+            else:
+                os.environ[EnvKey.TRACE_SEED] = prev_trace_seed
 
         flagged = sorted(self._master.anomaly.stragglers())
         for node in flagged:

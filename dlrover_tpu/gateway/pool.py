@@ -95,6 +95,15 @@ class RequestWork:
     # per-token arrival stamps (the bench's inter-token-latency p95
     # source); reset with first_token_t on resubmission
     token_times: list = dataclasses.field(default_factory=list)
+    # causal trace (§27): pre-minted root span id + trace:span context
+    # ("" = head-sampled out); the engines this request touches attach
+    # their admit/handoff/prefill spans under the root before the
+    # gateway writes its retroactive gateway_request point
+    span_id: str = ""
+    sctx: str = ""
+    # disaggregated TTFT decomposition stamps (monotonic clock)
+    prefill_done_t: float = 0.0
+    decode_dispatch_t: float = 0.0
 
 
 class EngineReplica:
@@ -252,18 +261,23 @@ class EngineReplica:
                     # the prefill dispatch starts the service clock and
                     # the decode dispatch must not reset it
                     work.dispatch_t = time.monotonic()
+                if work.bundle is not None and not work.decode_dispatch_t:
+                    work.decode_dispatch_t = time.monotonic()
                 work.replica_id = self.id
+                # sctx only when sampled: fake/minimal engines in tests
+                # that predate the kwarg stay callable
+                extra = {"sctx": work.sctx} if work.sctx else {}
                 try:
                     if work.bundle is not None:
                         rid = engine.submit_prefilled(
                             work.prompt, work.params,
                             bundle=work.bundle,
-                            on_token=self._token_cb(work),
+                            on_token=self._token_cb(work), **extra,
                         )
                     else:
                         rid = engine.submit(
                             work.prompt, work.params,
-                            on_token=self._token_cb(work),
+                            on_token=self._token_cb(work), **extra,
                         )
                 except Exception as e:  # noqa: BLE001 - a bad request
                     # (prompt too long etc.) fails ITS future only

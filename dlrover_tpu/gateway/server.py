@@ -44,7 +44,13 @@ from dlrover_tpu.gateway.pool import ReplicaPool, RequestWork
 from dlrover_tpu.gateway.router import Router
 from dlrover_tpu.serving import SamplingParams
 from dlrover_tpu.telemetry.exposition import CONTENT_TYPE, render
-from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.journal import (
+    current_trace_id,
+    format_ctx,
+    get_journal,
+    mint_span_id,
+    should_sample,
+)
 from dlrover_tpu.telemetry.metrics import registry
 
 logger = get_logger(__name__)
@@ -247,6 +253,12 @@ class Gateway:
             id=rid, prompt=list(prompt), params=params,
             future=Future(), submit_t=time.monotonic(),
         )
+        if get_journal().enabled and should_sample(f"req:{rid}"):
+            # pre-mint the trace root (§27): the prefill/decode engines
+            # attach children under it while the request is in flight;
+            # the retroactive gateway_request point reuses this id
+            work.span_id = mint_span_id("gateway_request")
+            work.sctx = format_ctx(current_trace_id(), work.span_id)
         if not self._try_dispatch(work):
             with self._undispatched_lock:
                 self._undispatched.append(work)
@@ -373,6 +385,7 @@ class Gateway:
     def _on_prefilled(self, work: RequestWork, res: Any) -> None:
         """Prefill-pool completion hook: attach the KV bundle and hand
         the request to the decode pool."""
+        work.prefill_done_t = time.monotonic()
         work.bundle = res.bundle
         if not self._try_dispatch(work):
             with self._undispatched_lock:
@@ -406,6 +419,9 @@ class Gateway:
             work.attempts += 1
             work.first_token_t = 0.0
             work.token_times = []
+            work.decode_dispatch_t = 0.0
+            if work.bundle is None:
+                work.prefill_done_t = 0.0
             with self._undispatched_lock:
                 self._undispatched.append(work)
 
@@ -422,17 +438,7 @@ class Gateway:
         _requests_total.labels("200").inc()
         _request_seconds.labels(res.finish_reason).observe(total)
         _queue_seconds.observe(queue_s)
-        journal = get_journal()
-        parent = journal.emit(
-            "gateway_request", dur=total, request=work.id,
-            replica=work.replica_id, attempts=work.attempts,
-            finish=res.finish_reason, tokens=len(res.tokens),
-        )
-        journal.emit("gateway_queue", parent=parent, dur=queue_s)
-        journal.emit("gateway_route", parent=parent,
-                     replica=work.replica_id)
-        journal.emit("gateway_prefill", parent=parent, dur=prefill_s)
-        journal.emit("gateway_decode", parent=parent, dur=decode_s)
+        self._journal_request(work, res, done_t)
         if not work.future.done():
             work.future.set_result(GatewayResult(
                 id=work.id, tokens=list(res.tokens),
@@ -442,6 +448,57 @@ class Gateway:
                 decode_s=decode_s,
                 token_times=list(work.token_times),
             ))
+
+    def _journal_request(self, work: RequestWork, res: Any,
+                         done_t: float) -> None:
+        """Retroactive causal tree of one finished request (§27): the
+        pre-minted ``gateway_request`` root plus phase children placed
+        at their true wall times, so the phase durations exactly tile
+        [submit, done] and ``telemetry/trace.py`` can decompose TTFT.
+        Skipped entirely when the request was head-sampled out."""
+        journal = get_journal()
+        if not journal.enabled or not work.span_id:
+            return
+        now_wall = time.time()
+
+        def wall(mono: float) -> float:
+            # monotonic stamp -> the wall time the same instant had
+            return round(now_wall - (done_t - mono), 6)
+
+        total = done_t - work.submit_t
+        first = work.first_token_t or done_t
+        parent = journal.emit(
+            "gateway_request", dur=total, rid=work.id,
+            replica=work.replica_id, attempts=work.attempts,
+            finish=res.finish_reason, tokens=len(res.tokens),
+            span_id=work.span_id, disagg=work.bundle is not None,
+        )
+        journal.emit("gateway_queue", parent=parent,
+                     dur=max(0.0, work.dispatch_t - work.submit_t),
+                     t=wall(work.dispatch_t))
+        journal.emit("gateway_route", parent=parent, dur=0.0,
+                     replica=work.replica_id, t=wall(work.dispatch_t))
+        if work.bundle is not None and work.prefill_done_t:
+            # disaggregated TTFT: prefill chunks, bundle handoff +
+            # decode-pool queue, then install-to-first-token
+            decode_disp = work.decode_dispatch_t or work.prefill_done_t
+            journal.emit(
+                "gateway_prefill", parent=parent,
+                dur=max(0.0, work.prefill_done_t - work.dispatch_t),
+                t=wall(work.prefill_done_t))
+            journal.emit(
+                "gateway_handoff", parent=parent,
+                dur=max(0.0, decode_disp - work.prefill_done_t),
+                t=wall(decode_disp))
+            journal.emit(
+                "gateway_decode_first", parent=parent,
+                dur=max(0.0, first - decode_disp), t=wall(first))
+        else:
+            journal.emit(
+                "gateway_prefill", parent=parent,
+                dur=max(0.0, first - work.dispatch_t), t=wall(first))
+        journal.emit("gateway_decode", parent=parent,
+                     dur=max(0.0, done_t - first), t=wall(done_t))
 
     def _fail(self, work: RequestWork, exc: Exception) -> None:
         self.admission.release()

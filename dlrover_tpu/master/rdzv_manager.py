@@ -19,7 +19,11 @@ import time
 
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.master.saturation import TimedLock
-from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.journal import (
+    current_trace_id,
+    format_ctx,
+    get_journal,
+)
 from dlrover_tpu.telemetry.metrics import registry
 
 logger = get_logger(__name__)
@@ -75,6 +79,10 @@ class CommWorld:
     # treat the recovery as a resharding event — the fallback topology
     # may already be pre-compiled (DESIGN.md §17)
     reshard: bool = False
+    # span context (§27) of the rdzv_round journal point for this round
+    # — propagated to agents in CommWorldResponse.sctx so their
+    # rendezvous_wait spans link to the round that admitted them
+    sctx: str = ""
 
 
 class RendezvousManager:
@@ -283,10 +291,11 @@ class RendezvousManager:
         _waiting_nodes.labels(self.name).set(len(self._waiting))
         # one completed-interval line (begin time is derivable from dur):
         # the job-level stall the lost-time report charges to rendezvous
-        get_journal().emit(
+        round_span = get_journal().emit(
             "rdzv_round", dur=round_s, rdzv=self.name, round=self._round,
             nodes=len(world), fast=fast, reshard=reshard,
         )
+        self._latest.sctx = format_ctx(current_trace_id(), round_span)
 
     def get_comm_world(self, node_id: int) -> CommWorld | None:
         """The completed world containing ``node_id``, if any (non-blocking)."""

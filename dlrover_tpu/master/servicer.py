@@ -28,6 +28,11 @@ from dlrover_tpu.master.saturation import (
 )
 from dlrover_tpu.master.speed_monitor import SpeedMonitor
 from dlrover_tpu.master.task_manager import TaskManager
+from dlrover_tpu.telemetry.journal import (
+    current_trace_id,
+    format_ctx,
+    get_journal,
+)
 
 logger = get_logger(__name__)
 
@@ -397,6 +402,15 @@ class MasterServicer:
                 # window / failure ladder stay single-charged)
                 return m.OkResponse()
             self._node_manager.report_failure(msg.node_id)
+            # master-side node of the incident tree (§27): msg.sctx is
+            # the context captured when the agent minted the report, so
+            # a redelivered replay still attaches under the original
+            # incident (the transport envelope carries flush-time ctx)
+            get_journal().emit(
+                "failure_report", node=msg.node_id,
+                restart_count=msg.restart_count, level=msg.level.value,
+                remote_parent=msg.sctx,
+            )
             logger.warning(
                 "failure report from node %d (restart %d, %s): %s",
                 msg.node_id, msg.restart_count, msg.level.value,
@@ -562,6 +576,12 @@ class MasterServicer:
             if self._rid_seen(msg.rid):
                 return m.OkResponse()
             key = (int(msg.step), int(msg.num_shards), str(msg.group))
+            # ledger entry journals under the writer's ckpt_persist span
+            # (msg.sctx = mint-time context; survives redelivery, §27)
+            get_journal().emit(
+                "persist_ack", node=msg.node_id, step=int(msg.step),
+                group=str(msg.group), remote_parent=msg.sctx,
+            )
             with self._persist_lock:
                 self._persist_acks.setdefault(key, {})[
                     str(msg.node_id)
@@ -690,6 +710,7 @@ class MasterServicer:
                 self._paral_config,
                 autopilot_plan=decision.to_plan.to_json(),
                 version=self._paral_config.version + 1,
+                sctx=decision.sctx,
             )
             logger.info(
                 "autopilot retune pushed: %s -> %s via %s (paral "
@@ -715,6 +736,8 @@ class MasterServicer:
                 self._paral_config,
                 snapshot_interval=new,
                 version=self._paral_config.version + 1,
+                sctx=getattr(self._interval_tuner,
+                             "last_retune_sctx", ""),
             )
             logger.info(
                 "snapshot interval retuned to %d steps (paral config v%d)",
@@ -746,11 +769,19 @@ class MasterServicer:
             self._last_oom_bump = now
             self._oom_bump_threshold = restart_count + 1
             current = self._paral_config.grad_accum_steps or 1
+            # verdict point (§27): inherits the reporting agent's span
+            # via the RPC envelope, and the restart it requests traces
+            # back here through ParalConfig.sctx
+            verdict_span = get_journal().emit(
+                "oom_accum_bump", old_accum=current,
+                new_accum=current * 2, restart_count=restart_count,
+            )
             self._paral_config = _dc.replace(
                 self._paral_config,
                 grad_accum_steps=current * 2,
                 restart_required=True,
                 version=self._paral_config.version + 1,
+                sctx=format_ctx(current_trace_id(), verdict_span),
             )
             logger.info(
                 "OOM: suggesting grad_accum_steps=%d (paral config v%d)",
@@ -797,6 +828,7 @@ class MasterServicer:
             trace_id=self.trace_id,
             reshard=world.reshard,
             master_epoch=self.master_epoch,
+            sctx=world.sctx,
         )
 
     def _network_check_group(self, msg: m.NetworkCheckGroupRequest

@@ -59,7 +59,7 @@ from dlrover_tpu.master.kv_store import (
     cache_puts_total,
     topology_tag,
 )
-from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.journal import get_journal, spawn_ctx
 
 logger = get_logger(__name__)
 
@@ -426,7 +426,8 @@ def load_or_compile(
             dur = time.monotonic() - start
             stats = blob_stats(got[0])
             get_journal().emit("compile_cache", dur=dur, hit=True,
-                               layer=got[1], key=key)
+                               layer=got[1], key=key,
+                               remote_parent=spawn_ctx())
             logger.info("compile cache HIT (%s) for %s in %.2fs",
                         got[1], key, dur)
             return AotStep(fn=loaded, cache_hit=True, source=got[1],
@@ -442,7 +443,7 @@ def load_or_compile(
         logger.warning("compile-cache publish failed: %s", e)
     dur = time.monotonic() - start
     get_journal().emit("compile_cache", dur=dur, hit=False,
-                       layer="none", key=key)
+                       layer="none", key=key, remote_parent=spawn_ctx())
     logger.info("compile cache MISS for %s; compiled+published in %.2fs",
                 key, dur)
     return AotStep(fn=compiled, cache_hit=False, source="compiled",

@@ -108,7 +108,12 @@ def launch_local_master(args, min_nodes: int, max_nodes: int
         "--heartbeat-interval", str(args.heartbeat_interval),
         "--port-file", port_file,
     ]
-    proc = subprocess.Popen(cmd, start_new_session=True)
+    # span-id namespace (§27): the master shares the agent's env (no
+    # NODE_ID) — without a namespace the two would mint identical
+    # deterministic span-id streams under DLROVER_TPU_TRACE_SEED
+    env = dict(os.environ)
+    env[EnvKey.SPAN_NS] = "master"
+    proc = subprocess.Popen(cmd, start_new_session=True, env=env)
     deadline = time.time() + 30
     while time.time() < deadline:
         if proc.poll() is not None:

@@ -133,6 +133,9 @@ class Request:
     on_token: Any = None
     # a prefill-pool product to install instead of running prefill here
     bundle: Any = None
+    # trace:span context (§27) of the gateway request this serves; this
+    # engine's admit/handoff journal events attach under it
+    sctx: str = ""
 
 
 @dataclasses.dataclass
@@ -162,6 +165,9 @@ class KVBundle:
     last: Any                  # [vocab] float32 logits of the last token
     page_size: int
     prefix_key: tuple          # final-aligned-boundary prefix key
+    # trace:span context (§27) carried with the KV across the process
+    # boundary so the decode side's install journals into the same tree
+    sctx: str = ""
 
 
 @dataclasses.dataclass
@@ -561,18 +567,19 @@ class InferenceEngine:
 
     def submit(self, prompt: list[int],
                params: SamplingParams | None = None,
-               on_token=None) -> int:
+               on_token=None, sctx: str = "") -> int:
         params = params or SamplingParams()
         self._validate(list(prompt), params)
         rid = next(self._ids)
-        self._queue.append(Request(rid, list(prompt), params, on_token))
+        self._queue.append(Request(rid, list(prompt), params, on_token,
+                                   sctx=sctx))
         self._submit_time[rid] = time.monotonic()
         return rid
 
     def submit_prefilled(self, prompt: list[int],
                          params: SamplingParams | None = None,
                          bundle: KVBundle | None = None,
-                         on_token=None) -> int:
+                         on_token=None, sctx: str = "") -> int:
         """Submit a request whose prefill already ran on a PREFILL
         engine: admission installs ``bundle`` (one install, zero
         chunks) instead of re-running the prompt."""
@@ -588,7 +595,8 @@ class InferenceEngine:
             )
         rid = next(self._ids)
         self._queue.append(Request(rid, prompt, params, on_token,
-                                   bundle=bundle))
+                                   bundle=bundle,
+                                   sctx=sctx or bundle.sctx))
         self._submit_time[rid] = time.monotonic()
         return rid
 
@@ -816,6 +824,7 @@ class InferenceEngine:
         get_journal().emit(
             "engine_admit", request=parked.req.id, kind="resume",
             chunks=0, emitted=len(parked.emitted),
+            remote_parent=parked.req.sctx,
         )
 
     def _start_admission(self) -> bool:
@@ -867,7 +876,7 @@ class InferenceEngine:
         journal.emit(
             "engine_admit", request=req.id, kind=pa.kind,
             chunks=run.chunks, dur=round(run.work_s, 6),
-            tokens=len(req.prompt),
+            tokens=len(req.prompt), remote_parent=req.sctx,
         )
         if pa.kind == "handoff":
             _kv_handoffs_total.inc()
@@ -876,6 +885,7 @@ class InferenceEngine:
                 pages=int(req.bundle.k.shape[1]),
                 tokens=len(req.prompt),
                 bytes=int(req.bundle.k.nbytes + req.bundle.v.nbytes),
+                remote_parent=req.sctx,
             )
 
     def _admit_tick(self) -> bool:

@@ -26,6 +26,7 @@ from typing import Any
 
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.serving.engine import InferenceEngine, KVBundle
+from dlrover_tpu.telemetry.journal import get_journal
 
 logger = get_logger(__name__)
 
@@ -55,8 +56,9 @@ class PrefillEngine:
         self.engine = engine
         self.slots = max(1, engine.slots)
         self._ids = itertools.count()
-        self._queue: deque[tuple[int, list[int]]] = deque()
-        self._current: tuple[int, Any] | None = None   # (rid, run)
+        self._queue: deque[tuple[int, list[int], str]] = deque()
+        # (rid, run, sctx) of the in-flight chunked prefill
+        self._current: tuple[int, Any, str] | None = None
         self._results: list[PrefillResult] = []
 
     # ------------------------------------------------------- replica surface
@@ -76,31 +78,39 @@ class PrefillEngine:
         return len(self._queue) + (1 if self._current else 0)
 
     def submit(self, prompt: list[int], params: Any = None,
-               on_token: Any = None) -> int:
+               on_token: Any = None, sctx: str = "") -> int:
         """Queue a prompt for prefill. ``params``/``on_token`` are
         accepted for replica-surface compatibility; tokens only exist
-        once the decode pool takes over."""
+        once the decode pool takes over. ``sctx`` is the gateway
+        request's trace context (§27): the prefill run journals under
+        it and the produced bundle carries it to the decode side."""
         prompt = list(prompt)
         if not prompt:
             raise ValueError("empty prompt")
         if len(prompt) > self.engine.max_len:
             raise ValueError("prompt > max_len")
         rid = next(self._ids)
-        self._queue.append((rid, prompt))
+        self._queue.append((rid, prompt, sctx))
         return rid
 
     def step(self) -> int:
         """Run ONE prefill chunk of the current prompt (starting the
         next queued one if idle); returns outstanding count."""
         if self._current is None and self._queue:
-            rid, prompt = self._queue.popleft()
-            self._current = (rid, self.engine.prefill_begin(prompt))
+            rid, prompt, sctx = self._queue.popleft()
+            self._current = (rid, self.engine.prefill_begin(prompt), sctx)
         if self._current is not None:
-            rid, run = self._current
+            rid, run, sctx = self._current
             if self.engine.prefill_step(run):
+                bundle = self.engine.make_bundle(run)
+                bundle.sctx = sctx
+                get_journal().emit(
+                    "prefill_run", request=rid, chunks=run.chunks,
+                    dur=round(run.work_s, 6), tokens=len(run.prompt),
+                    remote_parent=sctx,
+                )
                 self._results.append(PrefillResult(
-                    id=rid, prompt=run.prompt,
-                    bundle=self.engine.make_bundle(run),
+                    id=rid, prompt=run.prompt, bundle=bundle,
                     chunks=run.chunks,
                 ))
                 self._current = None

@@ -16,6 +16,21 @@ completed interval recorded in one line). A begin with no matching end
 means the process died inside the span — the offline report treats it
 as open until the journal's last event.
 
+Span context (DESIGN.md §27): a context-local span stack makes nested
+``span(...)`` blocks parent their children automatically, and a
+``trace:span`` context string (``current_ctx()`` / ``parse_ctx()``)
+carries causality across process boundaries — in the RPC envelope
+(``common/rpc.py`` ``sctx`` key, adopted server-side via
+``adopt_remote_ctx``), in message payloads (``sctx`` fields), and in
+the child environment (``DLROVER_TPU_SPAN_CTX``, read back with
+``spawn_ctx()``). ``remote_parent=`` accepts such a context string and
+is used as the parent only when no local span is on the stack — local
+causality wins. Under ``DLROVER_TPU_TRACE_SEED`` span ids come from a
+deterministic per-process counter stream instead of ``uuid4``, so
+seeded chaos/fleetsim replays produce byte-identical trace trees.
+``telemetry/trace.py`` assembles the journals of all nodes into causal
+trees with critical paths.
+
 Span taxonomy (names are load-bearing for ``telemetry/report.py`` and
 ``telemetry/timeline.py``; ``native/check_metric_names.py`` lints that
 every name is documented in DESIGN.md): ``rdzv_round`` / ``job_start`` /
@@ -35,6 +50,9 @@ one) and reopened, bounding a long soak's footprint at ~2x the cap;
 
 from __future__ import annotations
 
+import contextvars
+import hashlib
+import itertools
 import json
 import os
 import time
@@ -43,9 +61,20 @@ from contextlib import contextmanager
 from typing import Iterator, Optional
 
 from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.telemetry.metrics import registry
 
 JOURNAL_FILE = "events.jsonl"
 ROTATED_SUFFIX = ".1"
+
+_spans_total = registry().counter(
+    "dlrover_tpu_trace_spans_total",
+    "journal trace events written, by event kind (b/e/p)",
+    ("kind",),
+)
+_dropped_total = registry().counter(
+    "dlrover_tpu_trace_dropped_total",
+    "per-request trace roots dropped by head sampling",
+)
 
 
 def max_journal_bytes() -> int:
@@ -79,6 +108,121 @@ def _proc_name() -> str:
     if node is None:
         return f"pid{os.getpid()}"
     return f"node{node}"
+
+
+# ------------------------------------------------------------- span context
+#
+# A context string is ``"<trace_id>:<span_id>"`` — the wire format every
+# propagation point uses (RPC envelope ``sctx`` key, message ``sctx``
+# fields, ``DLROVER_TPU_SPAN_CTX`` in a child env, standby promotion
+# payloads, ``KVBundle.sctx``).
+
+_SPAN_STACK: contextvars.ContextVar[tuple[str, ...]] = \
+    contextvars.ContextVar("dlrover_tpu_span_stack", default=())
+# Deterministic-id counters, one stream per span NAME (used only under
+# DLROVER_TPU_TRACE_SEED). A single global counter would make ids
+# depend on how concurrent threads interleave their draws — a heartbeat
+# emitting between two recovery spans would shift every later id and
+# break replay determinism. Per-name streams are immune to cross-name
+# interleaving; same-name spans racing within one process swap ids only
+# among themselves, which the skeleton contract cannot observe.
+_SPAN_SEQ: dict[str, Iterator[int]] = {}
+
+
+def format_ctx(trace: str, span: str) -> str:
+    return f"{trace}:{span}" if span else ""
+
+
+def parse_ctx(ctx: str | None) -> tuple[str, str]:
+    if not ctx or not isinstance(ctx, str):
+        return "", ""
+    trace, _, span = ctx.rpartition(":")
+    return trace, span
+
+
+def mint_span_id(name: str = "") -> str:
+    """A fresh span id. Random (``uuid4``) normally; under
+    ``DLROVER_TPU_TRACE_SEED`` a deterministic blake2s stream keyed by
+    (seed, namespace, node, incarnation, standby-ness, rank, span name,
+    per-name counter: the namespace — ``DLROVER_TPU_SPAN_NS`` —
+    separates co-located processes that share every other component,
+    e.g. the standalone master and the agent that spawned it), so the
+    same seeded chaos/fleetsim run always mints the same ids — trace
+    trees stay byte-identical across replays."""
+    seed = os.environ.get(EnvKey.TRACE_SEED, "")
+    if not seed:
+        return uuid.uuid4().hex[:12]
+    stream = "|".join((
+        seed,
+        os.environ.get(EnvKey.SPAN_NS, "-"),
+        os.environ.get(EnvKey.NODE_ID, "m"),
+        os.environ.get(EnvKey.RESTART_COUNT, "-"),
+        "s" if os.environ.get(EnvKey.STANDBY_FILE) else "-",
+        os.environ.get(EnvKey.GLOBAL_RANK, "-"),
+        name,
+        str(next(_SPAN_SEQ.setdefault(name, itertools.count()))),
+    ))
+    return hashlib.blake2s(stream.encode(), digest_size=6).hexdigest()
+
+
+def current_span_id() -> str:
+    """Innermost live span in this execution context ("" if none)."""
+    stack = _SPAN_STACK.get()
+    return stack[-1] if stack else ""
+
+
+def current_ctx() -> str:
+    """The ``trace:span`` context string a caller puts on the wire so
+    the remote side journals as a child ("" when no span is live)."""
+    return format_ctx(current_trace_id(), current_span_id())
+
+
+def spawn_ctx() -> str:
+    """The spawn-time span context a parent process left in the child's
+    environment (``DLROVER_TPU_SPAN_CTX``) — recovery call sites pass
+    it as ``remote_parent=`` so restore/recompile attach under the
+    incident that respawned them."""
+    return os.environ.get(EnvKey.SPAN_CTX, "")
+
+
+@contextmanager
+def adopt_remote_ctx(ctx: str | None) -> Iterator[None]:
+    """Adopt a remote caller's span context for the duration of a block
+    (the RPC server wraps handler dispatch in this), so every journal
+    emission inside attaches as a child of the caller's span."""
+    _, span = parse_ctx(ctx)
+    if not span:
+        yield
+        return
+    token = _SPAN_STACK.set(_SPAN_STACK.get() + (span,))
+    try:
+        yield
+    finally:
+        _SPAN_STACK.reset(token)
+
+
+def should_sample(key: str) -> bool:
+    """Head-sampling decision for per-request serving traces, stable in
+    the request id so every hop of one request agrees. Incidents and
+    control-plane traces never consult this — they are always sampled."""
+    raw = os.environ.get(EnvKey.TRACE_SAMPLE, "").strip()
+    if not raw:
+        return True
+    try:
+        rate = float(raw)
+    except ValueError:
+        return True
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        _dropped_total.inc()
+        return False
+    h = int.from_bytes(hashlib.blake2s(key.encode(),
+                                       digest_size=4).digest(), "big")
+    if h / 0xFFFFFFFF < rate:
+        return True
+    _dropped_total.inc()
+    return False
 
 
 class EventJournal:
@@ -133,8 +277,25 @@ class EventJournal:
             os.write(self._fd,
                      (json.dumps(event, separators=(",", ":")) + "\n")
                      .encode("utf-8"))
+            _spans_total.labels(event.get("ev", "p")).inc()
         except OSError:
             pass  # telemetry must never take down the instrumented path
+
+    @staticmethod
+    def _resolve_parent(parent: str | None,
+                        remote_parent: str | None) -> str | None:
+        """Parent precedence: explicit ``parent`` span id, then the
+        innermost local span on the context stack, then the span half of
+        a ``remote_parent`` context string — local causality wins over a
+        remote link."""
+        if parent:
+            return parent
+        local = current_span_id()
+        if local:
+            return local
+        if remote_parent:
+            return parse_ctx(remote_parent)[1] or None
+        return None
 
     def _base(self, name: str, ev: str, span_id: str,
               parent: str | None, fields: dict) -> dict:
@@ -154,17 +315,23 @@ class EventJournal:
         return event
 
     def emit(self, name: str, parent: str | None = None,
-             dur: float | None = None, **fields) -> str:
+             dur: float | None = None, remote_parent: str | None = None,
+             span_id: str | None = None, **fields) -> str:
         """One-line point event; ``dur`` marks a completed interval that
-        ended at the event's timestamp."""
-        span_id = uuid.uuid4().hex[:12]
+        ended at the event's timestamp. ``span_id`` lets a caller that
+        pre-minted an id (so other processes could attach children
+        before this retroactive point is written) reuse it."""
+        span_id = span_id or mint_span_id(name)
         if dur is not None:
             fields["dur"] = round(float(dur), 6)
+        parent = self._resolve_parent(parent, remote_parent)
         self._write(self._base(name, "p", span_id, parent, fields))
         return span_id
 
-    def begin(self, name: str, parent: str | None = None, **fields) -> str:
-        span_id = uuid.uuid4().hex[:12]
+    def begin(self, name: str, parent: str | None = None,
+              remote_parent: str | None = None, **fields) -> str:
+        span_id = mint_span_id(name)
+        parent = self._resolve_parent(parent, remote_parent)
         self._write(self._base(name, "b", span_id, parent, fields))
         return span_id
 
@@ -176,12 +343,16 @@ class EventJournal:
 
     @contextmanager
     def span(self, name: str, parent: str | None = None,
+             remote_parent: str | None = None,
              **fields) -> Iterator[str]:
         start = time.time()
-        span_id = self.begin(name, parent=parent, **fields)
+        span_id = self.begin(name, parent=parent,
+                             remote_parent=remote_parent, **fields)
+        token = _SPAN_STACK.set(_SPAN_STACK.get() + (span_id,))
         try:
             yield span_id
         finally:
+            _SPAN_STACK.reset(token)
             self.end(span_id, name, start=start)
 
     def close(self) -> None:
@@ -198,10 +369,12 @@ class NullJournal:
     path = ""
 
     def emit(self, name: str, parent: str | None = None,
-             dur: float | None = None, **fields) -> str:
+             dur: float | None = None, remote_parent: str | None = None,
+             span_id: str | None = None, **fields) -> str:
         return ""
 
-    def begin(self, name: str, parent: str | None = None, **fields) -> str:
+    def begin(self, name: str, parent: str | None = None,
+              remote_parent: str | None = None, **fields) -> str:
         return ""
 
     def end(self, span_id: str, name: str, start: float | None = None,
@@ -210,6 +383,7 @@ class NullJournal:
 
     @contextmanager
     def span(self, name: str, parent: str | None = None,
+             remote_parent: str | None = None,
              **fields) -> Iterator[str]:
         yield ""
 

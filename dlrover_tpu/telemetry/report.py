@@ -125,7 +125,18 @@ class Span:
 
 def pair_spans(events: list[dict]) -> list[Span]:
     """Reassemble spans from b/e/p lines; an unmatched begin is closed at
-    the journal's final timestamp (crash semantics)."""
+    the journal's final timestamp (crash semantics).
+
+    Rotation accounting: a span whose begin/end straddle the ``.1``
+    rotation boundary pairs normally, because ``load_events`` reads the
+    rotated sibling before the live file and matching is by span id.
+    When the begin has aged out entirely (rotated past ``.1`` and
+    deleted), the orphan end still carries the ``dur`` the writer
+    stamped (``journal.end(..., start=t0)``), so the span is
+    reconstructed from the end line alone — attributed exactly once,
+    never dropped, never double-counted (the reconstruction only
+    happens when no begin matched).
+    """
     if not events:
         return []
     last_t = events[-1]["t"]
@@ -151,6 +162,16 @@ def pair_spans(events: list[dict]) -> list[Span]:
                 span.end = ev["t"]
                 span.open = False
                 span.fields.update(fields)
+            else:
+                # begin rotated past .1: rebuild from the end's dur
+                dur = float(ev.get("dur", 0.0) or 0.0)
+                fields["begin_rotated"] = True
+                spans.append(Span(
+                    span_id=ev.get("span", ""), name=ev["name"],
+                    proc=ev.get("proc", ""), trace=ev.get("trace", ""),
+                    start=ev["t"] - dur, end=ev["t"],
+                    parent=ev.get("parent", ""), fields=fields,
+                ))
         else:  # point
             dur = float(ev.get("dur", 0.0) or 0.0)
             spans.append(Span(

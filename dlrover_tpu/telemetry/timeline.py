@@ -120,6 +120,42 @@ def build_trace(paths: list[str], trace: str | None = None) -> dict:
                 "args": args,
             })
 
+    # flow events (ph="s"/"f"): a causal arrow from each parent span to
+    # every child in a DIFFERENT lane (same-lane nesting already reads
+    # visually), so cross-process trees — RPC caller -> servicer
+    # handler, gateway request -> prefill/decode, incident -> trainer
+    # restore — render as arrows in Perfetto (DESIGN.md §27)
+    by_id = {s.span_id: s for s in spans if s.span_id}
+
+    def _is_slice(s: Span) -> bool:
+        return s.name not in INSTANT_NAMES and s.end > s.start
+
+    for span in spans:
+        parent = by_id.get(span.parent) if span.parent else None
+        if parent is None or not _is_slice(parent) or not _is_slice(span):
+            continue
+        if _lane_key(parent) == _lane_key(span):
+            continue
+        try:
+            flow_id = int(span.span_id, 16) & 0x7FFFFFFF
+        except ValueError:
+            continue
+        p_proc = parent.proc or "unknown"
+        # step ts must land inside the slice it binds to
+        s_ts = min(max(span.start, parent.start), parent.end)
+        out.append({
+            "ph": "s", "name": "causal", "cat": "flow", "id": flow_id,
+            "ts": round((s_ts - t0) * 1e6, 3),
+            "pid": pid_of[p_proc], "tid": tid_of[(p_proc, parent.name)],
+        })
+        c_proc = span.proc or "unknown"
+        out.append({
+            "ph": "f", "name": "causal", "cat": "flow", "id": flow_id,
+            "bp": "e",
+            "ts": round((span.start - t0) * 1e6, 3),
+            "pid": pid_of[c_proc], "tid": tid_of[(c_proc, span.name)],
+        })
+
     # counter tracks: MFU lane + stacked step-phase lane per process,
     # so the efficiency series read alongside the span lanes
     for sample in counters:

@@ -1,0 +1,357 @@
+"""Cross-node causal trace assembler: journals -> span trees with
+critical paths.
+
+``python -m dlrover_tpu.telemetry.trace --journal <dir-or-file>...``
+merges the event journals of every process (rotated ``.jsonl.1``
+siblings included), joins spans into causal trees via the
+``span``/``parent`` links the span-context fabric writes (DESIGN.md
+§27: context-local stack in-process, ``sctx`` on the RPC envelope and
+message payloads across processes, ``DLROVER_TPU_SPAN_CTX`` across
+spawns), and renders:
+
+- ``--trace <id>``: every tree of one job trace;
+- ``--request <rid>``: the single tree of one gateway request
+  (``gateway_request`` root carrying that ``rid``), with the TTFT
+  phase decomposition (queue/route/prefill/handoff/decode) summed from
+  its direct children;
+- ``--incident``: every recovery incident (``node_restart`` roots),
+  each with its critical path and a lost-time category breakdown
+  (``telemetry/report.py`` vocabulary) computed from the same tree —
+  the reconciliation hook the bench's 10% agreement check uses.
+
+The critical path of a tree is the last-finisher chain from the root:
+at each node descend into the child that ends last. Each hop is
+annotated with ``wait_s`` (time inside the parent before the hop
+started) and ``self_s`` (the node's wall not covered by its on-path
+child) — the self times of the path tile the root's wall exactly, so
+"where did this request's / this recovery's time go" reads straight
+off the path. ``--format json`` emits one stable-keyed document.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+from dlrover_tpu.telemetry.report import (
+    CATEGORY_OF,
+    Span,
+    _union_seconds,
+    load_events,
+    pair_spans,
+)
+
+# request-phase children of a gateway_request root, in pipeline order
+REQUEST_PHASES = ("gateway_queue", "gateway_route", "gateway_prefill",
+                  "gateway_handoff", "gateway_decode_first",
+                  "gateway_decode")
+INCIDENT_ROOT = "node_restart"
+
+
+class TraceNode:
+    """One span plus its causal children (sorted by start time)."""
+
+    __slots__ = ("span", "children")
+
+    def __init__(self, span: Span):
+        self.span = span
+        self.children: list[TraceNode] = []
+
+    @property
+    def start(self) -> float:
+        return self.span.start
+
+    @property
+    def end(self) -> float:
+        return self.span.end
+
+    @property
+    def dur(self) -> float:
+        return max(0.0, self.span.end - self.span.start)
+
+    def walk(self):
+        yield self
+        for child in self.children:
+            yield from child.walk()
+
+    def n_procs(self) -> int:
+        return len({n.span.proc for n in self.walk() if n.span.proc})
+
+
+def load_spans(paths: list[str], trace: str | None = None) -> list[Span]:
+    events: list[dict] = []
+    for path in paths:
+        events.extend(load_events(path))
+    events.sort(key=lambda e: e["t"])
+    spans = pair_spans(events)
+    if trace:
+        spans = [s for s in spans if s.trace == trace]
+    return spans
+
+
+def build_forest(spans: list[Span]) -> list[TraceNode]:
+    """Causal forest: every span attaches under its parent when the
+    parent span is present in the merged journals; a span whose parent
+    was sampled out, rotated away, or belongs to another job becomes a
+    root (its dangling parent id is kept in ``span.fields``)."""
+    nodes = {s.span_id: TraceNode(s) for s in spans if s.span_id}
+    roots: list[TraceNode] = []
+    for span in spans:
+        node = nodes.get(span.span_id)
+        if node is None:
+            continue
+        parent = nodes.get(span.parent) if span.parent else None
+        if parent is None or parent is node:
+            if span.parent:
+                span.fields.setdefault("dangling_parent", span.parent)
+            roots.append(node)
+        else:
+            parent.children.append(node)
+    for node in nodes.values():
+        node.children.sort(key=lambda n: (n.start, n.end))
+    roots.sort(key=lambda n: (n.start, n.end))
+    return roots
+
+
+def critical_path(root: TraceNode) -> list[dict]:
+    """Last-finisher chain from ``root``; ``self_s`` per hop tiles the
+    root's wall, ``wait_s`` is the lead-in inside the parent."""
+    path = [root]
+    cur = root
+    while cur.children:
+        cur = max(cur.children, key=lambda c: (c.end, c.start))
+        path.append(cur)
+    segs: list[dict] = []
+    for i, node in enumerate(path):
+        child = path[i + 1] if i + 1 < len(path) else None
+        if child is not None:
+            self_s = max(0.0, child.start - node.start) \
+                + max(0.0, node.end - child.end)
+        else:
+            self_s = node.dur
+        segs.append({
+            "span": node.span.span_id,
+            "name": node.span.name,
+            "proc": node.span.proc,
+            "t0": round(node.start, 6),
+            "dur_s": round(node.dur, 6),
+            "self_s": round(self_s, 6),
+            "wait_s": round(max(0.0, node.start - path[i - 1].start), 6)
+            if i else 0.0,
+        })
+    return segs
+
+
+def request_phases(root: TraceNode) -> dict[str, float]:
+    """TTFT decomposition of one ``gateway_request`` tree: per-phase
+    seconds from the root's direct phase children (one vocabulary with
+    the gateway's journaled decomposition), plus the request wall."""
+    phases: dict[str, float] = {}
+    for child in root.children:
+        if child.span.name in REQUEST_PHASES:
+            phases[child.span.name] = round(
+                phases.get(child.span.name, 0.0) + child.dur, 6)
+    phases["wall_s"] = round(root.dur, 6)
+    return phases
+
+
+def incident_breakdown(root: TraceNode) -> dict[str, float]:
+    """Lost-time category split of one incident tree, same interval-
+    union attribution (and vocabulary) as ``telemetry/report.py`` — so
+    the incident trace and the offline report can be reconciled."""
+    by_cat: dict[str, list[tuple[float, float]]] = {}
+    for node in root.walk():
+        cat = CATEGORY_OF.get(node.span.name)
+        if cat is None:
+            continue
+        by_cat.setdefault(cat, []).append((node.start, node.end))
+    return {cat: round(_union_seconds(ivs), 6)
+            for cat, ivs in sorted(by_cat.items())}
+
+
+def find_request_roots(roots: list[TraceNode],
+                       rid: str | None = None) -> list[TraceNode]:
+    found = []
+    for root in roots:
+        for node in root.walk():
+            if node.span.name != "gateway_request":
+                continue
+            if rid is None or str(node.span.fields.get("rid", "")) == rid:
+                found.append(node)
+    return found
+
+
+def find_incident_roots(roots: list[TraceNode]) -> list[TraceNode]:
+    found = []
+    for root in roots:
+        for node in root.walk():
+            if node.span.name == INCIDENT_ROOT:
+                found.append(node)
+    return found
+
+
+def tree_dict(node: TraceNode) -> dict:
+    """Stable JSON form of one tree (byte-identical across seeded
+    replays: ids are deterministic, times are excluded from the
+    canonical id/name/proc skeleton consumers diff)."""
+    return {
+        "span": node.span.span_id,
+        "name": node.span.name,
+        "proc": node.span.proc,
+        "t0": round(node.start, 6),
+        "dur_s": round(node.dur, 6),
+        "open": node.span.open,
+        "fields": {k: node.span.fields[k]
+                   for k in sorted(node.span.fields)},
+        "children": [tree_dict(c) for c in node.children],
+    }
+
+
+def tree_skeleton(node: TraceNode,
+                  _procs: dict[str, str] | None = None) -> dict:
+    """The timing-free shape of a tree — (name, proc, children) — the
+    replay-determinism contract compares verbatim. Process names are
+    normalised to first-seen aliases (``p0``, ``p1``, …) in tree order:
+    a process without ``DLROVER_TPU_NODE_ID`` journals as ``pid<n>``,
+    and raw pids differ between two otherwise identical seeded runs.
+    Children are ordered by span id, not start time: sibling spans from
+    different processes can start microseconds apart and flip order
+    between replays, while seeded span ids are stable."""
+    procs = {} if _procs is None else _procs
+    proc = node.span.proc
+    if proc not in procs:
+        procs[proc] = f"p{len(procs)}"
+    children = sorted(node.children, key=lambda n: n.span.span_id)
+    return {
+        "span": node.span.span_id,
+        "name": node.span.name,
+        "proc": procs[proc],
+        "children": [tree_skeleton(c, procs) for c in children],
+    }
+
+
+def render_tree(node: TraceNode, t0: float | None = None,
+                crit: set[str] | None = None, prefix: str = "",
+                last: bool = True, root: bool = True) -> list[str]:
+    t0 = node.start if t0 is None else t0
+    crit = crit or set()
+    mark = "*" if node.span.span_id in crit else " "
+    stem = "" if root else ("└─ " if last else "├─ ")
+    extras = ""
+    rid = node.span.fields.get("rid")
+    if rid:
+        extras += f" rid={rid}"
+    if node.span.fields.get("incarnation") is not None:
+        extras += f" inc={node.span.fields['incarnation']}"
+    if node.span.open:
+        extras += " [open]"
+    line = (f"{prefix}{stem}{mark}{node.span.name} "
+            f"[{node.span.proc}] +{node.start - t0:.3f}s "
+            f"{node.dur:.3f}s{extras}")
+    lines = [line]
+    child_prefix = prefix if root else \
+        prefix + ("   " if last else "│  ")
+    for i, child in enumerate(node.children):
+        lines.extend(render_tree(child, t0, crit, child_prefix,
+                                 i == len(node.children) - 1,
+                                 root=False))
+    return lines
+
+
+def render_text(root: TraceNode, kind: str = "trace") -> str:
+    segs = critical_path(root)
+    crit = {s["span"] for s in segs}
+    lines = [
+        f"{kind} tree: root {root.span.name} "
+        f"[{root.span.proc}] {root.dur:.3f}s across "
+        f"{root.n_procs()} process(es) "
+        f"({sum(1 for _ in root.walk())} spans); * = critical path",
+    ]
+    lines.extend(render_tree(root, crit=crit))
+    lines.append("critical path (self_s tiles the root wall):")
+    for seg in segs:
+        lines.append(
+            f"  {seg['name']:<24} [{seg['proc']}]"
+            f"  wait {seg['wait_s']:8.3f}s"
+            f"  self {seg['self_s']:8.3f}s"
+            f"  dur {seg['dur_s']:8.3f}s"
+        )
+    if root.span.name == "gateway_request":
+        phases = request_phases(root)
+        wall = phases.pop("wall_s", 0.0)
+        phase_sum = sum(phases.values())
+        lines.append(f"request phases (sum {phase_sum:.3f}s of "
+                     f"{wall:.3f}s wall):")
+        for name in REQUEST_PHASES:
+            if name in phases:
+                lines.append(f"  {name:<24} {phases[name]:8.3f}s")
+    if root.span.name == INCIDENT_ROOT:
+        lines.append("lost-time categories (report.py vocabulary):")
+        for cat, sec in incident_breakdown(root).items():
+            lines.append(f"  {cat:<24} {sec:8.3f}s")
+    return "\n".join(lines)
+
+
+def root_document(root: TraceNode, kind: str) -> dict:
+    doc = {
+        "kind": kind,
+        "tree": tree_dict(root),
+        "critical_path": critical_path(root),
+        "n_spans": sum(1 for _ in root.walk()),
+        "n_procs": root.n_procs(),
+        "wall_s": round(root.dur, 6),
+    }
+    if root.span.name == "gateway_request":
+        doc["phases"] = request_phases(root)
+    if root.span.name == INCIDENT_ROOT:
+        doc["categories"] = incident_breakdown(root)
+    return doc
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        "python -m dlrover_tpu.telemetry.trace",
+        description="assemble cross-process causal span trees with "
+                    "critical paths from event journals",
+    )
+    parser.add_argument("--journal", required=True, nargs="+",
+                        help="journal file(s) or DLROVER_TPU_JOURNAL_DIR "
+                             "dir(s); rotated .1 siblings are included")
+    parser.add_argument("--trace", default=None,
+                        help="render every tree of one job trace id")
+    parser.add_argument("--request", default=None,
+                        help="render the tree of one gateway request id")
+    parser.add_argument("--incident", action="store_true",
+                        help="render every recovery incident tree")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    args = parser.parse_args(argv)
+
+    spans = load_spans(args.journal, trace=args.trace)
+    roots = build_forest(spans)
+    if args.request is not None:
+        selected = [(r, "request")
+                    for r in find_request_roots(roots, args.request)]
+        missing = f"no gateway_request with rid {args.request!r}"
+    elif args.incident:
+        selected = [(r, "incident") for r in find_incident_roots(roots)]
+        missing = "no node_restart incident roots"
+    else:
+        selected = [(r, "trace") for r in roots]
+        missing = "no spans" + (f" for trace {args.trace!r}"
+                                if args.trace else "")
+    if not selected:
+        print(missing, file=sys.stderr)
+        return 1
+    if args.format == "json":
+        docs = [root_document(r, kind) for r, kind in selected]
+        print(json.dumps({"roots": docs}, indent=2, sort_keys=True))
+    else:
+        print("\n\n".join(render_text(r, kind) for r, kind in selected))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,7 +23,7 @@ from dlrover_tpu.common.constants import EnvKey
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.parallel.mesh import data_parallel_size
 from dlrover_tpu.telemetry.efficiency import EfficiencyMonitor
-from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.journal import get_journal, spawn_ctx
 from dlrover_tpu.telemetry.metrics import registry
 from dlrover_tpu.trainer.train_step import CompiledTrain, TrainState
 
@@ -241,9 +241,12 @@ class ElasticTrainer:
             # load + one step) from a cold XLA compile; the lost-time
             # report splits the recompile category on it
             hit = getattr(self.compiled, "cache_hit", None)
+            # spawn_ctx (§27): the incarnation's recompile attaches
+            # under the recovery incident that respawned this trainer
             get_journal().emit(
                 "compile", dur=dispatch_wall, step=step,
                 cache_hit=bool(hit) if hit is not None else None,
+                remote_parent=spawn_ctx(),
             )
             self._maybe_install_flops(state, batch)
         else:

@@ -14,7 +14,11 @@ the open/close pairing the regex could never see:
   verbatim in DESIGN.md;
 - a ``sid = X.begin("name")`` must be paired, within the same function
   or (via a ``self.attr``) the same class, with an ``X.end(sid, ...)``
-  — the ``span()`` context manager pairs itself and is always fine.
+  — the ``span()`` context manager pairs itself and is always fine;
+- a ``remote_parent=`` argument must be an expression (an envelope /
+  payload / spawn-env field), never a string literal: a literal
+  context would hard-wire fake causality into the trace fabric
+  (DESIGN.md §27).
 
 ``telemetry/journal.py`` is excluded: it implements the API and
 forwards caller-supplied names.
@@ -104,6 +108,20 @@ class JournalSpanChecker(Checker):
                     f"journal span {name!r} is not documented in "
                     "DESIGN.md; add it to the span-name table",
                 ))
+            for kw in node.keywords:
+                if kw.arg == "remote_parent" \
+                        and isinstance(kw.value, ast.Constant) \
+                        and kw.value.value:
+                    # a literal remote_parent fabricates causality: the
+                    # context must arrive through an RPC envelope,
+                    # message payload field, or the spawn environment
+                    findings.append(self.finding(
+                        module, node,
+                        f"journal span {name!r} passes a literal "
+                        "remote_parent — the context string must come "
+                        "from an envelope/payload/spawn-env field "
+                        "(§27), never be hard-wired",
+                    ))
         return findings
 
     # -------------------------------------------------------- begin pairing

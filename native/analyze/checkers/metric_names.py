@@ -92,6 +92,10 @@ DOCUMENTED_PREFIXES = (
     # runbook keys on the degraded/unreachable/reconcile/redelivery
     # families and the epoch gauge
     "dlrover_tpu_agent_",
+    # causal trace fabric (DESIGN.md §27): the "where did this
+    # request's / incident's time go" runbook keys on the span-write
+    # and head-sampling-drop counters
+    "dlrover_tpu_trace_",
 )
 
 # label names that are themselves an operator contract (dashboards and

@@ -442,6 +442,59 @@ def test_seeded_scenario_recovers_and_replays_identically(tmp_path):
     # determinism: identical fault/recovery journal trail across runs
     assert results[0].trail == results[1].trail
 
+    # §27: the kill's incident trace assembles across the agent and the
+    # respawned trainer, its category breakdown reconciles with the
+    # report vocabulary, and the seeded span-id discipline makes the
+    # incident trees byte-identical across the two runs
+    from dlrover_tpu.telemetry import trace as trace_mod
+
+    skeletons = []
+    for run, res in zip(("run_a", "run_b"), results):
+        jdir = str(tmp_path / run / "journal")
+        roots = trace_mod.build_forest(trace_mod.load_spans([jdir]))
+        incidents = [r for r in trace_mod.find_incident_roots(roots)
+                     if r.span.fields.get("kind") == "failure"]
+        assert incidents, "no failure incident tree assembled"
+        inc = incidents[0]
+        names = {n.span.name for n in inc.walk()}
+        # the recovery phases attached under the incident root: the
+        # agent's rendezvous and (cross-process, via SPAN_CTX) the
+        # respawned trainer's restore
+        assert "rendezvous_wait" in names
+        assert "ckpt_restore" in names
+        assert inc.n_procs() >= 2
+        cats = trace_mod.incident_breakdown(inc)
+        assert cats.get("restore", 0) > 0
+        assert cats.get("rendezvous", 0) > 0
+        # kill -> restore read off the TREE agrees with the journal-
+        # timestamp recovery number (same bound bench.py asserts)
+        from dlrover_tpu.chaos.scenario import _read_journal
+        t_kill = next(e["t"] for e in _read_journal(jdir)
+                      if e.get("name") == "chaos_fault"
+                      and e.get("point") == "agent_kill_trainer")
+        restore_end = min(n.end for n in inc.walk()
+                          if n.span.name == "ckpt_restore")
+        assert restore_end - t_kill == pytest.approx(
+            res.recovery_seconds, rel=0.10)
+        assert trace_mod.critical_path(inc)[-1].get("name") in names
+
+        # byte-identical modulo the save-before-restart persist: that
+        # span is opportunistic BY DESIGN (it fires only if a fresher
+        # shm snapshot won the race with the kill signal), so its
+        # presence is the one legitimately timing-dependent bit of an
+        # otherwise deterministic incident tree
+        def prune(sk):
+            sk["children"] = [
+                prune(c) for c in sk["children"]
+                if c["name"] not in ("ckpt_persist", "ckpt_persist_shard")
+            ]
+            return sk
+
+        skeletons.append(json.dumps(
+            [prune(trace_mod.tree_skeleton(i)) for i in incidents],
+            sort_keys=True))
+    assert skeletons[0] == skeletons[1]
+
 
 @pytest.mark.timeout(300)
 def test_standby_promotion_is_deterministic_under_kill_chaos(tmp_path):
@@ -482,8 +535,12 @@ def test_standby_promotion_is_deterministic_under_kill_chaos(tmp_path):
         res.assert_invariants()
         assert res.legs[0].result["restart_count"] == 1
         assert res.legs[0].result["final_step"] == 14
-        # the kill recovered from the shm snapshot, not from step 0
-        assert res.legs[0].result["resumed_from"] >= 8
+        # the kill recovered from a warm shm snapshot, not from step 0.
+        # The kill dispatches on the step the AGENT observed (>= 8), so
+        # on a slow host it can land before the step-8 snapshot
+        # (mem-ckpt-interval 2) is taken — warm recovery then resumes
+        # from the previous snapshot, one interval behind
+        assert res.legs[0].result["resumed_from"] >= 6
         # the respawn was a PROMOTION: the agent journaled the
         # standby_promote span around handing over the payload
         events = _read_journal(os.path.join(work, "journal"))

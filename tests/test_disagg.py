@@ -358,3 +358,168 @@ def test_paged_long_generation_does_not_block_short(params):
                            prefill_len=8, kv_pages=2)
     with pytest.raises(ValueError, match="pages"):
         tiny.submit([1] * 10, SamplingParams(max_new_tokens=20))
+
+
+# ----------------------------------------------- causal request traces (§27)
+
+
+_TRACE_DRIVER = """
+import json, os, pickle, sys
+
+role, work = sys.argv[1], sys.argv[2]
+import jax  # noqa: E402
+from dlrover_tpu.models import transformer as tfm
+from dlrover_tpu.serving import InferenceEngine, SamplingParams
+from dlrover_tpu.serving.prefill import PrefillEngine
+
+cfg = tfm.CONFIGS["tiny"]
+params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+engine = InferenceEngine(params, cfg, slots=2, max_len=64,
+                         prefill_len=8, kv_pages=16)
+with open(os.path.join(work, "req.json")) as f:
+    spec = json.load(f)
+if role == "prefill":
+    pe = PrefillEngine(engine)
+    pe.submit(spec["prompt"], sctx=spec["sctx"])
+    while pe.step():
+        pass
+    [res] = pe.poll_results()
+    with open(os.path.join(work, "bundle.pkl"), "wb") as f:
+        pickle.dump(res.bundle, f)
+else:
+    with open(os.path.join(work, "bundle.pkl"), "rb") as f:
+        bundle = pickle.load(f)
+    engine.submit_prefilled(
+        spec["prompt"],
+        SamplingParams(temperature=0.0, max_new_tokens=4),
+        bundle=bundle)
+    done = []
+    while not done:
+        engine.step()
+        done = engine.poll_results()
+    print(json.dumps({"tokens": done[0].tokens}))
+"""
+
+
+@pytest.mark.timeout(300)
+def test_request_trace_spans_three_processes(tmp_path, monkeypatch):
+    """ISSUE-16 satellite: the span context crosses REAL process
+    boundaries — a gateway-process root, a prefill process journaling
+    ``prefill_run`` under it, and a decode process whose
+    ``engine_admit``/``kv_handoff`` attach via the pickled
+    ``KVBundle.sctx`` — assembling into ONE tree spanning 3 procs."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    from dlrover_tpu.common.constants import EnvKey
+    from dlrover_tpu.telemetry import trace as trace_mod
+    from dlrover_tpu.telemetry.journal import current_ctx, get_journal
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    jdir = tmp_path / "journal"
+    monkeypatch.setenv(EnvKey.JOURNAL_DIR, str(jdir))
+    monkeypatch.setenv(EnvKey.TRACE_ID, "t3p")
+    monkeypatch.setenv(EnvKey.NODE_ID, "gw9")
+    driver = tmp_path / "driver.py"
+    driver.write_text(_TRACE_DRIVER)
+
+    def child(role, node_id):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=repo + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        env[EnvKey.NODE_ID] = node_id
+        proc = subprocess.run(
+            [sys.executable, str(driver), role, str(tmp_path)],
+            env=env, cwd=repo, capture_output=True, text=True,
+            timeout=180,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        return proc.stdout
+
+    prompt = list(range(19))                   # 3 chunks at P=8
+    with get_journal().span("gateway_request", rid=77):
+        with open(tmp_path / "req.json", "w") as f:
+            json.dump({"prompt": prompt, "sctx": current_ctx()}, f)
+        child("prefill", "p9")
+        out = child("decode", "d9")
+    assert len(json.loads(out.strip().splitlines()[-1])["tokens"]) == 4
+
+    roots = trace_mod.build_forest(
+        trace_mod.load_spans([str(jdir)]))
+    [req] = trace_mod.find_request_roots(roots, "77")
+    names = {n.span.name for n in req.walk()}
+    assert {"gateway_request", "prefill_run",
+            "engine_admit", "kv_handoff"} <= names
+    assert req.n_procs() >= 3
+    procs = {n.span.name: n.span.proc for n in req.walk()}
+    assert procs["prefill_run"] == "nodep9"
+    assert procs["engine_admit"] == "noded9"
+    # one tree: nothing from this request dangles as its own root
+    dangling = [r for r in roots
+                if r is not req and any(
+                    n.span.name in names for n in r.walk())]
+    assert not dangling
+
+
+@pytest.mark.timeout(300)
+def test_request_trace_phases_sum_to_wall(params, tmp_path, monkeypatch):
+    """ISSUE-16 acceptance: one ``/v1/generate`` through the disagg
+    gateway yields an assembled trace whose TTFT phase decomposition
+    (queue/route/prefill/handoff/decode-first/decode) sums to within 5%
+    of the measured request wall time."""
+    import json
+    import os
+    import urllib.request
+
+    from dlrover_tpu.common.constants import EnvKey
+    from dlrover_tpu.gateway import GatewayHTTPServer
+    from dlrover_tpu.telemetry import trace as trace_mod
+
+    monkeypatch.setenv(EnvKey.JOURNAL_DIR, str(tmp_path / "journal"))
+    monkeypatch.setenv(EnvKey.TRACE_ID, "reqwall")
+    gw = Gateway(_factory(params, kv_pages=16), replicas=1,
+                 prefill_len=8, prefill_replicas=1, seed=7)
+    srv = GatewayHTTPServer(gw, host="127.0.0.1",
+                            request_timeout_s=120).start()
+    try:
+        assert _wait(lambda: len(gw.pool.ready_replicas()) == 1
+                     and len(gw.prefill_pool.ready_replicas()) == 1)
+        url = f"http://127.0.0.1:{srv.port}/v1/generate"
+
+        def generate(max_new):
+            body = json.dumps({
+                "prompt": list(range(40, 59)), "temperature": 0.0,
+                "max_new_tokens": max_new,
+            }).encode()
+            req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"})
+            t0 = time.monotonic()
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                out = json.loads(resp.read())
+            return out, time.monotonic() - t0
+
+        generate(4)                        # warmup: compiles settle
+        out, wall = generate(32)           # the measured request
+        assert len(out["tokens"]) == 32
+    finally:
+        srv.stop()
+        gw.stop()
+
+    roots = trace_mod.build_forest(
+        trace_mod.load_spans([str(tmp_path / "journal")]))
+    [req] = trace_mod.find_request_roots(roots, str(out["id"]))
+    phases = trace_mod.request_phases(req)
+    journaled_wall = phases.pop("wall_s")
+    # disagg decomposition present, and the phases tile the wall
+    assert {"gateway_queue", "gateway_prefill", "gateway_handoff",
+            "gateway_decode_first", "gateway_decode"} <= set(phases)
+    assert sum(phases.values()) == pytest.approx(journaled_wall,
+                                                 abs=1e-5)
+    # ...which itself is the measured request wall, within 5%
+    assert sum(phases.values()) == pytest.approx(wall, rel=0.05)
+    # the prefill pool's own span joined the same tree (same process
+    # here, but linked causally via Request/KVBundle sctx)
+    assert "prefill_run" in {n.span.name for n in req.walk()}

@@ -375,6 +375,42 @@ def test_redelivery_queue_replays_with_same_rid():
     assert [a.rid for a in acks] + [f.rid for f in fails] == queued_rids
 
 
+def test_redelivered_reports_carry_mint_time_span_context(
+        monkeypatch, tmp_path):
+    """ISSUE-16 satellite: a queued ack/failure report replayed after a
+    master restart carries the span context of the work that PRODUCED
+    it (captured at mint time), not a fresh one from the reconcile that
+    flushed it — so incident trees survive a master restart."""
+    from dlrover_tpu.telemetry.journal import get_journal
+
+    monkeypatch.setenv(EnvKey.JOURNAL_DIR, str(tmp_path))
+    monkeypatch.setenv(EnvKey.TRACE_ID, "rt")
+    transport = _FenceTransport()
+    client = _client(transport)
+    client.report_heartbeat(0)
+    transport.down = True
+    with get_journal().span("ckpt_persist", step=7) as sid:
+        mint_ctx = f"rt:{sid}"
+        client.report_persist_ack(7, 2, {"crc32": 1})
+    with get_journal().span("node_restart", kind="failure") as rid:
+        incident_ctx = f"rt:{rid}"
+        client.report_failure("exit code 9 (killed)")
+    assert client.redelivery_pending == 2
+    assert [q.sctx for q in client._redelivery] == [
+        mint_ctx, incident_ctx]
+
+    transport.down = False
+    client.report_heartbeat(0)           # reconcile drains the queue
+    assert client.redelivery_pending == 0
+    # replayed OUTSIDE any live span, yet the original context survived
+    [ack] = [s for s in transport.sent
+             if isinstance(s, m.PersistAckReport)]
+    [fail] = [s for s in transport.sent
+              if isinstance(s, m.FailureReport)]
+    assert ack.sctx == mint_ctx
+    assert fail.sctx == incident_ctx
+
+
 def test_redelivery_queue_bounded(monkeypatch):
     monkeypatch.setenv(EnvKey.REDELIVERY_QUEUE, "3")
     transport = _FenceTransport()

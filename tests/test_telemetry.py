@@ -225,6 +225,162 @@ def test_journal_survives_torn_final_line(tmp_path):
     assert len(load_events(path)) == 1
 
 
+def test_rotation_boundary_span_accounted_once(tmp_path):
+    """ISSUE-16 satellite: a span whose begin/end straddle the ``.1``
+    rotation boundary is attributed exactly once — never dropped,
+    never double-counted — and a span whose begin aged out entirely is
+    reconstructed from its end line's ``dur``."""
+    live = tmp_path / "events.jsonl"
+    rotated = tmp_path / "events.jsonl.1"
+
+    def line(path, **kw):
+        kw.setdefault("trace", "tr")
+        kw.setdefault("proc", "agent0")
+        with open(path, "a") as f:
+            f.write(json.dumps(kw) + "\n")
+
+    # span s1 straddles: begin in the rotated sibling, end in the live
+    # file; span s2's begin rotated past .1 (deleted) — only its end
+    # (with the writer-stamped dur) survives
+    line(rotated, t=10.0, name="ckpt_persist", ev="b", span="s1", step=4)
+    line(live, t=13.0, name="ckpt_persist", ev="e", span="s1", dur=3.0)
+    line(live, t=20.0, name="ckpt_restore", ev="e", span="s2", dur=2.0)
+
+    spans = pair_spans(load_events(str(tmp_path)))
+    persist = [s for s in spans if s.name == "ckpt_persist"]
+    assert len(persist) == 1                      # once, not twice
+    assert persist[0].start == 10.0 and persist[0].end == 13.0
+    assert not persist[0].open
+    assert "begin_rotated" not in persist[0].fields
+    restore = [s for s in spans if s.name == "ckpt_restore"]
+    assert len(restore) == 1                      # reconstructed, kept
+    assert restore[0].start == pytest.approx(18.0)
+    assert restore[0].end == 20.0
+    assert restore[0].fields.get("begin_rotated") is True
+
+
+# ------------------------------------------------------- span context (§27)
+
+
+def test_span_context_parent_precedence(tmp_path):
+    """Explicit parent > local stack > remote_parent — local causality
+    wins over a context string that arrived on the wire."""
+    from dlrover_tpu.telemetry.journal import adopt_remote_ctx
+
+    path = str(tmp_path / "events.jsonl")
+    j = EventJournal(path, proc="n0", trace_id="tr")
+    with j.span("node_restart", kind="failure") as restart:
+        j.emit("ckpt_restore", dur=0.1)                 # local stack
+        j.emit("compile", dur=0.1, remote_parent="tr:feedbeef0000")
+        j.emit("rendezvous_wait", dur=0.1, parent="aaa")  # explicit
+    # no local span live: the remote context is adopted
+    j.emit("prefill_run", remote_parent="tr:feedbeef0000")
+    with adopt_remote_ctx("tr:abc123abc123"):
+        j.emit("engine_admit", dur=0.0)                  # envelope adopt
+    j.close()
+
+    by_name = {s.name: s for s in pair_spans(load_events(path))}
+    assert by_name["ckpt_restore"].parent == restart
+    assert by_name["compile"].parent == restart          # local wins
+    assert by_name["rendezvous_wait"].parent == "aaa"    # explicit wins
+    assert by_name["prefill_run"].parent == "feedbeef0000"
+    assert by_name["engine_admit"].parent == "abc123abc123"
+
+
+def test_span_ids_deterministic_under_trace_seed(monkeypatch):
+    """Seeded chaos/fleetsim discipline: the same seed mints the same
+    id stream; different seeds (or no seed) diverge. Streams are
+    per-name so concurrent threads emitting OTHER span names cannot
+    shift this name's ids between replays."""
+    import dlrover_tpu.telemetry.journal as journal_mod
+
+    def stream(seed, n=4, name="train_step", interleave=()):
+        monkeypatch.setenv(EnvKey.TRACE_SEED, seed)
+        monkeypatch.setattr(journal_mod, "_SPAN_SEQ", {})
+        out = []
+        for _ in range(n):
+            out.append(journal_mod.mint_span_id(name))
+            for other in interleave:          # racing thread, other name
+                journal_mod.mint_span_id(other)
+        return out
+
+    a, b = stream("chaos:1234"), stream("chaos:1234")
+    assert a == b
+    # a heartbeat thread drawing ids between ours must not shift them
+    assert stream("chaos:1234", interleave=("master_rpc",)) == a
+    assert stream("chaos:9") != a
+    assert stream("chaos:1234", name="master_rpc") != a
+    assert len(set(a)) == len(a)                 # per-span, not per-run
+    monkeypatch.delenv(EnvKey.TRACE_SEED)
+    assert journal_mod.mint_span_id() != journal_mod.mint_span_id()
+
+
+def test_trace_assembler_tree_and_critical_path(tmp_path, capsys):
+    """telemetry/trace.py on a synthetic two-process request journal:
+    one assembled tree, critical-path self times tile the root wall,
+    and the request phases sum to exactly the journaled wall."""
+    from dlrover_tpu.telemetry import trace as trace_mod
+
+    path = str(tmp_path / "events.jsonl")
+    gw = EventJournal(path, proc="gw0", trace_id="tr")
+    eng = EventJournal(path, proc="decode0", trace_id="tr")
+    root = gw.emit("gateway_request", dur=1.0, rid=7, t=11.0,
+                   finish="length")
+    gw.emit("gateway_queue", parent=root, dur=0.2, t=10.2)
+    gw.emit("gateway_route", parent=root, dur=0.0, t=10.2)
+    gw.emit("gateway_prefill", parent=root, dur=0.5, t=10.7)
+    gw.emit("gateway_decode", parent=root, dur=0.3, t=11.0)
+    eng.emit("engine_admit", dur=0.1, t=10.75,
+             remote_parent=f"tr:{root}")
+    gw.close()
+    eng.close()
+
+    roots = trace_mod.build_forest(trace_mod.load_spans([path]))
+    [req] = trace_mod.find_request_roots(roots, "7")
+    assert {n.span.name for n in req.walk()} == {
+        "gateway_request", "gateway_queue", "gateway_route",
+        "gateway_prefill", "gateway_decode", "engine_admit"}
+    assert req.n_procs() == 2
+    phases = trace_mod.request_phases(req)
+    wall = phases.pop("wall_s")
+    assert sum(phases.values()) == pytest.approx(wall, abs=1e-6)
+    segs = trace_mod.critical_path(req)
+    assert sum(s["self_s"] for s in segs) == pytest.approx(
+        req.dur, abs=1e-6)
+    # CLI smoke: text render names the phases, json is parseable
+    assert trace_mod.main(["--journal", path, "--request", "7"]) == 0
+    assert "critical path" in capsys.readouterr().out
+    assert trace_mod.main(["--journal", path, "--format", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["roots"][0]["tree"]["name"] == "gateway_request"
+
+
+def test_timeline_emits_cross_lane_flow_events(tmp_path):
+    """Perfetto flow arrows (§27): a parent/child pair in different
+    lanes gets one ph="s"/"f" pair with a shared id; same-lane nesting
+    gets none."""
+    from dlrover_tpu.telemetry.timeline import build_trace
+
+    path = str(tmp_path / "events.jsonl")
+    agent = EventJournal(path, proc="agent0", trace_id="tr")
+    trainer = EventJournal(path, proc="trainer0", trace_id="tr")
+    restart = agent.begin("node_restart", kind="failure")
+    time.sleep(0.01)
+    child = trainer.begin("ckpt_restore", parent=restart)
+    time.sleep(0.01)
+    trainer.end(child, "ckpt_restore")
+    agent.end(restart, "node_restart")
+    agent.close()
+    trainer.close()
+
+    events = build_trace([path])["traceEvents"]
+    starts = [e for e in events if e["ph"] == "s"]
+    finishes = [e for e in events if e["ph"] == "f"]
+    assert len(starts) == 1 and len(finishes) == 1
+    assert starts[0]["id"] == finishes[0]["id"]
+    assert starts[0]["pid"] != finishes[0]["pid"]  # crosses lanes
+
+
 # --------------------------------------------------------- lost-time report
 
 
