@@ -1494,16 +1494,19 @@ def bench_control_plane(extra: dict,
     t_start = time.monotonic()
     seed = int(os.environ.get("BENCH_CP_SEED", "2026"))
 
-    def tier_profile(nodes: int, full_every: int = 10) -> FleetProfile:
+    def tier_profile(nodes: int, full_every: int = 10,
+                     racks: int = 0) -> FleetProfile:
         # churn (failure + death waves) only at the small tier: each
         # wave re-distributes the O(nodes)-sized comm world to every
         # agent — the measured O(nodes^2) cost that at 5k nodes would
         # eat the stage deadline for no extra signal
         churn = nodes <= 1000
         return FleetProfile(
-            name=f"cp{nodes}_f{full_every}",
+            name=f"cp{nodes}_f{full_every}" + (
+                f"_r{racks}" if racks else ""),
             seed=seed,
             nodes=nodes,
+            racks=racks,
             duration_s=45.0 if churn else 30.0,
             snapshot_interval_s=15.0 if churn else 20.0,
             heartbeat_interval_s=15.0,
@@ -1525,18 +1528,25 @@ def bench_control_plane(extra: dict,
     os.environ[EnvKey.JOURNAL_DIR] = journal_dir
     tiers_done: list[int] = []
 
-    def record_tier(nodes: int, res) -> None:
+    flat_tiers: list[int] = []
+
+    def record_tier(nodes: int, res, racked: bool = False) -> None:
         tiers_done.append(nodes)
         extra[f"cp_master_rpc_p99_ms_n{nodes}"] = round(
             res.overall_p99_ms(), 3)
+        extra[f"cp_rounds_n{nodes}"] = len(res.rounds)
+        extra[f"cp_sim_wall_s_n{nodes}"] = round(res.wall_s, 1)
+        if racked:
+            # per-agent RPCs terminate at the sub-masters: the root-side
+            # join/snapshot rows that the flat keys read do not exist
+            return
+        flat_tiers.append(nodes)
         extra[f"cp_master_joins_per_s_n{nodes}"] = round(
             res.joins_per_s())
         extra[f"cp_join_mean_ms_n{nodes}"] = round(
             res.join_mean_ms(), 4)
         extra[f"cp_snapshot_ingest_ms_n{nodes}"] = round(
             res.snapshot_ingest_mean_ms(), 4)
-        extra[f"cp_rounds_n{nodes}"] = len(res.rounds)
-        extra[f"cp_sim_wall_s_n{nodes}"] = round(res.wall_s, 1)
 
     try:
         # delta-compressed snapshot pushes vs full, same seeded 1k
@@ -1572,17 +1582,59 @@ def bench_control_plane(extra: dict,
         # ~wall cost scales with nodes^2 (the O(world)-sized comm-world
         # response goes to every agent): gate the big tiers on what is
         # left of the stage budget
-        for nodes, est_s in ((5000, 160), (10000, 600)):
+        for nodes, est_s in ((5000, 160),):
             left = stage_budget_s - (time.monotonic() - t_start)
             if left < est_s + 30:
                 break
             record_tier(nodes, FleetSimulator(tier_profile(nodes)).run())
         extra["cp_tiers"] = tiers_done
 
+        # §28 racked 10k tier: the fleet behind nodes//64 sub-masters,
+        # the root seeing only per-rack merged pushes / batched joins /
+        # world pulls. One death exercises the comm-world diff path at
+        # scale (survivors reshard; racks pull the new world as a diff
+        # against their acked round instead of a full re-send).
+        left = stage_budget_s - (time.monotonic() - t_start)
+        if left >= 90 + 30:
+            nodes = 10000
+            racks = nodes // 64
+            rp = tier_profile(nodes, racks=racks)
+            rp.name = f"cp{nodes}_r{racks}"
+            rp.deaths = 1
+            res = FleetSimulator(rp).run()
+            record_tier(nodes, res, racked=True)
+            extra[f"cp_racks_n{nodes}"] = racks
+            root_calls = sum(r["calls"] for r in res.rpc.values())
+            extra[f"cp_root_calls_n{nodes}"] = root_calls
+            extra[f"cp_root_calls_per_agent_n{nodes}"] = round(
+                root_calls / nodes, 3)
+            rack_join = res.rpc.get("RackJoinRequest")
+            if rack_join:
+                extra[f"cp_rack_join_mean_ms_n{nodes}"] = \
+                    rack_join["mean_ms"]
+            d = res.to_dict()
+            extra["cp_world_diff_bytes_frac"] = \
+                d["world_diff_bytes_frac"]
+            # the tier's whole point: root load (and thus its p99)
+            # stays ~flat as the fleet grows 10x past the 1k tier
+            p99_1k = extra.get("cp_master_rpc_p99_ms_n1000")
+            p99_10k = extra[f"cp_master_rpc_p99_ms_n{nodes}"]
+            if p99_1k:
+                extra["cp_rack_p99_ratio_10k_vs_1k"] = round(
+                    p99_10k / p99_1k, 2)
+                extra["cp_rack_p99_within_2x_1k"] = bool(
+                    p99_10k < 2.0 * p99_1k)
+                assert p99_10k < 2.0 * p99_1k, (
+                    f"racked 10k master rpc p99 {p99_10k:.2f}ms vs "
+                    f"{p99_1k:.2f}ms at 1k — the rack tier is not "
+                    "holding root load flat"
+                )
+
         # the join hot path must stay ~flat across tiers (the §22 O(1)
-        # rendezvous contract): report the measured ratio
-        if len(tiers_done) >= 2:
-            lo, hi = tiers_done[0], tiers_done[-1]
+        # rendezvous contract): report the measured ratio. Flat tiers
+        # only — in rack mode joins reach the root pre-batched.
+        if len(flat_tiers) >= 2:
+            lo, hi = flat_tiers[0], flat_tiers[-1]
             lo_ms = extra[f"cp_join_mean_ms_n{lo}"]
             hi_ms = extra[f"cp_join_mean_ms_n{hi}"]
             if lo_ms > 0:
@@ -2595,10 +2647,11 @@ STAGES = [
     Stage("chaos", bench_chaos, est_s=130, deadline_s=300,
           pass_budget=True, min_deadline_s=180),
     # control-plane saturation (CPU-only, no devices): 1k tier + the
-    # delta-snapshot comparison fit in ~60 s; the 5k tier rides when
-    # the budget allows (min gate covers 1k + delta/full)
-    Stage("control_plane", bench_control_plane, est_s=240,
-          deadline_s=420, pass_budget=True, min_deadline_s=90),
+    # delta-snapshot comparison fit in ~60 s; the 5k flat tier and the
+    # 10k racked tier (§28, ~60 s — the rack fan-in makes 10k cheaper
+    # than 5k flat) ride when the budget allows
+    Stage("control_plane", bench_control_plane, est_s=300,
+          deadline_s=560, pass_budget=True, min_deadline_s=90),
     Stage("int8", bench_int8, est_s=275, deadline_s=450),
     # strategy autopilot (CPU-runnable): plan-vs-measured agreement,
     # history-seeded re-planning, seeded forced-contradiction retune
@@ -2642,6 +2695,9 @@ HEADLINE_KEYS = [
     "soak_completed", "soak_kills",
     "chaos_completed", "chaos_recovery_seconds", "chaos_goodput",
     "cp_master_rpc_p99_ms_n1000", "cp_master_rpc_p99_ms_n5000",
+    "cp_master_rpc_p99_ms_n10000", "cp_rack_p99_ratio_10k_vs_1k",
+    "cp_rack_p99_within_2x_1k", "cp_racks_n10000",
+    "cp_root_calls_per_agent_n10000", "cp_world_diff_bytes_frac",
     "cp_master_joins_per_s_n1000", "cp_master_joins_per_s_n5000",
     "cp_snapshot_ingest_ms_n1000", "cp_join_cost_ratio",
     "cp_snapshot_wire_reduction", "cp_snapshot_ingest_reduction",

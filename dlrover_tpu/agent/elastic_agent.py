@@ -127,8 +127,20 @@ class ElasticAgent:
 
     def __init__(self, config: AgentConfig, client: MasterClient | None = None):
         self._config = config
+        # rack attach (DESIGN.md §28): when the launcher placed this
+        # node behind a rack sub-master it sets DLROVER_TPU_RACK_ID and
+        # points master_addr at the sub-master. The client then
+        # re-dials target-keyed: the rack's own port file first, the
+        # root's as the degraded direct-to-root fallback — and prefers
+        # the rack file again on every re-dial, so a respawned
+        # sub-master reclaims its agents automatically.
+        rack_port_file = envspec.get(EnvKey.RACK_PORT_FILE) \
+            if envspec.get(EnvKey.RACK_ID) else None
         self._client = client or MasterClient(
-            config.master_addr, config.node_id
+            config.master_addr, config.node_id,
+            port_file=rack_port_file,
+            fallback_port_file=envspec.get(EnvKey.MASTER_PORT_FILE)
+            if rack_port_file else None,
         )
         self._proc: subprocess.Popen | None = None
         # failure restarts (consume the failover budget) vs the incarnation

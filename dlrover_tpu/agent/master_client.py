@@ -69,13 +69,30 @@ class MasterClient:
     _instance_lock = threading.Lock()
 
     def __init__(self, master_addr: str, node_id: int, transport=None,
-                 snapshot_full_every: int | None = None):
+                 snapshot_full_every: int | None = None,
+                 port_file: str | None = None,
+                 fallback_port_file: str | None = None,
+                 epoch_observer=None):
         # ``transport`` is any object with RpcClient's call/close
         # surface; the fleet simulator passes an in-process loopback so
         # thousands of simulated agents exercise the genuine typed
         # client + serde path without a socket each
         self._client = transport or RpcClient(master_addr)
         self.node_id = node_id
+        # target-keyed re-dial (§28): the atomic port file THIS client's
+        # target republishes after a restart. None falls back to the
+        # root master's file (EnvKey.MASTER_PORT_FILE) — the pre-rack
+        # behavior. A rack-attached agent passes its sub-master's file
+        # plus the root's as ``fallback_port_file``: when the rack file
+        # yields no fresh address the client degrades to dialing the
+        # root directly, and returns to the rack the moment a restarted
+        # sub-master republishes.
+        self._port_file = port_file
+        self._fallback_port_file = fallback_port_file
+        # replaces the built-in agent reconcile as the reaction to a
+        # transport-envelope epoch change: the rack sub-master handles
+        # a root restart by re-registering its rack instead (§28)
+        self._epoch_observer = epoch_observer
         # per-role delta state for metrics pushes (one pushing loop per
         # role per process: heartbeat thread, trainer cadence, gateway)
         self._snapshot_full_every = snapshot_full_every
@@ -101,7 +118,8 @@ class MasterClient:
         # transports (fleetsim loopback) fence via the explicit
         # HeartbeatResponse/CommWorldResponse fields instead
         if hasattr(transport, "on_epoch"):
-            transport.on_epoch = self._observe_epoch
+            transport.on_epoch = \
+                self._epoch_observer or self._observe_epoch
 
     # ------------------------------------------------------- epoch fence
 
@@ -214,23 +232,34 @@ class MasterClient:
 
     # ------------------------------------------------------------ re-dial
 
-    def maybe_redial(self) -> bool:
-        """Re-resolve the master address from the atomic port file
-        (DLROVER_TPU_MASTER_PORT_FILE) — a restarted master binds a
-        fresh port and republishes it there. Returns True when the
-        client moved to a new address."""
-        path = envspec.get(EnvKey.MASTER_PORT_FILE)
-        if not path or not isinstance(self._client, RpcClient):
-            return False
+    def _read_port_file(self, path: str) -> str | None:
+        """host:port from one atomic port file, or None when the file
+        is missing/garbled or names the address already dialed."""
         try:
             with open(path) as f:
-                text = f.read().strip()
-            port = int(text)
+                port = int(f.read().strip())
         except (OSError, ValueError):
-            return False
+            return None
         host = self._client.addr.rsplit(":", 1)[0]
         new_addr = f"{host}:{port}"
-        if new_addr == self._client.addr:
+        return None if new_addr == self._client.addr else new_addr
+
+    def maybe_redial(self) -> bool:
+        """Re-resolve this client's TARGET from its atomic port file —
+        a restarted master (root or rack sub-master) binds a fresh port
+        and republishes it there. The file is target-keyed (§28): a
+        rack-attached client re-resolves its sub-master's own file, and
+        when that yields nothing fresh falls back to the root's file
+        (degraded direct-to-root; the next call prefers the rack file
+        again, so a respawned sub-master reclaims its agents). Returns
+        True when the client moved to a new address."""
+        if not isinstance(self._client, RpcClient):
+            return False
+        primary = self._port_file or envspec.get(EnvKey.MASTER_PORT_FILE)
+        new_addr = self._read_port_file(primary) if primary else None
+        if new_addr is None and self._fallback_port_file:
+            new_addr = self._read_port_file(self._fallback_port_file)
+        if new_addr is None:
             return False
         old = self._client
         fresh = old.clone(new_addr)
@@ -661,4 +690,57 @@ class MasterClient:
         self._client.call(
             m.JobExitRequest(node_id=self.node_id, success=success,
                              reason=reason)
+        )
+
+    # ------------------------------------- rack sub-master tier (§28)
+
+    def forward(self, msg):
+        """Pass a message built elsewhere through to this client's
+        target unchanged — the rack sub-master's relay for agent
+        messages it does not aggregate (failure reports, node events,
+        anything outside its local scope)."""
+        return self._client.call(msg)
+
+    def register_submaster(self, rack_id: str, addr: str = ""
+                           ) -> m.SubMasterRegisterResponse:
+        """Announce a rack sub-master to the root; the minted epoch in
+        the response is what the sub-master stamps on its agent-facing
+        replies (the rack tier's §26 fence)."""
+        return self._client.call(
+            m.SubMasterRegisterRequest(rack_id=rack_id, addr=addr)
+        )
+
+    def rack_join(self, rack_id: str, joins: list,
+                  rdzv_name: str = "training") -> m.RackJoinResponse:
+        """Push one rack's buffered rendezvous joins upstream as a
+        single batch (each entry: {node_id, addr, local_devices,
+        topology_key})."""
+        return self._client.call(
+            m.RackJoinRequest(rack_id=rack_id, rdzv_name=rdzv_name,
+                              joins=list(joins))
+        )
+
+    def rack_world(self, rack_id: str, acked_round: int = 0,
+                   rdzv_name: str = "training",
+                   cursor: int = 0) -> m.RackWorldResponse:
+        """Pull the comm-world versioned against the last acked round;
+        the root answers with a compact member diff when it still holds
+        that round's world. Payloads are chunk-bounded: a nonzero
+        ``next_cursor`` on the response resumes the transfer here."""
+        return self._client.call(
+            m.RackWorldRequest(rack_id=rack_id, rdzv_name=rdzv_name,
+                               acked_round=acked_round, cursor=cursor)
+        )
+
+    def report_rack_merged(self, rack_id: str, heartbeats: list,
+                           snapshots: list, acks: list
+                           ) -> m.RackMergedResponse:
+        """One merged upstream push per sub-master flush tick: the
+        rack's aggregated heartbeats, metrics-snapshot deltas and
+        persist-acks (original rids preserved for the root's dedup)."""
+        return self._client.call(
+            m.RackMergedReport(rack_id=rack_id,
+                               heartbeats=list(heartbeats),
+                               snapshots=list(snapshots),
+                               acks=list(acks))
         )

@@ -769,9 +769,10 @@ def run_embedding_scenario(work_dir: str, *, seed: int = 4242,
 def master_kill_trail(journal_dir: str) -> dict:
     """Canonical, replay-comparable trail of a master-kill scenario
     (DESIGN.md §26): master restarts (epoch sequence), agent epoch-fence
-    reconciles, rendezvous rounds, autopilot retunes and snapshot
-    rollbacks — occurrence-indexed and sorted like the chaos fault
-    trail, so two seeded runs compare verbatim."""
+    reconciles, rendezvous rounds, autopilot retunes, snapshot
+    rollbacks and rack sub-master failovers (§28) — occurrence-indexed
+    and sorted like the chaos fault trail, so two seeded runs compare
+    verbatim."""
     entries: list[list[Any]] = []
     for e in _read_journal(journal_dir):
         name = e.get("name")
@@ -794,6 +795,10 @@ def master_kill_trail(journal_dir: str) -> dict:
         elif name == "degraded_mode":
             entries.append(["degraded_mode", e.get("component", ""),
                             e.get("state", "")])
+        elif name == "submaster_failover":
+            entries.append(["submaster_failover", e.get("rack", ""),
+                            e.get("old_epoch", 0),
+                            e.get("new_epoch", 0)])
     counts: dict[str, int] = {}
     indexed: list[list[Any]] = []
     for entry in entries:
@@ -806,7 +811,8 @@ def master_kill_trail(journal_dir: str) -> dict:
 
 @dataclasses.dataclass
 class MasterKillScenarioResult:
-    """What survived four SIGKILLs of the master (§26 acceptance)."""
+    """What survived four SIGKILLs of the root master (§26
+    acceptance) plus one SIGKILL of a rack sub-master (§28)."""
 
     epochs: list[int]              # epoch of each restarted master
     round_after_restart: int       # rendezvous round completed on M2
@@ -819,6 +825,10 @@ class MasterKillScenarioResult:
     retunes_used_final: int        # budget charged per the final state
     restart_actions: int           # "restart" actions agents received
     trail: dict
+    # §28 sub-master kill leg: rack epoch before/after the SIGKILL and
+    # the rendezvous round that completed THROUGH the respawned tier
+    sub_epochs: list[int] = dataclasses.field(default_factory=list)
+    sub_round: int = 0
 
     def assert_invariants(self) -> None:
         assert self.epochs == [2, 3, 4, 5], (
@@ -850,6 +860,18 @@ class MasterKillScenarioResult:
             f"trainers were asked to restart {self.restart_actions} "
             "times during master failover"
         )
+        # §28: the root mints the rack epoch above its own (5 after
+        # four restarts), and the sub-master SIGKILL re-mints above the
+        # predecessor — the fence the rack's agents reconcile on
+        assert self.sub_epochs == [6, 7], (
+            f"rack epochs not re-minted across the sub-master kill: "
+            f"{self.sub_epochs}"
+        )
+        assert self.sub_round == 3, (
+            "the round interrupted by the sub-master kill did not "
+            f"complete through the respawned tier (round "
+            f"{self.sub_round})"
+        )
 
 
 def run_master_kill_scenario(work_dir: str, *, seed: int = 4242
@@ -862,7 +884,14 @@ def run_master_kill_scenario(work_dir: str, *, seed: int = 4242
     redelivery replay, restored ack ledger/rendezvous/autopilot state.
     The kill points are state-based (the snapshot provably contains the
     in-flight mutation before the SIGKILL lands), so the trail is
-    replay-identical across runs of the same seed."""
+    replay-identical across runs of the same seed.
+
+    A fifth leg SIGKILLs a REAL rack sub-master (§28) mid-rendezvous-
+    round: its agents re-resolve the rack's target-keyed port file,
+    fence on the rack epoch the root re-mints, and the interrupted
+    round completes through the respawned tier — zero trainer
+    restarts, and the ``submaster_failover`` event lands in the same
+    replay-comparable trail."""
     import zlib
 
     from dlrover_tpu.agent.master_client import MasterClient
@@ -972,7 +1001,7 @@ def run_master_kill_scenario(work_dir: str, *, seed: int = 4242
                          "sum": cum[0], "count": int(cum[1])}],
         }], role="trainer")
 
-    a0 = a1 = None
+    a0 = a1 = ra0 = ra1 = None
     try:
         port = spawn_master("")
         addr = f"127.0.0.1:{port}"
@@ -1129,6 +1158,100 @@ def run_master_kill_scenario(work_dir: str, *, seed: int = 4242
         retunes_used_final = int(
             state.get("autopilot", {}).get("retunes_used", 0))
         epochs.append(a0.master_epoch)
+
+        # ---- kill 5 (§28): SIGKILL the rack SUB-MASTER mid-
+        # rendezvous-round. The rack tier's own failover: agents
+        # re-resolve the rack's target-keyed port file, fence on the
+        # rack epoch the root re-mints, and the interrupted round
+        # completes — with zero trainer restarts --------------------
+        rack_port_file = os.path.join(work_dir, "rack.port")
+        port = open(port_file).read().strip()
+        root_addr = f"127.0.0.1:{port}"
+        # the sub-master's upstream redial resolves the ROOT's port
+        # file; the parent set it in os.environ after ``env`` was taken
+        sub_env = dict(env)
+        sub_env[EnvKey.MASTER_PORT_FILE] = port_file
+
+        def spawn_submaster(prev_port: str) -> str:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "dlrover_tpu.master.submaster",
+                 "--rack-id", "rackA", "--master-addr", root_addr,
+                 "--port-file", rack_port_file,
+                 "--flush-interval", "0.1"],
+                env=sub_env, cwd=REPO, stdout=log, stderr=log,
+            )
+            procs.append(proc)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"sub-master exited early rc={proc.returncode}"
+                    )
+                try:
+                    with open(rack_port_file) as f:
+                        text = f.read().strip()
+                    if text and text != prev_port:
+                        return text
+                except OSError:
+                    pass
+                time.sleep(0.05)
+            raise TimeoutError("sub-master never published its port")
+
+        rack_port = spawn_submaster("")
+
+        def make_rack_agent(nid: int) -> MasterClient:
+            rack_addr = f"127.0.0.1:{rack_port}"
+            return MasterClient(
+                rack_addr, nid,
+                transport=RpcClient(rack_addr, retries=2,
+                                    deadline_s=4.0,
+                                    backoff_base_s=0.05,
+                                    backoff_max_s=0.2),
+                port_file=rack_port_file,
+                fallback_port_file=port_file,
+            )
+
+        ra0, ra1 = make_rack_agent(0), make_rack_agent(1)
+        actions.append(ra0.report_heartbeat(0))
+        actions.append(ra1.report_heartbeat(0))
+        sub_epochs = [ra0.master_epoch]
+        # node 0 re-joins THROUGH the rack: buffered at the sub-master
+        # and pushed upstream as a RackJoinRequest batch at its flush
+        ra0.join_rendezvous("127.0.0.1:7770", 4)
+
+        def _rack_join_pushed(s: dict) -> bool:
+            # the kill must land mid-round with the rack's join durable
+            # at the ROOT (round 2 invalidated, node 0 waiting): the
+            # in-flight picture the respawned tier completes from
+            rdzv = s.get("rendezvous", {}).get("training", {})
+            return (
+                int(rdzv.get("round", 0)) == 2
+                and [int(w.get("node_id", -1))
+                     for w in rdzv.get("waiting", ())] == [0]
+                and bool(s.get("racks", {}).get("epochs"))
+            )
+
+        wait_state(_rack_join_pushed,
+                   "rack join pushed upstream mid-round")
+        sub_proc = procs[-1]
+        os.kill(sub_proc.pid, 9)
+        sub_proc.wait(timeout=10)
+        rack_port = spawn_submaster(rack_port)
+        reconnect(ra0)
+        reconnect(ra1)
+        # the respawned incarnation lost its buffered join floors:
+        # re-join (idempotent at the root — newest join wins) so the
+        # sub serves these agents the NEW round, never a stale mirror
+        ra0.join_rendezvous("127.0.0.1:7770", 4)
+        ra1.join_rendezvous("127.0.0.1:7771", 4)
+        rw0 = ra0.wait_comm_world(timeout=30)
+        rw1 = ra1.wait_comm_world(timeout=30)
+        assert rw0.round == rw1.round, \
+            "rack agents disagree on the post-failover round"
+        sub_round = rw0.round
+        actions.append(ra0.report_heartbeat(0))
+        actions.append(ra1.report_heartbeat(0))
+        sub_epochs.append(ra0.master_epoch)
     finally:
         for proc in procs:
             try:
@@ -1136,7 +1259,7 @@ def run_master_kill_scenario(work_dir: str, *, seed: int = 4242
                 proc.wait(timeout=5)
             except (ProcessLookupError, subprocess.TimeoutExpired):
                 pass
-        for agent in (a0, a1):
+        for agent in (a0, a1, ra0, ra1):
             if agent is not None:
                 agent.close()
         log.close()
@@ -1162,6 +1285,8 @@ def run_master_kill_scenario(work_dir: str, *, seed: int = 4242
         retunes_used_final=retunes_used_final,
         restart_actions=sum(1 for a in actions if a == "restart"),
         trail=master_kill_trail(journal_dir),
+        sub_epochs=sub_epochs,
+        sub_round=sub_round,
     )
 
 

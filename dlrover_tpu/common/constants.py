@@ -238,6 +238,17 @@ class EnvKey:
     MASTER_PORT_FILE = "DLROVER_TPU_MASTER_PORT_FILE"
     REDELIVERY_QUEUE = "DLROVER_TPU_REDELIVERY_QUEUE"
     DEGRADED_WARN_S = "DLROVER_TPU_DEGRADED_WARN_S"
+    # hierarchical control plane (DESIGN.md §28): the rack this agent
+    # belongs to (assigns it to a rack sub-master), the sub-master's
+    # own atomic port file (target-keyed re-dial, same mechanism as the
+    # root's), the byte bound on the rack-local compile-cache mirror,
+    # and the sub-master's merged-upstream-push cadence
+    RACK_ID = "DLROVER_TPU_RACK_ID"
+    RACK_PORT_FILE = "DLROVER_TPU_RACK_PORT_FILE"
+    RACK_CACHE_MB = "DLROVER_TPU_RACK_CACHE_MB"
+    RACK_FLUSH_S = "DLROVER_TPU_RACK_FLUSH_S"
+    RACK_WORLD_CHUNK = "DLROVER_TPU_RACK_WORLD_CHUNK"
+    RACK_MERGE_MAX = "DLROVER_TPU_RACK_MERGE_MAX"
 
 
 class Defaults:

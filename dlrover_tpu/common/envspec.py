@@ -252,6 +252,33 @@ SPECS: tuple[EnvVar, ...] = (
            "seconds between repeated 'master unreachable' warnings "
            "while an agent link is degraded (the outage itself is one "
            "journal instant + a counter, not log spam)", "§26"),
+    # --------------------------------------------- hierarchical control plane
+    EnvVar("DLROVER_TPU_RACK_ID", None,
+           "rack this agent belongs to; the launcher points the agent "
+           "at that rack's sub-master instead of the root (unset = "
+           "flat topology, dial the root directly)", "§28",
+           restart_required=True),
+    EnvVar("DLROVER_TPU_RACK_PORT_FILE", None,
+           "the rack sub-master's own atomic port file: agents "
+           "re-resolve a restarted sub-master from it (target-keyed "
+           "twin of DLROVER_TPU_MASTER_PORT_FILE; a stale/missing file "
+           "degrades the agent to the root)", "§28"),
+    EnvVar("DLROVER_TPU_RACK_CACHE_MB", "256",
+           "byte bound (MB) on the sub-master's rack-local "
+           "compile-cache LRU mirror; misses fall through to the root",
+           "§28"),
+    EnvVar("DLROVER_TPU_RACK_FLUSH_S", "1.0",
+           "seconds between a sub-master's merged upstream pushes "
+           "(aggregated heartbeats, metrics deltas, persist-acks go up "
+           "as one batch per tick)", "§28"),
+    EnvVar("DLROVER_TPU_RACK_WORLD_CHUNK", "512",
+           "max comm-world members per RackWorldResponse: bigger "
+           "worlds stream as cursor-chunked pulls so no single root "
+           "RPC is O(world) (the §28 bounded-RPC rule)", "§28"),
+    EnvVar("DLROVER_TPU_RACK_MERGE_MAX", "2",
+           "max metrics snapshots per merged upstream push; a burst "
+           "drains as several bounded pushes in one flush tick so the "
+           "root's per-RPC handler time stays flat", "§28"),
 )
 
 SPEC_BY_NAME: dict[str, EnvVar] = {spec.name: spec for spec in SPECS}

@@ -807,3 +807,140 @@ class AutopilotPlanReport:
     # rejects alternatives whose mesh cannot shard it, BEFORE a retune
     # is armed/journaled/charged; 0 = unknown (schedule gate only)
     step_batch: int = 0
+
+
+# -------------------------------------- rack sub-master tier (DESIGN.md §28)
+
+
+@register_message
+@dataclasses.dataclass
+class SubMasterRegisterRequest:
+    """A rack sub-master announcing itself to the root master.
+
+    The root mints a monotonic per-rack epoch (persisted in the master
+    state snapshot, §26): a restarted sub-master registers again and
+    receives a HIGHER epoch, which it stamps on its own agent-facing
+    responses — the agents' existing epoch-fence reconcile then treats
+    the sub-master crash exactly like a master restart."""
+
+    rack_id: str = ""
+    addr: str = ""  # the sub-master's agent-facing host:port
+
+
+@register_message
+@dataclasses.dataclass
+class SubMasterRegisterResponse:
+    # the minted per-rack epoch this sub-master incarnation serves with
+    epoch: int = 0
+    # root incarnation (§26): the sub-master watches it across rack
+    # RPCs and re-registers when the ROOT restarts, bumping its own
+    # epoch so the agents behind it reconcile too
+    master_epoch: int = 0
+    # job-wide trace id, adopted like CommWorldResponse.trace_id
+    trace_id: str = ""
+
+
+@register_message
+@dataclasses.dataclass
+class RackJoinRequest:
+    """One rack's batched rendezvous joins: the rack quorum summary.
+
+    Two-level rendezvous (§28): agents join at their sub-master, which
+    forwards the buffered joins upstream as ONE request per flush tick
+    — the root sees O(racks) join RPCs per round, not O(nodes)."""
+
+    rack_id: str = ""
+    rdzv_name: str = "training"
+    # each entry: {node_id, addr, local_devices, topology_key}
+    joins: list = dataclasses.field(default_factory=list)
+
+
+@register_message
+@dataclasses.dataclass
+class RackJoinResponse:
+    round: int = 0
+    master_epoch: int = 0
+
+
+@register_message
+@dataclasses.dataclass
+class RackWorldRequest:
+    """A sub-master pulling the comm-world, versioned against the last
+    round it acked: the root answers with a compact DIFF (changed
+    members only) when it still holds the acked round's world, a full
+    world otherwise."""
+
+    rack_id: str = ""
+    rdzv_name: str = "training"
+    # last round whose world this sub-master holds (0 = none: full)
+    acked_round: int = 0
+    # chunked transfer cursor: resume a bounded world pull from this
+    # member offset (0 starts a new transfer)
+    cursor: int = 0
+
+
+@register_message
+@dataclasses.dataclass
+class RackWorldResponse:
+    """Comm-world for one rack, as a diff when possible (§28).
+
+    ``base_round > 0``: apply ``added`` (new/re-ranked members) and
+    ``removed`` on top of the acked ``base_round`` world to obtain the
+    ``round`` world — bit-equal to the full membership the root holds.
+    ``base_round == 0``: ``world`` carries the full membership.
+
+    ``rerank``: ranks are positional, so one mid-world removal shifts
+    every later rank — shipped naively that diff is O(world). When the
+    root verifies that survivors keep their relative rank order (always
+    true for the positional assignment), it sets ``rerank`` and ships
+    only genuinely-new members in ``added``: the receiver re-derives
+    survivor ranks by filling the rank slots not taken by ``added``
+    with the base's survivors in base-rank order.
+
+    Either payload is bounded to DLROVER_TPU_RACK_WORLD_CHUNK members
+    per response; ``next_cursor > 0`` means more chunks of the same
+    ``round`` remain — re-pull with that cursor (``removed`` travels
+    whole on the first chunk)."""
+
+    completed: bool = False
+    round: int = 0
+    base_round: int = 0
+    rerank: bool = False
+    next_cursor: int = 0
+    world: dict[int, int] = dataclasses.field(default_factory=dict)
+    added: dict[int, int] = dataclasses.field(default_factory=dict)
+    removed: list[int] = dataclasses.field(default_factory=list)
+    coordinator: str = ""
+    total_devices: int = 0
+    trace_id: str = ""
+    reshard: bool = False
+    master_epoch: int = 0
+    sctx: str = ""
+
+
+@register_message
+@dataclasses.dataclass
+class RackMergedReport:
+    """One rack's merged upstream push per flush tick (§28): the
+    locally aggregated heartbeats, metrics-snapshot deltas and
+    persist-acks travel as one RPC instead of one per agent.
+
+    ``heartbeats``: {node_id, restart_count} per alive agent since the
+    last tick. ``snapshots``: {node_id, role, samples, is_delta} in
+    the MetricsSnapshotRequest shape. ``acks``: full PersistAckReport
+    field dicts with their ORIGINAL rids, so the root's rid dedup
+    holds across sub-master retries and failover replays."""
+
+    rack_id: str = ""
+    heartbeats: list = dataclasses.field(default_factory=list)
+    snapshots: list = dataclasses.field(default_factory=list)
+    acks: list = dataclasses.field(default_factory=list)
+
+
+@register_message
+@dataclasses.dataclass
+class RackMergedResponse:
+    # node_id(str) -> pending master action ("restart", "profile:K"),
+    # relayed to the agent on its next heartbeat at the sub-master
+    actions: dict = dataclasses.field(default_factory=dict)
+    master_epoch: int = 0

@@ -72,6 +72,14 @@ class FleetProfile:
     # from the restart until every alive agent re-registered) and the
     # re-registered-nodes curve. Placed mid-window after the waves.
     master_restarts: int = 0
+    # rack sub-master tier (DESIGN.md §28): 0 = flat (every agent dials
+    # the root directly, the pre-§28 topology); N > 0 partitions the
+    # fleet into N contiguous racks, each behind a real in-process
+    # SubMaster. Only ROOT-bound RPCs are measured then — the headline
+    # master_rpc_* keys read the root's load, which is the tier's whole
+    # point. Sub-masters flush on the virtual clock at rack_flush_s.
+    racks: int = 0
+    rack_flush_s: float = 0.5
 
     def __post_init__(self) -> None:
         if self.nodes < 1:
@@ -80,6 +88,8 @@ class FleetProfile:
             raise ValueError("deaths must leave at least one node")
         if not 0.0 <= self.trainer_frac <= 1.0:
             raise ValueError("trainer_frac must be in [0, 1]")
+        if self.racks < 0 or self.racks > self.nodes:
+            raise ValueError("racks must be in [0, nodes]")
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), sort_keys=True)

@@ -130,6 +130,33 @@ class _LoopbackTransport:
         pass
 
 
+class _RackTransport:
+    """Agent -> sub-master hop: direct in-process dispatch, unmeasured.
+
+    In rack mode (DESIGN.md §28) the headline ``master_rpc_*`` keys
+    must read the ROOT's load — that is the tier's whole point — so
+    only the shared upstream loopback is measured. Skipping serde on
+    the rack-local hop also keeps the 10k-agent tier's wall cost
+    proportional to root traffic rather than agent traffic.
+    """
+
+    def __init__(self, handler):
+        self._handler = handler
+
+    def call(self, msg: Any) -> Any:
+        return self._handler(msg)
+
+    def close(self) -> None:
+        pass
+
+
+def _counter_total(metric) -> float:
+    """Sum a registry counter across its children (0.0 when untouched).
+    The registry is process-global, so rack byte accounting subtracts a
+    pre-run base — same convention as the lock-wait histograms."""
+    return sum(s["value"] for s in metric.samples())
+
+
 class _SimAgent:
     __slots__ = ("node_id", "client", "alive", "is_trainer",
                  "is_straggler", "push_idx", "trainer_cum_sum",
@@ -163,6 +190,11 @@ class SimResult:
     # master_restarts profile
     master_recovery_s: float | None = None
     reregistered_curve: list = dataclasses.field(default_factory=list)
+    # §28 comm-world diff accounting (root-side counters, run delta):
+    # bytes actually sent for rack world pulls vs what full worlds
+    # would have cost — the sublinearity evidence the bench pins
+    world_diff_bytes: int = 0
+    world_full_bytes: int = 0
 
     # ------------------------------------------------------ derived views
 
@@ -220,6 +252,12 @@ class SimResult:
             "reregistered_curve": [
                 [dt, n] for dt, n in self.reregistered_curve
             ],
+            "world_diff_bytes": self.world_diff_bytes,
+            "world_full_bytes": self.world_full_bytes,
+            "world_diff_bytes_frac": (
+                round(self.world_diff_bytes / self.world_full_bytes, 4)
+                if self.world_full_bytes else None
+            ),
         }
 
 
@@ -232,6 +270,7 @@ class FleetSimulator:
         "death",
     )
     _MASTER_RESTART = "master_restart"
+    _RACK_FLUSH = "rack_flush"
 
     def __init__(self, profile: FleetProfile):
         self.profile = profile
@@ -247,6 +286,10 @@ class FleetSimulator:
         self._reregistered: set[int] = set()
         self._rereg_curve: list[tuple[float, int]] = []
         self._recovery_s: float | None = None
+        # §28 rack tier (populated in run() when profile.racks > 0)
+        self._subs: list = []
+        self._rack_of: list[int] = []
+        self._pre_restart_rack_epochs: list[int] = []
 
     # ------------------------------------------------------------ engine
 
@@ -291,6 +334,32 @@ class FleetSimulator:
         }
         transport = _LoopbackTransport(master.servicer.handle)
         self._transport = transport
+        # §28 world-diff byte counters: process-global registry, so the
+        # run's contribution is an end-minus-base delta
+        wd_metric = master.servicer._world_diff_bytes
+        wf_metric = master.servicer._world_full_bytes
+        wd_base = _counter_total(wd_metric)
+        wf_base = _counter_total(wf_metric)
+        rack_transports: list = []
+        if p.racks:
+            from dlrover_tpu.master.submaster import SubMaster
+
+            # real SubMasters, never start()ed: no sockets or flush
+            # threads — the virtual clock drives flush() through
+            # _RACK_FLUSH events so the merge cadence replays. All
+            # racks share the one measured root transport; agents dial
+            # their rack through an unmeasured direct hop.
+            self._subs = [
+                SubMaster(
+                    f"rack{r:03d}", upstream_transport=transport,
+                    flush_interval_s=3600.0,
+                )
+                for r in range(p.racks)
+            ]
+            self._rack_of = [i * p.racks // p.nodes
+                             for i in range(p.nodes)]
+            rack_transports = [_RackTransport(s.handle)
+                               for s in self._subs]
         rng_jitter = random.Random(f"{p.seed}:jitter")
         rng_pick = random.Random(f"{p.seed}:pick")
         k = round(p.nodes * p.straggler_frac)
@@ -301,7 +370,9 @@ class FleetSimulator:
             _SimAgent(
                 i,
                 MasterClient(
-                    "fleetsim", i, transport=transport,
+                    "fleetsim", i,
+                    transport=(rack_transports[self._rack_of[i]]
+                               if p.racks else transport),
                     snapshot_full_every=p.snapshot_full_every,
                 ),
                 is_trainer=i < trainer_cut,
@@ -311,6 +382,8 @@ class FleetSimulator:
         ]
         self._master = master
         self._trail("start", p.nodes, p.seed)
+        if p.racks:
+            self._trail("racks", p.racks)
         for node in sorted(stragglers):
             self._trail("straggler", node)
 
@@ -336,6 +409,12 @@ class FleetSimulator:
         if p.ckpt_interval_s > 0:
             self._schedule(p.join_window_s + p.ckpt_interval_s,
                            self._STORM, -1)
+        if p.racks:
+            for r in range(p.racks):
+                # stagger racks across one flush period so merged
+                # pushes don't all land on a single virtual instant
+                self._schedule(p.rack_flush_s * (r + 1) / p.racks,
+                               self._RACK_FLUSH, r)
         for r in range(p.master_restarts):
             # offset off the wave grid so a restart never shares a
             # virtual instant with a failure/death event
@@ -389,6 +468,8 @@ class FleetSimulator:
             virtual_s=horizon,
             master_recovery_s=self._recovery_s,
             reregistered_curve=list(self._rereg_curve),
+            world_diff_bytes=int(_counter_total(wd_metric) - wd_base),
+            world_full_bytes=int(_counter_total(wf_metric) - wf_base),
         )
         logger.info(
             "fleetsim %s: %d nodes, %d rounds, %d rpc types, "
@@ -432,6 +513,10 @@ class FleetSimulator:
                 self._on_storm(t)
             elif kind == self._MASTER_RESTART:
                 self._on_master_restart(t)
+            elif kind == self._RACK_FLUSH:
+                self._subs[node].flush()
+                self._schedule(t + p.rack_flush_s, self._RACK_FLUSH,
+                               node)
             elif kind in (self._FAIL, self._DEATH):
                 self._on_wave(t, kind, rng_jitter, rng_pick)
 
@@ -538,6 +623,10 @@ class FleetSimulator:
                        & 0xFFFFFFFF,
                        "bytes": 1 << 20, "pieces": {}},
             )
+        for sub in self._subs:
+            # drain buffered acks upstream before the ledger poll: the
+            # §20 commit wait in rack mode spans at most one merge tick
+            sub.flush()
         status = alive[0].client.persist_status(step, len(alive))
         self._trail("ckpt_storm", step, int(status.acked))
         self._schedule(t + self.profile.ckpt_interval_s, self._STORM,
@@ -572,6 +661,10 @@ class FleetSimulator:
         self._transport._handler = master.servicer.handle
         self._restart_t = t
         self._restart_epoch = master.master_epoch
+        # rack mode: agents fence on their RACK's epoch, which bumps
+        # when the sub-master re-registers against the restarted root —
+        # recovery is "every agent above its rack's pre-restart epoch"
+        self._pre_restart_rack_epochs = [s.epoch for s in self._subs]
         self._reregistered = set()
         self._rereg_curve = [(0.0, 0)]
         self._recovery_s = None
@@ -583,8 +676,14 @@ class FleetSimulator:
         counts as re-registered. All alive agents re-registered ==
         recovery complete; both the curve and the total are VIRTUAL
         time, so they replay identically."""
-        if agent.client.master_epoch != self._restart_epoch \
-                or agent.node_id in self._reregistered:
+        if self._subs:
+            pre = self._pre_restart_rack_epochs[
+                self._rack_of[agent.node_id]]
+            recovered = agent.client.master_epoch > pre
+        else:
+            recovered = \
+                agent.client.master_epoch == self._restart_epoch
+        if not recovered or agent.node_id in self._reregistered:
             return
         self._reregistered.add(agent.node_id)
         dt = t - self._restart_t

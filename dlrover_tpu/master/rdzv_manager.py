@@ -305,6 +305,14 @@ class RendezvousManager:
                 return self._latest
             return None
 
+    def latest_world(self) -> CommWorld | None:
+        """The current completed world regardless of membership — the
+        rack sub-master tier reads it to cut per-rack diffs against the
+        last round each rack acked (DESIGN.md §28)."""
+        with self._lock:
+            self._try_complete()
+            return self._latest
+
     def clear_waiting(self) -> None:
         with self._lock:
             self._waiting.clear()

@@ -13,7 +13,7 @@ import time
 from collections import OrderedDict
 from typing import Any
 
-from dlrover_tpu.common import messages as m
+from dlrover_tpu.common import envspec, messages as m
 from dlrover_tpu.common.constants import EnvKey, NodeExitReason, NodeStatus
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.master.diagnosis import DiagnosisManager
@@ -152,6 +152,31 @@ class MasterServicer:
         self._bundles: list[m.DebugBundleReport] = []
         self._bundles_lock = threading.Lock()
         self.max_bundles = 200
+        # rack sub-master tier (DESIGN.md §28): per-rack monotonic
+        # epochs (persisted in the state snapshot — a restarted
+        # sub-master must register into a HIGHER epoch so its agents
+        # fence, §26) and a bounded per-rendezvous history of completed
+        # worlds, the bases the per-rack comm-world diffs are cut from
+        self._rack_lock = threading.Lock()
+        self._submaster_epochs: dict[str, int] = {}
+        self._world_history: dict[
+            str, "OrderedDict[int, dict[int, int]]"
+        ] = {}
+        self.max_world_history = 8
+        # bounded-RPC rule (§28): no rack world response carries more
+        # than this many members — larger payloads stream by cursor
+        self.world_chunk = envspec.get_int(EnvKey.RACK_WORLD_CHUNK)
+        # wire-size cache for the diff-savings counters: every rack
+        # pulling round R (from base B) would otherwise re-encode the
+        # same O(world) payload just to measure it
+        self._world_wire_cache: "OrderedDict[tuple, int]" = \
+            OrderedDict()
+        # per-round work caches for the chunked pulls: the sorted
+        # member order and the diff plan are computed once per
+        # (rdzv, round[, base]) instead of O(world) per chunk request
+        self._world_order_cache: "OrderedDict[tuple, list]" = \
+            OrderedDict()
+        self._diff_cache: "OrderedDict[tuple, dict]" = OrderedDict()
         self._rpc_seconds = registry().histogram(
             "dlrover_tpu_master_rpc_seconds",
             "master RPC dispatch latency by message type",
@@ -187,6 +212,21 @@ class MasterServicer:
             "dlrover_tpu_master_snapshot_families_total",
             "metric families carried by ingested snapshot pushes "
             "(the ingest volume deltas suppress)",
+        )
+        self._submaster_registered = registry().gauge(
+            "dlrover_tpu_submaster_registered",
+            "rack sub-masters currently registered with this root "
+            "master (DESIGN.md §28)",
+        )
+        self._world_diff_bytes = registry().counter(
+            "dlrover_tpu_submaster_world_diff_bytes_total",
+            "comm-world wire bytes actually sent to rack sub-masters "
+            "(full on first contact, member diffs after)",
+        )
+        self._world_full_bytes = registry().counter(
+            "dlrover_tpu_submaster_world_full_bytes_total",
+            "comm-world wire bytes the same rack responses would have "
+            "cost as full worlds — the diff savings denominator",
         )
 
     # The single entry point handed to RpcServer: dispatch + telemetry.
@@ -444,50 +484,7 @@ class MasterServicer:
         if isinstance(msg, m.JobStatsRequest):
             return self._job_stats(msg)
         if isinstance(msg, m.MetricsSnapshotRequest):
-            ingest_start = time.monotonic()
-            key = (msg.node_id, msg.role)
-            with self._node_metrics_lock:
-                if msg.is_delta:
-                    # delta push: changed families only — fold into the
-                    # stored copy (telemetry/snapshot_delta.py); a
-                    # restarted master's empty base converges at the
-                    # pusher's next periodic full snapshot
-                    from dlrover_tpu.telemetry.snapshot_delta import (
-                        merge_snapshot,
-                    )
-
-                    self._node_metrics[key] = merge_snapshot(
-                        self._node_metrics.get(key, []), msg.samples
-                    )
-                else:
-                    self._node_metrics[key] = msg.samples
-            # miners get the PUSHED families, not the merged store: a
-            # family absent from a delta is unchanged, so its (sum,
-            # count) delta would be zero anyway — skipping it outright
-            # is both correct and the ingest saving deltas exist for
-            if self._anomaly is not None:
-                # the straggler detector mines the step-duration series
-                # out of the same push (no-op for snapshots without it)
-                self._anomaly.observe_snapshot(msg.node_id, msg.samples)
-            if self._autopilot is not None and msg.role == "trainer":
-                # same push feeds the plan-vs-measured contradiction
-                # detector (no-op while no plan is armed); a fired
-                # retune reaches trainers via _apply_autopilot_retune
-                self._autopilot.observe_snapshot(msg.node_id,
-                                                 msg.samples)
-            if self._interval_tuner is not None and msg.role == "trainer":
-                # same push carries the snapshot-cost and step-time
-                # histograms the Young-Daly optimum needs
-                self._interval_tuner.observe_metrics_snapshot(msg.samples)
-                self._maybe_retune_snapshot_interval()
-            self._snapshot_pushes.labels(
-                "delta" if msg.is_delta else "full"
-            ).inc()
-            self._snapshot_families.inc(len(msg.samples))
-            self._snapshot_ingest.observe(
-                time.monotonic() - ingest_start
-            )
-            return m.OkResponse()
+            return self._ingest_snapshot(msg)
         if isinstance(msg, m.DebugBundleReport):
             if not msg.timestamp:
                 msg.timestamp = time.time()
@@ -573,26 +570,7 @@ class MasterServicer:
         if isinstance(msg, m.JobExitRequest):
             return self._job_exit(msg)
         if isinstance(msg, m.PersistAckReport):
-            if self._rid_seen(msg.rid):
-                return m.OkResponse()
-            key = (int(msg.step), int(msg.num_shards), str(msg.group))
-            # ledger entry journals under the writer's ckpt_persist span
-            # (msg.sctx = mint-time context; survives redelivery, §27)
-            get_journal().emit(
-                "persist_ack", node=msg.node_id, step=int(msg.step),
-                group=str(msg.group), remote_parent=msg.sctx,
-            )
-            with self._persist_lock:
-                self._persist_acks.setdefault(key, {})[
-                    str(msg.node_id)
-                ] = dict(msg.shard)
-                if len(self._persist_acks) > self.max_persist_steps:
-                    for old in sorted(self._persist_acks)[
-                        : len(self._persist_acks) - self.max_persist_steps
-                    ]:
-                        del self._persist_acks[old]
-            self._state_changed()
-            return m.OkResponse()
+            return self._persist_ack(msg)
         if isinstance(msg, m.PersistStatusRequest):
             key = (int(msg.step), int(msg.num_shards), str(msg.group))
             with self._persist_lock:
@@ -608,7 +586,355 @@ class MasterServicer:
         if isinstance(msg, m.SyncFinishedRequest):
             n = self._kv_store.add(f"sync/{msg.sync_name}", 0)
             return m.KVStoreResponse(found=True, number=n)
+        if isinstance(msg, m.SubMasterRegisterRequest):
+            return self._submaster_register(msg)
+        if isinstance(msg, m.RackJoinRequest):
+            return self._rack_join(msg)
+        if isinstance(msg, m.RackWorldRequest):
+            return self._rack_world(msg)
+        if isinstance(msg, m.RackMergedReport):
+            return self._rack_merged(msg)
         raise TypeError(f"unhandled message type {type(msg).__name__}")
+
+    # ------------------------------------- rack sub-master tier (§28)
+
+    def _submaster_register(self, msg: m.SubMasterRegisterRequest
+                            ) -> m.SubMasterRegisterResponse:
+        """Mint this sub-master incarnation's epoch: monotonic per rack
+        AND above the root's own epoch, so a degrade-to-root detour and
+        the return to the rack both read as epoch increases to the
+        agents behind it."""
+        with self._rack_lock:
+            prev = self._submaster_epochs.get(msg.rack_id, 0)
+            epoch = max(prev, self.master_epoch) + 1
+            self._submaster_epochs[msg.rack_id] = epoch
+            self._submaster_registered.set(len(self._submaster_epochs))
+        if prev:
+            # a re-registration is a sub-master incarnation change
+            # (crash/restart, or a root restart forcing re-registration)
+            # — the recovery event the rack tier's trail pins on
+            get_journal().emit(
+                "submaster_failover", rack=msg.rack_id,
+                old_epoch=prev, new_epoch=epoch,
+            )
+            logger.warning(
+                "rack %s sub-master re-registered: epoch %d -> %d "
+                "(agents behind it will reconcile)",
+                msg.rack_id, prev, epoch,
+            )
+        else:
+            logger.info("rack %s sub-master registered at %s (epoch %d)",
+                        msg.rack_id, msg.addr, epoch)
+        self._state_changed()
+        return m.SubMasterRegisterResponse(
+            epoch=epoch, master_epoch=self.master_epoch,
+            trace_id=self.trace_id,
+        )
+
+    def _rack_join(self, msg: m.RackJoinRequest) -> m.RackJoinResponse:
+        mgr = self._rdzv_managers.get(msg.rdzv_name)
+        if mgr is None:
+            raise ValueError(f"no rendezvous named {msg.rdzv_name!r}")
+        rnd = 0
+        for entry in msg.joins:
+            nid = int(entry.get("node_id", 0))
+            addr = str(entry.get("addr", ""))
+            self._node_manager.ensure_node(nid, addr)
+            rnd = mgr.join(
+                nid, addr, int(entry.get("local_devices", 0)),
+                str(entry.get("topology_key", "")),
+            )
+        if msg.joins:
+            # same durability rule as individual joins: a mid-round
+            # crash must resume the round, not strand the rack (§26)
+            self._state_changed()
+        return m.RackJoinResponse(round=rnd,
+                                  master_epoch=self.master_epoch)
+
+    def _record_world(self, rdzv_name: str, rnd: int,
+                      world: dict[int, int]) -> None:
+        # caller holds _rack_lock; bounded history of completed worlds
+        hist = self._world_history.setdefault(rdzv_name, OrderedDict())
+        if rnd not in hist:
+            hist[rnd] = dict(world)
+            while len(hist) > self.max_world_history:
+                hist.popitem(last=False)
+
+    def _wire_size(self, key: tuple, build) -> int:
+        """Cached serde size of an O(world) accounting payload: every
+        rack pulling round R (from base B) would otherwise re-encode
+        the same world just to measure it."""
+        from dlrover_tpu.common import serde
+
+        with self._rack_lock:
+            cached = self._world_wire_cache.get(key)
+        if cached is not None:
+            return cached
+        size = len(serde.encode(build()))
+        with self._rack_lock:
+            self._world_wire_cache[key] = size
+            while len(self._world_wire_cache) > 64:
+                self._world_wire_cache.popitem(last=False)
+        return size
+
+    def _world_order(self, rdzv_name: str, world) -> list:
+        """Cached sorted member list for one round: cursor-chunked full
+        pulls slice this instead of re-sorting O(world) per chunk."""
+        key = (rdzv_name, world.round)
+        with self._rack_lock:
+            cached = self._world_order_cache.get(key)
+        if cached is not None:
+            return cached
+        order = sorted(world.world.items())
+        with self._rack_lock:
+            self._world_order_cache[key] = order
+            while len(self._world_order_cache) > 8:
+                self._world_order_cache.popitem(last=False)
+        return order
+
+    def _diff_plan(self, rdzv_name: str, world, acked: int,
+                   base: dict) -> dict:
+        """Cached diff of ``world`` against the acked ``base`` round.
+
+        Ranks are positional, so one mid-world removal re-ranks every
+        later member and a naive changed-pairs diff is O(world). But
+        the positional assignment keeps survivors in their relative
+        order, so when the reconstruction check passes the plan ships
+        only genuinely-new members plus the removed list (``rerank``);
+        the sub-master re-derives survivor ranks locally. The verified
+        fallback is the explicit changed-pairs diff.
+        """
+        key = (rdzv_name, world.round, acked)
+        with self._rack_lock:
+            cached = self._diff_cache.get(key)
+        if cached is not None:
+            return cached
+        added = {nid: rank for nid, rank in world.world.items()
+                 if nid not in base}
+        removed = sorted(nid for nid in base if nid not in world.world)
+        survivors = [nid for nid, _ in sorted(base.items(),
+                                              key=lambda kv: kv[1])
+                     if nid in world.world]
+        taken = set(added.values())
+        rebuilt = dict(added)
+        free = (r for r in range(len(world.world)) if r not in taken)
+        for nid, rank in zip(survivors, free):
+            rebuilt[nid] = rank
+        if rebuilt == world.world:
+            plan = {"rerank": True, "items": sorted(added.items()),
+                    "removed": removed}
+        else:
+            plan = {"rerank": False,
+                    "items": sorted(
+                        (nid, rank) for nid, rank in world.world.items()
+                        if base.get(nid) != rank
+                    ),
+                    "removed": removed}
+        with self._rack_lock:
+            self._diff_cache[key] = plan
+            while len(self._diff_cache) > 8:
+                self._diff_cache.popitem(last=False)
+        return plan
+
+    def _rack_world(self, msg: m.RackWorldRequest) -> m.RackWorldResponse:
+        mgr = self._rdzv_managers.get(msg.rdzv_name)
+        if mgr is None:
+            raise ValueError(f"no rendezvous named {msg.rdzv_name!r}")
+        world = mgr.latest_world()
+        if world is None:
+            return m.RackWorldResponse(completed=False,
+                                       master_epoch=self.master_epoch)
+        if world.round > self._seen_rounds.get(msg.rdzv_name, 0):
+            self._seen_rounds[msg.rdzv_name] = world.round
+            self._state_changed()
+        acked = int(msg.acked_round)
+        cursor = max(0, int(msg.cursor))
+        chunk = max(1, int(self.world_chunk))
+        with self._rack_lock:
+            self._record_world(msg.rdzv_name, world.round, world.world)
+            base = self._world_history.get(msg.rdzv_name, {}).get(acked)
+        resp = m.RackWorldResponse(
+            completed=True, round=world.round,
+            coordinator=world.coordinator,
+            total_devices=world.total_devices,
+            trace_id=self.trace_id, reshard=world.reshard,
+            master_epoch=self.master_epoch, sctx=world.sctx,
+        )
+        if base is not None:
+            # diff against the acked round: an unchanged ack diffs
+            # against itself to an empty change set. The member payload
+            # is chunk-bounded (§28 bounded-RPC rule); removals ride
+            # the first chunk.
+            plan = self._diff_plan(msg.rdzv_name, world, acked, base)
+            items = plan["items"]
+            resp.base_round = acked
+            resp.rerank = plan["rerank"]
+            resp.added = dict(items[cursor:cursor + chunk])
+            if cursor == 0:
+                resp.removed = plan["removed"]
+            if cursor + chunk < len(items):
+                resp.next_cursor = cursor + chunk
+        else:
+            members = self._world_order(msg.rdzv_name, world)
+            resp.world = dict(members[cursor:cursor + chunk])
+            if cursor + chunk < len(members):
+                resp.next_cursor = cursor + chunk
+        if base is not None and world.round != acked and cursor == 0:
+            # wire accounting for the sublinearity headline, once per
+            # logical membership-change transfer (bootstrap full pulls
+            # are initial state, not a membership change): what this
+            # diff ships in total vs what a full world would have cost
+            full = self._wire_size(
+                (msg.rdzv_name, world.round, 0),
+                lambda: m.RackWorldResponse(
+                    completed=True, round=world.round,
+                    world=dict(world.world),
+                    coordinator=world.coordinator,
+                    total_devices=world.total_devices,
+                    trace_id=self.trace_id, reshard=world.reshard,
+                    master_epoch=self.master_epoch, sctx=world.sctx,
+                ),
+            )
+            sent = self._wire_size(
+                (msg.rdzv_name, world.round, acked),
+                lambda: m.RackWorldResponse(
+                    completed=True, round=world.round,
+                    base_round=acked, rerank=plan["rerank"],
+                    added=dict(items), removed=plan["removed"],
+                    coordinator=world.coordinator,
+                    total_devices=world.total_devices,
+                    trace_id=self.trace_id, reshard=world.reshard,
+                    master_epoch=self.master_epoch, sctx=world.sctx,
+                ),
+            )
+            self._world_diff_bytes.inc(sent)
+            self._world_full_bytes.inc(full)
+            get_journal().emit(
+                "world_diff", rack=msg.rack_id, rdzv=msg.rdzv_name,
+                round=world.round, base=acked, rerank=plan["rerank"],
+                added=len(items), removed=len(plan["removed"]),
+                sent_bytes=sent, full_bytes=full,
+            )
+        return resp
+
+    def _rack_merged(self, msg: m.RackMergedReport
+                     ) -> m.RackMergedResponse:
+        actions: dict = {}
+        for hb in msg.heartbeats:
+            nid = int(hb.get("node_id", 0))
+            action = self._node_manager.report_heartbeat(
+                nid, int(hb.get("restart_count", 0))
+            )
+            if action:
+                actions[str(nid)] = action
+        for snap in msg.snapshots:
+            self._ingest_snapshot(m.MetricsSnapshotRequest(
+                node_id=int(snap.get("node_id", 0)),
+                role=str(snap.get("role", "agent")),
+                samples=list(snap.get("samples", ())),
+                is_delta=bool(snap.get("is_delta", False)),
+            ))
+        for ack in msg.acks:
+            self._persist_ack(m.PersistAckReport(
+                node_id=ack.get("node_id", 0),
+                step=int(ack.get("step", 0)),
+                num_shards=int(ack.get("num_shards", 1)),
+                shard=dict(ack.get("shard", {})),
+                group=str(ack.get("group", "")),
+                rid=str(ack.get("rid", "")),
+                sctx=str(ack.get("sctx", "")),
+            ))
+        return m.RackMergedResponse(actions=actions,
+                                    master_epoch=self.master_epoch)
+
+    def export_rack_state(self) -> dict:
+        """Per-rack sub-master epochs for the state snapshot: a root
+        restart must keep minting ABOVE every epoch it ever issued, or
+        a restarted sub-master could serve an epoch its agents already
+        saw (a broken fence, §26/§28)."""
+        with self._rack_lock:
+            return {"epochs": dict(self._submaster_epochs)}
+
+    def restore_rack_state(self, state: dict) -> None:
+        with self._rack_lock:
+            for rack, epoch in (state.get("epochs") or {}).items():
+                self._submaster_epochs[str(rack)] = max(
+                    self._submaster_epochs.get(str(rack), 0), int(epoch)
+                )
+            self._submaster_registered.set(len(self._submaster_epochs))
+
+    # ----------------------------------------------- report ingestion
+
+    def _ingest_snapshot(self, msg: m.MetricsSnapshotRequest
+                         ) -> m.OkResponse:
+        """One MetricsSnapshotRequest push — direct from a node, or
+        unpacked from a rack sub-master's merged report (§28)."""
+        ingest_start = time.monotonic()
+        key = (msg.node_id, msg.role)
+        with self._node_metrics_lock:
+            if msg.is_delta:
+                # delta push: changed families only — fold into the
+                # stored copy (telemetry/snapshot_delta.py); a
+                # restarted master's empty base converges at the
+                # pusher's next periodic full snapshot
+                from dlrover_tpu.telemetry.snapshot_delta import (
+                    merge_snapshot,
+                )
+
+                self._node_metrics[key] = merge_snapshot(
+                    self._node_metrics.get(key, []), msg.samples
+                )
+            else:
+                self._node_metrics[key] = msg.samples
+        # miners get the PUSHED families, not the merged store: a
+        # family absent from a delta is unchanged, so its (sum,
+        # count) delta would be zero anyway — skipping it outright
+        # is both correct and the ingest saving deltas exist for
+        if self._anomaly is not None:
+            # the straggler detector mines the step-duration series
+            # out of the same push (no-op for snapshots without it)
+            self._anomaly.observe_snapshot(msg.node_id, msg.samples)
+        if self._autopilot is not None and msg.role == "trainer":
+            # same push feeds the plan-vs-measured contradiction
+            # detector (no-op while no plan is armed); a fired
+            # retune reaches trainers via _apply_autopilot_retune
+            self._autopilot.observe_snapshot(msg.node_id,
+                                             msg.samples)
+        if self._interval_tuner is not None and msg.role == "trainer":
+            # same push carries the snapshot-cost and step-time
+            # histograms the Young-Daly optimum needs
+            self._interval_tuner.observe_metrics_snapshot(msg.samples)
+            self._maybe_retune_snapshot_interval()
+        self._snapshot_pushes.labels(
+            "delta" if msg.is_delta else "full"
+        ).inc()
+        self._snapshot_families.inc(len(msg.samples))
+        self._snapshot_ingest.observe(
+            time.monotonic() - ingest_start
+        )
+        return m.OkResponse()
+
+    def _persist_ack(self, msg: m.PersistAckReport) -> m.OkResponse:
+        if self._rid_seen(msg.rid):
+            return m.OkResponse()
+        key = (int(msg.step), int(msg.num_shards), str(msg.group))
+        # ledger entry journals under the writer's ckpt_persist span
+        # (msg.sctx = mint-time context; survives redelivery, §27)
+        get_journal().emit(
+            "persist_ack", node=msg.node_id, step=int(msg.step),
+            group=str(msg.group), remote_parent=msg.sctx,
+        )
+        with self._persist_lock:
+            self._persist_acks.setdefault(key, {})[
+                str(msg.node_id)
+            ] = dict(msg.shard)
+            if len(self._persist_acks) > self.max_persist_steps:
+                for old in sorted(self._persist_acks)[
+                    : len(self._persist_acks) - self.max_persist_steps
+                ]:
+                    del self._persist_acks[old]
+        self._state_changed()
+        return m.OkResponse()
 
     def _job_stats(self, msg: m.JobStatsRequest) -> m.JobStatsResponse:
         def sample(nid: int, s) -> m.NodeStatSample:
@@ -816,6 +1142,11 @@ class MasterServicer:
             # once per round, not per poll
             self._seen_rounds[msg.rdzv_name] = world.round
             self._state_changed()
+        with self._rack_lock:
+            # seed the rack-diff base history from direct polls too, so
+            # a sub-master's first ack after mixed-mode attach can still
+            # be served a diff (§28)
+            self._record_world(msg.rdzv_name, world.round, world.world)
         if msg.rdzv_name == "network-check":
             self._diagnosis.set_expected_nodes(set(world.world),
                                                generation=world.round)

@@ -198,6 +198,7 @@ class MasterStateManager:
             state["interval_tuner"] = servicer.export_tuner_state()
             state["compile_cache"] = \
                 servicer.compile_cache.export_state(self._spill_dir)
+            state["racks"] = servicer.export_rack_state()
         rdzv = getattr(master, "rdzv_managers", None)
         if rdzv:
             state["rendezvous"] = {
@@ -233,6 +234,12 @@ class MasterStateManager:
                     state["compile_cache"], self._spill_dir
                 )
                 restored.append(f"compile_cache:{n}")
+            if state.get("racks"):
+                # per-rack sub-master epochs: the fence guarantee (§28)
+                # is that a restarted root never re-mints an epoch a
+                # rack's agents already observed
+                servicer.restore_rack_state(state["racks"])
+                restored.append("racks")
         if version >= 2 and state.get("rendezvous"):
             for name, mgr in getattr(master, "rdzv_managers",
                                      {}).items():

@@ -1,0 +1,633 @@
+"""Rack sub-master: the aggregation tier between agents and the root.
+
+DESIGN.md §28. Past ~1k nodes the root master's dispatch loop becomes
+the job's scalability ceiling: every agent heartbeats, pushes metric
+snapshots and reports persist-acks straight at one process, and every
+membership change fans a full comm-world out to every poller. The rack
+sub-master sits between a rack's agents and the root and converts that
+per-agent stream into one merged upstream push per flush tick:
+
+- **heartbeats** collapse to the newest ``restart_count`` per node;
+  pending master actions come back in the merged response and are
+  served on each node's next heartbeat;
+- **metrics snapshots** fold per ``(node, role)`` with the same delta
+  merge the root uses (telemetry/snapshot_delta.py), so a tick carries
+  at most one snapshot per pusher no matter how often it pushed;
+- **persist-acks** batch with their ORIGINAL rids, so the root's
+  rid-dedup keeps redelivery across either tier idempotent;
+- **rendezvous** goes two-level: joins buffer per rendezvous and travel
+  upstream as one ``RackJoinRequest`` batch, and the comm-world comes
+  back as a compact member DIFF against the last round this rack acked
+  (``RackWorldRequest``), mirrored locally and served to agents from
+  memory;
+- **compile-cache** gets a rack-local byte-bounded LRU mirror: gets hit
+  the mirror first and fall through to the root on miss (populating the
+  mirror), puts write through.
+
+Everything else — failure reports, node events, KV, tasks, paral
+config, persist-status polls — forwards to the root unchanged, so the
+sub-master never needs to understand the whole message surface.
+
+Failure model (the §26 fence, one tier down): the sub-master registers
+with the root and is minted a per-rack epoch strictly above both its
+predecessor's and the root's own. That epoch is stamped on every
+agent-facing response envelope, so agents detect a sub-master restart
+exactly the way they detect a root restart — re-register, force full
+snapshots, replay unacked reports. While a sub-master is down, agents'
+``maybe_redial`` falls back from the rack port file to the root's
+(degraded direct-to-root) and returns the moment a respawned
+sub-master republishes its file. A ROOT restart is detected from the
+upstream envelope epoch; the sub-master then re-registers, which bumps
+its own rack epoch so the agents behind it reconcile too.
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.common import envspec
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.common.rpc import RpcServer
+from dlrover_tpu.master.kv_store import CompileCacheService
+from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.metrics import registry
+from dlrover_tpu.telemetry.snapshot_delta import merge_snapshot
+
+logger = get_logger(__name__)
+
+_TRANSIENT = (ConnectionError, TimeoutError, OSError)
+
+
+class _Mirror:
+    """The locally mirrored comm-world of one rendezvous."""
+
+    __slots__ = ("round", "world", "coordinator", "total_devices",
+                 "reshard", "sctx", "trace_id", "valid")
+
+    def __init__(self):
+        # ``valid`` mirrors the root's invalidation signal: a member
+        # rejoin/removal nulls the root's completed world, and agents
+        # must see not-completed (and re-join) rather than the stale
+        # membership. The round/world stay as the next pull's diff base.
+        self.valid = False
+        self.round = 0
+        self.world: dict[int, int] = {}
+        self.coordinator = ""
+        self.total_devices = 0
+        self.reshard = False
+        self.sctx = ""
+        self.trace_id = ""
+
+
+class SubMaster:
+    """One rack's aggregation point: agents dial it like a master."""
+
+    def __init__(self, rack_id: str, master_addr: str = "",
+                 upstream_transport=None, host: str = "127.0.0.1",
+                 port: int = 0, flush_interval_s: float | None = None,
+                 cache_mb: int | None = None):
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        self.rack_id = rack_id
+        # the rack fence epoch: 0 until the root mints one at
+        # registration; stamped on every agent-facing response envelope
+        self.epoch = 0
+        # the root epoch observed at registration; an upstream envelope
+        # above it means the root restarted -> re-register (bumping our
+        # own epoch so the rack's agents reconcile through us)
+        self._root_epoch = 0
+        self._root_restarted = False
+        if flush_interval_s is None:
+            flush_interval_s = float(
+                envspec.get(EnvKey.RACK_FLUSH_S) or 1.0
+            )
+        self.flush_interval_s = flush_interval_s
+        if cache_mb is None:
+            cache_mb = int(envspec.get(EnvKey.RACK_CACHE_MB) or 256)
+        self._merge_max = int(envspec.get(EnvKey.RACK_MERGE_MAX) or 2)
+        # epoch_observer: the upstream client must NOT run the agent
+        # reconcile (it would register a phantom node-0); root restarts
+        # are handled by re-registering the rack at the next flush
+        self._up = MasterClient(
+            master_addr or "127.0.0.1:0", node_id=0,
+            transport=upstream_transport,
+            epoch_observer=self._observe_root_epoch,
+        )
+        self._lock = threading.Lock()
+        # node_id -> newest restart_count since the last flush
+        self._heartbeats: dict[int, int] = {}
+        # (node_id, role) -> {"samples": [...], "is_delta": bool}
+        self._snapshots: dict[tuple[int, str], dict] = {}
+        # buffered PersistAckReport field dicts (original rid + sctx)
+        self._acks: list[dict] = []
+        # rdzv -> {node_id -> join entry dict}; newest join wins
+        self._joins: dict[str, dict[int, dict]] = {}
+        # (rdzv, node_id) -> mirror round at join time: a node joining
+        # for round N+1 must not be served the mirrored round N
+        self._join_round: dict[tuple[str, int], int] = {}
+        self._mirrors: dict[str, _Mirror] = {}
+        # rendezvous with unserved joiners: flush pulls their worlds
+        self._want_world: set[str] = set()
+        # node_id -> pending master action from the merged response,
+        # delivered on that node's next heartbeat then cleared
+        self._actions: dict[int, str] = {}
+        # rdzv -> root's waiting count, refreshed at flush for the
+        # rendezvous agents actually asked about since the last one
+        self._waiting: dict[str, int] = {}
+        self._waiting_queried: set[str] = set()
+        self._cache = CompileCacheService(max_bytes=cache_mb << 20)
+        self._server: RpcServer | None = None
+        self._host = host
+        self._req_port = port
+        self._stop = threading.Event()
+        self._flusher: threading.Thread | None = None
+        self._epoch_gauge = registry().gauge(
+            "dlrover_tpu_submaster_epoch",
+            "this rack sub-master incarnation's fence epoch, as minted "
+            "by the root at registration (DESIGN.md §28)",
+            label_names=("rack",),
+        )
+        self._merge_total = registry().counter(
+            "dlrover_tpu_submaster_merge_total",
+            "merged upstream pushes this sub-master completed "
+            "(one per flush tick with buffered traffic)",
+            label_names=("rack",),
+        )
+        self._merge_items = registry().counter(
+            "dlrover_tpu_submaster_merge_items_total",
+            "per-agent reports collapsed into merged upstream pushes, "
+            "by kind (heartbeat/snapshot/ack/join)",
+            label_names=("rack", "kind"),
+        )
+        self._cache_lookups = registry().counter(
+            "dlrover_tpu_submaster_cache_lookup_total",
+            "rack-local compile-cache lookups by outcome "
+            "(local_hit / root_hit / miss)",
+            label_names=("rack", "outcome"),
+        )
+        self._upstream_seconds = registry().histogram(
+            "dlrover_tpu_submaster_upstream_seconds",
+            "wall time of one flush tick's upstream conversation "
+            "(register + join batches + world pulls + merged push)",
+        )
+
+    # ------------------------------------------------------- lifecycle
+
+    @property
+    def port(self) -> int:
+        return self._server.port if self._server is not None else 0
+
+    @property
+    def addr(self) -> str:
+        return f"{self._host}:{self.port}"
+
+    def start(self) -> None:
+        self._server = RpcServer(
+            self.handle, host=self._host, port=self._req_port,
+            epoch_fn=lambda: self.epoch,
+        )
+        self._server.start()
+        self._flusher = threading.Thread(
+            target=self._flush_loop, name=f"rack-{self.rack_id}-flush",
+            daemon=True,
+        )
+        self._flusher.start()
+        logger.info("rack %s sub-master serving on %s",
+                    self.rack_id, self.addr)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._flusher is not None:
+            self._flusher.join(timeout=10.0)
+        if self._server is not None:
+            self._server.stop()
+        self._up.close()
+
+    def _flush_loop(self) -> None:
+        while not self._stop.wait(self.flush_interval_s):
+            try:
+                self.flush()
+            except Exception:  # noqa: BLE001 - keep the cadence
+                logger.exception("rack %s flush failed", self.rack_id)
+
+    # ----------------------------------------------------- epoch fence
+
+    def _observe_root_epoch(self, epoch: int) -> None:
+        if epoch <= 0:
+            return
+        with self._lock:
+            if self._root_epoch and epoch > self._root_epoch:
+                # root restarted: re-register at the next flush so our
+                # own epoch bumps and the rack's agents fence through us
+                self._root_restarted = True
+
+    def _ensure_registered(self) -> bool:
+        with self._lock:
+            registered = self.epoch > 0 and not self._root_restarted
+        if registered:
+            return True
+        resp = self._up.register_submaster(self.rack_id, self.addr)
+        with self._lock:
+            self.epoch = int(resp.epoch)
+            self._root_epoch = int(resp.master_epoch)
+            self._root_restarted = False
+            # a fresh root incarnation holds no mirror bases: re-pull
+            # every mirrored world from scratch
+            for mirror in self._mirrors.values():
+                mirror.round = 0
+            self._want_world.update(self._mirrors)
+        self._epoch_gauge.labels(self.rack_id).set(self.epoch)
+        logger.info("rack %s registered with root (epoch %d, root "
+                    "epoch %d)", self.rack_id, self.epoch,
+                    self._root_epoch)
+        return True
+
+    # -------------------------------------------------- agent dispatch
+
+    def handle(self, msg):
+        if isinstance(msg, m.NodeHeartbeat):
+            with self._lock:
+                self._heartbeats[msg.node_id] = msg.restart_count
+                action = self._actions.pop(msg.node_id, "")
+            return m.HeartbeatResponse(action=action,
+                                       master_epoch=self.epoch)
+        if isinstance(msg, m.MetricsSnapshotRequest):
+            self._buffer_snapshot(msg)
+            return m.OkResponse()
+        if isinstance(msg, m.PersistAckReport):
+            with self._lock:
+                self._acks.append({
+                    "node_id": msg.node_id, "step": int(msg.step),
+                    "num_shards": int(msg.num_shards),
+                    "shard": dict(msg.shard), "group": str(msg.group),
+                    "rid": str(msg.rid), "sctx": str(msg.sctx),
+                })
+            return m.OkResponse()
+        if isinstance(msg, m.JoinRendezvousRequest):
+            return self._buffer_join(msg)
+        if isinstance(msg, m.CommWorldRequest):
+            return self._serve_world(msg)
+        if isinstance(msg, m.NumNodesWaitingRequest):
+            with self._lock:
+                self._waiting_queried.add(msg.rdzv_name)
+                n = self._waiting.get(msg.rdzv_name, 0)
+            return m.NumNodesWaitingResponse(waiting_num=n)
+        if isinstance(msg, m.CompileCacheGetRequest):
+            return self._cache_get(msg)
+        if isinstance(msg, m.CompileCachePutRequest):
+            # write-through: the root stays the durable owner (it
+            # spills to the state snapshot); the mirror serves reads
+            self._cache.put(msg.key, msg.payload, msg.meta)
+            return self._up.forward(msg)
+        # everything else — failure reports, node events, KV, tasks,
+        # persist-status polls, paral config, compile-cache queries —
+        # relays to the root unchanged
+        return self._up.forward(msg)
+
+    def _buffer_snapshot(self, msg: m.MetricsSnapshotRequest) -> None:
+        key = (msg.node_id, msg.role)
+        with self._lock:
+            cur = self._snapshots.get(key)
+            if cur is None or not msg.is_delta:
+                # first push since the flush, or a full snapshot: a
+                # full REPLACES whatever deltas were pending
+                self._snapshots[key] = {
+                    "samples": list(msg.samples),
+                    "is_delta": bool(msg.is_delta),
+                }
+            else:
+                # delta onto the pending buffer: fold with the same
+                # merge the root would apply; the buffered kind is
+                # preserved (delta+delta stays a delta, full+delta
+                # stays a full)
+                cur["samples"] = merge_snapshot(
+                    cur["samples"], msg.samples
+                )
+
+    def _buffer_join(self, msg: m.JoinRendezvousRequest
+                     ) -> m.JoinRendezvousResponse:
+        with self._lock:
+            mirror = self._mirrors.get(msg.rdzv_name)
+            self._joins.setdefault(msg.rdzv_name, {})[msg.node_id] = {
+                "node_id": msg.node_id, "addr": msg.addr,
+                "local_devices": msg.local_devices,
+                "topology_key": msg.topology_key,
+            }
+            # this node's world must be NEWER than the mirror at join
+            # time — rejoining into the mirrored round would hand back
+            # the membership it just left
+            self._join_round[(msg.rdzv_name, msg.node_id)] = \
+                mirror.round if mirror else 0
+            self._want_world.add(msg.rdzv_name)
+            rnd = mirror.round if mirror else 0
+        return m.JoinRendezvousResponse(round=rnd)
+
+    def _serve_world(self, msg: m.CommWorldRequest) -> m.CommWorldResponse:
+        with self._lock:
+            mirror = self._mirrors.get(msg.rdzv_name)
+            floor = self._join_round.get((msg.rdzv_name, msg.node_id))
+            if (mirror is None or not mirror.valid
+                    or msg.node_id not in mirror.world
+                    or (floor is not None and mirror.round <= floor)):
+                self._want_world.add(msg.rdzv_name)
+                return m.CommWorldResponse(completed=False,
+                                           master_epoch=self.epoch)
+            # served: the join-time floor is spent
+            self._join_round.pop((msg.rdzv_name, msg.node_id), None)
+            return m.CommWorldResponse(
+                completed=True, round=mirror.round,
+                world=dict(mirror.world),
+                coordinator=mirror.coordinator,
+                total_devices=mirror.total_devices,
+                trace_id=mirror.trace_id, reshard=mirror.reshard,
+                master_epoch=self.epoch, sctx=mirror.sctx,
+            )
+
+    def _cache_get(self, msg: m.CompileCacheGetRequest
+                   ) -> m.CompileCacheGetResponse:
+        entry = self._cache.get(msg.key)
+        if entry is not None:
+            payload, meta = entry
+            self._cache_lookups.labels(self.rack_id, "local_hit").inc()
+            return m.CompileCacheGetResponse(found=True, payload=payload,
+                                             meta=meta)
+        resp = self._up.forward(msg)
+        if getattr(resp, "found", False):
+            # populate the mirror so the rack's NEXT node with the same
+            # topology compiles warm without touching the root
+            self._cache.put(msg.key, resp.payload, resp.meta)
+            self._cache_lookups.labels(self.rack_id, "root_hit").inc()
+        else:
+            self._cache_lookups.labels(self.rack_id, "miss").inc()
+        return resp
+
+    # ------------------------------------------------------ flush tick
+
+    def flush(self) -> bool:
+        """One upstream conversation: register if needed, push join
+        batches, pull wanted worlds as diffs, send the merged report,
+        refresh waiting counts. Transport failures leave every buffer
+        intact (re-dials, then the next tick retries); returns True
+        when the tick reached the root."""
+        start = time.monotonic()
+        try:
+            with get_journal().span("rack_merge", rack=self.rack_id):
+                self._ensure_registered()
+                self._push_joins()
+                self._pull_worlds()
+                self._push_merged()
+                self._refresh_waiting()
+        except _TRANSIENT as e:
+            logger.warning("rack %s upstream unreachable (%s); "
+                           "re-dialing", self.rack_id, e)
+            self._up.maybe_redial()
+            return False
+        finally:
+            self._upstream_seconds.observe(time.monotonic() - start)
+        return True
+
+    def _push_joins(self) -> None:
+        with self._lock:
+            batches = {name: list(entries.values())
+                       for name, entries in self._joins.items()
+                       if entries}
+            self._joins.clear()
+        for name, entries in batches.items():
+            try:
+                resp = self._up.rack_join(self.rack_id, entries,
+                                          rdzv_name=name)
+                self._observe_root_epoch(int(resp.master_epoch))
+            except _TRANSIENT:
+                with self._lock:
+                    # re-buffer, newest-wins against any fresh joins
+                    merged = self._joins.setdefault(name, {})
+                    for entry in entries:
+                        merged.setdefault(entry["node_id"], entry)
+                raise
+            self._merge_items.labels(self.rack_id, "join").inc(
+                len(entries)
+            )
+            with self._lock:
+                self._want_world.add(name)
+
+    def _pull_worlds(self) -> None:
+        with self._lock:
+            wanted = list(self._want_world)
+        for name in wanted:
+            with self._lock:
+                mirror = self._mirrors.get(name)
+                acked = mirror.round if mirror else 0
+            head = self._up.rack_world(self.rack_id, acked_round=acked,
+                                       rdzv_name=name)
+            # explicit-field epoch watch: loopback transports (fleetsim)
+            # carry no RPC envelope, so a root restart must be visible
+            # from the rack responses themselves
+            self._observe_root_epoch(int(head.master_epoch))
+            if not head.completed:
+                with self._lock:
+                    mirror = self._mirrors.get(name)
+                    if mirror is not None and mirror.valid:
+                        # the root invalidated the round (a member
+                        # rejoined or was removed): stop serving the
+                        # stale mirror so the rack's agents re-join
+                        mirror.valid = False
+                        self._want_world.add(name)
+                continue
+            # assemble the bounded transfer (§28 bounded-RPC rule):
+            # each response carries at most RACK_WORLD_CHUNK members,
+            # so a big world arrives as a cursor walk of same-round
+            # pulls; removals ride the first chunk
+            full = dict(head.world)
+            added = dict(head.added)
+            resp, intact = head, True
+            while resp.next_cursor:
+                resp = self._up.rack_world(
+                    self.rack_id, acked_round=acked, rdzv_name=name,
+                    cursor=int(resp.next_cursor),
+                )
+                self._observe_root_epoch(int(resp.master_epoch))
+                if not resp.completed or resp.round != head.round:
+                    # the round moved mid-transfer: the chunks no
+                    # longer describe one world — retry next tick
+                    intact = False
+                    break
+                full.update(resp.world)
+                added.update(resp.added)
+            if not intact:
+                continue
+            with self._lock:
+                mirror = self._mirrors.setdefault(name, _Mirror())
+                if head.base_round == 0:
+                    world = full
+                elif mirror.round == head.base_round:
+                    if head.rerank:
+                        # positional rerank (§28): survivors keep their
+                        # relative order under membership change, so
+                        # their shifted ranks are re-derived locally —
+                        # the wire carried only new members + removals
+                        gone = set(head.removed)
+                        survivors = [
+                            nid for nid, _ in sorted(
+                                mirror.world.items(),
+                                key=lambda kv: kv[1])
+                            if nid not in gone and nid not in added
+                        ]
+                        taken = set(added.values())
+                        world = dict(added)
+                        free = (r for r in
+                                range(len(survivors) + len(added))
+                                if r not in taken)
+                        for nid, rank in zip(survivors, free):
+                            world[nid] = rank
+                    else:
+                        world = dict(mirror.world)
+                        world.update(added)
+                        for nid in head.removed:
+                            world.pop(nid, None)
+                else:
+                    # the diff's base is not what we hold (lost mirror,
+                    # re-registration race): drop to a full re-pull at
+                    # the next tick rather than apply a wrong diff
+                    mirror.round = 0
+                    continue
+                mirror.valid = True
+                mirror.round = head.round
+                mirror.world = world
+                mirror.coordinator = head.coordinator
+                mirror.total_devices = head.total_devices
+                mirror.reshard = head.reshard
+                mirror.sctx = head.sctx
+                mirror.trace_id = head.trace_id
+                # keep pulling only while a joiner still awaits a round
+                # newer than the mirror
+                if not any(
+                    rn >= mirror.round
+                    for (rname, _nid), rn in self._join_round.items()
+                    if rname == name
+                ):
+                    self._want_world.discard(name)
+
+    def _push_merged(self) -> None:
+        with self._lock:
+            heartbeats = [
+                {"node_id": nid, "restart_count": rc}
+                for nid, rc in self._heartbeats.items()
+            ]
+            snapshots = [
+                {"node_id": nid, "role": role,
+                 "samples": buf["samples"], "is_delta": buf["is_delta"]}
+                for (nid, role), buf in self._snapshots.items()
+            ]
+            acks = list(self._acks)
+            self._heartbeats.clear()
+            self._snapshots.clear()
+            self._acks.clear()
+        if not (heartbeats or snapshots or acks):
+            return
+        # bounded drain (§28 bounded-RPC rule): at most RACK_MERGE_MAX
+        # snapshots ride any one push so the root's per-RPC handler
+        # time stays flat when a rack's agents burst in lockstep;
+        # heartbeats and acks are small and ship with the first push
+        limit = max(1, self._merge_max)
+        while heartbeats or snapshots or acks:
+            batch = snapshots[:limit]
+            try:
+                resp = self._up.report_rack_merged(
+                    self.rack_id, heartbeats, batch, acks
+                )
+            except _TRANSIENT:
+                with self._lock:
+                    # re-buffer everything unsent behind anything that
+                    # arrived meanwhile: newest heartbeat wins,
+                    # snapshots re-fold, acks are rid-deduped by the
+                    # root so replay order is safe
+                    for hb in heartbeats:
+                        self._heartbeats.setdefault(hb["node_id"],
+                                                    hb["restart_count"])
+                    for snap in snapshots:
+                        key = (snap["node_id"], snap["role"])
+                        cur = self._snapshots.get(key)
+                        if cur is None:
+                            self._snapshots[key] = {
+                                "samples": snap["samples"],
+                                "is_delta": snap["is_delta"],
+                            }
+                        elif cur["is_delta"]:
+                            merged = merge_snapshot(snap["samples"],
+                                                    cur["samples"])
+                            self._snapshots[key] = {
+                                "samples": merged,
+                                "is_delta": snap["is_delta"],
+                            }
+                    self._acks[:0] = acks
+                raise
+            self._observe_root_epoch(int(resp.master_epoch))
+            with self._lock:
+                for nid, action in resp.actions.items():
+                    if action:
+                        self._actions[int(nid)] = action
+            self._merge_total.labels(self.rack_id).inc()
+            self._merge_items.labels(self.rack_id, "heartbeat").inc(
+                len(heartbeats)
+            )
+            self._merge_items.labels(self.rack_id, "snapshot").inc(
+                len(batch)
+            )
+            self._merge_items.labels(self.rack_id, "ack").inc(len(acks))
+            snapshots = snapshots[limit:]
+            heartbeats, acks = [], []
+
+    def _refresh_waiting(self) -> None:
+        with self._lock:
+            queried = list(self._waiting_queried)
+            self._waiting_queried.clear()
+        for name in queried:
+            n = self._up.num_nodes_waiting(name)
+            with self._lock:
+                self._waiting[name] = n
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser("dlrover-tpu rack sub-master")
+    parser.add_argument("--rack-id", required=True)
+    parser.add_argument("--master-addr", required=True,
+                        help="the ROOT master's host:port")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument(
+        "--port-file", default="",
+        help="publish the bound port here once serving — the file the "
+             "rack's agents re-resolve on re-dial (DLROVER_TPU_RACK_"
+             "PORT_FILE)",
+    )
+    parser.add_argument("--flush-interval", type=float, default=None)
+    args = parser.parse_args(argv)
+    sub = SubMaster(
+        args.rack_id, master_addr=args.master_addr, host=args.host,
+        port=args.port, flush_interval_s=args.flush_interval,
+    )
+    sub.start()
+    # register before publishing the port: an agent that reads the file
+    # must get epoch-stamped responses, not epoch-0 ones that dodge the
+    # fence
+    sub.flush()
+    if args.port_file:
+        from dlrover_tpu.common.storage import atomic_write_file
+
+        atomic_write_file(str(sub.port), args.port_file)
+    try:
+        while True:
+            time.sleep(3600.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        sub.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -96,6 +96,10 @@ DOCUMENTED_PREFIXES = (
     # request's / incident's time go" runbook keys on the span-write
     # and head-sampling-drop counters
     "dlrover_tpu_trace_",
+    # rack sub-master tier (DESIGN.md §28): the "scaling past 1k
+    # nodes" runbook keys on the merge/epoch/cache-lookup families
+    # and the comm-world diff byte counters
+    "dlrover_tpu_submaster_",
 )
 
 # label names that are themselves an operator contract (dashboards and

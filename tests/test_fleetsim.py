@@ -325,3 +325,63 @@ def test_fleetsim_events_journaled(tmp_path, monkeypatch):
     kinds = {e.get("kind") for e in events
              if e.get("name") == "fleetsim_event"}
     assert {"start", "round", "fail", "end"} <= kinds
+
+
+# ------------------------------------------------------ rack tier (§28)
+
+
+def test_rack_tier_determinism_and_round_parity():
+    """Racked runs replay identically (trails, recovery curve), and
+    the rack tier preserves rendezvous semantics: the same profile
+    run flat and racked completes the same rounds with the same
+    membership shapes (initial, fast re-admit, reshard)."""
+    base = dict(nodes=120, duration_s=40.0, snapshot_interval_s=15.0,
+                heartbeat_interval_s=15.0, straggler_frac=0.0,
+                failures=1, deaths=1, ckpt_interval_s=20.0,
+                master_restarts=1)
+    r1 = FleetSimulator(small_profile(racks=4, **base)).run()
+    r2 = FleetSimulator(small_profile(racks=4, **base)).run()
+    assert r1.trail == r2.trail
+    assert r1.reregistered_curve == r2.reregistered_curve
+    assert ["racks", 4, 0] in r1.trail["events"]
+    # root crash-restart recovered through the rack tier: every alive
+    # agent observed its rack's bumped epoch and reconciled
+    assert r1.master_recovery_s is not None
+    flat = FleetSimulator(small_profile(racks=0, **base)).run()
+    assert r1.rounds == flat.rounds
+    assert any(r["reshard"] for r in r1.rounds)
+    # ckpt storm committed fully in both topologies (the rack tier
+    # drains buffered acks before the ledger poll)
+    storms_racked = sorted(e for e in r1.trail["events"]
+                           if e[0] == "ckpt_storm")
+    storms_flat = sorted(e for e in flat.trail["events"]
+                         if e[0] == "ckpt_storm")
+    assert storms_racked == storms_flat and storms_racked
+
+
+def test_rack_tier_reduces_root_rpc_load():
+    """The tier's reason to exist: the root handles per-RACK merged
+    pushes and world pulls instead of per-AGENT heartbeats, polls and
+    snapshots — total root-bound calls drop by a large factor, and
+    membership deltas ship as diffs cheaper than full worlds."""
+    base = dict(nodes=120, duration_s=40.0, snapshot_interval_s=15.0,
+                heartbeat_interval_s=15.0, straggler_frac=0.0,
+                failures=0, deaths=1, ckpt_interval_s=0.0)
+    racked = FleetSimulator(small_profile(racks=4, **base)).run()
+    flat = FleetSimulator(small_profile(racks=0, **base)).run()
+    calls_racked = sum(r["calls"] for r in racked.rpc.values())
+    calls_flat = sum(r["calls"] for r in flat.rpc.values())
+    assert calls_flat / calls_racked > 3.0, (
+        f"root calls only dropped {calls_flat}/{calls_racked}"
+    )
+    # per-agent chatter never reaches the root in rack mode
+    for rpc in ("NodeHeartbeat", "JoinRendezvousRequest",
+                "CommWorldRequest", "MetricsSnapshotRequest"):
+        assert rpc not in racked.rpc, f"{rpc} leaked past the racks"
+    for rpc in ("RackJoinRequest", "RackWorldRequest",
+                "RackMergedReport", "SubMasterRegisterRequest"):
+        assert rpc in racked.rpc, f"{rpc} missing at the root"
+    # the reshard after the death shipped as a diff: bytes actually
+    # sent stay below what full worlds would have cost
+    assert racked.world_full_bytes > 0
+    assert 0 < racked.world_diff_bytes < racked.world_full_bytes

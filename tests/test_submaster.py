@@ -1,0 +1,338 @@
+"""Rack sub-master tier (DESIGN.md §28): two-level rendezvous, per-rack
+comm-world diffs, merged upstream pushes, compile-cache mirroring and
+the one-tier-down epoch fence.
+
+Every upstream hop goes through a serde round-trip, so the bit-equality
+claims below cover the wire format (int keys survive JSON), not just
+in-memory dict identity.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common import serde
+from dlrover_tpu.master.submaster import SubMaster
+
+
+class _Loop:
+    """In-process transport with a full serde round-trip each way."""
+
+    def __init__(self, handler):
+        self._handler = handler
+
+    def call(self, msg):
+        resp = self._handler(serde.decode(serde.encode(msg)))
+        return serde.decode(serde.encode(resp))
+
+    def close(self):
+        pass
+
+
+def _master(tmp_path, **kw):
+    from dlrover_tpu.master.job_master import JobMaster
+
+    kw.setdefault("job_name", "rack")
+    kw.setdefault("state_dir", str(tmp_path / "state"))
+    master = JobMaster(**kw)
+    master.prepare()
+    return master
+
+
+def _crash(master) -> None:
+    master._server.stop()
+    master.node_manager.stop()
+    if master.state_manager is not None:
+        master.state_manager._stopped.set()
+
+
+def _sub(master, rack_id: str) -> SubMaster:
+    return SubMaster(rack_id,
+                     upstream_transport=_Loop(master.servicer.handle),
+                     flush_interval_s=3600.0)
+
+
+def _join(sub: SubMaster, nid: int, devices: int = 4):
+    return sub.handle(m.JoinRendezvousRequest(
+        node_id=nid, addr=f"n{nid}:1", local_devices=devices))
+
+
+def _world(sub: SubMaster, nid: int) -> m.CommWorldResponse:
+    return sub.handle(m.CommWorldRequest(node_id=nid))
+
+
+def test_two_level_rendezvous_rack_quorum_then_root(tmp_path):
+    """Joins buffer rack-locally, travel upstream as one batch per
+    rack, and the completed world served from each rack mirror is
+    bit-equal to the root's own."""
+    root = _master(tmp_path, min_nodes=4, max_nodes=4)
+    sub_a, sub_b = _sub(root, "rack-a"), _sub(root, "rack-b")
+    try:
+        for nid in (0, 1):
+            _join(sub_a, nid)
+        for nid in (2, 3):
+            _join(sub_b, nid)
+        # nothing reached the root yet: the batch is the flush tick's
+        assert root.rdzv_managers["training"].num_nodes_waiting() == 0
+        assert not _world(sub_a, 0).completed
+        assert sub_a.flush() and sub_b.flush() and sub_a.flush()
+        direct = root.servicer.handle(m.CommWorldRequest(node_id=0))
+        assert direct.completed and sorted(direct.world) == [0, 1, 2, 3]
+        for sub, nid in ((sub_a, 0), (sub_a, 1), (sub_b, 2), (sub_b, 3)):
+            got = _world(sub, nid)
+            assert got.completed and got.round == direct.round
+            assert got.world == direct.world  # bit-equal membership
+            assert all(isinstance(k, int) for k in got.world)
+            assert got.coordinator == direct.coordinator
+            assert got.total_devices == direct.total_devices
+    finally:
+        root.stop()
+
+
+def test_world_diff_apply_equals_full(tmp_path):
+    """Round N+1 reaches a rack that acked round N as a member DIFF
+    (changed + removed only), and applying it reproduces the root's
+    full world exactly."""
+    root = _master(tmp_path, min_nodes=2, max_nodes=3)
+    sub = _sub(root, "rack-a")
+    try:
+        for nid in (0, 1, 2):
+            _join(sub, nid)
+        assert sub.flush()
+        first = _world(sub, 0)
+        assert first.completed and sorted(first.world) == [0, 1, 2]
+        # node 2 dies; survivors re-rendezvous through the rack
+        sub.handle(m.NodeEventReport(node_id=2, status="failed"))
+        for nid in (0, 1):
+            _join(sub, nid)
+        assert sub.flush()
+        # the wire response against the acked base is a genuine diff
+        wire = sub._up.rack_world("rack-a", acked_round=first.round)
+        assert wire.completed and wire.base_round == first.round
+        assert wire.world == {}  # diff responses carry no full world
+        rebuilt = dict(first.world)
+        rebuilt.update(wire.added)
+        for nid in wire.removed:
+            rebuilt.pop(nid, None)
+        direct = root.servicer.handle(m.CommWorldRequest(node_id=0))
+        assert direct.completed and direct.round == wire.round
+        assert rebuilt == direct.world
+        assert 2 in wire.removed
+        # and the mirror the agents see applied the same diff
+        got = _world(sub, 0)
+        assert got.completed and got.world == direct.world
+    finally:
+        root.stop()
+
+
+def test_shrink_rejoin_and_fast_readmit_through_submaster(tmp_path):
+    """A node that leaves and rejoins through its sub-master gets the
+    fast re-admit path: the new round completes immediately (no timeout
+    wait) with identical membership."""
+    root = _master(tmp_path, min_nodes=2, max_nodes=2,
+                   rdzv_timeout=3600.0)
+    sub = _sub(root, "rack-a")
+    try:
+        for nid in (0, 1):
+            _join(sub, nid)
+        assert sub.flush()
+        first = _world(sub, 0)
+        assert first.completed and first.round == 1
+        # node 1 respawns: its rejoin must not be served the stale
+        # mirrored round even though the mirror still lists it
+        _join(sub, 1)
+        stale = _world(sub, 1)
+        assert not stale.completed
+        # the flush pushes the rejoin and learns the root invalidated
+        # the round: the mirror stops being served, so node 0 re-joins
+        # instead of running on stale membership
+        assert sub.flush()
+        assert not _world(sub, 0).completed
+        _join(sub, 0)
+        assert sub.flush()
+        # both members re-admitted fast: round 2 completed immediately
+        # (no waiting_timeout backoff) with identical membership
+        for nid in (0, 1):
+            again = _world(sub, nid)
+            assert again.completed and again.round == 2
+            assert again.world == first.world
+    finally:
+        root.stop()
+
+
+def test_merged_push_collapses_and_preserves_semantics(tmp_path):
+    """One flush carries newest-wins heartbeats, delta-folded
+    snapshots and rid-preserving acks — and the root's ledger/metrics
+    land exactly as if each agent had reported directly."""
+    root = _master(tmp_path)
+    sub = _sub(root, "rack-a")
+    # the metrics registry is process-global: count pushes relative to
+    # whatever earlier tests in this process already recorded
+    pushes_base = root.servicer._snapshot_pushes.labels("full").value
+    try:
+        for rc in (0, 1, 2):
+            sub.handle(m.NodeHeartbeat(node_id=7, restart_count=rc))
+        sub.handle(m.MetricsSnapshotRequest(
+            node_id=7, role="trainer",
+            samples=[{"name": "dlrover_tpu_trainer_step_total",
+                      "type": "counter",
+                      "samples": [{"labels": {}, "value": 3.0}]}],
+        ))
+        # delta push: the counter advanced to a new CUMULATIVE value;
+        # folding replaces the family (unchanged-family suppression,
+        # not value diffing)
+        sub.handle(m.MetricsSnapshotRequest(
+            node_id=7, role="trainer", is_delta=True,
+            samples=[{"name": "dlrover_tpu_trainer_step_total",
+                      "type": "counter",
+                      "samples": [{"labels": {}, "value": 5.0}]}],
+        ))
+        sub.handle(m.PersistAckReport(
+            node_id=7, step=4, num_shards=1, shard={"crc32": 9},
+            rid="rack-rid-1"))
+        assert sub.flush()
+        # heartbeat collapsed to the newest restart_count
+        node = root.node_manager.ensure_node(7)
+        assert node.process_restarts == 2
+        # snapshot delta folded before the push: the stored full shows
+        # the summed counter
+        snaps = root.servicer.node_metrics_snapshots()
+        fam = snaps[(7, "trainer")][0]
+        assert fam["samples"][0]["value"] == 5.0
+        # ONE merged push carried all of it (not three heartbeats +
+        # two snapshots + one ack)
+        assert root.servicer._snapshot_pushes.labels("full").value \
+            == pushes_base + 1
+        # ack landed with its ORIGINAL rid: redelivery dedups
+        status = root.servicer.handle(
+            m.PersistStatusRequest(step=4, num_shards=1))
+        assert status.complete
+        sub.handle(m.PersistAckReport(
+            node_id=7, step=4, num_shards=1, shard={"crc32": 9},
+            rid="rack-rid-1"))
+        assert sub.flush()  # replay: deduped upstream, no error
+        # a pending master action comes back on the next heartbeat
+        root.node_manager.send_action(7, "restart")
+        sub.handle(m.NodeHeartbeat(node_id=7, restart_count=2))
+        assert sub.flush()
+        hb = sub.handle(m.NodeHeartbeat(node_id=7, restart_count=2))
+        assert hb.action == "restart"
+    finally:
+        root.stop()
+
+
+def test_epoch_fencing_on_submaster_restart(tmp_path):
+    """A replacement sub-master registers into a strictly higher epoch,
+    and an agent heartbeating through it runs the §26 reconcile."""
+    from dlrover_tpu.agent.master_client import MasterClient
+
+    root = _master(tmp_path)
+    sub1 = _sub(root, "rack-a")
+    try:
+        assert sub1.flush()
+        e1 = sub1.epoch
+        assert e1 > root.master_epoch
+        agent = MasterClient("", node_id=5,
+                             transport=_Loop(sub1.handle))
+        agent.report_heartbeat()
+        assert agent.master_epoch == e1
+        # sub-master dies; its replacement re-registers the same rack
+        sub2 = _sub(root, "rack-a")
+        assert sub2.flush()
+        assert sub2.epoch > e1
+        # the agent re-dials (here: re-pointed transport) and fences
+        agent._client = _Loop(sub2.handle)
+        agent.report_heartbeat()
+        assert agent.master_epoch == sub2.epoch
+        # the reconcile re-registered the node with the root (relayed
+        # through the sub-master's forward path)
+        assert 5 in root.node_manager._nodes
+    finally:
+        root.stop()
+
+
+def test_submaster_epochs_survive_root_restart(tmp_path):
+    """The root persists per-rack epochs: after a root crash+restore a
+    re-registering sub-master still gets a HIGHER epoch, and the
+    sub-master notices the root restart from the rack responses and
+    re-registers on its own."""
+    m1 = _master(tmp_path)
+    sub = _sub(m1, "rack-a")
+    assert sub.flush()
+    e1 = sub.epoch
+    m1.state_manager.snapshot()
+    _crash(m1)
+    m2 = _master(tmp_path)
+    try:
+        assert m2.master_epoch == m1.master_epoch + 1
+        # the restored epoch table keeps the fence monotonic per rack
+        reg = m2.servicer.handle(
+            m.SubMasterRegisterRequest(rack_id="rack-a"))
+        assert reg.epoch > e1
+        # a sub-master still holding the old epoch re-points at the new
+        # root, observes the bumped root epoch mid-flush, and its NEXT
+        # flush re-registers (bumping its own rack epoch)
+        sub._up._client = _Loop(m2.servicer.handle)
+        sub.handle(m.NodeHeartbeat(node_id=1, restart_count=0))
+        assert sub.flush()
+        assert sub._root_restarted
+        assert sub.flush()
+        assert sub.epoch > reg.epoch
+    finally:
+        m2.stop()
+
+
+def test_compile_cache_rack_mirror(tmp_path):
+    """Gets hit the rack-local LRU first; misses fall through to the
+    root and populate the mirror; puts write through to the root."""
+    root = _master(tmp_path)
+    sub = _sub(root, "rack-a")
+    try:
+        blob = b"\x00aot\xff" * 16
+        # write-through: the root owns the durable copy
+        sub.handle(m.CompileCachePutRequest(
+            node_id=0, key="n2t8/cafe", payload=blob, meta={"j": "x"}))
+        assert root.servicer.compile_cache.get("n2t8/cafe") is not None
+        # a different rack's sub-master misses locally, falls through,
+        # and mirrors the artifact
+        other = _sub(root, "rack-b")
+        got = other.handle(m.CompileCacheGetRequest(key="n2t8/cafe"))
+        assert got.found and got.payload == blob
+        assert other._cache.get("n2t8/cafe") is not None
+        # second get is served rack-locally even with the root gone
+        other._up._client = _Loop(_refuse)
+        again = other.handle(m.CompileCacheGetRequest(key="n2t8/cafe"))
+        assert again.found and again.payload == blob
+    finally:
+        root.stop()
+
+
+def _refuse(msg):
+    raise ConnectionError("root down")
+
+
+def test_buffers_survive_unreachable_root(tmp_path):
+    """A flush that cannot reach the root keeps every buffer intact;
+    the next successful tick delivers everything once."""
+    root = _master(tmp_path)
+    sub = _sub(root, "rack-a")
+    try:
+        assert sub.flush()  # register while reachable
+        good = sub._up._client
+        sub._up._client = _Loop(_refuse)
+        sub.handle(m.NodeHeartbeat(node_id=3, restart_count=1))
+        sub.handle(m.PersistAckReport(
+            node_id=3, step=1, num_shards=1, shard={}, rid="r-keep"))
+        _join(sub, 3)
+        assert not sub.flush()
+        sub._up._client = good
+        assert sub.flush()
+        assert root.node_manager.ensure_node(3).process_restarts == 1
+        assert root.servicer.handle(
+            m.PersistStatusRequest(step=1, num_shards=1)).complete
+        # the buffered join went upstream and completed a round
+        world = root.rdzv_managers["training"].latest_world()
+        assert world is not None and sorted(world.world) == [3]
+    finally:
+        root.stop()
