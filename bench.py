@@ -2005,6 +2005,10 @@ def bench_gateway(extra: dict) -> None:
     trace_dir = tempfile.mkdtemp(prefix="bench_gw_trace_")
     prev_jdir = os.environ.get("DLROVER_TPU_JOURNAL_DIR")
     os.environ["DLROVER_TPU_JOURNAL_DIR"] = trace_dir
+    # dense kv_pool sampling (§29): the leg is short, so the default
+    # cadence would yield too few observatory points to summarize
+    prev_cadence = os.environ.get("DLROVER_TPU_OBSERVATORY_SAMPLE_EVERY")
+    os.environ["DLROVER_TPU_OBSERVATORY_SAMPLE_EVERY"] = "8"
     try:
         disagg = run_leg(disagg=True)
     finally:
@@ -2012,6 +2016,11 @@ def bench_gateway(extra: dict) -> None:
             os.environ.pop("DLROVER_TPU_JOURNAL_DIR", None)
         else:
             os.environ["DLROVER_TPU_JOURNAL_DIR"] = prev_jdir
+        if prev_cadence is None:
+            os.environ.pop("DLROVER_TPU_OBSERVATORY_SAMPLE_EVERY", None)
+        else:
+            os.environ["DLROVER_TPU_OBSERVATORY_SAMPLE_EVERY"] = \
+                prev_cadence
 
     # decode-stall p99 from the disagg leg's PRE-KILL histogram delta,
     # expressed in single-chunk units: the tentpole's bounded-stall
@@ -2085,6 +2094,41 @@ def bench_gateway(extra: dict) -> None:
                 phase_sum / max(slowest.dur, 1e-9), 4)
     except Exception as e:  # noqa: BLE001 - trace evidence is a rider
         extra["gateway_trace_error"] = repr(e)
+
+    # serving-observatory headlines (§29) from the disagg leg's
+    # journaled kv_pool samples: page-pool pressure, COW share
+    # headroom and the speculative-decoding acceptance prior —
+    # ROADMAP-3's before/after baseline
+    try:
+        from dlrover_tpu.telemetry.report import load_events
+        kv = [e for e in load_events(trace_dir)
+              if e.get("name") == "kv_pool"]
+        if kv:
+            occ = sorted(float(e.get("occupancy", 0.0) or 0.0)
+                         for e in kv)
+            last = kv[-1]
+            extra["gateway_kv_samples"] = len(kv)
+            extra["gateway_kv_occupancy_p95"] = round(
+                occ[min(len(occ) - 1, int(0.95 * len(occ)))], 4)
+            extra["gateway_kv_high_water"] = int(max(
+                int(e.get("high_water", 0) or 0) for e in kv))
+            extra["gateway_pages_shareable_frac"] = round(max(
+                float(e.get("shareable_frac", 0.0) or 0.0)
+                for e in kv), 4)
+            extra["gateway_cow_multiplier"] = round(max(
+                float(e.get("cow_multiplier", 0.0) or 0.0)
+                for e in kv), 4)
+            # cumulative counters: the final sample is the aggregate
+            extra["gateway_draft_accept_rate"] = round(
+                float(last.get("accept_rate", 0.0) or 0.0), 4)
+            extra["gateway_draft_tokens_scored"] = int(
+                last.get("scored", 0) or 0)
+            extra["gateway_accept_run_p50"] = int(
+                last.get("accept_run_p50", 0) or 0)
+            extra["gateway_accept_run_p95"] = int(
+                last.get("accept_run_p95", 0) or 0)
+    except Exception as e:  # noqa: BLE001 - observatory is a rider
+        extra["gateway_kv_error"] = repr(e)
     finally:
         shutil.rmtree(trace_dir, ignore_errors=True)
 
@@ -2703,7 +2747,133 @@ HEADLINE_KEYS = [
     "cp_snapshot_wire_reduction", "cp_snapshot_ingest_reduction",
     "cp_master_recovery_s_n1000", "cp_reregistered_nodes_n1000",
     "lc_best_speedup", "bench_total_s",
+    "gateway_kv_occupancy_p95", "gateway_kv_high_water",
+    "gateway_pages_shareable_frac", "gateway_cow_multiplier",
+    "gateway_draft_accept_rate", "gateway_draft_tokens_scored",
+    "gateway_accept_run_p50", "gateway_accept_run_p95",
 ]
+
+
+# ------------------------------------------------- trajectory compare
+#
+# `bench.py --compare OLD.json NEW.json` reads two committed
+# BENCH_r0*.json wrappers (or raw bench stdout captures) and diffs
+# their headline dicts. Keys are gated by CATEGORY, not blanket
+# percentage: raw latencies and throughputs swing wildly across rounds
+# whose stage configs legitimately changed (r06 ran 2 control-plane
+# tiers in a 500s budget, r07 ran 3 in 1200s), so only genuine quality
+# signals fail the run —
+#   - failure counts (substring "fail"/"error"): any >10% increase,
+#     or any increase from zero;
+#   - booleans that flip true -> false;
+#   - dimensionless quality ratios (goodput/mfu/*_speedup/
+#     *_agreement/*_rate/*_completed): a >10% DROP.
+# Everything else prints as an informational delta.
+
+def _load_headline(path: str) -> dict:
+    """Headline dict from a bench output file: the wrapper's embedded
+    tail (committed BENCH_r0*.json shape) or raw stdout — in either
+    case the LAST parseable line carrying a "headline" object wins
+    (bench emits cumulative lines per stage; the last is the sweep)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        wrapper = json.loads(text)
+    except json.JSONDecodeError:
+        wrapper = None
+    if isinstance(wrapper, dict):
+        if isinstance(wrapper.get("headline"), dict):
+            return wrapper["headline"]
+        if isinstance(wrapper.get("tail"), str):
+            text = wrapper["tail"]
+    head = None
+    for line in text.splitlines():
+        line = line.strip()
+        if '"headline"' not in line:
+            continue
+        try:
+            doc = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # the tail byte-window may crop older lines
+        if isinstance(doc, dict) and isinstance(doc.get("headline"),
+                                                dict):
+            head = doc["headline"]
+    if head is None:
+        raise ValueError(f"no headline line found in {path}")
+    return head
+
+
+_QUALITY_SUFFIXES = ("_speedup", "_agreement", "_rate", "_completed",
+                     "_frac_ok")
+
+
+def _compare_category(key: str) -> str:
+    low = key.lower()
+    if "fail" in low or "error" in low:
+        return "failure"
+    if ("goodput" in low or "mfu" in low
+            or low.endswith(_QUALITY_SUFFIXES)):
+        return "quality"
+    return "info"
+
+
+def compare_headlines(old: dict, new: dict,
+                      threshold: float = 0.10) -> tuple[list[str],
+                                                        list[str]]:
+    """Diff two headline dicts; returns (report lines, regressions)."""
+    lines: list[str] = []
+    regressions: list[str] = []
+    for key in sorted(set(old) | set(new)):
+        a, b = old.get(key), new.get(key)
+        if a is None or b is None:
+            lines.append(f"  {key:<36} "
+                         f"{'(new)' if a is None else '(gone)'}  "
+                         f"{b if a is None else a}")
+            continue
+        if isinstance(a, bool) or isinstance(b, bool):
+            mark = ""
+            if bool(a) and not bool(b):
+                mark = "  << REGRESSION (true -> false)"
+                regressions.append(key)
+            lines.append(f"  {key:<36} {a} -> {b}{mark}")
+            continue
+        if not (isinstance(a, (int, float))
+                and isinstance(b, (int, float))):
+            if a != b:
+                lines.append(f"  {key:<36} {a} -> {b}")
+            continue
+        delta = (b - a) / abs(a) if a else None
+        pct = f"{100 * delta:+.1f}%" if delta is not None else "n/a"
+        cat = _compare_category(key)
+        mark = ""
+        if cat == "failure" and (b > a * (1 + threshold)
+                                 if a else b > a):
+            mark = f"  << REGRESSION (failures up {pct})"
+            regressions.append(key)
+        elif cat == "quality" and a > 0 and b < a * (1 - threshold):
+            mark = f"  << REGRESSION ({pct} on a quality metric)"
+            regressions.append(key)
+        lines.append(f"  {key:<36} {a} -> {b}  ({pct}){mark}")
+    return lines, regressions
+
+
+def compare_main(old_path: str, new_path: str) -> int:
+    try:
+        old = _load_headline(old_path)
+        new = _load_headline(new_path)
+    except (OSError, ValueError) as e:
+        print(f"compare: {e}", file=sys.stderr)
+        return 2
+    lines, regressions = compare_headlines(old, new)
+    print(f"headline diff: {old_path} -> {new_path}")
+    print("\n".join(lines))
+    if regressions:
+        print(f"REGRESSIONS ({len(regressions)}): "
+              f"{', '.join(regressions)}")
+        return 1
+    print("no gated regressions "
+          "(failure counts, booleans, quality ratios all held)")
+    return 0
 
 
 def _result_line(extra: dict) -> str:
@@ -2734,12 +2904,24 @@ def _headline_line(extra: dict, errors: list[str]) -> str:
 
 
 def main(argv: list[str] | None = None) -> int:
+    argv = list(argv or [])
+    # trajectory compare mode: must intercept BEFORE stage selection
+    # (the filter below drops "-"-prefixed args, which would turn the
+    # two file operands into unknown stage names)
+    if "--compare" in argv:
+        i = argv.index("--compare")
+        paths = argv[i + 1: i + 3]
+        if len(paths) != 2 or any(p.startswith("-") for p in paths):
+            print("usage: bench.py --compare OLD.json NEW.json",
+                  file=sys.stderr)
+            return 2
+        return compare_main(paths[0], paths[1])
     extra: dict = {}
     errors: list[str] = []
     # optional stage-name filter: `python bench.py control_plane chaos`
     # runs only the named stages. Explicit argv only — callers invoking
     # main() in-process (the harness tests) always get the full sweep.
-    selected = [a for a in (argv or []) if not a.startswith("-")]
+    selected = [a for a in argv if not a.startswith("-")]
     unknown = [s for s in selected
                if s not in {st.name for st in STAGES}]
     if unknown:

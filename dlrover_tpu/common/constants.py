@@ -249,6 +249,12 @@ class EnvKey:
     RACK_FLUSH_S = "DLROVER_TPU_RACK_FLUSH_S"
     RACK_WORLD_CHUNK = "DLROVER_TPU_RACK_WORLD_CHUNK"
     RACK_MERGE_MAX = "DLROVER_TPU_RACK_MERGE_MAX"
+    # serving memory observatory (DESIGN.md §29): the measure-only
+    # off-switch, the kv_pool sample cadence (decode steps), and the
+    # n-gram order of the draft-acceptance shadow predictor
+    SERVING_OBSERVATORY = "DLROVER_TPU_SERVING_OBSERVATORY"
+    OBSERVATORY_SAMPLE_EVERY = "DLROVER_TPU_OBSERVATORY_SAMPLE_EVERY"
+    SHADOW_ORDER = "DLROVER_TPU_SHADOW_ORDER"
 
 
 class Defaults:

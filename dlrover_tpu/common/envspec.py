@@ -279,6 +279,18 @@ SPECS: tuple[EnvVar, ...] = (
            "max metrics snapshots per merged upstream push; a burst "
            "drains as several bounded pushes in one flush tick so the "
            "root's per-RPC handler time stays flat", "§28"),
+    # ------------------------------------------- serving memory observatory
+    EnvVar("DLROVER_TPU_SERVING_OBSERVATORY", "1",
+           "measure-only serving observatory (KV page pressure, "
+           "prefix-share headroom, draft-acceptance shadowing); 0 "
+           "disables all three instruments on engines built after the "
+           "flip", "§29"),
+    EnvVar("DLROVER_TPU_OBSERVATORY_SAMPLE_EVERY", "32",
+           "decode steps between kv_pool journal samples / gauge "
+           "refreshes", "§29"),
+    EnvVar("DLROVER_TPU_SHADOW_ORDER", "3",
+           "n-gram order of the draft-acceptance shadow predictor "
+           "(longest-match back-off to 1)", "§29"),
 )
 
 SPEC_BY_NAME: dict[str, EnvVar] = {spec.name: spec for spec in SPECS}

@@ -64,6 +64,42 @@ _drained_total = registry().counter(
     "replicas drained, by cause and pool",
     label_names=("cause", "pool"),
 )
+# serving-observatory aggregates (DESIGN.md §29): the health tick rolls
+# every ready replica's last kv_pool sample into one scrape surface per
+# pool, like the master metrics path — scrapers never fan out to
+# replicas
+_kv_free_gauge = registry().gauge(
+    "dlrover_tpu_gateway_kv_pages_free",
+    "KV pool pages free across READY replicas, per pool",
+    label_names=("pool",),
+)
+_kv_used_gauge = registry().gauge(
+    "dlrover_tpu_gateway_kv_pages_used",
+    "KV pool pages leased across READY replicas, per pool",
+    label_names=("pool",),
+)
+_kv_occupancy_gauge = registry().gauge(
+    "dlrover_tpu_gateway_kv_occupancy",
+    "leased fraction of the pool-wide KV page pool",
+    label_names=("pool",),
+)
+_shareable_frac_gauge = registry().gauge(
+    "dlrover_tpu_gateway_pages_shareable_frac",
+    "fraction of live full pages shareable across slots (copy-on-write "
+    "headroom), pool-wide",
+    label_names=("pool",),
+)
+_accept_rate_gauge = registry().gauge(
+    "dlrover_tpu_gateway_draft_accept_rate",
+    "shadow-predictor acceptance rate across READY replicas "
+    "(speculative-decoding headroom), per pool",
+    label_names=("pool",),
+)
+_prefix_hit_rate_gauge = registry().gauge(
+    "dlrover_tpu_gateway_prefix_cache_hit_rate",
+    "prefix-cache hit fraction across READY replicas, per pool",
+    label_names=("pool",),
+)
 
 
 class ReplicaState(str, Enum):
@@ -362,6 +398,9 @@ class ReplicaPool:
         self._replicas: dict[int, EngineReplica] = {}
         self._watchers: dict[int, PreemptionWatcher] = {}
         self._next_id = 0
+        # pool-wide §29 observatory aggregate, refreshed by the health
+        # tick; the gateway's stats()/healthz payload reads it
+        self.observatory: dict = {}
         self._stop = threading.Event()
         self._health_thread = threading.Thread(
             target=self._health_loop, name="gateway-pool-health",
@@ -534,6 +573,67 @@ class ReplicaPool:
         for state, n in counts.items():
             _replicas_gauge.labels(state.value, self.name).set(n)
         _slot_occupancy.labels(self.name).set(self.occupancy())
+        self.observatory = self._observatory_tick()
+
+    def _observatory_tick(self) -> dict:
+        """Roll every READY replica's last observatory sample (plus its
+        prefix-cache counters) into the pool-wide §29 aggregate and
+        refresh the gateway gauges. Ratios are weighted by each
+        replica's denominators, never averaged over averages."""
+        hits = queries = 0
+        free = used = total = high_water = 0
+        sh_pages = sh_total = 0
+        accepted = scored = 0
+        run_p95 = run_p50 = 0
+        sampled = 0
+        for replica in self.ready_replicas():
+            eng = replica.engine
+            hits += int(getattr(eng, "prefix_cache_hits", 0) or 0)
+            queries += int(getattr(eng, "prefix_cache_queries", 0) or 0)
+            snap_fn = getattr(eng, "observatory_snapshot", None)
+            snap = snap_fn() if snap_fn is not None else None
+            if not snap:
+                continue
+            sampled += 1
+            free += snap.get("free", 0)
+            used += snap.get("used", 0)
+            total += snap.get("total", 0)
+            high_water += snap.get("high_water", 0)
+            sh_pages += snap.get("shareable_pages", 0)
+            sh_total += snap.get("total_pages", 0)
+            accepted += snap.get("accepted", 0)
+            scored += snap.get("scored", 0)
+            run_p50 = max(run_p50, snap.get("accept_run_p50", 0))
+            run_p95 = max(run_p95, snap.get("accept_run_p95", 0))
+        agg = {
+            "replicas_sampled": sampled,
+            "kv_pages_free": free,
+            "kv_pages_used": used,
+            "kv_pages_total": total,
+            "kv_pages_high_water": high_water,
+            "kv_occupancy": round(used / total, 4) if total else 0.0,
+            "pages_shareable_frac": (
+                round(sh_pages / sh_total, 4) if sh_total else 0.0),
+            "draft_accept_rate": (
+                round(accepted / scored, 4) if scored else 0.0),
+            "draft_tokens_scored": scored,
+            "accept_run_p50": run_p50,
+            "accept_run_p95": run_p95,
+            "prefix_cache_hits": hits,
+            "prefix_cache_queries": queries,
+            "prefix_cache_hit_rate": (
+                round(hits / queries, 4) if queries else 0.0),
+        }
+        _kv_free_gauge.labels(self.name).set(free)
+        _kv_used_gauge.labels(self.name).set(used)
+        _kv_occupancy_gauge.labels(self.name).set(agg["kv_occupancy"])
+        _shareable_frac_gauge.labels(self.name).set(
+            agg["pages_shareable_frac"])
+        _accept_rate_gauge.labels(self.name).set(
+            agg["draft_accept_rate"])
+        _prefix_hit_rate_gauge.labels(self.name).set(
+            agg["prefix_cache_hit_rate"])
+        return agg
 
 
 class PoolScaler(Scaler):

@@ -271,9 +271,21 @@ class Gateway:
 
     def stats(self) -> dict:
         states = [r.state.value for r in self.pool.replicas()]
+        # §29: pool-wide observatory aggregate (health-tick product) +
+        # the prefix-cache hit rate across every pool this gateway runs
+        obs = dict(self.pool.observatory or {})
+        hits = obs.get("prefix_cache_hits", 0)
+        queries = obs.get("prefix_cache_queries", 0)
+        if self.prefill_pool is not None:
+            pf_obs = self.prefill_pool.observatory or {}
+            hits += pf_obs.get("prefix_cache_hits", 0)
+            queries += pf_obs.get("prefix_cache_queries", 0)
+        hit_rate = round(hits / queries, 4) if queries else 0.0
         if self.prefill_pool is not None:
             pf = self.prefill_pool
             return {
+                "prefix_cache_hit_rate": hit_rate,
+                "serving_observatory": obs,
                 "degraded": bool(self.master_link is not None
                                  and self.master_link.degraded),
                 "disaggregated": True,
@@ -295,6 +307,8 @@ class Gateway:
         return {
             "degraded": bool(self.master_link is not None
                              and self.master_link.degraded),
+            "prefix_cache_hit_rate": hit_rate,
+            "serving_observatory": obs,
             "replicas": {s: states.count(s) for s in set(states)},
             "ready": len(self.pool.ready_replicas()),
             "slots_total": self.pool.slots_total(),

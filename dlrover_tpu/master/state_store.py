@@ -174,6 +174,11 @@ class MasterStateManager:
         self._spill_dir = spill_dir
         self._stopped = threading.Event()
         self._dirty = threading.Event()
+        # capture+save must be one atomic unit: an explicit snapshot()
+        # (shutdown, tests) racing the loop thread's periodic one could
+        # otherwise persist OLDER state last — the loop captures before
+        # a dispatch mutates, then its save lands after the newer write
+        self._snap_lock = threading.Lock()
         self._thread: threading.Thread | None = None
         # what the last restore() recovered: the restarting master bumps
         # its epoch past this before serving
@@ -183,6 +188,10 @@ class MasterStateManager:
         self._dirty.set()
 
     def snapshot(self) -> None:
+        with self._snap_lock:
+            self._snapshot_locked()
+
+    def _snapshot_locked(self) -> None:
         master = self._master
         servicer = getattr(master, "servicer", None)
         state = {

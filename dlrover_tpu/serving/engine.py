@@ -70,6 +70,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from dlrover_tpu.common import envspec
+from dlrover_tpu.common.constants import EnvKey
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.models.decode import (
     forward_cached,
@@ -77,10 +79,15 @@ from dlrover_tpu.models.decode import (
     sample_logits,
 )
 from dlrover_tpu.models.transformer import TransformerConfig
+from dlrover_tpu.serving.observatory import ServingObservatory
 from dlrover_tpu.telemetry.journal import get_journal
 from dlrover_tpu.telemetry.metrics import registry
 
 logger = get_logger(__name__)
+
+# engine instances in one process share the metrics registry; gauges
+# are disambiguated by a per-process engine id label
+_ENGINE_IDS = itertools.count()
 
 _request_seconds = registry().histogram(
     "dlrover_tpu_serving_request_seconds",
@@ -105,6 +112,19 @@ _kv_parked_total = registry().counter(
 _kv_handoffs_total = registry().counter(
     "dlrover_tpu_engine_kv_handoffs_total",
     "prefilled KV bundles installed from a prefill engine",
+)
+_prefix_cache_hits_total = registry().counter(
+    "dlrover_tpu_engine_prefix_cache_hits_total",
+    "prefill runs resumed from a cached aligned prefix",
+)
+_prefix_cache_queries_total = registry().counter(
+    "dlrover_tpu_engine_prefix_cache_queries_total",
+    "prefill runs that probed the prefix cache",
+)
+_prefix_cache_entries = registry().gauge(
+    "dlrover_tpu_engine_prefix_cache_entries",
+    "prefilled KV rows currently pinned in the prefix LRU, per engine",
+    label_names=("engine",),
 )
 
 
@@ -228,6 +248,7 @@ class InferenceEngine:
         self._params = params
         self.cfg = cfg
         self.slots = slots
+        self.engine_id = f"eng{next(_ENGINE_IDS)}"
         self.max_len = max_len or cfg.max_seq_len
         # default chunk: the largest divisor of max_len <= 64 (a real
         # divisor search — gcd would only extract the power-of-two
@@ -332,6 +353,19 @@ class InferenceEngine:
         # steady-state decode re-uses the uploaded arrays instead of
         # rebuilding + re-uploading [slots] vectors every step
         self._samp_cache: tuple | None = None
+
+        # measure-only serving observatory (DESIGN.md §29): page-pool
+        # pressure, prefix-share headroom, draft-acceptance shadowing.
+        # Host-side bookkeeping only — the identity test pins that the
+        # token stream is bit-identical with it on or off.
+        self._obs: ServingObservatory | None = None
+        if envspec.get_bool(EnvKey.SERVING_OBSERVATORY):
+            self._obs = ServingObservatory(
+                self,
+                sample_every=envspec.get_int(
+                    EnvKey.OBSERVATORY_SAMPLE_EVERY, 32),
+                shadow_order=envspec.get_int(EnvKey.SHADOW_ORDER, 3),
+            )
 
         self._cache = init_cache(cfg, slots, self.max_len)
         self._cache["pos"] = jnp.zeros((slots,), jnp.int32)
@@ -652,10 +686,15 @@ class InferenceEngine:
         start = 0
         if self.prefix_cache_entries:
             self.prefix_cache_queries += 1
+            _prefix_cache_queries_total.inc()
             hit = self._prefix_lookup(prompt)
             if hit is not None:
                 start, (row_k, row_v, pos, last) = hit
                 self.prefix_cache_hits += 1
+                _prefix_cache_hits_total.inc()
+            _prefix_cache_entries.labels(self.engine_id).set(
+                len(self._prefix_cache)
+            )
         return _PrefillRun(
             prompt=list(prompt), row_k=row_k, row_v=row_v, pos=pos,
             last=last, next_lo=start, start=start,
@@ -802,6 +841,8 @@ class InferenceEngine:
         self._samp_cache = None
         self.kv_parked_total += 1
         _kv_parked_total.inc()
+        if self._obs is not None:
+            self._obs.note_park(req.id)
 
     def _resume_parked(self, slot: int, parked: _Parked) -> None:
         table = np.zeros((self.pages_per_slot,), np.int32)
@@ -821,6 +862,8 @@ class InferenceEngine:
         self._since_install[slot] = 0
         self._samp_cache = None
         jax.block_until_ready(self._last)
+        if self._obs is not None:
+            self._obs.note_resume(parked.req.id)
         get_journal().emit(
             "engine_admit", request=parked.req.id, kind="resume",
             chunks=0, emitted=len(parked.emitted),
@@ -838,8 +881,12 @@ class InferenceEngine:
         if self._paging:
             need = self._pages_needed(req)  # fits: validated at submit
             if len(self._free_pages) < need:
+                if self._obs is not None:
+                    self._obs.note_page_blocked()
                 return False
             pages = [self._free_pages.pop() for _ in range(need)]
+            if self._obs is not None:
+                self._obs.note_pages_leased(req.id, need)
         self._queue.popleft()
         if req.bundle is not None:
             run = self._run_from_bundle(req)
@@ -872,6 +919,8 @@ class InferenceEngine:
         self._seeds[slot] = np.uint32(seed % (2**32))
         self._sampled[slot] = 0
         self._samp_cache = None
+        if self._obs is not None:
+            self._obs.note_admitted(req)
         journal = get_journal()
         journal.emit(
             "engine_admit", request=req.id, kind=pa.kind,
@@ -1012,6 +1061,8 @@ class InferenceEngine:
                 t = int(toks[j, s])
                 self._emitted[s].append(t)
                 self._since_install[s] += 1
+                if self._obs is not None:
+                    self._obs.observe_token(req.id, t)
                 if req.on_token is not None:
                     try:
                         req.on_token(req.id, t)
@@ -1026,6 +1077,8 @@ class InferenceEngine:
                 if len(self._emitted[s]) >= p.max_new_tokens:
                     self._retire(s, "length")
                     break
+        if self._obs is not None:
+            self._obs.on_step()
         return sum(r is not None for r in self._active)
 
     def _retire(self, slot: int, reason: str) -> None:
@@ -1040,6 +1093,8 @@ class InferenceEngine:
                 time.monotonic() - submitted
             )
         _tokens_total.inc(len(self._emitted[slot]))
+        if self._obs is not None:
+            self._obs.note_retire(req.id)
         self._active[slot] = None
         self._emitted[slot] = []
         self._samp_cache = None
@@ -1051,6 +1106,18 @@ class InferenceEngine:
     @property
     def free_pages(self) -> int:
         return len(self._free_pages)
+
+    @property
+    def observatory(self) -> ServingObservatory | None:
+        return self._obs
+
+    def observatory_snapshot(self) -> dict | None:
+        """Last ``kv_pool`` sample (None when the observatory is off or
+        has not sampled yet) — the gateway health tick's per-replica
+        read, safe from any thread."""
+        if self._obs is None:
+            return None
+        return self._obs.snapshot() or None
 
     @property
     def outstanding(self) -> int:

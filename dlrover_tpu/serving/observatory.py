@@ -1,0 +1,380 @@
+"""Serving memory observatory: measure before building ROADMAP-3.
+
+PAPER.md's top layer is the thesis that optimization decisions should
+be driven by collected runtime measurements, and this repo has proven
+the pattern twice (the §23 control-plane observatory named the
+bottleneck PR-17's rack tier then fixed; the §24 autopilot plans from
+measured step history). ROADMAP item 3 — speculative decoding +
+copy-on-write KV pages, the two multiplicative levers on
+``serving_toks_per_s`` — had no such instrument. This module is that
+instrument: three **measure-only** probes (zero behavior change,
+pinned by a token-identity test) that quantify each lever's headroom
+on live traffic before either is built (DESIGN.md §29):
+
+1. **KV page-pool accounting** — free/used/high-water page gauges,
+   pages-per-request and park/resume-churn histograms, and the wall
+   time admission spends blocked on page exhaustion. Periodic
+   ``kv_pool`` journal samples become Perfetto counter lanes
+   (``telemetry/timeline.py``), so page pressure reads alongside the
+   request span lanes.
+2. **Prefix-share headroom** (the COW case) — blake2s chain hashes
+   over each live slot's page-aligned token-id spans. A page is
+   *shareable* when its chained digest (which covers the whole prefix
+   through that page — KV content depends on every preceding token,
+   so equal page content alone is not shareable) appears in ≥ 2 live
+   slots. Yields ``shareable_frac``, the would-be effective-capacity
+   multiplier under copy-on-write (total/unique pages), and prefix
+   families keyed by leading-page content — the tenant proxy: requests
+   sharing a system prompt share their first page(s), so family sizes
+   recover per-tenant sharing without a tenant field in the API.
+3. **Draft-acceptance shadowing** (the spec-decode case) — a cheap
+   host-side shadow predictor (order-k n-gram over the request's OWN
+   prompt + generated context, deterministic, no RNG) scores every
+   emitted decode token. The resulting ``draft_accept_rate`` and
+   run-length histogram of consecutive accepts are the measured prior
+   for choosing draft depth k later.
+
+The observatory is on by default (``DLROVER_TPU_SERVING_OBSERVATORY=0``
+disables it) and touches only host-side bookkeeping: it never reads
+device arrays, never changes which compiled programs run, and never
+reorders admission — the identity test in tests/test_observatory.py
+pins exactly that.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from collections import Counter
+
+from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.metrics import registry
+
+_pages_free = registry().gauge(
+    "dlrover_tpu_engine_kv_pages_free",
+    "KV pool pages currently free, per engine",
+    label_names=("engine",),
+)
+_pages_used = registry().gauge(
+    "dlrover_tpu_engine_kv_pages_used",
+    "KV pool pages currently leased, per engine",
+    label_names=("engine",),
+)
+_pages_high_water = registry().gauge(
+    "dlrover_tpu_engine_kv_pages_high_water",
+    "max pages ever simultaneously leased, per engine",
+    label_names=("engine",),
+)
+_shareable_frac_g = registry().gauge(
+    "dlrover_tpu_engine_kv_shareable_frac",
+    "fraction of live full pages whose chained content hash appears "
+    "in >= 2 live slots (the copy-on-write headroom)",
+    label_names=("engine",),
+)
+_accept_rate_g = registry().gauge(
+    "dlrover_tpu_engine_draft_accept_rate",
+    "fraction of emitted decode tokens the n-gram shadow predictor "
+    "guessed (the speculative-decoding acceptance prior)",
+    label_names=("engine",),
+)
+_pages_per_request = registry().histogram(
+    "dlrover_tpu_engine_kv_pages_per_request",
+    "pages leased per admitted request",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+)
+_park_churn = registry().histogram(
+    "dlrover_tpu_engine_kv_park_churn",
+    "park + resume events over one request's lifetime",
+    buckets=(0, 1, 2, 4, 8, 16, 32),
+)
+_admission_wait = registry().histogram(
+    "dlrover_tpu_engine_kv_admission_wait_seconds",
+    "wall time the queue head spent blocked on page-pool exhaustion",
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0),
+)
+_accept_run_len = registry().histogram(
+    "dlrover_tpu_engine_draft_accept_run_length",
+    "consecutive shadow-predictor accepts per run (the measured prior "
+    "for speculative draft depth)",
+    buckets=(1, 2, 4, 8, 16, 32, 64),
+)
+
+# pow2 run-length buckets mirrored host-side so the observatory can
+# derive p50/p95 for its own journal samples without scraping
+_RUN_BOUNDS = (1, 2, 4, 8, 16, 32, 64)
+
+
+def page_share_stats(slot_tokens, page_size: int) -> dict:
+    """Prefix-share headroom over live slots' token streams.
+
+    ``slot_tokens`` is one token-id list per live slot (prompt +
+    emitted). Pages are hashed with a per-slot blake2s CHAIN — digest
+    at page boundary p covers tokens[0 : (p+1)*page_size] — because a
+    KV page is only truly shareable when the entire prefix through it
+    matches, not merely the page's own tokens. Only full pages count;
+    a partial trailing page is never shareable.
+    """
+    owners: dict[bytes, set[int]] = {}
+    first_page: list[bytes] = []
+    total = 0
+    for sid, toks in enumerate(slot_tokens):
+        h = hashlib.blake2s()
+        for p in range(len(toks) // page_size):
+            lo = p * page_size
+            for t in toks[lo: lo + page_size]:
+                h.update(int(t).to_bytes(8, "little", signed=True))
+            digest = h.digest()
+            owners.setdefault(digest, set()).add(sid)
+            if p == 0:
+                first_page.append(digest)
+            total += 1
+    shareable = sum(
+        len(s) for s in owners.values() if len(s) >= 2
+    )
+    unique = len(owners)
+    families = Counter(first_page)
+    sizes = sorted(families.values(), reverse=True)
+    return {
+        "total_pages": total,
+        "unique_pages": unique,
+        "shareable_pages": shareable,
+        "shareable_frac": (shareable / total) if total else 0.0,
+        # effective capacity multiplier if shared pages were COW: the
+        # same live set would fit in unique_pages physical pages
+        "cow_multiplier": (total / unique) if unique else 1.0,
+        "families": len(sizes),
+        "largest_family": sizes[0] if sizes else 0,
+        "family_sizes": sizes[:8],
+    }
+
+
+class ShadowPredictor:
+    """Order-k n-gram draft shadow over one request's own context.
+
+    Deterministic by construction (no RNG: ties break to the smallest
+    token id; back-off is longest-match k→1), so the acceptance
+    estimate is reproducible and the measure-only pin is trivially
+    safe — the predictor only ever *observes* emitted tokens.
+    """
+
+    def __init__(self, order: int, prompt) -> None:
+        self.order = max(1, int(order))
+        self._ctx: list[int] = []
+        self._tables: list[dict[tuple, Counter]] = [
+            {} for _ in range(self.order)
+        ]
+        self.scored = 0
+        self.accepted = 0
+        for t in prompt:
+            self._absorb(int(t))
+
+    def _absorb(self, tok: int) -> None:
+        ctx = self._ctx
+        for j in range(1, self.order + 1):
+            if len(ctx) >= j:
+                key = tuple(ctx[-j:])
+                table = self._tables[j - 1]
+                followers = table.get(key)
+                if followers is None:
+                    followers = table[key] = Counter()
+                followers[tok] += 1
+        ctx.append(tok)
+
+    def predict(self):
+        """What the draft would emit next, or None with no evidence."""
+        ctx = self._ctx
+        for j in range(min(self.order, len(ctx)), 0, -1):
+            followers = self._tables[j - 1].get(tuple(ctx[-j:]))
+            if followers:
+                return min(
+                    followers.items(), key=lambda kv: (-kv[1], kv[0])
+                )[0]
+        return None
+
+    def observe(self, tok: int) -> bool:
+        """Score one emitted token against the draft, then absorb it;
+        returns whether the draft would have been accepted."""
+        guess = self.predict()
+        self.scored += 1
+        hit = guess == tok
+        if hit:
+            self.accepted += 1
+        self._absorb(int(tok))
+        return hit
+
+
+class ServingObservatory:
+    """Per-engine measurement state + the periodic ``kv_pool`` sample.
+
+    All hooks run on the engine's single decode thread; ``snapshot()``
+    (the gateway health-tick reader) only copies the last published
+    sample under a small lock.
+    """
+
+    def __init__(self, engine, *, sample_every: int = 32,
+                 shadow_order: int = 3) -> None:
+        self.engine = engine
+        self.sample_every = max(1, int(sample_every))
+        self.shadow_order = max(1, int(shadow_order))
+        self._lock = threading.Lock()
+        self._steps = 0
+        self._shadow: dict[int, ShadowPredictor] = {}
+        self._run_cur: dict[int, int] = {}
+        self._run_counts = [0] * (len(_RUN_BOUNDS) + 1)
+        self._runs_closed = 0
+        self._churn: dict[int, int] = {}
+        self._blocked_since: float | None = None
+        self.high_water = 0
+        self.scored = 0
+        self.accepted = 0
+        self._last_sample: dict = {}
+
+    # ------------------------------------------------- page-pool hooks
+
+    def note_page_blocked(self) -> None:
+        """Queue head could not lease its pages this tick."""
+        if self._blocked_since is None:
+            self._blocked_since = time.monotonic()
+
+    def note_pages_leased(self, rid: int, n_pages: int) -> None:
+        if self._blocked_since is not None:
+            _admission_wait.observe(
+                time.monotonic() - self._blocked_since
+            )
+            self._blocked_since = None
+        if n_pages:
+            _pages_per_request.observe(n_pages)
+        eng = self.engine
+        if eng.kv_pages:
+            used = eng.kv_pages - len(eng._free_pages)
+            if used > self.high_water:
+                self.high_water = used
+
+    def note_park(self, rid: int) -> None:
+        self._churn[rid] = self._churn.get(rid, 0) + 1
+
+    def note_resume(self, rid: int) -> None:
+        self._churn[rid] = self._churn.get(rid, 0) + 1
+
+    # ----------------------------------------------- shadow-draft hooks
+
+    def note_admitted(self, req) -> None:
+        if req.id not in self._shadow:
+            self._shadow[req.id] = ShadowPredictor(
+                self.shadow_order, req.prompt
+            )
+            self._churn.setdefault(req.id, 0)
+
+    def observe_token(self, rid: int, tok: int) -> None:
+        shadow = self._shadow.get(rid)
+        if shadow is None:
+            return
+        hit = shadow.observe(tok)
+        self.scored += 1
+        if hit:
+            self.accepted += 1
+            self._run_cur[rid] = self._run_cur.get(rid, 0) + 1
+        else:
+            run = self._run_cur.pop(rid, 0)
+            if run:
+                self._close_run(run)
+
+    def _close_run(self, n: int) -> None:
+        _accept_run_len.observe(n)
+        for i, bound in enumerate(_RUN_BOUNDS):
+            if n <= bound:
+                self._run_counts[i] += 1
+                break
+        else:
+            self._run_counts[-1] += 1
+        self._runs_closed += 1
+
+    def note_retire(self, rid: int) -> None:
+        run = self._run_cur.pop(rid, 0)
+        if run:
+            self._close_run(run)
+        self._shadow.pop(rid, None)
+        _park_churn.observe(self._churn.pop(rid, 0))
+
+    def _run_percentile(self, q: float) -> int:
+        if not self._runs_closed:
+            return 0
+        need = q * self._runs_closed
+        seen = 0
+        for i, count in enumerate(self._run_counts):
+            seen += count
+            if seen >= need:
+                return (_RUN_BOUNDS[i] if i < len(_RUN_BOUNDS)
+                        else _RUN_BOUNDS[-1] * 2)
+        return _RUN_BOUNDS[-1] * 2
+
+    # ------------------------------------------------------- sampling
+
+    def on_step(self) -> None:
+        """Called once per engine decode step; publishes a sample every
+        ``sample_every`` steps."""
+        self._steps += 1
+        if self._steps % self.sample_every == 0:
+            self.sample()
+
+    def sample(self) -> dict:
+        """Compute + publish one observation: gauges, the ``kv_pool``
+        journal point (a Perfetto counter lane), and the snapshot the
+        gateway aggregates."""
+        eng = self.engine
+        total = int(eng.kv_pages)
+        free = len(eng._free_pages)
+        used = total - free if total else 0
+        if used > self.high_water:
+            self.high_water = used
+        active = sum(r is not None for r in eng._active)
+        parked = len(eng._parked)
+        live = [
+            list(req.prompt) + list(eng._emitted[s])
+            for s, req in enumerate(eng._active) if req is not None
+        ]
+        live += [
+            list(p.req.prompt) + list(p.emitted) for p in eng._parked
+        ]
+        share = page_share_stats(live, eng.page_size)
+        rate = self.accepted / self.scored if self.scored else 0.0
+        occupancy = (used / total if total
+                     else (active / eng.slots if eng.slots else 0.0))
+        sample = {
+            "free": free,
+            "used": used,
+            "total": total,
+            "high_water": self.high_water,
+            "occupancy": round(occupancy, 4),
+            "active": active,
+            "parked": parked,
+            "total_pages": share["total_pages"],
+            "unique_pages": share["unique_pages"],
+            "shareable_pages": share["shareable_pages"],
+            "shareable_frac": round(share["shareable_frac"], 4),
+            "cow_multiplier": round(share["cow_multiplier"], 4),
+            "families": share["families"],
+            "largest_family": share["largest_family"],
+            "accept_rate": round(rate, 4),
+            "accepted": self.accepted,
+            "scored": self.scored,
+            "accept_run_p50": self._run_percentile(0.50),
+            "accept_run_p95": self._run_percentile(0.95),
+        }
+        eid = eng.engine_id
+        _pages_free.labels(eid).set(free)
+        _pages_used.labels(eid).set(used)
+        _pages_high_water.labels(eid).set(self.high_water)
+        _shareable_frac_g.labels(eid).set(sample["shareable_frac"])
+        _accept_rate_g.labels(eid).set(sample["accept_rate"])
+        get_journal().emit("kv_pool", **sample)
+        with self._lock:
+            self._last_sample = sample
+        return sample
+
+    def snapshot(self) -> dict:
+        """Last published sample (possibly empty) — safe from any
+        thread; the gateway health tick aggregates these per pool."""
+        with self._lock:
+            return dict(self._last_sample)

@@ -77,6 +77,20 @@ class PrefillEngine:
     def outstanding(self) -> int:
         return len(self._queue) + (1 if self._current else 0)
 
+    # §29 observability rides the wrapped engine: the prefix cache (and
+    # its hit counters) lives there, and the pool health tick reads the
+    # same replica surface off prefill and decode replicas alike
+    @property
+    def prefix_cache_hits(self) -> int:
+        return self.engine.prefix_cache_hits
+
+    @property
+    def prefix_cache_queries(self) -> int:
+        return self.engine.prefix_cache_queries
+
+    def observatory_snapshot(self) -> dict | None:
+        return self.engine.observatory_snapshot()
+
     def submit(self, prompt: list[int], params: Any = None,
                on_token: Any = None, sctx: str = "") -> int:
         """Queue a prompt for prefill. ``params``/``on_token`` are

@@ -236,6 +236,16 @@ class LostTimeReport:
     master_saturation: list[dict] = dataclasses.field(
         default_factory=list
     )
+    # serving memory observatory per engine process (DESIGN.md §29),
+    # from periodic ``kv_pool`` journal samples: {"proc", "samples",
+    # "kv_pages_total", "kv_occupancy_mean", "kv_occupancy_p95",
+    # "kv_pages_high_water", "pages_shareable_frac", "cow_multiplier",
+    # "draft_accept_rate", "tokens_scored", "accept_run_p50",
+    # "accept_run_p95"} — the measured headroom for ROADMAP-3's COW
+    # and speculative-decoding levers
+    serving_observatory: list[dict] = dataclasses.field(
+        default_factory=list
+    )
 
     def to_dict(self) -> dict:
         d = {
@@ -251,6 +261,7 @@ class LostTimeReport:
             "incarnations": self.incarnations,
             "efficiency": self.efficiency,
             "master_saturation": self.master_saturation,
+            "serving_observatory": self.serving_observatory,
         }
         if self.goodput_report is not None:
             d["goodput_report"] = self.goodput_report.to_dict()
@@ -339,6 +350,7 @@ def build_report(journal_path: str, goodput_log: str | None = None,
         ),
         efficiency=_efficiency_rows(spans),
         master_saturation=_master_saturation_rows(spans),
+        serving_observatory=_serving_observatory_rows(spans),
     )
 
 
@@ -513,6 +525,55 @@ def _master_saturation_rows(spans: list[Span]) -> list[dict]:
     return out
 
 
+def _serving_observatory_rows(spans: list[Span]) -> list[dict]:
+    """Serving memory observatory per engine process (DESIGN.md §29).
+
+    ``kv_pool`` journal points — periodic samples from
+    ``serving/observatory.py`` — are grouped by emitting process.
+    Occupancy summarizes over the sample series (mean + p95: how hard
+    the page pool ran); shareable fraction and the COW multiplier
+    report their maxima (the best dedup opportunity observed); the
+    acceptance numbers come from the LAST sample, whose counters are
+    cumulative over the engine's lifetime.
+    """
+    per_proc: dict[str, list[Span]] = {}
+    for span in spans:
+        if span.name == "kv_pool":
+            per_proc.setdefault(span.proc or "unknown", []).append(span)
+    rows: list[dict] = []
+    for proc in sorted(per_proc):
+        samples = sorted(per_proc[proc], key=lambda s: s.end)
+        occ = sorted(
+            float(s.fields.get("occupancy", 0.0) or 0.0)
+            for s in samples
+        )
+        last = samples[-1].fields
+
+        def fmax(key: str) -> float:
+            return max(
+                float(s.fields.get(key, 0.0) or 0.0) for s in samples
+            )
+
+        rows.append({
+            "proc": proc,
+            "samples": len(samples),
+            "kv_pages_total": int(last.get("total", 0) or 0),
+            "kv_occupancy_mean": round(sum(occ) / len(occ), 4),
+            "kv_occupancy_p95": round(
+                occ[min(len(occ) - 1, int(0.95 * len(occ)))], 4),
+            "kv_pages_high_water": int(fmax("high_water")),
+            "pages_shareable_frac": round(fmax("shareable_frac"), 4),
+            "cow_multiplier": round(fmax("cow_multiplier"), 4),
+            "largest_family": int(fmax("largest_family")),
+            "draft_accept_rate": round(
+                float(last.get("accept_rate", 0.0) or 0.0), 4),
+            "tokens_scored": int(last.get("scored", 0) or 0),
+            "accept_run_p50": int(last.get("accept_run_p50", 0) or 0),
+            "accept_run_p95": int(last.get("accept_run_p95", 0) or 0),
+        })
+    return rows
+
+
 def _per_incarnation(spans: list[Span],
                      window: tuple[float, float] | None,
                      median: float,
@@ -628,6 +689,22 @@ def format_report(report: LostTimeReport) -> str:
                     f"      {center:<28} {total_ms:10.1f} ms"
                     f"  p99 {p99:8.3f} ms  x{calls}"
                 )
+    if report.serving_observatory:
+        lines.append("  serving memory observatory (kv_pool samples, "
+                     "DESIGN.md §29):")
+        lines.append("    proc              occ-mean  occ-p95  hi-water"
+                     "  share-frac  cow-mult  accept  run-p50/p95")
+        for row in report.serving_observatory:
+            lines.append(
+                f"    {row['proc']:<16}"
+                f"  {row['kv_occupancy_mean']:8.4f}"
+                f"  {row['kv_occupancy_p95']:7.4f}"
+                f"  {row['kv_pages_high_water']:8d}"
+                f"  {row['pages_shareable_frac']:10.4f}"
+                f"  {row['cow_multiplier']:8.4f}"
+                f"  {row['draft_accept_rate']:6.4f}"
+                f"  {row['accept_run_p50']}/{row['accept_run_p95']}"
+            )
     return "\n".join(lines)
 
 

@@ -42,10 +42,11 @@ INSTANT_NAMES = frozenset({
     "job_start", "job_end", "profile_request", "profile_capture",
 })
 
-# journaled metric samples (telemetry/efficiency.py metrics_sample
-# points) render as Perfetto COUNTER tracks (ph="C"), not spans: an MFU
-# lane and a stacked step-phase lane beside the span lanes
-COUNTER_NAMES = frozenset({"metrics_sample"})
+# journaled metric samples render as Perfetto COUNTER tracks (ph="C"),
+# not spans: metrics_sample (telemetry/efficiency.py) becomes an MFU
+# lane and a stacked step-phase lane; kv_pool (serving/observatory.py,
+# §29) becomes page-pool, share-headroom and draft-acceptance lanes
+COUNTER_NAMES = frozenset({"metrics_sample", "kv_pool"})
 
 
 def _lane_key(span: Span) -> tuple[str, str]:
@@ -162,6 +163,31 @@ def build_trace(paths: list[str], trace: str | None = None) -> dict:
         proc = sample.proc or "unknown"
         pid = pid_of[proc]
         ts = round((sample.end - t0) * 1e6, 3)
+        if sample.name == "kv_pool":
+            # §29 serving-observatory lanes: stacked free/used pages,
+            # COW share headroom, and the shadow acceptance rate
+            out.append({
+                "ph": "C", "name": "kv_pages", "cat": "serving",
+                "ts": ts, "pid": pid, "args": {
+                    "used": float(sample.fields.get("used", 0) or 0),
+                    "free": float(sample.fields.get("free", 0) or 0),
+                },
+            })
+            out.append({
+                "ph": "C", "name": "kv_shareable_frac",
+                "cat": "serving", "ts": ts, "pid": pid, "args": {
+                    "shareable_frac": float(
+                        sample.fields.get("shareable_frac", 0.0) or 0),
+                },
+            })
+            out.append({
+                "ph": "C", "name": "draft_accept_rate",
+                "cat": "serving", "ts": ts, "pid": pid, "args": {
+                    "accept_rate": float(
+                        sample.fields.get("accept_rate", 0.0) or 0),
+                },
+            })
+            continue
         mfu = sample.fields.get("mfu")
         if isinstance(mfu, (int, float)):
             out.append({

@@ -100,6 +100,10 @@ DOCUMENTED_PREFIXES = (
     # nodes" runbook keys on the merge/epoch/cache-lookup families
     # and the comm-world diff byte counters
     "dlrover_tpu_submaster_",
+    # serving memory observatory (DESIGN.md §29): the "is the KV pool
+    # the bottleneck" runbook keys on the request-latency family and
+    # the engine kv_/draft_ gauges (covered by the engine_ prefix)
+    "dlrover_tpu_serving_",
 )
 
 # label names that are themselves an operator contract (dashboards and

@@ -120,6 +120,55 @@ def test_rendezvous_round_monotonic_and_waiting_restored(tmp_path):
         m2.stop()
 
 
+def test_racing_snapshot_cannot_clobber_newer_state(tmp_path):
+    """A loop-thread snapshot captured BEFORE a dispatch must not save
+    AFTER (and thus clobber) a snapshot captured after the dispatch —
+    capture+save is one atomic unit. Deterministic replay of a suite
+    flake: the stale capture's save is parked until the newer snapshot
+    has had every chance to win the write order."""
+    m1 = _master(tmp_path, min_nodes=2, max_nodes=2)
+    sm = m1.state_manager
+    for nid, addr in ((0, "a:1"), (1, "b:1")):
+        m1.servicer.handle(m.JoinRendezvousRequest(
+            node_id=nid, addr=addr, local_devices=4))
+    assert m1.servicer.handle(m.CommWorldRequest(node_id=0)).completed
+
+    backend = sm._backend
+    orig_save = backend.save
+    stale_captured = threading.Event()
+    newer_saved = threading.Event()
+    gated = []
+
+    def gated_save(state):
+        if not gated:
+            gated.append(True)
+            stale_captured.set()
+            newer_saved.wait(1.0)
+        orig_save(state)
+
+    backend.save = gated_save
+    stale = threading.Thread(target=sm.snapshot)  # captures pre-rejoin
+    stale.start()
+    assert stale_captured.wait(5.0)
+    m1.servicer.handle(m.JoinRendezvousRequest(   # invalidates round 1
+        node_id=0, addr="a:1", local_devices=4))
+    sm.snapshot()                                 # captures post-rejoin
+    newer_saved.set()
+    stale.join(10.0)
+    backend.save = orig_save
+    _crash(m1)
+
+    m2 = _master(tmp_path, min_nodes=2, max_nodes=2)
+    try:
+        m2.servicer.handle(m.JoinRendezvousRequest(
+            node_id=1, addr="b:1", local_devices=4))
+        w2 = m2.servicer.handle(m.CommWorldRequest(node_id=0))
+        assert w2.completed          # node 0's rejoin survived the race
+        assert sorted(w2.world) == [0, 1]
+    finally:
+        m2.stop()
+
+
 def test_compile_cache_spilled_and_served_warm(tmp_path):
     blob = b"\x00executable\xff" * 9
     m1 = _master(tmp_path)

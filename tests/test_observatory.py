@@ -1,0 +1,279 @@
+"""Serving memory observatory (ISSUE 18 tentpole, DESIGN.md §29).
+
+The properties that make a measure-only instrument trustworthy:
+
+- the measure-only pin: a seeded engine trace produces bit-identical
+  token streams with the observatory on vs off (mirroring the
+  disagg==unified identity test) — measurement must never steer;
+- shareable-page hashing counts only full, whole-prefix-matching
+  pages (overlap / no-overlap / partial-page cases);
+- the n-gram shadow predictor is deterministic: same stream, same
+  acceptance, no RNG anywhere;
+- `bench.py --compare` gates by category, so the committed r06/r07
+  pair (whose stage configs legitimately diverged) runs green while a
+  genuine quality drop still fails.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from pathlib import Path
+
+import pytest
+
+import jax
+
+import bench
+from dlrover_tpu.models import transformer as tfm
+from dlrover_tpu.serving import InferenceEngine, SamplingParams
+from dlrover_tpu.serving.observatory import (
+    ShadowPredictor,
+    page_share_stats,
+)
+
+CFG = tfm.CONFIGS["tiny"]
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(CFG, jax.random.PRNGKey(0))
+
+
+# ------------------------------------------------- measure-only pin
+
+
+@pytest.mark.timeout(300)
+def test_observatory_on_off_token_identity(params, monkeypatch):
+    """ISSUE 18 acceptance: the same seeded open-loop-shaped trace on
+    a paged engine (parks and resumes included) emits bit-identical
+    streams with the observatory enabled and disabled."""
+    rng = random.Random(7)
+    reqs = []
+    for i in range(8):
+        plen = rng.randint(1, 12)
+        reqs.append((
+            [rng.randrange(CFG.vocab_size) for _ in range(plen)],
+            SamplingParams(
+                temperature=rng.choice([0.0, 0.8]),
+                max_new_tokens=rng.randint(2, 20),
+                seed=2000 + i),
+        ))
+
+    def run(enabled):
+        monkeypatch.setenv("DLROVER_TPU_SERVING_OBSERVATORY",
+                           "1" if enabled else "0")
+        monkeypatch.setenv("DLROVER_TPU_OBSERVATORY_SAMPLE_EVERY", "4")
+        eng = InferenceEngine(params, CFG, slots=2, max_len=64,
+                              prefill_len=8, kv_pages=24)
+        ids = [eng.submit(p, sp) for p, sp in reqs]
+        out = {r.id: r.tokens for r in eng.run()}
+        return eng, [out[i] for i in ids]
+
+    eng_on, on = run(True)
+    eng_off, off = run(False)
+    assert on == off                       # the measure-only pin
+    assert eng_on.kv_parked_total >= 1     # parks actually happened
+    # and the instrument measured while not steering
+    snap = eng_on.observatory_snapshot()
+    assert snap is not None
+    assert snap["total"] == 24
+    assert snap["scored"] > 0
+    assert 0.0 <= snap["accept_rate"] <= 1.0
+    assert snap["high_water"] > 0
+    assert eng_off.observatory_snapshot() is None
+
+
+# ------------------------------------------- shareable-page hashing
+
+
+class TestPageShareStats:
+    def test_full_overlap_two_slots(self):
+        # two slots share 2 aligned pages, then diverge on page 3
+        shared = list(range(100, 108))          # 2 pages of 4
+        a = shared + [1, 2, 3, 4]
+        b = shared + [5, 6, 7, 8]
+        s = page_share_stats([a, b], 4)
+        assert s["total_pages"] == 6
+        assert s["shareable_pages"] == 4        # both copies of both
+        assert s["shareable_frac"] == pytest.approx(4 / 6)
+        assert s["unique_pages"] == 4           # 2 shared + 2 distinct
+        assert s["cow_multiplier"] == pytest.approx(6 / 4)
+        assert s["families"] == 1
+        assert s["largest_family"] == 2
+
+    def test_no_overlap(self):
+        s = page_share_stats([[1, 2, 3, 4], [5, 6, 7, 8]], 4)
+        assert s["shareable_pages"] == 0
+        assert s["shareable_frac"] == 0.0
+        assert s["cow_multiplier"] == 1.0
+        assert s["families"] == 2
+
+    def test_partial_page_never_shareable(self):
+        # shared prefix shorter than one page: no FULL page matches
+        s = page_share_stats([[9, 9, 9], [9, 9, 9]], 4)
+        assert s["total_pages"] == 0
+        assert s["shareable_frac"] == 0.0
+        # ... and a full first page + partial tail counts only the page
+        s = page_share_stats([[9] * 6, [9] * 6], 4)
+        assert s["total_pages"] == 2
+        assert s["shareable_pages"] == 2
+
+    def test_equal_content_different_prefix_not_shareable(self):
+        # page 2's TOKENS match across slots but the prefixes differ;
+        # KV content depends on the whole prefix, so the chain hash
+        # must refuse the share
+        a = [1, 2, 3, 4] + [7, 7, 7, 7]
+        b = [5, 6, 7, 8] + [7, 7, 7, 7]
+        s = page_share_stats([a, b], 4)
+        assert s["shareable_pages"] == 0
+
+
+# ------------------------------------------- shadow-draft determinism
+
+
+class TestShadowPredictor:
+    def test_deterministic_under_fixed_seed(self):
+        rng = random.Random(123)
+        prompt = [rng.randrange(64) for _ in range(12)]
+        stream = [rng.randrange(64) for _ in range(200)]
+
+        def score():
+            sp = ShadowPredictor(3, prompt)
+            hits = [sp.observe(t) for t in stream]
+            return sp.accepted, sp.scored, hits
+
+        assert score() == score()
+
+    def test_repetition_is_predictable(self):
+        period = [3, 1, 4, 1, 5]
+        sp = ShadowPredictor(3, period * 2)
+        accepts = sum(sp.observe(t) for t in period * 10)
+        # a periodic stream is exactly what an n-gram nails
+        assert accepts / (len(period) * 10) > 0.9
+        assert sp.scored == len(period) * 10
+
+    def test_cold_context_scores_misses(self):
+        sp = ShadowPredictor(2, [1])
+        assert sp.observe(2) is False   # no evidence -> miss, scored
+        assert sp.scored == 1 and sp.accepted == 0
+
+
+# --------------------------------------------------- bench --compare
+
+
+class TestBenchCompare:
+    def test_committed_r06_r07_green(self):
+        """ISSUE 18 acceptance: the committed trajectory files diff
+        clean — config-driven latency/throughput swings are
+        informational, not gated."""
+        rc = bench.main([
+            "--compare",
+            str(REPO / "BENCH_r06.json"),
+            str(REPO / "BENCH_r07.json"),
+        ])
+        assert rc == 0
+
+    def test_quality_regression_gates(self, tmp_path, capsys):
+        old = tmp_path / "old.json"
+        new = tmp_path / "new.json"
+        old.write_text(json.dumps(
+            {"headline": {"goodput": 0.95, "step_ms": 100}}))
+        new.write_text(json.dumps(
+            {"headline": {"goodput": 0.50, "step_ms": 300}}))
+        rc = bench.main(["--compare", str(old), str(new)])
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert "goodput" in out and "REGRESSION" in out
+        # the raw-latency swing reports but does not gate
+        assert "step_ms" in out
+
+    def test_failure_count_increase_gates(self, tmp_path):
+        old = tmp_path / "old.json"
+        new = tmp_path / "new.json"
+        old.write_text(json.dumps(
+            {"headline": {"gateway_failed": 0, "n_errors": 0}}))
+        new.write_text(json.dumps(
+            {"headline": {"gateway_failed": 2, "n_errors": 1}}))
+        assert bench.main(["--compare", str(old), str(new)]) == 1
+
+    def test_boolean_flip_gates(self, tmp_path):
+        old = tmp_path / "old.json"
+        new = tmp_path / "new.json"
+        old.write_text(json.dumps(
+            {"headline": {"cp_rack_p99_within_2x_1k": True}}))
+        new.write_text(json.dumps(
+            {"headline": {"cp_rack_p99_within_2x_1k": False}}))
+        assert bench.main(["--compare", str(old), str(new)]) == 1
+
+    def test_wrapper_and_raw_formats_load(self, tmp_path):
+        raw = tmp_path / "raw.txt"
+        raw.write_text(
+            'noise\n{"metric": "x", "headline": {"mfu": 0.4}}\n')
+        assert bench._load_headline(str(raw)) == {"mfu": 0.4}
+        wrapped = tmp_path / "wrapped.json"
+        wrapped.write_text(json.dumps(
+            {"n": 1, "rc": 0,
+             "tail": 'cut{"bad\n{"headline": {"mfu": 0.5}}\n'}))
+        assert bench._load_headline(str(wrapped)) == {"mfu": 0.5}
+        with pytest.raises(ValueError):
+            empty = tmp_path / "empty.json"
+            empty.write_text("{}")
+            bench._load_headline(str(empty))
+
+    def test_new_headline_keys_registered(self):
+        for key in ("gateway_kv_occupancy_p95",
+                    "gateway_pages_shareable_frac",
+                    "gateway_draft_accept_rate",
+                    "gateway_accept_run_p50",
+                    "gateway_accept_run_p95"):
+            assert key in bench.HEADLINE_KEYS
+
+
+# ------------------------------------------- gateway-level aggregation
+
+
+@pytest.mark.timeout(300)
+def test_gateway_stats_expose_observatory(params, monkeypatch):
+    """The health tick rolls replica samples into the pool aggregate
+    and stats()/healthz carry the §29 payload + prefix hit rate."""
+    from dlrover_tpu.gateway import Gateway
+
+    monkeypatch.setenv("DLROVER_TPU_SERVING_OBSERVATORY", "1")
+    monkeypatch.setenv("DLROVER_TPU_OBSERVATORY_SAMPLE_EVERY", "2")
+
+    def factory():
+        return InferenceEngine(
+            params, CFG, slots=2, max_len=64, prefill_len=8,
+            prefix_cache_entries=4, kv_pages=16,
+        )
+
+    gw = Gateway(factory, replicas=1, prefill_len=8, seed=11,
+                 health_interval_s=0.05)
+    try:
+        import time
+
+        deadline = time.monotonic() + 90
+        while (len(gw.pool.ready_replicas()) < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        shared = list(range(40, 48))            # one aligned page
+        for extra_tok in (1, 2, 3):
+            gw.generate(shared + [extra_tok], SamplingParams(
+                temperature=0.0, max_new_tokens=4), timeout=120)
+        deadline = time.monotonic() + 30
+        while (not gw.pool.observatory.get("replicas_sampled")
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        stats = gw.stats()
+        obs = stats["serving_observatory"]
+        assert obs["replicas_sampled"] == 1
+        assert obs["kv_pages_total"] == 16
+        assert obs["draft_tokens_scored"] > 0
+        assert 0.0 <= obs["draft_accept_rate"] <= 1.0
+        # shared one-page prefix across the 3 prompts: the LRU hit
+        assert stats["prefix_cache_hit_rate"] > 0.0
+        assert obs["prefix_cache_queries"] >= 3
+    finally:
+        gw.stop()
