@@ -770,6 +770,14 @@ class ElasticAgent:
                     link.ok()
                 except (ConnectionError, RuntimeError, OSError) as e:
                     link.failed(e)
+                    if link.stale():
+                        # a control action mirrored before the outage
+                        # must not fire minutes later (§30): the master
+                        # re-issues it on the next heartbeat if it
+                        # still wants it
+                        with self._action_lock:
+                            self._pending_action = ""
+                            self._pending_restart_sctx = ""
                 self._stopped.wait(self._config.heartbeat_interval_s)
 
         threading.Thread(target=loop, name="agent-heartbeat",

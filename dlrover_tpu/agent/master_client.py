@@ -8,6 +8,7 @@ MasterClient, API surface :122-404). One singleton per process, address from
 from __future__ import annotations
 
 import os
+import random
 import threading
 import time
 import uuid
@@ -72,12 +73,19 @@ class MasterClient:
                  snapshot_full_every: int | None = None,
                  port_file: str | None = None,
                  fallback_port_file: str | None = None,
-                 epoch_observer=None):
+                 epoch_observer=None,
+                 link: tuple[str, str] | None = None):
         # ``transport`` is any object with RpcClient's call/close
         # surface; the fleet simulator passes an in-process loopback so
         # thousands of simulated agents exercise the genuine typed
-        # client + serde path without a socket each
-        self._client = transport or RpcClient(master_addr)
+        # client + serde path without a socket each.
+        # ``link`` names the src/dst tiers for the net_partition chaos
+        # point (§30); a rack-attached client (it has a fallback file)
+        # starts on the agent->rack edge.
+        if link is None:
+            link = (("agent", "rack") if fallback_port_file
+                    else ("agent", "root"))
+        self._client = transport or RpcClient(master_addr, link=link)
         self.node_id = node_id
         # target-keyed re-dial (§28): the atomic port file THIS client's
         # target republishes after a restart. None falls back to the
@@ -89,6 +97,13 @@ class MasterClient:
         # sub-master republishes.
         self._port_file = port_file
         self._fallback_port_file = fallback_port_file
+        # sticky re-dial (§30): which port file the client is currently
+        # attached through, and the earliest time a fallback-pinned
+        # client probes the rack file again. Without the pin, every
+        # re-dial tried the (dead) rack address first and the client
+        # flapped rack->root on every transient error.
+        self._active_target = "primary"
+        self._rack_retry_at = 0.0
         # replaces the built-in agent reconcile as the reaction to a
         # transport-envelope epoch change: the rack sub-master handles
         # a root restart by re-registering its rack instead (§28)
@@ -244,30 +259,69 @@ class MasterClient:
         new_addr = f"{host}:{port}"
         return None if new_addr == self._client.addr else new_addr
 
-    def maybe_redial(self) -> bool:
+    def _arm_rack_retry(self, now: float) -> None:
+        """Schedule the next rack-file probe while pinned to the
+        fallback: RACK_RETRY_S jittered ±20% so a rack's worth of
+        fallback-pinned agents don't re-probe (and potentially
+        re-attach, re-register, re-join) in lockstep."""
+        retry_s = float(envspec.get_float(EnvKey.RACK_RETRY_S) or 5.0)
+        self._rack_retry_at = now + retry_s * random.uniform(0.8, 1.2)
+
+    def maybe_redial(self, prefer_fallback: bool = False) -> bool:
         """Re-resolve this client's TARGET from its atomic port file —
         a restarted master (root or rack sub-master) binds a fresh port
         and republishes it there. The file is target-keyed (§28): a
         rack-attached client re-resolves its sub-master's own file, and
         when that yields nothing fresh falls back to the root's file
-        (degraded direct-to-root; the next call prefers the rack file
-        again, so a respawned sub-master reclaims its agents). Returns
-        True when the client moved to a new address."""
+        (degraded direct-to-root). The re-dial is STICKY (§30): while
+        pinned to the fallback it re-probes the rack file only every
+        RACK_RETRY_S (jittered), instead of flapping back to a dead
+        rack address on every transient error; a respawned sub-master
+        reclaims its agents at the next probe. ``prefer_fallback``
+        skips the rack probe entirely — the sub-master itself told this
+        agent to go to the root (lease lapsed, fail-closed redirect).
+        Returns True when the client moved to a new address."""
         if not isinstance(self._client, RpcClient):
             return False
         primary = self._port_file or envspec.get(EnvKey.MASTER_PORT_FILE)
-        new_addr = self._read_port_file(primary) if primary else None
-        if new_addr is None and self._fallback_port_file:
-            new_addr = self._read_port_file(self._fallback_port_file)
+        fallback = self._fallback_port_file
+        now = time.monotonic()
+        new_addr, target = None, ""
+        if prefer_fallback and fallback:
+            new_addr = self._read_port_file(fallback)
+            target = "fallback"
+            self._arm_rack_retry(now)
+        else:
+            probe_primary = bool(primary) and (
+                self._active_target != "fallback"
+                or now >= self._rack_retry_at
+            )
+            if probe_primary:
+                new_addr = self._read_port_file(primary)
+                if new_addr is not None:
+                    target = "primary"
+                elif self._active_target == "fallback":
+                    # rack still gone/unchanged: back off the probe
+                    self._arm_rack_retry(now)
+            if new_addr is None and fallback:
+                new_addr = self._read_port_file(fallback)
+                if new_addr is not None:
+                    target = "fallback"
+                    self._arm_rack_retry(now)
         if new_addr is None:
             return False
         old = self._client
         fresh = old.clone(new_addr)
+        # the partition edge follows the target tier (§30)
+        if fallback:
+            fresh.link = (("agent", "rack") if target == "primary"
+                          else ("agent", "root"))
         self._wire_epoch_hook(fresh)
         self._client = fresh
+        self._active_target = target
         old.close()
-        logger.info("re-dialed master at %s (was %s)", new_addr,
-                    old.addr)
+        logger.info("re-dialed master at %s (was %s, via %s file)",
+                    new_addr, old.addr, target)
         return True
 
     # ------------------------------------------------------------- singleton
@@ -336,6 +390,11 @@ class MasterClient:
                 continue
             if resp.completed:
                 return resp
+            if getattr(resp, "redirect", False):
+                # the rack sub-master failed closed (lease lapsed or
+                # superseded, §30): finish this round directly against
+                # the root instead of waiting out the rack
+                self.maybe_redial(prefer_fallback=True)
             time.sleep(poll_interval)
         raise TimeoutError(
             f"rendezvous {rdzv_name!r} did not complete in {timeout}s"
@@ -733,14 +792,17 @@ class MasterClient:
         )
 
     def report_rack_merged(self, rack_id: str, heartbeats: list,
-                           snapshots: list, acks: list
-                           ) -> m.RackMergedResponse:
+                           snapshots: list, acks: list,
+                           epoch: int = 0) -> m.RackMergedResponse:
         """One merged upstream push per sub-master flush tick: the
         rack's aggregated heartbeats, metrics-snapshot deltas and
-        persist-acks (original rids preserved for the root's dedup)."""
+        persist-acks (original rids preserved for the root's dedup).
+        ``epoch`` stamps the sender's rack incarnation so the root can
+        fence a superseded sub-master's resumed pushes (§30); 0 is the
+        legacy unstamped form, accepted unfenced."""
         return self._client.call(
             m.RackMergedReport(rack_id=rack_id,
                                heartbeats=list(heartbeats),
                                snapshots=list(snapshots),
-                               acks=list(acks))
+                               acks=list(acks), epoch=int(epoch))
         )

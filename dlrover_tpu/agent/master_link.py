@@ -69,6 +69,8 @@ class MasterLink:
         self._warn_every_s = warn_every_s
         self._lock = threading.Lock()
         self._degraded = False
+        self._degraded_since = 0.0
+        self._stale_logged = False
         self._last_warn = 0.0
         self._gauge.set(0)
 
@@ -86,6 +88,7 @@ class MasterLink:
             if not self._degraded:
                 return
             self._degraded = False
+            self._stale_logged = False
         self._gauge.set(0)
         get_journal().emit("degraded_mode", state="exit",
                            component=self.component)
@@ -101,6 +104,8 @@ class MasterLink:
         with self._lock:
             entered = not self._degraded
             self._degraded = True
+            if entered:
+                self._degraded_since = now
             warn = entered or now - self._last_warn >= self._warn_every_s
             if warn:
                 self._last_warn = now
@@ -121,3 +126,31 @@ class MasterLink:
                 redial()
             except Exception:  # noqa: BLE001 - re-dial is best-effort
                 logger.exception("master re-dial failed")
+
+    def stale(self) -> bool:
+        """Mirrored-config staleness bound (DESIGN.md §30): True once
+        the link has been degraded for longer than
+        ``DLROVER_TPU_LINK_STALE_S``. Degraded mode keeps the component
+        doing its real job on last-known config; past this bound that
+        config is old enough that acting on it (a queued restart, a
+        mirrored scale target) can contradict what the partitioned
+        master has since decided — consumers should drop it and wait
+        for the link to recover. The first stale tick of an episode is
+        one ``degraded_mode`` state="stale" journal instant."""
+        stale_s = envspec.get_float(EnvKey.LINK_STALE_S, 60.0) or 60.0
+        with self._lock:
+            if not self._degraded:
+                return False
+            if time.monotonic() - self._degraded_since < stale_s:
+                return False
+            first = not self._stale_logged
+            self._stale_logged = True
+        if first:
+            get_journal().emit("degraded_mode", state="stale",
+                               component=self.component)
+            logger.warning(
+                "%s degraded for over %.0fs: mirrored master config is "
+                "now STALE; holding position until the link recovers",
+                self.component, stale_s,
+            )
+        return True

@@ -1,0 +1,107 @@
+"""Sustained network partitions as a declarative chaos domain.
+
+The per-RPC chaos actions (``rpc_call`` drop/reset/garble) sever one
+call; a *partition* severs a **link** — every message crossing a
+src-tier -> dst-tier edge fails until the rule's occurrence window
+closes (DESIGN.md §30). Rules ride the ordinary chaos plan under the
+``net_partition`` point, so they inherit the whole replay contract
+(seeded per-rule streams, count-based ``after``/``every``/``times``
+windows, per-process counters from the inherited env):
+
+    {"point": "net_partition", "action": "drop",
+     "match": {"src": "rack", "dst": "root"}, "after": 3, "times": 10}
+
+opens the rack->root edge at its 4th crossing and heals it after 10
+dropped crossings. ``match: {"link": "agent|root"}`` matches BOTH
+directions of an edge (``link`` is the sorted pair) — a symmetric
+split; matching ``src``/``dst`` makes it one-way. Enforcement sites
+(``RpcClient.call`` request and response directions, the sub-master
+upstream merge tick, the embedding ``service._call`` framing) call
+``check(src, dst, ...)`` per crossing and raise ``ConnectionError``
+when a fault fires, so the ordinary degraded-mode machinery —
+retries, redelivery queues, port-file re-dial, rack leases — is what
+a partition exercises.
+
+Every open and heal is journaled once (``net_partition`` instants) and
+counted; since transitions derive only from per-rule occurrence
+counts, a seeded replay produces the identical open/heal trail.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from dlrover_tpu import chaos
+from dlrover_tpu.chaos.injector import Fault
+from dlrover_tpu.common.log import get_logger
+from dlrover_tpu.telemetry.journal import get_journal
+from dlrover_tpu.telemetry.metrics import registry
+
+logger = get_logger(__name__)
+
+_transitions_total = registry().counter(
+    "dlrover_tpu_partition_transitions_total",
+    "net_partition link-state transitions: 'open' at the first "
+    "dropped crossing, 'heal' at the first crossing that passes again",
+    label_names=("link", "state"),
+)
+_drops_total = registry().counter(
+    "dlrover_tpu_partition_drops_total",
+    "messages dropped by an open net_partition link, by directed edge",
+    label_names=("link",),
+)
+
+_lock = threading.Lock()
+# (src, dst) -> seq of the fault that opened this directed edge
+_open: dict[tuple[str, str], int] = {}
+
+
+def canonical_link(src: str, dst: str) -> str:
+    """Direction-free edge name (``"agent|root"``): what symmetric
+    rules match and what the metrics/journal label links with."""
+    return "|".join(sorted((str(src), str(dst))))
+
+
+def reset() -> None:
+    """Forget link states (scenario/test hygiene between plans)."""
+    with _lock:
+        _open.clear()
+
+
+def check(src: str, dst: str, **ctx) -> Fault | None:
+    """Evaluate the ``net_partition`` point for one message crossing
+    ``src -> dst``. Returns the fired fault (the site must fail the
+    message with ``ConnectionError``) or None (link healthy). Journals
+    the open/heal transitions exactly once per episode."""
+    if not chaos.ENABLED:
+        if _open:
+            with _lock:
+                _open.clear()
+        return None
+    edge = f"{src}>{dst}"
+    fault = chaos.fire(
+        "net_partition", src=src, dst=dst,
+        link=canonical_link(src, dst), **ctx
+    )
+    key = (src, dst)
+    if fault is not None:
+        _drops_total.labels(edge).inc()
+        with _lock:
+            newly = key not in _open
+            if newly:
+                _open[key] = fault.seq
+        if newly:
+            _transitions_total.labels(edge, "open").inc()
+            get_journal().emit("net_partition", state="open",
+                               src=src, dst=dst, seq=fault.seq)
+            logger.warning("chaos: net partition OPEN on %s (seq %d)",
+                           edge, fault.seq)
+        return fault
+    with _lock:
+        opened = _open.pop(key, None)
+    if opened is not None:
+        _transitions_total.labels(edge, "heal").inc()
+        get_journal().emit("net_partition", state="heal",
+                           src=src, dst=dst, seq=opened)
+        logger.warning("chaos: net partition HEALED on %s", edge)
+    return None

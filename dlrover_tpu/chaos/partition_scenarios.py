@@ -1,0 +1,680 @@
+"""Partition-tolerance acceptance scenarios (DESIGN.md §30).
+
+Three drills over REAL subprocesses and the declarative
+``net_partition`` chaos point, each ending in the trail-invariant
+auditor (telemetry/audit.py):
+
+- **zombie sub-master**: SIGSTOP a rack sub-master, replace it, then
+  SIGCONT the original. The zombie resumes with buffered state and a
+  superseded epoch; its first (keepalive) push must bounce off the
+  root's push-direction fence — ``push_fenced`` journaled, zero agent
+  acts on anything the zombie held, zero trainer restarts, and the
+  trail replay-identical across two seeded runs.
+
+- **asymmetric agent<->root split**: a one-way request-drop window
+  followed by a response-loss window on the same link. A lost request
+  queues the report; a lost RESPONSE queues a report the root already
+  applied — redelivery replays both with their original rids and the
+  root's dedup proves single application (exactly one ``persist_ack``
+  journal line per report).
+
+- **rack-wide split during a rendezvous round**: the rack's upstream
+  link opens mid-round with a 1-second lease. The sub-master's lease
+  lapses and it fails closed (``lease_expired`` tier="rack", agents
+  redirected); the agents complete the round through the
+  direct-to-root fallback; on heal the root lazily expires the rack
+  (``lease_expired`` tier="root") and the same incarnation's next
+  push re-admits it — lease loss is not epoch loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.common.log import get_logger
+
+logger = get_logger(__name__)
+
+
+def _setup(work_dir: str, seed: int, tag: str,
+           extra_env: dict | None = None):
+    """Shared scaffolding: dirs, subprocess env (shared journal,
+    seeded trace streams), and the parent-process env swap the typed
+    clients resolve their port files through."""
+    os.makedirs(work_dir, exist_ok=True)
+    state_dir = os.path.join(work_dir, "state")
+    journal_dir = os.path.join(work_dir, "journal")
+    port_file = os.path.join(work_dir, "master.port")
+    log_path = os.path.join(work_dir, f"{tag}.log")
+    os.makedirs(state_dir, exist_ok=True)
+
+    from dlrover_tpu.chaos.scenario import REPO
+
+    env = dict(os.environ)
+    env.update({
+        EnvKey.JOURNAL_DIR: journal_dir,
+        EnvKey.TRACE_ID: f"{tag}{seed}",
+        EnvKey.TRACE_SEED: f"{tag}:{seed}",
+        "PYTHONPATH": env.get("PYTHONPATH", "") + os.pathsep + REPO,
+    })
+    env.pop(EnvKey.CHAOS, None)
+    env.update(extra_env or {})
+    swap_keys = (EnvKey.MASTER_PORT_FILE, EnvKey.JOURNAL_DIR)
+    prev_env = {k: os.environ.get(k) for k in swap_keys}
+    os.environ[EnvKey.MASTER_PORT_FILE] = port_file
+    os.environ[EnvKey.JOURNAL_DIR] = journal_dir
+    return state_dir, journal_dir, port_file, log_path, env, prev_env
+
+
+def _restore_env(prev_env: dict) -> None:
+    for key, value in prev_env.items():
+        if value is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = value
+
+
+def _spawn_master(env: dict, log, procs: list, state_dir: str,
+                  port_file: str, *, min_nodes: int = 2,
+                  max_nodes: int = 2, prev_port: str = "") -> str:
+    from dlrover_tpu.chaos.scenario import REPO
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dlrover_tpu.master.job_master",
+         "--job-name", "pt", "--min-nodes", str(min_nodes),
+         "--max-nodes", str(max_nodes), "--rdzv-timeout", "60",
+         "--state-dir", state_dir, "--port-file", port_file],
+        env=env, cwd=REPO, stdout=log, stderr=log,
+    )
+    procs.append(proc)
+    return _await_port(proc, port_file, prev_port, "master")
+
+
+def _spawn_submaster(env: dict, log, procs: list, root_addr: str,
+                     rack_port_file: str, *, rack_id: str = "rackA",
+                     prev_port: str = "") -> str:
+    from dlrover_tpu.chaos.scenario import REPO
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dlrover_tpu.master.submaster",
+         "--rack-id", rack_id, "--master-addr", root_addr,
+         "--port-file", rack_port_file, "--flush-interval", "0.1"],
+        env=env, cwd=REPO, stdout=log, stderr=log,
+    )
+    procs.append(proc)
+    return _await_port(proc, rack_port_file, prev_port, "sub-master")
+
+
+def _await_port(proc, port_file: str, prev_port: str, what: str,
+                timeout: float = 30.0) -> str:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"{what} exited early rc={proc.returncode}"
+            )
+        try:
+            with open(port_file) as f:
+                text = f.read().strip()
+            if text and text != prev_port:
+                return text
+        except OSError:
+            pass
+        time.sleep(0.05)
+    raise TimeoutError(f"{what} never published its port")
+
+
+def _kill_all(procs: list) -> None:
+    for proc in procs:
+        try:
+            proc.send_signal(signal.SIGCONT)  # a stopped proc ignores 9
+        except (ProcessLookupError, OSError):
+            pass
+        try:
+            proc.kill()
+            proc.wait(timeout=5)
+        except (ProcessLookupError, subprocess.TimeoutExpired):
+            pass
+
+
+def _events(journal_dir: str, name: str) -> list[dict]:
+    from dlrover_tpu.chaos.scenario import _read_journal
+
+    return [e for e in _read_journal(journal_dir)
+            if e.get("name") == name]
+
+
+def _wait_event(journal_dir: str, name: str, pred=None,
+                timeout: float = 20.0) -> dict:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for e in _events(journal_dir, name):
+            if pred is None or pred(e):
+                return e
+        time.sleep(0.1)
+    raise TimeoutError(f"journal never showed a {name!r} event")
+
+
+# ------------------------------------------------- zombie sub-master
+
+
+@dataclasses.dataclass
+class ZombieScenarioResult:
+    rack_epochs: list[int]      # agent-observed: original, replacement
+    fenced: list[tuple]         # (rack, stale_epoch, current) journaled
+    rounds: tuple[int, int]     # (round through original, through repl)
+    restart_actions: int
+    trail: dict
+
+    def assert_invariants(self) -> None:
+        assert self.rack_epochs[1] > self.rack_epochs[0], (
+            f"replacement epoch not above the zombie's: "
+            f"{self.rack_epochs}"
+        )
+        assert len(self.fenced) >= 1, \
+            "the resumed zombie's push was never fenced"
+        for rack, stale, current in self.fenced:
+            assert stale == self.rack_epochs[0] \
+                and current == self.rack_epochs[1], (
+                    f"fence fired on unexpected epochs: "
+                    f"{(rack, stale, current)} vs {self.rack_epochs}"
+                )
+        assert self.restart_actions == 0, (
+            f"{self.restart_actions} restart actions reached agents "
+            "across a pure control-plane incident"
+        )
+        assert self.rounds == (1, 2), \
+            f"unexpected rendezvous rounds {self.rounds}"
+
+
+def zombie_trail(journal_dir: str) -> dict:
+    """Canonical, wall-clock-free trail for replay comparison."""
+    from dlrover_tpu.chaos.scenario import _read_journal
+
+    failovers, fenced, rounds, leases = [], [], [], []
+    for e in _read_journal(journal_dir):
+        name = e.get("name")
+        if name == "submaster_failover":
+            failovers.append((e.get("rack"), int(e.get("old_epoch", 0)),
+                              int(e.get("new_epoch", 0))))
+        elif name == "push_fenced":
+            fenced.append((e.get("rack"), int(e.get("epoch", 0)),
+                           int(e.get("current", 0))))
+        elif name == "rdzv_round" and e.get("ev") != "b":
+            rounds.append(int(e.get("round", 0)))
+        elif name == "lease_expired":
+            leases.append((e.get("tier"), e.get("rack")))
+    return {"failovers": failovers, "fenced": fenced,
+            "rounds": rounds, "leases": sorted(set(leases))}
+
+
+def run_zombie_submaster_scenario(work_dir: str, *, seed: int = 4242
+                                  ) -> ZombieScenarioResult:
+    """SIGSTOP a rack sub-master mid-life, register a replacement
+    (the root mints a higher rack epoch), complete a round through the
+    replacement, then SIGCONT the original. The zombie resumes with a
+    live socket and buffered state; its first keepalive push carries
+    its superseded epoch and must be rejected whole by the root's
+    push-direction fence — no agent acts on anything the zombie held,
+    and trainers never restart."""
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.common.rpc import RpcClient
+    from dlrover_tpu.telemetry.audit import assert_clean
+
+    # a generous lease keeps wall-clock lease expiry out of this
+    # trail: the drill is about EPOCH fencing, and the replayed trail
+    # must not depend on how long the SIGSTOP window happened to last
+    state_dir, journal_dir, port_file, log_path, env, prev_env = \
+        _setup(work_dir, seed, "zb",
+               extra_env={EnvKey.RACK_LEASE_S: "60"})
+    rack_port_file = os.path.join(work_dir, "rack.port")
+    sub_env = dict(env)
+    sub_env[EnvKey.MASTER_PORT_FILE] = port_file
+    log = open(log_path, "ab")
+    procs: list[subprocess.Popen] = []
+    agents: list[MasterClient] = []
+    actions: list[str] = []
+    try:
+        port = _spawn_master(env, log, procs, state_dir, port_file)
+        root_addr = f"127.0.0.1:{port}"
+        rack_port = _spawn_submaster(sub_env, log, procs, root_addr,
+                                     rack_port_file)
+
+        def make_rack_agent(nid: int) -> MasterClient:
+            rack_addr = f"127.0.0.1:{rack_port}"
+            agent = MasterClient(
+                rack_addr, nid,
+                transport=RpcClient(rack_addr, retries=2,
+                                    deadline_s=4.0,
+                                    backoff_base_s=0.05,
+                                    backoff_max_s=0.2),
+                port_file=rack_port_file,
+                fallback_port_file=port_file,
+            )
+            agents.append(agent)
+            return agent
+
+        def reconnect(agent: MasterClient, timeout: float = 20.0):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                agent.maybe_redial()
+                try:
+                    actions.append(agent.report_heartbeat(0))
+                    return
+                except (ConnectionError, TimeoutError, OSError):
+                    time.sleep(0.1)
+            raise TimeoutError("agent could not reconnect")
+
+        ra0, ra1 = make_rack_agent(0), make_rack_agent(1)
+        actions.append(ra0.report_heartbeat(0))
+        actions.append(ra1.report_heartbeat(0))
+        ra0.join_rendezvous("127.0.0.1:7770", 4)
+        ra1.join_rendezvous("127.0.0.1:7771", 4)
+        round1 = ra0.wait_comm_world(timeout=30).round
+        ra1.wait_comm_world(timeout=30)
+        epoch_a = ra0.master_epoch
+
+        # freeze — not kill — the sub-master: a zombie keeps its
+        # sockets, its registration, and everything it buffered
+        zombie = procs[-1]
+        zombie_port = rack_port
+        os.kill(zombie.pid, signal.SIGSTOP)
+        rack_port = _spawn_submaster(sub_env, log, procs, root_addr,
+                                     rack_port_file,
+                                     prev_port=rack_port)
+        reconnect(ra0)
+        reconnect(ra1)
+        # the replacement lost the zombie's join floors: re-join
+        # (idempotent at the root) and complete a round through it
+        ra0.join_rendezvous("127.0.0.1:7770", 4)
+        ra1.join_rendezvous("127.0.0.1:7771", 4)
+        rw0 = ra0.wait_comm_world(timeout=30)
+        rw1 = ra1.wait_comm_world(timeout=30)
+        assert rw0.round == rw1.round, \
+            "agents disagree on the post-replacement round"
+        epoch_b = ra0.master_epoch
+
+        # resume the zombie. Under lease-keepalive gating (§30) an idle
+        # zombie would sit out a third of its 60s lease before pushing
+        # anything; a straggler agent that never heard about the
+        # replacement gives it real traffic to flush, which carries its
+        # stale epoch straight into the root's fence. Heartbeats
+        # neither journal nor yield actions here, so the replayed
+        # trail is unchanged.
+        os.kill(zombie.pid, signal.SIGCONT)
+        zombie_addr = f"127.0.0.1:{zombie_port}"
+        straggler = MasterClient(
+            zombie_addr, 0,
+            transport=RpcClient(zombie_addr, retries=2,
+                                deadline_s=2.0,
+                                backoff_base_s=0.05,
+                                backoff_max_s=0.2),
+        )
+        agents.append(straggler)
+        deadline = time.monotonic() + 15.0
+        while True:
+            try:
+                straggler.report_heartbeat(0)
+                break
+            except (ConnectionError, TimeoutError, OSError):
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        "straggler could not reach the resumed zombie"
+                    )
+                time.sleep(0.1)
+        _wait_event(journal_dir, "push_fenced")
+        # the fenced zombie must step down, not retry: give it a few
+        # flush ticks and require the fence fired exactly once
+        time.sleep(1.0)
+        actions.append(ra0.report_heartbeat(0))
+        actions.append(ra1.report_heartbeat(0))
+    finally:
+        _kill_all(procs)
+        for agent in agents:
+            agent.close()
+        log.close()
+        _restore_env(prev_env)
+
+    fenced = [(e.get("rack"), int(e.get("epoch", 0)),
+               int(e.get("current", 0)))
+              for e in _events(journal_dir, "push_fenced")]
+    assert len(fenced) == 1, (
+        f"a superseded sub-master must push exactly once before "
+        f"stepping down, got {len(fenced)} fenced pushes"
+    )
+    assert_clean(journal_dir, context="zombie sub-master scenario")
+    return ZombieScenarioResult(
+        rack_epochs=[epoch_a, epoch_b],
+        fenced=fenced,
+        rounds=(round1, rw0.round),
+        restart_actions=sum(1 for a in actions if a == "restart"),
+        trail=zombie_trail(journal_dir),
+    )
+
+
+# ------------------------------------------- asymmetric agent<->root
+
+
+@dataclasses.dataclass
+class AsymSplitScenarioResult:
+    acked_steps: list[int]      # steps with a persist_ack journal line
+    ack_events: int             # total persist_ack lines (dedup proof)
+    transitions: list[tuple]    # (src, dst, state) in append order
+    trail: dict
+
+    def assert_invariants(self) -> None:
+        assert self.acked_steps == [1, 2, 3, 4, 5], (
+            f"not every report survived the split: {self.acked_steps}"
+        )
+        assert self.ack_events == 5, (
+            f"rid dedup failed: {self.ack_events} persist_ack lines "
+            "for 5 distinct reports (the response-loss replay "
+            "double-applied)"
+        )
+        assert self.transitions == [
+            ("agent", "root", "open"), ("agent", "root", "heal"),
+            ("root", "agent", "open"), ("root", "agent", "heal"),
+        ], f"unexpected partition transitions: {self.transitions}"
+
+
+def run_asym_split_scenario(work_dir: str, *, seed: int = 4242
+                            ) -> AsymSplitScenarioResult:
+    """One-way splits on the agent<->root link, one direction at a
+    time. The request-drop window queues reports the root never saw;
+    the response-loss window queues a report the root DID apply.
+    Redelivery replays all of them with their original rids and the
+    root's dedup keeps the trail at exactly one ``persist_ack`` per
+    report — the §30 'redelivery through an asymmetric split is
+    idempotent' proof."""
+    from dlrover_tpu import chaos
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.chaos import partition
+    from dlrover_tpu.common.rpc import RpcClient
+    from dlrover_tpu.telemetry.audit import assert_clean
+
+    state_dir, journal_dir, port_file, log_path, env, prev_env = \
+        _setup(work_dir, seed, "as")
+    log = open(log_path, "ab")
+    procs: list[subprocess.Popen] = []
+    agent = None
+    try:
+        port = _spawn_master(env, log, procs, state_dir, port_file,
+                             min_nodes=1, max_nodes=1)
+        addr = f"127.0.0.1:{port}"
+        agent = MasterClient(
+            addr, 0,
+            # retries=1 → exactly one link crossing per call, so the
+            # occurrence windows below land on known crossings and two
+            # seeded runs produce the identical transition trail
+            transport=RpcClient(addr, retries=1, deadline_s=4.0,
+                                backoff_base_s=0.05,
+                                backoff_max_s=0.2),
+        )
+        # crossing ledger (requests m1..m8, responses m1..m6):
+        #   ack1 req m1 pass, resp m1 pass
+        #   ack2 req m2 FIRE (open agent>root)  -> queued
+        #   ack3 req m3 FIRE                    -> queued
+        #   ack4 req m4 pass (heal), resp m2 pass
+        #   ack5 req m5 pass, resp m3 FIRE (open root>agent) -> queued
+        #        (the root APPLIED ack5 — its response was lost)
+        #   flush: ack2 m6/m4, ack3 m7/m5 (heal root>agent),
+        #          ack5 m8/m6 -> rid-deduped at the root
+        chaos.install({"seed": seed, "faults": [
+            {"point": "net_partition", "action": "drop",
+             "match": {"src": "agent", "dst": "root"},
+             "after": 1, "times": 2},
+            {"point": "net_partition", "action": "drop",
+             "match": {"src": "root", "dst": "agent"},
+             "after": 2, "times": 1},
+        ]})
+        for step in range(1, 6):
+            agent.report_persist_ack(step, 1, {"crc32": step,
+                                               "bytes": 8})
+        assert agent.redelivery_pending == 3, (
+            f"expected acks 2,3,5 queued, have "
+            f"{agent.redelivery_pending}"
+        )
+        replayed = agent.flush_redelivery()
+        assert replayed == 3, f"redelivery replayed {replayed} of 3"
+    finally:
+        chaos.uninstall()
+        partition.reset()
+        _kill_all(procs)
+        if agent is not None:
+            agent.close()
+        log.close()
+        _restore_env(prev_env)
+
+    # the master journals persist_ack once per UNIQUE rid: ack5 was
+    # applied twice on the wire but must land once in the trail
+    acks = [e for e in _events(journal_dir, "persist_ack")
+            if int(e.get("node", -1)) == 0]
+    transitions = [(e.get("src"), e.get("dst"), e.get("state"))
+                   for e in _events(journal_dir, "net_partition")]
+    assert_clean(journal_dir, context="asymmetric split scenario")
+    return AsymSplitScenarioResult(
+        acked_steps=sorted({int(e.get("step", -1)) for e in acks}),
+        ack_events=len(acks),
+        transitions=transitions,
+        trail={"transitions": transitions,
+               "acked": sorted({int(e.get("step", -1)) for e in acks}),
+               "ack_events": len(acks)},
+    )
+
+
+# ------------------------------------------------- rack-wide split
+
+
+@dataclasses.dataclass
+class RackSplitScenarioResult:
+    completed_round: int
+    rack_lease_expired: int     # lease_expired tier="rack" events
+    root_lease_expired: int     # lease_expired tier="root" events
+    redirected: bool            # agents finished via direct-to-root
+    readmitted: bool            # same incarnation pushed again post-heal
+    restart_actions: int
+    # wall seconds from the link opening to the rack's re-admission
+    # (the bench's partition-recovery headline; a measurement, not
+    # part of any replay-compared trail)
+    recovery_s: float = 0.0
+
+    def assert_invariants(self) -> None:
+        assert self.completed_round >= 1, \
+            "the round never completed through the fallback"
+        assert self.rack_lease_expired >= 1, \
+            "the sub-master never failed closed (no rack lease_expired)"
+        assert self.root_lease_expired >= 1, \
+            "the root never expired the partitioned rack"
+        assert self.redirected, \
+            "agents were never redirected to the direct-to-root fallback"
+        assert self.readmitted, (
+            "the healed sub-master was not re-admitted (lease loss "
+            "must not be epoch loss)"
+        )
+        assert self.restart_actions == 0, (
+            f"{self.restart_actions} restart actions during a pure "
+            "network incident"
+        )
+
+
+def run_rack_split_scenario(work_dir: str, *, seed: int = 4242
+                            ) -> RackSplitScenarioResult:
+    """Open the rack->root link mid-rendezvous with a 1-second rack
+    lease. The sub-master's merge ticks fail for ~3s, its lease lapses
+    and it fails closed — agents polling ``wait_comm_world`` get
+    ``redirect`` and complete the round against the root directly. On
+    heal, the root lazily expires the rack's lease at the sub-master's
+    first post-heal push, then accepts that same push (the epoch never
+    changed) and re-admits the rack."""
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.common.rpc import RpcClient
+    from dlrover_tpu.telemetry.audit import assert_clean
+
+    state_dir, journal_dir, port_file, log_path, env, prev_env = \
+        _setup(work_dir, seed, "rs",
+               extra_env={EnvKey.RACK_LEASE_S: "1.0"})
+    prev_lease = os.environ.get(EnvKey.RACK_LEASE_S)
+    os.environ[EnvKey.RACK_LEASE_S] = "1.0"
+    rack_port_file = os.path.join(work_dir, "rack.port")
+    sub_env = dict(env)
+    sub_env[EnvKey.MASTER_PORT_FILE] = port_file
+    # the partition lives in the SUB-MASTER's process, where every
+    # upstream crossing (the flush's explicit check AND the transport's
+    # request-direction check) matches src=rack,dst=root. after=3 lets
+    # the registration and the first merge tick through — the rack gets
+    # a real epoch and a lease before the link opens; times=80 then
+    # holds the link open for a few seconds of merge ticks against the
+    # 1-second lease.
+    sub_env[EnvKey.CHAOS] = json.dumps({"seed": seed, "faults": [
+        {"point": "net_partition", "action": "drop",
+         "match": {"src": "rack", "dst": "root"},
+         "after": 3, "times": 80},
+    ]})
+    log = open(log_path, "ab")
+    procs: list[subprocess.Popen] = []
+    agents: list[MasterClient] = []
+    actions: list[str] = []
+    redirected = False
+    try:
+        port = _spawn_master(env, log, procs, state_dir, port_file)
+        root_addr = f"127.0.0.1:{port}"
+        rack_port = _spawn_submaster(sub_env, log, procs, root_addr,
+                                     rack_port_file)
+
+        def make_rack_agent(nid: int) -> MasterClient:
+            rack_addr = f"127.0.0.1:{rack_port}"
+            agent = MasterClient(
+                rack_addr, nid,
+                transport=RpcClient(rack_addr, retries=2,
+                                    deadline_s=4.0,
+                                    backoff_base_s=0.05,
+                                    backoff_max_s=0.2),
+                port_file=rack_port_file,
+                fallback_port_file=port_file,
+            )
+            agents.append(agent)
+            return agent
+
+        ra0, ra1 = make_rack_agent(0), make_rack_agent(1)
+        actions.append(ra0.report_heartbeat(0))
+        actions.append(ra1.report_heartbeat(0))
+
+        def join_and_wait(agent: MasterClient, comm_addr: str,
+                          timeout: float = 40.0):
+            """The agent loop under a failing rack: join once, honor
+            the fail-closed redirect (re-joining through the root —
+            the lapsed rack dropped its buffered joins), and poll the
+            world wherever the client currently points. The join is
+            NOT refreshed on every poll: §26 reads a re-join after
+            completion as a node restart and would invalidate the
+            very round this agent is waiting to read."""
+            nonlocal redirected
+            deadline = time.monotonic() + timeout
+            joined = False
+            while time.monotonic() < deadline:
+                try:
+                    if not joined:
+                        agent.join_rendezvous(comm_addr, 4)
+                        joined = True
+                    resp = agent.get_comm_world()
+                except (ConnectionError, TimeoutError, OSError):
+                    agent.maybe_redial()
+                    joined = False
+                    time.sleep(0.2)
+                    continue
+                if resp.completed:
+                    return resp
+                if getattr(resp, "redirect", False):
+                    redirected = True
+                    agent.maybe_redial(prefer_fallback=True)
+                    joined = False
+                time.sleep(0.2)
+            raise TimeoutError("round never completed through the "
+                               "fallback")
+
+        results: dict[int, object] = {}
+
+        def drive(agent, nid, comm_addr):
+            results[nid] = join_and_wait(agent, comm_addr)
+
+        threads = [
+            threading.Thread(target=drive,
+                             args=(ra0, 0, "127.0.0.1:7770")),
+            threading.Thread(target=drive,
+                             args=(ra1, 1, "127.0.0.1:7771")),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+        assert results.get(0) is not None and results.get(1) is not None
+        rw0, rw1 = results[0], results[1]
+        assert rw0.round == rw1.round, \
+            "agents disagree on the fallback-completed round"
+
+        # heal: the split closes after its 30 dropped ticks; the
+        # sub-master's next accepted push re-admits the rack at the
+        # root under its ORIGINAL epoch
+        heal_t = _wait_event(
+            journal_dir, "net_partition",
+            pred=lambda e: e.get("state") == "heal"
+            and e.get("src") == "rack",
+            timeout=30.0,
+        ).get("t", 0)
+        _wait_event(journal_dir, "lease_expired",
+                    pred=lambda e: e.get("tier") == "root",
+                    timeout=20.0)
+        deadline = time.monotonic() + 15.0
+        readmitted = False
+        readmit_t = 0.0
+        while time.monotonic() < deadline and not readmitted:
+            post_heal = [
+                e.get("t", 0)
+                for e in _events(journal_dir, "rack_merge")
+                if e.get("ev") == "e" and e.get("t", 0) > heal_t
+            ]
+            if post_heal:
+                readmitted = True
+                readmit_t = min(post_heal)
+            else:
+                time.sleep(0.2)
+        actions.append(ra0.report_heartbeat(0))
+        actions.append(ra1.report_heartbeat(0))
+    finally:
+        _kill_all(procs)
+        for agent in agents:
+            agent.close()
+        log.close()
+        _restore_env(prev_env)
+        if prev_lease is None:
+            os.environ.pop(EnvKey.RACK_LEASE_S, None)
+        else:
+            os.environ[EnvKey.RACK_LEASE_S] = prev_lease
+
+    rack_exp = [e for e in _events(journal_dir, "lease_expired")
+                if e.get("tier") == "rack"]
+    root_exp = [e for e in _events(journal_dir, "lease_expired")
+                if e.get("tier") == "root"]
+    opens = [e.get("t", 0)
+             for e in _events(journal_dir, "net_partition")
+             if e.get("state") == "open" and e.get("src") == "rack"]
+    recovery_s = (readmit_t - min(opens)
+                  if readmitted and opens else 0.0)
+    assert_clean(journal_dir, context="rack split scenario")
+    return RackSplitScenarioResult(
+        completed_round=rw0.round,
+        rack_lease_expired=len(rack_exp),
+        root_lease_expired=len(root_exp),
+        redirected=redirected,
+        readmitted=readmitted,
+        restart_actions=sum(1 for a in actions if a == "restart"),
+        recovery_s=recovery_s,
+    )

@@ -297,6 +297,13 @@ def run_scenario(scenario: Scenario, work_dir: str, *,
                 # same journal and collide in the assembler's id map
                 EnvKey.TRACE_SEED:
                     f"{scenario.name}:{leg.name}:{scenario.seed}",
+                # each leg is its own JOB: pin a deterministic per-leg
+                # trace id so the auditor's per-job invariant scoping
+                # sees leg B's round 1 as a fresh job, not a reissue —
+                # and so a trace id leaked into the harness process's
+                # environ can never glue the legs together
+                EnvKey.TRACE_ID:
+                    f"{scenario.name}:{leg.name}:{scenario.seed}",
                 "PYTHONPATH": (env.get("PYTHONPATH", "")
                                + os.pathsep + REPO),
             })
@@ -373,6 +380,11 @@ def run_scenario(scenario: Scenario, work_dir: str, *,
             goodput = compute_goodput(goodput_log).goodput
         except Exception:  # noqa: BLE001 - diagnostics only
             logger.exception("goodput aggregation failed")
+    # trail-invariant audit (§30): every chaos scenario ends by proving
+    # the merged journals violate none of the safety invariants
+    from dlrover_tpu.telemetry.audit import assert_clean
+
+    assert_clean(journal_dir, context=f"scenario {scenario.name}")
     return ScenarioResult(
         scenario=scenario,
         legs=legs,
@@ -569,6 +581,9 @@ def run_sharded_scenario(work_dir: str, *, seed: int = 4242,
         else:
             os.environ[EnvKey.JOURNAL_DIR] = prev_journal
     expected = state_at(4)
+    from dlrover_tpu.telemetry.audit import assert_clean
+
+    assert_clean(journal_dir, context="sharded scenario")
     return ShardedScenarioResult(
         restored_step=restored_step,
         bad_writers=bad,
@@ -755,6 +770,9 @@ def run_embedding_scenario(work_dir: str, *, seed: int = 4242,
             os.environ.pop(EnvKey.JOURNAL_DIR, None)
         else:
             os.environ[EnvKey.JOURNAL_DIR] = prev_journal
+    from dlrover_tpu.telemetry.audit import assert_clean
+
+    assert_clean(journal_dir, context="embedding scenario")
     return EmbeddingScenarioResult(
         moved=moved,
         total_rows=total,
@@ -1273,6 +1291,9 @@ def run_master_kill_scenario(work_dir: str, *, seed: int = 4242
         1 for e in _read_journal(journal_dir)
         if e.get("name") == "autopilot_retune"
     )
+    from dlrover_tpu.telemetry.audit import assert_clean
+
+    assert_clean(journal_dir, context="master-kill scenario")
     return MasterKillScenarioResult(
         epochs=epochs,
         round_after_restart=round_after_restart,

@@ -76,7 +76,8 @@ def commit_marker(num_shards: int) -> str:
 
 
 def write_commit(storage, sdir: str, step: int, num_shards: int,
-                 shards: dict, extra: dict | None = None) -> None:
+                 shards: dict, extra: dict | None = None,
+                 group: str = "") -> None:
     """Terminal COMMIT: ``shards`` maps node id (str) -> {"crc32",
     "bytes", "pieces": {key: {"crc32", "path", "index", "replica"}}}
     as collected from the persist acks (or done markers). The piece
@@ -87,7 +88,10 @@ def write_commit(storage, sdir: str, step: int, num_shards: int,
     members, table geometry, applied version — so ``import_`` can
     reassemble any saved ring size onto the current one; verification
     ignores unknown fields). Atomic via the storage's tmp+fsync+rename
-    write."""
+    write. ``group`` names the ack ledger this commit drew from (the
+    embedding fabric passes "embedding"), so the §30 trail auditor can
+    cross-check every committed step against its ``persist_ack``
+    trail."""
     manifest = {"step": step, "num_shards": num_shards,
                 "shards": shards}
     for key, value in (extra or {}).items():
@@ -96,6 +100,9 @@ def write_commit(storage, sdir: str, step: int, num_shards: int,
         json.dumps(manifest),
         os.path.join(sdir, commit_marker(num_shards)),
     )
+    get_journal().emit("ckpt_commit", step=int(step),
+                       num_shards=int(num_shards),
+                       shards=len(shards), group=group)
 
 
 def _shard_crc(storage, path: str) -> tuple[int, int]:

@@ -249,6 +249,14 @@ class EnvKey:
     RACK_FLUSH_S = "DLROVER_TPU_RACK_FLUSH_S"
     RACK_WORLD_CHUNK = "DLROVER_TPU_RACK_WORLD_CHUNK"
     RACK_MERGE_MAX = "DLROVER_TPU_RACK_MERGE_MAX"
+    # partition tolerance (DESIGN.md §30): the rack lease the merge
+    # tick refreshes (expiry fails the sub-master closed and lets the
+    # root expire the rack), the jittered re-probe cadence of a
+    # fallback-pinned agent's rack target, and the degraded-mode bound
+    # after which mirrored config is too stale to act on
+    RACK_LEASE_S = "DLROVER_TPU_RACK_LEASE_S"
+    RACK_RETRY_S = "DLROVER_TPU_RACK_RETRY_S"
+    LINK_STALE_S = "DLROVER_TPU_LINK_STALE_S"
     # serving memory observatory (DESIGN.md §29): the measure-only
     # off-switch, the kv_pool sample cadence (decode steps), and the
     # n-gram order of the draft-acceptance shadow predictor

@@ -279,6 +279,22 @@ SPECS: tuple[EnvVar, ...] = (
            "max metrics snapshots per merged upstream push; a burst "
            "drains as several bounded pushes in one flush tick so the "
            "root's per-RPC handler time stays flat", "§28"),
+    # ------------------------------------------------ partition tolerance
+    EnvVar("DLROVER_TPU_RACK_LEASE_S", "10",
+           "rack sub-master lease: every accepted merge tick renews "
+           "it; a sub-master past its lease fails closed (serves no "
+           "comm world, redirects agents to the root) and the root "
+           "expires the rack from its registered census", "§30"),
+    EnvVar("DLROVER_TPU_RACK_RETRY_S", "5",
+           "seconds (jittered ±20%) between an agent's re-probes of "
+           "its rack port file while pinned to the direct-to-root "
+           "fallback; between probes the re-dial sticks to the last "
+           "working target instead of flapping", "§30"),
+    EnvVar("DLROVER_TPU_LINK_STALE_S", "60",
+           "degraded-mode staleness bound: after this long without "
+           "master contact a MasterLink reports stale and consumers "
+           "(gateway scale mirror, agent config mirror) stop acting "
+           "on mirrored config until the link recovers", "§30"),
     # ------------------------------------------- serving memory observatory
     EnvVar("DLROVER_TPU_SERVING_OBSERVATORY", "1",
            "measure-only serving observatory (KV page pressure, "

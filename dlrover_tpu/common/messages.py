@@ -82,6 +82,11 @@ class CommWorldResponse:
     # span context (§27) of the master's rendezvous round — agents link
     # their rendezvous_wait span to the round that admitted them
     sctx: str = ""
+    # a sub-master whose rack lease expired (or that was superseded by
+    # a replacement) fails closed and answers ``completed=False,
+    # redirect=True``: the agent must stop polling this mirror and
+    # re-dial its direct-to-root fallback (DESIGN.md §30)
+    redirect: bool = False
 
 
 @register_message
@@ -935,6 +940,12 @@ class RackMergedReport:
     heartbeats: list = dataclasses.field(default_factory=list)
     snapshots: list = dataclasses.field(default_factory=list)
     acks: list = dataclasses.field(default_factory=list)
+    # push-direction epoch fence (§30): the pushing sub-master's minted
+    # incarnation epoch. The root rejects a report bearing an epoch
+    # below the rack's registered one — a zombie resuming after its
+    # replacement registered must bounce, not merge. 0 = legacy report
+    # (pre-fence wire compat); those are accepted unfenced.
+    epoch: int = 0
 
 
 @register_message
@@ -944,3 +955,7 @@ class RackMergedResponse:
     # relayed to the agent on its next heartbeat at the sub-master
     actions: dict = dataclasses.field(default_factory=dict)
     master_epoch: int = 0
+    # True when the push was rejected by the push-direction epoch fence
+    # (§30): the sender is a superseded incarnation and must step down
+    # (fail closed, stop re-pushing) instead of retrying
+    fenced: bool = False

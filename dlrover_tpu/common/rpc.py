@@ -22,6 +22,7 @@ from collections import OrderedDict
 from typing import Any, Callable, Optional
 
 from dlrover_tpu import chaos
+from dlrover_tpu.chaos import partition as net_partition
 from dlrover_tpu.common import serde
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.telemetry.journal import adopt_remote_ctx, current_ctx
@@ -40,6 +41,19 @@ _deadline_total = registry().counter(
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 256 * 1024 * 1024
+
+
+def backoff_jitter_s(base_s: float, max_s: float, attempt: int,
+                     rng=random) -> float:
+    """Full-jitter exponential backoff: uniform over [0, cap) where cap
+    doubles from ``base_s`` up to ``max_s``. Full jitter (not equal
+    jitter) on purpose: a 1k-agent herd re-dialing after a partition
+    heal all sits at the same attempt count, and equal jitter packs the
+    whole herd into the top half of the window — the fleetsim reconnect
+    burst measures the difference (DESIGN.md §30). Shared with the
+    simulator so the modeled herd uses the production formula."""
+    cap = min(max_s, base_s * (2 ** max(0, attempt - 1)))
+    return rng.uniform(0.0, cap)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -189,11 +203,12 @@ class RpcClient:
     """Persistent-connection client with reconnect + jittered-backoff retry.
 
     Retry policy: exponential backoff from ``backoff_base_s`` doubling
-    up to ``backoff_max_s``, with equal jitter (half the window fixed,
-    half uniform-random) so N agents reconnecting after a master
-    restart spread out instead of thundering in lockstep — the fixed
-    1s interval this replaced re-synchronized the whole fleet onto the
-    same retry ticks. ``deadline_s`` bounds one ``call`` end to end
+    up to ``backoff_max_s``, with FULL jitter (uniform over the whole
+    window, ``backoff_jitter_s``) so N agents reconnecting after a
+    master restart or partition heal spread out instead of thundering
+    in lockstep — equal jitter packed the herd into the top half of
+    each window and the fleetsim reconnect-burst p99 showed it
+    clustering (§30). ``deadline_s`` bounds one ``call`` end to end
     regardless of how many attempts fit; both abandonment paths are
     counted (``dlrover_tpu_rpc_retry_total`` /
     ``..._retry_deadline_exceeded_total``).
@@ -202,10 +217,16 @@ class RpcClient:
     def __init__(self, addr: str, timeout: float = 30.0, retries: int = 8,
                  retry_interval: float | None = None,
                  backoff_base_s: float = 0.1, backoff_max_s: float = 3.0,
-                 deadline_s: float = 60.0):
+                 deadline_s: float = 60.0,
+                 link: tuple[str, str] | None = None):
         host, _, port = addr.rpartition(":")
         self._host = host or "127.0.0.1"
         self._port = int(port)
+        # which control-plane edge this client crosses, for the
+        # net_partition chaos domain (§30): (caller tier, callee tier).
+        # Owners that know better (sub-master upstream, rack-attached
+        # agents, the gateway) override the default.
+        self.link = tuple(link) if link else ("agent", "root")
         self._timeout = timeout
         self._retries = max(1, retries)
         if retry_interval is not None:
@@ -234,6 +255,7 @@ class RpcClient:
             backoff_base_s=self._backoff_base_s,
             backoff_max_s=self._backoff_max_s,
             deadline_s=self._deadline_s,
+            link=self.link,
         )
 
     def _connect(self) -> socket.socket:
@@ -272,6 +294,16 @@ class RpcClient:
         while True:
             try:
                 if chaos.ENABLED:
+                    # request direction of the link: an open partition
+                    # drops the request before it is sent
+                    if net_partition.check(
+                        self.link[0], self.link[1],
+                        msg=type(msg).__name__, addr=self.addr,
+                    ) is not None:
+                        raise ConnectionError(
+                            f"chaos: net partition open "
+                            f"({self.link[0]}->{self.link[1]})"
+                        )
                     fault = chaos.fire(
                         "rpc_call", msg=type(msg).__name__,
                         addr=self.addr, attempt=attempt,
@@ -282,6 +314,20 @@ class RpcClient:
                     sock = self._connect()
                     send_frame(sock, payload)
                     raw = recv_frame(sock)
+                if chaos.ENABLED:
+                    # response direction: an asymmetric split can lose
+                    # the ACK of a request the server DID apply — the
+                    # redelivery + rid-dedup machinery must absorb the
+                    # replay (DESIGN.md §30)
+                    if net_partition.check(
+                        self.link[1], self.link[0],
+                        msg=type(msg).__name__, addr=self.addr,
+                    ) is not None:
+                        raise ConnectionError(
+                            f"chaos: net partition open "
+                            f"({self.link[1]}->{self.link[0]}, "
+                            f"response lost)"
+                        )
                 obj = json.loads(raw.decode("utf-8"))
                 epoch = obj.pop("me", None)
                 resp = serde.decode_obj(obj)
@@ -310,9 +356,9 @@ class RpcClient:
                         f"tries: {last_err}"
                     ) from e
                 _retry_total.inc()
-                cap = min(self._backoff_max_s,
-                          self._backoff_base_s * (2 ** (attempt - 1)))
-                sleep_s = cap / 2 + random.uniform(0.0, cap / 2)
+                sleep_s = backoff_jitter_s(
+                    self._backoff_base_s, self._backoff_max_s, attempt
+                )
                 time.sleep(max(0.0, min(sleep_s, deadline - now)))
 
     def _apply_rpc_fault(self, fault: chaos.Fault) -> None:

@@ -868,6 +868,7 @@ class FabricCoordinator(ArrayMsgServer):
             sdir = os.path.join(ckpt_dir, f"step-{step}")
             integrity.write_commit(
                 self.storage, sdir, step, W, shards,
+                group="embedding",
                 extra={
                     "kind": "embedding", "dim": self.dim,
                     "num_slots": self.num_slots,

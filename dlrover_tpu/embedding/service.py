@@ -119,6 +119,15 @@ def _apply_msg_fault(fault, sock: socket.socket) -> None:
 def _call(sock: socket.socket, op: str, meta: dict | None = None,
           arrays: dict | None = None) -> tuple[dict, dict]:
     if chaos.ENABLED:
+        # the trainer->shard partition site (§30): like the rack tier,
+        # the embedding framing bypasses RpcClient, so the link-level
+        # net_partition rules need their own hook here
+        from dlrover_tpu.chaos import partition as net_partition
+
+        if net_partition.check("trainer", "shard", op=op) is not None:
+            raise ConnectionError(
+                "chaos: net partition open (trainer->shard)"
+            )
         fault = chaos.fire("embedding_msg", op=op)
         if fault is not None:
             _apply_msg_fault(fault, sock)

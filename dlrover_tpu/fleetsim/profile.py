@@ -80,6 +80,16 @@ class FleetProfile:
     # point. Sub-masters flush on the virtual clock at rack_flush_s.
     racks: int = 0
     rack_flush_s: float = 0.5
+    # sustained netsplit waves (DESIGN.md §30): a seeded fraction of
+    # the fleet loses its master link for partition_s virtual seconds.
+    # Cut agents' one-way reports queue through the REAL MasterClient
+    # redelivery path; on heal each cut agent reconnects after a
+    # production-jittered delay (common/rpc.backoff_jitter_s — the
+    # same full-jitter window the TCP client uses), so the measured
+    # reconnect burst shape is the one a real fleet would produce.
+    partitions: int = 0
+    partition_s: float = 4.0
+    partition_frac: float = 0.25
 
     def __post_init__(self) -> None:
         if self.nodes < 1:
@@ -90,6 +100,8 @@ class FleetProfile:
             raise ValueError("trainer_frac must be in [0, 1]")
         if self.racks < 0 or self.racks > self.nodes:
             raise ValueError("racks must be in [0, nodes]")
+        if not 0.0 <= self.partition_frac <= 1.0:
+            raise ValueError("partition_frac must be in [0, 1]")
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), sort_keys=True)

@@ -40,6 +40,7 @@ from typing import Any
 
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.common import serde
+from dlrover_tpu.common.rpc import backoff_jitter_s
 from dlrover_tpu.common.constants import EnvKey, NodeEventType, NodeStatus
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.fleetsim.profile import FleetProfile
@@ -130,6 +131,35 @@ class _LoopbackTransport:
         pass
 
 
+class _PartitionGate:
+    """Per-agent netsplit valve in front of a shared transport (§30).
+
+    Membership tests against the simulator's live cut-set (shared
+    object, mutated in place) so opening/healing a wave is O(wave),
+    not O(fleet). A cut agent's calls raise ``ConnectionError`` —
+    the same failure the TCP client sees — so ``MasterClient``'s real
+    queue-and-redeliver machinery runs, not a simulation of it.
+    """
+
+    __slots__ = ("_inner", "_node", "_cut")
+
+    def __init__(self, inner, node: int, cut: set):
+        self._inner = inner
+        self._node = node
+        self._cut = cut
+
+    def call(self, msg: Any) -> Any:
+        if self._node in self._cut:
+            raise ConnectionError(
+                f"fleetsim: node {self._node} partitioned from master"
+            )
+        inner = self._inner  # bare-name .call: the conformance lint's
+        return inner.call(msg)  # one legal transport-delegation shape
+
+    def close(self) -> None:
+        pass
+
+
 class _RackTransport:
     """Agent -> sub-master hop: direct in-process dispatch, unmeasured.
 
@@ -148,6 +178,23 @@ class _RackTransport:
 
     def close(self) -> None:
         pass
+
+
+def _reconnect_burst_p99(delays: list[float],
+                         bin_s: float = 0.05) -> int:
+    """p99 reconnect burst size: attempts landing in the same ``bin_s``
+    virtual window after a heal. The §30 jitter audit's clustering
+    detector — full jitter spreads reconnects over the whole backoff
+    window, while the old equal-jitter formula emptied the window's
+    lower half and doubled the per-bin density the master absorbs."""
+    if not delays:
+        return 0
+    bins: dict[int, int] = {}
+    for d in delays:
+        b = int(d / bin_s)
+        bins[b] = bins.get(b, 0) + 1
+    counts = sorted(bins.values())
+    return counts[min(len(counts) - 1, int(0.99 * len(counts)))]
 
 
 def _counter_total(metric) -> float:
@@ -195,6 +242,12 @@ class SimResult:
     # would have cost — the sublinearity evidence the bench pins
     world_diff_bytes: int = 0
     world_full_bytes: int = 0
+    # §30 netsplit-wave measurements (virtual seconds): worst-case
+    # time from a heal until every cut agent's reconnect heartbeat
+    # landed, and the p99 reconnect burst size (attempts per 50ms bin)
+    # under the production retry jitter; None/0 without partitions
+    partition_recovery_s: float | None = None
+    reconnect_burst_p99: int = 0
 
     # ------------------------------------------------------ derived views
 
@@ -258,6 +311,11 @@ class SimResult:
                 round(self.world_diff_bytes / self.world_full_bytes, 4)
                 if self.world_full_bytes else None
             ),
+            "partition_recovery_s": (
+                round(self.partition_recovery_s, 3)
+                if self.partition_recovery_s is not None else None
+            ),
+            "reconnect_burst_p99": self.reconnect_burst_p99,
         }
 
 
@@ -271,6 +329,7 @@ class FleetSimulator:
     )
     _MASTER_RESTART = "master_restart"
     _RACK_FLUSH = "rack_flush"
+    _PARTITION, _HEAL, _RECONNECT = "partition", "heal", "reconnect"
 
     def __init__(self, profile: FleetProfile):
         self.profile = profile
@@ -290,6 +349,15 @@ class FleetSimulator:
         self._subs: list = []
         self._rack_of: list[int] = []
         self._pre_restart_rack_epochs: list[int] = []
+        # §30 netsplit waves: live cut-set (shared with every agent's
+        # _PartitionGate — mutate in place, never rebind), plus the
+        # virtual reconnect-burst measurements
+        self._cut: set[int] = set()
+        self._partition_wave = 0
+        self._heal_t: float | None = None
+        self._await_reconnect: set[int] = set()
+        self._reconnect_delays: list[float] = []
+        self._partition_recovery: list[float] = []
 
     # ------------------------------------------------------------ engine
 
@@ -366,13 +434,19 @@ class FleetSimulator:
         stragglers = set(rng_pick.sample(range(p.nodes), k)) if k \
             else set()
         trainer_cut = int(p.nodes * p.trainer_frac)
+        def _agent_transport(i: int):
+            inner = (rack_transports[self._rack_of[i]] if p.racks
+                     else transport)
+            if p.partitions:
+                return _PartitionGate(inner, i, self._cut)
+            return inner
+
         self._agents = [
             _SimAgent(
                 i,
                 MasterClient(
                     "fleetsim", i,
-                    transport=(rack_transports[self._rack_of[i]]
-                               if p.racks else transport),
+                    transport=_agent_transport(i),
                     snapshot_full_every=p.snapshot_full_every,
                 ),
                 is_trainer=i < trainer_cut,
@@ -423,6 +497,13 @@ class FleetSimulator:
                 + p.duration_s * (r + 0.62) / (p.master_restarts + 1),
                 self._MASTER_RESTART, -1,
             )
+        for w in range(p.partitions):
+            # 0.38 offset: off both the wave grid and the restart grid
+            self._schedule(
+                p.join_window_s
+                + p.duration_s * (w + 0.38) / (p.partitions + 1),
+                self._PARTITION, -1,
+            )
 
         try:
             self._run_loop(horizon, rng_jitter, rng_pick)
@@ -470,6 +551,12 @@ class FleetSimulator:
             reregistered_curve=list(self._rereg_curve),
             world_diff_bytes=int(_counter_total(wd_metric) - wd_base),
             world_full_bytes=int(_counter_total(wf_metric) - wf_base),
+            partition_recovery_s=(
+                max(self._partition_recovery)
+                if self._partition_recovery else None
+            ),
+            reconnect_burst_p99=_reconnect_burst_p99(
+                self._reconnect_delays),
         )
         logger.info(
             "fleetsim %s: %d nodes, %d rounds, %d rpc types, "
@@ -501,10 +588,14 @@ class FleetSimulator:
             elif kind == self._HEARTBEAT:
                 agent = self._agents[node]
                 if agent.alive:
-                    agent.client.report_heartbeat(0)
-                    if self._restart_t is not None \
-                            and self._recovery_s is None:
-                        self._track_recovery(t, agent)
+                    try:
+                        agent.client.report_heartbeat(0)
+                    except ConnectionError:
+                        pass  # cut by a netsplit wave: next beat retries
+                    else:
+                        if self._restart_t is not None \
+                                and self._recovery_s is None:
+                            self._track_recovery(t, agent)
                     self._schedule(t + p.heartbeat_interval_s,
                                    self._HEARTBEAT, node)
             elif kind == self._SNAPSHOT:
@@ -517,6 +608,12 @@ class FleetSimulator:
                 self._subs[node].flush()
                 self._schedule(t + p.rack_flush_s, self._RACK_FLUSH,
                                node)
+            elif kind == self._PARTITION:
+                self._on_partition(t, rng_pick)
+            elif kind == self._HEAL:
+                self._on_heal(t)
+            elif kind == self._RECONNECT:
+                self._on_reconnect(t, node)
             elif kind in (self._FAIL, self._DEATH):
                 self._on_wave(t, kind, rng_jitter, rng_pick)
 
@@ -526,7 +623,12 @@ class FleetSimulator:
         agent = self._agents[node]
         if not agent.alive:
             return
-        resp = agent.client.get_comm_world()
+        try:
+            resp = agent.client.get_comm_world()
+        except ConnectionError:
+            self._schedule(t + self.profile.poll_interval_s,
+                           self._POLL, node)
+            return
         if resp.completed and resp.round > agent.last_round:
             first_world = agent.last_round == 0
             agent.last_round = resp.round
@@ -598,14 +700,17 @@ class FleetSimulator:
         agent = self._agents[node]
         if not agent.alive:
             return
-        agent.client.report_metrics(self._agent_families(agent))
-        if agent.is_trainer:
-            agent.client.report_metrics(
-                self._trainer_families(agent), role="trainer"
-            )
-        agent.push_idx += 1
-        if node == 0:
-            agent.client.report_step(agent.trainer_cum_count)
+        try:
+            agent.client.report_metrics(self._agent_families(agent))
+            if agent.is_trainer:
+                agent.client.report_metrics(
+                    self._trainer_families(agent), role="trainer"
+                )
+            agent.push_idx += 1
+            if node == 0:
+                agent.client.report_step(agent.trainer_cum_count)
+        except ConnectionError:
+            pass  # cut by a netsplit wave: next push retries
         self._schedule(t + self.profile.snapshot_interval_s,
                        self._SNAPSHOT, node)
 
@@ -627,8 +732,15 @@ class FleetSimulator:
             # drain buffered acks upstream before the ledger poll: the
             # §20 commit wait in rack mode spans at most one merge tick
             sub.flush()
-        status = alive[0].client.persist_status(step, len(alive))
-        self._trail("ckpt_storm", step, int(status.acked))
+        # the ledger poll needs a reachable host: lowest-id alive agent
+        # outside the current cut (cut agents' acks queued above and
+        # replay at their reconnect heartbeat)
+        pollers = [a for a in alive if a.node_id not in self._cut]
+        if pollers:
+            status = pollers[0].client.persist_status(step, len(alive))
+            self._trail("ckpt_storm", step, int(status.acked))
+        else:
+            self._trail("ckpt_storm", step, -1)
         self._schedule(t + self.profile.ckpt_interval_s, self._STORM,
                        -1)
 
@@ -693,6 +805,63 @@ class FleetSimulator:
         if len(self._reregistered) >= alive:
             self._recovery_s = dt
             self._trail("master_recovered", len(self._reregistered))
+
+    def _on_partition(self, t: float,
+                      rng_pick: random.Random) -> None:
+        """Open a netsplit wave (§30): a seeded fraction of the alive
+        fleet loses its master link. Their heartbeats and snapshot
+        pushes fail, their persist acks queue in the real client
+        redelivery buffer, and nothing restarts — a partition is a
+        delay, not a failure."""
+        p = self.profile
+        wave = self._partition_wave
+        self._partition_wave += 1
+        alive = [a.node_id for a in self._agents if a.alive]
+        k = min(len(alive), max(1, round(len(alive)
+                                         * p.partition_frac)))
+        cut = sorted(rng_pick.sample(alive, k))
+        self._cut.clear()
+        self._cut.update(cut)
+        self._trail("partition", wave, len(cut))
+        self._schedule(t + p.partition_s, self._HEAL, wave)
+
+    def _on_heal(self, t: float) -> None:
+        """Heal the wave and fan the cut agents' reconnects out with
+        the PRODUCTION retry jitter (common/rpc.backoff_jitter_s, full
+        jitter): the burst shape the master absorbs here is exactly
+        what the TCP client fleet would produce, which is what the
+        reconnect-burst p99 measurement audits."""
+        p = self.profile
+        cut = sorted(self._cut)
+        self._cut.clear()
+        self._heal_t = t
+        self._await_reconnect = set(cut)
+        self._trail("heal", len(cut))
+        for node in cut:
+            rng = random.Random(
+                f"{p.seed}:reconnect:{self._partition_wave}:{node}"
+            )
+            delay = backoff_jitter_s(0.5, 8.0, 1, rng=rng)
+            self._reconnect_delays.append(delay)
+            self._schedule(t + delay, self._RECONNECT, node)
+
+    def _on_reconnect(self, t: float, node: int) -> None:
+        agent = self._agents[node]
+        if not agent.alive:
+            self._await_reconnect.discard(node)
+            return
+        try:
+            # the real client flushes its redelivery queue inside a
+            # successful heartbeat: queued storm acks land here
+            agent.client.report_heartbeat(0)
+        except ConnectionError:
+            return  # still inside a newer wave; its heal will retry
+        self._await_reconnect.discard(node)
+        if not self._await_reconnect and self._heal_t is not None:
+            dt = t - self._heal_t
+            self._partition_recovery.append(round(dt, 3))
+            self._trail("partition_recovered", round(dt, 3))
+            self._heal_t = None
 
     def _on_wave(self, t: float, kind: str, rng_jitter: random.Random,
                  rng_pick: random.Random) -> None:

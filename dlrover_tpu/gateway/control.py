@@ -89,6 +89,12 @@ class MasterLink(_DegradedLink):
             raw = self._client.kv_get(self._kv_key)
         except (ConnectionError, RuntimeError, OSError) as e:
             self.failed(e)
+            if self.stale():
+                # mirrored scale target is past the staleness bound
+                # (§30): forget it, so a post-recovery target is always
+                # re-read from the master and re-applied fresh rather
+                # than deduplicated against pre-outage state
+                self._last_target = None
             return
         self.ok()
         if not raw:

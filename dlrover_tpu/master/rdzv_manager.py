@@ -19,6 +19,7 @@ import time
 
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.master.saturation import TimedLock
+from dlrover_tpu.telemetry.audit import world_compact, world_hash
 from dlrover_tpu.telemetry.journal import (
     current_trace_id,
     format_ctx,
@@ -291,9 +292,13 @@ class RendezvousManager:
         _waiting_nodes.labels(self.name).set(len(self._waiting))
         # one completed-interval line (begin time is derivable from dur):
         # the job-level stall the lost-time report charges to rendezvous
+        # membership digest + (small-world) inline members: what the
+        # trail-invariant auditor proves uniqueness / rank-sanity over
+        # (telemetry/audit.py, DESIGN.md §30)
         round_span = get_journal().emit(
             "rdzv_round", dur=round_s, rdzv=self.name, round=self._round,
             nodes=len(world), fast=fast, reshard=reshard,
+            world=world_compact(world), world_hash=world_hash(world),
         )
         self._latest.sctx = format_ctx(current_trace_id(), round_span)
 

@@ -159,6 +159,14 @@ class MasterServicer:
         # worlds, the bases the per-rack comm-world diffs are cut from
         self._rack_lock = threading.Lock()
         self._submaster_epochs: dict[str, int] = {}
+        # rack leases (DESIGN.md §30): rack_id -> wall-clock deadline,
+        # renewed by registration and every ACCEPTED merged push.
+        # Absent = expired (or never registered): the rack is out of
+        # the registered census and its agents are expected on the
+        # direct-to-root fallback. Epochs above deliberately OUTLIVE
+        # the lease — fencing must keep working against a zombie long
+        # after its lease lapsed.
+        self._submaster_leases: dict[str, float] = {}
         self._world_history: dict[
             str, "OrderedDict[int, dict[int, int]]"
         ] = {}
@@ -215,8 +223,19 @@ class MasterServicer:
         )
         self._submaster_registered = registry().gauge(
             "dlrover_tpu_submaster_registered",
-            "rack sub-masters currently registered with this root "
-            "master (DESIGN.md §28)",
+            "rack sub-masters holding an unexpired lease with this "
+            "root master (DESIGN.md §28/§30)",
+        )
+        self._push_fenced_total = registry().counter(
+            "dlrover_tpu_partition_push_fenced_total",
+            "RackMergedReport pushes rejected by the push-direction "
+            "epoch fence: a superseded sub-master incarnation resumed "
+            "pushing (DESIGN.md §30)",
+        )
+        self._root_lease_expired_total = registry().counter(
+            "dlrover_tpu_partition_root_lease_expired_total",
+            "rack leases the root expired after "
+            "DLROVER_TPU_RACK_LEASE_S without an accepted merge tick",
         )
         self._world_diff_bytes = registry().counter(
             "dlrover_tpu_submaster_world_diff_bytes_total",
@@ -598,17 +617,62 @@ class MasterServicer:
 
     # ------------------------------------- rack sub-master tier (§28)
 
+    def _rack_lease_s(self) -> float:
+        return envspec.get_float(EnvKey.RACK_LEASE_S)
+
+    def _touch_rack_lease(self, rack_id: str) -> None:
+        """Renew the rack's lease (registration or an accepted merge
+        tick, §30). Caller must NOT hold ``_rack_lock``."""
+        with self._rack_lock:
+            self._submaster_leases[rack_id] = (
+                time.time() + self._rack_lease_s()
+            )
+            self._submaster_registered.set(len(self._submaster_leases))
+
+    def _expire_rack_leases(self) -> None:
+        """Lazily expire rack leases (called on every rack-tier RPC):
+        an expired rack leaves the registered census — the root keeps
+        accepting its agents' direct attaches, and keeps its epoch so
+        the push fence still bites if a zombie resumes."""
+        now = time.time()
+        expired: list[tuple[str, int]] = []
+        with self._rack_lock:
+            for rack, deadline in list(self._submaster_leases.items()):
+                if now >= deadline:
+                    self._submaster_leases.pop(rack, None)
+                    expired.append(
+                        (rack, self._submaster_epochs.get(rack, 0))
+                    )
+            if expired:
+                self._submaster_registered.set(
+                    len(self._submaster_leases)
+                )
+        for rack, epoch in expired:
+            self._root_lease_expired_total.inc()
+            get_journal().emit("lease_expired", tier="root",
+                               rack=rack, epoch=epoch)
+            logger.warning(
+                "rack %s lease expired at the root (epoch %d): rack "
+                "out of the registered census, its agents are "
+                "expected via the direct-to-root fallback",
+                rack, epoch,
+            )
+
     def _submaster_register(self, msg: m.SubMasterRegisterRequest
                             ) -> m.SubMasterRegisterResponse:
         """Mint this sub-master incarnation's epoch: monotonic per rack
         AND above the root's own epoch, so a degrade-to-root detour and
         the return to the rack both read as epoch increases to the
         agents behind it."""
+        self._expire_rack_leases()
         with self._rack_lock:
             prev = self._submaster_epochs.get(msg.rack_id, 0)
             epoch = max(prev, self.master_epoch) + 1
             self._submaster_epochs[msg.rack_id] = epoch
-            self._submaster_registered.set(len(self._submaster_epochs))
+            self._submaster_leases[msg.rack_id] = (
+                time.time() + self._rack_lease_s()
+            )
+            self._submaster_registered.set(len(self._submaster_leases))
         if prev:
             # a re-registration is a sub-master incarnation change
             # (crash/restart, or a root restart forcing re-registration)
@@ -819,6 +883,35 @@ class MasterServicer:
 
     def _rack_merged(self, msg: m.RackMergedReport
                      ) -> m.RackMergedResponse:
+        self._expire_rack_leases()
+        if msg.epoch:
+            # push-direction epoch fence (§30): the response-direction
+            # fence (§26, the "me" envelope stamp) cannot stop a
+            # zombie's buffered state from MERGING — this does. A
+            # report from a superseded incarnation is rejected whole
+            # (its heartbeats/snapshots/acks are the replacement's to
+            # re-report) and the sender is told to step down.
+            with self._rack_lock:
+                current = self._submaster_epochs.get(msg.rack_id, 0)
+            if current and int(msg.epoch) < current:
+                self._push_fenced_total.inc()
+                get_journal().emit(
+                    "push_fenced", rack=msg.rack_id,
+                    epoch=int(msg.epoch), current=current,
+                )
+                logger.warning(
+                    "fenced stale push from rack %s: epoch %d < "
+                    "registered %d (%d heartbeats, %d snapshots, %d "
+                    "acks dropped)", msg.rack_id, msg.epoch, current,
+                    len(msg.heartbeats), len(msg.snapshots),
+                    len(msg.acks),
+                )
+                return m.RackMergedResponse(
+                    actions={}, master_epoch=self.master_epoch,
+                    fenced=True,
+                )
+            # an accepted merge tick IS the lease renewal (§30)
+            self._touch_rack_lease(msg.rack_id)
         actions: dict = {}
         for hb in msg.heartbeats:
             nid = int(hb.get("node_id", 0))
@@ -848,12 +941,14 @@ class MasterServicer:
                                     master_epoch=self.master_epoch)
 
     def export_rack_state(self) -> dict:
-        """Per-rack sub-master epochs for the state snapshot: a root
-        restart must keep minting ABOVE every epoch it ever issued, or
-        a restarted sub-master could serve an epoch its agents already
-        saw (a broken fence, §26/§28)."""
+        """Per-rack sub-master epochs + lease deadlines for the state
+        snapshot: a root restart must keep minting ABOVE every epoch it
+        ever issued, or a restarted sub-master could serve an epoch its
+        agents already saw (a broken fence, §26/§28); leases persist so
+        a restart does not silently resurrect an expired rack (§30)."""
         with self._rack_lock:
-            return {"epochs": dict(self._submaster_epochs)}
+            return {"epochs": dict(self._submaster_epochs),
+                    "leases": dict(self._submaster_leases)}
 
     def restore_rack_state(self, state: dict) -> None:
         with self._rack_lock:
@@ -861,7 +956,12 @@ class MasterServicer:
                 self._submaster_epochs[str(rack)] = max(
                     self._submaster_epochs.get(str(rack), 0), int(epoch)
                 )
-            self._submaster_registered.set(len(self._submaster_epochs))
+            for rack, deadline in (state.get("leases") or {}).items():
+                self._submaster_leases[str(rack)] = max(
+                    self._submaster_leases.get(str(rack), 0.0),
+                    float(deadline),
+                )
+            self._submaster_registered.set(len(self._submaster_leases))
 
     # ----------------------------------------------- report ingestion
 

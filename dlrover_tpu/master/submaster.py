@@ -47,12 +47,14 @@ import argparse
 import threading
 import time
 
+from dlrover_tpu.chaos import partition as net_partition
 from dlrover_tpu.common import messages as m
 from dlrover_tpu.common.constants import EnvKey
 from dlrover_tpu.common import envspec
 from dlrover_tpu.common.log import get_logger
 from dlrover_tpu.common.rpc import RpcServer
 from dlrover_tpu.master.kv_store import CompileCacheService
+from dlrover_tpu.telemetry.audit import world_compact, world_hash
 from dlrover_tpu.telemetry.journal import get_journal
 from dlrover_tpu.telemetry.metrics import registry
 from dlrover_tpu.telemetry.snapshot_delta import merge_snapshot
@@ -116,7 +118,22 @@ class SubMaster:
             master_addr or "127.0.0.1:0", node_id=0,
             transport=upstream_transport,
             epoch_observer=self._observe_root_epoch,
+            link=("rack", "root"),
         )
+        # rack lease (§30): renewed by every accepted upstream merge
+        # tick; past the deadline this sub-master FAILS CLOSED — it
+        # stops serving its mirrored comm world (the root may already
+        # have re-formed the round without this rack) and redirects
+        # agents to the direct-to-root fallback instead
+        self.lease_s = float(
+            envspec.get_float(EnvKey.RACK_LEASE_S) or 10.0
+        )
+        self._lease_deadline = time.monotonic() + self.lease_s
+        self._lease_renewed_at = time.monotonic()
+        self._lease_lapsed = False
+        # set when the root fenced a push: a newer incarnation owns the
+        # rack, so this one must step down, not retry
+        self._superseded = False
         self._lock = threading.Lock()
         # node_id -> newest restart_count since the last flush
         self._heartbeats: dict[int, int] = {}
@@ -174,6 +191,13 @@ class SubMaster:
             "wall time of one flush tick's upstream conversation "
             "(register + join batches + world pulls + merged push)",
         )
+        self._lease_expired_total = registry().counter(
+            "dlrover_tpu_partition_rack_lease_expired_total",
+            "times this sub-master's root lease lapsed and it failed "
+            "closed (stopped serving its mirror, redirected agents to "
+            "the direct-to-root fallback) (DESIGN.md §30)",
+            label_names=("rack",),
+        )
 
     # ------------------------------------------------------- lifecycle
 
@@ -225,6 +249,56 @@ class SubMaster:
                 # own epoch bumps and the rack's agents fence through us
                 self._root_restarted = True
 
+    # ------------------------------------------------------ rack lease
+
+    def _renew_lease(self) -> None:
+        """An accepted upstream conversation proves the root still
+        recognises this incarnation: push the fail-closed deadline out
+        and re-arm the once-per-episode expiry journal."""
+        with self._lock:
+            self._lease_deadline = time.monotonic() + self.lease_s
+            self._lease_renewed_at = time.monotonic()
+            self._lease_lapsed = False
+
+    def _failing_closed(self) -> bool:
+        """True when this sub-master must not serve its mirror: it is
+        superseded (a newer incarnation owns the rack) or its lease
+        lapsed (the root may have expired the rack and re-formed the
+        round without it). On the first lapse of an episode the
+        buffered joins are dropped — the agents they belong to are
+        about to re-join through the root directly."""
+        if self._superseded:
+            return True
+        if time.monotonic() < self._lease_deadline:
+            return False
+        with self._lock:
+            first = not self._lease_lapsed
+            if first:
+                self._lease_lapsed = True
+                self._joins.clear()
+                self._join_round.clear()
+        if first:
+            self._lease_expired_total.labels(self.rack_id).inc()
+            get_journal().emit("lease_expired", tier="rack",
+                               rack=self.rack_id, epoch=self.epoch)
+            logger.warning(
+                "rack %s lease lapsed (%.1fs without an accepted "
+                "upstream tick): failing closed, redirecting agents "
+                "to the root", self.rack_id, self.lease_s,
+            )
+        return True
+
+    def _step_down(self) -> None:
+        """The root fenced our push: a newer incarnation was minted for
+        this rack while we were away. Everything buffered here is the
+        replacement's to re-report — serve nothing, push nothing,
+        never re-register under this identity."""
+        self._superseded = True
+        logger.warning(
+            "rack %s epoch %d superseded at the root; stepping down",
+            self.rack_id, self.epoch,
+        )
+
     def _ensure_registered(self) -> bool:
         with self._lock:
             registered = self.epoch > 0 and not self._root_restarted
@@ -240,6 +314,7 @@ class SubMaster:
             for mirror in self._mirrors.values():
                 mirror.round = 0
             self._want_world.update(self._mirrors)
+        self._renew_lease()
         self._epoch_gauge.labels(self.rack_id).set(self.epoch)
         logger.info("rack %s registered with root (epoch %d, root "
                     "epoch %d)", self.rack_id, self.epoch,
@@ -253,6 +328,12 @@ class SubMaster:
             with self._lock:
                 self._heartbeats[msg.node_id] = msg.restart_count
                 action = self._actions.pop(msg.node_id, "")
+            if action:
+                # the auditor (§30) cross-checks every action a rack
+                # tier delivered against the fence trail
+                get_journal().emit("rack_action", rack=self.rack_id,
+                                   epoch=self.epoch,
+                                   node=msg.node_id, action=action)
             return m.HeartbeatResponse(action=action,
                                        master_epoch=self.epoch)
         if isinstance(msg, m.MetricsSnapshotRequest):
@@ -327,6 +408,13 @@ class SubMaster:
         return m.JoinRendezvousResponse(round=rnd)
 
     def _serve_world(self, msg: m.CommWorldRequest) -> m.CommWorldResponse:
+        if self._failing_closed():
+            # fail closed (§30): a lapsed lease means the root may
+            # already have re-formed this round without us — serving
+            # the mirror could split the comm world. Redirect the
+            # agent to its direct-to-root fallback instead.
+            return m.CommWorldResponse(completed=False, redirect=True,
+                                       master_epoch=self.epoch)
         with self._lock:
             mirror = self._mirrors.get(msg.rdzv_name)
             floor = self._join_round.get((msg.rdzv_name, msg.node_id))
@@ -373,9 +461,21 @@ class SubMaster:
         refresh waiting counts. Transport failures leave every buffer
         intact (re-dials, then the next tick retries); returns True
         when the tick reached the root."""
+        if self._superseded:
+            return False
         start = time.monotonic()
         try:
-            with get_journal().span("rack_merge", rack=self.rack_id):
+            # the rack->root partition site (§30): an open link fails
+            # the whole tick through the ordinary transient path below,
+            # leaving every buffer intact — exactly like a real split
+            fault = net_partition.check("rack", "root",
+                                        rack=self.rack_id)
+            if fault is not None:
+                raise ConnectionError(
+                    "chaos: net partition open (rack->root)"
+                )
+            with get_journal().span("rack_merge", rack=self.rack_id,
+                                    epoch=self.epoch):
                 self._ensure_registered()
                 self._push_joins()
                 self._pull_worlds()
@@ -502,6 +602,16 @@ class SubMaster:
                 mirror.reshard = head.reshard
                 mirror.sctx = head.sctx
                 mirror.trace_id = head.trace_id
+                adopted = (head.round, dict(world))
+            # the auditor (§30) proves every world a rack tier served
+            # for a round hashes identically to the root's
+            get_journal().emit(
+                "comm_world", rack=self.rack_id, epoch=self.epoch,
+                rdzv=name, round=adopted[0],
+                world=world_compact(adopted[1]),
+                world_hash=world_hash(adopted[1]),
+            )
+            with self._lock:
                 # keep pulling only while a joiner still awaits a round
                 # newer than the mirror
                 if not any(
@@ -526,45 +636,57 @@ class SubMaster:
             self._heartbeats.clear()
             self._snapshots.clear()
             self._acks.clear()
-        if not (heartbeats or snapshots or acks):
-            return
         # bounded drain (§28 bounded-RPC rule): at most RACK_MERGE_MAX
         # snapshots ride any one push so the root's per-RPC handler
         # time stays flat when a rack's agents burst in lockstep;
-        # heartbeats and acks are small and ship with the first push
+        # heartbeats and acks are small and ship with the first push.
+        # An EMPTY push doubles as the lease keepalive (§30), but only
+        # once a third of the lease window has elapsed since the last
+        # accepted push — an idle rack renews ~3x per window instead of
+        # adding a root RPC every flush tick, which would erase the
+        # rack tier's fan-in win. Traffic-bearing pushes always go out
+        # immediately, so a resumed zombie with buffered agent traffic
+        # still announces itself into the push-direction fence.
         limit = max(1, self._merge_max)
-        while heartbeats or snapshots or acks:
+        with self._lock:
+            keepalive_due = (
+                time.monotonic()
+                >= self._lease_renewed_at + self.lease_s / 3.0
+            )
+        first = keepalive_due
+        while first or heartbeats or snapshots or acks:
+            first = False
             batch = snapshots[:limit]
             try:
                 resp = self._up.report_rack_merged(
-                    self.rack_id, heartbeats, batch, acks
+                    self.rack_id, heartbeats, batch, acks,
+                    epoch=self.epoch,
                 )
             except _TRANSIENT:
-                with self._lock:
-                    # re-buffer everything unsent behind anything that
-                    # arrived meanwhile: newest heartbeat wins,
-                    # snapshots re-fold, acks are rid-deduped by the
-                    # root so replay order is safe
-                    for hb in heartbeats:
-                        self._heartbeats.setdefault(hb["node_id"],
-                                                    hb["restart_count"])
-                    for snap in snapshots:
-                        key = (snap["node_id"], snap["role"])
-                        cur = self._snapshots.get(key)
-                        if cur is None:
-                            self._snapshots[key] = {
-                                "samples": snap["samples"],
-                                "is_delta": snap["is_delta"],
-                            }
-                        elif cur["is_delta"]:
-                            merged = merge_snapshot(snap["samples"],
-                                                    cur["samples"])
-                            self._snapshots[key] = {
-                                "samples": merged,
-                                "is_delta": snap["is_delta"],
-                            }
-                    self._acks[:0] = acks
+                self._rebuffer(heartbeats, snapshots, acks)
                 raise
+            if getattr(resp, "fenced", False):
+                self._observe_root_epoch(int(resp.master_epoch))
+                with self._lock:
+                    root_restarted = self._root_restarted
+                if root_restarted:
+                    # the fence tripped against a RESTARTED root's
+                    # restored epoch table, not a live replacement:
+                    # the epoch observation above armed the §28
+                    # reaction — the next tick re-registers, minting
+                    # a fresh epoch above the fence. This push is
+                    # still ours to deliver, so re-buffer it.
+                    self._rebuffer(heartbeats, snapshots, acks)
+                    logger.warning(
+                        "rack %s push fenced by a restarted root; "
+                        "re-registering next tick", self.rack_id,
+                    )
+                    return
+                # a newer incarnation owns the rack: what we just tried
+                # to push is its to re-report — do NOT re-buffer
+                self._step_down()
+                return
+            self._renew_lease()
             self._observe_root_epoch(int(resp.master_epoch))
             with self._lock:
                 for nid, action in resp.actions.items():
@@ -580,6 +702,32 @@ class SubMaster:
             self._merge_items.labels(self.rack_id, "ack").inc(len(acks))
             snapshots = snapshots[limit:]
             heartbeats, acks = [], []
+
+    def _rebuffer(self, heartbeats: list, snapshots: list,
+                  acks: list) -> None:
+        """Re-buffer an undelivered push behind anything that arrived
+        meanwhile: newest heartbeat wins, snapshots re-fold, acks are
+        rid-deduped by the root so replay order is safe."""
+        with self._lock:
+            for hb in heartbeats:
+                self._heartbeats.setdefault(hb["node_id"],
+                                            hb["restart_count"])
+            for snap in snapshots:
+                key = (snap["node_id"], snap["role"])
+                cur = self._snapshots.get(key)
+                if cur is None:
+                    self._snapshots[key] = {
+                        "samples": snap["samples"],
+                        "is_delta": snap["is_delta"],
+                    }
+                elif cur["is_delta"]:
+                    merged = merge_snapshot(snap["samples"],
+                                            cur["samples"])
+                    self._snapshots[key] = {
+                        "samples": merged,
+                        "is_delta": snap["is_delta"],
+                    }
+            self._acks[:0] = acks
 
     def _refresh_waiting(self) -> None:
         with self._lock:

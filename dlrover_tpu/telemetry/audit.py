@@ -1,0 +1,327 @@
+"""Trail-invariant auditor: post-scenario safety proofs over journals.
+
+Chaos scenarios (``chaos/scenario.py``, ``chaos/partition_scenarios.py``)
+prove *liveness* — the job finished, recovery happened within budget.
+This module adds the *safety* half (DESIGN.md §30): after a scenario
+ends, its merged journal (every process appended to one
+``DLROVER_TPU_JOURNAL_DIR``, so file order is global append order) is
+replayed against invariants that a partition, a zombie sub-master, or a
+crash-restart race must never violate:
+
+``unique_world``     no two comm worlds for one rendezvous round — every
+                     ``rdzv_round`` / ``comm_world`` event for (rdzv,
+                     round) carries the same membership hash, whichever
+                     tier served it.
+``duplicate_rank``   no comm world assigns one rank to two nodes (or one
+                     node to two ranks) — parsed from the compact
+                     membership the emitters record.
+``round_monotonic``  round numbers per rendezvous only grow in append
+                     order — a restarted master must never reissue a
+                     round (§26).
+``committed_acks``   no committed checkpoint step is missing acks: every
+                     ``ckpt_commit`` carries a full manifest
+                     (``shards >= num_shards``), and when the trail
+                     shows the master's ack ledger for that step/group
+                     it must have reached quorum.
+``epoch_monotonic``  epochs only grow per tier: root-minted rack epochs
+                     (``submaster_failover``) strictly increase per
+                     rack; a sub-master process's own epoch
+                     (``rack_merge`` / ``comm_world`` / ``rack_action``)
+                     never decreases within that process.
+``fenced_action``    no action was applied from a fenced source — a
+                     ``rack_action`` delivery whose (rack, epoch) the
+                     root fenced (``push_fenced``) is split-brain made
+                     visible.
+
+``audit_events`` returns findings (empty = proof holds);
+``assert_clean`` raises with the findings listed, and is what every
+``run_*_scenario`` calls before returning, so each scenario doubles as
+a safety proof. The reader tolerates torn final lines (SIGKILL legs)
+and the ``.1`` rotation sibling, like ``chaos/scenario.py``'s reader.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+
+JOURNAL_BASENAME = "events.jsonl"
+
+# the world-membership fields the emitters attach (rdzv_manager for the
+# root tier, submaster mirror adoption for the rack tier); worlds above
+# this size hash without the inline membership (the hash comparison
+# still proves uniqueness; only the rank check needs members)
+WORLD_INLINE_MAX = 200
+
+
+def world_compact(world: dict) -> str:
+    """Canonical compact membership: ``"nid:rank,..."`` sorted by node
+    id ("" when too large to inline)."""
+    if len(world) > WORLD_INLINE_MAX:
+        return ""
+    return ",".join(
+        f"{int(nid)}:{int(rank)}"
+        for nid, rank in sorted(
+            (int(k), int(v)) for k, v in world.items()
+        )
+    )
+
+
+def world_hash(world: dict) -> str:
+    """Deterministic membership digest (size-independent)."""
+    joined = ",".join(
+        f"{int(nid)}:{int(rank)}"
+        for nid, rank in sorted(
+            (int(k), int(v)) for k, v in world.items()
+        )
+    )
+    return hashlib.blake2s(joined.encode(), digest_size=8).hexdigest()
+
+
+@dataclasses.dataclass
+class Finding:
+    invariant: str
+    detail: str
+    evidence: dict = dataclasses.field(default_factory=dict)
+
+    def __str__(self) -> str:  # readable assertion messages
+        return f"[{self.invariant}] {self.detail}"
+
+
+def read_journal(journal_dir: str) -> list[dict]:
+    """Merged journal events in append order (rotated sibling first),
+    tolerating torn lines from SIGKILLed writers."""
+    events: list[dict] = []
+    base = os.path.join(journal_dir, JOURNAL_BASENAME)
+    for path in (base + ".1", base):
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        ev = json.loads(line)
+                    except ValueError:
+                        continue  # torn final line of a killed process
+                    if isinstance(ev, dict):
+                        events.append(ev)
+        except OSError:
+            continue
+    return events
+
+
+def _parse_members(compact: str) -> list[tuple[int, int]]:
+    members = []
+    for part in compact.split(","):
+        if not part:
+            continue
+        nid, _, rank = part.partition(":")
+        try:
+            members.append((int(nid), int(rank)))
+        except ValueError:
+            return []  # unparseable -> skip the rank check, not crash
+    return members
+
+
+def _check_worlds(events: list[dict], findings: list[Finding]) -> None:
+    # unique_world + duplicate_rank + round_monotonic
+    hashes: dict[tuple[str, int], dict[str, dict]] = {}
+    last_round: dict[str, int] = {}
+    for ev in events:
+        name = ev.get("name")
+        if name not in ("rdzv_round", "comm_world"):
+            continue
+        rdzv = str(ev.get("rdzv", ""))
+        rnd = int(ev.get("round", 0) or 0)
+        wh = ev.get("world_hash")
+        if wh:
+            seen = hashes.setdefault((rdzv, rnd), {})
+            if str(wh) not in seen:
+                seen[str(wh)] = ev
+            if len(seen) > 1:
+                findings.append(Finding(
+                    "unique_world",
+                    f"rendezvous {rdzv!r} round {rnd} was served with "
+                    f"{len(seen)} distinct memberships "
+                    f"(hashes {sorted(seen)})",
+                    {"rdzv": rdzv, "round": rnd,
+                     "hashes": sorted(seen)},
+                ))
+        compact = ev.get("world")
+        if compact:
+            members = _parse_members(str(compact))
+            ranks = [r for _, r in members]
+            nids = [n for n, _ in members]
+            if len(set(ranks)) != len(ranks) \
+                    or len(set(nids)) != len(nids):
+                findings.append(Finding(
+                    "duplicate_rank",
+                    f"rendezvous {rdzv!r} round {rnd} world assigns a "
+                    f"duplicate rank or node: {compact}",
+                    {"rdzv": rdzv, "round": rnd, "world": compact},
+                ))
+        if name == "rdzv_round" and rnd:
+            prev = last_round.get(rdzv, 0)
+            if rnd <= prev:
+                findings.append(Finding(
+                    "round_monotonic",
+                    f"rendezvous {rdzv!r} completed round {rnd} after "
+                    f"round {prev} — round numbers were reissued",
+                    {"rdzv": rdzv, "round": rnd, "prev": prev},
+                ))
+            last_round[rdzv] = max(prev, rnd)
+
+
+def _check_commits(events: list[dict], findings: list[Finding]) -> None:
+    # committed_acks: manifest completeness + ledger quorum when the
+    # trail shows the master ledger was in play for that step/group
+    acks: dict[tuple[int, str], set] = {}
+    for ev in events:
+        if ev.get("name") == "persist_ack":
+            key = (int(ev.get("step", -1)), str(ev.get("group", "")))
+            acks.setdefault(key, set()).add(ev.get("node"))
+    for ev in events:
+        if ev.get("name") != "ckpt_commit":
+            continue
+        step = int(ev.get("step", -1))
+        num_shards = int(ev.get("num_shards", 0) or 0)
+        shards = int(ev.get("shards", 0) or 0)
+        group = str(ev.get("group", ""))
+        if shards < num_shards:
+            findings.append(Finding(
+                "committed_acks",
+                f"step {step} ({group or 'dense'}) committed with only "
+                f"{shards}/{num_shards} shard manifest entries",
+                {"step": step, "group": group, "shards": shards,
+                 "num_shards": num_shards},
+            ))
+        ledger = acks.get((step, group))
+        if ledger and len(ledger) < num_shards:
+            # acks flowed through the master for this step but quorum
+            # was never reached — the commit used data the ledger
+            # cannot justify (done-marker commits leave no acks at all
+            # and are exempt by the `ledger` truthiness guard)
+            findings.append(Finding(
+                "committed_acks",
+                f"step {step} ({group or 'dense'}) committed but the "
+                f"ack ledger shows only {len(ledger)}/{num_shards} "
+                f"writers",
+                {"step": step, "group": group,
+                 "acked": len(ledger), "num_shards": num_shards},
+            ))
+
+
+def _check_epochs(events: list[dict], findings: list[Finding]) -> None:
+    # epoch_monotonic: root-minted rack epochs strictly increase per
+    # rack in append order; a single sub-master process's epoch never
+    # decreases (keyed by proc+pid so a zombie's stale-epoch events are
+    # judged against its OWN history, not its replacement's)
+    minted: dict[str, int] = {}
+    per_proc: dict[tuple, int] = {}
+    for ev in events:
+        name = ev.get("name")
+        if name == "submaster_failover":
+            rack = str(ev.get("rack", ""))
+            old = int(ev.get("old_epoch", 0) or 0)
+            new = int(ev.get("new_epoch", 0) or 0)
+            prev = minted.get(rack, 0)
+            if new <= max(old, prev):
+                findings.append(Finding(
+                    "epoch_monotonic",
+                    f"rack {rack!r} minted epoch {new} after "
+                    f"{max(old, prev)} — root epoch fence regressed",
+                    {"rack": rack, "new_epoch": new,
+                     "prev": max(old, prev)},
+                ))
+            minted[rack] = max(prev, new)
+        elif name in ("rack_merge", "comm_world", "rack_action"):
+            epoch = ev.get("epoch")
+            if epoch is None:
+                continue
+            key = (str(ev.get("rack", "")), ev.get("proc"),
+                   ev.get("pid"))
+            prev = per_proc.get(key, 0)
+            if int(epoch) < prev:
+                findings.append(Finding(
+                    "epoch_monotonic",
+                    f"rack {key[0]!r} process {key[1]}:{key[2]} epoch "
+                    f"went {prev} -> {epoch}",
+                    {"rack": key[0], "proc": key[1],
+                     "epoch": int(epoch), "prev": prev},
+                ))
+            per_proc[key] = max(prev, int(epoch))
+
+
+def _check_fencing(events: list[dict],
+                   findings: list[Finding]) -> None:
+    # fenced_action: once the root fenced (rack, epoch), no action may
+    # be delivered to an agent from that incarnation — in append order,
+    # so a delivery that legitimately preceded the fence is not charged
+    fenced: set[tuple[str, int]] = set()
+    for ev in events:
+        name = ev.get("name")
+        if name == "push_fenced":
+            fenced.add((str(ev.get("rack", "")),
+                        int(ev.get("epoch", 0) or 0)))
+        elif name == "rack_action":
+            key = (str(ev.get("rack", "")),
+                   int(ev.get("epoch", 0) or 0))
+            if key in fenced:
+                findings.append(Finding(
+                    "fenced_action",
+                    f"action {ev.get('action')!r} delivered to node "
+                    f"{ev.get('node')} from fenced source "
+                    f"rack={key[0]} epoch={key[1]}",
+                    {"rack": key[0], "epoch": key[1],
+                     "node": ev.get("node"),
+                     "action": ev.get("action")},
+                ))
+
+
+def audit_events(events: list[dict]) -> list[Finding]:
+    """Replay a merged journal against every trail invariant; the
+    returned findings are empty exactly when the proof holds.
+
+    Invariants are scoped per job — the §27 trace id, which every
+    master incarnation of one job shares (minted at job start, adopted
+    across restarts) while separate jobs sharing a journal dir (e.g.
+    the legs of a multi-leg chaos scenario) each mint their own. Round
+    numbers, epochs and ack ledgers are promises WITHIN a job; leg B
+    legitimately starts over at round 1."""
+    findings: list[Finding] = []
+    groups: dict[str, list[dict]] = {}
+    for ev in events:
+        groups.setdefault(str(ev.get("trace", "")), []).append(ev)
+    for job_events in groups.values():
+        _check_worlds(job_events, findings)
+        _check_commits(job_events, findings)
+        _check_epochs(job_events, findings)
+        _check_fencing(job_events, findings)
+    return findings
+
+
+def audit_journal_dir(journal_dir: str) -> list[Finding]:
+    return audit_events(read_journal(journal_dir))
+
+
+def assert_clean(events_or_dir, context: str = "") -> int:
+    """Assert the trail is invariant-clean; returns the number of
+    events audited so callers can record coverage. Raises
+    ``AssertionError`` naming every violated invariant."""
+    if isinstance(events_or_dir, str):
+        events = read_journal(events_or_dir)
+    else:
+        events = list(events_or_dir)
+    findings = audit_events(events)
+    if findings:
+        where = f" ({context})" if context else ""
+        lines = "\n  ".join(str(f) for f in findings)
+        raise AssertionError(
+            f"trail-invariant audit failed{where}: "
+            f"{len(findings)} finding(s) over {len(events)} events\n"
+            f"  {lines}"
+        )
+    return len(events)

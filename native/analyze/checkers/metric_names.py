@@ -104,6 +104,10 @@ DOCUMENTED_PREFIXES = (
     # the bottleneck" runbook keys on the request-latency family and
     # the engine kv_/draft_ gauges (covered by the engine_ prefix)
     "dlrover_tpu_serving_",
+    # partition tolerance (DESIGN.md §30): the "a rack is partitioned
+    # from the root" runbook keys on the link-transition/drop counters
+    # and the lease-expiry / push-fence families
+    "dlrover_tpu_partition_",
 )
 
 # label names that are themselves an operator contract (dashboards and

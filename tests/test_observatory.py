@@ -263,7 +263,12 @@ def test_gateway_stats_expose_observatory(params, monkeypatch):
             gw.generate(shared + [extra_tok], SamplingParams(
                 temperature=0.0, max_new_tokens=4), timeout=120)
         deadline = time.monotonic() + 30
-        while (not gw.pool.observatory.get("replicas_sampled")
+        # wait for a sample taken AFTER all 3 generates: an early
+        # health tick can snapshot the pool mid-traffic and stats()
+        # would then serve a 2-query observatory
+        while ((not gw.pool.observatory.get("replicas_sampled")
+                or gw.pool.observatory.get("prefix_cache_queries", 0)
+                < 3)
                and time.monotonic() < deadline):
             time.sleep(0.05)
         stats = gw.stats()

@@ -1,0 +1,500 @@
+"""Partition tolerance (DESIGN.md §30): the ``net_partition`` chaos
+domain, rack leases on both sides of the rack/root link, push-direction
+epoch fencing, sticky degraded re-dial, the degraded-link staleness and
+warn rate-limit bounds, the trail-invariant auditor, and the three
+partition acceptance scenarios driven end to end (real subprocesses,
+seeded chaos plans, replay-identical trails).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+from dlrover_tpu import chaos
+from dlrover_tpu.chaos import partition
+from dlrover_tpu.common import messages as m
+from dlrover_tpu.common import serde
+from dlrover_tpu.common.constants import EnvKey
+from dlrover_tpu.common.rpc import RpcClient
+from dlrover_tpu.master.submaster import SubMaster
+from dlrover_tpu.telemetry import audit
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_state():
+    yield
+    chaos.uninstall()
+    partition.reset()
+
+
+def _read(journal_dir) -> list[dict]:
+    path = os.path.join(str(journal_dir), "events.jsonl")
+    if not os.path.exists(path):
+        return []
+    return [json.loads(line)
+            for line in open(path, encoding="utf-8") if line.strip()]
+
+
+class _Loop:
+    """In-process transport with a full serde round-trip each way."""
+
+    def __init__(self, handler):
+        self._handler = handler
+
+    def call(self, msg):
+        resp = self._handler(serde.decode(serde.encode(msg)))
+        return serde.decode(serde.encode(resp))
+
+    def close(self):
+        pass
+
+
+def _root(tmp_path, **kw):
+    from dlrover_tpu.master.job_master import JobMaster
+
+    kw.setdefault("job_name", "pt")
+    kw.setdefault("state_dir", str(tmp_path / "state"))
+    master = JobMaster(**kw)
+    master.prepare()
+    return master
+
+
+# ------------------------------------------------- net_partition point
+
+
+def test_partition_opens_heals_and_journals_once(monkeypatch, tmp_path):
+    """A directed rule opens the edge at its first fired crossing,
+    keeps dropping while the occurrence window is open, and heals at
+    the first crossing that passes — one open + one heal journal
+    instant per episode, carrying the opening fault's seq."""
+    monkeypatch.setenv(EnvKey.JOURNAL_DIR, str(tmp_path))
+    chaos.install({"seed": 5, "faults": [
+        {"point": "net_partition", "action": "drop",
+         "match": {"src": "a", "dst": "b"}, "after": 1, "times": 2},
+    ]})
+    assert partition.check("a", "b") is None       # after=1 skips one
+    assert partition.check("b", "a") is None       # directional: no match
+    assert partition.check("a", "b") is not None   # opens
+    assert partition.check("a", "b") is not None   # still open, no journal
+    assert partition.check("a", "b") is None       # exhausted: heals
+    assert partition.check("a", "b") is None       # healthy, no journal
+    trans = [e for e in _read(tmp_path) if e["name"] == "net_partition"]
+    assert [(e["state"], e["src"], e["dst"]) for e in trans] == \
+        [("open", "a", "b"), ("heal", "a", "b")]
+    assert trans[0]["seq"] == trans[1]["seq"]
+
+
+def test_partition_symmetric_link_cuts_both_directions(monkeypatch,
+                                                       tmp_path):
+    """``match: {"link": "a|b"}`` is a symmetric split: crossings in
+    BOTH directions fire (and both consume the one rule's occurrence
+    window), each direction with its own open/heal episode."""
+    monkeypatch.setenv(EnvKey.JOURNAL_DIR, str(tmp_path))
+    chaos.install({"seed": 5, "faults": [
+        {"point": "net_partition", "action": "drop",
+         "match": {"link": "a|b"}, "times": 4},
+    ]})
+    assert partition.check("a", "b") is not None
+    assert partition.check("b", "a") is not None
+    assert partition.check("a", "b") is not None
+    assert partition.check("b", "a") is not None
+    assert partition.check("a", "b") is None
+    assert partition.check("b", "a") is None
+    trans = [(e["state"], e["src"], e["dst"])
+             for e in _read(tmp_path) if e["name"] == "net_partition"]
+    assert trans == [("open", "a", "b"), ("open", "b", "a"),
+                     ("heal", "a", "b"), ("heal", "b", "a")]
+
+
+def test_partition_disabled_is_noop_and_clears_state():
+    chaos.install({"seed": 5, "faults": [
+        {"point": "net_partition", "action": "drop",
+         "match": {"src": "a", "dst": "b"}, "times": 5},
+    ]})
+    assert partition.check("a", "b") is not None
+    chaos.uninstall()
+    assert partition.check("a", "b") is None
+    assert not partition._open  # forgotten, not leaked to the next plan
+
+
+# ---------------------------------------------- trail-invariant auditor
+
+
+def _world_ev(name, rnd, world, rdzv="training"):
+    return {"name": name, "rdzv": rdzv, "round": rnd,
+            "world_hash": audit.world_hash(world),
+            "world": audit.world_compact(world)}
+
+
+def test_audit_clean_trail_passes():
+    """A consistent trail — one membership per round, complete commit
+    manifests with a quorate ledger, monotonic epochs, no deliveries
+    from fenced incarnations — yields zero findings."""
+    w1, w2 = {0: 0, 1: 1}, {0: 0}
+    events = [
+        _world_ev("rdzv_round", 1, w1),
+        _world_ev("comm_world", 1, w1),
+        {"name": "persist_ack", "step": 10, "group": "", "node": 0},
+        {"name": "persist_ack", "step": 10, "group": "", "node": 1},
+        {"name": "ckpt_commit", "step": 10, "group": "",
+         "num_shards": 2, "shards": 2},
+        {"name": "submaster_failover", "rack": "rackA",
+         "old_epoch": 2, "new_epoch": 3},
+        {"name": "push_fenced", "rack": "rackA", "epoch": 2,
+         "current": 3},
+        {"name": "rack_action", "rack": "rackA", "epoch": 3,
+         "node": 1, "action": "restart"},
+        _world_ev("rdzv_round", 2, w2),
+    ]
+    assert audit.audit_events(events) == []
+    assert audit.assert_clean(events, "unit") == len(events)
+
+
+@pytest.mark.parametrize("invariant,events", [
+    ("unique_world", [_world_ev("rdzv_round", 1, {0: 0, 1: 1}),
+                      _world_ev("comm_world", 1, {0: 0})]),
+    ("duplicate_rank", [{"name": "comm_world", "rdzv": "training",
+                         "round": 1, "world": "0:0,1:0"}]),
+    ("round_monotonic", [_world_ev("rdzv_round", 2, {0: 0}),
+                         _world_ev("rdzv_round", 2, {0: 0})]),
+    ("committed_acks", [{"name": "ckpt_commit", "step": 5, "group": "",
+                         "num_shards": 2, "shards": 1}]),
+    ("committed_acks", [{"name": "persist_ack", "step": 5, "group": "",
+                         "node": 0},
+                        {"name": "ckpt_commit", "step": 5, "group": "",
+                         "num_shards": 2, "shards": 2}]),
+    ("epoch_monotonic", [{"name": "submaster_failover", "rack": "r",
+                          "old_epoch": 2, "new_epoch": 3},
+                         {"name": "submaster_failover", "rack": "r",
+                          "old_epoch": 2, "new_epoch": 3}]),
+    ("epoch_monotonic", [{"name": "rack_merge", "rack": "r",
+                          "proc": "sub", "pid": 1, "epoch": 3},
+                         {"name": "rack_merge", "rack": "r",
+                          "proc": "sub", "pid": 1, "epoch": 2}]),
+    ("fenced_action", [{"name": "push_fenced", "rack": "r",
+                        "epoch": 2, "current": 3},
+                       {"name": "rack_action", "rack": "r", "epoch": 2,
+                        "node": 0, "action": "restart"}]),
+])
+def test_audit_detects_violation(invariant, events):
+    findings = audit.audit_events(events)
+    assert [f.invariant for f in findings] == [invariant]
+    with pytest.raises(AssertionError, match=invariant):
+        audit.assert_clean(events, "unit")
+
+
+def test_audit_reader_tolerates_rotation_and_torn_lines(tmp_path):
+    """The merged reader walks the ``.1`` rotation sibling first and
+    skips a SIGKILLed writer's torn final line instead of crashing."""
+    base = tmp_path / "events.jsonl"
+    (tmp_path / "events.jsonl.1").write_text(
+        json.dumps({"name": "first"}) + "\n")
+    base.write_text(json.dumps({"name": "second"}) + "\n"
+                    + '{"name": "torn", "ro')
+    events = audit.read_journal(str(tmp_path))
+    assert [e["name"] for e in events] == ["first", "second"]
+    assert audit.audit_journal_dir(str(tmp_path)) == []
+
+
+# ------------------------------------------- push-direction epoch fence
+
+
+def test_push_fence_rejects_stale_epoch_and_journals(monkeypatch,
+                                                     tmp_path):
+    """A merged push from a superseded sub-master incarnation is
+    rejected whole (fenced=True, nothing merged, one ``push_fenced``
+    journal instant); the current incarnation and legacy epoch-0
+    pushes pass."""
+    monkeypatch.setenv(EnvKey.JOURNAL_DIR, str(tmp_path / "j"))
+    root = _root(tmp_path)
+    try:
+        r1 = root.servicer.handle(
+            m.SubMasterRegisterRequest(rack_id="rackA", addr="a:1"))
+        r2 = root.servicer.handle(
+            m.SubMasterRegisterRequest(rack_id="rackA", addr="a:2"))
+        assert r2.epoch > r1.epoch
+        stale = root.servicer.handle(m.RackMergedReport(
+            rack_id="rackA", epoch=r1.epoch,
+            heartbeats=[{"node_id": 7, "restart_count": 0}],
+        ))
+        assert stale.fenced and stale.actions == {}
+        fresh = root.servicer.handle(
+            m.RackMergedReport(rack_id="rackA", epoch=r2.epoch))
+        assert not fresh.fenced
+        legacy = root.servicer.handle(
+            m.RackMergedReport(rack_id="rackB", epoch=0))
+        assert not legacy.fenced
+    finally:
+        root.stop()
+    events = _read(tmp_path / "j")
+    fenced = [e for e in events if e["name"] == "push_fenced"]
+    assert [(e["rack"], e["epoch"], e["current"]) for e in fenced] == \
+        [("rackA", r1.epoch, r2.epoch)]
+    assert audit.audit_events(events) == []
+
+
+def test_root_expires_rack_lease_and_readmits_same_epoch(monkeypatch,
+                                                         tmp_path):
+    """Past RACK_LEASE_S without an accepted merge the root drops the
+    rack from the registered census (one ``lease_expired`` tier=root
+    instant) but KEEPS its epoch: lease expiry is not epoch
+    invalidation, so the same healed incarnation's next push is
+    accepted and re-admits the rack."""
+    monkeypatch.setenv(EnvKey.RACK_LEASE_S, "0.2")
+    monkeypatch.setenv(EnvKey.JOURNAL_DIR, str(tmp_path / "j"))
+    root = _root(tmp_path)
+    try:
+        reg = root.servicer.handle(
+            m.SubMasterRegisterRequest(rack_id="rackA", addr="a:1"))
+        time.sleep(0.3)
+        resp = root.servicer.handle(
+            m.RackMergedReport(rack_id="rackA", epoch=reg.epoch))
+        assert not resp.fenced  # same incarnation: welcomed back
+        assert "rackA" in root.servicer._submaster_leases
+    finally:
+        root.stop()
+    expired = [e for e in _read(tmp_path / "j")
+               if e["name"] == "lease_expired" and e["tier"] == "root"]
+    assert [(e["rack"], e["epoch"]) for e in expired] == \
+        [("rackA", reg.epoch)]
+
+
+# --------------------------------------- sub-master lease: fail closed
+
+
+def test_submaster_lease_fail_closed_redirects_then_recovers(
+        monkeypatch, tmp_path):
+    """Past its lease a sub-master stops serving the mirrored comm
+    world (redirect=True, joins dropped, ONE ``lease_expired``
+    tier=rack instant per episode); the next accepted upstream push
+    renews the lease, serving resumes, and a second lapse re-arms the
+    episode journal."""
+    monkeypatch.setenv(EnvKey.RACK_LEASE_S, "0.25")
+    monkeypatch.setenv(EnvKey.JOURNAL_DIR, str(tmp_path / "j"))
+    root = _root(tmp_path, min_nodes=1, max_nodes=1)
+    sub = SubMaster("rackA", upstream_transport=_Loop(root.servicer.handle),
+                    flush_interval_s=3600.0)
+    try:
+        sub.handle(m.JoinRendezvousRequest(node_id=0, addr="n0:1",
+                                           local_devices=4))
+        assert sub.flush()
+        served = sub.handle(m.CommWorldRequest(node_id=0))
+        assert served.completed and not served.redirect
+
+        time.sleep(0.35)
+        for _ in range(2):  # once-per-episode journal
+            lapsed = sub.handle(m.CommWorldRequest(node_id=0))
+            assert not lapsed.completed and lapsed.redirect
+        rack_expired = [e for e in _read(tmp_path / "j")
+                        if e["name"] == "lease_expired"
+                        and e["tier"] == "rack"]
+        assert len(rack_expired) == 1
+        assert not sub._joins  # buffered joins are the root's to re-form
+
+        # an accepted push is the lease renewal: buffer a heartbeat,
+        # flush, and the mirror serves again (same epoch — lease
+        # expiry invalidated nothing)
+        sub.handle(m.NodeHeartbeat(node_id=0, restart_count=0))
+        sub.flush()
+        again = sub.handle(m.CommWorldRequest(node_id=0))
+        assert again.completed and not again.redirect
+
+        time.sleep(0.35)
+        assert sub.handle(m.CommWorldRequest(node_id=0)).redirect
+        rack_expired = [e for e in _read(tmp_path / "j")
+                        if e["name"] == "lease_expired"
+                        and e["tier"] == "rack"]
+        assert len(rack_expired) == 2  # episode journal re-armed
+    finally:
+        root.stop()
+        sub._up.close()
+
+
+# ----------------------------------------------------- sticky re-dial
+
+
+def test_sticky_redial_pins_to_fallback_until_rack_retry(monkeypatch,
+                                                         tmp_path):
+    """Pinned to the direct-to-root fallback, the client does NOT
+    re-probe the rack port file before the jittered RACK_RETRY_S mark
+    (no flapping back to a dead rack address); past the mark a
+    republished rack file reclaims it, and the partition edge follows
+    the target tier."""
+    from dlrover_tpu.agent.master_client import MasterClient
+
+    monkeypatch.setenv(EnvKey.RACK_RETRY_S, "5")
+    rack_file = tmp_path / "rack.port"
+    root_file = tmp_path / "root.port"
+    root_file.write_text("20001")
+    client = MasterClient(
+        "127.0.0.1:10000", 0,
+        transport=RpcClient("127.0.0.1:10000", link=("agent", "rack")),
+        port_file=str(rack_file), fallback_port_file=str(root_file),
+    )
+    try:
+        # rack file missing -> degrade to the root, arm the rack retry
+        assert client.maybe_redial() is True
+        assert client._client.addr == "127.0.0.1:20001"
+        assert client._active_target == "fallback"
+        assert client._client.link == ("agent", "root")
+        armed = client._rack_retry_at - time.monotonic()
+        assert 3.5 <= armed <= 6.5  # RACK_RETRY_S jittered +-20%
+
+        # the rack comes back, but the pin holds until the retry mark
+        rack_file.write_text("20002")
+        assert client.maybe_redial() is False
+        assert client._client.addr == "127.0.0.1:20001"
+
+        # past the mark the rack file reclaims the client
+        client._rack_retry_at = 0.0
+        assert client.maybe_redial() is True
+        assert client._client.addr == "127.0.0.1:20002"
+        assert client._active_target == "primary"
+        assert client._client.link == ("agent", "rack")
+
+        # prefer_fallback (a fail-closed redirect) skips the rack
+        # probe entirely, fresh rack file or not
+        root_file.write_text("20003")
+        rack_file.write_text("20004")
+        assert client.maybe_redial(prefer_fallback=True) is True
+        assert client._client.addr == "127.0.0.1:20003"
+        assert client._active_target == "fallback"
+        assert client._client.link == ("agent", "root")
+    finally:
+        client.close()
+
+
+# ------------------------------- degraded link: warn bound + staleness
+
+
+def test_degraded_warn_rate_limited_through_long_outage(monkeypatch,
+                                                        tmp_path):
+    """A five-minute outage ticking every 5s produces ONE degraded
+    enter instant and warns only every DEGRADED_WARN_S — not one line
+    per tick — then one exit instant on recovery."""
+    from dlrover_tpu.agent import master_link as ml
+
+    monkeypatch.setenv(EnvKey.JOURNAL_DIR, str(tmp_path))
+    clock = {"t": 1000.0}
+    monkeypatch.setattr(ml.time, "monotonic", lambda: clock["t"])
+    warns = []
+    monkeypatch.setattr(ml.logger, "warning",
+                        lambda msg, *a, **k: warns.append(msg))
+    link = ml.MasterLink(object(), component="warnunit",
+                         warn_every_s=30.0)
+    err = ConnectionError("partitioned")
+    for i in range(61):  # ticks at t=0,5,...,300
+        clock["t"] = 1000.0 + 5.0 * i
+        link.failed(err)
+    assert len(warns) == 11  # t=0,30,...,300 only
+    link.ok()
+    events = [e for e in _read(tmp_path)
+              if e["name"] == "degraded_mode"
+              and e.get("component") == "warnunit"]
+    assert [e["state"] for e in events] == ["enter", "exit"]
+
+
+def test_link_staleness_bound(monkeypatch, tmp_path):
+    """``stale()`` flips only after LINK_STALE_S of continuous
+    degradation (one state="stale" instant per episode) and resets
+    with the link."""
+    from dlrover_tpu.agent import master_link as ml
+
+    monkeypatch.setenv(EnvKey.JOURNAL_DIR, str(tmp_path))
+    monkeypatch.setenv(EnvKey.LINK_STALE_S, "50")
+    clock = {"t": 2000.0}
+    monkeypatch.setattr(ml.time, "monotonic", lambda: clock["t"])
+    link = ml.MasterLink(object(), component="staleunit",
+                         warn_every_s=1e9)
+    assert link.stale() is False  # healthy links are never stale
+    link.failed(ConnectionError("partitioned"))
+    clock["t"] += 49.0
+    assert link.stale() is False
+    clock["t"] += 2.0
+    assert link.stale() is True
+    assert link.stale() is True  # still one journal instant
+    link.ok()
+    assert link.stale() is False
+    link.failed(ConnectionError("partitioned again"))
+    clock["t"] += 51.0
+    assert link.stale() is True  # second episode journals again
+
+    def _stales():
+        return [e for e in _read(tmp_path)
+                if e["name"] == "degraded_mode"
+                and e.get("component") == "staleunit"
+                and e.get("state") == "stale"]
+    assert len(_stales()) == 2
+
+
+# ------------------------------------------- fleetsim partition waves
+
+
+def test_fleetsim_partition_wave_recovery_and_burst():
+    """A netsplit wave cuts a seeded fraction of the fleet, heals, and
+    the reconnect stampede fans out under the production retry jitter:
+    the run measures a positive recovery time and a reconnect burst
+    p99, and two seeded runs replay the identical trail."""
+    from dlrover_tpu.fleetsim.profile import FleetProfile
+    from dlrover_tpu.fleetsim.sim import FleetSimulator
+
+    p = FleetProfile(name="pwave", seed=11, nodes=200, duration_s=30.0,
+                     failures=0, ckpt_interval_s=10.0, partitions=1,
+                     partition_s=4.0, partition_frac=0.3)
+    r1 = FleetSimulator(p).run()
+    r2 = FleetSimulator(FleetProfile.from_json(p.to_json())).run()
+    assert r1.trail == r2.trail
+    kinds = {e[0] for e in r1.trail["events"]}
+    assert {"partition", "heal", "partition_recovered"} <= kinds
+    assert r1.partition_recovery_s is not None
+    assert r1.partition_recovery_s > 0
+    assert r1.reconnect_burst_p99 > 0
+
+
+# ----------------------------------------------- acceptance scenarios
+
+
+def test_zombie_submaster_scenario_replay_identical(tmp_path):
+    """The §30 zombie acceptance: a real sub-master SIGSTOPped through
+    its replacement resumes and pushes — the push bounces off the
+    epoch fence exactly once, it steps down, trainers never restart,
+    and two seeded runs produce identical canonical trails."""
+    from dlrover_tpu.chaos.partition_scenarios import (
+        run_zombie_submaster_scenario,
+    )
+
+    r1 = run_zombie_submaster_scenario(str(tmp_path / "run1"), seed=4242)
+    r1.assert_invariants()
+    r2 = run_zombie_submaster_scenario(str(tmp_path / "run2"), seed=4242)
+    r2.assert_invariants()
+    assert r1.trail == r2.trail
+
+
+def test_asym_split_scenario_redelivery_ledger(tmp_path):
+    """An asymmetric split (each direction cut in turn) heals through
+    the redelivery queue: every ack lands exactly once (rid dedup
+    absorbs the replay whose response was lost) and the partition
+    transition ledger is exact."""
+    from dlrover_tpu.chaos.partition_scenarios import (
+        run_asym_split_scenario,
+    )
+
+    run_asym_split_scenario(str(tmp_path / "run"),
+                            seed=4242).assert_invariants()
+
+
+def test_rack_split_scenario_fails_closed_and_readmits(tmp_path):
+    """A rack-wide split during rendezvous: the sub-master's lease
+    lapses and it fails closed, agents complete the round via the
+    direct-to-root redirect, the root expires the rack lease, and the
+    healed same-epoch sub-master is re-admitted — zero restarts."""
+    from dlrover_tpu.chaos.partition_scenarios import (
+        run_rack_split_scenario,
+    )
+
+    run_rack_split_scenario(str(tmp_path / "run"),
+                            seed=4242).assert_invariants()
