@@ -31,6 +31,7 @@ numbers are fully on-chip and real.
 from __future__ import annotations
 
 import dataclasses
+import gc
 import json
 import os
 import signal
@@ -2185,6 +2186,164 @@ def bench_gateway(extra: dict) -> None:
     finally:
         shutil.rmtree(trace_dir, ignore_errors=True)
 
+    # §31 live-lever A/B riders: the two §29 instruments promoted to
+    # live levers, each isolated on a direct engine pair (the gateway
+    # A/B above keeps its mixed-tenant trace; these measure the lever).
+    from dlrover_tpu.common.constants import EnvKey
+
+    saved_env = {k: os.environ.get(k) for k in
+                 (EnvKey.SPEC_DEPTH, EnvKey.KV_COW,
+                  "DLROVER_TPU_SERVING_OBSERVATORY",
+                  "DLROVER_TPU_OBSERVATORY_SAMPLE_EVERY")}
+    os.environ["DLROVER_TPU_SERVING_OBSERVATORY"] = "1"
+    os.environ["DLROVER_TPU_OBSERVATORY_SAMPLE_EVERY"] = "8"
+    try:
+        # --- speculative decoding: spec-vs-plain on a self-predictable
+        # greedy trace (the regime the n-gram drafter serves; random
+        # prompts under a random-init model fall into cycles, so the
+        # order-2 drafter has real runs to ride). Two warm passes per
+        # leg: the jit block ladder's shapes depend on the evolving
+        # accept-run prior, so pass 1 alone leaves cold compiles that
+        # would land inside the timed pass.
+        spec_geo = dict(geo, slots=2, max_len=256, decode_block=4,
+                        kv_pages=64)
+        spec_prompts = [
+            [454, 126, 12, 214, 262, 346], [229, 389, 164, 351],
+            [485, 180, 384, 142, 241, 56], [4, 47, 391, 116],
+            [21, 485, 24], [443, 88, 403],
+        ]
+        spec_prompts += spec_prompts[:2]
+        spec_trace = [
+            (p, SamplingParams(temperature=0.0, max_new_tokens=200,
+                               seed=900 + i))
+            for i, p in enumerate(spec_prompts)
+        ]
+
+        def spec_build(depth):
+            os.environ[EnvKey.SPEC_DEPTH] = str(depth)
+            eng = InferenceEngine(params, cfg, **spec_geo)
+            if depth:
+                eng.warm_aot_verify()
+            for _ in range(2):
+                for p, sp in spec_trace:
+                    eng.submit(p, sp)
+                eng.run()
+            return eng
+
+        def spec_pass(eng, toks):
+            t0 = time.monotonic()
+            ids = [eng.submit(p, sp) for p, sp in spec_trace]
+            out = {r.id: r.tokens for r in eng.run()}
+            dt = time.monotonic() - t0
+            pass_toks = [out[i] for i in ids]
+            if toks is not None and pass_toks != toks:
+                raise RuntimeError("spec leg nondeterministic")
+            return dt, pass_toks
+
+        # INTERLEAVED best-of-4: host speed drifts over the seconds a
+        # leg takes (shared cores, frequency scaling), so timing the
+        # legs sequentially hands whichever ran on the faster stretch
+        # a bias larger than the lever's margin. Alternating passes
+        # samples both legs across the same drift; min is the
+        # least-contended estimate per leg (the bench_int8
+        # best-of-compiles convention).
+        p_eng, s_eng = spec_build(0), spec_build(4)
+        plain_s = spec_s = None
+        plain_toks = spec_toks = None
+        # gc paused for the timed window: by this point the stage's
+        # disagg A/B has grown the heap enough that gen-2 collections
+        # land mid-pass, and they fall disproportionately on whichever
+        # leg allocates more per step — a measurement artifact, not
+        # engine cost. Collect once up front, time, restore.
+        gc.collect()
+        gc_was_enabled = gc.isenabled()
+        gc.disable()
+        try:
+            for _ in range(4):
+                dt, plain_toks = spec_pass(p_eng, plain_toks)
+                plain_s = dt if plain_s is None else min(plain_s, dt)
+                dt, spec_toks = spec_pass(s_eng, spec_toks)
+                spec_s = dt if spec_s is None else min(spec_s, dt)
+        finally:
+            if gc_was_enabled:
+                gc.enable()
+        extra["gateway_spec_identical"] = plain_toks == spec_toks
+        extra["gateway_spec_speedup"] = round(plain_s / spec_s, 3)
+        extra["gateway_spec_accept_rate_live"] = round(
+            s_eng.spec_accept_rate, 4)
+        extra["gateway_spec_extra_tokens"] = s_eng.spec_extra_tokens_total
+        extra["gateway_spec_collapsed"] = s_eng.spec_collapsed_total
+    except Exception as e:  # noqa: BLE001 - riders must not kill bench
+        extra["gateway_spec_error"] = repr(e)
+    try:
+        # --- COW KV pages: at a FIXED page budget, how many requests
+        # with a shared system prefix can hold pages concurrently
+        # (active + parked + reserving), on vs off. Prefixes are page
+        # aligned so full prompt pages dedup against resident chains;
+        # off-leg admissions block at the reserve step instead.
+        # Decode runs span several pages so victims become parkable
+        # (the anti-thrash quantum is one decoded page) and the holder
+        # census exercises parked sharers, not just the two actives.
+        from dlrover_tpu.serving.observatory import digest_share_stats
+
+        pg = spec_geo["prefill_len"]     # page_size defaults to P
+        sys_pages = 4 if not on_tpu else 2
+        req_pages = 2 * sys_pages        # sys + 1 tail + decode span
+        uniq = req_pages - sys_pages
+        cow_sys = list(rng.integers(0, cfg.vocab_size, sys_pages * pg))
+        cow_geo = dict(spec_geo, max_len=req_pages * pg,
+                       kv_pages=req_pages + 3 * uniq)
+        cow_trace = []
+        for i in range(8):
+            tail = list(rng.integers(0, cfg.vocab_size, pg))
+            cow_trace.append((cow_sys + tail, SamplingParams(
+                temperature=0.0, max_new_tokens=(uniq - 1) * pg,
+                seed=700 + i)))
+
+        def cow_leg(on):
+            os.environ[EnvKey.KV_COW] = "1" if on else "0"
+            os.environ[EnvKey.SPEC_DEPTH] = "0"
+            eng = InferenceEngine(params, cfg, **cow_geo)
+            for p, sp in cow_trace:
+                eng.submit(p, sp)
+            peak, saved_frac, pred_frac, guard = 0, 0.0, 0.0, 0
+            while eng.outstanding and guard < 100000:
+                guard += 1
+                eng.step()
+                holders = (sum(p is not None for p in eng._slot_pages)
+                           + len(eng._parked)
+                           + (1 if eng._pending is not None else 0))
+                peak = max(peak, holders)
+                used = eng.kv_pages - len(eng._free_pages)
+                saved = eng.cow_pages_saved
+                if used + saved:
+                    saved_frac = max(saved_frac,
+                                     saved / (used + saved))
+                rids = ([r.id for r in eng._active if r is not None]
+                        + [pk.req.id for pk in eng._parked])
+                share = digest_share_stats(
+                    [eng._digest_store.pages(r) for r in rids])
+                pred_frac = max(pred_frac, share["shareable_frac"])
+            return eng, peak, saved_frac, pred_frac
+
+        on_eng, peak_on, saved_on, pred_on = cow_leg(True)
+        _, peak_off, _, pred_off = cow_leg(False)
+        extra["gateway_cow_admitted_gain"] = round(
+            peak_on / max(peak_off, 1), 2)
+        extra["gateway_cow_pages_saved_frac"] = round(saved_on, 4)
+        extra["gateway_cow_shareable_frac_pred"] = round(
+            max(pred_on, pred_off), 4)
+        extra["gateway_cow_shared_total"] = on_eng.cow_pages_shared_total
+        extra["gateway_cow_peak_holders"] = f"{peak_on}on/{peak_off}off"
+    except Exception as e:  # noqa: BLE001 - riders must not kill bench
+        extra["gateway_cow_error"] = repr(e)
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
 
 def bench_int8(extra: dict) -> None:
     """int8 MXU path vs bf16 on the llama-7B FFN stack (d=4096,
@@ -2806,6 +2965,9 @@ HEADLINE_KEYS = [
     "gateway_pages_shareable_frac", "gateway_cow_multiplier",
     "gateway_draft_accept_rate", "gateway_draft_tokens_scored",
     "gateway_accept_run_p50", "gateway_accept_run_p95",
+    "gateway_spec_speedup", "gateway_spec_accept_rate_live",
+    "gateway_spec_identical", "gateway_cow_admitted_gain",
+    "gateway_cow_pages_saved_frac", "gateway_cow_shareable_frac_pred",
 ]
 
 
@@ -2859,7 +3021,7 @@ def _load_headline(path: str) -> dict:
 
 
 _QUALITY_SUFFIXES = ("_speedup", "_agreement", "_rate", "_completed",
-                     "_frac_ok")
+                     "_frac_ok", "_gain", "_saved_frac", "_rate_live")
 
 
 def _compare_category(key: str) -> str:

@@ -263,6 +263,11 @@ class EnvKey:
     SERVING_OBSERVATORY = "DLROVER_TPU_SERVING_OBSERVATORY"
     OBSERVATORY_SAMPLE_EVERY = "DLROVER_TPU_OBSERVATORY_SAMPLE_EVERY"
     SHADOW_ORDER = "DLROVER_TPU_SHADOW_ORDER"
+    # serving raw speed (DESIGN.md §31): copy-on-write page sharing in
+    # the paged KV pool, and the max self-drafted speculative-decode
+    # verify depth (0 = plain decode)
+    KV_COW = "DLROVER_TPU_KV_COW"
+    SPEC_DEPTH = "DLROVER_TPU_SPEC_DEPTH"
 
 
 class Defaults:

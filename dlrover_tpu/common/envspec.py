@@ -307,6 +307,16 @@ SPECS: tuple[EnvVar, ...] = (
     EnvVar("DLROVER_TPU_SHADOW_ORDER", "3",
            "n-gram order of the draft-acceptance shadow predictor "
            "(longest-match back-off to 1)", "§29"),
+    # ------------------------------------------------- serving raw speed
+    EnvVar("DLROVER_TPU_KV_COW", "1",
+           "copy-on-write KV page sharing: admission dedups full "
+           "prefix pages against resident matching chain digests and "
+           "capacity counts unique pages; 0 reverts to private pages",
+           "§31"),
+    EnvVar("DLROVER_TPU_SPEC_DEPTH", "0",
+           "max speculative self-draft depth k: the n-gram drafter "
+           "proposes up to k tokens verified in one wide forward; 0 "
+           "disables speculation (plain decode)", "§31"),
 )
 
 SPEC_BY_NAME: dict[str, EnvVar] = {spec.name: spec for spec in SPECS}

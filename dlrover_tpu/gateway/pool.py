@@ -100,6 +100,19 @@ _prefix_hit_rate_gauge = registry().gauge(
     "prefix-cache hit fraction across READY replicas, per pool",
     label_names=("pool",),
 )
+_cow_saved_frac_gauge = registry().gauge(
+    "dlrover_tpu_gateway_cow_pages_saved_frac",
+    "fraction of the pool-wide live logical KV pages served by "
+    "copy-on-write sharing instead of a fresh lease (§31 realized, "
+    "vs the §29-predicted shareable headroom)",
+    label_names=("pool",),
+)
+_spec_rate_live_gauge = registry().gauge(
+    "dlrover_tpu_gateway_spec_accept_rate_live",
+    "live speculative-decode draft acceptance across READY replicas, "
+    "per pool (§31 realized, vs the §29 shadow prior)",
+    label_names=("pool",),
+)
 
 
 class ReplicaState(str, Enum):
@@ -359,10 +372,20 @@ class EngineReplica:
         if warm is None or not envspec.get_bool(EnvKey.AOT_CACHE):
             return None
         try:
-            return warm()
+            out = warm()
         except Exception:  # noqa: BLE001 - warming is best-effort
             logger.exception("replica %d AOT warmup failed", self.id)
             return None
+        # spec-enabled engines also pre-arm the per-depth verify ladder
+        # (§31) — same cache, same best-effort contract
+        warm_v = getattr(engine, "warm_aot_verify", None)
+        if warm_v is not None:
+            try:
+                warm_v()
+            except Exception:  # noqa: BLE001 - warming is best-effort
+                logger.exception(
+                    "replica %d verify AOT warmup failed", self.id)
+        return out
 
 
 class ReplicaPool:
@@ -586,10 +609,24 @@ class ReplicaPool:
         accepted = scored = 0
         run_p95 = run_p50 = 0
         sampled = 0
+        cow_saved = cow_shared = cow_breaks = 0
+        spec_acc = spec_scored = spec_extra = spec_steps = 0
         for replica in self.ready_replicas():
             eng = replica.engine
             hits += int(getattr(eng, "prefix_cache_hits", 0) or 0)
             queries += int(getattr(eng, "prefix_cache_queries", 0) or 0)
+            # §31 live counters come straight off the replica surface —
+            # they must aggregate even between observatory samples
+            cow_saved += int(getattr(eng, "cow_pages_saved", 0) or 0)
+            cow_shared += int(
+                getattr(eng, "cow_pages_shared_total", 0) or 0)
+            cow_breaks += int(getattr(eng, "cow_breaks_total", 0) or 0)
+            spec_acc += int(getattr(eng, "spec_drafts_accepted", 0) or 0)
+            spec_scored += int(
+                getattr(eng, "spec_drafts_scored", 0) or 0)
+            spec_extra += int(
+                getattr(eng, "spec_extra_tokens_total", 0) or 0)
+            spec_steps += int(getattr(eng, "spec_steps_total", 0) or 0)
             snap_fn = getattr(eng, "observatory_snapshot", None)
             snap = snap_fn() if snap_fn is not None else None
             if not snap:
@@ -623,6 +660,19 @@ class ReplicaPool:
             "prefix_cache_queries": queries,
             "prefix_cache_hit_rate": (
                 round(hits / queries, 4) if queries else 0.0),
+            # §31 realized COW/spec facts (0 when the levers are off)
+            "cow_pages_saved": cow_saved,
+            "cow_pages_saved_frac": (
+                round(cow_saved / (used + cow_saved), 4)
+                if used + cow_saved else 0.0),
+            "cow_pages_shared_total": cow_shared,
+            "cow_breaks_total": cow_breaks,
+            "spec_accept_rate_live": (
+                round(spec_acc / spec_scored, 4) if spec_scored
+                else 0.0),
+            "spec_drafts_scored": spec_scored,
+            "spec_extra_tokens_total": spec_extra,
+            "spec_verify_steps_total": spec_steps,
         }
         _kv_free_gauge.labels(self.name).set(free)
         _kv_used_gauge.labels(self.name).set(used)
@@ -633,6 +683,10 @@ class ReplicaPool:
             agg["draft_accept_rate"])
         _prefix_hit_rate_gauge.labels(self.name).set(
             agg["prefix_cache_hit_rate"])
+        _cow_saved_frac_gauge.labels(self.name).set(
+            agg["cow_pages_saved_frac"])
+        _spec_rate_live_gauge.labels(self.name).set(
+            agg["spec_accept_rate_live"])
         return agg
 
 

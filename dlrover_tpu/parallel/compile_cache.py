@@ -161,6 +161,17 @@ def stage_key(base_key: str, *, stage: int, num_stages: int, phase: str,
             f"v{max(1, int(interleave))}{phase}_{digest}")
 
 
+def verify_key(base_key: str, *, depth: int) -> str:
+    """Per-depth compile-cache key for a speculative-decode verify
+    program (DESIGN.md §31): the draft depth rides IN the key — one
+    entry per member of the pow2 depth ladder, scannable by prefix
+    (``<tag>/sv``) just like the pipeline-stage keys. ``base_key``
+    must come from :func:`compile_fingerprint` with the serving slot
+    geometry in the strategy facts."""
+    tag, digest = base_key.split("/", 1)
+    return f"{tag}/sv{int(depth)}_{digest}"
+
+
 # ------------------------------------------------------- artifact envelope
 
 

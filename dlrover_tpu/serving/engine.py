@@ -54,6 +54,32 @@ analog: prefilled KV rows are cached at chunk-aligned prompt prefixes
 prefix — shared system prompts (the RLHF rollout shape) skip nearly the
 whole prefill. A hit changes which chunks run, never a program shape,
 and a weight push invalidates the cache wholesale.
+
+**Copy-on-write KV pages** (DESIGN.md §31, ``DLROVER_TPU_KV_COW``):
+the page pool gains per-page refcounts and a sharing index keyed by
+the §29 prefix CHAIN digests (one per-request digest store, shared
+with the observatory — no double hashing). Admission dedups FULL
+prompt-prefix pages against resident matching chains: a sharer's
+page-table entries point at the owner's physical pages (incref), only
+the remainder is leased fresh, so capacity counts *unique* pages.
+Prefix pages are materialized into the pool at install and registered;
+shared entries are never written (park scatters them to the scratch
+page) — a write that WOULD land in a shared page (decode-dirty region
+overlapping a shared entry) breaks the share copy-on-write style into
+a fresh private page first. Park/resume and retire decref; a page
+returns to the free list only at refcount zero.
+
+**Speculative decoding** (§31, ``DLROVER_TPU_SPEC_DEPTH``): the §29
+n-gram shadow predictor self-drafts k tokens (zero RNG, no draft
+model) and the target model verifies them in ONE wide forward —
+``_verify_block`` extends the §23 eos-in-block machinery with a
+``[slots, k]`` token feed at per-slot positions. Position 0 always
+feeds the exactly-sampled next token, so every verify step yields >= 2
+tokens for a drafting row; position i is accepted while every fed
+guess before it matched the true sample at the SAME draw index —
+greedy token streams are bit-exact by construction. Depth k comes from
+the measured §29 accept-run p50 prior; a request whose live acceptance
+collapses falls back to k=1 (plain decode) for its lifetime.
 """
 
 from __future__ import annotations
@@ -61,6 +87,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import time
+import weakref
 from collections import deque
 from typing import Any
 
@@ -79,7 +106,10 @@ from dlrover_tpu.models.decode import (
     sample_logits,
 )
 from dlrover_tpu.models.transformer import TransformerConfig
-from dlrover_tpu.serving.observatory import ServingObservatory
+from dlrover_tpu.serving.observatory import (
+    PrefixDigestStore,
+    ServingObservatory,
+)
 from dlrover_tpu.telemetry.journal import get_journal
 from dlrover_tpu.telemetry.metrics import registry
 
@@ -126,6 +156,53 @@ _prefix_cache_entries = registry().gauge(
     "prefilled KV rows currently pinned in the prefix LRU, per engine",
     label_names=("engine",),
 )
+_kv_cow_shared_total = registry().counter(
+    "dlrover_tpu_engine_kv_cow_shared_total",
+    "page-table entries deduped onto a resident shared page at "
+    "admission (copy-on-write prefix sharing)",
+)
+_kv_cow_breaks_total = registry().counter(
+    "dlrover_tpu_engine_kv_cow_breaks_total",
+    "copy-on-write breaks: a write would have landed in a shared "
+    "page, so the entry was re-pointed at a fresh private page",
+)
+_spec_verify_steps_total = registry().counter(
+    "dlrover_tpu_spec_verify_steps_total",
+    "speculative verify dispatches (one wide forward verifying a "
+    "self-drafted token block)",
+)
+_spec_extra_tokens_total = registry().counter(
+    "dlrover_tpu_spec_extra_tokens_total",
+    "tokens emitted by verify steps beyond the one-per-slot a plain "
+    "decode step would have produced",
+)
+_spec_collapsed_total = registry().counter(
+    "dlrover_tpu_spec_collapsed_total",
+    "requests whose live draft acceptance collapsed and fell back to "
+    "k=1 plain decode for their remaining lifetime",
+)
+
+# engines register here so the test suite can assert the page-ledger
+# conservation invariant after every engine-touching test
+_LIVE_ENGINES: "weakref.WeakSet[InferenceEngine]" = weakref.WeakSet()
+
+# adaptive-depth collapse policy (§31): after this many scored REAL
+# draft tokens, a live acceptance below the floor drops the request to
+# k=1 for good — worst case then ~ plain decode, not a 2x flop tax
+_SPEC_COLLAPSE_MIN_SCORED = 16
+_SPEC_COLLAPSE_RATE = 0.2
+
+# Canonical low-precision numerics for the two programs that WRITE
+# decode KV. The wide verify forward and the narrow block scan are
+# DIFFERENT XLA programs; with excess precision allowed (the default),
+# fusion keeps different subsets of their bf16 intermediates in f32,
+# so ~1% of KV writes land one bf16 ulp apart between the programs —
+# enough to flip a greedy argmax hundreds of tokens later and break
+# the §31 spec-on/off token-identity pin. Forcing every intermediate
+# to its stated dtype makes both programs' KV bit-identical to the
+# eager op-by-op semantics, hence to each other, at ~zero cost on the
+# decode hot path (tests/test_serving_speed.py pins this end to end).
+_CANONICAL_NUMERICS = {"xla_allow_excess_precision": False}
 
 
 @dataclasses.dataclass
@@ -214,6 +291,9 @@ class _PendingAdmit:
     run: _PrefillRun
     pages: list[int]
     kind: str = "cold"         # cold | hit | handoff
+    # table indices (into `pages`) attached to SHARED physical pages
+    # at admission — already incref'd, never scattered to
+    shared: set = dataclasses.field(default_factory=set)
 
 
 @dataclasses.dataclass
@@ -228,6 +308,7 @@ class _Parked:
     seed: int
     sampled: int
     emitted: list[int]
+    shared: set = dataclasses.field(default_factory=set)
 
 
 class InferenceEngine:
@@ -313,6 +394,16 @@ class InferenceEngine:
         else:
             self._kpool = self._vpool = None
             self._free_pages = []
+        # copy-on-write page sharing (§31): refcount per LEASED
+        # physical page (private pages sit at 1), the sharing index
+        # chain-digest -> resident physical page, and its reverse map
+        # (for unregistering at free). All maintenance is host-side.
+        self._cow = self._paging and envspec.get_bool(EnvKey.KV_COW)
+        self._page_refs: dict[int, int] = {}
+        self._share_index: dict[bytes, int] = {}
+        self._page_digest: dict[int, bytes] = {}
+        self.cow_pages_shared_total = 0
+        self.cow_breaks_total = 0
 
         # prefix caching (the vLLM automatic-prefix-caching analog,
         # reference atorch/rl/inference_backend/vllm_backend.py): an LRU
@@ -342,6 +433,7 @@ class InferenceEngine:
         self._active: list[Request | None] = [None] * slots
         self._emitted: list[list[int]] = [[] for _ in range(slots)]
         self._slot_pages: list[list[int] | None] = [None] * slots
+        self._slot_shared: list[set | None] = [None] * slots
         self._since_install = [0] * slots
         self._results: list[Result] = []
         # admission state machine: at most one pending chunked prefill
@@ -366,6 +458,27 @@ class InferenceEngine:
                     EnvKey.OBSERVATORY_SAMPLE_EVERY, 32),
                 shadow_order=envspec.get_int(EnvKey.SHADOW_ORDER, 3),
             )
+
+        # one per-request digest store feeds BOTH the COW sharing
+        # index and the observatory's prefix-share sample (§31
+        # satellite: chain digests are computed once, incrementally at
+        # page boundaries — the sample never rehashes token lists)
+        self._digest_store: PrefixDigestStore | None = None
+        if self._cow or self._obs is not None:
+            self._digest_store = PrefixDigestStore(self.page_size)
+
+        # speculative decoding (§31): the drafter and the run-length
+        # depth prior live in the observatory, so speculation requires
+        # it; depth < 2 or a missing observatory means plain decode
+        self.spec_depth = max(0, envspec.get_int(EnvKey.SPEC_DEPTH, 0))
+        self._spec = self.spec_depth >= 2 and self._obs is not None
+        # rid -> [accepted, scored, collapsed] live draft accounting
+        self._spec_acc: dict[int, list[int]] = {}
+        self.spec_steps_total = 0
+        self.spec_extra_tokens_total = 0
+        self.spec_drafts_accepted = 0
+        self.spec_drafts_scored = 0
+        self.spec_collapsed_total = 0
 
         self._cache = init_cache(cfg, slots, self.max_len)
         self._cache["pos"] = jnp.zeros((slots,), jnp.int32)
@@ -488,8 +601,71 @@ class InferenceEngine:
             return toks, k, v, pos, last
 
         self._step_block = jax.jit(
-            _step_block, static_argnames=("n_steps",)
+            _step_block, static_argnames=("n_steps",),
+            compiler_options=_CANONICAL_NUMERICS,
         )
+
+        def _verify_block(params, k, v, pos, last, seeds, counts,
+                          temperature, top_k, top_p, active, eos_ids,
+                          guesses):
+            # speculative verify (§31): ONE wide forward checks a
+            # whole drafted block. ``guesses`` is [slots, n] int32 —
+            # column 0 doubles as the per-slot spec flag (>= 0: this
+            # row drafted; -1: plain row, advances exactly one token).
+            # Position 0 feeds the EXACTLY-sampled next token (same
+            # draw index as a plain step), positions 1..n-1 feed the
+            # drafter's guesses; true token i is sampled from the wide
+            # logits at i-1 with the plain path's draw index i, so
+            # accepted streams are bit-exact by construction. Only x0
+            # plus the MATCHED run is accepted — never the correction
+            # token after a miss: its position was fed the wrong
+            # guess, so its KV write and successor logits are stale.
+            # Nothing is lost: new_last is the very distribution that
+            # produces it, so the next dispatch's x0 re-derives the
+            # correction bit-identically AND writes its KV. An eos
+            # inside the accepted window truncates it, §23-style.
+            n = guesses.shape[1]
+            x0 = sample_logits(
+                last, _row_keys(seeds, counts), temperature, top_k,
+                top_p,
+            )
+            fed = jnp.concatenate(
+                [x0[:, None], jnp.maximum(guesses[:, 1:], 0)], axis=1
+            )
+            cache = {"k": k, "v": v, "pos": pos}
+            logits, cache = forward_cached(params, fed, cache, cfg)
+            toks = [x0]
+            for i in range(1, n):
+                toks.append(sample_logits(
+                    logits[:, i - 1], _row_keys(seeds, counts + i),
+                    temperature, top_k, top_p,
+                ))
+            toks = jnp.stack(toks, axis=1)              # [slots, n]
+            match = (guesses[:, 1:] == toks[:, 1:]).astype(jnp.int32)
+            run = jnp.cumprod(match, axis=1).sum(axis=1)
+            spec_on = guesses[:, 0] >= 0
+            acc = jnp.where(spec_on, 1 + run, 1)
+            hit = (eos_ids[:, None] >= 0) & (toks == eos_ids[:, None])
+            idx = jnp.arange(n)[None, :]
+            eos_at = jnp.min(
+                jnp.where(hit & (idx < acc[:, None]), idx, n), axis=1
+            )
+            acc = jnp.minimum(acc, eos_at + 1)
+            acc = jnp.where(active, acc, 0)
+            sel = jnp.maximum(acc - 1, 0)
+            new_last = jax.vmap(lambda row, i: row[i])(logits, sel)
+            new_last = jnp.where(active[:, None], new_last, last)
+            new_pos = jnp.where(active, pos + acc, pos)
+            return (toks, cache["k"], cache["v"], new_pos, new_last,
+                    acc)
+
+        self._verify_block = jax.jit(
+            _verify_block, compiler_options=_CANONICAL_NUMERICS,
+        )
+        # per-depth AOT verify programs (warm_aot_verify); missing
+        # depths fall back to the jit shape ladder above
+        self._aot_verify: dict[int, Any] = {}
+        self.aot_verify_info: dict[int, Any] = {}
         # the AOT decode-step program (warm_aot_step): replaces the
         # n_steps=1 jit dispatch when armed, so a fresh serving replica
         # whose (model, slots, max_len) was compiled by ANY earlier
@@ -497,6 +673,7 @@ class InferenceEngine:
         # 1 leftover). Other block sizes keep the jit ladder.
         self._aot_step = None
         self.aot_info = None
+        _LIVE_ENGINES.add(self)
 
     # ------------------------------------------------------- AOT cold start
 
@@ -540,14 +717,20 @@ class InferenceEngine:
                 strategy={"kind": "serving_step", "slots": self.slots,
                           "max_len": self.max_len,
                           "prefill_len": self.prefill_len,
-                          "n_steps": 1},
+                          "n_steps": 1,
+                          # part of the digest on purpose: an executable
+                          # compiled WITHOUT canonical numerics is not
+                          # interchangeable with one compiled with them
+                          # (§31 spec-on/off identity), so pre-§31 cache
+                          # entries must miss here
+                          "numerics": "canonical"},
                 args_signature=abstract_signature(sample),
             )
             aot = load_or_compile(
                 key, inputs,
                 lambda: self._step_block.lower(
                     *sample, n_steps=1
-                ).compile(),
+                ).compile(compiler_options=_CANONICAL_NUMERICS),
                 cache=cache,
             )
         except Exception:  # noqa: BLE001 - cold path must keep serving
@@ -557,6 +740,63 @@ class InferenceEngine:
         self._aot_step = aot.fn
         self.aot_info = aot
         return aot
+
+    def warm_aot_verify(self, depths=None, cache=None):
+        """Compile-or-load the speculative verify program for each
+        pow2 depth of the engine's ladder (§31). Per-depth cache keys
+        are derived through ``verify_key`` so a replica's verify
+        ladder lists next to its decode step. No-op when speculation
+        is off; safe to skip — the jit ladder stays functional."""
+        if not self._spec:
+            return []
+        from dlrover_tpu.parallel.compile_cache import (
+            abstract_signature,
+            compile_fingerprint,
+            launder,
+            load_or_compile,
+            verify_key,
+        )
+
+        if depths is None:
+            depths, d = [], 2
+            while d <= self.spec_depth:
+                depths.append(d)
+                d *= 2
+        out = []
+        try:
+            self._params = launder(self._params)
+            self._cache = launder(self._cache)
+            self._last = launder(self._last)
+            self._samp_cache = None
+            for depth in depths:
+                sample = self._step_sample_args() + (
+                    jnp.full((self.slots, depth), -1, jnp.int32),)
+                key, inputs = compile_fingerprint(
+                    num_nodes=1,
+                    total_devices=jax.local_device_count(),
+                    mesh_axes={},
+                    model=self.cfg,
+                    strategy={"kind": "serving_verify",
+                              "slots": self.slots,
+                              "max_len": self.max_len,
+                              "prefill_len": self.prefill_len,
+                              "numerics": "canonical"},
+                    args_signature=abstract_signature(sample),
+                )
+                key = verify_key(key, depth=depth)
+                aot = load_or_compile(
+                    key, inputs,
+                    lambda s=sample: self._verify_block.lower(
+                        *s).compile(compiler_options=_CANONICAL_NUMERICS),
+                    cache=cache,
+                )
+                self._aot_verify[depth] = aot.fn
+                self.aot_verify_info[depth] = aot
+                out.append(aot)
+        except Exception:  # noqa: BLE001 - cold path must keep serving
+            logger.exception("AOT verify warmup failed; keeping the "
+                             "jit ladder")
+        return out
 
     # ----------------------------------------------------------- user API
 
@@ -796,6 +1036,97 @@ class InferenceEngine:
         total = len(req.prompt) + req.params.max_new_tokens
         return -(-total // self.page_size)
 
+    # ------------------------------------------------- COW page ledger
+
+    def _lease_page(self) -> int:
+        pid = self._free_pages.pop()
+        self._page_refs[pid] = 1
+        return pid
+
+    def _release_ref(self, pid: int) -> None:
+        """Decref one page-table reference; at zero the page is
+        unregistered from the sharing index and returned to the free
+        list. Raises on a negative refcount — that is corruption, not
+        a recoverable state."""
+        left = self._page_refs.get(pid, 0) - 1
+        if left < 0:
+            raise AssertionError(
+                f"negative refcount for KV page {pid}"
+            )
+        if left:
+            self._page_refs[pid] = left
+            return
+        del self._page_refs[pid]
+        digest = self._page_digest.pop(pid, None)
+        if digest is not None and self._share_index.get(digest) == pid:
+            del self._share_index[digest]
+        self._free_pages.append(pid)
+
+    def _share_match(self, req: Request) -> list[int]:
+        """Resident physical pages matching this prompt's full-prefix
+        chain digests, contiguous from page 0 (a chain digest only
+        certifies a page when the whole prefix through it matches)."""
+        if not self._cow or self._digest_store is None:
+            return []
+        out: list[int] = []
+        for digest in self._digest_store.pages(req.id):
+            pid = self._share_index.get(digest)
+            if pid is None:
+                break
+            out.append(pid)
+        return out
+
+    def _cow_break(self, slot: int, idx: int) -> None:
+        """Copy-on-write: a scatter is about to write content into a
+        shared physical page (the slot's dense row diverged inside the
+        entry's span), so re-point the table entry at a fresh private
+        page and drop the shared reference. Unreachable under the
+        share policy (only full prompt-prefix pages are shared, decode
+        never writes below the prompt) — kept live as the corruption
+        guard the sharing discipline rests on."""
+        if not self._free_pages:
+            raise RuntimeError(
+                "KV pool exhausted during copy-on-write break"
+            )
+        req = self._active[slot]
+        old = self._slot_pages[slot][idx]
+        fresh = self._lease_page()
+        self._slot_pages[slot][idx] = fresh
+        shared = self._slot_shared[slot]
+        if shared is not None:
+            shared.discard(idx)
+        self._release_ref(old)
+        self.cow_breaks_total += 1
+        _kv_cow_breaks_total.inc()
+        get_journal().emit(
+            "kv_cow", request=req.id, kind="break", page=old,
+            fresh=fresh, remote_parent=req.sctx,
+        )
+
+    def kv_page_ledger(self) -> dict:
+        """Conservation snapshot of the page pool: every physical page
+        is exactly one of free or leased-with-positive-refcount, free
+        pages are distinct, and the sharing index round-trips through
+        its reverse map. Tests assert ``ok`` after every engine test."""
+        leased = dict(self._page_refs)
+        free = list(self._free_pages)
+        ok = (not self._paging) or (
+            len(free) + len(leased) == self.kv_pages
+            and len(set(free)) == len(free)
+            and not (set(free) & set(leased))
+            and min(leased.values(), default=1) >= 1
+            and all(self._share_index.get(d) == p
+                    for p, d in self._page_digest.items())
+        )
+        return {
+            "total": self.kv_pages,
+            "free": len(free),
+            "leased": len(leased),
+            "min_ref": min(leased.values(), default=1),
+            "shared_entries": self.cow_pages_saved,
+            "ok": ok,
+        }
+
     def _take_slot(self) -> int | None:
         """A free slot, or (paging only) free one by parking the
         longest-running active generation that has decoded at least one
@@ -820,8 +1151,24 @@ class InferenceEngine:
     def _park_slot(self, slot: int) -> None:
         req = self._active[slot]
         pages = self._slot_pages[slot] or []
+        shared = self._slot_shared[slot] or set()
+        pos_now = int(self._cache["pos"][slot])
+        plen = len(req.prompt)
         table = np.zeros((self.pages_per_slot,), np.int32)
-        table[: len(pages)] = pages
+        for i in range(len(pages)):
+            # immutable entries (attached shares + this slot's own
+            # registered prefix pages) are already resident and must
+            # never be scattered to — their table slot points at the
+            # scratch page. A write that WOULD land in one (the
+            # decode-dirty span [plen, pos) overlapping its pages)
+            # breaks the share copy-on-write first.
+            immutable = (i in shared
+                         or pages[i] in self._page_digest)
+            if (immutable and i * self.page_size < pos_now
+                    and (i + 1) * self.page_size > plen):
+                self._cow_break(slot, i)
+                immutable = False
+            table[i] = 0 if immutable else pages[i]
         self._kpool, self._vpool = self._park_out(
             self._cache["k"], self._cache["v"], self._kpool,
             self._vpool, jnp.asarray(slot, jnp.int32),
@@ -829,15 +1176,17 @@ class InferenceEngine:
         )
         self._parked.append(_Parked(
             req=req, pages=pages,
-            pos=int(self._cache["pos"][slot]),
+            pos=pos_now,
             last=self._last[slot],
             seed=int(self._seeds[slot]),
             sampled=int(self._sampled[slot]),
             emitted=self._emitted[slot],
+            shared=set(self._slot_shared[slot] or ()),
         ))
         self._active[slot] = None
         self._emitted[slot] = []
         self._slot_pages[slot] = None
+        self._slot_shared[slot] = None
         self._samp_cache = None
         self.kv_parked_total += 1
         _kv_parked_total.inc()
@@ -857,6 +1206,7 @@ class InferenceEngine:
         self._active[slot] = parked.req
         self._emitted[slot] = parked.emitted
         self._slot_pages[slot] = parked.pages
+        self._slot_shared[slot] = set(parked.shared)
         self._seeds[slot] = np.uint32(parked.seed)
         self._sampled[slot] = parked.sampled
         self._since_install[slot] = 0
@@ -877,16 +1227,37 @@ class InferenceEngine:
         if not self._queue:
             return False
         req = self._queue[0]
+        if self._digest_store is not None:
+            self._digest_store.start(req.id, req.prompt)
         pages: list[int] = []
+        shared_n = 0
         if self._paging:
             need = self._pages_needed(req)  # fits: validated at submit
-            if len(self._free_pages) < need:
+            shared = self._share_match(req)
+            if len(self._free_pages) < need - len(shared):
                 if self._obs is not None:
                     self._obs.note_page_blocked()
                 return False
-            pages = [self._free_pages.pop() for _ in range(need)]
+            # admission capacity counts UNIQUE pages: attached shares
+            # are incref'd (a pending admission holds its references —
+            # the owner retiring cannot free them out from under it),
+            # only the remainder is leased from the free list
+            for pid in shared:
+                self._page_refs[pid] += 1
+            fresh = [self._lease_page()
+                     for _ in range(need - len(shared))]
+            pages = shared + fresh
+            shared_n = len(shared)
+            if shared_n:
+                self.cow_pages_shared_total += shared_n
+                _kv_cow_shared_total.inc(shared_n)
+                get_journal().emit(
+                    "kv_cow", request=req.id, kind="share",
+                    shared=shared_n, fresh=len(fresh),
+                    remote_parent=req.sctx,
+                )
             if self._obs is not None:
-                self._obs.note_pages_leased(req.id, need)
+                self._obs.note_pages_leased(req.id, len(fresh))
         self._queue.popleft()
         if req.bundle is not None:
             run = self._run_from_bundle(req)
@@ -895,7 +1266,8 @@ class InferenceEngine:
             run = self.prefill_begin(req.prompt)
             kind = "hit" if run.start else "cold"
         self._pending = _PendingAdmit(req=req, run=run, pages=pages,
-                                      kind=kind)
+                                      kind=kind,
+                                      shared=set(range(shared_n)))
         return True
 
     def _install_admit(self, slot: int, pa: _PendingAdmit) -> None:
@@ -911,7 +1283,10 @@ class InferenceEngine:
         self._active[slot] = req
         self._emitted[slot] = []
         self._slot_pages[slot] = pa.pages
+        self._slot_shared[slot] = set(pa.shared)
         self._since_install[slot] = 0
+        if self._cow and pa.pages:
+            self._materialize_prefix(slot, pa)
         seed = (req.params.seed if req.params.seed is not None
                 else int(self._seed_gen.integers(0, 2**32)))
         # normalize arbitrary ints (time_ns(), 64-bit random) into
@@ -936,6 +1311,32 @@ class InferenceEngine:
                 bytes=int(req.bundle.k.nbytes + req.bundle.v.nbytes),
                 remote_parent=req.sctx,
             )
+
+    def _materialize_prefix(self, slot: int, pa: _PendingAdmit) -> None:
+        """Scatter the freshly installed row's FULL prompt-prefix
+        pages into the pool and register their chain digests, so later
+        admissions dedup against them (§31). Attached shares are
+        already resident; only fresh, not-yet-registered prefix pages
+        are written. One extra `_park_out` dispatch per admission that
+        registers anything — the price of a resident sharing index."""
+        digests = self._digest_store.pages(pa.req.id)
+        n_pref = min(len(digests), len(pa.pages))
+        fresh = [i for i in range(n_pref)
+                 if i not in pa.shared
+                 and digests[i] not in self._share_index]
+        if not fresh:
+            return
+        table = np.zeros((self.pages_per_slot,), np.int32)
+        for i in fresh:
+            table[i] = pa.pages[i]
+        self._kpool, self._vpool = self._park_out(
+            self._cache["k"], self._cache["v"], self._kpool,
+            self._vpool, jnp.asarray(slot, jnp.int32),
+            jnp.asarray(table),
+        )
+        for i in fresh:
+            self._share_index[digests[i]] = pa.pages[i]
+            self._page_digest[pa.pages[i]] = digests[i]
 
     def _admit_tick(self) -> bool:
         """At most ONE unit of admission work — a single prefill chunk,
@@ -1008,6 +1409,94 @@ class InferenceEngine:
             block *= 2
         return block
 
+    def _spec_plan(self):
+        """This step's verify depth + per-slot draft feed, or None for
+        the plain block path. Depth policy (§31): k tracks the
+        observatory's accept-run p50 prior (cold start: 2), clamped to
+        ``spec_depth`` and to every ACTIVE slot's remaining budget (so
+        no row can overrun its page lease or max_len), then snapped to
+        the pow2 ladder. Greedy rows with drafter evidence and a live
+        (non-collapsed) acceptance record speculate; everything else
+        advances exactly one token inside the same dispatch — which is
+        why, when the engine's block ladder would scan more than one
+        step, a verify only dispatches if EVERY active slot drafted: a
+        non-drafting slot inside a verify advances 1 token where the
+        block scan would have given it ``block``, so mixed dispatches
+        are a strict loss the moment block > 1."""
+        drafts: dict[int, list[int]] = {}
+        rem_min = None
+        n_active = 0
+        for s, req in enumerate(self._active):
+            if req is None:
+                continue
+            n_active += 1
+            rem = req.params.max_new_tokens - len(self._emitted[s])
+            rem_min = rem if rem_min is None else min(rem_min, rem)
+            if req.params.temperature > 0:
+                continue               # greedy-only by design
+            st = self._spec_acc.get(req.id)
+            if st is not None and st[2]:
+                continue               # collapsed to k=1
+            shadow = self._obs._shadow.get(req.id)
+            if shadow is None:
+                continue
+            d = shadow.draft(self.spec_depth)
+            if d:
+                drafts[s] = d
+        if not drafts:
+            return None
+        if self._block_size() > 1 and len(drafts) < n_active:
+            return None
+        prior = self._obs._run_percentile(0.50)
+        # floor 4, not 2: the verify program's per-token cost only
+        # beats the block scan once a couple of drafts can land, so a
+        # cold prior must not pin the ladder at its least profitable
+        # depth — per-request collapse already protects the hopeless
+        kmax = min(self.spec_depth, max(4, prior + 1))
+        cap = min(kmax, rem_min)
+        depth = 1
+        while depth * 2 <= cap:
+            depth *= 2
+        if depth < 2:
+            return None
+        guesses = np.full((self.slots, depth), -1, np.int32)
+        for s, d in drafts.items():
+            for i in range(min(depth, len(d))):
+                guesses[s, i] = d[i]
+        return depth, guesses
+
+    def _spec_score(self, guesses, toks_sn, depth: int) -> None:
+        """Per-request live acceptance from one verify step: each REAL
+        fed guess is scored against the chain-true token at its
+        position, sequentially up to (and including) the first miss —
+        the standard speculative accounting. Collapse drops the
+        request to k=1 for good."""
+        for s, req in enumerate(self._active):
+            if req is None or guesses[s, 0] < 0:
+                continue
+            ac = sc = 0
+            for i in range(1, depth):
+                g = int(guesses[s, i])
+                if g < 0:
+                    break
+                sc += 1
+                if g == int(toks_sn[s, i]):
+                    ac += 1
+                else:
+                    break
+            if not sc:
+                continue
+            st = self._spec_acc.setdefault(req.id, [0, 0, 0])
+            st[0] += ac
+            st[1] += sc
+            self.spec_drafts_accepted += ac
+            self.spec_drafts_scored += sc
+            if (not st[2] and st[1] >= _SPEC_COLLAPSE_MIN_SCORED
+                    and st[0] / st[1] < _SPEC_COLLAPSE_RATE):
+                st[2] = 1
+                self.spec_collapsed_total += 1
+                _spec_collapsed_total.inc()
+
     def step(self) -> int:
         """Admit (at most one chunk of) waiting work, decode one token
         (or one compiled block) for every active slot, retire finished
@@ -1035,32 +1524,54 @@ class InferenceEngine:
         if not active_mask.any():
             return 0
         temp, top_k, top_p, eos_ids = self._sampling_tensors()
-        block = self._block_size()
         args = (
             self.params, self._cache["k"], self._cache["v"],
             self._cache["pos"], self._last,
             jnp.asarray(self._seeds), jnp.asarray(self._sampled),
             temp, top_k, top_p, jnp.asarray(active_mask), eos_ids,
         )
-        if block == 1 and self._aot_step is not None:
-            toks_dev, k, v, pos, last = self._aot_step(*args)
+        plan = self._spec_plan() if self._spec else None
+        if plan is not None:
+            depth, guesses = plan
+            fn = self._aot_verify.get(depth, self._verify_block)
+            toks_dev, k, v, pos, last, acc_dev = fn(
+                *args, jnp.asarray(guesses))
+            toks_sn, acc = (np.asarray(a) for a in
+                            jax.device_get((toks_dev, acc_dev)))
+            toks = toks_sn.T                     # [depth, slots]
+            counts = acc.astype(np.int64)        # inactive rows: 0
+            self._sampled += counts
+            self.spec_steps_total += 1
+            _spec_verify_steps_total.inc()
+            extra = int(counts.sum()) - int(active_mask.sum())
+            if extra > 0:
+                self.spec_extra_tokens_total += extra
+                _spec_extra_tokens_total.inc(extra)
+            self._spec_score(guesses, toks_sn, depth)
         else:
-            toks_dev, k, v, pos, last = self._step_block(
-                *args, n_steps=block,
-            )
-        self._sampled[active_mask] += block
+            block = self._block_size()
+            if block == 1 and self._aot_step is not None:
+                toks_dev, k, v, pos, last = self._aot_step(*args)
+            else:
+                toks_dev, k, v, pos, last = self._step_block(
+                    *args, n_steps=block,
+                )
+            self._sampled[active_mask] += block
+            toks = np.asarray(jax.device_get(toks_dev))
+            counts = np.where(active_mask, block, 0)
         self._cache["k"], self._cache["v"] = k, v
         self._cache["pos"] = pos
         self._last = last
-        toks = np.asarray(jax.device_get(toks_dev))  # [block, slots]
         for s, req in enumerate(self._active):
             if req is None:
                 continue
             p = req.params
-            for j in range(block):
+            for j in range(int(counts[s])):
                 t = int(toks[j, s])
                 self._emitted[s].append(t)
                 self._since_install[s] += 1
+                if self._digest_store is not None:
+                    self._digest_store.extend(req.id, t)
                 if self._obs is not None:
                     self._obs.observe_token(req.id, t)
                 if req.on_token is not None:
@@ -1095,17 +1606,47 @@ class InferenceEngine:
         _tokens_total.inc(len(self._emitted[slot]))
         if self._obs is not None:
             self._obs.note_retire(req.id)
+        if self._digest_store is not None:
+            self._digest_store.drop(req.id)
+        st = self._spec_acc.pop(req.id, None)
+        if st is not None and st[1]:
+            get_journal().emit(
+                "spec_verify", request=req.id, accepted=st[0],
+                scored=st[1], collapsed=bool(st[2]),
+                remote_parent=req.sctx,
+            )
         self._active[slot] = None
         self._emitted[slot] = []
         self._samp_cache = None
         pages = self._slot_pages[slot]
         if pages:
-            self._free_pages.extend(pages)
+            for pid in pages:
+                self._release_ref(pid)
         self._slot_pages[slot] = None
+        self._slot_shared[slot] = None
 
     @property
     def free_pages(self) -> int:
         return len(self._free_pages)
+
+    @property
+    def cow_pages_saved(self) -> int:
+        """Page-table entries currently deduped onto shared physical
+        pages across active, parked and pending requests — each is one
+        physical page the pool did not have to lease."""
+        saved = sum(len(s) for s in self._slot_shared if s)
+        saved += sum(len(p.shared) for p in self._parked)
+        if self._pending is not None:
+            saved += len(self._pending.shared)
+        return saved
+
+    @property
+    def spec_accept_rate(self) -> float:
+        """Live draft acceptance: accepted / scored REAL draft tokens
+        across verify steps (0.0 before any draft was scored)."""
+        if not self.spec_drafts_scored:
+            return 0.0
+        return self.spec_drafts_accepted / self.spec_drafts_scored
 
     @property
     def observatory(self) -> ServingObservatory | None:
@@ -1155,3 +1696,19 @@ class InferenceEngine:
             )
         out, self._results = self._results, []
         return out
+
+
+def check_kv_ledgers() -> list[str]:
+    """Page-ledger conservation across every live engine in this
+    process (the autouse test fixture's hook): returns one description
+    per violated ledger, empty when all conserve."""
+    bad = []
+    for eng in list(_LIVE_ENGINES):
+        try:
+            ledger = eng.kv_page_ledger()
+        except Exception as exc:  # noqa: BLE001 - report, don't mask
+            bad.append(f"{eng.engine_id}: ledger check raised {exc!r}")
+            continue
+        if not ledger["ok"]:
+            bad.append(f"{eng.engine_id}: {ledger}")
+    return bad

@@ -47,6 +47,7 @@ import hashlib
 import threading
 import time
 from collections import Counter
+from typing import Any
 
 from dlrover_tpu.telemetry.journal import get_journal
 from dlrover_tpu.telemetry.metrics import registry
@@ -99,32 +100,84 @@ _accept_run_len = registry().histogram(
     "for speculative draft depth)",
     buckets=(1, 2, 4, 8, 16, 32, 64),
 )
+_cow_saved_g = registry().gauge(
+    "dlrover_tpu_engine_kv_cow_pages_saved",
+    "page-table entries currently deduped onto shared physical pages "
+    "(realized copy-on-write savings), per engine",
+    label_names=("engine",),
+)
+_spec_rate_g = registry().gauge(
+    "dlrover_tpu_spec_accept_rate_live",
+    "live speculative-draft acceptance: accepted / scored REAL draft "
+    "tokens across verify steps, per engine",
+    label_names=("engine",),
+)
 
 # pow2 run-length buckets mirrored host-side so the observatory can
 # derive p50/p95 for its own journal samples without scraping
 _RUN_BOUNDS = (1, 2, 4, 8, 16, 32, 64)
 
 
-def page_share_stats(slot_tokens, page_size: int) -> dict:
-    """Prefix-share headroom over live slots' token streams.
+class PrefixDigestStore:
+    """Per-request incremental chain digests at page boundaries (§31).
 
-    ``slot_tokens`` is one token-id list per live slot (prompt +
-    emitted). Pages are hashed with a per-slot blake2s CHAIN — digest
-    at page boundary p covers tokens[0 : (p+1)*page_size] — because a
-    KV page is only truly shareable when the entire prefix through it
-    matches, not merely the page's own tokens. Only full pages count;
-    a partial trailing page is never shareable.
+    One blake2s hasher per live request, fed each token exactly once
+    (prompt at admission, emitted tokens from the decode host loop); a
+    digest lands in the per-request list at every FULL page boundary.
+    Both the engine's COW sharing index and the observatory's
+    prefix-share sample read these lists — chain hashing happens once,
+    never per sample. Digest scheme is identical to
+    ``page_share_stats``: boundary p's digest covers the whole prefix
+    ``tokens[0 : (p+1)*page_size]``.
     """
+
+    def __init__(self, page_size: int) -> None:
+        self.page_size = max(1, int(page_size))
+        self._hashers: dict[int, Any] = {}
+        self._counts: dict[int, int] = {}
+        self._pages: dict[int, list[bytes]] = {}
+
+    def start(self, rid: int, tokens) -> None:
+        """Open a request's chain and absorb its prompt (idempotent —
+        blocked admissions re-probe without double hashing)."""
+        if rid in self._hashers:
+            return
+        self._hashers[rid] = hashlib.blake2s()
+        self._counts[rid] = 0
+        self._pages[rid] = []
+        for tok in tokens:
+            self.extend(rid, tok)
+
+    def extend(self, rid: int, tok: int) -> None:
+        h = self._hashers.get(rid)
+        if h is None:
+            return
+        h.update(int(tok).to_bytes(8, "little", signed=True))
+        self._counts[rid] += 1
+        if self._counts[rid] % self.page_size == 0:
+            # blake2s digest() does not finalize: the chain continues
+            self._pages[rid].append(h.digest())
+
+    def pages(self, rid: int) -> list[bytes]:
+        """Full-page chain digests absorbed so far (never a copy the
+        caller may mutate — treat as read-only)."""
+        return self._pages.get(rid, [])
+
+    def drop(self, rid: int) -> None:
+        self._hashers.pop(rid, None)
+        self._counts.pop(rid, None)
+        self._pages.pop(rid, None)
+
+
+def digest_share_stats(slot_digests) -> dict:
+    """Prefix-share headroom over precomputed per-slot chain-digest
+    lists (one ``PrefixDigestStore.pages`` list per live request) —
+    the O(pages) sample path, no token rehashing."""
     owners: dict[bytes, set[int]] = {}
     first_page: list[bytes] = []
     total = 0
-    for sid, toks in enumerate(slot_tokens):
-        h = hashlib.blake2s()
-        for p in range(len(toks) // page_size):
-            lo = p * page_size
-            for t in toks[lo: lo + page_size]:
-                h.update(int(t).to_bytes(8, "little", signed=True))
-            digest = h.digest()
+    for sid, digests in enumerate(slot_digests):
+        for p, digest in enumerate(digests):
             owners.setdefault(digest, set()).add(sid)
             if p == 0:
                 first_page.append(digest)
@@ -147,6 +200,29 @@ def page_share_stats(slot_tokens, page_size: int) -> dict:
         "largest_family": sizes[0] if sizes else 0,
         "family_sizes": sizes[:8],
     }
+
+
+def page_share_stats(slot_tokens, page_size: int) -> dict:
+    """Prefix-share headroom over live slots' token streams.
+
+    ``slot_tokens`` is one token-id list per live slot (prompt +
+    emitted). Pages are hashed with a per-slot blake2s CHAIN — digest
+    at page boundary p covers tokens[0 : (p+1)*page_size] — because a
+    KV page is only truly shareable when the entire prefix through it
+    matches, not merely the page's own tokens. Only full pages count;
+    a partial trailing page is never shareable.
+    """
+    slot_digests = []
+    for toks in slot_tokens:
+        h = hashlib.blake2s()
+        digests = []
+        for p in range(len(toks) // page_size):
+            lo = p * page_size
+            for t in toks[lo: lo + page_size]:
+                h.update(int(t).to_bytes(8, "little", signed=True))
+            digests.append(h.digest())
+        slot_digests.append(digests)
+    return digest_share_stats(slot_digests)
 
 
 class ShadowPredictor:
@@ -181,16 +257,43 @@ class ShadowPredictor:
                 followers[tok] += 1
         ctx.append(tok)
 
-    def predict(self):
-        """What the draft would emit next, or None with no evidence."""
-        ctx = self._ctx
+    def _predict_ctx(self, ctx, min_order: int = 1):
         for j in range(min(self.order, len(ctx)), 0, -1):
+            if j < min_order:
+                break
             followers = self._tables[j - 1].get(tuple(ctx[-j:]))
             if followers:
                 return min(
                     followers.items(), key=lambda kv: (-kv[1], kv[0])
                 )[0]
         return None
+
+    def predict(self):
+        """What the draft would emit next, or None with no evidence."""
+        return self._predict_ctx(self._ctx)
+
+    def draft(self, k: int, min_order: int = 2) -> list[int]:
+        """Up to k self-drafted next tokens (§31): rolling
+        longest-match lookups over context + the draft's own guesses,
+        WITHOUT absorbing them — the tables only ever learn emitted
+        truth. Zero RNG; stops early when evidence runs out.
+
+        ``min_order`` gates the FIRST guess on longest-match depth:
+        order-1 backoff fires on almost any context but measures ~2x
+        worse precision than an order->=2 match, and a fired-but-wrong
+        draft costs a wasted wide verify — the live drafter only
+        speaks when the evidence is strong (rolled continuations may
+        back off; the leading match already anchors them)."""
+        ctx = list(self._ctx)
+        out: list[int] = []
+        for i in range(max(0, int(k))):
+            guess = self._predict_ctx(
+                ctx, min_order if i == 0 else 1)
+            if guess is None:
+                break
+            out.append(guess)
+            ctx.append(guess)
+        return out
 
     def observe(self, tok: int) -> bool:
         """Score one emitted token against the draft, then absorb it;
@@ -330,17 +433,41 @@ class ServingObservatory:
             self.high_water = used
         active = sum(r is not None for r in eng._active)
         parked = len(eng._parked)
-        live = [
-            list(req.prompt) + list(eng._emitted[s])
-            for s, req in enumerate(eng._active) if req is not None
-        ]
-        live += [
-            list(p.req.prompt) + list(p.emitted) for p in eng._parked
-        ]
-        share = page_share_stats(live, eng.page_size)
+        store = getattr(eng, "_digest_store", None)
+        if store is not None:
+            # §31 satellite: the per-request digest store already
+            # holds every chain digest — the sample reads lists, it
+            # never rehashes token streams
+            rids = [req.id for req in eng._active if req is not None]
+            rids += [p.req.id for p in eng._parked]
+            share = digest_share_stats(
+                [store.pages(r) for r in rids])
+        else:
+            live = [
+                list(req.prompt) + list(eng._emitted[s])
+                for s, req in enumerate(eng._active)
+                if req is not None
+            ]
+            live += [
+                list(p.req.prompt) + list(p.emitted)
+                for p in eng._parked
+            ]
+            share = page_share_stats(live, eng.page_size)
         rate = self.accepted / self.scored if self.scored else 0.0
         occupancy = (used / total if total
                      else (active / eng.slots if eng.slots else 0.0))
+        cow_saved = int(getattr(eng, "cow_pages_saved", 0))
+        # realized saved fraction: of the LOGICAL pages live requests
+        # reference (unique leased + deduped entries), how many the
+        # pool did not have to lease. The §29-predicted headroom
+        # (shareable_frac) counts every family member, so realized
+        # lands within family_size/(family_size-1) ~ 2x of it.
+        logical = used + cow_saved
+        spec_scored = int(getattr(eng, "spec_drafts_scored", 0))
+        spec_rate = (
+            int(getattr(eng, "spec_drafts_accepted", 0)) / spec_scored
+            if spec_scored else 0.0
+        )
         sample = {
             "free": free,
             "used": used,
@@ -361,6 +488,20 @@ class ServingObservatory:
             "scored": self.scored,
             "accept_run_p50": self._run_percentile(0.50),
             "accept_run_p95": self._run_percentile(0.95),
+            # §31 live instruments (0 when COW/spec disabled)
+            "cow_saved_pages": cow_saved,
+            "cow_saved_frac": round(
+                cow_saved / logical if logical else 0.0, 4),
+            "cow_shared_total": int(
+                getattr(eng, "cow_pages_shared_total", 0)),
+            "cow_breaks": int(getattr(eng, "cow_breaks_total", 0)),
+            "spec_steps": int(getattr(eng, "spec_steps_total", 0)),
+            "spec_extra_tokens": int(
+                getattr(eng, "spec_extra_tokens_total", 0)),
+            "spec_accept_rate": round(spec_rate, 4),
+            "spec_scored": spec_scored,
+            "spec_collapsed": int(
+                getattr(eng, "spec_collapsed_total", 0)),
         }
         eid = eng.engine_id
         _pages_free.labels(eid).set(free)
@@ -368,6 +509,8 @@ class ServingObservatory:
         _pages_high_water.labels(eid).set(self.high_water)
         _shareable_frac_g.labels(eid).set(sample["shareable_frac"])
         _accept_rate_g.labels(eid).set(sample["accept_rate"])
+        _cow_saved_g.labels(eid).set(cow_saved)
+        _spec_rate_g.labels(eid).set(sample["spec_accept_rate"])
         get_journal().emit("kv_pool", **sample)
         with self._lock:
             self._last_sample = sample

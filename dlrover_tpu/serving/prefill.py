@@ -88,6 +88,42 @@ class PrefillEngine:
     def prefix_cache_queries(self) -> int:
         return self.engine.prefix_cache_queries
 
+    # §31 live counters forward too: the pool aggregation loop reads
+    # COW/spec facts off every ready replica through one surface, and a
+    # prefill replica answers with the wrapped engine's (zero) totals
+    # rather than an AttributeError
+    @property
+    def cow_pages_shared_total(self) -> int:
+        return self.engine.cow_pages_shared_total
+
+    @property
+    def cow_breaks_total(self) -> int:
+        return self.engine.cow_breaks_total
+
+    @property
+    def cow_pages_saved(self) -> int:
+        return self.engine.cow_pages_saved
+
+    @property
+    def spec_steps_total(self) -> int:
+        return self.engine.spec_steps_total
+
+    @property
+    def spec_extra_tokens_total(self) -> int:
+        return self.engine.spec_extra_tokens_total
+
+    @property
+    def spec_drafts_accepted(self) -> int:
+        return self.engine.spec_drafts_accepted
+
+    @property
+    def spec_drafts_scored(self) -> int:
+        return self.engine.spec_drafts_scored
+
+    @property
+    def spec_accept_rate(self) -> float:
+        return self.engine.spec_accept_rate
+
     def observatory_snapshot(self) -> dict | None:
         return self.engine.observatory_snapshot()
 

@@ -108,6 +108,10 @@ DOCUMENTED_PREFIXES = (
     # from the root" runbook keys on the link-transition/drop counters
     # and the lease-expiry / push-fence families
     "dlrover_tpu_partition_",
+    # serving raw speed (DESIGN.md §31): the "acceptance collapsed"
+    # runbook keys on the speculative-decode verify/accept families
+    # (the COW kv_cow_ gauges ride the engine_/gateway_ prefixes)
+    "dlrover_tpu_spec_",
 )
 
 # label names that are themselves an operator contract (dashboards and

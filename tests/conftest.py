@@ -122,3 +122,26 @@ def pytest_runtest_teardown(item):
 def tmp_ipc_dir(tmp_path, monkeypatch):
     monkeypatch.setenv("DLROVER_TPU_IPC_DIR", str(tmp_path / "ipc"))
     return tmp_path
+
+
+@pytest.fixture(autouse=True)
+def _kv_page_ledger_guard():
+    """§31 conservation invariant, asserted after EVERY test that
+    touched a serving engine: each physical KV page is exactly one of
+    free or leased-with-positive-refcount, and the COW sharing index
+    round-trips. Keyed off sys.modules so the ~90% of tests that never
+    import the serving engine pay nothing. Replica threads may still be
+    retiring when the test body returns, so one short retry absorbs
+    in-flight teardown before the failure is real."""
+    yield
+    import sys
+    import time as _time
+
+    em = sys.modules.get("dlrover_tpu.serving.engine")
+    if em is None:
+        return
+    bad = em.check_kv_ledgers()
+    if bad:
+        _time.sleep(0.05)
+        bad = em.check_kv_ledgers()
+    assert not bad, f"kv page ledger violated: {bad}"
